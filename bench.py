@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""bench.py — north-star benchmark: ResNet-50 ImageNet-shape training
+throughput, images/sec/chip (BASELINE.json:2).
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": R}
+
+vs_baseline compares against the first measured value recorded in
+BENCH_BASELINE.json (the reference publishes no numbers — BASELINE.md
+policy: first instrumented run IS the baseline, ratio 1.0 that round).
+
+Methodology: synthetic data (isolates device throughput from disk),
+bf16 compute policy, full train step (fwd+bwd+SGD update) on all local
+devices, timed over `--steps` steps after `--warmup` compile+warm steps,
+p50 step time → images/sec/chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-per-chip", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--model", default="resnet50")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        OptimConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    n_chips = jax.device_count()
+    mesh = build_mesh(MeshConfig(data=-1, fsdp=1, tensor=1, context=1))
+    model_cfg = ModelConfig(name=args.model, num_classes=1000,
+                            image_size=args.image_size)
+    model = build_model(model_cfg, PrecisionConfig(compute_dtype="bfloat16"))
+    tx, _ = make_optimizer(
+        OptimConfig(name="momentum", learning_rate=0.1, schedule="constant",
+                    warmup_steps=0),
+        total_steps=1000,
+    )
+    rules = rules_for_model(args.model)
+
+    def init_state(rng):
+        x = jnp.zeros((2, args.image_size, args.image_size, 3))
+        variables = model.init({"params": rng}, x, train=False)
+        return TrainState.create(params=variables["params"], tx=tx,
+                                 batch_stats=variables.get("batch_stats", {}))
+
+    rng = jax.random.PRNGKey(0)
+    shape = jax.eval_shape(init_state, rng)
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    state = jax.jit(init_state, out_shardings=sharding)(rng)
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(model, get_loss_fn("softmax_xent"), tx),
+        mesh, sharding,
+    )
+
+    global_batch = args.batch_per_chip * n_chips
+    rng_np = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(
+            rng_np.standard_normal(
+                (global_batch, args.image_size, args.image_size, 3)
+            ),
+            jnp.float32,
+        ),
+        "label": jnp.asarray(rng_np.integers(0, 1000, global_batch), jnp.int32),
+    }
+
+    for _ in range(args.warmup):
+        state, metrics = step(state, batch, rng)
+    jax.block_until_ready(state.params)
+
+    times = []
+    for _ in range(args.steps):
+        t0 = time.perf_counter()
+        state, metrics = step(state, batch, rng)
+        jax.block_until_ready(metrics["loss"])
+        times.append(time.perf_counter() - t0)
+
+    p50 = float(np.percentile(times, 50))
+    imgs_per_sec = global_batch / p50
+    per_chip = imgs_per_sec / n_chips
+
+    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    default_run = (args.batch_per_chip == 128 and args.image_size == 224
+                   and args.model == "resnet50")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f).get("resnet50_images_per_sec_per_chip")
+        if base:
+            vs = per_chip / base
+    elif default_run:
+        # First measured default run seeds the baseline (BASELINE.md policy);
+        # smoke runs with non-default shapes must not.
+        with open(baseline_path, "w") as f:
+            json.dump({"resnet50_images_per_sec_per_chip": per_chip,
+                       "recorded": time.strftime("%Y-%m-%d")}, f)
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``zoezhu/pytorch_distributed_train`` (a torch.distributed/NCCL harness — see
+SURVEY.md; the reference mount was empty, so parity targets are pinned by
+BASELINE.json and the torch 2.13.0 library sources its behavior is defined by):
+
+- ``init_process_group('nccl')`` + DDP grad all-reduce  →  one jit-compiled
+  train step over a ``jax.sharding.Mesh`` with compiler-placed collectives
+  (BASELINE.json:5).
+- ``DistributedSampler`` + ``DataLoader``  →  per-host sharded input pipeline
+  with prefetch to HBM (data/).
+- AMP/GradScaler + SGD  →  bf16 dtype policy + jitted optax update (optim.py).
+- DDP/FSDP wrappers  →  GSPMD sharding annotations over mesh axes
+  ``('data','fsdp','tensor','context')`` (parallel/).
+
+Public surface mirrors the reference harness: ``Trainer``, ``TrainConfig``
+presets for the five BASELINE.json config rows, and a ``train.py`` CLI.
+"""
+
+__version__ = "0.1.0"
+
+from pytorch_distributed_train_tpu.config import (  # noqa: F401
+    TrainConfig,
+    get_preset,
+    list_presets,
+)

@@ -1,0 +1,128 @@
+"""Orbax-backed checkpoint/resume (SURVEY §5.4, C21/C22).
+
+Replaces both reference paths with one mechanism:
+- rank-0 ``torch.save({'model','optim','epoch'})`` (torch:serialization.py:944)
+- sharded DCP save/load (torch:distributed/checkpoint/state_dict_saver.py:89)
+
+Orbax writes every host's param shards in parallel via TensorStore, saves
+asynchronously (step N+1 trains while N persists — no rank-0 bottleneck or
+barrier stall, SURVEY §3.5), and reshards on restore when the mesh changed
+(the FSDP→GSPMD resharding requirement, BASELINE.json:11).
+
+``resume='auto'`` restores the latest step when the directory has one — the
+default path, because TPU elasticity is whole-job-restart-and-resume
+(SURVEY §5.3b), not per-rank recovery.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from pytorch_distributed_train_tpu.train_state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, ckpt_cfg, config_json: str = ""):
+        self.cfg = ckpt_cfg
+        path = os.path.abspath(ckpt_cfg.dir)
+        os.makedirs(path, exist_ok=True)
+        self.dir = path
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=ckpt_cfg.max_to_keep,
+            enable_async_checkpointing=ckpt_cfg.async_save,
+        )
+        self.mgr = ocp.CheckpointManager(path, options=options)
+        self.config_json = config_json
+
+    # ------------------------------------------------------------------ save
+    def save(self, state: TrainState, *, epoch: int = 0, force: bool = False) -> bool:
+        step = int(state.step)
+        if step in self.mgr.all_steps():
+            return False  # cadence save already wrote this step
+        saved = self.mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(_savable(state)),
+                meta=ocp.args.JsonSave({"epoch": epoch, "config": self.config_json}),
+            ),
+            force=force,
+        )
+        return bool(saved)
+
+    def maybe_save(self, state: TrainState, *, epoch: int = 0) -> bool:
+        step = int(state.step)
+        if self.cfg.save_every_steps and step % self.cfg.save_every_steps == 0:
+            return self.save(state, epoch=epoch)
+        return False
+
+    # --------------------------------------------------------------- restore
+    def latest_step(self) -> int | None:
+        return self.mgr.latest_step()
+
+    def restore(self, abstract_state: TrainState, step: int | None = None
+                ) -> tuple[TrainState, dict] | None:
+        """Restore into the sharding/dtype layout of ``abstract_state``
+        (jax.eval_shape + shardings) — reshard-on-restore falls out of
+        Orbax restoring to the target sharding."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        restored = self.mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(_savable(abstract_state)),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        sav = restored["state"]
+        state = abstract_state.replace(
+            step=sav["step"],
+            params=sav["params"],
+            opt_state=_merge_opt_state(abstract_state.opt_state, sav["opt_state"]),
+            batch_stats=sav["batch_stats"],
+        )
+        if abstract_state.dynamic_scale is not None and "dynamic_scale" in sav:
+            state = state.replace(
+                dynamic_scale=abstract_state.dynamic_scale.replace(**sav["dynamic_scale"])
+            )
+        return state, (restored["meta"] or {})
+
+    def wait(self) -> None:
+        self.mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self.mgr.wait_until_finished()
+        self.mgr.close()
+
+
+def _savable(state: TrainState) -> dict[str, Any]:
+    """TrainState → plain dict pytree (drops the non-pytree tx; keeps a
+    stable state_dict-like naming scheme for cross-framework legibility —
+    SURVEY §7.4.2)."""
+    d = {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "batch_stats": state.batch_stats,
+    }
+    if state.dynamic_scale is not None:
+        d["dynamic_scale"] = {
+            "scale": state.dynamic_scale.scale,
+            "growth_tracker": state.dynamic_scale.growth_tracker,
+        }
+    return d
+
+
+def _merge_opt_state(abstract_opt, restored_opt):
+    """Opt state round-trips as nested lists/dicts; rebuild the original
+    structure (NamedTuples etc.) from the restored leaves."""
+    leaves = jax.tree_util.tree_leaves(restored_opt)
+    treedef = jax.tree_util.tree_structure(abstract_opt)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+

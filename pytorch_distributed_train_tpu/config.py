@@ -1,0 +1,378 @@
+"""Config system: one dataclass tree + named presets + CLI overrides.
+
+Replaces the reference harness's argparse CLI + launcher env contract
+(SURVEY.md §5.6; BASELINE.json:5 "behind the same config ... interface").
+The five BASELINE.json configs (lines 7-11) ship as named presets — they are
+the acceptance matrix:
+
+    resnet18_cifar10   ResNet-18 / CIFAR-10, single process       (line 7)
+    resnet50_imagenet  ResNet-50 / ImageNet, data-parallel        (line 8)
+    vit_b16_imagenet   ViT-B/16, bf16 + grad accumulation         (line 9)
+    bert_base_mlm      BERT-base MLM, LAMB optimizer              (line 10)
+    llama2_7b          Llama-2 7B pretrain, GSPMD param sharding  (line 11)
+
+Parallelism is *config*, not code: the ``mesh`` section chooses axis sizes on
+``('data','fsdp','tensor','context')`` and the partition rules in
+parallel/partition.py do the rest (SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def _fields(cls) -> dict[str, dataclasses.Field]:
+    return {f.name: f for f in dataclasses.fields(cls)}
+
+
+@dataclass
+class ModelConfig:
+    """Which model to build and its architecture knobs.
+
+    ``name`` keys into models/registry.py. Transformer fields are ignored by
+    the vision models and vice versa.
+    """
+
+    name: str = "resnet18"
+    num_classes: int = 10
+    image_size: int = 32
+    # ViT
+    patch_size: int = 16
+    # Transformer family (ViT / BERT / Llama)
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 0  # 0 → = num_heads (MHA); <num_heads → GQA (Llama)
+    mlp_dim: int = 3072
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    dropout_rate: float = 0.0
+    # Llama
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    # Memory: rematerialise each transformer block's activations in backward
+    remat: bool = False
+
+
+@dataclass
+class DataConfig:
+    """Input pipeline. ``batch_size`` is GLOBAL (summed over all hosts/chips),
+    matching the reference's per-step effective batch under DDP."""
+
+    dataset: str = "synthetic_images"  # synthetic_images | cifar10 | imagenet_folder | synthetic_lm | text_mlm
+    data_dir: str = ""
+    batch_size: int = 128
+    eval_batch_size: int = 0  # 0 → = batch_size
+    num_workers: int = 4
+    prefetch: int = 2  # device-side double/triple buffer depth
+    shuffle: bool = True
+    drop_last: bool = True  # SPMD needs static shapes; pad-or-drop final batch
+    seed: int = 0
+    # LM datasets
+    seq_len: int = 512
+    mlm_prob: float = 0.15
+    # Synthetic dataset length (steps worth of fake data per epoch)
+    synthetic_size: int = 51200
+
+
+@dataclass
+class OptimConfig:
+    """Optimizer + LR schedule (reference: torch.optim.SGD / LAMB — SURVEY C20)."""
+
+    name: str = "sgd"  # sgd | momentum | adamw | lamb | adam
+    learning_rate: float = 0.1
+    warmup_steps: int = 0
+    schedule: str = "cosine"  # constant | cosine | step | linear
+    # step schedule
+    step_decay_rate: float = 0.1
+    step_decay_every: int = 30  # epochs
+    momentum: float = 0.9
+    nesterov: bool = False
+    weight_decay: float = 1e-4
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    grad_clip_norm: float = 0.0  # 0 → off
+    accum_steps: int = 1  # optax.MultiSteps microbatching (≡ DDP no_sync)
+    # Final LR fraction for cosine
+    end_lr_factor: float = 0.0
+
+
+@dataclass
+class PrecisionConfig:
+    """Mixed precision policy. Replaces autocast + GradScaler (SURVEY C18/C19):
+    params stay fp32, compute runs in ``compute_dtype``. bf16 needs no loss
+    scaling on TPU; ``loss_scale`` keeps the reference's GradScaler knob for
+    fp16 experiments (default off)."""
+
+    compute_dtype: str = "float32"  # float32 | bfloat16
+    param_dtype: str = "float32"
+    # "none" | "dynamic" | a float for static scaling
+    loss_scale: str = "none"
+    loss_scale_init: float = 2.0**15
+    loss_scale_growth_interval: int = 2000
+
+
+@dataclass
+class MeshConfig:
+    """Device mesh axis sizes. -1 on one axis → fill with remaining devices.
+
+    data    — batch sharding (DP; reference DDP, SURVEY §2.3)
+    fsdp    — parameter sharding (ZeRO/FSDP → GSPMD, BASELINE.json:11)
+    tensor  — megatron TP on heads / mlp hidden
+    context — sequence/ring-attention parallelism (SURVEY §5.7)
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    context: int = 1
+    # Which mesh axes batch is sharded over (data+fsdp is the common combo).
+    batch_axes: tuple[str, ...] = ("data", "fsdp")
+
+
+@dataclass
+class CheckpointConfig:
+    """Orbax-backed checkpointing (SURVEY §5.4). ``resume='auto'`` restores the
+    latest step if present — the default path, not a flag (SURVEY §5.3b)."""
+
+    dir: str = "checkpoints"
+    save_every_steps: int = 1000
+    max_to_keep: int = 3
+    resume: str = "auto"  # auto | none | <explicit path>
+    async_save: bool = True
+
+
+@dataclass
+class ObsConfig:
+    """Observability: metrics cadence, profiler window, failure detection
+    (SURVEY §5.1-5.5)."""
+
+    log_every_steps: int = 50
+    jsonl_path: str = ""  # "" → <ckpt dir>/metrics.jsonl
+    tensorboard: bool = False
+    profile_start_step: int = 0  # 0 → profiling off
+    profile_num_steps: int = 0
+    profile_dir: str = "profiles"
+    heartbeat_timeout_s: float = 0.0  # 0 → heartbeat monitor off
+    debug_nans: bool = False
+    # Cross-host input-divergence check cadence (0 → off); SURVEY §5.2
+    check_input_sync_every: int = 0
+
+
+@dataclass
+class TrainConfig:
+    """Root config. Serialises to/from JSON; dotted-path CLI overrides."""
+
+    preset: str = ""
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
+    # Train loop horizon: epochs if >0, else total_steps.
+    epochs: int = 0
+    total_steps: int = 1000
+    eval_every_steps: int = 0  # 0 → eval at epoch boundaries only
+    seed: int = 42
+    # Loss: "softmax_xent" (classification) | "mlm_xent" | "causal_lm_xent"
+    loss: str = "softmax_xent"
+
+    # ------------------------------------------------------------------ io
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TrainConfig":
+        kwargs: dict[str, Any] = {}
+        for name in _fields(cls):
+            if name not in d:
+                continue
+            v = d[name]
+            if name in _SECTIONS:
+                kwargs[name] = _SECTIONS[name](**_coerce_section(_SECTIONS[name], v))
+            else:
+                kwargs[name] = v
+        return cls(**kwargs)
+
+    @classmethod
+    def from_json(cls, s: str) -> "TrainConfig":
+        return cls.from_dict(json.loads(s))
+
+    # ------------------------------------------------------- dotted access
+    def override(self, dotted: str, value: str) -> None:
+        """Apply one ``section.field=value`` override, coercing to the field type."""
+        parts = dotted.split(".")
+        obj: Any = self
+        for p in parts[:-1]:
+            if not hasattr(obj, p):
+                raise KeyError(f"no config section {p!r} in {dotted!r}")
+            obj = getattr(obj, p)
+        leaf = parts[-1]
+        if not hasattr(obj, leaf):
+            raise KeyError(f"no config field {leaf!r} in {dotted!r}")
+        cur = getattr(obj, leaf)
+        setattr(obj, leaf, _coerce(value, cur))
+
+    def apply_overrides(self, pairs: list[str]) -> None:
+        for pair in pairs:
+            if "=" not in pair:
+                raise ValueError(f"override must be key=value, got {pair!r}")
+            k, v = pair.split("=", 1)
+            self.override(k.strip(), v.strip())
+
+
+_SECTIONS = {
+    "model": ModelConfig,
+    "data": DataConfig,
+    "optim": OptimConfig,
+    "precision": PrecisionConfig,
+    "mesh": MeshConfig,
+    "checkpoint": CheckpointConfig,
+    "obs": ObsConfig,
+}
+
+
+def _coerce_section(cls, d: dict[str, Any]) -> dict[str, Any]:
+    names = _fields(cls)
+    out = {}
+    for k, v in d.items():
+        if k in names:
+            if isinstance(v, list):
+                v = tuple(v)
+            out[k] = v
+    return out
+
+
+def _coerce(value: str, current: Any) -> Any:
+    """Coerce a CLI string to the type of the current value."""
+    if isinstance(current, bool):
+        if value.lower() in ("1", "true", "yes", "on"):
+            return True
+        if value.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"bad bool {value!r}")
+    if isinstance(current, int):
+        return int(value)
+    if isinstance(current, float):
+        return float(value)
+    if isinstance(current, tuple):
+        return tuple(x.strip() for x in value.split(",") if x.strip())
+    return value
+
+
+# ============================================================== presets
+# The BASELINE.json:7-11 acceptance matrix.
+
+def _resnet18_cifar10() -> TrainConfig:
+    """BASELINE.json:7 — ResNet-18 on CIFAR-10, single-process smoke config."""
+    c = TrainConfig(preset="resnet18_cifar10")
+    c.model = ModelConfig(name="resnet18", num_classes=10, image_size=32)
+    c.data = DataConfig(dataset="cifar10", batch_size=128)
+    c.optim = OptimConfig(
+        name="momentum", learning_rate=0.1, momentum=0.9, weight_decay=5e-4,
+        schedule="cosine", warmup_steps=200,
+    )
+    c.epochs = 30
+    c.loss = "softmax_xent"
+    return c
+
+
+def _resnet50_imagenet() -> TrainConfig:
+    """BASELINE.json:8 — ResNet-50 / ImageNet, DDP all-reduce → data-parallel mesh."""
+    c = TrainConfig(preset="resnet50_imagenet")
+    c.model = ModelConfig(name="resnet50", num_classes=1000, image_size=224)
+    c.data = DataConfig(dataset="imagenet_folder", batch_size=1024, num_workers=16)
+    c.optim = OptimConfig(
+        name="momentum", learning_rate=0.4, momentum=0.9, weight_decay=1e-4,
+        schedule="cosine", warmup_steps=2500, nesterov=False,
+    )
+    c.precision = PrecisionConfig(compute_dtype="bfloat16")
+    c.mesh = MeshConfig(data=-1)
+    c.epochs = 90
+    c.loss = "softmax_xent"
+    return c
+
+
+def _vit_b16_imagenet() -> TrainConfig:
+    """BASELINE.json:9 — ViT-B/16, bf16 mixed precision + grad accumulation."""
+    c = TrainConfig(preset="vit_b16_imagenet")
+    c.model = ModelConfig(
+        name="vit_b16", num_classes=1000, image_size=224, patch_size=16,
+        hidden_size=768, num_layers=12, num_heads=12, mlp_dim=3072,
+        dropout_rate=0.1,
+    )
+    c.data = DataConfig(dataset="imagenet_folder", batch_size=4096, num_workers=16)
+    c.optim = OptimConfig(
+        name="adamw", learning_rate=3e-3, weight_decay=0.3, beta2=0.999,
+        schedule="cosine", warmup_steps=10000, accum_steps=4, grad_clip_norm=1.0,
+    )
+    c.precision = PrecisionConfig(compute_dtype="bfloat16")
+    c.epochs = 300
+    c.loss = "softmax_xent"
+    return c
+
+
+def _bert_base_mlm() -> TrainConfig:
+    """BASELINE.json:10 — BERT-base MLM on Wikipedia, LAMB optimizer."""
+    c = TrainConfig(preset="bert_base_mlm")
+    c.model = ModelConfig(
+        name="bert_base", hidden_size=768, num_layers=12, num_heads=12,
+        mlp_dim=3072, vocab_size=30522, max_seq_len=512, dropout_rate=0.1,
+    )
+    c.data = DataConfig(dataset="text_mlm", batch_size=256, seq_len=512, mlm_prob=0.15)
+    c.optim = OptimConfig(
+        name="lamb", learning_rate=1.75e-3, weight_decay=0.01,
+        schedule="linear", warmup_steps=3125, grad_clip_norm=1.0,
+    )
+    c.precision = PrecisionConfig(compute_dtype="bfloat16")
+    c.total_steps = 28125
+    c.loss = "mlm_xent"
+    return c
+
+
+def _llama2_7b() -> TrainConfig:
+    """BASELINE.json:11 — Llama-2 7B pretrain; FSDP → GSPMD param sharding."""
+    c = TrainConfig(preset="llama2_7b")
+    c.model = ModelConfig(
+        name="llama", hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=32, mlp_dim=11008, vocab_size=32000, max_seq_len=4096,
+        rope_theta=10000.0, rms_norm_eps=1e-5, remat=True,
+    )
+    c.data = DataConfig(dataset="synthetic_lm", batch_size=128, seq_len=4096)
+    c.optim = OptimConfig(
+        name="adamw", learning_rate=3e-4, weight_decay=0.1, beta2=0.95,
+        schedule="cosine", warmup_steps=2000, grad_clip_norm=1.0,
+    )
+    c.precision = PrecisionConfig(compute_dtype="bfloat16")
+    c.mesh = MeshConfig(data=1, fsdp=-1)
+    c.total_steps = 500000
+    c.loss = "causal_lm_xent"
+    return c
+
+
+_PRESETS = {
+    "resnet18_cifar10": _resnet18_cifar10,
+    "resnet50_imagenet": _resnet50_imagenet,
+    "vit_b16_imagenet": _vit_b16_imagenet,
+    "bert_base_mlm": _bert_base_mlm,
+    "llama2_7b": _llama2_7b,
+}
+
+
+def list_presets() -> list[str]:
+    return sorted(_PRESETS)
+
+
+def get_preset(name: str) -> TrainConfig:
+    if name not in _PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {list_presets()}")
+    return _PRESETS[name]()

@@ -1,0 +1,13 @@
+"""Per-host sharded input pipeline (SURVEY L3, §3.4).
+
+Replaces the reference's DataLoader machinery (torch:utils/data/dataloader.py:149,
+worker processes, pin-memory thread) and DistributedSampler
+(torch:utils/data/distributed.py:17) with: an index sampler reproducing the
+exact seed+epoch shuffle / pad / stride semantics, per-host dataset shards,
+a threaded prefetch loader, and device-put double-buffering into HBM so step
+N+1's batch lands while step N computes (BASELINE.json:5 "device-side
+prefetch to HBM").
+"""
+
+from pytorch_distributed_train_tpu.data.sampler import DistributedSampler  # noqa: F401
+from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline  # noqa: F401

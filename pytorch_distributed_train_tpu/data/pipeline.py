@@ -1,0 +1,228 @@
+"""Host data loader + HBM prefetch (SURVEY C17, §3.4 TPU mapping).
+
+Pipeline stages, each overlapped with the next:
+
+  sampler indices ─→ [worker threads: decode/augment/collate]
+                 ─→ [background producer thread, bounded queue]
+                 ─→ [jax.make_array_from_process_local_data → HBM,
+                     `prefetch`-deep buffer]  ─→ jitted step
+
+Threads replace the reference's DataLoader worker *processes*
+(torch:utils/data/_utils/worker.py:244): PIL decode and numpy release the
+GIL, and there is no CUDA pinned-memory dance — device_put DMAs straight to
+HBM while the previous step runs (the double-buffer the reference gets from
+its pin-memory thread + non_blocking copies, torch:utils/data/_utils/
+pin_memory.py:18).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from pytorch_distributed_train_tpu.data.sampler import DistributedSampler
+
+
+class HostDataLoader:
+    """Per-host loader: yields this host's shard of each global batch.
+
+    Length semantics: drop_last=True (training) truncates to full batches —
+    required for SPMD static shapes (SURVEY §7.4.5); eval pads the tail batch
+    by wrapping (sampler already padded to host-divisibility).
+    """
+
+    def __init__(self, dataset, data_cfg, *, train: bool,
+                 num_hosts: int | None = None, host_id: int | None = None):
+        self.dataset = dataset
+        self.train = train
+        self.num_hosts = num_hosts if num_hosts is not None else jax.process_count()
+        self.host_id = host_id if host_id is not None else jax.process_index()
+        global_batch = data_cfg.batch_size if train else (
+            data_cfg.eval_batch_size or data_cfg.batch_size
+        )
+        if global_batch % self.num_hosts != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by {self.num_hosts} hosts"
+            )
+        self.host_batch = global_batch // self.num_hosts
+        self.global_batch = global_batch
+        self.seed = data_cfg.seed
+        self.num_workers = data_cfg.num_workers
+        self.sampler = DistributedSampler(
+            len(dataset), self.num_hosts, self.host_id,
+            shuffle=train and data_cfg.shuffle, seed=data_cfg.seed,
+            drop_last=False,
+        )
+        self._pool: ThreadPoolExecutor | None = None
+
+    @property
+    def steps_per_epoch(self) -> int:
+        n = self.sampler.num_samples
+        if self.train:
+            return n // self.host_batch
+        return (n + self.host_batch - 1) // self.host_batch
+
+    def epoch(self, epoch: int) -> Iterator[dict]:
+        """Yield host-local numpy batches for one epoch."""
+        self.sampler.set_epoch(epoch)
+        idx = self.sampler.indices()
+        n_steps = self.steps_per_epoch
+        if not self.train:
+            # pad tail by wrapping so every step is full-size (weights unused
+            # rows are the caller's concern only for exact eval metrics)
+            need = n_steps * self.host_batch
+            if len(idx) < need:
+                idx = np.concatenate([idx, idx[: need - len(idx)]])
+        for b in range(n_steps):
+            chunk = idx[b * self.host_batch : (b + 1) * self.host_batch]
+            rng = np.random.default_rng(
+                np.random.SeedSequence((self.seed, epoch, b, self.host_id))
+            )
+            yield self._collate(chunk, rng)
+
+    def _collate(self, chunk: np.ndarray, rng: np.random.Generator) -> dict:
+        if not getattr(self.dataset, "is_item_style", False):
+            return self.dataset.get_batch(chunk, rng, self.train)
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=max(1, self.num_workers))
+        seeds = rng.integers(0, 2**63, size=len(chunk))
+        items = list(
+            self._pool.map(
+                lambda a: self.dataset.get_item(int(a[0]), np.random.default_rng(int(a[1]))),
+                zip(chunk, seeds),
+            )
+        )
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+
+class _Producer(threading.Thread):
+    """Background producer draining an iterator into a bounded queue —
+    keeps host-side collate off the step critical path.
+
+    Shut-down safe: an abandoned consumer (early break from the epoch, step
+    cap reached) calls stop() from the iterator's finally, which unblocks a
+    producer wedged on a full queue — no leaked threads holding prefetch
+    buffers."""
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, depth: int):
+        super().__init__(daemon=True)
+        self.it = it
+        self.q: queue.Queue = queue.Queue(maxsize=depth)
+        self.error: BaseException | None = None
+        self._stopped = threading.Event()
+        self.start()
+
+    def run(self):
+        try:
+            for item in self.it:
+                while not self._stopped.is_set():
+                    try:
+                        self.q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stopped.is_set():
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self.error = e
+        finally:
+            # blocking-with-stop-check put: the queue may be full here, and
+            # dropping the marker would wedge the consumer on q.get() forever
+            while not self._stopped.is_set():
+                try:
+                    self.q.put(self._DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self.q.get()
+                if item is self._DONE:
+                    if self.error is not None:
+                        raise self.error
+                    return
+                yield item
+        finally:
+            self.stop()
+
+
+def device_prefetch(host_batches: Iterator[dict], mesh, batch_axes=("data", "fsdp"),
+                    depth: int = 2) -> Iterator[dict]:
+    """Assemble global jax.Arrays from host-local shards and keep `depth`
+    batches in flight to HBM (BASELINE.json:5 'device-side prefetch to HBM').
+
+    device_put is async — enqueueing the transfer returns immediately, so the
+    DMA for batch N+1 overlaps step N's compute.
+    """
+    sharding = NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))
+
+    def to_device(b: dict) -> dict:
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in b.items()
+        }
+
+    buf: deque = deque()
+    try:
+        for b in host_batches:
+            buf.append(to_device(b))
+            if len(buf) >= depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+    finally:
+        close = getattr(host_batches, "close", None)
+        if close is not None:
+            close()
+
+
+def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
+                         batch_axes=("data", "fsdp"), sync_check_every: int = 0):
+    """Convenience: loader + producer thread + device prefetch.
+
+    Returns (loader, epoch_fn) where epoch_fn(epoch) yields device-resident
+    global batches. ``sync_check_every`` enables the cross-host input
+    divergence check (SURVEY §5.2) on HOST-LOCAL batches, before global
+    array assembly — after assembly all hosts see identical global shapes by
+    construction, so checking there would be vacuous. The check runs on the
+    consumer thread (collectives must not race the step's collectives).
+    """
+    loader = HostDataLoader(dataset, data_cfg, train=train)
+
+    def epoch_fn(epoch: int) -> Iterator[dict]:
+        host_iter = iter(_Producer(loader.epoch(epoch),
+                                   depth=max(2, data_cfg.prefetch)))
+        if sync_check_every:
+            from pytorch_distributed_train_tpu.utils.debug import check_input_sync
+
+            def checked(it):
+                for i, b in enumerate(it):
+                    if i % sync_check_every == 0:
+                        check_input_sync(b)
+                    yield b
+
+            host_iter = checked(host_iter)
+        return device_prefetch(
+            host_iter, mesh, batch_axes=batch_axes, depth=data_cfg.prefetch
+        )
+
+    return loader, epoch_fn

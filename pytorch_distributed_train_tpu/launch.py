@@ -1,0 +1,67 @@
+"""Distributed bring-up (SURVEY L5, §3.1-3.2).
+
+The reference needs torchrun + elastic agent + TCPStore rendezvous +
+init_process_group('nccl') (SURVEY C5/C10: ~15k LoC of launcher machinery).
+On TPU the pod is gang-scheduled and bootstrap is ONE call —
+``jax.distributed.initialize`` starts/joins the coordination service
+(coordinator = process 0), after which every process sees the global device
+set. This module wraps that call with env-driven defaults so single-process
+runs (the sandbox, CPU tests) skip it transparently.
+
+Env contract (the torchrun RANK/WORLD_SIZE/MASTER_ADDR analogue — honored
+when set, auto-detected on real TPU pods where libtpu supplies topology):
+  COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def initialize_distributed(force: bool = False) -> None:
+    """Idempotent jax.distributed.initialize with env-driven config.
+
+    No-op for single-process runs unless env vars or `force` say otherwise —
+    matching the reference's "CPU smoke config runs without DDP" behavior
+    (BASELINE.json:7).
+    """
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    nproc = os.environ.get("NUM_PROCESSES")
+    pid = os.environ.get("PROCESS_ID")
+    explicit = coord is not None or nproc is not None or pid is not None
+    if not explicit and not force and not _on_multihost_tpu():
+        return
+    kwargs = {}
+    if coord:
+        kwargs["coordinator_address"] = coord
+    if nproc:
+        kwargs["num_processes"] = int(nproc)
+    if pid:
+        kwargs["process_id"] = int(pid)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already initialized" not in str(e):
+            raise
+
+
+def _on_multihost_tpu() -> bool:
+    # libtpu sets these on real pods. A single-entry TPU_WORKER_HOSTNAMES
+    # (e.g. 'localhost' in the sandbox) is still a one-process job.
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
+    return bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+
+
+def runtime_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+    }

@@ -1,0 +1,66 @@
+"""Loss functions for the acceptance-matrix workloads.
+
+All losses return (scalar_loss, aux_metrics_dict) with the loss in fp32.
+Static-shape discipline throughout: MLM and causal-LM losses weight ALL
+positions instead of gathering a dynamic number of masked/valid tokens
+(dynamic shapes would force recompilation — SURVEY §7.4.5).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def softmax_xent(logits, batch, *_):
+    """Classification loss. batch: {'image':…, 'label': (B,) int}."""
+    labels = batch["label"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    acc = (jnp.argmax(logits, axis=-1) == labels).mean()
+    return loss, {"accuracy": acc}
+
+
+def mlm_xent(logits, batch, *_):
+    """Masked-LM loss. batch: {'input_ids', 'labels', 'label_weights', ...}.
+
+    `labels` holds original token ids at masked positions (anything
+    elsewhere); `label_weights` is 1.0 at the positions that count
+    (the reference-era BERT convention — ~15% of tokens, BASELINE.json:10).
+    """
+    labels = batch["labels"]
+    weights = batch["label_weights"].astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (per_tok * weights).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == labels) * weights).sum() / denom
+    return loss, {"mlm_accuracy": acc}
+
+
+def causal_lm_xent(logits, batch, *_):
+    """Next-token loss. batch: {'input_ids': (B,S)}; optional 'loss_mask'.
+
+    Shifts inside the loss (logits[:, :-1] vs ids[:, 1:]) so the data
+    pipeline ships one tensor, as the reference's LM collate does.
+    """
+    ids = batch["input_ids"]
+    logits = logits[:, :-1]
+    targets = ids[:, 1:]
+    weights = batch.get("loss_mask", jnp.ones_like(ids, jnp.float32))[:, 1:]
+    weights = weights.astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (per_tok * weights).sum() / denom
+    return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+LOSSES = {
+    "softmax_xent": softmax_xent,
+    "mlm_xent": mlm_xent,
+    "causal_lm_xent": causal_lm_xent,
+}
+
+
+def get_loss_fn(name: str):
+    if name not in LOSSES:
+        raise KeyError(f"unknown loss {name!r}; have {sorted(LOSSES)}")
+    return LOSSES[name]

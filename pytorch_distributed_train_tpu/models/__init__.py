@@ -1,0 +1,10 @@
+"""Model zoo behind one registry keyed by config (SURVEY H3).
+
+The reference exposes ResNet/ViT "behind the same config and checkpoint
+interface" (BASELINE.json:5); the acceptance matrix adds BERT-base and
+Llama-2 7B (BASELINE.json:10-11). All models here are Flax Linen modules with
+an explicit ``dtype``/``param_dtype`` policy replacing torch AMP autocast
+(SURVEY C18).
+"""
+
+from pytorch_distributed_train_tpu.models.registry import build_model, list_models  # noqa: F401

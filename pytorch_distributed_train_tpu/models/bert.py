@@ -1,0 +1,153 @@
+"""BERT-base for masked-LM pretraining (SURVEY H3; BASELINE.json:10).
+
+The reference's config 4 is "BERT-base MLM on Wikipedia (sequence model, LAMB
+optimizer)". This is the classic post-LN BERT encoder: learned word +
+position + segment embeddings, 12 post-LN blocks, tied-embedding MLM head
+with GELU transform. Attention rides ops.attention (BSHD, fp32 softmax).
+
+TPU notes:
+- Padding mask arrives as (B, S) int/bool; expanded once to (B,1,1,S) —
+  static shapes, no data-dependent control flow (XLA requirement).
+- MLM loss is computed over ALL positions with a weight mask rather than
+  gathering masked positions (dynamic-size gather would break static shapes);
+  see losses.mlm_xent.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
+
+
+class BertSelfAttention(nn.Module):
+    num_heads: int
+    dropout_rate: float
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, pad_mask, deterministic: bool):
+        B, S, C = x.shape
+        head_dim = C // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name,
+        )
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        y = dot_product_attention(q, k, v, mask=pad_mask)
+        y = nn.DenseGeneral(
+            C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
+            name="attn_out",
+        )(y)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return y
+
+
+class BertLayer(nn.Module):
+    """Post-LN transformer block (original BERT ordering)."""
+
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float
+    deterministic: bool
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32, name=name
+        )
+        attn = BertSelfAttention(
+            self.num_heads, self.dropout_rate, self.dtype, self.param_dtype,
+            name="attn",
+        )(x, pad_mask, self.deterministic)
+        x = ln("ln_attn")(x + attn).astype(self.dtype)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_out")(h)
+        h = nn.Dropout(self.dropout_rate)(h, deterministic=self.deterministic)
+        x = ln("ln_mlp")(x + h).astype(self.dtype)
+        return x
+
+
+class BertForMLM(nn.Module):
+    """Inputs: dict with input_ids (B,S), attention_mask (B,S), optional
+    token_type_ids (B,S). Output: (B, S, vocab) fp32 logits."""
+
+    vocab_size: int
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    dropout_rate: float = 0.1
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 train: bool = True):
+        deterministic = not train
+        B, S = input_ids.shape
+
+        word = nn.Embed(self.vocab_size, self.hidden_size,
+                        embedding_init=nn.initializers.normal(0.02),
+                        param_dtype=self.param_dtype, name="word_embed")
+        x = word(input_ids)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.max_seq_len, self.hidden_size), self.param_dtype)
+        x = x + pos[:, :S].astype(x.dtype)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + nn.Embed(2, self.hidden_size,
+                         embedding_init=nn.initializers.normal(0.02),
+                         param_dtype=self.param_dtype, name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32,
+                         name="embed_ln")(x)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        x = x.astype(self.dtype)
+
+        if attention_mask is None:
+            pad_mask = None
+        else:
+            pad_mask = attention_mask[:, None, None, :].astype(bool)  # (B,1,1,S)
+
+        block_cls = nn.remat(BertLayer) if self.remat else BertLayer
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
+                self.dtype, self.param_dtype, name=f"layer{i}",
+            )(x, pad_mask)
+
+        # MLM head: dense + GELU + LN, then decode against tied word embeddings.
+        h = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlm_dense")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32,
+                         name="mlm_ln")(h)
+        logits = word.attend(h.astype(self.param_dtype))
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (self.vocab_size,), jnp.float32
+        )
+        return logits.astype(jnp.float32)
+
+
+def bert_base(cfg, dtype, param_dtype) -> BertForMLM:
+    return BertForMLM(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        mlp_dim=cfg.mlp_dim,
+        max_seq_len=cfg.max_seq_len,
+        dropout_rate=cfg.dropout_rate,
+        remat=cfg.remat,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

@@ -1,0 +1,188 @@
+"""Llama-2 decoder for pretraining (SURVEY H3; BASELINE.json:11).
+
+Config 5 of the acceptance matrix: "Llama-2 7B pretrain, FSDP → XLA GSPMD
+param sharding". Architecture: RMSNorm (pre-norm), rotary position
+embeddings, GQA-capable attention, SwiGLU MLP, untied LM head — the Llama-2
+recipe, sized by ModelConfig (7B = hidden 4096 / 32 layers / 32 heads /
+mlp 11008 / vocab 32000).
+
+TPU-first notes:
+- Param layout is chosen for the FSDP×TP partition rules in
+  parallel/partition.py::llama_rules (projection kernels keep hidden first so
+  'fsdp' shards the big dim, 'tensor' the head dim).
+- RoPE is precomputed per call at trace time — it folds into constants under
+  jit; no cache buffers to shard.
+- `remat=True` (the 7B preset default) checkpoints each block: standard
+  HBM-for-FLOPs trade (SURVEY "jax.checkpoint / rematerialisation").
+- Causal masking happens inside the attention core; no materialised (S,S)
+  mask tensor at the model level.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        y = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float) -> tuple:
+    """Precompute cos/sin tables (S, head_dim/2) in fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (S, D/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: (B, S, H, D). Rotates pairs (x[..., :D/2], x[..., D/2:]) — the
+    'split-half' convention (matches HF Llama, so checkpoints interop)."""
+    B, S, H, D = x.shape
+    x1, x2 = x[..., : D // 2], x[..., D // 2:]
+    cos = cos[None, :S, None, :].astype(x.dtype)
+    sin = sin[None, :S, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+class LlamaAttention(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    rope_theta: float
+    max_seq_len: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, C = x.shape
+        head_dim = C // self.num_heads
+        proj = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+            (heads, head_dim), axis=-1, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name=name,
+        )
+        q = proj(self.num_heads, "q_proj")(x)
+        k = proj(self.num_kv_heads, "k_proj")(x)
+        v = proj(self.num_kv_heads, "v_proj")(x)
+
+        cos, sin = rope_frequencies(head_dim, S, self.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        y = dot_product_attention(q, k, v, causal=True)
+        y = nn.DenseGeneral(
+            C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name="o_proj",
+        )(y)
+        return y
+
+
+class LlamaMLP(nn.Module):
+    mlp_dim: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        dense = lambda dim, name: nn.Dense(  # noqa: E731
+            dim, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name=name,
+        )
+        gate = nn.silu(dense(self.mlp_dim, "gate_proj")(x))
+        up = dense(self.mlp_dim, "up_proj")(x)
+        return dense(x.shape[-1], "down_proj")(gate * up)
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    mlp_dim: int
+    rope_theta: float
+    max_seq_len: int
+    rms_norm_eps: float
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        h = RMSNorm(self.rms_norm_eps, name="input_norm")(x)
+        x = x + LlamaAttention(
+            self.num_heads, self.num_kv_heads, self.rope_theta,
+            self.max_seq_len, self.dtype, self.param_dtype, name="attn",
+        )(h)
+        h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
+        x = x + LlamaMLP(self.mlp_dim, self.dtype, self.param_dtype, name="mlp")(h)
+        return x
+
+
+class LlamaForCausalLM(nn.Module):
+    """Input: input_ids (B, S). Output: (B, S, vocab) fp32 logits."""
+
+    vocab_size: int
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    mlp_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    remat: bool = True
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = True):
+        del train  # no dropout in the Llama-2 pretrain recipe
+        x = nn.Embed(
+            self.vocab_size, self.hidden_size,
+            embedding_init=nn.initializers.normal(0.02),
+            param_dtype=self.param_dtype, name="tok_embed",
+        )(input_ids).astype(self.dtype)
+
+        block_cls = nn.remat(LlamaBlock) if self.remat else LlamaBlock
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads, self.num_kv_heads, self.mlp_dim,
+                self.rope_theta, self.max_seq_len, self.rms_norm_eps,
+                self.dtype, self.param_dtype, name=f"layer{i}",
+            )(x)
+
+        x = RMSNorm(self.rms_norm_eps, name="final_norm")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def llama(cfg, dtype, param_dtype) -> LlamaForCausalLM:
+    return LlamaForCausalLM(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads or cfg.num_heads,
+        mlp_dim=cfg.mlp_dim,
+        max_seq_len=cfg.max_seq_len,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_norm_eps,
+        remat=cfg.remat,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

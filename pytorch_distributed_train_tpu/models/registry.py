@@ -1,0 +1,57 @@
+"""Model registry: config name → Flax module (SURVEY H3, §7.2 `models/`).
+
+The reference selects its model from config ("ResNet/ViT ... behind the same
+config and checkpoint interface", BASELINE.json:5); this is the same switch,
+plus the BERT/Llama rows of the acceptance matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def _populate():
+    if _REGISTRY:
+        return
+    from pytorch_distributed_train_tpu.models import bert, llama, resnet, vit
+
+    _REGISTRY.update(
+        {
+            "resnet18": resnet.resnet18,
+            "resnet50": resnet.resnet50,
+            "vit_b16": vit.vit_b16,
+            "bert_base": bert.bert_base,
+            "llama": llama.llama,
+        }
+    )
+
+
+def list_models() -> list[str]:
+    _populate()
+    return sorted(_REGISTRY)
+
+
+def build_model(model_cfg, precision_cfg):
+    """Build the Flax module for a ModelConfig under a PrecisionConfig."""
+    _populate()
+    name = model_cfg.name
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {list_models()}")
+    dtype = jnp.dtype(precision_cfg.compute_dtype)
+    param_dtype = jnp.dtype(precision_cfg.param_dtype)
+    return _REGISTRY[name](model_cfg, dtype, param_dtype)
+
+
+def is_language_model(name: str) -> bool:
+    return name.startswith(("bert", "llama", "gpt"))

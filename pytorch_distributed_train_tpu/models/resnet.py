@@ -1,0 +1,164 @@
+"""ResNet-18/50 in Flax Linen (SURVEY H3; BASELINE.json:7-8).
+
+TPU-first choices, not a torchvision translation:
+- NHWC layout throughout (XLA:TPU's native conv layout; NCHW forces
+  transposes before every conv).
+- BatchNorm runs in fp32 even under a bf16 compute policy (variance in bf16
+  underflows); `axis_name='batch'` is deliberately NOT used — per-device BN
+  statistics match DDP semantics, where torch BN normalises over the local
+  batch only (torch DDP does not sync BN unless SyncBatchNorm is opted into).
+- A `cifar_stem` flag swaps the 7x7/s2+maxpool ImageNet stem for the 3x3/s1
+  stem every CIFAR ResNet-18 recipe uses — the reference's config 1 vs 2
+  distinction (BASELINE.json:7 vs :8).
+
+Weight init mirrors the reference-era recipe: He-normal conv kernels,
+zero-init for the final BN scale in each residual branch (the "zero-init
+residual" trick), so early training matches torch defaults closely enough for
+the golden-numerics cross-check (SURVEY §4.5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    """Basic 3x3+3x3 block (ResNet-18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), (self.strides, self.strides), name="conv_proj"
+            )(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1-3x3-1x1 bottleneck (ResNet-50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides), name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), (self.strides, self.strides), name="conv_proj"
+            )(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Input: NHWC images. Output: (batch, num_classes) logits in fp32."""
+
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int
+    num_filters: int = 64
+    cifar_stem: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,  # BN stats in fp32 regardless of compute dtype
+            param_dtype=jnp.float32,
+        )
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), name="conv_stem")(x)
+            x = norm(name="bn_stem")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                     name="conv_stem")(x)
+            x = norm(name="bn_stem")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    conv=conv,
+                    norm=norm,
+                    strides=strides,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.01),
+            name="fc",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(cfg, dtype, param_dtype) -> ResNet:
+    return ResNet(
+        stage_sizes=(2, 2, 2, 2),
+        block_cls=ResNetBlock,
+        num_classes=cfg.num_classes,
+        cifar_stem=cfg.image_size <= 64,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )
+
+
+def resnet50(cfg, dtype, param_dtype) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        block_cls=BottleneckBlock,
+        num_classes=cfg.num_classes,
+        cifar_stem=False,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

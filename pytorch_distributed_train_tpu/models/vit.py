@@ -1,0 +1,159 @@
+"""ViT-B/16 in Flax Linen (SURVEY H3; BASELINE.json:9).
+
+Design notes (TPU-first, not a timm translation):
+- Patch embedding is a strided conv in NHWC — one big MXU matmul per image.
+- Attention goes through ops.attention.dot_product_attention (BSHD layout,
+  fp32 softmax) so the Pallas flash kernel can slot in transparently.
+- Learned position embeddings, prepended CLS token, pre-LN blocks, GELU MLP —
+  the ViT-B/16 recipe the reference's config targets (bf16 + grad
+  accumulation, BASELINE.json:9).
+- LayerNorm in fp32 under a bf16 policy (same rationale as BN in resnet.py).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dropout_rate: float
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_in")(x)
+        x = nn.gelu(x)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        x = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_out")(x)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        return x
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dropout_rate: float
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        B, S, C = x.shape
+        head_dim = C // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim),
+            axis=-1,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name=name,
+        )
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        y = dot_product_attention(q, k, v)
+        y = nn.DenseGeneral(
+            C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
+            name="attn_out",
+        )(y)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return y
+
+
+class EncoderBlock(nn.Module):
+    # `deterministic` is a module attribute, not a call arg, so nn.remat needs
+    # no static_argnums bookkeeping (attributes are never traced).
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float
+    deterministic: bool
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        norm = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=1e-6, dtype=jnp.float32, param_dtype=jnp.float32, name=name
+        )
+        x = x + MultiHeadAttention(
+            self.num_heads, self.dropout_rate, self.dtype, self.param_dtype,
+            name="attn",
+        )(norm("ln1")(x).astype(self.dtype), self.deterministic)
+        x = x + MlpBlock(
+            self.mlp_dim, self.dropout_rate, self.dtype, self.param_dtype,
+            name="mlp",
+        )(norm("ln2")(x).astype(self.dtype), self.deterministic)
+        return x
+
+
+class ViT(nn.Module):
+    """Input: NHWC images. Output: (batch, num_classes) fp32 logits."""
+
+    num_classes: int
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        deterministic = not train
+        p = self.patch_size
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.hidden_size, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, param_dtype=self.param_dtype, name="patch_embed",
+        )(x)
+        B, H, W, C = x.shape
+        x = x.reshape(B, H * W, C)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, C), self.param_dtype
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, C)).astype(self.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, H * W + 1, C),
+            self.param_dtype,
+        )
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
+                self.dtype, self.param_dtype, name=f"block{i}",
+            )(x)
+
+        x = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        x = x[:, 0]  # CLS token
+        x = nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.zeros, name="head",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def vit_b16(cfg, dtype, param_dtype) -> ViT:
+    return ViT(
+        num_classes=cfg.num_classes,
+        patch_size=cfg.patch_size,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        mlp_dim=cfg.mlp_dim,
+        dropout_rate=cfg.dropout_rate,
+        remat=cfg.remat,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

@@ -1,0 +1,9 @@
+"""Compute ops: attention cores and Pallas TPU kernels.
+
+The reference's hot compute path is ATen/cuDNN kernels (SURVEY C23); here it
+is XLA-compiled HLO targeting the MXU, with Pallas kernels where XLA
+underperforms (fused flash attention) and ring collectives for context
+parallelism (SURVEY §5.7).
+"""
+
+from pytorch_distributed_train_tpu.ops.attention import dot_product_attention  # noqa: F401

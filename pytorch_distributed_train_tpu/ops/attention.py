@@ -1,0 +1,76 @@
+"""Attention core with a single dispatch point.
+
+All transformer models route through :func:`dot_product_attention`, so the
+implementation (XLA einsum path vs Pallas flash kernel) is swappable without
+touching model code — the analogue of torch's `scaled_dot_product_attention`
+backend dispatch, but resolved statically.
+
+Shapes follow the TPU-friendly convention (batch, seq, heads, head_dim) —
+"BSHD" — which keeps the head dim last (lane dim, 128-multiple for the MXU)
+and avoids the NCHW-style transposes torch attention does.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,  # (B, Sq, H, D)
+    k: jax.Array,  # (B, Sk, H_kv, D)
+    v: jax.Array,  # (B, Sk, H_kv, D)
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,  # (B, 1, Sq, Sk) or broadcastable, True=keep
+    softmax_dtype: jnp.dtype = jnp.float32,
+    impl: str = "auto",  # auto | xla | pallas
+) -> jax.Array:
+    """Multi-head attention core, GQA-aware.
+
+    Softmax is always computed in fp32 (``softmax_dtype``) regardless of the
+    bf16 compute policy — the TPU replacement for autocast's per-op allowlist
+    keeping softmax in fp32 (SURVEY C18).
+    """
+    if impl in ("auto", "pallas"):
+        from pytorch_distributed_train_tpu.ops import flash_attention as _fa
+
+        if _fa.supported(q, k, v, causal=causal, mask=mask):
+            if impl == "pallas" or _fa.profitable(q):
+                return _fa.flash_attention(q, k, v, causal=causal)
+        elif impl == "pallas":
+            raise ValueError("pallas flash attention unsupported for these shapes")
+    return _xla_attention(q, k, v, causal=causal, mask=mask, softmax_dtype=softmax_dtype)
+
+
+def _xla_attention(q, k, v, *, causal, mask, softmax_dtype):
+    orig_dtype = q.dtype
+    B, Sq, H, D = q.shape
+    _, Sk, Hkv, _ = k.shape
+    if Hkv != H:
+        # GQA: repeat KV heads up to H (XLA fuses the broadcast into the matmul)
+        if H % Hkv != 0:
+            raise ValueError(f"heads {H} not divisible by kv heads {Hkv}")
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    scale = 1.0 / jnp.sqrt(D).astype(softmax_dtype)
+    # (B, H, Sq, Sk)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=softmax_dtype)
+    logits = logits * scale
+
+    if causal:
+        q_pos = jnp.arange(Sq)[:, None] + (Sk - Sq)  # align ends for KV-cache decode
+        k_pos = jnp.arange(Sk)[None, :]
+        causal_mask = q_pos >= k_pos
+        logits = jnp.where(causal_mask[None, None], logits, _neg_inf(softmax_dtype))
+    if mask is not None:
+        logits = jnp.where(mask, logits, _neg_inf(softmax_dtype))
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(orig_dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _neg_inf(dtype) -> jax.Array:
+    return jnp.asarray(jnp.finfo(dtype).min, dtype)

@@ -1,0 +1,34 @@
+"""Pallas flash-attention kernel for TPU (placeholder gate this milestone).
+
+The real kernel (online-softmax tiling over KV blocks, VMEM-resident
+accumulators — pallas_guide.md patterns) lands in the kernels milestone;
+until then ``supported()`` reports False and the XLA einsum path serves all
+callers. Model code never imports this module directly — it goes through
+ops.attention.dot_product_attention.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_ENABLED = False  # flipped when the Pallas kernel lands
+
+
+def supported(q, k, v, *, causal: bool, mask) -> bool:
+    if not _ENABLED:
+        return False
+    if mask is not None:
+        return False
+    if q.shape[2] != k.shape[2]:  # GQA handled by pre-repeat in caller for now
+        return False
+    D = q.shape[-1]
+    return D in (64, 128, 256)
+
+
+def profitable(q) -> bool:
+    # Flash pays off once the score matrix stops fitting comfortably in VMEM.
+    return q.shape[1] >= 1024
+
+
+def flash_attention(q, k, v, *, causal: bool = False) -> jax.Array:
+    raise NotImplementedError("pallas flash attention not yet enabled")

@@ -1,0 +1,103 @@
+"""Optimizers + LR schedules as optax chains (SURVEY C20, H5).
+
+Replaces torch.optim.SGD (torch:optim/sgd.py:28) and the reference's LAMB
+(not in torch.optim — reference-era harnesses pull it from apex/local impl;
+here it's optax.lamb, verified present in optax 0.2.6), plus
+torch.optim.lr_scheduler (StepLR/CosineAnnealingLR) as optax schedules.
+
+Gradient accumulation (`accum_steps>1`) wraps the chain in optax.MultiSteps —
+the semantic equivalent of DDP's no_sync() microbatching (SURVEY C6): N
+forward/backwards accumulate locally, collectives fire once per real step.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def make_schedule(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
+    """Learning-rate schedule with linear warmup.
+
+    `step` schedule decays by `step_decay_rate` every `step_decay_every`
+    EPOCHS (torch StepLR semantics, torch:optim/lr_scheduler.py:592) — needs
+    steps_per_epoch; falls back to interpreting it as steps if unknown.
+    """
+    base = opt_cfg.learning_rate
+    warmup = opt_cfg.warmup_steps
+    decay_steps = max(total_steps - warmup, 1)
+
+    if opt_cfg.schedule == "constant":
+        main = optax.constant_schedule(base)
+    elif opt_cfg.schedule == "cosine":
+        main = optax.cosine_decay_schedule(
+            base, decay_steps, alpha=opt_cfg.end_lr_factor
+        )
+    elif opt_cfg.schedule == "linear":
+        main = optax.linear_schedule(base, base * opt_cfg.end_lr_factor, decay_steps)
+    elif opt_cfg.schedule == "step":
+        every = opt_cfg.step_decay_every * (steps_per_epoch or 1)
+        boundaries_and_scales = {
+            every * (i + 1): opt_cfg.step_decay_rate for i in range(100)
+        }
+        main = optax.piecewise_constant_schedule(base, boundaries_and_scales)
+    else:
+        raise ValueError(f"unknown schedule {opt_cfg.schedule!r}")
+
+    if warmup > 0:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, base, warmup), main], [warmup]
+        )
+    return main
+
+
+def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
+    """Build the full optax transform chain.
+
+    Order matters: clip → optimizer(+wd) → accumulate. Weight decay is
+    decoupled (AdamW-style) for adamw/lamb and L2-coupled for SGD —
+    matching torch's SGD(weight_decay=) semantics (torch:optim/sgd.py:252
+    adds wd*p to the gradient before momentum).
+
+    ``total_steps``/``steps_per_epoch`` are MICRO-steps (what the trainer
+    counts); with accumulation the inner schedule advances once per
+    ``accum_steps``, so horizons are converted to optimizer updates here.
+    ``warmup_steps`` is therefore denominated in optimizer updates.
+    """
+    accum = max(opt_cfg.accum_steps, 1)
+    sched = make_schedule(
+        opt_cfg, max(1, total_steps // accum),
+        max(1, steps_per_epoch // accum) if steps_per_epoch else 0,
+    )
+    parts = []
+    if opt_cfg.grad_clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(opt_cfg.grad_clip_norm))
+
+    name = opt_cfg.name
+    if name in ("sgd", "momentum"):
+        if opt_cfg.weight_decay > 0:
+            # torch-style coupled L2: grad += wd * param, then momentum.
+            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+        momentum = opt_cfg.momentum if name == "momentum" or opt_cfg.momentum else None
+        parts.append(
+            optax.sgd(sched, momentum=momentum, nesterov=opt_cfg.nesterov)
+        )
+    elif name == "adam":
+        parts.append(optax.adam(sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2,
+                                eps=opt_cfg.eps))
+    elif name == "adamw":
+        parts.append(
+            optax.adamw(sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2,
+                        eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay)
+        )
+    elif name == "lamb":
+        parts.append(
+            optax.lamb(sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2,
+                       eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay)
+        )
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+
+    tx = optax.chain(*parts)
+    if opt_cfg.accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=opt_cfg.accum_steps)
+    return tx, sched

@@ -1,0 +1,22 @@
+"""Parallelism = mesh axes + sharding annotations (SURVEY.md §2.3, §7.2).
+
+Replaces the reference stack's four separate wrapper families — DDP
+(torch:nn/parallel/distributed.py:466), FSDP
+(torch:distributed/fsdp/fully_sharded_data_parallel.py:118), tensor-parallel
+styles, and experimental context parallelism — with one
+``jax.sharding.Mesh`` over axes ``('data', 'fsdp', 'tensor', 'context')``
+plus regex partition rules. XLA's GSPMD partitioner inserts the collectives
+the reference issued by hand through c10d.
+"""
+
+from pytorch_distributed_train_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES,
+    batch_pspec,
+    build_mesh,
+    mesh_shape_from_config,
+)
+from pytorch_distributed_train_tpu.parallel.partition import (  # noqa: F401
+    PartitionRules,
+    match_partition_rules,
+    rules_for_model,
+)

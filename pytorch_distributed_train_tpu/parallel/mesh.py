@@ -1,0 +1,93 @@
+"""Device-mesh construction.
+
+The reference builds communicator *groups* at runtime
+(torch:distributed/distributed_c10d.py:1984 `_new_process_group_helper`,
+SURVEY C1/C2); on TPU the analogue is a static ``jax.sharding.Mesh`` whose
+named axes ride the ICI torus. One mesh, four axes, unused axes sized 1 —
+parallelism strategy becomes pure config (SURVEY §7.2).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ("data", "fsdp", "tensor", "context")
+
+
+def mesh_shape_from_config(mesh_cfg, n_devices: int | None = None) -> dict[str, int]:
+    """Resolve axis sizes, expanding a single ``-1`` to fill the device count.
+
+    Mirrors the ergonomics of torchrun's ``--nproc-per-node=auto``
+    (torch:distributed/run.py:985): the common case is "use everything".
+    """
+    if n_devices is None:
+        n_devices = jax.device_count()
+    sizes = {ax: getattr(mesh_cfg, ax) for ax in MESH_AXES}
+    wild = [ax for ax, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+    fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+    if wild:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"device count {n_devices} not divisible by fixed axes {sizes}"
+            )
+        sizes[wild[0]] = n_devices // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n_devices:
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices but {n_devices} are available"
+        )
+    return sizes
+
+
+def build_mesh(mesh_cfg=None, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build the global mesh.
+
+    Axis order matters for ICI locality: ``data`` outermost (cross-slice DCN
+    tolerant — gradient all-reduce is latency-tolerant), ``tensor``/``context``
+    innermost (latency-critical per-layer collectives ride neighbor ICI
+    links). This is the layout recipe from the scaling-book mental model.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if mesh_cfg is None:
+        sizes = {ax: 1 for ax in MESH_AXES}
+        sizes["data"] = devices.size
+    else:
+        sizes = mesh_shape_from_config(mesh_cfg, devices.size)
+    shape = tuple(sizes[ax] for ax in MESH_AXES)
+    return Mesh(devices.reshape(shape), MESH_AXES)
+
+
+def batch_pspec(batch_axes: Sequence[str] = ("data", "fsdp")) -> PartitionSpec:
+    """PartitionSpec for a batch dim sharded over the given mesh axes.
+
+    Replaces DistributedSampler's rank-strided subsampling *placement*
+    (torch:utils/data/distributed.py:134) — each device owns batch rows along
+    the flattened (data, fsdp) axes.
+    """
+    return PartitionSpec(tuple(batch_axes))
+
+
+def batch_sharding(mesh: Mesh, batch_axes: Sequence[str] = ("data", "fsdp")) -> NamedSharding:
+    return NamedSharding(mesh, batch_pspec(batch_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_batch_size(global_batch: int, mesh: Mesh, batch_axes=("data", "fsdp")) -> int:
+    """Per-host slice of the global batch (SURVEY §3.4 TPU mapping)."""
+    n_shards = int(np.prod([mesh.shape[ax] for ax in batch_axes]))
+    if global_batch % n_shards != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by batch shards {n_shards}"
+        )
+    return global_batch // jax.process_count()

@@ -1,0 +1,197 @@
+"""Regex partition rules: param-name path → PartitionSpec.
+
+The TPU-native replacement for FSDP's FlatParameter sharding
+(torch:distributed/fsdp/_flat_param.py:202) and tensor-parallel module styles
+(torch:distributed/tensor/parallel/style.py): instead of wrapping modules,
+we map each parameter's pytree path through an ordered list of
+``(regex, PartitionSpec)`` rules (the GSPMD idiom — SURVEY C13, SNIPPETS §[2]
+pattern). XLA then inserts the all-gathers / reduce-scatters that FSDP's
+runtime performed by hand.
+
+Rules are matched against '/'-joined flax param paths, e.g.
+``params/encoder/layers_3/attn/q_proj/kernel``. First match wins; scalars are
+always replicated; a catch-all ``.*`` rule should end every rule set.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) table applied to a params pytree."""
+
+    def __init__(self, rules: list[tuple[str, PartitionSpec]]):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, name: str, shape: tuple[int, ...]) -> PartitionSpec:
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        raise ValueError(f"no partition rule matched param {name!r} (shape {shape})")
+
+    def tree_specs(self, params: Any) -> Any:
+        """Pytree of PartitionSpec matching ``params``' structure."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        specs = [
+            self.spec_for(path_name(p), getattr(leaf, "shape", ()))
+            for p, leaf in flat
+        ]
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def tree_shardings(self, mesh: Mesh, params: Any) -> Any:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        shardings = []
+        for p, leaf in flat:
+            shape = getattr(leaf, "shape", ())
+            spec = self.spec_for(path_name(p), shape)
+            spec = validate_spec(spec, shape, mesh)
+            shardings.append(NamedSharding(mesh, spec))
+        return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def validate_spec(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh
+                  ) -> PartitionSpec:
+    """Drop sharding on dims the mesh can't divide evenly.
+
+    GSPMD requires dim % (product of assigned axis sizes) == 0; real models
+    always have stray dims (num_classes=10, vocab remainders) that a generic
+    rule can't shard on every mesh — fall back to replicating THAT dim only,
+    which is exactly what FSDP's pad-to-divisible flat-param avoids at the
+    cost of padding (we prefer replication: these dims are small).
+    Also truncates specs longer than the array rank (a 2-d rule matched
+    against a reshaped scalar etc.)."""
+    entries = list(spec)
+    out = []
+    for i, entry in enumerate(entries[: len(shape)]):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        out.append(entry if shape[i] % size == 0 else None)
+    return PartitionSpec(*out)
+
+
+def path_name(path) -> str:
+    """'/'-joined readable name for a jax key path."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules: list[tuple[str, PartitionSpec]], params: Any) -> Any:
+    """Functional one-shot form (SNIPPETS §[2] pattern, reimplemented)."""
+    return PartitionRules(rules).tree_specs(params)
+
+
+# ------------------------------------------------------------------ rule sets
+#
+# Sharding recipes per model family. Convention on axis use:
+#   'fsdp'   — ZeRO-style weight sharding; shard the LARGEST dim that is not
+#              already tensor-sharded, so reshards are cheap.
+#   'tensor' — megatron TP: column-parallel on q/k/v/up projections
+#              (output dim), row-parallel on o/down projections (input dim).
+# Biases/norm scales replicate. The optimizer state inherits these specs
+# through jit's sharding propagation (SURVEY C13 rightmost column).
+
+
+def dense_rules() -> list[tuple[str, PartitionSpec]]:
+    """Fallback for unregistered models: shard kernels on their output
+    channel (conv HWIO dim 3; dense (in,out) dim 1) over 'fsdp'; replicate
+    the rest. Conv rule must precede the generic kernel rule — regex can't
+    see array rank."""
+    return [
+        (r"conv[^/]*/kernel$", P(None, None, None, "fsdp")),
+        (r"(kernel|embedding)$", P(None, "fsdp")),
+        (r".*", P()),
+    ]
+
+
+def llama_rules() -> list[tuple[str, PartitionSpec]]:
+    """Llama-2: FSDP × TP layout (BASELINE.json:11).
+
+    Matches flax param paths from models/llama.py.
+    """
+    return [
+        # Embedding: vocab × hidden — shard vocab on tensor, hidden on fsdp
+        (r"tok_embed/embedding$", P("tensor", "fsdp")),
+        # Attention: hidden × (heads·head_dim)
+        (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
+        (r"o_proj/kernel$", P("tensor", "fsdp")),
+        # MLP: gate/up column-parallel, down row-parallel
+        (r"(gate_proj|up_proj)/kernel$", P("fsdp", "tensor")),
+        (r"down_proj/kernel$", P("tensor", "fsdp")),
+        # Final LM head
+        (r"lm_head/kernel$", P("fsdp", "tensor")),
+        # Norm scales replicate
+        (r"(input_norm|post_attn_norm|final_norm)/scale$", P()),
+        (r".*", P()),
+    ]
+
+
+def bert_rules() -> list[tuple[str, PartitionSpec]]:
+    return [
+        (r"(word_embed|pos_embed|type_embed)/embedding$", P(None, "fsdp")),
+        (r"(query|key|value)/kernel$", P("fsdp", "tensor")),
+        (r"attn_out/kernel$", P("tensor", "fsdp")),
+        (r"mlp_in/kernel$", P("fsdp", "tensor")),
+        (r"mlp_out/kernel$", P("tensor", "fsdp")),
+        (r"(mlm_dense|pooler)/kernel$", P("fsdp", None)),
+        (r".*", P()),
+    ]
+
+
+def vit_rules() -> list[tuple[str, PartitionSpec]]:
+    return [
+        (r"patch_embed/kernel$", P(None, None, None, "fsdp")),
+        (r"(query|key|value)/kernel$", P("fsdp", "tensor")),
+        (r"attn_out/kernel$", P("tensor", "fsdp")),
+        (r"mlp_in/kernel$", P("fsdp", "tensor")),
+        (r"mlp_out/kernel$", P("tensor", "fsdp")),
+        (r"head/kernel$", P("fsdp", None)),
+        (r".*", P()),
+    ]
+
+
+def resnet_rules() -> list[tuple[str, PartitionSpec]]:
+    """ResNets are small — replicate params (DDP-equivalent), shard only batch.
+    With fsdp>1 conv kernels shard on output channels (HWIO last dim)."""
+    return [
+        (r"conv[^/]*/kernel$", P(None, None, None, "fsdp")),
+        (r"fc/kernel$", P(None, "fsdp")),
+        (r".*", P()),
+    ]
+
+
+_RULE_SETS: dict[str, Callable[[], list[tuple[str, PartitionSpec]]]] = {
+    "resnet": resnet_rules,
+    "vit": vit_rules,
+    "bert": bert_rules,
+    "llama": llama_rules,
+    "dense": dense_rules,
+}
+
+
+def rules_for_model(model_name: str) -> PartitionRules:
+    for prefix, fn in _RULE_SETS.items():
+        if model_name.startswith(prefix):
+            return PartitionRules(fn())
+    return PartitionRules(dense_rules())

@@ -1,0 +1,165 @@
+"""The jitted train/eval step — the whole reference hot loop as ONE program.
+
+The reference's step (SURVEY §3.3) is five runtime phases: autocast forward,
+DDP-hooked backward with bucketed NCCL all-reduce (reducer.hpp:285),
+GradScaler unscale+check, optimizer step, scheduler step. Here that entire
+block is a single XLA executable: forward + loss + grad + compiler-placed
+collectives + optax update, with overlap done by XLA's latency-hiding
+scheduler instead of autograd hooks (SURVEY C7 — "obsolete by construction").
+
+Sharding contract: the TrainState and batch arrive as jax.Arrays already laid
+out per the partition rules; `jit(in_shardings=..., donate_argnums=0)` makes
+the update in-place in HBM. One PartitionRules table shards params AND
+optimizer state AND batch stats — optax state mirrors the param tree
+structure, and the '$'-anchored suffix regexes match either path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from pytorch_distributed_train_tpu.train_state import TrainState
+
+
+def model_inputs(batch: dict) -> tuple:
+    """Dispatch batch dict → model positional args (registry-wide convention:
+    vision models take images NHWC; BERT takes (input_ids, attention_mask);
+    causal LMs take input_ids)."""
+    if "image" in batch:
+        return (batch["image"],)
+    if "attention_mask" in batch:
+        return (batch["input_ids"], batch["attention_mask"])
+    return (batch["input_ids"],)
+
+
+def apply_model(model, params, batch_stats, batch, *, train: bool, dropout_rng):
+    variables: dict[str, Any] = {"params": params}
+    # mutable must be False (not []) when there are no stats — flax returns a
+    # (out, vars) tuple for ANY list, including an empty one.
+    mutable: Any = False
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+        if train:
+            mutable = ["batch_stats"]
+    rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    out = model.apply(
+        variables, *model_inputs(batch), train=train, rngs=rngs, mutable=mutable
+    )
+    if mutable:
+        logits, updated = out
+        return logits, updated["batch_stats"]
+    return out, None
+
+
+def _tree_finite(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    finite = jnp.bool_(True)
+    for leaf in leaves:
+        finite &= jnp.all(jnp.isfinite(leaf))
+    return finite
+
+
+def make_train_step(model, loss_fn: Callable, tx) -> Callable:
+    """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
+    closes over the optax transform; jit-wrapped by the caller with explicit
+    shardings."""
+
+    def train_step(state: TrainState, batch: dict, rng: jax.Array):
+        # Per-step dropout key: fold the step counter into the base key —
+        # deterministic under resume (same step → same mask), no key chain
+        # to checkpoint (the reference relies on torch's stateful global RNG).
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        scale = state.dynamic_scale.scale if state.dynamic_scale is not None else None
+
+        def loss_for_grad(params):
+            logits, new_stats = apply_model(
+                model, params, state.batch_stats, batch,
+                train=True, dropout_rng=dropout_rng,
+            )
+            loss, aux = loss_fn(logits, batch)
+            scaled = loss * scale if scale is not None else loss
+            return scaled, (loss, aux, new_stats)
+
+        grads, (loss, aux, new_stats) = jax.grad(loss_for_grad, has_aux=True)(
+            state.params
+        )
+
+        if state.dynamic_scale is not None:
+            # GradScaler semantics (torch:amp/grad_scaler.py:302,375,484):
+            # unscale, check finite, skip update on overflow, adjust scale.
+            grads = jax.tree.map(lambda g: g / scale, grads)
+            finite = _tree_finite(grads)
+            stepped = state.apply_gradients(tx, grads, new_stats)
+            skipped = state.replace(step=state.step + 1)  # step advances either way
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old), stepped, skipped
+            )
+            new_state = new_state.replace(
+                dynamic_scale=state.dynamic_scale.update(finite)
+            )
+            metrics_extra = {"loss_scale": scale, "grads_finite": finite}
+        else:
+            new_state = state.apply_gradients(tx, grads, new_stats)
+            metrics_extra = {}
+
+        gnorm = optax_global_norm(grads)
+        metrics = {"loss": loss, "grad_norm": gnorm, **aux, **metrics_extra}
+        return new_state, metrics
+
+    return train_step
+
+
+def optax_global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
+def make_eval_step(model, loss_fn: Callable) -> Callable:
+    def eval_step(state: TrainState, batch: dict):
+        logits, _ = apply_model(
+            model, state.params, state.batch_stats, batch,
+            train=False, dropout_rng=None,
+        )
+        loss, aux = loss_fn(logits, batch)
+        return {"loss": loss, **aux}
+
+    return eval_step
+
+
+# ---------------------------------------------------------------- sharding
+
+def state_shardings(mesh: Mesh, rules, state_shape) -> Any:
+    """Sharding pytree for a TrainState *shape* tree (from jax.eval_shape).
+
+    One rules table covers params, optimizer mirrors (mu/nu/trace/MultiSteps
+    accumulators — same name suffixes), and batch stats (fall through to the
+    catch-all → replicated). Divisibility-validated against the mesh."""
+    return rules.tree_shardings(mesh, state_shape)
+
+
+def jit_train_step(train_step, mesh: Mesh, state_sharding, batch_axes=("data", "fsdp")):
+    batch_sh = NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(
+        train_step,
+        in_shardings=(state_sharding, batch_sh, rep),
+        out_shardings=(state_sharding, rep),
+        donate_argnums=(0,),
+    )
+
+
+def jit_eval_step(eval_step, mesh: Mesh, state_sharding, batch_axes=("data", "fsdp")):
+    batch_sh = NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(
+        eval_step,
+        in_shardings=(state_sharding, batch_sh),
+        out_shardings=rep,
+    )

@@ -1,0 +1,85 @@
+"""Train state: params + opt state + BN stats + step, as one pytree.
+
+The analogue of the reference's {model.state_dict(), optimizer.state_dict(),
+epoch} checkpoint triple (SURVEY §3.5) — but a single immutable pytree that
+flows through the jitted step with donated buffers. Loss-scale state (the
+GradScaler replacement, SURVEY C19) lives here too when enabled.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class DynamicScale:
+    """Dynamic fp16 loss scaling — optax-style replacement for
+    torch.amp.GradScaler (torch:amp/grad_scaler.py:53): scale up every
+    `growth_interval` finite steps, halve on overflow, skip the update on
+    non-finite grads."""
+
+    scale: jnp.ndarray  # f32 scalar
+    growth_tracker: jnp.ndarray  # i32 scalar
+    growth_interval: int = flax.struct.field(pytree_node=False, default=2000)
+
+    @classmethod
+    def create(cls, init_scale: float, growth_interval: int) -> "DynamicScale":
+        return cls(
+            scale=jnp.float32(init_scale),
+            growth_tracker=jnp.int32(0),
+            growth_interval=growth_interval,
+        )
+
+    def update(self, grads_finite: jnp.ndarray) -> "DynamicScale":
+        grown = self.growth_tracker + 1
+        should_grow = grown >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(should_grow, self.scale * 2.0, self.scale),
+            jnp.maximum(self.scale * 0.5, 1.0),
+        )
+        new_tracker = jnp.where(
+            grads_finite & ~should_grow, grown, jnp.int32(0)
+        )
+        return self.replace(scale=new_scale, growth_tracker=new_tracker)
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Pure-array pytree. The optimizer transform `tx` is deliberately NOT a
+    field: function identity in treedef metadata breaks pytree equality
+    across rebuilds (e.g. restore-then-step with a freshly constructed
+    optimizer) — the step function closes over tx instead."""
+
+    step: jnp.ndarray  # i32 scalar
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # BN running stats ({} for stat-free models)
+    dynamic_scale: DynamicScale | None = None
+
+    def apply_gradients(self, tx: optax.GradientTransformation, grads,
+                        new_batch_stats=None):
+        updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=(
+                new_batch_stats if new_batch_stats is not None else self.batch_stats
+            ),
+        )
+
+    @classmethod
+    def create(cls, *, params, tx, batch_stats=None, dynamic_scale=None):
+        return cls(
+            step=jnp.int32(0),
+            params=params,
+            opt_state=tx.init(params),
+            batch_stats=batch_stats if batch_stats is not None else {},
+            dynamic_scale=dynamic_scale,
+        )

@@ -1,0 +1,255 @@
+"""Trainer: builds everything from a TrainConfig and runs the epoch/step loop.
+
+The structural twin of the reference's train.py main() (SURVEY H1, §3.3):
+build mesh ← (init_process_group) · model ← config · data · optimizer ·
+restore ← checkpoint · loop{step, log, ckpt} · validate. Every phase maps to
+its TPU-native mechanism per SURVEY §7.2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_train_tpu import losses as losses_lib
+from pytorch_distributed_train_tpu import steps as steps_lib
+from pytorch_distributed_train_tpu.checkpoint import CheckpointManager
+from pytorch_distributed_train_tpu.config import TrainConfig
+from pytorch_distributed_train_tpu.data.datasets import build_dataset
+from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
+from pytorch_distributed_train_tpu.models.registry import build_model
+from pytorch_distributed_train_tpu.optim import make_optimizer
+from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
+from pytorch_distributed_train_tpu.train_state import DynamicScale, TrainState
+from pytorch_distributed_train_tpu.utils import debug as debug_lib
+from pytorch_distributed_train_tpu.utils.metrics import Meter, MetricLogger
+from pytorch_distributed_train_tpu.utils.watchdog import FlightRecorder, Heartbeat
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, mesh=None):
+        self.cfg = cfg
+        if cfg.obs.debug_nans:
+            debug_lib.enable_nan_debugging()
+        self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+        self.batch_axes = tuple(cfg.mesh.batch_axes)
+        self.model = build_model(cfg.model, cfg.precision)
+        self.loss_fn = losses_lib.get_loss_fn(cfg.loss)
+        self.rules = rules_for_model(cfg.model.name)
+
+        # ---- data
+        self.train_ds = build_dataset(cfg.data, cfg.model, train=True)
+        self.train_loader, self.train_epoch_fn = build_input_pipeline(
+            self.train_ds, cfg.data, self.mesh, train=True,
+            batch_axes=self.batch_axes,
+            sync_check_every=cfg.obs.check_input_sync_every,
+        )
+        self.eval_ds = build_dataset(cfg.data, cfg.model, train=False)
+        self.eval_loader, self.eval_epoch_fn = build_input_pipeline(
+            self.eval_ds, cfg.data, self.mesh, train=False,
+            batch_axes=self.batch_axes,
+        )
+
+        # ---- horizon
+        self.steps_per_epoch = self.train_loader.steps_per_epoch
+        if cfg.epochs > 0:
+            self.total_steps = cfg.epochs * self.steps_per_epoch
+        else:
+            self.total_steps = cfg.total_steps
+
+        # ---- optimizer
+        self.tx, self.lr_schedule = make_optimizer(
+            cfg.optim, self.total_steps, self.steps_per_epoch
+        )
+
+        # ---- state (sharded init: params materialize directly into their
+        # mesh layout — no host-RAM staging of 7B params; SURVEY C13)
+        self.rng = jax.random.PRNGKey(cfg.seed)
+        init_rng, self.step_rng = jax.random.split(self.rng)
+        state_shape = jax.eval_shape(self._init_state, init_rng)
+        self.state_sharding = steps_lib.state_shardings(
+            self.mesh, self.rules, state_shape
+        )
+        with self.mesh:
+            self.state: TrainState = jax.jit(
+                self._init_state, out_shardings=self.state_sharding
+            )(init_rng)
+
+        # ---- jitted steps
+        self.train_step = steps_lib.jit_train_step(
+            steps_lib.make_train_step(self.model, self.loss_fn, self.tx),
+            self.mesh, self.state_sharding, self.batch_axes,
+        )
+        self.eval_step = steps_lib.jit_eval_step(
+            steps_lib.make_eval_step(self.model, self.loss_fn),
+            self.mesh, self.state_sharding, self.batch_axes,
+        )
+
+        # ---- checkpoint + resume (auto is the default path, SURVEY §5.3b)
+        self.ckpt = CheckpointManager(cfg.checkpoint, cfg.to_json())
+        self.start_epoch = 0
+        resume_mode = cfg.checkpoint.resume
+        if resume_mode != "none":
+            if resume_mode in ("auto", cfg.checkpoint.dir):
+                restored = self.ckpt.restore(self.state)
+            else:
+                # explicit path: warm-start from a DIFFERENT run's directory
+                src_cfg = dataclasses.replace(cfg.checkpoint, dir=resume_mode,
+                                              resume="none")
+                src = CheckpointManager(src_cfg)
+                restored = src.restore(self.state)
+                src.close()
+            if restored is not None:
+                self.state, meta = restored
+                self.start_epoch = int(meta.get("epoch", 0))
+                if jax.process_index() == 0:
+                    print(f"[resume] restored step {int(self.state.step)} "
+                          f"(epoch {self.start_epoch})", flush=True)
+            elif resume_mode not in ("auto",):
+                raise FileNotFoundError(
+                    f"checkpoint.resume={resume_mode!r} has no checkpoint to restore"
+                )
+
+        # ---- observability
+        jsonl = cfg.obs.jsonl_path or f"{cfg.checkpoint.dir}/metrics.jsonl"
+        tb_dir = f"{cfg.checkpoint.dir}/tb" if cfg.obs.tensorboard else ""
+        self.logger = MetricLogger(jsonl, tb_dir)
+        self.meter = Meter()
+        self.recorder = FlightRecorder(dump_dir=cfg.checkpoint.dir)
+        self.recorder.install_signal_dump()
+        self.heartbeat = Heartbeat(cfg.obs.heartbeat_timeout_s, self.recorder)
+        self._profiling = False
+
+    # ------------------------------------------------------------------ init
+    def _init_state(self, rng):
+        dummy = self._dummy_inputs()
+        variables = self.model.init({"params": rng}, *dummy, train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        ds = None
+        ls = self.cfg.precision.loss_scale
+        if ls == "dynamic":
+            ds = DynamicScale.create(
+                self.cfg.precision.loss_scale_init,
+                self.cfg.precision.loss_scale_growth_interval,
+            )
+        elif ls != "none":
+            # static scale: fixed value, never grows (still halves on
+            # overflow as a safety net, like GradScaler with growth off)
+            ds = DynamicScale.create(float(ls), growth_interval=2**31 - 1)
+        return TrainState.create(
+            params=params, tx=self.tx, batch_stats=batch_stats, dynamic_scale=ds
+        )
+
+    def _dummy_inputs(self) -> tuple:
+        m, d = self.cfg.model, self.cfg.data
+        if self.cfg.loss == "softmax_xent":
+            return (jnp.zeros((2, m.image_size, m.image_size, 3), jnp.float32),)
+        if self.cfg.loss == "mlm_xent":
+            ids = jnp.zeros((2, d.seq_len), jnp.int32)
+            return (ids, jnp.ones((2, d.seq_len), jnp.int32))
+        return (jnp.zeros((2, d.seq_len), jnp.int32),)
+
+    @property
+    def items_per_step(self) -> int:
+        if self.cfg.loss == "softmax_xent":
+            return self.cfg.data.batch_size  # images/step
+        return self.cfg.data.batch_size * self.cfg.data.seq_len  # tokens/step
+
+    # ------------------------------------------------------------------ loop
+    def fit(self, max_steps: int | None = None) -> TrainState:
+        cfg = self.cfg
+        limit = min(self.total_steps, max_steps or self.total_steps)
+        step = int(self.state.step)
+        epoch = self.start_epoch
+        t_start = time.time()
+        try:
+            while step < limit:
+                self.recorder.record("epoch_start", step, epoch=epoch)
+                for batch in self.train_epoch_fn(epoch):
+                    if step >= limit:
+                        break
+                    self._maybe_profile(step)
+                    self.state, metrics = self.train_step(
+                        self.state, batch, self.step_rng
+                    )
+                    step = int(self.state.step)  # syncs; acceptable at MVP
+                    self.meter.tick()
+                    self.heartbeat.beat()
+                    self.recorder.record("step", step)
+                    if step % cfg.obs.log_every_steps == 0 or step == limit:
+                        self._log_train(step, metrics)
+                    if self.ckpt.maybe_save(self.state, epoch=epoch):
+                        self.recorder.record("ckpt", step)
+                    if (cfg.eval_every_steps and
+                            step % cfg.eval_every_steps == 0):
+                        self.evaluate(step)
+                epoch += 1
+                if not cfg.eval_every_steps:
+                    # every epoch boundary INCLUDING the last: the final
+                    # validation metric is the acceptance-matrix number
+                    self.evaluate(step)
+                self.meter.reset_clock()  # epoch boundary: don't count eval time
+        finally:
+            self.heartbeat.stop()
+            self.ckpt.save(self.state, epoch=epoch, force=True)
+            self.ckpt.wait()
+            self.logger.log(
+                step,
+                {"wall_time_s": time.time() - t_start, **self.meter.percentiles()},
+                prefix="summary",
+            )
+            self.logger.close()
+        return self.state
+
+    def _log_train(self, step: int, metrics: dict) -> None:
+        host = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        # the schedule counts optimizer updates, not micro-steps
+        host["lr"] = float(self.lr_schedule(step // max(self.cfg.optim.accum_steps, 1)))
+        host.update(self.meter.percentiles())
+        tput = self.meter.throughput(self.items_per_step)
+        if tput is not None:
+            unit = "images" if self.cfg.loss == "softmax_xent" else "tokens"
+            host[f"{unit}_per_sec"] = tput
+            host[f"{unit}_per_sec_per_chip"] = tput / jax.device_count()
+        host["epoch"] = step // max(self.steps_per_epoch, 1)
+        self.logger.log(step, host, prefix="train")
+
+    def evaluate(self, step: int) -> dict:
+        sums: dict[str, float] = {}
+        n = 0
+        for batch in self.eval_epoch_fn(0):
+            m = self.eval_step(self.state, batch)
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
+            n += 1
+        if n == 0:
+            return {}
+        avg = {k: v / n for k, v in sums.items()}
+        self.logger.log(step, avg, prefix="eval")
+        self.meter.reset_clock()
+        return avg
+
+    # ------------------------------------------------------------- profiling
+    def _maybe_profile(self, step: int) -> None:
+        obs = self.cfg.obs
+        if not obs.profile_num_steps:
+            return
+        if step == obs.profile_start_step and not self._profiling:
+            jax.profiler.start_trace(obs.profile_dir)
+            self._profiling = True
+            self.recorder.record("profile_start", step)
+        elif self._profiling and step >= obs.profile_start_step + obs.profile_num_steps:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self.recorder.record("profile_stop", step)
+
+    def close(self) -> None:
+        self.heartbeat.stop()
+        self.ckpt.close()
+        self.logger.close()

@@ -1,0 +1,2 @@
+"""Utilities: metrics/logging, profiling hooks, failure detection, debug
+checks (SURVEY §5.1-5.5)."""

@@ -1,0 +1,60 @@
+"""Debug-mode divergence checks (SURVEY §5.2).
+
+The reference's TORCH_DISTRIBUTED_DEBUG=DETAIL wraps process groups to
+cross-check collective op+shape across ranks before each call
+(torch:distributed/distributed_c10d.py:2282-2308). Under SPMD that race
+class is unauthorable — one program, compiler-placed collectives. What CAN
+still diverge is the host side: per-host input pipelines feeding
+different-shaped or differently-ordered batches. These helpers catch that.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+
+def batch_signature(batch: dict) -> str:
+    """Stable hash of structure+shapes+dtypes (cheap) of a HOST-LOCAL batch.
+
+    Must be called on numpy batches before global-array assembly (the
+    pipeline wires this via sync_check_every) — after assembly every host
+    sees identical global shapes by construction. Content is intentionally
+    not hashed: host shards legitimately differ."""
+    h = hashlib.sha256()
+    for k in sorted(batch):
+        v = batch[k]
+        h.update(k.encode())
+        h.update(str(np.asarray(v).shape).encode())
+        h.update(str(np.asarray(v).dtype).encode())
+    return h.hexdigest()[:16]
+
+
+def check_input_sync(batch: dict) -> None:
+    """Assert all hosts assembled structurally identical batches this step.
+
+    Cross-host gather of the signature; raises on divergence. Call at debug
+    cadence only (obs.check_input_sync_every) — it is a blocking collective
+    off the step path.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    sig = batch_signature(batch)
+    sig_bytes = np.frombuffer(sig.encode(), dtype=np.uint8)
+    all_sigs = multihost_utils.process_allgather(sig_bytes)
+    first = bytes(np.asarray(all_sigs[0]).tobytes())
+    for i in range(1, all_sigs.shape[0]):
+        if bytes(np.asarray(all_sigs[i]).tobytes()) != first:
+            raise RuntimeError(
+                f"input pipeline divergence: host 0 sig {first!r} != host {i}"
+            )
+
+
+def enable_nan_debugging() -> None:
+    """jax.debug_nans — the analogue of torch's anomaly detection /
+    NanCheck.hpp in the NCCL path."""
+    jax.config.update("jax_debug_nans", True)

@@ -1,0 +1,102 @@
+"""Metrics: rank-0 console + JSONL step log + optional TensorBoard
+(SURVEY §5.5; reference: torch:utils/tensorboard/writer.py:173 +
+rank-0 console logging).
+
+North-star instrumentation from day one: images|tokens/sec/chip and
+step-time p50/p99 (BASELINE.json:2) — collected with a rolling window so the
+numbers exclude compile time after the first step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+
+import jax
+import numpy as np
+
+
+class Meter:
+    """Rolling step-time / throughput meter (window excludes compile steps)."""
+
+    def __init__(self, window: int = 200):
+        self.times: deque[float] = deque(maxlen=window)
+        self._last: float | None = None
+
+    def tick(self) -> float | None:
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            dt = now - self._last
+            self.times.append(dt)
+        self._last = now
+        return dt
+
+    def reset_clock(self) -> None:
+        self._last = None
+
+    def percentiles(self) -> dict[str, float]:
+        if not self.times:
+            return {}
+        arr = np.asarray(self.times)
+        return {
+            "step_time_ms_p50": float(np.percentile(arr, 50) * 1e3),
+            "step_time_ms_p99": float(np.percentile(arr, 99) * 1e3),
+        }
+
+    def throughput(self, items_per_step: int) -> float | None:
+        if not self.times:
+            return None
+        p50 = float(np.percentile(np.asarray(self.times), 50))
+        return items_per_step / p50 if p50 > 0 else None
+
+
+class MetricLogger:
+    """Process-0 writer: console + JSONL (+ TensorBoard when enabled)."""
+
+    def __init__(self, jsonl_path: str = "", tensorboard_dir: str = "",
+                 is_main: bool | None = None):
+        self.is_main = jax.process_index() == 0 if is_main is None else is_main
+        self._jsonl = None
+        self._tb = None
+        if not self.is_main:
+            return
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._jsonl = open(jsonl_path, "a", buffering=1)
+        if tensorboard_dir:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception:
+                self._tb = None
+
+    def log(self, step: int, metrics: dict, prefix: str = "train") -> None:
+        if not self.is_main:
+            return
+        record = {"step": step, "ts": time.time()}
+        for k, v in metrics.items():
+            if hasattr(v, "item"):
+                v = float(np.asarray(v))
+            record[k] = v
+        if self._jsonl:
+            self._jsonl.write(json.dumps({"tag": prefix, **record}) + "\n")
+        if self._tb:
+            for k, v in record.items():
+                if isinstance(v, (int, float)) and k not in ("step", "ts"):
+                    self._tb.add_scalar(f"{prefix}/{k}", v, step)
+        shown = {
+            k: (f"{v:.4f}" if isinstance(v, float) else v)
+            for k, v in record.items()
+            if k != "ts"
+        }
+        print(f"[{prefix}] " + " ".join(f"{k}={v}" for k, v in shown.items()), flush=True)
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+        if self._tb:
+            self._tb.close()

@@ -1,0 +1,122 @@
+"""In-job failure detection: heartbeat monitor + flight recorder
+(SURVEY §5.3a, C25/C26).
+
+Reference machinery being replaced: ProcessGroupNCCL's watchdog thread +
+HeartbeatMonitor (ProcessGroupNCCL.hpp:562,592 — dump debug state and abort
+when collectives wedge) and the c10d FlightRecorder ring buffer of recent
+collectives (FlightRecorder.hpp:98).
+
+TPU analogue: the failure mode is a stalled step (wedged DCN link, hung
+host), not a divergent collective (SPMD can't author those — SURVEY §5.2).
+So:
+- ``FlightRecorder`` — fixed-size ring of recent step events (host-side,
+  lock-free enough: GIL-atomic list assignment), dumped to stderr + file on
+  abort or SIGTERM/SIGQUIT.
+- ``Heartbeat`` — daemon thread; if no step-end beat arrives within
+  ``timeout_s``, dumps the ring + all-thread stacks and hard-aborts the
+  process so the scheduler can restart the job (whole-job restart + Orbax
+  auto-resume is the recovery path, SURVEY §5.3b).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import signal
+import sys
+import threading
+import time
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 256, dump_dir: str = ""):
+        self.capacity = capacity
+        self.buf: list[tuple] = [None] * capacity  # type: ignore[list-item]
+        self.n = 0
+        self.dump_dir = dump_dir
+        self._installed = False
+
+    def record(self, kind: str, step: int, **info) -> None:
+        self.buf[self.n % self.capacity] = (time.time(), kind, step, info)
+        self.n += 1
+
+    def events(self) -> list[tuple]:
+        if self.n <= self.capacity:
+            return [e for e in self.buf[: self.n]]
+        i = self.n % self.capacity
+        return [e for e in self.buf[i:] + self.buf[:i]]
+
+    def _write(self, out) -> None:
+        out.write(f"=== flight recorder: last {min(self.n, self.capacity)} events ===\n")
+        for ts, kind, step, info in self.events():
+            out.write(f"{ts:.3f} {kind} step={step} {info}\n")
+        out.flush()
+
+    def dump(self, out=None) -> None:
+        self._write(out or sys.stderr)
+        if self.dump_dir and out is None:
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                path = os.path.join(self.dump_dir, f"flight_{os.getpid()}.log")
+                with open(path, "w") as f:
+                    self._write(f)
+            except OSError:
+                pass  # diagnostics must never crash the dump path
+
+    def install_signal_dump(self) -> None:
+        """Dump ring + stacks on SIGTERM (scheduler preemption) — the
+        analogue of the NCCL watchdog's debug dump on timeout."""
+        if self._installed:
+            return
+        self._installed = True
+        faulthandler.enable()
+
+        def _handler(signum, frame):
+            self.dump()
+            faulthandler.dump_traceback()
+            signal.default_int_handler(signum, frame) if signum == signal.SIGINT else sys.exit(143)
+
+        try:
+            signal.signal(signal.SIGTERM, _handler)
+        except ValueError:
+            pass  # not the main thread (tests)
+
+
+class Heartbeat:
+    """Abort-on-stall monitor. `beat()` after every step; a missing beat for
+    `timeout_s` means the step wedged — dump and abort (exit code 134)."""
+
+    def __init__(self, timeout_s: float, recorder: FlightRecorder | None = None,
+                 abort=None):
+        self.timeout_s = timeout_s
+        self.recorder = recorder
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._abort = abort or self._default_abort
+        self._thread: threading.Thread | None = None
+        if timeout_s > 0:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="heartbeat-monitor")
+            self._thread.start()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(min(self.timeout_s / 4, 10.0)):
+            if time.monotonic() - self._last > self.timeout_s:
+                sys.stderr.write(
+                    f"[heartbeat] no step completed in {self.timeout_s}s — aborting\n"
+                )
+                if self.recorder is not None:
+                    self.recorder.dump()
+                faulthandler.dump_traceback()
+                self._abort()
+                return
+
+    @staticmethod
+    def _default_abort() -> None:
+        os._exit(134)

@@ -1,0 +1,36 @@
+"""Test harness: 8 fake CPU devices in one process (SURVEY §4.2).
+
+The JAX analogue of torch's Gloo/fake-pg test backends
+(torch:testing/_internal/common_distributed.py:874): all mesh/sharding tests
+run the REAL jit'd train step on a virtual 8-device CPU mesh — no cluster,
+no TPU. The sandbox's sitecustomize force-selects the axon TPU platform, so
+we override both the env and the live jax config before any backend is
+instantiated.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"need 8 fake CPU devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def tmp_ckpt_dir(tmp_path):
+    return str(tmp_path / "ckpt")

@@ -1,0 +1,58 @@
+"""Config system tests (SURVEY §4.1: config parsing is unit-testable with no
+devices)."""
+
+import json
+
+import pytest
+
+from pytorch_distributed_train_tpu.config import TrainConfig, get_preset, list_presets
+
+
+def test_presets_cover_acceptance_matrix():
+    # The five BASELINE.json:7-11 rows.
+    assert list_presets() == [
+        "bert_base_mlm",
+        "llama2_7b",
+        "resnet18_cifar10",
+        "resnet50_imagenet",
+        "vit_b16_imagenet",
+    ]
+
+
+def test_preset_fields():
+    c = get_preset("bert_base_mlm")
+    assert c.optim.name == "lamb"  # BASELINE.json:10
+    assert c.loss == "mlm_xent"
+    c = get_preset("llama2_7b")
+    assert c.mesh.fsdp == -1  # FSDP → GSPMD sharding, BASELINE.json:11
+    assert c.model.hidden_size == 4096
+    c = get_preset("vit_b16_imagenet")
+    assert c.precision.compute_dtype == "bfloat16"  # BASELINE.json:9
+    assert c.optim.accum_steps > 1
+
+
+def test_override_coercion():
+    c = get_preset("resnet18_cifar10")
+    c.apply_overrides(
+        ["optim.learning_rate=0.5", "data.batch_size=64", "model.remat=true",
+         "mesh.batch_axes=data"]
+    )
+    assert c.optim.learning_rate == 0.5
+    assert c.data.batch_size == 64
+    assert c.model.remat is True
+    assert c.mesh.batch_axes == ("data",)
+
+
+def test_override_unknown_key_raises():
+    c = get_preset("resnet18_cifar10")
+    with pytest.raises(KeyError):
+        c.override("optim.nope", "1")
+
+
+def test_json_roundtrip():
+    c = get_preset("llama2_7b")
+    c.optim.learning_rate = 1.25e-4
+    d = json.loads(c.to_json())
+    c2 = TrainConfig.from_dict(d)
+    assert c2.to_json() == c.to_json()
+    assert c2.mesh.batch_axes == c.mesh.batch_axes  # tuple survives round-trip

@@ -1,0 +1,69 @@
+"""Sampler property tests — exact torch DistributedSampler semantics
+(SURVEY §4.1: "union of host shards == permutation"; C16 behavior spec at
+torch:utils/data/distributed.py:107-146)."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_train_tpu.data.sampler import DistributedSampler
+
+
+@pytest.mark.parametrize("n,world", [(100, 4), (101, 4), (8, 3), (1000, 8)])
+def test_union_of_shards_is_padded_permutation(n, world):
+    shards = [
+        DistributedSampler(n, world, r, shuffle=True, seed=7).indices()
+        for r in range(world)
+    ]
+    # equal length on every rank (SPMD static shapes)
+    assert len({len(s) for s in shards}) == 1
+    union = np.concatenate(shards)
+    # padded total covers every index at least once
+    assert set(union.tolist()) == set(range(n))
+    total = sum(len(s) for s in shards)
+    assert total == shards[0].shape[0] * world
+    assert total >= n
+    assert total - n < world  # minimal padding
+
+
+def test_epoch_reshuffles_deterministically():
+    s = DistributedSampler(50, 2, 0, shuffle=True, seed=3)
+    s.set_epoch(0)
+    e0 = s.indices()
+    s.set_epoch(1)
+    e1 = s.indices()
+    s.set_epoch(0)
+    e0b = s.indices()
+    assert not np.array_equal(e0, e1)  # reshuffled
+    assert np.array_equal(e0, e0b)  # seed+epoch deterministic
+
+
+def test_ranks_agree_on_permutation_without_communication():
+    # Every rank derives the same global order from (seed, epoch) alone —
+    # the property that lets torch's sampler work with zero collectives.
+    world = 4
+    perms = []
+    for r in range(world):
+        s = DistributedSampler(40, world, r, shuffle=True, seed=11)
+        s.set_epoch(5)
+        perms.append(s.indices())
+    interleaved = np.empty(40, dtype=int)
+    for r in range(world):
+        interleaved[r::world] = perms[r]
+    assert set(interleaved.tolist()) == set(range(40))
+
+
+def test_drop_last_truncates():
+    s = DistributedSampler(103, 4, 0, shuffle=False, drop_last=True)
+    assert s.num_samples == 25
+    assert len(s.indices()) == 25
+    total = np.concatenate(
+        [DistributedSampler(103, 4, r, shuffle=False, drop_last=True).indices()
+         for r in range(4)]
+    )
+    assert len(total) == 100
+    assert len(set(total.tolist())) == 100  # no duplicates under drop_last
+
+
+def test_no_shuffle_is_strided():
+    s = DistributedSampler(12, 3, 1, shuffle=False)
+    assert np.array_equal(s.indices(), np.array([1, 4, 7, 10]))

@@ -1,0 +1,155 @@
+"""Golden-numerics cross-check vs torch CPU (SURVEY §4.5).
+
+torch 2.13.0+cpu is installed as a numerics oracle: build the reference-era
+ResNet BasicBlock stack in torch, copy OUR flax init into it, and demand the
+forward logits and parameter gradients agree within float tolerance. This
+pins model-definition fidelity — conv padding arithmetic, BN eps/affine
+application, pooling, layout conversions (BASELINE.json:5 "mirrored in Flax
+behind the same config"). torchvision is not installed, so the torch twin is
+defined here, following the torchvision BasicBlock recipe.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig  # noqa: E402
+from pytorch_distributed_train_tpu.models.registry import build_model  # noqa: E402
+
+
+class TorchBasicBlock(tnn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout, eps=1e-5)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout, eps=1e-5)
+        self.proj = None
+        if stride != 1 or cin != cout:
+            self.proj = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout, eps=1e-5),
+            )
+
+    def forward(self, x):
+        r = x if self.proj is None else self.proj(x)
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return torch.relu(r + y)
+
+
+class TorchResNet18Cifar(tnn.Module):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv_stem = tnn.Conv2d(3, 64, 3, 1, 1, bias=False)
+        self.bn_stem = tnn.BatchNorm2d(64, eps=1e-5)
+        layers = []
+        cin = 64
+        for i, blocks in enumerate((2, 2, 2, 2)):
+            cout = 64 * 2**i
+            for j in range(blocks):
+                layers.append(TorchBasicBlock(cin, cout, 2 if i > 0 and j == 0 else 1))
+                cin = cout
+        self.blocks = tnn.Sequential(*layers)
+        self.fc = tnn.Linear(512, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.bn_stem(self.conv_stem(x)))
+        x = self.blocks(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def _copy_conv(tconv, fkernel):
+    # flax HWIO → torch OIHW
+    tconv.weight.data = torch.from_numpy(
+        np.asarray(fkernel).transpose(3, 2, 0, 1).copy()
+    )
+
+
+def _copy_bn(tbn, fparams):
+    tbn.weight.data = torch.from_numpy(np.asarray(fparams["scale"]).copy())
+    tbn.bias.data = torch.from_numpy(np.asarray(fparams["bias"]).copy())
+
+
+def _copy_block(tblock, fparams):
+    _copy_conv(tblock.conv1, fparams["conv1"]["kernel"])
+    _copy_bn(tblock.bn1, fparams["bn1"])
+    _copy_conv(tblock.conv2, fparams["conv2"]["kernel"])
+    _copy_bn(tblock.bn2, fparams["bn2"])
+    if tblock.proj is not None:
+        _copy_conv(tblock.proj[0], fparams["conv_proj"]["kernel"])
+        _copy_bn(tblock.proj[1], fparams["bn_proj"])
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = ModelConfig(name="resnet18", num_classes=10, image_size=32)
+    fmodel = build_model(cfg, PrecisionConfig())
+    variables = fmodel.init({"params": jax.random.PRNGKey(0)},
+                            jnp.zeros((1, 32, 32, 3)), train=False)
+    tmodel = TorchResNet18Cifar()
+    p = variables["params"]
+    _copy_conv(tmodel.conv_stem, p["conv_stem"]["kernel"])
+    _copy_bn(tmodel.bn_stem, p["bn_stem"])
+    k = 0
+    for i in range(1, 5):
+        for j in range(1, 3):
+            _copy_block(tmodel.blocks[k], p[f"stage{i}_block{j}"])
+            k += 1
+    tmodel.fc.weight.data = torch.from_numpy(
+        np.asarray(p["fc"]["kernel"]).T.copy()
+    )
+    tmodel.fc.bias.data = torch.from_numpy(np.asarray(p["fc"]["bias"]).copy())
+    tmodel.eval()
+    return fmodel, variables, tmodel
+
+
+def test_forward_parity(models):
+    fmodel, variables, tmodel = models
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 32, 32, 3)).astype(np.float32)
+    f_logits = np.asarray(fmodel.apply(variables, jnp.asarray(x), train=False))
+    with torch.no_grad():
+        t_logits = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2).copy())).numpy()
+    np.testing.assert_allclose(f_logits, t_logits, atol=2e-4, rtol=1e-3)
+
+
+def test_gradient_parity(models):
+    fmodel, variables, tmodel = models
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, 4)
+
+    def loss_fn(params):
+        logits = fmodel.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            jnp.asarray(x), train=False,
+        )
+        onehot = jax.nn.one_hot(jnp.asarray(y), 10)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+    f_loss, f_grads = jax.value_and_grad(loss_fn)(variables["params"])
+
+    xt = torch.from_numpy(x.transpose(0, 3, 1, 2).copy())
+    yt = torch.from_numpy(y.astype(np.int64))
+    t_loss = tnn.functional.cross_entropy(tmodel(xt), yt)
+    t_loss.backward()
+
+    np.testing.assert_allclose(float(f_loss), float(t_loss), atol=1e-5, rtol=1e-5)
+    # fc kernel grad: flax (I,O) vs torch (O,I)
+    np.testing.assert_allclose(
+        np.asarray(f_grads["fc"]["kernel"]),
+        tmodel.fc.weight.grad.numpy().T,
+        atol=1e-4, rtol=1e-3,
+    )
+    # stem conv grad: flax HWIO vs torch OIHW
+    np.testing.assert_allclose(
+        np.asarray(f_grads["conv_stem"]["kernel"]),
+        tmodel.conv_stem.weight.grad.numpy().transpose(2, 3, 1, 0),
+        atol=1e-4, rtol=1e-3,
+    )

@@ -1,0 +1,172 @@
+"""Distributed train-step parity tests (SURVEY §4.2) — the JAX analogue of
+torch's DDP-parity-vs-single-process golden tests
+(torch:testing/_internal/distributed/distributed_test.py):
+
+- DP over 8 fake devices must produce the SAME updated params as 1 device
+  (DDP semantics: grad all-reduce ≡ big-batch gradient).
+- FSDP (params sharded) must produce the same loss/params as DP (sharding is
+  layout, not math).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from pytorch_distributed_train_tpu import steps as steps_lib
+from pytorch_distributed_train_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    PrecisionConfig,
+)
+from pytorch_distributed_train_tpu.losses import get_loss_fn
+from pytorch_distributed_train_tpu.models.registry import build_model
+from pytorch_distributed_train_tpu.optim import make_optimizer
+from pytorch_distributed_train_tpu.parallel.mesh import MESH_AXES, build_mesh
+from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
+from pytorch_distributed_train_tpu.train_state import TrainState
+
+
+def _make_batch(n=16, image=8, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "image": jnp.asarray(rng.standard_normal((n, image, image, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, classes, n), jnp.int32),
+    }
+
+
+def _setup(mesh, model_cfg, opt_cfg, batch_axes=("data", "fsdp")):
+    model = build_model(model_cfg, PrecisionConfig())
+    loss_fn = get_loss_fn("softmax_xent")
+    tx, _ = make_optimizer(opt_cfg, total_steps=100)
+    rules = rules_for_model(model_cfg.name)
+
+    def init_state(rng):
+        x = jnp.zeros((2, model_cfg.image_size, model_cfg.image_size, 3))
+        variables = model.init({"params": rng}, x, train=False)
+        return TrainState.create(
+            params=variables["params"], tx=tx,
+            batch_stats=variables.get("batch_stats", {}),
+        )
+
+    rng = jax.random.PRNGKey(0)
+    shape = jax.eval_shape(init_state, rng)
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    state = jax.jit(init_state, out_shardings=sharding)(rng)
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(model, loss_fn, tx), mesh, sharding, batch_axes
+    )
+    return state, step
+
+
+def _run_steps(mesh_shape, devices, n_steps=3, model_name="resnet18"):
+    mesh_cfg = MeshConfig(**dict(zip(MESH_AXES, mesh_shape)))
+    mesh = build_mesh(mesh_cfg, devices)
+    model_cfg = ModelConfig(name=model_name, num_classes=10, image_size=8)
+    opt_cfg = OptimConfig(name="momentum", learning_rate=0.1, schedule="constant",
+                          warmup_steps=0, weight_decay=1e-4)
+    state, step = _setup(mesh, model_cfg, opt_cfg)
+    rng = jax.random.PRNGKey(42)
+    losses = []
+    for i in range(n_steps):
+        batch = _make_batch(seed=i)
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    params = jax.device_get(state.params)
+    return losses, params
+
+
+@pytest.fixture(scope="module")
+def single_device_run():
+    return _run_steps((1, 1, 1, 1), jax.devices("cpu")[:1])
+
+
+def test_dp8_matches_single_device(devices8, single_device_run):
+    losses1, params1 = single_device_run
+    losses8, params8 = _run_steps((8, 1, 1, 1), devices8)
+    np.testing.assert_allclose(losses1, losses8, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), params1, params8
+    )
+
+
+def test_fsdp_matches_dp(devices8, single_device_run):
+    losses1, params1 = single_device_run
+    losses_f, params_f = _run_steps((2, 4, 1, 1), devices8)
+    np.testing.assert_allclose(losses1, losses_f, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), params1, params_f
+    )
+
+
+def test_tensor_parallel_llama_matches_replicated(devices8):
+    """TP sharding of a tiny Llama must not change the math."""
+    model_cfg = ModelConfig(name="llama", vocab_size=64, hidden_size=32,
+                            num_layers=2, num_heads=4, num_kv_heads=4, mlp_dim=64,
+                            max_seq_len=16, remat=False)
+    opt_cfg = OptimConfig(name="adamw", learning_rate=1e-3, schedule="constant",
+                          warmup_steps=0, weight_decay=0.0)
+    loss_fn = get_loss_fn("causal_lm_xent")
+
+    def run(mesh_shape, devs):
+        mesh_cfg = MeshConfig(**dict(zip(MESH_AXES, mesh_shape)))
+        mesh = build_mesh(mesh_cfg, devs)
+        model = build_model(model_cfg, PrecisionConfig())
+        tx, _ = make_optimizer(opt_cfg, total_steps=10)
+        rules = rules_for_model("llama")
+
+        def init_state(rng):
+            ids = jnp.zeros((2, 16), jnp.int32)
+            variables = model.init({"params": rng}, ids, train=False)
+            return TrainState.create(params=variables["params"], tx=tx)
+
+        rng = jax.random.PRNGKey(0)
+        shape = jax.eval_shape(init_state, rng)
+        sharding = steps_lib.state_shardings(mesh, rules, shape)
+        state = jax.jit(init_state, out_shardings=sharding)(rng)
+        step = steps_lib.jit_train_step(
+            steps_lib.make_train_step(model, loss_fn, tx), mesh, sharding
+        )
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (8, 16)), jnp.int32)
+        state, metrics = step(state, {"input_ids": ids}, rng)
+        return float(metrics["loss"]), jax.device_get(state.params)
+
+    loss1, params1 = run((1, 1, 1, 1), jax.devices("cpu")[:1])
+    # data=2 × fsdp=2 × tensor=2
+    loss_tp, params_tp = run((2, 2, 2, 1), devices8)
+    assert abs(loss1 - loss_tp) < 1e-5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4), params1, params_tp
+    )
+
+
+def test_grad_accumulation_equals_big_batch(devices8):
+    """optax.MultiSteps over k micro-batches == one k·B batch step — the
+    DDP no_sync() contract (SURVEY C6). Uses a BN-free model: under
+    BatchNorm, micro-batch ≠ big-batch normalization in ANY framework."""
+    mesh = build_mesh(MeshConfig(data=8, fsdp=1, tensor=1, context=1), devices8)
+    model_cfg = ModelConfig(name="vit_b16", num_classes=10, image_size=8,
+                            patch_size=4, hidden_size=32, num_layers=2,
+                            num_heads=4, mlp_dim=64, dropout_rate=0.0)
+    big = _make_batch(n=32, seed=7)
+
+    def run(accum, batches):
+        opt_cfg = OptimConfig(name="sgd", learning_rate=0.1, momentum=0.0,
+                              schedule="constant", warmup_steps=0,
+                              weight_decay=0.0, accum_steps=accum)
+        state, step = _setup(mesh, model_cfg, opt_cfg)
+        rng = jax.random.PRNGKey(0)
+        for b in batches:
+            state, _ = step(state, b, rng)
+        return jax.device_get(state.params)
+
+    micro = [
+        {k: v[i * 8 : (i + 1) * 8] for k, v in big.items()} for i in range(4)
+    ]
+    p_accum = run(4, micro)
+    p_big = run(1, [big])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), p_accum, p_big
+    )

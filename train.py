@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""train.py — CLI entrypoint (SURVEY H1; BASELINE.json:5 "the same train.py
+entrypoint").
+
+Usage mirrors the reference harness:
+
+    python train.py --config resnet18_cifar10
+    python train.py --config llama2_7b --set optim.learning_rate=1e-4 \\
+        --set mesh.fsdp=8 --set data.batch_size=64
+    python train.py --config-json path/to/config.json --resume auto
+
+Where the reference needed `torchrun --nproc-per-node=8 train.py` (SURVEY
+§3.1), here the same script runs unmodified from 1 chip to a pod: bring-up is
+jax.distributed.initialize (launch.py), and parallelism is the `mesh.*`
+config, not a launcher topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--config", default="resnet18_cifar10",
+                   help="preset name (see --list-configs)")
+    p.add_argument("--config-json", default="",
+                   help="path to a full TrainConfig JSON (overrides --config)")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="dotted config override, e.g. optim.learning_rate=0.1")
+    p.add_argument("--resume", default="", choices=["", "auto", "none"],
+                   help="shortcut for checkpoint.resume")
+    p.add_argument("--steps", type=int, default=0,
+                   help="cap total steps (smoke runs)")
+    p.add_argument("--list-configs", action="store_true")
+    p.add_argument("--print-config", action="store_true",
+                   help="print resolved config JSON and exit")
+    return p.parse_args(argv)
+
+
+def build_config(args):
+    from pytorch_distributed_train_tpu.config import TrainConfig, get_preset
+
+    if args.config_json:
+        with open(args.config_json) as f:
+            cfg = TrainConfig.from_dict(json.load(f))
+    else:
+        cfg = get_preset(args.config)
+    cfg.apply_overrides(args.set)
+    if args.resume:
+        cfg.checkpoint.resume = args.resume
+    if args.steps:
+        cfg.total_steps = args.steps
+        cfg.epochs = 0
+    return cfg
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.list_configs:
+        from pytorch_distributed_train_tpu.config import list_presets
+
+        print("\n".join(list_presets()))
+        return 0
+
+    cfg = build_config(args)
+    if args.print_config:
+        print(cfg.to_json())
+        return 0
+
+    from pytorch_distributed_train_tpu.launch import initialize_distributed, runtime_info
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    initialize_distributed()
+    info = runtime_info()
+    if info["process_index"] == 0:
+        print(f"[launch] {info}", flush=True)
+        print(f"[config] preset={cfg.preset}", flush=True)
+
+    trainer = Trainer(cfg)
+    trainer.fit()
+    trainer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
