@@ -35,12 +35,30 @@ def dot_product_attention(
     if impl in ("auto", "pallas"):
         from pytorch_distributed_train_tpu.ops import flash_attention as _fa
 
+        on_tpu = _on_tpu()
         if _fa.supported(q, k, v, causal=causal, mask=mask):
-            if impl == "pallas" or _fa.profitable(q):
-                return _fa.flash_attention(q, k, v, causal=causal)
+            # impl='pallas' forces the kernel anywhere (interpret mode off-TPU
+            # — slow but exact, which is what tests and debugging want);
+            # 'auto' uses it only on TPU where it pays off.
+            if impl == "pallas" or (on_tpu and _fa.profitable(q)):
+                H, Hkv = q.shape[2], k.shape[2]
+                if Hkv != H:  # GQA: expand KV for the kernel
+                    # TODO(perf): index kv blocks as b // rep in the kernel
+                    # instead of materialising the repeat in HBM.
+                    k = jnp.repeat(k, H // Hkv, axis=2)
+                    v = jnp.repeat(v, H // Hkv, axis=2)
+                return _fa.flash_attention(q, k, v, causal=causal,
+                                           interpret=not on_tpu)
         elif impl == "pallas":
             raise ValueError("pallas flash attention unsupported for these shapes")
     return _xla_attention(q, k, v, causal=causal, mask=mask, softmax_dtype=softmax_dtype)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
 
 
 def _xla_attention(q, k, v, *, causal, mask, softmax_dtype):

@@ -1,0 +1,108 @@
+"""Pallas flash attention vs XLA reference, interpret mode on CPU
+(SURVEY §5.2: "Pallas kernels → interpret=True mode vs XLA reference
+implementation in tests")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_train_tpu.ops.attention import _xla_attention
+from pytorch_distributed_train_tpu.ops.flash_attention import (
+    flash_attention,
+    supported,
+)
+
+
+def _make_qkv(B=2, S=256, H=2, D=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, S, H, D)) * 0.5, dtype
+    )
+    return mk(), mk(), mk()
+
+
+def _xla(q, k, v, causal):
+    return _xla_attention(q, k, v, causal=causal, mask=None,
+                          softmax_dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_xla(causal):
+    q, k, v = _make_qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = _xla(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_xla(causal):
+    q, k, v = _make_qkv(B=1, S=256, H=2, D=64, seed=3)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla(q, k, v, causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_multi_block_seq():
+    # exercises the online-softmax accumulation across 4 KV blocks
+    q, k, v = _make_qkv(B=1, S=512, H=1, D=64, seed=5)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _xla(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs():
+    q, k, v = _make_qkv(B=1, S=256, H=2, D=64, seed=7, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=False, interpret=True)
+    ref = _xla(q, k, v, False)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2,
+    )
+
+
+def test_dispatch_pallas_impl_covers_gqa_expansion():
+    """impl='pallas' runs the real dispatch path (incl. KV expansion) in
+    interpret mode on CPU — the CI seam for lines only a TPU would hit."""
+    from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((1, 256, 4, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.float32)
+    out = dot_product_attention(q, k, v, causal=True, impl="pallas")
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_rejects_unexpanded_gqa():
+    q, _, _ = _make_qkv(B=1, S=256, H=4, D=64)
+    _, k, v = _make_qkv(B=1, S=256, H=2, D=64)
+    with pytest.raises(ValueError, match="pre-expanded"):
+        flash_attention(q, k, v, interpret=True)
+
+
+def test_supported_gates():
+    q, k, v = _make_qkv(S=256, D=64)
+    assert supported(q, k, v, causal=False, mask=None)
+    assert not supported(q, k, v, causal=False, mask=jnp.ones((1, 1, 1, 256)))
+    q2, k2, v2 = _make_qkv(S=100, D=64)  # S not block-divisible
+    assert not supported(q2, k2, v2, causal=False, mask=None)
+    q3, k3, v3 = _make_qkv(S=256, D=48)  # D not lane-aligned
+    assert not supported(q3, k3, v3, causal=False, mask=None)
