@@ -132,6 +132,10 @@ class MeshConfig:
     context: int = 1
     # Which mesh axes batch is sharded over (data+fsdp is the common combo).
     batch_axes: tuple[str, ...] = ("data", "fsdp")
+    # Attention algorithm when context > 1 (SURVEY §5.7):
+    #   ring    — lax.ppermute KV rotation around the ICI ring; any size
+    #   ulysses — all-to-all head↔seq swap; needs heads % context == 0
+    context_impl: str = "ring"
 
 
 @dataclass

@@ -16,9 +16,13 @@ TPU notes:
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
-from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_train_tpu.ops.attention import (
+    ContextParallelConfig,
+    dot_product_attention,
+)
 
 
 class BertSelfAttention(nn.Module):
@@ -26,6 +30,9 @@ class BertSelfAttention(nn.Module):
     dropout_rate: float
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    # CP on BERT requires context_impl='ulysses' (pad masks don't rotate
+    # around a ring — ops.attention dispatch enforces this).
+    cp: ContextParallelConfig | None = None
 
     @nn.compact
     def __call__(self, x, pad_mask, deterministic: bool):
@@ -36,7 +43,7 @@ class BertSelfAttention(nn.Module):
             param_dtype=self.param_dtype, name=name,
         )
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        y = dot_product_attention(q, k, v, mask=pad_mask)
+        y = dot_product_attention(q, k, v, mask=pad_mask, cp=self.cp)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
             name="attn_out",
@@ -54,6 +61,7 @@ class BertLayer(nn.Module):
     deterministic: bool
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    cp: ContextParallelConfig | None = None
 
     @nn.compact
     def __call__(self, x, pad_mask):
@@ -62,7 +70,7 @@ class BertLayer(nn.Module):
         )
         attn = BertSelfAttention(
             self.num_heads, self.dropout_rate, self.dtype, self.param_dtype,
-            name="attn",
+            cp=self.cp, name="attn",
         )(x, pad_mask, self.deterministic)
         x = ln("ln_attn")(x + attn).astype(self.dtype)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
@@ -89,6 +97,7 @@ class BertForMLM(nn.Module):
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    cp: ContextParallelConfig | None = None
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -112,6 +121,10 @@ class BertForMLM(nn.Module):
                          name="embed_ln")(x)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         x = x.astype(self.dtype)
+        if self.cp is not None and self.cp.active:
+            x = jax.lax.with_sharding_constraint(
+                x, self.cp.activation_sharding(x.ndim)
+            )
 
         if attention_mask is None:
             pad_mask = None
@@ -122,7 +135,7 @@ class BertForMLM(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
-                self.dtype, self.param_dtype, name=f"layer{i}",
+                self.dtype, self.param_dtype, cp=self.cp, name=f"layer{i}",
             )(x, pad_mask)
 
         # MLM head: dense + GELU + LN, then decode against tied word embeddings.
@@ -138,8 +151,9 @@ class BertForMLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def bert_base(cfg, dtype, param_dtype) -> BertForMLM:
+def bert_base(cfg, dtype, param_dtype, cp=None) -> BertForMLM:
     return BertForMLM(
+        cp=cp,
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

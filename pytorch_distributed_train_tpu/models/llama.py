@@ -24,7 +24,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_train_tpu.ops.attention import (
+    ContextParallelConfig,
+    dot_product_attention,
+)
 
 
 class RMSNorm(nn.Module):
@@ -64,6 +67,7 @@ class LlamaAttention(nn.Module):
     max_seq_len: int
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    cp: ContextParallelConfig | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -82,7 +86,7 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        y = dot_product_attention(q, k, v, causal=True)
+        y = dot_product_attention(q, k, v, causal=True, cp=self.cp)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -116,13 +120,15 @@ class LlamaBlock(nn.Module):
     rms_norm_eps: float
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    cp: ContextParallelConfig | None = None
 
     @nn.compact
     def __call__(self, x):
         h = RMSNorm(self.rms_norm_eps, name="input_norm")(x)
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.rope_theta,
-            self.max_seq_len, self.dtype, self.param_dtype, name="attn",
+            self.max_seq_len, self.dtype, self.param_dtype, cp=self.cp,
+            name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         x = x + LlamaMLP(self.mlp_dim, self.dtype, self.param_dtype, name="mlp")(h)
@@ -144,6 +150,7 @@ class LlamaForCausalLM(nn.Module):
     remat: bool = True
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    cp: ContextParallelConfig | None = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -153,13 +160,20 @@ class LlamaForCausalLM(nn.Module):
             embedding_init=nn.initializers.normal(0.02),
             param_dtype=self.param_dtype, name="tok_embed",
         )(input_ids).astype(self.dtype)
+        if self.cp is not None and self.cp.active:
+            # Keep everything between attentions seq-sharded: without this
+            # GSPMD may replicate the seq dim outside the shard_map regions
+            # and each device would run full-sequence norms/MLPs.
+            x = jax.lax.with_sharding_constraint(
+                x, self.cp.activation_sharding(x.ndim)
+            )
 
         block_cls = nn.remat(LlamaBlock) if self.remat else LlamaBlock
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.num_kv_heads, self.mlp_dim,
                 self.rope_theta, self.max_seq_len, self.rms_norm_eps,
-                self.dtype, self.param_dtype, name=f"layer{i}",
+                self.dtype, self.param_dtype, cp=self.cp, name=f"layer{i}",
             )(x)
 
         x = RMSNorm(self.rms_norm_eps, name="final_norm")(x)
@@ -171,8 +185,9 @@ class LlamaForCausalLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def llama(cfg, dtype, param_dtype) -> LlamaForCausalLM:
+def llama(cfg, dtype, param_dtype, cp=None) -> LlamaForCausalLM:
     return LlamaForCausalLM(
+        cp=cp,
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

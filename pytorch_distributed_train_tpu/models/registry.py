@@ -42,15 +42,31 @@ def list_models() -> list[str]:
     return sorted(_REGISTRY)
 
 
-def build_model(model_cfg, precision_cfg):
-    """Build the Flax module for a ModelConfig under a PrecisionConfig."""
+def build_model(model_cfg, precision_cfg, mesh=None, mesh_cfg=None):
+    """Build the Flax module for a ModelConfig under a PrecisionConfig.
+
+    ``mesh`` + ``mesh_cfg`` activate context parallelism: when the mesh's
+    context axis is >1 the transformer models route attention through
+    ring/Ulysses (SURVEY §5.7) and constrain activations seq-sharded.
+    """
     _populate()
     name = model_cfg.name
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; have {list_models()}")
     dtype = jnp.dtype(precision_cfg.compute_dtype)
     param_dtype = jnp.dtype(precision_cfg.param_dtype)
-    return _REGISTRY[name](model_cfg, dtype, param_dtype)
+    cp = None
+    if mesh is not None and mesh_cfg is not None and mesh.shape.get("context", 1) > 1:
+        from pytorch_distributed_train_tpu.ops.attention import (
+            ContextParallelConfig,
+        )
+
+        cp = ContextParallelConfig(
+            mesh=mesh,
+            impl=mesh_cfg.context_impl,
+            batch_axes=tuple(mesh_cfg.batch_axes),
+        )
+    return _REGISTRY[name](model_cfg, dtype, param_dtype, cp=cp)
 
 
 def is_language_model(name: str) -> bool:

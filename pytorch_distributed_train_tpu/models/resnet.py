@@ -142,7 +142,8 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def resnet18(cfg, dtype, param_dtype) -> ResNet:
+def resnet18(cfg, dtype, param_dtype, cp=None) -> ResNet:
+    del cp  # no sequence dim
     return ResNet(
         stage_sizes=(2, 2, 2, 2),
         block_cls=ResNetBlock,
@@ -153,7 +154,8 @@ def resnet18(cfg, dtype, param_dtype) -> ResNet:
     )
 
 
-def resnet50(cfg, dtype, param_dtype) -> ResNet:
+def resnet50(cfg, dtype, param_dtype, cp=None) -> ResNet:
+    del cp  # no sequence dim
     return ResNet(
         stage_sizes=(3, 4, 6, 3),
         block_cls=BottleneckBlock,

@@ -144,7 +144,8 @@ class ViT(nn.Module):
         return x.astype(jnp.float32)
 
 
-def vit_b16(cfg, dtype, param_dtype) -> ViT:
+def vit_b16(cfg, dtype, param_dtype, cp=None) -> ViT:
+    del cp  # patch-seq CP not useful at ViT scale (197 tokens)
     return ViT(
         num_classes=cfg.num_classes,
         patch_size=cfg.patch_size,

@@ -12,8 +12,41 @@ and avoids the NCHW-style transposes torch attention does.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ContextParallelConfig:
+    """Static recipe for sequence/context parallelism (SURVEY §5.7, §2.3).
+
+    Passed down from the mesh config to attention modules; hashable so flax
+    modules can hold it as a static attribute. ``impl``:
+      ring    — lax.ppermute KV rotation, scales to any axis size
+      ulysses — all-to-all head↔seq swap, needs heads % axis size == 0
+    """
+
+    mesh: jax.sharding.Mesh
+    impl: str = "ring"  # ring | ulysses
+    context_axis: str = "context"
+    batch_axes: tuple[str, ...] = ("data", "fsdp")
+    tensor_axis: str | None = "tensor"
+
+    @property
+    def active(self) -> bool:
+        return self.mesh.shape[self.context_axis] > 1
+
+    def activation_sharding(self, ndim: int) -> jax.sharding.NamedSharding:
+        """(B, S, ...) activation sharding: batch over batch_axes, seq over
+        the context axis — the constraint models apply so pre/post-attention
+        pointwise compute stays seq-sharded instead of replicating."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(tuple(self.batch_axes), self.context_axis,
+                             *([None] * (ndim - 2)))
+        return NamedSharding(self.mesh, spec)
 
 
 def dot_product_attention(
@@ -25,13 +58,49 @@ def dot_product_attention(
     mask: jax.Array | None = None,  # (B, 1, Sq, Sk) or broadcastable, True=keep
     softmax_dtype: jnp.dtype = jnp.float32,
     impl: str = "auto",  # auto | xla | pallas
+    cp: ContextParallelConfig | None = None,
 ) -> jax.Array:
     """Multi-head attention core, GQA-aware.
 
     Softmax is always computed in fp32 (``softmax_dtype``) regardless of the
     bf16 compute policy — the TPU replacement for autocast's per-op allowlist
     keeping softmax in fp32 (SURVEY C18).
+
+    With an *active* ``cp`` the sequence dim is sharded over the context mesh
+    axis and the core routes through ring attention or Ulysses (SURVEY §5.7)
+    inside a shard_map region embedded in the surrounding GSPMD program.
+    Contract under cp: Ulysses forwards ``impl`` to its local full-sequence
+    core; ring attention is its own implementation (``impl`` does not apply)
+    and always does fp32 chunk softmax — same as the default
+    ``softmax_dtype``, which cp paths do not override.
     """
+    if cp is not None and cp.active:
+        if cp.impl == "ring":
+            if mask is not None:
+                raise NotImplementedError(
+                    "ring attention supports causal masking only; use "
+                    "context_impl='ulysses' for padded/arbitrary masks"
+                )
+            from pytorch_distributed_train_tpu.ops.ring_attention import (
+                ring_attention,
+            )
+
+            return ring_attention(
+                q, k, v, mesh=cp.mesh, causal=causal,
+                context_axis=cp.context_axis, batch_axes=cp.batch_axes,
+                tensor_axis=cp.tensor_axis,
+            )
+        if cp.impl == "ulysses":
+            from pytorch_distributed_train_tpu.ops.ulysses import (
+                ulysses_attention,
+            )
+
+            return ulysses_attention(
+                q, k, v, mask=mask, mesh=cp.mesh, causal=causal,
+                context_axis=cp.context_axis, batch_axes=cp.batch_axes,
+                tensor_axis=cp.tensor_axis, impl=impl,
+            )
+        raise ValueError(f"unknown context_impl {cp.impl!r}")
     if impl in ("auto", "pallas"):
         from pytorch_distributed_train_tpu.ops import flash_attention as _fa
 
@@ -41,12 +110,14 @@ def dot_product_attention(
             # — slow but exact, which is what tests and debugging want);
             # 'auto' uses it only on TPU where it pays off.
             if impl == "pallas" or (on_tpu and _fa.profitable(q)):
-                H, Hkv = q.shape[2], k.shape[2]
-                if Hkv != H:  # GQA: expand KV for the kernel
-                    # TODO(perf): index kv blocks as b // rep in the kernel
-                    # instead of materialising the repeat in HBM.
-                    k = jnp.repeat(k, H // Hkv, axis=2)
-                    v = jnp.repeat(v, H // Hkv, axis=2)
+                from pytorch_distributed_train_tpu.ops.cp_common import (
+                    expand_kv_heads,
+                )
+
+                # GQA: expand KV for the kernel.
+                # TODO(perf): index kv blocks as b // rep in the kernel
+                # instead of materialising the repeat in HBM.
+                k, v = expand_kv_heads(k, v, q.shape[2])
                 return _fa.flash_attention(q, k, v, causal=causal,
                                            interpret=not on_tpu)
         elif impl == "pallas":
@@ -62,16 +133,13 @@ def _on_tpu() -> bool:
 
 
 def _xla_attention(q, k, v, *, causal, mask, softmax_dtype):
+    from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
+
     orig_dtype = q.dtype
     B, Sq, H, D = q.shape
     _, Sk, Hkv, _ = k.shape
-    if Hkv != H:
-        # GQA: repeat KV heads up to H (XLA fuses the broadcast into the matmul)
-        if H % Hkv != 0:
-            raise ValueError(f"heads {H} not divisible by kv heads {Hkv}")
-        rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA: repeat KV heads up to H (XLA fuses the broadcast into the matmul)
+    k, v = expand_kv_heads(k, v, H)
 
     scale = 1.0 / jnp.sqrt(D).astype(softmax_dtype)
     # (B, H, Sq, Sk)

@@ -1,0 +1,59 @@
+"""Shared helpers for the context-parallel attention wrappers.
+
+One home for the sharding-spec gating and GQA head expansion that ring
+attention and Ulysses both need — the two global wrappers must stay
+behaviorally identical at their boundaries (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+P = PartitionSpec
+
+
+def expand_kv_heads(k, v, num_heads: int):
+    """Repeat GQA KV heads up to ``num_heads`` (validated).
+
+    XLA fuses the broadcast into the following matmul, so this is free in
+    compute — but NOT in comm, so callers that move K/V across chips should
+    expand on the far side of the transfer when possible (see ulysses.py).
+    """
+    h_kv = k.shape[2]
+    if h_kv == num_heads:
+        return k, v
+    if h_kv == 0 or num_heads % h_kv != 0:
+        raise ValueError(f"query heads {num_heads} not divisible by kv heads {h_kv}")
+    rep = num_heads // h_kv
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
+def divisible_axes(dim: int, axes: Sequence[str], mesh: Mesh):
+    """Mesh axes for a dim, or None when the dim can't divide over them
+    (shape probes with batch 1 etc. — replicate rather than fail)."""
+    size = int(np.prod([mesh.shape[a] for a in axes]))
+    return tuple(axes) if size > 0 and dim % size == 0 else None
+
+
+def qkv_spec(
+    q,
+    k,
+    mesh: Mesh,
+    *,
+    context_axis: str,
+    batch_axes: Sequence[str],
+    tensor_axis: str | None,
+) -> PartitionSpec:
+    """(B, S, H, D) PartitionSpec for the CP manual region: batch over
+    batch_axes (when divisible), seq over the context axis, heads over the
+    tensor axis (when both Q and KV head counts divide it)."""
+    H, Hkv = q.shape[2], k.shape[2]
+    t_size = mesh.shape[tensor_axis] if tensor_axis else 1
+    head_ax = tensor_axis if (t_size > 1 and H % t_size == 0 and
+                              Hkv % t_size == 0) else None
+    batch_ax = divisible_axes(q.shape[0], batch_axes, mesh)
+    return P(batch_ax, context_axis, head_ax, None)

@@ -65,7 +65,7 @@ def profitable(q) -> bool:
 # ================================================================= forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, block_q, block_k, seq_len,
+                acc_ref, m_ref, l_ref, *, block_q, block_k,
                 causal, scale):
     """Grid (BH, nq, nk): one (block_q, D) output tile, sweeping KV blocks."""
     qi = pl.program_id(1)
@@ -134,7 +134,7 @@ def _fwd(q3, k3, v3, *, causal, scale, block_q, block_k, interpret):
         jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),  # LSE (trailing 1: TPU block-shape alignment)
     ]
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=S,
+        _fwd_kernel, block_q=block_q, block_k=block_k,
         causal=causal, scale=scale,
     )
     return pl.pallas_call(

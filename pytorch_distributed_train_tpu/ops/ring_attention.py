@@ -1,0 +1,183 @@
+"""Ring attention: context parallelism over the ICI ring (SURVEY §5.7).
+
+The TPU-native replacement for torch's experimental context parallelism
+(torch:distributed/tensor/experimental/_context_parallel/_attention.py:317
+`_templated_ring_attention`, :242 `_RingRotater`): the sequence dim is
+sharded over the ``'context'`` mesh axis; each device keeps its Q shard
+resident and K/V shards rotate one hop per step around the ring via
+``lax.ppermute`` — neighbor ICI links, no switch contention. Chunk outputs
+merge with the flash-attention logsumexp rule, so the full (S, S) score
+matrix never exists anywhere.
+
+Key properties:
+- **Comm/compute overlap**: the next hop's ppermute is issued before the
+  current chunk's matmuls, so XLA's latency-hiding scheduler overlaps the
+  ICI transfer with MXU work.
+- **Causal skipping**: steps whose whole K/V chunk sits above the diagonal
+  are skipped with ``lax.cond`` (the torch module's round-robin
+  load-balancer answers the same problem — reference `_load_balancer.py`).
+  Masks are position-based, so any sequence layout (contiguous or
+  zigzag/load-balanced) works by passing the right position arrays.
+- **Backward = reverse ring**: the forward is written in plain JAX, so
+  autodiff transposes each ppermute into the opposite-direction rotation —
+  exactly the hand-written backward of the torch impl (:488) — and
+  ``jax.checkpoint`` on the chunk keeps residual memory at O(S_local).
+
+Called inside ``shard_map`` (use :func:`ring_attention` for the global-array
+wrapper). Softmax math is fp32 regardless of input dtype (ops.attention
+policy).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+NEG_INF = -1e30
+
+P = PartitionSpec
+
+
+def _chunk_attention(q, k, v, q_pos, kv_pos, *, causal: bool, scale: float):
+    """Attention of a local Q block against ONE K/V chunk.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D); positions: (Sq,), (Sk,) global.
+    Returns (o, lse): o normalized within the chunk (B, Sq, H, D) fp32,
+    lse (B, H, Sq) fp32. Fully-masked rows get o=0, lse=NEG_INF — the merge
+    rule then gives them zero weight.
+    """
+    from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
+
+    k, v = expand_kv_heads(k, v, q.shape[2])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = q_pos[:, None] >= kv_pos[None, :]  # (Sq, Sk)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # (B, H, Sq)
+    # Rows with every entry masked: m == NEG_INF → treat as empty chunk.
+    empty = m <= NEG_INF / 2
+    p = jnp.exp(s - jnp.where(empty, 0.0, m)[..., None])
+    p = jnp.where(empty[..., None], 0.0, p)
+    l = jnp.sum(p, axis=-1)  # (B, H, Sq)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / l_safe[..., None],
+                   v.astype(jnp.float32))
+    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+    return o, lse
+
+
+def _merge(o_a, lse_a, o_b, lse_b):
+    """Combine two chunk-normalized attention results (flash merge rule)."""
+    lse_new = jnp.logaddexp(lse_a, lse_b)  # (B, H, Sq)
+    w_a = jnp.exp(lse_a - lse_new)
+    w_b = jnp.exp(lse_b - lse_new)
+    # transpose weights (B,H,Sq) → (B,Sq,H,1) to match o layout
+    wt = lambda w: jnp.transpose(w, (0, 2, 1))[..., None]  # noqa: E731
+    return o_a * wt(w_a) + o_b * wt(w_b), lse_new
+
+
+def ring_attention_local(
+    q: jax.Array,  # (B, Sq_local, H, D) — this device's Q shard
+    k: jax.Array,  # (B, Sk_local, Hkv, D)
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    q_pos: jax.Array | None = None,  # (Sq_local,) global positions
+    kv_pos: jax.Array | None = None,
+) -> jax.Array:
+    """Ring attention body — call inside shard_map with seq sharded on
+    ``axis_name``. Positions default to the contiguous layout
+    (shard i owns [i*S_local, (i+1)*S_local)); pass explicit positions for a
+    load-balanced (zigzag) layout."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    idx = jax.lax.axis_index(axis_name)
+    if q_pos is None:
+        q_pos = idx * Sq + jnp.arange(Sq)
+    if kv_pos is None:
+        kv_pos = idx * Sk + jnp.arange(Sk)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    chunk = jax.checkpoint(
+        functools.partial(_chunk_attention, causal=causal, scale=scale)
+    )
+
+    def masked_chunk(k_t, v_t, pos_t):
+        """Chunk attention, skipped entirely when causality masks the whole
+        chunk (the ppermute still runs — all devices stay in the ring)."""
+        if not causal:
+            return chunk(q, k_t, v_t, q_pos, pos_t)
+        needed = jnp.max(q_pos) >= jnp.min(pos_t)
+
+        def skip(_q, _k, _v, _qp, _kp):
+            return (
+                jnp.zeros((B, Sq, H, D), jnp.float32),
+                jnp.full((B, H, Sq), NEG_INF, jnp.float32),
+            )
+
+        return jax.lax.cond(needed, chunk, skip, q, k_t, v_t, q_pos, pos_t)
+
+    o = jnp.zeros((B, Sq, H, D), jnp.float32)
+    lse = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+    k_t, v_t, pos_t = k, v, kv_pos
+    for t in range(axis_size):
+        if t < axis_size - 1:
+            # Issue the next hop FIRST so the ICI transfer overlaps the
+            # chunk's MXU work (XLA latency-hiding scheduler).
+            k_n = jax.lax.ppermute(k_t, axis_name, perm)
+            v_n = jax.lax.ppermute(v_t, axis_name, perm)
+            pos_n = jax.lax.ppermute(pos_t, axis_name, perm)
+        o_c, lse_c = masked_chunk(k_t, v_t, pos_t)
+        o, lse = _merge(o, lse, o_c, lse_c)
+        if t < axis_size - 1:
+            k_t, v_t, pos_t = k_n, v_n, pos_n
+    return o.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # (B, S, H, D) GLOBAL arrays
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    causal: bool = False,
+    context_axis: str = "context",
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+    tensor_axis: str | None = "tensor",
+) -> jax.Array:
+    """Global-array entry: shard_map wrapper over the mesh.
+
+    Sequence dim shards on ``context_axis``, batch on ``batch_axes``, heads
+    on ``tensor_axis`` — composing CP×DP×TP in one manual region embedded in
+    the surrounding GSPMD program.
+    """
+    from pytorch_distributed_train_tpu.ops.cp_common import qkv_spec
+
+    n = mesh.shape[context_axis]
+    if q.shape[1] % n != 0 or k.shape[1] % n != 0:
+        # Sequence can't shard over the ring (e.g. a probe batch at init
+        # time) — run the plain core instead.
+        from pytorch_distributed_train_tpu.ops import attention as attention_lib
+
+        return attention_lib.dot_product_attention(q, k, v, causal=causal)
+    spec = qkv_spec(q, k, mesh, context_axis=context_axis,
+                    batch_axes=batch_axes, tensor_axis=tensor_axis)
+
+    fn = functools.partial(
+        ring_attention_local, axis_name=context_axis, axis_size=n,
+        causal=causal,
+    )
+    return jax.shard_map(
+        lambda a, b, c: fn(a, b, c),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,126 @@
+"""Ulysses sequence parallelism: all-to-all head↔sequence resharding.
+
+The DeepSpeed-Ulysses pattern (SURVEY §2.3 — not in torch core; its
+primitive is `all_to_all`, torch:distributed/distributed_c10d.py:5145):
+activations arrive sharded on the sequence dim over the ``'context'`` axis;
+two ``lax.all_to_all``s swap that to head sharding around the attention
+core, so each device computes FULL-sequence attention for S/n of the heads —
+which lets the single-device Pallas flash kernel run unchanged inside the
+manual region (ring attention by contrast restructures the kernel itself).
+
+Tradeoff vs ring: all-to-all moves q+k+v+o once each (4·B·S·H·D/n per
+device) instead of rotating k+v n-1 times; on an ICI torus both are
+bandwidth-friendly, but Ulysses caps context parallelism at the head count
+(H % n == 0) while ring scales to any n. Both are exposed behind
+``MeshConfig.context_impl``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, PartitionSpec
+
+from pytorch_distributed_train_tpu.ops import attention as attention_lib
+
+P = PartitionSpec
+
+
+def ulysses_attention_local(
+    q: jax.Array,  # (B, S_local, H, D) — seq-sharded on entry
+    k: jax.Array,  # (B, S_local, Hkv, D)
+    v: jax.Array,
+    mask: jax.Array | None = None,  # (B, 1, Sq, Sk) FULL-seq, replicated
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    impl: str = "auto",
+) -> jax.Array:
+    """Ulysses body — call inside shard_map. Returns seq-sharded output.
+
+    all_to_all #1: (B, S/n, H, D) → (B, S, H/n, D)  [scatter heads, gather seq]
+    local attention over the full sequence with H/n heads
+    all_to_all #2: back to (B, S/n, H, D).
+
+    Unlike ring attention, an arbitrary (e.g. padding) mask just works: after
+    the first all_to_all every device sees the full sequence, so the
+    replicated full-seq mask applies unchanged (this is why BERT-style padded
+    batches route here — ops.attention dispatch).
+    """
+    from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
+
+    n = axis_size
+    if n == 1:
+        return attention_lib.dot_product_attention(q, k, v, causal=causal,
+                                                   mask=mask, impl=impl)
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % n != 0:
+        raise ValueError(f"ulysses needs heads {H} % context {n} == 0")
+    if Hkv != H and Hkv % n != 0:
+        # GQA ratio the axis can't divide — expand before the swap (pays
+        # H/Hkv extra ICI bytes; unavoidable for this head count).
+        k, v = expand_kv_heads(k, v, H)
+
+    # split_axis=2 (heads scattered), concat_axis=1 (seq gathered)
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    q, k, v = a2a(q), a2a(k), a2a(v)
+    # GQA with Hkv % n == 0: K/V crossed the wire at Hkv/n heads — the
+    # H/Hkv-fold expansion happens here, after the transfer, for free in
+    # compute (XLA fuses the broadcast) and at zero extra ICI traffic.
+    k, v = expand_kv_heads(k, v, q.shape[2])
+    o = attention_lib.dot_product_attention(q, k, v, causal=causal, mask=mask,
+                                            impl=impl)
+    # inverse: scatter seq, gather heads
+    return jax.lax.all_to_all(o, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,  # (B, S, H, D) GLOBAL
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: jax.Array | None = None,  # (B, 1, Sq, Sk) or broadcastable
+    mesh: Mesh,
+    causal: bool = False,
+    context_axis: str = "context",
+    batch_axes: Sequence[str] = ("data", "fsdp"),
+    tensor_axis: str | None = "tensor",
+    impl: str = "auto",
+) -> jax.Array:
+    """Global-array shard_map wrapper (mirror of ring_attention's)."""
+    from pytorch_distributed_train_tpu.ops.cp_common import (
+        divisible_axes,
+        qkv_spec,
+    )
+
+    n = mesh.shape[context_axis]
+    if q.shape[1] % n != 0 or k.shape[1] % n != 0:
+        return attention_lib.dot_product_attention(q, k, v, causal=causal,
+                                                   mask=mask, impl=impl)
+    spec = qkv_spec(q, k, mesh, context_axis=context_axis,
+                    batch_axes=batch_axes, tensor_axis=tensor_axis)
+    fn = functools.partial(
+        ulysses_attention_local, axis_name=context_axis, axis_size=n,
+        causal=causal, impl=impl,
+    )
+    if mask is None:
+        return jax.shard_map(
+            lambda a, b, c: fn(a, b, c),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    # Mask stays full-seq: sharded on batch only, replicated over context.
+    mask_spec = P(divisible_axes(mask.shape[0], batch_axes, mesh),
+                  *([None] * (mask.ndim - 1)))
+    return jax.shard_map(
+        lambda a, b, c, m: fn(a, b, c, m),
+        mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v, mask)

@@ -38,7 +38,8 @@ class Trainer:
             debug_lib.enable_nan_debugging()
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         self.batch_axes = tuple(cfg.mesh.batch_axes)
-        self.model = build_model(cfg.model, cfg.precision)
+        self.model = build_model(cfg.model, cfg.precision,
+                                 mesh=self.mesh, mesh_cfg=cfg.mesh)
         self.loss_fn = losses_lib.get_loss_fn(cfg.loss)
         self.rules = rules_for_model(cfg.model.name)
 
