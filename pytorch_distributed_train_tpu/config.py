@@ -55,6 +55,10 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False
+    # Pipeline parallelism (model name "llama_pp"; SURVEY §2.3 PP row):
+    # microbatch count (0 → = stage count) and schedule ("gpipe" | "1f1b").
+    pipeline_microbatches: int = 0
+    pipeline_schedule: str = "gpipe"
 
 
 @dataclass
@@ -120,12 +124,14 @@ class PrecisionConfig:
 class MeshConfig:
     """Device mesh axis sizes. -1 on one axis → fill with remaining devices.
 
+    stage   — pipeline parallelism (GPipe/1F1B microbatch schedules)
     data    — batch sharding (DP; reference DDP, SURVEY §2.3)
     fsdp    — parameter sharding (ZeRO/FSDP → GSPMD, BASELINE.json:11)
     tensor  — megatron TP on heads / mlp hidden
     context — sequence/ring-attention parallelism (SURVEY §5.7)
     """
 
+    stage: int = 1
     data: int = -1
     fsdp: int = 1
     tensor: int = 1

@@ -1,0 +1,129 @@
+"""Pipeline-parallel Llama: the 'llama_pp' registry entry.
+
+The torch analogue splits an nn.Sequential across stage worker processes
+(torch:distributed/pipelining/stage.py builds a PipelineStage per rank);
+here the decoder blocks are STACKED along a leading layer axis, that axis is
+sharded ``P('stage')``, and parallel/pipeline.py runs the microbatch
+schedule inside one SPMD program. Embedding, final norm and LM head are
+computed outside the pipeline region under plain GSPMD (they are replicated
+over 'stage' and sharded over data/fsdp/tensor as usual) — only the block
+stack pipelines.
+
+This class is deliberately NOT an nn.Module: stacking per-layer params is a
+plain ``jax.vmap`` over the single-block ``init``, and the pipeline body
+calls ``block.apply`` as a pure function — no flax lifted-transform
+machinery between the schedule and the compiler. It duck-types the
+``init``/``apply`` surface the trainer and steps module use.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.models.llama import LlamaBlock, RMSNorm
+from pytorch_distributed_train_tpu.parallel import pipeline as pipeline_lib
+
+
+class PipelinedLlama:
+    """Llama-2 decoder with the block stack pipelined over 'stage'.
+
+    Param tree (paths drive partition rules, parallel/partition.py):
+      params/tok_embed/embedding         (V, C)
+      params/blocks/...                  every LlamaBlock leaf with a leading
+                                         stacked-layer dim L (sharded 'stage')
+      params/final_norm/scale            (C,)
+      params/lm_head/kernel              (C, V)
+    """
+
+    def __init__(self, cfg, dtype, param_dtype, *, mesh, cp=None,
+                 num_microbatches: int = 0, schedule: str = "gpipe"):
+        S = pipeline_lib.num_stages(mesh)
+        if cfg.num_layers % max(S, 1) != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"{S} pipeline stages"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dtype = dtype
+        self.param_dtype = param_dtype
+        self.num_microbatches = num_microbatches or max(S, 1)
+        self.schedule = schedule
+        self.embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size,
+            embedding_init=nn.initializers.normal(0.02),
+            param_dtype=param_dtype, name="tok_embed",
+        )
+        self.block = LlamaBlock(
+            cfg.num_heads, cfg.num_kv_heads or cfg.num_heads, cfg.mlp_dim,
+            cfg.rope_theta, cfg.max_seq_len, cfg.rms_norm_eps,
+            dtype, param_dtype, cp=cp,
+        )
+        self.final_norm = RMSNorm(cfg.rms_norm_eps)
+        self.lm_head = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            param_dtype=param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+
+    # ------------------------------------------------------------- interface
+    def init(self, rngs, input_ids, train: bool = False):
+        del train
+        rng = rngs["params"] if isinstance(rngs, dict) else rngs
+        r_embed, r_blocks, r_norm, r_head = jax.random.split(rng, 4)
+        _, S_len = input_ids.shape
+        h_dummy = jnp.zeros((1, S_len, self.cfg.hidden_size), self.dtype)
+
+        block_params = jax.vmap(
+            lambda r: self.block.init(r, h_dummy)["params"]
+        )(jax.random.split(r_blocks, self.cfg.num_layers))
+
+        return {
+            "params": {
+                "tok_embed": self.embed.init(r_embed, input_ids)["params"],
+                "blocks": block_params,
+                "final_norm": self.final_norm.init(r_norm, h_dummy)["params"],
+                "lm_head": self.lm_head.init(r_head, h_dummy)["params"],
+            }
+        }
+
+    def apply(self, variables, input_ids, train: bool = True, rngs=None,
+              mutable=False):
+        del train, rngs, mutable  # no dropout / batch stats in this recipe
+        p = variables["params"]
+        x = self.embed.apply({"params": p["tok_embed"]}, input_ids)
+        x = x.astype(self.dtype)
+
+        block_apply = self.block.apply
+        if self.cfg.remat:
+            block_apply = jax.checkpoint(block_apply)
+
+        def stage_fn(blocks_local, h):
+            # blocks_local leaves: (layers_per_stage, ...) — scan applies
+            # this stage's blocks in stacked order.
+            def body(h, p_one):
+                return block_apply({"params": p_one}, h), None
+
+            h, _ = jax.lax.scan(body, h, blocks_local)
+            return h
+
+        x_mb = pipeline_lib.microbatch(x, self.num_microbatches)
+        h_mb = pipeline_lib.spmd_pipeline(
+            stage_fn, p["blocks"], x_mb,
+            mesh=self.mesh, schedule=self.schedule,
+        )
+        h = pipeline_lib.unmicrobatch(h_mb)
+
+        h = self.final_norm.apply({"params": p["final_norm"]}, h)
+        logits = self.lm_head.apply({"params": p["lm_head"]}, h)
+        return logits.astype(jnp.float32)
+
+
+def llama_pp(cfg, dtype, param_dtype, *, mesh, cp=None) -> PipelinedLlama:
+    return PipelinedLlama(
+        cfg, dtype, param_dtype, mesh=mesh, cp=cp,
+        num_microbatches=cfg.pipeline_microbatches,
+        schedule=cfg.pipeline_schedule,
+    )

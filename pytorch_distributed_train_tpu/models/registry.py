@@ -35,6 +35,9 @@ def _populate():
             "llama": llama.llama,
         }
     )
+    from pytorch_distributed_train_tpu.models import pipeline_lm
+
+    _REGISTRY["llama_pp"] = pipeline_lm.llama_pp
 
 
 def list_models() -> list[str]:
@@ -66,6 +69,10 @@ def build_model(model_cfg, precision_cfg, mesh=None, mesh_cfg=None):
             impl=mesh_cfg.context_impl,
             batch_axes=tuple(mesh_cfg.batch_axes),
         )
+    if name == "llama_pp":
+        if mesh is None:
+            raise ValueError("model 'llama_pp' needs a mesh (stage axis)")
+        return _REGISTRY[name](model_cfg, dtype, param_dtype, cp=cp, mesh=mesh)
     return _REGISTRY[name](model_cfg, dtype, param_dtype, cp=cp)
 
 

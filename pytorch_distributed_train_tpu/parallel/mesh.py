@@ -15,7 +15,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-MESH_AXES = ("data", "fsdp", "tensor", "context")
+MESH_AXES = ("stage", "data", "fsdp", "tensor", "context")
 
 
 def mesh_shape_from_config(mesh_cfg, n_devices: int | None = None) -> dict[str, int]:
@@ -48,10 +48,12 @@ def mesh_shape_from_config(mesh_cfg, n_devices: int | None = None) -> dict[str, 
 def build_mesh(mesh_cfg=None, devices: Sequence[jax.Device] | None = None) -> Mesh:
     """Build the global mesh.
 
-    Axis order matters for ICI locality: ``data`` outermost (cross-slice DCN
-    tolerant — gradient all-reduce is latency-tolerant), ``tensor``/``context``
-    innermost (latency-critical per-layer collectives ride neighbor ICI
-    links). This is the layout recipe from the scaling-book mental model.
+    Axis order matters for ICI locality: ``stage`` outermost (pipeline P2P is
+    the most DCN-tolerant traffic pattern of all the parallelisms), then
+    ``data`` (cross-slice tolerant — gradient all-reduce is latency-tolerant),
+    ``tensor``/``context`` innermost (latency-critical per-layer collectives
+    ride neighbor ICI links). This is the layout recipe from the scaling-book
+    mental model.
     """
     if devices is None:
         devices = jax.devices()

@@ -147,6 +147,24 @@ def llama_rules() -> list[tuple[str, PartitionSpec]]:
     ]
 
 
+def llama_pp_rules() -> list[tuple[str, PartitionSpec]]:
+    """Pipelined Llama (models/pipeline_lm.py): block params carry a leading
+    stacked-layer dim sharded over 'stage'; within a layer the FSDP×TP layout
+    matches llama_rules. Embed/head live outside the pipeline (replicated
+    over 'stage', sharded over fsdp/tensor as usual)."""
+    return [
+        (r"blocks/.*(q_proj|k_proj|v_proj)/kernel$",
+         P("stage", "fsdp", "tensor")),
+        (r"blocks/.*o_proj/kernel$", P("stage", "tensor", None, "fsdp")),
+        (r"blocks/.*(gate_proj|up_proj)/kernel$", P("stage", "fsdp", "tensor")),
+        (r"blocks/.*down_proj/kernel$", P("stage", "tensor", "fsdp")),
+        (r"blocks/.*scale$", P("stage")),
+        (r"tok_embed/embedding$", P("tensor", "fsdp")),
+        (r"lm_head/kernel$", P("fsdp", "tensor")),
+        (r".*", P()),
+    ]
+
+
 def bert_rules() -> list[tuple[str, PartitionSpec]]:
     return [
         (r"(word_embed|pos_embed|type_embed)/embedding$", P(None, "fsdp")),
@@ -185,6 +203,7 @@ _RULE_SETS: dict[str, Callable[[], list[tuple[str, PartitionSpec]]]] = {
     "resnet": resnet_rules,
     "vit": vit_rules,
     "bert": bert_rules,
+    "llama_pp": llama_pp_rules,  # must precede the "llama" prefix match
     "llama": llama_rules,
     "dense": dense_rules,
 }

@@ -1,0 +1,136 @@
+"""Pipeline parallelism: SPMD microbatch pipelining over the 'stage' mesh axis.
+
+The TPU-native replacement for torch's pipelining stack
+(torch:distributed/pipelining/{stage.py,schedules.py,microbatch.py} — GPipe /
+1F1B / Interleaved schedules, SURVEY §2.3 PP row). The torch design is
+runtime machinery: per-stage worker processes exchange activations through
+P2P sends driven by a schedule interpreter. Here the whole pipeline is ONE
+SPMD program: every device runs the same compiled loop, stage identity is
+`lax.axis_index('stage')`, and activations hop stage→stage via
+`lax.ppermute` on neighbor ICI links (or DCN across slices — PP's
+point-to-point pattern is the most DCN-tolerant of all the parallelisms,
+which is why 'stage' is the outermost mesh axis).
+
+Schedules:
+- ``gpipe`` — all M microbatch forwards, then all backwards (autodiff of the
+  scan). Residuals for all T ticks stay live: O(M) activation memory, like
+  torch's ``ScheduleGPipe``.
+- ``1f1b`` — same compiled forward order, but each tick is wrapped in
+  `jax.checkpoint`: the backward re-runs one tick at a time, interleaving
+  per-tick recompute+grad exactly where 1F1B interleaves B with F. Live
+  activation footprint drops to O(1) ticks (+ the microbatch streams),
+  matching ``Schedule1F1B``'s memory motivation. The bubble fraction
+  (S-1)/(M+S-1) is identical — it is set by the dependency structure, not
+  the runtime.
+
+The loop is differentiable end-to-end (ppermute transposes to the reverse
+rotation; psum transposes to a broadcast), so `jax.grad` of a loss on the
+pipeline output produces the correct reverse-pipeline backward — there is no
+hand-written backward schedule to maintain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+P = PartitionSpec
+
+
+def num_stages(mesh: Mesh, stage_axis: str = "stage") -> int:
+    return mesh.shape.get(stage_axis, 1)
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x_mb: jax.Array,
+    *,
+    mesh: Mesh,
+    stage_axis: str = "stage",
+    schedule: str = "gpipe",
+) -> jax.Array:
+    """Run ``stage_fn`` as an S-stage GPipe/1F1B pipeline over microbatches.
+
+    Args:
+      stage_fn: ``(local_params, h) -> h`` — applies ONE stage's layers to a
+        microbatch of activations. Called inside the manual region; sees its
+        stage's shard of ``stage_params`` (leading layer dim divided by S).
+      stage_params: pytree whose leaves carry a leading stacked-layer dim
+        divisible by the stage count; sharded ``P('stage')`` on that dim.
+      x_mb: (M, mb, ...) microbatched activations, replicated over 'stage'
+        (other mesh axes — batch/tensor sharding — remain under GSPMD).
+      schedule: 'gpipe' | '1f1b' (see module docstring).
+
+    Returns (M, mb, ...) outputs of the final stage, replicated over 'stage'.
+    """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    S = num_stages(mesh, stage_axis)
+    if S == 1:
+        return _sequential(stage_fn, stage_params, x_mb)
+    M = x_mb.shape[0]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def run(params_local, xs):
+        idx = jax.lax.axis_index(stage_axis)
+
+        def tick(state, x_t):
+            # Stage 0 injects the next microbatch; others consume the
+            # activation their neighbor pushed last tick.
+            inp = jnp.where(idx == 0, x_t, state)
+            out = stage_fn(params_local, inp)
+            nxt = jax.lax.ppermute(out, stage_axis, perm)
+            return nxt, out
+
+        if schedule == "1f1b":
+            tick = jax.checkpoint(tick)
+
+        # T = M + S - 1 ticks: S-1 fill/drain bubble ticks padded with zeros.
+        pad = jnp.zeros((S - 1,) + xs.shape[1:], xs.dtype)
+        stream = jnp.concatenate([xs, pad], axis=0)
+        state0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        _, ys = jax.lax.scan(tick, state0, stream)
+
+        # Microbatch m finishes on the last stage at tick m + S - 1.
+        ys_valid = ys[S - 1:]
+        is_last = (idx == S - 1).astype(ys_valid.dtype)
+        # Masked psum ≡ broadcast-from-last-stage (transposes to a cheap
+        # mask in backward). Communicates one activation tensor per
+        # microbatch — the same bytes the torch runtime's final-stage
+        # gather moves.
+        return jax.lax.psum(ys_valid * is_last, stage_axis)
+
+    param_specs = jax.tree.map(lambda _: P(stage_axis), stage_params)
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names=frozenset({stage_axis}),
+        check_vma=False,
+    )(stage_params, x_mb)
+
+
+def _sequential(stage_fn, stage_params, x_mb):
+    """S=1 degenerate case: one 'stage' holding every layer, no mesh comm."""
+    return jax.vmap(lambda x: stage_fn(stage_params, x))(x_mb)
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """(B, ...) → (M, B/M, ...). The analogue of torch's
+    pipelining/microbatch.py split; static shapes required under jit."""
+    B = x.shape[0]
+    if B % num_microbatches != 0:
+        raise ValueError(
+            f"batch {B} not divisible by {num_microbatches} microbatches"
+        )
+    return x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(x_mb: jax.Array) -> jax.Array:
+    """(M, mb, ...) → (M·mb, ...)."""
+    return x_mb.reshape((x_mb.shape[0] * x_mb.shape[1],) + x_mb.shape[2:])

@@ -24,7 +24,7 @@ from pytorch_distributed_train_tpu.config import (
 from pytorch_distributed_train_tpu.losses import get_loss_fn
 from pytorch_distributed_train_tpu.models.registry import build_model
 from pytorch_distributed_train_tpu.optim import make_optimizer
-from pytorch_distributed_train_tpu.parallel.mesh import MESH_AXES, build_mesh
+from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
 from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
 from pytorch_distributed_train_tpu.train_state import TrainState
 
@@ -61,8 +61,10 @@ def _setup(mesh, model_cfg, opt_cfg, batch_axes=("data", "fsdp")):
     return state, step
 
 
-def _run_steps(mesh_shape, devices, n_steps=3, model_name="resnet18"):
-    mesh_cfg = MeshConfig(**dict(zip(MESH_AXES, mesh_shape)))
+def _run_steps(mesh_axes, devices, n_steps=3, model_name="resnet18"):
+    # Keyword axis sizes, NOT positional: MESH_AXES gains axes over time
+    # (stage was prepended for PP) and a zip would silently re-key.
+    mesh_cfg = MeshConfig(**{"data": 1, **mesh_axes})
     mesh = build_mesh(mesh_cfg, devices)
     model_cfg = ModelConfig(name=model_name, num_classes=10, image_size=8)
     opt_cfg = OptimConfig(name="momentum", learning_rate=0.1, schedule="constant",
@@ -80,12 +82,12 @@ def _run_steps(mesh_shape, devices, n_steps=3, model_name="resnet18"):
 
 @pytest.fixture(scope="module")
 def single_device_run():
-    return _run_steps((1, 1, 1, 1), jax.devices("cpu")[:1])
+    return _run_steps({}, jax.devices("cpu")[:1])
 
 
 def test_dp8_matches_single_device(devices8, single_device_run):
     losses1, params1 = single_device_run
-    losses8, params8 = _run_steps((8, 1, 1, 1), devices8)
+    losses8, params8 = _run_steps({"data": 8}, devices8)
     np.testing.assert_allclose(losses1, losses8, rtol=1e-5)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), params1, params8
@@ -94,7 +96,7 @@ def test_dp8_matches_single_device(devices8, single_device_run):
 
 def test_fsdp_matches_dp(devices8, single_device_run):
     losses1, params1 = single_device_run
-    losses_f, params_f = _run_steps((2, 4, 1, 1), devices8)
+    losses_f, params_f = _run_steps({"data": 2, "fsdp": 4}, devices8)
     np.testing.assert_allclose(losses1, losses_f, rtol=1e-5)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), params1, params_f
@@ -110,8 +112,8 @@ def test_tensor_parallel_llama_matches_replicated(devices8):
                           warmup_steps=0, weight_decay=0.0)
     loss_fn = get_loss_fn("causal_lm_xent")
 
-    def run(mesh_shape, devs):
-        mesh_cfg = MeshConfig(**dict(zip(MESH_AXES, mesh_shape)))
+    def run(mesh_axes, devs):
+        mesh_cfg = MeshConfig(**{"data": 1, **mesh_axes})
         mesh = build_mesh(mesh_cfg, devs)
         model = build_model(model_cfg, PrecisionConfig())
         tx, _ = make_optimizer(opt_cfg, total_steps=10)
@@ -133,9 +135,8 @@ def test_tensor_parallel_llama_matches_replicated(devices8):
         state, metrics = step(state, {"input_ids": ids}, rng)
         return float(metrics["loss"]), jax.device_get(state.params)
 
-    loss1, params1 = run((1, 1, 1, 1), jax.devices("cpu")[:1])
-    # data=2 × fsdp=2 × tensor=2
-    loss_tp, params_tp = run((2, 2, 2, 1), devices8)
+    loss1, params1 = run({}, jax.devices("cpu")[:1])
+    loss_tp, params_tp = run({"data": 2, "fsdp": 2, "tensor": 2}, devices8)
     assert abs(loss1 - loss_tp) < 1e-5
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4), params1, params_tp
