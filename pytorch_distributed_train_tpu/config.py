@@ -59,6 +59,15 @@ class ModelConfig:
     # microbatch count (0 → = stage count) and schedule ("gpipe" | "1f1b").
     pipeline_microbatches: int = 0
     pipeline_schedule: str = "gpipe"
+    # Mixture-of-Experts (SURVEY §2.3 EP row; ops/moe.py). num_experts>1
+    # swaps the dense MLP for top-k routed experts on every moe_every-th
+    # block; expert params shard over the 'expert' mesh axis.
+    num_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    moe_every: int = 1
+    moe_aux_weight: float = 0.01
+    moe_zloss_weight: float = 1e-3
 
 
 @dataclass
@@ -127,6 +136,7 @@ class MeshConfig:
     stage   — pipeline parallelism (GPipe/1F1B microbatch schedules)
     data    — batch sharding (DP; reference DDP, SURVEY §2.3)
     fsdp    — parameter sharding (ZeRO/FSDP → GSPMD, BASELINE.json:11)
+    expert  — MoE expert parallelism (token all-to-all dispatch)
     tensor  — megatron TP on heads / mlp hidden
     context — sequence/ring-attention parallelism (SURVEY §5.7)
     """
@@ -134,6 +144,7 @@ class MeshConfig:
     stage: int = 1
     data: int = -1
     fsdp: int = 1
+    expert: int = 1
     tensor: int = 1
     context: int = 1
     # Which mesh axes batch is sharded over (data+fsdp is the common combo).

@@ -121,6 +121,7 @@ class LlamaBlock(nn.Module):
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
+    moe: "MoeSpec | None" = None  # set → MoE FFN instead of dense (ops/moe.py)
 
     @nn.compact
     def __call__(self, x):
@@ -131,7 +132,15 @@ class LlamaBlock(nn.Module):
             name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
-        x = x + LlamaMLP(self.mlp_dim, self.dtype, self.param_dtype, name="mlp")(h)
+        if self.moe is not None:
+            from pytorch_distributed_train_tpu.ops.moe import MoeMLP
+
+            mlp = MoeMLP(self.moe, LlamaMLP, self.mlp_dim, self.dtype,
+                         self.param_dtype, name="moe_mlp")
+        else:
+            mlp = LlamaMLP(self.mlp_dim, self.dtype, self.param_dtype,
+                           name="mlp")
+        x = x + mlp(h)
         return x
 
 
@@ -151,6 +160,7 @@ class LlamaForCausalLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
+    moe: "MoeSpec | None" = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -170,10 +180,13 @@ class LlamaForCausalLM(nn.Module):
 
         block_cls = nn.remat(LlamaBlock) if self.remat else LlamaBlock
         for i in range(self.num_layers):
+            moe = (self.moe if self.moe is not None
+                   and self.moe.active_for_layer(i) else None)
             x = block_cls(
                 self.num_heads, self.num_kv_heads, self.mlp_dim,
                 self.rope_theta, self.max_seq_len, self.rms_norm_eps,
-                self.dtype, self.param_dtype, cp=self.cp, name=f"layer{i}",
+                self.dtype, self.param_dtype, cp=self.cp, moe=moe,
+                name=f"layer{i}",
             )(x)
 
         x = RMSNorm(self.rms_norm_eps, name="final_norm")(x)
@@ -186,8 +199,21 @@ class LlamaForCausalLM(nn.Module):
 
 
 def llama(cfg, dtype, param_dtype, cp=None) -> LlamaForCausalLM:
+    moe = None
+    if getattr(cfg, "num_experts", 0) > 1:
+        from pytorch_distributed_train_tpu.ops.moe import MoeSpec
+
+        moe = MoeSpec(
+            num_experts=cfg.num_experts,
+            top_k=cfg.expert_top_k,
+            capacity_factor=cfg.expert_capacity_factor,
+            aux_weight=cfg.moe_aux_weight,
+            zloss_weight=cfg.moe_zloss_weight,
+            every=cfg.moe_every,
+        )
     return LlamaForCausalLM(
         cp=cp,
+        moe=moe,
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

@@ -45,6 +45,14 @@ class PipelinedLlama:
                 f"num_layers {cfg.num_layers} not divisible by "
                 f"{S} pipeline stages"
             )
+        if getattr(cfg, "num_experts", 0) > 1:
+            # MoE aux losses can't escape the pipeline's manual region yet
+            # (block.apply runs as a pure function inside scan/shard_map);
+            # fail loudly rather than silently training a dense model.
+            raise ValueError(
+                "llama_pp does not support num_experts>1 — combine MoE "
+                "with the 'llama' model, or stage=1"
+            )
         self.cfg = cfg
         self.mesh = mesh
         self.dtype = dtype
@@ -91,7 +99,7 @@ class PipelinedLlama:
 
     def apply(self, variables, input_ids, train: bool = True, rngs=None,
               mutable=False):
-        del train, rngs, mutable  # no dropout / batch stats in this recipe
+        del train, rngs  # no dropout / batch stats in this recipe
         p = variables["params"]
         x = self.embed.apply({"params": p["tok_embed"]}, input_ids)
         x = x.astype(self.dtype)
@@ -118,7 +126,12 @@ class PipelinedLlama:
 
         h = self.final_norm.apply({"params": p["final_norm"]}, h)
         logits = self.lm_head.apply({"params": p["lm_head"]}, h)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        # Honor the flax mutable contract (steps.apply_model passes a list
+        # of collections in train mode and expects an (out, vars) tuple).
+        if mutable:
+            return logits, {}
+        return logits
 
 
 def llama_pp(cfg, dtype, param_dtype, *, mesh, cp=None) -> PipelinedLlama:

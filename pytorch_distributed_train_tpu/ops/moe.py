@@ -1,0 +1,154 @@
+"""Mixture-of-Experts layer with expert parallelism (SURVEY §2.3 EP row).
+
+Expert parallelism is absent from the reference and from torch core (the
+ecosystem supplies it via DeepSpeed-MoE/Megatron); its torch primitive is
+`all_to_all` (torch:distributed/distributed_c10d.py:5145). The TPU-native
+design is the GShard/Switch recipe, shaped for the MXU and GSPMD:
+
+- **Static capacity dispatch.** Top-k routing with a fixed per-expert
+  capacity C = ceil(k·N/E · capacity_factor). Dispatch/combine are dense
+  one-hot tensors contracted with einsum — no gather/scatter with dynamic
+  shapes, so XLA tiles everything onto the MXU and the program never
+  recompiles. Overflow tokens are dropped (pass through the residual),
+  the standard Switch behavior.
+- **Expert sharding.** Expert FFN params are stacked on a leading E dim
+  sharded ``P('expert')``; the (E, C, D) expert batch inherits that
+  sharding, and GSPMD inserts the token all-to-alls between the
+  batch-sharded and expert-sharded layouts — the compiler-placed
+  equivalent of DeepSpeed's hand-written `all_to_all` dispatch.
+- **Aux losses** (load-balance + router z-loss) leave the layer through
+  flax's ``sow`` into the 'losses' collection; the train step adds every
+  sown scalar to the objective (steps.apply_model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeSpec:
+    """MoE knobs threaded from ModelConfig into the block stack."""
+
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    zloss_weight: float = 1e-3
+    every: int = 1  # MoE every n-th block (others keep the dense MLP)
+
+    def active_for_layer(self, i: int) -> bool:
+        return self.num_experts > 1 and (i + 1) % self.every == 0
+
+
+def expert_capacity(n_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert slot count; ≥1 so tiny probe batches still trace."""
+    import math
+
+    return max(1, math.ceil(n_tokens * top_k / num_experts * capacity_factor))
+
+
+def topk_dispatch(gates: jnp.ndarray, top_k: int, capacity: int):
+    """Top-k token→expert assignment with capacity truncation.
+
+    Args:
+      gates: (N, E) fp32 router probabilities (softmax output).
+    Returns:
+      dispatch: (N, E, C) 0/1 — token n occupies slot c of expert e.
+      combine:  (N, E, C) fp32 — dispatch · renormalized gate weight.
+    Slot assignment is choice-major (all 1st choices queue before any 2nd
+    choice) then token-major — earlier tokens win ties, the GShard priority
+    rule.
+    """
+    N, E = gates.shape
+    vals, idx = jax.lax.top_k(gates, top_k)  # (N, k)
+    # Renormalize the selected gates so combine weights sum to 1 per token.
+    vals = vals / jnp.maximum(jnp.sum(vals, axis=-1, keepdims=True), 1e-9)
+
+    counts = jnp.zeros((E,), jnp.int32)  # slots used per expert so far
+    dispatch = jnp.zeros((N, E, capacity), jnp.float32)
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    for s in range(top_k):
+        oh = jax.nn.one_hot(idx[:, s], E, dtype=jnp.int32)  # (N, E)
+        pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]  # queue position
+        keep = (pos < capacity) & (oh > 0)
+        counts = counts + jnp.sum(keep.astype(jnp.int32), axis=0)
+        slot = jax.nn.one_hot(jnp.where(keep, pos, -1), capacity,
+                              dtype=jnp.float32)  # (N, E, C); -1 → all-zero
+        dispatch = dispatch + slot
+        combine = combine + slot * vals[:, s][:, None, None]
+    return dispatch, combine
+
+
+def load_balance_loss(gates: jnp.ndarray, dispatch: jnp.ndarray) -> jnp.ndarray:
+    """Switch-Transformer load-balance loss: E · Σ_e f_e · p_e, minimized at
+    uniform routing. f_e = fraction of dispatched slots on expert e (not
+    differentiable), p_e = mean router prob (differentiable)."""
+    E = gates.shape[1]
+    f = jnp.mean(jnp.sum(dispatch, axis=2), axis=0)  # (E,) tokens kept per e / N
+    p = jnp.mean(gates, axis=0)  # (E,)
+    return E * jnp.sum(f * p)
+
+
+def router_z_loss(logits: jnp.ndarray) -> jnp.ndarray:
+    """ST-MoE z-loss: penalizes large router logits for numeric stability."""
+    return jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+
+
+class MoeMLP(nn.Module):
+    """Drop-in replacement for the dense transformer MLP.
+
+    Param tree: router/kernel (D, E); experts/<proj>/kernel with a leading
+    (E,) dim from nn.vmap — sharded P('expert', ...) by the partition rules.
+    """
+
+    spec: MoeSpec
+    mlp_module: type  # the dense MLP class to replicate per expert
+    mlp_dim: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, D = x.shape
+        N = B * S
+        spec = self.spec
+        E = spec.num_experts
+        C = expert_capacity(N, E, spec.top_k, spec.capacity_factor)
+        xf = x.reshape(N, D)
+
+        # Router in fp32 — small matmul, numerics matter (ST-MoE practice).
+        logits = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.02), name="router",
+        )(xf.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine = topk_dispatch(gates, spec.top_k, C)
+
+        aux = (spec.aux_weight * load_balance_loss(gates, dispatch)
+               + spec.zloss_weight * router_z_loss(logits))
+        self.sow("losses", "moe_aux", aux)
+
+        # (N, E, C) × (N, D) → (E, C, D): the token all-to-all happens here
+        # (GSPMD re-lays batch-sharded tokens out over the 'expert' axis).
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(self.dtype), xf.astype(self.dtype)
+        )
+        experts = nn.vmap(
+            self.mlp_module,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(self.mlp_dim, self.dtype, self.param_dtype, name="experts")
+        expert_out = experts(expert_in)  # (E, C, D)
+
+        # Combine back to token layout (the return all-to-all).
+        yf = jnp.einsum(
+            "nec,ecd->nd", combine.astype(self.dtype), expert_out
+        )
+        return yf.reshape(B, S, D)

@@ -15,7 +15,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-MESH_AXES = ("stage", "data", "fsdp", "tensor", "context")
+MESH_AXES = ("stage", "data", "fsdp", "expert", "tensor", "context")
 
 
 def mesh_shape_from_config(mesh_cfg, n_devices: int | None = None) -> dict[str, int]:

@@ -136,6 +136,11 @@ def llama_rules() -> list[tuple[str, PartitionSpec]]:
         # Attention: hidden × (heads·head_dim)
         (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
         (r"o_proj/kernel$", P("tensor", "fsdp")),
+        # MoE experts (leading E dim over 'expert'); router replicated.
+        # Must precede the dense-MLP rules — same projection names.
+        (r"experts/(gate_proj|up_proj)/kernel$", P("expert", "fsdp", "tensor")),
+        (r"experts/down_proj/kernel$", P("expert", "tensor", "fsdp")),
+        (r"router/kernel$", P()),
         # MLP: gate/up column-parallel, down row-parallel
         (r"(gate_proj|up_proj)/kernel$", P("fsdp", "tensor")),
         (r"down_proj/kernel$", P("tensor", "fsdp")),

@@ -37,22 +37,34 @@ def model_inputs(batch: dict) -> tuple:
 
 
 def apply_model(model, params, batch_stats, batch, *, train: bool, dropout_rng):
+    """Returns (logits, new_batch_stats, aux_loss).
+
+    aux_loss is the sum of everything the model ``sow``ed into the 'losses'
+    collection (MoE load-balance/z-loss, ops/moe.py) — 0.0 for dense models.
+    """
     variables: dict[str, Any] = {"params": params}
     # mutable must be False (not []) when there are no stats — flax returns a
     # (out, vars) tuple for ANY list, including an empty one.
     mutable: Any = False
+    if train:
+        mutable = ["losses"]
     if batch_stats:
         variables["batch_stats"] = batch_stats
         if train:
-            mutable = ["batch_stats"]
+            mutable = ["batch_stats", "losses"]
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
     out = model.apply(
         variables, *model_inputs(batch), train=train, rngs=rngs, mutable=mutable
     )
     if mutable:
         logits, updated = out
-        return logits, updated["batch_stats"]
-    return out, None
+        aux = sum(
+            (jnp.sum(leaf) for leaf in jax.tree_util.tree_leaves(
+                updated.get("losses", {}))),
+            start=jnp.float32(0.0),
+        )
+        return logits, updated.get("batch_stats"), aux
+    return out, None, jnp.float32(0.0)
 
 
 def _tree_finite(tree) -> jnp.ndarray:
@@ -77,17 +89,18 @@ def make_train_step(model, loss_fn: Callable, tx) -> Callable:
         scale = state.dynamic_scale.scale if state.dynamic_scale is not None else None
 
         def loss_for_grad(params):
-            logits, new_stats = apply_model(
+            logits, new_stats, model_aux = apply_model(
                 model, params, state.batch_stats, batch,
                 train=True, dropout_rng=dropout_rng,
             )
             loss, aux = loss_fn(logits, batch)
-            scaled = loss * scale if scale is not None else loss
-            return scaled, (loss, aux, new_stats)
+            total = loss + model_aux  # sown losses (MoE aux) join the objective
+            scaled = total * scale if scale is not None else total
+            return scaled, (loss, aux, model_aux, new_stats)
 
-        grads, (loss, aux, new_stats) = jax.grad(loss_for_grad, has_aux=True)(
-            state.params
-        )
+        grads, (loss, aux, model_aux, new_stats) = jax.grad(
+            loss_for_grad, has_aux=True
+        )(state.params)
 
         if state.dynamic_scale is not None:
             # GradScaler semantics (torch:amp/grad_scaler.py:302,375,484):
@@ -108,7 +121,8 @@ def make_train_step(model, loss_fn: Callable, tx) -> Callable:
             metrics_extra = {}
 
         gnorm = optax_global_norm(grads)
-        metrics = {"loss": loss, "grad_norm": gnorm, **aux, **metrics_extra}
+        metrics = {"loss": loss, "grad_norm": gnorm, "aux_loss": model_aux,
+                   **aux, **metrics_extra}
         return new_state, metrics
 
     return train_step
@@ -123,7 +137,7 @@ def optax_global_norm(tree) -> jnp.ndarray:
 
 def make_eval_step(model, loss_fn: Callable) -> Callable:
     def eval_step(state: TrainState, batch: dict):
-        logits, _ = apply_model(
+        logits, _, _ = apply_model(
             model, state.params, state.batch_stats, batch,
             train=False, dropout_rng=None,
         )

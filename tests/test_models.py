@@ -21,7 +21,8 @@ def _init_and_apply(model, *inputs, train=False):
 
 
 def test_registry_lists_all_families():
-    assert list_models() == ["bert_base", "llama", "resnet18", "resnet50", "vit_b16"]
+    assert list_models() == ["bert_base", "llama", "llama_pp", "resnet18",
+                             "resnet50", "vit_b16"]
 
 
 def test_resnet18_cifar_shapes():
