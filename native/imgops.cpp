@@ -1,0 +1,112 @@
+// imgops — multithreaded fused image augmentation for the input pipeline.
+//
+// The native analogue of the decode/augment work torch's DataLoader worker
+// processes do in C (PIL/torchvision native loops) feeding pinned-memory
+// staging (SURVEY C17: torch:utils/data/_utils/worker.py:244,
+// pin_memory.py:18). The host-side augment hot loop — reflect-pad random
+// crop + horizontal flip + uint8→float32 normalize — is fused into one pass
+// over the batch, parallelized over images with plain std::threads (no GIL:
+// callers hand us raw numpy buffers via ctypes).
+//
+// Layouts: NHWC, uint8 in, float32 out. Reflect padding is 'reflect-101'
+// (mirror excluding the edge pixel), matching np.pad(mode="reflect").
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline int reflect101(int i, int n) {
+  // maps any i in [-(n-1), 2n-2] into [0, n); good for pad < n
+  if (i < 0) return -i;
+  if (i >= n) return 2 * n - 2 - i;
+  return i;
+}
+
+// One image: crop at (y0-pad, x0-pad) in reflect-padded coords, optional
+// hflip, normalize. in: (H, W, C) u8; out: (H, W, C) f32.
+void augment_one(const uint8_t* in, float* out, int H, int W, int C, int pad,
+                 int y0, int x0, bool flip, const float* scale,
+                 const float* bias) {
+  for (int y = 0; y < H; ++y) {
+    const int sy = reflect101(y0 + y - pad, H);
+    const uint8_t* row = in + static_cast<size_t>(sy) * W * C;
+    float* orow = out + static_cast<size_t>(y) * W * C;
+    for (int x = 0; x < W; ++x) {
+      const int xx = flip ? (W - 1 - x) : x;
+      const int sx = reflect101(x0 + xx - pad, W);
+      const uint8_t* px = row + static_cast<size_t>(sx) * C;
+      float* opx = orow + static_cast<size_t>(x) * C;
+      for (int c = 0; c < C; ++c)
+        opx[c] = static_cast<float>(px[c]) * scale[c] + bias[c];
+    }
+  }
+}
+
+void normalize_one(const uint8_t* in, float* out, size_t npix, int C,
+                   const float* scale, const float* bias) {
+  for (size_t p = 0; p < npix; ++p)
+    for (int c = 0; c < C; ++c)
+      out[p * C + c] = static_cast<float>(in[p * C + c]) * scale[c] + bias[c];
+}
+
+template <typename Fn>
+void parallel_for(int n, int nthreads, Fn fn) {
+  nthreads = std::max(1, std::min(nthreads, n));
+  if (nthreads == 1) {
+    for (int i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t)
+    ts.emplace_back([=] {
+      for (int i = t; i < n; i += nthreads) fn(i);
+    });
+  for (auto& th : ts) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fused reflect-pad crop + hflip + normalize over a batch.
+//   in:   (B, H, W, C) uint8       out: (B, H, W, C) float32
+//   ys/xs: (B,) int32 crop offsets in [0, 2*pad]
+//   flips: (B,) uint8 (0/1)
+//   mean/stddev: (C,) float32 — output = (u8/255 - mean) / stddev
+void imgops_augment_batch(const uint8_t* in, float* out, int B, int H, int W,
+                          int C, int pad, const int32_t* ys, const int32_t* xs,
+                          const uint8_t* flips, const float* mean,
+                          const float* stddev, int nthreads) {
+  std::vector<float> scale(C), bias(C);
+  for (int c = 0; c < C; ++c) {
+    scale[c] = 1.0f / (255.0f * stddev[c]);
+    bias[c] = -mean[c] / stddev[c];
+  }
+  const size_t img = static_cast<size_t>(H) * W * C;
+  parallel_for(B, nthreads, [&](int b) {
+    augment_one(in + b * img, out + b * img, H, W, C, pad, ys[b], xs[b],
+                flips[b] != 0, scale.data(), bias.data());
+  });
+}
+
+// uint8 → normalized float32, no geometry (eval path).
+void imgops_normalize_batch(const uint8_t* in, float* out, int B, int H, int W,
+                            int C, const float* mean, const float* stddev,
+                            int nthreads) {
+  std::vector<float> scale(C), bias(C);
+  for (int c = 0; c < C; ++c) {
+    scale[c] = 1.0f / (255.0f * stddev[c]);
+    bias[c] = -mean[c] / stddev[c];
+  }
+  const size_t npix = static_cast<size_t>(H) * W;
+  parallel_for(B, nthreads, [&](int b) {
+    normalize_one(in + b * npix * C, out + b * npix * C, npix, C, scale.data(),
+                  bias.data());
+  });
+}
+
+}  // extern "C"

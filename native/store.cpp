@@ -1,0 +1,382 @@
+// tpustore — a blocking TCP key-value store for job bootstrap.
+//
+// The native analogue of c10d's TCPStore (SURVEY C5:
+// torch:include/torch/csrc/distributed/c10d/TCPStore.hpp:73 — a C++ socket
+// server thread on rank 0 that every rank connects to for the init
+// handshake). JAX's coordination service covers in-job bootstrap; this store
+// serves the layer BELOW it — the launcher (tpurun) uses it for gang
+// rendezvous, restart barriers and cross-process key exchange before/around
+// jax.distributed, exactly where torchrun's elastic agent uses its TCPStore
+// rendezvous backend (SURVEY C10/C11).
+//
+// Protocol (all integers little-endian):
+//   request:  [op:u8][klen:u32][key bytes][vlen:u32][val bytes]
+//   ops: 1 SET   val = payload            → [status:u8]
+//        2 GET   val = i64 timeout_ms     → [status:u8][len:u32][payload]
+//                (blocks until key exists or timeout; status 1 = timeout)
+//        3 ADD   val = i64 delta          → [status:u8][i64 new_value]
+//                (atomic counter; key need not exist)
+//        4 WAIT  val = i64 timeout_ms     → [status:u8]  (no payload read)
+//        5 DEL                            → [status:u8]
+//        6 NUMKEYS                        → [status:u8][i64 count]
+//
+// Exported C API (ctypes-friendly) at the bottom. Threads: one acceptor +
+// one thread per connection; state under a single mutex + condition_variable
+// (GETs/WAITs block on the cv, SET/ADD notify_all).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+// ---------------------------------------------------------------- io utils
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+int64_t as_i64(const std::vector<uint8_t>& v) {
+  int64_t x = 0;
+  std::memcpy(&x, v.data(), std::min(v.size(), sizeof(x)));
+  return x;
+}
+
+// ------------------------------------------------------------------ server
+struct Server {
+  Store store;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread acceptor;
+  std::mutex conn_mu;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;
+
+  ~Server() { stop(); }
+
+  void handle_conn(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      uint32_t klen, vlen;
+      if (!read_exact(fd, &op, 1) || !read_exact(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!read_exact(fd, key.data(), klen) || !read_exact(fd, &vlen, 4)) break;
+      if (vlen > (1u << 30)) break;
+      std::vector<uint8_t> val(vlen);
+      if (vlen && !read_exact(fd, val.data(), vlen)) break;
+
+      uint8_t status = 0;
+      switch (op) {
+        case 1: {  // SET
+          {
+            std::lock_guard<std::mutex> l(store.mu);
+            store.data[key] = std::move(val);
+          }
+          store.cv.notify_all();
+          if (!write_exact(fd, &status, 1)) return;
+          break;
+        }
+        case 2:    // GET (blocking)
+        case 4: {  // WAIT
+          auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(as_i64(val));
+          std::vector<uint8_t> out;
+          {
+            std::unique_lock<std::mutex> l(store.mu);
+            bool ok = store.cv.wait_until(l, deadline, [&] {
+              return stopping.load() || store.data.count(key) > 0;
+            });
+            if (!ok || stopping.load()) {
+              status = 1;  // timeout
+            } else if (op == 2) {
+              out = store.data[key];
+            }
+          }
+          if (!write_exact(fd, &status, 1)) return;
+          if (op == 2 && status == 0) {
+            uint32_t n = static_cast<uint32_t>(out.size());
+            if (!write_exact(fd, &n, 4) ||
+                (n && !write_exact(fd, out.data(), n)))
+              return;
+          }
+          break;
+        }
+        case 3: {  // ADD
+          int64_t neu;
+          {
+            std::lock_guard<std::mutex> l(store.mu);
+            auto& cur = store.data[key];
+            int64_t old = cur.empty() ? 0 : as_i64(cur);
+            neu = old + as_i64(val);
+            cur.resize(sizeof(neu));
+            std::memcpy(cur.data(), &neu, sizeof(neu));
+          }
+          store.cv.notify_all();
+          if (!write_exact(fd, &status, 1) ||
+              !write_exact(fd, &neu, sizeof(neu)))
+            return;
+          break;
+        }
+        case 5: {  // DEL
+          {
+            std::lock_guard<std::mutex> l(store.mu);
+            store.data.erase(key);
+          }
+          if (!write_exact(fd, &status, 1)) return;
+          break;
+        }
+        case 6: {  // NUMKEYS
+          int64_t n;
+          {
+            std::lock_guard<std::mutex> l(store.mu);
+            n = static_cast<int64_t>(store.data.size());
+          }
+          if (!write_exact(fd, &status, 1) || !write_exact(fd, &n, sizeof(n)))
+            return;
+          break;
+        }
+        default:
+          return;
+      }
+    }
+    // fd is NOT closed here: stop() owns the close (after join), so a
+    // handler exit can't free an fd number stop() is about to shutdown.
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        ::listen(listen_fd, 128) < 0) {
+      ::close(listen_fd);
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    acceptor = std::thread([this] {
+      for (;;) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) {
+          if (stopping.load()) return;
+          continue;
+        }
+        std::lock_guard<std::mutex> l(conn_mu);
+        conn_fds.push_back(fd);
+        conns.emplace_back(&Server::handle_conn, this, fd);
+      }
+    });
+    return true;
+  }
+
+  void stop() {
+    if (stopping.exchange(true)) return;
+    store.cv.notify_all();
+    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
+    if (acceptor.joinable()) acceptor.join();
+    if (listen_fd >= 0) ::close(listen_fd);
+    // Unblock every handler (shutdown makes pending read_exact fail), then
+    // JOIN — detaching would let a live handler dereference the Server the
+    // caller is about to delete.
+    {
+      std::lock_guard<std::mutex> l(conn_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+    for (int fd : conn_fds) ::close(fd);
+  }
+};
+
+// ------------------------------------------------------------------ client
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client handle
+
+  ~Client() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  bool connect_to(const char* host, int port, int64_t timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    do {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) return false;
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        ::close(fd);
+        fd = -1;
+        return false;
+      }
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd);
+      fd = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    } while (std::chrono::steady_clock::now() < deadline);
+    return false;
+  }
+
+  bool request(uint8_t op, const char* key, const void* val, uint32_t vlen) {
+    uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+    return write_exact(fd, &op, 1) && write_exact(fd, &klen, 4) &&
+           write_exact(fd, key, klen) && write_exact(fd, &vlen, 4) &&
+           (vlen == 0 || write_exact(fd, val, vlen));
+  }
+};
+
+}  // namespace
+
+// ----------------------------------------------------------- exported C API
+extern "C" {
+
+void* tpustore_server_start(int port) {
+  auto* s = new Server();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int tpustore_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void tpustore_server_stop(void* h) { delete static_cast<Server*>(h); }
+
+void* tpustore_connect(const char* host, int port, int64_t timeout_ms) {
+  auto* c = new Client();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tpustore_close(void* h) { delete static_cast<Client*>(h); }
+
+// 0 ok, -1 io error
+int tpustore_set(void* h, const char* key, const void* data, int len) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> l(c->mu);
+  uint8_t status;
+  if (!c->request(1, key, data, static_cast<uint32_t>(len)) ||
+      !read_exact(c->fd, &status, 1))
+    return -1;
+  return status == 0 ? 0 : -1;
+}
+
+// returns payload length (>=0), -1 io error, -2 timeout, -3 buffer too small
+int tpustore_get(void* h, const char* key, int64_t timeout_ms, void* buf,
+                 int buf_len) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> l(c->mu);
+  uint8_t status;
+  if (!c->request(2, key, &timeout_ms, sizeof(timeout_ms)) ||
+      !read_exact(c->fd, &status, 1))
+    return -1;
+  if (status != 0) return -2;
+  uint32_t n;
+  if (!read_exact(c->fd, &n, 4)) return -1;
+  std::vector<uint8_t> tmp(n);
+  if (n && !read_exact(c->fd, tmp.data(), n)) return -1;
+  if (static_cast<int>(n) > buf_len) return -3;
+  if (n) std::memcpy(buf, tmp.data(), n);
+  return static_cast<int>(n);
+}
+
+// atomic add; returns new value via *out. 0 ok, -1 error.
+int tpustore_add(void* h, const char* key, int64_t delta, int64_t* out) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> l(c->mu);
+  uint8_t status;
+  if (!c->request(3, key, &delta, sizeof(delta)) ||
+      !read_exact(c->fd, &status, 1) || !read_exact(c->fd, out, sizeof(*out)))
+    return -1;
+  return 0;
+}
+
+// 0 key appeared, -2 timeout, -1 error
+int tpustore_wait(void* h, const char* key, int64_t timeout_ms) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> l(c->mu);
+  uint8_t status;
+  if (!c->request(4, key, &timeout_ms, sizeof(timeout_ms)) ||
+      !read_exact(c->fd, &status, 1))
+    return -1;
+  return status == 0 ? 0 : -2;
+}
+
+int tpustore_del(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> l(c->mu);
+  uint8_t status;
+  if (!c->request(5, key, nullptr, 0) || !read_exact(c->fd, &status, 1))
+    return -1;
+  return 0;
+}
+
+int tpustore_numkeys(void* h, int64_t* out) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> l(c->mu);
+  uint8_t status;
+  if (!c->request(6, "", nullptr, 0) || !read_exact(c->fd, &status, 1) ||
+      !read_exact(c->fd, out, sizeof(*out)))
+    return -1;
+  return 0;
+}
+
+}  // extern "C"

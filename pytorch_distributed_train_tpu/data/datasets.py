@@ -30,39 +30,75 @@ IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
 class ArrayDataset:
-    """In-RAM dataset: dict of equal-length numpy arrays + optional augment."""
+    """In-RAM dataset: dict of equal-length numpy arrays."""
 
     is_item_style = False
 
-    def __init__(self, arrays: dict[str, np.ndarray], augment: str = ""):
+    def __init__(self, arrays: dict[str, np.ndarray]):
         lens = {k: len(v) for k, v in arrays.items()}
         if len(set(lens.values())) != 1:
             raise ValueError(f"ragged arrays: {lens}")
         self.arrays = arrays
-        self.augment = augment
 
     def __len__(self) -> int:
         return len(next(iter(self.arrays.values())))
 
     def get_batch(self, idx: np.ndarray, rng: np.random.Generator, train: bool) -> dict:
-        batch = {k: v[idx] for k, v in self.arrays.items()}
-        if train and self.augment == "cifar":
-            batch["image"] = _augment_cifar(batch["image"], rng)
-        return batch
+        return {k: v[idx] for k, v in self.arrays.items()}
 
 
-def _augment_cifar(images: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-    """Pad-4 random crop + horizontal flip, vectorized over the batch."""
-    B, H, W, C = images.shape
-    padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+def _crop_flip(images: np.ndarray, pad: int, ys, xs, flips) -> np.ndarray:
+    """Reflect-pad random crop + hflip with precomputed draws — the numpy
+    reference for the native kernel (imgops.augment_batch minus normalize)."""
+    B, H, W, _ = images.shape
+    padded = np.pad(images, ((0, 0), (pad,) * 2, (pad,) * 2, (0, 0)),
+                    mode="reflect")
     out = np.empty_like(images)
-    ys = rng.integers(0, 9, size=B)
-    xs = rng.integers(0, 9, size=B)
-    flips = rng.random(B) < 0.5
     for i in range(B):
-        img = padded[i, ys[i] : ys[i] + H, xs[i] : xs[i] + W]
+        img = padded[i, ys[i]: ys[i] + H, xs[i]: xs[i] + W]
         out[i] = img[:, ::-1] if flips[i] else img
     return out
+
+
+class U8ImageDataset(ArrayDataset):
+    """uint8 image storage + fused native augment/normalize (native/imgops).
+
+    Keeps the dataset in RAM at 1/4 the float32 footprint and runs the
+    reflect-pad crop + hflip + u8→f32 normalize as ONE multithreaded C++
+    pass per batch (SURVEY C17 native equivalent). Falls back to the numpy
+    path when the native build is unavailable — batch values are identical
+    either way (both implement reflect-101 padding then (x/255-mean)/std).
+    """
+
+    def __init__(self, images_u8: np.ndarray, labels: np.ndarray,
+                 mean: np.ndarray, std: np.ndarray, augment: bool,
+                 pad: int = 4):
+        super().__init__({"image": images_u8, "label": labels})
+        self.mean, self.std = mean, std
+        self.do_augment = augment
+        self.pad = pad
+
+    def get_batch(self, idx, rng, train):
+        from pytorch_distributed_train_tpu.native import imgops
+
+        imgs = self.arrays["image"][idx]
+        B, H, W, C = imgs.shape
+        if train and self.do_augment:
+            ys = rng.integers(0, 2 * self.pad + 1, size=B)
+            xs = rng.integers(0, 2 * self.pad + 1, size=B)
+            flips = rng.random(B) < 0.5
+            if imgops.available():
+                out = imgops.augment_batch(
+                    imgs, self.pad, ys, xs, flips, self.mean, self.std)
+            else:
+                out = _crop_flip(imgs.astype(np.float32), self.pad, ys, xs,
+                                 flips)
+                out = (out / 255.0 - self.mean) / self.std
+        elif imgops.available():
+            out = imgops.normalize_batch(imgs, self.mean, self.std)
+        else:
+            out = (imgs.astype(np.float32) / 255.0 - self.mean) / self.std
+        return {"image": out, "label": self.arrays["label"][idx]}
 
 
 # ------------------------------------------------------------------ CIFAR-10
@@ -86,10 +122,11 @@ def load_cifar10(data_dir: str, train: bool) -> ArrayDataset:
             d = pickle.load(fh, encoding="bytes")
         xs.append(d[b"data"])
         ys.append(np.asarray(d[b"labels"], np.int32))
-    x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NHWC
-    x = (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
+    x = np.ascontiguousarray(
+        np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    )  # NHWC uint8 — normalization is fused into the per-batch native pass
     y = np.concatenate(ys)
-    return ArrayDataset({"image": x, "label": y}, augment="cifar" if train else "")
+    return U8ImageDataset(x, y, CIFAR_MEAN, CIFAR_STD, augment=train)
 
 
 def _find_cifar_dir(data_dir: str) -> str | None:
@@ -209,8 +246,14 @@ class ImageFolderDataset:
                     im = im.transpose(Image.FLIP_LEFT_RIGHT)
             else:
                 im = _center_crop(im, self.image_size)
-            x = np.asarray(im, np.float32) / 255.0
-        x = (x - IMAGENET_MEAN) / IMAGENET_STD
+            x_u8 = np.asarray(im, np.uint8)
+        from pytorch_distributed_train_tpu.native import imgops
+
+        if imgops.available():
+            x = imgops.normalize_batch(
+                x_u8[None], IMAGENET_MEAN, IMAGENET_STD, nthreads=1)[0]
+        else:
+            x = (x_u8.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
         return {"image": x, "label": np.int32(label)}
 
 

@@ -1,0 +1,258 @@
+"""tpurun — gang launcher with restart supervision (SURVEY C10, §3.1).
+
+The torchrun replacement. torchrun's elastic agent
+(torch:distributed/run.py:985, elastic/agent/server/api.py:455) spawns one
+worker per device, rendezvouses them through a TCPStore, monitors, and
+restarts failed workers in place. Under SPMD a single surviving rank is
+useless — the correct unit of restart is the WHOLE gang, resuming from the
+latest checkpoint (SURVEY §5.3b: ``checkpoint.resume='auto'`` is the default
+path). So this agent:
+
+1. hosts the native rendezvous store (native/store.cpp — the TCPStore
+   analogue) and publishes its address to workers via ``TPUSTORE_ADDR``;
+2. spawns ``nprocs`` workers with the env contract
+   ``PROCESS_ID / NUM_PROCESSES / COORDINATOR_ADDRESS`` (consumed by
+   launch.initialize_distributed → jax.distributed.initialize);
+3. monitors the gang; on any worker death it kills the rest, bumps the
+   restart generation in the store, and respawns everyone — up to
+   ``max_restarts`` times (elastic agent semantics, whole-gang flavor);
+4. exits 0 only when every worker exits 0.
+
+Workers can use ``worker_store()`` for launcher-mediated KV exchange and
+barriers (the same role c10d's store plays for init handshakes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+@dataclasses.dataclass
+class LaunchConfig:
+    nprocs: int
+    max_restarts: int = 3
+    monitor_interval_s: float = 0.5
+    # Multi-host: total processes = nnodes * nprocs; this host contributes
+    # ranks [node_rank*nprocs, (node_rank+1)*nprocs). Node 0 hosts the store
+    # and the JAX coordinator.
+    nnodes: int = 1
+    node_rank: int = 0
+    master_addr: str = "127.0.0.1"
+    store_port: int = 0  # 0 → ephemeral (single-node only)
+    env: dict | None = None
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class ElasticAgent:
+    def __init__(self, cfg: LaunchConfig, cmd: list[str]):
+        self.cfg = cfg
+        self.cmd = cmd
+        self.server = None
+        self.store_port = cfg.store_port
+        self.coord_port = None
+        self.procs: list[subprocess.Popen] = []
+        self.agent_client = None  # agent↔agent coordination (nnodes > 1)
+
+    # ------------------------------------------------------------ lifecycle
+    def _start_store(self) -> None:
+        if self.cfg.node_rank == 0:
+            from pytorch_distributed_train_tpu.native.store import StoreServer
+
+            self.server = StoreServer(self.cfg.store_port)
+            self.store_port = self.server.port
+            self.coord_port = _free_port()
+            # Publish the JAX coordinator endpoint for every node's workers.
+            from pytorch_distributed_train_tpu.native.store import StoreClient
+
+            with StoreClient("127.0.0.1", self.store_port) as c:
+                c.set("coord", f"{self.cfg.master_addr}:{self.coord_port}"
+                      .encode())
+        else:
+            from pytorch_distributed_train_tpu.native.store import StoreClient
+
+            with StoreClient(self.cfg.master_addr, self.store_port,
+                             timeout_ms=120_000) as c:
+                coord = c.get("coord", timeout_ms=120_000).decode()
+            self.coord_port = int(coord.rsplit(":", 1)[1])
+
+    def _spawn(self, restart_gen: int) -> None:
+        cfg = self.cfg
+        world = cfg.nnodes * cfg.nprocs
+        self.procs = []
+        for local in range(cfg.nprocs):
+            rank = cfg.node_rank * cfg.nprocs + local
+            env = dict(os.environ)
+            env.update(cfg.env or {})
+            env.update({
+                "PROCESS_ID": str(rank),
+                "LOCAL_PROCESS_ID": str(local),
+                "NUM_PROCESSES": str(world),
+                "COORDINATOR_ADDRESS":
+                    f"{cfg.master_addr}:{self.coord_port}",
+                "TPUSTORE_ADDR": f"{cfg.master_addr}:{self.store_port}",
+                "RESTART_GENERATION": str(restart_gen),
+            })
+            self.procs.append(subprocess.Popen(self.cmd, env=env))
+        self._log(f"spawned {cfg.nprocs} workers (gen {restart_gen}, "
+                  f"world {world}, coord :{self.coord_port})")
+
+    def _kill_all(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def _log(self, msg: str) -> None:
+        print(f"[tpurun] {msg}", flush=True)
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> int:
+        self._start_store()
+        cfg = self.cfg
+        try:
+            if cfg.nnodes > 1:
+                from pytorch_distributed_train_tpu.native.store import (
+                    StoreClient,
+                )
+
+                host = "127.0.0.1" if cfg.node_rank == 0 else cfg.master_addr
+                self.agent_client = StoreClient(host, self.store_port,
+                                                timeout_ms=120_000)
+            for gen in range(cfg.max_restarts + 1):
+                if self.agent_client is not None:
+                    # Gang restarts are whole-JOB: every node's agent meets
+                    # here before (re)spawning, so no generation skew.
+                    self.agent_client.barrier(
+                        f"agents/spawn/{gen}", cfg.nnodes, cfg.node_rank,
+                        timeout_ms=600_000)
+                self._spawn(gen)
+                rc = self._monitor(gen)
+                if rc == 0:
+                    self._log("all workers exited cleanly")
+                    return 0
+                if gen == self.cfg.max_restarts:
+                    self._log(f"worker failed (rc={rc}); restart budget "
+                              f"exhausted after {gen} restarts")
+                    return rc
+                self._log(f"worker failed (rc={rc}); restarting gang "
+                          f"({gen + 1}/{self.cfg.max_restarts})")
+            return 1
+        finally:
+            if self.agent_client is not None:
+                self.agent_client.close()
+            if self.server is not None:
+                self.server.stop()
+
+    def _peer_failure(self, gen: int) -> int | None:
+        """rc another node published for this generation, or None."""
+        if self.agent_client is None:
+            return None
+        try:
+            return int(self.agent_client.get(f"gang/fail/{gen}", timeout_ms=1))
+        except TimeoutError:
+            return None
+
+    def _monitor(self, gen: int) -> int:
+        """Waits for gang completion. Returns 0 (all nodes clean) or the
+        first bad rc — publishing local failures to peer agents so every
+        node restarts together (SPMD: the unit of restart is the job)."""
+        local_done = False
+        while True:
+            time.sleep(self.cfg.monitor_interval_s)
+            rc = self._peer_failure(gen)
+            if rc is not None:
+                self._kill_all()
+                return rc
+            if not local_done:
+                codes = [p.poll() for p in self.procs]
+                bad = [c for c in codes if c not in (None, 0)]
+                if bad:
+                    if self.agent_client is not None:
+                        self.agent_client.set(f"gang/fail/{gen}",
+                                              str(bad[0]).encode())
+                    self._kill_all()
+                    return bad[0]
+                if all(c == 0 for c in codes):
+                    if self.agent_client is None:
+                        return 0
+                    local_done = True
+                    n = self.agent_client.add(f"gang/ok/{gen}", 1)
+                    if n == self.cfg.nnodes:
+                        self.agent_client.set(f"gang/alldone/{gen}", b"1")
+            else:
+                try:
+                    self.agent_client.wait(f"gang/alldone/{gen}", timeout_ms=1)
+                    return 0
+                except TimeoutError:
+                    pass  # peers still running; keep watching for failures
+
+
+def worker_store():
+    """Connect to the launcher's store from inside a worker (or None when
+    not running under tpurun)."""
+    addr = os.environ.get("TPUSTORE_ADDR")
+    if not addr:
+        return None
+    from pytorch_distributed_train_tpu.native.store import StoreClient
+
+    host, port = addr.rsplit(":", 1)
+    return StoreClient(host, int(port))
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Gang launcher with whole-job restart supervision "
+                    "(the torchrun analogue).",
+    )
+    p.add_argument("--nprocs", type=int, required=True,
+                   help="worker processes on this node")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--master-addr", default="127.0.0.1")
+    p.add_argument("--store-port", type=int, default=0,
+                   help="required (nonzero) when nnodes > 1")
+    p.add_argument("--monitor-interval", type=float, default=0.5)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="worker command, e.g. train.py --config ...")
+    args = p.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("missing worker command")
+    if args.nnodes > 1 and args.store_port == 0:
+        p.error("--store-port must be fixed when nnodes > 1")
+    if cmd[0].endswith(".py"):
+        cmd = [sys.executable] + cmd
+    cfg = LaunchConfig(
+        nprocs=args.nprocs, max_restarts=args.max_restarts,
+        nnodes=args.nnodes, node_rank=args.node_rank,
+        master_addr=args.master_addr, store_port=args.store_port,
+        monitor_interval_s=args.monitor_interval,
+    )
+    return ElasticAgent(cfg, cmd).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""Native (C++) runtime components and their build machinery.
+
+The reference's runtime layer is C++ where it matters (SURVEY §2.2: TCPStore
+C5, DDP Reducer C7, DataLoader pin-memory C17, FlightRecorder C25). The TPU
+stack obsoletes the Reducer (XLA schedules the collectives) but the
+process-level runtime — rendezvous store, launcher plumbing, data-pipeline
+hot loops — still wants native code. Sources live in ``<repo>/native/``;
+each is compiled on demand into a shared library next to the source with
+g++ (no pybind11 in the image — the C API + ctypes is the binding layer).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import subprocess
+import threading
+
+_BUILD_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def _file_lock(path: str):
+    """Advisory flock serializing builds ACROSS processes (tpurun spawns N
+    workers that may all import the bindings on a fresh checkout)."""
+    fd = os.open(path, os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+def _native_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "native",
+    )
+
+
+def build_library(name: str, extra_flags: tuple[str, ...] = ()) -> str:
+    """Compile ``native/<name>.cpp`` → ``native/lib<name>.so`` if stale.
+
+    Returns the .so path. Thread-safe; rebuilds only when the source is
+    newer than the library (the make rule, inlined).
+    """
+    src = os.path.join(_native_dir(), f"{name}.cpp")
+    out = os.path.join(_native_dir(), f"lib{name}.so")
+    with _BUILD_LOCK, _file_lock(out + ".lock"):
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
+        tmp = f"{out}.{os.getpid()}.tmp"  # per-pid: os.replace stays atomic
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               *extra_flags, src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed: {' '.join(cmd)}\n{e.stderr}"
+            ) from e
+        os.replace(tmp, out)
+        return out

@@ -1,0 +1,144 @@
+"""ctypes bindings for the native rendezvous store (native/store.cpp).
+
+API mirrors c10d's TCPStore surface (set/get/add/wait —
+torch:include/torch/csrc/distributed/c10d/TCPStore.hpp:73, Store.hpp): a
+rank-0-hosted TCP KV server plus blocking clients. Used by the tpurun
+launcher for gang rendezvous and restart barriers (SURVEY C5/C10/C11).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from pytorch_distributed_train_tpu.native import build_library
+
+_LIB: ctypes.CDLL | None = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        lib = ctypes.CDLL(build_library("store"))
+        lib.tpustore_server_start.restype = ctypes.c_void_p
+        lib.tpustore_server_start.argtypes = [ctypes.c_int]
+        lib.tpustore_server_port.restype = ctypes.c_int
+        lib.tpustore_server_port.argtypes = [ctypes.c_void_p]
+        lib.tpustore_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tpustore_connect.restype = ctypes.c_void_p
+        lib.tpustore_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int64]
+        lib.tpustore_close.argtypes = [ctypes.c_void_p]
+        lib.tpustore_set.restype = ctypes.c_int
+        lib.tpustore_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.tpustore_get.restype = ctypes.c_int
+        lib.tpustore_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.tpustore_add.restype = ctypes.c_int
+        lib.tpustore_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.tpustore_wait.restype = ctypes.c_int
+        lib.tpustore_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.tpustore_del.restype = ctypes.c_int
+        lib.tpustore_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpustore_numkeys.restype = ctypes.c_int
+        lib.tpustore_numkeys.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        _LIB = lib
+    return _LIB
+
+
+class StoreServer:
+    """Hosts the KV store (launcher process / process 0). port=0 → ephemeral."""
+
+    def __init__(self, port: int = 0):
+        self._h = _lib().tpustore_server_start(port)
+        if not self._h:
+            raise OSError(f"tpustore: could not bind port {port}")
+        self.port = _lib().tpustore_server_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            _lib().tpustore_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class StoreClient:
+    """Blocking client. All methods raise on transport errors; ``get``/
+    ``wait`` raise TimeoutError when the key never appears."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_ms: int = 10_000):
+        self._h = _lib().tpustore_connect(host.encode(), port, timeout_ms)
+        if not self._h:
+            raise ConnectionError(f"tpustore: cannot reach {host}:{port}")
+
+    def set(self, key: str, value: bytes) -> None:
+        if _lib().tpustore_set(self._h, key.encode(), value, len(value)) != 0:
+            raise OSError(f"tpustore set({key!r}) failed")
+
+    def get(self, key: str, timeout_ms: int = 60_000,
+            max_len: int = 1 << 20) -> bytes:
+        buf = ctypes.create_string_buffer(max_len)
+        n = _lib().tpustore_get(self._h, key.encode(), timeout_ms, buf, max_len)
+        if n == -2:
+            raise TimeoutError(f"tpustore get({key!r}) timed out")
+        if n < 0:
+            raise OSError(f"tpustore get({key!r}) failed ({n})")
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        out = ctypes.c_int64(0)
+        if _lib().tpustore_add(self._h, key.encode(), delta,
+                               ctypes.byref(out)) != 0:
+            raise OSError(f"tpustore add({key!r}) failed")
+        return out.value
+
+    def wait(self, key: str, timeout_ms: int = 60_000) -> None:
+        r = _lib().tpustore_wait(self._h, key.encode(), timeout_ms)
+        if r == -2:
+            raise TimeoutError(f"tpustore wait({key!r}) timed out")
+        if r != 0:
+            raise OSError(f"tpustore wait({key!r}) failed")
+
+    def delete(self, key: str) -> None:
+        if _lib().tpustore_del(self._h, key.encode()) != 0:
+            raise OSError(f"tpustore del({key!r}) failed")
+
+    def num_keys(self) -> int:
+        out = ctypes.c_int64(0)
+        if _lib().tpustore_numkeys(self._h, ctypes.byref(out)) != 0:
+            raise OSError("tpustore numkeys failed")
+        return out.value
+
+    def barrier(self, name: str, world: int, rank: int,
+                timeout_ms: int = 60_000) -> None:
+        """All ``world`` participants block until everyone arrives.
+
+        The counter/flag two-phase pattern c10d uses for its store-based
+        barrier; ``name`` must be unique per use (epoch it if reused).
+        """
+        n = self.add(f"barrier/{name}/count", 1)
+        if n == world:
+            self.set(f"barrier/{name}/go", b"1")
+        self.wait(f"barrier/{name}/go", timeout_ms)
+
+    def close(self) -> None:
+        if self._h:
+            _lib().tpustore_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

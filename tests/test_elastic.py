@@ -1,0 +1,131 @@
+"""tpurun gang launcher (SURVEY C10): env contract, store-mediated barrier,
+whole-gang restart on worker failure — the behaviors torchrun's elastic
+agent tests cover (torch:distributed/elastic/agent/server/api.py:906-970),
+restart semantics adapted to SPMD (whole gang, not single rank).
+"""
+
+import os
+import subprocess
+import sys
+
+from pytorch_distributed_train_tpu.elastic import ElasticAgent, LaunchConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OK_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from pytorch_distributed_train_tpu.elastic import worker_store
+
+rank = int(os.environ["PROCESS_ID"])
+world = int(os.environ["NUM_PROCESSES"])
+gen = os.environ["RESTART_GENERATION"]
+store = worker_store()
+store.set(f"hello/{{rank}}", f"gen{{gen}}".encode())
+store.barrier(f"done-{{gen}}", world, rank, timeout_ms=20000)
+with open(os.path.join({out!r}, f"rank{{rank}}.txt"), "w") as f:
+    f.write(f"{{rank}}/{{world}} gen={{gen}}")
+"""
+
+FLAKY_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+rank = int(os.environ["PROCESS_ID"])
+gen = int(os.environ["RESTART_GENERATION"])
+marker = os.path.join({out!r}, "crashed-once")
+if rank == 1 and not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(17)  # first generation: rank 1 dies
+with open(os.path.join({out!r}, f"rank{{rank}}-gen{{gen}}.txt"), "w") as f:
+    f.write("ok")
+"""
+
+
+def _launch(script_text, tmp_path, nprocs=2, max_restarts=2):
+    script = tmp_path / "worker.py"
+    script.write_text(script_text.format(repo=REPO, out=str(tmp_path)))
+    cfg = LaunchConfig(nprocs=nprocs, max_restarts=max_restarts,
+                       monitor_interval_s=0.1)
+    agent = ElasticAgent(cfg, [sys.executable, str(script)])
+    return agent.run()
+
+
+def test_gang_runs_and_exchanges_via_store(tmp_path):
+    rc = _launch(OK_WORKER, tmp_path, nprocs=3)
+    assert rc == 0
+    for r in range(3):
+        content = (tmp_path / f"rank{r}.txt").read_text()
+        assert content == f"{r}/3 gen=0"
+
+
+def test_gang_restart_on_failure(tmp_path):
+    rc = _launch(FLAKY_WORKER, tmp_path, nprocs=2)
+    assert rc == 0
+    # generation 1 completed for every rank (whole-gang restart)
+    assert (tmp_path / "rank0-gen1.txt").exists()
+    assert (tmp_path / "rank1-gen1.txt").exists()
+    # generation 0: rank 1 died before writing; rank 0 was killed with the gang
+    assert not (tmp_path / "rank1-gen0.txt").exists()
+
+
+def test_restart_budget_exhausted(tmp_path):
+    always_fail = (
+        "import sys\nsys.exit(3)\n"
+    )
+    script = tmp_path / "worker.py"
+    script.write_text(always_fail)
+    cfg = LaunchConfig(nprocs=2, max_restarts=1, monitor_interval_s=0.1)
+    rc = ElasticAgent(cfg, [sys.executable, str(script)]).run()
+    assert rc == 3
+
+
+def test_multinode_gang_restart(tmp_path):
+    """nnodes=2 on localhost: a failure on node 1 must restart BOTH nodes'
+    gangs (whole-job restart, not per-node)."""
+    import socket
+    import threading
+
+    script = tmp_path / "worker.py"
+    script.write_text(FLAKY_WORKER.format(repo=REPO, out=str(tmp_path)))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+
+    rcs = {}
+
+    def agent(node_rank):
+        cfg = LaunchConfig(nprocs=1, max_restarts=2, monitor_interval_s=0.1,
+                           nnodes=2, node_rank=node_rank,
+                           master_addr="127.0.0.1", store_port=port)
+        rcs[node_rank] = ElasticAgent(
+            cfg, [sys.executable, str(script)]).run()
+
+    threads = [threading.Thread(target=agent, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert rcs == {0: 0, 1: 0}
+    # gen 1 completed on BOTH nodes (ranks 0 and 1)
+    assert (tmp_path / "rank0-gen1.txt").exists()
+    assert (tmp_path / "rank1-gen1.txt").exists()
+    # node 0's gen-0 worker was killed by the cross-node restart before
+    # writing (it sleeps on the barrier only in OK_WORKER; FLAKY_WORKER's
+    # rank 0 writes immediately, so only assert rank1 never wrote gen 0)
+    assert not (tmp_path / "rank1-gen0.txt").exists()
+
+
+def test_cli_smoke(tmp_path):
+    out = tmp_path / "cli.txt"
+    script = tmp_path / "w.py"
+    script.write_text(
+        f"import os\nopen({str(out)!r} + os.environ['PROCESS_ID'], 'w')"
+        ".write('x')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tpurun.py"), "--nprocs", "2",
+         "--", str(script)],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(str(out) + "0") and os.path.exists(str(out) + "1")

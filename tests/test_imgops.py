@@ -1,0 +1,81 @@
+"""Native image-augment kernels (native/imgops.cpp) vs the numpy reference.
+
+The native path must be bit-compatible (to float32 rounding) with the numpy
+reflect-pad/crop/flip/normalize it replaces — the U8ImageDataset fallback
+contract (both are 'the same augment', SURVEY C17).
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_train_tpu.native import imgops
+
+pytestmark = pytest.mark.skipif(
+    not imgops.available(), reason="native imgops build unavailable"
+)
+
+
+def _numpy_reference(imgs, pad, ys, xs, flips, mean, std):
+    B, H, W, C = imgs.shape
+    f = imgs.astype(np.float32)
+    padded = np.pad(f, ((0, 0), (pad,) * 2, (pad,) * 2, (0, 0)), mode="reflect")
+    out = np.empty_like(f)
+    for i in range(B):
+        img = padded[i, ys[i]:ys[i] + H, xs[i]:xs[i] + W]
+        out[i] = img[:, ::-1] if flips[i] else img
+    return (out / 255.0 - mean) / std
+
+
+def test_augment_matches_numpy():
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (16, 32, 32, 3), np.uint8)
+    ys = rng.integers(0, 9, size=16).astype(np.int32)
+    xs = rng.integers(0, 9, size=16).astype(np.int32)
+    flips = rng.random(16) < 0.5
+    mean = np.array([0.5, 0.4, 0.3], np.float32)
+    std = np.array([0.2, 0.25, 0.3], np.float32)
+    got = imgops.augment_batch(imgs, 4, ys, xs, flips, mean, std)
+    want = _numpy_reference(imgs, 4, ys, xs, flips, mean, std)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_augment_edge_offsets():
+    """Offsets 0 and 2*pad exercise the full reflection range."""
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, (4, 16, 16, 3), np.uint8)
+    ys = np.array([0, 8, 0, 8], np.int32)
+    xs = np.array([0, 0, 8, 8], np.int32)
+    flips = np.array([0, 1, 0, 1], bool)
+    mean = np.zeros(3, np.float32)
+    std = np.ones(3, np.float32)
+    got = imgops.augment_batch(imgs, 4, ys, xs, flips, mean, std)
+    want = _numpy_reference(imgs, 4, ys, xs, flips, mean, std)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_normalize_matches_numpy():
+    rng = np.random.default_rng(2)
+    imgs = rng.integers(0, 256, (8, 24, 24, 3), np.uint8)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    got = imgops.normalize_batch(imgs, mean, std)
+    want = (imgs.astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_u8_dataset_native_equals_fallback(monkeypatch):
+    """U8ImageDataset yields identical batches with and without the native
+    path (same rng consumption order)."""
+    from pytorch_distributed_train_tpu.data import datasets as ds
+
+    rng_data = np.random.default_rng(3)
+    imgs = rng_data.integers(0, 256, (32, 32, 32, 3), np.uint8)
+    labels = np.arange(32, dtype=np.int32)
+    d = ds.U8ImageDataset(imgs, labels, ds.CIFAR_MEAN, ds.CIFAR_STD,
+                          augment=True)
+    idx = np.arange(0, 32, 2)
+    native = d.get_batch(idx, np.random.default_rng(7), train=True)
+    monkeypatch.setattr(imgops, "available", lambda: False)
+    fallback = d.get_batch(idx, np.random.default_rng(7), train=True)
+    np.testing.assert_allclose(native["image"], fallback["image"], atol=1e-5)
+    np.testing.assert_array_equal(native["label"], fallback["label"])

@@ -1,0 +1,90 @@
+"""Native rendezvous store (native/store.cpp via ctypes) — the TCPStore
+analogue (SURVEY C5). Exercises the same surface c10d's store tests cover:
+set/get, blocking get, atomic add, wait timeout, and the two-phase barrier.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pytorch_distributed_train_tpu.native.store import StoreClient, StoreServer
+
+
+@pytest.fixture()
+def server():
+    with StoreServer() as s:
+        yield s
+
+
+def test_set_get_roundtrip(server):
+    with StoreClient(port=server.port) as c:
+        c.set("k", b"hello \x00 bytes")
+        assert c.get("k", timeout_ms=1000) == b"hello \x00 bytes"
+        c.set("k", b"overwritten")
+        assert c.get("k", timeout_ms=1000) == b"overwritten"
+        assert c.num_keys() == 1
+        c.delete("k")
+        assert c.num_keys() == 0
+
+
+def test_blocking_get_sees_later_set(server):
+    got = {}
+
+    def reader():
+        with StoreClient(port=server.port) as c:
+            got["v"] = c.get("slow", timeout_ms=5000)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.2)
+    with StoreClient(port=server.port) as c:
+        c.set("slow", b"arrived")
+    t.join(timeout=5)
+    assert got["v"] == b"arrived"
+
+
+def test_get_timeout(server):
+    with StoreClient(port=server.port) as c:
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            c.get("never", timeout_ms=300)
+        assert 0.2 < time.time() - t0 < 3.0
+
+
+def test_atomic_add_many_clients(server):
+    N, per = 8, 25
+
+    def bump():
+        with StoreClient(port=server.port) as c:
+            for _ in range(per):
+                c.add("ctr", 1)
+
+    threads = [threading.Thread(target=bump) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with StoreClient(port=server.port) as c:
+        assert c.add("ctr", 0) == N * per
+
+
+def test_barrier(server):
+    world = 4
+    order = []
+
+    def worker(rank):
+        with StoreClient(port=server.port) as c:
+            if rank == 0:
+                time.sleep(0.3)  # straggler: nobody may pass before it
+            c.barrier("b1", world, rank, timeout_ms=5000)
+            order.append(time.time())
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(order) == world
+    assert min(order) - t0 > 0.25  # all waited for the straggler

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""tpurun — launch a gang of training workers with restart supervision.
+
+Usage:
+    python tpurun.py --nprocs 4 -- train.py --config llama2_7b ...
+
+The torchrun analogue (SURVEY C10): native rendezvous store + whole-gang
+restart from the latest checkpoint. See pytorch_distributed_train_tpu/elastic.py.
+"""
+
+import sys
+
+from pytorch_distributed_train_tpu.elastic import main
+
+if __name__ == "__main__":
+    sys.exit(main())
