@@ -110,6 +110,10 @@ class OptimConfig:
     eps: float = 1e-8
     grad_clip_norm: float = 0.0  # 0 → off
     accum_steps: int = 1  # optax.MultiSteps microbatching (≡ DDP no_sync)
+    # Grad-compression hook (SURVEY C8 ddp_comm_hooks equivalent):
+    # "none" | "bf16" | "fp16" | "powersgd" (grad_hooks.py)
+    grad_hook: str = "none"
+    powersgd_rank: int = 2
     # Final LR fraction for cosine
     end_lr_factor: float = 0.0
 
@@ -182,6 +186,11 @@ class ObsConfig:
     debug_nans: bool = False
     # Cross-host input-divergence check cadence (0 → off); SURVEY §5.2
     check_input_sync_every: int = 0
+    # Fault injection (SURVEY §5.3c): hard-kill this process when the step
+    # counter reaches this value — but only in restart generation 0, so a
+    # tpurun-supervised job crashes exactly once and must recover through
+    # checkpoint resume. 0 → off. Test hook; no effect on saved state.
+    fault_inject_at_step: int = 0
 
 
 @dataclass

@@ -1,0 +1,126 @@
+"""Gradient-compression hooks as optax transforms (SURVEY C8).
+
+The reference's DDP comm hooks (torch:distributed/algorithms/ddp_comm_hooks/
+default_hooks.py fp16_compress_hook, powerSGD_hook.py) intercept each grad
+bucket before its NCCL all-reduce: cast to half precision, or project to a
+rank-r factorization with error feedback, then communicate the compressed
+form. On TPU the gradient collectives are placed by GSPMD inside the
+compiled step, so the hook point moves: these transforms run at the same
+algorithmic position (on the gradient, before clipping and the optimizer)
+and reproduce the hooks' numerics — the quantization/low-rank error and the
+error-feedback correction the model actually trains under. The wire-format
+saving of the torch hooks is an NCCL-runtime concern with no analogue here;
+XLA already fuses grad reduction into the backward schedule.
+
+Use via ``OptimConfig.grad_hook``: "none" | "bf16" | "fp16" | "powersgd".
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def compress(dtype: str) -> optax.GradientTransformation:
+    """Half-precision compression: grad → dtype → fp32 (the fp16/bf16
+    compress hook's quantization, default_hooks.py)."""
+    target = jnp.dtype(dtype)
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        updates = jax.tree.map(
+            lambda g: g.astype(target).astype(jnp.float32), updates
+        )
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class PowerSGDState(NamedTuple):
+    q: dict  # per-leaf rank-r right factors (None for passthrough leaves)
+    error: dict  # per-leaf error-feedback residuals
+
+
+def _is_matrix(shape: tuple[int, ...]) -> bool:
+    return len(shape) >= 2 and min(shape[0], int(np.prod(shape[1:]))) > 1
+
+
+def _as_2d(g: jnp.ndarray) -> jnp.ndarray:
+    return g.reshape(g.shape[0], -1)
+
+
+def powersgd(rank: int = 2, seed: int = 0) -> optax.GradientTransformation:
+    """PowerSGD low-rank compression with error feedback (powerSGD_hook.py,
+    after Vogels et al. 2019).
+
+    Per matrix-shaped grad G (m×n, reshaped from the leaf): with persistent
+    right factor Q (n×r), one subspace-iteration step
+        P = orth(（G+e) Q);  Q' = (G+e)ᵀ P;  Ĝ = P Q'ᵀ;  e' = (G+e) − Ĝ
+    replaces G by its rank-r approximation Ĝ; the residual e carries the
+    compression error into the next step (what makes PowerSGD converge).
+    Vectors/scalars pass through uncompressed, as in the torch hook.
+    """
+
+    def init_fn(params):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = jax.random.PRNGKey(seed)
+        keys = jax.random.split(key, max(len(leaves), 1))
+        qs, errs = [], []
+        for k, p in zip(keys, leaves):
+            if _is_matrix(p.shape):
+                n = int(np.prod(p.shape[1:]))
+                r = min(rank, p.shape[0], n)
+                qs.append(jax.random.normal(k, (n, r), jnp.float32))
+                errs.append(jnp.zeros(p.shape, jnp.float32))
+            else:
+                qs.append(None)
+                errs.append(None)
+        return PowerSGDState(
+            q=jax.tree_util.tree_unflatten(treedef, qs),
+            error=jax.tree_util.tree_unflatten(treedef, errs),
+        )
+
+    def _one(g, q, e):
+        if q is None:
+            return g, None, None
+        g2 = _as_2d(g.astype(jnp.float32)) + _as_2d(e)
+        p = g2 @ q  # (m, r)
+        p, _ = jnp.linalg.qr(p)  # orthonormalize (the hook's Gram-Schmidt)
+        q_new = g2.T @ p  # (n, r)
+        g_hat = p @ q_new.T
+        e_new = (g2 - g_hat).reshape(g.shape)
+        return g_hat.reshape(g.shape).astype(g.dtype), q_new, e_new
+
+    def update_fn(updates, state, params=None):
+        del params
+        u_leaves, treedef = jax.tree_util.tree_flatten(updates)
+        q_leaves = treedef.flatten_up_to(state.q)
+        e_leaves = treedef.flatten_up_to(state.error)
+        outs = [_one(g, q, e) for g, q, e in zip(u_leaves, q_leaves, e_leaves)]
+        new_u = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+        new_q = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+        new_e = jax.tree_util.tree_unflatten(treedef, [o[2] for o in outs])
+        return new_u, PowerSGDState(q=new_q, error=new_e)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def get_hook(name: str, *, powersgd_rank: int = 2,
+             seed: int = 0) -> optax.GradientTransformation | None:
+    if name in ("", "none"):
+        return None
+    if name in ("bf16", "bfloat16"):
+        return compress("bfloat16")
+    if name in ("fp16", "float16"):
+        return compress("float16")
+    if name == "powersgd":
+        return powersgd(rank=powersgd_rank, seed=seed)
+    raise ValueError(f"unknown grad_hook {name!r}")

@@ -69,6 +69,17 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
         max(1, steps_per_epoch // accum) if steps_per_epoch else 0,
     )
     parts = []
+    # Comm-hook analogue (SURVEY C8): compression runs where the DDP hook
+    # did — on the raw gradient, before clipping and the optimizer.
+    hook = None
+    if getattr(opt_cfg, "grad_hook", "none") not in ("", "none"):
+        from pytorch_distributed_train_tpu import grad_hooks
+
+        hook = grad_hooks.get_hook(
+            opt_cfg.grad_hook, powersgd_rank=opt_cfg.powersgd_rank
+        )
+    if hook is not None:
+        parts.append(hook)
     if opt_cfg.grad_clip_norm > 0:
         parts.append(optax.clip_by_global_norm(opt_cfg.grad_clip_norm))
 
