@@ -1,0 +1,138 @@
+"""Checkpoint interop: flax params ↔ torch-layout safetensors.
+
+SURVEY hard part #2: the reference promises "the same config and checkpoint
+interface" — a torch user must be able to read our weights and vice versa.
+Orbax remains the native training checkpoint (sharded, async — SURVEY §5.4);
+this module is the BRIDGE format: a single safetensors file whose tensors
+use torch conventions so `safetensors.torch.load_file` yields a plain
+state_dict:
+
+- names: '/'-joined flax paths → dotted; ``kernel``→``weight``,
+  ``scale``→``weight``, ``embedding``→``weight``, ``bias`` stays
+  (torch:serialization.py state_dict naming, nn.Linear/Conv2d/LayerNorm).
+- layouts: Dense (in, out) → Linear (out, in); Conv HWIO → Conv2d OIHW;
+  DenseGeneral 3-D kernels flatten their head dims then transpose like a
+  Linear (matching how HF exports fused attention projections).
+
+Every transform is recorded in the safetensors metadata header, so
+``load_flax_safetensors`` inverts the export EXACTLY (lossless round-trip)
+without re-deriving model structure — foreign checkpoints with torch names
+import through the same inverse as long as shapes match the template tree.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _path_str(path) -> str:
+    from pytorch_distributed_train_tpu.parallel.partition import path_name
+
+    return path_name(path)
+
+
+def _plan(name: str, shape: tuple[int, ...]) -> tuple[str, str]:
+    """(flax path, shape) → (torch state_dict name, transform tag)."""
+    parts = name.split("/")
+    leaf = parts[-1]
+    transform = "none"
+    if leaf == "kernel":
+        torch_leaf = "weight"
+        if len(shape) == 2:
+            transform = "dense_T"  # (in, out) → (out, in)
+        elif len(shape) == 4:
+            transform = "conv_oihw"  # HWIO → OIHW
+        elif len(shape) == 3:
+            # DenseGeneral. Output-fused (in, h, d) flattens the head dims;
+            # input-fused (h, d, out) — the o_proj orientation — flattens
+            # the first two. The metadata-recorded original shape makes the
+            # inverse exact either way.
+            if name.endswith(("o_proj/kernel", "attn_out/kernel")):
+                transform = "dgen_in3"  # (h, d, out) → (out, h·d)
+            else:
+                transform = "dgen_out3"  # (in, h, d) → (h·d, in)
+    elif leaf in ("scale", "embedding"):
+        torch_leaf = "weight"
+    else:
+        torch_leaf = leaf
+    torch_name = (".".join(parts[:-1] + [torch_leaf])
+                  if len(parts) > 1 else torch_leaf)
+    return torch_name, transform
+
+
+def _to_torch(arr: np.ndarray, transform: str) -> np.ndarray:
+    if transform == "dense_T":
+        arr = arr.T
+    elif transform == "conv_oihw":
+        arr = arr.transpose(3, 2, 0, 1)
+    elif transform == "dgen_in3":
+        arr = arr.reshape(-1, arr.shape[2]).T
+    elif transform == "dgen_out3":
+        arr = arr.reshape(arr.shape[0], -1).T
+    return np.ascontiguousarray(arr)
+
+
+def _from_torch(arr: np.ndarray, transform: str,
+                shape: tuple[int, ...]) -> np.ndarray:
+    if transform == "dense_T":
+        out = arr.T
+    elif transform == "conv_oihw":
+        out = arr.transpose(2, 3, 1, 0)
+    elif transform in ("dgen_in3", "dgen_out3"):
+        out = arr.T.reshape(shape)
+    else:
+        out = arr.reshape(shape)
+    return np.ascontiguousarray(out)
+
+
+def save_torch_safetensors(params: Any, path: str) -> None:
+    """Export a flax param tree as a torch-state_dict-style safetensors file."""
+    from safetensors.numpy import save_file
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    tensors: dict[str, np.ndarray] = {}
+    metas: dict[str, dict] = {}
+    for p, leaf in flat:
+        name = _path_str(p)
+        arr = np.asarray(jax.device_get(leaf))
+        tname, transform = _plan(name, arr.shape)
+        if tname in tensors:
+            raise ValueError(f"torch name collision: {tname}")
+        tensors[tname] = _to_torch(arr, transform)
+        metas[tname] = {"flax_name": name, "shape": list(arr.shape),
+                        "transform": transform}
+    save_file(tensors, path, metadata={"interop": json.dumps(metas)})
+
+
+def load_flax_safetensors(path: str, template: Any) -> Any:
+    """Import a (torch-layout) safetensors file into ``template``'s tree
+    structure. ``template`` may hold arrays or ShapeDtypeStructs — only
+    shapes/dtypes are read. Uses the export metadata when present; foreign
+    torch files fall back to the template-derived plan."""
+    from safetensors import safe_open
+
+    with safe_open(path, framework="numpy") as f:
+        file_meta = f.metadata() or {}
+        metas = json.loads(file_meta.get("interop", "{}"))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        leaves = []
+        for p, leaf in flat:
+            name = _path_str(p)
+            shape = tuple(leaf.shape)
+            tname, transform = _plan(name, shape)
+            meta = metas.get(tname)
+            if meta is not None:
+                transform = meta["transform"]
+                shape = tuple(meta["shape"])
+            arr = _from_torch(f.get_tensor(tname), transform, shape)
+            if arr.shape != tuple(leaf.shape):
+                raise ValueError(
+                    f"{tname}: restored shape {arr.shape} != template "
+                    f"{tuple(leaf.shape)}"
+                )
+            leaves.append(arr.astype(np.dtype(leaf.dtype)))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

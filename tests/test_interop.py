@@ -1,0 +1,109 @@
+"""Checkpoint interop bridge (interop.py — SURVEY hard part #2).
+
+Round-trip losslessness, torch-side legibility (safetensors.torch loads it
+as a state_dict with Linear/Conv2d layouts), and cross-framework numerics:
+weights exported from flax, loaded into an equivalent torch module, must
+produce the same forward output.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+from pytorch_distributed_train_tpu.interop import (
+    load_flax_safetensors,
+    save_torch_safetensors,
+)
+from pytorch_distributed_train_tpu.models.registry import build_model
+
+P32 = PrecisionConfig()
+
+
+def _tree_equal(a, b):
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(a),
+        jax.tree_util.tree_leaves_with_path(b),
+    ):
+        assert jax.tree_util.keystr(pa) == jax.tree_util.keystr(pb)
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=jax.tree_util.keystr(pa))
+
+
+def test_roundtrip_resnet(tmp_path):
+    cfg = ModelConfig(name="resnet18", num_classes=10, image_size=32)
+    model = build_model(cfg, P32)
+    v = model.init({"params": jax.random.PRNGKey(0)},
+                   jnp.zeros((1, 32, 32, 3)), train=False)
+    path = str(tmp_path / "resnet.safetensors")
+    save_torch_safetensors(v["params"], path)
+    restored = load_flax_safetensors(path, v["params"])
+    _tree_equal(v["params"], restored)
+
+
+def test_roundtrip_llama_with_template_shapes(tmp_path):
+    cfg = ModelConfig(name="llama", vocab_size=64, hidden_size=32,
+                      num_layers=2, num_heads=4, num_kv_heads=2, mlp_dim=64,
+                      max_seq_len=16)
+    model = build_model(cfg, P32)
+    v = model.init({"params": jax.random.PRNGKey(1)},
+                   jnp.zeros((1, 16), jnp.int32), train=False)
+    path = str(tmp_path / "llama.safetensors")
+    save_torch_safetensors(v["params"], path)
+    template = jax.eval_shape(lambda: v["params"])  # ShapeDtypeStructs
+    restored = load_flax_safetensors(path, template)
+    _tree_equal(v["params"], restored)
+
+
+def test_torch_reads_linear_and_conv_layouts(tmp_path):
+    """The exported file must be a legible torch state_dict: names dotted,
+    Linear (out,in), Conv2d OIHW."""
+    from safetensors.torch import load_file
+
+    cfg = ModelConfig(name="resnet18", num_classes=10, image_size=32)
+    model = build_model(cfg, P32)
+    v = model.init({"params": jax.random.PRNGKey(0)},
+                   jnp.zeros((1, 32, 32, 3)), train=False)
+    path = str(tmp_path / "m.safetensors")
+    save_torch_safetensors(v["params"], path)
+    sd = load_file(path)
+    # stem conv OIHW: input channels (3, RGB) land in dim 1
+    stem = sd["conv_stem.weight"]
+    assert stem.ndim == 4 and stem.shape[1] == 3, tuple(stem.shape)
+    assert stem.shape[2] == stem.shape[3]  # square kernel trailing (HW)
+    # classifier: flax (512,10) → torch Linear (10,512)
+    fc = [k for k, t in sd.items() if t.ndim == 2 and t.shape[0] == 10]
+    assert fc and tuple(sd[fc[0]].shape) == (10, 512)
+    assert all("." in k and "/" not in k for k in sd)
+
+
+def test_cross_framework_forward_parity(tmp_path):
+    """flax Dense stack → safetensors → torch.nn module: same outputs."""
+    import flax.linen as nn
+    import torch
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(16, name="fc1")(x)
+            x = nn.relu(x)
+            return nn.Dense(4, name="fc2")(x)
+
+    model = Tiny()
+    x = np.random.default_rng(0).standard_normal((8, 12)).astype(np.float32)
+    v = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    want = np.asarray(model.apply(v, jnp.asarray(x)))
+
+    path = str(tmp_path / "tiny.safetensors")
+    save_torch_safetensors(v["params"], path)
+
+    tmodel = torch.nn.Sequential()
+    tmodel.add_module("fc1", torch.nn.Linear(12, 16))
+    tmodel.add_module("relu", torch.nn.ReLU())
+    tmodel.add_module("fc2", torch.nn.Linear(16, 4))
+    from safetensors.torch import load_file
+
+    tmodel.load_state_dict(load_file(path))
+    with torch.no_grad():
+        got = tmodel(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
