@@ -69,8 +69,14 @@ class HostDataLoader:
             return n // self.host_batch
         return (n + self.host_batch - 1) // self.host_batch
 
-    def epoch(self, epoch: int) -> Iterator[dict]:
-        """Yield host-local numpy batches for one epoch."""
+    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
+        """Yield host-local numpy batches for one epoch.
+
+        ``start_batch`` fast-forwards a mid-epoch resume: the per-batch rng
+        is seeded by (seed, epoch, batch-index, host), so batch b is
+        identical whether or not batches before it were materialized — the
+        resumed stream continues exactly where the crashed run stopped
+        (stronger than the reference, which replays the epoch)."""
         self.sampler.set_epoch(epoch)
         idx = self.sampler.indices()
         n_steps = self.steps_per_epoch
@@ -80,7 +86,7 @@ class HostDataLoader:
             need = n_steps * self.host_batch
             if len(idx) < need:
                 idx = np.concatenate([idx, idx[: need - len(idx)]])
-        for b in range(n_steps):
+        for b in range(start_batch, n_steps):
             chunk = idx[b * self.host_batch : (b + 1) * self.host_batch]
             rng = np.random.default_rng(
                 np.random.SeedSequence((self.seed, epoch, b, self.host_id))
@@ -208,8 +214,8 @@ def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
     """
     loader = HostDataLoader(dataset, data_cfg, train=train)
 
-    def epoch_fn(epoch: int) -> Iterator[dict]:
-        host_iter = iter(_Producer(loader.epoch(epoch),
+    def epoch_fn(epoch: int, start_batch: int = 0) -> Iterator[dict]:
+        host_iter = iter(_Producer(loader.epoch(epoch, start_batch),
                                    depth=max(2, data_cfg.prefetch)))
         if sync_check_every:
             from pytorch_distributed_train_tpu.utils.debug import check_input_sync

@@ -156,6 +156,14 @@ class ElasticAgent:
             return 1
         finally:
             if self.agent_client is not None:
+                # Node 0 hosts the store every other agent is still polling:
+                # meet before teardown, else their clients die mid-request.
+                try:
+                    self.agent_client.barrier(
+                        "agents/exit", self.cfg.nnodes, self.cfg.node_rank,
+                        timeout_ms=60_000)
+                except Exception:
+                    pass  # a dead peer must not wedge shutdown
                 self.agent_client.close()
             if self.server is not None:
                 self.server.stop()

@@ -172,7 +172,13 @@ class Trainer:
         try:
             while step < limit:
                 self.recorder.record("epoch_start", step, epoch=epoch)
-                for batch in self.train_epoch_fn(epoch):
+                # Mid-epoch resume: continue the epoch's batch stream at the
+                # restored step's offset instead of replaying it (the
+                # per-batch rng seeding makes this exact — data/pipeline.py).
+                start_b = max(0, step - epoch * self.steps_per_epoch)
+                if start_b >= self.steps_per_epoch:
+                    start_b = 0  # stale epoch meta; just run a fresh epoch
+                for batch in self.train_epoch_fn(epoch, start_b):
                     if step >= limit:
                         break
                     self._maybe_profile(step)
@@ -180,6 +186,7 @@ class Trainer:
                         self.state, batch, self.step_rng
                     )
                     step = int(self.state.step)  # syncs; acceptable at MVP
+                    self._maybe_inject_fault(step)
                     self.meter.tick()
                     self.heartbeat.beat()
                     self.recorder.record("step", step)
@@ -235,6 +242,18 @@ class Trainer:
         self.logger.log(step, avg, prefix="eval")
         self.meter.reset_clock()
         return avg
+
+    def _maybe_inject_fault(self, step: int) -> None:
+        """SURVEY §5.3c: hard-kill between steps, first generation only —
+        the elastic-recovery test path (no finally-save, no flush; exactly
+        what a real host loss looks like to the launcher)."""
+        import os
+
+        fault = self.cfg.obs.fault_inject_at_step
+        if (fault and step >= fault
+                and os.environ.get("RESTART_GENERATION", "0") == "0"):
+            print(f"[fault-inject] killing process at step {step}", flush=True)
+            os._exit(41)
 
     # ------------------------------------------------------------- profiling
     def _maybe_profile(self, step: int) -> None:
