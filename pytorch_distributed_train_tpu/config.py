@@ -157,6 +157,11 @@ class MeshConfig:
     #   ring    — lax.ppermute KV rotation around the ICI ring; any size
     #   ulysses — all-to-all head↔seq swap; needs heads % context == 0
     context_impl: str = "ring"
+    # Megatron-style sequence parallelism (SURVEY §2.3 SP row): with
+    # tensor>1, shard activations along sequence over the 'tensor' axis
+    # between TP matmuls (norms/residuals run seq-sharded; GSPMD inserts
+    # the all-gather/reduce-scatter pair at the matmul boundaries).
+    sequence_parallel: bool = False
 
 
 @dataclass

@@ -98,6 +98,8 @@ class BertForMLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
+    # SP/CP activation anchoring (parallel/mesh.py ActivationSharding)
+    act: "object | None" = None
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -121,10 +123,8 @@ class BertForMLM(nn.Module):
                          name="embed_ln")(x)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         x = x.astype(self.dtype)
-        if self.cp is not None and self.cp.active:
-            x = jax.lax.with_sharding_constraint(
-                x, self.cp.activation_sharding(x.ndim)
-            )
+        if self.act is not None:
+            x = self.act.constrain(x)
 
         if attention_mask is None:
             pad_mask = None
@@ -137,6 +137,8 @@ class BertForMLM(nn.Module):
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
                 self.dtype, self.param_dtype, cp=self.cp, name=f"layer{i}",
             )(x, pad_mask)
+            if self.act is not None:
+                x = self.act.constrain(x)
 
         # MLM head: dense + GELU + LN, then decode against tied word embeddings.
         h = nn.Dense(self.hidden_size, dtype=self.dtype,
@@ -151,9 +153,10 @@ class BertForMLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def bert_base(cfg, dtype, param_dtype, cp=None) -> BertForMLM:
+def bert_base(cfg, dtype, param_dtype, cp=None, act=None) -> BertForMLM:
     return BertForMLM(
         cp=cp,
+        act=act,
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

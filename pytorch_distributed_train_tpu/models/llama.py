@@ -161,6 +161,11 @@ class LlamaForCausalLM(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None
+    # SP/CP activation anchoring (parallel/mesh.py ActivationSharding):
+    # keeps norms/residuals seq-sharded between attention / TP-matmul
+    # regions — CP without it replicates seq outside the shard_map regions;
+    # SP (Megatron SequenceParallel) IS this constraint.
+    act: "object | None" = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -170,13 +175,8 @@ class LlamaForCausalLM(nn.Module):
             embedding_init=nn.initializers.normal(0.02),
             param_dtype=self.param_dtype, name="tok_embed",
         )(input_ids).astype(self.dtype)
-        if self.cp is not None and self.cp.active:
-            # Keep everything between attentions seq-sharded: without this
-            # GSPMD may replicate the seq dim outside the shard_map regions
-            # and each device would run full-sequence norms/MLPs.
-            x = jax.lax.with_sharding_constraint(
-                x, self.cp.activation_sharding(x.ndim)
-            )
+        if self.act is not None:
+            x = self.act.constrain(x)
 
         block_cls = nn.remat(LlamaBlock) if self.remat else LlamaBlock
         for i in range(self.num_layers):
@@ -188,6 +188,8 @@ class LlamaForCausalLM(nn.Module):
                 self.dtype, self.param_dtype, cp=self.cp, moe=moe,
                 name=f"layer{i}",
             )(x)
+            if self.act is not None:
+                x = self.act.constrain(x)
 
         x = RMSNorm(self.rms_norm_eps, name="final_norm")(x)
         logits = nn.Dense(
@@ -198,7 +200,7 @@ class LlamaForCausalLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def llama(cfg, dtype, param_dtype, cp=None) -> LlamaForCausalLM:
+def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
     moe = None
     if getattr(cfg, "num_experts", 0) > 1:
         from pytorch_distributed_train_tpu.ops.moe import MoeSpec
@@ -214,6 +216,7 @@ def llama(cfg, dtype, param_dtype, cp=None) -> LlamaForCausalLM:
     return LlamaForCausalLM(
         cp=cp,
         moe=moe,
+        act=act,
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

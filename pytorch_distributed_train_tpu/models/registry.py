@@ -73,6 +73,13 @@ def build_model(model_cfg, precision_cfg, mesh=None, mesh_cfg=None):
         if mesh is None:
             raise ValueError("model 'llama_pp' needs a mesh (stage axis)")
         return _REGISTRY[name](model_cfg, dtype, param_dtype, cp=cp, mesh=mesh)
+    if name.startswith(("llama", "bert")):
+        from pytorch_distributed_train_tpu.parallel.mesh import (
+            activation_sharding_for,
+        )
+
+        act = activation_sharding_for(mesh, mesh_cfg)
+        return _REGISTRY[name](model_cfg, dtype, param_dtype, cp=cp, act=act)
     return _REGISTRY[name](model_cfg, dtype, param_dtype, cp=cp)
 
 

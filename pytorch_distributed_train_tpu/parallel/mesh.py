@@ -9,6 +9,7 @@ parallelism strategy becomes pure config (SURVEY §7.2).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Sequence
 
 import jax
@@ -65,6 +66,56 @@ def build_mesh(mesh_cfg=None, devices: Sequence[jax.Device] | None = None) -> Me
         sizes = mesh_shape_from_config(mesh_cfg, devices.size)
     shape = tuple(sizes[ax] for ax in MESH_AXES)
     return Mesh(devices.reshape(shape), MESH_AXES)
+
+
+@dataclasses.dataclass(frozen=True)
+class ActivationSharding:
+    """Sequence-dim activation anchoring for SP / CP (SURVEY §2.3 SP row).
+
+    Megatron-style sequence parallelism shards the activations BETWEEN
+    tensor-parallel matmuls (norms, residuals, dropout) along the sequence
+    dim; re-entering a TP matmul then costs an all-gather and leaving it a
+    reduce-scatter — exactly the Megatron SP communication pattern, except
+    GSPMD inserts the collectives from these constraints instead of the
+    module rewrites torch uses (torch:distributed/tensor/parallel/style.py
+    SequenceParallel). ``seq_axes`` may combine 'context' (ring/Ulysses CP)
+    with 'tensor' (SP): the sequence dim then shards over both.
+    """
+
+    mesh: Mesh
+    seq_axes: tuple[str, ...]
+    batch_axes: tuple[str, ...] = ("data", "fsdp")
+
+    def constrain(self, x):
+        """Anchor (B, S, ...) activations; no-op when S can't divide."""
+        import jax
+
+        n = int(np.prod([self.mesh.shape[a] for a in self.seq_axes]))
+        if x.ndim < 2 or x.shape[1] % n != 0 or x.shape[0] % max(
+            int(np.prod([self.mesh.shape[a] for a in self.batch_axes])), 1
+        ) != 0:
+            return x
+        spec = PartitionSpec(tuple(self.batch_axes), tuple(self.seq_axes),
+                             *([None] * (x.ndim - 2)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec)
+        )
+
+
+def activation_sharding_for(mesh: Mesh, mesh_cfg) -> "ActivationSharding | None":
+    """SP/CP activation anchoring implied by the mesh config, or None."""
+    if mesh is None or mesh_cfg is None:
+        return None
+    seq_axes = []
+    if mesh.shape.get("context", 1) > 1:
+        seq_axes.append("context")
+    if (getattr(mesh_cfg, "sequence_parallel", False)
+            and mesh.shape.get("tensor", 1) > 1):
+        seq_axes.append("tensor")
+    if not seq_axes:
+        return None
+    return ActivationSharding(mesh, tuple(seq_axes),
+                              tuple(mesh_cfg.batch_axes))
 
 
 def batch_pspec(batch_axes: Sequence[str] = ("data", "fsdp")) -> PartitionSpec:
