@@ -1,17 +1,24 @@
 #!/usr/bin/env python
-"""bench.py — north-star benchmark: ResNet-50 ImageNet-shape training
-throughput, images/sec/chip (BASELINE.json:2).
+"""bench.py — training-throughput benchmarks on the local TPU chip(s).
 
-Prints ONE JSON line:
+Default (the north-star, BASELINE.json:2): ResNet-50 ImageNet-shape
+training, images/sec/chip. Prints ONE JSON line:
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
    "unit": "images/sec/chip", "vs_baseline": R}
 
 vs_baseline compares against the first measured value recorded in
 BENCH_BASELINE.json (the reference publishes no numbers — BASELINE.md
 policy: first instrumented run IS the baseline, ratio 1.0 that round).
+Only the default configuration seeds/reads the baseline ratio; other
+models/shapes report vs_baseline against their own recorded key when
+present, else 1.0.
+
+Secondary modes: ``--model llama`` / ``--model bert_base`` measure
+tokens/sec/chip on a ~1B-param Llama (or BERT-base MLM) with the same
+machinery.
 
 Methodology: synthetic data (isolates device throughput from disk),
-bf16 compute policy, full train step (fwd+bwd+SGD update) on all local
+bf16 compute policy, full train step (fwd+bwd+optimizer) on all local
 devices. Timing enqueues `--steps` steps back-to-back and then fetches the
 final step's loss VALUE: the loss depends on the (donated) state chain, so
 the fetch forces every enqueued step to have executed. This measures
@@ -28,14 +35,19 @@ import json
 import os
 import time
 
+VISION = ("resnet18", "resnet50", "vit_b16")
+
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--batch-per-chip", type=int, default=128)
+    p.add_argument("--model", default="resnet50",
+                   help="resnet18|resnet50|vit_b16|llama|bert_base")
+    p.add_argument("--batch-per-chip", type=int, default=0,
+                   help="0 → model default (128 vision, 8 llama, 32 bert)")
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--model", default="resnet50")
     args = p.parse_args()
 
     import jax
@@ -57,20 +69,52 @@ def main() -> None:
     from pytorch_distributed_train_tpu.train_state import TrainState
 
     n_chips = jax.device_count()
-    mesh = build_mesh(MeshConfig(data=-1, fsdp=1, tensor=1, context=1))
-    model_cfg = ModelConfig(name=args.model, num_classes=1000,
-                            image_size=args.image_size)
+    mesh = build_mesh(MeshConfig(data=-1))
+    vision = args.model in VISION
+
+    if vision:
+        model_cfg = ModelConfig(name=args.model, num_classes=1000,
+                                image_size=args.image_size)
+        loss_name = "softmax_xent"
+        opt = OptimConfig(name="momentum", learning_rate=0.1,
+                          schedule="constant", warmup_steps=0)
+        bpc = args.batch_per_chip or 128
+    elif args.model == "llama":
+        # ~1.1B params: the largest shape that trains comfortably on one
+        # v5e chip's HBM with remat; scales out via mesh config in train.py.
+        model_cfg = ModelConfig(
+            name="llama", vocab_size=32000, hidden_size=2048, num_layers=16,
+            num_heads=16, num_kv_heads=16, mlp_dim=5504,
+            max_seq_len=args.seq_len, remat=True,
+        )
+        loss_name = "causal_lm_xent"
+        opt = OptimConfig(name="adamw", learning_rate=3e-4,
+                          schedule="constant", warmup_steps=0)
+        bpc = args.batch_per_chip or 8
+    elif args.model == "bert_base":
+        model_cfg = ModelConfig(
+            name="bert_base", vocab_size=30522, hidden_size=768,
+            num_layers=12, num_heads=12, mlp_dim=3072,
+            max_seq_len=min(args.seq_len, 512),
+        )
+        loss_name = "causal_lm_xent"  # plain next-token xent on logits
+        opt = OptimConfig(name="lamb", learning_rate=1e-3,
+                          schedule="constant", warmup_steps=0)
+        bpc = args.batch_per_chip or 32
+    else:
+        raise SystemExit(f"unknown bench model {args.model!r}")
+
     model = build_model(model_cfg, PrecisionConfig(compute_dtype="bfloat16"))
-    tx, _ = make_optimizer(
-        OptimConfig(name="momentum", learning_rate=0.1, schedule="constant",
-                    warmup_steps=0),
-        total_steps=1000,
-    )
+    tx, _ = make_optimizer(opt, total_steps=1000)
     rules = rules_for_model(args.model)
+    seq = model_cfg.max_seq_len
 
     def init_state(rng):
-        x = jnp.zeros((2, args.image_size, args.image_size, 3))
-        variables = model.init({"params": rng}, x, train=False)
+        if vision:
+            dummy = (jnp.zeros((2, args.image_size, args.image_size, 3)),)
+        else:
+            dummy = (jnp.zeros((2, seq), jnp.int32),)
+        variables = model.init({"params": rng}, *dummy, train=False)
         return TrainState.create(params=variables["params"], tx=tx,
                                  batch_stats=variables.get("batch_stats", {}))
 
@@ -79,21 +123,29 @@ def main() -> None:
     sharding = steps_lib.state_shardings(mesh, rules, shape)
     state = jax.jit(init_state, out_shardings=sharding)(rng)
     step = steps_lib.jit_train_step(
-        steps_lib.make_train_step(model, get_loss_fn("softmax_xent"), tx),
+        steps_lib.make_train_step(model, get_loss_fn(loss_name), tx),
         mesh, sharding,
     )
 
-    global_batch = args.batch_per_chip * n_chips
+    global_batch = bpc * n_chips
     rng_np = np.random.default_rng(0)
-    batch = {
-        "image": jnp.asarray(
-            rng_np.standard_normal(
-                (global_batch, args.image_size, args.image_size, 3)
+    if vision:
+        batch = {
+            "image": jnp.asarray(
+                rng_np.standard_normal(
+                    (global_batch, args.image_size, args.image_size, 3)
+                ),
+                jnp.float32,
             ),
-            jnp.float32,
-        ),
-        "label": jnp.asarray(rng_np.integers(0, 1000, global_batch), jnp.int32),
-    }
+            "label": jnp.asarray(rng_np.integers(0, 1000, global_batch),
+                                 jnp.int32),
+        }
+        items_per_step, unit_noun = global_batch, "images"
+    else:
+        batch = {"input_ids": jnp.asarray(
+            rng_np.integers(0, model_cfg.vocab_size, (global_batch, seq)),
+            jnp.int32)}
+        items_per_step, unit_noun = global_batch * seq, "tokens"
 
     for _ in range(args.warmup):
         state, metrics = step(state, batch, rng)
@@ -107,29 +159,30 @@ def main() -> None:
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
     per_step = wall / args.steps
-    imgs_per_sec = global_batch / per_step
-    per_chip = imgs_per_sec / n_chips
+    per_chip = items_per_step / per_step / n_chips
 
-    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
-    default_run = (args.batch_per_chip == 128 and args.image_size == 224
-                   and args.model == "resnet50")
-    vs = 1.0
+    metric = f"{args.model}_{unit_noun}_per_sec_per_chip"
+    default_run = (vision and args.model == "resnet50"
+                   and args.batch_per_chip in (0, 128)
+                   and args.image_size == 224)
+    baseline_path = os.path.join(os.path.dirname(__file__),
+                                 "BENCH_BASELINE.json")
+    base = {}
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
-            base = json.load(f).get("resnet50_images_per_sec_per_chip")
-        if base:
-            vs = per_chip / base
-    elif default_run:
-        # First measured default run seeds the baseline (BASELINE.md policy);
-        # smoke runs with non-default shapes must not.
+            base = json.load(f)
+    vs = per_chip / base[metric] if base.get(metric) else 1.0
+    if metric not in base and (default_run or not vision):
+        # First measured run of a canonical config seeds its baseline key.
+        base[metric] = per_chip
+        base.setdefault("recorded", time.strftime("%Y-%m-%d"))
         with open(baseline_path, "w") as f:
-            json.dump({"resnet50_images_per_sec_per_chip": per_chip,
-                       "recorded": time.strftime("%Y-%m-%d")}, f)
+            json.dump(base, f, indent=1)
 
     print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": metric,
         "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
+        "unit": f"{unit_noun}/sec/chip",
         "vs_baseline": round(vs, 4),
     }))
 
