@@ -45,14 +45,23 @@ class PipelinedLlama:
                 f"num_layers {cfg.num_layers} not divisible by "
                 f"{S} pipeline stages"
             )
+        moe = None
         if getattr(cfg, "num_experts", 0) > 1:
-            # MoE aux losses can't escape the pipeline's manual region yet
-            # (block.apply runs as a pure function inside scan/shard_map);
-            # fail loudly rather than silently training a dense model.
-            raise ValueError(
-                "llama_pp does not support num_experts>1 — combine MoE "
-                "with the 'llama' model, or stage=1"
+            if cfg.moe_every != 1:
+                # Stacked blocks must share one structure; alternating
+                # dense/MoE layers would need two stacks.
+                raise ValueError(
+                    "llama_pp MoE requires moe_every=1 (every block MoE)"
+                )
+            from pytorch_distributed_train_tpu.ops.moe import MoeSpec
+
+            moe = MoeSpec(
+                num_experts=cfg.num_experts, top_k=cfg.expert_top_k,
+                capacity_factor=cfg.expert_capacity_factor,
+                aux_weight=cfg.moe_aux_weight,
+                zloss_weight=cfg.moe_zloss_weight, every=1,
             )
+        self.moe = moe
         self.cfg = cfg
         self.mesh = mesh
         self.dtype = dtype
@@ -67,7 +76,7 @@ class PipelinedLlama:
         self.block = LlamaBlock(
             cfg.num_heads, cfg.num_kv_heads or cfg.num_heads, cfg.mlp_dim,
             cfg.rope_theta, cfg.max_seq_len, cfg.rms_norm_eps,
-            dtype, param_dtype, cp=cp,
+            dtype, param_dtype, cp=cp, moe=moe,
         )
         self.final_norm = RMSNorm(cfg.rms_norm_eps)
         self.lm_head = nn.Dense(
@@ -104,23 +113,40 @@ class PipelinedLlama:
         x = self.embed.apply({"params": p["tok_embed"]}, input_ids)
         x = x.astype(self.dtype)
 
-        block_apply = self.block.apply
+        moe = self.moe is not None
+
+        def block_apply(vars_, h):
+            if moe:
+                # MoE blocks sow load-balance/z losses; collect them here
+                # and thread the scalar out of the pipeline's manual region.
+                out, vs = self.block.apply(vars_, h, mutable=["losses"])
+                aux = sum(
+                    (jnp.sum(leaf) for leaf in
+                     jax.tree_util.tree_leaves(vs.get("losses", {}))),
+                    start=jnp.float32(0.0),
+                )
+                return out, aux
+            return self.block.apply(vars_, h), jnp.float32(0.0)
+
         if self.cfg.remat:
             block_apply = jax.checkpoint(block_apply)
 
         def stage_fn(blocks_local, h):
             # blocks_local leaves: (layers_per_stage, ...) — scan applies
             # this stage's blocks in stacked order.
-            def body(h, p_one):
-                return block_apply({"params": p_one}, h), None
+            def body(carry, p_one):
+                h, aux = carry
+                h, a = block_apply({"params": p_one}, h)
+                return (h, aux + a), None
 
-            h, _ = jax.lax.scan(body, h, blocks_local)
-            return h
+            (h, aux), _ = jax.lax.scan(body, (h, jnp.float32(0.0)),
+                                       blocks_local)
+            return h, aux
 
         x_mb = pipeline_lib.microbatch(x, self.num_microbatches)
-        h_mb = pipeline_lib.spmd_pipeline(
+        h_mb, aux = pipeline_lib.spmd_pipeline(
             stage_fn, p["blocks"], x_mb,
-            mesh=self.mesh, schedule=self.schedule,
+            mesh=self.mesh, schedule=self.schedule, with_aux=True,
         )
         h = pipeline_lib.unmicrobatch(h_mb)
 
@@ -128,9 +154,11 @@ class PipelinedLlama:
         logits = self.lm_head.apply({"params": p["lm_head"]}, h)
         logits = logits.astype(jnp.float32)
         # Honor the flax mutable contract (steps.apply_model passes a list
-        # of collections in train mode and expects an (out, vars) tuple).
+        # of collections in train mode and expects an (out, vars) tuple);
+        # the pipeline's aux total rides out through the losses collection.
         if mutable:
-            return logits, {}
+            losses = {"losses": {"moe_aux": aux}} if moe else {}
+            return logits, losses
         return logits
 
 

@@ -161,6 +161,13 @@ def llama_pp_rules() -> list[tuple[str, PartitionSpec]]:
         (r"blocks/.*(q_proj|k_proj|v_proj)/kernel$",
          P("stage", "fsdp", "tensor")),
         (r"blocks/.*o_proj/kernel$", P("stage", "tensor", None, "fsdp")),
+        # MoE experts: (L, E, ...) — stage on layers, expert on experts.
+        # Must precede the dense-MLP rules (same projection names).
+        (r"blocks/.*experts/(gate_proj|up_proj)/kernel$",
+         P("stage", "expert", "fsdp", "tensor")),
+        (r"blocks/.*experts/down_proj/kernel$",
+         P("stage", "expert", "tensor", "fsdp")),
+        (r"blocks/.*router/kernel$", P("stage")),
         (r"blocks/.*(gate_proj|up_proj)/kernel$", P("stage", "fsdp", "tensor")),
         (r"blocks/.*down_proj/kernel$", P("stage", "tensor", "fsdp")),
         (r"blocks/.*scale$", P("stage")),

@@ -45,55 +45,71 @@ def num_stages(mesh: Mesh, stage_axis: str = "stage") -> int:
 
 
 def spmd_pipeline(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable,
     stage_params: Any,
     x_mb: jax.Array,
     *,
     mesh: Mesh,
     stage_axis: str = "stage",
     schedule: str = "gpipe",
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Run ``stage_fn`` as an S-stage GPipe/1F1B pipeline over microbatches.
 
     Args:
       stage_fn: ``(local_params, h) -> h`` — applies ONE stage's layers to a
         microbatch of activations. Called inside the manual region; sees its
         stage's shard of ``stage_params`` (leading layer dim divided by S).
+        With ``with_aux=True`` it must return ``(h, aux_scalar)`` — e.g. MoE
+        load-balance losses sown by the stage's blocks.
       stage_params: pytree whose leaves carry a leading stacked-layer dim
         divisible by the stage count; sharded ``P('stage')`` on that dim.
       x_mb: (M, mb, ...) microbatched activations, replicated over 'stage'
         (other mesh axes — batch/tensor sharding — remain under GSPMD).
       schedule: 'gpipe' | '1f1b' (see module docstring).
 
-    Returns (M, mb, ...) outputs of the final stage, replicated over 'stage'.
+    Returns (M, mb, ...) outputs of the final stage, replicated over
+    'stage'; with ``with_aux`` returns ``(outputs, aux)`` where aux is the
+    MEAN over microbatches of the summed per-stage aux (matching the
+    unpipelined model, whose MoE aux is computed once over the full batch).
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     S = num_stages(mesh, stage_axis)
     if S == 1:
-        return _sequential(stage_fn, stage_params, x_mb)
+        return _sequential(stage_fn, stage_params, x_mb, with_aux)
     M = x_mb.shape[0]
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     def run(params_local, xs):
         idx = jax.lax.axis_index(stage_axis)
 
-        def tick(state, x_t):
+        def tick(state, inputs):
+            t, x_t = inputs
             # Stage 0 injects the next microbatch; others consume the
             # activation their neighbor pushed last tick.
             inp = jnp.where(idx == 0, x_t, state)
-            out = stage_fn(params_local, inp)
+            if with_aux:
+                out, aux = stage_fn(params_local, inp)
+                # Bubble ticks run on zero activations — their aux is
+                # garbage. Stage s does real work only at ticks [s, s+M).
+                real = ((t >= idx) & (t < idx + M)).astype(jnp.float32)
+                aux = aux * real
+            else:
+                out = stage_fn(params_local, inp)
+                aux = jnp.float32(0.0)
             nxt = jax.lax.ppermute(out, stage_axis, perm)
-            return nxt, out
+            return nxt, (out, aux)
 
         if schedule == "1f1b":
             tick = jax.checkpoint(tick)
 
         # T = M + S - 1 ticks: S-1 fill/drain bubble ticks padded with zeros.
+        T = M + S - 1
         pad = jnp.zeros((S - 1,) + xs.shape[1:], xs.dtype)
         stream = jnp.concatenate([xs, pad], axis=0)
         state0 = jnp.zeros(xs.shape[1:], xs.dtype)
-        _, ys = jax.lax.scan(tick, state0, stream)
+        _, (ys, auxs) = jax.lax.scan(tick, state0, (jnp.arange(T), stream))
 
         # Microbatch m finishes on the last stage at tick m + S - 1.
         ys_valid = ys[S - 1:]
@@ -102,22 +118,28 @@ def spmd_pipeline(
         # mask in backward). Communicates one activation tensor per
         # microbatch — the same bytes the torch runtime's final-stage
         # gather moves.
-        return jax.lax.psum(ys_valid * is_last, stage_axis)
+        out = jax.lax.psum(ys_valid * is_last, stage_axis)
+        aux = jax.lax.psum(jnp.sum(auxs), stage_axis) / M
+        return out, aux
 
     param_specs = jax.tree.map(lambda _: P(stage_axis), stage_params)
-    return jax.shard_map(
+    out, aux = jax.shard_map(
         run,
         mesh=mesh,
         in_specs=(param_specs, P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names=frozenset({stage_axis}),
         check_vma=False,
     )(stage_params, x_mb)
+    return (out, aux) if with_aux else out
 
 
-def _sequential(stage_fn, stage_params, x_mb):
+def _sequential(stage_fn, stage_params, x_mb, with_aux):
     """S=1 degenerate case: one 'stage' holding every layer, no mesh comm."""
-    return jax.vmap(lambda x: stage_fn(stage_params, x))(x_mb)
+    if not with_aux:
+        return jax.vmap(lambda x: stage_fn(stage_params, x))(x_mb)
+    ys, auxs = jax.vmap(lambda x: stage_fn(stage_params, x))(x_mb)
+    return ys, jnp.mean(auxs)
 
 
 def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:

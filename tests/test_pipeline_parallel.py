@@ -86,6 +86,49 @@ def test_pipeline_matches_sequential_backward(devices8):
         )
 
 
+def test_pipeline_moe_train_step(devices8):
+    """MoE inside the pipeline: aux losses escape the manual region and the
+    PP x EP composition trains."""
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    mesh_cfg = MeshConfig(stage=2, data=2, expert=2)
+    mesh = build_mesh(mesh_cfg, devices8)
+    cfg = ModelConfig(**TINY, num_experts=4, expert_top_k=2,
+                      pipeline_microbatches=4)
+    model = build_model(cfg, PrecisionConfig(), mesh=mesh, mesh_cfg=mesh_cfg)
+    tx, _ = make_optimizer(
+        OptimConfig(name="adamw", learning_rate=1e-2, schedule="constant",
+                    warmup_steps=0), total_steps=10,
+    )
+    rules = rules_for_model("llama_pp")
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(0, 64, (8, 16)), jnp.int32
+    )
+
+    def init_state(rng):
+        v = model.init({"params": rng}, ids)
+        return TrainState.create(params=v["params"], tx=tx)
+
+    rng = jax.random.PRNGKey(0)
+    sharding = steps_lib.state_shardings(
+        mesh, rules, jax.eval_shape(init_state, rng))
+    state = jax.jit(init_state, out_shardings=sharding)(rng)
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(model, get_loss_fn("causal_lm_xent"), tx),
+        mesh, sharding,
+    )
+    losses = []
+    for _ in range(4):
+        state, metrics = step(state, {"input_ids": ids}, rng)
+        losses.append(float(metrics["loss"]))
+        assert float(metrics["aux_loss"]) > 0.0
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
 def test_pipeline_train_step(devices8):
     """Full jitted train step: PP × DP × FSDP composes, loss decreases."""
     from pytorch_distributed_train_tpu import steps as steps_lib
