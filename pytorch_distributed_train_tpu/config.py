@@ -77,6 +77,9 @@ class DataConfig:
 
     dataset: str = "synthetic_images"  # synthetic_images | cifar10 | imagenet_folder | synthetic_lm | text_mlm
     data_dir: str = ""
+    # Host loader backend (SURVEY C17): "threads" (in-process pool) or
+    # "grain" (Grain worker PROCESSES — the torch-DataLoader-worker model)
+    loader: str = "threads"
     batch_size: int = 128
     eval_batch_size: int = 0  # 0 → = batch_size
     num_workers: int = 4

@@ -1,0 +1,119 @@
+"""Grain-backed host loader — the multiprocess alternative to the threaded
+HostDataLoader (SURVEY C17: torch's DataLoader runs worker *processes*,
+torch:utils/data/_utils/worker.py:244; Grain is the JAX-ecosystem loader
+with the same process-pool design).
+
+Selected via ``DataConfig.loader = "grain"``. Duck-types HostDataLoader
+(``steps_per_epoch``, ``epoch(epoch, start_batch)``) so the rest of the
+input pipeline — producer thread, HBM prefetch, sync checks — is shared.
+
+Reuses the datasets unchanged: a RandomMapTransform pulls one record
+through the dataset's own ``get_item``/``get_batch`` (batch of 1), so
+augmentation (incl. the native imgops path) runs inside Grain's worker
+processes, off the GIL and off the step path.
+
+Sharding/shuffle semantics mirror DistributedSampler (C16): per-epoch
+reseeded shuffle, host-sharded with drop_remainder — though the shuffle
+permutation itself is Grain's, not byte-identical to data/sampler.py.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+class _IndexSource:
+    """Grain source yielding record indices; transforms do the real work
+    (keeps dataset objects out of the pickled source when possible)."""
+
+    def __init__(self, n: int):
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> int:
+        return int(i)
+
+
+def _make_load_transform(dataset, train: bool):
+    import grain.python as gp
+
+    item_style = getattr(dataset, "is_item_style", False)
+
+    class _LoadRecord(gp.RandomMapTransform):
+        def random_map(self, i, rng: np.random.Generator):
+            if item_style:
+                return dataset.get_item(int(i), rng)
+            batch1 = dataset.get_batch(np.asarray([int(i)]), rng, train)
+            return {k: v[0] for k, v in batch1.items()}
+
+    return _LoadRecord()
+
+
+class GrainHostDataLoader:
+    """Per-host loader over Grain worker processes."""
+
+    def __init__(self, dataset, data_cfg, *, train: bool,
+                 num_hosts: int | None = None, host_id: int | None = None):
+        self.dataset = dataset
+        self.train = train
+        self.num_hosts = (num_hosts if num_hosts is not None
+                          else jax.process_count())
+        self.host_id = host_id if host_id is not None else jax.process_index()
+        global_batch = data_cfg.batch_size if train else (
+            data_cfg.eval_batch_size or data_cfg.batch_size
+        )
+        if global_batch % self.num_hosts != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"{self.num_hosts} hosts"
+            )
+        self.host_batch = global_batch // self.num_hosts
+        self.seed = data_cfg.seed
+        self.shuffle = train and data_cfg.shuffle
+        self.num_workers = data_cfg.num_workers
+        self.read_buffer = max(2, data_cfg.prefetch)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        per_host = len(self.dataset) // self.num_hosts
+        if self.train:
+            return per_host // self.host_batch
+        return (per_host + self.host_batch - 1) // self.host_batch
+
+    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
+        import grain.python as gp
+
+        sampler = gp.IndexSampler(
+            num_records=len(self.dataset),
+            shard_options=gp.ShardOptions(
+                shard_index=self.host_id, shard_count=self.num_hosts,
+                drop_remainder=True,
+            ),
+            shuffle=self.shuffle,
+            # per-epoch reshuffle ≡ DistributedSampler.set_epoch (C16)
+            seed=self.seed + epoch,
+            num_epochs=1,
+        )
+        loader = gp.DataLoader(
+            data_source=_IndexSource(len(self.dataset)),
+            sampler=sampler,
+            operations=[
+                _make_load_transform(self.dataset, self.train),
+                gp.Batch(batch_size=self.host_batch,
+                         drop_remainder=self.train),
+            ],
+            worker_count=self.num_workers,
+            read_options=gp.ReadOptions(prefetch_buffer_size=self.read_buffer),
+        )
+        n_steps = self.steps_per_epoch
+        for b, batch in enumerate(loader):
+            if b >= n_steps:
+                break
+            if b < start_batch:  # mid-epoch resume fast-forward
+                continue
+            yield {k: np.asarray(v) for k, v in batch.items()}

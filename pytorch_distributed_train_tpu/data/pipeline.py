@@ -212,7 +212,14 @@ def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
     construction, so checking there would be vacuous. The check runs on the
     consumer thread (collectives must not race the step's collectives).
     """
-    loader = HostDataLoader(dataset, data_cfg, train=train)
+    if getattr(data_cfg, "loader", "threads") == "grain":
+        from pytorch_distributed_train_tpu.data.grain_pipeline import (
+            GrainHostDataLoader,
+        )
+
+        loader = GrainHostDataLoader(dataset, data_cfg, train=train)
+    else:
+        loader = HostDataLoader(dataset, data_cfg, train=train)
 
     def epoch_fn(epoch: int, start_batch: int = 0) -> Iterator[dict]:
         host_iter = iter(_Producer(loader.epoch(epoch, start_batch),
