@@ -147,7 +147,8 @@ def main() -> None:
             jnp.int32)}
         items_per_step, unit_noun = global_batch * seq, "tokens"
 
-    for _ in range(args.warmup):
+    # Timing always excludes compile: at least one warmup step runs.
+    for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch, rng)
     float(metrics["loss"])  # value fetch = hard sync (see module docstring)
 
@@ -162,9 +163,16 @@ def main() -> None:
     per_chip = items_per_step / per_step / n_chips
 
     metric = f"{args.model}_{unit_noun}_per_sec_per_chip"
-    default_run = (vision and args.model == "resnet50"
-                   and args.batch_per_chip in (0, 128)
-                   and args.image_size == 224)
+    # Only canonical shapes may seed a baseline key — smoke runs with
+    # non-default shapes must not (BASELINE.md policy).
+    if vision:
+        canonical = (args.model == "resnet50"
+                     and args.batch_per_chip in (0, 128)
+                     and args.image_size == 224)
+    elif args.model == "llama":
+        canonical = args.batch_per_chip in (0, 8) and args.seq_len == 2048
+    else:  # bert_base
+        canonical = args.batch_per_chip in (0, 32) and args.seq_len >= 512
     baseline_path = os.path.join(os.path.dirname(__file__),
                                  "BENCH_BASELINE.json")
     base = {}
@@ -172,7 +180,7 @@ def main() -> None:
         with open(baseline_path) as f:
             base = json.load(f)
     vs = per_chip / base[metric] if base.get(metric) else 1.0
-    if metric not in base and (default_run or not vision):
+    if metric not in base and canonical:
         # First measured run of a canonical config seeds its baseline key.
         base[metric] = per_chip
         base.setdefault("recorded", time.strftime("%Y-%m-%d"))

@@ -85,10 +85,10 @@ class GrainHostDataLoader:
             return per_host // self.host_batch
         return (per_host + self.host_batch - 1) // self.host_batch
 
-    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
+    def _sampler(self, epoch: int):
         import grain.python as gp
 
-        sampler = gp.IndexSampler(
+        return gp.IndexSampler(
             num_records=len(self.dataset),
             shard_options=gp.ShardOptions(
                 shard_index=self.host_id, shard_count=self.num_hosts,
@@ -99,21 +99,56 @@ class GrainHostDataLoader:
             seed=self.seed + epoch,
             num_epochs=1,
         )
+
+    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
+        import grain.python as gp
+
+        if start_batch > 0:
+            # Mid-epoch resume: enumerate the epoch's record order from the
+            # sampler (pure index math), slice, and run a sequential pass —
+            # O(skip) index reads instead of materializing skipped batches
+            # through the workers. Data ORDER matches the uninterrupted
+            # epoch; per-record augment rng draws may differ (they key on
+            # sampler position) — use loader="threads" where bit-exact
+            # resume augmentation matters.
+            sampler = self._sampler(epoch)
+            n = min(self.steps_per_epoch * self.host_batch,
+                    len(self.dataset) // self.num_hosts)
+            ids = [int(sampler[i].record_key)
+                   for i in range(start_batch * self.host_batch, n)]
+            source: object = ids
+            order_sampler = gp.IndexSampler(
+                num_records=len(ids), shuffle=False,
+                seed=self.seed + epoch, num_epochs=1,
+                shard_options=gp.NoSharding(),
+            )
+        else:
+            source = _IndexSource(len(self.dataset))
+            order_sampler = self._sampler(epoch)
         loader = gp.DataLoader(
-            data_source=_IndexSource(len(self.dataset)),
-            sampler=sampler,
+            data_source=source,
+            sampler=order_sampler,
             operations=[
                 _make_load_transform(self.dataset, self.train),
-                gp.Batch(batch_size=self.host_batch,
-                         drop_remainder=self.train),
+                gp.Batch(batch_size=self.host_batch, drop_remainder=False),
             ],
             worker_count=self.num_workers,
             read_options=gp.ReadOptions(prefetch_buffer_size=self.read_buffer),
         )
-        n_steps = self.steps_per_epoch
+        n_steps = self.steps_per_epoch - start_batch
         for b, batch in enumerate(loader):
             if b >= n_steps:
                 break
-            if b < start_batch:  # mid-epoch resume fast-forward
-                continue
-            yield {k: np.asarray(v) for k, v in batch.items()}
+            out = {k: np.asarray(v) for k, v in batch.items()}
+            short = self.host_batch - len(next(iter(out.values())))
+            if short > 0:
+                # Pad the tail batch by wrapping — SPMD needs static shapes
+                # (same invariant as HostDataLoader's eval-tail wrap).
+                out = {
+                    k: np.concatenate(
+                        [v, np.tile(v, (short // len(v) + 1,)
+                                    + (1,) * (v.ndim - 1))[:short]]
+                    )
+                    for k, v in out.items()
+                }
+            yield out

@@ -64,6 +64,22 @@ def test_start_batch_fast_forward():
     np.testing.assert_array_equal(tail[0], full[2])
 
 
+def test_eval_tail_batch_padded_to_full_size():
+    """Eval epochs must keep static shapes: the tail batch pads by wrapping
+    (the HostDataLoader invariant, required by global-array assembly)."""
+    ds = synthetic_lm(40, 8, 100, seed=0)  # 40 records, batch 16 → 2.5
+    cfg = dataclasses.replace(CFG, shuffle=False)
+    loader = GrainHostDataLoader(ds, cfg, train=False, num_hosts=1, host_id=0)
+    assert loader.steps_per_epoch == 3
+    batches = list(loader.epoch(0))
+    assert len(batches) == 3
+    for b in batches:
+        assert b["input_ids"].shape == (16, 8)
+    # padded rows wrap rows of the tail batch itself
+    np.testing.assert_array_equal(batches[2]["input_ids"][8:],
+                                  batches[2]["input_ids"][:8])
+
+
 def test_multiprocess_workers():
     """worker_count>0 spawns real Grain worker processes."""
     ds = synthetic_images(64, 8, 10, seed=0)
