@@ -39,8 +39,13 @@ class CheckpointManager:
         self.config_json = config_json
 
     # ------------------------------------------------------------------ save
-    def save(self, state: TrainState, *, epoch: int = 0, force: bool = False) -> bool:
-        step = int(state.step)
+    def save(self, state: TrainState, *, epoch: int = 0, force: bool = False,
+             step: int | None = None) -> bool:
+        # Callers that track the step host-side pass it in — int(state.step)
+        # is a device sync that would serialize async dispatch (trainer hot
+        # loop keeps its own counter for exactly this reason).
+        if step is None:
+            step = int(state.step)
         if step in self.mgr.all_steps():
             return False  # cadence save already wrote this step
         saved = self.mgr.save(
@@ -53,10 +58,12 @@ class CheckpointManager:
         )
         return bool(saved)
 
-    def maybe_save(self, state: TrainState, *, epoch: int = 0) -> bool:
-        step = int(state.step)
+    def maybe_save(self, state: TrainState, *, epoch: int = 0,
+                   step: int | None = None) -> bool:
+        if step is None:
+            step = int(state.step)
         if self.cfg.save_every_steps and step % self.cfg.save_every_steps == 0:
-            return self.save(state, epoch=epoch)
+            return self.save(state, epoch=epoch, step=step)
         return False
 
     # --------------------------------------------------------------- restore

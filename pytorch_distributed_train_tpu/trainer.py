@@ -185,14 +185,19 @@ class Trainer:
                     self.state, metrics = self.train_step(
                         self.state, batch, self.step_rng
                     )
-                    step = int(self.state.step)  # syncs; acceptable at MVP
+                    # Host-side step counter: int(state.step) every step
+                    # would sync the device and serialize async dispatch
+                    # (the jitted step increments state.step identically,
+                    # including loss-scale skip steps).
+                    step += 1
                     self._maybe_inject_fault(step)
                     self.meter.tick()
                     self.heartbeat.beat()
                     self.recorder.record("step", step)
                     if step % cfg.obs.log_every_steps == 0 or step == limit:
                         self._log_train(step, metrics)
-                    if self.ckpt.maybe_save(self.state, epoch=epoch):
+                    if self.ckpt.maybe_save(self.state, epoch=epoch,
+                                            step=step):
                         self.recorder.record("ckpt", step)
                     if (cfg.eval_every_steps and
                             step % cfg.eval_every_steps == 0):
@@ -205,7 +210,7 @@ class Trainer:
                 self.meter.reset_clock()  # epoch boundary: don't count eval time
         finally:
             self.heartbeat.stop()
-            self.ckpt.save(self.state, epoch=epoch, force=True)
+            self.ckpt.save(self.state, epoch=epoch, force=True, step=step)
             self.ckpt.wait()
             self.logger.log(
                 step,
