@@ -56,9 +56,11 @@ class ModelConfig:
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False
     # Pipeline parallelism (model name "llama_pp"; SURVEY §2.3 PP row):
-    # microbatch count (0 → = stage count) and schedule ("gpipe" | "1f1b").
+    # microbatch count (0 → = stage count), schedule ("gpipe" | "1f1b" |
+    # "interleaved"), and chunks per device for the interleaved schedule.
     pipeline_microbatches: int = 0
     pipeline_schedule: str = "gpipe"
+    pipeline_chunks: int = 2
     # Mixture-of-Experts (SURVEY §2.3 EP row; ops/moe.py). num_experts>1
     # swaps the dense MLP for top-k routed experts on every moe_every-th
     # block; expert params shard over the 'expert' mesh axis.

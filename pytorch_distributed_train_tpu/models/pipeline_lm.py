@@ -38,12 +38,16 @@ class PipelinedLlama:
     """
 
     def __init__(self, cfg, dtype, param_dtype, *, mesh, cp=None,
-                 num_microbatches: int = 0, schedule: str = "gpipe"):
-        S = pipeline_lib.num_stages(mesh)
-        if cfg.num_layers % max(S, 1) != 0:
+                 num_microbatches: int = 0, schedule: str = "gpipe",
+                 chunks: int = 1):
+        S = max(pipeline_lib.num_stages(mesh), 1)
+        self.interleaved = schedule == "interleaved"
+        self.chunks = max(chunks, 1) if self.interleaved else 1
+        denom = S * self.chunks
+        if cfg.num_layers % denom != 0:
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by "
-                f"{S} pipeline stages"
+                f"{S} stages x {self.chunks} chunks"
             )
         moe = None
         if getattr(cfg, "num_experts", 0) > 1:
@@ -97,14 +101,24 @@ class PipelinedLlama:
             lambda r: self.block.init(r, h_dummy)["params"]
         )(jax.random.split(r_blocks, self.cfg.num_layers))
 
-        return {
-            "params": {
-                "tok_embed": self.embed.init(r_embed, input_ids)["params"],
-                "blocks": block_params,
-                "final_norm": self.final_norm.init(r_norm, h_dummy)["params"],
-                "lm_head": self.lm_head.init(r_head, h_dummy)["params"],
-            }
+        params = {
+            "tok_embed": self.embed.init(r_embed, input_ids)["params"],
+            "final_norm": self.final_norm.init(r_norm, h_dummy)["params"],
+            "lm_head": self.lm_head.init(r_head, h_dummy)["params"],
         }
+        if self.interleaved:
+            # (L, ...) → (C, S, Lps, ...): entry (c, s) is virtual stage
+            # v = c·S + s — the round-robin chunk assignment, stored so the
+            # partition rules shard dim 1 over 'stage' (no runtime reshard).
+            S = pipeline_lib.num_stages(self.mesh)
+            C = self.chunks
+            params["blocks_csl"] = jax.tree.map(
+                lambda a: a.reshape((C, max(S, 1), -1) + a.shape[1:]),
+                block_params,
+            )
+        else:
+            params["blocks"] = block_params
+        return {"params": params}
 
     def apply(self, variables, input_ids, train: bool = True, rngs=None,
               mutable=False):
@@ -144,10 +158,16 @@ class PipelinedLlama:
             return h, aux
 
         x_mb = pipeline_lib.microbatch(x, self.num_microbatches)
-        h_mb, aux = pipeline_lib.spmd_pipeline(
-            stage_fn, p["blocks"], x_mb,
-            mesh=self.mesh, schedule=self.schedule, with_aux=True,
-        )
+        if self.interleaved:
+            h_mb, aux = pipeline_lib.spmd_pipeline_interleaved(
+                stage_fn, p["blocks_csl"], x_mb,
+                mesh=self.mesh, with_aux=True,
+            )
+        else:
+            h_mb, aux = pipeline_lib.spmd_pipeline(
+                stage_fn, p["blocks"], x_mb,
+                mesh=self.mesh, schedule=self.schedule, with_aux=True,
+            )
         h = pipeline_lib.unmicrobatch(h_mb)
 
         h = self.final_norm.apply({"params": p["final_norm"]}, h)
@@ -167,4 +187,5 @@ def llama_pp(cfg, dtype, param_dtype, *, mesh, cp=None) -> PipelinedLlama:
         cfg, dtype, param_dtype, mesh=mesh, cp=cp,
         num_microbatches=cfg.pipeline_microbatches,
         schedule=cfg.pipeline_schedule,
+        chunks=cfg.pipeline_chunks,
     )

@@ -158,6 +158,22 @@ def llama_pp_rules() -> list[tuple[str, PartitionSpec]]:
     matches llama_rules. Embed/head live outside the pipeline (replicated
     over 'stage', sharded over fsdp/tensor as usual)."""
     return [
+        # Interleaved-schedule storage (C, S, Lps, ...): stage on dim 1.
+        (r"blocks_csl/.*(q_proj|k_proj|v_proj)/kernel$",
+         P(None, "stage", None, "fsdp", "tensor")),
+        (r"blocks_csl/.*o_proj/kernel$",
+         P(None, "stage", None, "tensor", None, "fsdp")),
+        (r"blocks_csl/.*experts/(gate_proj|up_proj)/kernel$",
+         P(None, "stage", None, "expert", "fsdp", "tensor")),
+        (r"blocks_csl/.*experts/down_proj/kernel$",
+         P(None, "stage", None, "expert", "tensor", "fsdp")),
+        (r"blocks_csl/.*router/kernel$", P(None, "stage")),
+        (r"blocks_csl/.*(gate_proj|up_proj)/kernel$",
+         P(None, "stage", None, "fsdp", "tensor")),
+        (r"blocks_csl/.*down_proj/kernel$",
+         P(None, "stage", None, "tensor", "fsdp")),
+        (r"blocks_csl/.*scale$", P(None, "stage")),
+        # GPipe/1F1B storage (L, ...): stage on dim 0.
         (r"blocks/.*(q_proj|k_proj|v_proj)/kernel$",
          P("stage", "fsdp", "tensor")),
         (r"blocks/.*o_proj/kernel$", P("stage", "tensor", None, "fsdp")),

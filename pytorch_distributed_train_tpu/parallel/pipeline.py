@@ -22,6 +22,15 @@ Schedules:
   matching ``Schedule1F1B``'s memory motivation. The bubble fraction
   (S-1)/(M+S-1) is identical — it is set by the dependency structure, not
   the runtime.
+- ``interleaved`` — circular/interleaved pipelining (torch's
+  ``ScheduleInterleavedF1B``): each device holds C CHUNKS of layers
+  assigned round-robin over virtual stages (device s owns v ≡ s mod S,
+  stored as a (C, S, layers/V) stack sharded on dim 1), and every
+  microbatch makes C laps around the ring. Per group of S microbatches the
+  schedule is conflict-free and dense — V + S - 1 ticks with only S-1
+  bubble ticks of 1/C-sized work each, the 1/C bubble reduction that is
+  the point of interleaving. Groups (M/S of them) run back to back.
+  Requires M % S == 0 and num_layers % (S·C) == 0.
 
 The loop is differentiable end-to-end (ppermute transposes to the reverse
 rotation; psum transposes to a broadcast), so `jax.grad` of a loss on the
@@ -140,6 +149,113 @@ def _sequential(stage_fn, stage_params, x_mb, with_aux):
         return jax.vmap(lambda x: stage_fn(stage_params, x))(x_mb)
     ys, auxs = jax.vmap(lambda x: stage_fn(stage_params, x))(x_mb)
     return ys, jnp.mean(auxs)
+
+
+def spmd_pipeline_interleaved(
+    stage_fn: Callable,
+    chunk_params: Any,
+    x_mb: jax.Array,
+    *,
+    mesh: Mesh,
+    stage_axis: str = "stage",
+    with_aux: bool = False,
+):
+    """Circular/interleaved pipeline (see module docstring).
+
+    Args:
+      stage_fn: ``(one_chunk_params, h) -> h`` (or ``(h, aux)`` with
+        ``with_aux``) applying ONE chunk (layers/V layers) to a microbatch.
+      chunk_params: pytree with leading dims (C, S, ...): entry (c, s) is
+        virtual stage v = c·S + s. Dim 1 sharded ``P(None, 'stage')``.
+      x_mb: (M, mb, ...) microbatches, M % S == 0.
+
+    Returns (M, mb, ...) final-stage outputs (+ mean aux with ``with_aux``),
+    replicated over 'stage'.
+    """
+    S = num_stages(mesh, stage_axis)
+    C = jax.tree_util.tree_leaves(chunk_params)[0].shape[0]
+    M = x_mb.shape[0]
+    if S == 1:
+        def seq_fn(params_cs, h):
+            aux_total = jnp.float32(0.0)
+            for c in range(C):
+                p_c = jax.tree.map(lambda a, c=c: a[c, 0], params_cs)
+                if with_aux:
+                    h, a = stage_fn(p_c, h)
+                    aux_total = aux_total + a
+                else:
+                    h = stage_fn(p_c, h)
+            return (h, aux_total) if with_aux else h
+        return _sequential(seq_fn, chunk_params, x_mb, with_aux)
+    if M % S != 0:
+        raise ValueError(f"interleaved schedule needs microbatches {M} "
+                         f"divisible by stages {S}")
+    V = C * S
+    G = M // S
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def run(params_local, xs):
+        # params_local: (C, 1, ...) — this device's chunks c·S + s.
+        params_local = jax.tree.map(lambda a: a[:, 0], params_local)
+        idx = jax.lax.axis_index(stage_axis)
+
+        def one_group(xs_g):
+            """xs_g: (S, mb, ...) — one group's microbatches."""
+            T = V + S - 1
+
+            def tick(state, t):
+                # Device s at tick t works microbatch r, virtual stage v:
+                #   r = (t - s) mod S,  v = t - r  (chunk c = v // S).
+                r = jnp.mod(t - idx, S)
+                v = t - r
+                c = v // S
+                valid = (v >= 0) & (v < V)
+                inject = (idx == 0) & (t < S)
+                inp = jnp.where(inject, xs_g[jnp.clip(t, 0, S - 1)], state)
+                p_c = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, jnp.clip(c, 0, C - 1), 0, keepdims=False),
+                    params_local,
+                )
+                if with_aux:
+                    out, aux = stage_fn(p_c, inp)
+                    aux = aux * valid.astype(jnp.float32)
+                else:
+                    out = stage_fn(p_c, inp)
+                    aux = jnp.float32(0.0)
+                # Bubble ticks pass their input through unchanged — keeps
+                # garbage zeros from compounding; outputs are only read at
+                # valid final-stage ticks anyway.
+                out = jnp.where(valid, out, inp)
+                nxt = jax.lax.ppermute(out, stage_axis, perm)
+                return nxt, (out, aux)
+
+            state0 = jnp.zeros(xs_g.shape[1:], xs_g.dtype)
+            _, (ys, auxs) = jax.lax.scan(tick, state0, jnp.arange(T))
+            # Microbatch r finishes (v = V-1, on device S-1) at t = r + V-1.
+            ys_valid = ys[V - 1:]
+            is_last = (idx == S - 1).astype(ys_valid.dtype)
+            out = jax.lax.psum(ys_valid * is_last, stage_axis)
+            return out, jnp.sum(auxs)
+
+        outs, auxs = [], []
+        for g in range(G):
+            o, a = one_group(xs[g * S:(g + 1) * S])
+            outs.append(o)
+            auxs.append(a)
+        total_aux = jax.lax.psum(sum(auxs), stage_axis) / M
+        return jnp.concatenate(outs, axis=0), total_aux
+
+    param_specs = jax.tree.map(lambda _: P(None, stage_axis), chunk_params)
+    out, aux = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=(P(), P()),
+        axis_names=frozenset({stage_axis}),
+        check_vma=False,
+    )(chunk_params, x_mb)
+    return (out, aux) if with_aux else out
 
 
 def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
