@@ -260,6 +260,22 @@ class Trainer:
             print(f"[fault-inject] killing process at step {step}", flush=True)
             os._exit(41)
 
+    def import_params(self, path: str) -> None:
+        """Warm-start params from a (torch-layout) safetensors file
+        (interop.py), keeping the configured sharding."""
+        from pytorch_distributed_train_tpu.interop import (
+            load_flax_safetensors,
+        )
+
+        host_params = load_flax_safetensors(path, self.state.params)
+        sharded = jax.device_put(
+            host_params,
+            self.rules.tree_shardings(self.mesh, host_params),
+        )
+        self.state = self.state.replace(params=sharded)
+        if jax.process_index() == 0:
+            print(f"[interop] warm-started params from {path}", flush=True)
+
     # ------------------------------------------------------------- profiling
     def _maybe_profile(self, step: int) -> None:
         obs = self.cfg.obs

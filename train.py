@@ -38,6 +38,13 @@ def parse_args(argv=None):
     p.add_argument("--list-configs", action="store_true")
     p.add_argument("--print-config", action="store_true",
                    help="print resolved config JSON and exit")
+    p.add_argument("--export-safetensors", default="", metavar="PATH",
+                   help="restore the latest checkpoint (or init) and write "
+                        "a torch-layout safetensors file, then exit "
+                        "(interop.py bridge)")
+    p.add_argument("--import-safetensors", default="", metavar="PATH",
+                   help="warm-start model params from a (torch-layout) "
+                        "safetensors file before training")
     return p.parse_args(argv)
 
 
@@ -81,6 +88,19 @@ def main(argv=None) -> int:
         print(f"[config] preset={cfg.preset}", flush=True)
 
     trainer = Trainer(cfg)
+    if args.export_safetensors:
+        from pytorch_distributed_train_tpu.interop import (
+            save_torch_safetensors,
+        )
+
+        # Trainer construction already auto-resumed the latest checkpoint.
+        save_torch_safetensors(trainer.state.params, args.export_safetensors)
+        print(f"[interop] exported params → {args.export_safetensors}",
+              flush=True)
+        trainer.close()
+        return 0
+    if args.import_safetensors:
+        trainer.import_params(args.import_safetensors)
     trainer.fit()
     trainer.close()
     return 0
