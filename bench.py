@@ -48,6 +48,12 @@ def main() -> None:
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--attention-impl", default="auto",
+                   choices=["auto", "xla", "pallas"],
+                   help="LM attention backend. 'auto' picks the Pallas flash "
+                        "kernel on real TPU backends but falls back to XLA "
+                        "under the axon tunnel, whose remote compile hangs "
+                        "on Mosaic kernels (ops/attention.py _pallas_usable).")
     args = p.parse_args()
 
     import jax
@@ -86,6 +92,7 @@ def main() -> None:
             name="llama", vocab_size=32000, hidden_size=2048, num_layers=16,
             num_heads=16, num_kv_heads=16, mlp_dim=5504,
             max_seq_len=args.seq_len, remat=True,
+            attention_impl=args.attention_impl,
         )
         loss_name = "causal_lm_xent"
         opt = OptimConfig(name="adamw", learning_rate=3e-4,
@@ -96,6 +103,7 @@ def main() -> None:
             name="bert_base", vocab_size=30522, hidden_size=768,
             num_layers=12, num_heads=12, mlp_dim=3072,
             max_seq_len=min(args.seq_len, 512),
+            attention_impl=args.attention_impl,
         )
         loss_name = "causal_lm_xent"  # plain next-token xent on logits
         opt = OptimConfig(name="lamb", learning_rate=1e-3,
@@ -170,9 +178,11 @@ def main() -> None:
                      and args.batch_per_chip in (0, 128)
                      and args.image_size == 224)
     elif args.model == "llama":
-        canonical = args.batch_per_chip in (0, 8) and args.seq_len == 2048
+        canonical = (args.batch_per_chip in (0, 8) and args.seq_len == 2048
+                     and args.attention_impl == "auto")
     else:  # bert_base
-        canonical = args.batch_per_chip in (0, 32) and args.seq_len >= 512
+        canonical = (args.batch_per_chip in (0, 32) and args.seq_len >= 512
+                     and args.attention_impl == "auto")
     baseline_path = os.path.join(os.path.dirname(__file__),
                                  "BENCH_BASELINE.json")
     base = {}

@@ -55,6 +55,10 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False
+    # Attention backend for this process: auto (pallas on TPU when
+    # supported+profitable, else XLA), or force xla / pallas. The
+    # PDTT_ATTENTION_IMPL env var overrides (ops/attention.py).
+    attention_impl: str = "auto"
     # Pipeline parallelism (model name "llama_pp"; SURVEY §2.3 PP row):
     # microbatch count (0 → = stage count), schedule ("gpipe" | "1f1b" |
     # "interleaved"), and chunks per device for the interleaved schedule.

@@ -33,6 +33,7 @@ class BertSelfAttention(nn.Module):
     # CP on BERT requires context_impl='ulysses' (pad masks don't rotate
     # around a ring — ops.attention dispatch enforces this).
     cp: ContextParallelConfig | None = None
+    attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
 
     @nn.compact
     def __call__(self, x, pad_mask, deterministic: bool):
@@ -43,7 +44,8 @@ class BertSelfAttention(nn.Module):
             param_dtype=self.param_dtype, name=name,
         )
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        y = dot_product_attention(q, k, v, mask=pad_mask, cp=self.cp)
+        y = dot_product_attention(q, k, v, mask=pad_mask, cp=self.cp,
+                                  impl=self.attn_impl)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
             name="attn_out",
@@ -62,6 +64,7 @@ class BertLayer(nn.Module):
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, pad_mask):
@@ -70,7 +73,7 @@ class BertLayer(nn.Module):
         )
         attn = BertSelfAttention(
             self.num_heads, self.dropout_rate, self.dtype, self.param_dtype,
-            cp=self.cp, name="attn",
+            cp=self.cp, attn_impl=self.attn_impl, name="attn",
         )(x, pad_mask, self.deterministic)
         x = ln("ln_attn")(x + attn).astype(self.dtype)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
@@ -98,6 +101,7 @@ class BertForMLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
+    attn_impl: str = "auto"
     # SP/CP activation anchoring (parallel/mesh.py ActivationSharding)
     act: "object | None" = None
 
@@ -135,7 +139,8 @@ class BertForMLM(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
-                self.dtype, self.param_dtype, cp=self.cp, name=f"layer{i}",
+                self.dtype, self.param_dtype, cp=self.cp,
+                attn_impl=self.attn_impl, name=f"layer{i}",
             )(x, pad_mask)
             if self.act is not None:
                 x = self.act.constrain(x)
@@ -146,7 +151,16 @@ class BertForMLM(nn.Module):
         h = nn.gelu(h)
         h = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32,
                          name="mlm_ln")(h)
-        logits = word.attend(h.astype(self.param_dtype))
+        # Tied-embedding decode in the compute dtype with fp32 accumulation:
+        # bf16 operands run at full MXU rate; preferred_element_type keeps
+        # the (B,S,V) logits fp32 (an fp32xfp32 matmul here is several times
+        # slower on the MXU).
+        emb = jnp.asarray(word.embedding, self.dtype)  # (V, C)
+        logits = jax.lax.dot_general(
+            h.astype(self.dtype), emb,
+            (((h.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         logits = logits + self.param(
             "mlm_bias", nn.initializers.zeros, (self.vocab_size,), jnp.float32
         )
@@ -157,6 +171,7 @@ def bert_base(cfg, dtype, param_dtype, cp=None, act=None) -> BertForMLM:
     return BertForMLM(
         cp=cp,
         act=act,
+        attn_impl=getattr(cfg, "attention_impl", "auto"),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

@@ -20,6 +20,8 @@ TPU-first notes:
 
 from __future__ import annotations
 
+from functools import partial
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -68,6 +70,7 @@ class LlamaAttention(nn.Module):
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
+    attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
 
     @nn.compact
     def __call__(self, x):
@@ -86,7 +89,8 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        y = dot_product_attention(q, k, v, causal=True, cp=self.cp)
+        y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
+                                  impl=self.attn_impl)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -122,6 +126,7 @@ class LlamaBlock(nn.Module):
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None  # set → MoE FFN instead of dense (ops/moe.py)
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
@@ -129,7 +134,7 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.rope_theta,
             self.max_seq_len, self.dtype, self.param_dtype, cp=self.cp,
-            name="attn",
+            attn_impl=self.attn_impl, name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         if self.moe is not None:
@@ -161,6 +166,7 @@ class LlamaForCausalLM(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None
+    attn_impl: str = "auto"
     # SP/CP activation anchoring (parallel/mesh.py ActivationSharding):
     # keeps norms/residuals seq-sharded between attention / TP-matmul
     # regions — CP without it replicates seq outside the shard_map regions;
@@ -186,15 +192,22 @@ class LlamaForCausalLM(nn.Module):
                 self.num_heads, self.num_kv_heads, self.mlp_dim,
                 self.rope_theta, self.max_seq_len, self.rms_norm_eps,
                 self.dtype, self.param_dtype, cp=self.cp, moe=moe,
-                name=f"layer{i}",
+                attn_impl=self.attn_impl, name=f"layer{i}",
             )(x)
             if self.act is not None:
                 x = self.act.constrain(x)
 
         x = RMSNorm(self.rms_norm_eps, name="final_norm")(x)
+        # Head matmul in the compute dtype with fp32 accumulation: bf16
+        # operands hit the MXU at full rate while preferred_element_type
+        # keeps the (B,S,V) logits fp32 without an intermediate bf16
+        # rounding (an fp32xfp32 matmul here ran at a fraction of MXU rate
+        # and the head is ~1/6 of total model FLOPs at 32k vocab).
         logits = nn.Dense(
-            self.vocab_size, use_bias=False, dtype=jnp.float32,
+            self.vocab_size, use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
+            dot_general=partial(jax.lax.dot_general,
+                                preferred_element_type=jnp.float32),
             kernel_init=nn.initializers.normal(0.02), name="lm_head",
         )(x)
         return logits.astype(jnp.float32)
@@ -217,6 +230,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         cp=cp,
         moe=moe,
         act=act,
+        attn_impl=getattr(cfg, "attention_impl", "auto"),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

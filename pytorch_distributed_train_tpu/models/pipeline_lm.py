@@ -18,6 +18,8 @@ machinery between the schedule and the compiler. It duck-types the
 
 from __future__ import annotations
 
+from functools import partial
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -81,11 +83,16 @@ class PipelinedLlama:
             cfg.num_heads, cfg.num_kv_heads or cfg.num_heads, cfg.mlp_dim,
             cfg.rope_theta, cfg.max_seq_len, cfg.rms_norm_eps,
             dtype, param_dtype, cp=cp, moe=moe,
+            attn_impl=getattr(cfg, "attention_impl", "auto"),
         )
         self.final_norm = RMSNorm(cfg.rms_norm_eps)
+        # bf16 operands + fp32 accumulation: full MXU rate with fp32 logits
+        # (same rationale as LlamaForCausalLM's head).
         self.lm_head = nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            cfg.vocab_size, use_bias=False, dtype=dtype,
             param_dtype=param_dtype,
+            dot_general=partial(jax.lax.dot_general,
+                                preferred_element_type=jnp.float32),
             kernel_init=nn.initializers.normal(0.02),
         )
 

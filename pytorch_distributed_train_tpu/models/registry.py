@@ -56,6 +56,9 @@ def build_model(model_cfg, precision_cfg, mesh=None, mesh_cfg=None):
     name = model_cfg.name
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; have {list_models()}")
+    # ModelConfig.attention_impl is threaded into the modules as a static
+    # attr (attn_impl) by each model ctor — no process-global state, so two
+    # models with different backends coexist in one process.
     dtype = jnp.dtype(precision_cfg.compute_dtype)
     param_dtype = jnp.dtype(precision_cfg.param_dtype)
     cp = None

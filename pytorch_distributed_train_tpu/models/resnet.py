@@ -7,9 +7,14 @@ TPU-first choices, not a torchvision translation:
   `force_float32_reductions`), but BN *outputs* follow the compute dtype:
   emitting bf16 halves the HBM traffic of every BN→ReLU→conv chain, which
   profiling showed dominating step time when BN emitted fp32.
-  `axis_name='batch'` is deliberately NOT used — per-device BN
-  statistics match DDP semantics, where torch BN normalises over the local
-  batch only (torch DDP does not sync BN unless SyncBatchNorm is opted into).
+  Under GSPMD jit the batch dim is sharded, so flax's plain batch
+  reduction compiles to a GLOBAL mean/var (XLA inserts the all-reduce) —
+  i.e. SyncBatchNorm semantics by construction, with the collective placed
+  by the compiler instead of torch's explicit process-group broadcast.
+  torch DDP's *default* (local-batch statistics, SyncBN opt-in) has no
+  cheap GSPMD analogue and normalises over fewer samples anyway; global
+  stats are the strictly-more-correct behavior the reference opts into
+  via SyncBatchNorm.
 - A `cifar_stem` flag swaps the 7x7/s2+maxpool ImageNet stem for the 3x3/s1
   stem every CIFAR ResNet-18 recipe uses — the reference's config 1 vs 2
   distinction (BASELINE.json:7 vs :8).

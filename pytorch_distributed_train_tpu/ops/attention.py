@@ -13,9 +13,53 @@ and avoids the NCHW-style transposes torch attention does.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
+
+# Process-wide default for impl="auto" callers. Models thread their own
+# ModelConfig.attention_impl as a static module attr, so this global is
+# only the operator-level control. Resolution order for an "auto" call:
+# PDTT_ATTENTION_IMPL env var > set_default_impl() > "auto" heuristic.
+# The torch analogue is the global torch.backends.cuda.sdp_kernel switch.
+_default_impl = "auto"
+
+_VALID_IMPLS = ("auto", "xla", "pallas")
+
+
+def set_default_impl(impl: str) -> None:
+    if impl not in _VALID_IMPLS:
+        raise ValueError(f"attention impl must be auto|xla|pallas, got {impl!r}")
+    global _default_impl
+    _default_impl = impl
+
+
+def _env_impl() -> str | None:
+    env = os.environ.get("PDTT_ATTENTION_IMPL")
+    if env is not None and env not in _VALID_IMPLS:
+        raise ValueError(
+            f"PDTT_ATTENTION_IMPL must be auto|xla|pallas, got {env!r}"
+        )
+    return env
+
+
+def _resolve_default_impl() -> str:
+    return _env_impl() or _default_impl
+
+
+def _pallas_usable() -> bool:
+    """Whether impl='auto' may pick the Pallas kernel on this backend.
+
+    The sandbox's tunnelled axon PJRT (JAX_PLATFORMS=axon, remote compile)
+    cannot compile Mosaic kernels — a tiny flash-attention fwd hung >8 min
+    and wedged the device lease. Explicit impl='pallas' still forces the
+    kernel anywhere. Checks both the env var and the live jax config (the
+    backend can be selected either way).
+    """
+    cfg_platforms = getattr(jax.config, "jax_platforms", None) or ""
+    return ("axon" not in os.environ.get("JAX_PLATFORMS", "")
+            and "axon" not in cfg_platforms)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +118,15 @@ def dot_product_attention(
     and always does fp32 chunk softmax — same as the default
     ``softmax_dtype``, which cp paths do not override.
     """
+    # The env var is the operator's kill switch: it beats EVERYTHING,
+    # including an explicit impl arg or a config-threaded backend — its
+    # whole purpose is preventing Mosaic-compile hangs no matter what the
+    # config says.
+    env = _env_impl()
+    if env is not None:
+        impl = env
+    elif impl == "auto":
+        impl = _default_impl
     if cp is not None and cp.active:
         if cp.impl == "ring":
             if mask is not None:
@@ -108,8 +161,11 @@ def dot_product_attention(
         if _fa.supported(q, k, v, causal=causal, mask=mask):
             # impl='pallas' forces the kernel anywhere (interpret mode off-TPU
             # — slow but exact, which is what tests and debugging want);
-            # 'auto' uses it only on TPU where it pays off.
-            if impl == "pallas" or (on_tpu and _fa.profitable(q)):
+            # 'auto' uses it only on TPU where it pays off and the backend
+            # can actually compile Mosaic (_pallas_usable).
+            if impl == "pallas" or (
+                on_tpu and _pallas_usable() and _fa.profitable(q)
+            ):
                 from pytorch_distributed_train_tpu.ops.cp_common import (
                     expand_kv_heads,
                 )

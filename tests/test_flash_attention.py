@@ -106,3 +106,53 @@ def test_supported_gates():
     assert not supported(q2, k2, v2, causal=False, mask=None)
     q3, k3, v3 = _make_qkv(S=256, D=48)  # D not lane-aligned
     assert not supported(q3, k3, v3, causal=False, mask=None)
+
+
+def test_default_impl_override(monkeypatch):
+    """Backend selection: ModelConfig.attention_impl threads into the module
+    tree (no process-global state); set_default_impl is the operator-level
+    control for impl='auto' callers; PDTT_ATTENTION_IMPL is the kill switch
+    that beats everything, including explicit impl args."""
+    from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.ops import attention as attn
+
+    monkeypatch.delenv("PDTT_ATTENTION_IMPL", raising=False)
+    orig = attn._default_impl
+    try:
+        attn.set_default_impl("xla")
+        q, k, v = _make_qkv(B=1, S=2048, H=2, D=128)  # supported+profitable
+        out = attn.dot_product_attention(q, k, v, causal=True)  # impl="auto"
+        ref = attn._xla_attention(q, k, v, causal=True, mask=None,
+                                  softmax_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+        # the config knob is a static module attr — two models with
+        # different backends coexist, nothing global mutates
+        tiny = dict(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+                    mlp_dim=64, max_seq_len=16)
+        m_xla = build_model(ModelConfig(name="llama", **tiny,
+                                        attention_impl="xla"),
+                            PrecisionConfig())
+        m_auto = build_model(ModelConfig(name="llama", **tiny),
+                             PrecisionConfig())
+        assert m_xla.attn_impl == "xla" and m_auto.attn_impl == "auto"
+        assert attn._default_impl == "xla"  # untouched by builds
+
+        # env var beats the setter, an explicit impl arg, and the heuristic
+        monkeypatch.setenv("PDTT_ATTENTION_IMPL", "xla")
+        attn.set_default_impl("pallas")
+        assert attn._resolve_default_impl() == "xla"
+        out_env = attn.dot_product_attention(q, k, v, causal=True,
+                                             impl="pallas")
+        np.testing.assert_array_equal(np.asarray(out_env), np.asarray(ref))
+
+        monkeypatch.setenv("PDTT_ATTENTION_IMPL", "flash")
+        with pytest.raises(ValueError, match="PDTT_ATTENTION_IMPL"):
+            attn.dot_product_attention(q, k, v, causal=True)
+        monkeypatch.delenv("PDTT_ATTENTION_IMPL")
+
+        with pytest.raises(ValueError, match="auto|xla|pallas"):
+            attn.set_default_impl("nope")
+    finally:
+        attn._default_impl = orig
