@@ -136,3 +136,146 @@ def load_flax_safetensors(path: str, template: Any) -> Any:
                 )
             leaves.append(arr.astype(np.dtype(leaf.dtype)))
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ----------------------------------------------------- HF transformers bridge
+#
+# Name-level mapping to the HuggingFace state_dict conventions for the LM
+# families, so checkpoints are mutually legible with the torch ecosystem the
+# reference lives in (SURVEY hard part #2): our Llama ↔ HF LlamaForCausalLM,
+# our BERT MLM ↔ HF BertForMaskedLM. Layout notes: flax Dense kernels are
+# (in, out) vs torch Linear (out, in); DenseGeneral attention projections
+# carry explicit (heads, head_dim) axes that HF fuses into one dim. Our RoPE
+# uses the halves ("rotate_half") convention — the same as HF's modeling
+# code, so q/k projections map with NO permutation (unlike Meta→HF
+# conversion, which must interleave).
+#
+# Transform tags reuse the generic bridge's vocabulary (_to_torch /
+# _from_torch): dense_T (in,out)→(out,in), dgen_out3 (C,H,D)→(H·D,C),
+# dgen_in3 (H,D,C)→(C,H·D); plus HF-only "flat" (squeeze/flatten to the HF
+# shape) and "none".
+
+_HF_RULES: dict[str, list[tuple[str, str, str]]] = {
+    "llama": [
+        (r"^tok_embed/embedding$", "model.embed_tokens.weight", "none"),
+        (r"^layer(\d+)/attn/(q_proj|k_proj|v_proj)/kernel$",
+         "model.layers.{0}.self_attn.{1}.weight", "dgen_out3"),
+        (r"^layer(\d+)/attn/o_proj/kernel$",
+         "model.layers.{0}.self_attn.o_proj.weight", "dgen_in3"),
+        (r"^layer(\d+)/mlp/(gate_proj|up_proj|down_proj)/kernel$",
+         "model.layers.{0}.mlp.{1}.weight", "dense_T"),
+        (r"^layer(\d+)/input_norm/scale$",
+         "model.layers.{0}.input_layernorm.weight", "none"),
+        (r"^layer(\d+)/post_attn_norm/scale$",
+         "model.layers.{0}.post_attention_layernorm.weight", "none"),
+        (r"^final_norm/scale$", "model.norm.weight", "none"),
+        (r"^lm_head/kernel$", "lm_head.weight", "dense_T"),
+    ],
+    "bert": [
+        (r"^word_embed/embedding$",
+         "bert.embeddings.word_embeddings.weight", "none"),
+        (r"^pos_embed$", "bert.embeddings.position_embeddings.weight", "flat"),
+        (r"^type_embed/embedding$",
+         "bert.embeddings.token_type_embeddings.weight", "none"),
+        (r"^embed_ln/scale$", "bert.embeddings.LayerNorm.weight", "none"),
+        (r"^embed_ln/bias$", "bert.embeddings.LayerNorm.bias", "none"),
+        (r"^layer(\d+)/attn/(query|key|value)/kernel$",
+         "bert.encoder.layer.{0}.attention.self.{1}.weight", "dgen_out3"),
+        (r"^layer(\d+)/attn/(query|key|value)/bias$",
+         "bert.encoder.layer.{0}.attention.self.{1}.bias", "flat"),
+        (r"^layer(\d+)/attn/attn_out/kernel$",
+         "bert.encoder.layer.{0}.attention.output.dense.weight", "dgen_in3"),
+        (r"^layer(\d+)/attn/attn_out/bias$",
+         "bert.encoder.layer.{0}.attention.output.dense.bias", "none"),
+        (r"^layer(\d+)/ln_attn/scale$",
+         "bert.encoder.layer.{0}.attention.output.LayerNorm.weight", "none"),
+        (r"^layer(\d+)/ln_attn/bias$",
+         "bert.encoder.layer.{0}.attention.output.LayerNorm.bias", "none"),
+        (r"^layer(\d+)/mlp_in/kernel$",
+         "bert.encoder.layer.{0}.intermediate.dense.weight", "dense_T"),
+        (r"^layer(\d+)/mlp_in/bias$",
+         "bert.encoder.layer.{0}.intermediate.dense.bias", "none"),
+        (r"^layer(\d+)/mlp_out/kernel$",
+         "bert.encoder.layer.{0}.output.dense.weight", "dense_T"),
+        (r"^layer(\d+)/mlp_out/bias$",
+         "bert.encoder.layer.{0}.output.dense.bias", "none"),
+        (r"^layer(\d+)/ln_mlp/scale$",
+         "bert.encoder.layer.{0}.output.LayerNorm.weight", "none"),
+        (r"^layer(\d+)/ln_mlp/bias$",
+         "bert.encoder.layer.{0}.output.LayerNorm.bias", "none"),
+        (r"^mlm_dense/kernel$",
+         "cls.predictions.transform.dense.weight", "dense_T"),
+        (r"^mlm_dense/bias$", "cls.predictions.transform.dense.bias", "none"),
+        (r"^mlm_ln/scale$",
+         "cls.predictions.transform.LayerNorm.weight", "none"),
+        (r"^mlm_ln/bias$", "cls.predictions.transform.LayerNorm.bias", "none"),
+        (r"^mlm_bias$", "cls.predictions.bias", "none"),
+    ],
+}
+
+
+def _hf_rules(family: str):
+    import re as _re
+
+    for prefix, rules in _HF_RULES.items():
+        if family.startswith(prefix):
+            return [(_re.compile(pat), fmt, tr) for pat, fmt, tr in rules]
+    raise KeyError(f"no HF mapping for model family {family!r} "
+                   f"(have {sorted(_HF_RULES)})")
+
+
+def _hf_name(name: str, rules) -> tuple[str, str]:
+    for pat, fmt, tr in rules:
+        m = pat.match(name)
+        if m:
+            return fmt.format(*m.groups()), tr
+    raise KeyError(f"param {name!r} has no HF mapping rule")
+
+
+def to_hf_state_dict(params: Any, family: str) -> dict[str, np.ndarray]:
+    """Flax param tree → HF-convention numpy state dict.
+
+    For BERT the tied decoder entries (``cls.predictions.decoder.*``) are
+    emitted too, so ``BertForMaskedLM.load_state_dict`` is satisfied without
+    relying on HF's tying hooks.
+    """
+    rules = _hf_rules(family)
+    out: dict[str, np.ndarray] = {}
+    for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = _path_str(p)
+        hf, tr = _hf_name(name, rules)
+        arr = np.asarray(jax.device_get(leaf))
+        if tr == "flat":
+            # pos_embed (1,L,C) → (L,C); fused (H,D) biases → (H·D,)
+            arr = arr[0] if (arr.ndim == 3 and arr.shape[0] == 1) else arr.reshape(-1)
+            arr = np.ascontiguousarray(arr)
+        else:
+            arr = _to_torch(arr, tr)
+        out[hf] = arr
+    if family.startswith("bert"):
+        out["cls.predictions.decoder.weight"] = out[
+            "bert.embeddings.word_embeddings.weight"]
+        out["cls.predictions.decoder.bias"] = out["cls.predictions.bias"]
+    return out
+
+
+def from_hf_state_dict(state_dict: dict, template: Any, family: str) -> Any:
+    """HF-convention state dict (numpy or torch tensors) → flax param tree
+    shaped like ``template``."""
+    rules = _hf_rules(family)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for p, leaf in flat:
+        name = _path_str(p)
+        hf, tr = _hf_name(name, rules)
+        arr = state_dict[hf]
+        if hasattr(arr, "detach"):  # torch tensor
+            arr = arr.detach().cpu().numpy()
+        arr = np.asarray(arr)
+        shape = tuple(leaf.shape)
+        arr = (arr.reshape(shape) if tr == "flat"
+               else _from_torch(arr, tr, shape))
+        if arr.shape != shape:
+            raise ValueError(f"{hf}: shape {arr.shape} != template {shape}")
+        leaves.append(np.ascontiguousarray(arr).astype(np.dtype(leaf.dtype)))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

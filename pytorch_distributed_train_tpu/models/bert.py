@@ -78,7 +78,7 @@ class BertLayer(nn.Module):
         x = ln("ln_attn")(x + attn).astype(self.dtype)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
                      name="mlp_in")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # exact erf GELU (BERT/HF convention)
         h = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=self.param_dtype,
                      name="mlp_out")(h)
         h = nn.Dropout(self.dropout_rate)(h, deterministic=self.deterministic)
@@ -148,7 +148,7 @@ class BertForMLM(nn.Module):
         # MLM head: dense + GELU + LN, then decode against tied word embeddings.
         h = nn.Dense(self.hidden_size, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="mlm_dense")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # exact erf GELU (BERT/HF convention)
         h = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32,
                          name="mlm_ln")(h)
         # Tied-embedding decode in the compute dtype with fp32 accumulation:

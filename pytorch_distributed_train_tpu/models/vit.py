@@ -42,6 +42,7 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
@@ -55,7 +56,7 @@ class MultiHeadAttention(nn.Module):
             name=name,
         )
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        y = dot_product_attention(q, k, v)
+        y = dot_product_attention(q, k, v, impl=self.attn_impl)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
             name="attn_out",
@@ -73,6 +74,7 @@ class EncoderBlock(nn.Module):
     deterministic: bool
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
@@ -81,7 +83,7 @@ class EncoderBlock(nn.Module):
         )
         x = x + MultiHeadAttention(
             self.num_heads, self.dropout_rate, self.dtype, self.param_dtype,
-            name="attn",
+            attn_impl=self.attn_impl, name="attn",
         )(norm("ln1")(x).astype(self.dtype), self.deterministic)
         x = x + MlpBlock(
             self.mlp_dim, self.dropout_rate, self.dtype, self.param_dtype,
@@ -103,6 +105,7 @@ class ViT(nn.Module):
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -131,7 +134,8 @@ class ViT(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
-                self.dtype, self.param_dtype, name=f"block{i}",
+                self.dtype, self.param_dtype, attn_impl=self.attn_impl,
+                name=f"block{i}",
             )(x)
 
         x = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, param_dtype=jnp.float32,
@@ -147,6 +151,7 @@ class ViT(nn.Module):
 def vit_b16(cfg, dtype, param_dtype, cp=None) -> ViT:
     del cp  # patch-seq CP not useful at ViT scale (197 tokens)
     return ViT(
+        attn_impl=getattr(cfg, "attention_impl", "auto"),
         num_classes=cfg.num_classes,
         patch_size=cfg.patch_size,
         hidden_size=cfg.hidden_size,

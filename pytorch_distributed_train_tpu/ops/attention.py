@@ -118,6 +118,8 @@ def dot_product_attention(
     and always does fp32 chunk softmax — same as the default
     ``softmax_dtype``, which cp paths do not override.
     """
+    if impl not in _VALID_IMPLS:
+        raise ValueError(f"attention impl must be auto|xla|pallas, got {impl!r}")
     # The env var is the operator's kill switch: it beats EVERYTHING,
     # including an explicit impl arg or a config-threaded backend — its
     # whole purpose is preventing Mosaic-compile hangs no matter what the

@@ -14,6 +14,14 @@ from pytorch_distributed_train_tpu.ops.flash_attention import (
 )
 
 
+@pytest.fixture(autouse=True)
+def _no_attention_env(monkeypatch):
+    """The PDTT_ATTENTION_IMPL kill switch overrides even explicit impl
+    args; with it exported the pallas-vs-xla tests would compare XLA to
+    itself. Scrub it for every test in this module."""
+    monkeypatch.delenv("PDTT_ATTENTION_IMPL", raising=False)
+
+
 def _make_qkv(B=2, S=256, H=2, D=64, seed=0, dtype=jnp.float32):
     rng = np.random.default_rng(seed)
     mk = lambda: jnp.asarray(  # noqa: E731
