@@ -1,0 +1,106 @@
+"""Autoregressive generation with a KV cache (Llama family).
+
+The inference counterpart of the training harness — torch-ecosystem
+analogue: HF ``model.generate(past_key_values=...)``. TPU-first shape
+discipline: the KV cache is a STATIC (B, max_seq_len, H_kv, D) buffer per
+layer (flax 'cache' collection, models/llama.py decode mode), the prefill
+is one jitted call over the whole prompt, and every subsequent token is the
+same jitted single-token step — two executables total, no shape-dependent
+recompilation as the sequence grows (dynamic shapes would leave the MXU —
+SURVEY §7.4.5).
+
+Sampling: greedy, temperature, and top-k — jax.random.categorical on
+fp32 logits; deterministic under a fixed key.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+from pytorch_distributed_train_tpu.models.registry import build_model
+
+
+def build_decode_model(model_cfg: ModelConfig, precision: PrecisionConfig):
+    """The decode-mode twin of a training model: same params tree, KV cache
+    enabled, remat off (pointless without a backward pass)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(model_cfg, remat=False)
+    model = build_model(cfg, precision)
+    if not any(f.name == "decode" for f in dataclasses.fields(model)):
+        raise ValueError(
+            f"model {model_cfg.name!r} has no decode mode (generation is "
+            "causal-LM only)")
+    return dataclasses.replace(model, decode=True)
+
+
+def init_cache(model, batch: int) -> Any:
+    """Allocate the static KV cache for ``batch`` sequences.
+
+    Shapes come from eval_shape (no param re-init, no FLOPs); every cache
+    entry starts as zeros — including the int32 cache_index."""
+    ids = jnp.zeros((batch, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)}, ids,
+                           train=False))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _decode_step(model, params, cache, ids):
+    logits, updated = model.apply(
+        {"params": params, "cache": cache}, ids, train=False,
+        mutable=["cache"],
+    )
+    return logits[:, -1], updated["cache"]
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, params, prompt_ids, max_new_tokens: int,
+             *, temperature: float = 0.0, top_k: int = 0,
+             rng=None, eos_id: int | None = None) -> jnp.ndarray:
+    """Generate continuations for a (B, S) int32 prompt batch.
+
+    Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
+    call; each new token reuses the jitted single-token step (cache donated
+    in-place). With ``temperature=0`` decoding is greedy and deterministic;
+    ``eos_id`` freezes finished rows (emitted tokens stay ``eos_id``).
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, S = prompt_ids.shape
+    if S + max_new_tokens > model.max_seq_len:
+        raise ValueError(
+            f"prompt ({S}) + new tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({model.max_seq_len})")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    cache = init_cache(model, B)
+    logits, cache = _decode_step(model, params, cache, prompt_ids)  # prefill
+
+    out = [prompt_ids]
+    done = jnp.zeros((B,), bool)
+    for i in range(max_new_tokens):
+        rng, step_rng = jax.random.split(rng)
+        nxt = _sample(logits, step_rng, temperature, top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        out.append(nxt[:, None])
+        if i + 1 < max_new_tokens:  # last sample needs no further forward
+            logits, cache = _decode_step(model, params, cache, nxt[:, None])
+    return jnp.concatenate(out, axis=1)

@@ -71,6 +71,11 @@ class LlamaAttention(nn.Module):
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
+    # Autoregressive decode: maintain a (B, max_seq_len, H_kv, D) KV cache in
+    # the flax 'cache' collection (the idiomatic flax decode pattern — torch
+    # analogue: HF past_key_values). Works for both the prefill call (S>1 at
+    # offset 0) and single-token steps (S=1 at the running offset).
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -85,12 +90,37 @@ class LlamaAttention(nn.Module):
         k = proj(self.num_kv_heads, "k_proj")(x)
         v = proj(self.num_kv_heads, "v_proj")(x)
 
-        cos, sin = rope_frequencies(head_dim, S, self.rope_theta)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if self.decode:
+            L = self.max_seq_len
+            c_k = self.variable("cache", "cached_key", jnp.zeros,
+                                (B, L, self.num_kv_heads, head_dim), k.dtype)
+            c_v = self.variable("cache", "cached_value", jnp.zeros,
+                                (B, L, self.num_kv_heads, head_dim), v.dtype)
+            c_i = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            idx = c_i.value
+            cos, sin = rope_frequencies(head_dim, L, self.rope_theta)
+            cos = jax.lax.dynamic_slice_in_dim(cos, idx, S, 0)
+            sin = jax.lax.dynamic_slice_in_dim(sin, idx, S, 0)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            c_k.value = jax.lax.dynamic_update_slice_in_dim(c_k.value, k, idx, 1)
+            c_v.value = jax.lax.dynamic_update_slice_in_dim(c_v.value, v, idx, 1)
+            c_i.value = idx + S
+            # causal mask against absolute positions; cache tail (>= idx+S)
+            # is masked out, so the static cache length never leaks garbage
+            q_pos = idx + jnp.arange(S)
+            k_pos = jnp.arange(L)
+            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # (1,1,S,L)
+            y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
+                                      impl="xla")
+        else:
+            cos, sin = rope_frequencies(head_dim, S, self.rope_theta)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
 
-        y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
-                                  impl=self.attn_impl)
+            y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
+                                      impl=self.attn_impl)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -127,6 +157,7 @@ class LlamaBlock(nn.Module):
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None  # set → MoE FFN instead of dense (ops/moe.py)
     attn_impl: str = "auto"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -134,7 +165,7 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.rope_theta,
             self.max_seq_len, self.dtype, self.param_dtype, cp=self.cp,
-            attn_impl=self.attn_impl, name="attn",
+            attn_impl=self.attn_impl, decode=self.decode, name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         if self.moe is not None:
@@ -167,6 +198,7 @@ class LlamaForCausalLM(nn.Module):
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None
     attn_impl: str = "auto"
+    decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # SP/CP activation anchoring (parallel/mesh.py ActivationSharding):
     # keeps norms/residuals seq-sharded between attention / TP-matmul
     # regions — CP without it replicates seq outside the shard_map regions;
@@ -192,7 +224,8 @@ class LlamaForCausalLM(nn.Module):
                 self.num_heads, self.num_kv_heads, self.mlp_dim,
                 self.rope_theta, self.max_seq_len, self.rms_norm_eps,
                 self.dtype, self.param_dtype, cp=self.cp, moe=moe,
-                attn_impl=self.attn_impl, name=f"layer{i}",
+                attn_impl=self.attn_impl, decode=self.decode,
+                name=f"layer{i}",
             )(x)
             if self.act is not None:
                 x = self.act.constrain(x)
