@@ -15,7 +15,7 @@ fp32 logits; deterministic under a fixed key.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any
 
 import jax
@@ -39,17 +39,23 @@ def build_decode_model(model_cfg: ModelConfig, precision: PrecisionConfig):
     return dataclasses.replace(model, decode=True)
 
 
-def init_cache(model, batch: int) -> Any:
-    """Allocate the static KV cache for ``batch`` sequences.
-
-    Shapes come from eval_shape (no param re-init, no FLOPs); every cache
-    entry starts as zeros — including the int32 cache_index."""
+@lru_cache(maxsize=16)
+def _cache_shapes(model, batch: int):
     ids = jnp.zeros((batch, 1), jnp.int32)
     shapes = jax.eval_shape(
         lambda: model.init({"params": jax.random.PRNGKey(0)}, ids,
                            train=False))
+    return shapes["cache"]
+
+
+def init_cache(model, batch: int) -> Any:
+    """Allocate the static KV cache for ``batch`` sequences.
+
+    Shapes come from one memoized eval_shape per (model, batch) — no param
+    re-init, no repeated full-model trace per generate() call; fresh zero
+    buffers each time (the decode step donates the cache in place)."""
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                        shapes["cache"])
+                        _cache_shapes(model, batch))
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
@@ -78,8 +84,11 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
 
     Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
     call; each new token reuses the jitted single-token step (cache donated
-    in-place). With ``temperature=0`` decoding is greedy and deterministic;
-    ``eos_id`` freezes finished rows (emitted tokens stay ``eos_id``).
+    in-place). Decode contract (models/llama.py): a multi-token call means
+    "prefill this cache from position 0"; continuation past a prefill is
+    single-token steps only. With ``temperature=0`` decoding is greedy and
+    deterministic; ``eos_id`` freezes finished rows (emitted tokens stay
+    ``eos_id``).
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     B, S = prompt_ids.shape

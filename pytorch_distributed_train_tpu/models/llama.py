@@ -98,22 +98,42 @@ class LlamaAttention(nn.Module):
                                 (B, L, self.num_kv_heads, head_dim), v.dtype)
             c_i = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
-            idx = c_i.value
-            cos, sin = rope_frequencies(head_dim, L, self.rope_theta)
-            cos = jax.lax.dynamic_slice_in_dim(cos, idx, S, 0)
-            sin = jax.lax.dynamic_slice_in_dim(sin, idx, S, 0)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            c_k.value = jax.lax.dynamic_update_slice_in_dim(c_k.value, k, idx, 1)
-            c_v.value = jax.lax.dynamic_update_slice_in_dim(c_v.value, v, idx, 1)
-            c_i.value = idx + S
-            # causal mask against absolute positions; cache tail (>= idx+S)
-            # is masked out, so the static cache length never leaks garbage
-            q_pos = idx + jnp.arange(S)
-            k_pos = jnp.arange(L)
-            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # (1,1,S,L)
-            y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
-                                      impl="xla")
+            if S > 1:
+                # Prefill: a multi-token decode call means "start this cache
+                # from position 0" (generate.py's contract). Positions are
+                # static, attention is plain causal over the PROMPT ONLY —
+                # O(S^2), not O(S*L) over the padded cache — and the
+                # configured attn_impl (incl. Pallas) still applies.
+                cos, sin = rope_frequencies(head_dim, S, self.rope_theta)
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+                c_k.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_k.value, k, 0, 1)
+                c_v.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_v.value, v, 0, 1)
+                c_i.value = jnp.full((), S, jnp.int32)
+                y = dot_product_attention(q, k, v, causal=True,
+                                          impl=self.attn_impl)
+            else:
+                # Single-token step at the running offset (dynamic index).
+                idx = c_i.value
+                cos, sin = rope_frequencies(head_dim, L, self.rope_theta)
+                cos = jax.lax.dynamic_slice_in_dim(cos, idx, S, 0)
+                sin = jax.lax.dynamic_slice_in_dim(sin, idx, S, 0)
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+                c_k.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_k.value, k, idx, 1)
+                c_v.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_v.value, v, idx, 1)
+                c_i.value = idx + S
+                # mask against absolute positions; the unwritten cache tail
+                # (> idx) is masked out so the static length leaks nothing
+                q_pos = idx + jnp.arange(S)
+                k_pos = jnp.arange(L)
+                mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+                y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
+                                          impl="xla")
         else:
             cos, sin = rope_frequencies(head_dim, S, self.rope_theta)
             q = apply_rope(q, cos, sin)

@@ -42,8 +42,16 @@ def setup():
     return cfg, train_model, params, ids
 
 
-def test_decode_matches_full_forward(setup):
-    cfg, train_model, params, ids = setup
+@pytest.mark.parametrize("kv_heads", [H, 1])  # MHA and GQA cache layouts
+def test_decode_matches_full_forward(kv_heads):
+    import dataclasses
+
+    cfg = dataclasses.replace(_tiny_cfg(), num_kv_heads=kv_heads)
+    train_model = build_model(cfg, PrecisionConfig())
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, V, (2, 10)),
+                      jnp.int32)
+    params = train_model.init({"params": jax.random.PRNGKey(0)}, ids,
+                              train=False)["params"]
     full = train_model.apply({"params": params}, ids, train=False)
 
     dm = build_decode_model(cfg, PrecisionConfig())

@@ -1,0 +1,62 @@
+"""Profiler-report utility (utils/xplane.py): aggregation over a
+synthetic XPlane proto, plus classification rules."""
+
+import os
+
+import pytest
+
+from pytorch_distributed_train_tpu.utils import xplane as xp
+
+
+def test_classify_op():
+    assert xp.classify_op("%fusion.123") == "fusion"
+    assert xp.classify_op("%convolution.4") == "convolution"
+    assert xp.classify_op("%all-reduce.1") == "collective"
+    assert xp.classify_op("%copy-start.9") == "copy"
+    assert xp.classify_op("%dot.2") == "matmul"
+    assert xp.classify_op("custom-call.foo") == "other"
+
+
+def _build_space(xplane_pb2):
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add(name="/device:TPU:0")
+    for i, name in enumerate(["%fusion.1", "%convolution.2", "step"],
+                             start=1):
+        m = plane.event_metadata[i]
+        m.id, m.name = i, name
+    line = plane.lines.add(name="XLA Ops")
+    for md, dur_ms in ((1, 3.0), (1, 2.0), (2, 5.0)):
+        ev = line.events.add()
+        ev.metadata_id = md
+        ev.duration_ps = int(dur_ms * 1e9)
+    host = xs.planes.add(name="/host:CPU")
+    hm = host.event_metadata[1]
+    hm.id, hm.name = 1, "python"
+    hev = host.lines.add(name="py").events.add()
+    hev.metadata_id = 1
+    hev.duration_ps = int(99e9)
+    return xs
+
+
+def test_summarize_and_report(tmp_path):
+    xplane_pb2 = pytest.importorskip(
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    xs = _build_space(xplane_pb2)
+
+    planes = xp.summarize_xspace(xs)
+    assert len(planes) == 1  # host plane filtered out
+    p = planes[0]
+    assert p["plane"] == "/device:TPU:0"
+    assert abs(p["total_ms"] - 10.0) < 1e-6
+    assert p["ops"][0] == ("%fusion.1", 5.0, 2)
+    assert abs(p["by_class"]["convolution"] - 5.0) < 1e-6
+
+    d = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(d)
+    with open(d / "host.xplane.pb", "wb") as f:
+        f.write(xs.SerializeToString())
+    text = xp.report(str(tmp_path))
+    assert "/device:TPU:0" in text and "fusion" in text
+    assert "host.xplane.pb" in text
+
+    assert "no *.xplane.pb" in xp.report(str(tmp_path / "empty"))
