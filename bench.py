@@ -33,15 +33,103 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
+import threading
 import time
 
 VISION = ("resnet18", "resnet50", "vit_b16")
 
 
+_progress_ts = [time.monotonic()]
+
+
+def _touch() -> None:
+    """Mark bench progress (resets the watchdog deadline)."""
+    _progress_ts[0] = time.monotonic()
+
+
+def _arm_watchdog(seconds: float) -> None:
+    """Hard-exit if the bench makes NO PROGRESS for ``seconds``.
+
+    Progress points (_touch): imports/backend up, state initialized, warmup
+    executed, timing done. A wedged device lease (observed on the axon
+    tunnel after an orphaned remote compile) blocks the first jnp call
+    forever; a CI driver should get a loud nonzero exit instead of an
+    eternal hang — while a healthy long run keeps resetting the deadline.
+    Override with BENCH_TIMEOUT_S; 0 disables."""
+    def watch():
+        while True:
+            idle = time.monotonic() - _progress_ts[0]
+            if idle > seconds:
+                print(
+                    f"bench.py watchdog: no progress for {idle:.0f}s — "
+                    "device backend likely unavailable/wedged; aborting",
+                    file=sys.stderr, flush=True)
+                os._exit(3)
+            time.sleep(min(60.0, seconds / 4))
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
+def pipeline_bench(args) -> None:
+    """Host input-pipeline throughput (SURVEY hard part #1): sampler →
+    batch augment/normalize → numpy batches, NO device involved. The
+    augment is the fused C++ pass (native/imgops, internally multithreaded)
+    on u8 storage; with the native build absent it falls back to the
+    single-threaded numpy path — the metric name records which one ran so
+    the numbers aren't conflated. (The per-item thread pool and the
+    producer/prefetch stages don't apply to array-style datasets; what's
+    measured here is the per-batch collate cost the train loop overlaps
+    with device steps.)"""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the TPU here
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.config import DataConfig
+    from pytorch_distributed_train_tpu.data.datasets import U8ImageDataset
+    from pytorch_distributed_train_tpu.data.pipeline import HostDataLoader
+    from pytorch_distributed_train_tpu.native import imgops
+
+    size = args.image_size
+    n = 4096
+    batch = args.batch_per_chip or 256
+    if batch * 2 > n:
+        raise SystemExit(
+            f"--batch-per-chip {batch} too large for the {n}-sample "
+            "synthetic dataset (need >= 2 batches: 1 warmup + 1 timed)")
+    rng = np.random.default_rng(0)
+    ds = U8ImageDataset(
+        rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8),
+        rng.integers(0, 1000, n).astype(np.int32),
+        mean=np.array([0.485, 0.456, 0.406], np.float32),
+        std=np.array([0.229, 0.224, 0.225], np.float32),
+        augment=True,
+    )
+    cfg = DataConfig(batch_size=batch)
+    loader = HostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)
+
+    it = loader.epoch(0)
+    next(it)  # warm caches
+    _touch()
+    t0 = time.perf_counter()
+    seen = 0
+    for b in it:
+        seen += len(b["label"])
+    wall = time.perf_counter() - t0
+    _touch()
+    native = "native" if imgops.available() else "numpy"
+    metric = f"input_pipeline_{native}_images_per_sec"
+    print(json.dumps({
+        "metric": metric,
+        "value": round(seen / wall, 2),
+        "unit": "images/sec (host)",
+        "vs_baseline": 1.0,
+    }))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   help="resnet18|resnet50|vit_b16|llama|bert_base")
+                   help="resnet18|resnet50|vit_b16|llama|bert_base|pipeline")
     p.add_argument("--batch-per-chip", type=int, default=0,
                    help="0 → model default (128 vision, 8 llama, 32 bert)")
     p.add_argument("--image-size", type=int, default=224)
@@ -55,6 +143,13 @@ def main() -> None:
                         "under the axon tunnel, whose remote compile hangs "
                         "on Mosaic kernels (ops/attention.py _pallas_usable).")
     args = p.parse_args()
+
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1800"))
+    if timeout_s > 0:
+        _arm_watchdog(timeout_s)
+
+    if args.model == "pipeline":
+        return pipeline_bench(args)
 
     import jax
     import jax.numpy as jnp
@@ -112,6 +207,7 @@ def main() -> None:
     else:
         raise SystemExit(f"unknown bench model {args.model!r}")
 
+    _touch()  # backend import + arg setup done
     model = build_model(model_cfg, PrecisionConfig(compute_dtype="bfloat16"))
     tx, _ = make_optimizer(opt, total_steps=1000)
     rules = rules_for_model(args.model)
@@ -130,6 +226,7 @@ def main() -> None:
     shape = jax.eval_shape(init_state, rng)
     sharding = steps_lib.state_shardings(mesh, rules, shape)
     state = jax.jit(init_state, out_shardings=sharding)(rng)
+    _touch()  # state materialized on device
     step = steps_lib.jit_train_step(
         steps_lib.make_train_step(model, get_loss_fn(loss_name), tx),
         mesh, sharding,
@@ -159,11 +256,13 @@ def main() -> None:
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch, rng)
     float(metrics["loss"])  # value fetch = hard sync (see module docstring)
+    _touch()  # warmup executed
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = step(state, batch, rng)
     loss = float(metrics["loss"])  # forces the whole donated-state chain
+    _touch()  # timed steps executed
     wall = time.perf_counter() - t0
     assert np.isfinite(loss), f"non-finite loss {loss}"
 

@@ -226,6 +226,8 @@ class TrainConfig:
     seed: int = 42
     # Loss: "softmax_xent" (classification) | "mlm_xent" | "causal_lm_xent"
     loss: str = "softmax_xent"
+    # torch CrossEntropyLoss(label_smoothing=) analogue (softmax_xent only)
+    label_smoothing: float = 0.0
 
     # ------------------------------------------------------------------ io
     def to_dict(self) -> dict[str, Any]:

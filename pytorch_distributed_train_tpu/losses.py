@@ -8,16 +8,33 @@ positions instead of gathering a dynamic number of masked/valid tokens
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import optax
 
 
-def softmax_xent(logits, batch, *_):
-    """Classification loss. batch: {'image':…, 'label': (B,) int}."""
+def softmax_xent(logits, batch, *_, label_smoothing: float = 0.0):
+    """Classification loss. batch: {'image':…, 'label': (B,) int}.
+
+    ``label_smoothing`` follows torch's CrossEntropyLoss(label_smoothing=)
+    semantics (uniform mass over classes). Metrics: top-1 always; top-5
+    when the class count allows (the ImageNet recipe's second number).
+    """
     labels = batch["label"]
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    n_cls = logits.shape[-1]
+    if label_smoothing > 0.0:
+        targets = optax.smooth_labels(
+            jax.nn.one_hot(labels, n_cls), label_smoothing)
+        loss = optax.softmax_cross_entropy(logits, targets).mean()
+    else:
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
     acc = (jnp.argmax(logits, axis=-1) == labels).mean()
-    return loss, {"accuracy": acc}
+    metrics = {"accuracy": acc}
+    if n_cls > 5:
+        top5 = jax.lax.top_k(logits, 5)[1]  # (B, 5) indices
+        metrics["top5_accuracy"] = (top5 == labels[:, None]).any(-1).mean()
+    return loss, metrics
 
 
 def mlm_xent(logits, batch, *_):
@@ -60,7 +77,16 @@ LOSSES = {
 }
 
 
-def get_loss_fn(name: str):
+def get_loss_fn(name: str, label_smoothing: float = 0.0):
     if name not in LOSSES:
         raise KeyError(f"unknown loss {name!r}; have {sorted(LOSSES)}")
-    return LOSSES[name]
+    fn = LOSSES[name]
+    if label_smoothing > 0.0:
+        if name != "softmax_xent":
+            raise ValueError(
+                f"label_smoothing is only supported for softmax_xent, "
+                f"not {name!r}")
+        import functools
+
+        return functools.partial(fn, label_smoothing=label_smoothing)
+    return fn

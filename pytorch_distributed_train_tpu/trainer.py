@@ -40,7 +40,8 @@ class Trainer:
         self.batch_axes = tuple(cfg.mesh.batch_axes)
         self.model = build_model(cfg.model, cfg.precision,
                                  mesh=self.mesh, mesh_cfg=cfg.mesh)
-        self.loss_fn = losses_lib.get_loss_fn(cfg.loss)
+        self.loss_fn = losses_lib.get_loss_fn(
+            cfg.loss, label_smoothing=cfg.label_smoothing)
         self.rules = rules_for_model(cfg.model.name)
 
         # ---- data

@@ -1,0 +1,52 @@
+"""Loss functions: label smoothing semantics (vs torch CrossEntropyLoss)
+and top-1/top-5 metrics."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.losses import get_loss_fn
+
+
+def _case(B=8, n_cls=10, seed=0):
+    rng = np.random.default_rng(seed)
+    logits = jnp.asarray(rng.standard_normal((B, n_cls)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, n_cls, B), jnp.int32)
+    return logits, {"label": labels}
+
+
+def test_label_smoothing_matches_torch():
+    torch = pytest.importorskip("torch")
+    logits, batch = _case()
+    for smoothing in (0.0, 0.1):
+        loss, _ = get_loss_fn("softmax_xent", label_smoothing=smoothing)(
+            logits, batch)
+        ref = torch.nn.functional.cross_entropy(
+            torch.from_numpy(np.asarray(logits)),
+            torch.from_numpy(np.asarray(batch["label"]).astype(np.int64)),
+            label_smoothing=smoothing,
+        )
+        np.testing.assert_allclose(float(loss), float(ref), atol=1e-6,
+                                   rtol=1e-6)
+
+
+def test_top5_metric():
+    logits, batch = _case(B=32, n_cls=100, seed=3)
+    _, metrics = get_loss_fn("softmax_xent")(logits, batch)
+    top1 = float(metrics["accuracy"])
+    top5 = float(metrics["top5_accuracy"])
+    assert 0.0 <= top1 <= top5 <= 1.0
+    # brute-force top5 oracle
+    l_np = np.asarray(logits)
+    want = np.mean([
+        int(lbl) in np.argsort(-row)[:5]
+        for row, lbl in zip(l_np, np.asarray(batch["label"]))
+    ])
+    np.testing.assert_allclose(top5, want, atol=1e-6)
+
+
+def test_top5_absent_for_tiny_class_count():
+    logits, batch = _case(n_cls=4, seed=5)
+    _, metrics = get_loss_fn("softmax_xent")(logits, batch)
+    assert "top5_accuracy" not in metrics
