@@ -41,6 +41,7 @@ VISION = ("resnet18", "resnet50", "vit_b16")
 
 
 _progress_ts = [time.monotonic()]
+_watchdog_armed = [False]
 
 
 def _touch() -> None:
@@ -48,23 +49,33 @@ def _touch() -> None:
     _progress_ts[0] = time.monotonic()
 
 
-def _arm_watchdog(seconds: float) -> None:
-    """Hard-exit if the bench makes NO PROGRESS for ``seconds``.
+def _disarm_watchdog() -> None:
+    """Called once warmup has EXECUTED on the device: the backend is proven
+    healthy, and the timed region may legitimately block longer than any
+    fixed idle budget (one un-touchable value fetch spans all timed steps),
+    so the bring-up watchdog stands down."""
+    _watchdog_armed[0] = False
 
-    Progress points (_touch): imports/backend up, state initialized, warmup
-    executed, timing done. A wedged device lease (observed on the axon
-    tunnel after an orphaned remote compile) blocks the first jnp call
-    forever; a CI driver should get a loud nonzero exit instead of an
-    eternal hang — while a healthy long run keeps resetting the deadline.
-    Override with BENCH_TIMEOUT_S; 0 disables."""
+
+def _arm_watchdog(seconds: float) -> None:
+    """Hard-exit if bench BRING-UP makes no progress for ``seconds``.
+
+    Covers backend import → state init → warmup execution: a wedged device
+    lease (observed on the axon tunnel after an orphaned Mosaic remote
+    compile) blocks the first jnp call forever, and a CI driver should get
+    a loud nonzero exit instead of an eternal hang. Progress points
+    (_touch) reset the deadline; after warmup the watchdog disarms (see
+    _disarm_watchdog). Override with BENCH_TIMEOUT_S; 0 disables."""
+    _watchdog_armed[0] = True
+
     def watch():
-        while True:
+        while _watchdog_armed[0]:
             idle = time.monotonic() - _progress_ts[0]
             if idle > seconds:
                 print(
-                    f"bench.py watchdog: no progress for {idle:.0f}s — "
-                    "device backend likely unavailable/wedged; aborting",
-                    file=sys.stderr, flush=True)
+                    f"bench.py watchdog: no bring-up progress for "
+                    f"{idle:.0f}s — device backend likely unavailable/"
+                    "wedged; aborting", file=sys.stderr, flush=True)
                 os._exit(3)
             time.sleep(min(60.0, seconds / 4))
 
@@ -80,7 +91,9 @@ def pipeline_bench(args) -> None:
     the numbers aren't conflated. (The per-item thread pool and the
     producer/prefetch stages don't apply to array-style datasets; what's
     measured here is the per-batch collate cost the train loop overlaps
-    with device steps.)"""
+    with device steps.) Deliberately does NOT seed/read BENCH_BASELINE.json:
+    host throughput scales with whatever else shares the host cores, so a
+    cross-run ratio would gate CI on machine load, not on code."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the TPU here
     import numpy as np
 
@@ -114,8 +127,8 @@ def pipeline_bench(args) -> None:
     seen = 0
     for b in it:
         seen += len(b["label"])
+        _touch()  # per-batch progress (host loop is touchable)
     wall = time.perf_counter() - t0
-    _touch()
     native = "native" if imgops.available() else "numpy"
     metric = f"input_pipeline_{native}_images_per_sec"
     print(json.dumps({
@@ -256,7 +269,7 @@ def main() -> None:
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch, rng)
     float(metrics["loss"])  # value fetch = hard sync (see module docstring)
-    _touch()  # warmup executed
+    _disarm_watchdog()  # warmup executed: backend is healthy
 
     t0 = time.perf_counter()
     for _ in range(args.steps):

@@ -171,6 +171,42 @@ _HF_RULES: dict[str, list[tuple[str, str, str]]] = {
         (r"^final_norm/scale$", "model.norm.weight", "none"),
         (r"^lm_head/kernel$", "lm_head.weight", "dense_T"),
     ],
+    "vit": [
+        (r"^patch_embed/kernel$",
+         "vit.embeddings.patch_embeddings.projection.weight", "conv_oihw"),
+        (r"^patch_embed/bias$",
+         "vit.embeddings.patch_embeddings.projection.bias", "none"),
+        (r"^cls_token$", "vit.embeddings.cls_token", "none"),
+        (r"^pos_embed$", "vit.embeddings.position_embeddings", "none"),
+        (r"^block(\d+)/attn/(query|key|value)/kernel$",
+         "vit.encoder.layer.{0}.attention.attention.{1}.weight", "dgen_out3"),
+        (r"^block(\d+)/attn/(query|key|value)/bias$",
+         "vit.encoder.layer.{0}.attention.attention.{1}.bias", "flat"),
+        (r"^block(\d+)/attn/attn_out/kernel$",
+         "vit.encoder.layer.{0}.attention.output.dense.weight", "dgen_in3"),
+        (r"^block(\d+)/attn/attn_out/bias$",
+         "vit.encoder.layer.{0}.attention.output.dense.bias", "none"),
+        (r"^block(\d+)/ln1/scale$",
+         "vit.encoder.layer.{0}.layernorm_before.weight", "none"),
+        (r"^block(\d+)/ln1/bias$",
+         "vit.encoder.layer.{0}.layernorm_before.bias", "none"),
+        (r"^block(\d+)/ln2/scale$",
+         "vit.encoder.layer.{0}.layernorm_after.weight", "none"),
+        (r"^block(\d+)/ln2/bias$",
+         "vit.encoder.layer.{0}.layernorm_after.bias", "none"),
+        (r"^block(\d+)/mlp/mlp_in/kernel$",
+         "vit.encoder.layer.{0}.intermediate.dense.weight", "dense_T"),
+        (r"^block(\d+)/mlp/mlp_in/bias$",
+         "vit.encoder.layer.{0}.intermediate.dense.bias", "none"),
+        (r"^block(\d+)/mlp/mlp_out/kernel$",
+         "vit.encoder.layer.{0}.output.dense.weight", "dense_T"),
+        (r"^block(\d+)/mlp/mlp_out/bias$",
+         "vit.encoder.layer.{0}.output.dense.bias", "none"),
+        (r"^ln_final/scale$", "vit.layernorm.weight", "none"),
+        (r"^ln_final/bias$", "vit.layernorm.bias", "none"),
+        (r"^head/kernel$", "classifier.weight", "dense_T"),
+        (r"^head/bias$", "classifier.bias", "none"),
+    ],
     "bert": [
         (r"^word_embed/embedding$",
          "bert.embeddings.word_embeddings.weight", "none"),

@@ -80,6 +80,9 @@ LOSSES = {
 def get_loss_fn(name: str, label_smoothing: float = 0.0):
     if name not in LOSSES:
         raise KeyError(f"unknown loss {name!r}; have {sorted(LOSSES)}")
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}")
     fn = LOSSES[name]
     if label_smoothing > 0.0:
         if name != "softmax_xent":

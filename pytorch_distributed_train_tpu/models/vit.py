@@ -29,7 +29,7 @@ class MlpBlock(nn.Module):
         d = x.shape[-1]
         x = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
                      name="mlp_in")(x)
-        x = nn.gelu(x)
+        x = nn.gelu(x, approximate=False)  # exact erf (torchvision/HF ViT)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         x = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
                      name="mlp_out")(x)

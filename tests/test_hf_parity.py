@@ -110,3 +110,36 @@ def test_bert_mlm_logits_match_hf():
 
     back = from_hf_state_dict(sd, jax.eval_shape(lambda: params), "bert")
     _tree_equal(params, back)
+
+
+def test_vit_logits_match_hf():
+    cfg = ModelConfig(name="vit_b16", num_classes=7, image_size=8,
+                      patch_size=4, hidden_size=C, num_layers=L, num_heads=H,
+                      mlp_dim=MLP, dropout_rate=0.0)
+    model = build_model(cfg, PrecisionConfig())
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    params = model.init({"params": jax.random.PRNGKey(2)},
+                        jnp.asarray(x), train=False)["params"]
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=8, patch_size=4, num_channels=3, hidden_size=C,
+        num_hidden_layers=L, num_attention_heads=H, intermediate_size=MLP,
+        hidden_act="gelu", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, layer_norm_eps=1e-6, num_labels=7,
+        attn_implementation="eager",
+    )
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+    sd = {k: torch.from_numpy(v.copy()) for k, v in
+          to_hf_state_dict(params, "vit").items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert not missing, missing
+
+    ours = model.apply({"params": params}, jnp.asarray(x), train=False)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(x.transpose(0, 3, 1, 2))).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=3e-4, rtol=3e-4)
+
+    back = from_hf_state_dict(sd, jax.eval_shape(lambda: params), "vit")
+    _tree_equal(params, back)
