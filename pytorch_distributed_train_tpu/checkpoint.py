@@ -79,10 +79,15 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             return None
+        template = _savable(abstract_state)
+        if "ema_params" in template and not self._ckpt_has(step, "ema_params"):
+            # ckpt written before EMA was enabled: restore without the
+            # mirror, re-seed it from params below
+            template.pop("ema_params")
         restored = self.mgr.restore(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(_savable(abstract_state)),
+                state=ocp.args.StandardRestore(template),
                 meta=ocp.args.JsonRestore(),
             ),
         )
@@ -93,11 +98,24 @@ class CheckpointManager:
             opt_state=_merge_opt_state(abstract_state.opt_state, sav["opt_state"]),
             batch_stats=sav["batch_stats"],
         )
+        if abstract_state.ema_params is not None:
+            # Resume with EMA on: restore the mirror; a ckpt written before
+            # EMA was enabled has no mirror — re-seed from restored params.
+            state = state.replace(
+                ema_params=sav.get("ema_params", sav["params"]))
         if abstract_state.dynamic_scale is not None and "dynamic_scale" in sav:
             state = state.replace(
                 dynamic_scale=abstract_state.dynamic_scale.replace(**sav["dynamic_scale"])
             )
         return state, (restored["meta"] or {})
+
+    def _ckpt_has(self, step: int, key: str) -> bool:
+        """Whether the saved state tree at ``step`` contains ``key``."""
+        try:
+            meta = self.mgr.item_metadata(step)["state"]
+            return key in meta
+        except Exception:
+            return True  # metadata unavailable → assume matching layout
 
     def wait(self) -> None:
         self.mgr.wait_until_finished()
@@ -117,6 +135,8 @@ def _savable(state: TrainState) -> dict[str, Any]:
         "opt_state": state.opt_state,
         "batch_stats": state.batch_stats,
     }
+    if state.ema_params is not None:
+        d["ema_params"] = state.ema_params
     if state.dynamic_scale is not None:
         d["dynamic_scale"] = {
             "scale": state.dynamic_scale.scale,

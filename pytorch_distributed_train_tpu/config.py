@@ -119,6 +119,9 @@ class OptimConfig:
     eps: float = 1e-8
     grad_clip_norm: float = 0.0  # 0 → off
     accum_steps: int = 1  # optax.MultiSteps microbatching (≡ DDP no_sync)
+    # Polyak/EMA weight averaging (torch-recipe "model EMA"): decay per
+    # step, 0 → off. Eval runs on the EMA mirror when enabled.
+    ema_decay: float = 0.0
     # Grad-compression hook (SURVEY C8 ddp_comm_hooks equivalent):
     # "none" | "bf16" | "fp16" | "powersgd" (grad_hooks.py)
     grad_hook: str = "none"

@@ -75,10 +75,13 @@ def _tree_finite(tree) -> jnp.ndarray:
     return finite
 
 
-def make_train_step(model, loss_fn: Callable, tx) -> Callable:
+def make_train_step(model, loss_fn: Callable, tx,
+                    ema_decay: float = 0.0) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
-    closes over the optax transform; jit-wrapped by the caller with explicit
-    shardings."""
+    closes over the optax transform (and the static EMA decay); jit-wrapped
+    by the caller with explicit shardings."""
+    if not 0.0 <= ema_decay < 1.0:
+        raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
 
     def train_step(state: TrainState, batch: dict, rng: jax.Array):
         # Per-step dropout key: fold the step counter into the base key —
@@ -107,7 +110,8 @@ def make_train_step(model, loss_fn: Callable, tx) -> Callable:
             # unscale, check finite, skip update on overflow, adjust scale.
             grads = jax.tree.map(lambda g: g / scale, grads)
             finite = _tree_finite(grads)
-            stepped = state.apply_gradients(tx, grads, new_stats)
+            stepped = state.apply_gradients(tx, grads, new_stats,
+                                            ema_decay=ema_decay)
             skipped = state.replace(step=state.step + 1)  # step advances either way
             new_state = jax.tree.map(
                 lambda new, old: jnp.where(finite, new, old), stepped, skipped
@@ -117,7 +121,8 @@ def make_train_step(model, loss_fn: Callable, tx) -> Callable:
             )
             metrics_extra = {"loss_scale": scale, "grads_finite": finite}
         else:
-            new_state = state.apply_gradients(tx, grads, new_stats)
+            new_state = state.apply_gradients(tx, grads, new_stats,
+                                              ema_decay=ema_decay)
             metrics_extra = {}
 
         gnorm = optax_global_norm(grads)
@@ -138,7 +143,7 @@ def optax_global_norm(tree) -> jnp.ndarray:
 def make_eval_step(model, loss_fn: Callable) -> Callable:
     def eval_step(state: TrainState, batch: dict):
         logits, _, _ = apply_model(
-            model, state.params, state.batch_stats, batch,
+            model, state.eval_params, state.batch_stats, batch,
             train=False, dropout_rng=None,
         )
         loss, aux = loss_fn(logits, batch)

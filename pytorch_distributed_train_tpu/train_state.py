@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.struct
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -60,11 +61,31 @@ class TrainState:
     opt_state: Any
     batch_stats: Any  # BN running stats ({} for stat-free models)
     dynamic_scale: DynamicScale | None = None
+    # Polyak/EMA weight average (the torch-recipe "model EMA"): a params
+    # mirror updated ema = d*ema + (1-d)*params each step; None when off.
+    # Params only — BN stats are not averaged (matters only for BN models;
+    # the classic EMA consumer here is ViT, which has none).
+    ema_params: Any = None
 
     def apply_gradients(self, tx: optax.GradientTransformation, grads,
-                        new_batch_stats=None):
+                        new_batch_stats=None, ema_decay: float = 0.0):
         updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
+        ema = self.ema_params
+        if ema is not None and ema_decay > 0.0:
+            stepped = optax.incremental_update(new_params, ema,
+                                               1.0 - ema_decay)
+            if isinstance(new_opt_state, optax.MultiStepsState):
+                # Under gradient accumulation only the boundary micro-step
+                # changes params; decaying on every micro-step would shorten
+                # the averaging window by accum_steps. mini_step wraps to 0
+                # exactly when the inner optimizer fired.
+                boundary = new_opt_state.mini_step == 0
+                ema = jax.tree.map(
+                    lambda new, old: jnp.where(boundary, new, old),
+                    stepped, ema)
+            else:
+                ema = stepped
         return self.replace(
             step=self.step + 1,
             params=new_params,
@@ -72,14 +93,22 @@ class TrainState:
             batch_stats=(
                 new_batch_stats if new_batch_stats is not None else self.batch_stats
             ),
+            ema_params=ema,
         )
 
+    @property
+    def eval_params(self):
+        """What evaluation should run on: the EMA mirror when enabled."""
+        return self.ema_params if self.ema_params is not None else self.params
+
     @classmethod
-    def create(cls, *, params, tx, batch_stats=None, dynamic_scale=None):
+    def create(cls, *, params, tx, batch_stats=None, dynamic_scale=None,
+               ema: bool = False):
         return cls(
             step=jnp.int32(0),
             params=params,
             opt_state=tx.init(params),
             batch_stats=batch_stats if batch_stats is not None else {},
             dynamic_scale=dynamic_scale,
+            ema_params=params if ema else None,
         )

@@ -84,7 +84,8 @@ class Trainer:
 
         # ---- jitted steps
         self.train_step = steps_lib.jit_train_step(
-            steps_lib.make_train_step(self.model, self.loss_fn, self.tx),
+            steps_lib.make_train_step(self.model, self.loss_fn, self.tx,
+                                      ema_decay=cfg.optim.ema_decay),
             self.mesh, self.state_sharding, self.batch_axes,
         )
         self.eval_step = steps_lib.jit_eval_step(
@@ -145,7 +146,8 @@ class Trainer:
             # overflow as a safety net, like GradScaler with growth off)
             ds = DynamicScale.create(float(ls), growth_interval=2**31 - 1)
         return TrainState.create(
-            params=params, tx=self.tx, batch_stats=batch_stats, dynamic_scale=ds
+            params=params, tx=self.tx, batch_stats=batch_stats,
+            dynamic_scale=ds, ema=self.cfg.optim.ema_decay > 0.0,
         )
 
     def _dummy_inputs(self) -> tuple:
@@ -274,6 +276,10 @@ class Trainer:
             self.rules.tree_shardings(self.mesh, host_params),
         )
         self.state = self.state.replace(params=sharded)
+        if self.state.ema_params is not None:
+            # re-seed the EMA mirror too, else eval would run on the stale
+            # random-init mirror until the EMA horizon washes it out
+            self.state = self.state.replace(ema_params=sharded)
         if jax.process_index() == 0:
             print(f"[interop] warm-started params from {path}", flush=True)
 
