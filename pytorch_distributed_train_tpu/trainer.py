@@ -96,6 +96,7 @@ class Trainer:
         # ---- checkpoint + resume (auto is the default path, SURVEY §5.3b)
         self.ckpt = CheckpointManager(cfg.checkpoint, cfg.to_json())
         self.start_epoch = 0
+        self.resumed = False  # did construction restore a checkpoint?
         resume_mode = cfg.checkpoint.resume
         if resume_mode != "none":
             if resume_mode in ("auto", cfg.checkpoint.dir):
@@ -109,6 +110,7 @@ class Trainer:
                 src.close()
             if restored is not None:
                 self.state, meta = restored
+                self.resumed = True
                 self.start_epoch = int(meta.get("epoch", 0))
                 if jax.process_index() == 0:
                     print(f"[resume] restored step {int(self.state.step)} "

@@ -1,0 +1,48 @@
+"""train.py CLI end-to-end on the CPU harness: train → checkpoint →
+eval-only restore (the reference's validate() mode)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _overrides(tmp_path):
+    return [
+        "--set", "data.dataset=synthetic_images",
+        "--set", "data.synthetic_size=256",
+        "--set", "data.batch_size=32",
+        "--set", "data.eval_batch_size=32",
+        "--set", "obs.log_every_steps=2",
+        "--set", f"checkpoint.dir={tmp_path}/ck",
+        "--set", "checkpoint.save_every_steps=4",
+        "--set", "checkpoint.async_save=false",
+    ]
+
+
+def test_train_then_eval_only(tmp_path, capfd):
+    sys.path.insert(0, REPO)
+    import train
+
+    rc = train.main(["--config", "resnet18_cifar10", "--steps", "4",
+                     *_overrides(tmp_path)])
+    assert rc == 0
+    out = capfd.readouterr().out
+    assert "[train] step=4" in out
+
+    rc = train.main(["--config", "resnet18_cifar10", "--eval-only",
+                     "--resume", "auto", *_overrides(tmp_path)])
+    assert rc == 0
+    out = capfd.readouterr().out
+    assert "[resume] restored step 4" in out
+    assert "[eval]" in out and "accuracy=" in out
+
+
+def test_eval_only_refuses_random_init(tmp_path, capfd):
+    sys.path.insert(0, REPO)
+    import train
+
+    rc = train.main(["--config", "resnet18_cifar10", "--eval-only",
+                     "--resume", "auto", *_overrides(tmp_path)])
+    assert rc == 2
+    assert "refusing to validate" in capfd.readouterr().err

@@ -38,6 +38,10 @@ def parse_args(argv=None):
     p.add_argument("--list-configs", action="store_true")
     p.add_argument("--print-config", action="store_true",
                    help="print resolved config JSON and exit")
+    p.add_argument("--eval-only", action="store_true",
+                   help="restore the latest checkpoint (per --resume) and "
+                        "run one validation pass, then exit — the "
+                        "reference's validate() mode")
     p.add_argument("--export-safetensors", default="", metavar="PATH",
                    help="restore the latest checkpoint (or init) and write "
                         "a torch-layout safetensors file, then exit "
@@ -101,6 +105,16 @@ def main(argv=None) -> int:
         return 0
     if args.import_safetensors:
         trainer.import_params(args.import_safetensors)
+    if args.eval_only:
+        if not (trainer.resumed or args.import_safetensors):
+            print("[eval-only] ERROR: no checkpoint restored and no "
+                  "--import-safetensors — refusing to validate "
+                  "randomly-initialized weights", file=sys.stderr, flush=True)
+            trainer.close()
+            return 2
+        metrics = trainer.evaluate(int(trainer.state.step))
+        trainer.close()
+        return 0 if metrics else 1
     trainer.fit()
     trainer.close()
     return 0
