@@ -131,8 +131,15 @@ def llama_rules() -> list[tuple[str, PartitionSpec]]:
     Matches flax param paths from models/llama.py.
     """
     return [
-        # Embedding: vocab × hidden — shard vocab on tensor, hidden on fsdp
-        (r"tok_embed/embedding$", P("tensor", "fsdp")),
+        # Embedding: vocab × hidden — VOCAB over 'fsdp', hidden unsharded.
+        # This is the GSPMD-friendly gather layout: a vocab-sharded table
+        # lowers to masked-gather + psum over 'fsdp' and the output
+        # inherits the token indices' (batch, seq) sharding directly.
+        # Sharding hidden instead (or vocab over 'tensor', the tied-weight
+        # layout) left the partitioner resharding the gather output via
+        # "involuntary full rematerialization" under tp×cp meshes
+        # (observed in dryrun_multichip).
+        (r"tok_embed/embedding$", P("fsdp", None)),
         # Attention: hidden × (heads·head_dim)
         (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
         (r"o_proj/kernel$", P("tensor", "fsdp")),
@@ -187,7 +194,8 @@ def llama_pp_rules() -> list[tuple[str, PartitionSpec]]:
         (r"blocks/.*(gate_proj|up_proj)/kernel$", P("stage", "fsdp", "tensor")),
         (r"blocks/.*down_proj/kernel$", P("stage", "tensor", "fsdp")),
         (r"blocks/.*scale$", P("stage")),
-        (r"tok_embed/embedding$", P("tensor", "fsdp")),
+        # vocab over 'fsdp' — same gather-friendly layout as llama_rules
+        (r"tok_embed/embedding$", P("fsdp", None)),
         (r"lm_head/kernel$", P("fsdp", "tensor")),
         (r".*", P()),
     ]

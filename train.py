@@ -31,8 +31,9 @@ def parse_args(argv=None):
                    help="path to a full TrainConfig JSON (overrides --config)")
     p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                    help="dotted config override, e.g. optim.learning_rate=0.1")
-    p.add_argument("--resume", default="", choices=["", "auto", "none"],
-                   help="shortcut for checkpoint.resume")
+    p.add_argument("--resume", default="",
+                   help="shortcut for checkpoint.resume: auto | none | "
+                        "/path/to/another/run's/checkpoint/dir")
     p.add_argument("--steps", type=int, default=0,
                    help="cap total steps (smoke runs)")
     p.add_argument("--list-configs", action="store_true")
