@@ -208,6 +208,15 @@ class ObsConfig:
     # tpurun-supervised job crashes exactly once and must recover through
     # checkpoint resume. 0 → off. Test hook; no effect on saved state.
     fault_inject_at_step: int = 0
+    # Log device memory (HBM bytes_in_use / peak) with train metrics.
+    # No-op on backends that don't report memory_stats (CPU).
+    log_memory: bool = False
+    # Persistent XLA compilation cache dir ("" → leave jax's default): cuts
+    # the minutes-scale recompiles of big GSPMD programs across job restarts
+    # (SURVEY §7.4.5) — the torch.compile cache analogue. NOTE: the jax
+    # setting is process-global; "" does not reset a value set by an
+    # earlier Trainer in the same process.
+    compile_cache_dir: str = ""
 
 
 @dataclass

@@ -36,6 +36,15 @@ class Trainer:
         self.cfg = cfg
         if cfg.obs.debug_nans:
             debug_lib.enable_nan_debugging()
+        if cfg.obs.compile_cache_dir:
+            # Persistent XLA compile cache: restart-and-resume (the SPMD
+            # elasticity model, SURVEY §5.3) skips the minutes-scale GSPMD
+            # recompiles of large models.
+            import os
+
+            os.makedirs(cfg.obs.compile_cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir",
+                              cfg.obs.compile_cache_dir)
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         self.batch_axes = tuple(cfg.mesh.batch_axes)
         self.model = build_model(cfg.model, cfg.precision,
@@ -236,6 +245,8 @@ class Trainer:
             host[f"{unit}_per_sec"] = tput
             host[f"{unit}_per_sec_per_chip"] = tput / jax.device_count()
         host["epoch"] = step // max(self.steps_per_epoch, 1)
+        if self.cfg.obs.log_memory:
+            host.update(device_memory_metrics())
         self.logger.log(step, host, prefix="train")
 
     def evaluate(self, step: int) -> dict:
@@ -303,3 +314,19 @@ class Trainer:
         self.heartbeat.stop()
         self.ckpt.close()
         self.logger.close()
+
+
+def device_memory_metrics() -> dict:
+    """HBM usage of local device 0, or {} where the backend reports none
+    (CPU). Keys mirror the reference's torch.cuda.memory_allocated /
+    max_memory_allocated logging convention."""
+    stats = jax.local_devices()[0].memory_stats()
+    if not stats:
+        return {}
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_gb_in_use"] = stats["bytes_in_use"] / 2**30
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        out["hbm_gb_peak"] = peak / 2**30
+    return out
