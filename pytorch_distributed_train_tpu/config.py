@@ -417,12 +417,37 @@ def _llama2_7b() -> TrainConfig:
     return c
 
 
+def _gpt2_small() -> TrainConfig:
+    """GPT-2 124M pretrain (model-zoo extension beyond the BASELINE matrix;
+    HF-checkpoint-compatible via interop's 'gpt2' mapping)."""
+    c = TrainConfig(preset="gpt2_small")
+    c.model = ModelConfig(
+        name="gpt2", hidden_size=768, num_layers=12, num_heads=12,
+        # 50257 padded to 50304 (2^7·393): the standard GPT-2 trick — the
+        # true vocab is indivisible by any power-of-2 mesh, which would
+        # silently replicate wte (the largest param) instead of fsdp-
+        # sharding it (parallel/partition.py validate_spec fallback).
+        mlp_dim=3072, vocab_size=50304, max_seq_len=1024, dropout_rate=0.1,
+    )
+    c.data = DataConfig(dataset="synthetic_lm", batch_size=64, seq_len=1024)
+    c.optim = OptimConfig(
+        name="adamw", learning_rate=6e-4, weight_decay=0.1, beta2=0.95,
+        schedule="cosine", warmup_steps=2000, grad_clip_norm=1.0,
+    )
+    c.precision = PrecisionConfig(compute_dtype="bfloat16")
+    c.mesh = MeshConfig(data=-1)
+    c.total_steps = 600000
+    c.loss = "causal_lm_xent"
+    return c
+
+
 _PRESETS = {
     "resnet18_cifar10": _resnet18_cifar10,
     "resnet50_imagenet": _resnet50_imagenet,
     "vit_b16_imagenet": _vit_b16_imagenet,
     "bert_base_mlm": _bert_base_mlm,
     "llama2_7b": _llama2_7b,
+    "gpt2_small": _gpt2_small,
 }
 
 

@@ -51,7 +51,8 @@ def _plan(name: str, shape: tuple[int, ...]) -> tuple[str, str]:
             # input-fused (h, d, out) — the o_proj orientation — flattens
             # the first two. The metadata-recorded original shape makes the
             # inverse exact either way.
-            if name.endswith(("o_proj/kernel", "attn_out/kernel")):
+            if name.endswith(("o_proj/kernel", "attn_out/kernel",
+                              "attn/c_proj/kernel")):
                 transform = "dgen_in3"  # (h, d, out) → (out, h·d)
             else:
                 transform = "dgen_out3"  # (in, h, d) → (h·d, in)
@@ -171,6 +172,35 @@ _HF_RULES: dict[str, list[tuple[str, str, str]]] = {
         (r"^final_norm/scale$", "model.norm.weight", "none"),
         (r"^lm_head/kernel$", "lm_head.weight", "dense_T"),
     ],
+    # GPT-2 note: HF stores linear layers as Conv1D with (in, out) weights —
+    # the SAME orientation as flax Dense, so 2-D kernels map with NO
+    # transpose ("none"); 3-D DenseGeneral kernels flatten head dims without
+    # transposing (conv1d_out3 / conv1d_in3). The fused c_attn is assembled
+    # from q/k/v in to_hf_state_dict (and split in from_hf_state_dict).
+    "gpt2": [
+        (r"^wte/embedding$", "transformer.wte.weight", "none"),
+        (r"^wpe$", "transformer.wpe.weight", "none"),
+        (r"^h(\d+)/(ln_1|ln_2)/scale$", "transformer.h.{0}.{1}.weight",
+         "none"),
+        (r"^h(\d+)/(ln_1|ln_2)/bias$", "transformer.h.{0}.{1}.bias", "none"),
+        (r"^h(\d+)/attn/(q_proj|k_proj|v_proj)/kernel$",
+         "__qkv__.{0}.{1}.weight", "conv1d_out3"),
+        (r"^h(\d+)/attn/(q_proj|k_proj|v_proj)/bias$",
+         "__qkv__.{0}.{1}.bias", "flat"),
+        (r"^h(\d+)/attn/c_proj/kernel$",
+         "transformer.h.{0}.attn.c_proj.weight", "conv1d_in3"),
+        (r"^h(\d+)/attn/c_proj/bias$",
+         "transformer.h.{0}.attn.c_proj.bias", "none"),
+        (r"^h(\d+)/c_fc/kernel$", "transformer.h.{0}.mlp.c_fc.weight",
+         "none"),
+        (r"^h(\d+)/c_fc/bias$", "transformer.h.{0}.mlp.c_fc.bias", "none"),
+        (r"^h(\d+)/c_proj/kernel$", "transformer.h.{0}.mlp.c_proj.weight",
+         "none"),
+        (r"^h(\d+)/c_proj/bias$", "transformer.h.{0}.mlp.c_proj.bias",
+         "none"),
+        (r"^ln_f/scale$", "transformer.ln_f.weight", "none"),
+        (r"^ln_f/bias$", "transformer.ln_f.bias", "none"),
+    ],
     "vit": [
         (r"^patch_embed/kernel$",
          "vit.embeddings.patch_embeddings.projection.weight", "conv_oihw"),
@@ -285,6 +315,10 @@ def to_hf_state_dict(params: Any, family: str) -> dict[str, np.ndarray]:
             # pos_embed (1,L,C) → (L,C); fused (H,D) biases → (H·D,)
             arr = arr[0] if (arr.ndim == 3 and arr.shape[0] == 1) else arr.reshape(-1)
             arr = np.ascontiguousarray(arr)
+        elif tr == "conv1d_out3":  # (C,H,D) → (C,H·D), no transpose (Conv1D)
+            arr = np.ascontiguousarray(arr.reshape(arr.shape[0], -1))
+        elif tr == "conv1d_in3":   # (H,D,C) → (H·D,C), no transpose (Conv1D)
+            arr = np.ascontiguousarray(arr.reshape(-1, arr.shape[-1]))
         else:
             arr = _to_torch(arr, tr)
         out[hf] = arr
@@ -292,24 +326,56 @@ def to_hf_state_dict(params: Any, family: str) -> dict[str, np.ndarray]:
         out["cls.predictions.decoder.weight"] = out[
             "bert.embeddings.word_embeddings.weight"]
         out["cls.predictions.decoder.bias"] = out["cls.predictions.bias"]
+    if family.startswith("gpt2"):
+        _gpt2_fuse_qkv(out)
+        out["lm_head.weight"] = out["transformer.wte.weight"]  # tied
     return out
+
+
+def _gpt2_fuse_qkv(out: dict) -> None:
+    """Assemble HF GPT-2's fused c_attn from the staged q/k/v entries."""
+    import re as _re
+
+    layers = sorted({int(m.group(1)) for k in out
+                     if (m := _re.match(r"__qkv__\.(\d+)\.", k))})
+    for i in layers:
+        w = [out.pop(f"__qkv__.{i}.{p}.weight")
+             for p in ("q_proj", "k_proj", "v_proj")]
+        b = [out.pop(f"__qkv__.{i}.{p}.bias")
+             for p in ("q_proj", "k_proj", "v_proj")]
+        out[f"transformer.h.{i}.attn.c_attn.weight"] = np.concatenate(w, 1)
+        out[f"transformer.h.{i}.attn.c_attn.bias"] = np.concatenate(b, 0)
 
 
 def from_hf_state_dict(state_dict: dict, template: Any, family: str) -> Any:
     """HF-convention state dict (numpy or torch tensors) → flax param tree
     shaped like ``template``."""
+    import re as _re
+
     rules = _hf_rules(family)
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for p, leaf in flat:
         name = _path_str(p)
         hf, tr = _hf_name(name, rules)
-        arr = state_dict[hf]
-        if hasattr(arr, "detach"):  # torch tensor
-            arr = arr.detach().cpu().numpy()
-        arr = np.asarray(arr)
+        qkv = _re.match(r"__qkv__\.(\d+)\.(q_proj|k_proj|v_proj)\.(\w+)", hf)
+        if qkv:  # gpt2: slice the fused c_attn third for this projection
+            i, proj, kind = qkv.groups()
+            fused = state_dict[f"transformer.h.{i}.attn.c_attn.{kind}"]
+            if hasattr(fused, "detach"):
+                fused = fused.detach().cpu().numpy()
+            fused = np.asarray(fused)
+            C3 = fused.shape[-1]
+            j = ("q_proj", "k_proj", "v_proj").index(proj)
+            arr = fused[..., j * C3 // 3:(j + 1) * C3 // 3]
+        else:
+            arr = state_dict[hf]
+            if hasattr(arr, "detach"):  # torch tensor
+                arr = arr.detach().cpu().numpy()
+            arr = np.asarray(arr)
         shape = tuple(leaf.shape)
-        arr = (arr.reshape(shape) if tr == "flat"
+        arr = (arr.reshape(shape)
+               if tr in ("flat", "conv1d_out3", "conv1d_in3")
                else _from_torch(arr, tr, shape))
         if arr.shape != shape:
             raise ValueError(f"{hf}: shape {arr.shape} != template {shape}")

@@ -1,0 +1,159 @@
+"""GPT-2 decoder (model-zoo extension beyond the BASELINE matrix).
+
+The classic pre-LN decoder: learned token + position embeddings, blocks of
+ln_1 → attention → residual, ln_2 → MLP(gelu_tanh) → residual, final LN,
+and a TIED lm head (logits = h @ wte^T) — the architecture of the HF/torch
+``gpt2`` checkpoints, so weights round-trip through interop
+(`to_hf_state_dict(..., "gpt2")`) and logits parity is testable against
+``transformers.GPT2LMHeadModel`` (tests/test_hf_parity.py).
+
+TPU notes mirror the other LMs: BSHD attention through ops.attention
+(fp32 softmax, backend-dispatched), fp32-accumulated bf16 head matmul,
+activations castable to the compute dtype throughout. GELU is the tanh
+approximation — GPT-2's ``gelu_new``, unlike BERT/ViT's exact erf.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.ops.attention import (
+    ContextParallelConfig,
+    dot_product_attention,
+)
+
+
+class GPT2Attention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+    cp: ContextParallelConfig | None = None
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, C = x.shape
+        head_dim = C // self.num_heads
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name=name,
+        )
+        q, k, v = proj("q_proj")(x), proj("k_proj")(x), proj("v_proj")(x)
+        y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
+                                  impl=self.attn_impl)
+        return nn.DenseGeneral(
+            C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name="c_proj",
+        )(y)
+
+
+class GPT2Block(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float
+    deterministic: bool
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+    cp: ContextParallelConfig | None = None
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=1e-5, dtype=jnp.float32, param_dtype=jnp.float32,
+            name=name,
+        )
+        h = ln("ln_1")(x).astype(self.dtype)
+        x = x + nn.Dropout(self.dropout_rate)(
+            GPT2Attention(self.num_heads, self.dtype, self.param_dtype,
+                          cp=self.cp, attn_impl=self.attn_impl,
+                          name="attn")(h),
+            deterministic=self.deterministic)
+        h = ln("ln_2")(x).astype(self.dtype)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     kernel_init=nn.initializers.normal(0.02),
+                     name="c_fc")(h)
+        h = nn.gelu(h)  # tanh approximation == GPT-2's gelu_new
+        h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     kernel_init=nn.initializers.normal(0.02),
+                     name="c_proj")(h)
+        return x + nn.Dropout(self.dropout_rate)(
+            h, deterministic=self.deterministic)
+
+
+class GPT2LMHead(nn.Module):
+    """Input: (B, S) int ids. Output: (B, S, vocab) fp32 logits."""
+
+    vocab_size: int
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 1024
+    dropout_rate: float = 0.0
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    cp: ContextParallelConfig | None = None
+    attn_impl: str = "auto"
+    act: "object | None" = None
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = True):
+        deterministic = not train
+        B, S = input_ids.shape
+        wte = nn.Embed(self.vocab_size, self.hidden_size,
+                       embedding_init=nn.initializers.normal(0.02),
+                       param_dtype=self.param_dtype, name="wte")
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (self.max_seq_len, self.hidden_size),
+                         self.param_dtype)
+        x = wte(input_ids) + wpe[None, :S]
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        x = x.astype(self.dtype)
+        if self.act is not None:
+            x = self.act.constrain(x)
+
+        block_cls = nn.remat(GPT2Block) if self.remat else GPT2Block
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads, self.mlp_dim, self.dropout_rate,
+                deterministic, self.dtype, self.param_dtype, cp=self.cp,
+                attn_impl=self.attn_impl, name=f"h{i}",
+            )(x)
+            if self.act is not None:
+                x = self.act.constrain(x)
+
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="ln_f")(x)
+        # Tied head, bf16 operands with fp32 accumulation (cf. bert.py).
+        emb = jnp.asarray(wte.embedding, self.dtype)  # (V, C)
+        logits = jax.lax.dot_general(
+            x.astype(self.dtype), emb,
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return logits.astype(jnp.float32)
+
+
+def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
+    return GPT2LMHead(
+        cp=cp,
+        act=act,
+        attn_impl=getattr(cfg, "attention_impl", "auto"),
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        mlp_dim=cfg.mlp_dim,
+        max_seq_len=cfg.max_seq_len,
+        dropout_rate=cfg.dropout_rate,
+        remat=cfg.remat,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

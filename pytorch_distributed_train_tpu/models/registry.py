@@ -26,6 +26,8 @@ def _populate():
         return
     from pytorch_distributed_train_tpu.models import bert, llama, resnet, vit
 
+    from pytorch_distributed_train_tpu.models import gpt2 as gpt2_mod
+
     _REGISTRY.update(
         {
             "resnet18": resnet.resnet18,
@@ -33,6 +35,7 @@ def _populate():
             "vit_b16": vit.vit_b16,
             "bert_base": bert.bert_base,
             "llama": llama.llama,
+            "gpt2": gpt2_mod.gpt2,
         }
     )
     from pytorch_distributed_train_tpu.models import pipeline_lm
@@ -76,7 +79,7 @@ def build_model(model_cfg, precision_cfg, mesh=None, mesh_cfg=None):
         if mesh is None:
             raise ValueError("model 'llama_pp' needs a mesh (stage axis)")
         return _REGISTRY[name](model_cfg, dtype, param_dtype, cp=cp, mesh=mesh)
-    if name.startswith(("llama", "bert")):
+    if name.startswith(("llama", "bert", "gpt")):
         from pytorch_distributed_train_tpu.parallel.mesh import (
             activation_sharding_for,
         )

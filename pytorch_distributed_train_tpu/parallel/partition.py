@@ -201,6 +201,20 @@ def llama_pp_rules() -> list[tuple[str, PartitionSpec]]:
     ]
 
 
+def gpt2_rules() -> list[tuple[str, PartitionSpec]]:
+    """GPT-2: FSDP × TP. Tied head means the vocab-over-'fsdp' embedding is
+    also the output projection; the logsumexp then reduces over 'fsdp'."""
+    return [
+        (r"wte/embedding$", P("fsdp", None)),
+        (r"wpe$", P()),
+        (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
+        (r"attn/c_proj/kernel$", P("tensor", None, "fsdp")),
+        (r"c_fc/kernel$", P("fsdp", "tensor")),
+        (r"c_proj/kernel$", P("tensor", "fsdp")),
+        (r".*", P()),
+    ]
+
+
 def bert_rules() -> list[tuple[str, PartitionSpec]]:
     return [
         (r"(word_embed|pos_embed|type_embed)/embedding$", P(None, "fsdp")),
@@ -239,6 +253,7 @@ _RULE_SETS: dict[str, Callable[[], list[tuple[str, PartitionSpec]]]] = {
     "resnet": resnet_rules,
     "vit": vit_rules,
     "bert": bert_rules,
+    "gpt": gpt2_rules,
     "llama_pp": llama_pp_rules,  # must precede the "llama" prefix match
     "llama": llama_rules,
     "dense": dense_rules,

@@ -143,3 +143,36 @@ def test_vit_logits_match_hf():
 
     back = from_hf_state_dict(sd, jax.eval_shape(lambda: params), "vit")
     _tree_equal(params, back)
+
+
+def test_gpt2_logits_match_hf():
+    cfg = ModelConfig(name="gpt2", vocab_size=V, hidden_size=C, num_layers=L,
+                      num_heads=H, mlp_dim=MLP, max_seq_len=16,
+                      dropout_rate=0.0)
+    model = build_model(cfg, PrecisionConfig())
+    ids = np.random.default_rng(3).integers(0, V, (2, S))
+    params = model.init({"params": jax.random.PRNGKey(3)},
+                        jnp.asarray(ids, jnp.int32), train=False)["params"]
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=V, n_embd=C, n_layer=L, n_head=H, n_inner=MLP,
+        n_positions=16, activation_function="gelu_new",
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=1e-5, attn_implementation="eager",
+    )
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    sd = {k: torch.from_numpy(v.copy()) for k, v in
+          to_hf_state_dict(params, "gpt2").items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert all(".attn.bias" in k or ".attn.masked_bias" in k
+               for k in missing), missing  # causal-mask buffers only
+
+    ours = model.apply({"params": params}, jnp.asarray(ids, jnp.int32),
+                       train=False)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=3e-4, rtol=3e-4)
+
+    back = from_hf_state_dict(sd, jax.eval_shape(lambda: params), "gpt2")
+    _tree_equal(params, back)

@@ -21,7 +21,7 @@ def _init_and_apply(model, *inputs, train=False):
 
 
 def test_registry_lists_all_families():
-    assert list_models() == ["bert_base", "llama", "llama_pp", "resnet18",
+    assert list_models() == ["bert_base", "gpt2", "llama", "llama_pp", "resnet18",
                              "resnet50", "vit_b16"]
 
 
@@ -109,3 +109,25 @@ def test_gqa_repeat_matches_mha_when_equal():
     )
     np.testing.assert_allclose(np.asarray(gqa), np.asarray(manual), atol=1e-6)
     assert full.shape == gqa.shape
+
+
+def test_gpt2_tiny_shapes_and_causality():
+    cfg = ModelConfig(name="gpt2", vocab_size=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=48, max_seq_len=16,
+                      dropout_rate=0.0)
+    model = build_model(cfg, PrecisionConfig())
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 10)),
+                      jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, ids,
+                           train=False)
+    logits = model.apply(variables, ids, train=False)
+    assert logits.shape == (2, 10, 64) and logits.dtype == jnp.float32
+
+    # causality: changing a future token must not affect earlier logits
+    ids2 = ids.at[:, 7].set((ids[:, 7] + 1) % 64)
+    logits2 = model.apply(variables, ids2, train=False)
+    np.testing.assert_allclose(np.asarray(logits[:, :7]),
+                               np.asarray(logits2[:, :7]),
+                               atol=1e-6, rtol=1e-6)
+    assert not np.allclose(np.asarray(logits[:, 7:]),
+                           np.asarray(logits2[:, 7:]))
