@@ -27,10 +27,12 @@ from pytorch_distributed_train_tpu.ops.attention import (
 
 class GPT2Attention(nn.Module):
     num_heads: int
+    max_seq_len: int
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    decode: bool = False  # KV cache (same contract as llama.py decode)
 
     @nn.compact
     def __call__(self, x):
@@ -42,8 +44,37 @@ class GPT2Attention(nn.Module):
             kernel_init=nn.initializers.normal(0.02), name=name,
         )
         q, k, v = proj("q_proj")(x), proj("k_proj")(x), proj("v_proj")(x)
-        y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
-                                  impl=self.attn_impl)
+        if self.decode:
+            L = self.max_seq_len
+            c_k = self.variable("cache", "cached_key", jnp.zeros,
+                                (B, L, self.num_heads, head_dim), k.dtype)
+            c_v = self.variable("cache", "cached_value", jnp.zeros,
+                                (B, L, self.num_heads, head_dim), v.dtype)
+            c_i = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            if S > 1:  # prefill from position 0 (generate.py contract)
+                c_k.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_k.value, k, 0, 1)
+                c_v.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_v.value, v, 0, 1)
+                c_i.value = jnp.full((), S, jnp.int32)
+                y = dot_product_attention(q, k, v, causal=True,
+                                          impl=self.attn_impl)
+            else:
+                idx = c_i.value
+                c_k.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_k.value, k, idx, 1)
+                c_v.value = jax.lax.dynamic_update_slice_in_dim(
+                    c_v.value, v, idx, 1)
+                c_i.value = idx + S
+                q_pos = idx + jnp.arange(S)
+                k_pos = jnp.arange(L)
+                mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+                y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
+                                          impl="xla")
+        else:
+            y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
+                                      impl=self.attn_impl)
         return nn.DenseGeneral(
             C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
             kernel_init=nn.initializers.normal(0.02), name="c_proj",
@@ -53,12 +84,14 @@ class GPT2Attention(nn.Module):
 class GPT2Block(nn.Module):
     num_heads: int
     mlp_dim: int
+    max_seq_len: int
     dropout_rate: float
     deterministic: bool
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -68,8 +101,9 @@ class GPT2Block(nn.Module):
         )
         h = ln("ln_1")(x).astype(self.dtype)
         x = x + nn.Dropout(self.dropout_rate)(
-            GPT2Attention(self.num_heads, self.dtype, self.param_dtype,
-                          cp=self.cp, attn_impl=self.attn_impl,
+            GPT2Attention(self.num_heads, self.max_seq_len, self.dtype,
+                          self.param_dtype, cp=self.cp,
+                          attn_impl=self.attn_impl, decode=self.decode,
                           name="attn")(h),
             deterministic=self.deterministic)
         h = ln("ln_2")(x).astype(self.dtype)
@@ -101,6 +135,7 @@ class GPT2LMHead(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    decode: bool = False  # KV-cache autoregressive mode (generate.py)
     act: "object | None" = None
 
     @nn.compact
@@ -113,7 +148,20 @@ class GPT2LMHead(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (self.max_seq_len, self.hidden_size),
                          self.param_dtype)
-        x = wte(input_ids) + wpe[None, :S]
+        if self.decode and S == 1:
+            # single-token step at the running offset (prefill resets to 0,
+            # same contract as the attention caches)
+            p_i = self.variable("cache", "pos_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            pos = jax.lax.dynamic_slice_in_dim(wpe, p_i.value, S, 0)
+            p_i.value = p_i.value + S
+        else:
+            pos = wpe[:S]
+            if self.decode:
+                p_i = self.variable("cache", "pos_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+                p_i.value = jnp.full((), S, jnp.int32)
+        x = wte(input_ids) + pos[None]
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         x = x.astype(self.dtype)
         if self.act is not None:
@@ -122,9 +170,10 @@ class GPT2LMHead(nn.Module):
         block_cls = nn.remat(GPT2Block) if self.remat else GPT2Block
         for i in range(self.num_layers):
             x = block_cls(
-                self.num_heads, self.mlp_dim, self.dropout_rate,
-                deterministic, self.dtype, self.param_dtype, cp=self.cp,
-                attn_impl=self.attn_impl, name=f"h{i}",
+                self.num_heads, self.mlp_dim, self.max_seq_len,
+                self.dropout_rate, deterministic, self.dtype,
+                self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
+                decode=self.decode, name=f"h{i}",
             )(x)
             if self.act is not None:
                 x = self.act.constrain(x)

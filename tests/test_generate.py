@@ -67,35 +67,6 @@ def test_decode_matches_full_forward(kv_heads):
                                    atol=1e-5, rtol=1e-5)
 
 
-def test_greedy_matches_hf_generate(setup):
-    torch = pytest.importorskip("torch")
-    transformers = pytest.importorskip("transformers")
-    from pytorch_distributed_train_tpu.interop import to_hf_state_dict
-
-    cfg, _, params, ids = setup
-    dm = build_decode_model(cfg, PrecisionConfig())
-    ours = generate(dm, params, ids, max_new_tokens=8)
-
-    hf_cfg = transformers.LlamaConfig(
-        vocab_size=V, hidden_size=C, intermediate_size=MLP,
-        num_hidden_layers=L, num_attention_heads=H, num_key_value_heads=H,
-        max_position_embeddings=MAXLEN, rms_norm_eps=1e-5,
-        rope_theta=10000.0, attention_bias=False, tie_word_embeddings=False,
-        attn_implementation="eager",
-    )
-    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
-    sd = {k: torch.from_numpy(v.copy()) for k, v in
-          to_hf_state_dict(params, "llama").items()}
-    hf.load_state_dict(sd, strict=False)
-    with torch.no_grad():
-        theirs = hf.generate(
-            torch.from_numpy(np.asarray(ids)), max_new_tokens=8,
-            do_sample=False, use_cache=True,
-            pad_token_id=0,
-        ).numpy()
-    np.testing.assert_array_equal(np.asarray(ours), theirs)
-
-
 def test_sampling_modes(setup):
     cfg, _, params, ids = setup
     dm = build_decode_model(cfg, PrecisionConfig())
@@ -123,3 +94,76 @@ def test_eos_freezes_rows(setup):
     row = out[0]
     assert row[10] == eos
     assert np.all(row[10:] == eos)
+
+
+_HF_FAMILIES = {
+    "llama": dict(
+        cfg=dict(name="llama", vocab_size=V, hidden_size=C, num_layers=L,
+                 num_heads=H, num_kv_heads=H, mlp_dim=MLP,
+                 max_seq_len=MAXLEN),
+        hf_cls="LlamaForCausalLM",
+        hf_cfg=dict(vocab_size=V, hidden_size=C, intermediate_size=MLP,
+                    num_hidden_layers=L, num_attention_heads=H,
+                    num_key_value_heads=H, max_position_embeddings=MAXLEN,
+                    rms_norm_eps=1e-5, rope_theta=10000.0,
+                    attention_bias=False, tie_word_embeddings=False,
+                    attn_implementation="eager"),
+        hf_cfg_cls="LlamaConfig",
+    ),
+    "gpt2": dict(
+        cfg=dict(name="gpt2", vocab_size=V, hidden_size=C, num_layers=L,
+                 num_heads=H, mlp_dim=MLP, max_seq_len=MAXLEN,
+                 dropout_rate=0.0),
+        hf_cls="GPT2LMHeadModel",
+        hf_cfg=dict(vocab_size=V, n_embd=C, n_layer=L, n_head=H, n_inner=MLP,
+                    n_positions=MAXLEN, activation_function="gelu_new",
+                    resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+                    layer_norm_epsilon=1e-5, attn_implementation="eager"),
+        hf_cfg_cls="GPT2Config",
+    ),
+}
+
+
+@pytest.mark.parametrize("family", sorted(_HF_FAMILIES))
+def test_decode_and_hf_generate_parity(family):
+    """One harness per causal-LM family: (a) prefill + single-token decode
+    logits == full training forward at every position; (b) greedy
+    continuation is token-identical to HF generate on the same weights."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from pytorch_distributed_train_tpu.interop import to_hf_state_dict
+
+    spec = _HF_FAMILIES[family]
+    cfg = ModelConfig(**spec["cfg"])
+    train_model = build_model(cfg, PrecisionConfig())
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, V, (2, 10)),
+                      jnp.int32)
+    params = train_model.init({"params": jax.random.PRNGKey(5)}, ids,
+                              train=False)["params"]
+    full = train_model.apply({"params": params}, ids, train=False)
+
+    dm = build_decode_model(cfg, PrecisionConfig())
+    cache = init_cache(dm, batch=2)
+    last, cache = _decode_step(dm, params, cache, ids[:, :6])
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, 5]),
+                               atol=1e-5, rtol=1e-5)
+    for t in range(6, 10):
+        last, cache = _decode_step(dm, params, cache, ids[:, t:t + 1])
+        np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, t]),
+                                   atol=1e-5, rtol=1e-5)
+
+    ours = generate(dm, params, ids, max_new_tokens=8)
+    hf = getattr(transformers, spec["hf_cls"])(
+        getattr(transformers, spec["hf_cfg_cls"])(**spec["hf_cfg"])).eval()
+    sd = {k: torch.from_numpy(v.copy()) for k, v in
+          to_hf_state_dict(params, family).items()}
+    hf.load_state_dict(sd, strict=False)
+    ids_t = torch.from_numpy(np.asarray(ids))
+    with torch.no_grad():
+        # explicit all-ones mask: without it HF *infers* a mask whenever
+        # pad_token_id (0) appears in the prompt, silently masking a real
+        # token and breaking the equivalence being asserted
+        theirs = hf.generate(ids_t, attention_mask=torch.ones_like(ids_t),
+                             max_new_tokens=8, do_sample=False,
+                             use_cache=True, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(np.asarray(ours), theirs)
