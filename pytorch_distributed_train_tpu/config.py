@@ -93,6 +93,15 @@ class DataConfig:
     shuffle: bool = True
     drop_last: bool = True  # SPMD needs static shapes; pad-or-drop final batch
     seed: int = 0
+    # Batch augmentation (device-side, ops/mixup.py — the torchvision/timm
+    # --mixup-alpha/--cutmix-alpha recipe knobs); 0.0 disables.
+    mixup_alpha: float = 0.0
+    cutmix_alpha: float = 0.0
+    mixup_switch_prob: float = 0.5
+    # Host-side RandAugment (data/augment.py; ImageFolder train path).
+    # num_ops 0 disables; magnitude in [0, 30] (torchvision's 31 bins).
+    randaugment_num_ops: int = 0
+    randaugment_magnitude: int = 9
     # LM datasets
     seq_len: int = 512
     mlm_prob: float = 0.15

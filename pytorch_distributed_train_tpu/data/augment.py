@@ -1,0 +1,148 @@
+"""Host-side RandAugment (Cubuk et al. 2020), torchvision semantics.
+
+The reference-era ImageNet recipes (torchvision ``--auto-augment ra``)
+apply RandAugment between RandomHorizontalFlip and normalization. This is
+inherently per-image, branchy, uint8 work — exactly what should stay on the
+host CPU (it would recompile per op-combination under jit), so unlike
+MixUp/CutMix (ops/mixup.py, device-side) it lives in the data pipeline and
+runs inside the loader's worker threads (PIL releases the GIL).
+
+Op space, magnitude binning (31 bins), signed-ops coin flip, and the
+affine conventions mirror ``torchvision.transforms.RandAugment``
+(num_ops=2, magnitude=9 defaults). Randomness comes from the caller's
+seeded ``np.random.Generator`` — same generator discipline as the rest of
+the pipeline, so epochs are reproducible and resume-stable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_BINS = 31
+
+
+def _enhance(factor_cls):
+    def apply(im, mag, _rng):
+        from PIL import ImageEnhance
+
+        return getattr(ImageEnhance, factor_cls)(im).enhance(1.0 + mag)
+
+    return apply
+
+
+def _shear_x(im, mag, _rng):
+    from PIL import Image
+
+    # torchvision shears about the top-left corner with nearest resampling.
+    return im.transform(im.size, Image.AFFINE, (1, mag, 0, 0, 1, 0),
+                        Image.NEAREST, fillcolor=0)
+
+
+def _shear_y(im, mag, _rng):
+    from PIL import Image
+
+    return im.transform(im.size, Image.AFFINE, (1, 0, 0, mag, 1, 0),
+                        Image.NEAREST, fillcolor=0)
+
+
+def _translate_x(im, mag, _rng):
+    from PIL import Image
+
+    return im.transform(im.size, Image.AFFINE, (1, 0, mag, 0, 1, 0),
+                        Image.NEAREST, fillcolor=0)
+
+
+def _translate_y(im, mag, _rng):
+    from PIL import Image
+
+    return im.transform(im.size, Image.AFFINE, (1, 0, 0, 0, 1, mag),
+                        Image.NEAREST, fillcolor=0)
+
+
+def _rotate(im, mag, _rng):
+    from PIL import Image
+
+    return im.rotate(mag, Image.NEAREST, fillcolor=0)
+
+
+def _posterize(im, mag, _rng):
+    from PIL import ImageOps
+
+    return ImageOps.posterize(im, int(mag))
+
+
+def _solarize(im, mag, _rng):
+    from PIL import ImageOps
+
+    return ImageOps.solarize(im, int(mag))
+
+
+def _autocontrast(im, _mag, _rng):
+    from PIL import ImageOps
+
+    return ImageOps.autocontrast(im)
+
+
+def _equalize(im, _mag, _rng):
+    from PIL import ImageOps
+
+    return ImageOps.equalize(im)
+
+
+def _identity(im, _mag, _rng):
+    return im
+
+
+def _op_table(width: int, height: int):
+    """(name, apply_fn, magnitudes[31] or None, signed) rows — the
+    torchvision ``_augmentation_space`` table for a width×height image
+    (translate bins scale with the translated axis, as torchvision's do)."""
+    lin = np.linspace
+    return [
+        ("Identity", _identity, None, False),
+        ("ShearX", _shear_x, lin(0.0, 0.3, _BINS), True),
+        ("ShearY", _shear_y, lin(0.0, 0.3, _BINS), True),
+        ("TranslateX", _translate_x, lin(0.0, 150.0 / 331.0 * width, _BINS), True),
+        ("TranslateY", _translate_y, lin(0.0, 150.0 / 331.0 * height, _BINS), True),
+        ("Rotate", _rotate, lin(0.0, 30.0, _BINS), True),
+        ("Brightness", _enhance("Brightness"), lin(0.0, 0.9, _BINS), True),
+        ("Color", _enhance("Color"), lin(0.0, 0.9, _BINS), True),
+        ("Contrast", _enhance("Contrast"), lin(0.0, 0.9, _BINS), True),
+        ("Sharpness", _enhance("Sharpness"), lin(0.0, 0.9, _BINS), True),
+        ("Posterize", _posterize,
+         8 - np.round(np.arange(_BINS) / ((_BINS - 1) / 4)), False),
+        ("Solarize", _solarize, lin(255.0, 0.0, _BINS), False),
+        ("AutoContrast", _autocontrast, None, False),
+        ("Equalize", _equalize, None, False),
+    ]
+
+
+class RandAugment:
+    """num_ops uniformly-chosen ops at a fixed magnitude bin, per image."""
+
+    def __init__(self, num_ops: int = 2, magnitude: int = 9):
+        if not 0 <= magnitude < _BINS:
+            raise ValueError(f"magnitude must be in [0, {_BINS - 1}]")
+        self.num_ops = num_ops
+        self.magnitude = magnitude
+        self._tables: dict[tuple[int, int], list] = {}  # per (W, H) op table
+
+    def __call__(self, im, rng: np.random.Generator):
+        table = self._tables.get(im.size)
+        if table is None:
+            table = self._tables[im.size] = _op_table(*im.size)
+        for _ in range(self.num_ops):
+            name, fn, mags, signed = table[int(rng.integers(len(table)))]
+            mag = float(mags[self.magnitude]) if mags is not None else 0.0
+            if signed and rng.random() < 0.5:
+                mag = -mag
+            im = fn(im, mag, rng)
+        return im
+
+
+def apply_randaugment_u8(img_u8: np.ndarray, aug: RandAugment,
+                         rng: np.random.Generator) -> np.ndarray:
+    """Array-dataset adapter: HWC uint8 → RandAugment → HWC uint8."""
+    from PIL import Image
+
+    return np.asarray(aug(Image.fromarray(img_u8), rng), np.uint8)

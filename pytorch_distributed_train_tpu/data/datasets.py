@@ -72,17 +72,26 @@ class U8ImageDataset(ArrayDataset):
 
     def __init__(self, images_u8: np.ndarray, labels: np.ndarray,
                  mean: np.ndarray, std: np.ndarray, augment: bool,
-                 pad: int = 4):
+                 pad: int = 4, randaugment=None):
         super().__init__({"image": images_u8, "label": labels})
         self.mean, self.std = mean, std
         self.do_augment = augment
         self.pad = pad
+        self.randaugment = randaugment if augment else None
 
     def get_batch(self, idx, rng, train):
         from pytorch_distributed_train_tpu.native import imgops
 
         imgs = self.arrays["image"][idx]
         B, H, W, C = imgs.shape
+        if train and self.randaugment is not None:
+            from pytorch_distributed_train_tpu.data.augment import (
+                apply_randaugment_u8,
+            )
+
+            imgs = np.stack([
+                apply_randaugment_u8(im, self.randaugment, rng) for im in imgs
+            ])
         if train and self.do_augment:
             ys = rng.integers(0, 2 * self.pad + 1, size=B)
             xs = rng.integers(0, 2 * self.pad + 1, size=B)
@@ -103,7 +112,7 @@ class U8ImageDataset(ArrayDataset):
 
 # ------------------------------------------------------------------ CIFAR-10
 
-def load_cifar10(data_dir: str, train: bool) -> ArrayDataset:
+def load_cifar10(data_dir: str, train: bool, randaugment=None) -> ArrayDataset:
     """Reads the standard python-pickle CIFAR-10 batches (cifar-10-batches-py).
 
     The reference's config 1 dataset (BASELINE.json:7). Falls back to a
@@ -126,7 +135,8 @@ def load_cifar10(data_dir: str, train: bool) -> ArrayDataset:
         np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
     )  # NHWC uint8 — normalization is fused into the per-batch native pass
     y = np.concatenate(ys)
-    return U8ImageDataset(x, y, CIFAR_MEAN, CIFAR_STD, augment=train)
+    return U8ImageDataset(x, y, CIFAR_MEAN, CIFAR_STD, augment=train,
+                          randaugment=randaugment)
 
 
 def _find_cifar_dir(data_dir: str) -> str | None:
@@ -214,12 +224,14 @@ class ImageFolderDataset:
 
     is_item_style = True
 
-    def __init__(self, root: str, image_size: int, train: bool):
+    def __init__(self, root: str, image_size: int, train: bool,
+                 randaugment=None):
         from PIL import Image  # noqa: F401  (verify import early)
 
         self.root = root
         self.image_size = image_size
         self.train = train
+        self.randaugment = randaugment if train else None
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
         )
@@ -244,6 +256,8 @@ class ImageFolderDataset:
                 im = _random_resized_crop(im, self.image_size, rng)
                 if rng.random() < 0.5:
                     im = im.transpose(Image.FLIP_LEFT_RIGHT)
+                if self.randaugment is not None:
+                    im = self.randaugment(im, rng)
             else:
                 im = _center_crop(im, self.image_size)
             x_u8 = np.asarray(im, np.uint8)
@@ -287,10 +301,20 @@ def _center_crop(im, size: int):
 
 # ------------------------------------------------------------------ factory
 
+def _build_randaugment(data_cfg, train: bool):
+    if not train or data_cfg.randaugment_num_ops <= 0:
+        return None
+    from pytorch_distributed_train_tpu.data.augment import RandAugment
+
+    return RandAugment(data_cfg.randaugment_num_ops,
+                       data_cfg.randaugment_magnitude)
+
+
 def build_dataset(data_cfg, model_cfg, train: bool):
     name = data_cfg.dataset
     if name == "cifar10":
-        return load_cifar10(data_cfg.data_dir, train)
+        return load_cifar10(data_cfg.data_dir, train,
+                            randaugment=_build_randaugment(data_cfg, train))
     if name == "synthetic_images":
         return synthetic_images(
             data_cfg.synthetic_size, model_cfg.image_size, model_cfg.num_classes,
@@ -304,7 +328,8 @@ def build_dataset(data_cfg, model_cfg, train: bool):
                 data_cfg.synthetic_size, model_cfg.image_size,
                 model_cfg.num_classes, seed=0 if train else 1,
             )
-        return ImageFolderDataset(root, model_cfg.image_size, train)
+        return ImageFolderDataset(root, model_cfg.image_size, train,
+                                  randaugment=_build_randaugment(data_cfg, train))
     if name == "synthetic_lm":
         return synthetic_lm(
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,

@@ -22,7 +22,13 @@ def softmax_xent(logits, batch, *_, label_smoothing: float = 0.0):
     """
     labels = batch["label"]
     n_cls = logits.shape[-1]
-    if label_smoothing > 0.0:
+    if "target_probs" in batch:
+        # Soft targets from MixUp/CutMix (ops/mixup.py) — smoothing is
+        # already folded into the target rows there; accuracy below stays
+        # against the original hard labels.
+        loss = optax.softmax_cross_entropy(
+            logits, batch["target_probs"]).mean()
+    elif label_smoothing > 0.0:
         targets = optax.smooth_labels(
             jax.nn.one_hot(labels, n_cls), label_smoothing)
         loss = optax.softmax_cross_entropy(logits, targets).mean()

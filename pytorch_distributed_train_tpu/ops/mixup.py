@@ -1,0 +1,168 @@
+"""Device-side MixUp / CutMix batch augmentation.
+
+The reference-era torchvision/timm recipes apply MixUp (Zhang et al. 2018)
+and CutMix (Yun et al. 2019) as a host-side collate transform
+(torchvision.transforms.v2.{MixUp,CutMix}, timm.data.Mixup). On TPU the
+idiomatic place is INSIDE the jitted train step: the mix is a handful of
+elementwise ops on a batch already resident in HBM, it fuses into the
+forward, and the host pipeline stays on the fast path. Shapes stay static
+(box masks are arange comparisons, never dynamic slices), so there is no
+recompilation hazard.
+
+Semantics (matching timm.data.Mixup defaults, batch-wise mode):
+- per batch, draw lam ~ Beta(alpha, alpha); partner sample = the adjacent
+  element (pairwise swap 0↔1, 2↔3, …). timm pairs with the reversed batch
+  (``x.flip(0)``) — statistically equivalent, but a reverse along a
+  batch axis sharded over the 'data' mesh axis lowers to a collective
+  permute of the WHOLE image tensor every step; the pairwise swap is a
+  reshape + reverse of an unsharded length-2 axis, which stays shard-local
+  whenever the per-shard batch is even (falls back to the reverse for odd
+  batches);
+- if both mixup_alpha and cutmix_alpha are enabled, a Bernoulli(switch_prob)
+  draw picks CutMix vs MixUp for the whole batch;
+- CutMix cuts a box of area ratio (1 - lam) with uniformly-random center,
+  clipped to the image, then sets lam := 1 - cut_area/total_area (the
+  correction for clipping);
+- targets become the convex combination of the one-hot (optionally
+  label-smoothed) target rows: lam * y + (1 - lam) * y_flipped, shipped to
+  the loss as ``batch['target_probs']`` (soft-target cross-entropy).
+
+``batch['label']`` is kept (unmixed) so accuracy metrics stay comparable
+with un-augmented runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _sample_beta(rng: jax.Array, alpha: float) -> jnp.ndarray:
+    """One Beta(alpha, alpha) draw via two Gammas (jax.random.beta)."""
+    return jax.random.beta(rng, alpha, alpha)
+
+
+def partner(x: jnp.ndarray) -> jnp.ndarray:
+    """Mix partner along the batch axis: pairwise swap [1,0,3,2,…].
+
+    Shard-local under 'data'-axis batch sharding (see module docstring);
+    odd batch sizes fall back to the full reverse.
+    """
+    batch = x.shape[0]
+    if batch % 2:
+        return x[::-1]
+    paired = x.reshape((batch // 2, 2) + x.shape[1:])
+    return paired[:, ::-1].reshape(x.shape)
+
+
+def _cutmix_box_mask(rng: jax.Array, height: int, width: int,
+                     lam: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(H, W) bool mask that is True INSIDE the cut box, plus corrected lam.
+
+    Box edge ratio sqrt(1-lam) per CutMix; center uniform over the image;
+    the box is clipped at the borders, so the realized area can be smaller
+    than requested — lam is recomputed from the realized area exactly as
+    timm's ``cutmix_bbox_and_lam(correct_lam=True)`` does.
+    """
+    ratio = jnp.sqrt(1.0 - lam)
+    cut_h = (height * ratio).astype(jnp.int32)
+    cut_w = (width * ratio).astype(jnp.int32)
+    rng_y, rng_x = jax.random.split(rng)
+    cy = jax.random.randint(rng_y, (), 0, height)
+    cx = jax.random.randint(rng_x, (), 0, width)
+    y0 = jnp.clip(cy - cut_h // 2, 0, height)
+    y1 = jnp.clip(cy + cut_h // 2, 0, height)
+    x0 = jnp.clip(cx - cut_w // 2, 0, width)
+    x1 = jnp.clip(cx + cut_w // 2, 0, width)
+    rows = jnp.arange(height)[:, None]
+    cols = jnp.arange(width)[None, :]
+    mask = (rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1)
+    area = ((y1 - y0) * (x1 - x0)).astype(jnp.float32)
+    lam_corrected = 1.0 - area / float(height * width)
+    return mask, lam_corrected
+
+
+@dataclass(frozen=True)
+class MixupCutmix:
+    """Batch transform: (batch, rng) -> batch with mixed images + soft targets.
+
+    All fields are static (closed over by the jitted step). Disabled axes
+    (alpha == 0) are never traced in.
+    """
+
+    mixup_alpha: float = 0.0
+    cutmix_alpha: float = 0.0
+    switch_prob: float = 0.5  # P(cutmix) when both enabled
+    num_classes: int = 0
+    label_smoothing: float = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.mixup_alpha > 0.0 or self.cutmix_alpha > 0.0
+
+    def __call__(self, batch: dict, rng: jax.Array) -> dict:
+        if not self.enabled:
+            return batch
+        if self.num_classes <= 0:
+            raise ValueError("MixupCutmix needs num_classes > 0")
+        images = batch["image"]
+        labels = batch["label"]
+        height, width = images.shape[1], images.shape[2]
+
+        rng_lam, rng_box, rng_switch = jax.random.split(rng, 3)
+        flipped = partner(images)
+
+        def mixup_branch():
+            lam = _sample_beta(rng_lam, self.mixup_alpha)
+            mixed = lam * images + (1.0 - lam) * flipped
+            return mixed.astype(images.dtype), lam
+
+        def cutmix_branch():
+            lam0 = _sample_beta(rng_lam, self.cutmix_alpha)
+            mask, lam = _cutmix_box_mask(rng_box, height, width, lam0)
+            mixed = jnp.where(mask[None, :, :, None], flipped, images)
+            return mixed, lam
+
+        if self.mixup_alpha > 0.0 and self.cutmix_alpha > 0.0:
+            use_cutmix = jax.random.bernoulli(rng_switch, self.switch_prob)
+            mixed, lam = jax.lax.cond(
+                use_cutmix, cutmix_branch, mixup_branch)
+        elif self.cutmix_alpha > 0.0:
+            mixed, lam = cutmix_branch()
+        else:
+            mixed, lam = mixup_branch()
+
+        one_hot = jax.nn.one_hot(labels, self.num_classes)
+        if self.label_smoothing > 0.0:
+            one_hot = optax.smooth_labels(one_hot, self.label_smoothing)
+        targets = lam * one_hot + (1.0 - lam) * partner(one_hot)
+
+        out = dict(batch)
+        out["image"] = mixed
+        out["target_probs"] = targets
+        return out
+
+
+def build_mixup(data_cfg, model_cfg, label_smoothing: float,
+                loss: str = "softmax_xent") -> MixupCutmix | None:
+    """Config → transform (or None when disabled). Mirrors the torchvision
+    recipe flags --mixup-alpha/--cutmix-alpha. Validates workload
+    compatibility at construction time (a config error here would otherwise
+    surface as an opaque KeyError deep inside the jit trace)."""
+    m = MixupCutmix(
+        mixup_alpha=data_cfg.mixup_alpha,
+        cutmix_alpha=data_cfg.cutmix_alpha,
+        switch_prob=data_cfg.mixup_switch_prob,
+        num_classes=model_cfg.num_classes,
+        label_smoothing=label_smoothing,
+    )
+    if not m.enabled:
+        return None
+    if loss != "softmax_xent":
+        raise ValueError(
+            f"mixup/cutmix requires an image-classification workload "
+            f"(loss='softmax_xent'); this config uses loss={loss!r}")
+    return m

@@ -76,10 +76,10 @@ def _tree_finite(tree) -> jnp.ndarray:
 
 
 def make_train_step(model, loss_fn: Callable, tx,
-                    ema_decay: float = 0.0) -> Callable:
+                    ema_decay: float = 0.0, mixup=None) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
-    closes over the optax transform (and the static EMA decay); jit-wrapped
-    by the caller with explicit shardings."""
+    closes over the optax transform (and the static EMA decay / mixup
+    transform); jit-wrapped by the caller with explicit shardings."""
     if not 0.0 <= ema_decay < 1.0:
         raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
 
@@ -88,6 +88,8 @@ def make_train_step(model, loss_fn: Callable, tx,
         # deterministic under resume (same step → same mask), no key chain
         # to checkpoint (the reference relies on torch's stateful global RNG).
         dropout_rng = jax.random.fold_in(rng, state.step)
+        if mixup is not None:
+            batch = mixup(batch, jax.random.fold_in(dropout_rng, 1))
 
         scale = state.dynamic_scale.scale if state.dynamic_scale is not None else None
 

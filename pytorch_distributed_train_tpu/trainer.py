@@ -92,9 +92,14 @@ class Trainer:
             )(init_rng)
 
         # ---- jitted steps
+        from pytorch_distributed_train_tpu.ops.mixup import build_mixup
+
+        mixup = build_mixup(cfg.data, cfg.model, cfg.label_smoothing,
+                            loss=cfg.loss)
         self.train_step = steps_lib.jit_train_step(
             steps_lib.make_train_step(self.model, self.loss_fn, self.tx,
-                                      ema_decay=cfg.optim.ema_decay),
+                                      ema_decay=cfg.optim.ema_decay,
+                                      mixup=mixup),
             self.mesh, self.state_sharding, self.batch_axes,
         )
         self.eval_step = steps_lib.jit_eval_step(
