@@ -113,7 +113,7 @@ class DataConfig:
 class OptimConfig:
     """Optimizer + LR schedule (reference: torch.optim.SGD / LAMB — SURVEY C20)."""
 
-    name: str = "sgd"  # sgd | momentum | adamw | lamb | adam
+    name: str = "sgd"  # sgd | momentum | adamw | lamb | adam | lars
     learning_rate: float = 0.1
     warmup_steps: int = 0
     schedule: str = "cosine"  # constant | cosine | step | linear
@@ -123,6 +123,11 @@ class OptimConfig:
     momentum: float = 0.9
     nesterov: bool = False
     weight_decay: float = 1e-4
+    # No-decay param groups (the torch-recipe `no_decay=['bias','LayerNorm']`
+    # pattern): comma-separated regexes matched against the '/'-joined param
+    # path; matching params skip weight decay (and LARS trust-ratio scaling).
+    # Flax naming: biases are 'bias', Layer/RMS/BatchNorm scales are 'scale'.
+    decay_exclude: str = ""
     beta1: float = 0.9
     beta2: float = 0.999
     eps: float = 1e-8
@@ -381,6 +386,7 @@ def _vit_b16_imagenet() -> TrainConfig:
     c.optim = OptimConfig(
         name="adamw", learning_rate=3e-3, weight_decay=0.3, beta2=0.999,
         schedule="cosine", warmup_steps=10000, accum_steps=4, grad_clip_norm=1.0,
+        decay_exclude=r"bias$,scale$",  # timm recipe: no decay on bias/norm
     )
     c.precision = PrecisionConfig(compute_dtype="bfloat16")
     c.epochs = 300
@@ -399,6 +405,8 @@ def _bert_base_mlm() -> TrainConfig:
     c.optim = OptimConfig(
         name="lamb", learning_rate=1.75e-3, weight_decay=0.01,
         schedule="linear", warmup_steps=3125, grad_clip_norm=1.0,
+        # BERT recipe's no_decay = ['bias', 'LayerNorm.weight']
+        decay_exclude=r"bias$,scale$",
     )
     c.precision = PrecisionConfig(compute_dtype="bfloat16")
     c.total_steps = 28125
@@ -418,6 +426,7 @@ def _llama2_7b() -> TrainConfig:
     c.optim = OptimConfig(
         name="adamw", learning_rate=3e-4, weight_decay=0.1, beta2=0.95,
         schedule="cosine", warmup_steps=2000, grad_clip_norm=1.0,
+        decay_exclude=r"scale$",  # no decay on RMSNorm scales (no biases in llama)
     )
     c.precision = PrecisionConfig(compute_dtype="bfloat16")
     c.mesh = MeshConfig(data=1, fsdp=-1)
@@ -442,6 +451,7 @@ def _gpt2_small() -> TrainConfig:
     c.optim = OptimConfig(
         name="adamw", learning_rate=6e-4, weight_decay=0.1, beta2=0.95,
         schedule="cosine", warmup_steps=2000, grad_clip_norm=1.0,
+        decay_exclude=r"bias$,scale$",  # decay only matmul/embedding weights
     )
     c.precision = PrecisionConfig(compute_dtype="bfloat16")
     c.mesh = MeshConfig(data=-1)

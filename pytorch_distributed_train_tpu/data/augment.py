@@ -127,6 +127,14 @@ class RandAugment:
         self.magnitude = magnitude
         self._tables: dict[tuple[int, int], list] = {}  # per (W, H) op table
 
+    def __getstate__(self):
+        # The op-table cache holds closures (unpicklable); grain's worker
+        # processes pickle the dataset that owns this transform. Rebuilt
+        # lazily on first use.
+        state = self.__dict__.copy()
+        state["_tables"] = {}
+        return state
+
     def __call__(self, im, rng: np.random.Generator):
         table = self._tables.get(im.size)
         if table is None:

@@ -78,25 +78,58 @@ class U8ImageDataset(ArrayDataset):
         self.do_augment = augment
         self.pad = pad
         self.randaugment = randaugment if augment else None
+        self._ra_pool = None
+
+    def __getstate__(self):
+        # Thread pools don't pickle (grain's worker processes pickle the
+        # dataset); it is rebuilt lazily in the worker.
+        state = self.__dict__.copy()
+        state["_ra_pool"] = None
+        return state
+
+    def _randaugment_batch(self, imgs_u8: np.ndarray, rng) -> np.ndarray:
+        """RandAugment each image on a thread pool (PIL releases the GIL;
+        a serial loop here would stall the single producer thread and make
+        training input-bound). Per-image seeds are drawn up-front from the
+        batch rng, so the result is deterministic regardless of thread
+        scheduling."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from pytorch_distributed_train_tpu.data.augment import (
+            apply_randaugment_u8,
+        )
+
+        if self._ra_pool is None:
+            self._ra_pool = ThreadPoolExecutor(
+                max_workers=min(16, os.cpu_count() or 4))
+        seeds = rng.integers(np.iinfo(np.int64).max, size=len(imgs_u8))
+        return np.stack(list(self._ra_pool.map(
+            lambda args: apply_randaugment_u8(
+                args[0], self.randaugment, np.random.default_rng(args[1])),
+            zip(imgs_u8, seeds),
+        )))
 
     def get_batch(self, idx, rng, train):
         from pytorch_distributed_train_tpu.native import imgops
 
         imgs = self.arrays["image"][idx]
         B, H, W, C = imgs.shape
-        if train and self.randaugment is not None:
-            from pytorch_distributed_train_tpu.data.augment import (
-                apply_randaugment_u8,
-            )
-
-            imgs = np.stack([
-                apply_randaugment_u8(im, self.randaugment, rng) for im in imgs
-            ])
         if train and self.do_augment:
             ys = rng.integers(0, 2 * self.pad + 1, size=B)
             xs = rng.integers(0, 2 * self.pad + 1, size=B)
             flips = rng.random(B) < 0.5
-            if imgops.available():
+            if self.randaugment is not None:
+                # torchvision recipe order: crop → flip → RandAugment →
+                # normalize. RandAugment needs uint8 pixels, so the fused
+                # native crop+normalize pass can't be used; crop/flip on u8,
+                # augment, then normalize (native when available).
+                cropped = _crop_flip(imgs, self.pad, ys, xs, flips)
+                auged = self._randaugment_batch(cropped, rng)
+                if imgops.available():
+                    out = imgops.normalize_batch(auged, self.mean, self.std)
+                else:
+                    out = (auged.astype(np.float32) / 255.0 - self.mean) / self.std
+            elif imgops.available():
                 out = imgops.augment_batch(
                     imgs, self.pad, ys, xs, flips, self.mean, self.std)
             else:

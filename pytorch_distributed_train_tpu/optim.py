@@ -12,7 +12,32 @@ forward/backwards accumulate locally, collectives fire once per real step.
 
 from __future__ import annotations
 
+import re
+
 import optax
+
+
+def decay_mask_fn(exclude: str):
+    """Weight-decay mask from comma-separated path regexes (OptimConfig.
+    decay_exclude) — the torch-recipe "no_decay = ['bias', 'LayerNorm']"
+    param-group split. Returns None (decay everything, torch's default)
+    when no patterns are given; else a params-tree → bool-tree callable
+    (True = apply decay) matching each '/'-joined param path."""
+    patterns = [re.compile(p.strip()) for p in exclude.split(",") if p.strip()]
+    if not patterns:
+        return None
+
+    def mask(params):
+        from flax import traverse_util
+
+        flat = traverse_util.flatten_dict(params)
+        keep = {
+            k: not any(p.search("/".join(map(str, k))) for p in patterns)
+            for k in flat
+        }
+        return traverse_util.unflatten_dict(keep)
+
+    return mask
 
 
 def make_schedule(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
@@ -84,10 +109,12 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
         parts.append(optax.clip_by_global_norm(opt_cfg.grad_clip_norm))
 
     name = opt_cfg.name
+    mask = decay_mask_fn(getattr(opt_cfg, "decay_exclude", ""))
     if name in ("sgd", "momentum"):
         if opt_cfg.weight_decay > 0:
             # torch-style coupled L2: grad += wd * param, then momentum.
-            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+            parts.append(
+                optax.add_decayed_weights(opt_cfg.weight_decay, mask=mask))
         momentum = opt_cfg.momentum if name == "momentum" or opt_cfg.momentum else None
         parts.append(
             optax.sgd(sched, momentum=momentum, nesterov=opt_cfg.nesterov)
@@ -98,12 +125,24 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
     elif name == "adamw":
         parts.append(
             optax.adamw(sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2,
-                        eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay)
+                        eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay,
+                        mask=mask)
         )
     elif name == "lamb":
         parts.append(
             optax.lamb(sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2,
-                       eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay)
+                       eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay,
+                       mask=mask)
+        )
+    elif name == "lars":
+        # Large-batch ResNet recipe (MLPerf): layerwise trust ratio; the
+        # no-decay params are also excluded from trust-ratio adaptation,
+        # matching the reference implementations' skip of BN/bias.
+        parts.append(
+            optax.lars(sched, weight_decay=opt_cfg.weight_decay,
+                       weight_decay_mask=mask if mask is not None else True,
+                       trust_ratio_mask=mask if mask is not None else True,
+                       momentum=opt_cfg.momentum, nesterov=opt_cfg.nesterov)
         )
     else:
         raise ValueError(f"unknown optimizer {name!r}")
