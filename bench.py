@@ -150,11 +150,13 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--attention-impl", default="auto",
-                   choices=["auto", "xla", "pallas"],
+                   choices=["auto", "xla", "pallas", "chunked"],
                    help="LM attention backend. 'auto' picks the Pallas flash "
                         "kernel on real TPU backends but falls back to XLA "
                         "under the axon tunnel, whose remote compile hangs "
-                        "on Mosaic kernels (ops/attention.py _pallas_usable).")
+                        "on Mosaic kernels (ops/attention.py _pallas_usable). "
+                        "'chunked' is the pure-XLA flash-style path: O(S* "
+                        "chunk) memory, compiles everywhere.")
     args = p.parse_args()
 
     timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1800"))

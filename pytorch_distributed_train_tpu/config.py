@@ -56,7 +56,9 @@ class ModelConfig:
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False
     # Attention backend for this process: auto (pallas on TPU when
-    # supported+profitable, else XLA), or force xla / pallas. The
+    # supported+profitable, else XLA), or force xla / pallas / chunked
+    # (pure-XLA flash-style query-chunked path — O(S*chunk) memory,
+    # compiles on backends that can't take Mosaic kernels). The
     # PDTT_ATTENTION_IMPL env var overrides (ops/attention.py).
     attention_impl: str = "auto"
     # Pipeline parallelism (model name "llama_pp"; SURVEY §2.3 PP row):
@@ -386,7 +388,9 @@ def _vit_b16_imagenet() -> TrainConfig:
     c.optim = OptimConfig(
         name="adamw", learning_rate=3e-3, weight_decay=0.3, beta2=0.999,
         schedule="cosine", warmup_steps=10000, accum_steps=4, grad_clip_norm=1.0,
-        decay_exclude=r"bias$,scale$",  # timm recipe: no decay on bias/norm
+        # timm recipe: no decay on bias/norm, nor on cls_token/pos_embed
+        # (timm's ViT no_weight_decay() set)
+        decay_exclude=r"bias$,scale$,cls_token$,pos_embed$",
     )
     c.precision = PrecisionConfig(compute_dtype="bfloat16")
     c.epochs = 300

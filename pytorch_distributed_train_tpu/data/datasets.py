@@ -99,10 +99,19 @@ class U8ImageDataset(ArrayDataset):
             apply_randaugment_u8,
         )
 
+        seeds = rng.integers(np.iinfo(np.int64).max, size=len(imgs_u8))
+        if len(imgs_u8) <= 2:
+            # grain's per-record path calls with a single image — skip the
+            # pool (a 16-thread executor per worker process for zero
+            # parallelism otherwise).
+            return np.stack([
+                apply_randaugment_u8(im, self.randaugment,
+                                     np.random.default_rng(s))
+                for im, s in zip(imgs_u8, seeds)
+            ])
         if self._ra_pool is None:
             self._ra_pool = ThreadPoolExecutor(
                 max_workers=min(16, os.cpu_count() or 4))
-        seeds = rng.integers(np.iinfo(np.int64).max, size=len(imgs_u8))
         return np.stack(list(self._ra_pool.map(
             lambda args: apply_randaugment_u8(
                 args[0], self.randaugment, np.random.default_rng(args[1])),

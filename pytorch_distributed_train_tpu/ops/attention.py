@@ -25,12 +25,13 @@ import jax.numpy as jnp
 # The torch analogue is the global torch.backends.cuda.sdp_kernel switch.
 _default_impl = "auto"
 
-_VALID_IMPLS = ("auto", "xla", "pallas")
+_VALID_IMPLS = ("auto", "xla", "pallas", "chunked")
 
 
 def set_default_impl(impl: str) -> None:
     if impl not in _VALID_IMPLS:
-        raise ValueError(f"attention impl must be auto|xla|pallas, got {impl!r}")
+        raise ValueError(
+            f"attention impl must be one of {_VALID_IMPLS}, got {impl!r}")
     global _default_impl
     _default_impl = impl
 
@@ -39,7 +40,7 @@ def _env_impl() -> str | None:
     env = os.environ.get("PDTT_ATTENTION_IMPL")
     if env is not None and env not in _VALID_IMPLS:
         raise ValueError(
-            f"PDTT_ATTENTION_IMPL must be auto|xla|pallas, got {env!r}"
+            f"PDTT_ATTENTION_IMPL must be one of {_VALID_IMPLS}, got {env!r}"
         )
     return env
 
@@ -101,7 +102,7 @@ def dot_product_attention(
     causal: bool = False,
     mask: jax.Array | None = None,  # (B, 1, Sq, Sk) or broadcastable, True=keep
     softmax_dtype: jnp.dtype = jnp.float32,
-    impl: str = "auto",  # auto | xla | pallas
+    impl: str = "auto",  # auto | xla | pallas | chunked
     cp: ContextParallelConfig | None = None,
 ) -> jax.Array:
     """Multi-head attention core, GQA-aware.
@@ -119,7 +120,8 @@ def dot_product_attention(
     ``softmax_dtype``, which cp paths do not override.
     """
     if impl not in _VALID_IMPLS:
-        raise ValueError(f"attention impl must be auto|xla|pallas, got {impl!r}")
+        raise ValueError(
+            f"attention impl must be one of {_VALID_IMPLS}, got {impl!r}")
     # The env var is the operator's kill switch: it beats EVERYTHING,
     # including an explicit impl arg or a config-threaded backend — its
     # whole purpose is preventing Mosaic-compile hangs no matter what the
@@ -180,6 +182,14 @@ def dot_product_attention(
                                            interpret=not on_tpu)
         elif impl == "pallas":
             raise ValueError("pallas flash attention unsupported for these shapes")
+    if impl == "chunked" or (impl == "auto" and q.shape[1] >= _AUTO_CHUNK_MIN_SEQ):
+        # auto → chunked at training-length sequences when the Pallas kernel
+        # didn't take the call above. Measured on v5e (BASELINE.md
+        # 2026-07-30): llama seq2048 +11% tokens/sec AND fits shapes the
+        # dense path OOMs on; BERT seq512 −3.6% (tile overhead) → dense
+        # stays the short-seq default.
+        return _chunked_attention(q, k, v, causal=causal, mask=mask,
+                                  softmax_dtype=softmax_dtype)
     return _xla_attention(q, k, v, causal=causal, mask=mask, softmax_dtype=softmax_dtype)
 
 
@@ -218,3 +228,91 @@ def _xla_attention(q, k, v, *, causal, mask, softmax_dtype):
 
 def _neg_inf(dtype) -> jax.Array:
     return jnp.asarray(jnp.finfo(dtype).min, dtype)
+
+
+# Query-chunk size for impl="chunked". 256 keeps the per-chunk logits tile
+# MXU-friendly while bounding live attention memory to O(chunk * Sk).
+_CHUNK_Q = 256
+
+# impl="auto" switches from dense XLA to the chunked path at this query
+# length (≥4 tiles — below that the map/remat overhead outweighs the
+# saved HBM traffic; see the v5e llama/BERT measurements in BASELINE.md).
+_AUTO_CHUNK_MIN_SEQ = 1024
+
+
+def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
+                       chunk: int = _CHUNK_Q):
+    """Memory-efficient attention in pure XLA: flash-attention's streaming
+    structure (process the score matrix in tiles, never materialise it
+    whole) expressed as a sequential `lax.map` over query chunks with the
+    chunk body rematerialised.
+
+    Motivation (measured, BASELINE.md 2026-07-30): the plain XLA path keeps
+    O(Sq*Sk) bf16 score/remat temps live through the backward — a ~1B llama
+    at bs8/seq2048 needs 16.85G vs the chip's 15.75G HBM. Here the forward
+    holds one (B, H, chunk, Sk) fp32 tile at a time, and `jax.checkpoint`
+    on the body makes the backward recompute tiles instead of storing them
+    — the same FLOPs-for-HBM trade the Pallas flash kernel makes, minus the
+    hand-written kernel, so it compiles on any backend (including remote
+    compilers that cannot take Mosaic, e.g. this sandbox's axon tunnel).
+
+    Numerics match `_xla_attention` exactly per chunk: fp32 scores, full
+    row softmax over Sk (no online rescaling needed — each query row sees
+    all keys within its tile), output cast back to the input dtype.
+    """
+    from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
+
+    orig_dtype = q.dtype
+    B, Sq, H, D = q.shape
+    _, Sk, _, _ = k.shape
+    k, v = expand_kv_heads(k, v, H)
+    if Sq <= chunk:
+        return _xla_attention(q, k, v, causal=causal, mask=mask,
+                              softmax_dtype=softmax_dtype)
+
+    n_chunks = -(-Sq // chunk)
+    pad = n_chunks * chunk - Sq
+    q_padded = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    if mask is not None and mask.ndim < 4:
+        # Honor the dense path's broadcastable-mask contract: left-pad
+        # dims exactly as numpy broadcasting against (B, H, Sq, Sk) would,
+        # so dim 2 is the query axis for the tile slicing below.
+        mask = mask.reshape((1,) * (4 - mask.ndim) + mask.shape)
+    if mask is not None and mask.shape[2] > 1 and pad:
+        # Keep tile slices aligned: dynamic_slice clamps at the edge, which
+        # would shift the last tile's window. Padded rows are fully masked;
+        # their (uniform-softmax) outputs are dropped by the final slice.
+        mask = jnp.pad(mask, ((0, 0),) * 2 + ((0, pad), (0, 0)),
+                       constant_values=False)
+    # (n, B, chunk, H, D) — leading axis is the map axis
+    q_tiles = q_padded.reshape(B, n_chunks, chunk, H, D).transpose(1, 0, 2, 3, 4)
+    starts = jnp.arange(n_chunks) * chunk
+
+    scale = 1.0 / jnp.sqrt(D).astype(softmax_dtype)
+    k_pos = jnp.arange(Sk)[None, :]
+
+    def body(args):
+        q_tile, start = args
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q_tile, k,
+                            preferred_element_type=softmax_dtype) * scale
+        q_pos = start + jnp.arange(chunk)[:, None] + (Sk - Sq)
+        if causal:
+            logits = jnp.where((q_pos >= k_pos)[None, None], logits,
+                               _neg_inf(softmax_dtype))
+        if mask is not None:
+            # mask is (B, 1, Sq, Sk) or broadcastable; slice the query axis
+            # when it is materialised, else broadcast as-is.
+            if mask.shape[2] == 1:
+                tile_mask = mask
+            else:
+                tile_mask = jax.lax.dynamic_slice_in_dim(mask, start, chunk,
+                                                         axis=2)
+            logits = jnp.where(tile_mask, logits, _neg_inf(softmax_dtype))
+        # Padded query rows (beyond Sq) mask everything out → uniform
+        # softmax over garbage; harmless, dropped by the final slice.
+        probs = jax.nn.softmax(logits, axis=-1).astype(orig_dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    out_tiles = jax.lax.map(jax.checkpoint(body), (q_tiles, starts))
+    out = out_tiles.transpose(1, 0, 2, 3, 4).reshape(B, n_chunks * chunk, H, D)
+    return out[:, :Sq]

@@ -120,6 +120,11 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
             optax.sgd(sched, momentum=momentum, nesterov=opt_cfg.nesterov)
         )
     elif name == "adam":
+        if opt_cfg.weight_decay > 0:
+            # torch.optim.Adam(weight_decay=) is coupled L2 (grad += wd*p),
+            # unlike AdamW's decoupled decay.
+            parts.append(
+                optax.add_decayed_weights(opt_cfg.weight_decay, mask=mask))
         parts.append(optax.adam(sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2,
                                 eps=opt_cfg.eps))
     elif name == "adamw":

@@ -1,0 +1,173 @@
+"""Chunked (pure-XLA flash-style) attention vs the XLA reference.
+
+impl="chunked" exists for backends whose remote compiler cannot take
+Mosaic/Pallas kernels (BASELINE.md axon caveat): same O(S*chunk) memory
+trade as the flash kernel, plain XLA ops only. Numerics must match the
+dense path to fp32-accumulation tolerance in BOTH directions (values and
+gradients) across causal, masked, GQA, and non-divisible shapes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.ops.attention import (
+    _chunked_attention, _xla_attention, dot_product_attention,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_attention_env(monkeypatch):
+    monkeypatch.delenv("PDTT_ATTENTION_IMPL", raising=False)
+
+
+def _qkv(B=2, Sq=512, Sk=512, H=4, Hkv=None, D=32, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, Sq, H, D)) * 0.5, dtype)
+    k = jnp.asarray(rng.standard_normal((B, Sk, Hkv or H, D)) * 0.5, dtype)
+    v = jnp.asarray(rng.standard_normal((B, Sk, Hkv or H, D)) * 0.5, dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_chunked_matches_xla(causal):
+    q, k, v = _qkv()
+    ref = _xla_attention(q, k, v, causal=causal, mask=None,
+                         softmax_dtype=jnp.float32)
+    out = _chunked_attention(q, k, v, causal=causal, mask=None,
+                             softmax_dtype=jnp.float32, chunk=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_chunked_nondivisible_seq_and_gqa():
+    # Sq=300 with chunk=128 → padded final tile; GQA Hkv=2 under H=4
+    q, k, v = _qkv(Sq=300, Sk=300, Hkv=2)
+    ref = _xla_attention(q, k, v, causal=True, mask=None,
+                         softmax_dtype=jnp.float32)
+    out = _chunked_attention(q, k, v, causal=True, mask=None,
+                             softmax_dtype=jnp.float32, chunk=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_chunked_arbitrary_mask():
+    q, k, v = _qkv(Sq=320, Sk=320)
+    rng = np.random.default_rng(3)
+    mask = jnp.asarray(rng.random((2, 1, 320, 320)) > 0.3)
+    # guarantee every row keeps at least one key (degenerate rows differ
+    # between dense and chunked only in which uniform garbage they emit)
+    mask = mask.at[:, :, :, 0].set(True)
+    ref = _xla_attention(q, k, v, causal=False, mask=mask,
+                         softmax_dtype=jnp.float32)
+    out = _chunked_attention(q, k, v, causal=False, mask=mask,
+                             softmax_dtype=jnp.float32, chunk=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_chunked_gradients_match_xla():
+    q, k, v = _qkv(Sq=384, Sk=384)
+
+    def loss_with(fn):
+        def f(q, k, v):
+            out = fn(q, k, v, causal=True, mask=None,
+                     softmax_dtype=jnp.float32)
+            return jnp.sum(out * out)
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    g_ref = loss_with(_xla_attention)(q, k, v)
+    g_out = loss_with(
+        lambda *a, **kw: _chunked_attention(*a, chunk=128, **kw))(q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_chunked_bf16_and_dispatch():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = dot_product_attention(q, k, v, causal=True, impl="chunked")
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_chunked_small_seq_falls_back_to_dense():
+    # Sq <= chunk: single dense tile, exact equality expected
+    q, k, v = _qkv(Sq=64, Sk=64)
+    out = _chunked_attention(q, k, v, causal=True, mask=None,
+                             softmax_dtype=jnp.float32, chunk=256)
+    ref = _xla_attention(q, k, v, causal=True, mask=None,
+                         softmax_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_chunked_decode_alignment():
+    """KV-cache decode shape (Sq=1, long Sk) must keep the causal
+    end-alignment the dense path implements."""
+    q, k, v = _qkv(Sq=1, Sk=128)
+    out = _chunked_attention(q, k, v, causal=True, mask=None,
+                             softmax_dtype=jnp.float32, chunk=64)
+    ref = _xla_attention(q, k, v, causal=True, mask=None,
+                         softmax_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_chunked_peak_memory_is_smaller():
+    """Compiled-HLO peak temp memory: chunked must beat dense at long
+    sequence (the reason it exists). Uses the CPU backend's memory
+    analysis on the value-and-grad program."""
+    q, k, v = _qkv(B=1, Sq=2048, Sk=2048, H=2, D=32)
+
+    def make(fn):
+        def f(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=True, mask=None,
+                              softmax_dtype=jnp.float32) ** 2)
+        return jax.jit(jax.grad(f))
+
+    def peak(fn):
+        c = make(fn).lower(q, k, v).compile()
+        try:
+            return c.memory_analysis().temp_size_in_bytes
+        except Exception:
+            pytest.skip("backend lacks memory_analysis")
+
+    dense = peak(_xla_attention)
+    chunked = peak(lambda *a, **kw: _chunked_attention(*a, chunk=256, **kw))
+    assert chunked < dense / 2, (chunked, dense)
+
+
+def test_auto_dispatch_picks_chunked_at_long_seq(monkeypatch):
+    from pytorch_distributed_train_tpu.ops import attention as attn
+
+    calls = []
+    real = attn._chunked_attention
+    monkeypatch.setattr(
+        attn, "_chunked_attention",
+        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
+    q, k, v = _qkv(B=1, Sq=1024, Sk=1024, H=2, D=8)
+    attn.dot_product_attention(q, k, v, causal=True, impl="auto")
+    assert calls, "auto at seq>=1024 must route to the chunked path"
+    calls.clear()
+    q, k, v = _qkv(B=1, Sq=512, Sk=512, H=2, D=8)
+    attn.dot_product_attention(q, k, v, causal=True, impl="auto")
+    assert not calls, "auto at short seq keeps the dense path"
+
+
+def test_chunked_broadcastable_2d_mask():
+    """The dense path's broadcastable-mask contract holds for chunked."""
+    q, k, v = _qkv(Sq=300, Sk=300)
+    rng = np.random.default_rng(5)
+    mask2d = jnp.asarray(rng.random((300, 300)) > 0.3)
+    mask2d = mask2d.at[:, 0].set(True)
+    ref = _xla_attention(q, k, v, causal=False, mask=mask2d,
+                         softmax_dtype=jnp.float32)
+    out = _chunked_attention(q, k, v, causal=False, mask=mask2d,
+                             softmax_dtype=jnp.float32, chunk=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

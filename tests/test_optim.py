@@ -111,3 +111,27 @@ def test_presets_carry_decay_exclude():
                            ("resnet50_imagenet", False)):
         cfg = get_preset(preset)
         assert bool(cfg.optim.decay_exclude) is expect, preset
+
+
+def test_adam_applies_coupled_weight_decay():
+    """torch.optim.Adam(weight_decay=) is coupled L2; the 'adam' branch
+    must decay (regression: it silently ignored weight_decay)."""
+    changed = _decayed_which(OptimConfig(
+        name="adam", learning_rate=0.1, weight_decay=0.1,
+        decay_exclude=r"bias$,scale$", schedule="constant"))
+    assert changed["dense"]["kernel"]
+    assert not changed["dense"]["bias"]
+
+
+def test_vit_preset_excludes_cls_and_pos_embed():
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.optim import decay_mask_fn
+
+    cfg = get_preset("vit_b16_imagenet")
+    mask = decay_mask_fn(cfg.optim.decay_exclude)({
+        "cls_token": jnp.zeros((1, 1, 4)),
+        "pos_embed": jnp.zeros((1, 5, 4)),
+        "blk": {"kernel": jnp.zeros((4, 4)), "bias": jnp.zeros((4,))},
+    })
+    assert mask == {"cls_token": False, "pos_embed": False,
+                    "blk": {"kernel": True, "bias": False}}
