@@ -149,6 +149,10 @@ def main() -> None:
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--fused-head", action="store_true",
+                   help="llama only: fused chunked LM-head loss "
+                        "(model.fused_lm_loss) — (B,S,V) logits never "
+                        "materialize.")
     p.add_argument("--attention-impl", default="auto",
                    choices=["auto", "xla", "pallas", "chunked"],
                    help="LM attention backend. 'auto' picks the Pallas flash "
@@ -203,8 +207,9 @@ def main() -> None:
             num_heads=16, num_kv_heads=16, mlp_dim=5504,
             max_seq_len=args.seq_len, remat=True,
             attention_impl=args.attention_impl,
+            fused_lm_loss=args.fused_head,
         )
-        loss_name = "causal_lm_xent"
+        loss_name = "fused_causal_lm_xent" if args.fused_head else "causal_lm_xent"
         opt = OptimConfig(name="adamw", learning_rate=3e-4,
                           schedule="constant", warmup_steps=0)
         bpc = args.batch_per_chip or 8
@@ -292,8 +297,11 @@ def main() -> None:
                      and args.batch_per_chip in (0, 128)
                      and args.image_size == 224)
     elif args.model == "llama":
+        # fused-head runs are a different program (no logits materialized) —
+        # they must not share a baseline key with the dense-head config.
         canonical = (args.batch_per_chip in (0, 8) and args.seq_len == 2048
-                     and args.attention_impl == "auto")
+                     and args.attention_impl == "auto"
+                     and not args.fused_head)
     else:  # bert_base
         canonical = (args.batch_per_chip in (0, 32) and args.seq_len >= 512
                      and args.attention_impl == "auto")

@@ -55,6 +55,10 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False
+    # Fused chunked LM-head loss (llama/gpt2): head matmul + CE computed per
+    # sequence chunk under remat so (B,S,V) logits never materialize
+    # (losses.chunked_causal_ce). Requires loss="fused_causal_lm_xent".
+    fused_lm_loss: bool = False
     # Attention backend for this process: auto (pallas on TPU when
     # supported+profitable, else XLA), or force xla / pallas / chunked
     # (pure-XLA flash-style query-chunked path — O(S*chunk) memory,

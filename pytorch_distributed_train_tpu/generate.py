@@ -31,6 +31,9 @@ def build_decode_model(model_cfg: ModelConfig, precision: PrecisionConfig):
     import dataclasses
 
     cfg = dataclasses.replace(model_cfg, remat=False)
+    if getattr(cfg, "fused_lm_loss", False):
+        # generation needs logits; the fused head returns CE sums
+        cfg = dataclasses.replace(cfg, fused_lm_loss=False)
     model = build_model(cfg, precision)
     if not any(f.name == "decode" for f in dataclasses.fields(model)):
         raise ValueError(

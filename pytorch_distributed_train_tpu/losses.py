@@ -76,10 +76,76 @@ def causal_lm_xent(logits, batch, *_):
     return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
 
 
+def fused_causal_lm_xent(out, batch, *_):
+    """Loss for models running the fused chunked head (ModelConfig.
+    fused_lm_loss): the model already reduced CE inside its head region
+    (chunked_causal_ce below) and returns {'loss_sum', 'weight_sum'}
+    instead of (B, S, V) logits — which at 32k vocab never materialize.
+    """
+    loss = out["loss_sum"] / jnp.maximum(out["weight_sum"], 1.0)
+    return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+def chunked_causal_ce(x, kernel, input_ids, loss_mask=None,
+                      chunk: int = 256, transpose_kernel: bool = False) -> dict:
+    """Fused LM-head + cross-entropy over sequence chunks.
+
+    The torch-era pattern materializes logits (B, S, V) and hands them to
+    the loss; at Llama vocab (32k) and seq 2048 that is ~2 GB of fp32 HLO
+    temps live through the backward (measured, BASELINE.md 2026-07-30).
+    Computing ``head_matmul → CE → scalar`` per sequence chunk under
+    `jax.checkpoint` keeps one (B, chunk, V) tile live at a time and saves
+    only two scalars per chunk; backward recomputes tiles (the same
+    FLOPs-for-HBM trade as chunked attention / flash kernels).
+
+    x: (B, S, E) final hidden states (compute dtype); kernel: (E, V) — or
+    (V, E) with ``transpose_kernel`` (tied-embedding heads pass the raw
+    embedding table so no transposed copy materializes in HBM);
+    input_ids: (B, S) — targets are the shift-by-one, as causal_lm_xent.
+    Returns {'loss_sum', 'weight_sum'} fp32 scalars.
+    """
+    xs = x[:, :-1]
+    targets = input_ids[:, 1:]
+    weights = (loss_mask[:, 1:] if loss_mask is not None
+               else jnp.ones_like(targets)).astype(jnp.float32)
+    contract = ((x.ndim - 1,), (1,) if transpose_kernel else (0,))
+
+    B, S, E = xs.shape
+    n_chunks = -(-S // chunk)
+    pad = n_chunks * chunk - S
+    if pad:  # padded positions carry weight 0 → contribute nothing
+        xs = jnp.pad(xs, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        weights = jnp.pad(weights, ((0, 0), (0, pad)))
+    tiles = (
+        xs.reshape(B, n_chunks, chunk, E).transpose(1, 0, 2, 3),
+        targets.reshape(B, n_chunks, chunk).transpose(1, 0, 2),
+        weights.reshape(B, n_chunks, chunk).transpose(1, 0, 2),
+    )
+
+    def body(carry, tile):
+        xt, tt, wt = tile
+        logits = jax.lax.dot_general(
+            xt, kernel, (contract, ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tt)
+        return (carry[0] + (ce * wt).sum(), carry[1] + wt.sum()), None
+
+    # lax.scan (not a Python unroll): forces chunk-sequential scheduling so
+    # peak memory really is ONE tile — unrolled chunks let XLA overlap
+    # several chunk backwards and the saving evaporates. checkpoint makes
+    # the backward recompute each tile's logits from its saved inputs.
+    (loss_sum, weight_sum), _ = jax.lax.scan(
+        jax.checkpoint(body), (jnp.float32(0.0), jnp.float32(0.0)), tiles)
+    return {"loss_sum": loss_sum, "weight_sum": weight_sum}
+
+
 LOSSES = {
     "softmax_xent": softmax_xent,
     "mlm_xent": mlm_xent,
     "causal_lm_xent": causal_lm_xent,
+    "fused_causal_lm_xent": fused_causal_lm_xent,
 }
 
 

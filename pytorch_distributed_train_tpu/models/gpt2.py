@@ -136,10 +136,12 @@ class GPT2LMHead(nn.Module):
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
+    # Fused chunked head+CE over the tied embedding (losses.chunked_causal_ce)
+    fused_loss: bool = False
     act: "object | None" = None
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True):
+    def __call__(self, input_ids, train: bool = True, loss_mask=None):
         deterministic = not train
         B, S = input_ids.shape
         wte = nn.Embed(self.vocab_size, self.hidden_size,
@@ -182,6 +184,12 @@ class GPT2LMHead(nn.Module):
                          param_dtype=jnp.float32, name="ln_f")(x)
         # Tied head, bf16 operands with fp32 accumulation (cf. bert.py).
         emb = jnp.asarray(wte.embedding, self.dtype)  # (V, C)
+        if self.fused_loss and not self.decode:
+            from pytorch_distributed_train_tpu.losses import chunked_causal_ce
+
+            return chunked_causal_ce(x.astype(self.dtype), emb, input_ids,
+                                     loss_mask=loss_mask,
+                                     transpose_kernel=True)
         logits = jax.lax.dot_general(
             x.astype(self.dtype), emb,
             (((x.ndim - 1,), (1,)), ((), ())),
@@ -195,6 +203,7 @@ def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
         cp=cp,
         act=act,
         attn_impl=getattr(cfg, "attention_impl", "auto"),
+        fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

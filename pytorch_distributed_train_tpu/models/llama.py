@@ -219,6 +219,10 @@ class LlamaForCausalLM(nn.Module):
     moe: "MoeSpec | None" = None
     attn_impl: str = "auto"
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
+    # Fused chunked head+CE (losses.chunked_causal_ce): __call__ returns
+    # {'loss_sum','weight_sum'} instead of logits — (B,S,V) fp32 logits
+    # never materialize. Pair with loss="fused_causal_lm_xent".
+    fused_loss: bool = False
     # SP/CP activation anchoring (parallel/mesh.py ActivationSharding):
     # keeps norms/residuals seq-sharded between attention / TP-matmul
     # regions — CP without it replicates seq outside the shard_map regions;
@@ -226,7 +230,7 @@ class LlamaForCausalLM(nn.Module):
     act: "object | None" = None
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True):
+    def __call__(self, input_ids, train: bool = True, loss_mask=None):
         del train  # no dropout in the Llama-2 pretrain recipe
         x = nn.Embed(
             self.vocab_size, self.hidden_size,
@@ -256,13 +260,26 @@ class LlamaForCausalLM(nn.Module):
         # keeps the (B,S,V) logits fp32 without an intermediate bf16
         # rounding (an fp32xfp32 matmul here ran at a fraction of MXU rate
         # and the head is ~1/6 of total model FLOPs at 32k vocab).
-        logits = nn.Dense(
+        head = nn.Dense(
             self.vocab_size, use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
             dot_general=partial(jax.lax.dot_general,
                                 preferred_element_type=jnp.float32),
             kernel_init=nn.initializers.normal(0.02), name="lm_head",
-        )(x)
+        )
+        if self.fused_loss and not self.decode:
+            from pytorch_distributed_train_tpu.losses import chunked_causal_ce
+
+            # Create the head params at the standard path without the full
+            # matmul (the tiny call is dead code XLA eliminates), then hand
+            # the kernel ARRAY to the pure chunked-CE helper — a flax
+            # submodule can't be called inside jax.checkpoint, an array can.
+            _ = head(x[:, :1])
+            kernel = jnp.asarray(head.variables["params"]["kernel"],
+                                 self.dtype)
+            return chunked_causal_ce(x, kernel, input_ids,
+                                     loss_mask=loss_mask)
+        logits = head(x)
         return logits.astype(jnp.float32)
 
 
@@ -284,6 +301,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         moe=moe,
         act=act,
         attn_impl=getattr(cfg, "attention_impl", "auto"),
+        fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

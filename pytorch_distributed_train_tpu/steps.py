@@ -53,8 +53,14 @@ def apply_model(model, params, batch_stats, batch, *, train: bool, dropout_rng):
         if train:
             mutable = ["batch_stats", "losses"]
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    kwargs = {}
+    if getattr(model, "fused_loss", False) and "loss_mask" in batch:
+        # Fused-head models reduce CE inside the model (losses.
+        # chunked_causal_ce), so the mask must travel in with the inputs.
+        kwargs["loss_mask"] = batch["loss_mask"]
     out = model.apply(
-        variables, *model_inputs(batch), train=train, rngs=rngs, mutable=mutable
+        variables, *model_inputs(batch), train=train, rngs=rngs,
+        mutable=mutable, **kwargs
     )
     if mutable:
         logits, updated = out

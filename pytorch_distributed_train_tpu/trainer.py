@@ -49,6 +49,17 @@ class Trainer:
         self.batch_axes = tuple(cfg.mesh.batch_axes)
         self.model = build_model(cfg.model, cfg.precision,
                                  mesh=self.mesh, mesh_cfg=cfg.mesh)
+        fused_model = getattr(cfg.model, "fused_lm_loss", False)
+        if fused_model != (cfg.loss == "fused_causal_lm_xent"):
+            raise ValueError(
+                "model.fused_lm_loss and loss='fused_causal_lm_xent' must be "
+                f"set together (got fused_lm_loss={fused_model}, "
+                f"loss={cfg.loss!r}): the fused model returns CE sums, not "
+                "logits, so no other loss can consume its output")
+        if fused_model and cfg.model.name not in ("llama", "gpt2"):
+            raise ValueError(
+                f"fused_lm_loss is implemented for llama/gpt2, not "
+                f"{cfg.model.name!r}")
         self.loss_fn = losses_lib.get_loss_fn(
             cfg.loss, label_smoothing=cfg.label_smoothing)
         self.rules = rules_for_model(cfg.model.name)
