@@ -149,6 +149,10 @@ def main() -> None:
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--remat-policy", default="full",
+                   choices=["full", "dots", "dots_no_batch"],
+                   help="llama only: what block remat keeps resident "
+                        "(models/remat.py)")
     p.add_argument("--fused-head", action="store_true",
                    help="llama only: fused chunked LM-head loss "
                         "(model.fused_lm_loss) — (B,S,V) logits never "
@@ -206,6 +210,7 @@ def main() -> None:
             name="llama", vocab_size=32000, hidden_size=2048, num_layers=16,
             num_heads=16, num_kv_heads=16, mlp_dim=5504,
             max_seq_len=args.seq_len, remat=True,
+            remat_policy=args.remat_policy,
             attention_impl=args.attention_impl,
             fused_lm_loss=args.fused_head,
         )
@@ -301,7 +306,8 @@ def main() -> None:
         # they must not share a baseline key with the dense-head config.
         canonical = (args.batch_per_chip in (0, 8) and args.seq_len == 2048
                      and args.attention_impl == "auto"
-                     and not args.fused_head)
+                     and not args.fused_head
+                     and args.remat_policy == "full")
     else:  # bert_base
         canonical = (args.batch_per_chip in (0, 32) and args.seq_len >= 512
                      and args.attention_impl == "auto")

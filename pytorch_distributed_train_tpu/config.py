@@ -55,6 +55,11 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False
+    # What remat may keep resident (models/remat.py — the selective
+    # activation-checkpointing dial): "full" recomputes everything,
+    # "dots" keeps matmul outputs (XLA dots_saveable), "dots_no_batch"
+    # keeps only non-batch-dim matmuls.
+    remat_policy: str = "full"
     # Fused chunked LM-head loss (llama/gpt2): head matmul + CE computed per
     # sequence chunk under remat so (B,S,V) logits never materialize
     # (losses.chunked_causal_ce). Requires loss="fused_causal_lm_xent".
@@ -429,6 +434,10 @@ def _llama2_7b() -> TrainConfig:
         name="llama", hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=32, mlp_dim=11008, vocab_size=32000, max_seq_len=4096,
         rope_theta=10000.0, rms_norm_eps=1e-5, remat=True,
+        # (B,S,V) logits at 32k vocab / 4k seq are ~2 GB fp32 per sample —
+        # the fused chunked head (losses.chunked_causal_ce) never builds
+        # them; generation clears the flag automatically.
+        fused_lm_loss=True,
     )
     c.data = DataConfig(dataset="synthetic_lm", batch_size=128, seq_len=4096)
     c.optim = OptimConfig(
@@ -439,7 +448,7 @@ def _llama2_7b() -> TrainConfig:
     c.precision = PrecisionConfig(compute_dtype="bfloat16")
     c.mesh = MeshConfig(data=1, fsdp=-1)
     c.total_steps = 500000
-    c.loss = "causal_lm_xent"
+    c.loss = "fused_causal_lm_xent"  # pairs with model.fused_lm_loss above
     return c
 
 

@@ -98,6 +98,7 @@ class BertForMLM(nn.Module):
     max_seq_len: int = 512
     dropout_rate: float = 0.1
     remat: bool = False
+    remat_policy: str = "full"  # full | dots | dots_no_batch (models/remat.py)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
@@ -135,7 +136,9 @@ class BertForMLM(nn.Module):
         else:
             pad_mask = attention_mask[:, None, None, :].astype(bool)  # (B,1,1,S)
 
-        block_cls = nn.remat(BertLayer) if self.remat else BertLayer
+        from pytorch_distributed_train_tpu.models.remat import remat_block
+
+        block_cls = remat_block(BertLayer, self.remat, self.remat_policy)
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
@@ -180,6 +183,7 @@ def bert_base(cfg, dtype, param_dtype, cp=None, act=None) -> BertForMLM:
         max_seq_len=cfg.max_seq_len,
         dropout_rate=cfg.dropout_rate,
         remat=cfg.remat,
+        remat_policy=getattr(cfg, "remat_policy", "full"),
         dtype=dtype,
         param_dtype=param_dtype,
     )

@@ -131,6 +131,7 @@ class GPT2LMHead(nn.Module):
     max_seq_len: int = 1024
     dropout_rate: float = 0.0
     remat: bool = False
+    remat_policy: str = "full"  # full | dots | dots_no_batch (models/remat.py)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
@@ -169,7 +170,9 @@ class GPT2LMHead(nn.Module):
         if self.act is not None:
             x = self.act.constrain(x)
 
-        block_cls = nn.remat(GPT2Block) if self.remat else GPT2Block
+        from pytorch_distributed_train_tpu.models.remat import remat_block
+
+        block_cls = remat_block(GPT2Block, self.remat, self.remat_policy)
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.max_seq_len,
@@ -212,6 +215,7 @@ def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
         max_seq_len=cfg.max_seq_len,
         dropout_rate=cfg.dropout_rate,
         remat=cfg.remat,
+        remat_policy=getattr(cfg, "remat_policy", "full"),
         dtype=dtype,
         param_dtype=param_dtype,
     )

@@ -213,6 +213,7 @@ class LlamaForCausalLM(nn.Module):
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     remat: bool = True
+    remat_policy: str = "full"  # full | dots | dots_no_batch (models/remat.py)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
@@ -240,7 +241,9 @@ class LlamaForCausalLM(nn.Module):
         if self.act is not None:
             x = self.act.constrain(x)
 
-        block_cls = nn.remat(LlamaBlock) if self.remat else LlamaBlock
+        from pytorch_distributed_train_tpu.models.remat import remat_block
+
+        block_cls = remat_block(LlamaBlock, self.remat, self.remat_policy)
         for i in range(self.num_layers):
             moe = (self.moe if self.moe is not None
                    and self.moe.active_for_layer(i) else None)
@@ -312,6 +315,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         rope_theta=cfg.rope_theta,
         rms_norm_eps=cfg.rms_norm_eps,
         remat=cfg.remat,
+        remat_policy=getattr(cfg, "remat_policy", "full"),
         dtype=dtype,
         param_dtype=param_dtype,
     )

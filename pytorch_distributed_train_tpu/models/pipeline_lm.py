@@ -150,7 +150,14 @@ class PipelinedLlama:
             return self.block.apply(vars_, h), jnp.float32(0.0)
 
         if self.cfg.remat:
-            block_apply = jax.checkpoint(block_apply)
+            from pytorch_distributed_train_tpu.models.remat import POLICIES
+
+            policy = getattr(self.cfg, "remat_policy", "full")
+            if policy not in POLICIES:
+                raise ValueError(
+                    f"remat_policy must be one of {sorted(POLICIES)}, "
+                    f"got {policy!r}")
+            block_apply = jax.checkpoint(block_apply, policy=POLICIES[policy])
 
         def stage_fn(blocks_local, h):
             # blocks_local leaves: (layers_per_stage, ...) — scan applies

@@ -1,0 +1,34 @@
+"""Rematerialization policy selection (the torch activation-checkpointing
+`checkpoint_impl`/selective-checkpoint analogue, config-driven).
+
+``remat=True`` recomputes everything inside each transformer block during
+backward (jax default policy). On large models the MXU-bound matmul
+recompute can dominate backward time; ``remat_policy="dots"`` keeps matmul
+outputs resident (XLA's ``dots_saveable``) and recomputes only the cheap
+elementwise/norm chains — the classic flops↔HBM dial. "dots_no_batch"
+saves only non-batch-dim matmuls (scales better with batch).
+"""
+
+from __future__ import annotations
+
+import jax
+import flax.linen as nn
+
+POLICIES = {
+    "full": None,  # save nothing — recompute the whole block (default)
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def remat_block(block_cls, enabled: bool, policy: str = "full"):
+    """Wrap a block class with nn.remat per the configured policy."""
+    if not enabled:
+        return block_cls
+    if policy not in POLICIES:
+        raise ValueError(
+            f"remat_policy must be one of {sorted(POLICIES)}, got {policy!r}")
+    chosen = POLICIES[policy]
+    if chosen is None:
+        return nn.remat(block_cls)
+    return nn.remat(block_cls, policy=chosen)

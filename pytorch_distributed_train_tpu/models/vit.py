@@ -103,6 +103,7 @@ class ViT(nn.Module):
     mlp_dim: int = 3072
     dropout_rate: float = 0.0
     remat: bool = False
+    remat_policy: str = "full"  # full | dots | dots_no_batch (models/remat.py)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     attn_impl: str = "auto"
@@ -130,7 +131,9 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
 
-        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
+        from pytorch_distributed_train_tpu.models.remat import remat_block
+
+        block_cls = remat_block(EncoderBlock, self.remat, self.remat_policy)
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
@@ -160,6 +163,7 @@ def vit_b16(cfg, dtype, param_dtype, cp=None) -> ViT:
         mlp_dim=cfg.mlp_dim,
         dropout_rate=cfg.dropout_rate,
         remat=cfg.remat,
+        remat_policy=getattr(cfg, "remat_policy", "full"),
         dtype=dtype,
         param_dtype=param_dtype,
     )

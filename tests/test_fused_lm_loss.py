@@ -159,3 +159,47 @@ def test_trainer_rejects_fused_on_unsupported_family(tmp_path):
     cfg.checkpoint.dir = str(tmp_path)
     with pytest.raises(ValueError, match="llama/gpt2"):
         Trainer(cfg)
+
+
+def test_fused_loss_under_fsdp_tp_sharding(devices8):
+    """GSPMD must partition the scan+remat fused head (kernel sharded over
+    'tensor', activations over 'fsdp'/'data') and agree with the dense
+    path's loss on the same params."""
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import MeshConfig, OptimConfig
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2, context=1))
+    prec = PrecisionConfig()
+    batch = _batch(B=4, S=256, vocab=512, seed=7)
+    tx, _ = make_optimizer(OptimConfig(name="adamw", learning_rate=1e-3,
+                                       schedule="constant"), total_steps=10)
+    rules = rules_for_model("llama")
+
+    losses = {}
+    for fused in (False, True):
+        cfg = _cfg("llama", fused)
+        cfg.max_seq_len = 256
+        model = build_model(cfg, prec, mesh=mesh,
+                            mesh_cfg=MeshConfig(data=2, fsdp=2, tensor=2))
+
+        def init_state(rng):
+            v = model.init({"params": rng}, batch["input_ids"], train=False)
+            return TrainState.create(params=v["params"], tx=tx)
+
+        shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+        sh = steps_lib.state_shardings(mesh, rules, shape)
+        state = jax.jit(init_state, out_shardings=sh)(jax.random.PRNGKey(0))
+        loss_name = "fused_causal_lm_xent" if fused else "causal_lm_xent"
+        step = steps_lib.jit_train_step(
+            steps_lib.make_train_step(model, get_loss_fn(loss_name), tx),
+            mesh, sh)
+        _, metrics = step(state, batch, jax.random.PRNGKey(1))
+        losses[fused] = float(metrics["loss"])
+    np.testing.assert_allclose(losses[True], losses[False],
+                               atol=1e-5, rtol=1e-5)
