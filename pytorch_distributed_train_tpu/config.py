@@ -92,7 +92,10 @@ class DataConfig:
     """Input pipeline. ``batch_size`` is GLOBAL (summed over all hosts/chips),
     matching the reference's per-step effective batch under DDP."""
 
-    dataset: str = "synthetic_images"  # synthetic_images | cifar10 | imagenet_folder | synthetic_lm | text_mlm
+    # synthetic_images | cifar10 | imagenet_folder | synthetic_lm |
+    # text_lm (real corpus) | text_mlm (real corpus when text_files set,
+    # else synthetic masking stream)
+    dataset: str = "synthetic_images"
     data_dir: str = ""
     # Host loader backend (SURVEY C17): "threads" (in-process pool) or
     # "grain" (Grain worker PROCESSES — the torch-DataLoader-worker model)
@@ -116,6 +119,11 @@ class DataConfig:
     # LM datasets
     seq_len: int = 512
     mlm_prob: float = 0.15
+    # Real-text corpus (datasets text_lm / text_mlm, data/text.py): glob of
+    # local .txt/.jsonl files, and an optional local HF-tokenizer directory
+    # (absent → built-in byte-level tokenizer, vocab 259).
+    text_files: str = ""
+    tokenizer_path: str = ""
     # Synthetic dataset length (steps worth of fake data per epoch)
     synthetic_size: int = 51200
 

@@ -377,7 +377,17 @@ def build_dataset(data_cfg, model_cfg, train: bool):
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,
             seed=0 if train else 1,
         )
+    if name == "text_lm":
+        from pytorch_distributed_train_tpu.data.text import build_text_dataset
+
+        return build_text_dataset(data_cfg, model_cfg, train, mlm=False)
     if name == "text_mlm":
+        if data_cfg.text_files:
+            from pytorch_distributed_train_tpu.data.text import (
+                build_text_dataset,
+            )
+
+            return build_text_dataset(data_cfg, model_cfg, train, mlm=True)
         return synthetic_mlm(
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,
             data_cfg.mlm_prob, seed=0 if train else 1,

@@ -82,10 +82,14 @@ class HostDataLoader:
         n_steps = self.steps_per_epoch
         if not self.train:
             # pad tail by wrapping so every step is full-size (weights unused
-            # rows are the caller's concern only for exact eval metrics)
+            # rows are the caller's concern only for exact eval metrics).
+            # np.resize tiles cyclically — datasets smaller than one batch
+            # (tiny eval holdouts) still fill a whole batch, where a single
+            # wrap-around concat would come up short and break the sharded
+            # device_put's divisibility contract.
             need = n_steps * self.host_batch
             if len(idx) < need:
-                idx = np.concatenate([idx, idx[: need - len(idx)]])
+                idx = np.resize(idx, need)
         for b in range(start_batch, n_steps):
             chunk = idx[b * self.host_batch : (b + 1) * self.host_batch]
             rng = np.random.default_rng(

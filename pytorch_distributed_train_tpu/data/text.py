@@ -1,0 +1,186 @@
+"""Real-text corpus pipeline: local files → tokens → packed blocks.
+
+The reference's config 4 trains BERT MLM on Wikipedia text
+(BASELINE.json:10) through a tokenize → pack → mask pipeline; the causal
+configs consume packed next-token blocks the same way. This module is that
+pipeline for LOCAL data (this environment has no network egress, and
+production TPU pods mount data anyway):
+
+- ``datasets: text_lm | text_mlm`` with ``data.text_files`` pointing at
+  .txt/.jsonl globs;
+- tokenizer: a HF tokenizer directory via ``data.tokenizer_path``
+  (transformers.AutoTokenizer, loaded offline), else a built-in byte-level
+  tokenizer (vocab 259: 256 bytes + pad/eos/mask) so the path works with
+  zero assets;
+- packing: documents are tokenized independently, joined with EOS, and cut
+  into contiguous ``seq_len`` blocks — the standard LM packing that keeps
+  every batch shape static (SURVEY §7.4.5);
+- split: every ``eval_holdout``-th block goes to eval — deterministic,
+  disjoint from train, no files to maintain.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Asset-free fallback: UTF-8 bytes + {pad, eos, mask} specials."""
+
+    vocab_size = 259
+    pad_id = 256
+    eos_id = 257
+    mask_id = 258
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+
+class HFTokenizer:
+    """transformers.AutoTokenizer adapter (loaded from a LOCAL directory)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.vocab_size = len(self._tok)
+        self.pad_id = self._tok.pad_token_id or 0
+        self.eos_id = (self._tok.eos_token_id
+                       if self._tok.eos_token_id is not None
+                       else self._tok.sep_token_id or 0)
+        self.mask_id = (self._tok.mask_token_id
+                        if self._tok.mask_token_id is not None
+                        else self.eos_id)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+
+def load_tokenizer(tokenizer_path: str = ""):
+    return HFTokenizer(tokenizer_path) if tokenizer_path else ByteTokenizer()
+
+
+def _doc_text(doc) -> str:
+    return doc.get("text", "") if isinstance(doc, dict) else ""
+
+
+def _iter_documents(files: list[str]):
+    """Yield text documents: .jsonl lines' 'text' field; .json whole-file
+    (array of docs or a single doc); else raw lines grouped into
+    blank-line-separated paragraphs (txt)."""
+    for path in files:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            if path.endswith(".jsonl"):
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if _doc_text(doc):
+                        yield _doc_text(doc)
+            elif path.endswith(".json"):
+                # a standard (possibly pretty-printed) JSON file — parsing
+                # it line-wise would silently contribute zero documents
+                try:
+                    parsed = json.load(fh)
+                except json.JSONDecodeError as e:
+                    raise ValueError(f"{path} is not valid JSON: {e}") from e
+                docs = parsed if isinstance(parsed, list) else [parsed]
+                for doc in docs:
+                    if _doc_text(doc):
+                        yield _doc_text(doc)
+            else:
+                para: list[str] = []
+                for line in fh:
+                    if line.strip():
+                        para.append(line.strip())
+                    elif para:
+                        yield " ".join(para)
+                        para = []
+                if para:
+                    yield " ".join(para)
+
+
+def pack_corpus(files: list[str], tokenizer, seq_len: int) -> np.ndarray:
+    """Tokenize + pack into (N, seq_len) int32 blocks (EOS-joined docs;
+    the ragged tail that doesn't fill a block is dropped — same contract
+    as drop_last batching). Accumulates per-document int32 chunks, not one
+    giant Python int list (~7x the final array's RAM)."""
+    eos = np.asarray([tokenizer.eos_id], np.int32)
+    chunks: list[np.ndarray] = []
+    total = 0
+    for doc in _iter_documents(files):
+        ids = np.asarray(tokenizer.encode(doc), np.int32)
+        chunks.extend((ids, eos))
+        total += len(ids) + 1
+    n_blocks = total // seq_len
+    if n_blocks == 0:
+        raise ValueError(
+            f"corpus too small: {total} tokens < seq_len {seq_len}")
+    stream = np.concatenate(chunks)[: n_blocks * seq_len]
+    return stream.reshape(n_blocks, seq_len)
+
+
+def _resolve_files(pattern: str) -> list[str]:
+    files = sorted(glob_mod.glob(pattern, recursive=True))
+    if not files:
+        raise FileNotFoundError(f"data.text_files matched nothing: {pattern!r}")
+    return files
+
+
+def _split(blocks: np.ndarray, train: bool, eval_holdout: int):
+    idx = np.arange(len(blocks))
+    is_eval = (idx % eval_holdout) == (eval_holdout - 1)
+    picked = blocks[~is_eval] if train else blocks[is_eval]
+    if len(picked) == 0:  # tiny corpora: fall back to using everything
+        picked = blocks
+    return picked
+
+
+# Trainer builds the train and eval datasets back-to-back; pack the corpus
+# once and split the shared (read-only) array both ways. Keyed on content
+# identity (paths + mtimes + sizes) so a changed corpus re-packs.
+_PACK_CACHE: dict[tuple, np.ndarray] = {}
+
+
+def _packed_blocks(files: list[str], tokenizer_path: str, seq_len: int):
+    key = (tuple(files),
+           tuple((os.path.getmtime(f), os.path.getsize(f)) for f in files),
+           tokenizer_path, seq_len)
+    if key not in _PACK_CACHE:
+        _PACK_CACHE.clear()  # hold at most one corpus
+        tok = load_tokenizer(tokenizer_path)
+        _PACK_CACHE[key] = pack_corpus(files, tok, seq_len)
+    return _PACK_CACHE[key]
+
+
+def build_text_dataset(data_cfg, model_cfg, train: bool, mlm: bool,
+                       eval_holdout: int = 50):
+    """Factory for datasets 'text_lm' (causal) and 'text_mlm' (BERT MLM)."""
+    from pytorch_distributed_train_tpu.data.datasets import (
+        ArrayDataset, MLMDataset,
+    )
+
+    tok = load_tokenizer(data_cfg.tokenizer_path)
+    if tok.vocab_size > model_cfg.vocab_size:
+        raise ValueError(
+            f"tokenizer vocab {tok.vocab_size} exceeds model.vocab_size "
+            f"{model_cfg.vocab_size}")
+    blocks = _packed_blocks(_resolve_files(data_cfg.text_files),
+                            data_cfg.tokenizer_path, data_cfg.seq_len)
+    blocks = _split(blocks, train, eval_holdout)
+    if not mlm:
+        return ArrayDataset({"input_ids": blocks})
+    # random-replacement ids must come from the TOKENIZER's vocab — the
+    # model's (padded) vocab may contain rows real data never produces.
+    return MLMDataset(
+        blocks, np.ones_like(blocks), tok.vocab_size,
+        mlm_prob=data_cfg.mlm_prob, mask_id=tok.mask_id,
+    )

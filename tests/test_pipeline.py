@@ -103,3 +103,22 @@ def test_dataset_factory_covers_matrix():
         ds = build_dataset(_cfg(dataset=name, synthetic_size=16, seq_len=16), m,
                            train=True)
         assert len(ds) > 0
+
+
+def test_eval_dataset_smaller_than_one_batch_wraps_to_full():
+    """A 3-sample eval set with batch 8 must still yield full (8, ...)
+    batches (sharded device_put needs batch % devices == 0) — regression
+    for the single-concat wrap that came up short."""
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.config import DataConfig
+    from pytorch_distributed_train_tpu.data.datasets import ArrayDataset
+    from pytorch_distributed_train_tpu.data.pipeline import HostDataLoader
+
+    ds = ArrayDataset({"x": np.arange(3, dtype=np.int32)})
+    loader = HostDataLoader(ds, DataConfig(batch_size=8), train=False,
+                            num_hosts=1, host_id=0)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 1
+    assert batches[0]["x"].shape == (8,)
+    assert set(batches[0]["x"]) == {0, 1, 2}  # wrapped, not padded w/ junk

@@ -1,0 +1,167 @@
+"""Real-text corpus pipeline (data/text.py): tokenize → pack → split →
+LM/MLM datasets, with the byte fallback and a local HF tokenizer."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_train_tpu.config import DataConfig, ModelConfig
+from pytorch_distributed_train_tpu.data.datasets import build_dataset
+from pytorch_distributed_train_tpu.data.text import (
+    ByteTokenizer, _split, load_tokenizer, pack_corpus,
+)
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    (tmp_path / "a.txt").write_text(
+        "hello world this is doc one\n\nsecond paragraph here\n")
+    with open(tmp_path / "b.jsonl", "w") as fh:
+        for i in range(40):
+            fh.write(json.dumps({"text": f"json document number {i} " * 8}) + "\n")
+        fh.write("not json\n")          # skipped
+        fh.write(json.dumps([1, 2]) + "\n")  # non-dict: skipped
+    return tmp_path
+
+
+def test_pack_corpus_byte_tokenizer(corpus):
+    tok = ByteTokenizer()
+    blocks = pack_corpus(sorted(str(p) for p in corpus.iterdir()), tok, 64)
+    assert blocks.dtype == np.int32 and blocks.shape[1] == 64
+    assert blocks.min() >= 0 and blocks.max() < tok.vocab_size
+    # document joins carry EOS separators
+    assert (blocks == tok.eos_id).sum() >= 40
+    # byte round-trip of the first document's start
+    text = bytes(b for b in blocks.flat if b < 256).decode(
+        "utf-8", errors="replace")
+    assert "hello world this is doc one" in text
+    # deterministic
+    blocks2 = pack_corpus(sorted(str(p) for p in corpus.iterdir()), tok, 64)
+    np.testing.assert_array_equal(blocks, blocks2)
+
+
+def test_split_disjoint_and_fallback():
+    blocks = np.arange(100 * 4, dtype=np.int32).reshape(100, 4)
+    tr = _split(blocks, True, 50)
+    ev = _split(blocks, False, 50)
+    assert len(tr) == 98 and len(ev) == 2
+    tr_rows = {tuple(r) for r in tr}
+    assert all(tuple(r) not in tr_rows for r in ev)
+    tiny = blocks[:3]
+    assert len(_split(tiny, False, 50)) == 3  # holdout empty → use all
+
+
+def test_build_dataset_text_lm_and_mlm(corpus):
+    data_cfg = DataConfig(dataset="text_lm", seq_len=64,
+                          text_files=str(corpus / "*"))
+    model_cfg = ModelConfig(vocab_size=512)
+    ds = build_dataset(data_cfg, model_cfg, train=True)
+    batch = ds.get_batch(np.arange(4), np.random.default_rng(0), train=True)
+    assert batch["input_ids"].shape == (4, 64)
+
+    data_cfg = DataConfig(dataset="text_mlm", seq_len=64, mlm_prob=0.15,
+                          text_files=str(corpus / "*"))
+    ds = build_dataset(data_cfg, model_cfg, train=True)
+    batch = ds.get_batch(np.arange(8), np.random.default_rng(0), train=True)
+    assert set(batch) >= {"input_ids", "labels", "label_weights",
+                          "attention_mask"}
+    frac = batch["label_weights"].mean()
+    assert 0.05 < frac < 0.3  # ~15% masked
+    # masked positions use the byte tokenizer's mask id 80% of the time
+    w = batch["label_weights"].astype(bool)
+    assert (batch["input_ids"][w] == ByteTokenizer.mask_id).mean() > 0.5
+    # eval split comes from held-out blocks, not the train rows
+    ds_ev = build_dataset(data_cfg, model_cfg, train=False)
+    assert len(ds_ev) > 0
+
+
+def test_vocab_size_validation(corpus):
+    data_cfg = DataConfig(dataset="text_lm", seq_len=32,
+                          text_files=str(corpus / "*"))
+    with pytest.raises(ValueError, match="vocab"):
+        build_dataset(data_cfg, ModelConfig(vocab_size=128), train=True)
+
+
+def test_missing_files_raise():
+    cfg = DataConfig(dataset="text_lm", seq_len=32,
+                     text_files="/nonexistent/*.txt")
+    with pytest.raises(FileNotFoundError):
+        build_dataset(cfg, ModelConfig(vocab_size=512), train=True)
+
+
+def test_hf_tokenizer_adapter(tmp_path, corpus):
+    transformers = pytest.importorskip("transformers")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "hello", "world",
+             "json", "document", "number", "this", "is", "doc", "one"]
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    (tok_dir / "vocab.txt").write_text("\n".join(vocab) + "\n")
+    hf = transformers.BertTokenizer.from_pretrained(str(tok_dir))
+    hf.save_pretrained(str(tok_dir))
+
+    tok = load_tokenizer(str(tok_dir))
+    assert tok.vocab_size == len(vocab)
+    assert tok.mask_id == vocab.index("[MASK]")
+    ids = tok.encode("hello world")
+    assert ids == [vocab.index("hello"), vocab.index("world")]
+
+    blocks = pack_corpus([str(corpus / "a.txt")], tok, 8)
+    assert blocks.shape[1] == 8
+    assert blocks.max() < len(vocab)
+
+
+def test_text_lm_trains_end_to_end(tmp_path, corpus):
+    """Trainer runs causal-LM training on the packed real-text corpus."""
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    cfg = get_preset("gpt2_small")
+    cfg.model = ModelConfig(name="gpt2", vocab_size=512, hidden_size=32,
+                            num_layers=1, num_heads=2, mlp_dim=64,
+                            max_seq_len=64)
+    cfg.loss = "causal_lm_xent"
+    cfg.data = DataConfig(dataset="text_lm", seq_len=64, batch_size=8,
+                          text_files=str(corpus / "*"))
+    cfg.checkpoint.dir = str(tmp_path / "ck")
+    cfg.checkpoint.save_every_steps = 0
+    cfg.total_steps = 2
+    cfg.epochs = 0
+    Trainer(cfg).fit()
+
+
+def test_json_whole_file_and_pack_cache(tmp_path):
+    import json as json_mod
+
+    from pytorch_distributed_train_tpu.data import text as text_mod
+
+    docs = [{"text": f"pretty printed doc {i} " * 10} for i in range(30)]
+    (tmp_path / "c.json").write_text(json_mod.dumps(docs, indent=2))
+    files = [str(tmp_path / "c.json")]
+    blocks = text_mod.pack_corpus(files, ByteTokenizer(), 32)
+    assert len(blocks) > 5  # pretty-printed JSON contributes documents
+
+    (tmp_path / "bad.json").write_text("{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        text_mod.pack_corpus([str(tmp_path / "bad.json")], ByteTokenizer(), 32)
+
+    # pack cache: same corpus → same array object across train/eval builds
+    cfg = DataConfig(dataset="text_lm", seq_len=32,
+                     text_files=str(tmp_path / "c.json"))
+    model_cfg = ModelConfig(vocab_size=512)
+    text_mod._PACK_CACHE.clear()
+    text_mod.build_text_dataset(cfg, model_cfg, train=True, mlm=False)
+    assert len(text_mod._PACK_CACHE) == 1
+    cached = next(iter(text_mod._PACK_CACHE.values()))
+    text_mod.build_text_dataset(cfg, model_cfg, train=False, mlm=False)
+    assert next(iter(text_mod._PACK_CACHE.values())) is cached
+
+
+def test_mlm_random_replacement_stays_in_tokenizer_vocab(corpus):
+    data_cfg = DataConfig(dataset="text_mlm", seq_len=64, mlm_prob=0.5,
+                          text_files=str(corpus / "*"))
+    ds = build_dataset(data_cfg, ModelConfig(vocab_size=50000), train=True)
+    batch = ds.get_batch(np.arange(8), np.random.default_rng(0), train=True)
+    # every input id must be producible by the byte tokenizer (vocab 259),
+    # including the 10% random replacements
+    assert batch["input_ids"].max() < ByteTokenizer.vocab_size
