@@ -107,6 +107,9 @@ class DataConfig:
     shuffle: bool = True
     drop_last: bool = True  # SPMD needs static shapes; pad-or-drop final batch
     seed: int = 0
+    # "" | "inverse_class" — torch WeightedRandomSampler recipe: train-time
+    # draws WITH replacement ∝ 1/class-frequency (array datasets w/ labels)
+    weighted_sampling: str = ""
     # Batch augmentation (device-side, ops/mixup.py — the torchvision/timm
     # --mixup-alpha/--cutmix-alpha recipe knobs); 0.0 disables.
     mixup_alpha: float = 0.0
@@ -135,7 +138,14 @@ class OptimConfig:
     name: str = "sgd"  # sgd | momentum | adamw | lamb | adam | lars
     learning_rate: float = 0.1
     warmup_steps: int = 0
-    schedule: str = "cosine"  # constant | cosine | step | linear
+    # constant | cosine | step | linear | onecycle | cosine_restarts
+    schedule: str = "cosine"
+    # onecycle: fraction of the horizon spent ramping up (torch OneCycleLR
+    # pct_start); cosine_restarts: first cycle length in optimizer updates
+    # (0 → horizon/4) and per-restart length multiplier (torch T_0/T_mult).
+    onecycle_pct_start: float = 0.3
+    restart_period: int = 0
+    restart_mult: float = 1.0
     # step schedule
     step_decay_rate: float = 0.1
     step_decay_every: int = 30  # epochs

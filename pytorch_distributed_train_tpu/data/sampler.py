@@ -75,3 +75,41 @@ class DistributedSampler:
 
     def __len__(self) -> int:
         return self.num_samples
+
+
+class WeightedDistributedSampler(DistributedSampler):
+    """torch WeightedRandomSampler semantics, made distributed-aware.
+
+    torch's WeightedRandomSampler (torch recipe for class-imbalanced data)
+    draws ``num_samples`` indices WITH replacement, proportionally to a
+    per-sample weight vector; in DDP recipes it is wrapped per-rank. Here
+    the weighted draw replaces the permutation directly: identical on every
+    host (seed+epoch rng, no communication), padded/stride-sharded like the
+    base class, reshuffled per epoch.
+    """
+
+    def __init__(self, weights: np.ndarray, num_replicas: int, rank: int,
+                 seed: int = 0, drop_last: bool = False,
+                 num_samples: int | None = None):
+        total = num_samples if num_samples is not None else len(weights)
+        super().__init__(total, num_replicas, rank, shuffle=True, seed=seed,
+                         drop_last=drop_last)
+        weights = np.asarray(weights, np.float64)
+        if (weights < 0).any() or weights.sum() <= 0:
+            raise ValueError("weights must be non-negative with positive sum")
+        self.weights = weights / weights.sum()
+
+    def indices(self) -> np.ndarray:
+        g = np.random.default_rng(self.seed + self.epoch)
+        idx = g.choice(len(self.weights), size=self.total_size, replace=True,
+                       p=self.weights)
+        return idx[self.rank :: self.num_replicas]
+
+
+def inverse_class_weights(labels: np.ndarray) -> np.ndarray:
+    """Per-sample weights ∝ 1/class-frequency — the standard torch
+    WeightedRandomSampler recipe for imbalanced classification."""
+    labels = np.asarray(labels)
+    _, inverse, counts = np.unique(labels, return_inverse=True,
+                                   return_counts=True)
+    return (1.0 / counts)[inverse]

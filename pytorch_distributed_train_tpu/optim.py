@@ -12,6 +12,7 @@ forward/backwards accumulate locally, collectives fire once per real step.
 
 from __future__ import annotations
 
+import itertools
 import re
 
 import optax
@@ -65,6 +66,40 @@ def make_schedule(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
             every * (i + 1): opt_cfg.step_decay_rate for i in range(100)
         }
         main = optax.piecewise_constant_schedule(base, boundaries_and_scales)
+    elif opt_cfg.schedule == "onecycle":
+        # torch OneCycleLR analogue. The policy owns its own ramp, so a
+        # separate warmup would double-warm — reject the combination.
+        if warmup > 0:
+            raise ValueError(
+                "schedule='onecycle' has a built-in warmup phase "
+                "(onecycle_pct_start); set warmup_steps=0")
+        return optax.cosine_onecycle_schedule(
+            max(total_steps, 1), base,
+            pct_start=opt_cfg.onecycle_pct_start,
+        )
+    elif opt_cfg.schedule == "cosine_restarts":
+        # torch CosineAnnealingWarmRestarts: cycles of cosine decay back to
+        # the base LR, each restart_mult times longer than the last. Same
+        # domain rules as torch (T_mult >= 1, T_0 > 0) — shrinking cycles
+        # would degenerate into ~horizon/1 one-step schedule closures.
+        if opt_cfg.restart_mult < 1.0:
+            raise ValueError(
+                f"restart_mult must be >= 1, got {opt_cfg.restart_mult}")
+        if opt_cfg.restart_period < 0:
+            raise ValueError(
+                f"restart_period must be >= 0, got {opt_cfg.restart_period}")
+        period = opt_cfg.restart_period or max(decay_steps // 4, 1)
+        periods: list[int] = []
+        covered = 0
+        while covered < decay_steps:
+            periods.append(period)
+            covered += period
+            period = max(int(period * opt_cfg.restart_mult), 1)
+        cycles = [optax.cosine_decay_schedule(base, p,
+                                              alpha=opt_cfg.end_lr_factor)
+                  for p in periods]
+        boundaries = list(itertools.accumulate(periods))[:-1]
+        main = optax.join_schedules(cycles, boundaries)
     else:
         raise ValueError(f"unknown schedule {opt_cfg.schedule!r}")
 

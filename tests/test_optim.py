@@ -7,6 +7,7 @@ optax weight-decay transform (optim.decay_mask_fn).
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -135,3 +136,67 @@ def test_vit_preset_excludes_cls_and_pos_embed():
     })
     assert mask == {"cls_token": False, "pos_embed": False,
                     "blk": {"kernel": True, "bias": False}}
+
+
+def test_onecycle_schedule_shape():
+    from pytorch_distributed_train_tpu.optim import make_schedule
+
+    cfg = OptimConfig(name="adamw", learning_rate=1.0, schedule="onecycle",
+                      onecycle_pct_start=0.25)
+    sched = make_schedule(cfg, total_steps=100)
+    lrs = [float(sched(t)) for t in range(100)]
+    peak = int(np.argmax(lrs))
+    assert 20 <= peak <= 30            # ramps up for pct_start of the run
+    assert lrs[0] < 0.1 and max(lrs) == pytest.approx(1.0, abs=1e-6)
+    assert lrs[-1] < 0.01              # anneals far below the peak
+    with pytest.raises(ValueError, match="onecycle"):
+        make_schedule(OptimConfig(schedule="onecycle", warmup_steps=10),
+                      total_steps=100)
+
+
+def test_cosine_restarts_schedule():
+    from pytorch_distributed_train_tpu.optim import make_schedule
+
+    cfg = OptimConfig(name="momentum", learning_rate=1.0,
+                      schedule="cosine_restarts", restart_period=20,
+                      restart_mult=1.0)
+    sched = make_schedule(cfg, total_steps=60)
+    lrs = np.array([float(sched(t)) for t in range(60)])
+    # restarts at 20 and 40: LR jumps back to ~base
+    assert lrs[0] == pytest.approx(1.0)
+    for boundary in (20, 40):
+        assert lrs[boundary] > 0.95, boundary
+        assert lrs[boundary - 1] < 0.05, boundary
+    # restart_mult grows cycles: second cycle twice as long
+    cfg2 = OptimConfig(name="momentum", learning_rate=1.0,
+                       schedule="cosine_restarts", restart_period=10,
+                       restart_mult=2.0)
+    sched2 = make_schedule(cfg2, total_steps=70)
+    lrs2 = np.array([float(sched2(t)) for t in range(70)])
+    assert lrs2[10] > 0.95 and lrs2[30] > 0.95  # cycles at 10, 10+20
+
+
+def test_cosine_restarts_validation():
+    from pytorch_distributed_train_tpu.optim import make_schedule
+
+    with pytest.raises(ValueError, match="restart_mult"):
+        make_schedule(OptimConfig(schedule="cosine_restarts",
+                                  restart_mult=0.5), total_steps=100)
+    with pytest.raises(ValueError, match="restart_period"):
+        make_schedule(OptimConfig(schedule="cosine_restarts",
+                                  restart_period=-5), total_steps=100)
+
+
+def test_grain_loader_rejects_weighted_sampling():
+    from pytorch_distributed_train_tpu.config import DataConfig
+    from pytorch_distributed_train_tpu.data.datasets import ArrayDataset
+    from pytorch_distributed_train_tpu.data.pipeline import (
+        build_input_pipeline,
+    )
+
+    ds = ArrayDataset({"image": np.zeros((16, 2, 2, 3), np.float32),
+                       "label": np.zeros(16, np.int32)})
+    cfg = DataConfig(batch_size=8, loader="grain",
+                     weighted_sampling="inverse_class")
+    with pytest.raises(ValueError, match="threads"):
+        build_input_pipeline(ds, cfg, None, train=True)

@@ -67,3 +67,54 @@ def test_drop_last_truncates():
 def test_no_shuffle_is_strided():
     s = DistributedSampler(12, 3, 1, shuffle=False)
     assert np.array_equal(s.indices(), np.array([1, 4, 7, 10]))
+
+
+def test_weighted_sampler_oversamples_rare_class():
+    from pytorch_distributed_train_tpu.data.sampler import (
+        WeightedDistributedSampler, inverse_class_weights,
+    )
+
+    labels = np.array([0] * 900 + [1] * 100)
+    w = inverse_class_weights(labels)
+    assert w[0] * 9 == pytest.approx(w[-1])
+
+    shards = []
+    for rank in range(4):
+        s = WeightedDistributedSampler(w, 4, rank, seed=3)
+        s.set_epoch(1)
+        shards.append(s.indices())
+    idx = np.concatenate(shards)
+    assert len(idx) == len(labels)  # padded total, stride-sharded
+    frac_rare = (labels[idx] == 1).mean()
+    assert 0.4 < frac_rare < 0.6  # balanced in expectation
+    # deterministic per (seed, epoch); reshuffles across epochs
+    s = WeightedDistributedSampler(w, 4, 0, seed=3)
+    s.set_epoch(1)
+    np.testing.assert_array_equal(s.indices(), shards[0])
+    s.set_epoch(2)
+    assert not np.array_equal(s.indices(), shards[0])
+
+    with pytest.raises(ValueError, match="weights"):
+        WeightedDistributedSampler(np.array([-1.0, 1.0]), 1, 0)
+
+
+def test_weighted_sampling_wired_into_loader():
+    from pytorch_distributed_train_tpu.config import DataConfig
+    from pytorch_distributed_train_tpu.data.datasets import ArrayDataset
+    from pytorch_distributed_train_tpu.data.pipeline import HostDataLoader
+
+    labels = np.array([0] * 90 + [1] * 10, np.int32)
+    ds = ArrayDataset({"image": np.zeros((100, 2, 2, 3), np.float32),
+                       "label": labels})
+    cfg = DataConfig(batch_size=20, weighted_sampling="inverse_class")
+    loader = HostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)
+    batch = next(iter(loader.epoch(0)))
+    assert (batch["label"] == 1).mean() > 0.2  # rare class oversampled
+
+    eval_loader = HostDataLoader(ds, cfg, train=False, num_hosts=1, host_id=0)
+    from pytorch_distributed_train_tpu.data.sampler import DistributedSampler
+    assert type(eval_loader.sampler) is DistributedSampler  # eval unweighted
+
+    with pytest.raises(ValueError, match="label"):
+        HostDataLoader(ArrayDataset({"x": np.zeros(10)}), cfg, train=True,
+                       num_hosts=1, host_id=0)
