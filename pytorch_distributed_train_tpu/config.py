@@ -127,6 +127,9 @@ class DataConfig:
     # (absent → built-in byte-level tokenizer, vocab 259).
     text_files: str = ""
     tokenizer_path: str = ""
+    # text_files matching one .bin selects the memory-mapped pre-tokenized
+    # stream (nanoGPT-style flat token file); this is its element dtype.
+    token_bin_dtype: str = "uint16"
     # Synthetic dataset length (steps worth of fake data per epoch)
     synthetic_size: int = 51200
 

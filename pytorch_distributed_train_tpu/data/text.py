@@ -161,20 +161,115 @@ def _packed_blocks(files: list[str], tokenizer_path: str, seq_len: int):
     return _PACK_CACHE[key]
 
 
+class TokenBinDataset:
+    """Pre-tokenized flat binary token file, memory-mapped (the
+    nanoGPT-style ``.bin`` format: one contiguous uint16/uint32 token
+    stream). The scalable path for corpora too large to tokenize+pack in
+    RAM at startup: the OS pages in only the blocks a batch touches.
+
+    Blocks are the non-overlapping seq_len windows of the stream; batch
+    reads copy out of the mmap (int32, C-contiguous) so downstream code
+    never holds mmap views.
+    """
+
+    is_item_style = False
+
+    def __init__(self, path: str, seq_len: int, dtype: str = "uint16",
+                 train: bool = True, eval_holdout: int = 50,
+                 vocab_size: int = 0):
+        self.path = path
+        self.dtype = dtype
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size  # 0 → unchecked
+        self._mm = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+        if len(self._mm) < seq_len:
+            raise ValueError(
+                f"token bin {path} has {len(self._mm)} tokens < seq_len "
+                f"{seq_len}")
+        n_blocks = len(self._mm) // seq_len
+        self._blocks = _split(np.arange(n_blocks), train, eval_holdout)
+
+    def __getstate__(self):
+        # grain workers pickle the dataset; a pickled memmap materializes
+        # the WHOLE file (the multi-GB case this class exists for). Reopen
+        # in the worker instead.
+        state = self.__dict__.copy()
+        state["_mm"] = None
+        return state
+
+    def _mmap(self):
+        if self._mm is None:
+            self._mm = np.memmap(self.path, dtype=np.dtype(self.dtype),
+                                 mode="r")
+        return self._mm
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def get_batch(self, idx: np.ndarray, rng, train: bool) -> dict:
+        mm = self._mmap()
+        S = self.seq_len
+        out = np.empty((len(idx), S), np.int32)
+        for row, logical in enumerate(np.asarray(idx)):
+            start = int(self._blocks[int(logical)]) * S
+            out[row] = mm[start: start + S]
+        if self.vocab_size and out.max() >= self.vocab_size:
+            # checked per batch — scanning the whole mmap up-front would
+            # page in the entire file; out-of-range ids would otherwise
+            # reach the embedding gather and train on garbage silently
+            raise ValueError(
+                f"token id {int(out.max())} >= model vocab {self.vocab_size} "
+                f"in {self.path}")
+        return {"input_ids": out}
+
+
+def write_token_bin(ids: np.ndarray, path: str, dtype: str = "uint16"):
+    """Produce a TokenBinDataset file from a token id array (the offline
+    tokenize step; also what tests use)."""
+    info = np.iinfo(np.dtype(dtype))
+    if ids.min() < info.min or ids.max() > info.max:
+        raise ValueError(f"token ids out of range for {dtype}")
+    np.asarray(ids, np.dtype(dtype)).ravel().tofile(path)
+
+
 def build_text_dataset(data_cfg, model_cfg, train: bool, mlm: bool,
                        eval_holdout: int = 50):
-    """Factory for datasets 'text_lm' (causal) and 'text_mlm' (BERT MLM)."""
+    """Factory for datasets 'text_lm' (causal) and 'text_mlm' (BERT MLM).
+
+    ``data.text_files`` matching a single ``.bin`` file selects the
+    memory-mapped pre-tokenized path (causal only); anything else goes
+    through tokenize-and-pack.
+    """
     from pytorch_distributed_train_tpu.data.datasets import (
         ArrayDataset, MLMDataset,
     )
+
+    files = _resolve_files(data_cfg.text_files)
+    n_bin = sum(f.endswith(".bin") for f in files)
+    if n_bin:
+        if n_bin != len(files):
+            raise ValueError(
+                f"text_files mixes .bin and text files ({files}); the "
+                "tokenize-and-pack path would read binary tokens as UTF-8 "
+                "garbage — match exactly one .bin or only text files")
+        if mlm:
+            raise ValueError(
+                "token-bin datasets are causal-LM only (MLM needs the "
+                "tokenizer's mask id — use text files + tokenizer_path)")
+        if len(files) != 1:
+            raise ValueError(
+                f"expected one .bin token file, matched {len(files)}")
+        return TokenBinDataset(files[0], data_cfg.seq_len,
+                               dtype=data_cfg.token_bin_dtype,
+                               train=train, eval_holdout=eval_holdout,
+                               vocab_size=model_cfg.vocab_size)
 
     tok = load_tokenizer(data_cfg.tokenizer_path)
     if tok.vocab_size > model_cfg.vocab_size:
         raise ValueError(
             f"tokenizer vocab {tok.vocab_size} exceeds model.vocab_size "
             f"{model_cfg.vocab_size}")
-    blocks = _packed_blocks(_resolve_files(data_cfg.text_files),
-                            data_cfg.tokenizer_path, data_cfg.seq_len)
+    blocks = _packed_blocks(files, data_cfg.tokenizer_path, data_cfg.seq_len)
     blocks = _split(blocks, train, eval_holdout)
     if not mlm:
         return ArrayDataset({"input_ids": blocks})

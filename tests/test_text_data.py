@@ -165,3 +165,78 @@ def test_mlm_random_replacement_stays_in_tokenizer_vocab(corpus):
     # every input id must be producible by the byte tokenizer (vocab 259),
     # including the 10% random replacements
     assert batch["input_ids"].max() < ByteTokenizer.vocab_size
+
+
+def test_token_bin_dataset(tmp_path):
+    from pytorch_distributed_train_tpu.data.text import (
+        TokenBinDataset, write_token_bin,
+    )
+
+    ids = np.arange(64 * 101, dtype=np.int64) % 1000
+    path = str(tmp_path / "tokens.bin")
+    write_token_bin(ids, path, dtype="uint16")
+
+    ds = TokenBinDataset(path, seq_len=64, train=True)
+    ds_ev = TokenBinDataset(path, seq_len=64, train=False)
+    assert len(ds) + len(ds_ev) == 101
+    assert len(ds_ev) == 2  # blocks 49 and 99 held out
+
+    batch = ds.get_batch(np.array([0, 1]), None, train=True)
+    assert batch["input_ids"].shape == (2, 64)
+    assert batch["input_ids"].dtype == np.int32
+    np.testing.assert_array_equal(batch["input_ids"][0], ids[:64] % 1000)
+    # eval blocks are the held-out windows, disjoint from train's
+    ev = ds_ev.get_batch(np.array([0]), None, train=False)
+    np.testing.assert_array_equal(ev["input_ids"][0], ids[49 * 64: 50 * 64])
+
+    with pytest.raises(ValueError, match="out of range"):
+        write_token_bin(np.array([70000]), str(tmp_path / "x.bin"), "uint16")
+
+
+def test_token_bin_via_build_dataset_and_loader(tmp_path):
+    from pytorch_distributed_train_tpu.data.pipeline import HostDataLoader
+    from pytorch_distributed_train_tpu.data.text import write_token_bin
+
+    rng = np.random.default_rng(0)
+    write_token_bin(rng.integers(0, 500, 64 * 40), str(tmp_path / "t.bin"))
+    cfg = DataConfig(dataset="text_lm", seq_len=64, batch_size=8,
+                     text_files=str(tmp_path / "*.bin"))
+    ds = build_dataset(cfg, ModelConfig(vocab_size=512), train=True)
+    loader = HostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)
+    batch = next(iter(loader.epoch(0)))
+    assert batch["input_ids"].shape == (8, 64)
+
+    with pytest.raises(ValueError, match="causal"):
+        cfg_mlm = DataConfig(dataset="text_mlm", seq_len=64,
+                             text_files=str(tmp_path / "*.bin"))
+        build_dataset(cfg_mlm, ModelConfig(vocab_size=512), train=True)
+
+
+def test_token_bin_review_fixes(tmp_path):
+    """Vocab guard, pickling without materializing, mixed-glob rejection."""
+    import pickle
+
+    from pytorch_distributed_train_tpu.data.text import (
+        TokenBinDataset, write_token_bin,
+    )
+
+    path = str(tmp_path / "t.bin")
+    write_token_bin(np.full(64 * 10, 400, np.int64), path)
+
+    ds = TokenBinDataset(path, 64, vocab_size=512)
+    ds.get_batch(np.array([0]), None, True)  # in range: fine
+    with pytest.raises(ValueError, match="vocab"):
+        TokenBinDataset(path, 64, vocab_size=256).get_batch(
+            np.array([0]), None, True)
+
+    clone = pickle.loads(pickle.dumps(ds))
+    assert len(pickle.dumps(ds)) < 10_000  # memmap NOT materialized
+    np.testing.assert_array_equal(
+        clone.get_batch(np.array([0]), None, True)["input_ids"],
+        ds.get_batch(np.array([0]), None, True)["input_ids"])
+
+    (tmp_path / "notes.txt").write_text("hello")
+    cfg = DataConfig(dataset="text_lm", seq_len=64,
+                     text_files=str(tmp_path / "*"))
+    with pytest.raises(ValueError, match="mixes"):
+        build_dataset(cfg, ModelConfig(vocab_size=512), train=True)
