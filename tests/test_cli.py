@@ -46,3 +46,24 @@ def test_eval_only_refuses_random_init(tmp_path, capfd):
                      "--resume", "auto", *_overrides(tmp_path)])
     assert rc == 2
     assert "refusing to validate" in capfd.readouterr().err
+
+
+def test_show_sharding_tool():
+    """tools/show_sharding.py prints the resolved partition table."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "show_sharding.py"),
+         "--config", "gpt2_small", "--devices", "8",
+         "--set", "mesh.data=2", "--set", "mesh.fsdp=4", "--top", "3"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "wte/embedding" in out.stdout
+    assert "'fsdp'" in out.stdout
+    assert "MB/device" in out.stdout
