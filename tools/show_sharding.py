@@ -61,12 +61,14 @@ def main() -> None:
     model = build_model(cfg.model, cfg.precision, mesh=mesh, mesh_cfg=cfg.mesh)
     rules = rules_for_model(cfg.model.name)
 
+    from pytorch_distributed_train_tpu.models.registry import is_language_model
+
     def init(rng):
-        if cfg.model.name in ("resnet18", "resnet50", "vit_b16"):
-            dummy = jnp.zeros((2, cfg.model.image_size, cfg.model.image_size, 3))
-        else:
+        if is_language_model(cfg.model.name):
             dummy = jnp.zeros((2, min(cfg.data.seq_len, cfg.model.max_seq_len)),
                               jnp.int32)
+        else:
+            dummy = jnp.zeros((2, cfg.model.image_size, cfg.model.image_size, 3))
         return model.init({"params": rng}, dummy, train=False)
 
     shapes = jax.eval_shape(init, jax.random.PRNGKey(0))["params"]
@@ -95,7 +97,7 @@ def main() -> None:
         mb = np.prod(shard) * itemsize / 2**20
         rows.append((mb, name, leaf.shape, spec, tuple(shard), itemsize))
 
-    rows.sort(reverse=True)
+    rows.sort(key=lambda r: r[0], reverse=True)  # stable: ties keep layer order
     shown = rows[: args.top] if args.top else rows
     for mb, name, shape, spec, shard, _ in shown:
         print(f"{name:58s} {str(tuple(shape)):>20s} {str(tuple(spec)):>24s} "
