@@ -31,6 +31,7 @@ reports readiness ~40x before execution finishes).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -157,6 +158,16 @@ def main() -> None:
                    help="llama only: fused chunked LM-head loss "
                         "(model.fused_lm_loss) — (B,S,V) logits never "
                         "materialize.")
+    p.add_argument("--optimizer", default="",
+                   help="override the model's default optimizer (llama: "
+                        "adamw; bert: lamb; vision: momentum) — e.g. "
+                        "adafactor to probe optimizer-state HBM headroom")
+    p.add_argument("--moment-dtype", default="",
+                   help="optimizer moment storage dtype ('' = fp32; "
+                        "bfloat16 halves adam/adamw/lamb first-moment HBM)")
+    p.add_argument("--offload-opt", action="store_true",
+                   help="keep optimizer state in pinned HOST memory between "
+                        "steps (ZeRO-Offload analogue; TPU backends only)")
     p.add_argument("--attention-impl", default="auto",
                    choices=["auto", "xla", "pallas", "chunked"],
                    help="LM attention backend. 'auto' picks the Pallas flash "
@@ -232,6 +243,12 @@ def main() -> None:
     else:
         raise SystemExit(f"unknown bench model {args.model!r}")
 
+    if args.optimizer:
+        opt = OptimConfig(name=args.optimizer, learning_rate=opt.learning_rate,
+                          schedule="constant", warmup_steps=0)
+    if args.moment_dtype:
+        opt = dataclasses.replace(opt, moment_dtype=args.moment_dtype)
+
     _touch()  # backend import + arg setup done
     model = build_model(model_cfg, PrecisionConfig(compute_dtype="bfloat16"))
     tx, _ = make_optimizer(opt, total_steps=1000)
@@ -250,12 +267,21 @@ def main() -> None:
     rng = jax.random.PRNGKey(0)
     shape = jax.eval_shape(init_state, rng)
     sharding = steps_lib.state_shardings(mesh, rules, shape)
+    opt_dev_sharding = sharding.opt_state
+    if args.offload_opt:
+        if jax.devices()[0].platform == "cpu":
+            raise SystemExit(
+                "--offload-opt needs a TPU backend — the CPU backend "
+                "cannot execute host-memory placement "
+                "(annotate_device_placement)")
+        sharding = steps_lib.offload_state_shardings(sharding)
     state = jax.jit(init_state, out_shardings=sharding)(rng)
     _touch()  # state materialized on device
-    step = steps_lib.jit_train_step(
-        steps_lib.make_train_step(model, get_loss_fn(loss_name), tx),
-        mesh, sharding,
-    )
+    train_step = steps_lib.make_train_step(model, get_loss_fn(loss_name), tx)
+    if args.offload_opt:
+        train_step = steps_lib.offload_opt_state(
+            train_step, opt_dev_sharding, sharding.opt_state)
+    step = steps_lib.jit_train_step(train_step, mesh, sharding)
 
     global_batch = bpc * n_chips
     rng_np = np.random.default_rng(0)
@@ -297,20 +323,25 @@ def main() -> None:
     metric = f"{args.model}_{unit_noun}_per_sec_per_chip"
     # Only canonical shapes may seed a baseline key — smoke runs with
     # non-default shapes must not (BASELINE.md policy).
+    default_opt = (not args.optimizer and not args.moment_dtype
+                   and not args.offload_opt)
     if vision:
-        canonical = (args.model == "resnet50"
+        # resnet50 is the north-star; vit_b16 also tracks its own key so
+        # regressions there are visible across rounds (resnet18 stays a
+        # smoke config).
+        canonical = (args.model in ("resnet50", "vit_b16")
                      and args.batch_per_chip in (0, 128)
-                     and args.image_size == 224)
+                     and args.image_size == 224 and default_opt)
     elif args.model == "llama":
         # fused-head runs are a different program (no logits materialized) —
         # they must not share a baseline key with the dense-head config.
         canonical = (args.batch_per_chip in (0, 8) and args.seq_len == 2048
                      and args.attention_impl == "auto"
                      and not args.fused_head
-                     and args.remat_policy == "full")
+                     and args.remat_policy == "full" and default_opt)
     else:  # bert_base
         canonical = (args.batch_per_chip in (0, 32) and args.seq_len >= 512
-                     and args.attention_impl == "auto")
+                     and args.attention_impl == "auto" and default_opt)
     baseline_path = os.path.join(os.path.dirname(__file__),
                                  "BENCH_BASELINE.json")
     base = {}

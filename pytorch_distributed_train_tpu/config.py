@@ -138,11 +138,13 @@ class DataConfig:
 class OptimConfig:
     """Optimizer + LR schedule (reference: torch.optim.SGD / LAMB — SURVEY C20)."""
 
-    name: str = "sgd"  # sgd | momentum | adamw | lamb | adam | lars
+    name: str = "sgd"  # sgd | momentum | adamw | lamb | adam | lars | adafactor
     learning_rate: float = 0.1
     warmup_steps: int = 0
-    # constant | cosine | step | linear | onecycle | cosine_restarts
+    # constant | cosine | step | linear | polynomial | onecycle |
+    # cosine_restarts
     schedule: str = "cosine"
+    poly_power: float = 1.0  # polynomial schedule exponent (1.0 = linear)
     # onecycle: fraction of the horizon spent ramping up (torch OneCycleLR
     # pct_start); cosine_restarts: first cycle length in optimizer updates
     # (0 → horizon/4) and per-restart length multiplier (torch T_0/T_mult).
@@ -164,6 +166,24 @@ class OptimConfig:
     beta2: float = 0.999
     eps: float = 1e-8
     grad_clip_norm: float = 0.0  # 0 → off
+    # Keep optimizer state (adam/lamb moments, momentum) in pinned HOST
+    # memory between steps — the ZeRO-Offload analogue, via JAX memory
+    # kinds. Frees ~2 params-worth of HBM for adam-family optimizers at the
+    # cost of host<->HBM transfers XLA overlaps with compute. TPU-only
+    # (the CPU test backend cannot execute the placement custom-call).
+    offload_state: bool = False
+    # Storage dtype for optimizer moment/momentum accumulators ("" → fp32).
+    # "bfloat16" halves first-moment HBM for adam/adamw/lamb (and the SGD
+    # momentum buffer) — the update math stays fp32, only storage narrows.
+    # Second moments (nu) always stay fp32: bf16's 8-bit mantissa loses the
+    # small squared-gradient increments that drive the Adam denominator.
+    moment_dtype: str = ""
+    # adafactor: factor second moments above this dim (optax default 128);
+    # momentum is a SEPARATE knob (0 → stateless, the paper default) so the
+    # SGD-oriented `momentum=0.9` default can't silently re-add the
+    # first-moment buffer adafactor exists to avoid.
+    adafactor_min_dim_factored: int = 128
+    adafactor_momentum: float = 0.0
     accum_steps: int = 1  # optax.MultiSteps microbatching (≡ DDP no_sync)
     # Polyak/EMA weight averaging (torch-recipe "model EMA"): decay per
     # step, 0 → off. Eval runs on the EMA mirror when enabled.
@@ -232,6 +252,12 @@ class CheckpointConfig:
     max_to_keep: int = 3
     resume: str = "auto"  # auto | none | <explicit path>
     async_save: bool = True
+    # Track the best eval checkpoint (the torch-recipe `model_best.pth`
+    # pattern): "" → off; else an eval-metric name ("accuracy", "loss", …).
+    # When the metric improves, the state is saved under <dir>/best
+    # (max_to_keep=1); resume still uses the latest cadence checkpoint.
+    best_metric: str = ""
+    best_mode: str = "max"  # max | min
 
 
 @dataclass

@@ -17,7 +17,10 @@ import numpy as np
 
 from pytorch_distributed_train_tpu import losses as losses_lib
 from pytorch_distributed_train_tpu import steps as steps_lib
-from pytorch_distributed_train_tpu.checkpoint import CheckpointManager
+from pytorch_distributed_train_tpu.checkpoint import (
+    BestCheckpointTracker,
+    CheckpointManager,
+)
 from pytorch_distributed_train_tpu.config import TrainConfig
 from pytorch_distributed_train_tpu.data.datasets import build_dataset
 from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
@@ -97,6 +100,15 @@ class Trainer:
         self.state_sharding = steps_lib.state_shardings(
             self.mesh, self.rules, state_shape
         )
+        opt_dev_sharding = self.state_sharding.opt_state
+        if cfg.optim.offload_state:
+            if jax.devices()[0].platform == "cpu":
+                raise ValueError(
+                    "optim.offload_state needs a TPU backend — the CPU "
+                    "backend cannot execute host-memory placement "
+                    "(annotate_device_placement)")
+            self.state_sharding = steps_lib.offload_state_shardings(
+                self.state_sharding)
         with self.mesh:
             self.state: TrainState = jax.jit(
                 self._init_state, out_shardings=self.state_sharding
@@ -107,11 +119,14 @@ class Trainer:
 
         mixup = build_mixup(cfg.data, cfg.model, cfg.label_smoothing,
                             loss=cfg.loss)
+        train_step = steps_lib.make_train_step(
+            self.model, self.loss_fn, self.tx,
+            ema_decay=cfg.optim.ema_decay, mixup=mixup)
+        if cfg.optim.offload_state:
+            train_step = steps_lib.offload_opt_state(
+                train_step, opt_dev_sharding, self.state_sharding.opt_state)
         self.train_step = steps_lib.jit_train_step(
-            steps_lib.make_train_step(self.model, self.loss_fn, self.tx,
-                                      ema_decay=cfg.optim.ema_decay,
-                                      mixup=mixup),
-            self.mesh, self.state_sharding, self.batch_axes,
+            train_step, self.mesh, self.state_sharding, self.batch_axes,
         )
         self.eval_step = steps_lib.jit_eval_step(
             steps_lib.make_eval_step(self.model, self.loss_fn),
@@ -120,6 +135,8 @@ class Trainer:
 
         # ---- checkpoint + resume (auto is the default path, SURVEY §5.3b)
         self.ckpt = CheckpointManager(cfg.checkpoint, cfg.to_json())
+        self.best_ckpt = (BestCheckpointTracker(cfg.checkpoint, cfg.to_json())
+                          if cfg.checkpoint.best_metric else None)
         self.start_epoch = 0
         self.resumed = False  # did construction restore a checkpoint?
         resume_mode = cfg.checkpoint.resume
@@ -242,6 +259,8 @@ class Trainer:
             self.heartbeat.stop()
             self.ckpt.save(self.state, epoch=epoch, force=True, step=step)
             self.ckpt.wait()
+            if self.best_ckpt is not None:
+                self.best_ckpt.close()
             self.logger.log(
                 step,
                 {"wall_time_s": time.time() - t_start, **self.meter.percentiles()},
@@ -277,6 +296,12 @@ class Trainer:
             return {}
         avg = {k: v / n for k, v in sums.items()}
         self.logger.log(step, avg, prefix="eval")
+        if self.best_ckpt is not None:
+            if self.best_ckpt.update(
+                    avg, self.state, step=step,
+                    epoch=step // max(self.steps_per_epoch, 1)):
+                self.recorder.record("ckpt_best", step,
+                                     value=self.best_ckpt.best_value)
         self.meter.reset_clock()
         return avg
 
@@ -329,6 +354,8 @@ class Trainer:
     def close(self) -> None:
         self.heartbeat.stop()
         self.ckpt.close()
+        if self.best_ckpt is not None:
+            self.best_ckpt.close()
         self.logger.close()
 
 

@@ -200,3 +200,113 @@ def test_grain_loader_rejects_weighted_sampling():
                      weighted_sampling="inverse_class")
     with pytest.raises(ValueError, match="threads"):
         build_input_pipeline(ds, cfg, None, train=True)
+
+
+def _leaf_dtypes(tree):
+    return {jnp.asarray(x).dtype.name for x in jax.tree.leaves(tree)}
+
+
+def test_moment_dtype_narrows_first_moment_only():
+    """moment_dtype="bfloat16" stores adam mu in bf16 but keeps nu fp32,
+    and the resulting update stays close to the fp32-state update."""
+    params = _params()
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.01), params)
+
+    def one_update(moment_dtype):
+        tx, _ = make_optimizer(OptimConfig(
+            name="adamw", learning_rate=0.1, weight_decay=0.0,
+            schedule="constant", moment_dtype=moment_dtype), total_steps=10)
+        state = tx.init(params)
+        updates, state = tx.update(grads, state, params)
+        return updates, state
+
+    up32, st32 = one_update("")
+    up16, st16 = one_update("bfloat16")
+    flat16 = [x for x in jax.tree.leaves(st16)]
+    assert any(jnp.asarray(x).dtype == jnp.bfloat16 for x in flat16), \
+        "no bf16 accumulator found in adamw state"
+    assert any(jnp.asarray(x).dtype == jnp.float32 and x.ndim > 0
+               for x in flat16), "nu should remain fp32"
+    for a, b in zip(jax.tree.leaves(up32), jax.tree.leaves(up16)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.02, atol=1e-6)
+
+
+def test_moment_dtype_lamb_matches_fp32_closely():
+    params = _params()
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.02), params)
+
+    def one_update(moment_dtype):
+        tx, _ = make_optimizer(OptimConfig(
+            name="lamb", learning_rate=0.1, weight_decay=0.01,
+            decay_exclude=r"bias$,scale$", schedule="constant",
+            moment_dtype=moment_dtype), total_steps=10)
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        return updates
+
+    up32 = one_update("")
+    up16 = one_update("bfloat16")
+    for a, b in zip(jax.tree.leaves(up32), jax.tree.leaves(up16)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.02, atol=1e-6)
+
+
+def test_adafactor_state_is_factored_and_small():
+    """Adafactor: a (256,512) matrix keeps only row+col second-moment
+    vectors (no O(n*m) state, no first moment by default)."""
+    params = {"dense": {"kernel": jnp.ones((256, 512))},
+              "norm": {"scale": jnp.ones((512,))}}
+    tx, _ = make_optimizer(OptimConfig(
+        name="adafactor", learning_rate=0.01, schedule="constant"),
+        total_steps=10)
+    state = tx.init(params)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    state_floats = sum(
+        jnp.asarray(x).size for x in jax.tree.leaves(state)
+        if hasattr(x, "size") and jnp.asarray(x).ndim > 0)
+    assert state_floats < 0.05 * n_params, (
+        f"adafactor state {state_floats} floats vs {n_params} params — "
+        "expected factored (row+col) statistics only")
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.01), params)
+    updates, state = tx.update(grads, state, params)
+    new = jax.tree.map(lambda p, u: p + u, params, updates)
+    assert all(np.all(np.isfinite(np.asarray(x)))
+               for x in jax.tree.leaves(new))
+    assert any(np.any(np.asarray(a) != np.asarray(b)) for a, b in
+               zip(jax.tree.leaves(params), jax.tree.leaves(new)))
+
+
+def test_adafactor_momentum_off_by_default_on_by_knob():
+    params = {"w": jnp.ones((256, 512))}  # >= 128 per dim → factored
+
+    def state_size(**kw):
+        tx, _ = make_optimizer(OptimConfig(
+            name="adafactor", learning_rate=0.01, schedule="constant", **kw),
+            total_steps=10)
+        state = tx.init(params)
+        return sum(jnp.asarray(x).size for x in jax.tree.leaves(state)
+                   if hasattr(x, "size") and jnp.asarray(x).ndim > 0)
+
+    # momentum=0.9 (the SGD-oriented default) must NOT create a buffer;
+    # only the dedicated adafactor_momentum knob does.
+    assert state_size(momentum=0.9) < 256 * 512
+    assert state_size(adafactor_momentum=0.9) >= 256 * 512
+
+
+def test_polynomial_schedule_shape():
+    from pytorch_distributed_train_tpu.optim import make_schedule
+
+    cfg = OptimConfig(schedule="polynomial", learning_rate=1e-3,
+                      warmup_steps=10, poly_power=1.0, end_lr_factor=0.0)
+    sched = make_schedule(cfg, total_steps=110)
+    lrs = np.array([float(sched(t)) for t in range(110)])
+    np.testing.assert_allclose(lrs[10], 1e-3, rtol=1e-5)  # warmup peak
+    # power=1 → linear decay to 0 over the remaining 100 steps
+    np.testing.assert_allclose(lrs[60], 0.5e-3, rtol=1e-4)
+    assert lrs[-1] < 2e-5
+    # power=2 decays slower early: at the midpoint (1-0.5)^2 = 0.25
+    cfg2 = OptimConfig(schedule="polynomial", learning_rate=1e-3,
+                       warmup_steps=0, poly_power=2.0, end_lr_factor=0.0)
+    sched2 = make_schedule(cfg2, total_steps=100)
+    np.testing.assert_allclose(float(sched2(50)), 0.25e-3, rtol=1e-3)

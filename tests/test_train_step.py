@@ -171,3 +171,49 @@ def test_grad_accumulation_equals_big_batch(devices8):
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), p_accum, p_big
     )
+
+
+def test_offload_state_shardings_metadata(devices8):
+    """offload_state_shardings moves ONLY the opt_state subtree to
+    pinned_host, preserving every partition spec. (Execution is TPU-only —
+    the CPU backend has no annotate_device_placement — so CPU tests cover
+    the metadata transform and the trainer's backend gate.)"""
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    model_cfg = ModelConfig(name="resnet18", num_classes=10, image_size=8)
+    model = build_model(model_cfg, PrecisionConfig())
+    tx, _ = make_optimizer(
+        OptimConfig(name="adamw", learning_rate=0.1, schedule="constant"),
+        total_steps=10)
+    rules = rules_for_model("resnet18")
+
+    def init_state(rng):
+        x = jnp.zeros((2, 8, 8, 3))
+        variables = model.init({"params": rng}, x, train=False)
+        return TrainState.create(params=variables["params"], tx=tx,
+                                 batch_stats=variables.get("batch_stats", {}))
+
+    shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    off = steps_lib.offload_state_shardings(sharding)
+    for a, b in zip(jax.tree.leaves(sharding.opt_state),
+                    jax.tree.leaves(off.opt_state)):
+        assert b.memory_kind == "pinned_host"
+        assert a.spec == b.spec and a.mesh == b.mesh
+    # params/batch_stats untouched (same objects or same default memory)
+    for a, b in zip(jax.tree.leaves(sharding.params),
+                    jax.tree.leaves(off.params)):
+        assert b.memory_kind != "pinned_host"
+
+
+def test_trainer_rejects_offload_on_cpu(tmp_path):
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    cfg = get_preset("resnet18_cifar10")
+    cfg.data.synthetic_size = 64
+    cfg.data.batch_size = 16
+    cfg.optim.offload_state = True
+    cfg.checkpoint.dir = str(tmp_path / "ckpt")
+    cfg.checkpoint.resume = "none"
+    with pytest.raises(ValueError, match="offload_state"):
+        Trainer(cfg)
