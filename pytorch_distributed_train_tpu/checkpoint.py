@@ -40,19 +40,32 @@ class CheckpointManager:
 
     # ------------------------------------------------------------------ save
     def save(self, state: TrainState, *, epoch: int = 0, force: bool = False,
-             step: int | None = None) -> bool:
+             step: int | None = None, overwrite: bool = False,
+             extra_meta: dict | None = None) -> bool:
         # Callers that track the step host-side pass it in — int(state.step)
         # is a device sync that would serialize async dispatch (trainer hot
         # loop keeps its own counter for exactly this reason).
         if step is None:
             step = int(state.step)
         if step in self.mgr.all_steps():
-            return False  # cadence save already wrote this step
+            if not overwrite:
+                # Cadence already wrote this step — keep it. (force only
+                # bypasses Orbax's should_save, never an existing ckpt: the
+                # trainer's final force-save must not delete-and-rewrite a
+                # checkpoint an async cadence save may still be writing.)
+                return False
+            # overwrite (BestCheckpointTracker re-improving at a step this
+            # manager already holds): Orbax refuses to save over an
+            # existing step, so wait out any in-flight write and drop it.
+            self.mgr.wait_until_finished()
+            self.mgr.delete(step)
+        meta = {"epoch": epoch, "config": self.config_json,
+                **(extra_meta or {})}
         saved = self.mgr.save(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(_savable(state)),
-                meta=ocp.args.JsonSave({"epoch": epoch, "config": self.config_json}),
+                meta=ocp.args.JsonSave(meta),
             ),
             force=force,
         )
@@ -117,12 +130,91 @@ class CheckpointManager:
         except Exception:
             return True  # metadata unavailable → assume matching layout
 
+    def read_meta(self, step: int | None = None) -> dict:
+        """Read just the JSON meta of a saved step (no state restore) —
+        used to recover the best-metric watermark across restarts."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return {}
+        try:
+            restored = self.mgr.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+            return restored["meta"] or {}
+        except Exception:
+            return {}
+
     def wait(self) -> None:
         self.mgr.wait_until_finished()
 
     def close(self) -> None:
         self.mgr.wait_until_finished()
         self.mgr.close()
+
+
+class BestCheckpointTracker:
+    """`model_best.pth` semantics (reference-genre harnesses: save when the
+    validation metric improves). A second Orbax manager under
+    ``<dir>/best`` with max_to_keep=1; the watermark survives restarts via
+    the meta JSON. Resume-from-latest is untouched — this is an export/eval
+    artifact, not the recovery path."""
+
+    def __init__(self, ckpt_cfg, config_json: str = ""):
+        import dataclasses as _dc
+
+        self.metric = ckpt_cfg.best_metric
+        self.mode = ckpt_cfg.best_mode
+        if self.mode not in ("max", "min"):
+            raise ValueError(f"best_mode must be max|min, got {self.mode!r}")
+        best_cfg = _dc.replace(
+            ckpt_cfg, dir=os.path.join(ckpt_cfg.dir, "best"), max_to_keep=1)
+        self.mgr = CheckpointManager(best_cfg, config_json)
+        # The watermark carries over only on a resuming run AND only if it
+        # measures the same thing: resume="none" is a fresh run (a reused
+        # dir must not pin the old run's best), and a reconfigured
+        # metric/mode must not compare new losses against an old accuracy.
+        # Fresh watermark → the first eval overwrites the stale best.
+        meta = self.mgr.read_meta() if ckpt_cfg.resume != "none" else {}
+        if (meta.get("best_metric"), meta.get("best_mode")) == (
+                self.metric, self.mode):
+            self.best_value: float | None = meta.get("best_value")
+        else:
+            self.best_value = None
+
+    def _improved(self, value: float) -> bool:
+        if self.best_value is None:
+            return True
+        return (value > self.best_value if self.mode == "max"
+                else value < self.best_value)
+
+    _closed = False
+
+    def update(self, eval_metrics: dict, state: TrainState, *, epoch: int,
+               step: int) -> bool:
+        """Save iff ``eval_metrics[metric]`` improves. Missing metric is an
+        error — a silent typo in best_metric would track nothing."""
+        if self.metric not in eval_metrics:
+            raise KeyError(
+                f"checkpoint.best_metric={self.metric!r} not in eval "
+                f"metrics {sorted(eval_metrics)}")
+        value = float(eval_metrics[self.metric])
+        if not self._improved(value):
+            return False
+        self.best_value = value
+        # One save path (CheckpointManager.save); force=True because a
+        # repeat eval can improve at a step number this manager already
+        # holds.
+        self.mgr.save(
+            state, epoch=epoch, step=step, force=True, overwrite=True,
+            extra_meta={"best_value": value, "best_metric": self.metric,
+                        "best_mode": self.mode})
+        return True
+
+    def close(self) -> None:
+        # Idempotent: both fit()'s finally and Trainer.close() call this.
+        if not self._closed:
+            self._closed = True
+            self.mgr.close()
 
 
 def _savable(state: TrainState) -> dict[str, Any]:

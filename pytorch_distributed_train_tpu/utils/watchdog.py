@@ -108,12 +108,19 @@ class Heartbeat:
     def _run(self) -> None:
         while not self._stop.wait(min(self.timeout_s / 4, 10.0)):
             if time.monotonic() - self._last > self.timeout_s:
-                sys.stderr.write(
-                    f"[heartbeat] no step completed in {self.timeout_s}s — aborting\n"
-                )
-                if self.recorder is not None:
-                    self.recorder.dump()
-                faulthandler.dump_traceback()
+                # Diagnostics are best-effort: a broken stderr (no fileno
+                # under capture/redirection, closed pipe) must never keep
+                # the abort from firing — failing open here means a wedged
+                # job never gets restarted.
+                try:
+                    sys.stderr.write(
+                        f"[heartbeat] no step completed in "
+                        f"{self.timeout_s}s — aborting\n")
+                    if self.recorder is not None:
+                        self.recorder.dump()
+                    faulthandler.dump_traceback()
+                except Exception:
+                    pass
                 self._abort()
                 return
 

@@ -67,3 +67,50 @@ def test_heartbeat_aborts_stalled_process(tmp_path):
     assert "flight recorder" in combined.lower() or any(
         "flight" in f for f in os.listdir(tmp_path)
     )
+
+
+def test_heartbeat_quiet_while_beats_arrive_and_after_stop():
+    """The monitor must not fire while beats keep arriving, and stop()
+    de-arms it (the Trainer stops it before teardown so shutdown can't
+    race a late abort)."""
+    import time
+
+    from pytorch_distributed_train_tpu.utils.watchdog import Heartbeat
+
+    fired = []
+    hb = Heartbeat(timeout_s=0.4, abort=lambda: fired.append(1))
+    for _ in range(6):
+        time.sleep(0.15)
+        hb.beat()
+    assert not fired  # beats within timeout → no abort
+    hb.stop()
+    time.sleep(1.0)
+    assert not fired  # stopped → stall after stop is not an abort
+
+
+def test_heartbeat_custom_abort_dumps_recorder(capsys):
+    import time
+
+    from pytorch_distributed_train_tpu.utils.watchdog import (
+        FlightRecorder,
+        Heartbeat,
+    )
+
+    fr = FlightRecorder(capacity=4)
+    fr.record("step", 7, loss=1.25)
+    fired = []
+    hb = Heartbeat(timeout_s=0.3, recorder=fr, abort=lambda: fired.append(1))
+    time.sleep(1.2)
+    assert fired  # stalled → custom abort invoked (instead of os._exit)
+    hb.stop()
+
+
+def test_heartbeat_zero_timeout_disabled():
+    import time
+
+    from pytorch_distributed_train_tpu.utils.watchdog import Heartbeat
+
+    fired = []
+    hb = Heartbeat(timeout_s=0.0, abort=lambda: fired.append(1))
+    time.sleep(0.5)
+    assert hb._thread is None and not fired
