@@ -165,6 +165,9 @@ def main() -> None:
     p.add_argument("--moment-dtype", default="",
                    help="optimizer moment storage dtype ('' = fp32; "
                         "bfloat16 halves adam/adamw/lamb first-moment HBM)")
+    p.add_argument("--stem", default="conv", choices=["conv", "space_to_depth"],
+                   help="resnet ImageNet stem: space_to_depth is the exact "
+                        "MXU-friendly 4x4/s1 rewrite (models/resnet.py)")
     p.add_argument("--offload-opt", action="store_true",
                    help="keep optimizer state in pinned HOST memory between "
                         "steps (ZeRO-Offload analogue; TPU backends only)")
@@ -209,7 +212,7 @@ def main() -> None:
 
     if vision:
         model_cfg = ModelConfig(name=args.model, num_classes=1000,
-                                image_size=args.image_size)
+                                image_size=args.image_size, stem=args.stem)
         loss_name = "softmax_xent"
         opt = OptimConfig(name="momentum", learning_rate=0.1,
                           schedule="constant", warmup_steps=0)
@@ -331,7 +334,8 @@ def main() -> None:
         # smoke config).
         canonical = (args.model in ("resnet50", "vit_b16")
                      and args.batch_per_chip in (0, 128)
-                     and args.image_size == 224 and default_opt)
+                     and args.image_size == 224 and default_opt
+                     and args.stem == "conv")
     elif args.model == "llama":
         # fused-head runs are a different program (no logits materialized) —
         # they must not share a baseline key with the dense-head config.

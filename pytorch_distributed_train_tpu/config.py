@@ -39,6 +39,11 @@ class ModelConfig:
     name: str = "resnet18"
     num_classes: int = 10
     image_size: int = 32
+    # ResNet ImageNet stem: "conv" (7x7/s2, torch-identical) or
+    # "space_to_depth" (mathematically-exact 4x4/s1 rewrite over a 2x2
+    # space-to-depth input — MXU-friendly C_in 3→12; the parameter keeps
+    # the canonical (7,7,3,F) layout so checkpoints/interop are unchanged)
+    stem: str = "conv"
     # ViT
     patch_size: int = 16
     # Transformer family (ViT / BERT / Llama)
@@ -413,6 +418,8 @@ def _resnet18_cifar10() -> TrainConfig:
     )
     c.epochs = 30
     c.loss = "softmax_xent"
+    # reference-genre recipe: keep the best-val-accuracy checkpoint
+    c.checkpoint.best_metric = "accuracy"
     return c
 
 
@@ -429,6 +436,8 @@ def _resnet50_imagenet() -> TrainConfig:
     c.mesh = MeshConfig(data=-1)
     c.epochs = 90
     c.loss = "softmax_xent"
+    # reference-genre recipe: keep the best-val-accuracy checkpoint
+    c.checkpoint.best_metric = "accuracy"
     return c
 
 
@@ -451,6 +460,8 @@ def _vit_b16_imagenet() -> TrainConfig:
     c.precision = PrecisionConfig(compute_dtype="bfloat16")
     c.epochs = 300
     c.loss = "softmax_xent"
+    # reference-genre recipe: keep the best-val-accuracy checkpoint
+    c.checkpoint.best_metric = "accuracy"
     return c
 
 

@@ -7,10 +7,12 @@ Selected via ``DataConfig.loader = "grain"``. Duck-types HostDataLoader
 (``steps_per_epoch``, ``epoch(epoch, start_batch)``) so the rest of the
 input pipeline — producer thread, HBM prefetch, sync checks — is shared.
 
-Reuses the datasets unchanged: a RandomMapTransform pulls one record
-through the dataset's own ``get_item``/``get_batch`` (batch of 1), so
-augmentation (incl. the native imgops path) runs inside Grain's worker
-processes, off the GIL and off the step path.
+Reuses the datasets unchanged: a MapTransform pulls one record through the
+dataset's own ``get_item``/``get_batch`` (batch of 1), so augmentation
+(incl. the native imgops path) runs inside Grain's worker processes, off
+the GIL and off the step path. Augment randomness does NOT use Grain's
+sampler-position rng: each record's rng is keyed on (seed, epoch, record
+index), which makes mid-epoch resume draws bit-exact (see _LoadRecord).
 
 Sharding/shuffle semantics mirror DistributedSampler (C16): per-epoch
 reseeded shuffle, host-sharded with drop_remainder — though the shuffle
@@ -39,13 +41,20 @@ class _IndexSource:
         return int(i)
 
 
-def _make_load_transform(dataset, train: bool):
+def _make_load_transform(dataset, train: bool, seed: int, epoch: int):
     import grain.python as gp
 
     item_style = getattr(dataset, "is_item_style", False)
 
-    class _LoadRecord(gp.RandomMapTransform):
-        def random_map(self, i, rng: np.random.Generator):
+    class _LoadRecord(gp.MapTransform):
+        """Augment rng keyed on (seed, epoch, RECORD index) — not Grain's
+        sampler-position rng — so a mid-epoch resume (which re-enumerates
+        the tail at shifted positions) reproduces the exact per-record
+        draws of the uninterrupted epoch."""
+
+        def map(self, i):
+            rng = np.random.default_rng(
+                np.random.SeedSequence((seed, epoch, int(i))))
             if item_style:
                 return dataset.get_item(int(i), rng)
             batch1 = dataset.get_batch(np.asarray([int(i)]), rng, train)
@@ -107,10 +116,9 @@ class GrainHostDataLoader:
             # Mid-epoch resume: enumerate the epoch's record order from the
             # sampler (pure index math), slice, and run a sequential pass —
             # O(skip) index reads instead of materializing skipped batches
-            # through the workers. Data ORDER matches the uninterrupted
-            # epoch; per-record augment rng draws may differ (they key on
-            # sampler position) — use loader="threads" where bit-exact
-            # resume augmentation matters.
+            # through the workers. Data order AND augment draws match the
+            # uninterrupted epoch (the load transform keys its rng on the
+            # record index travelling through the sliced source).
             sampler = self._sampler(epoch)
             n = min(self.steps_per_epoch * self.host_batch,
                     len(self.dataset) // self.num_hosts)
@@ -129,7 +137,8 @@ class GrainHostDataLoader:
             data_source=source,
             sampler=order_sampler,
             operations=[
-                _make_load_transform(self.dataset, self.train),
+                _make_load_transform(self.dataset, self.train,
+                                     self.seed, epoch),
                 gp.Batch(batch_size=self.host_batch, drop_remainder=False),
             ],
             worker_count=self.num_workers,

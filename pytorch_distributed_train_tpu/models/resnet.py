@@ -32,8 +32,50 @@ from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 ModuleDef = Any
+
+
+class SpaceToDepthStem(nn.Module):
+    """MXU-friendly ImageNet stem: the 7x7/s2 conv over 3-channel input
+    wastes the 128-wide systolic array (C_in=3); rewriting it as a 4x4/s1
+    conv over a 2x2 space-to-depth input (C_in=12) is mathematically
+    EXACT — the 7x7 kernel zero-pads to 8 taps and regroups into the s2d
+    channel layout. The PARAMETER stays the canonical (7,7,3,F) kernel
+    (same name/shape as the nn.Conv stem), so checkpoints and the torch
+    interop bridge are unaffected; only the compute path changes. The
+    MLPerf-era TPU ResNet recipe, in-graph instead of in-pipeline."""
+
+    filters: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        f = self.filters
+        kernel = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+            (7, 7, 3, f), self.param_dtype,
+        )
+        # w8[0]=0 zero tap; w4[ry,rx,(dy,dx,ch)] = w8[2ry+dy, 2rx+dx, ch]
+        w8 = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = (w8.reshape(4, 2, 4, 2, 3, f)
+              .transpose(0, 2, 1, 3, 4, 5)
+              .reshape(4, 4, 12, f))
+        # Left pad 4 (3 for the conv + 1 dead column under the zero tap),
+        # right pad 2; then 2x2 space-to-depth with matching (dy,dx,ch)
+        # channel packing.
+        xp = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
+        b, h, w, c = xp.shape
+        xs = (xp.reshape(b, h // 2, 2, w // 2, 2, c)
+              .transpose(0, 1, 3, 2, 4, 5)
+              .reshape(b, h // 2, w // 2, 4 * c))
+        return lax.conv_general_dilated(
+            xs, w4.astype(self.dtype), (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
 
 
 class ResNetBlock(nn.Module):
@@ -95,6 +137,7 @@ class ResNet(nn.Module):
     num_classes: int
     num_filters: int = 64
     cifar_stem: bool = False
+    stem: str = "conv"  # conv | space_to_depth (ImageNet stem only)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -118,11 +161,27 @@ class ResNet(nn.Module):
             param_dtype=jnp.float32,
         )
 
+        if self.stem not in ("conv", "space_to_depth"):
+            # A typo'd --set model.stem would otherwise silently train the
+            # plain conv stem while the user benchmarks "s2d".
+            raise ValueError(
+                f"unknown stem {self.stem!r}; have conv | space_to_depth")
         x = x.astype(self.dtype)
         if self.cifar_stem:
             x = conv(self.num_filters, (3, 3), name="conv_stem")(x)
             x = norm(name="bn_stem")(x)
             x = nn.relu(x)
+        elif self.stem == "space_to_depth":
+            if x.shape[1] % 2 or x.shape[2] % 2:
+                raise ValueError(
+                    f"space_to_depth stem needs even image dims, got "
+                    f"{x.shape[1]}x{x.shape[2]}")
+            x = SpaceToDepthStem(self.num_filters, dtype=self.dtype,
+                                 param_dtype=self.param_dtype,
+                                 name="conv_stem")(x)
+            x = norm(name="bn_stem")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         else:
             x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                      name="conv_stem")(x)
@@ -159,6 +218,7 @@ def resnet18(cfg, dtype, param_dtype, cp=None) -> ResNet:
         block_cls=ResNetBlock,
         num_classes=cfg.num_classes,
         cifar_stem=cfg.image_size <= 64,
+        stem=getattr(cfg, "stem", "conv"),
         dtype=dtype,
         param_dtype=param_dtype,
     )
@@ -171,6 +231,7 @@ def resnet50(cfg, dtype, param_dtype, cp=None) -> ResNet:
         block_cls=BottleneckBlock,
         num_classes=cfg.num_classes,
         cifar_stem=False,
+        stem=getattr(cfg, "stem", "conv"),
         dtype=dtype,
         param_dtype=param_dtype,
     )

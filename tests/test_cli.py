@@ -67,3 +67,15 @@ def test_show_sharding_tool():
     assert "wte/embedding" in out.stdout
     assert "'fsdp'" in out.stdout
     assert "MB/device" in out.stdout
+
+
+def test_bad_config_is_one_line_error_exit_2(capfd):
+    import train as train_mod
+
+    assert train_mod.main(["--config", "nope"]) == 2
+    err = capfd.readouterr().err
+    assert "unknown preset" in err and "Traceback" not in err
+
+    assert train_mod.main(["--set", "optim.nope=1"]) == 2
+    err = capfd.readouterr().err
+    assert "optim.nope" in err and "Traceback" not in err

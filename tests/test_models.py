@@ -131,3 +131,54 @@ def test_gpt2_tiny_shapes_and_causality():
                                atol=1e-6, rtol=1e-6)
     assert not np.allclose(np.asarray(logits[:, 7:]),
                            np.asarray(logits2[:, 7:]))
+
+
+def test_space_to_depth_stem_is_exact():
+    """The s2d stem must be a mathematically exact rewrite: identical
+    params (same (7,7,3,F) kernel path), identical logits for any input."""
+    import jax
+    import numpy as np
+    from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    cfg = ModelConfig(name="resnet50", num_classes=10, image_size=32)
+    base = build_model(cfg, PrecisionConfig())
+    import dataclasses
+    s2d = build_model(dataclasses.replace(cfg, stem="space_to_depth"),
+                      PrecisionConfig())
+    x = jax.numpy.asarray(
+        np.random.default_rng(0).standard_normal((2, 32, 32, 3)),
+        jax.numpy.float32)
+    v = base.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    # same param tree structure → the s2d model accepts the conv params
+    v2 = s2d.init({"params": jax.random.PRNGKey(1)}, x, train=False)
+    assert (jax.tree_util.tree_structure(v["params"])
+            == jax.tree_util.tree_structure(v2["params"]))
+    assert v["params"]["conv_stem"]["kernel"].shape == (7, 7, 3, 64)
+    out_base = base.apply(v, x, train=False)
+    out_s2d = s2d.apply(v, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_base), np.asarray(out_s2d),
+                               rtol=2e-4, atol=2e-4)
+
+    # odd image dims are rejected (the 2x2 regroup needs even H/W)
+    import pytest
+    xo = jax.numpy.zeros((1, 31, 31, 3))
+    with pytest.raises(ValueError, match="even image dims"):
+        s2d.init({"params": jax.random.PRNGKey(0)}, xo, train=False)
+
+
+def test_unknown_stem_rejected():
+    import dataclasses
+    import jax
+    import pytest
+    from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    bad = build_model(
+        dataclasses.replace(
+            ModelConfig(name="resnet50", num_classes=10, image_size=32),
+            stem="s2d"),
+        PrecisionConfig())
+    with pytest.raises(ValueError, match="unknown stem"):
+        bad.init({"params": jax.random.PRNGKey(0)},
+                 jax.numpy.zeros((1, 32, 32, 3)), train=False)

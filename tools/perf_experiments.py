@@ -12,6 +12,7 @@ Arms:
   bf16_bnstats — BN statistics reductions in bf16
                  (force_float32_reductions=False; MLPerf-era ResNets did
                  this — validate loss parity before adopting)
+  s2d_stem     — space-to-depth stem rewrite (exact; MXU-friendly C_in 12)
 
 Keep arms additive and honest: any adopted change must land in the model
 code with its measured delta recorded in BASELINE.md.
@@ -28,7 +29,8 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
 def run_arm(name: str, *, steps: int, warmup: int, bn_fp32_stats: bool,
-            input_dtype: str, image_size: int = 224, bs: int = 128) -> dict:
+            input_dtype: str, stem: str = "conv", image_size: int = 224,
+            bs: int = 128) -> dict:
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -47,7 +49,7 @@ def run_arm(name: str, *, steps: int, warmup: int, bn_fp32_stats: bool,
 
     mesh = build_mesh(MeshConfig(data=-1))
     model = build_model(ModelConfig(name="resnet50", num_classes=1000,
-                                    image_size=image_size),
+                                    image_size=image_size, stem=stem),
                         PrecisionConfig(compute_dtype="bfloat16"))
     tx, _ = make_optimizer(OptimConfig(name="momentum", learning_rate=0.1,
                                        schedule="constant", warmup_steps=0),
@@ -106,7 +108,7 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--arms", default="baseline,bf16_input,bf16_bnstats")
+    p.add_argument("--arms", default="baseline,bf16_input,bf16_bnstats,s2d_stem")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--batch", type=int, default=128)
     args = p.parse_args()
@@ -115,6 +117,10 @@ def main() -> None:
         "baseline": dict(bn_fp32_stats=True, input_dtype="float32"),
         "bf16_input": dict(bn_fp32_stats=True, input_dtype="bfloat16"),
         "bf16_bnstats": dict(bn_fp32_stats=False, input_dtype="float32"),
+        # exact 4x4/s1 rewrite of the 7x7/s2 stem over s2d input
+        # (models/resnet.py SpaceToDepthStem)
+        "s2d_stem": dict(bn_fp32_stats=True, input_dtype="float32",
+                         stem="space_to_depth"),
     }
     for arm in args.arms.split(","):
         out = run_arm(arm, steps=args.steps, warmup=args.warmup,

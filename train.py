@@ -78,7 +78,15 @@ def main(argv=None) -> int:
         print("\n".join(list_presets()))
         return 0
 
-    cfg = build_config(args)
+    try:
+        cfg = build_config(args)
+    except (KeyError, ValueError, FileNotFoundError) as e:
+        # Config mistakes (unknown preset, typo'd --set path, bad value)
+        # are user errors, not crashes: one clear line, exit 2 — the
+        # argparse convention — instead of a traceback.
+        msg = e.args[0] if e.args else e
+        print(f"train.py: error: {msg}", file=sys.stderr, flush=True)
+        return 2
     if args.print_config:
         print(cfg.to_json())
         return 0
