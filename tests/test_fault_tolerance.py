@@ -143,3 +143,76 @@ def test_multiprocess_jax_distributed_bringup(tmp_path):
     assert rc == 0
     assert (tmp_path / "dist-ok-0").exists()
     assert (tmp_path / "dist-ok-1").exists()
+
+
+PREEMPT_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorch_distributed_train_tpu.config import TrainConfig
+from pytorch_distributed_train_tpu.trainer import Trainer
+
+cfg = TrainConfig()
+cfg.model.name = "resnet18"; cfg.model.num_classes = 10
+cfg.model.image_size = 8
+cfg.data.dataset = "synthetic_images"; cfg.data.synthetic_size = 2048
+cfg.data.batch_size = 16; cfg.data.num_workers = 1
+cfg.optim.name = "momentum"; cfg.optim.learning_rate = 0.05
+cfg.optim.schedule = "constant"; cfg.optim.warmup_steps = 0
+cfg.total_steps = 100000  # far horizon: only SIGTERM ends this run
+cfg.checkpoint.dir = {ckpt!r}
+cfg.checkpoint.save_every_steps = 10**9  # no cadence saves
+cfg.checkpoint.async_save = False
+cfg.obs.log_every_steps = 1
+cfg.obs.jsonl_path = {metrics!r}
+t = Trainer(cfg)
+print("TRAINER_READY", flush=True)
+t.fit()
+"""
+
+
+@pytest.mark.slow
+def test_sigterm_preemption_saves_resumable_checkpoint(tmp_path):
+    """GKE-style preemption drill (SURVEY §5.3): SIGTERM mid-training must
+    (a) dump the flight recorder, (b) unwind through fit()'s finally and
+    write a final checkpoint at the current step — with cadence saves
+    disabled, any checkpoint present proves the preemption path wrote it —
+    and (c) exit 143 so the supervisor sees a signal death, not success."""
+    import signal
+    import time
+
+    ckpt = str(tmp_path / "ckpt")
+    metrics = str(tmp_path / "metrics.jsonl")
+    script = tmp_path / "worker.py"
+    script.write_text(PREEMPT_WORKER.format(
+        repo=REPO, ckpt=ckpt, metrics=metrics))
+    env = {**os.environ, **CPU_ENV, "RESTART_GENERATION": "0"}
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        # Wait for steps to flow (metrics lines appear), then preempt.
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if os.path.exists(metrics) and os.path.getsize(metrics) > 0:
+                break
+            time.sleep(0.5)
+        else:
+            proc.kill()
+            raise AssertionError("no training steps before deadline")
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 143, (proc.returncode, err[-800:])
+    assert "flight recorder" in err.lower()
+    # The checkpoint written on the way down restores.
+    from pytorch_distributed_train_tpu.checkpoint import CheckpointManager
+    from pytorch_distributed_train_tpu.config import CheckpointConfig
+
+    mgr = CheckpointManager(CheckpointConfig(dir=ckpt, async_save=False))
+    step = mgr.latest_step()
+    assert step is not None and step >= 1
+    mgr.close()
