@@ -63,6 +63,12 @@ def init_cache(model, batch: int) -> Any:
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _decode_step(model, params, cache, ids):
+    # Weight-only int8 support (quant.py): a quantized tree dequantizes
+    # here, inside the executable — the int8 arrays are the jit inputs, so
+    # they (not bf16 copies) are what sit in HBM between steps.
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
     logits, updated = model.apply(
         {"params": params, "cache": cache}, ids, train=False,
         mutable=["cache"],

@@ -1,0 +1,109 @@
+"""Weight-only int8 quantization for inference (decode) params.
+
+Beyond the reference harness (its inference story is torch fp32/amp
+forward); the TPU rationale: decode is HBM-bound — every generated token
+re-reads all params — so storing matmul weights as int8 (+ per-output-
+channel fp32 scales) halves resident param bytes vs bf16 and ~quarters
+them vs fp32. Dequantization happens IN-GRAPH at the top of the jitted
+decode step (quant structs are the jit inputs), so int8 is what lives in
+HBM and XLA fuses the convert-multiply into the consumers where
+profitable.
+
+Scheme: symmetric per-output-channel (last axis) absmax scaling,
+``w ≈ w_int8 * scale`` with ``w_int8 ∈ [-127, 127]`` — the standard
+weight-only PTQ used by LLM serving stacks; per-element error is bounded
+by ``scale/2 = absmax/254`` per channel.
+
+Only ndim>=2 leaves matching ``include`` quantize (matmul kernels,
+embeddings); vectors (norm scales, biases) stay fp32 — they're tiny and
+quantization there hurts disproportionately.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from flax import traverse_util
+
+# Leaf-struct keys. A dict with exactly these keys is a quantized leaf —
+# still a valid pytree, so quantized trees flow through jit/device_put
+# unchanged.
+_W, _S = "w_int8", "scale"
+
+DEFAULT_INCLUDE = r"(kernel|embedding)$"
+
+
+def _is_quant_leaf(x) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == {_W, _S}
+
+
+def quantize_leaf(w: jax.Array, axes: tuple[int, ...] | None = None) -> dict:
+    """Symmetric int8 with absmax scales reduced over ``axes``.
+
+    Because decode DEQUANTIZES before the matmul (no int8 arithmetic),
+    any scale granularity reconstructs the weight elementwise — finer
+    grouping only tightens the error bound (absmax/254 per group). Default
+    grouping when ``axes`` is None:
+    - 2D (in, out) kernels: reduce axis 0 → per-output-channel.
+    - 3D DenseGeneral kernels: reduce axis 0 when it's the largest dim
+      (the (C, heads, head_dim) q/k/v layout → per-(head, head_dim)
+      scales, so one outlier head can't widen every head's step); else
+      reduce the two leading axes (the (heads, head_dim, C) out-proj
+      layout → per-output-channel).
+    """
+    if axes is None:
+        if w.ndim == 3 and w.shape[0] >= max(w.shape[1:]):
+            axes = (0,)
+        else:
+            axes = tuple(range(w.ndim - 1))
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127)
+    return {_W: q.astype(jnp.int8), _S: scale.astype(jnp.float32)}
+
+
+def dequantize_leaf(q: dict, dtype=jnp.bfloat16) -> jax.Array:
+    return (q[_W].astype(jnp.float32) * q[_S]).astype(dtype)
+
+
+def quantize_tree(params, include: str = DEFAULT_INCLUDE):
+    """Params tree → same-structure tree with matching kernels replaced by
+    {w_int8, scale} structs. ``include`` is a regex over the '/'-joined
+    param path (same convention as partition rules / decay_exclude)."""
+    pat = re.compile(include)
+    flat = traverse_util.flatten_dict(params)
+    out = {}
+    for path, leaf in flat.items():
+        name = "/".join(map(str, path))
+        if leaf.ndim >= 2 and pat.search(name):
+            # Embedding tables scale per ROW (reduce the hidden axis):
+            # right for lookup (each token's row has its own step) and for
+            # the transposed tied-head matmul (row == output channel).
+            axes = (-1,) if name.endswith("embedding") else None
+            out[path] = quantize_leaf(leaf, axes)
+        else:
+            out[path] = leaf
+    return traverse_util.unflatten_dict(out)
+
+
+def dequantize_tree(params, dtype=jnp.bfloat16):
+    """Inverse of quantize_tree; non-quantized leaves pass through. Call
+    INSIDE the jitted consumer so the int8 arrays are what cross into the
+    executable (and live in HBM)."""
+    return jax.tree.map(
+        lambda x: dequantize_leaf(x, dtype) if _is_quant_leaf(x) else x,
+        params, is_leaf=_is_quant_leaf,
+    )
+
+
+def is_quantized(params) -> bool:
+    return any(_is_quant_leaf(x) for x in
+               jax.tree.leaves(params, is_leaf=_is_quant_leaf))
+
+
+def tree_param_bytes(params) -> int:
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(params))
