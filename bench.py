@@ -140,6 +140,77 @@ def pipeline_bench(args) -> None:
     }))
 
 
+def decode_bench(args) -> None:
+    """KV-cache decode throughput (tokens/sec/chip) on the ~1B llama —
+    the serving-side counterpart of the training bench. Single generation
+    stream per batch row; timing excludes compile and prefill via a full
+    warmup generation. Never seeds a training baseline key."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_train_tpu import quant
+    from pytorch_distributed_train_tpu.config import (
+        ModelConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model,
+        generate,
+    )
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    if args.model != "llama":
+        raise SystemExit("--decode-tokens supports --model llama")
+    if args.decode_tokens < 2:
+        raise SystemExit("--decode-tokens must be >= 2 (prefill-subtraction "
+                         "timing needs at least one pure decode step)")
+    bpc = args.batch_per_chip or 8
+    new_tokens = args.decode_tokens
+    model_cfg = ModelConfig(
+        name="llama", vocab_size=32000, hidden_size=2048, num_layers=16,
+        num_heads=16, num_kv_heads=16, mlp_dim=5504,
+        max_seq_len=min(args.seq_len, 128 + new_tokens + 1),
+        attention_impl="xla",  # decode steps are single-token; dense is right
+    )
+    precision = PrecisionConfig(compute_dtype="bfloat16")
+    _touch()
+    train_model = build_model(model_cfg, precision)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32000, (bpc, 128)), jnp.int32)
+    params = jax.jit(
+        lambda r: train_model.init({"params": r}, ids[:1, :8],
+                                   train=False)["params"]
+    )(jax.random.PRNGKey(0))
+    if args.quantize == "int8":
+        params = jax.jit(quant.quantize_tree)(params)
+    model = build_decode_model(model_cfg, precision)
+    _touch()
+
+    out = generate(model, params, ids, new_tokens)  # warmup: compile both
+    float(out[0, -1])
+    _disarm_watchdog()
+    # Prefill runs inside generate(), so time a prefill+1-token generation
+    # and subtract it: the difference is (new_tokens - 1) pure decode steps.
+    t0 = time.perf_counter()
+    out = generate(model, params, ids, 1)
+    float(out[0, -1])
+    t_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = generate(model, params, ids, new_tokens)
+    float(out[0, -1])  # forces the chain
+    wall = time.perf_counter() - t0 - t_prefill
+    # Single-device generation (no mesh) — per-chip IS the run's rate.
+    per_chip = bpc * (new_tokens - 1) / max(wall, 1e-9)
+    suffix = "_int8" if args.quantize else ""
+    print(json.dumps({
+        "metric": f"llama_decode{suffix}_tokens_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
@@ -165,6 +236,12 @@ def main() -> None:
     p.add_argument("--moment-dtype", default="",
                    help="optimizer moment storage dtype ('' = fp32; "
                         "bfloat16 halves adam/adamw/lamb first-moment HBM)")
+    p.add_argument("--decode-tokens", type=int, default=0,
+                   help="llama only: measure KV-cache DECODE throughput "
+                        "instead of training — generate this many tokens "
+                        "per sequence (timed after a warmup generation)")
+    p.add_argument("--quantize", default="", choices=["", "int8"],
+                   help="decode bench: weight-only int8 params (quant.py)")
     p.add_argument("--stem", default="conv", choices=["conv", "space_to_depth"],
                    help="resnet ImageNet stem: space_to_depth is the exact "
                         "MXU-friendly 4x4/s1 rewrite (models/resnet.py)")
@@ -187,6 +264,8 @@ def main() -> None:
 
     if args.model == "pipeline":
         return pipeline_bench(args)
+    if args.decode_tokens:
+        return decode_bench(args)
 
     import jax
     import jax.numpy as jnp

@@ -20,6 +20,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
 from pytorch_distributed_train_tpu.models.registry import build_model
@@ -61,6 +62,57 @@ def init_cache(model, batch: int) -> Any:
                         _cache_shapes(model, batch))
 
 
+def shard_decode_params(model_name: str, mesh, params) -> Any:
+    """Lay a (possibly int8-quantized) params tree out on ``mesh`` for
+    multi-chip serving — the training partition rules reused for decode
+    (tensor-parallel heads/MLP over 'tensor', optionally fsdp/data too).
+    A quantized {w_int8, scale} struct inherits the base kernel's rule:
+    the rule lookup sees the kernel path/shape (via a proxy tree, so
+    unquantized 'scale' norm params still match their own rules), and the
+    scale re-validates the spec against its keepdims-1 shape (non-divisible
+    dims replicate). Returns the device_put tree; pass it (and mesh=) to
+    ``generate``."""
+    from pytorch_distributed_train_tpu import quant
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+        validate_spec,
+    )
+
+    rules = rules_for_model(model_name)
+    is_q = quant._is_quant_leaf
+    proxy = jax.tree.map(lambda x: x[quant._W] if is_q(x) else x,
+                         params, is_leaf=is_q)
+    kernel_shardings = rules.tree_shardings(mesh, proxy)
+
+    def expand(leaf, sh):
+        if not is_q(leaf):
+            return sh
+        scale_spec = validate_spec(sh.spec, leaf[quant._S].shape, mesh)
+        return {quant._W: sh,
+                quant._S: NamedSharding(mesh, scale_spec)}
+
+    sharding_tree = jax.tree.map(expand, params, kernel_shardings,
+                                 is_leaf=is_q)
+    return jax.device_put(params, sharding_tree)
+
+
+def _cache_shardings(mesh, cache, tp_axis: str = "tensor"):
+    """KV buffers (B, S, H_kv, D) shard heads over the TP axis (the cache
+    must live where its heads' q/k/v columns live); everything else
+    (position counters) replicates. Head counts not divisible by the axis
+    replicate via validate_spec."""
+    from pytorch_distributed_train_tpu.parallel.partition import validate_spec
+
+    def one(leaf):
+        if getattr(leaf, "ndim", 0) == 4:
+            spec = validate_spec(P(None, None, tp_axis, None), leaf.shape,
+                                 mesh)
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(one, cache)
+
+
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _decode_step(model, params, cache, ids):
     # Weight-only int8 support (quant.py): a quantized tree dequantizes
@@ -88,7 +140,7 @@ def _sample(logits, rng, temperature: float, top_k: int):
 
 def generate(model, params, prompt_ids, max_new_tokens: int,
              *, temperature: float = 0.0, top_k: int = 0,
-             rng=None, eos_id: int | None = None) -> jnp.ndarray:
+             rng=None, eos_id: int | None = None, mesh=None) -> jnp.ndarray:
     """Generate continuations for a (B, S) int32 prompt batch.
 
     Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
@@ -107,7 +159,21 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
             f"max_seq_len ({model.max_seq_len})")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    cache = init_cache(model, B)
+    if mesh is not None:
+        # Multi-chip serving: params were laid out by shard_decode_params;
+        # allocate the cache DIRECTLY into its mesh layout (heads beside
+        # their q/k/v columns — materializing it on one chip first would
+        # defeat the point for serving-sized caches) and replicate the
+        # ids; GSPMD propagates the layouts through the same jitted step.
+        shapes = _cache_shapes(model, B)
+        cache = jax.jit(
+            lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 shapes),
+            out_shardings=_cache_shardings(mesh, shapes),
+        )()
+        prompt_ids = jax.device_put(prompt_ids, NamedSharding(mesh, P()))
+    else:
+        cache = init_cache(model, B)
     logits, cache = _decode_step(model, params, cache, prompt_ids)  # prefill
 
     out = [prompt_ids]

@@ -167,3 +167,39 @@ def test_decode_and_hf_generate_parity(family):
                              max_new_tokens=8, do_sample=False,
                              use_cache=True, pad_token_id=0).numpy()
     np.testing.assert_array_equal(np.asarray(ours), theirs)
+
+
+def test_tensor_parallel_decode_matches_single_device(devices8):
+    """Multi-chip serving: sharding params/cache over a 'tensor' mesh must
+    reproduce single-device greedy decode exactly (sharding is layout, not
+    math — the same invariant the training tests pin for TP)."""
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model,
+        shard_decode_params,
+    )
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.config import MeshConfig
+
+    cfg = _tiny_cfg()
+    train_model = build_model(cfg, PrecisionConfig())
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, V, (2, 6)),
+                      jnp.int32)
+    params = train_model.init({"params": jax.random.PRNGKey(0)}, ids,
+                              train=False)["params"]
+    model = build_decode_model(cfg, PrecisionConfig())
+    ref = generate(model, params, ids, 8)
+
+    mesh = build_mesh(MeshConfig(tensor=2, data=2, fsdp=2))
+    sharded = shard_decode_params(cfg.name, mesh, params)
+    out = generate(model, sharded, ids, 8, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+    # quantized tree shards through the same rules (w_int8/scale inherit
+    # the kernel's spec) and still generates deterministically
+    from pytorch_distributed_train_tpu import quant
+
+    qsharded = shard_decode_params(cfg.name, mesh,
+                                   quant.quantize_tree(params))
+    qout = generate(model, qsharded, ids, 8, mesh=mesh)
+    qref = generate(model, quant.quantize_tree(params), ids, 8)
+    np.testing.assert_array_equal(np.asarray(qref), np.asarray(qout))
