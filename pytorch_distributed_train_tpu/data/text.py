@@ -39,6 +39,10 @@ class ByteTokenizer:
     def encode(self, text: str) -> list[int]:
         return list(text.encode("utf-8"))
 
+    def decode(self, ids) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8",
+                                                       errors="replace")
+
 
 class HFTokenizer:
     """transformers.AutoTokenizer adapter (loaded from a LOCAL directory)."""
@@ -58,6 +62,9 @@ class HFTokenizer:
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
 
 
 def load_tokenizer(tokenizer_path: str = ""):

@@ -79,3 +79,56 @@ def test_bad_config_is_one_line_error_exit_2(capfd):
     assert train_mod.main(["--set", "optim.nope=1"]) == 2
     err = capfd.readouterr().err
     assert "optim.nope" in err and "Traceback" not in err
+
+
+def test_generate_cli_end_to_end(tmp_path, capfd):
+    """Export tiny-llama weights via the interop bridge, then drive the
+    generation CLI: byte tokenizer, greedy decode, int8 path."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.interop import save_torch_safetensors
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import generate_cli
+
+    shrink = ["model.vocab_size=300", "model.hidden_size=64",
+              "model.num_layers=2", "model.num_heads=4",
+              "model.num_kv_heads=4", "model.mlp_dim=128",
+              "model.max_seq_len=64", "model.fused_lm_loss=false",
+              "model.remat=false"]
+    cfg = get_preset("llama2_7b")
+    cfg.apply_overrides(shrink)
+    model = build_model(cfg.model, cfg.precision)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 2), jnp.int32), train=False)["params"]
+    st = tmp_path / "weights.st"
+    save_torch_safetensors(params, str(st))
+
+    rc = generate_cli.main(
+        ["--config", "llama2_7b", "--safetensors", str(st),
+         "--prompt", "hello", "--prompt", "world!",
+         "--max-new-tokens", "6"]
+        + [f"--set={s}" for s in shrink])
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert "prompt 0: 'hello'" in out and "prompt 1: 'world!'" in out
+
+    rc = generate_cli.main(
+        ["--config", "llama2_7b", "--safetensors", str(st),
+         "--prompt", "hi", "--max-new-tokens", "4", "--quantize", "int8"]
+        + [f"--set={s}" for s in shrink])
+    assert rc == 0
+    assert "prompt 0" in capfd.readouterr().out
+
+
+def test_generate_cli_user_errors_one_line(tmp_path, capfd):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import generate_cli
+
+    rc = generate_cli.main(["--safetensors", str(tmp_path / "nope.st"),
+                            "--prompt", "x"])
+    err = capfd.readouterr().err
+    assert rc == 2 and "Traceback" not in err and "error" in err

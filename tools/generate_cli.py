@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Text generation CLI — the serving-side entrypoint.
+
+Loads model weights from a torch-layout safetensors file (the bridge
+format: `python train.py --export-safetensors model.st` writes one from
+any checkpoint; HF torch files of the same architecture import too),
+tokenizes prompts (local HF tokenizer dir, or the asset-free byte
+tokenizer), and runs KV-cache decode (generate.py) — optionally with
+weight-only int8 (quant.py) and/or tensor-parallel over the local chips.
+
+    python tools/generate_cli.py --config llama2_7b \
+        --safetensors model.st --tokenizer /models/llama2-tok \
+        --prompt "The capital of France is" --max-new-tokens 64 \
+        --temperature 0.8 --top-k 40 [--quantize int8] [--tp 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="llama2_7b",
+                   help="preset supplying the model architecture")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="dotted config override (model.* mostly)")
+    p.add_argument("--safetensors", required=True,
+                   help="torch-layout safetensors weights (interop bridge)")
+    p.add_argument("--tokenizer", default="",
+                   help="local HF tokenizer dir; empty → byte tokenizer")
+    p.add_argument("--prompt", action="append", default=[],
+                   help="repeatable; '-' reads one prompt per stdin line")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quantize", default="", choices=["", "int8"])
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel ways over local devices (0 → off)")
+    args = p.parse_args(argv)
+
+    prompts = []
+    for item in args.prompt or ["-"]:
+        if item == "-":
+            prompts.extend(line.rstrip("\n") for line in sys.stdin
+                           if line.strip())
+        else:
+            prompts.append(item)
+    if not prompts:
+        print("generate_cli: no prompts", file=sys.stderr)
+        return 2
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_train_tpu import quant
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.data.text import load_tokenizer
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model,
+        generate,
+        shard_decode_params,
+    )
+    from pytorch_distributed_train_tpu.interop import load_flax_safetensors
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    try:
+        cfg = get_preset(args.config)
+        cfg.apply_overrides(args.set)
+
+        tok = load_tokenizer(args.tokenizer)
+        encoded = [tok.encode(t) for t in prompts]
+        if any(len(e) == 0 for e in encoded):
+            raise ValueError("empty prompt after tokenization")
+
+        model_cfg = cfg.model
+        template = jax.eval_shape(
+            lambda: build_model(model_cfg, cfg.precision).init(
+                {"params": jax.random.PRNGKey(0)},
+                jnp.zeros((1, 2), jnp.int32), train=False))["params"]
+        params = load_flax_safetensors(args.safetensors, template)
+        if args.quantize == "int8":
+            params = jax.jit(quant.quantize_tree)(params)
+
+        model = build_decode_model(model_cfg, cfg.precision)
+        mesh = None
+        if args.tp > 1:
+            from pytorch_distributed_train_tpu.config import MeshConfig
+            from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+
+            mesh = build_mesh(MeshConfig(tensor=args.tp, data=1, fsdp=1))
+            params = shard_decode_params(model_cfg.name, mesh, params)
+
+        # One generation per prompt: the decoder has no padding mask, so
+        # batching mixed-length prompts with left-pad would let pad tokens
+        # leak into attention (and shift RoPE positions). Equal-shape calls
+        # reuse the same compiled executables.
+        for i, (text, e) in enumerate(zip(prompts, encoded)):
+            ids = jnp.asarray(np.asarray(e, np.int32)[None, :])
+            out = np.asarray(generate(
+                model, params, ids, args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                rng=jax.random.PRNGKey(args.seed + i), eos_id=tok.eos_id,
+                mesh=mesh))
+            new = out[0, len(e):].tolist()
+            if tok.eos_id in new:
+                new = new[: new.index(tok.eos_id)]
+            print(f"=== prompt {i}: {text!r}")
+            print(tok.decode(new))
+        return 0
+    except (KeyError, ValueError, FileNotFoundError, OSError) as e:
+        # User-input mistakes (unknown preset, typo'd --set, missing or
+        # foreign weights file, prompt longer than max_seq_len, bad --tp)
+        # print one clear line and exit 2 — same contract as train.py.
+        print(f"generate_cli: error: {e.args[0] if e.args else e}",
+              file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
