@@ -142,9 +142,9 @@ def pipeline_bench(args) -> None:
 
 def decode_bench(args) -> None:
     """KV-cache decode throughput (tokens/sec/chip) on the ~1B llama —
-    the serving-side counterpart of the training bench. Single generation
-    stream per batch row; timing excludes compile and prefill via a full
-    warmup generation. Never seeds a training baseline key."""
+    the serving-side counterpart of the training bench. Prefills once
+    (untimed), warms the single-token executable, then times N-1 pure
+    decode steps driven directly. Never seeds a training baseline key."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -154,30 +154,39 @@ def decode_bench(args) -> None:
         ModelConfig,
         PrecisionConfig,
     )
-    from pytorch_distributed_train_tpu.generate import (
-        build_decode_model,
-        generate,
-    )
+    from pytorch_distributed_train_tpu.generate import build_decode_model
     from pytorch_distributed_train_tpu.models.registry import build_model
 
     if args.model != "llama":
         raise SystemExit("--decode-tokens supports --model llama")
     if args.decode_tokens < 2:
-        raise SystemExit("--decode-tokens must be >= 2 (prefill-subtraction "
-                         "timing needs at least one pure decode step)")
+        raise SystemExit("--decode-tokens must be >= 2 (timing needs at "
+                         "least one pure decode step after the warmup one)")
     bpc = args.batch_per_chip or 8
     new_tokens = args.decode_tokens
+    prompt_len = 16 if args.tiny else 128
+    if prompt_len + new_tokens + 1 > args.seq_len:
+        # generate()'s length guard doesn't run on this direct-step path;
+        # overflowing the cache would silently clamp writes into the last
+        # slot and time a semantically broken decode.
+        raise SystemExit(
+            f"prompt ({prompt_len}) + decode tokens ({new_tokens}) + 1 "
+            f"exceeds --seq-len {args.seq_len}; raise --seq-len")
+    dims = (dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                 num_kv_heads=4, mlp_dim=128) if args.tiny else
+            dict(vocab_size=32000, hidden_size=2048, num_layers=16,
+                 num_heads=16, num_kv_heads=16, mlp_dim=5504))
     model_cfg = ModelConfig(
-        name="llama", vocab_size=32000, hidden_size=2048, num_layers=16,
-        num_heads=16, num_kv_heads=16, mlp_dim=5504,
-        max_seq_len=min(args.seq_len, 128 + new_tokens + 1),
+        name="llama", **dims,
+        max_seq_len=min(args.seq_len, prompt_len + new_tokens + 1),
         attention_impl="xla",  # decode steps are single-token; dense is right
     )
     precision = PrecisionConfig(compute_dtype="bfloat16")
     _touch()
     train_model = build_model(model_cfg, precision)
     ids = jnp.asarray(
-        np.random.default_rng(0).integers(0, 32000, (bpc, 128)), jnp.int32)
+        np.random.default_rng(0).integers(0, dims["vocab_size"],
+                                          (bpc, prompt_len)), jnp.int32)
     params = jax.jit(
         lambda r: train_model.init({"params": r}, ids[:1, :8],
                                    train=False)["params"]
@@ -187,22 +196,30 @@ def decode_bench(args) -> None:
     model = build_decode_model(model_cfg, precision)
     _touch()
 
-    out = generate(model, params, ids, new_tokens)  # warmup: compile both
-    float(out[0, -1])
+    # Drive the single-token step loop directly: prefill once (untimed),
+    # warm the decode executable, then time N pure decode steps — no
+    # noisy two-run subtraction.
+    from pytorch_distributed_train_tpu.generate import (
+        _decode_step,
+        init_cache,
+    )
+
+    cache = init_cache(model, bpc)
+    logits, cache = _decode_step(model, params, cache, ids)  # prefill
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    logits, cache = _decode_step(model, params, cache, nxt)  # compile step
+    float(logits[0, 0])
     _disarm_watchdog()
-    # Prefill runs inside generate(), so time a prefill+1-token generation
-    # and subtract it: the difference is (new_tokens - 1) pure decode steps.
     t0 = time.perf_counter()
-    out = generate(model, params, ids, 1)
-    float(out[0, -1])
-    t_prefill = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = generate(model, params, ids, new_tokens)
-    float(out[0, -1])  # forces the chain
-    wall = time.perf_counter() - t0 - t_prefill
+    for _ in range(new_tokens - 1):
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        logits, cache = _decode_step(model, params, cache, nxt)
+    float(logits[0, 0])  # forces the chain (donated-cache dependency)
+    wall = time.perf_counter() - t0
     # Single-device generation (no mesh) — per-chip IS the run's rate.
-    per_chip = bpc * (new_tokens - 1) / max(wall, 1e-9)
-    suffix = "_int8" if args.quantize else ""
+    per_chip = bpc * (new_tokens - 1) / wall
+    suffix = ("_int8" if args.quantize else "") + (
+        "_tiny" if args.tiny else "")
     print(json.dumps({
         "metric": f"llama_decode{suffix}_tokens_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -242,6 +259,9 @@ def main() -> None:
                         "per sequence (timed after a warmup generation)")
     p.add_argument("--quantize", default="", choices=["", "int8"],
                    help="decode bench: weight-only int8 params (quant.py)")
+    p.add_argument("--tiny", action="store_true",
+                   help="decode bench: toy model sizes for CI smoke on CPU "
+                        "(never comparable to real numbers)")
     p.add_argument("--stem", default="conv", choices=["conv", "space_to_depth"],
                    help="resnet ImageNet stem: space_to_depth is the exact "
                         "MXU-friendly 4x4/s1 rewrite (models/resnet.py)")

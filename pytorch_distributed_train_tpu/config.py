@@ -288,6 +288,9 @@ class ObsConfig:
     # Log device memory (HBM bytes_in_use / peak) with train metrics.
     # No-op on backends that don't report memory_stats (CPU).
     log_memory: bool = False
+    # Per-top-level-module grad norms in the train metrics
+    # (grad_norm/<module> keys) — which block explodes/vanishes.
+    log_module_grad_norms: bool = False
     # Persistent XLA compilation cache dir ("" → leave jax's default): cuts
     # the minutes-scale recompiles of big GSPMD programs across job restarts
     # (SURVEY §7.4.5) — the torch.compile cache analogue. NOTE: the jax

@@ -75,44 +75,53 @@ def _doc_text(doc) -> str:
     return doc.get("text", "") if isinstance(doc, dict) else ""
 
 
-def _iter_documents(files: list[str]):
+def _iter_documents(files: list[str | tuple[str, int]]):
     """Yield text documents: .jsonl lines' 'text' field; .json whole-file
     (array of docs or a single doc); else raw lines grouped into
-    blank-line-separated paragraphs (txt)."""
-    for path in files:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            if path.endswith(".jsonl"):
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        doc = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    if _doc_text(doc):
-                        yield _doc_text(doc)
-            elif path.endswith(".json"):
-                # a standard (possibly pretty-printed) JSON file — parsing
-                # it line-wise would silently contribute zero documents
+    blank-line-separated paragraphs (txt). A ``(path, repeat)`` entry
+    yields the file's documents ``repeat`` times (corpus mixing — the
+    data-blend "epochs per source" recipe; re-reads the file instead of
+    holding it in RAM)."""
+    for entry in files:
+        path, repeat = entry if isinstance(entry, tuple) else (entry, 1)
+        for _ in range(repeat):
+            yield from _iter_one_file(path)
+
+
+def _iter_one_file(path: str):
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        if path.endswith(".jsonl"):
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
                 try:
-                    parsed = json.load(fh)
-                except json.JSONDecodeError as e:
-                    raise ValueError(f"{path} is not valid JSON: {e}") from e
-                docs = parsed if isinstance(parsed, list) else [parsed]
-                for doc in docs:
-                    if _doc_text(doc):
-                        yield _doc_text(doc)
-            else:
-                para: list[str] = []
-                for line in fh:
-                    if line.strip():
-                        para.append(line.strip())
-                    elif para:
-                        yield " ".join(para)
-                        para = []
-                if para:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if _doc_text(doc):
+                    yield _doc_text(doc)
+        elif path.endswith(".json"):
+            # a standard (possibly pretty-printed) JSON file — parsing
+            # it line-wise would silently contribute zero documents
+            try:
+                parsed = json.load(fh)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path} is not valid JSON: {e}") from e
+            docs = parsed if isinstance(parsed, list) else [parsed]
+            for doc in docs:
+                if _doc_text(doc):
+                    yield _doc_text(doc)
+        else:
+            para: list[str] = []
+            for line in fh:
+                if line.strip():
+                    para.append(line.strip())
+                elif para:
                     yield " ".join(para)
+                    para = []
+            if para:
+                yield " ".join(para)
 
 
 def pack_corpus(files: list[str], tokenizer, seq_len: int) -> np.ndarray:
@@ -135,11 +144,37 @@ def pack_corpus(files: list[str], tokenizer, seq_len: int) -> np.ndarray:
     return stream.reshape(n_blocks, seq_len)
 
 
-def _resolve_files(pattern: str) -> list[str]:
-    files = sorted(glob_mod.glob(pattern, recursive=True))
-    if not files:
-        raise FileNotFoundError(f"data.text_files matched nothing: {pattern!r}")
-    return files
+def _resolve_files(pattern: str) -> list[tuple[str, int]]:
+    """``data.text_files`` spec → [(path, repeat)].
+
+    Comma-separated globs, each optionally ``glob::N`` — that source's
+    documents appear N times in the packed stream (integer data-blend
+    weights, the "epochs per source" mixing recipe)."""
+    out: list[tuple[str, int]] = []
+    for spec in pattern.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        glob_part, _, rep_part = spec.partition("::")
+        repeat = 1
+        if rep_part:
+            try:
+                repeat = int(rep_part)
+            except ValueError:
+                repeat = -1
+            if repeat < 1:
+                raise ValueError(
+                    f"text_files weight in {spec!r} must be a positive "
+                    "integer (docs from that glob repeat N times)")
+        files = sorted(glob_mod.glob(glob_part, recursive=True))
+        if not files:
+            raise FileNotFoundError(
+                f"data.text_files matched nothing: {glob_part!r}")
+        out.extend((f, repeat) for f in files)
+    if not out:
+        raise FileNotFoundError(
+            f"data.text_files matched nothing: {pattern!r}")
+    return out
 
 
 def _split(blocks: np.ndarray, train: bool, eval_holdout: int):
@@ -157,9 +192,10 @@ def _split(blocks: np.ndarray, train: bool, eval_holdout: int):
 _PACK_CACHE: dict[tuple, np.ndarray] = {}
 
 
-def _packed_blocks(files: list[str], tokenizer_path: str, seq_len: int):
-    key = (tuple(files),
-           tuple((os.path.getmtime(f), os.path.getsize(f)) for f in files),
+def _packed_blocks(files, tokenizer_path: str, seq_len: int):
+    paths = [f if isinstance(f, str) else f[0] for f in files]
+    key = (tuple(f if isinstance(f, str) else tuple(f) for f in files),
+           tuple((os.path.getmtime(p), os.path.getsize(p)) for p in paths),
            tokenizer_path, seq_len)
     if key not in _PACK_CACHE:
         _PACK_CACHE.clear()  # hold at most one corpus
@@ -252,21 +288,27 @@ def build_text_dataset(data_cfg, model_cfg, train: bool, mlm: bool,
     )
 
     files = _resolve_files(data_cfg.text_files)
-    n_bin = sum(f.endswith(".bin") for f in files)
+    paths = [f for f, _ in files]
+    n_bin = sum(p.endswith(".bin") for p in paths)
     if n_bin:
-        if n_bin != len(files):
+        if any(rep != 1 for _, rep in files):
             raise ValueError(
-                f"text_files mixes .bin and text files ({files}); the "
+                "::N blend weights are not supported on .bin token files "
+                "(the memory-mapped stream has no packing stage to repeat "
+                "documents in) — drop the weight or use text files")
+        if n_bin != len(paths):
+            raise ValueError(
+                f"text_files mixes .bin and text files ({paths}); the "
                 "tokenize-and-pack path would read binary tokens as UTF-8 "
                 "garbage — match exactly one .bin or only text files")
         if mlm:
             raise ValueError(
                 "token-bin datasets are causal-LM only (MLM needs the "
                 "tokenizer's mask id — use text files + tokenizer_path)")
-        if len(files) != 1:
+        if len(paths) != 1:
             raise ValueError(
-                f"expected one .bin token file, matched {len(files)}")
-        return TokenBinDataset(files[0], data_cfg.seq_len,
+                f"expected one .bin token file, matched {len(paths)}")
+        return TokenBinDataset(paths[0], data_cfg.seq_len,
                                dtype=data_cfg.token_bin_dtype,
                                train=train, eval_holdout=eval_holdout,
                                vocab_size=model_cfg.vocab_size)

@@ -82,10 +82,15 @@ def _tree_finite(tree) -> jnp.ndarray:
 
 
 def make_train_step(model, loss_fn: Callable, tx,
-                    ema_decay: float = 0.0, mixup=None) -> Callable:
+                    ema_decay: float = 0.0, mixup=None,
+                    module_grad_norms: bool = False) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
     closes over the optax transform (and the static EMA decay / mixup
-    transform); jit-wrapped by the caller with explicit shardings."""
+    transform); jit-wrapped by the caller with explicit shardings.
+    ``module_grad_norms`` adds per-top-level-module grad norms to the
+    metrics (grad_norm/<module> keys) — the torch-recipe debugging habit
+    of watching which block's gradients explode/vanish; computed in-graph,
+    so it costs a few reductions, not a host transfer per param."""
     if not 0.0 <= ema_decay < 1.0:
         raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
 
@@ -136,6 +141,9 @@ def make_train_step(model, loss_fn: Callable, tx,
         gnorm = optax_global_norm(grads)
         metrics = {"loss": loss, "grad_norm": gnorm, "aux_loss": model_aux,
                    **aux, **metrics_extra}
+        if module_grad_norms:
+            for key, sub in grads.items():
+                metrics[f"grad_norm/{key}"] = optax_global_norm(sub)
         return new_state, metrics
 
     return train_step

@@ -121,7 +121,8 @@ class Trainer:
                             loss=cfg.loss)
         train_step = steps_lib.make_train_step(
             self.model, self.loss_fn, self.tx,
-            ema_decay=cfg.optim.ema_decay, mixup=mixup)
+            ema_decay=cfg.optim.ema_decay, mixup=mixup,
+            module_grad_norms=cfg.obs.log_module_grad_norms)
         if cfg.optim.offload_state:
             train_step = steps_lib.offload_opt_state(
                 train_step, opt_dev_sharding, self.state_sharding.opt_state)

@@ -240,3 +240,38 @@ def test_token_bin_review_fixes(tmp_path):
                      text_files=str(tmp_path / "*"))
     with pytest.raises(ValueError, match="mixes"):
         build_dataset(cfg, ModelConfig(vocab_size=512), train=True)
+
+
+def test_corpus_mix_weights(tmp_path):
+    """'glob::N' repeats that source's docs N times in the packed stream
+    (integer data-blend weights); bad weights fail loudly."""
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.data import text as text_mod
+    from pytorch_distributed_train_tpu.data.text import (
+        ByteTokenizer,
+        _resolve_files,
+        pack_corpus,
+    )
+
+    (tmp_path / "a.txt").write_text("aaaa aaaa aaaa\n")
+    (tmp_path / "b.txt").write_text("bb bb\n")
+    spec = f"{tmp_path}/a.txt::2,{tmp_path}/b.txt"
+    files = _resolve_files(spec)
+    assert files == [(str(tmp_path / "a.txt"), 2),
+                     (str(tmp_path / "b.txt"), 1)]
+    tok = ByteTokenizer()
+    blocks = pack_corpus(files, tok, 8)
+    stream = np.concatenate(blocks)
+    n_a = int((stream == ord("a")).sum())
+    base_a = len("aaaa aaaa aaaa".encode()) - 2  # 'a' count per pass
+    assert n_a == 2 * base_a  # doubled vs a single pass
+
+    import pytest
+
+    with pytest.raises(ValueError, match="positive integer"):
+        _resolve_files(f"{tmp_path}/a.txt::0")
+    with pytest.raises(ValueError, match="positive integer"):
+        _resolve_files(f"{tmp_path}/a.txt::x")
+    with pytest.raises(FileNotFoundError):
+        _resolve_files(f"{tmp_path}/missing*.txt::2")

@@ -217,3 +217,38 @@ def test_trainer_rejects_offload_on_cpu(tmp_path):
     cfg.checkpoint.resume = "none"
     with pytest.raises(ValueError, match="offload_state"):
         Trainer(cfg)
+
+
+def test_module_grad_norm_metrics(devices8):
+    mesh = build_mesh(MeshConfig(data=8))
+    model_cfg = ModelConfig(name="resnet18", num_classes=10, image_size=8)
+    model = build_model(model_cfg, PrecisionConfig())
+    from pytorch_distributed_train_tpu.losses import get_loss_fn as glf
+
+    tx, _ = make_optimizer(OptimConfig(name="momentum", learning_rate=0.1,
+                                       schedule="constant"), total_steps=10)
+    rules = rules_for_model("resnet18")
+
+    def init_state(rng):
+        variables = model.init({"params": rng}, jnp.zeros((2, 8, 8, 3)),
+                               train=False)
+        return TrainState.create(params=variables["params"], tx=tx,
+                                 batch_stats=variables.get("batch_stats", {}))
+
+    shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    state = jax.jit(init_state, out_shardings=sharding)(jax.random.PRNGKey(0))
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(model, glf("softmax_xent"), tx,
+                                  module_grad_norms=True),
+        mesh, sharding)
+    state, metrics = step(state, _make_batch(), jax.random.PRNGKey(1))
+    per_module = {k: float(v) for k, v in metrics.items()
+                  if k.startswith("grad_norm/")}
+    assert "grad_norm/conv_stem" in per_module
+    assert any(k.startswith("grad_norm/stage") for k in per_module)
+    assert all(np.isfinite(v) and v >= 0 for v in per_module.values())
+    # per-module norms compose to the global norm
+    total = float(metrics["grad_norm"])
+    rss = float(np.sqrt(sum(v**2 for v in per_module.values())))
+    np.testing.assert_allclose(rss, total, rtol=1e-4)
