@@ -171,6 +171,17 @@ class OptimConfig:
     beta2: float = 0.999
     eps: float = 1e-8
     grad_clip_norm: float = 0.0  # 0 → off
+    # ReduceLROnPlateau analogue (optax.contrib.reduce_on_plateau), driven
+    # by the per-step training loss inside the jitted step (torch drives
+    # it with whatever metric you pass — commonly val loss per epoch; here
+    # the signal is the train loss, smoothed over plateau_accumulation
+    # updates). plateau_factor > 0 enables; patience/cooldown count
+    # optimizer updates.
+    plateau_factor: float = 0.0
+    plateau_patience: int = 10
+    plateau_cooldown: int = 0
+    plateau_accumulation: int = 1
+    plateau_min_scale: float = 0.0
     # Keep optimizer state (adam/lamb moments, momentum) in pinned HOST
     # memory between steps — the ZeRO-Offload analogue, via JAX memory
     # kinds. Frees ~2 params-worth of HBM for adam-family optimizers at the

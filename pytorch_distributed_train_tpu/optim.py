@@ -15,6 +15,7 @@ from __future__ import annotations
 import itertools
 import re
 
+import jax
 import optax
 
 
@@ -228,7 +229,38 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
     else:
         raise ValueError(f"unknown optimizer {name!r}")
 
+    if getattr(opt_cfg, "plateau_factor", 0.0) > 0.0:
+        # torch ReduceLROnPlateau analogue: scales the UPDATES (≡ LR) down
+        # by plateau_factor after plateau_patience updates without the
+        # (plateau_accumulation-smoothed) loss improving. Appended after
+        # the optimizer so it sees the final update magnitudes; the loss
+        # reaches it as tx.update(..., value=loss) (train_state passes it
+        # when the trainer enables plateau).
+        from optax import contrib as optax_contrib
+
+        parts.append(optax_contrib.reduce_on_plateau(
+            factor=opt_cfg.plateau_factor,
+            patience=opt_cfg.plateau_patience,
+            cooldown=opt_cfg.plateau_cooldown,
+            accumulation_size=max(opt_cfg.plateau_accumulation, 1),
+            min_scale=opt_cfg.plateau_min_scale,
+        ))
     tx = optax.chain(*parts)
     if opt_cfg.accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=opt_cfg.accum_steps)
     return tx, sched
+
+
+def plateau_scale(opt_state):
+    """Current ReduceLROnPlateau LR scale from an optimizer state tree, or
+    None when plateau isn't in the chain — the logging hook (the effective
+    LR is schedule(step) * this)."""
+    hits = []
+
+    def visit(s):
+        if hasattr(s, "plateau_count") and hasattr(s, "scale"):
+            hits.append(s.scale)
+
+    jax.tree.map(visit, opt_state,
+                 is_leaf=lambda s: hasattr(s, "plateau_count"))
+    return hits[0] if hits else None

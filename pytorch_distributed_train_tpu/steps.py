@@ -124,7 +124,7 @@ def make_train_step(model, loss_fn: Callable, tx,
             grads = jax.tree.map(lambda g: g / scale, grads)
             finite = _tree_finite(grads)
             stepped = state.apply_gradients(tx, grads, new_stats,
-                                            ema_decay=ema_decay)
+                                            ema_decay=ema_decay, loss=loss)
             skipped = state.replace(step=state.step + 1)  # step advances either way
             new_state = jax.tree.map(
                 lambda new, old: jnp.where(finite, new, old), stepped, skipped
@@ -135,7 +135,7 @@ def make_train_step(model, loss_fn: Callable, tx,
             metrics_extra = {"loss_scale": scale, "grads_finite": finite}
         else:
             new_state = state.apply_gradients(tx, grads, new_stats,
-                                              ema_decay=ema_decay)
+                                              ema_decay=ema_decay, loss=loss)
             metrics_extra = {}
 
         gnorm = optax_global_norm(grads)

@@ -16,6 +16,15 @@ import jax.numpy as jnp
 import optax
 
 
+def _has_plateau_state(opt_state) -> bool:
+    """Whether a reduce_on_plateau state sits anywhere in the tree (its
+    leaves duck-type on the plateau_count field)."""
+    return any(
+        hasattr(s, "plateau_count")
+        for s in jax.tree.leaves(
+            opt_state, is_leaf=lambda s: hasattr(s, "plateau_count")))
+
+
 @flax.struct.dataclass
 class DynamicScale:
     """Dynamic fp16 loss scaling — optax-style replacement for
@@ -68,8 +77,18 @@ class TrainState:
     ema_params: Any = None
 
     def apply_gradients(self, tx: optax.GradientTransformation, grads,
-                        new_batch_stats=None, ema_decay: float = 0.0):
-        updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
+                        new_batch_stats=None, ema_decay: float = 0.0,
+                        loss=None):
+        # reduce_on_plateau in the chain REQUIRES value=; other chains
+        # reject the kwarg. Detect the plateau state structurally (trace-
+        # time pytree walk, zero runtime cost) so every caller that passes
+        # the loss is safe regardless of which OptimConfig built the tx.
+        if loss is not None and _has_plateau_state(self.opt_state):
+            updates, new_opt_state = tx.update(
+                grads, self.opt_state, self.params, value=loss)
+        else:
+            updates, new_opt_state = tx.update(
+                grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         ema = self.ema_params
         if ema is not None and ema_decay > 0.0:

@@ -25,7 +25,7 @@ from pytorch_distributed_train_tpu.config import TrainConfig
 from pytorch_distributed_train_tpu.data.datasets import build_dataset
 from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
 from pytorch_distributed_train_tpu.models.registry import build_model
-from pytorch_distributed_train_tpu.optim import make_optimizer
+from pytorch_distributed_train_tpu.optim import make_optimizer, plateau_scale
 from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
 from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
 from pytorch_distributed_train_tpu.train_state import DynamicScale, TrainState
@@ -274,6 +274,11 @@ class Trainer:
         host = {k: float(np.asarray(v)) for k, v in metrics.items()}
         # the schedule counts optimizer updates, not micro-steps
         host["lr"] = float(self.lr_schedule(step // max(self.cfg.optim.accum_steps, 1)))
+        if self.cfg.optim.plateau_factor > 0:
+            scale = plateau_scale(self.state.opt_state)
+            if scale is not None:
+                host["lr_plateau_scale"] = float(np.asarray(scale))
+                host["lr"] *= host["lr_plateau_scale"]
         host.update(self.meter.percentiles())
         tput = self.meter.throughput(self.items_per_step)
         if tput is not None:

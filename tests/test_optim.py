@@ -310,3 +310,70 @@ def test_polynomial_schedule_shape():
                        warmup_steps=0, poly_power=2.0, end_lr_factor=0.0)
     sched2 = make_schedule(cfg2, total_steps=100)
     np.testing.assert_allclose(float(sched2(50)), 0.25e-3, rtol=1e-3)
+
+
+def test_reduce_on_plateau_scales_updates():
+    """torch ReduceLROnPlateau analogue: after `patience` updates without
+    the loss improving, the update magnitude drops by plateau_factor; an
+    improving loss keeps it unscaled."""
+    from pytorch_distributed_train_tpu.optim import plateau_scale
+
+    cfg = OptimConfig(name="sgd", learning_rate=1.0, momentum=0.0,
+                      weight_decay=0.0, schedule="constant",
+                      plateau_factor=0.5, plateau_patience=2)
+    tx, _ = make_optimizer(cfg, total_steps=100)
+    params = {"w": jnp.zeros((3,))}
+    state = tx.init(params)
+    g = {"w": jnp.ones((3,))}
+    assert float(plateau_scale(state)) == 1.0
+
+    # constant (non-improving) loss: patience 2 → scale halves, and the
+    # actual update halves with it
+    for _ in range(4):
+        updates, state = tx.update(g, state, params, value=jnp.float32(5.0))
+    assert float(plateau_scale(state)) == 0.5
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.5, rtol=1e-6)
+
+    # improving loss: scale stays where it is (no further decay)
+    for v in (4.0, 3.0, 2.0, 1.0):
+        updates, state = tx.update(g, state, params, value=jnp.float32(v))
+    assert float(plateau_scale(state)) == 0.5
+
+    # no plateau in the chain → helper reports None
+    tx2, _ = make_optimizer(OptimConfig(name="sgd", schedule="constant"),
+                            total_steps=10)
+    assert plateau_scale(tx2.init(params)) is None
+
+
+def test_plateau_trains_end_to_end(tmp_path):
+    from pytorch_distributed_train_tpu.config import TrainConfig
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    cfg = TrainConfig()
+    cfg.model.name = "resnet18"
+    cfg.model.num_classes = 10
+    cfg.model.image_size = 8
+    cfg.data.dataset = "synthetic_images"
+    cfg.data.synthetic_size = 64
+    cfg.data.batch_size = 16
+    cfg.data.num_workers = 1
+    cfg.optim.name = "momentum"
+    cfg.optim.learning_rate = 0.05
+    cfg.optim.schedule = "constant"
+    cfg.optim.warmup_steps = 0
+    cfg.optim.plateau_factor = 0.5
+    cfg.optim.plateau_patience = 1
+    cfg.total_steps = 3
+    cfg.checkpoint.dir = str(tmp_path / "ckpt")
+    cfg.checkpoint.save_every_steps = 10**9
+    cfg.checkpoint.async_save = False
+    cfg.obs.log_every_steps = 1
+    cfg.obs.jsonl_path = str(tmp_path / "m.jsonl")
+    t = Trainer(cfg)
+    t.fit()
+    t.close()
+    import json as _json
+
+    rows = [_json.loads(line) for line in open(tmp_path / "m.jsonl")]
+    train_rows = [r for r in rows if r.get("tag") == "train"]
+    assert train_rows and all("lr_plateau_scale" in r for r in train_rows)
