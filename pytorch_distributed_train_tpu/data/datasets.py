@@ -288,11 +288,18 @@ class ImageFolderDataset:
     def __len__(self) -> int:
         return len(self.samples)
 
-    def get_item(self, i: int, rng: np.random.Generator) -> dict:
+    def _open_sample(self, i: int):
+        """→ (PIL.Image, label). Overridden by the tar-shard variant."""
         from PIL import Image
 
         path, label = self.samples[i]
-        with Image.open(path) as im:
+        return Image.open(path), label
+
+    def get_item(self, i: int, rng: np.random.Generator) -> dict:
+        from PIL import Image
+
+        pil, label = self._open_sample(i)
+        with pil as im:
             im = im.convert("RGB")
             if self.train:
                 im = _random_resized_crop(im, self.image_size, rng)
@@ -311,6 +318,102 @@ class ImageFolderDataset:
         else:
             x = (x_u8.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
         return {"image": x, "label": np.int32(label)}
+
+
+class TarShardImageDataset(ImageFolderDataset):
+    """WebDataset-convention tar shards: each ``.tar`` holds ``<key>.jpg``
+    (or .jpeg/.png) + ``<key>.cls`` (class index as ASCII) pairs. The
+    ImageNet-at-scale storage layout — thousands of sequential-read shards
+    instead of a million tiny files (object stores and network filesystems
+    hate the latter). Same decode/augment path as ImageFolderDataset.
+
+    Random access: member offsets are indexed once at startup (tar headers
+    only); reads then seek directly into the shard. File handles are
+    per-thread and dropped on pickle, so the dataset works under both the
+    thread loader and Grain worker processes."""
+
+    def __init__(self, pattern: str, image_size: int, train: bool,
+                 randaugment=None):
+        import glob as glob_mod
+        import tarfile
+
+        self.image_size = image_size
+        self.train = train
+        self.randaugment = randaugment if train else None
+        self.shards = sorted(glob_mod.glob(pattern))
+        if not self.shards:
+            raise FileNotFoundError(
+                f"data.data_dir matched no .tar shards: {pattern!r}")
+        # samples: (shard_idx, jpg_offset, jpg_size, label)
+        self.samples = []  # type: ignore[assignment]
+        for si, shard in enumerate(self.shards):
+            pairs: dict[str, dict] = {}
+            # mode "r:" = uncompressed only — autodetected gzip shards
+            # would index offsets into the DECOMPRESSED stream that the
+            # raw-seek read path can't honor; fail fast here instead of
+            # handing gzip bytes to PIL later.
+            with tarfile.open(shard, "r:") as tf:
+                for m in tf:
+                    if not m.isfile():
+                        continue
+                    key, dot, ext = m.name.rpartition(".")
+                    ext = ext.lower()
+                    entry = pairs.setdefault(key, {})
+                    if ext in ("jpg", "jpeg", "png"):
+                        entry["img"] = (m.offset_data, m.size)
+                    elif ext == "cls":
+                        f = tf.extractfile(m)
+                        entry["label"] = int(f.read().strip())  # type: ignore[union-attr]
+            for key in sorted(pairs):
+                entry = pairs[key]
+                if "img" in entry and "label" in entry:
+                    off, size = entry["img"]
+                    self.samples.append((si, off, size, entry["label"]))
+        if not self.samples:
+            raise ValueError(
+                f"tar shards {self.shards} contain no (img, cls) pairs")
+        import threading
+
+        self._local = threading.local()
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_local", None)  # open handles never cross process forks
+        return d
+
+    def __setstate__(self, d):
+        import threading
+
+        self.__dict__.update(d)
+        self._local = threading.local()
+
+    _MAX_OPEN_PER_THREAD = 64
+
+    def _handle(self, si: int):
+        # LRU-bounded per-thread handle cache: random access touches every
+        # shard eventually, and thousands-of-shards x N threads of open
+        # fds would blow typical ulimits mid-epoch.
+        files = getattr(self._local, "files", None)
+        if files is None:
+            files = self._local.files = {}
+        fh = files.pop(si, None)
+        if fh is None:
+            if len(files) >= self._MAX_OPEN_PER_THREAD:
+                oldest = next(iter(files))  # dict order = LRU order
+                files.pop(oldest).close()
+            fh = open(self.shards[si], "rb")
+        files[si] = fh  # reinsert → most-recently-used position
+        return fh
+
+    def _open_sample(self, i: int):
+        import io
+
+        from PIL import Image
+
+        si, off, size, label = self.samples[i]
+        fh = self._handle(si)
+        fh.seek(off)
+        return Image.open(io.BytesIO(fh.read(size))), label
 
 
 def _random_resized_crop(im, size: int, rng: np.random.Generator):
@@ -372,6 +475,15 @@ def build_dataset(data_cfg, model_cfg, train: bool):
             )
         return ImageFolderDataset(root, model_cfg.image_size, train,
                                   randaugment=_build_randaugment(data_cfg, train))
+    if name == "imagenet_tar":
+        # WebDataset-style shards: data_dir is a glob per split, e.g.
+        # '/data/imagenet-{split}-*.tar' ({split} → train|val), or a
+        # plain glob used for both splits.
+        pattern = data_cfg.data_dir.replace(
+            "{split}", "train" if train else "val")
+        return TarShardImageDataset(
+            pattern, model_cfg.image_size, train,
+            randaugment=_build_randaugment(data_cfg, train))
     if name == "synthetic_lm":
         return synthetic_lm(
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,
