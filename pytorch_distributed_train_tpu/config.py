@@ -143,7 +143,8 @@ class DataConfig:
 class OptimConfig:
     """Optimizer + LR schedule (reference: torch.optim.SGD / LAMB — SURVEY C20)."""
 
-    name: str = "sgd"  # sgd | momentum | adamw | lamb | adam | lars | adafactor
+    # sgd | momentum | adamw | lamb | adam | lars | adafactor | muon
+    name: str = "sgd"
     learning_rate: float = 0.1
     warmup_steps: int = 0
     # constant | cosine | step | linear | polynomial | onecycle |
@@ -200,6 +201,9 @@ class OptimConfig:
     # first-moment buffer adafactor exists to avoid.
     adafactor_min_dim_factored: int = 128
     adafactor_momentum: float = 0.0
+    # muon: momentum coefficient for the orthogonalized branch (matrix
+    # params); beta1/beta2 configure its adam branch (everything else).
+    muon_beta: float = 0.95
     accum_steps: int = 1  # optax.MultiSteps microbatching (≡ DDP no_sync)
     # Polyak/EMA weight averaging (torch-recipe "model EMA"): decay per
     # step, 0 → off. Eval runs on the EMA mirror when enabled.

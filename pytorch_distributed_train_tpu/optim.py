@@ -216,6 +216,85 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
                                if opt_cfg.weight_decay > 0 else None),
             weight_decay_mask=mask if mask is not None else True,
         ))
+    elif name == "muon":
+        # Muon (Jordan et al. 2024, via optax.contrib): momentum
+        # orthogonalized by Newton-Schulz iterations for matrix params,
+        # AdamW for everything else. The NS iterations are five matmuls
+        # per 2D param — MXU-native work, a natural TPU optimizer.
+        # optax's default muon sends EVERY 2D param to the orthogonalized
+        # branch — including embedding tables and the LM head, the params
+        # the Muon recipe explicitly routes to adam. Partition ourselves:
+        # embed/head params get a plain AdamW; everything else goes to
+        # the default muon (which already handles its internal 2D-vs-rest
+        # split). (Passing explicit MuonDimensionNumbers instead was
+        # observed to under-orthogonalize in optax 0.2.6.)
+        from optax import contrib as optax_contrib
+
+        def muon_labels(params):
+            from flax import traverse_util
+
+            flat = traverse_util.flatten_dict(params)
+            out = {
+                path: ("adam" if re.search(
+                    r"(embedding$|embed/|lm_head/|/head/|^head/)",
+                    "/".join(map(str, path))) else "muon")
+                for path in flat
+            }
+            return traverse_util.unflatten_dict(out)
+
+        parts.append(optax.multi_transform(
+            {
+                "muon": optax_contrib.muon(
+                    sched, beta=getattr(opt_cfg, "muon_beta", 0.95),
+                    weight_decay=opt_cfg.weight_decay,
+                    weight_decay_mask=mask if mask is not None else None,
+                    mu_dtype=mu_dtype,
+                    adam_b1=opt_cfg.beta1, adam_b2=opt_cfg.beta2),
+                "adam": optax.adamw(
+                    sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2,
+                    eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay,
+                    mask=mask, mu_dtype=mu_dtype),
+            },
+            muon_labels,
+        ))
+    elif name == "schedule_free_adamw":
+        # Schedule-Free AdamW (Defazio et al. 2024): no decay schedule at
+        # all — the iterate interpolation replaces it. Training runs on
+        # the z-sequence; EVALUATION must use schedule_free_eval_params
+        # (trainer routes this via make_eval_step(schedule_free=True)).
+        from optax import contrib as optax_contrib
+
+        if opt_cfg.schedule not in ("constant",):
+            raise ValueError(
+                "schedule_free_adamw replaces the LR schedule by design — "
+                "set schedule='constant' (warmup_steps is honored)")
+        if getattr(opt_cfg, "plateau_factor", 0.0) > 0:
+            raise ValueError(
+                "schedule_free_adamw + plateau_factor: reduce_on_plateau "
+                "would rescale the y-sequence updates out from under the "
+                "ScheduleFreeState and is itself an LR schedule — "
+                "disable one")
+        if getattr(opt_cfg, "ema_decay", 0.0) > 0:
+            raise ValueError(
+                "schedule_free_adamw already averages iterates — EMA on "
+                "top would evaluate the EMA of the z-sequence, which is "
+                "neither; disable one")
+        if mask is not None:
+            raise ValueError(
+                "schedule_free_adamw has no decay mask in optax — "
+                "decay_exclude would be silently ignored; clear it or "
+                "use adamw")
+        if mu_dtype is not None:
+            raise ValueError(
+                "schedule_free_adamw does not narrow moment storage "
+                "(optax state_dtype changes the z-iterate too) — clear "
+                "moment_dtype or use adamw")
+        parts.append(optax_contrib.schedule_free_adamw(
+            learning_rate=opt_cfg.learning_rate,
+            warmup_steps=opt_cfg.warmup_steps or None,
+            b1=opt_cfg.beta1, b2=opt_cfg.beta2, eps=opt_cfg.eps,
+            weight_decay=opt_cfg.weight_decay,
+        ))
     elif name == "lars":
         # Large-batch ResNet recipe (MLPerf): layerwise trust ratio; the
         # no-decay params are also excluded from trust-ratio adaptation,
@@ -249,6 +328,19 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
     if opt_cfg.accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=opt_cfg.accum_steps)
     return tx, sched
+
+
+def schedule_free_eval(opt_state, params):
+    """Schedule-Free evaluation params: locate the ScheduleFreeState in
+    the (possibly chained/wrapped) optimizer state — duck-typed on its
+    ``z`` iterate field — and interpolate. Passthrough when absent."""
+    from optax import contrib as optax_contrib
+
+    states = [s for s in jax.tree.leaves(
+        opt_state, is_leaf=lambda s: hasattr(s, "z")) if hasattr(s, "z")]
+    if not states:
+        return params
+    return optax_contrib.schedule_free_eval_params(states[0], params)
 
 
 def plateau_scale(opt_state):

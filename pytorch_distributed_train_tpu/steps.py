@@ -156,10 +156,22 @@ def optax_global_norm(tree) -> jnp.ndarray:
     )
 
 
-def make_eval_step(model, loss_fn: Callable) -> Callable:
+def make_eval_step(model, loss_fn: Callable,
+                   schedule_free: bool = False) -> Callable:
     def eval_step(state: TrainState, batch: dict):
+        params = state.eval_params
+        if schedule_free:
+            # Schedule-Free trains on the z-sequence; the model that's
+            # actually good is the x/y interpolation recovered from the
+            # optimizer state (optim.schedule_free_eval locates the
+            # ScheduleFreeState inside the chain).
+            from pytorch_distributed_train_tpu.optim import (
+                schedule_free_eval,
+            )
+
+            params = schedule_free_eval(state.opt_state, params)
         logits, _, _ = apply_model(
-            model, state.eval_params, state.batch_stats, batch,
+            model, params, state.batch_stats, batch,
             train=False, dropout_rng=None,
         )
         loss, aux = loss_fn(logits, batch)

@@ -130,7 +130,9 @@ class Trainer:
             train_step, self.mesh, self.state_sharding, self.batch_axes,
         )
         self.eval_step = steps_lib.jit_eval_step(
-            steps_lib.make_eval_step(self.model, self.loss_fn),
+            steps_lib.make_eval_step(
+                self.model, self.loss_fn,
+                schedule_free=cfg.optim.name == "schedule_free_adamw"),
             self.mesh, self.state_sharding, self.batch_axes,
         )
 
