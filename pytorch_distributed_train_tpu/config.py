@@ -57,6 +57,10 @@ class ModelConfig:
     dropout_rate: float = 0.0
     # Llama
     rope_theta: float = 10000.0
+    # Linear RoPE position interpolation (HF rope_scaling "linear"): >1
+    # stretches the usable context to rope_scaling x the pretrain length
+    # (set max_seq_len accordingly; positions divide by the factor).
+    rope_scaling: float = 1.0
     rms_norm_eps: float = 1e-5
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False

@@ -44,10 +44,16 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(dtype)
 
 
-def rope_frequencies(head_dim: int, max_seq_len: int, theta: float) -> tuple:
-    """Precompute cos/sin tables (S, head_dim/2) in fp32."""
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float,
+                     scaling: float = 1.0) -> tuple:
+    """Precompute cos/sin tables (S, head_dim/2) in fp32.
+
+    ``scaling`` > 1 is linear position interpolation (Chen et al. 2023;
+    HF rope_scaling type "linear"): positions divide by the factor, so a
+    model trained at L tokens serves scaling*L — rope(t, scaling=k) ==
+    rope(t/k) exactly."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    t = jnp.arange(max_seq_len, dtype=jnp.float32) / scaling
     freqs = jnp.outer(t, inv_freq)  # (S, D/2)
     return jnp.cos(freqs), jnp.sin(freqs)
 
@@ -66,6 +72,7 @@ class LlamaAttention(nn.Module):
     num_heads: int
     num_kv_heads: int
     rope_theta: float
+    rope_scaling: float
     max_seq_len: int
     dtype: jnp.dtype
     param_dtype: jnp.dtype
@@ -104,7 +111,8 @@ class LlamaAttention(nn.Module):
                 # static, attention is plain causal over the PROMPT ONLY —
                 # O(S^2), not O(S*L) over the padded cache — and the
                 # configured attn_impl (incl. Pallas) still applies.
-                cos, sin = rope_frequencies(head_dim, S, self.rope_theta)
+                cos, sin = rope_frequencies(head_dim, S, self.rope_theta,
+                                             self.rope_scaling)
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
@@ -117,7 +125,8 @@ class LlamaAttention(nn.Module):
             else:
                 # Single-token step at the running offset (dynamic index).
                 idx = c_i.value
-                cos, sin = rope_frequencies(head_dim, L, self.rope_theta)
+                cos, sin = rope_frequencies(head_dim, L, self.rope_theta,
+                                             self.rope_scaling)
                 cos = jax.lax.dynamic_slice_in_dim(cos, idx, S, 0)
                 sin = jax.lax.dynamic_slice_in_dim(sin, idx, S, 0)
                 q = apply_rope(q, cos, sin)
@@ -135,7 +144,8 @@ class LlamaAttention(nn.Module):
                 y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
                                           impl="xla")
         else:
-            cos, sin = rope_frequencies(head_dim, S, self.rope_theta)
+            cos, sin = rope_frequencies(head_dim, S, self.rope_theta,
+                                             self.rope_scaling)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
 
@@ -170,6 +180,7 @@ class LlamaBlock(nn.Module):
     num_kv_heads: int
     mlp_dim: int
     rope_theta: float
+    rope_scaling: float
     max_seq_len: int
     rms_norm_eps: float
     dtype: jnp.dtype
@@ -184,8 +195,9 @@ class LlamaBlock(nn.Module):
         h = RMSNorm(self.rms_norm_eps, name="input_norm")(x)
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.rope_theta,
-            self.max_seq_len, self.dtype, self.param_dtype, cp=self.cp,
-            attn_impl=self.attn_impl, decode=self.decode, name="attn",
+            self.rope_scaling, self.max_seq_len, self.dtype,
+            self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
+            decode=self.decode, name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         if self.moe is not None:
@@ -211,6 +223,9 @@ class LlamaForCausalLM(nn.Module):
     mlp_dim: int = 11008
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
+    # Linear position interpolation factor (HF rope_scaling "linear"):
+    # serve/fine-tune at rope_scaling x the pretrain context.
+    rope_scaling: float = 1.0
     rms_norm_eps: float = 1e-5
     remat: bool = True
     remat_policy: str = "full"  # full | dots | dots_no_batch (models/remat.py)
@@ -249,8 +264,9 @@ class LlamaForCausalLM(nn.Module):
                    and self.moe.active_for_layer(i) else None)
             x = block_cls(
                 self.num_heads, self.num_kv_heads, self.mlp_dim,
-                self.rope_theta, self.max_seq_len, self.rms_norm_eps,
-                self.dtype, self.param_dtype, cp=self.cp, moe=moe,
+                self.rope_theta, self.rope_scaling, self.max_seq_len,
+                self.rms_norm_eps, self.dtype, self.param_dtype,
+                cp=self.cp, moe=moe,
                 attn_impl=self.attn_impl, decode=self.decode,
                 name=f"layer{i}",
             )(x)
@@ -313,6 +329,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         mlp_dim=cfg.mlp_dim,
         max_seq_len=cfg.max_seq_len,
         rope_theta=cfg.rope_theta,
+        rope_scaling=getattr(cfg, "rope_scaling", 1.0),
         rms_norm_eps=cfg.rms_norm_eps,
         remat=cfg.remat,
         remat_policy=getattr(cfg, "remat_policy", "full"),

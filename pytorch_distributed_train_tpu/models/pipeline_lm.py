@@ -81,7 +81,8 @@ class PipelinedLlama:
         )
         self.block = LlamaBlock(
             cfg.num_heads, cfg.num_kv_heads or cfg.num_heads, cfg.mlp_dim,
-            cfg.rope_theta, cfg.max_seq_len, cfg.rms_norm_eps,
+            cfg.rope_theta, getattr(cfg, "rope_scaling", 1.0),
+            cfg.max_seq_len, cfg.rms_norm_eps,
             dtype, param_dtype, cp=cp, moe=moe,
             attn_impl=getattr(cfg, "attention_impl", "auto"),
         )

