@@ -79,6 +79,10 @@ class ModelConfig:
     # compiles on backends that can't take Mosaic kernels). The
     # PDTT_ATTENTION_IMPL env var overrides (ops/attention.py).
     attention_impl: str = "auto"
+    # Sliding-window attention span in tokens (Mistral recipe): each query
+    # attends to its trailing `attention_window` keys. 0 = full causal.
+    # Llama family; composes with the xla/chunked backends (not pallas/cp).
+    attention_window: int = 0
     # Pipeline parallelism (model name "llama_pp"; SURVEY §2.3 PP row):
     # microbatch count (0 → = stage count), schedule ("gpipe" | "1f1b" |
     # "interleaved"), and chunks per device for the interleaved schedule.
@@ -208,6 +212,10 @@ class OptimConfig:
     # muon: momentum coefficient for the orthogonalized branch (matrix
     # params); beta1/beta2 configure its adam branch (everything else).
     muon_beta: float = 0.95
+    # Layer-wise LR decay (timm/BEiT fine-tune recipe): depth-d params'
+    # updates scale by decay^(max_depth - d); 1.0 → off. Head/final norm
+    # keep full LR, embeddings/stem train slowest.
+    layer_lr_decay: float = 1.0
     accum_steps: int = 1  # optax.MultiSteps microbatching (≡ DDP no_sync)
     # Polyak/EMA weight averaging (torch-recipe "model EMA"): decay per
     # step, 0 → off. Eval runs on the EMA mirror when enabled.

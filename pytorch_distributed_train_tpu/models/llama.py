@@ -78,6 +78,7 @@ class LlamaAttention(nn.Module):
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
+    window: int = 0  # sliding-window attention (0 = full causal)
     # Autoregressive decode: maintain a (B, max_seq_len, H_kv, D) KV cache in
     # the flax 'cache' collection (the idiomatic flax decode pattern — torch
     # analogue: HF past_key_values). Works for both the prefill call (S>1 at
@@ -121,7 +122,8 @@ class LlamaAttention(nn.Module):
                     c_v.value, v, 0, 1)
                 c_i.value = jnp.full((), S, jnp.int32)
                 y = dot_product_attention(q, k, v, causal=True,
-                                          impl=self.attn_impl)
+                                          impl=self.attn_impl,
+                                          window=self.window)
             else:
                 # Single-token step at the running offset (dynamic index).
                 idx = c_i.value
@@ -140,7 +142,10 @@ class LlamaAttention(nn.Module):
                 # (> idx) is masked out so the static length leaks nothing
                 q_pos = idx + jnp.arange(S)
                 k_pos = jnp.arange(L)
-                mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+                mask = k_pos[None, :] <= q_pos[:, None]
+                if self.window:
+                    mask &= (q_pos[:, None] - k_pos[None, :]) < self.window
+                mask = mask[None, None]
                 y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
                                           impl="xla")
         else:
@@ -150,7 +155,8 @@ class LlamaAttention(nn.Module):
             k = apply_rope(k, cos, sin)
 
             y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
-                                      impl=self.attn_impl)
+                                      impl=self.attn_impl,
+                                      window=self.window)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -188,6 +194,7 @@ class LlamaBlock(nn.Module):
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None  # set → MoE FFN instead of dense (ops/moe.py)
     attn_impl: str = "auto"
+    window: int = 0
     decode: bool = False
 
     @nn.compact
@@ -197,7 +204,7 @@ class LlamaBlock(nn.Module):
             self.num_heads, self.num_kv_heads, self.rope_theta,
             self.rope_scaling, self.max_seq_len, self.dtype,
             self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
-            decode=self.decode, name="attn",
+            window=self.window, decode=self.decode, name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         if self.moe is not None:
@@ -234,6 +241,8 @@ class LlamaForCausalLM(nn.Module):
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None
     attn_impl: str = "auto"
+    # Sliding-window attention span (Mistral recipe; 0 = full causal).
+    attention_window: int = 0
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # Fused chunked head+CE (losses.chunked_causal_ce): __call__ returns
     # {'loss_sum','weight_sum'} instead of logits — (B,S,V) fp32 logits
@@ -267,7 +276,8 @@ class LlamaForCausalLM(nn.Module):
                 self.rope_theta, self.rope_scaling, self.max_seq_len,
                 self.rms_norm_eps, self.dtype, self.param_dtype,
                 cp=self.cp, moe=moe,
-                attn_impl=self.attn_impl, decode=self.decode,
+                attn_impl=self.attn_impl, window=self.attention_window,
+                decode=self.decode,
                 name=f"layer{i}",
             )(x)
             if self.act is not None:
@@ -320,6 +330,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         moe=moe,
         act=act,
         attn_impl=getattr(cfg, "attention_impl", "auto"),
+        attention_window=getattr(cfg, "attention_window", 0),
         fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,

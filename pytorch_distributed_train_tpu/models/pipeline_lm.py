@@ -85,6 +85,7 @@ class PipelinedLlama:
             cfg.max_seq_len, cfg.rms_norm_eps,
             dtype, param_dtype, cp=cp, moe=moe,
             attn_impl=getattr(cfg, "attention_impl", "auto"),
+            window=getattr(cfg, "attention_window", 0),
         )
         self.final_norm = RMSNorm(cfg.rms_norm_eps)
         # bf16 operands + fp32 accumulation: full MXU rate with fp32 logits

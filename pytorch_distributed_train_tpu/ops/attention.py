@@ -104,6 +104,7 @@ def dot_product_attention(
     softmax_dtype: jnp.dtype = jnp.float32,
     impl: str = "auto",  # auto | xla | pallas | chunked
     cp: ContextParallelConfig | None = None,
+    window: int = 0,  # >0: sliding window — attend to the last `window` keys
 ) -> jax.Array:
     """Multi-head attention core, GQA-aware.
 
@@ -122,6 +123,17 @@ def dot_product_attention(
     if impl not in _VALID_IMPLS:
         raise ValueError(
             f"attention impl must be one of {_VALID_IMPLS}, got {impl!r}")
+    if window:
+        # Mistral-style sliding window: only defined relative to causal
+        # ordering (each query sees its trailing `window` keys).
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if cp is not None and cp.active:
+            raise NotImplementedError(
+                "sliding-window + context parallelism is not implemented "
+                "(the ring/all-to-all paths assume full causal attention)")
     # The env var is the operator's kill switch: it beats EVERYTHING,
     # including an explicit impl arg or a config-threaded backend — its
     # whole purpose is preventing Mosaic-compile hangs no matter what the
@@ -158,7 +170,16 @@ def dot_product_attention(
                 tensor_axis=cp.tensor_axis, impl=impl,
             )
         raise ValueError(f"unknown context_impl {cp.impl!r}")
-    if impl in ("auto", "pallas"):
+    if impl == "pallas" and window:
+        # An explicit pallas request can't be honored with a window (the
+        # kernel has no band support) — refuse loudly rather than silently
+        # running a different (dense) backend than the operator forced.
+        raise ValueError(
+            "the pallas flash kernel has no sliding-window support; use "
+            "attention impl 'chunked' (long seq) or 'xla' with window")
+    if impl in ("auto", "pallas") and not window:
+        # (auto windowed calls route to the chunked/XLA paths below, which
+        # implement the band)
         from pytorch_distributed_train_tpu.ops import flash_attention as _fa
 
         on_tpu = _on_tpu()
@@ -189,8 +210,9 @@ def dot_product_attention(
         # dense path OOMs on; BERT seq512 −3.6% (tile overhead) → dense
         # stays the short-seq default.
         return _chunked_attention(q, k, v, causal=causal, mask=mask,
-                                  softmax_dtype=softmax_dtype)
-    return _xla_attention(q, k, v, causal=causal, mask=mask, softmax_dtype=softmax_dtype)
+                                  softmax_dtype=softmax_dtype, window=window)
+    return _xla_attention(q, k, v, causal=causal, mask=mask,
+                          softmax_dtype=softmax_dtype, window=window)
 
 
 def _on_tpu() -> bool:
@@ -200,7 +222,7 @@ def _on_tpu() -> bool:
         return False
 
 
-def _xla_attention(q, k, v, *, causal, mask, softmax_dtype):
+def _xla_attention(q, k, v, *, causal, mask, softmax_dtype, window=0):
     from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
 
     orig_dtype = q.dtype
@@ -218,6 +240,8 @@ def _xla_attention(q, k, v, *, causal, mask, softmax_dtype):
         q_pos = jnp.arange(Sq)[:, None] + (Sk - Sq)  # align ends for KV-cache decode
         k_pos = jnp.arange(Sk)[None, :]
         causal_mask = q_pos >= k_pos
+        if window:
+            causal_mask &= (q_pos - k_pos) < window
         logits = jnp.where(causal_mask[None, None], logits, _neg_inf(softmax_dtype))
     if mask is not None:
         logits = jnp.where(mask, logits, _neg_inf(softmax_dtype))
@@ -241,7 +265,7 @@ _AUTO_CHUNK_MIN_SEQ = 1024
 
 
 def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
-                       chunk: int = _CHUNK_Q):
+                       chunk: int = _CHUNK_Q, window: int = 0):
     """Memory-efficient attention in pure XLA: flash-attention's streaming
     structure (process the score matrix in tiles, never materialise it
     whole) expressed as a sequential `lax.map` over query chunks with the
@@ -268,7 +292,7 @@ def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
     k, v = expand_kv_heads(k, v, H)
     if Sq <= chunk:
         return _xla_attention(q, k, v, causal=causal, mask=mask,
-                              softmax_dtype=softmax_dtype)
+                              softmax_dtype=softmax_dtype, window=window)
 
     n_chunks = -(-Sq // chunk)
     pad = n_chunks * chunk - Sq
@@ -290,14 +314,32 @@ def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
 
     scale = 1.0 / jnp.sqrt(D).astype(softmax_dtype)
     k_pos = jnp.arange(Sk)[None, :]
+    # Sliding window: each tile's queries only see keys in
+    # [start - window + 1, start + chunk) — slice K/V to that static-width
+    # band instead of scoring (and masking away) the whole key axis:
+    # O(Sq * window) work, the compute win windowing exists for. Only when
+    # no explicit mask rides along (its key axis would need slicing too).
+    band_width = min(Sk, (window + chunk - 1)) if window else Sk
+    use_band = bool(window) and mask is None and band_width < Sk
 
     def body(args):
         q_tile, start = args
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q_tile, k,
+        if use_band:
+            band_start = jnp.clip(start + (Sk - Sq) - (window - 1),
+                                  0, Sk - band_width)
+            k_t = jax.lax.dynamic_slice_in_dim(k, band_start, band_width, 1)
+            v_t = jax.lax.dynamic_slice_in_dim(v, band_start, band_width, 1)
+            k_pos_t = (band_start + jnp.arange(band_width))[None, :]
+        else:
+            k_t, v_t, k_pos_t = k, v, k_pos
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q_tile, k_t,
                             preferred_element_type=softmax_dtype) * scale
         q_pos = start + jnp.arange(chunk)[:, None] + (Sk - Sq)
         if causal:
-            logits = jnp.where((q_pos >= k_pos)[None, None], logits,
+            keep = q_pos >= k_pos_t
+            if window:
+                keep &= (q_pos - k_pos_t) < window
+            logits = jnp.where(keep[None, None], logits,
                                _neg_inf(softmax_dtype))
         if mask is not None:
             # mask is (B, 1, Sq, Sk) or broadcastable; slice the query axis
@@ -311,7 +353,7 @@ def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
         # Padded query rows (beyond Sq) mask everything out → uniform
         # softmax over garbage; harmless, dropped by the final slice.
         probs = jax.nn.softmax(logits, axis=-1).astype(orig_dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v_t)
 
     out_tiles = jax.lax.map(jax.checkpoint(body), (q_tiles, starts))
     out = out_tiles.transpose(1, 0, 2, 3, 4).reshape(B, n_chunks * chunk, H, D)

@@ -42,6 +42,56 @@ def decay_mask_fn(exclude: str):
     return mask
 
 
+_LAYER_PAT = re.compile(r"(?:^|/)(?:layer|layers_|stage|block)(\d+)")
+
+
+def layer_lr_decay_transform(decay: float):
+    """Layer-wise LR decay (the timm/BEiT/BERT fine-tune recipe): updates
+    for depth-d params scale by decay^(D_max - d) — deeper (later) layers
+    keep the full LR, the embedding/stem end trains slowest. Depth parses
+    from the param path (layer<k>/layers_<k>/stage<k>); depthless params
+    (embeddings, stem, final norm, head) split: head/final keep full LR,
+    everything else gets the slowest rate, matching timm's grouping."""
+
+    def scale_tree(params):
+        from flax import traverse_util
+
+        flat = traverse_util.flatten_dict(params)
+        depths = {}
+        for path in flat:
+            m = _LAYER_PAT.search("/".join(map(str, path)))
+            depths[path] = int(m.group(1)) if m else None
+        known = [d for d in depths.values() if d is not None]
+        if not known:
+            raise ValueError(
+                "layer_lr_decay found no depth-indexed params (expected "
+                "layer<k>/layers_<k>/stage<k>/block<k> in the param paths) "
+                "— it would silently become a uniform LR cut")
+        d_max = max(known)
+        out = {}
+        for path, d in depths.items():
+            name = "/".join(map(str, path))
+            if d is None:
+                tail = bool(re.search(
+                    r"(head|fc|final_norm|classifier|logits)", name))
+                d = d_max if tail else -1  # embeddings/stem: slowest
+            out[path] = decay ** (d_max - d)
+        return traverse_util.unflatten_dict(out)
+
+    def init_fn(params):
+        import jax.numpy as jnp
+
+        return {"scales": jax.tree.map(jnp.float32, scale_tree(params))}
+
+
+    def update_fn(updates, state, params=None):
+        del params
+        updates = jax.tree.map(lambda u, s: u * s, updates, state["scales"])
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_schedule(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
     """Learning-rate schedule with linear warmup.
 
@@ -308,6 +358,15 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
     else:
         raise ValueError(f"unknown optimizer {name!r}")
 
+    if getattr(opt_cfg, "layer_lr_decay", 1.0) != 1.0:
+        # Applied AFTER the optimizer (scales the final updates ≡ scaling
+        # the LR per layer) — before it, adam's normalization would undo
+        # the scaling.
+        if not 0.0 < opt_cfg.layer_lr_decay <= 1.0:
+            raise ValueError(
+                f"layer_lr_decay must be in (0, 1], got "
+                f"{opt_cfg.layer_lr_decay}")
+        parts.append(layer_lr_decay_transform(opt_cfg.layer_lr_decay))
     if getattr(opt_cfg, "plateau_factor", 0.0) > 0.0:
         # torch ReduceLROnPlateau analogue: scales the UPDATES (≡ LR) down
         # by plateau_factor after plateau_patience updates without the

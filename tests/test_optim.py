@@ -472,3 +472,55 @@ def test_schedule_free_adamw_trains_and_evals(tmp_path):
             make_optimizer(OptimConfig(name="schedule_free_adamw",
                                        schedule="constant", **kw),
                            total_steps=10)
+
+
+def test_layer_lr_decay_scales_by_depth():
+    """timm-style layer decay: update magnitude ratio between adjacent
+    layers equals the decay factor; head keeps full LR, embeddings get
+    the slowest rate; validation rejects nonsense factors."""
+    params = {
+        "tok_embed": {"embedding": jnp.zeros((16, 8))},
+        "layer0": {"mlp": {"kernel": jnp.zeros((8, 8))}},
+        "layer1": {"mlp": {"kernel": jnp.zeros((8, 8))}},
+        "lm_head": {"kernel": jnp.zeros((8, 16))},
+    }
+    cfg = OptimConfig(name="sgd", learning_rate=1.0, momentum=0.0,
+                      weight_decay=0.0, schedule="constant",
+                      layer_lr_decay=0.5)
+    tx, _ = make_optimizer(cfg, total_steps=10)
+    state = tx.init(params)
+    grads = jax.tree.map(lambda p: jnp.ones_like(p), params)
+    updates, _ = tx.update(grads, state, params)
+
+    def mag(x):
+        return float(np.abs(np.asarray(x)).mean())
+
+    l0, l1 = mag(updates["layer0"]["mlp"]["kernel"]), mag(
+        updates["layer1"]["mlp"]["kernel"])
+    np.testing.assert_allclose(l0 / l1, 0.5, rtol=1e-6)  # one layer apart
+    np.testing.assert_allclose(mag(updates["lm_head"]["kernel"]), 1.0,
+                               rtol=1e-6)  # head: full LR
+    np.testing.assert_allclose(
+        mag(updates["tok_embed"]["embedding"]),
+        0.5 ** 2, rtol=1e-6)  # embeddings: one below layer0
+
+    with pytest.raises(ValueError, match="layer_lr_decay"):
+        make_optimizer(OptimConfig(name="sgd", schedule="constant",
+                                   layer_lr_decay=1.5), total_steps=10)
+
+    # ViT-style block<i> paths are recognized too
+    from pytorch_distributed_train_tpu.optim import layer_lr_decay_transform
+
+    vit_params = {"patch_embed": {"kernel": jnp.zeros((4, 4))},
+                  "block0": {"kernel": jnp.zeros((4, 4))},
+                  "block3": {"kernel": jnp.zeros((4, 4))},
+                  "head": {"kernel": jnp.zeros((4, 4))}}
+    scales = layer_lr_decay_transform(0.5).init(vit_params)["scales"]
+    assert float(scales["block3"]["kernel"]) == 1.0
+    assert float(scales["block0"]["kernel"]) == 0.5 ** 3
+    assert float(scales["head"]["kernel"]) == 1.0
+    assert float(scales["patch_embed"]["kernel"]) == 0.5 ** 4
+
+    # depthless trees fail loudly instead of becoming a uniform LR cut
+    with pytest.raises(ValueError, match="depth-indexed"):
+        layer_lr_decay_transform(0.5).init({"w": jnp.zeros((4, 4))})
