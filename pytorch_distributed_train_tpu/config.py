@@ -97,6 +97,13 @@ class ModelConfig:
     expert_capacity_factor: float = 1.25
     moe_every: int = 1
     moe_aux_weight: float = 0.01
+    # Router style: "topk" (GShard/Switch — tokens choose) or
+    # "expert_choice" (experts choose their top-capacity tokens: perfect
+    # load balance structurally, no balance loss; a token may be served
+    # by 0..E experts). Caveat for causal LMs: expert-choice selection
+    # ranks over the whole batch, so training is mildly non-causal
+    # (ops/moe.py::expert_choice_dispatch docstring).
+    moe_router: str = "topk"
     moe_zloss_weight: float = 1e-3
 
 

@@ -324,6 +324,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
             aux_weight=cfg.moe_aux_weight,
             zloss_weight=cfg.moe_zloss_weight,
             every=cfg.moe_every,
+            router=cfg.moe_router,
         )
     return LlamaForCausalLM(
         cp=cp,

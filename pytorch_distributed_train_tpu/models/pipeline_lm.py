@@ -66,6 +66,7 @@ class PipelinedLlama:
                 capacity_factor=cfg.expert_capacity_factor,
                 aux_weight=cfg.moe_aux_weight,
                 zloss_weight=cfg.moe_zloss_weight, every=1,
+                router=cfg.moe_router,
             )
         self.moe = moe
         self.cfg = cfg

@@ -40,6 +40,10 @@ class MoeSpec:
     aux_weight: float = 0.01
     zloss_weight: float = 1e-3
     every: int = 1  # MoE every n-th block (others keep the dense MLP)
+    # "topk" (GShard/Switch: tokens choose experts, overflow drops) or
+    # "expert_choice" (Zhou et al. 2022: experts choose tokens — perfect
+    # load balance by construction, no balance loss needed).
+    router: str = "topk"
 
     def active_for_layer(self, i: int) -> bool:
         return self.num_experts > 1 and (i + 1) % self.every == 0
@@ -82,6 +86,35 @@ def topk_dispatch(gates: jnp.ndarray, top_k: int, capacity: int):
                               dtype=jnp.float32)  # (N, E, C); -1 → all-zero
         dispatch = dispatch + slot
         combine = combine + slot * vals[:, s][:, None, None]
+    return dispatch, combine
+
+
+def expert_choice_dispatch(gates: jnp.ndarray, capacity: int):
+    """Expert-choice routing (Zhou et al. 2022): each EXPERT takes its
+    top-``capacity`` tokens by gate score. Every expert is exactly full —
+    perfect load balance with no auxiliary loss; a token may be served by
+    0..E experts (unchosen tokens pass through the residual, like
+    dropped-overflow tokens under top-k).
+
+    CAUSALITY CAVEAT: selection ranks over ALL flattened batch tokens, so
+    in a decoder-only LM whether position t gets served depends on later
+    positions (and on other sequences in the batch). Training loss is
+    therefore mildly non-causal and batch-dependent — the known
+    Zhou et al. limitation for autoregressive LMs. Best suited to
+    encoder/MLM-style models; for causal LMs treat perplexity
+    comparisons against top-k with care.
+
+    Returns (dispatch, combine) of shape (N, E, min(capacity, N)) —
+    same contract as topk_dispatch except the capacity axis clamps to N
+    (an expert cannot take more tokens than exist); combine carries the
+    raw gate score of each selection (the paper's weighted sum — no
+    per-token renormalization)."""
+    N, E = gates.shape
+    cap = min(capacity, N)
+    vals, idx = jax.lax.top_k(gates.T, cap)  # (E, C): each expert's picks
+    sel = jax.nn.one_hot(idx, N, dtype=jnp.float32)  # (E, C, N)
+    dispatch = sel.transpose(2, 0, 1)  # (N, E, C)
+    combine = dispatch * vals[None, :, :]
     return dispatch, combine
 
 
@@ -128,10 +161,18 @@ class MoeMLP(nn.Module):
             kernel_init=nn.initializers.normal(0.02), name="router",
         )(xf.astype(jnp.float32))
         gates = jax.nn.softmax(logits, axis=-1)
-        dispatch, combine = topk_dispatch(gates, spec.top_k, C)
-
-        aux = (spec.aux_weight * load_balance_loss(gates, dispatch)
-               + spec.zloss_weight * router_z_loss(logits))
+        if spec.router == "expert_choice":
+            dispatch, combine = expert_choice_dispatch(gates, C)
+            # balance is structural; only the z-loss remains useful
+            aux = spec.zloss_weight * router_z_loss(logits)
+        elif spec.router == "topk":
+            dispatch, combine = topk_dispatch(gates, spec.top_k, C)
+            aux = (spec.aux_weight * load_balance_loss(gates, dispatch)
+                   + spec.zloss_weight * router_z_loss(logits))
+        else:
+            raise ValueError(
+                f"unknown moe router {spec.router!r}; "
+                "have topk | expert_choice")
         self.sow("losses", "moe_aux", aux)
 
         # (N, E, C) × (N, D) → (E, C, D): the token all-to-all happens here
