@@ -32,6 +32,7 @@ class GPT2Attention(nn.Module):
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    window: int = 0  # sliding-window attention (0 = full causal)
     decode: bool = False  # KV cache (same contract as llama.py decode)
 
     @nn.compact
@@ -59,7 +60,8 @@ class GPT2Attention(nn.Module):
                     c_v.value, v, 0, 1)
                 c_i.value = jnp.full((), S, jnp.int32)
                 y = dot_product_attention(q, k, v, causal=True,
-                                          impl=self.attn_impl)
+                                          impl=self.attn_impl,
+                                          window=self.window)
             else:
                 idx = c_i.value
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
@@ -69,12 +71,16 @@ class GPT2Attention(nn.Module):
                 c_i.value = idx + S
                 q_pos = idx + jnp.arange(S)
                 k_pos = jnp.arange(L)
-                mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+                mask = k_pos[None, :] <= q_pos[:, None]
+                if self.window:
+                    mask &= (q_pos[:, None] - k_pos[None, :]) < self.window
+                mask = mask[None, None]
                 y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
                                           impl="xla")
         else:
             y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
-                                      impl=self.attn_impl)
+                                      impl=self.attn_impl,
+                                      window=self.window)
         return nn.DenseGeneral(
             C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
             kernel_init=nn.initializers.normal(0.02), name="c_proj",
@@ -91,6 +97,7 @@ class GPT2Block(nn.Module):
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    window: int = 0
     decode: bool = False
 
     @nn.compact
@@ -103,7 +110,8 @@ class GPT2Block(nn.Module):
         x = x + nn.Dropout(self.dropout_rate)(
             GPT2Attention(self.num_heads, self.max_seq_len, self.dtype,
                           self.param_dtype, cp=self.cp,
-                          attn_impl=self.attn_impl, decode=self.decode,
+                          attn_impl=self.attn_impl, window=self.window,
+                          decode=self.decode,
                           name="attn")(h),
             deterministic=self.deterministic)
         h = ln("ln_2")(x).astype(self.dtype)
@@ -136,6 +144,7 @@ class GPT2LMHead(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    attention_window: int = 0  # sliding window (0 = full causal)
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # Fused chunked head+CE over the tied embedding (losses.chunked_causal_ce)
     fused_loss: bool = False
@@ -178,6 +187,7 @@ class GPT2LMHead(nn.Module):
                 self.num_heads, self.mlp_dim, self.max_seq_len,
                 self.dropout_rate, deterministic, self.dtype,
                 self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
+                window=self.attention_window,
                 decode=self.decode, name=f"h{i}",
             )(x)
             if self.act is not None:
@@ -206,6 +216,7 @@ def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
         cp=cp,
         act=act,
         attn_impl=getattr(cfg, "attention_impl", "auto"),
+        attention_window=getattr(cfg, "attention_window", 0),
         fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,

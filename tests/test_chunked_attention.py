@@ -228,6 +228,11 @@ def test_sliding_window_matches_explicit_mask():
     # greedy continuation from the full forward's last logits agrees
     nxt_full = int(jnp.argmax(logits_full[0, -1]))
     assert int(out[0, 20]) == nxt_full
+    # and every single-token windowed decode step matches teacher forcing
+    for i in range(1, 6):
+        logits_i = train_model.apply(variables, out[:, : 20 + i],
+                                     train=False)
+        assert int(out[0, 20 + i]) == int(jnp.argmax(logits_i[0, -1])), i
 
     from pytorch_distributed_train_tpu.ops.attention import (
         dot_product_attention,
@@ -236,3 +241,42 @@ def test_sliding_window_matches_explicit_mask():
 
     with pytest.raises(ValueError, match="causal"):
         dot_product_attention(q, k, v, causal=False, window=4, impl="xla")
+
+
+def test_gpt2_sliding_window_decode_matches_full_forward():
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.config import (
+        ModelConfig, PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model, generate,
+    )
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    rng = np.random.default_rng(5)
+    cfg = ModelConfig(name="gpt2", vocab_size=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      max_seq_len=48, attention_window=8,
+                      attention_impl="xla")
+    train_model = build_model(cfg, PrecisionConfig())
+    ids = jnp.asarray(rng.integers(0, 64, (1, 20)), jnp.int32)
+    variables = train_model.init({"params": jax.random.PRNGKey(0)}, ids,
+                                 train=False)
+    logits_full = train_model.apply(variables, ids, train=False)
+    model = build_decode_model(cfg, PrecisionConfig())
+    out = generate(model, variables["params"], ids, 4)
+    assert int(out[0, 20]) == int(jnp.argmax(logits_full[0, -1]))
+    # every SINGLE-TOKEN decode step (the windowed cache mask) must agree
+    # with a teacher-forced full forward over the growing sequence
+    for i in range(1, 4):
+        logits_i = train_model.apply(variables, out[:, : 20 + i],
+                                     train=False)
+        assert int(out[0, 20 + i]) == int(jnp.argmax(logits_i[0, -1])), i
+    # windowed != unwindowed (the band actually changes the computation)
+    import dataclasses
+    base = build_model(dataclasses.replace(cfg, attention_window=0),
+                       PrecisionConfig())
+    logits_b = base.apply(variables, ids, train=False)
+    assert not np.allclose(np.asarray(logits_full), np.asarray(logits_b))
