@@ -37,12 +37,38 @@ import os
 import sys
 import threading
 import time
+import traceback
 
 VISION = ("resnet18", "resnet50", "vit_b16")
+
+# Substrings identifying a device-backend bring-up failure (vs a bench bug).
+# Matching errors raised BEFORE bring-up completed (see _bringup_done) yield
+# ONE parseable JSON line + exit 3, so a wedged/absent TPU lease produces a
+# structured record instead of a raw traceback (observed:
+# jax.device_count() raising "Unable to initialize backend 'axon':
+# UNAVAILABLE: TPU backend setup/compile error"). Errors after bring-up are
+# real bench/framework bugs and propagate as normal tracebacks.
+_BACKEND_ERR_MARKERS = (
+    "Unable to initialize backend",
+    "backend setup/compile error",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "No visible TPU",
+)
+
+
+def _emit_backend_unavailable(detail: str) -> None:
+    print(json.dumps({
+        "error": "tpu_unavailable",
+        "detail": detail[-1500:],
+        "metric": None,
+        "value": None,
+    }), flush=True)
 
 
 _progress_ts = [time.monotonic()]
 _watchdog_armed = [False]
+_bringup_done = [False]
 
 
 def _touch() -> None:
@@ -56,6 +82,7 @@ def _disarm_watchdog() -> None:
     fixed idle budget (one un-touchable value fetch spans all timed steps),
     so the bring-up watchdog stands down."""
     _watchdog_armed[0] = False
+    _bringup_done[0] = True
 
 
 def _arm_watchdog(seconds: float) -> None:
@@ -74,9 +101,22 @@ def _arm_watchdog(seconds: float) -> None:
             idle = time.monotonic() - _progress_ts[0]
             if idle > seconds:
                 print(
-                    f"bench.py watchdog: no bring-up progress for "
-                    f"{idle:.0f}s — device backend likely unavailable/"
-                    "wedged; aborting", file=sys.stderr, flush=True)
+                    f"bench.py watchdog: no progress for "
+                    f"{idle:.0f}s — aborting", file=sys.stderr, flush=True)
+                if _bringup_done[0]:
+                    # Post-bring-up stall (host pipeline loop): NOT a lease
+                    # problem — don't let the record blame the TPU.
+                    print(json.dumps({
+                        "error": "bench_stalled",
+                        "detail": f"no progress for {idle:.0f}s after "
+                                  "bring-up (host-side stall)",
+                        "metric": None,
+                        "value": None,
+                    }), flush=True)
+                else:
+                    _emit_backend_unavailable(
+                        f"no bring-up progress for {idle:.0f}s (device "
+                        "lease wedged — first device op never returned)")
                 os._exit(3)
             time.sleep(min(60.0, seconds / 4))
 
@@ -96,6 +136,7 @@ def pipeline_bench(args) -> None:
     host throughput scales with whatever else shares the host cores, so a
     cross-run ratio would gate CI on machine load, not on code."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the TPU here
+    _bringup_done[0] = True  # host-only mode: no stall/error here is the TPU's
     import numpy as np
 
     from pytorch_distributed_train_tpu.config import DataConfig
@@ -468,4 +509,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:
+        msg = f"{type(exc).__name__}: {exc}"
+        if not _bringup_done[0] and any(m in msg for m in _BACKEND_ERR_MARKERS):
+            traceback.print_exc(file=sys.stderr)
+            _emit_backend_unavailable(msg)
+            sys.exit(3)
+        raise
