@@ -104,6 +104,11 @@ class ModelConfig:
     # ranks over the whole batch, so training is mildly non-causal
     # (ops/moe.py::expert_choice_dispatch docstring).
     moe_router: str = "topk"
+    # expert_choice ranks tokens over the whole flattened batch, so a
+    # causal-LM loss trained with it leaks future positions into routing.
+    # The trainer refuses that combination unless this is set — an explicit
+    # "I understand the Zhou et al. caveat" opt-in.
+    moe_router_allow_noncausal: bool = False
     moe_zloss_weight: float = 1e-3
 
 

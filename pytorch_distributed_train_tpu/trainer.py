@@ -63,6 +63,17 @@ class Trainer:
             raise ValueError(
                 f"fused_lm_loss is implemented for llama/gpt2, not "
                 f"{cfg.model.name!r}")
+        if (cfg.model.num_experts > 1
+                and cfg.model.moe_router == "expert_choice"
+                and cfg.loss in ("causal_lm_xent", "fused_causal_lm_xent")
+                and not cfg.model.moe_router_allow_noncausal):
+            raise ValueError(
+                "moe_router='expert_choice' with a causal-LM loss leaks "
+                "future tokens into routing (selection ranks over the whole "
+                "flattened batch — ops/moe.py::expert_choice_dispatch). Use "
+                "moe_router='topk', or set "
+                "model.moe_router_allow_noncausal=true to accept the "
+                "Zhou et al. 2022 caveat explicitly")
         self.loss_fn = losses_lib.get_loss_fn(
             cfg.loss, label_smoothing=cfg.label_smoothing)
         self.rules = rules_for_model(cfg.model.name)

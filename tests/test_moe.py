@@ -185,6 +185,37 @@ def test_expert_choice_moe_trains(devices8):
     assert float(jnp.abs(g["router"]["kernel"]).sum()) > 0
 
 
+def test_expert_choice_refused_for_causal_lm(tmp_path):
+    """Expert-choice routing ranks tokens over the whole batch (future
+    positions influence routing), so the trainer must refuse it under a
+    causal-LM loss unless explicitly opted in."""
+    import pytest
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    def make_cfg():
+        cfg = get_preset("gpt2_small")
+        cfg.model = ModelConfig(**MOE_TINY, moe_router="expert_choice")
+        cfg.loss = "causal_lm_xent"
+        cfg.data.seq_len = 16
+        cfg.data.batch_size = 8
+        cfg.data.synthetic_size = 64
+        cfg.checkpoint.dir = str(tmp_path)
+        cfg.checkpoint.save_every_steps = 0
+        cfg.total_steps = 1
+        cfg.epochs = 0
+        return cfg
+
+    with pytest.raises(ValueError, match="expert_choice"):
+        Trainer(make_cfg())
+
+    # explicit opt-in constructs fine
+    cfg = make_cfg()
+    cfg.model.moe_router_allow_noncausal = True
+    Trainer(cfg)
+
+
 def test_unknown_moe_router_rejected():
     import pytest
 
