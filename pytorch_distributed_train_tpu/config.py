@@ -81,7 +81,10 @@ class ModelConfig:
     attention_impl: str = "auto"
     # Sliding-window attention span in tokens (Mistral recipe): each query
     # attends to its trailing `attention_window` keys. 0 = full causal.
-    # Llama family; composes with the xla/chunked backends (not pallas/cp).
+    # Llama family; composes with every backend: xla/chunked mask or
+    # band-slice, pallas masks within tiles and skips out-of-band blocks,
+    # ring attention skips out-of-band hops, ulysses windows its full-seq
+    # local core.
     attention_window: int = 0
     # Pipeline parallelism (model name "llama_pp"; SURVEY §2.3 PP row):
     # microbatch count (0 → = stage count), schedule ("gpipe" | "1f1b" |

@@ -125,15 +125,14 @@ def dot_product_attention(
             f"attention impl must be one of {_VALID_IMPLS}, got {impl!r}")
     if window:
         # Mistral-style sliding window: only defined relative to causal
-        # ordering (each query sees its trailing `window` keys).
+        # ordering (each query sees its trailing `window` keys). Composes
+        # with every backend: xla/chunked mask or band-slice, pallas masks
+        # within tiles and skips out-of-band blocks, ring skips whole
+        # out-of-band hops, ulysses applies it on the full-seq local core.
         if window < 0:
             raise ValueError(f"window must be >= 0, got {window}")
         if not causal:
             raise ValueError("window attention requires causal=True")
-        if cp is not None and cp.active:
-            raise NotImplementedError(
-                "sliding-window + context parallelism is not implemented "
-                "(the ring/all-to-all paths assume full causal attention)")
     # The env var is the operator's kill switch: it beats EVERYTHING,
     # including an explicit impl arg or a config-threaded backend — its
     # whole purpose is preventing Mosaic-compile hangs no matter what the
@@ -155,9 +154,9 @@ def dot_product_attention(
             )
 
             return ring_attention(
-                q, k, v, mesh=cp.mesh, causal=causal,
-                context_axis=cp.context_axis, batch_axes=cp.batch_axes,
-                tensor_axis=cp.tensor_axis,
+                q, k, v, mesh=cp.mesh, causal=causal, window=window,
+                impl=impl, context_axis=cp.context_axis,
+                batch_axes=cp.batch_axes, tensor_axis=cp.tensor_axis,
             )
         if cp.impl == "ulysses":
             from pytorch_distributed_train_tpu.ops.ulysses import (
@@ -166,24 +165,16 @@ def dot_product_attention(
 
             return ulysses_attention(
                 q, k, v, mask=mask, mesh=cp.mesh, causal=causal,
-                context_axis=cp.context_axis, batch_axes=cp.batch_axes,
+                window=window, context_axis=cp.context_axis,
+                batch_axes=cp.batch_axes,
                 tensor_axis=cp.tensor_axis, impl=impl,
             )
         raise ValueError(f"unknown context_impl {cp.impl!r}")
-    if impl == "pallas" and window:
-        # An explicit pallas request can't be honored with a window (the
-        # kernel has no band support) — refuse loudly rather than silently
-        # running a different (dense) backend than the operator forced.
-        raise ValueError(
-            "the pallas flash kernel has no sliding-window support; use "
-            "attention impl 'chunked' (long seq) or 'xla' with window")
-    if impl in ("auto", "pallas") and not window:
-        # (auto windowed calls route to the chunked/XLA paths below, which
-        # implement the band)
+    if impl in ("auto", "pallas"):
         from pytorch_distributed_train_tpu.ops import flash_attention as _fa
 
         on_tpu = _on_tpu()
-        if _fa.supported(q, k, v, causal=causal, mask=mask):
+        if _fa.supported(q, k, v, causal=causal, mask=mask, window=window):
             # impl='pallas' forces the kernel anywhere (interpret mode off-TPU
             # — slow but exact, which is what tests and debugging want);
             # 'auto' uses it only on TPU where it pays off and the backend
@@ -200,6 +191,7 @@ def dot_product_attention(
                 # instead of materialising the repeat in HBM.
                 k, v = expand_kv_heads(k, v, q.shape[2])
                 return _fa.flash_attention(q, k, v, causal=causal,
+                                           window=window,
                                            interpret=not on_tpu)
         elif impl == "pallas":
             raise ValueError("pallas flash attention unsupported for these shapes")

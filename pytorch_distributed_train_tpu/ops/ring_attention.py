@@ -42,13 +42,16 @@ NEG_INF = -1e30
 P = PartitionSpec
 
 
-def _chunk_attention(q, k, v, q_pos, kv_pos, *, causal: bool, scale: float):
+def _chunk_attention(q, k, v, q_pos, kv_pos, *, causal: bool, scale: float,
+                     window: int = 0):
     """Attention of a local Q block against ONE K/V chunk.
 
     q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D); positions: (Sq,), (Sk,) global.
     Returns (o, lse): o normalized within the chunk (B, Sq, H, D) fp32,
     lse (B, H, Sq) fp32. Fully-masked rows get o=0, lse=NEG_INF — the merge
-    rule then gives them zero weight.
+    rule then gives them zero weight. ``window`` > 0 adds the Mistral band
+    (query attends its trailing ``window`` positions; requires causal,
+    enforced upstream).
     """
     from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
 
@@ -58,6 +61,8 @@ def _chunk_attention(q, k, v, q_pos, kv_pos, *, causal: bool, scale: float):
     ) * scale
     if causal:
         mask = q_pos[:, None] >= kv_pos[None, :]  # (Sq, Sk)
+        if window:
+            mask &= (q_pos[:, None] - kv_pos[None, :]) < window
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)  # (B, H, Sq)
     # Rows with every entry masked: m == NEG_INF → treat as empty chunk.
@@ -90,13 +95,23 @@ def ring_attention_local(
     axis_name: str,
     axis_size: int,
     causal: bool = False,
+    window: int = 0,
     q_pos: jax.Array | None = None,  # (Sq_local,) global positions
     kv_pos: jax.Array | None = None,
+    chunk_impl: str = "einsum",  # einsum | pallas
+    interpret: bool = False,  # pallas chunks: interpret mode (tests/CPU)
 ) -> jax.Array:
     """Ring attention body — call inside shard_map with seq sharded on
     ``axis_name``. Positions default to the contiguous layout
     (shard i owns [i*S_local, (i+1)*S_local)); pass explicit positions for a
-    load-balanced (zigzag) layout."""
+    load-balanced (zigzag) layout.
+
+    ``chunk_impl='pallas'`` runs each hop's local attention through the
+    Pallas flash chunk kernel (flash_attention.flash_attention_chunk) —
+    same (o, lse) contract, O(block) VMEM instead of the einsum path's
+    materialized (Sq, Sk) fp32 scores. ``window`` > 0 applies the sliding
+    band; whole out-of-band hops are skipped like above-diagonal ones.
+    """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
@@ -107,16 +122,41 @@ def ring_attention_local(
         kv_pos = idx * Sk + jnp.arange(Sk)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-    chunk = jax.checkpoint(
-        functools.partial(_chunk_attention, causal=causal, scale=scale)
-    )
+    if chunk_impl == "pallas":
+        from pytorch_distributed_train_tpu.ops import flash_attention as _fa
+        from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
+
+        # The kernel wants pre-expanded KV heads. Expanding before the loop
+        # means the rotating chunks carry H (not Hkv) heads over ICI — the
+        # einsum path expands per hop instead. TODO(perf): index kv blocks
+        # as h // rep inside the kernel to rotate un-expanded chunks.
+        k, v = expand_kv_heads(k, v, H)
+
+        def chunk(q_, k_, v_, qp, kp):
+            return _fa.flash_attention_chunk(
+                q_, k_, v_, qp, kp, causal=causal, window=window,
+                interpret=interpret)
+
+        chunk = jax.checkpoint(chunk)
+    elif chunk_impl == "einsum":
+        chunk = jax.checkpoint(
+            functools.partial(_chunk_attention, causal=causal, scale=scale,
+                              window=window)
+        )
+    else:
+        raise ValueError(
+            f"ring chunk_impl must be einsum|pallas, got {chunk_impl!r}")
 
     def masked_chunk(k_t, v_t, pos_t):
-        """Chunk attention, skipped entirely when causality masks the whole
-        chunk (the ppermute still runs — all devices stay in the ring)."""
+        """Chunk attention, skipped entirely when causality (or the window
+        band) masks the whole chunk (the ppermute still runs — all devices
+        stay in the ring)."""
         if not causal:
             return chunk(q, k_t, v_t, q_pos, pos_t)
         needed = jnp.max(q_pos) >= jnp.min(pos_t)
+        if window:
+            # Band intersection: some key within (q - window, q].
+            needed &= jnp.max(pos_t) > jnp.min(q_pos) - window
 
         def skip(_q, _k, _v, _qp, _kp):
             return (
@@ -143,6 +183,36 @@ def ring_attention_local(
     return o.astype(q.dtype)
 
 
+def _resolve_chunk_impl(q, k, n_ring, impl: str):
+    """Map an attention ``impl`` request onto (chunk_impl, interpret) for
+    the ring body, mirroring dot_product_attention's pallas gating: an
+    explicit 'pallas' forces the kernel anywhere (interpret off-TPU — what
+    parity tests want); 'auto' takes it only on a TPU backend that can
+    compile Mosaic and at shard sizes where it pays; 'xla'/'chunked' keep
+    the einsum path."""
+    from pytorch_distributed_train_tpu.ops import attention as attention_lib
+    from pytorch_distributed_train_tpu.ops import flash_attention as _fa
+
+    if impl not in ("auto", "pallas"):
+        return "einsum", False
+    B, S, H, D = q.shape
+    # chunk_supported / profitable gate on seq-shard and lane dims only —
+    # the head count (however 'tensor' splits it) doesn't affect support.
+    local = jax.ShapeDtypeStruct((B, S // n_ring, H, D), q.dtype)
+    if not _fa.chunk_supported(local, local, local):
+        if impl == "pallas":
+            raise ValueError(
+                "ring attention: pallas chunks unsupported for these local "
+                f"shapes (S_local={S // n_ring}, D={D})")
+        return "einsum", False
+    on_tpu = attention_lib._on_tpu()
+    if impl == "pallas":
+        return "pallas", not on_tpu
+    if on_tpu and attention_lib._pallas_usable() and _fa.profitable(local):
+        return "pallas", False
+    return "einsum", False
+
+
 def ring_attention(
     q: jax.Array,  # (B, S, H, D) GLOBAL arrays
     k: jax.Array,
@@ -150,6 +220,8 @@ def ring_attention(
     *,
     mesh: Mesh,
     causal: bool = False,
+    window: int = 0,
+    impl: str = "auto",  # auto | xla | pallas | chunked (chunk backend)
     context_axis: str = "context",
     batch_axes: Sequence[str] = ("data", "fsdp"),
     tensor_axis: str | None = "tensor",
@@ -158,7 +230,9 @@ def ring_attention(
 
     Sequence dim shards on ``context_axis``, batch on ``batch_axes``, heads
     on ``tensor_axis`` — composing CP×DP×TP in one manual region embedded in
-    the surrounding GSPMD program.
+    the surrounding GSPMD program. ``impl`` selects the per-hop chunk
+    backend (see _resolve_chunk_impl); ``window`` applies the sliding band
+    across the ring (out-of-band hops are skipped).
     """
     from pytorch_distributed_train_tpu.ops.cp_common import qkv_spec
 
@@ -168,13 +242,16 @@ def ring_attention(
         # time) — run the plain core instead.
         from pytorch_distributed_train_tpu.ops import attention as attention_lib
 
-        return attention_lib.dot_product_attention(q, k, v, causal=causal)
+        return attention_lib.dot_product_attention(q, k, v, causal=causal,
+                                                   window=window, impl=impl)
+    chunk_impl, interpret = _resolve_chunk_impl(q, k, n, impl)
     spec = qkv_spec(q, k, mesh, context_axis=context_axis,
                     batch_axes=batch_axes, tensor_axis=tensor_axis)
 
     fn = functools.partial(
         ring_attention_local, axis_name=context_axis, axis_size=n,
-        causal=causal,
+        causal=causal, window=window, chunk_impl=chunk_impl,
+        interpret=interpret,
     )
     return jax.shard_map(
         lambda a, b, c: fn(a, b, c),

@@ -37,6 +37,7 @@ def ulysses_attention_local(
     axis_name: str,
     axis_size: int,
     causal: bool = False,
+    window: int = 0,
     impl: str = "auto",
 ) -> jax.Array:
     """Ulysses body — call inside shard_map. Returns seq-sharded output.
@@ -55,7 +56,8 @@ def ulysses_attention_local(
     n = axis_size
     if n == 1:
         return attention_lib.dot_product_attention(q, k, v, causal=causal,
-                                                   mask=mask, impl=impl)
+                                                   mask=mask, impl=impl,
+                                                   window=window)
     H, Hkv = q.shape[2], k.shape[2]
     if H % n != 0:
         raise ValueError(f"ulysses needs heads {H} % context {n} == 0")
@@ -74,8 +76,10 @@ def ulysses_attention_local(
     # H/Hkv-fold expansion happens here, after the transfer, for free in
     # compute (XLA fuses the broadcast) and at zero extra ICI traffic.
     k, v = expand_kv_heads(k, v, q.shape[2])
+    # After the swap each device holds the FULL sequence (for H/n
+    # heads), so the sliding window applies directly on the local core.
     o = attention_lib.dot_product_attention(q, k, v, causal=causal, mask=mask,
-                                            impl=impl)
+                                            impl=impl, window=window)
     # inverse: scatter seq, gather heads
     return jax.lax.all_to_all(o, axis_name=axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
@@ -89,6 +93,7 @@ def ulysses_attention(
     mask: jax.Array | None = None,  # (B, 1, Sq, Sk) or broadcastable
     mesh: Mesh,
     causal: bool = False,
+    window: int = 0,
     context_axis: str = "context",
     batch_axes: Sequence[str] = ("data", "fsdp"),
     tensor_axis: str | None = "tensor",
@@ -103,12 +108,13 @@ def ulysses_attention(
     n = mesh.shape[context_axis]
     if q.shape[1] % n != 0 or k.shape[1] % n != 0:
         return attention_lib.dot_product_attention(q, k, v, causal=causal,
-                                                   mask=mask, impl=impl)
+                                                   mask=mask, impl=impl,
+                                                   window=window)
     spec = qkv_spec(q, k, mesh, context_axis=context_axis,
                     batch_axes=batch_axes, tensor_axis=tensor_axis)
     fn = functools.partial(
         ulysses_attention_local, axis_name=context_axis, axis_size=n,
-        causal=causal, impl=impl,
+        causal=causal, window=window, impl=impl,
     )
     if mask is None:
         return jax.shard_map(

@@ -30,7 +30,7 @@ from pytorch_distributed_train_tpu.parallel.partition import (
 
 @pytest.fixture(scope="module")
 def sharded_7b(devices8):
-    """(mesh, state_shape, state_sharding, model, cfg) at true 7B shapes."""
+    """(mesh, state_shape, state_sharding, model, cfg, tx) at 7B shapes."""
     cfg = get_preset("llama2_7b")
     mesh_cfg = MeshConfig(data=2, fsdp=2, tensor=2)
     mesh = build_mesh(mesh_cfg, devices8)

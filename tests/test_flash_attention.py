@@ -84,6 +84,116 @@ def test_bf16_inputs():
     )
 
 
+def test_windowed_forward_matches_xla():
+    """Sliding window in the kernel (band mask within tiles + out-of-band
+    block skip) vs the XLA reference band."""
+    q, k, v = _make_qkv(B=1, S=512, H=2, D=64, seed=9)
+    for W in (32, 100, 511):
+        out = flash_attention(q, k, v, causal=True, window=W, interpret=True)
+        ref = _xla_attention(q, k, v, causal=True, mask=None,
+                             softmax_dtype=jnp.float32, window=W)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5, err_msg=f"W={W}")
+
+
+def test_windowed_gradients_match_xla():
+    q, k, v = _make_qkv(B=1, S=256, H=2, D=64, seed=13)
+    W = 64
+
+    gf = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal=True, window=W, interpret=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(_xla_attention(
+        a, b, c, causal=True, mask=None, softmax_dtype=jnp.float32,
+        window=W) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_chunk_entry_contract():
+    """flash_attention_chunk: the ring inner kernel's (o, lse) contract —
+    diagonal chunk == causal self-attention; all-future chunk returns
+    o=0 / lse=NEG_INF (zero weight under the merge rule)."""
+    from pytorch_distributed_train_tpu.ops.flash_attention import (
+        flash_attention_chunk,
+    )
+
+    q, k, v = _make_qkv(B=1, S=256, H=2, D=64, seed=17)
+    pos = jnp.arange(256, dtype=jnp.int32)
+    o, lse = flash_attention_chunk(q, k, v, pos, pos, causal=True,
+                                   interpret=True)
+    ref = _xla(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert lse.shape == (1, 2, 256)
+
+    o_f, lse_f = flash_attention_chunk(q, k, v, pos, pos + 256, causal=True,
+                                       interpret=True)
+    assert float(jnp.abs(o_f).max()) == 0.0
+    assert float(lse_f.max()) < -1e29
+
+
+def test_chunk_merge_equals_full_attention_with_grads():
+    """Two merged chunks (flash merge rule) == one attention over the
+    concatenated keys, through the backward — this exercises the lse
+    cotangent folding (delta' = delta − dlse) that ring attention relies
+    on."""
+    from pytorch_distributed_train_tpu.ops.flash_attention import (
+        flash_attention_chunk,
+    )
+    from pytorch_distributed_train_tpu.ops.ring_attention import _merge
+
+    S = 256
+    q, k1, v1 = _make_qkv(B=1, S=S, H=2, D=64, seed=19)
+    _, k2, v2 = _make_qkv(B=1, S=S, H=2, D=64, seed=23)
+    pos = jnp.arange(S, dtype=jnp.int32)
+
+    def merged(a, b1, c1, b2, c2):
+        o1, l1 = flash_attention_chunk(a, b1, c1, pos + S, pos,
+                                       causal=True, interpret=True)
+        o2, l2 = flash_attention_chunk(a, b2, c2, pos + S, pos + S,
+                                       causal=True, interpret=True)
+        o, _ = _merge(o1, l1, o2, l2)
+        return o
+
+    def ref(a, b1, c1, b2, c2):
+        kk = jnp.concatenate([b1, b2], axis=1)
+        vv = jnp.concatenate([c1, c2], axis=1)
+        # Sq < Sk: _xla_attention aligns ends, i.e. q_pos = S..2S-1 — the
+        # same layout as the merged chunks above.
+        return _xla_attention(a, kk, vv, causal=True, mask=None,
+                              softmax_dtype=jnp.float32)
+
+    om = merged(q, k1, v1, k2, v2)
+    orf = ref(q, k1, v1, k2, v2)
+    np.testing.assert_allclose(np.asarray(om), np.asarray(orf),
+                               atol=2e-5, rtol=2e-5)
+
+    gm = jax.grad(lambda *a: jnp.sum(merged(*a) ** 2),
+                  argnums=(0, 1, 2, 3, 4))(q, k1, v1, k2, v2)
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2),
+                  argnums=(0, 1, 2, 3, 4))(q, k1, v1, k2, v2)
+    for a, b, name in zip(gm, gr, ["q", "k1", "v1", "k2", "v2"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_dispatch_windowed_pallas_impl():
+    """impl='pallas' with a window runs the kernel (the old refusal is
+    gone) and matches the windowed XLA path."""
+    from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
+
+    q, k, v = _make_qkv(B=1, S=256, H=2, D=64, seed=29)
+    out = dot_product_attention(q, k, v, causal=True, window=64,
+                                impl="pallas")
+    ref = dot_product_attention(q, k, v, causal=True, window=64, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_dispatch_pallas_impl_covers_gqa_expansion():
     """impl='pallas' runs the real dispatch path (incl. KV expansion) in
     interpret mode on CPU — the CI seam for lines only a TPU would hit."""
