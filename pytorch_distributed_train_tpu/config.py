@@ -143,6 +143,12 @@ class DataConfig:
     mixup_alpha: float = 0.0
     cutmix_alpha: float = 0.0
     mixup_switch_prob: float = 0.5
+    # Native libjpeg batch decode for imagenet_tar (native/jpegdec.cpp):
+    # decode + crop-resize + normalize in C++ threads instead of per-item
+    # PIL. Falls back silently when the lib can't build, shards hold PNGs,
+    # or RandAugment is on (PIL-op chain). Same crop policy, plain-bilinear
+    # resampling (PIL filters on downscale — statistically equivalent).
+    native_decode: bool = False
     # Host-side RandAugment (data/augment.py; ImageFolder train path).
     # num_ops 0 disables; magnitude in [0, 30] (torchvision's 31 bins).
     randaugment_num_ops: int = 0
@@ -327,6 +333,12 @@ class ObsConfig:
     # tpurun-supervised job crashes exactly once and must recover through
     # checkpoint resume. 0 → off. Test hook; no effect on saved state.
     fault_inject_at_step: int = 0
+    # Stall injection (SURVEY §5.3a): WEDGE this process (sleep forever,
+    # heartbeat never beats) when the step counter reaches this value —
+    # generation 0 only, like fault_inject_at_step. Exercises the full
+    # stalled-step chain: heartbeat fires → flight-recorder dump → abort
+    # (exit 134) → gang restart → checkpoint resume. 0 → off. Test hook.
+    stall_inject_at_step: int = 0
     # Log device memory (HBM bytes_in_use / peak) with train metrics.
     # No-op on backends that don't report memory_stats (CPU).
     log_memory: bool = False

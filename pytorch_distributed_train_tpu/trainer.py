@@ -252,6 +252,7 @@ class Trainer:
                     # including loss-scale skip steps).
                     step += 1
                     self._maybe_inject_fault(step)
+                    self._maybe_inject_stall(step)
                     self.meter.tick()
                     self.heartbeat.beat()
                     self.recorder.record("step", step)
@@ -335,6 +336,20 @@ class Trainer:
                 and os.environ.get("RESTART_GENERATION", "0") == "0"):
             print(f"[fault-inject] killing process at step {step}", flush=True)
             os._exit(41)
+
+    def _maybe_inject_stall(self, step: int) -> None:
+        """SURVEY §5.3a: wedge (don't crash) this step, first generation
+        only — BEFORE the heartbeat beat, so the monitor sees a step that
+        never completes (a hung host / wedged link, not a dead process) and
+        must drive the dump→abort→gang-restart→resume chain itself."""
+        import os
+
+        stall = self.cfg.obs.stall_inject_at_step
+        if (stall and step >= stall
+                and os.environ.get("RESTART_GENERATION", "0") == "0"):
+            print(f"[stall-inject] wedging at step {step}", flush=True)
+            while True:  # only the heartbeat abort ends this
+                time.sleep(60)
 
     def import_params(self, path: str) -> None:
         """Warm-start params from a (torch-layout) safetensors file

@@ -145,6 +145,87 @@ def test_multiprocess_jax_distributed_bringup(tmp_path):
     assert (tmp_path / "dist-ok-1").exists()
 
 
+STALL_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorch_distributed_train_tpu.config import TrainConfig
+from pytorch_distributed_train_tpu.trainer import Trainer
+
+cfg = TrainConfig()
+cfg.model.name = "resnet18"; cfg.model.num_classes = 10
+cfg.model.image_size = 8
+cfg.data.dataset = "synthetic_images"; cfg.data.synthetic_size = 256
+cfg.data.batch_size = 32; cfg.data.num_workers = 1; cfg.data.prefetch = 2
+cfg.optim.name = "momentum"; cfg.optim.learning_rate = 0.05
+cfg.optim.schedule = "constant"; cfg.optim.warmup_steps = 0
+cfg.total_steps = 8
+cfg.checkpoint.dir = {ckpt!r}
+cfg.checkpoint.save_every_steps = 2
+cfg.checkpoint.async_save = False
+cfg.obs.log_every_steps = 1
+cfg.obs.jsonl_path = {metrics!r}
+# Timeout must exceed first-step compile (the beat only lands at step end);
+# the shared compile cache makes generation 1's compile a cache hit, so
+# only generation 0 pays it. Production uses minutes here for the same
+# reason.
+cfg.obs.heartbeat_timeout_s = 30.0
+cfg.obs.stall_inject_at_step = 5
+cfg.obs.compile_cache_dir = {cache!r}
+t = Trainer(cfg)
+t.fit()
+t.close()
+"""
+
+
+@pytest.mark.slow
+def test_stalled_step_dump_abort_restart_resume(tmp_path, capfd):
+    """The full stalled-step chain (SURVEY §5.3a, VERDICT r1 item 9): a
+    worker WEDGES (not crashes) at step 5 → the heartbeat monitor fires →
+    the flight-recorder ring is dumped (stderr + file) → the process
+    aborts (exit 134) → the elastic agent gang-restarts → generation 1
+    resumes from the step-4 checkpoint and completes all 8 steps. All four
+    artifacts are asserted."""
+    from pytorch_distributed_train_tpu.elastic import (
+        ElasticAgent,
+        LaunchConfig,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    metrics = str(tmp_path / "metrics.jsonl")
+    script = tmp_path / "worker.py"
+    script.write_text(STALL_WORKER.format(repo=REPO, ckpt=ckpt,
+                                          metrics=metrics,
+                                          cache=str(tmp_path / "xla-cache")))
+    cfg = LaunchConfig(nprocs=1, max_restarts=2, monitor_interval_s=0.2,
+                       env=CPU_ENV)
+    rc = ElasticAgent(cfg, [sys.executable, str(script)]).run()
+    out, err = capfd.readouterr()
+    assert rc == 0, (rc, err[-800:])
+
+    # 1. the heartbeat fired on the wedged step (worker stderr)
+    assert "[heartbeat] no step completed" in err, err[-800:]
+    assert "[stall-inject] wedging at step 5" in out
+    # 2. the flight-recorder dump was written — to stderr and to the
+    #    dump file in the checkpoint dir (dump_dir wiring)
+    assert "flight recorder" in err.lower()
+    dumps = [f for f in os.listdir(ckpt) if f.startswith("flight_")]
+    assert dumps, os.listdir(ckpt)
+    with open(os.path.join(ckpt, dumps[0])) as f:
+        dump_text = f.read()
+    # The ring shows the last COMPLETED step (4) — step 5 wedged before its
+    # step-end event, which is precisely the diagnostic a stalled job needs.
+    assert "step step=4" in dump_text, dump_text
+    assert "step step=5" not in dump_text, dump_text
+    # 3. the agent observed the abort and gang-restarted (generation 1)
+    assert "gen 1" in out, out[-800:]
+    # 4. generation 1 resumed from the checkpoint and completed
+    assert "[resume] restored step 4" in out, out[-1500:]
+    got = _read_metrics(metrics)
+    assert max(got) == 8, sorted(got)
+
+
 PREEMPT_WORKER = """
 import os, sys, time
 sys.path.insert(0, {repo!r})
