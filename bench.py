@@ -181,6 +181,107 @@ def pipeline_bench(args) -> None:
     }))
 
 
+def pipeline_decode_bench(args) -> None:
+    """JPEG-decode input pipeline throughput (SURVEY §7.4.1 — the part
+    `--model pipeline` deliberately excludes): synthetic photo-like JPEGs
+    in a WebDataset tar shard → TarShardImageDataset → the configured
+    loader, full decode + RandomResizedCrop + flip + normalize per image.
+    ``--decoder native`` routes through native/jpegdec.cpp (libjpeg batch
+    decode in C++ threads); ``pil`` is the per-item PIL path. The metric
+    name records decoder AND loader actually used. Never touches a device
+    and never seeds a baseline key (host-load-dependent, like the collate
+    bench)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the TPU here
+    _bringup_done[0] = True  # host-only mode
+    import io
+    import shutil
+    import tarfile
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    from pytorch_distributed_train_tpu.config import DataConfig
+    from pytorch_distributed_train_tpu.data.datasets import (
+        TarShardImageDataset,
+    )
+
+    n = 2048
+    batch = args.batch_per_chip or 256
+    if batch * 2 > n:
+        raise SystemExit(
+            f"--batch-per-chip {batch} too large for the {n}-sample "
+            "synthetic shard (need >= 2 batches: 1 warmup + 1 timed)")
+    tmp = tempfile.mkdtemp(prefix="bench-decode-")
+    try:
+        rng = np.random.default_rng(0)
+        shard = os.path.join(tmp, "bench-000000.tar")
+        with tarfile.open(shard, "w") as tf:
+            for i in range(n):
+                # Photo-like statistics: low-res noise upsampled smooth —
+                # JPEG entropy (and decode cost) close to real photos,
+                # unlike raw noise (pathological worst case).
+                W = int(rng.integers(256, 513))
+                H = int(rng.integers(256, 513))
+                base = rng.integers(0, 256, (H // 8, W // 8, 3), np.uint8)
+                im = Image.fromarray(base).resize((W, H), Image.BILINEAR)
+                buf = io.BytesIO()
+                im.save(buf, "JPEG", quality=85)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"{i:06d}.jpg")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+                cls = str(int(rng.integers(0, 1000))).encode()
+                info = tarfile.TarInfo(f"{i:06d}.cls")
+                info.size = len(cls)
+                tf.addfile(info, io.BytesIO(cls))
+                _touch()
+        workers = args.workers or (os.cpu_count() or 1)
+        ds = TarShardImageDataset(shard, args.image_size, train=True,
+                                  native_decode=args.decoder == "native",
+                                  decode_threads=workers)
+        decoder = "native" if ds.native_decode else "pil"
+        if args.decoder == "native" and decoder != "native":
+            raise SystemExit("--decoder native requested but the jpegdec "
+                             "library is unavailable")
+        cfg = DataConfig(batch_size=batch, loader=args.loader,
+                         num_workers=workers)
+        if args.loader == "grain":
+            from pytorch_distributed_train_tpu.data.grain_pipeline import (
+                GrainHostDataLoader,
+            )
+
+            loader = GrainHostDataLoader(ds, cfg, train=True)
+        else:
+            from pytorch_distributed_train_tpu.data.pipeline import (
+                HostDataLoader,
+            )
+
+            loader = HostDataLoader(ds, cfg, train=True, num_hosts=1,
+                                    host_id=0)
+        it = loader.epoch(0)
+        next(it)  # warm caches / spin up workers
+        _touch()
+        t0 = time.perf_counter()
+        seen = 0
+        for b in it:
+            seen += len(b["label"])
+            _touch()
+        wall = time.perf_counter() - t0
+        close = getattr(loader, "close", None)
+        if close is not None:
+            close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "metric": f"input_pipeline_decode_{decoder}_{args.loader}"
+                  "_images_per_sec",
+        "value": round(seen / wall, 2),
+        "unit": "images/sec (host)",
+        "vs_baseline": 1.0,
+    }))
+
+
 def decode_bench(args) -> None:
     """KV-cache decode throughput (tokens/sec/chip) on the ~1B llama —
     the serving-side counterpart of the training bench. Prefills once
@@ -303,6 +404,17 @@ def main() -> None:
     p.add_argument("--tiny", action="store_true",
                    help="decode bench: toy model sizes for CI smoke on CPU "
                         "(never comparable to real numbers)")
+    p.add_argument("--pipeline-decode", action="store_true",
+                   help="with --model pipeline: measure the JPEG-DECODE "
+                        "pipeline (synthetic tar shard) instead of the "
+                        "pre-decoded collate path")
+    p.add_argument("--decoder", default="pil", choices=["pil", "native"],
+                   help="decode bench: per-item PIL vs native libjpeg "
+                        "batch decode (native/jpegdec.cpp)")
+    p.add_argument("--loader", default="threads", choices=["threads", "grain"],
+                   help="decode bench: host loader backend (SURVEY C17)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="decode bench: loader workers (0 → cpu count)")
     p.add_argument("--stem", default="conv", choices=["conv", "space_to_depth"],
                    help="resnet ImageNet stem: space_to_depth is the exact "
                         "MXU-friendly 4x4/s1 rewrite (models/resnet.py)")
@@ -324,6 +436,8 @@ def main() -> None:
         _arm_watchdog(timeout_s)
 
     if args.model == "pipeline":
+        if args.pipeline_decode:
+            return pipeline_decode_bench(args)
         return pipeline_bench(args)
     if args.decode_tokens:
         return decode_bench(args)

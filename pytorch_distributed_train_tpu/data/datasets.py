@@ -333,7 +333,8 @@ class TarShardImageDataset(ImageFolderDataset):
     thread loader and Grain worker processes."""
 
     def __init__(self, pattern: str, image_size: int, train: bool,
-                 randaugment=None):
+                 randaugment=None, native_decode: bool = False,
+                 decode_threads: int = 0):
         import glob as glob_mod
         import tarfile
 
@@ -346,6 +347,7 @@ class TarShardImageDataset(ImageFolderDataset):
                 f"data.data_dir matched no .tar shards: {pattern!r}")
         # samples: (shard_idx, jpg_offset, jpg_size, label)
         self.samples = []  # type: ignore[assignment]
+        has_non_jpeg = False
         for si, shard in enumerate(self.shards):
             pairs: dict[str, dict] = {}
             # mode "r:" = uncompressed only — autodetected gzip shards
@@ -361,6 +363,7 @@ class TarShardImageDataset(ImageFolderDataset):
                     entry = pairs.setdefault(key, {})
                     if ext in ("jpg", "jpeg", "png"):
                         entry["img"] = (m.offset_data, m.size)
+                        has_non_jpeg |= ext == "png"
                     elif ext == "cls":
                         f = tf.extractfile(m)
                         entry["label"] = int(f.read().strip())  # type: ignore[union-attr]
@@ -372,6 +375,21 @@ class TarShardImageDataset(ImageFolderDataset):
         if not self.samples:
             raise ValueError(
                 f"tar shards {self.shards} contain no (img, cls) pairs")
+        # Native decode path (SURVEY §7.4.1): libjpeg batch decode + crop
+        # resize + normalize in C++ threads instead of per-item PIL. Only
+        # when every image is JPEG, RandAugment is off (PIL-op chain), and
+        # the library builds — silently fall back otherwise: the knob is a
+        # throughput choice, not a semantics one.
+        self.native_decode = False
+        self.decode_threads = decode_threads  # 0 → jpegdec.default_threads
+        self._decode_failures = 0
+        self._failure_warnings = 0
+        if native_decode and not has_non_jpeg and self.randaugment is None:
+            from pytorch_distributed_train_tpu.native import jpegdec
+
+            self.native_decode = jpegdec.available()
+        if self.native_decode:
+            self.is_item_style = False  # loader calls get_batch instead
         import threading
 
         self._local = threading.local()
@@ -415,11 +433,65 @@ class TarShardImageDataset(ImageFolderDataset):
         fh.seek(off)
         return Image.open(io.BytesIO(fh.read(size))), label
 
+    def get_batch(self, idx, rng: np.random.Generator, train: bool) -> dict:
+        """Native decode path: raw bytes out of the shard (Python, cheap) →
+        one jpegdec call (C++ threads, no GIL) doing decode + crop-box
+        bilinear resize + flip + normalize. Boxes come from the SAME
+        _rrc_box/_center_box policy the PIL path uses; only the resampler
+        differs (plain bilinear vs PIL's filtered resize — documented in
+        native/jpegdec.cpp). Corrupt members decode to zeros rather than
+        poisoning the epoch."""
+        from pytorch_distributed_train_tpu.native import jpegdec
 
-def _random_resized_crop(im, size: int, rng: np.random.Generator):
-    from PIL import Image
+        blobs: list[bytes] = []
+        labels = np.empty(len(idx), np.int32)
+        for n, i in enumerate(idx):
+            si, off, size, label = self.samples[int(i)]
+            fh = self._handle(si)
+            fh.seek(off)
+            blobs.append(fh.read(size))
+            labels[n] = label
+        dims = jpegdec.dims(blobs)
+        B = len(blobs)
+        boxes = np.empty((B, 4), np.float32)
+        flips = np.zeros(B, bool)
+        for n in range(B):
+            W, H = int(dims[n, 0]), int(dims[n, 1])
+            if W == 0 or H == 0:
+                boxes[n] = (0.0, 0.0, 1.0, 1.0)  # corrupt: zeroed below
+                continue
+            if train:
+                box = _rrc_box(W, H, rng)
+                boxes[n] = box if box is not None else _center_box(W, H)
+                flips[n] = rng.random() < 0.5
+            else:
+                boxes[n] = _center_box(W, H)
+        images, fails = jpegdec.decode_batch(
+            blobs, boxes, flips, self.image_size,
+            IMAGENET_MEAN, IMAGENET_STD, nthreads=self.decode_threads)
+        if fails:
+            # Zero-filled images keep real labels — survivable (one bad
+            # sample must not kill an epoch) but must be LOUD: systematic
+            # corruption silently degrading accuracy is the failure mode.
+            self._decode_failures += fails
+            if self._failure_warnings < 5:
+                self._failure_warnings += 1
+                import sys
 
-    W, H = im.size
+                print(
+                    f"[jpegdec] {fails} corrupt image(s) in batch "
+                    f"(total {self._decode_failures} this dataset) — "
+                    "zero-filled"
+                    + ("; suppressing further warnings"
+                       if self._failure_warnings == 5 else ""),
+                    file=sys.stderr, flush=True)
+        return {"image": images, "label": labels}
+
+
+def _rrc_box(W: int, H: int, rng: np.random.Generator):
+    """RandomResizedCrop box (x0, y0, w, h) in source coords, or None after
+    10 failed attempts (caller falls back to center crop). Pure function of
+    (dims, rng) so the PIL and native-decode paths draw identical boxes."""
     area = W * H
     for _ in range(10):
         target = area * rng.uniform(0.08, 1.0)
@@ -429,8 +501,28 @@ def _random_resized_crop(im, size: int, rng: np.random.Generator):
         if 0 < w <= W and 0 < h <= H:
             x0 = int(rng.integers(0, W - w + 1))
             y0 = int(rng.integers(0, H - h + 1))
-            return im.resize((size, size), Image.BILINEAR, box=(x0, y0, x0 + w, y0 + h))
-    return _center_crop(im, size)
+            return (x0, y0, w, h)
+    return None
+
+
+def _center_box(W: int, H: int):
+    """Center-crop box equivalent of _center_crop's resize-then-crop: a
+    centered square of side min(W,H)·224/256, resized to target by the
+    caller. (Sub-pixel rounding differs from the PIL path's two-step
+    resize; visually and statistically identical.)"""
+    side = min(W, H) * 224.0 / 256.0
+    return ((W - side) / 2.0, (H - side) / 2.0, side, side)
+
+
+def _random_resized_crop(im, size: int, rng: np.random.Generator):
+    from PIL import Image
+
+    W, H = im.size
+    box = _rrc_box(W, H, rng)
+    if box is None:
+        return _center_crop(im, size)
+    x0, y0, w, h = box
+    return im.resize((size, size), Image.BILINEAR, box=(x0, y0, x0 + w, y0 + h))
 
 
 def _center_crop(im, size: int):
@@ -483,7 +575,9 @@ def build_dataset(data_cfg, model_cfg, train: bool):
             "{split}", "train" if train else "val")
         return TarShardImageDataset(
             pattern, model_cfg.image_size, train,
-            randaugment=_build_randaugment(data_cfg, train))
+            randaugment=_build_randaugment(data_cfg, train),
+            native_decode=data_cfg.native_decode,
+            decode_threads=data_cfg.num_workers)
     if name == "synthetic_lm":
         return synthetic_lm(
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,

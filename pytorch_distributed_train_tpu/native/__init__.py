@@ -40,11 +40,14 @@ def _native_dir() -> str:
     )
 
 
-def build_library(name: str, extra_flags: tuple[str, ...] = ()) -> str:
+def build_library(name: str, extra_flags: tuple[str, ...] = (),
+                  extra_libs: tuple[str, ...] = ()) -> str:
     """Compile ``native/<name>.cpp`` → ``native/lib<name>.so`` if stale.
 
     Returns the .so path. Thread-safe; rebuilds only when the source is
-    newer than the library (the make rule, inlined).
+    newer than the library (the make rule, inlined). ``extra_libs``
+    (-l flags) go AFTER the source — ahead of it the linker discards them
+    and the .so loads with undefined symbols.
     """
     src = os.path.join(_native_dir(), f"{name}.cpp")
     out = os.path.join(_native_dir(), f"lib{name}.so")
@@ -54,7 +57,7 @@ def build_library(name: str, extra_flags: tuple[str, ...] = ()) -> str:
             return out
         tmp = f"{out}.{os.getpid()}.tmp"  # per-pid: os.replace stays atomic
         cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-               *extra_flags, src, "-o", tmp]
+               *extra_flags, src, *extra_libs, "-o", tmp]
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
         except subprocess.CalledProcessError as e:
