@@ -288,6 +288,17 @@ class MeshConfig:
     #   ring    — lax.ppermute KV rotation around the ICI ring; any size
     #   ulysses — all-to-all head↔seq swap; needs heads % context == 0
     context_impl: str = "ring"
+    # Ring sequence layout: "zigzag" gives each device chunks (i, 2n−1−i)
+    # so causal-triangle work balances across the ring
+    # (ops/ring_attention.py::zigzag_perm). Exact at any size; costs one
+    # gather each way per attention call. The ~2× causal saving is
+    # realized by the pallas chunk backend's block skipping, which needs
+    # the half-chunk to cover ≥1 KV block: S_local/2 ≥ block_k (i.e.
+    # seq/ring ≥ 2048 at the default 1024-wide blocks) — exactly the
+    # long-context regime CP exists for. Below that (or on the einsum
+    # backend) zigzag is correct but pays the gathers for no win.
+    # Ignored by ulysses / non-causal attention.
+    context_layout: str = "contiguous"
     # Megatron-style sequence parallelism (SURVEY §2.3 SP row): with
     # tensor>1, shard activations along sequence over the 'tensor' axis
     # between TP matmuls (norms/residuals run seq-sharded; GSPMD inserts

@@ -73,6 +73,7 @@ def build_model(model_cfg, precision_cfg, mesh=None, mesh_cfg=None):
         cp = ContextParallelConfig(
             mesh=mesh,
             impl=mesh_cfg.context_impl,
+            layout=mesh_cfg.context_layout,
             batch_axes=tuple(mesh_cfg.batch_axes),
         )
     if name == "llama_pp":

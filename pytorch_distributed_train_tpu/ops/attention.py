@@ -75,6 +75,10 @@ class ContextParallelConfig:
 
     mesh: jax.sharding.Mesh
     impl: str = "ring"  # ring | ulysses
+    # Ring sequence layout: "zigzag" pairs chunk i with 2n−1−i per device
+    # so causal work balances across the ring (ring_attention.zigzag_perm);
+    # ignored by ulysses and by non-causal calls.
+    layout: str = "contiguous"  # contiguous | zigzag
     context_axis: str = "context"
     batch_axes: tuple[str, ...] = ("data", "fsdp")
     tensor_axis: str | None = "tensor"
@@ -155,7 +159,7 @@ def dot_product_attention(
 
             return ring_attention(
                 q, k, v, mesh=cp.mesh, causal=causal, window=window,
-                impl=impl, context_axis=cp.context_axis,
+                impl=impl, layout=cp.layout, context_axis=cp.context_axis,
                 batch_axes=cp.batch_axes, tensor_axis=cp.tensor_axis,
             )
         if cp.impl == "ulysses":

@@ -213,6 +213,37 @@ def _resolve_chunk_impl(q, k, n_ring, impl: str):
     return "einsum", False
 
 
+def zigzag_perm(S: int, n: int) -> "np.ndarray":
+    """Permutation laying the sequence out zigzag over an n-device ring:
+    split into 2n chunks; device i holds chunks (i, 2n-1-i).
+
+    The causal load balancer (SURVEY §5.7; the torch CP module's
+    `_load_balancer.py` answers the same problem): under a contiguous
+    layout device 0's rows finish after one hop while device n-1 computes
+    on every hop, so every ring step runs at the slowest device's pace and
+    causality saves nothing. Pairing chunk i with chunk 2n-1-i gives every
+    device the same causal-triangle area per hop; with the Pallas chunk
+    backend the out-of-triangle BLOCKS inside each hop are skipped on the
+    position predicate, realizing the ~2× causal saving. Returns the
+    new→old index array; invert with argsort."""
+    import numpy as np
+
+    h = S // (2 * n)
+    order = []
+    for i in range(n):
+        order.extend(range(i * h, (i + 1) * h))
+        order.extend(range((2 * n - 1 - i) * h, (2 * n - i) * h))
+    return np.asarray(order, np.int32)
+
+
+def _zigzag_pos(idx, Sq: int, n: int):
+    """Device idx's global positions under the zigzag layout (traced)."""
+    h = Sq // 2
+    lo = idx * h
+    hi = (2 * n - 1 - idx) * h
+    return jnp.concatenate([lo + jnp.arange(h), hi + jnp.arange(h)])
+
+
 def ring_attention(
     q: jax.Array,  # (B, S, H, D) GLOBAL arrays
     k: jax.Array,
@@ -222,6 +253,7 @@ def ring_attention(
     causal: bool = False,
     window: int = 0,
     impl: str = "auto",  # auto | xla | pallas | chunked (chunk backend)
+    layout: str = "contiguous",  # contiguous | zigzag (causal balance)
     context_axis: str = "context",
     batch_axes: Sequence[str] = ("data", "fsdp"),
     tensor_axis: str | None = "tensor",
@@ -232,12 +264,24 @@ def ring_attention(
     on ``tensor_axis`` — composing CP×DP×TP in one manual region embedded in
     the surrounding GSPMD program. ``impl`` selects the per-hop chunk
     backend (see _resolve_chunk_impl); ``window`` applies the sliding band
-    across the ring (out-of-band hops are skipped).
+    across the ring (out-of-band hops are skipped). ``layout='zigzag'``
+    (causal only) permutes the sequence so each device holds chunks
+    (i, 2n−1−i) — equal causal work per hop (see zigzag_perm); attention is
+    permutation-equivariant over keys and position-masked explicitly, so
+    the result is exact. Costs one gather in + one gather out per call
+    (GSPMD lowers them onto the context axis) — wins when S² compute
+    dwarfs S·D movement, i.e. exactly the long-context regime CP targets.
     """
     from pytorch_distributed_train_tpu.ops.cp_common import qkv_spec
 
     n = mesh.shape[context_axis]
-    if q.shape[1] % n != 0 or k.shape[1] % n != 0:
+    S = q.shape[1]
+    use_zigzag = (layout == "zigzag" and causal and n > 1
+                  and S % (2 * n) == 0 and S == k.shape[1])
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"ring layout must be contiguous|zigzag, "
+                         f"got {layout!r}")
+    if S % n != 0 or k.shape[1] % n != 0:
         # Sequence can't shard over the ring (e.g. a probe batch at init
         # time) — run the plain core instead.
         from pytorch_distributed_train_tpu.ops import attention as attention_lib
@@ -247,6 +291,25 @@ def ring_attention(
     chunk_impl, interpret = _resolve_chunk_impl(q, k, n, impl)
     spec = qkv_spec(q, k, mesh, context_axis=context_axis,
                     batch_axes=batch_axes, tensor_axis=tensor_axis)
+
+    if use_zigzag:
+        import numpy as np
+
+        p = zigzag_perm(S, n)
+        perm, inv = jnp.asarray(p), jnp.asarray(np.argsort(p))
+        q, k, v = (jnp.take(x, perm, axis=1) for x in (q, k, v))
+
+        def fn(a, b, c):
+            idx = jax.lax.axis_index(context_axis)
+            pos = _zigzag_pos(idx, a.shape[1], n)
+            return ring_attention_local(
+                a, b, c, axis_name=context_axis, axis_size=n,
+                causal=causal, window=window, q_pos=pos, kv_pos=pos,
+                chunk_impl=chunk_impl, interpret=interpret)
+
+        o = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False)(q, k, v)
+        return jnp.take(o, inv, axis=1)
 
     fn = functools.partial(
         ring_attention_local, axis_name=context_axis, axis_size=n,

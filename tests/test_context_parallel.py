@@ -185,6 +185,64 @@ def test_ring_pallas_gqa(cp_mesh):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ring_zigzag_matches_full_attention(cp_mesh, impl):
+    """Causal load-balanced layout (SURVEY §5.7; torch _load_balancer.py):
+    the zigzag permutation must be EXACT — attention is permutation-
+    equivariant and the masks are position-based."""
+    q, k, v = _qkv_flash()
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    out = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, mesh=cp_mesh, causal=True,
+                                       layout="zigzag", impl=impl)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_zigzag_windowed_and_grads(cp_mesh):
+    q, k, v = _qkv_flash(B=1)
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla", window=100)
+    out = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, mesh=cp_mesh, causal=True,
+                                       window=100, layout="zigzag",
+                                       impl="pallas")
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    g_z = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(jnp.square(ring_attention(
+            a, b, c, mesh=cp_mesh, causal=True, layout="zigzag",
+            impl="pallas"))),
+        argnums=(0, 1, 2),
+    ))(q, k, v)
+    g_ref = jax.grad(
+        lambda a, b, c: jnp.sum(jnp.square(dot_product_attention(
+            a, b, c, causal=True, impl="xla"))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g1, g2, name in zip(g_z, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=5e-4, rtol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_zigzag_perm_properties():
+    """zigzag_perm is a permutation pairing chunk i with 2n−1−i, and
+    non-causal / indivisible calls ignore the layout knob."""
+    from pytorch_distributed_train_tpu.ops.ring_attention import zigzag_perm
+
+    S, n = 64, 4
+    p = zigzag_perm(S, n)
+    assert sorted(p.tolist()) == list(range(S))
+    h = S // (2 * n)
+    for i in range(n):
+        dev = p[i * 2 * h:(i + 1) * 2 * h]
+        assert dev[0] == i * h  # low chunk start
+        assert dev[h] == (2 * n - 1 - i) * h  # paired high chunk start
+
+
 def test_ring_gradients_match(cp_mesh):
     """Backward ring (autodiff-transposed ppermutes) vs full-attention grads."""
     q, k, v = _qkv(B=2, S=128, H=4, D=16)
@@ -206,8 +264,10 @@ def test_ring_gradients_match(cp_mesh):
                                    atol=5e-5, rtol=5e-5)
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
-def test_llama_train_step_cp_matches_dp(impl):
+@pytest.mark.parametrize("impl,layout", [("ring", "contiguous"),
+                                         ("ring", "zigzag"),
+                                         ("ulysses", "contiguous")])
+def test_llama_train_step_cp_matches_dp(impl, layout):
     """End-to-end: one train step of a tiny Llama under CP == without CP."""
     from pytorch_distributed_train_tpu import steps as steps_lib
     from pytorch_distributed_train_tpu.config import (
@@ -257,7 +317,8 @@ def test_llama_train_step_cp_matches_dp(impl):
 
     loss_dp, leaf_dp = run(MeshConfig(data=8, fsdp=1, tensor=1, context=1))
     loss_cp, leaf_cp = run(
-        MeshConfig(data=2, fsdp=1, tensor=1, context=4, context_impl=impl)
+        MeshConfig(data=2, fsdp=1, tensor=1, context=4, context_impl=impl,
+                   context_layout=layout)
     )
     assert abs(loss_dp - loss_cp) < 1e-4, (loss_dp, loss_cp)
     np.testing.assert_allclose(leaf_cp, leaf_dp, atol=1e-4, rtol=1e-4)
