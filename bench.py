@@ -401,6 +401,9 @@ def main() -> None:
                         "per sequence (timed after a warmup generation)")
     p.add_argument("--quantize", default="", choices=["", "int8"],
                    help="decode bench: weight-only int8 params (quant.py)")
+    p.add_argument("--quant-training", default="", choices=["", "int8"],
+                   help="llama training bench: AQT-style int8 QAT matmuls "
+                        "(quant.int8_dot_general — int8 MXU path)")
     p.add_argument("--tiny", action="store_true",
                    help="decode bench: toy model sizes for CI smoke on CPU "
                         "(never comparable to real numbers)")
@@ -435,6 +438,11 @@ def main() -> None:
     if timeout_s > 0:
         _arm_watchdog(timeout_s)
 
+    if args.quant_training and (args.model != "llama" or args.decode_tokens):
+        # Same convention as the Trainer guard: a silently-ignored knob
+        # records fp numbers as an int8 measurement.
+        raise SystemExit("--quant-training supports llama TRAINING only "
+                         "(decode-side int8 is --quantize)")
     if args.model == "pipeline":
         if args.pipeline_decode:
             return pipeline_decode_bench(args)
@@ -481,6 +489,7 @@ def main() -> None:
             remat_policy=args.remat_policy,
             attention_impl=args.attention_impl,
             fused_lm_loss=args.fused_head,
+            quant_training=args.quant_training,
         )
         loss_name = "fused_causal_lm_xent" if args.fused_head else "causal_lm_xent"
         opt = OptimConfig(name="adamw", learning_rate=3e-4,
@@ -610,7 +619,7 @@ def main() -> None:
         # they must not share a baseline key with the dense-head config.
         canonical = (args.batch_per_chip in (0, 8) and args.seq_len == 2048
                      and args.attention_impl == "auto"
-                     and not args.fused_head
+                     and not args.fused_head and not args.quant_training
                      and args.remat_policy == "full" and default_opt)
     else:  # bert_base
         canonical = (args.batch_per_chip in (0, 32) and args.seq_len >= 512

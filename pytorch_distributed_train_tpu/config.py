@@ -113,6 +113,13 @@ class ModelConfig:
     # "I understand the Zhou et al. caveat" opt-in.
     moe_router_allow_noncausal: bool = False
     moe_zloss_weight: float = 1e-3
+    # AQT-style int8 quantized TRAINING ("" | "int8"; llama family):
+    # attention + MLP matmuls run int8×int8→int32 on the MXU (2× bf16
+    # MACs/cycle on v5e) with dynamic symmetric absmax scales and a
+    # straight-through backward — quant.int8_dot_general. lm_head and MoE
+    # experts stay in the compute dtype. Decode-side weight-only int8 is
+    # separate (generate/bench --quantize int8).
+    quant_training: str = ""
 
 
 @dataclass

@@ -58,6 +58,17 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float,
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
+def _quant_dot_general(quant: str):
+    """dot_general override for the quant_training knob (None = default)."""
+    if not quant:
+        return None
+    if quant == "int8":
+        from pytorch_distributed_train_tpu.quant import int8_dot_general
+
+        return int8_dot_general
+    raise ValueError(f"quant_training must be ''|'int8', got {quant!r}")
+
+
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
     """x: (B, S, H, D). Rotates pairs (x[..., :D/2], x[..., D/2:]) — the
     'split-half' convention (matches HF Llama, so checkpoints interop)."""
@@ -79,6 +90,7 @@ class LlamaAttention(nn.Module):
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
     window: int = 0  # sliding-window attention (0 = full causal)
+    quant: str = ""  # "" | "int8" — AQT QAT matmuls (quant.int8_dot_general)
     # Autoregressive decode: maintain a (B, max_seq_len, H_kv, D) KV cache in
     # the flax 'cache' collection (the idiomatic flax decode pattern — torch
     # analogue: HF past_key_values). Works for both the prefill call (S>1 at
@@ -89,9 +101,10 @@ class LlamaAttention(nn.Module):
     def __call__(self, x):
         B, S, C = x.shape
         head_dim = C // self.num_heads
+        dg = _quant_dot_general(self.quant)
         proj = lambda heads, name: nn.DenseGeneral(  # noqa: E731
             (heads, head_dim), axis=-1, use_bias=False, dtype=self.dtype,
-            param_dtype=self.param_dtype,
+            param_dtype=self.param_dtype, dot_general=dg,
             kernel_init=nn.initializers.normal(0.02), name=name,
         )
         q = proj(self.num_heads, "q_proj")(x)
@@ -159,7 +172,7 @@ class LlamaAttention(nn.Module):
                                       window=self.window)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
-            param_dtype=self.param_dtype,
+            param_dtype=self.param_dtype, dot_general=dg,
             kernel_init=nn.initializers.normal(0.02), name="o_proj",
         )(y)
         return y
@@ -169,11 +182,13 @@ class LlamaMLP(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    quant: str = ""  # "" | "int8" (MoE experts always pass "" — fp experts)
 
     @nn.compact
     def __call__(self, x):
         dense = lambda dim, name: nn.Dense(  # noqa: E731
             dim, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+            dot_general=_quant_dot_general(self.quant),
             kernel_init=nn.initializers.normal(0.02), name=name,
         )
         gate = nn.silu(dense(self.mlp_dim, "gate_proj")(x))
@@ -195,6 +210,7 @@ class LlamaBlock(nn.Module):
     moe: "MoeSpec | None" = None  # set → MoE FFN instead of dense (ops/moe.py)
     attn_impl: str = "auto"
     window: int = 0
+    quant: str = ""
     decode: bool = False
 
     @nn.compact
@@ -204,7 +220,8 @@ class LlamaBlock(nn.Module):
             self.num_heads, self.num_kv_heads, self.rope_theta,
             self.rope_scaling, self.max_seq_len, self.dtype,
             self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
-            window=self.window, decode=self.decode, name="attn",
+            window=self.window, quant=self.quant, decode=self.decode,
+            name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         if self.moe is not None:
@@ -214,7 +231,7 @@ class LlamaBlock(nn.Module):
                          self.param_dtype, name="moe_mlp")
         else:
             mlp = LlamaMLP(self.mlp_dim, self.dtype, self.param_dtype,
-                           name="mlp")
+                           quant=self.quant, name="mlp")
         x = x + mlp(h)
         return x
 
@@ -241,6 +258,11 @@ class LlamaForCausalLM(nn.Module):
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None
     attn_impl: str = "auto"
+    # AQT-style int8 QAT ("" | "int8"): attention + MLP matmuls run
+    # int8xint8->int32 on the MXU with dynamic absmax scales and a
+    # straight-through backward (quant.int8_dot_general). The lm_head and
+    # MoE experts stay in the compute dtype.
+    quant_training: str = ""
     # Sliding-window attention span (Mistral recipe; 0 = full causal).
     attention_window: int = 0
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
@@ -277,7 +299,7 @@ class LlamaForCausalLM(nn.Module):
                 self.rms_norm_eps, self.dtype, self.param_dtype,
                 cp=self.cp, moe=moe,
                 attn_impl=self.attn_impl, window=self.attention_window,
-                decode=self.decode,
+                quant=self.quant_training, decode=self.decode,
                 name=f"layer{i}",
             )(x)
             if self.act is not None:
@@ -330,6 +352,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         cp=cp,
         moe=moe,
         act=act,
+        quant_training=getattr(cfg, "quant_training", ""),
         attn_impl=getattr(cfg, "attention_impl", "auto"),
         attention_window=getattr(cfg, "attention_window", 0),
         fused_loss=getattr(cfg, "fused_lm_loss", False),

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for inference (decode) params.
+"""int8 quantization: weight-only PTQ for decode + AQT-style QAT training.
 
 Beyond the reference harness (its inference story is torch fp32/amp
 forward); the TPU rationale: decode is HBM-bound — every generated token
@@ -21,6 +21,7 @@ quantization there hurts disproportionately.
 
 from __future__ import annotations
 
+import functools
 import re
 
 import jax
@@ -107,3 +108,69 @@ def is_quantized(params) -> bool:
 def tree_param_bytes(params) -> int:
     return sum(x.size * x.dtype.itemsize
                for x in jax.tree_util.tree_leaves(params))
+
+
+# ===================================================== int8 TRAINING (QAT)
+#
+# AQT-style quantized training (beyond the reference; ROADMAP candidate):
+# the big matmuls run int8×int8→int32 on the MXU — 2× the bf16 MACs/cycle
+# on v5e — with dynamic symmetric absmax scales and a straight-through
+# backward. Forward:
+#   q(x) = clip(round(x / s_x)),  s_x = absmax over the CONTRACTION dims
+#          (per-token rows for activations, per-output-channel for weights)
+#   out  = dot_int32(q(x), q(w)) · s_x ⊗ s_w        (exact rescale)
+# Backward: gradients of the UNQUANTIZED dot at the original values (STE —
+# quantization treated as identity). Scales carry stop_gradient, matching
+# AQT's default. Injected into flax layers via their `dot_general` arg, so
+# model code doesn't change shape: see models/llama.py quant_training.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _int8_dot(lhs, rhs, dimension_numbers):
+    (lc, rc), (lb, rb) = dimension_numbers
+    assert not lb and not rb, "int8 dot: batch dims unsupported"
+    ql, sl = _dyn_quant(lhs, lc)
+    qr, sr = _dyn_quant(rhs, rc)
+    out32 = jax.lax.dot_general(ql, qr, dimension_numbers,
+                                preferred_element_type=jnp.int32)
+    sl_f = jnp.squeeze(sl, lc)  # (lhs free dims...)
+    sr_f = jnp.squeeze(sr, rc)  # (rhs free dims...)
+    out = out32.astype(jnp.float32)
+    out = out * sl_f.reshape(sl_f.shape + (1,) * sr_f.ndim) * sr_f
+    return out.astype(lhs.dtype)
+
+
+def _dyn_quant(x, contract_axes):
+    a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=contract_axes,
+                keepdims=True)
+    s = jax.lax.stop_gradient(jnp.where(a > 0, a / 127.0, 1.0))
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _int8_dot_fwd(lhs, rhs, dimension_numbers):
+    return _int8_dot(lhs, rhs, dimension_numbers), (lhs, rhs)
+
+
+def _int8_dot_bwd(dimension_numbers, res, g):
+    lhs, rhs = res
+
+    def fp_dot(a, b):
+        return jax.lax.dot_general(a, b, dimension_numbers,
+                                   preferred_element_type=g.dtype)
+
+    _, vjp = jax.vjp(fp_dot, lhs, rhs)
+    return vjp(g)
+
+
+_int8_dot.defvjp(_int8_dot_fwd, _int8_dot_bwd)
+
+
+def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                     preferred_element_type=None):
+    """Drop-in ``dot_general`` for flax Dense/DenseGeneral (their call
+    signature) running the AQT int8 forward + STE backward above.
+    ``precision``/``preferred_element_type`` are accepted for signature
+    compatibility; the int8 path fixes its own accumulation type."""
+    del precision, preferred_element_type
+    return _int8_dot(lhs, rhs, dimension_numbers)
