@@ -113,7 +113,7 @@ class ModelConfig:
     # "I understand the Zhou et al. caveat" opt-in.
     moe_router_allow_noncausal: bool = False
     moe_zloss_weight: float = 1e-3
-    # AQT-style int8 quantized TRAINING ("" | "int8"; llama family):
+    # AQT-style int8 quantized TRAINING ("" | "int8"; llama/llama_pp/gpt2):
     # attention + MLP matmuls run int8×int8→int32 on the MXU (2× bf16
     # MACs/cycle on v5e) with dynamic symmetric absmax scales and a
     # straight-through backward — quant.int8_dot_general. lm_head and MoE

@@ -33,15 +33,19 @@ class GPT2Attention(nn.Module):
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
     window: int = 0  # sliding-window attention (0 = full causal)
+    quant: str = ""  # "" | "int8" (quant.int8_dot_general QAT matmuls)
     decode: bool = False  # KV cache (same contract as llama.py decode)
 
     @nn.compact
     def __call__(self, x):
+        from pytorch_distributed_train_tpu.quant import quant_dot_general
+
         B, S, C = x.shape
         head_dim = C // self.num_heads
+        dg = quant_dot_general(self.quant)
         proj = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
-            param_dtype=self.param_dtype,
+            param_dtype=self.param_dtype, dot_general=dg,
             kernel_init=nn.initializers.normal(0.02), name=name,
         )
         q, k, v = proj("q_proj")(x), proj("k_proj")(x), proj("v_proj")(x)
@@ -83,6 +87,7 @@ class GPT2Attention(nn.Module):
                                       window=self.window)
         return nn.DenseGeneral(
             C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
+            dot_general=dg,
             kernel_init=nn.initializers.normal(0.02), name="c_proj",
         )(y)
 
@@ -98,10 +103,13 @@ class GPT2Block(nn.Module):
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
     window: int = 0
+    quant: str = ""
     decode: bool = False
 
     @nn.compact
     def __call__(self, x):
+        from pytorch_distributed_train_tpu.quant import quant_dot_general
+
         ln = lambda name: nn.LayerNorm(  # noqa: E731
             epsilon=1e-5, dtype=jnp.float32, param_dtype=jnp.float32,
             name=name,
@@ -111,17 +119,18 @@ class GPT2Block(nn.Module):
             GPT2Attention(self.num_heads, self.max_seq_len, self.dtype,
                           self.param_dtype, cp=self.cp,
                           attn_impl=self.attn_impl, window=self.window,
-                          decode=self.decode,
+                          quant=self.quant, decode=self.decode,
                           name="attn")(h),
             deterministic=self.deterministic)
         h = ln("ln_2")(x).astype(self.dtype)
+        dg = quant_dot_general(self.quant)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     param_dtype=self.param_dtype,
+                     param_dtype=self.param_dtype, dot_general=dg,
                      kernel_init=nn.initializers.normal(0.02),
                      name="c_fc")(h)
         h = nn.gelu(h)  # tanh approximation == GPT-2's gelu_new
         h = nn.Dense(x.shape[-1], dtype=self.dtype,
-                     param_dtype=self.param_dtype,
+                     param_dtype=self.param_dtype, dot_general=dg,
                      kernel_init=nn.initializers.normal(0.02),
                      name="c_proj")(h)
         return x + nn.Dropout(self.dropout_rate)(
@@ -145,6 +154,7 @@ class GPT2LMHead(nn.Module):
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
     attention_window: int = 0  # sliding window (0 = full causal)
+    quant_training: str = ""  # "" | "int8" AQT matmuls (tied head stays fp)
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # Fused chunked head+CE over the tied embedding (losses.chunked_causal_ce)
     fused_loss: bool = False
@@ -187,7 +197,7 @@ class GPT2LMHead(nn.Module):
                 self.num_heads, self.mlp_dim, self.max_seq_len,
                 self.dropout_rate, deterministic, self.dtype,
                 self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
-                window=self.attention_window,
+                window=self.attention_window, quant=self.quant_training,
                 decode=self.decode, name=f"h{i}",
             )(x)
             if self.act is not None:
@@ -217,6 +227,7 @@ def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
         act=act,
         attn_impl=getattr(cfg, "attention_impl", "auto"),
         attention_window=getattr(cfg, "attention_window", 0),
+        quant_training=getattr(cfg, "quant_training", ""),
         fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,

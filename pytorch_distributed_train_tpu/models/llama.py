@@ -58,17 +58,6 @@ def rope_frequencies(head_dim: int, max_seq_len: int, theta: float,
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
-def _quant_dot_general(quant: str):
-    """dot_general override for the quant_training knob (None = default)."""
-    if not quant:
-        return None
-    if quant == "int8":
-        from pytorch_distributed_train_tpu.quant import int8_dot_general
-
-        return int8_dot_general
-    raise ValueError(f"quant_training must be ''|'int8', got {quant!r}")
-
-
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
     """x: (B, S, H, D). Rotates pairs (x[..., :D/2], x[..., D/2:]) — the
     'split-half' convention (matches HF Llama, so checkpoints interop)."""
@@ -101,7 +90,9 @@ class LlamaAttention(nn.Module):
     def __call__(self, x):
         B, S, C = x.shape
         head_dim = C // self.num_heads
-        dg = _quant_dot_general(self.quant)
+        from pytorch_distributed_train_tpu.quant import quant_dot_general
+
+        dg = quant_dot_general(self.quant)
         proj = lambda heads, name: nn.DenseGeneral(  # noqa: E731
             (heads, head_dim), axis=-1, use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype, dot_general=dg,
@@ -186,9 +177,12 @@ class LlamaMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from pytorch_distributed_train_tpu.quant import quant_dot_general
+
+        dg = quant_dot_general(self.quant)
         dense = lambda dim, name: nn.Dense(  # noqa: E731
             dim, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
-            dot_general=_quant_dot_general(self.quant),
+            dot_general=dg,
             kernel_init=nn.initializers.normal(0.02), name=name,
         )
         gate = nn.silu(dense(self.mlp_dim, "gate_proj")(x))

@@ -87,6 +87,7 @@ class PipelinedLlama:
             dtype, param_dtype, cp=cp, moe=moe,
             attn_impl=getattr(cfg, "attention_impl", "auto"),
             window=getattr(cfg, "attention_window", 0),
+            quant=getattr(cfg, "quant_training", ""),
         )
         self.final_norm = RMSNorm(cfg.rms_norm_eps)
         # bf16 operands + fp32 accumulation: full MXU rate with fp32 logits

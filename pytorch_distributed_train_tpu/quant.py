@@ -166,6 +166,17 @@ def _int8_dot_bwd(dimension_numbers, res, g):
 _int8_dot.defvjp(_int8_dot_fwd, _int8_dot_bwd)
 
 
+def quant_dot_general(quant: str):
+    """Map a quant_training knob value onto a flax ``dot_general``
+    override (None = the default fp path). The one switch models share
+    (llama / llama_pp / gpt2 thread it into Dense/DenseGeneral)."""
+    if not quant:
+        return None
+    if quant == "int8":
+        return int8_dot_general
+    raise ValueError(f"quant_training must be ''|'int8', got {quant!r}")
+
+
 def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
                      preferred_element_type=None):
     """Drop-in ``dot_general`` for flax Dense/DenseGeneral (their call

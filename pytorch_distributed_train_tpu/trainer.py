@@ -64,11 +64,11 @@ class Trainer:
                 f"fused_lm_loss is implemented for llama/gpt2, not "
                 f"{cfg.model.name!r}")
         if (getattr(cfg.model, "quant_training", "")
-                and cfg.model.name != "llama"):
+                and cfg.model.name not in ("llama", "llama_pp", "gpt2")):
             raise ValueError(
-                f"quant_training is implemented for the llama family, not "
-                f"{cfg.model.name!r} (other models would silently ignore "
-                "the knob)")
+                f"quant_training is implemented for llama/llama_pp/gpt2, "
+                f"not {cfg.model.name!r} (other models would silently "
+                "ignore the knob)")
         if (cfg.model.num_experts > 1
                 and cfg.model.moe_router == "expert_choice"
                 and cfg.loss in ("causal_lm_xent", "fused_causal_lm_xent")

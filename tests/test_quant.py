@@ -191,6 +191,51 @@ def test_int8_qat_llama_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_int8_qat_threads_into_pipelined_llama():
+    """llama_pp reuses LlamaBlock; the knob must reach the block template
+    (full pipelined execution is covered by test_pipeline_parallel — here
+    we pin the config plumbing that would otherwise silently drop it)."""
+    from pytorch_distributed_train_tpu.config import MeshConfig
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+
+    tiny = dict(name="llama_pp", vocab_size=128, hidden_size=64,
+                num_layers=2, num_heads=4, num_kv_heads=4, mlp_dim=128,
+                max_seq_len=32, pipeline_microbatches=2)
+    mesh_cfg = MeshConfig(stage=2)
+    mesh = build_mesh(mesh_cfg, jax.devices("cpu")[:2])
+    q_model = build_model(ModelConfig(**tiny, quant_training="int8"),
+                          PrecisionConfig(), mesh=mesh, mesh_cfg=mesh_cfg)
+    assert q_model.block.quant == "int8"
+    fp_model = build_model(ModelConfig(**tiny), PrecisionConfig(),
+                           mesh=mesh, mesh_cfg=mesh_cfg)
+    assert fp_model.block.quant == ""
+
+
+def test_int8_qat_gpt2_forward():
+    """gpt2 threads quant_training into its blocks: same param tree as fp,
+    forward within quantization noise."""
+    import numpy as np
+
+    tiny = dict(name="gpt2", vocab_size=128, hidden_size=64, num_layers=2,
+                num_heads=4, mlp_dim=128, max_seq_len=32)
+    fp_model = build_model(ModelConfig(**tiny), PrecisionConfig())
+    q_model = build_model(ModelConfig(**tiny, quant_training="int8"),
+                          PrecisionConfig())
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (4, 32)), jnp.int32)
+    params = fp_model.init({"params": jax.random.PRNGKey(0)}, ids,
+                           train=False)["params"]
+    q_init = q_model.init({"params": jax.random.PRNGKey(0)}, ids,
+                          train=False)["params"]
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(q_init)
+    fp_out = fp_model.apply({"params": params}, ids, train=False)
+    q_out = q_model.apply({"params": params}, ids, train=False)
+    rel = float(jnp.abs(q_out - fp_out).mean()
+                / (jnp.abs(fp_out).mean() + 1e-9))
+    assert rel < 0.2, rel
+
+
 def test_quant_training_guarded_to_llama(tmp_path):
     from pytorch_distributed_train_tpu.config import get_preset
     from pytorch_distributed_train_tpu.trainer import Trainer
