@@ -122,6 +122,35 @@ class CheckpointManager:
             )
         return state, (restored["meta"] or {})
 
+    def restore_params_only(self, abstract_params: Any,
+                            step: int | None = None) -> Any | None:
+        """Restore just the ``params`` subtree of a saved TrainState —
+        the LoRA warm-start path (config ``lora.base_checkpoint``), where
+        the source run's optimizer state is meaningless to the new run
+        (different optax tree once the adapter mask wraps it).
+
+        ``abstract_params`` carries target shapes/dtypes/shardings, so the
+        params land directly in this run's mesh layout. The remaining
+        saved keys are restored via a template reconstructed from the
+        checkpoint's own metadata and dropped — simple and portable at the
+        cost of materializing the source opt_state once; acceptable for a
+        warm start, which happens once per run."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        # PyTreeRestore(partial_restore=True) reads ONLY the params
+        # subtree named in the template: the source run's opt_state /
+        # EMA mirror (2-3x params for adam at 7B) is never deserialized.
+        item_dir = os.path.join(self.dir, str(step), "state")
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(
+            item_dir,
+            args=ocp.args.PyTreeRestore(item={"params": abstract_params},
+                                        partial_restore=True),
+        )
+        return restored["params"]
+
     def _ckpt_has(self, step: int, key: str) -> bool:
         """Whether the saved state tree at ``step`` contains ``key``."""
         try:

@@ -372,6 +372,40 @@ class ObsConfig:
 
 
 @dataclass
+class LoraConfig:
+    """Parameter-efficient fine-tuning (lora.py). ``rank=0`` disables.
+
+    Freeze the base model, train rank-r adapters on the projections whose
+    param path matches ``targets``; merge for export with lora.strip().
+    Beyond-reference capability (the [SPEC] harness has no PEFT) built on
+    the same config/checkpoint interfaces (SURVEY H7/H8).
+    """
+
+    rank: int = 0
+    alpha: float = 16.0
+    # Regex over '/'-joined param paths; adapters attach to matching 2-D
+    # Dense / 3-D DenseGeneral `kernel` leaves. Default covers the
+    # llama/gpt2/bert/vit attention projections (torch-PEFT's customary
+    # default is q/v only; we take all four — adapters are cheap, quality
+    # is not).
+    targets: str = (
+        r"(q_proj|k_proj|v_proj|o_proj|query|key|value|attn_out"
+        r"|attn/c_proj)/kernel$")
+    # 3-D DenseGeneral kernels matching this regex are OUTPUT projections
+    # — contracted (input) dims first, (H, Dh, d_out) — so the rank-r
+    # factors bridge (H*Dh) -> out instead of in -> (H, Dh). Extend when
+    # targeting a new model family whose out-projection has another name.
+    out_proj_targets: str = r"(o_proj|attn_out|out_proj|attn/c_proj)/kernel$"
+    # Additional full-rank leaves to leave trainable (regex, "" = none),
+    # e.g. r"(final_norm|/bias$)" for norm-and-bias tuning a la BitFit.
+    extra_trainable: str = ""
+    # Warm-start: restore base params (only) from this run directory's
+    # latest checkpoint before training — the "load pretrained, add
+    # adapters" workflow. "" = train from fresh init (tests/debug).
+    base_checkpoint: str = ""
+
+
+@dataclass
 class TrainConfig:
     """Root config. Serialises to/from JSON; dotted-path CLI overrides."""
 
@@ -383,6 +417,7 @@ class TrainConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    lora: LoraConfig = field(default_factory=LoraConfig)
     # Train loop horizon: epochs if >0, else total_steps.
     epochs: int = 0
     total_steps: int = 1000
@@ -448,6 +483,7 @@ _SECTIONS = {
     "mesh": MeshConfig,
     "checkpoint": CheckpointConfig,
     "obs": ObsConfig,
+    "lora": LoraConfig,
 }
 
 

@@ -1,0 +1,212 @@
+"""LoRA: low-rank adaptation as a functional param-tree transform.
+
+Parameter-efficient fine-tuning in the shape users of torch PEFT expect
+(freeze the base model, train rank-r adapters on the attention/MLP
+projections, merge for export), built the TPU-native way: instead of
+wrapping nn.Modules and monkey-patching forward (the torch
+``peft.LoraModel`` approach), the adapters live as extra leaves in the
+params pytree (``.../q_proj/lora_a``, ``.../q_proj/lora_b``) and a pure
+``merge`` transform folds them into the base kernels *inside the jitted
+train step*:
+
+    W_eff = stop_gradient(W) + (alpha / r) * A @ B
+
+XLA fuses the rank-r outer product into the surrounding graph, and
+``stop_gradient`` on the base lets the compiler dead-code-eliminate the
+whole base-weight backward pass — the same "requires_grad=False skips the
+grad kernels" effect torch gets from autograd, obtained at compile time.
+The optimizer is masked with ``optax.multi_transform`` so moment buffers
+exist only for adapter leaves: optimizer-state memory scales with the
+adapter count, not the model (the actual point of LoRA at 7B scale, where
+Adam moments are 2x params).
+
+Reference surface replicated: the reference harness itself has no PEFT
+(SURVEY [SPEC] scope), so this is a beyond-reference capability; the
+config/checkpoint integration follows the same H7/H8 interfaces.
+
+Conventions:
+- ``lora_a`` is (prod(in_dims), r), initialised N(0, 1/sqrt(fan_in));
+  ``lora_b`` is (r, prod(out_dims)), initialised zero — adapters start as
+  an exact identity, so step 0 of a LoRA run reproduces the frozen base
+  model bitwise.
+- Only 2-D Dense / 3-D DenseGeneral ``kernel`` leaves whose path matches
+  ``cfg.targets`` get adapters. ``cfg.extra_trainable`` names additional
+  full-rank leaves to leave unfrozen (typical: norm scales or biases a la
+  BitFit); a kernel matching both trains full-rank AND carries adapters.
+- Weight-space LoRA has no per-call input dropout (there is no module to
+  hook); classic lora_dropout=0 semantics.
+
+All traversal uses the repo-standard ``flax.traverse_util`` flat-dict
+idiom ('/'-joined paths — same convention as quant.py and optim.py's
+decay masks, and as parallel/partition.py's rule regexes).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import traverse_util
+
+
+def _compile(cfg) -> tuple[re.Pattern, re.Pattern | None]:
+    tgt = re.compile(cfg.targets)
+    extra = re.compile(cfg.extra_trainable) if cfg.extra_trainable else None
+    return tgt, extra
+
+
+def _flat(tree: dict) -> dict[str, Any]:
+    return traverse_util.flatten_dict(tree, sep="/")
+
+
+def _unflat(flat: dict[str, Any]) -> dict:
+    return traverse_util.unflatten_dict(flat, sep="/")
+
+
+def _is_adapter(path: str) -> bool:
+    return path.rsplit("/", 1)[-1] in ("lora_a", "lora_b")
+
+
+def _split_dims(path: str, shape: tuple[int, ...], out_proj: re.Pattern
+                ) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """(input_dims, output_dims) of a targeted kernel. 2-D Dense is
+    (in, out); 3-D DenseGeneral is (in, H, Dh) for q/k/v-style and —
+    for kernels matching ``cfg.out_proj_targets`` — (H, Dh, out) with the
+    contracted dims first (models/{llama,gpt2,bert,vit} convention)."""
+    if len(shape) == 2:
+        return shape[:1], shape[1:]
+    if out_proj.search(path):
+        return shape[:-1], shape[-1:]
+    return shape[:1], shape[1:]
+
+
+def target_paths(params: dict, cfg) -> list[str]:
+    """'/'-joined paths of the kernels that receive adapters."""
+    tgt, _ = _compile(cfg)
+    return [
+        path for path, leaf in _flat(params).items()
+        if path.rsplit("/", 1)[-1] == "kernel"
+        and getattr(leaf, "ndim", 0) in (2, 3)  # convs (4-D) excluded
+        and tgt.search(path)
+    ]
+
+
+def inject(rng: jax.Array, params: dict, cfg) -> dict:
+    """Return ``params`` with ``lora_a``/``lora_b`` siblings added beside
+    every targeted kernel. Raises if the targets regex matches nothing —
+    a silent no-op LoRA run (full model frozen, zero trainable params)
+    is always a config mistake."""
+    paths = target_paths(params, cfg)
+    if not paths:
+        raise ValueError(
+            f"lora.targets={cfg.targets!r} matched no 2-D/3-D kernel in "
+            "the params tree — adapter set would be empty")
+    out_proj = re.compile(cfg.out_proj_targets)
+    flat = dict(_flat(params))
+    for i, path in enumerate(paths):
+        kernel = flat[path]
+        in_dims, out_dims = _split_dims(path, kernel.shape, out_proj)
+        d_in = int(np.prod(in_dims))
+        d_out = int(np.prod(out_dims))
+        k_rng = jax.random.fold_in(rng, i)
+        # A ~ N(0, 1/sqrt(d_in)) (kaiming-style fan-in), B = 0: the product
+        # starts at zero so the adapted model == base model at init.
+        stem = path[: -len("kernel")]
+        flat[stem + "lora_a"] = (
+            jax.random.normal(k_rng, (d_in, cfg.rank), jnp.float32)
+            / np.sqrt(d_in)).astype(kernel.dtype)
+        flat[stem + "lora_b"] = jnp.zeros((cfg.rank, d_out), kernel.dtype)
+    return _unflat(flat)
+
+
+def merge(params: dict, cfg, *, freeze_base: bool = True) -> dict:
+    """Fold adapters into base kernels; returns a tree with the exact
+    structure ``model.init`` produced (no lora keys), usable by any
+    ``model.apply``. With ``freeze_base`` every leaf that is neither an
+    adapter nor ``extra_trainable`` is ``stop_gradient``-ed, so
+    ``jax.grad`` through the merged tree only differentiates the
+    trainable set. A kernel matching both ``targets`` and
+    ``extra_trainable`` keeps its gradient (full-rank + adapter)."""
+    _, extra = _compile(cfg)
+    scale = cfg.alpha / cfg.rank
+    flat = dict(_flat(params))
+    out: dict[str, Any] = {}
+    for path in [p for p in flat if p.rsplit("/", 1)[-1] == "lora_a"]:
+        stem = path[: -len("lora_a")]
+        w = flat.pop(stem + "kernel")
+        a = flat.pop(stem + "lora_a")
+        b = flat.pop(stem + "lora_b")
+        if freeze_base and not (extra is not None
+                                and extra.search(stem + "kernel")):
+            w = jax.lax.stop_gradient(w)
+        delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scale
+        out[stem + "kernel"] = w + delta.reshape(w.shape).astype(w.dtype)
+    for path, leaf in flat.items():
+        if freeze_base and not (extra is not None and extra.search(path)):
+            leaf = jax.lax.stop_gradient(leaf)
+        out[path] = leaf
+    return _unflat(out)
+
+
+def param_labels(params: dict, cfg) -> dict:
+    """'trainable'/'frozen' label tree for ``optax.multi_transform``."""
+    _, extra = _compile(cfg)
+    return _unflat({
+        path: ("trainable" if _is_adapter(path)
+               or (extra is not None and extra.search(path)) else "frozen")
+        for path in _flat(params)
+    })
+
+
+def mask_optimizer(tx: optax.GradientTransformation, cfg
+                   ) -> optax.GradientTransformation:
+    """Train adapters only. ``set_to_zero`` carries no state, so the
+    wrapped optimizer allocates moments for adapter leaves alone — the
+    FSDP-scale memory win that makes 7B fine-tuning fit."""
+    return optax.multi_transform(
+        {"trainable": tx, "frozen": optax.set_to_zero()},
+        lambda params: param_labels(params, cfg),
+    )
+
+
+def strip(params: dict, cfg) -> dict:
+    """Merge-for-export: same fold as :func:`merge` but differentiable
+    nowhere needed — no stop_gradient, result has no adapter leaves.
+    This is the tree to hand to generate.py / interop export."""
+    return merge(params, cfg, freeze_base=False)
+
+
+def transplant_base(full_params: dict, base_params: dict) -> dict:
+    """Overwrite the base leaves of an adapter-injected tree with values
+    from a base-only tree (warm-start from a pretrained checkpoint whose
+    params predate LoRA injection). Adapter leaves keep their fresh init.
+    """
+    flat = dict(_flat(full_params))
+    base = _flat(base_params)
+    for path in flat:
+        if not _is_adapter(path):
+            flat[path] = base[path]
+    return _unflat(flat)
+
+
+def strip_abstract(params_shape: Any) -> Any:
+    """Drop adapter leaves from an abstract (eval_shape) params tree —
+    the restore template for a base-only checkpoint."""
+    return _unflat({p: v for p, v in _flat(params_shape).items()
+                    if not _is_adapter(p)})
+
+
+def count_trainable(params: dict, cfg) -> tuple[int, int]:
+    """(trainable, total) parameter counts — the PEFT-style banner."""
+    labels = _flat(param_labels(params, cfg))
+    trainable = total = 0
+    for path, leaf in _flat(params).items():
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += n
+        if labels[path] == "trainable":
+            trainable += n
+    return trainable, total

@@ -168,7 +168,8 @@ def make_schedule(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
     return main
 
 
-def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
+def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0,
+                   param_mask=None):
     """Build the full optax transform chain.
 
     Order matters: clip → optimizer(+wd) → accumulate. Weight decay is
@@ -384,6 +385,12 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
             min_scale=opt_cfg.plateau_min_scale,
         ))
     tx = optax.chain(*parts)
+    if param_mask is not None:
+        # LoRA-style trainable/frozen masking. Must wrap INSIDE MultiSteps:
+        # train_state.py's accumulation-boundary detection (EMA gating,
+        # plateau loss routing) keys on the TOP-LEVEL opt_state being a
+        # MultiStepsState, which a mask wrapped outside would bury.
+        tx = param_mask(tx)
     if opt_cfg.accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=opt_cfg.accum_steps)
     return tx, sched

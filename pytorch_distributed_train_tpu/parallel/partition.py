@@ -261,7 +261,12 @@ _RULE_SETS: dict[str, Callable[[], list[tuple[str, PartitionSpec]]]] = {
 
 
 def rules_for_model(model_name: str) -> PartitionRules:
+    # LoRA adapter leaves (lora.py) replicate: rank-r matrices are tiny
+    # (d*r vs d*d), and replication keeps the A@B fold free of collectives
+    # inside the merged train step. Prepended so the family rule sets'
+    # generic `kernel` patterns can never capture them.
+    lora_rules = [(r"lora_[ab]$", P())]
     for prefix, fn in _RULE_SETS.items():
         if model_name.startswith(prefix):
-            return PartitionRules(fn())
-    return PartitionRules(dense_rules())
+            return PartitionRules(lora_rules + fn())
+    return PartitionRules(lora_rules + dense_rules())

@@ -83,7 +83,8 @@ def _tree_finite(tree) -> jnp.ndarray:
 
 def make_train_step(model, loss_fn: Callable, tx,
                     ema_decay: float = 0.0, mixup=None,
-                    module_grad_norms: bool = False) -> Callable:
+                    module_grad_norms: bool = False,
+                    param_transform: Callable | None = None) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
     closes over the optax transform (and the static EMA decay / mixup
     transform); jit-wrapped by the caller with explicit shardings.
@@ -105,6 +106,11 @@ def make_train_step(model, loss_fn: Callable, tx,
         scale = state.dynamic_scale.scale if state.dynamic_scale is not None else None
 
         def loss_for_grad(params):
+            # LoRA et al: fold adapter leaves into base kernels in-graph
+            # (lora.merge); grads flow only through the transform's
+            # non-stop_gradient outputs.
+            if param_transform is not None:
+                params = param_transform(params)
             logits, new_stats, model_aux = apply_model(
                 model, params, state.batch_stats, batch,
                 train=True, dropout_rng=dropout_rng,
@@ -157,7 +163,8 @@ def optax_global_norm(tree) -> jnp.ndarray:
 
 
 def make_eval_step(model, loss_fn: Callable,
-                   schedule_free: bool = False) -> Callable:
+                   schedule_free: bool = False,
+                   param_transform: Callable | None = None) -> Callable:
     def eval_step(state: TrainState, batch: dict):
         params = state.eval_params
         if schedule_free:
@@ -170,6 +177,8 @@ def make_eval_step(model, loss_fn: Callable,
             )
 
             params = schedule_free_eval(state.opt_state, params)
+        if param_transform is not None:
+            params = param_transform(params)
         logits, _, _ = apply_model(
             model, params, state.batch_stats, batch,
             train=False, dropout_rng=None,

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorch_distributed_train_tpu import lora as lora_lib
 from pytorch_distributed_train_tpu import losses as losses_lib
 from pytorch_distributed_train_tpu import steps as steps_lib
 from pytorch_distributed_train_tpu.checkpoint import (
@@ -80,6 +81,12 @@ class Trainer:
                 "moe_router='topk', or set "
                 "model.moe_router_allow_noncausal=true to accept the "
                 "Zhou et al. 2022 caveat explicitly")
+        if cfg.lora.rank > 0 and cfg.optim.name == "schedule_free_adamw":
+            raise ValueError(
+                "lora + schedule_free_adamw is unsupported: the eval-time "
+                "x/y unwrap (optim.schedule_free_eval) cannot locate the "
+                "ScheduleFreeState through the lora optimizer mask "
+                "(optax.multi_transform nests per-label inner states)")
         self.loss_fn = losses_lib.get_loss_fn(
             cfg.loss, label_smoothing=cfg.label_smoothing)
         self.rules = rules_for_model(cfg.model.name)
@@ -104,9 +111,12 @@ class Trainer:
         else:
             self.total_steps = cfg.total_steps
 
-        # ---- optimizer
+        # ---- optimizer (adapter-only masking, when LoRA is on, happens
+        # inside make_optimizer so MultiSteps stays the outermost wrapper)
         self.tx, self.lr_schedule = make_optimizer(
-            cfg.optim, self.total_steps, self.steps_per_epoch
+            cfg.optim, self.total_steps, self.steps_per_epoch,
+            param_mask=(lambda tx: lora_lib.mask_optimizer(tx, cfg.lora))
+            if cfg.lora.rank > 0 else None,
         )
 
         # ---- state (sharded init: params materialize directly into their
@@ -136,10 +146,14 @@ class Trainer:
 
         mixup = build_mixup(cfg.data, cfg.model, cfg.label_smoothing,
                             loss=cfg.loss)
+        param_transform = None
+        if cfg.lora.rank > 0:
+            param_transform = lambda p: lora_lib.merge(p, cfg.lora)  # noqa: E731
         train_step = steps_lib.make_train_step(
             self.model, self.loss_fn, self.tx,
             ema_decay=cfg.optim.ema_decay, mixup=mixup,
-            module_grad_norms=cfg.obs.log_module_grad_norms)
+            module_grad_norms=cfg.obs.log_module_grad_norms,
+            param_transform=param_transform)
         if cfg.optim.offload_state:
             train_step = steps_lib.offload_opt_state(
                 train_step, opt_dev_sharding, self.state_sharding.opt_state)
@@ -149,14 +163,30 @@ class Trainer:
         self.eval_step = steps_lib.jit_eval_step(
             steps_lib.make_eval_step(
                 self.model, self.loss_fn,
-                schedule_free=cfg.optim.name == "schedule_free_adamw"),
+                schedule_free=cfg.optim.name == "schedule_free_adamw",
+                param_transform=param_transform),
             self.mesh, self.state_sharding, self.batch_axes,
         )
+        if cfg.lora.rank > 0 and jax.process_index() == 0:
+            t, n = lora_lib.count_trainable(self.state.params, cfg.lora)
+            print(f"[lora] rank={cfg.lora.rank} trainable {t:,} / "
+                  f"{n:,} params ({100.0 * t / n:.2f}%)", flush=True)
 
         # ---- checkpoint + resume (auto is the default path, SURVEY §5.3b)
         self.ckpt = CheckpointManager(cfg.checkpoint, cfg.to_json())
         self.best_ckpt = (BestCheckpointTracker(cfg.checkpoint, cfg.to_json())
                           if cfg.checkpoint.best_metric else None)
+        if (cfg.lora.rank > 0 and cfg.lora.base_checkpoint
+                and (cfg.checkpoint.resume == "none"
+                     or self.ckpt.latest_step() is None)):
+            # Fresh LoRA run: pull the frozen base from the pretrained
+            # checkpoint. A restarted run (resume enabled + own ckpt
+            # present) skips this — its resume below restores
+            # base+adapters together, and re-reading the (potentially
+            # 7B-scale) source checkpoint only to overwrite it would
+            # waste minutes of IO per gang restart. With resume='none'
+            # the own ckpt is never restored, so warm-start must run.
+            self._warm_start_lora_base()
         self.start_epoch = 0
         self.resumed = False  # did construction restore a checkpoint?
         resume_mode = cfg.checkpoint.resume
@@ -193,10 +223,38 @@ class Trainer:
         self._profiling = False
 
     # ------------------------------------------------------------------ init
+    def _warm_start_lora_base(self):
+        """lora.base_checkpoint: restore the BASE params subtree from a
+        pretrained run's latest checkpoint into this run's (adapter-
+        injected) state. Adapters keep their fresh identity init, so the
+        warm-started model is exactly the pretrained model at step 0."""
+        cfg = self.cfg
+        src_cfg = dataclasses.replace(
+            cfg.checkpoint, dir=cfg.lora.base_checkpoint, resume="none")
+        src = CheckpointManager(src_cfg)
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            self.state.params)
+        base = src.restore_params_only(lora_lib.strip_abstract(abstract))
+        src.close()
+        if base is None:
+            raise FileNotFoundError(
+                f"lora.base_checkpoint={cfg.lora.base_checkpoint!r} has no "
+                "checkpoint to warm-start from")
+        self.state = self.state.replace(
+            params=lora_lib.transplant_base(self.state.params, base))
+        if jax.process_index() == 0:
+            print(f"[lora] warm-started base params from "
+                  f"{cfg.lora.base_checkpoint}", flush=True)
+
     def _init_state(self, rng):
         dummy = self._dummy_inputs()
         variables = self.model.init({"params": rng}, *dummy, train=False)
         params = variables["params"]
+        if self.cfg.lora.rank > 0:
+            params = lora_lib.inject(
+                jax.random.fold_in(rng, 0x10FA), params, self.cfg.lora)
         batch_stats = variables.get("batch_stats", {})
         ds = None
         ls = self.cfg.precision.loss_scale

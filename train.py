@@ -107,7 +107,15 @@ def main(argv=None) -> int:
         )
 
         # Trainer construction already auto-resumed the latest checkpoint.
-        save_torch_safetensors(trainer.state.params, args.export_safetensors)
+        params = trainer.state.params
+        if cfg.lora.rank > 0:
+            # Merge adapters into the base kernels: the exported file is a
+            # plain base-model checkpoint (no lora_* tensors, which the
+            # torch name mapping has no names for anyway).
+            from pytorch_distributed_train_tpu import lora as lora_lib
+
+            params = lora_lib.strip(params, cfg.lora)
+        save_torch_safetensors(params, args.export_safetensors)
         print(f"[interop] exported params → {args.export_safetensors}",
               flush=True)
         trainer.close()
