@@ -130,11 +130,8 @@ class CheckpointManager:
         (different optax tree once the adapter mask wraps it).
 
         ``abstract_params`` carries target shapes/dtypes/shardings, so the
-        params land directly in this run's mesh layout. The remaining
-        saved keys are restored via a template reconstructed from the
-        checkpoint's own metadata and dropped — simple and portable at the
-        cost of materializing the source opt_state once; acceptable for a
-        warm start, which happens once per run."""
+        params land directly in this run's mesh layout. The source run's
+        other keys (opt_state, EMA mirror) are never deserialized."""
         if step is None:
             step = self.latest_step()
         if step is None:

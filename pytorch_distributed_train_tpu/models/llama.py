@@ -85,6 +85,10 @@ class LlamaAttention(nn.Module):
     # analogue: HF past_key_values). Works for both the prefill call (S>1 at
     # offset 0) and single-token steps (S=1 at the running offset).
     decode: bool = False
+    # Force the continuation path even for S>1 calls: tokens append at the
+    # running cache offset instead of restarting at 0 (speculative
+    # decoding's k+1-token verify pass, speculative.py).
+    decode_multi: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -110,7 +114,7 @@ class LlamaAttention(nn.Module):
                                 (B, L, self.num_kv_heads, head_dim), v.dtype)
             c_i = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
-            if S > 1:
+            if S > 1 and not self.decode_multi:
                 # Prefill: a multi-token decode call means "start this cache
                 # from position 0" (generate.py's contract). Positions are
                 # static, attention is plain causal over the PROMPT ONLY —
@@ -129,7 +133,11 @@ class LlamaAttention(nn.Module):
                                           impl=self.attn_impl,
                                           window=self.window)
             else:
-                # Single-token step at the running offset (dynamic index).
+                # Step(s) at the running offset (dynamic index). Handles
+                # any static S: with decode_multi this is the multi-token
+                # CONTINUATION path (speculative.py's verify pass appends
+                # k+1 tokens mid-stream) — positions are idx..idx+S-1 and
+                # the mask below is causal across the new tokens too.
                 idx = c_i.value
                 cos, sin = rope_frequencies(head_dim, L, self.rope_theta,
                                              self.rope_scaling)
@@ -206,6 +214,7 @@ class LlamaBlock(nn.Module):
     window: int = 0
     quant: str = ""
     decode: bool = False
+    decode_multi: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -215,6 +224,7 @@ class LlamaBlock(nn.Module):
             self.rope_scaling, self.max_seq_len, self.dtype,
             self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
             window=self.window, quant=self.quant, decode=self.decode,
+            decode_multi=self.decode_multi,
             name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
@@ -260,6 +270,8 @@ class LlamaForCausalLM(nn.Module):
     # Sliding-window attention span (Mistral recipe; 0 = full causal).
     attention_window: int = 0
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
+    # Multi-token continuation in decode mode (speculative.py verify pass)
+    decode_multi: bool = False
     # Fused chunked head+CE (losses.chunked_causal_ce): __call__ returns
     # {'loss_sum','weight_sum'} instead of logits — (B,S,V) fp32 logits
     # never materialize. Pair with loss="fused_causal_lm_xent".
@@ -294,6 +306,7 @@ class LlamaForCausalLM(nn.Module):
                 cp=self.cp, moe=moe,
                 attn_impl=self.attn_impl, window=self.attention_window,
                 quant=self.quant_training, decode=self.decode,
+                decode_multi=self.decode_multi,
                 name=f"layer{i}",
             )(x)
             if self.act is not None:

@@ -1,0 +1,261 @@
+"""Speculative decoding: draft-model proposal + single-pass verification.
+
+Serving-side latency optimization (beyond the reference's scope — its
+harness has no inference path at all; this extends generate.py the way
+vLLM/HF extend torch serving): a small DRAFT model proposes ``k`` tokens
+autoregressively, then the large TARGET model scores all k in ONE
+multi-token forward and accepts a prefix of them. Exact-sampling
+acceptance (Leviathan et al. 2023, "Fast Inference from Transformers via
+Speculative Decoding"): token d_i is accepted with probability
+min(1, p_target(d_i)/p_draft(d_i)); on the first rejection a replacement
+is drawn from the residual distribution norm(max(p_t - p_d, 0)). The
+emitted token stream is distributed EXACTLY as target-only sampling —
+the draft only changes how many target forwards are needed, never the
+output law. With temperature=0 both laws are argmax, so acceptance is
+"draft token == target argmax" and output equals greedy target decoding
+token-for-token.
+
+Why this fits the TPU decode regime: single-token decode steps are
+HBM-bandwidth-bound (every step streams all weights for one token of
+compute), so a k+1-token verify forward costs nearly the same wall-clock
+as a 1-token step — the MXU is idle either way; accepted tokens are
+almost free. All device work is jit-compiled with static shapes: the
+draft loop is k single-token steps, verification is one (1, k+1) call on
+the ``decode_multi`` continuation path (models/llama.py), and the
+accept/resample decision is a fused kernel returning (n_accepted,
+next_token). Only the Python round loop sees the dynamic acceptance
+count — it rolls the static KV caches back by resetting their
+``cache_index`` scalars (stale tail entries are position-masked, so a
+rewound index fully invalidates them).
+
+Batch is restricted to B=1: per-row acceptance counts would need per-row
+cache indices, and latency-bound serving (the regime where speculative
+decoding pays) is B=1 anyway.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import traverse_util
+
+from pytorch_distributed_train_tpu.generate import (
+    build_decode_model,
+    init_cache,
+)
+
+
+def _filtered_probs(logits, temperature: float, top_k: int):
+    """Temperature/top-k-adjusted probabilities. Both models' laws are
+    modified identically, and spec sampling is exact w.r.t. the MODIFIED
+    target law (the standard convention). logits: (..., V), fp32."""
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _step_logits(model, params, cache, ids):
+    """One decode forward (any static S); returns per-position logits."""
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
+    logits, updated = model.apply(
+        {"params": params, "cache": cache}, ids, train=False,
+        mutable=["cache"],
+    )
+    return logits, updated["cache"]
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def _accept(rng, draft_tokens, p_draft, k: int, temperature: float,
+            top_k: int, t_logits):
+    """The accept/resample decision, fused on device.
+
+    draft_tokens: (k,) int32; p_draft: (k, V) draft probabilities for the
+    positions that produced each draft token; t_logits: (k+1, V) target
+    logits — row i is the target's next-token distribution at the
+    position where draft_tokens[i] was proposed, row k is the bonus
+    position after all k drafts.
+
+    Returns (n_accepted, next_token): n in [0, k]; next_token is the
+    residual resample when n < k, the bonus sample when n == k.
+    """
+    greedy = temperature == 0.0
+    if greedy:
+        t_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (k+1,)
+        accept = t_choice[:k] == draft_tokens
+        n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+        # rejected → the target's own argmax at position n; all accepted
+        # → bonus argmax. Both are t_choice[n].
+        return n, t_choice[n]
+    p_t = _filtered_probs(t_logits, temperature, top_k)  # (k+1, V)
+    p_t_k = p_t[:k]
+    rng_u, rng_res, rng_bonus = jax.random.split(rng, 3)
+    p_d_tok = jnp.take_along_axis(
+        p_draft, draft_tokens[:, None], axis=-1)[:, 0]
+    p_t_tok = jnp.take_along_axis(
+        p_t_k, draft_tokens[:, None], axis=-1)[:, 0]
+    u = jax.random.uniform(rng_u, (k,))
+    accept = u * p_d_tok < p_t_tok  # u < p_t/p_d without the div-by-zero
+    n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+    # Residual at the first rejected position (row n when n < k; row
+    # clamped to k-1 is dead when n == k). max(p_t - p_d, 0) renormalized;
+    # if the residual is numerically all-zero (p_t == p_d) fall back to p_t.
+    row = jnp.minimum(n, k - 1)
+    residual = jnp.maximum(p_t_k[row] - p_draft[row], 0.0)
+    mass = jnp.sum(residual)
+    residual = jnp.where(mass > 0, residual / jnp.maximum(mass, 1e-20),
+                         p_t_k[row])
+    resampled = jax.random.categorical(
+        rng_res, jnp.log(jnp.maximum(residual, 1e-30)))
+    bonus = jax.random.categorical(
+        rng_bonus, jnp.log(jnp.maximum(p_t[k], 1e-30)))
+    nxt = jnp.where(n < k, resampled, bonus).astype(jnp.int32)
+    return n, nxt
+
+
+def _set_cache_index(cache, idx: int):
+    """Roll a static KV cache to ``idx`` committed tokens. Entries past
+    the index are stale but position-masked (models/llama.py builds the
+    decode mask from cache_index, not buffer contents), so resetting the
+    per-layer index scalars IS the rollback."""
+    flat = traverse_util.flatten_dict(cache, sep="/")
+    for path in flat:
+        if path.rsplit("/", 1)[-1] == "cache_index":
+            flat[path] = jnp.full((), idx, jnp.int32)
+    return traverse_util.unflatten_dict(flat, sep="/")
+
+
+def speculative_generate(model_cfg, precision, params,
+                         draft_model_cfg, draft_params,
+                         prompt_ids, max_new_tokens: int,
+                         *, k: int = 4, temperature: float = 0.0,
+                         top_k: int = 0, rng=None,
+                         eos_id: int | None = None,
+                         return_stats: bool = False):
+    """Generate ``max_new_tokens`` continuation tokens for a (1, S)
+    prompt, distributed exactly as target-only sampling.
+
+    ``model_cfg``/``draft_model_cfg`` are ModelConfigs (llama family —
+    the decode-mode models are built here, both sharing a vocabulary);
+    ``params``/``draft_params`` their trained param trees. ``k`` is the
+    speculation depth: each round costs k draft forwards + 1 target
+    forward and commits between 1 and k+1 tokens.
+    """
+    target = build_decode_model(model_cfg, precision)
+    draft = build_decode_model(draft_model_cfg, precision)
+    if model_cfg.vocab_size != draft_model_cfg.vocab_size:
+        raise ValueError(
+            f"target vocab ({model_cfg.vocab_size}) != draft vocab "
+            f"({draft_model_cfg.vocab_size}) — speculative decoding "
+            "compares per-token distributions, the vocabularies must match")
+    import dataclasses
+
+    target_multi = dataclasses.replace(target, decode_multi=True)
+    draft_multi = dataclasses.replace(draft, decode_multi=True)
+
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, S = prompt_ids.shape
+    if B != 1:
+        raise ValueError(
+            f"speculative decoding is B=1 (got B={B}): acceptance length "
+            "varies per row, and the static KV cache has one index")
+    horizon = S + max_new_tokens + k + 1
+    for label, limit in (("target", model_cfg.max_seq_len),
+                         ("draft", draft_model_cfg.max_seq_len)):
+        # Both caches walk the full sequence; an overrun would clamp the
+        # dynamic KV writes onto the last slot silently, not error.
+        if horizon > limit:
+            raise ValueError(
+                f"prompt ({S}) + new ({max_new_tokens}) + speculation "
+                f"margin ({k + 1}) exceeds {label} max_seq_len ({limit})")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    tokens = [int(t) for t in prompt_ids[0]]
+    t_cache = init_cache(target, 1)
+    d_cache = init_cache(draft, 1)
+    if S > 1:
+        # Prefill both caches with the prompt MINUS its last token — the
+        # last token is the round loop's pending input (its KV is written
+        # by the round that consumes it).
+        _, t_cache = _step_logits(target, params, t_cache,
+                                  prompt_ids[:, :-1])
+        _, d_cache = _step_logits(draft, draft_params, d_cache,
+                                  prompt_ids[:, :-1])
+    d_valid = S - 1  # committed tokens whose KV the draft cache holds
+    produced = 0
+    rounds = accepted_total = 0
+
+    while produced < max_new_tokens:
+        C = len(tokens) - 1  # committed-and-cached (target view); tokens[-1] pending
+        # ---- draft k proposals (first step flushes any tokens the draft
+        # cache missed — at most 1, when the previous round accepted all k)
+        d_in = jnp.asarray([tokens[d_valid:]], jnp.int32)  # (1, 1 or 2)
+        d_model = draft if d_in.shape[1] == 1 else draft_multi
+        logits, d_cache = _step_logits(d_model, draft_params, d_cache, d_in)
+        draft_tokens = []
+        draft_probs = []
+        for i in range(k):
+            rng, r = jax.random.split(rng)
+            if temperature == 0.0:
+                # _accept's greedy branch never reads p_draft — skip the
+                # full-vocab softmax entirely (it's per proposed token in
+                # the latency-bound loop) and pass a placeholder.
+                tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+                p = jnp.zeros((logits.shape[-1],), jnp.float32)
+            else:
+                p = _filtered_probs(logits[0, -1], temperature, top_k)
+                tok = jax.random.categorical(r, jnp.log(
+                    jnp.maximum(p, 1e-30))).astype(jnp.int32)
+            draft_tokens.append(tok)
+            draft_probs.append(p)
+            if i + 1 < k:  # d_k's own forward is never needed this round
+                logits, d_cache = _step_logits(
+                    draft, draft_params, d_cache, tok[None, None])
+        draft_vec = jnp.stack(draft_tokens)
+        p_draft = jnp.stack(draft_probs)
+
+        # ---- verify: one (1, k+1) target forward at the running offset
+        v_in = jnp.concatenate(
+            [jnp.asarray([tokens[-1]], jnp.int32), draft_vec])[None, :]
+        t_logits, t_cache = _step_logits(
+            target_multi, params, t_cache, v_in)
+        rng, r = jax.random.split(rng)
+        n, nxt = _accept(r, draft_vec, p_draft, k, temperature, top_k,
+                         t_logits[0].astype(jnp.float32))
+        n = int(n)
+
+        # ---- commit + roll both caches back to the accepted prefix
+        new_tokens = [int(t) for t in draft_vec[:n]] + [int(nxt)]
+        tokens.extend(new_tokens)
+        produced += len(new_tokens)
+        rounds += 1
+        accepted_total += n
+        # target wrote k+1 KVs (pending + k drafts); valid prefix is
+        # pending + n accepted → C + 1 + n. tokens[-1] is the new pending.
+        t_cache = _set_cache_index(t_cache, C + 1 + n)
+        # draft wrote len(d_in) + (k-1) KVs, covering committed tokens up
+        # to d_{k-1} — everything accepted except a fully-accepted d_k.
+        d_valid = min(C + 1 + n, C + k)
+        d_cache = _set_cache_index(d_cache, d_valid)
+        if eos_id is not None and eos_id in new_tokens:
+            cut = len(tokens) - len(new_tokens) + new_tokens.index(eos_id)
+            tokens = tokens[: cut + 1]
+            break
+
+    tokens = tokens[: S + max_new_tokens]
+    if eos_id is not None and len(tokens) < S + max_new_tokens:
+        tokens += [eos_id] * (S + max_new_tokens - len(tokens))
+    out = jnp.asarray([tokens], jnp.int32)
+    if return_stats:
+        return out, {
+            "rounds": rounds,
+            "accept_rate": accepted_total / max(rounds * k, 1),
+            "tokens_per_round": (len(tokens) - S) / max(rounds, 1),
+        }
+    return out
