@@ -122,39 +122,67 @@ class CheckpointManager:
             )
         return state, (restored["meta"] or {})
 
-    def restore_params_only(self, abstract_params: Any,
-                            step: int | None = None) -> Any | None:
-        """Restore just the ``params`` subtree of a saved TrainState —
-        the LoRA warm-start path (config ``lora.base_checkpoint``), where
-        the source run's optimizer state is meaningless to the new run
-        (different optax tree once the adapter mask wraps it).
-
-        ``abstract_params`` carries target shapes/dtypes/shardings, so the
-        params land directly in this run's mesh layout. The source run's
-        other keys (opt_state, EMA mirror) are never deserialized."""
+    def restore_partial(self, item: dict,
+                        step: int | None = None) -> dict | None:
+        """Restore only the named subtrees of a saved TrainState (e.g.
+        ``{"params": ..., "batch_stats": ...}``). Template leaves carry
+        target shapes/dtypes/shardings, so arrays land directly in the
+        caller's mesh layout; every subtree NOT named (opt_state, the EMA
+        mirror — 2-3x params for adam at 7B) is never deserialized."""
         if step is None:
             step = self.latest_step()
         if step is None:
             return None
-        # PyTreeRestore(partial_restore=True) reads ONLY the params
-        # subtree named in the template: the source run's opt_state /
-        # EMA mirror (2-3x params for adam at 7B) is never deserialized.
+        # partial_restore=True returns the TEMPLATE LEAVES UNCHANGED for
+        # keys absent from the checkpoint (no error) — refuse up front,
+        # otherwise a caller naming e.g. 'ema_params' against a non-EMA
+        # checkpoint would get ShapeDtypeStructs where arrays belong.
+        saved = self.saved_state_keys(step)
+        missing = set(item) - saved if saved is not None else set()
+        if missing:
+            raise KeyError(
+                f"checkpoint step {step} in {self.dir} has no "
+                f"{sorted(missing)} (saved keys: {sorted(saved)})")
         item_dir = os.path.join(self.dir, str(step), "state")
         ckptr = ocp.PyTreeCheckpointer()
-        restored = ckptr.restore(
+        # construct_restore_args carries the template's shardings into the
+        # deserializer; without it PyTreeRestore silently restores every
+        # array single-device (an all-gather-to-chip-0 OOM at 7B).
+        restore_args = ocp.checkpoint_utils.construct_restore_args(item)
+        return ckptr.restore(
             item_dir,
-            args=ocp.args.PyTreeRestore(item={"params": abstract_params},
+            args=ocp.args.PyTreeRestore(item=item,
+                                        restore_args=restore_args,
                                         partial_restore=True),
         )
-        return restored["params"]
+
+    def restore_params_only(self, abstract_params: Any,
+                            step: int | None = None) -> Any | None:
+        """Restore just the ``params`` subtree — the LoRA warm-start path
+        (config ``lora.base_checkpoint``), where the source run's
+        optimizer state is meaningless to the new run (different optax
+        tree once the adapter mask wraps it)."""
+        restored = self.restore_partial({"params": abstract_params}, step)
+        return None if restored is None else restored["params"]
+
+    def saved_state_keys(self, step: int) -> set[str] | None:
+        """Top-level keys of the saved state tree at ``step`` (read from
+        the item's own pytree metadata — the manager's item_metadata needs
+        a handler registry this codepath doesn't keep), or None when the
+        metadata cannot be read."""
+        try:
+            meta = ocp.PyTreeCheckpointer().metadata(
+                os.path.join(self.dir, str(step), "state"))
+            return set(dict(meta.item_metadata.tree).keys())
+        except Exception:
+            return None
 
     def _ckpt_has(self, step: int, key: str) -> bool:
         """Whether the saved state tree at ``step`` contains ``key``."""
-        try:
-            meta = self.mgr.item_metadata(step)["state"]
-            return key in meta
-        except Exception:
+        keys = self.saved_state_keys(step)
+        if keys is None:
             return True  # metadata unavailable → assume matching layout
+        return key in keys
 
     def read_meta(self, step: int | None = None) -> dict:
         """Read just the JSON meta of a saved step (no state restore) —

@@ -406,6 +406,21 @@ class LoraConfig:
 
 
 @dataclass
+class DistillConfig:
+    """Knowledge distillation (distill.py). Enabled when
+    ``teacher_checkpoint`` names a checkpoint directory; the teacher's
+    architecture is read from that checkpoint's saved config, so nothing
+    about the teacher is re-declared here."""
+
+    teacher_checkpoint: str = ""
+    # total = alpha * hard_loss + (1 - alpha) * kd_term
+    alpha: float = 0.5
+    # Softmax temperature for both teacher and student in the KD term
+    # (the kd gradient is scaled by T^2 per Hinton et al. 2015).
+    temperature: float = 2.0
+
+
+@dataclass
 class TrainConfig:
     """Root config. Serialises to/from JSON; dotted-path CLI overrides."""
 
@@ -418,6 +433,7 @@ class TrainConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     lora: LoraConfig = field(default_factory=LoraConfig)
+    distill: DistillConfig = field(default_factory=DistillConfig)
     # Train loop horizon: epochs if >0, else total_steps.
     epochs: int = 0
     total_steps: int = 1000
@@ -484,6 +500,7 @@ _SECTIONS = {
     "checkpoint": CheckpointConfig,
     "obs": ObsConfig,
     "lora": LoraConfig,
+    "distill": DistillConfig,
 }
 
 

@@ -1,0 +1,138 @@
+"""Knowledge distillation: a frozen teacher's logits guide the student.
+
+Beyond-reference training capability (the [SPEC] harness trains from
+labels only) in the classic Hinton et al. 2015 shape torch users build by
+hand: total = alpha * hard_xent + (1-alpha) * T^2 * KL(teacher_T ||
+student_T). Practical pairing here: distill a small llama draft from a
+trained target so speculative decoding (speculative.py) gets a
+high-acceptance draft — the acceptance rate is exactly what KD optimizes
+(matching the target's token distributions).
+
+TPU-native construction: the teacher forward runs INSIDE the student's
+jitted train step (steps.make_train_step's ``teacher_fn`` hook) in
+eval mode under the same GSPMD shardings, so teacher activations never
+leave the device and XLA schedules teacher+student compute together. The
+teacher's architecture is not re-specified in the student config — it is
+read from the teacher checkpoint's own saved config JSON, and its
+params/batch_stats restore via the same partial-restore path as the LoRA
+warm start (opt_state is never read).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu import steps as steps_lib
+from pytorch_distributed_train_tpu.checkpoint import CheckpointManager
+
+
+def load_teacher(distill_cfg, precision, mesh, student_loss: str):
+    """Build the teacher model and restore its weights.
+
+    Returns (model, variables) where variables = {'params', 'batch_stats'}
+    ready for eval-mode apply. The teacher's ModelConfig comes from the
+    config JSON the CheckpointManager stored beside the weights; its
+    params are sharded by its own family's partition rules over the
+    student's mesh (a 7B teacher stays sharded, not replicated)."""
+    import dataclasses
+
+    from pytorch_distributed_train_tpu.config import (
+        CheckpointConfig,
+        TrainConfig,
+    )
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+
+    src = CheckpointManager(
+        CheckpointConfig(dir=distill_cfg.teacher_checkpoint, resume="none"))
+    meta = src.read_meta()
+    if not meta.get("config"):
+        raise FileNotFoundError(
+            f"distill.teacher_checkpoint={distill_cfg.teacher_checkpoint!r}"
+            " has no checkpoint with a saved config to build the teacher "
+            "from")
+    t_cfg = TrainConfig.from_dict(json.loads(meta["config"]))
+    model_cfg = t_cfg.model
+    if getattr(model_cfg, "fused_lm_loss", False):
+        # The student needs (B,S,V) teacher logits; run the teacher's
+        # dense head even if it trained with the fused one.
+        model_cfg = dataclasses.replace(model_cfg, fused_lm_loss=False)
+    model = build_model(model_cfg, precision)
+
+    def init(rng):
+        variables = model.init(
+            {"params": rng},
+            *steps_lib.dummy_inputs(student_loss, model_cfg, t_cfg.data),
+            train=False)
+        if t_cfg.lora.rank > 0:
+            # A LoRA teacher's learning lives entirely in its adapter
+            # leaves — the template must name them or partial_restore
+            # silently skips them and we'd distill from the frozen base.
+            from pytorch_distributed_train_tpu import lora as lora_lib
+
+            variables = dict(variables)
+            variables["params"] = lora_lib.inject(
+                jax.random.fold_in(rng, 0x10FA), variables["params"],
+                t_cfg.lora)
+        return variables
+
+    shape = jax.eval_shape(init, jax.random.PRNGKey(0))
+    rules = rules_for_model(model_cfg.name)
+    p_shard = rules.tree_shardings(mesh, shape["params"])
+    p_abstract = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        shape["params"], p_shard)
+    # The teacher's SERVED weights: the EMA mirror when the run kept one
+    # (eval/best-ckpt were measured on it — train_state.eval_params), the
+    # raw params otherwise.
+    step = src.latest_step()
+    saved = src.saved_state_keys(step) if step is not None else None
+    params_key = ("ema_params"
+                  if saved is not None and "ema_params" in saved
+                  else "params")
+    abstract = {params_key: p_abstract}
+    if shape.get("batch_stats"):
+        # BN teachers (resnet) need their running stats for eval mode;
+        # stats are tiny — replicate.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        abstract["batch_stats"] = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep),
+            shape["batch_stats"])
+    restored = src.restore_partial(abstract, step)
+    src.close()
+    if restored is None:
+        raise FileNotFoundError(
+            f"distill.teacher_checkpoint={distill_cfg.teacher_checkpoint!r}"
+            " has no checkpoint step to restore")
+    params = restored[params_key]
+    if t_cfg.lora.rank > 0:
+        from pytorch_distributed_train_tpu import lora as lora_lib
+
+        params = lora_lib.strip(params, t_cfg.lora)
+    variables = {"params": params}
+    if "batch_stats" in restored:
+        variables["batch_stats"] = restored["batch_stats"]
+    return model, variables, model_cfg
+
+
+def make_teacher_fn(model, variables):
+    """The train-step hook: batch -> (B, ..., V) fp32 teacher logits,
+    computed in eval mode with no gradient path (the KD term re-asserts
+    stop_gradient). Closes over the teacher tree; under jit the arrays
+    become ordinary device inputs, not baked constants."""
+    batch_stats = variables.get("batch_stats", {})
+
+    def teacher_fn(batch):
+        logits, _, _ = steps_lib.apply_model(
+            model, variables["params"], batch_stats, batch,
+            train=False, dropout_rng=None)
+        return jax.lax.stop_gradient(logits.astype(jnp.float32))
+
+    return teacher_fn

@@ -128,14 +128,24 @@ def _decode_step(model, params, cache, ids):
     return logits[:, -1], updated["cache"]
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def filter_logits(logits, temperature: float, top_k: int):
+    """THE sampling law's logit filtering — temperature scaling + top-k
+    truncation. Single definition shared by the direct sampler below and
+    speculative.py's draft/verify distributions (whose exactness guarantee
+    is 'same law as generate()'); requires temperature > 0."""
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filter_logits(logits, temperature, top_k), axis=-1
+    ).astype(jnp.int32)
 
 
 def generate(model, params, prompt_ids, max_new_tokens: int,

@@ -141,6 +141,65 @@ def chunked_causal_ce(x, kernel, input_ids, loss_mask=None,
     return {"loss_sum": loss_sum, "weight_sum": weight_sum}
 
 
+def _kd_term(student_logits, teacher_logits, weights, temperature: float):
+    """Hinton-style distillation term: T^2 * KL(softmax(t/T) ||
+    softmax(s/T)), position-weighted mean. The T^2 factor keeps the KD
+    gradient magnitude comparable to the hard loss as T varies (Hinton et
+    al. 2015 §2); teacher logits enter under stop_gradient so the graph
+    never differentiates through the teacher forward."""
+    t = jax.lax.stop_gradient(teacher_logits.astype(jnp.float32))
+    s = student_logits.astype(jnp.float32)
+    log_p_t = jax.nn.log_softmax(t / temperature, axis=-1)
+    log_q_s = jax.nn.log_softmax(s / temperature, axis=-1)
+    kl = jnp.sum(jnp.exp(log_p_t) * (log_p_t - log_q_s), axis=-1)
+    if weights is None:
+        kd = kl.mean()
+    else:
+        w = weights.astype(jnp.float32)
+        kd = (kl * w).sum() / jnp.maximum(w.sum(), 1.0)
+    return kd * temperature**2
+
+
+def make_distill_loss(base_fn, base_name: str, alpha: float,
+                      temperature: float):
+    """Wrap a base loss with knowledge distillation (distill.py):
+
+        total = alpha * hard_loss + (1 - alpha) * kd_term
+
+    The batch must carry ``teacher_logits`` (same shape as the student's
+    logits — steps.make_train_step's ``teacher_fn`` hook adds them). The
+    KD positions/weights mirror each base loss's own: all positions for
+    classification, ``label_weights`` for MLM, the shifted ``loss_mask``
+    for causal LM."""
+    if base_name not in ("softmax_xent", "mlm_xent", "causal_lm_xent"):
+        raise ValueError(
+            f"distillation needs per-position logits; loss {base_name!r} "
+            "is unsupported (fused_causal_lm_xent never materializes them)")
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"distill.alpha must be in [0, 1], got {alpha}")
+    if temperature <= 0.0:
+        raise ValueError(
+            f"distill.temperature must be > 0, got {temperature}")
+
+    def fn(logits, batch, *args):
+        hard, metrics = base_fn(logits, batch, *args)
+        t_logits = batch["teacher_logits"]
+        if base_name == "softmax_xent":
+            s, t, w = logits, t_logits, None
+        elif base_name == "mlm_xent":
+            s, t, w = logits, t_logits, batch["label_weights"]
+        else:  # causal_lm_xent — same shift as the base loss
+            s, t = logits[:, :-1], t_logits[:, :-1]
+            ids = batch["input_ids"]
+            w = batch.get("loss_mask",
+                          jnp.ones_like(ids, jnp.float32))[:, 1:]
+        kd = _kd_term(s, t, w, temperature)
+        total = alpha * hard + (1.0 - alpha) * kd
+        return total, {**metrics, "hard_loss": hard, "kd_loss": kd}
+
+    return fn
+
+
 LOSSES = {
     "softmax_xent": softmax_xent,
     "mlm_xent": mlm_xent,

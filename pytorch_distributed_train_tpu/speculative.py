@@ -43,19 +43,18 @@ from flax import traverse_util
 
 from pytorch_distributed_train_tpu.generate import (
     build_decode_model,
+    filter_logits,
     init_cache,
 )
 
 
 def _filtered_probs(logits, temperature: float, top_k: int):
     """Temperature/top-k-adjusted probabilities. Both models' laws are
-    modified identically, and spec sampling is exact w.r.t. the MODIFIED
-    target law (the standard convention). logits: (..., V), fp32."""
-    logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.nn.softmax(logits, axis=-1)
+    modified identically — via generate.filter_logits, the SAME filtering
+    generate() samples with — and spec sampling is exact w.r.t. the
+    modified target law (the standard convention). logits: (..., V)."""
+    return jax.nn.softmax(filter_logits(logits, temperature, top_k),
+                          axis=-1)
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
@@ -69,6 +68,20 @@ def _step_logits(model, params, cache, ids):
         mutable=["cache"],
     )
     return logits, updated["cache"]
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _draft_sample(logits_last, rng, temperature: float, top_k: int):
+    """One fused dispatch per proposed token: (token, draft probs)."""
+    if temperature == 0.0:
+        # _accept's greedy branch never reads p_draft — skip the
+        # full-vocab softmax and return a placeholder.
+        return (jnp.argmax(logits_last).astype(jnp.int32),
+                jnp.zeros((logits_last.shape[-1],), jnp.float32))
+    p = _filtered_probs(logits_last, temperature, top_k)
+    tok = jax.random.categorical(
+        rng, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
+    return tok, p
 
 
 @partial(jax.jit, static_argnums=(3, 4, 5))
@@ -202,16 +215,7 @@ def speculative_generate(model_cfg, precision, params,
         draft_probs = []
         for i in range(k):
             rng, r = jax.random.split(rng)
-            if temperature == 0.0:
-                # _accept's greedy branch never reads p_draft — skip the
-                # full-vocab softmax entirely (it's per proposed token in
-                # the latency-bound loop) and pass a placeholder.
-                tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
-                p = jnp.zeros((logits.shape[-1],), jnp.float32)
-            else:
-                p = _filtered_probs(logits[0, -1], temperature, top_k)
-                tok = jax.random.categorical(r, jnp.log(
-                    jnp.maximum(p, 1e-30))).astype(jnp.int32)
+            tok, p = _draft_sample(logits[0, -1], r, temperature, top_k)
             draft_tokens.append(tok)
             draft_probs.append(p)
             if i + 1 < k:  # d_k's own forward is never needed this round

@@ -25,6 +25,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from pytorch_distributed_train_tpu.train_state import TrainState
 
 
+def dummy_inputs(loss: str, model_cfg, data_cfg) -> tuple:
+    """Tiny dummy inputs for model.init / eval_shape, dispatched the same
+    way ``model_inputs`` dispatches real batches (shared by Trainer init
+    and distill.py's teacher loading)."""
+    if loss == "softmax_xent":
+        return (jnp.zeros(
+            (2, model_cfg.image_size, model_cfg.image_size, 3),
+            jnp.float32),)
+    if loss == "mlm_xent":
+        ids = jnp.zeros((2, data_cfg.seq_len), jnp.int32)
+        return (ids, jnp.ones((2, data_cfg.seq_len), jnp.int32))
+    return (jnp.zeros((2, data_cfg.seq_len), jnp.int32),)
+
+
 def model_inputs(batch: dict) -> tuple:
     """Dispatch batch dict → model positional args (registry-wide convention:
     vision models take images NHWC; BERT takes (input_ids, attention_mask);
@@ -84,7 +98,8 @@ def _tree_finite(tree) -> jnp.ndarray:
 def make_train_step(model, loss_fn: Callable, tx,
                     ema_decay: float = 0.0, mixup=None,
                     module_grad_norms: bool = False,
-                    param_transform: Callable | None = None) -> Callable:
+                    param_transform: Callable | None = None,
+                    teacher_fn: Callable | None = None) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
     closes over the optax transform (and the static EMA decay / mixup
     transform); jit-wrapped by the caller with explicit shardings.
@@ -102,6 +117,11 @@ def make_train_step(model, loss_fn: Callable, tx,
         dropout_rng = jax.random.fold_in(rng, state.step)
         if mixup is not None:
             batch = mixup(batch, jax.random.fold_in(dropout_rng, 1))
+        if teacher_fn is not None:
+            # Distillation (distill.py): the frozen teacher scores the
+            # (possibly mixup-transformed) batch in the same executable;
+            # the KD loss reads batch['teacher_logits'].
+            batch = {**batch, "teacher_logits": teacher_fn(batch)}
 
         scale = state.dynamic_scale.scale if state.dynamic_scale is not None else None
 

@@ -89,6 +89,27 @@ class Trainer:
                 "(optax.multi_transform nests per-label inner states)")
         self.loss_fn = losses_lib.get_loss_fn(
             cfg.loss, label_smoothing=cfg.label_smoothing)
+        # Eval always scores the plain objective; the KD wrap below only
+        # applies to training (eval batches carry no teacher_logits).
+        self.eval_loss_fn = self.loss_fn
+        self.teacher_fn = None
+        if cfg.distill.teacher_checkpoint:
+            from pytorch_distributed_train_tpu import distill as distill_lib
+
+            t_model, t_vars, t_cfg = distill_lib.load_teacher(
+                cfg.distill, cfg.precision, self.mesh, cfg.loss)
+            t_dim = (t_cfg.num_classes if cfg.loss == "softmax_xent"
+                     else t_cfg.vocab_size)
+            s_dim = (cfg.model.num_classes if cfg.loss == "softmax_xent"
+                     else cfg.model.vocab_size)
+            if t_dim != s_dim:
+                raise ValueError(
+                    f"teacher output dim ({t_dim}) != student ({s_dim}) — "
+                    "distillation compares per-class/token distributions")
+            self.teacher_fn = distill_lib.make_teacher_fn(t_model, t_vars)
+            self.loss_fn = losses_lib.make_distill_loss(
+                self.loss_fn, cfg.loss, cfg.distill.alpha,
+                cfg.distill.temperature)
         self.rules = rules_for_model(cfg.model.name)
 
         # ---- data
@@ -153,7 +174,8 @@ class Trainer:
             self.model, self.loss_fn, self.tx,
             ema_decay=cfg.optim.ema_decay, mixup=mixup,
             module_grad_norms=cfg.obs.log_module_grad_norms,
-            param_transform=param_transform)
+            param_transform=param_transform,
+            teacher_fn=self.teacher_fn)
         if cfg.optim.offload_state:
             train_step = steps_lib.offload_opt_state(
                 train_step, opt_dev_sharding, self.state_sharding.opt_state)
@@ -162,7 +184,7 @@ class Trainer:
         )
         self.eval_step = steps_lib.jit_eval_step(
             steps_lib.make_eval_step(
-                self.model, self.loss_fn,
+                self.model, self.eval_loss_fn,
                 schedule_free=cfg.optim.name == "schedule_free_adamw",
                 param_transform=param_transform),
             self.mesh, self.state_sharding, self.batch_axes,
@@ -273,13 +295,8 @@ class Trainer:
         )
 
     def _dummy_inputs(self) -> tuple:
-        m, d = self.cfg.model, self.cfg.data
-        if self.cfg.loss == "softmax_xent":
-            return (jnp.zeros((2, m.image_size, m.image_size, 3), jnp.float32),)
-        if self.cfg.loss == "mlm_xent":
-            ids = jnp.zeros((2, d.seq_len), jnp.int32)
-            return (ids, jnp.ones((2, d.seq_len), jnp.int32))
-        return (jnp.zeros((2, d.seq_len), jnp.int32),)
+        return steps_lib.dummy_inputs(self.cfg.loss, self.cfg.model,
+                                      self.cfg.data)
 
     @property
     def items_per_step(self) -> int:

@@ -1,0 +1,234 @@
+"""Knowledge distillation (distill.py, losses.make_distill_loss):
+KD-term math, config guards, and the teacher-from-checkpoint workflow
+end to end for both LM (llama) and BN-vision (resnet) teachers.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_train_tpu.config import TrainConfig
+from pytorch_distributed_train_tpu.losses import get_loss_fn, make_distill_loss
+
+V = 64
+
+
+def _lm_batch(b=2, s=8, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, V, (b, s)), jnp.int32)
+    logits = jnp.asarray(rng.standard_normal((b, s, V)), jnp.float32)
+    return ids, logits
+
+
+def test_kd_zero_when_teacher_equals_student():
+    ids, logits = _lm_batch()
+    base = get_loss_fn("causal_lm_xent")
+    fn = make_distill_loss(base, "causal_lm_xent", alpha=0.0,
+                           temperature=2.0)
+    batch = {"input_ids": ids, "teacher_logits": logits}
+    total, metrics = fn(logits, batch)
+    assert abs(float(metrics["kd_loss"])) < 1e-5
+    assert abs(float(total)) < 1e-5  # alpha=0 → total is the KD term
+
+
+def test_alpha_one_reduces_to_base_loss():
+    ids, logits = _lm_batch()
+    rng = np.random.default_rng(1)
+    t_logits = jnp.asarray(rng.standard_normal(logits.shape), jnp.float32)
+    base = get_loss_fn("causal_lm_xent")
+    fn = make_distill_loss(base, "causal_lm_xent", alpha=1.0,
+                           temperature=4.0)
+    batch = {"input_ids": ids, "teacher_logits": t_logits}
+    total, metrics = fn(logits, batch)
+    ref, _ = base(logits, {"input_ids": ids})
+    np.testing.assert_allclose(float(total), float(ref), rtol=1e-6)
+    assert float(metrics["kd_loss"]) > 0.0  # reported even when unweighted
+
+
+def test_kd_gradient_pulls_student_toward_teacher():
+    """A gradient step on the KD term must reduce teacher-student KL."""
+    ids, logits = _lm_batch()
+    rng = np.random.default_rng(2)
+    t_logits = jnp.asarray(rng.standard_normal(logits.shape), jnp.float32)
+    fn = make_distill_loss(get_loss_fn("causal_lm_xent"),
+                           "causal_lm_xent", alpha=0.0, temperature=1.0)
+    batch = {"input_ids": ids, "teacher_logits": t_logits}
+    kd = lambda s: fn(s, batch)[0]  # noqa: E731
+    g = jax.grad(kd)(logits)
+    assert float(kd(logits - 0.5 * g)) < float(kd(logits))
+
+
+def test_guards():
+    base = get_loss_fn("causal_lm_xent")
+    with pytest.raises(ValueError, match="fused"):
+        make_distill_loss(base, "fused_causal_lm_xent", 0.5, 2.0)
+    with pytest.raises(ValueError, match="alpha"):
+        make_distill_loss(base, "causal_lm_xent", 1.5, 2.0)
+    with pytest.raises(ValueError, match="temperature"):
+        make_distill_loss(base, "causal_lm_xent", 0.5, 0.0)
+
+
+def _teacher_cfg(tmp_path, name, **model_kw):
+    cfg = TrainConfig()
+    cfg.model.name = name
+    for k, v in model_kw.items():
+        setattr(cfg.model, k, v)
+    if name == "llama":
+        cfg.loss = "causal_lm_xent"
+        cfg.data.dataset = "synthetic_lm"
+        cfg.data.seq_len = 16
+    else:
+        cfg.model.num_classes = 10
+        cfg.model.image_size = 8
+        cfg.data.dataset = "synthetic_images"
+    cfg.data.synthetic_size = 64
+    cfg.data.batch_size = 8
+    cfg.data.num_workers = 1
+    cfg.optim.name = "sgd"
+    cfg.optim.learning_rate = 0.01
+    cfg.optim.schedule = "constant"
+    cfg.optim.warmup_steps = 0
+    cfg.total_steps = 2
+    cfg.checkpoint.dir = str(tmp_path / f"teacher_{name}")
+    cfg.checkpoint.save_every_steps = 2
+    cfg.checkpoint.async_save = False
+    cfg.obs.log_every_steps = 100
+    return cfg
+
+
+def _read_metrics(ckpt_dir):
+    rows = []
+    with open(f"{ckpt_dir}/metrics.jsonl") as f:
+        for line in f:
+            rows.append(json.loads(line))
+    return rows
+
+
+@pytest.mark.slow
+def test_llama_distill_e2e(tmp_path):
+    """Teacher trains and checkpoints; the student run reads the teacher
+    architecture from the checkpoint's saved config, restores its params
+    via partial restore, and the train metrics carry finite kd/hard
+    losses — the draft-for-speculative-decoding training recipe."""
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    t_kw = dict(vocab_size=V, hidden_size=32, num_layers=2, num_heads=4,
+                num_kv_heads=2, mlp_dim=64, max_seq_len=32)
+    teacher = Trainer(_teacher_cfg(tmp_path, "llama", **t_kw))
+    teacher.fit()
+    teacher.close()
+
+    s_cfg = _teacher_cfg(tmp_path, "llama", **{**t_kw, "hidden_size": 16,
+                                               "num_heads": 2,
+                                               "num_kv_heads": 2,
+                                               "mlp_dim": 32,
+                                               "num_layers": 1})
+    s_cfg.checkpoint.dir = str(tmp_path / "student")
+    s_cfg.distill.teacher_checkpoint = str(tmp_path / "teacher_llama")
+    s_cfg.distill.alpha = 0.3
+    s_cfg.obs.log_every_steps = 1
+    student = Trainer(s_cfg)
+    student.fit()
+    student.close()
+
+    train_rows = [r for r in _read_metrics(s_cfg.checkpoint.dir)
+                  if "kd_loss" in r]
+    assert train_rows, "kd_loss never logged"
+    assert all(np.isfinite(r["kd_loss"]) and np.isfinite(r["hard_loss"])
+               for r in train_rows)
+
+
+@pytest.mark.slow
+def test_resnet_distill_e2e(tmp_path):
+    """BN teacher: batch_stats restore through the partial-restore path
+    (eval-mode teacher needs running stats, not batch stats)."""
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    teacher = Trainer(_teacher_cfg(tmp_path, "resnet18"))
+    teacher.fit()
+    teacher.close()
+
+    s_cfg = _teacher_cfg(tmp_path, "resnet18")
+    s_cfg.checkpoint.dir = str(tmp_path / "student_rn")
+    s_cfg.distill.teacher_checkpoint = str(tmp_path / "teacher_resnet18")
+    s_cfg.obs.log_every_steps = 1
+    student = Trainer(s_cfg)
+    student.fit()
+    student.close()
+    rows = [r for r in _read_metrics(s_cfg.checkpoint.dir)
+            if "kd_loss" in r]
+    assert rows and all(np.isfinite(r["kd_loss"]) for r in rows)
+
+
+@pytest.mark.slow
+def test_teacher_served_weights(tmp_path):
+    """load_teacher must return the teacher's SERVED weights: the EMA
+    mirror when the run kept one, and the adapter-merged tree when the
+    teacher was LoRA-fine-tuned — not the raw/frozen base in either case.
+    """
+    from pytorch_distributed_train_tpu import distill as distill_lib
+    from pytorch_distributed_train_tpu import lora as lora_lib
+    from pytorch_distributed_train_tpu.config import PrecisionConfig
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    t_kw = dict(vocab_size=V, hidden_size=16, num_layers=1, num_heads=2,
+                num_kv_heads=2, mlp_dim=32, max_seq_len=32)
+
+    # EMA teacher
+    cfg = _teacher_cfg(tmp_path, "llama", **t_kw)
+    cfg.checkpoint.dir = str(tmp_path / "t_ema")
+    cfg.optim.ema_decay = 0.5
+    t = Trainer(cfg)
+    t.fit()
+    ema_ref = jax.device_get(t.state.ema_params)
+    raw_ref = jax.device_get(t.state.params)
+    t.close()
+    cfg.distill.teacher_checkpoint = cfg.checkpoint.dir
+    mesh = build_mesh(cfg.mesh)
+    _, tvars, _ = distill_lib.load_teacher(
+        cfg.distill, PrecisionConfig(), mesh, "causal_lm_xent")
+    got = jax.device_get(tvars["params"])
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 got, ema_ref)
+    assert not all(
+        np.array_equal(a, b) for a, b in
+        zip(jax.tree.leaves(got), jax.tree.leaves(raw_ref)))
+
+    # LoRA teacher: served weights are base + merged adapters
+    cfg2 = _teacher_cfg(tmp_path, "llama", **t_kw)
+    cfg2.checkpoint.dir = str(tmp_path / "t_lora")
+    cfg2.lora.rank = 2
+    cfg2.optim.name = "adamw"
+    cfg2.optim.learning_rate = 1e-2
+    t2 = Trainer(cfg2)
+    t2.fit()
+    merged_ref = jax.device_get(
+        lora_lib.strip(t2.state.params, cfg2.lora))
+    t2.close()
+    cfg2.distill.teacher_checkpoint = cfg2.checkpoint.dir
+    _, tvars2, _ = distill_lib.load_teacher(
+        cfg2.distill, PrecisionConfig(), mesh, "causal_lm_xent")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        jax.device_get(tvars2["params"]), merged_ref)
+
+
+def test_vocab_mismatch_is_loud(tmp_path):
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    t_kw = dict(vocab_size=V, hidden_size=16, num_layers=1, num_heads=2,
+                num_kv_heads=2, mlp_dim=32, max_seq_len=32)
+    teacher = Trainer(_teacher_cfg(tmp_path, "llama", **t_kw))
+    teacher.fit()
+    teacher.close()
+
+    s_cfg = _teacher_cfg(tmp_path, "llama",
+                         **{**t_kw, "vocab_size": V * 2})
+    s_cfg.checkpoint.dir = str(tmp_path / "student_bad")
+    s_cfg.distill.teacher_checkpoint = str(tmp_path / "teacher_llama")
+    with pytest.raises(ValueError, match="teacher output dim"):
+        Trainer(s_cfg)
