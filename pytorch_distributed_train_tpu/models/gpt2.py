@@ -35,6 +35,9 @@ class GPT2Attention(nn.Module):
     window: int = 0  # sliding-window attention (0 = full causal)
     quant: str = ""  # "" | "int8" (quant.int8_dot_general QAT matmuls)
     decode: bool = False  # KV cache (same contract as llama.py decode)
+    # S>1 appends at the running offset instead of prefilling from 0
+    # (speculative.py's verify pass — same contract as llama.py)
+    decode_multi: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -57,7 +60,8 @@ class GPT2Attention(nn.Module):
                                 (B, L, self.num_heads, head_dim), v.dtype)
             c_i = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
-            if S > 1:  # prefill from position 0 (generate.py contract)
+            if S > 1 and not self.decode_multi:
+                # prefill from position 0 (generate.py contract)
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
                     c_k.value, k, 0, 1)
                 c_v.value = jax.lax.dynamic_update_slice_in_dim(
@@ -105,6 +109,7 @@ class GPT2Block(nn.Module):
     window: int = 0
     quant: str = ""
     decode: bool = False
+    decode_multi: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -120,6 +125,7 @@ class GPT2Block(nn.Module):
                           self.param_dtype, cp=self.cp,
                           attn_impl=self.attn_impl, window=self.window,
                           quant=self.quant, decode=self.decode,
+                          decode_multi=self.decode_multi,
                           name="attn")(h),
             deterministic=self.deterministic)
         h = ln("ln_2")(x).astype(self.dtype)
@@ -156,6 +162,8 @@ class GPT2LMHead(nn.Module):
     attention_window: int = 0  # sliding window (0 = full causal)
     quant_training: str = ""  # "" | "int8" AQT matmuls (tied head stays fp)
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
+    # Multi-token continuation in decode mode (speculative.py verify pass)
+    decode_multi: bool = False
     # Fused chunked head+CE over the tied embedding (losses.chunked_causal_ce)
     fused_loss: bool = False
     act: "object | None" = None
@@ -170,9 +178,10 @@ class GPT2LMHead(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (self.max_seq_len, self.hidden_size),
                          self.param_dtype)
-        if self.decode and S == 1:
-            # single-token step at the running offset (prefill resets to 0,
-            # same contract as the attention caches)
+        if self.decode and (S == 1 or self.decode_multi):
+            # step(s) at the running offset: single-token decode, or a
+            # multi-token continuation (speculative.py verify — positions
+            # are the absolute idx..idx+S-1, same as the attention cache)
             p_i = self.variable("cache", "pos_index",
                                 lambda: jnp.zeros((), jnp.int32))
             pos = jax.lax.dynamic_slice_in_dim(wpe, p_i.value, S, 0)
@@ -198,7 +207,8 @@ class GPT2LMHead(nn.Module):
                 self.dropout_rate, deterministic, self.dtype,
                 self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
                 window=self.attention_window, quant=self.quant_training,
-                decode=self.decode, name=f"h{i}",
+                decode=self.decode, decode_multi=self.decode_multi,
+                name=f"h{i}",
             )(x)
             if self.act is not None:
                 x = self.act.constrain(x)

@@ -136,10 +136,11 @@ def _set_cache_index(cache, idx: int):
     """Roll a static KV cache to ``idx`` committed tokens. Entries past
     the index are stale but position-masked (models/llama.py builds the
     decode mask from cache_index, not buffer contents), so resetting the
-    per-layer index scalars IS the rollback."""
+    per-layer index scalars IS the rollback. ``pos_index`` is gpt2's
+    learned-position counter (models/gpt2.py) — same discipline."""
     flat = traverse_util.flatten_dict(cache, sep="/")
     for path in flat:
-        if path.rsplit("/", 1)[-1] == "cache_index":
+        if path.rsplit("/", 1)[-1] in ("cache_index", "pos_index"):
             flat[path] = jnp.full((), idx, jnp.int32)
     return traverse_util.unflatten_dict(flat, sep="/")
 

@@ -33,9 +33,9 @@ from pytorch_distributed_train_tpu.speculative import (
 V = 64
 
 
-def _cfg(layers=2, hidden=32, heads=4):
+def _cfg(layers=2, hidden=32, heads=4, name="llama"):
     return ModelConfig(
-        name="llama", vocab_size=V, hidden_size=hidden, num_layers=layers,
+        name=name, vocab_size=V, hidden_size=hidden, num_layers=layers,
         num_heads=heads, num_kv_heads=2, mlp_dim=hidden * 2,
         max_seq_len=64, dropout_rate=0.0)
 
@@ -52,10 +52,11 @@ def _prompt(s=8, seed=0):
     return jnp.asarray(rng.integers(0, V, (1, s)), jnp.int32)
 
 
-def test_decode_multi_continuation_matches_full_forward():
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+def test_decode_multi_continuation_matches_full_forward(family):
     """A k-token continuation on the decode_multi path must produce the
     same per-position logits as the plain (cache-free) forward."""
-    cfg = _cfg()
+    cfg = _cfg(name=family)
     params = _init_params(cfg, 0)
     full_model = build_model(cfg, PrecisionConfig())
     ids = _prompt(12)
@@ -80,12 +81,13 @@ def test_decode_multi_continuation_matches_full_forward():
 
 
 @pytest.mark.parametrize("spec_k", [2, 4])
-def test_greedy_spec_matches_greedy_generate(spec_k):
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+def test_greedy_spec_matches_greedy_generate(family, spec_k):
     """temperature=0: speculative output must equal target-only greedy
     decoding token-for-token, for any draft (here: a different random
     model — near-worst-case acceptance)."""
-    cfg = _cfg()
-    draft_cfg = _cfg(layers=1, hidden=16, heads=2)
+    cfg = _cfg(name=family)
+    draft_cfg = _cfg(layers=1, hidden=16, heads=2, name=family)
     params = _init_params(cfg, 0)
     draft_params = _init_params(draft_cfg, 1)
     prompt = _prompt(8)
