@@ -62,6 +62,15 @@ class ModelConfig:
     # (set max_seq_len accordingly; positions divide by the factor).
     rope_scaling: float = 1.0
     rms_norm_eps: float = 1e-5
+    # T5 family (models/t5.py): decoder stack depth (0 → = num_layers) and
+    # the bucketed relative-position-bias geometry.
+    decoder_layers: int = 0
+    rel_pos_buckets: int = 32
+    rel_pos_max_distance: int = 128
+    # Tie the LM head to the shared embedding (t5: published v1.0
+    # checkpoints tie + rescale decoder output by d_model**-0.5; set true
+    # to load them via interop).
+    tie_word_embeddings: bool = False
     # Memory: rematerialise each transformer block's activations in backward
     remat: bool = False
     # What remat may keep resident (models/remat.py — the selective
@@ -162,6 +171,8 @@ class DataConfig:
     randaugment_magnitude: int = 9
     # LM datasets
     seq_len: int = 512
+    # Decoder-side target length for seq2seq datasets (0 → = seq_len).
+    tgt_seq_len: int = 0
     mlm_prob: float = 0.15
     # Real-text corpus (datasets text_lm / text_mlm, data/text.py): glob of
     # local .txt/.jsonl files, and an optional local HF-tokenizer directory
@@ -663,6 +674,33 @@ def _gpt2_small() -> TrainConfig:
     return c
 
 
+def _t5_small() -> TrainConfig:
+    """T5-small seq2seq pretrain (model-zoo extension beyond the BASELINE
+    matrix). HF-layout-compatible via interop's 't5' mapping
+    (feed_forward_proj='relu'); trains an UNTIED head — to load published
+    tied v1.0 checkpoints set model.tie_word_embeddings=true."""
+    c = TrainConfig(preset="t5_small")
+    c.model = ModelConfig(
+        name="t5", hidden_size=512, num_layers=6, decoder_layers=6,
+        num_heads=8, mlp_dim=2048, vocab_size=32128, max_seq_len=512,
+        dropout_rate=0.1,
+    )
+    c.data = DataConfig(dataset="synthetic_seq2seq", batch_size=128,
+                        seq_len=512, tgt_seq_len=128)
+    c.optim = OptimConfig(
+        # The T5 paper trains with Adafactor; inverse-sqrt decay is
+        # approximated with cosine here (the schedule families in
+        # optim.make_schedule).
+        name="adafactor", learning_rate=1e-2, weight_decay=0.0,
+        schedule="cosine", warmup_steps=10000, grad_clip_norm=1.0,
+    )
+    c.precision = PrecisionConfig(compute_dtype="bfloat16")
+    c.mesh = MeshConfig(data=-1)
+    c.total_steps = 500000
+    c.loss = "seq2seq_xent"
+    return c
+
+
 _PRESETS = {
     "resnet18_cifar10": _resnet18_cifar10,
     "resnet50_imagenet": _resnet50_imagenet,
@@ -670,6 +708,7 @@ _PRESETS = {
     "bert_base_mlm": _bert_base_mlm,
     "llama2_7b": _llama2_7b,
     "gpt2_small": _gpt2_small,
+    "t5_small": _t5_small,
 }
 
 

@@ -207,6 +207,21 @@ def synthetic_lm(size: int, seq_len: int, vocab_size: int, seed: int = 0) -> Arr
     return ArrayDataset({"input_ids": ids})
 
 
+def synthetic_seq2seq(size: int, src_len: int, tgt_len: int,
+                      vocab_size: int, seed: int = 0) -> ArrayDataset:
+    """Random source/target pairs in the T5 convention:
+    decoder_input_ids = labels shifted right with a 0 start token
+    (HF `_shift_right`; id 0 is T5's pad/decoder-start)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(1, vocab_size, size=(size, src_len)).astype(np.int32)
+    labels = rng.integers(1, vocab_size,
+                          size=(size, tgt_len)).astype(np.int32)
+    dec_in = np.concatenate(
+        [np.zeros((size, 1), np.int32), labels[:, :-1]], axis=1)
+    return ArrayDataset({"input_ids": src, "decoder_input_ids": dec_in,
+                         "labels": labels})
+
+
 class MLMDataset(ArrayDataset):
     """Token sequences + BERT-style dynamic masking applied at batch time.
 
@@ -582,6 +597,12 @@ def build_dataset(data_cfg, model_cfg, train: bool):
         return synthetic_lm(
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,
             seed=0 if train else 1,
+        )
+    if name == "synthetic_seq2seq":
+        return synthetic_seq2seq(
+            data_cfg.synthetic_size, data_cfg.seq_len,
+            data_cfg.tgt_seq_len or data_cfg.seq_len,
+            model_cfg.vocab_size, seed=0 if train else 1,
         )
     if name == "text_lm":
         from pytorch_distributed_train_tpu.data.text import build_text_dataset

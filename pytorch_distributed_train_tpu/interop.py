@@ -201,6 +201,56 @@ _HF_RULES: dict[str, list[tuple[str, str, str]]] = {
         (r"^ln_f/scale$", "transformer.ln_f.weight", "none"),
         (r"^ln_f/bias$", "transformer.ln_f.bias", "none"),
     ],
+    # T5 note: block layout is positional in HF — layer.0 = self-attn,
+    # layer.1 = cross-attn (decoder) or FF (encoder), layer.2 = FF
+    # (decoder). The relative-bias table exists in block 0 only (one per
+    # stack). encoder/decoder.embed_tokens aliases of `shared` are emitted
+    # in to_hf_state_dict.
+    "t5": [
+        (r"^shared/embedding$", "shared.weight", "none"),
+        (r"^enc_block(\d+)/self_attn/(q|k|v)_proj/kernel$",
+         "encoder.block.{0}.layer.0.SelfAttention.{1}.weight", "dgen_out3"),
+        (r"^enc_block(\d+)/self_attn/o_proj/kernel$",
+         "encoder.block.{0}.layer.0.SelfAttention.o.weight", "dgen_in3"),
+        (r"^enc_block0/self_attn/rel_bias/embedding$",
+         "encoder.block.0.layer.0.SelfAttention"
+         ".relative_attention_bias.weight", "none"),
+        (r"^enc_block(\d+)/ln_self/scale$",
+         "encoder.block.{0}.layer.0.layer_norm.weight", "none"),
+        (r"^enc_block(\d+)/mlp/wi/kernel$",
+         "encoder.block.{0}.layer.1.DenseReluDense.wi.weight", "dense_T"),
+        (r"^enc_block(\d+)/mlp/wo/kernel$",
+         "encoder.block.{0}.layer.1.DenseReluDense.wo.weight", "dense_T"),
+        (r"^enc_block(\d+)/ln_mlp/scale$",
+         "encoder.block.{0}.layer.1.layer_norm.weight", "none"),
+        (r"^enc_final_norm/scale$", "encoder.final_layer_norm.weight",
+         "none"),
+        (r"^dec_block(\d+)/self_attn/(q|k|v)_proj/kernel$",
+         "decoder.block.{0}.layer.0.SelfAttention.{1}.weight", "dgen_out3"),
+        (r"^dec_block(\d+)/self_attn/o_proj/kernel$",
+         "decoder.block.{0}.layer.0.SelfAttention.o.weight", "dgen_in3"),
+        (r"^dec_block0/self_attn/rel_bias/embedding$",
+         "decoder.block.0.layer.0.SelfAttention"
+         ".relative_attention_bias.weight", "none"),
+        (r"^dec_block(\d+)/ln_self/scale$",
+         "decoder.block.{0}.layer.0.layer_norm.weight", "none"),
+        (r"^dec_block(\d+)/cross_attn/(q|k|v)_proj/kernel$",
+         "decoder.block.{0}.layer.1.EncDecAttention.{1}.weight",
+         "dgen_out3"),
+        (r"^dec_block(\d+)/cross_attn/o_proj/kernel$",
+         "decoder.block.{0}.layer.1.EncDecAttention.o.weight", "dgen_in3"),
+        (r"^dec_block(\d+)/ln_cross/scale$",
+         "decoder.block.{0}.layer.1.layer_norm.weight", "none"),
+        (r"^dec_block(\d+)/mlp/wi/kernel$",
+         "decoder.block.{0}.layer.2.DenseReluDense.wi.weight", "dense_T"),
+        (r"^dec_block(\d+)/mlp/wo/kernel$",
+         "decoder.block.{0}.layer.2.DenseReluDense.wo.weight", "dense_T"),
+        (r"^dec_block(\d+)/ln_mlp/scale$",
+         "decoder.block.{0}.layer.2.layer_norm.weight", "none"),
+        (r"^dec_final_norm/scale$", "decoder.final_layer_norm.weight",
+         "none"),
+        (r"^lm_head/kernel$", "lm_head.weight", "dense_T"),
+    ],
     "vit": [
         (r"^patch_embed/kernel$",
          "vit.embeddings.patch_embeddings.projection.weight", "conv_oihw"),
@@ -329,6 +379,14 @@ def to_hf_state_dict(params: Any, family: str) -> dict[str, np.ndarray]:
     if family.startswith("gpt2"):
         _gpt2_fuse_qkv(out)
         out["lm_head.weight"] = out["transformer.wte.weight"]  # tied
+    if family.startswith("t5"):
+        # HF T5 state dicts carry the shared table under the per-stack
+        # embed_tokens aliases too; a tied model (no lm_head param —
+        # ModelConfig.tie_word_embeddings) aliases the head as well.
+        out["encoder.embed_tokens.weight"] = out["shared.weight"]
+        out["decoder.embed_tokens.weight"] = out["shared.weight"]
+        if "lm_head.weight" not in out:
+            out["lm_head.weight"] = out["shared.weight"]
     return out
 
 

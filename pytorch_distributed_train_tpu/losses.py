@@ -76,6 +76,23 @@ def causal_lm_xent(logits, batch, *_):
     return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
 
 
+def seq2seq_xent(logits, batch, *_):
+    """Encoder-decoder LM loss (t5). batch: {'input_ids' (B,Se),
+    'decoder_input_ids' (B,Sd), 'labels' (B,Sd)}; optional
+    'label_weights' masks target padding. No shift here — the data
+    pipeline builds decoder_input_ids as the shifted-right labels (the
+    T5 convention), so logits[t] already predicts labels[t]."""
+    labels = batch["labels"]
+    weights = batch.get("label_weights",
+                        jnp.ones_like(labels)).astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (per_tok * weights).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == labels) * weights).sum() / denom
+    return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0)),
+                  "token_accuracy": acc}
+
+
 def fused_causal_lm_xent(out, batch, *_):
     """Loss for models running the fused chunked head (ModelConfig.
     fused_lm_loss): the model already reduced CE inside its head region
@@ -204,6 +221,7 @@ LOSSES = {
     "softmax_xent": softmax_xent,
     "mlm_xent": mlm_xent,
     "causal_lm_xent": causal_lm_xent,
+    "seq2seq_xent": seq2seq_xent,
     "fused_causal_lm_xent": fused_causal_lm_xent,
 }
 

@@ -38,9 +38,10 @@ def _populate():
             "gpt2": gpt2_mod.gpt2,
         }
     )
-    from pytorch_distributed_train_tpu.models import pipeline_lm
+    from pytorch_distributed_train_tpu.models import pipeline_lm, t5
 
     _REGISTRY["llama_pp"] = pipeline_lm.llama_pp
+    _REGISTRY["t5"] = t5.t5
 
 
 def list_models() -> list[str]:

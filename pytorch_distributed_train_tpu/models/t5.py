@@ -1,0 +1,299 @@
+"""T5 encoder-decoder (model-zoo extension beyond the BASELINE matrix).
+
+The encoder-decoder archetype the zoo's decoder-only (llama/gpt2) and
+encoder-only (bert/vit) families don't cover: a bidirectional encoder,
+a causal decoder with CROSS-attention over the encoder output, bucketed
+RELATIVE position biases instead of absolute/rotary embeddings, and a
+shared input embedding table. Numerics follow HF transformers'
+`T5ForConditionalGeneration` (v1.0, relu feed-forward) exactly — pinned
+by the logits-parity tests against the torch implementation
+(tests/test_hf_parity.py) in both head variants: untied (this repo's
+training default) and tied+d_model**-0.5-rescaled
+(`ModelConfig.tie_word_embeddings`, the published-checkpoint layout).
+
+T5-specific conventions replicated (they bite anyone porting T5):
+- attention scores are NOT scaled by 1/sqrt(head_dim) — the original
+  checkpoints fold the scale into the weight init;
+- the relative-attention-bias table lives in block 0 ONLY (one table for
+  the encoder stack, one for the decoder stack) and the computed
+  (H, Sq, Sk) bias is shared by every later block;
+- T5's LayerNorm is scale-only RMS (no mean subtraction, no bias), with
+  the mean-square computed in fp32;
+- cross-attention has no position bias.
+
+TPU notes: attention runs as explicit einsums with the additive bias
+folded in before a fp32 softmax — XLA fuses bias+mask+softmax into the
+score matmul's epilogue. The Pallas flash kernel doesn't carry additive
+bias (it would need a bias-tile stream); at T5's typical 512-token
+encoder lengths the dense path is MXU-bound anyway.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+# T5's LayerNorm IS llama's RMSNorm (scale-only, fp32 mean-square, no
+# mean subtraction) — one implementation in the zoo, eps=1e-6 here.
+from pytorch_distributed_train_tpu.models.llama import RMSNorm  # noqa: E402
+
+
+def relative_position_bucket(relative_position, bidirectional: bool,
+                             num_buckets: int, max_distance: int):
+    """HF `_relative_position_bucket`: exact log-spaced bucketing.
+
+    relative_position = key_pos - query_pos, int32 array. Encoder
+    (bidirectional) splits buckets by sign; decoder buckets only the
+    causal past. Near positions get exact buckets, far positions log-
+    spaced up to max_distance."""
+    rp = relative_position
+    buckets = jnp.zeros_like(rp)
+    if bidirectional:
+        num_buckets //= 2
+        buckets = buckets + (rp > 0).astype(jnp.int32) * num_buckets
+        rp = jnp.abs(rp)
+    else:
+        rp = -jnp.minimum(rp, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    large = max_exact + (
+        jnp.log(rp.astype(jnp.float32) / max_exact + 1e-9)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return buckets + jnp.where(is_small, rp, large)
+
+
+class T5Attention(nn.Module):
+    """Self- or cross-attention, T5 numerics (no 1/sqrt(d) scale).
+
+    When ``rel_bias`` this module OWNS the stack's relative-bias table
+    and returns the computed bias for reuse by later blocks; callers pass
+    ``position_bias`` back in for the biasless blocks."""
+
+    num_heads: int
+    rel_bias: bool
+    bidirectional: bool
+    rel_pos_buckets: int
+    rel_pos_max_distance: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None, position_bias=None):
+        B, Sq, C = x.shape
+        kv = x if kv is None else kv
+        Sk = kv.shape[1]
+        head_dim = C // self.num_heads
+        proj = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+            (heads, head_dim), axis=-1, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name=name,
+        )
+        q = proj(self.num_heads, "q_proj")(x)        # (B, Sq, H, D)
+        k = proj(self.num_heads, "k_proj")(kv)       # (B, Sk, H, D)
+        v = proj(self.num_heads, "v_proj")(kv)
+        # T5: unscaled scores (the 1/sqrt(d) lives in the checkpoint init)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        if self.rel_bias:
+            table = nn.Embed(
+                self.rel_pos_buckets, self.num_heads,
+                embedding_init=nn.initializers.normal(0.02),
+                param_dtype=self.param_dtype, name="rel_bias")
+            rel = (jnp.arange(Sk)[None, :]
+                   - jnp.arange(Sq)[:, None]).astype(jnp.int32)
+            buckets = relative_position_bucket(
+                rel, self.bidirectional, self.rel_pos_buckets,
+                self.rel_pos_max_distance)
+            position_bias = jnp.transpose(
+                table(buckets), (2, 0, 1))[None]      # (1, H, Sq, Sk)
+            position_bias = position_bias.astype(jnp.float32)
+        if position_bias is not None:
+            scores = scores + position_bias
+        if mask is not None:
+            scores = jnp.where(mask, scores, jnp.float32(-1e9))
+        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(
+            C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name="o_proj",
+        )(y)
+        return out, position_bias
+
+
+class T5MLP(nn.Module):
+    """v1.0 DenseReluDense: wi -> relu -> wo, no biases."""
+
+    mlp_dim: int
+    dropout_rate: float
+    deterministic: bool
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        dense = partial(nn.Dense, use_bias=False, dtype=self.dtype,
+                        param_dtype=self.param_dtype,
+                        kernel_init=nn.initializers.normal(0.02))
+        h = nn.relu(dense(self.mlp_dim, name="wi")(x))
+        h = nn.Dropout(self.dropout_rate)(h, deterministic=self.deterministic)
+        return dense(x.shape[-1], name="wo")(h)
+
+
+class T5Block(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    rel_bias: bool          # block 0 owns the stack's bias table
+    is_decoder: bool
+    rel_pos_buckets: int
+    rel_pos_max_distance: int
+    eps: float
+    dropout_rate: float
+    deterministic: bool
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, enc=None, self_mask=None, cross_mask=None,
+                 position_bias=None):
+        drop = lambda h: nn.Dropout(self.dropout_rate)(  # noqa: E731
+            h, deterministic=self.deterministic)
+        attn = partial(
+            T5Attention, self.num_heads,
+            rel_pos_buckets=self.rel_pos_buckets,
+            rel_pos_max_distance=self.rel_pos_max_distance,
+            dtype=self.dtype, param_dtype=self.param_dtype)
+
+        h = RMSNorm(self.eps, name="ln_self")(x)
+        h, position_bias = attn(
+            rel_bias=self.rel_bias, bidirectional=not self.is_decoder,
+            name="self_attn",
+        )(h, mask=self_mask, position_bias=position_bias)
+        x = x + drop(h)
+        if self.is_decoder:
+            h = RMSNorm(self.eps, name="ln_cross")(x)
+            h, _ = attn(rel_bias=False, bidirectional=True,
+                        name="cross_attn")(h, kv=enc, mask=cross_mask)
+            x = x + drop(h)
+        h = RMSNorm(self.eps, name="ln_mlp")(x)
+        h = T5MLP(self.mlp_dim, self.dropout_rate, self.deterministic,
+                  self.dtype, self.param_dtype, name="mlp")(h)
+        return x + drop(h), position_bias
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """Inputs: (input_ids (B,Se), decoder_input_ids (B,Sd)); optional
+    encoder ``attention_mask``. Output: (B, Sd, vocab) fp32 logits."""
+
+    vocab_size: int
+    hidden_size: int = 512
+    num_layers: int = 6          # encoder depth
+    decoder_layers: int = 0      # 0 -> = num_layers
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    rel_pos_buckets: int = 32
+    rel_pos_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-6
+    # v1.0 published checkpoints tie the head to `shared` and rescale the
+    # decoder output by d_model**-0.5 before it (HF applies the rescale
+    # only when tied); untied is this repo's training default.
+    tie_head: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, train: bool = True,
+                 attention_mask=None, loss_mask=None):
+        del loss_mask  # seq2seq loss reads weights from the batch
+        det = not train
+        n_dec = self.decoder_layers or self.num_layers
+        shared = nn.Embed(
+            self.vocab_size, self.hidden_size,
+            embedding_init=nn.initializers.normal(1.0),
+            param_dtype=self.param_dtype, name="shared")
+        drop = lambda h: nn.Dropout(self.dropout_rate)(  # noqa: E731
+            h, deterministic=det)
+        block = partial(
+            T5Block, self.num_heads, self.mlp_dim,
+            rel_pos_buckets=self.rel_pos_buckets,
+            rel_pos_max_distance=self.rel_pos_max_distance,
+            eps=self.layer_norm_eps, dropout_rate=self.dropout_rate,
+            deterministic=det, dtype=self.dtype,
+            param_dtype=self.param_dtype)
+
+        # ---- encoder
+        Se = input_ids.shape[1]
+        enc_mask = None
+        if attention_mask is not None:
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        x = drop(shared(input_ids).astype(self.dtype))
+        bias = None
+        for i in range(self.num_layers):
+            x, bias = block(rel_bias=i == 0, is_decoder=False,
+                            name=f"enc_block{i}")(
+                x, self_mask=enc_mask, position_bias=bias)
+        enc = drop(RMSNorm(self.layer_norm_eps, name="enc_final_norm")(x))
+
+        # ---- decoder
+        Sd = decoder_input_ids.shape[1]
+        causal = jnp.tril(jnp.ones((Sd, Sd), bool))[None, None]
+        cross_mask = enc_mask  # (B,1,1,Se) broadcasts over decoder queries
+        y = drop(shared(decoder_input_ids).astype(self.dtype))
+        bias = None
+        for i in range(n_dec):
+            y, bias = block(rel_bias=i == 0, is_decoder=True,
+                            name=f"dec_block{i}")(
+                y, enc=enc, self_mask=causal, cross_mask=cross_mask,
+                position_bias=bias)
+        y = drop(RMSNorm(self.layer_norm_eps, name="dec_final_norm")(y))
+
+        if self.tie_head:
+            y = y * (self.hidden_size ** -0.5)
+            emb = jnp.asarray(shared.embedding, self.dtype)
+            logits = jax.lax.dot_general(
+                y, emb, (((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                dot_general=partial(jax.lax.dot_general,
+                                    preferred_element_type=jnp.float32),
+                kernel_init=nn.initializers.normal(0.02), name="lm_head",
+            )(y)
+        return logits.astype(jnp.float32)
+
+
+def t5(cfg, dtype, param_dtype, cp=None, act=None) -> T5ForConditionalGeneration:
+    """Registry ctor. Encoder-decoder context parallelism is not
+    implemented — refuse loudly rather than silently train without the
+    ring/Ulysses path the mesh asked for."""
+    if cp is not None:
+        raise ValueError(
+            "t5 does not support context parallelism (mesh context>1): "
+            "the encoder-decoder attention stack has no ring/Ulysses "
+            "routing — use context=1 for t5 runs")
+    del act
+    return T5ForConditionalGeneration(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        decoder_layers=getattr(cfg, "decoder_layers", 0),
+        num_heads=cfg.num_heads,
+        mlp_dim=cfg.mlp_dim,
+        rel_pos_buckets=getattr(cfg, "rel_pos_buckets", 32),
+        rel_pos_max_distance=getattr(cfg, "rel_pos_max_distance", 128),
+        dropout_rate=cfg.dropout_rate,
+        tie_head=getattr(cfg, "tie_word_embeddings", False),
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

@@ -249,6 +249,24 @@ def resnet_rules() -> list[tuple[str, PartitionSpec]]:
     ]
 
 
+def t5_rules() -> list[tuple[str, PartitionSpec]]:
+    """T5 encoder-decoder (models/t5.py): the llama FSDP×TP recipe applied
+    to both stacks — q/k/v column-parallel over 'tensor', o row-parallel,
+    MLP wi/wo likewise; the shared embedding vocab-sharded over 'fsdp'
+    (same gather-layout rationale as llama's tok_embed rule); relative-
+    bias tables and norm scales replicate (tiny)."""
+    return [
+        (r"shared/embedding$", P("fsdp", None)),
+        (r"(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tensor")),
+        (r"o_proj/kernel$", P("tensor", None, "fsdp")),
+        (r"mlp/wi/kernel$", P("fsdp", "tensor")),
+        (r"mlp/wo/kernel$", P("tensor", "fsdp")),
+        (r"lm_head/kernel$", P("fsdp", "tensor")),
+        (r"rel_bias/embedding$", P()),
+        (r".*", P()),
+    ]
+
+
 _RULE_SETS: dict[str, Callable[[], list[tuple[str, PartitionSpec]]]] = {
     "resnet": resnet_rules,
     "vit": vit_rules,
@@ -256,6 +274,7 @@ _RULE_SETS: dict[str, Callable[[], list[tuple[str, PartitionSpec]]]] = {
     "gpt": gpt2_rules,
     "llama_pp": llama_pp_rules,  # must precede the "llama" prefix match
     "llama": llama_rules,
+    "t5": t5_rules,
     "dense": dense_rules,
 }
 

@@ -36,6 +36,10 @@ def dummy_inputs(loss: str, model_cfg, data_cfg) -> tuple:
     if loss == "mlm_xent":
         ids = jnp.zeros((2, data_cfg.seq_len), jnp.int32)
         return (ids, jnp.ones((2, data_cfg.seq_len), jnp.int32))
+    if loss == "seq2seq_xent":
+        return (jnp.zeros((2, data_cfg.seq_len), jnp.int32),
+                jnp.zeros((2, data_cfg.tgt_seq_len or data_cfg.seq_len),
+                          jnp.int32))
     return (jnp.zeros((2, data_cfg.seq_len), jnp.int32),)
 
 
@@ -45,6 +49,8 @@ def model_inputs(batch: dict) -> tuple:
     causal LMs take input_ids)."""
     if "image" in batch:
         return (batch["image"],)
+    if "decoder_input_ids" in batch:  # seq2seq (t5) — before the bert key
+        return (batch["input_ids"], batch["decoder_input_ids"])
     if "attention_mask" in batch:
         return (batch["input_ids"], batch["attention_mask"])
     return (batch["input_ids"],)

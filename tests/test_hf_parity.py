@@ -176,3 +176,86 @@ def test_gpt2_logits_match_hf():
 
     back = from_hf_state_dict(sd, jax.eval_shape(lambda: params), "gpt2")
     _tree_equal(params, back)
+
+
+def test_t5_logits_match_hf():
+    """Encoder-decoder parity: relative-bias sharing, unscaled attention,
+    scale-only RMS norms, cross-attention, and the padded-encoder mask
+    path all pinned against HF T5ForConditionalGeneration."""
+    cfg = ModelConfig(name="t5", vocab_size=V, hidden_size=C, num_layers=L,
+                      decoder_layers=L, num_heads=H, mlp_dim=MLP,
+                      dropout_rate=0.0)
+    model = build_model(cfg, PrecisionConfig())
+    rng = np.random.default_rng(4)
+    src = rng.integers(0, V, (2, S))
+    tgt = rng.integers(0, V, (2, 6))
+    mask = np.ones((2, S), np.int64)
+    mask[1, -3:] = 0  # one padded encoder row exercises the mask path
+    params = model.init({"params": jax.random.PRNGKey(4)},
+                        jnp.asarray(src, jnp.int32),
+                        jnp.asarray(tgt, jnp.int32), train=False)["params"]
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=V, d_model=C, d_kv=C // H, d_ff=MLP, num_layers=L,
+        num_decoder_layers=L, num_heads=H,
+        relative_attention_num_buckets=32,
+        relative_attention_max_distance=128, dropout_rate=0.0,
+        layer_norm_epsilon=1e-6, feed_forward_proj="relu",
+        tie_word_embeddings=False, is_encoder_decoder=True,
+    )
+    hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    sd = {k: torch.from_numpy(v.copy()) for k, v in
+          to_hf_state_dict(params, "t5").items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert not missing, missing
+
+    ours = model.apply({"params": params}, jnp.asarray(src, jnp.int32),
+                       jnp.asarray(tgt, jnp.int32), train=False,
+                       attention_mask=jnp.asarray(mask, jnp.int32))
+    with torch.no_grad():
+        theirs = hf(input_ids=torch.from_numpy(src),
+                    attention_mask=torch.from_numpy(mask),
+                    decoder_input_ids=torch.from_numpy(tgt)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=3e-4, rtol=3e-4)
+
+    back = from_hf_state_dict(sd, jax.eval_shape(lambda: params), "t5")
+    _tree_equal(params, back)
+
+
+def test_t5_tied_head_matches_hf():
+    """The published-checkpoint layout: head tied to the shared embedding
+    with HF's tied-only d_model**-0.5 decoder-output rescale."""
+    cfg = ModelConfig(name="t5", vocab_size=V, hidden_size=C, num_layers=L,
+                      decoder_layers=L, num_heads=H, mlp_dim=MLP,
+                      dropout_rate=0.0, tie_word_embeddings=True)
+    model = build_model(cfg, PrecisionConfig())
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, V, (2, S))
+    tgt = rng.integers(0, V, (2, 6))
+    params = model.init({"params": jax.random.PRNGKey(5)},
+                        jnp.asarray(src, jnp.int32),
+                        jnp.asarray(tgt, jnp.int32), train=False)["params"]
+    assert "lm_head" not in params  # tied: no separate head param
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=V, d_model=C, d_kv=C // H, d_ff=MLP, num_layers=L,
+        num_decoder_layers=L, num_heads=H,
+        relative_attention_num_buckets=32,
+        relative_attention_max_distance=128, dropout_rate=0.0,
+        layer_norm_epsilon=1e-6, feed_forward_proj="relu",
+        tie_word_embeddings=True, is_encoder_decoder=True,
+    )
+    hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    sd = {k: torch.from_numpy(v.copy()) for k, v in
+          to_hf_state_dict(params, "t5").items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert not missing, missing
+
+    ours = model.apply({"params": params}, jnp.asarray(src, jnp.int32),
+                       jnp.asarray(tgt, jnp.int32), train=False)
+    with torch.no_grad():
+        theirs = hf(input_ids=torch.from_numpy(src),
+                    decoder_input_ids=torch.from_numpy(tgt)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=3e-4, rtol=3e-4)

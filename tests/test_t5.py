@@ -1,0 +1,142 @@
+"""T5 encoder-decoder (models/t5.py): bucketing math, decoder causality,
+encoder masking, FSDP/TP sharding rules, and the Trainer e2e on the
+seq2seq objective. Golden numerics vs HF live in test_hf_parity.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_train_tpu.config import (
+    ModelConfig,
+    PrecisionConfig,
+    TrainConfig,
+)
+from pytorch_distributed_train_tpu.models.registry import build_model
+from pytorch_distributed_train_tpu.models.t5 import relative_position_bucket
+
+V = 64
+
+
+def _cfg(**kw):
+    base = dict(name="t5", vocab_size=V, hidden_size=32, num_layers=2,
+                decoder_layers=2, num_heads=4, mlp_dim=64, dropout_rate=0.0)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _model_and_params(cfg=None, se=10, sd=6):
+    cfg = cfg or _cfg()
+    model = build_model(cfg, PrecisionConfig())
+    src = jnp.zeros((2, se), jnp.int32)
+    tgt = jnp.zeros((2, sd), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, src, tgt,
+                        train=False)["params"]
+    return model, params
+
+
+def test_relative_position_bucket_matches_hf():
+    """Pin the bucketing against HF's torch implementation directly —
+    the one piece of T5 most likely to drift (log-spaced far buckets)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    hf_fn = transformers.models.t5.modeling_t5.T5Attention._relative_position_bucket
+    rel = (np.arange(40)[None, :] - np.arange(40)[:, None]).astype(np.int32)
+    for bidirectional in (True, False):
+        ours = np.asarray(relative_position_bucket(
+            jnp.asarray(rel), bidirectional, 32, 128))
+        theirs = hf_fn(torch.from_numpy(rel).long(),
+                       bidirectional=bidirectional,
+                       num_buckets=32, max_distance=128).numpy()
+        np.testing.assert_array_equal(ours, theirs)
+
+
+def test_decoder_is_causal():
+    """Changing a decoder token must not change logits at earlier
+    positions (the cross-attended encoder is held fixed)."""
+    model, params = _model_and_params()
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(0, V, (1, 10)), jnp.int32)
+    tgt = np.asarray(rng.integers(0, V, (1, 6)), np.int32)
+    base = model.apply({"params": params}, src, jnp.asarray(tgt),
+                       train=False)
+    tgt2 = tgt.copy()
+    tgt2[0, 4] = (tgt2[0, 4] + 1) % V
+    pert = model.apply({"params": params}, src, jnp.asarray(tgt2),
+                       train=False)
+    np.testing.assert_array_equal(np.asarray(base[:, :4]),
+                                  np.asarray(pert[:, :4]))
+    assert not np.allclose(np.asarray(base[:, 4:]), np.asarray(pert[:, 4:]))
+
+
+def test_encoder_mask_blocks_padding():
+    """A masked-out encoder token must not influence decoder logits; an
+    unmasked change must."""
+    model, params = _model_and_params()
+    rng = np.random.default_rng(1)
+    src = np.asarray(rng.integers(0, V, (1, 10)), np.int32)
+    tgt = jnp.asarray(rng.integers(0, V, (1, 6)), jnp.int32)
+    mask = np.ones((1, 10), np.int32)
+    mask[0, -2:] = 0
+    base = model.apply({"params": params}, jnp.asarray(src), tgt,
+                       train=False, attention_mask=jnp.asarray(mask))
+    src2 = src.copy()
+    src2[0, -1] = (src2[0, -1] + 1) % V  # masked position
+    out2 = model.apply({"params": params}, jnp.asarray(src2), tgt,
+                       train=False, attention_mask=jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(out2))
+    src3 = src.copy()
+    src3[0, 0] = (src3[0, 0] + 1) % V  # attended position
+    out3 = model.apply({"params": params}, jnp.asarray(src3), tgt,
+                       train=False, attention_mask=jnp.asarray(mask))
+    assert not np.allclose(np.asarray(base), np.asarray(out3))
+
+
+def test_sharding_rules_cover_t5(devices8):
+    """Every t5 param gets a valid spec on a fsdp×tensor mesh."""
+    from jax.sharding import Mesh
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+
+    _, params = _model_and_params()
+    mesh = Mesh(np.array(devices8).reshape(4, 2), ("fsdp", "tensor"))
+    shardings = rules_for_model("t5").tree_shardings(mesh, params)
+    flat = {"/".join(str(getattr(k, "key", k)) for k in p): s
+            for p, s in jax.tree_util.tree_leaves_with_path(shardings)}
+    # the big matmuls must actually shard (not fall back to replicated)
+    assert "fsdp" in str(flat["shared/embedding"].spec)
+    assert "tensor" in str(flat["enc_block0/self_attn/q_proj/kernel"].spec)
+    assert "tensor" in str(flat["dec_block1/mlp/wo/kernel"].spec)
+
+
+@pytest.mark.slow
+def test_t5_trainer_e2e(tmp_path):
+    """Two steps of seq2seq training through the full Trainer (8-device
+    DP mesh, synthetic seq2seq data, loss finite and improving-or-sane),
+    plus checkpoint save."""
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    cfg = TrainConfig()
+    cfg.model = _cfg(max_seq_len=32)
+    cfg.loss = "seq2seq_xent"
+    cfg.data.dataset = "synthetic_seq2seq"
+    cfg.data.seq_len = 16
+    cfg.data.tgt_seq_len = 8
+    cfg.data.synthetic_size = 64
+    cfg.data.batch_size = 8
+    cfg.data.num_workers = 1
+    cfg.optim.name = "adamw"
+    cfg.optim.learning_rate = 1e-3
+    cfg.optim.schedule = "constant"
+    cfg.optim.warmup_steps = 0
+    cfg.total_steps = 2
+    cfg.checkpoint.dir = str(tmp_path / "t5")
+    cfg.checkpoint.save_every_steps = 2
+    cfg.checkpoint.async_save = False
+    cfg.obs.log_every_steps = 100
+    t = Trainer(cfg)
+    state = t.fit()
+    assert int(state.step) == 2
+    t.close()

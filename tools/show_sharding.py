@@ -61,15 +61,14 @@ def main() -> None:
     model = build_model(cfg.model, cfg.precision, mesh=mesh, mesh_cfg=cfg.mesh)
     rules = rules_for_model(cfg.model.name)
 
-    from pytorch_distributed_train_tpu.models.registry import is_language_model
+    from pytorch_distributed_train_tpu.steps import dummy_inputs
 
     def init(rng):
-        if is_language_model(cfg.model.name):
-            dummy = jnp.zeros((2, min(cfg.data.seq_len, cfg.model.max_seq_len)),
-                              jnp.int32)
-        else:
-            dummy = jnp.zeros((2, cfg.model.image_size, cfg.model.image_size, 3))
-        return model.init({"params": rng}, dummy, train=False)
+        # The same loss-keyed input dispatch the Trainer uses — covers
+        # vision, LM, MLM, and seq2seq (t5) signatures.
+        return model.init({"params": rng},
+                          *dummy_inputs(cfg.loss, cfg.model, cfg.data),
+                          train=False)
 
     shapes = jax.eval_shape(init, jax.random.PRNGKey(0))["params"]
     flat = traverse_util.flatten_dict(shapes)
