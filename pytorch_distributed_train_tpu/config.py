@@ -674,6 +674,32 @@ def _gpt2_small() -> TrainConfig:
     return c
 
 
+def _mixtral_8x7b() -> TrainConfig:
+    """Mixtral-8x7B-style sparse-MoE decoder (model-zoo extension): the
+    llama family with GShard top-2 routing over 8 experts, GQA (8 kv
+    heads), sliding-window attention, and rope_theta=1e6. Mesh splits
+    experts over their own axis beside fsdp (SURVEY §2.3 EP)."""
+    c = TrainConfig(preset="mixtral_8x7b")
+    c.model = ModelConfig(
+        name="llama", hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, mlp_dim=14336, vocab_size=32000, max_seq_len=4096,
+        rope_theta=1e6, rms_norm_eps=1e-5, remat=True, fused_lm_loss=True,
+        attention_window=4096,
+        num_experts=8, expert_top_k=2, moe_aux_weight=0.02,
+    )
+    c.data = DataConfig(dataset="synthetic_lm", batch_size=128, seq_len=4096)
+    c.optim = OptimConfig(
+        name="adamw", learning_rate=3e-4, weight_decay=0.1, beta2=0.95,
+        schedule="cosine", warmup_steps=2000, grad_clip_norm=1.0,
+        decay_exclude=r"scale$",
+    )
+    c.precision = PrecisionConfig(compute_dtype="bfloat16")
+    c.mesh = MeshConfig(data=1, expert=8, fsdp=-1)
+    c.total_steps = 500000
+    c.loss = "fused_causal_lm_xent"
+    return c
+
+
 def _t5_small() -> TrainConfig:
     """T5-small seq2seq pretrain (model-zoo extension beyond the BASELINE
     matrix). HF-layout-compatible via interop's 't5' mapping
@@ -709,6 +735,7 @@ _PRESETS = {
     "llama2_7b": _llama2_7b,
     "gpt2_small": _gpt2_small,
     "t5_small": _t5_small,
+    "mixtral_8x7b": _mixtral_8x7b,
 }
 
 

@@ -92,4 +92,4 @@ def build_model(model_cfg, precision_cfg, mesh=None, mesh_cfg=None):
 
 
 def is_language_model(name: str) -> bool:
-    return name.startswith(("bert", "llama", "gpt"))
+    return name.startswith(("bert", "llama", "gpt", "t5"))

@@ -82,6 +82,8 @@ class T5Attention(nn.Module):
     bidirectional: bool
     rel_pos_buckets: int
     rel_pos_max_distance: int
+    dropout_rate: float
+    deterministic: bool
     dtype: jnp.dtype
     param_dtype: jnp.dtype
 
@@ -91,14 +93,19 @@ class T5Attention(nn.Module):
         kv = x if kv is None else kv
         Sk = kv.shape[1]
         head_dim = C // self.num_heads
-        proj = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+        # T5's scaled init is what makes UNSCALED attention scores sane at
+        # step 0: q ~ N(0, (d_model*d_kv)^-0.5), k/v/o ~ N(0, d_model^-0.5)
+        # (HF T5PreTrainedModel._init_weights with factor=1).
+        q_std = (C * head_dim) ** -0.5
+        kv_std = C ** -0.5
+        proj = lambda heads, std, name: nn.DenseGeneral(  # noqa: E731
             (heads, head_dim), axis=-1, use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
-            kernel_init=nn.initializers.normal(0.02), name=name,
+            kernel_init=nn.initializers.normal(std), name=name,
         )
-        q = proj(self.num_heads, "q_proj")(x)        # (B, Sq, H, D)
-        k = proj(self.num_heads, "k_proj")(kv)       # (B, Sk, H, D)
-        v = proj(self.num_heads, "v_proj")(kv)
+        q = proj(self.num_heads, q_std, "q_proj")(x)    # (B, Sq, H, D)
+        k = proj(self.num_heads, kv_std, "k_proj")(kv)  # (B, Sk, H, D)
+        v = proj(self.num_heads, kv_std, "v_proj")(kv)
         # T5: unscaled scores (the 1/sqrt(d) lives in the checkpoint init)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
@@ -120,11 +127,15 @@ class T5Attention(nn.Module):
         if mask is not None:
             scores = jnp.where(mask, scores, jnp.float32(-1e9))
         probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        # HF T5 drops out the attention PROBABILITIES too, not just the
+        # sublayer outputs.
+        probs = nn.Dropout(self.dropout_rate)(
+            probs, deterministic=self.deterministic)
         y = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
-            kernel_init=nn.initializers.normal(0.02), name="o_proj",
+            kernel_init=nn.initializers.normal(kv_std), name="o_proj",
         )(y)
         return out, position_bias
 
@@ -140,12 +151,14 @@ class T5MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        dense = partial(nn.Dense, use_bias=False, dtype=self.dtype,
-                        param_dtype=self.param_dtype,
-                        kernel_init=nn.initializers.normal(0.02))
-        h = nn.relu(dense(self.mlp_dim, name="wi")(x))
+        # HF scaled init: wi ~ N(0, d_model^-0.5), wo ~ N(0, d_ff^-0.5)
+        dense = lambda features, std, name: nn.Dense(  # noqa: E731
+            features, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(std), name=name)
+        h = nn.relu(dense(self.mlp_dim, x.shape[-1] ** -0.5, "wi")(x))
         h = nn.Dropout(self.dropout_rate)(h, deterministic=self.deterministic)
-        return dense(x.shape[-1], name="wo")(h)
+        return dense(x.shape[-1], self.mlp_dim ** -0.5, "wo")(h)
 
 
 class T5Block(nn.Module):
@@ -170,6 +183,8 @@ class T5Block(nn.Module):
             T5Attention, self.num_heads,
             rel_pos_buckets=self.rel_pos_buckets,
             rel_pos_max_distance=self.rel_pos_max_distance,
+            dropout_rate=self.dropout_rate,
+            deterministic=self.deterministic,
             dtype=self.dtype, param_dtype=self.param_dtype)
 
         h = RMSNorm(self.eps, name="ln_self")(x)
@@ -268,7 +283,9 @@ class T5ForConditionalGeneration(nn.Module):
                 param_dtype=self.param_dtype,
                 dot_general=partial(jax.lax.dot_general,
                                     preferred_element_type=jnp.float32),
-                kernel_init=nn.initializers.normal(0.02), name="lm_head",
+                kernel_init=nn.initializers.normal(
+                    self.hidden_size ** -0.5),  # HF untied-head init
+                name="lm_head",
             )(y)
         return logits.astype(jnp.float32)
 

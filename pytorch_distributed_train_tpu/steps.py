@@ -74,6 +74,10 @@ def apply_model(model, params, batch_stats, batch, *, train: bool, dropout_rng):
             mutable = ["batch_stats", "losses"]
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
     kwargs = {}
+    if "decoder_input_ids" in batch and "attention_mask" in batch:
+        # seq2seq (t5): the encoder padding mask rides as a kwarg (the
+        # positional slots are taken by the two id tensors).
+        kwargs["attention_mask"] = batch["attention_mask"]
     if getattr(model, "fused_loss", False) and "loss_mask" in batch:
         # Fused-head models reduce CE inside the model (losses.
         # chunked_causal_ce), so the mask must travel in with the inputs.

@@ -151,3 +151,43 @@ def test_7b_train_step_lowers(sharded_7b):
     text = lowered.as_text()
     # the lowering must carry real sharding annotations, not defaults
     assert "sharding" in text
+
+
+def test_mixtral_expert_sharding(devices8):
+    """mixtral_8x7b preset at real shapes: expert FFN banks shard their
+    leading E dim over 'expert', attention/embedding keep the llama
+    FSDPxTP layout, and no large matrix falls through to replication."""
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    cfg = get_preset("mixtral_8x7b")
+    mesh_cfg = MeshConfig(data=1, expert=2, fsdp=2, tensor=2)
+    mesh = build_mesh(mesh_cfg, devices8)
+    model = build_model(cfg.model, cfg.precision, mesh=mesh,
+                        mesh_cfg=mesh_cfg)
+    tx, _ = make_optimizer(cfg.optim, total_steps=100)
+    rules = rules_for_model(cfg.model.name)
+
+    def init_state(rng):
+        ids = jnp.zeros((2, cfg.model.max_seq_len), jnp.int32)
+        variables = model.init({"params": rng}, ids, train=False)
+        return TrainState.create(params=variables["params"], tx=tx)
+
+    state_shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    sharding = steps_lib.state_shardings(mesh, rules, state_shape)
+    specs = _flat_specs(sharding.params)
+
+    expert_kernels = {k: v for k, v in specs.items() if "experts/" in k}
+    assert expert_kernels, "no expert banks found in mixtral params"
+    for k, spec in expert_kernels.items():
+        assert spec[0] == "expert", (k, spec)
+        assert "fsdp" in str(spec) and "tensor" in str(spec), (k, spec)
+    for k, spec in specs.items():
+        if "q_proj" in k or "k_proj" in k or "v_proj" in k:
+            assert "tensor" in str(spec), (k, spec)
+    # nothing >=100MB may be fully replicated
+    flat_shapes = jax.tree_util.tree_leaves_with_path(state_shape.params)
+    for p, leaf in flat_shapes:
+        n_mb = 4 * int(jnp.prod(jnp.asarray(leaf.shape))) / 1e6
+        name = path_name(p)
+        if n_mb >= 100:
+            assert any(a is not None for a in specs[name]), (name, n_mb)

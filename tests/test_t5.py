@@ -93,6 +93,50 @@ def test_encoder_mask_blocks_padding():
     assert not np.allclose(np.asarray(base), np.asarray(out3))
 
 
+def test_batch_attention_mask_reaches_encoder():
+    """The Trainer path (steps.apply_model) must forward a seq2seq
+    batch's attention_mask to the model — a masked source token change
+    must not alter logits through that path."""
+    from pytorch_distributed_train_tpu.steps import apply_model
+
+    model, params = _model_and_params()
+    rng = np.random.default_rng(2)
+    src = np.asarray(rng.integers(0, V, (1, 10)), np.int32)
+    batch = {
+        "input_ids": jnp.asarray(src),
+        "decoder_input_ids": jnp.asarray(
+            rng.integers(0, V, (1, 6)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, V, (1, 6)), jnp.int32),
+        "attention_mask": jnp.asarray(
+            np.concatenate([np.ones((1, 8), np.int32),
+                            np.zeros((1, 2), np.int32)], 1)),
+    }
+    base, _, _ = apply_model(model, params, {}, batch, train=False,
+                             dropout_rng=None)
+    src2 = src.copy()
+    src2[0, -1] = (src2[0, -1] + 1) % V  # masked position
+    batch2 = {**batch, "input_ids": jnp.asarray(src2)}
+    out2, _, _ = apply_model(model, params, {}, batch2, train=False,
+                             dropout_rng=None)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(out2))
+
+
+def test_dropout_active_in_train_mode():
+    """dropout_rate>0 + train=True must be stochastic (covers the
+    attention-probability dropout alongside the sublayer dropouts)."""
+    cfg = _cfg(dropout_rate=0.3)
+    model = build_model(cfg, PrecisionConfig())
+    src = jnp.zeros((2, 10), jnp.int32)
+    tgt = jnp.zeros((2, 6), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, src, tgt,
+                        train=False)["params"]
+    o1 = model.apply({"params": params}, src, tgt, train=True,
+                     rngs={"dropout": jax.random.PRNGKey(1)})
+    o2 = model.apply({"params": params}, src, tgt, train=True,
+                     rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
 def test_sharding_rules_cover_t5(devices8):
     """Every t5 param gets a valid spec on a fsdp×tensor mesh."""
     from jax.sharding import Mesh
