@@ -198,3 +198,94 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
         if i + 1 < max_new_tokens:  # last sample needs no further forward
             logits, cache = _decode_step(model, params, cache, nxt[:, None])
     return jnp.concatenate(out, axis=1)
+
+
+# ------------------------------------------------------- encoder-decoder (t5)
+
+@partial(jax.jit, static_argnums=(0,))
+def _seq2seq_encode(model, params, ids, mask):
+    """Jitted encoder prefill — one dispatch, int8-aware like the
+    decode steps (quantized trees dequantize in-graph)."""
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
+    return model.apply({"params": params}, ids, attention_mask=mask)
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _seq2seq_decode_step(model, params, cache, ids, enc, enc_mask):
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
+    logits, updated = model.apply(
+        {"params": params, "cache": cache}, ids, enc, enc_mask,
+        mutable=["cache"],
+    )
+    return logits[:, -1], updated["cache"]
+
+
+def generate_seq2seq(model_cfg, precision, params, input_ids,
+                     max_new_tokens: int, *, temperature: float = 0.0,
+                     top_k: int = 0, rng=None, eos_id: int | None = 1,
+                     decoder_start_id: int = 0,
+                     attention_mask=None) -> jnp.ndarray:
+    """Encoder-decoder generation (t5): encode the (B, Se) source once,
+    then decode autoregressively with a cached decoder
+    (models/t5.py::T5DecodeStep — same param tree as training).
+
+    Returns (B, max_new_tokens) decoder tokens (no BOS column). T5's
+    conventions by default: decoder starts from the pad id 0, eos is 1.
+    Rows freeze at ``eos_id`` once emitted.
+    """
+    from pytorch_distributed_train_tpu.models.t5 import (
+        t5_decode_step,
+        t5_encoder,
+    )
+
+    dtype = jnp.dtype(precision.compute_dtype)
+    param_dtype = jnp.dtype(precision.param_dtype)
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B = input_ids.shape[0]
+    if attention_mask is not None:
+        attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    else:
+        attention_mask = jnp.ones_like(input_ids)
+
+    if max_new_tokens + 1 > model_cfg.max_seq_len:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) + start token exceeds "
+            f"max_seq_len ({model_cfg.max_seq_len})")
+    encoder = t5_encoder(model_cfg, dtype, param_dtype)
+    enc = _seq2seq_encode(encoder, params, input_ids, attention_mask)
+
+    # Cache sized to max_seq_len (not the call's token budget): the
+    # decode module is a static jit key, so a fixed size means ONE
+    # compiled step per model regardless of requested length.
+    decoder = t5_decode_step(model_cfg, dtype, param_dtype,
+                             max_decode_len=model_cfg.max_seq_len)
+    # Cache shapes via eval_shape of an init that never materializes
+    # (abstract args must be eval_shape ARGUMENTS, not closures).
+    shapes = jax.eval_shape(
+        lambda ids, e, m: decoder.init(
+            {"params": jax.random.PRNGKey(0)}, ids, e, m),
+        jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        jax.ShapeDtypeStruct(enc.shape, enc.dtype),
+        jax.ShapeDtypeStruct((B, input_ids.shape[1]), jnp.int32),
+    )["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids = jnp.full((B, 1), decoder_start_id, jnp.int32)
+    out = []
+    done = jnp.zeros((B,), bool)
+    for _ in range(max_new_tokens):
+        logits, cache = _seq2seq_decode_step(
+            decoder, params, cache, ids, enc, attention_mask)
+        rng, step_rng = jax.random.split(rng)
+        nxt = _sample(logits, step_rng, temperature, top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        out.append(nxt[:, None])
+        ids = nxt[:, None]
+    return jnp.concatenate(out, axis=1)

@@ -110,9 +110,10 @@ class T5Attention(nn.Module):
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
         if self.rel_bias:
+            # HF inits the bias table at d_model**-0.5 like k/v/o
             table = nn.Embed(
                 self.rel_pos_buckets, self.num_heads,
-                embedding_init=nn.initializers.normal(0.02),
+                embedding_init=nn.initializers.normal(C ** -0.5),
                 param_dtype=self.param_dtype, name="rel_bias")
             rel = (jnp.arange(Sk)[None, :]
                    - jnp.arange(Sq)[:, None]).astype(jnp.int32)
@@ -159,6 +160,77 @@ class T5MLP(nn.Module):
         h = nn.relu(dense(self.mlp_dim, x.shape[-1] ** -0.5, "wi")(x))
         h = nn.Dropout(self.dropout_rate)(h, deterministic=self.deterministic)
         return dense(x.shape[-1], self.mlp_dim ** -0.5, "wo")(h)
+
+
+class T5DecodeAttention(nn.Module):
+    """Single-token decoder SELF-attention with a KV cache (generation
+    path, generate.generate_seq2seq). Mirrors llama's decode discipline:
+    static (B, L, H, D) buffers + an index scalar, absolute-position
+    masking of the unwritten tail. The block-0 relative-bias table is
+    looked up per step for the query's absolute position; later blocks
+    receive the computed (1, H, 1, L) bias."""
+
+    num_heads: int
+    rel_bias: bool
+    rel_pos_buckets: int
+    rel_pos_max_distance: int
+    max_len: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, position_bias=None):
+        B, S, C = x.shape
+        assert S == 1, "decode steps are single-token"
+        head_dim = C // self.num_heads
+        q_std = (C * head_dim) ** -0.5
+        kv_std = C ** -0.5
+        proj = lambda std, name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), axis=-1, use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(std), name=name,
+        )
+        q = proj(q_std, "q_proj")(x)
+        k = proj(kv_std, "k_proj")(x)
+        v = proj(kv_std, "v_proj")(x)
+        L = self.max_len
+        c_k = self.variable("cache", "cached_key", jnp.zeros,
+                            (B, L, self.num_heads, head_dim), k.dtype)
+        c_v = self.variable("cache", "cached_value", jnp.zeros,
+                            (B, L, self.num_heads, head_dim), v.dtype)
+        c_i = self.variable("cache", "cache_index",
+                            lambda: jnp.zeros((), jnp.int32))
+        idx = c_i.value
+        c_k.value = jax.lax.dynamic_update_slice_in_dim(c_k.value, k, idx, 1)
+        c_v.value = jax.lax.dynamic_update_slice_in_dim(c_v.value, v, idx, 1)
+        c_i.value = idx + 1
+        k_pos = jnp.arange(L)
+        if self.rel_bias:
+            # HF inits the bias table at d_model**-0.5 like k/v/o
+            table = nn.Embed(
+                self.rel_pos_buckets, self.num_heads,
+                embedding_init=nn.initializers.normal(C ** -0.5),
+                param_dtype=self.param_dtype, name="rel_bias")
+            buckets = relative_position_bucket(
+                (k_pos - idx).astype(jnp.int32), False,
+                self.rel_pos_buckets, self.rel_pos_max_distance)
+            position_bias = jnp.transpose(
+                table(buckets), (1, 0))[None, :, None, :]  # (1, H, 1, L)
+            position_bias = position_bias.astype(jnp.float32)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, c_k.value,
+                            preferred_element_type=jnp.float32)
+        if position_bias is not None:
+            scores = scores + position_bias
+        scores = jnp.where(k_pos[None, None, None, :] <= idx, scores,
+                           jnp.float32(-1e9))
+        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", probs, c_v.value)
+        out = nn.DenseGeneral(
+            C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(kv_std), name="o_proj",
+        )(y)
+        return out, position_bias
 
 
 class T5Block(nn.Module):
@@ -283,8 +355,7 @@ class T5ForConditionalGeneration(nn.Module):
                 param_dtype=self.param_dtype,
                 dot_general=partial(jax.lax.dot_general,
                                     preferred_element_type=jnp.float32),
-                kernel_init=nn.initializers.normal(
-                    self.hidden_size ** -0.5),  # HF untied-head init
+                kernel_init=nn.initializers.normal(1.0),  # HF: factor*1.0
                 name="lm_head",
             )(y)
         return logits.astype(jnp.float32)
@@ -314,3 +385,167 @@ def t5(cfg, dtype, param_dtype, cp=None, act=None) -> T5ForConditionalGeneration
         dtype=dtype,
         param_dtype=param_dtype,
     )
+
+
+class T5DecodeBlock(nn.Module):
+    """Decoder block for single-token generation: cached self-attention
+    (T5DecodeAttention), cross-attention over the fixed encoder output,
+    MLP. Submodule names mirror T5Block's decoder layout exactly, so the
+    TRAINING param tree drives decoding unchanged."""
+
+    num_heads: int
+    mlp_dim: int
+    rel_bias: bool
+    rel_pos_buckets: int
+    rel_pos_max_distance: int
+    eps: float
+    max_len: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, enc, enc_mask=None, position_bias=None):
+        h = RMSNorm(self.eps, name="ln_self")(x)
+        h, position_bias = T5DecodeAttention(
+            self.num_heads, rel_bias=self.rel_bias,
+            rel_pos_buckets=self.rel_pos_buckets,
+            rel_pos_max_distance=self.rel_pos_max_distance,
+            max_len=self.max_len, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="self_attn",
+        )(h, position_bias=position_bias)
+        x = x + h
+        h = RMSNorm(self.eps, name="ln_cross")(x)
+        # Cross K/V are recomputed from `enc` each step (two (Se,C,inner)
+        # matmuls per layer per token) rather than cached — simpler, and
+        # at T5 shapes the self-attn weight streaming dominates anyway.
+        h, _ = T5Attention(
+            self.num_heads, rel_bias=False, bidirectional=True,
+            rel_pos_buckets=self.rel_pos_buckets,
+            rel_pos_max_distance=self.rel_pos_max_distance,
+            dropout_rate=0.0, deterministic=True, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="cross_attn",
+        )(h, kv=enc, mask=enc_mask)
+        x = x + h
+        h = RMSNorm(self.eps, name="ln_mlp")(x)
+        h = T5MLP(self.mlp_dim, 0.0, True, self.dtype, self.param_dtype,
+                  name="mlp")(h)
+        return x + h, position_bias
+
+
+class T5Encoder(nn.Module):
+    """Encoder-only forward (generation prefill). Same param names as the
+    full model's encoder half."""
+
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    mlp_dim: int
+    rel_pos_buckets: int
+    rel_pos_max_distance: int
+    layer_norm_eps: float
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        shared = nn.Embed(
+            self.vocab_size, self.hidden_size,
+            embedding_init=nn.initializers.normal(1.0),
+            param_dtype=self.param_dtype, name="shared")
+        enc_mask = None
+        if attention_mask is not None:
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        x = shared(input_ids).astype(self.dtype)
+        bias = None
+        for i in range(self.num_layers):
+            x, bias = T5Block(
+                self.num_heads, self.mlp_dim, rel_bias=i == 0,
+                is_decoder=False, rel_pos_buckets=self.rel_pos_buckets,
+                rel_pos_max_distance=self.rel_pos_max_distance,
+                eps=self.layer_norm_eps, dropout_rate=0.0,
+                deterministic=True, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"enc_block{i}",
+            )(x, self_mask=enc_mask, position_bias=bias)
+        return RMSNorm(self.layer_norm_eps, name="enc_final_norm")(x)
+
+
+class T5DecodeStep(nn.Module):
+    """One decoder token against a fixed encoder output, KV cache in the
+    flax 'cache' collection. Param names mirror the training model, so
+    ``model.apply({'params': train_params, 'cache': cache}, ...)`` works
+    directly."""
+
+    vocab_size: int
+    hidden_size: int
+    decoder_layers: int
+    num_heads: int
+    mlp_dim: int
+    rel_pos_buckets: int
+    rel_pos_max_distance: int
+    layer_norm_eps: float
+    max_decode_len: int
+    tie_head: bool
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, dec_ids, enc, enc_mask=None):
+        shared = nn.Embed(
+            self.vocab_size, self.hidden_size,
+            embedding_init=nn.initializers.normal(1.0),
+            param_dtype=self.param_dtype, name="shared")
+        mask4 = None
+        if enc_mask is not None:
+            mask4 = enc_mask[:, None, None, :].astype(bool)
+        y = shared(dec_ids).astype(self.dtype)
+        bias = None
+        for i in range(self.decoder_layers):
+            y, bias = T5DecodeBlock(
+                self.num_heads, self.mlp_dim, rel_bias=i == 0,
+                rel_pos_buckets=self.rel_pos_buckets,
+                rel_pos_max_distance=self.rel_pos_max_distance,
+                eps=self.layer_norm_eps, max_len=self.max_decode_len,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                name=f"dec_block{i}",
+            )(y, enc, enc_mask=mask4, position_bias=bias)
+        y = RMSNorm(self.layer_norm_eps, name="dec_final_norm")(y)
+        if self.tie_head:
+            y = y * (self.hidden_size ** -0.5)
+            emb = jnp.asarray(shared.embedding, self.dtype)
+            logits = jax.lax.dot_general(
+                y, emb, (((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                dot_general=partial(jax.lax.dot_general,
+                                    preferred_element_type=jnp.float32),
+                kernel_init=nn.initializers.normal(1.0),  # HF: factor*1.0
+                name="lm_head",
+            )(y)
+        return logits.astype(jnp.float32)
+
+
+def t5_encoder(cfg, dtype, param_dtype) -> T5Encoder:
+    return T5Encoder(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+        mlp_dim=cfg.mlp_dim,
+        rel_pos_buckets=getattr(cfg, "rel_pos_buckets", 32),
+        rel_pos_max_distance=getattr(cfg, "rel_pos_max_distance", 128),
+        layer_norm_eps=1e-6, dtype=dtype, param_dtype=param_dtype)
+
+
+def t5_decode_step(cfg, dtype, param_dtype, max_decode_len: int
+                   ) -> T5DecodeStep:
+    return T5DecodeStep(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        decoder_layers=getattr(cfg, "decoder_layers", 0) or cfg.num_layers,
+        num_heads=cfg.num_heads, mlp_dim=cfg.mlp_dim,
+        rel_pos_buckets=getattr(cfg, "rel_pos_buckets", 32),
+        rel_pos_max_distance=getattr(cfg, "rel_pos_max_distance", 128),
+        layer_norm_eps=1e-6, max_decode_len=max_decode_len,
+        tie_head=getattr(cfg, "tie_word_embeddings", False),
+        dtype=dtype, param_dtype=param_dtype)
