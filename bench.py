@@ -370,6 +370,89 @@ def decode_bench(args) -> None:
     }))
 
 
+def spec_bench(args) -> None:
+    """Speculative-decoding throughput (B=1, latency regime). Two arms:
+
+    - default: a quarter-ish-size RANDOM draft — acceptance ~0, so this is
+      the overhead FLOOR (worst case: all speculation wasted);
+    - ``--spec-self``: draft == target — acceptance 1, the machinery
+      CEILING (k+1 committed tokens per verify at full draft cost).
+
+    A trained/distilled draft lands between the two; compare against the
+    ``llama_decode`` metric (note that one is B=8). Never seeds a
+    baseline key."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.config import (
+        ModelConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.speculative import (
+        speculative_generate,
+    )
+
+    if args.model != "llama":
+        raise SystemExit("--speculative supports --model llama")
+    k = args.speculative
+    new_tokens = args.decode_tokens or 64
+    prompt_len = 16 if args.tiny else 128
+    dims = (dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                 num_kv_heads=4, mlp_dim=128) if args.tiny else
+            dict(vocab_size=32000, hidden_size=2048, num_layers=16,
+                 num_heads=16, num_kv_heads=16, mlp_dim=5504))
+    d_dims = (dict(vocab_size=512, hidden_size=32, num_layers=1,
+                   num_heads=2, num_kv_heads=2, mlp_dim=64) if args.tiny
+              else dict(vocab_size=32000, hidden_size=512, num_layers=4,
+                        num_heads=8, num_kv_heads=8, mlp_dim=1376))
+    max_len = prompt_len + new_tokens + k + 2
+    cfg = ModelConfig(name="llama", **dims, max_seq_len=max_len,
+                      attention_impl="xla")
+    precision = PrecisionConfig(compute_dtype="bfloat16")
+    _touch()
+
+    def init_params(c, seed):
+        m = build_model(c, precision)
+        return jax.jit(lambda r: m.init(
+            {"params": r}, jnp.zeros((1, 8), jnp.int32),
+            train=False)["params"])(jax.random.PRNGKey(seed))
+
+    params = init_params(cfg, 0)
+    if args.spec_self:
+        draft_cfg, draft_params, arm = cfg, params, "self"
+    else:
+        draft_cfg = ModelConfig(name="llama", **d_dims, max_seq_len=max_len,
+                                attention_impl="xla")
+        draft_params, arm = init_params(draft_cfg, 1), "randdraft"
+    _touch()
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, dims["vocab_size"],
+                                          (1, prompt_len)), jnp.int32)
+    # warm every executable (prefills, draft steps, verify, accept);
+    # capped at new_tokens so the warmup horizon fits the cache the
+    # timed run sized (max_len above)
+    warm_tokens = min(max(2 * k, 4), new_tokens)
+    speculative_generate(cfg, precision, params, draft_cfg, draft_params,
+                         prompt, warm_tokens, k=k, temperature=0.0)
+    _disarm_watchdog()
+    t0 = time.perf_counter()
+    out, stats = speculative_generate(
+        cfg, precision, params, draft_cfg, draft_params, prompt,
+        new_tokens, k=k, temperature=0.0, return_stats=True)
+    wall = time.perf_counter() - t0
+    suffix = "_tiny" if args.tiny else ""
+    print(json.dumps({
+        "metric": f"llama_spec_{arm}_k{k}{suffix}_tokens_per_sec",
+        "value": round((out.shape[1] - prompt_len) / wall, 2),
+        "unit": "tokens/sec (B=1)",
+        "vs_baseline": 1.0,
+        "accept_rate": round(stats["accept_rate"], 4),
+        "tokens_per_round": round(stats["tokens_per_round"], 3),
+    }))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
@@ -399,6 +482,13 @@ def main() -> None:
                    help="llama only: measure KV-cache DECODE throughput "
                         "instead of training — generate this many tokens "
                         "per sequence (timed after a warmup generation)")
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="llama only: speculative-decoding bench with "
+                        "speculation depth K (B=1; see spec_bench)")
+    p.add_argument("--spec-self", action="store_true",
+                   help="with --speculative: draft == target (acceptance-1 "
+                        "machinery ceiling instead of the random-draft "
+                        "floor)")
     p.add_argument("--quantize", default="", choices=["", "int8"],
                    help="decode bench: weight-only int8 params (quant.py)")
     p.add_argument("--quant-training", default="", choices=["", "int8"],
@@ -447,6 +537,8 @@ def main() -> None:
         if args.pipeline_decode:
             return pipeline_decode_bench(args)
         return pipeline_bench(args)
+    if args.speculative:
+        return spec_bench(args)
     if args.decode_tokens:
         return decode_bench(args)
 
@@ -495,6 +587,18 @@ def main() -> None:
         opt = OptimConfig(name="adamw", learning_rate=3e-4,
                           schedule="constant", warmup_steps=0)
         bpc = args.batch_per_chip or 8
+    elif args.model == "t5":
+        # t5-small shapes (the t5_small preset): seq2seq throughput —
+        # tokens counted as encoder source + decoder target per example.
+        model_cfg = ModelConfig(
+            name="t5", vocab_size=32128, hidden_size=512, num_layers=6,
+            decoder_layers=6, num_heads=8, mlp_dim=2048,
+            max_seq_len=min(args.seq_len, 512),
+        )
+        loss_name = "seq2seq_xent"
+        opt = OptimConfig(name="adafactor", learning_rate=1e-2,
+                          schedule="constant", warmup_steps=0)
+        bpc = args.batch_per_chip or 64
     elif args.model == "bert_base":
         model_cfg = ModelConfig(
             name="bert_base", vocab_size=30522, hidden_size=768,
@@ -525,9 +629,14 @@ def main() -> None:
     rules = rules_for_model(args.model)
     seq = model_cfg.max_seq_len
 
+    tgt_seq = seq // 4 if args.model == "t5" else 0  # t5_small's 512/128
+
     def init_state(rng):
         if vision:
             dummy = (jnp.zeros((2, args.image_size, args.image_size, 3)),)
+        elif args.model == "t5":
+            dummy = (jnp.zeros((2, seq), jnp.int32),
+                     jnp.zeros((2, tgt_seq), jnp.int32))
         else:
             dummy = (jnp.zeros((2, seq), jnp.int32),)
         variables = model.init({"params": rng}, *dummy, train=False)
@@ -575,6 +684,20 @@ def main() -> None:
         mlm_batch = ds.get_batch(np.arange(global_batch), rng_np, train=True)
         batch = {k: jnp.asarray(v) for k, v in mlm_batch.items()}
         items_per_step, unit_noun = global_batch * seq, "tokens"
+    elif args.model == "t5":
+        labels = rng_np.integers(0, model_cfg.vocab_size,
+                                 (global_batch, tgt_seq))
+        batch = {
+            "input_ids": jnp.asarray(
+                rng_np.integers(0, model_cfg.vocab_size,
+                                (global_batch, seq)), jnp.int32),
+            "decoder_input_ids": jnp.asarray(
+                np.concatenate([np.zeros((global_batch, 1), np.int64),
+                                labels[:, :-1]], 1), jnp.int32),
+            "labels": jnp.asarray(labels, jnp.int32),
+        }
+        items_per_step = global_batch * (seq + tgt_seq)
+        unit_noun = "tokens"
     else:
         batch = {"input_ids": jnp.asarray(
             rng_np.integers(0, model_cfg.vocab_size, (global_batch, seq)),
@@ -621,6 +744,9 @@ def main() -> None:
                      and args.attention_impl == "auto"
                      and not args.fused_head and not args.quant_training
                      and args.remat_policy == "full" and default_opt)
+    elif args.model == "t5":
+        canonical = (args.batch_per_chip in (0, 64) and args.seq_len >= 512
+                     and default_opt)
     else:  # bert_base
         canonical = (args.batch_per_chip in (0, 32) and args.seq_len >= 512
                      and args.attention_impl == "auto" and default_opt)

@@ -202,6 +202,19 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
 
 # ------------------------------------------------------- encoder-decoder (t5)
 
+@lru_cache(maxsize=16)
+def _seq2seq_cache_shapes(decoder, batch: int, enc_shape, enc_dtype: str):
+    """Memoized like _cache_shapes: one abstract decoder-init trace per
+    (decoder, batch, encoder-shape), not one per generate call."""
+    return jax.eval_shape(
+        lambda ids, e, m: decoder.init(
+            {"params": jax.random.PRNGKey(0)}, ids, e, m),
+        jax.ShapeDtypeStruct((batch, 1), jnp.int32),
+        jax.ShapeDtypeStruct(tuple(enc_shape), jnp.dtype(enc_dtype)),
+        jax.ShapeDtypeStruct((batch, enc_shape[1]), jnp.int32),
+    )["cache"]
+
+
 @partial(jax.jit, static_argnums=(0,))
 def _seq2seq_encode(model, params, ids, mask):
     """Jitted encoder prefill — one dispatch, int8-aware like the
@@ -263,15 +276,7 @@ def generate_seq2seq(model_cfg, precision, params, input_ids,
     # compiled step per model regardless of requested length.
     decoder = t5_decode_step(model_cfg, dtype, param_dtype,
                              max_decode_len=model_cfg.max_seq_len)
-    # Cache shapes via eval_shape of an init that never materializes
-    # (abstract args must be eval_shape ARGUMENTS, not closures).
-    shapes = jax.eval_shape(
-        lambda ids, e, m: decoder.init(
-            {"params": jax.random.PRNGKey(0)}, ids, e, m),
-        jax.ShapeDtypeStruct((B, 1), jnp.int32),
-        jax.ShapeDtypeStruct(enc.shape, enc.dtype),
-        jax.ShapeDtypeStruct((B, input_ids.shape[1]), jnp.int32),
-    )["cache"]
+    shapes = _seq2seq_cache_shapes(decoder, B, enc.shape, str(enc.dtype))
     cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
