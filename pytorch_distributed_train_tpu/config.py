@@ -302,6 +302,11 @@ class MeshConfig:
     context: int = 1
     # Which mesh axes batch is sharded over (data+fsdp is the common combo).
     batch_axes: tuple[str, ...] = ("data", "fsdp")
+    # ZeRO stage on the 'fsdp' axis (torch FSDP ShardingStrategy analogue,
+    # steps.state_shardings): 3 = params+optimizer sharded (FULL_SHARD,
+    # default); 1 = optimizer-state-only sharding, params replicated
+    # (fits when weights fit per-chip but adam moments don't).
+    zero_stage: int = 3
     # Attention algorithm when context > 1 (SURVEY §5.7):
     #   ring    — lax.ppermute KV rotation around the ICI ring; any size
     #   ulysses — all-to-all head↔seq swap; needs heads % context == 0

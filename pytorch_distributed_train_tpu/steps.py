@@ -257,13 +257,54 @@ def offload_opt_state(train_step, opt_dev_sharding, opt_host_sharding):
     return wrapped
 
 
-def state_shardings(mesh: Mesh, rules, state_shape) -> Any:
+def _drop_axis(spec: PartitionSpec, axis: str) -> PartitionSpec:
+    """Remove one mesh axis from a PartitionSpec (entries may be tuples)."""
+    out = []
+    for e in spec:
+        if e == axis:
+            out.append(None)
+        elif isinstance(e, tuple):
+            kept = tuple(a for a in e if a != axis)
+            out.append(kept if kept else None)
+        else:
+            out.append(e)
+    return PartitionSpec(*out)
+
+
+def state_shardings(mesh: Mesh, rules, state_shape,
+                    zero_stage: int = 3) -> Any:
     """Sharding pytree for a TrainState *shape* tree (from jax.eval_shape).
 
     One rules table covers params, optimizer mirrors (mu/nu/trace/MultiSteps
     accumulators — same name suffixes), and batch stats (fall through to the
-    catch-all → replicated). Divisibility-validated against the mesh."""
-    return rules.tree_shardings(mesh, state_shape)
+    catch-all → replicated). Divisibility-validated against the mesh.
+
+    ``zero_stage`` selects the torch-FSDP ShardingStrategy analogue on the
+    'fsdp' mesh axis (SURVEY C13 `ShardingStrategy{FULL_SHARD,NO_SHARD}`):
+
+    - 3 (default, FULL_SHARD/ZeRO-3): params AND optimizer mirrors sharded
+      per the rules — XLA all-gathers weights at use.
+    - 1 (ZeRO-1, torch's optimizer-state sharding): params (and the EMA
+      mirror) REPLICATED over 'fsdp' — it behaves as a second data axis
+      for compute — while optimizer moments keep the sharded layout; the
+      partitioner derives the reduce-scatter(grads) -> sharded update ->
+      all-gather(params) dance that ZeRO-1 implements by hand. Weight
+      memory is not reduced, optimizer memory (2x params for adam) is.
+
+    NO_SHARD is simply fsdp=1; there is no runtime to choose, only layout.
+    """
+    if zero_stage not in (1, 3):
+        raise ValueError(f"zero_stage must be 1 or 3, got {zero_stage}")
+    sh = rules.tree_shardings(mesh, state_shape)
+    if zero_stage == 1:
+        def replicate_fsdp(s):
+            return NamedSharding(mesh, _drop_axis(s.spec, "fsdp"))
+
+        sh = sh.replace(params=jax.tree.map(replicate_fsdp, sh.params))
+        if sh.ema_params is not None:
+            sh = sh.replace(
+                ema_params=jax.tree.map(replicate_fsdp, sh.ema_params))
+    return sh
 
 
 def jit_train_step(train_step, mesh: Mesh, state_sharding, batch_axes=("data", "fsdp")):

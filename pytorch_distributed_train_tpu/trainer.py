@@ -146,7 +146,8 @@ class Trainer:
         init_rng, self.step_rng = jax.random.split(self.rng)
         state_shape = jax.eval_shape(self._init_state, init_rng)
         self.state_sharding = steps_lib.state_shardings(
-            self.mesh, self.rules, state_shape
+            self.mesh, self.rules, state_shape,
+            zero_stage=cfg.mesh.zero_stage,
         )
         opt_dev_sharding = self.state_sharding.opt_state
         if cfg.optim.offload_state:

@@ -74,6 +74,29 @@ def test_inject_is_identity_at_init():
                                   np.asarray(merged_out))
 
 
+def test_inject_identity_t5():
+    """The default targets regex covers T5's projections too (incl. the
+    out-first 3-D o_proj via out_proj_targets) — adapters attach across
+    encoder self-, decoder self-, and cross-attention, identity at init."""
+    cfg = LoraConfig(rank=4)
+    t5_cfg = ModelConfig(name="t5", vocab_size=64, hidden_size=32,
+                         num_layers=2, decoder_layers=2, num_heads=4,
+                         mlp_dim=64, dropout_rate=0.0)
+    model = build_model(t5_cfg, PrecisionConfig())
+    src = jnp.zeros((2, 10), jnp.int32)
+    tgt = jnp.zeros((2, 6), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, src, tgt,
+                        train=False)["params"]
+    paths = lora_lib.target_paths(params, cfg)
+    # (2 enc self + 2 dec self + 2 dec cross) x q/k/v/o
+    assert len(paths) == 24
+    injected = lora_lib.inject(jax.random.PRNGKey(1), params, cfg)
+    base = model.apply({"params": params}, src, tgt, train=False)
+    merged = model.apply({"params": lora_lib.merge(injected, cfg)},
+                         src, tgt, train=False)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(merged))
+
+
 def test_no_targets_is_loud():
     """A targets regex that matches nothing must raise, not silently
     train zero parameters (resnet has no attention projections)."""

@@ -252,3 +252,63 @@ def test_module_grad_norm_metrics(devices8):
     total = float(metrics["grad_norm"])
     rss = float(np.sqrt(sum(v**2 for v in per_module.values())))
     np.testing.assert_allclose(rss, total, rtol=1e-4)
+
+
+def test_zero1_matches_full_shard(devices8):
+    """mesh.zero_stage=1 (ZeRO-1: optimizer-state-only sharding) must be
+    pure layout: identical updated params to FULL_SHARD after two steps,
+    with params replicated over 'fsdp' and adam moments still sharded."""
+    from pytorch_distributed_train_tpu.config import ModelConfig, OptimConfig
+
+    model_cfg = ModelConfig(
+        name="llama", vocab_size=64, hidden_size=32, num_layers=2,
+        num_heads=4, num_kv_heads=2, mlp_dim=64, max_seq_len=16)
+    model = build_model(model_cfg, PrecisionConfig())
+    loss_fn = get_loss_fn("causal_lm_xent")
+    tx, _ = make_optimizer(
+        OptimConfig(name="adamw", learning_rate=1e-2, schedule="constant",
+                    warmup_steps=0), total_steps=100)
+    rules = rules_for_model("llama")
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4), devices8)
+    batch = {"input_ids": jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (16, 16)), jnp.int32)}
+
+    def init_state(rng):
+        ids = jnp.zeros((2, 16), jnp.int32)
+        variables = model.init({"params": rng}, ids, train=False)
+        # ema=True: the EMA mirror must follow the params' replicated
+        # layout under zero_stage=1 (eval serves from it)
+        return TrainState.create(params=variables["params"], tx=tx,
+                                 ema=True)
+
+    results = {}
+    for stage in (3, 1):
+        shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+        sharding = steps_lib.state_shardings(mesh, rules, shape,
+                                             zero_stage=stage)
+        state = jax.jit(init_state, out_shardings=sharding)(
+            jax.random.PRNGKey(0))
+        step = steps_lib.jit_train_step(
+            steps_lib.make_train_step(model, loss_fn, tx, ema_decay=0.5),
+            mesh, sharding)
+        for _ in range(2):
+            state, metrics = step(state, batch, jax.random.PRNGKey(1))
+        results[stage] = (jax.device_get(state.params), sharding,
+                          jax.device_get(state.ema_params))
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-6),
+        results[1][0], results[3][0])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-6),
+        results[1][2], results[3][2])
+
+    z1 = results[1][1]
+    flat_p = jax.tree_util.tree_leaves(z1.params)
+    assert all("fsdp" not in str(s.spec) for s in flat_p)
+    assert all("fsdp" not in str(s.spec)
+               for s in jax.tree_util.tree_leaves(z1.ema_params))
+    moment_specs = [str(s.spec) for s in
+                    jax.tree_util.tree_leaves(z1.opt_state)
+                    if hasattr(s, "spec")]
+    assert any("fsdp" in sp for sp in moment_specs), moment_specs
