@@ -455,8 +455,13 @@ class TrainConfig:
     total_steps: int = 1000
     eval_every_steps: int = 0  # 0 → eval at epoch boundaries only
     seed: int = 42
-    # Loss: "softmax_xent" (classification) | "mlm_xent" | "causal_lm_xent"
+    # Loss: "softmax_xent" (classification) | "mlm_xent" |
+    # "causal_lm_xent" | "seq2seq_xent" | "fused_causal_lm_xent" |
+    # "dpo" (preference pairs vs the frozen reference named by
+    # distill.teacher_checkpoint; losses.make_dpo_loss)
     loss: str = "softmax_xent"
+    # DPO temperature (the beta in -log sigmoid(beta * margin))
+    dpo_beta: float = 0.1
     # torch CrossEntropyLoss(label_smoothing=) analogue (softmax_xent only)
     label_smoothing: float = 0.0
 

@@ -207,6 +207,25 @@ def synthetic_lm(size: int, seq_len: int, vocab_size: int, seed: int = 0) -> Arr
     return ArrayDataset({"input_ids": ids})
 
 
+def synthetic_dpo(size: int, seq_len: int, vocab_size: int,
+                  prompt_len: int | None = None,
+                  seed: int = 0) -> ArrayDataset:
+    """Random preference pairs for DPO (losses.make_dpo_loss): each row
+    holds a shared prompt followed by two different continuations,
+    ``input_ids`` (2, S) stacked [chosen, rejected], ``loss_mask``
+    marking the continuation positions."""
+    rng = np.random.default_rng(seed)
+    p = prompt_len if prompt_len is not None else seq_len // 2
+    prompt = rng.integers(0, vocab_size, (size, 1, p))
+    conts = rng.integers(0, vocab_size, (size, 2, seq_len - p))
+    ids = np.concatenate(
+        [np.broadcast_to(prompt, (size, 2, p)), conts], axis=2)
+    mask = np.zeros((size, 2, seq_len), np.float32)
+    mask[:, :, p:] = 1.0
+    return ArrayDataset({"input_ids": ids.astype(np.int32),
+                         "loss_mask": mask})
+
+
 def synthetic_seq2seq(size: int, src_len: int, tgt_len: int,
                       vocab_size: int, seed: int = 0) -> ArrayDataset:
     """Random source/target pairs in the T5 convention:
@@ -597,6 +616,11 @@ def build_dataset(data_cfg, model_cfg, train: bool):
         return synthetic_lm(
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,
             seed=0 if train else 1,
+        )
+    if name == "synthetic_dpo":
+        return synthetic_dpo(
+            data_cfg.synthetic_size, data_cfg.seq_len,
+            model_cfg.vocab_size, seed=0 if train else 1,
         )
     if name == "synthetic_seq2seq":
         return synthetic_seq2seq(

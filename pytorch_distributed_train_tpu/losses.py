@@ -217,6 +217,52 @@ def make_distill_loss(base_fn, base_name: str, alpha: float,
     return fn
 
 
+def make_dpo_loss(beta: float):
+    """Direct Preference Optimization (Rafailov et al. 2023) —
+    preference fine-tuning without a reward model:
+
+        L = -log sigmoid(beta * [(pi_c - ref_c) - (pi_r - ref_r)])
+
+    where pi/ref are the policy's / frozen reference's summed
+    continuation log-probs of the chosen (c) and rejected (r) responses.
+
+    Batch layout (data.datasets.synthetic_dpo / a preference corpus):
+    ``input_ids`` (B, 2, S) — dim 1 is [chosen, rejected] —
+    ``loss_mask`` (B, 2, S) marking response tokens (prompt masked out).
+    The model sees the pair flattened to (2B, S) (steps.model_inputs);
+    the frozen reference's logits arrive as ``teacher_logits`` through
+    the same teacher hook distillation uses (distill.load_teacher — the
+    reference model IS a teacher with a different loss).
+    """
+    if beta <= 0.0:
+        raise ValueError(f"dpo beta must be > 0, got {beta}")
+
+    def seq_logps(logits, ids, mask):
+        # next-token logprob of each sequence's masked continuation
+        lp = jax.nn.log_softmax(logits[:, :, :-1].astype(jnp.float32), -1)
+        tok = jnp.take_along_axis(lp, ids[:, :, 1:, None], axis=-1)[..., 0]
+        return (tok * mask[:, :, 1:].astype(jnp.float32)).sum(-1)  # (B, 2)
+
+    def fn(logits, batch, *_):
+        ids = batch["input_ids"]            # (B, 2, S)
+        B, two, S = ids.shape
+        mask = batch.get("loss_mask", jnp.ones_like(ids))
+        pi = seq_logps(logits.reshape(B, 2, S, -1), ids, mask)
+        ref = seq_logps(
+            jax.lax.stop_gradient(
+                batch["teacher_logits"]).reshape(B, 2, S, -1), ids, mask)
+        margin = beta * ((pi[:, 0] - ref[:, 0]) - (pi[:, 1] - ref[:, 1]))
+        loss = -jax.nn.log_sigmoid(margin).mean()
+        return loss, {
+            "dpo_accuracy": (margin > 0).mean(),
+            "reward_margin": margin.mean() / beta,
+            "chosen_reward": (pi[:, 0] - ref[:, 0]).mean(),
+            "rejected_reward": (pi[:, 1] - ref[:, 1]).mean(),
+        }
+
+    return fn
+
+
 LOSSES = {
     "softmax_xent": softmax_xent,
     "mlm_xent": mlm_xent,

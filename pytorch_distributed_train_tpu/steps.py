@@ -53,7 +53,12 @@ def model_inputs(batch: dict) -> tuple:
         return (batch["input_ids"], batch["decoder_input_ids"])
     if "attention_mask" in batch:
         return (batch["input_ids"], batch["attention_mask"])
-    return (batch["input_ids"],)
+    ids = batch["input_ids"]
+    if ids.ndim == 3:
+        # preference pairs (B, 2, S) — DPO; the model scores the pair as
+        # one flattened (2B, S) forward (losses.make_dpo_loss un-flattens)
+        ids = ids.reshape(-1, ids.shape[-1])
+    return (ids,)
 
 
 def apply_model(model, params, batch_stats, batch, *, train: bool, dropout_rng):
@@ -194,8 +199,13 @@ def optax_global_norm(tree) -> jnp.ndarray:
 
 def make_eval_step(model, loss_fn: Callable,
                    schedule_free: bool = False,
-                   param_transform: Callable | None = None) -> Callable:
+                   param_transform: Callable | None = None,
+                   teacher_fn: Callable | None = None) -> Callable:
     def eval_step(state: TrainState, batch: dict):
+        if teacher_fn is not None:
+            # losses that SCORE AGAINST a frozen model (DPO's reference)
+            # need its logits at eval time too
+            batch = {**batch, "teacher_logits": teacher_fn(batch)}
         params = state.eval_params
         if schedule_free:
             # Schedule-Free trains on the z-sequence; the model that's

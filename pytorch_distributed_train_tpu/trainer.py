@@ -87,17 +87,35 @@ class Trainer:
                 "x/y unwrap (optim.schedule_free_eval) cannot locate the "
                 "ScheduleFreeState through the lora optimizer mask "
                 "(optax.multi_transform nests per-label inner states)")
-        self.loss_fn = losses_lib.get_loss_fn(
-            cfg.loss, label_smoothing=cfg.label_smoothing)
-        # Eval always scores the plain objective; the KD wrap below only
-        # applies to training (eval batches carry no teacher_logits).
-        self.eval_loss_fn = self.loss_fn
         self.teacher_fn = None
+        if cfg.loss == "dpo":
+            # Preference fine-tuning: distill.teacher_checkpoint names the
+            # frozen REFERENCE policy (the pre-DPO model) — loaded through
+            # the same teacher machinery, consumed by a different loss.
+            if not cfg.distill.teacher_checkpoint:
+                raise ValueError(
+                    "loss='dpo' needs distill.teacher_checkpoint pointing "
+                    "at the frozen reference policy's run directory")
+            if getattr(cfg.model, "fused_lm_loss", False):
+                raise ValueError(
+                    "loss='dpo' needs per-position logits — set "
+                    "model.fused_lm_loss=false")
+            self.loss_fn = losses_lib.make_dpo_loss(cfg.dpo_beta)
+            # DPO eval scores the same preference objective (the eval
+            # step injects the reference logits too)
+            self.eval_loss_fn = self.loss_fn
+        else:
+            self.loss_fn = losses_lib.get_loss_fn(
+                cfg.loss, label_smoothing=cfg.label_smoothing)
+            # Eval always scores the plain objective; the KD wrap below
+            # only applies to training.
+            self.eval_loss_fn = self.loss_fn
         if cfg.distill.teacher_checkpoint:
             from pytorch_distributed_train_tpu import distill as distill_lib
 
             t_model, t_vars, t_cfg = distill_lib.load_teacher(
-                cfg.distill, cfg.precision, self.mesh, cfg.loss)
+                cfg.distill, cfg.precision, self.mesh,
+                "causal_lm_xent" if cfg.loss == "dpo" else cfg.loss)
             t_dim = (t_cfg.num_classes if cfg.loss == "softmax_xent"
                      else t_cfg.vocab_size)
             s_dim = (cfg.model.num_classes if cfg.loss == "softmax_xent"
@@ -105,11 +123,13 @@ class Trainer:
             if t_dim != s_dim:
                 raise ValueError(
                     f"teacher output dim ({t_dim}) != student ({s_dim}) — "
-                    "distillation compares per-class/token distributions")
+                    "the teacher/reference and student distributions must "
+                    "live on the same classes/vocabulary")
             self.teacher_fn = distill_lib.make_teacher_fn(t_model, t_vars)
-            self.loss_fn = losses_lib.make_distill_loss(
-                self.loss_fn, cfg.loss, cfg.distill.alpha,
-                cfg.distill.temperature)
+            if cfg.loss != "dpo":
+                self.loss_fn = losses_lib.make_distill_loss(
+                    self.loss_fn, cfg.loss, cfg.distill.alpha,
+                    cfg.distill.temperature)
         self.rules = rules_for_model(cfg.model.name)
 
         # ---- data
@@ -187,7 +207,8 @@ class Trainer:
             steps_lib.make_eval_step(
                 self.model, self.eval_loss_fn,
                 schedule_free=cfg.optim.name == "schedule_free_adamw",
-                param_transform=param_transform),
+                param_transform=param_transform,
+                teacher_fn=self.teacher_fn if cfg.loss == "dpo" else None),
             self.mesh, self.state_sharding, self.batch_axes,
         )
         if cfg.lora.rank > 0 and jax.process_index() == 0:
@@ -303,6 +324,8 @@ class Trainer:
     def items_per_step(self) -> int:
         if self.cfg.loss == "softmax_xent":
             return self.cfg.data.batch_size  # images/step
+        if self.cfg.loss == "dpo":  # each row is a (chosen, rejected) pair
+            return 2 * self.cfg.data.batch_size * self.cfg.data.seq_len
         return self.cfg.data.batch_size * self.cfg.data.seq_len  # tokens/step
 
     # ------------------------------------------------------------------ loop
@@ -441,10 +464,10 @@ class Trainer:
         )
 
         host_params = load_flax_safetensors(path, self.state.params)
-        sharded = jax.device_put(
-            host_params,
-            self.rules.tree_shardings(self.mesh, host_params),
-        )
+        # Place into the state's ACTUAL layout (state_sharding), not a
+        # re-derivation from the rules — they differ under
+        # mesh.zero_stage=1, where params are replicated over 'fsdp'.
+        sharded = jax.device_put(host_params, self.state_sharding.params)
         self.state = self.state.replace(params=sharded)
         if self.state.ema_params is not None:
             # re-seed the EMA mirror too, else eval would run on the stale
