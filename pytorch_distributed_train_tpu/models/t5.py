@@ -294,6 +294,9 @@ class T5ForConditionalGeneration(nn.Module):
     # decoder output by d_model**-0.5 before it (HF applies the rescale
     # only when tied); untied is this repo's training default.
     tie_head: bool = False
+    # Activation rematerialization per block (models/remat.py policies)
+    remat: bool = False
+    remat_policy: str = "full"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -309,8 +312,11 @@ class T5ForConditionalGeneration(nn.Module):
             param_dtype=self.param_dtype, name="shared")
         drop = lambda h: nn.Dropout(self.dropout_rate)(  # noqa: E731
             h, deterministic=det)
+        from pytorch_distributed_train_tpu.models.remat import remat_block
+
+        block_cls = remat_block(T5Block, self.remat, self.remat_policy)
         block = partial(
-            T5Block, self.num_heads, self.mlp_dim,
+            block_cls, self.num_heads, self.mlp_dim,
             rel_pos_buckets=self.rel_pos_buckets,
             rel_pos_max_distance=self.rel_pos_max_distance,
             eps=self.layer_norm_eps, dropout_rate=self.dropout_rate,
@@ -382,6 +388,8 @@ def t5(cfg, dtype, param_dtype, cp=None, act=None) -> T5ForConditionalGeneration
         rel_pos_max_distance=getattr(cfg, "rel_pos_max_distance", 128),
         dropout_rate=cfg.dropout_rate,
         tie_head=getattr(cfg, "tie_word_embeddings", False),
+        remat=getattr(cfg, "remat", False),
+        remat_policy=getattr(cfg, "remat_policy", "full"),
         dtype=dtype,
         param_dtype=param_dtype,
     )

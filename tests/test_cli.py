@@ -132,3 +132,53 @@ def test_generate_cli_user_errors_one_line(tmp_path, capfd):
                             "--prompt", "x"])
     err = capfd.readouterr().err
     assert rc == 2 and "Traceback" not in err and "error" in err
+
+
+def test_generate_cli_t5(tmp_path, capfd):
+    """Seq2seq serving through the same CLI: t5 weights via the interop
+    bridge, byte tokenizer, greedy + int8; --tp refused loudly."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.interop import save_torch_safetensors
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import generate_cli
+
+    shrink = ["model.vocab_size=300", "model.hidden_size=32",
+              "model.num_layers=2", "model.decoder_layers=2",
+              "model.num_heads=4", "model.mlp_dim=64",
+              "model.max_seq_len=64", "model.dropout_rate=0.0"]
+    cfg = get_preset("t5_small")
+    cfg.apply_overrides(shrink)
+    model = build_model(cfg.model, cfg.precision)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 2), jnp.int32),
+                        jnp.zeros((1, 2), jnp.int32),
+                        train=False)["params"]
+    st = tmp_path / "t5.st"
+    save_torch_safetensors(params, str(st))
+
+    rc = generate_cli.main(
+        ["--config", "t5_small", "--safetensors", str(st),
+         "--prompt", "translate this", "--max-new-tokens", "5"]
+        + [f"--set={s}" for s in shrink])
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert "prompt 0: 'translate this'" in out
+
+    rc = generate_cli.main(
+        ["--config", "t5_small", "--safetensors", str(st),
+         "--prompt", "hi", "--max-new-tokens", "3", "--quantize", "int8"]
+        + [f"--set={s}" for s in shrink])
+    assert rc == 0
+    assert "prompt 0" in capfd.readouterr().out
+
+    rc = generate_cli.main(
+        ["--config", "t5_small", "--safetensors", str(st),
+         "--prompt", "hi", "--max-new-tokens", "3", "--tp", "2"]
+        + [f"--set={s}" for s in shrink])
+    assert rc == 2
+    assert "t5 serving" in capfd.readouterr().err

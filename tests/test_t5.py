@@ -137,6 +137,33 @@ def test_dropout_active_in_train_mode():
     assert not np.allclose(np.asarray(o1), np.asarray(o2))
 
 
+def test_remat_preserves_fwd_and_grad():
+    """model.remat=true on t5 must be numerically inert (same forward,
+    same grads — it only trades backward FLOPs for activation memory)."""
+    src = jnp.zeros((2, 10), jnp.int32)
+    tgt = jnp.zeros((2, 6), jnp.int32)
+    outs = {}
+    for remat in (False, True):
+        model = build_model(_cfg(remat=remat), PrecisionConfig())
+        params = model.init({"params": jax.random.PRNGKey(0)}, src, tgt,
+                            train=False)["params"]
+
+        def loss(p):
+            return jnp.sum(model.apply({"params": p}, src, tgt,
+                                       train=True) ** 2)
+
+        outs[remat] = (float(loss(params)), jax.grad(loss)(params))
+    np.testing.assert_allclose(outs[False][0], outs[True][0], rtol=1e-6)
+    # remat reorders the recompute, so bit-exactness isn't guaranteed;
+    # near-cancelling gradient elements carry fp32 accumulation noise
+    # proportional to the LOSS scale (O(1e3) here), not their own tiny
+    # values — compare at that floor
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=5e-4),
+        outs[False][1], outs[True][1])
+
+
 def test_sharding_rules_cover_t5(devices8):
     """Every t5 param gets a valid spec on a fsdp×tensor mesh."""
     from jax.sharding import Mesh

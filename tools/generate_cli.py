@@ -80,13 +80,43 @@ def main(argv=None) -> int:
             raise ValueError("empty prompt after tokenization")
 
         model_cfg = cfg.model
+        is_t5 = model_cfg.name.startswith("t5")
+        if is_t5 and args.tp > 1:
+            # refuse BEFORE the (potentially tens-of-GB) weight load
+            raise ValueError(
+                "--tp supports the causal-LM families; t5 serving is "
+                "single-device for now")
+        init_inputs = ((jnp.zeros((1, 2), jnp.int32),
+                        jnp.zeros((1, 2), jnp.int32)) if is_t5
+                       else (jnp.zeros((1, 2), jnp.int32),))
         template = jax.eval_shape(
             lambda: build_model(model_cfg, cfg.precision).init(
                 {"params": jax.random.PRNGKey(0)},
-                jnp.zeros((1, 2), jnp.int32), train=False))["params"]
+                *init_inputs, train=False))["params"]
         params = load_flax_safetensors(args.safetensors, template)
         if args.quantize == "int8":
             params = jax.jit(quant.quantize_tree)(params)
+
+        def emit(i, text, new):
+            if tok.eos_id in new:
+                new = new[: new.index(tok.eos_id)]
+            print(f"=== prompt {i}: {text!r}")
+            print(tok.decode(new))
+
+        if is_t5:
+            from pytorch_distributed_train_tpu.generate import (
+                generate_seq2seq,
+            )
+
+            for i, (text, e) in enumerate(zip(prompts, encoded)):
+                ids = jnp.asarray(np.asarray(e, np.int32)[None, :])
+                out = np.asarray(generate_seq2seq(
+                    model_cfg, cfg.precision, params, ids,
+                    args.max_new_tokens, temperature=args.temperature,
+                    top_k=args.top_k, rng=jax.random.PRNGKey(args.seed + i),
+                    eos_id=tok.eos_id))
+                emit(i, text, out[0].tolist())
+            return 0
 
         model = build_decode_model(model_cfg, cfg.precision)
         mesh = None
@@ -108,11 +138,7 @@ def main(argv=None) -> int:
                 temperature=args.temperature, top_k=args.top_k,
                 rng=jax.random.PRNGKey(args.seed + i), eos_id=tok.eos_id,
                 mesh=mesh))
-            new = out[0, len(e):].tolist()
-            if tok.eos_id in new:
-                new = new[: new.index(tok.eos_id)]
-            print(f"=== prompt {i}: {text!r}")
-            print(tok.decode(new))
+            emit(i, text, out[0, len(e):].tolist())
         return 0
     except (KeyError, ValueError, FileNotFoundError, OSError) as e:
         # User-input mistakes (unknown preset, typo'd --set, missing or
