@@ -20,6 +20,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
@@ -294,3 +295,107 @@ def generate_seq2seq(model_cfg, precision, params, input_ids,
         out.append(nxt[:, None])
         ids = nxt[:, None]
     return jnp.concatenate(out, axis=1)
+
+
+# ------------------------------------------------------------- beam search
+
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2,))
+def _beam_step(model, params, cache, ids, beam_scores, num_beams: int,
+               finished, last_token):
+    """One beam-search expansion: score continuations of every live beam,
+    keep the global top ``num_beams``, and REORDER the KV cache so each
+    surviving beam sits on the cache row of its parent (gather on the
+    batch axis — the TPU-friendly equivalent of torch's
+    `reorder_cache`). Finished beams (emitted eos) are frozen: their only
+    continuation is another eos at zero added score."""
+    from pytorch_distributed_train_tpu import quant
+
+    p = quant.dequantize_tree(params, model.dtype)
+    logits, cache = model.apply(
+        {"params": p, "cache": cache}, ids, train=False, mutable=["cache"],
+    )
+    cache = cache["cache"]
+    logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
+    V = logp.shape[-1]
+    # frozen beams contribute exactly one candidate: repeat last_token
+    # (eos) at unchanged score; all their other continuations are -inf
+    frozen_rows = jax.vmap(lambda t: jnp.full((V,), -jnp.inf)
+                           .at[t].set(0.0))(last_token)
+    logp = jnp.where(finished[:, None], frozen_rows, logp)
+    total = beam_scores[:, None] + logp                  # (beams, V)
+    flat = total.reshape(-1)
+    top_scores, top_idx = jax.lax.top_k(flat, num_beams)
+    parent = top_idx // V
+    token = (top_idx % V).astype(jnp.int32)
+    cache = jax.tree.map(
+        lambda x: jnp.take(x, parent, axis=0) if x.ndim > 0 else x, cache)
+    return cache, token, top_scores, parent
+
+
+def beam_search(model, params, prompt_ids, max_new_tokens: int,
+                *, num_beams: int = 4, eos_id: int | None = None,
+                length_penalty: float = 1.0) -> tuple:
+    """Beam-search decoding for a (1, S) prompt (causal-LM families).
+
+    Returns (sequences (num_beams, S + max_new_tokens), scores
+    (num_beams,)) sorted best-first; ``scores`` are summed token
+    log-probs divided by (generated length)**length_penalty. Beams that
+    emit ``eos_id`` freeze (their score stops accumulating). num_beams=1
+    reproduces greedy decoding exactly.
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, S = prompt_ids.shape
+    if B != 1:
+        raise ValueError(f"beam_search expects a single prompt (got B={B})")
+    if S + max_new_tokens > model.max_seq_len:
+        raise ValueError(
+            f"prompt ({S}) + new tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({model.max_seq_len})")
+    # Prefill ONCE at B=1, then broadcast the cache rows to the beam
+    # count (same batch-axis gather the per-step reorder uses) — running
+    # num_beams identical prompt forwards would multiply prefill cost.
+    cache = init_cache(model, 1)
+    logits, cache = _decode_step(model, params, cache, prompt_ids)
+    zeros = jnp.zeros((num_beams,), jnp.int32)
+    cache = jax.tree.map(
+        lambda x: jnp.take(x, zeros, axis=0) if x.ndim > 0 else x, cache)
+    # _decode_step already sliced to the last position: logits is (B, V)
+    logp0 = jax.nn.log_softmax(logits[0].astype(jnp.float32), -1)
+    # first expansion: all beams share the prompt, so seed from ONE row's
+    # top-k (otherwise every beam would pick the same argmax)
+    scores, first = jax.lax.top_k(logp0, num_beams)
+    tokens = [first.astype(jnp.int32)]
+    parents = []
+    finished = (first == eos_id) if eos_id is not None else jnp.zeros(
+        (num_beams,), bool)
+    gen_len = jnp.ones((num_beams,), jnp.int32)
+    for _ in range(max_new_tokens - 1):
+        cache, tok, scores, parent = _beam_step(
+            model, params, cache, tokens[-1][:, None], scores, num_beams,
+            finished, tokens[-1])
+        finished = jnp.take(finished, parent) if eos_id is not None else finished
+        gen_len = jnp.take(gen_len, parent) + (~finished).astype(jnp.int32)
+        if eos_id is not None:
+            finished = finished | (tok == eos_id)
+        tokens.append(tok)
+        parents.append(parent)
+        if eos_id is not None and bool(jnp.all(finished)):
+            break
+    # backtrack through the parent pointers to reconstruct sequences
+    n_steps = len(tokens)
+    seqs = np.zeros((num_beams, n_steps), np.int32)
+    idx = np.arange(num_beams)
+    for t in range(n_steps - 1, -1, -1):
+        seqs[:, t] = np.asarray(tokens[t])[idx]
+        if t > 0:
+            idx = np.asarray(parents[t - 1])[idx]
+    full = np.concatenate(
+        [np.repeat(np.asarray(prompt_ids), num_beams, 0), seqs], axis=1)
+    if full.shape[1] < S + max_new_tokens:  # early eos stop: pad
+        pad = np.full((num_beams, S + max_new_tokens - full.shape[1]),
+                      eos_id if eos_id is not None else 0, np.int32)
+        full = np.concatenate([full, pad], axis=1)
+    final = np.asarray(scores) / np.maximum(
+        np.asarray(gen_len), 1) ** length_penalty
+    order = np.argsort(-final)
+    return jnp.asarray(full[order]), jnp.asarray(final[order])

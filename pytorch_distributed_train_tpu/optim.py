@@ -232,6 +232,21 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0,
                         eps=opt_cfg.eps, weight_decay=opt_cfg.weight_decay,
                         mask=mask, mu_dtype=mu_dtype)
         )
+    elif name == "lion":
+        # Lion (Chen et al. 2023, "Symbolic Discovery of Optimization
+        # Algorithms"): sign(momentum-interpolated grad) updates — ONE
+        # moment buffer (half adam's optimizer memory) and sign updates
+        # that are bf16-friendly on TPU. Canonical recipe: lr ~3-10x
+        # smaller and weight_decay ~3-10x larger than adamw's.
+        # OptimConfig's beta2 default (0.999) is adam's; Lion's canonical
+        # b2 is 0.99 — remap the untouched default so `optim.name=lion`
+        # alone runs the published recipe (any other explicit value wins).
+        lion_b2 = 0.99 if opt_cfg.beta2 == 0.999 else opt_cfg.beta2
+        parts.append(
+            optax.lion(sched, b1=opt_cfg.beta1, b2=lion_b2,
+                       weight_decay=opt_cfg.weight_decay, mask=mask,
+                       mu_dtype=mu_dtype)
+        )
     elif name == "lamb":
         if mu_dtype is None:
             parts.append(

@@ -524,3 +524,35 @@ def test_layer_lr_decay_scales_by_depth():
     # depthless trees fail loudly instead of becoming a uniform LR cut
     with pytest.raises(ValueError, match="depth-indexed"):
         layer_lr_decay_transform(0.5).init({"w": jnp.zeros((4, 4))})
+
+
+def test_lion_sign_updates_and_single_moment():
+    """Lion: updates are sign-valued (every parameter moves by exactly
+    +-lr when weight decay is off) and the state carries ONE moment
+    buffer — half of adam's optimizer memory."""
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+
+    lr = 1e-2
+    cfg = OptimConfig(name="lion", learning_rate=lr, schedule="constant",
+                      warmup_steps=0, weight_decay=0.0, beta1=0.9,
+                      beta2=0.99)
+    tx, _ = make_optimizer(cfg, total_steps=10)
+    params = {"w": jnp.asarray(np.random.default_rng(0).standard_normal(
+        (8, 4)), jnp.float32)}
+    state = tx.init(params)
+    grads = {"w": jnp.asarray(np.random.default_rng(1).standard_normal(
+        (8, 4)), jnp.float32)}
+    updates, state = tx.update(grads, state, params)
+    mags = np.abs(np.asarray(updates["w"]))
+    np.testing.assert_allclose(mags, lr, rtol=1e-6)
+
+    lion_elems = sum(int(np.prod(l.shape)) for l in
+                     jax.tree_util.tree_leaves(state)
+                     if getattr(l, "ndim", 0) >= 2)
+    adam_tx, _ = make_optimizer(
+        OptimConfig(name="adamw", learning_rate=lr, schedule="constant",
+                    warmup_steps=0), total_steps=10)
+    adam_elems = sum(int(np.prod(l.shape)) for l in
+                     jax.tree_util.tree_leaves(adam_tx.init(params))
+                     if getattr(l, "ndim", 0) >= 2)
+    assert lion_elems == adam_elems // 2
