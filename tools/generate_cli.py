@@ -38,6 +38,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--num-beams", type=int, default=0,
+                   help="beam-search decoding (causal-LM families; "
+                        "overrides temperature/top-k; 0 → off)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quantize", default="", choices=["", "int8"])
     p.add_argument("--tp", type=int, default=0,
@@ -81,11 +84,20 @@ def main(argv=None) -> int:
 
         model_cfg = cfg.model
         is_t5 = model_cfg.name.startswith("t5")
+        # argument-compatibility refusals BEFORE the (potentially
+        # tens-of-GB) weight load
         if is_t5 and args.tp > 1:
-            # refuse BEFORE the (potentially tens-of-GB) weight load
             raise ValueError(
                 "--tp supports the causal-LM families; t5 serving is "
                 "single-device for now")
+        if args.num_beams >= 1 and is_t5:
+            raise ValueError(
+                "--num-beams supports the causal-LM families; t5 beam "
+                "search is not built yet (docs/ROADMAP.md)")
+        if args.num_beams >= 1 and args.tp > 1:
+            raise ValueError(
+                "--num-beams with --tp is unsupported (beam search "
+                "drives the single-device step)")
         init_inputs = ((jnp.zeros((1, 2), jnp.int32),
                         jnp.zeros((1, 2), jnp.int32)) if is_t5
                        else (jnp.zeros((1, 2), jnp.int32),))
@@ -133,11 +145,21 @@ def main(argv=None) -> int:
         # reuse the same compiled executables.
         for i, (text, e) in enumerate(zip(prompts, encoded)):
             ids = jnp.asarray(np.asarray(e, np.int32)[None, :])
-            out = np.asarray(generate(
-                model, params, ids, args.max_new_tokens,
-                temperature=args.temperature, top_k=args.top_k,
-                rng=jax.random.PRNGKey(args.seed + i), eos_id=tok.eos_id,
-                mesh=mesh))
+            if args.num_beams >= 1:  # 1 == greedy via the beam machinery
+                from pytorch_distributed_train_tpu.generate import (
+                    beam_search,
+                )
+
+                seqs, _ = beam_search(
+                    model, params, ids, args.max_new_tokens,
+                    num_beams=args.num_beams, eos_id=tok.eos_id)
+                out = np.asarray(seqs)
+            else:
+                out = np.asarray(generate(
+                    model, params, ids, args.max_new_tokens,
+                    temperature=args.temperature, top_k=args.top_k,
+                    rng=jax.random.PRNGKey(args.seed + i),
+                    eos_id=tok.eos_id, mesh=mesh))
             emit(i, text, out[0, len(e):].tolist())
         return 0
     except (KeyError, ValueError, FileNotFoundError, OSError) as e:
