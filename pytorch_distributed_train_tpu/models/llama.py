@@ -68,6 +68,18 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def apply_rope_rows(x: jnp.ndarray, cos: jnp.ndarray,
+                    sin: jnp.ndarray) -> jnp.ndarray:
+    """Per-row-position rope: x (B, S, H, D), cos/sin (B, S, D/2) — each
+    batch row carries its own position slice (continuous-batching decode,
+    serving.py, where slots sit at different sequence offsets)."""
+    D = x.shape[-1]
+    x1, x2 = x[..., : D // 2], x[..., D // 2:]
+    cos = cos[:, :, None, :].astype(x.dtype)
+    sin = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
 class LlamaAttention(nn.Module):
     num_heads: int
     num_kv_heads: int
@@ -89,6 +101,10 @@ class LlamaAttention(nn.Module):
     # running cache offset instead of restarting at 0 (speculative
     # decoding's k+1-token verify pass, speculative.py).
     decode_multi: bool = False
+    # Continuous batching (serving.py): cache_index is (B,) — every batch
+    # row decodes at ITS OWN sequence offset, so serving slots at different
+    # positions share one batched step. Prefill still starts rows at 0.
+    decode_rows: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -112,8 +128,13 @@ class LlamaAttention(nn.Module):
                                 (B, L, self.num_kv_heads, head_dim), k.dtype)
             c_v = self.variable("cache", "cached_value", jnp.zeros,
                                 (B, L, self.num_kv_heads, head_dim), v.dtype)
+            if self.decode_rows and self.decode_multi:
+                raise ValueError(
+                    "decode_rows and decode_multi are mutually exclusive "
+                    "(speculative decoding runs scalar-index caches)")
+            idx_shape = (B,) if self.decode_rows else ()
             c_i = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
+                                lambda: jnp.zeros(idx_shape, jnp.int32))
             if S > 1 and not self.decode_multi:
                 # Prefill: a multi-token decode call means "start this cache
                 # from position 0" (generate.py's contract). Positions are
@@ -128,10 +149,36 @@ class LlamaAttention(nn.Module):
                     c_k.value, k, 0, 1)
                 c_v.value = jax.lax.dynamic_update_slice_in_dim(
                     c_v.value, v, 0, 1)
-                c_i.value = jnp.full((), S, jnp.int32)
+                c_i.value = jnp.full(idx_shape, S, jnp.int32)
                 y = dot_product_attention(q, k, v, causal=True,
                                           impl=self.attn_impl,
                                           window=self.window)
+            elif self.decode_rows:
+                # Per-row continuation: row b's S tokens append at ITS
+                # offset idx[b]. vmap turns the per-row dynamic updates
+                # into one scatter; positions/mask are per-row too.
+                idx = c_i.value  # (B,)
+                cos, sin = rope_frequencies(head_dim, L, self.rope_theta,
+                                            self.rope_scaling)
+                take = lambda tbl, i: jax.lax.dynamic_slice_in_dim(  # noqa: E731
+                    tbl, i, S, 0)
+                cos_r = jax.vmap(take, (None, 0))(cos, idx)
+                sin_r = jax.vmap(take, (None, 0))(sin, idx)
+                q = apply_rope_rows(q, cos_r, sin_r)
+                k = apply_rope_rows(k, cos_r, sin_r)
+                upd = lambda c, new, i: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+                    c, new, i, 0)
+                c_k.value = jax.vmap(upd)(c_k.value, k, idx)
+                c_v.value = jax.vmap(upd)(c_v.value, v, idx)
+                c_i.value = idx + S
+                q_pos = idx[:, None] + jnp.arange(S)  # (B, S)
+                k_pos = jnp.arange(L)
+                mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (B, S, L)
+                if self.window:
+                    mask &= (q_pos[:, :, None] - k_pos[None, None, :]
+                             ) < self.window
+                y = dot_product_attention(q, c_k.value, c_v.value,
+                                          mask=mask[:, None], impl="xla")
             else:
                 # Step(s) at the running offset (dynamic index). Handles
                 # any static S: with decode_multi this is the multi-token
@@ -215,6 +262,7 @@ class LlamaBlock(nn.Module):
     quant: str = ""
     decode: bool = False
     decode_multi: bool = False
+    decode_rows: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -224,7 +272,7 @@ class LlamaBlock(nn.Module):
             self.rope_scaling, self.max_seq_len, self.dtype,
             self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
             window=self.window, quant=self.quant, decode=self.decode,
-            decode_multi=self.decode_multi,
+            decode_multi=self.decode_multi, decode_rows=self.decode_rows,
             name="attn",
         )(h)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
@@ -272,6 +320,8 @@ class LlamaForCausalLM(nn.Module):
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # Multi-token continuation in decode mode (speculative.py verify pass)
     decode_multi: bool = False
+    # Per-row cache offsets for continuous-batching serving (serving.py)
+    decode_rows: bool = False
     # Fused chunked head+CE (losses.chunked_causal_ce): __call__ returns
     # {'loss_sum','weight_sum'} instead of logits — (B,S,V) fp32 logits
     # never materialize. Pair with loss="fused_causal_lm_xent".
@@ -306,7 +356,7 @@ class LlamaForCausalLM(nn.Module):
                 cp=self.cp, moe=moe,
                 attn_impl=self.attn_impl, window=self.attention_window,
                 quant=self.quant_training, decode=self.decode,
-                decode_multi=self.decode_multi,
+                decode_multi=self.decode_multi, decode_rows=self.decode_rows,
                 name=f"layer{i}",
             )(x)
             if self.act is not None:

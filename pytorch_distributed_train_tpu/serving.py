@@ -1,0 +1,274 @@
+"""Continuous batching: slot-based serving loop over one batched decode step.
+
+The serving counterpart of generate.py (torch-ecosystem analogues: vLLM's
+continuous batching, TGI's router). generate() runs one batch lockstep —
+every sequence prefills together and finishes together, so short requests
+wait on long ones and free batch rows idle. This module keeps a fixed pool
+of B cache SLOTS instead: requests are admitted into free slots as they
+arrive, every active slot advances one token per batched step, and a slot
+frees the moment its row emits EOS or exhausts its budget.
+
+TPU-first shape discipline (SURVEY §7.4.5 — no dynamic shapes):
+- The KV cache stays ONE static (B, max_seq_len, H_kv, D) buffer per layer.
+  Per-row positions come from the model's ``decode_rows`` mode
+  (models/llama.py): ``cache_index`` is (B,), rope/mask/update are per-row,
+  so slots at different offsets share a single jitted step — two
+  executables steady-state (prefill per bucket + the step), regardless of
+  arrival order.
+- Prompts prefill at B=1 padded to a power-of-two BUCKET (few compiles,
+  bounded) and the resulting cache row is scattered into the slot
+  (``_insert_row``). Right-padding is causal-safe: the last real token
+  never attends to pad positions, and pad K/V beyond ``true_len`` stays
+  masked (cache_index) until overwritten by real decode steps.
+- Free slots keep decoding garbage rows — their outputs are ignored and
+  their state is fully overwritten at the next admit. Masking them out
+  would need a dynamic batch shape; computing them costs nothing extra in
+  the batched step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+from pytorch_distributed_train_tpu.generate import (
+    _decode_step,
+    build_decode_model,
+    filter_logits,
+    init_cache,
+)
+
+
+def build_serving_model(model_cfg: ModelConfig, precision: PrecisionConfig):
+    """The continuous-batching twin of a decode model: per-row cache
+    offsets enabled (models/llama.py decode_rows)."""
+    model = build_decode_model(model_cfg, precision)
+    if not any(f.name == "decode_rows"
+               for f in dataclasses.fields(model)):
+        raise ValueError(
+            f"model {model_cfg.name!r} has no decode_rows mode (continuous "
+            "batching currently covers the llama family)")
+    return dataclasses.replace(model, decode_rows=True)
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _prefill_step(model, params, cache, ids, true_len):
+    """Prefill a right-padded (1, P) prompt; return the logits at the last
+    REAL token (position true_len-1, not P-1) and the filled cache."""
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
+    logits, updated = model.apply(
+        {"params": params, "cache": cache}, ids, train=False,
+        mutable=["cache"],
+    )
+    last = jnp.take_along_axis(
+        logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
+    return last, updated["cache"]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _insert_row(big_cache, row_cache, r, true_len):
+    """Scatter a freshly prefilled B=1 cache into slot ``r`` of the pool.
+
+    K/V leaves copy the FULL row (zeros beyond the prompt erase the
+    previous occupant); the (B,) cache_index sets slot r to the prompt's
+    true length (the prefill wrote the padded length)."""
+    def one(big, row):
+        if big.ndim >= 2:  # (B, L, H, D) K/V buffers
+            return jax.lax.dynamic_update_slice(
+                big, row.astype(big.dtype),
+                (r,) + (0,) * (big.ndim - 1))
+        return big.at[r].set(true_len.astype(big.dtype))  # (B,) index
+
+    return jax.tree.map(one, big_cache, row_cache)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _sample_rows(logits, rng, temperature, top_k: int):
+    """Per-row sampling: rows with temperature 0 are greedy, others sample
+    at their own temperature under a shared static top-k."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    f = filter_logits(logits, jnp.maximum(temperature, 1e-6)[:, None],
+                      top_k)
+    sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature == 0.0, greedy, sampled)
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_id: int | None = None
+
+
+@dataclasses.dataclass
+class Completion:
+    uid: int
+    prompt: list[int]
+    tokens: list[int]  # generated continuation (includes eos if emitted)
+    finish_reason: str  # "eos" | "length"
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over ``slots`` concurrent sequences.
+
+    Usage::
+
+        b = ContinuousBatcher(cfg, precision, params, slots=8)
+        b.submit([1, 2, 3], max_new_tokens=32)
+        b.submit([4, 5], max_new_tokens=8, temperature=0.7)
+        for completion in b.run():
+            ...
+
+    ``step()`` is the scheduler quantum: admit queued requests into free
+    slots (one B=1 bucketed prefill each), then advance every slot one
+    token in a single batched decode step. Sampling law matches
+    generate(): greedy at temperature 0, categorical over
+    temperature-scaled top-k logits otherwise.
+    """
+
+    def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
+                 params: Any, *, slots: int = 4, top_k: int = 0,
+                 rng=None, min_bucket: int = 16):
+        self.model = build_serving_model(model_cfg, precision)
+        self.params = params
+        self.slots = slots
+        self.top_k = top_k
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.cache = init_cache(self.model, slots)
+        self.max_seq_len = self.model.max_seq_len
+        # power-of-two prefill buckets bound compile count to
+        # log2(max_seq_len / min_bucket) + 1 prefill executables
+        self.buckets = []
+        b = min_bucket
+        while b < self.max_seq_len:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(self.max_seq_len)
+
+        self.queue: deque[Request] = deque()
+        self._next_uid = 0
+        # host-side slot state
+        self._req: list[Request | None] = [None] * slots
+        self._generated: list[list[int]] = [[] for _ in range(slots)]
+        self._pending = np.zeros(slots, np.int32)  # next input token per slot
+        self._temp = np.zeros(slots, np.float32)
+        self.stats = {"steps": 0, "prefills": 0, "generated_tokens": 0,
+                      "slot_token_slots": 0}
+
+    # ------------------------------------------------------------- intake
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, eos_id: int | None = None) -> int:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens} "
+                "(admission always samples the first continuation token)")
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.max_seq_len})")
+        uid = self._next_uid
+        self._next_uid += 1
+        self.queue.append(Request(uid, prompt, max_new_tokens,
+                                  temperature, eos_id))
+        return uid
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds max bucket")
+
+    # ---------------------------------------------------------- scheduler
+    def _admit(self, r: int, req: Request) -> Completion | None:
+        """Prefill ``req`` into slot ``r``; returns a Completion iff the
+        very first sampled token already finishes the request."""
+        P = self._bucket(len(req.prompt))
+        ids = np.zeros((1, P), np.int32)
+        ids[0, : len(req.prompt)] = req.prompt
+        row_cache = init_cache(self.model, 1)
+        last, row_cache = _prefill_step(
+            self.model, self.params, row_cache, jnp.asarray(ids),
+            jnp.asarray([len(req.prompt)], jnp.int32))
+        self.cache = _insert_row(
+            self.cache, row_cache, jnp.int32(r),
+            jnp.int32(len(req.prompt)))
+        self.rng, step_rng = jax.random.split(self.rng)
+        first = int(_sample_rows(
+            last, step_rng, jnp.asarray([req.temperature], jnp.float32),
+            self.top_k)[0])
+        self.stats["prefills"] += 1
+        self.stats["generated_tokens"] += 1
+        self._req[r] = req
+        self._generated[r] = [first]
+        self._pending[r] = first
+        self._temp[r] = req.temperature
+        return self._maybe_finish(r, first)
+
+    def _maybe_finish(self, r: int, token: int) -> Completion | None:
+        req = self._req[r]
+        done_eos = req.eos_id is not None and token == req.eos_id
+        done_len = len(self._generated[r]) >= req.max_new_tokens
+        if not (done_eos or done_len):
+            return None
+        self._req[r] = None  # slot free; cache row is dead until re-admit
+        return Completion(req.uid, req.prompt, self._generated[r],
+                          "eos" if done_eos else "length")
+
+    @property
+    def active_slots(self) -> list[int]:
+        return [r for r in range(self.slots) if self._req[r] is not None]
+
+    def step(self) -> list[Completion]:
+        """One scheduler quantum: admit into free slots, then one batched
+        decode step advancing every active slot by one token."""
+        finished: list[Completion] = []
+        for r in range(self.slots):
+            if self._req[r] is None and self.queue:
+                done = self._admit(r, self.queue.popleft())
+                if done is not None:
+                    finished.append(done)
+        active = self.active_slots
+        if not active:
+            return finished
+        # Rows needing >=1 more token feed their pending sampled token;
+        # free rows feed token 0 and are ignored (their cache_index
+        # free-runs — reset at the next admit, clamped writes stay in the
+        # dead row).
+        ids = jnp.asarray(self._pending)[:, None]
+        logits, self.cache = _decode_step(
+            self.model, self.params, self.cache, ids)
+        self.rng, step_rng = jax.random.split(self.rng)
+        nxt = np.asarray(_sample_rows(
+            logits, step_rng, jnp.asarray(self._temp), self.top_k))
+        self.stats["steps"] += 1
+        self.stats["slot_token_slots"] += self.slots
+        for r in active:
+            tok = int(nxt[r])
+            self._generated[r].append(tok)
+            self._pending[r] = tok
+            self.stats["generated_tokens"] += 1
+            done = self._maybe_finish(r, tok)
+            if done is not None:
+                finished.append(done)
+        return finished
+
+    def run(self):
+        """Drive step() until queue and slots drain, yielding Completions
+        as they finish (arrival-order-independent)."""
+        while self.queue or self.active_slots:
+            yield from self.step()
