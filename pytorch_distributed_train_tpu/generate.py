@@ -129,11 +129,14 @@ def _decode_step(model, params, cache, ids):
     return logits[:, -1], updated["cache"]
 
 
-def filter_logits(logits, temperature: float, top_k: int):
+def filter_logits(logits, temperature, top_k: int):
     """THE sampling law's logit filtering — temperature scaling + top-k
-    truncation. Single definition shared by the direct sampler below and
+    truncation. Single definition shared by the direct sampler below,
     speculative.py's draft/verify distributions (whose exactness guarantee
-    is 'same law as generate()'); requires temperature > 0."""
+    is 'same law as generate()'), and serving.py's per-row sampler.
+    ``temperature`` is a positive scalar OR an array broadcastable against
+    ``logits`` (serving passes (B, 1) per-row temperatures); every entry
+    must be > 0."""
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
@@ -238,6 +241,44 @@ def _seq2seq_decode_step(model, params, cache, ids, enc, enc_mask):
     return logits[:, -1], updated["cache"]
 
 
+def _seq2seq_setup(model_cfg, precision, params, input_ids,
+                   max_new_tokens: int, attention_mask):
+    """Shared greedy/beam seq2seq bring-up: validate the token budget,
+    default the source mask, run the jitted encoder once, and build the
+    cached decoder. Callers allocate their own zeroed cache (its batch
+    dim differs: B rows for greedy, num_beams for beam search) via
+    _alloc_cache; it is sized to max_seq_len (not the call's token
+    budget) — the decode module is a static jit key, so a fixed size
+    means ONE compiled step per model regardless of requested length.
+    Returns (decoder, enc, attention_mask)."""
+    from pytorch_distributed_train_tpu.models.t5 import (
+        t5_decode_step,
+        t5_encoder,
+    )
+
+    dtype = jnp.dtype(precision.compute_dtype)
+    param_dtype = jnp.dtype(precision.param_dtype)
+    if max_new_tokens + 1 > model_cfg.max_seq_len:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) + start token exceeds "
+            f"max_seq_len ({model_cfg.max_seq_len})")
+    if attention_mask is not None:
+        attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    else:
+        attention_mask = jnp.ones_like(input_ids)
+    encoder = t5_encoder(model_cfg, dtype, param_dtype)
+    enc = _seq2seq_encode(encoder, params, input_ids, attention_mask)
+    decoder = t5_decode_step(model_cfg, dtype, param_dtype,
+                             max_decode_len=model_cfg.max_seq_len)
+    return decoder, enc, attention_mask
+
+
+def _alloc_cache(decoder, batch: int, enc):
+    shapes = _seq2seq_cache_shapes(decoder, batch, enc.shape,
+                                   str(enc.dtype))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
 def generate_seq2seq(model_cfg, precision, params, input_ids,
                      max_new_tokens: int, *, temperature: float = 0.0,
                      top_k: int = 0, rng=None, eos_id: int | None = 1,
@@ -251,34 +292,12 @@ def generate_seq2seq(model_cfg, precision, params, input_ids,
     conventions by default: decoder starts from the pad id 0, eos is 1.
     Rows freeze at ``eos_id`` once emitted.
     """
-    from pytorch_distributed_train_tpu.models.t5 import (
-        t5_decode_step,
-        t5_encoder,
-    )
-
-    dtype = jnp.dtype(precision.compute_dtype)
-    param_dtype = jnp.dtype(precision.param_dtype)
     input_ids = jnp.asarray(input_ids, jnp.int32)
     B = input_ids.shape[0]
-    if attention_mask is not None:
-        attention_mask = jnp.asarray(attention_mask, jnp.int32)
-    else:
-        attention_mask = jnp.ones_like(input_ids)
-
-    if max_new_tokens + 1 > model_cfg.max_seq_len:
-        raise ValueError(
-            f"max_new_tokens ({max_new_tokens}) + start token exceeds "
-            f"max_seq_len ({model_cfg.max_seq_len})")
-    encoder = t5_encoder(model_cfg, dtype, param_dtype)
-    enc = _seq2seq_encode(encoder, params, input_ids, attention_mask)
-
-    # Cache sized to max_seq_len (not the call's token budget): the
-    # decode module is a static jit key, so a fixed size means ONE
-    # compiled step per model regardless of requested length.
-    decoder = t5_decode_step(model_cfg, dtype, param_dtype,
-                             max_decode_len=model_cfg.max_seq_len)
-    shapes = _seq2seq_cache_shapes(decoder, B, enc.shape, str(enc.dtype))
-    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    decoder, enc, attention_mask = _seq2seq_setup(
+        model_cfg, precision, params, input_ids, max_new_tokens,
+        attention_mask)
+    cache = _alloc_cache(decoder, B, enc)
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     ids = jnp.full((B, 1), decoder_start_id, jnp.int32)
@@ -299,37 +318,104 @@ def generate_seq2seq(model_cfg, precision, params, input_ids,
 
 # ------------------------------------------------------------- beam search
 
+def _beam_expand(logp, beam_scores, finished, last_token, num_beams: int):
+    """THE beam-expansion law, shared by the causal and seq2seq steps:
+    finished beams are frozen (their single candidate repeats
+    ``last_token`` at zero added score), live beams fan out over the
+    vocab, and the global top ``num_beams`` survive. Returns
+    (top_scores, parent, token)."""
+    V = logp.shape[-1]
+    frozen_rows = jax.vmap(lambda t: jnp.full((V,), -jnp.inf)
+                           .at[t].set(0.0))(last_token)
+    logp = jnp.where(finished[:, None], frozen_rows, logp)
+    total = beam_scores[:, None] + logp                  # (beams, V)
+    top_scores, top_idx = jax.lax.top_k(total.reshape(-1), num_beams)
+    return top_scores, top_idx // V, (top_idx % V).astype(jnp.int32)
+
+
+def _gather_beams(cache, parent):
+    """REORDER a KV cache so each surviving beam sits on the cache row of
+    its parent (gather on the batch axis — the TPU-friendly equivalent of
+    torch's `reorder_cache`)."""
+    return jax.tree.map(
+        lambda x: jnp.take(x, parent, axis=0) if x.ndim > 0 else x, cache)
+
+
 @partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2,))
 def _beam_step(model, params, cache, ids, beam_scores, num_beams: int,
                finished, last_token):
-    """One beam-search expansion: score continuations of every live beam,
-    keep the global top ``num_beams``, and REORDER the KV cache so each
-    surviving beam sits on the cache row of its parent (gather on the
-    batch axis — the TPU-friendly equivalent of torch's
-    `reorder_cache`). Finished beams (emitted eos) are frozen: their only
-    continuation is another eos at zero added score."""
+    """One causal-LM beam expansion (see _beam_expand/_gather_beams)."""
     from pytorch_distributed_train_tpu import quant
 
     p = quant.dequantize_tree(params, model.dtype)
     logits, cache = model.apply(
         {"params": p, "cache": cache}, ids, train=False, mutable=["cache"],
     )
-    cache = cache["cache"]
     logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
-    V = logp.shape[-1]
-    # frozen beams contribute exactly one candidate: repeat last_token
-    # (eos) at unchanged score; all their other continuations are -inf
-    frozen_rows = jax.vmap(lambda t: jnp.full((V,), -jnp.inf)
-                           .at[t].set(0.0))(last_token)
-    logp = jnp.where(finished[:, None], frozen_rows, logp)
-    total = beam_scores[:, None] + logp                  # (beams, V)
-    flat = total.reshape(-1)
-    top_scores, top_idx = jax.lax.top_k(flat, num_beams)
-    parent = top_idx // V
-    token = (top_idx % V).astype(jnp.int32)
-    cache = jax.tree.map(
-        lambda x: jnp.take(x, parent, axis=0) if x.ndim > 0 else x, cache)
-    return cache, token, top_scores, parent
+    top_scores, parent, token = _beam_expand(
+        logp, beam_scores, finished, last_token, num_beams)
+    return _gather_beams(cache["cache"], parent), token, top_scores, parent
+
+
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2,))
+def _seq2seq_beam_step(decoder, params, cache, ids, beam_scores,
+                       num_beams: int, finished, last_token, enc, enc_mask):
+    """One encoder-decoder beam expansion: the decoder cache reorders by
+    parent; the encoder rows are FIXED (every beam reads the same source,
+    already repeated to the beam count) so they need no gather."""
+    from pytorch_distributed_train_tpu import quant
+
+    p = quant.dequantize_tree(params, decoder.dtype)
+    logits, updated = decoder.apply(
+        {"params": p, "cache": cache}, ids, enc, enc_mask,
+        mutable=["cache"],
+    )
+    logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
+    top_scores, parent, token = _beam_expand(
+        logp, beam_scores, finished, last_token, num_beams)
+    return _gather_beams(updated["cache"], parent), token, top_scores, parent
+
+
+def _run_beam_loop(expand, first_logp, num_beams: int, max_new_tokens: int,
+                   eos_id: int | None, length_penalty: float):
+    """Host-side beam bookkeeping shared by causal and seq2seq search.
+
+    ``expand(last_tokens, scores, finished) -> (token, scores, parent)``
+    advances the device state (cache reorder included) one step.
+    Seeds from ONE row's top-k of ``first_logp`` (all beams start
+    identical — seeding per-row would make every beam pick the same
+    argmax), then runs parent-pointer bookkeeping and backtracks.
+    Returns (seqs (num_beams, n_steps), scores (num_beams,)) best-first;
+    n_steps may stop short of max_new_tokens when every beam froze."""
+    scores, first = jax.lax.top_k(first_logp, num_beams)
+    tokens = [first.astype(jnp.int32)]
+    parents = []
+    finished = (first == eos_id) if eos_id is not None else jnp.zeros(
+        (num_beams,), bool)
+    gen_len = jnp.ones((num_beams,), jnp.int32)
+    for _ in range(max_new_tokens - 1):
+        tok, scores, parent = expand(tokens[-1], scores, finished)
+        finished = jnp.take(finished, parent) if eos_id is not None \
+            else finished
+        gen_len = jnp.take(gen_len, parent) + (~finished).astype(jnp.int32)
+        if eos_id is not None:
+            finished = finished | (tok == eos_id)
+        tokens.append(tok)
+        parents.append(parent)
+        if eos_id is not None and bool(jnp.all(finished)):
+            break
+    # backtrack through the parent pointers to reconstruct sequences
+    n_steps = len(tokens)
+    seqs = np.zeros((num_beams, n_steps), np.int32)
+    idx = np.arange(num_beams)
+    for t in range(n_steps - 1, -1, -1):
+        seqs[:, t] = np.asarray(tokens[t])[idx]
+        if t > 0:
+            idx = np.asarray(parents[t - 1])[idx]
+    final = np.asarray(scores) / np.maximum(
+        np.asarray(gen_len), 1) ** length_penalty
+    order = np.argsort(-final)
+    return seqs[order], final[order]
 
 
 def beam_search(model, params, prompt_ids, max_new_tokens: int,
@@ -356,46 +442,73 @@ def beam_search(model, params, prompt_ids, max_new_tokens: int,
     # num_beams identical prompt forwards would multiply prefill cost.
     cache = init_cache(model, 1)
     logits, cache = _decode_step(model, params, cache, prompt_ids)
-    zeros = jnp.zeros((num_beams,), jnp.int32)
-    cache = jax.tree.map(
-        lambda x: jnp.take(x, zeros, axis=0) if x.ndim > 0 else x, cache)
+    cache = _gather_beams(cache, jnp.zeros((num_beams,), jnp.int32))
     # _decode_step already sliced to the last position: logits is (B, V)
     logp0 = jax.nn.log_softmax(logits[0].astype(jnp.float32), -1)
-    # first expansion: all beams share the prompt, so seed from ONE row's
-    # top-k (otherwise every beam would pick the same argmax)
-    scores, first = jax.lax.top_k(logp0, num_beams)
-    tokens = [first.astype(jnp.int32)]
-    parents = []
-    finished = (first == eos_id) if eos_id is not None else jnp.zeros(
-        (num_beams,), bool)
-    gen_len = jnp.ones((num_beams,), jnp.int32)
-    for _ in range(max_new_tokens - 1):
-        cache, tok, scores, parent = _beam_step(
-            model, params, cache, tokens[-1][:, None], scores, num_beams,
-            finished, tokens[-1])
-        finished = jnp.take(finished, parent) if eos_id is not None else finished
-        gen_len = jnp.take(gen_len, parent) + (~finished).astype(jnp.int32)
-        if eos_id is not None:
-            finished = finished | (tok == eos_id)
-        tokens.append(tok)
-        parents.append(parent)
-        if eos_id is not None and bool(jnp.all(finished)):
-            break
-    # backtrack through the parent pointers to reconstruct sequences
-    n_steps = len(tokens)
-    seqs = np.zeros((num_beams, n_steps), np.int32)
-    idx = np.arange(num_beams)
-    for t in range(n_steps - 1, -1, -1):
-        seqs[:, t] = np.asarray(tokens[t])[idx]
-        if t > 0:
-            idx = np.asarray(parents[t - 1])[idx]
+
+    state = {"cache": cache}
+
+    def expand(last_tok, scores, finished):
+        state["cache"], tok, scores, parent = _beam_step(
+            model, params, state["cache"], last_tok[:, None], scores,
+            num_beams, finished, last_tok)
+        return tok, scores, parent
+
+    seqs, final = _run_beam_loop(expand, logp0, num_beams, max_new_tokens,
+                                 eos_id, length_penalty)
     full = np.concatenate(
         [np.repeat(np.asarray(prompt_ids), num_beams, 0), seqs], axis=1)
     if full.shape[1] < S + max_new_tokens:  # early eos stop: pad
         pad = np.full((num_beams, S + max_new_tokens - full.shape[1]),
                       eos_id if eos_id is not None else 0, np.int32)
         full = np.concatenate([full, pad], axis=1)
-    final = np.asarray(scores) / np.maximum(
-        np.asarray(gen_len), 1) ** length_penalty
-    order = np.argsort(-final)
-    return jnp.asarray(full[order]), jnp.asarray(final[order])
+    return jnp.asarray(full), jnp.asarray(final)
+
+
+def beam_search_seq2seq(model_cfg, precision, params, input_ids,
+                        max_new_tokens: int, *, num_beams: int = 4,
+                        eos_id: int | None = 1, length_penalty: float = 1.0,
+                        decoder_start_id: int = 0,
+                        attention_mask=None) -> tuple:
+    """Beam-search decoding for an encoder-decoder (t5) over ONE source.
+
+    Encodes the (1, Se) source once, repeats the encoder rows to the beam
+    count (they are read-only — no per-step gather), and expands the
+    cached decoder with the same beam law as the causal path. Returns
+    (sequences (num_beams, max_new_tokens), scores) best-first, T5
+    conventions by default (start from pad id 0, eos 1); no BOS column,
+    like generate_seq2seq. num_beams=1 reproduces greedy decoding.
+    """
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if input_ids.shape[0] != 1:
+        raise ValueError(
+            f"beam_search_seq2seq expects a single source "
+            f"(got B={input_ids.shape[0]})")
+    decoder, enc, attention_mask = _seq2seq_setup(
+        model_cfg, precision, params, input_ids, max_new_tokens,
+        attention_mask)
+    enc = jnp.repeat(enc, num_beams, axis=0)
+    enc_mask = jnp.repeat(attention_mask, num_beams, axis=0)
+    cache = _alloc_cache(decoder, num_beams, enc)
+    # Step every (identical) beam row through the start token — the rows
+    # stay identical, so no gather is needed before the first expansion.
+    start = jnp.full((num_beams, 1), decoder_start_id, jnp.int32)
+    logits, cache = _seq2seq_decode_step(
+        decoder, params, cache, start, enc, enc_mask)
+    logp0 = jax.nn.log_softmax(logits[0].astype(jnp.float32), -1)
+
+    state = {"cache": cache}
+
+    def expand(last_tok, scores, finished):
+        state["cache"], tok, scores, parent = _seq2seq_beam_step(
+            decoder, params, state["cache"], last_tok[:, None], scores,
+            num_beams, finished, last_tok, enc, enc_mask)
+        return tok, scores, parent
+
+    seqs, final = _run_beam_loop(expand, logp0, num_beams, max_new_tokens,
+                                 eos_id, length_penalty)
+    if seqs.shape[1] < max_new_tokens:  # early eos stop: pad
+        pad = np.full((num_beams, max_new_tokens - seqs.shape[1]),
+                      eos_id if eos_id is not None else 0, np.int32)
+        seqs = np.concatenate([seqs, pad], axis=1)
+    return jnp.asarray(seqs), jnp.asarray(final)

@@ -102,3 +102,83 @@ def test_eos_freezes_beams():
                                    num_beams=3, eos_id=int(arr[0, P]))
     np.testing.assert_allclose(float(scores[0]), float(scores_longer[0]),
                                rtol=1e-6)
+
+
+# ------------------------------------------------------- seq2seq (t5)
+
+def _t5_setup(seed=0):
+    cfg = ModelConfig(name="t5", vocab_size=37, hidden_size=32,
+                      num_layers=2, num_heads=4, mlp_dim=64,
+                      max_seq_len=24, dropout_rate=0.0)
+    model = build_model(cfg, PrecisionConfig())
+    src = jnp.asarray(
+        np.random.default_rng(seed).integers(0, 37, (1, 7)), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(seed)}, src,
+                        jnp.zeros((1, 2), jnp.int32), train=False)["params"]
+    return cfg, model, params, src
+
+
+def _t5_teacher_forced_logprob(cfg, params, src, seq):
+    """Sum of log p(tok_t | start, tok_<t, src) over the decoded tokens."""
+    full = build_model(cfg, PrecisionConfig())
+    dec_in = np.concatenate([[0], seq[:-1]])[None, :].astype(np.int32)
+    logits = full.apply({"params": params}, src, jnp.asarray(dec_in),
+                        train=False)
+    lp = jax.nn.log_softmax(np.asarray(logits[0], np.float32), -1)
+    return sum(lp[t, int(seq[t])] for t in range(len(seq)))
+
+
+def test_t5_beam1_equals_greedy():
+    from pytorch_distributed_train_tpu.generate import (
+        beam_search_seq2seq,
+        generate_seq2seq,
+    )
+
+    cfg, _, params, src = _t5_setup()
+    ref = generate_seq2seq(cfg, PrecisionConfig(), params, src, 8,
+                           temperature=0.0, eos_id=None)
+    seqs, _ = beam_search_seq2seq(cfg, PrecisionConfig(), params, src, 8,
+                                  num_beams=1, eos_id=None)
+    np.testing.assert_array_equal(np.asarray(seqs[0]), np.asarray(ref[0]))
+
+
+def test_t5_beam_scores_match_teacher_forced():
+    """Reported beam scores must equal the recomputed teacher-forced
+    log-probs — pins the DECODER cache parent-gather against the fixed
+    (ungathered) encoder rows."""
+    from pytorch_distributed_train_tpu.generate import beam_search_seq2seq
+
+    cfg, _, params, src = _t5_setup(1)
+    n = 6
+    seqs, scores = beam_search_seq2seq(cfg, PrecisionConfig(), params, src,
+                                       n, num_beams=4, eos_id=None)
+    assert seqs.shape == (4, n)
+    s = np.asarray(scores)
+    assert (np.diff(s) <= 1e-6).all()  # best-first
+    for b in range(4):
+        ref = _t5_teacher_forced_logprob(cfg, params, src,
+                                         np.asarray(seqs[b])) / n
+        np.testing.assert_allclose(s[b], ref, rtol=1e-4, atol=1e-5)
+    assert len({tuple(np.asarray(r)) for r in seqs}) > 1
+
+
+def test_t5_eos_freezes_beams():
+    from pytorch_distributed_train_tpu.generate import (
+        beam_search_seq2seq,
+        generate_seq2seq,
+    )
+
+    cfg, _, params, src = _t5_setup(2)
+    greedy = np.asarray(generate_seq2seq(cfg, PrecisionConfig(), params,
+                                         src, 1, temperature=0.0,
+                                         eos_id=None))
+    eos = int(greedy[0, 0])  # the argmax first token -> instant freeze
+    seqs, scores = beam_search_seq2seq(cfg, PrecisionConfig(), params, src,
+                                       8, num_beams=3, eos_id=eos)
+    arr = np.asarray(seqs)
+    assert (arr[0] == eos).all()
+    _, scores_longer = beam_search_seq2seq(cfg, PrecisionConfig(), params,
+                                           src, 11, num_beams=3,
+                                           eos_id=eos)
+    np.testing.assert_allclose(float(scores[0]), float(scores_longer[0]),
+                               rtol=1e-6)

@@ -178,6 +178,13 @@ def test_generate_cli_t5(tmp_path, capfd):
 
     rc = generate_cli.main(
         ["--config", "t5_small", "--safetensors", str(st),
+         "--prompt", "hi", "--max-new-tokens", "4", "--num-beams", "2"]
+        + [f"--set={s}" for s in shrink])
+    assert rc == 0
+    assert "prompt 0" in capfd.readouterr().out
+
+    rc = generate_cli.main(
+        ["--config", "t5_small", "--safetensors", str(st),
          "--prompt", "hi", "--max-new-tokens", "3", "--tp", "2"]
         + [f"--set={s}" for s in shrink])
     assert rc == 2

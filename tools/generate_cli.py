@@ -90,10 +90,6 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--tp supports the causal-LM families; t5 serving is "
                 "single-device for now")
-        if args.num_beams >= 1 and is_t5:
-            raise ValueError(
-                "--num-beams supports the causal-LM families; t5 beam "
-                "search is not built yet (docs/ROADMAP.md)")
         if args.num_beams >= 1 and args.tp > 1:
             raise ValueError(
                 "--num-beams with --tp is unsupported (beam search "
@@ -122,11 +118,23 @@ def main(argv=None) -> int:
 
             for i, (text, e) in enumerate(zip(prompts, encoded)):
                 ids = jnp.asarray(np.asarray(e, np.int32)[None, :])
-                out = np.asarray(generate_seq2seq(
-                    model_cfg, cfg.precision, params, ids,
-                    args.max_new_tokens, temperature=args.temperature,
-                    top_k=args.top_k, rng=jax.random.PRNGKey(args.seed + i),
-                    eos_id=tok.eos_id))
+                if args.num_beams >= 1:
+                    from pytorch_distributed_train_tpu.generate import (
+                        beam_search_seq2seq,
+                    )
+
+                    seqs, _ = beam_search_seq2seq(
+                        model_cfg, cfg.precision, params, ids,
+                        args.max_new_tokens, num_beams=args.num_beams,
+                        eos_id=tok.eos_id)
+                    out = np.asarray(seqs)
+                else:
+                    out = np.asarray(generate_seq2seq(
+                        model_cfg, cfg.precision, params, ids,
+                        args.max_new_tokens, temperature=args.temperature,
+                        top_k=args.top_k,
+                        rng=jax.random.PRNGKey(args.seed + i),
+                        eos_id=tok.eos_id))
                 emit(i, text, out[0].tolist())
             return 0
 
