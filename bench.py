@@ -314,10 +314,7 @@ def decode_bench(args) -> None:
         raise SystemExit(
             f"prompt ({prompt_len}) + decode tokens ({new_tokens}) + 1 "
             f"exceeds --seq-len {args.seq_len}; raise --seq-len")
-    dims = (dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
-                 num_kv_heads=4, mlp_dim=128) if args.tiny else
-            dict(vocab_size=32000, hidden_size=2048, num_layers=16,
-                 num_heads=16, num_kv_heads=16, mlp_dim=5504))
+    dims = _llama_dims(args.tiny)
     model_cfg = ModelConfig(
         name="llama", **dims,
         max_seq_len=min(args.seq_len, prompt_len + new_tokens + 1),
@@ -370,6 +367,92 @@ def decode_bench(args) -> None:
     }))
 
 
+def _llama_dims(tiny: bool) -> dict:
+    """The ~1.1B llama shape the decode/spec/serve benches share (tiny:
+    CI-smoke sizes — never comparable to real numbers)."""
+    return (dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                 num_kv_heads=4, mlp_dim=128) if tiny else
+            dict(vocab_size=32000, hidden_size=2048, num_layers=16,
+                 num_heads=16, num_kv_heads=16, mlp_dim=5504))
+
+
+def serve_bench(args) -> None:
+    """Continuous-batching serving throughput (serving.ContinuousBatcher):
+    ``--serve N`` requests with MIXED prompt lengths and budgets drain
+    through ``--batch-per-chip`` slots (default 8). The aggregate
+    generated-tokens/sec is the serving rate a lockstep generate() cannot
+    reach on this workload — lockstep pads every request to the longest
+    prompt and keeps finished rows in the batch until the longest budget
+    drains. ``occupancy`` (live-slot fraction per step) reports how full
+    the batch stayed. Never seeds a training baseline key."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.config import (
+        ModelConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.serving import ContinuousBatcher
+
+    if args.model != "llama":
+        raise SystemExit("--serve supports --model llama")
+    n_req = args.serve
+    slots = args.batch_per_chip or 8
+    dims = _llama_dims(args.tiny)
+    p_lo, p_hi = (4, 12) if args.tiny else (32, 256)
+    b_lo, b_hi = (2, 6) if args.tiny else (16, 96)
+    max_len = 32 if args.tiny else 512
+    model_cfg = ModelConfig(name="llama", **dims, max_seq_len=max_len,
+                            attention_impl="xla")
+    precision = PrecisionConfig(compute_dtype="bfloat16")
+    _touch()
+    train_model = build_model(model_cfg, precision)
+    params = jax.jit(
+        lambda r: train_model.init({"params": r},
+                                   jnp.zeros((1, 8), jnp.int32),
+                                   train=False)["params"]
+    )(jax.random.PRNGKey(0))
+    _touch()
+
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(p_lo, p_hi + 1), rng.integers(b_lo, b_hi + 1))
+            for _ in range(n_req)]
+
+    def make_batcher():
+        return ContinuousBatcher(model_cfg, precision, params, slots=slots)
+
+    # Warm every executable the timed run will hit: one short request per
+    # DISTINCT prefill bucket, plus the shared batched step. Executables
+    # cache across batchers (structurally equal static module args).
+    warm = make_batcher()
+    for bucket in sorted({warm._bucket(int(n)) for n, _ in reqs}):
+        warm.submit(rng.integers(0, dims["vocab_size"], bucket), 2)
+    list(warm.run())
+    _disarm_watchdog()
+
+    b = make_batcher()
+    for n, budget in reqs:
+        b.submit(rng.integers(0, dims["vocab_size"], int(n)), int(budget))
+    t0 = time.perf_counter()
+    done = list(b.run())
+    wall = time.perf_counter() - t0
+    assert len(done) == n_req
+    occupancy = (b.stats["generated_tokens"] - b.stats["prefills"]) / max(
+        b.stats["slot_token_slots"], 1)
+    suffix = "_tiny" if args.tiny else ""
+    print(json.dumps({
+        "metric": f"llama_serve{suffix}_tokens_per_sec_per_chip",
+        "value": round(b.stats["generated_tokens"] / wall, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "requests": n_req,
+        "slots": slots,
+        "occupancy": round(occupancy, 3),
+    }))
+
+
 def spec_bench(args) -> None:
     """Speculative-decoding throughput (B=1, latency regime). Two arms:
 
@@ -399,10 +482,7 @@ def spec_bench(args) -> None:
     k = args.speculative
     new_tokens = args.decode_tokens or 64
     prompt_len = 16 if args.tiny else 128
-    dims = (dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
-                 num_kv_heads=4, mlp_dim=128) if args.tiny else
-            dict(vocab_size=32000, hidden_size=2048, num_layers=16,
-                 num_heads=16, num_kv_heads=16, mlp_dim=5504))
+    dims = _llama_dims(args.tiny)
     d_dims = (dict(vocab_size=512, hidden_size=32, num_layers=1,
                    num_heads=2, num_kv_heads=2, mlp_dim=64) if args.tiny
               else dict(vocab_size=32000, hidden_size=512, num_layers=4,
@@ -485,6 +565,10 @@ def main() -> None:
     p.add_argument("--speculative", type=int, default=0, metavar="K",
                    help="llama only: speculative-decoding bench with "
                         "speculation depth K (B=1; see spec_bench)")
+    p.add_argument("--serve", type=int, default=0, metavar="N_REQUESTS",
+                   help="llama only: continuous-batching serving bench — "
+                        "drain N mixed-length requests through "
+                        "--batch-per-chip slots (see serve_bench)")
     p.add_argument("--spec-self", action="store_true",
                    help="with --speculative: draft == target (acceptance-1 "
                         "machinery ceiling instead of the random-draft "
@@ -537,6 +621,8 @@ def main() -> None:
         if args.pipeline_decode:
             return pipeline_decode_bench(args)
         return pipeline_bench(args)
+    if args.serve:
+        return serve_bench(args)
     if args.speculative:
         return spec_bench(args)
     if args.decode_tokens:

@@ -129,32 +129,51 @@ def _decode_step(model, params, cache, ids):
     return logits[:, -1], updated["cache"]
 
 
-def filter_logits(logits, temperature, top_k: int):
-    """THE sampling law's logit filtering — temperature scaling + top-k
-    truncation. Single definition shared by the direct sampler below,
-    speculative.py's draft/verify distributions (whose exactness guarantee
-    is 'same law as generate()'), and serving.py's per-row sampler.
+def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0):
+    """THE sampling law's logit filtering — temperature scaling, top-k
+    truncation, then top-p (nucleus) truncation. Single definition shared
+    by the direct sampler below, speculative.py's draft/verify
+    distributions (whose exactness guarantee is 'same law as
+    generate()'), and serving.py's per-row sampler.
     ``temperature`` is a positive scalar OR an array broadcastable against
     ``logits`` (serving passes (B, 1) per-row temperatures); every entry
-    must be > 0."""
+    must be > 0. ``top_p`` in (0, 1) keeps the smallest sorted prefix
+    whose cumulative probability reaches top_p (HF semantics: a token
+    survives iff the mass strictly BEFORE it is < top_p, so the argmax
+    always survives); 0 disables."""
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # Mask by SORTED INDEX, not by threshold value: ties at the
+        # nucleus boundary (common in bf16 / int8-dequant logits) must not
+        # widen the kept set beyond the prefix. Stable argsort breaks ties
+        # by original position; the inverse permutation (argsort of the
+        # ranks) scatters the sorted keep-mask back.
+        srt_idx = jnp.argsort(-logits, axis=-1)
+        srt = jnp.take_along_axis(logits, srt_idx, axis=-1)
+        p_srt = jax.nn.softmax(srt, axis=-1)
+        before = jnp.cumsum(p_srt, axis=-1) - p_srt  # exclusive cumsum
+        keep = jnp.take_along_axis(before < top_p,
+                                   jnp.argsort(srt_idx, axis=-1), axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
     return logits
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
+def _sample(logits, rng, temperature: float, top_k: int,
+            top_p: float = 0.0):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(
-        rng, filter_logits(logits, temperature, top_k), axis=-1
+        rng, filter_logits(logits, temperature, top_k, top_p), axis=-1
     ).astype(jnp.int32)
 
 
 def generate(model, params, prompt_ids, max_new_tokens: int,
              *, temperature: float = 0.0, top_k: int = 0,
-             rng=None, eos_id: int | None = None, mesh=None) -> jnp.ndarray:
+             top_p: float = 0.0, rng=None, eos_id: int | None = None,
+             mesh=None) -> jnp.ndarray:
     """Generate continuations for a (B, S) int32 prompt batch.
 
     Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
@@ -194,7 +213,7 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
     done = jnp.zeros((B,), bool)
     for i in range(max_new_tokens):
         rng, step_rng = jax.random.split(rng)
-        nxt = _sample(logits, step_rng, temperature, top_k)
+        nxt = _sample(logits, step_rng, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
@@ -281,8 +300,8 @@ def _alloc_cache(decoder, batch: int, enc):
 
 def generate_seq2seq(model_cfg, precision, params, input_ids,
                      max_new_tokens: int, *, temperature: float = 0.0,
-                     top_k: int = 0, rng=None, eos_id: int | None = 1,
-                     decoder_start_id: int = 0,
+                     top_k: int = 0, top_p: float = 0.0, rng=None,
+                     eos_id: int | None = 1, decoder_start_id: int = 0,
                      attention_mask=None) -> jnp.ndarray:
     """Encoder-decoder generation (t5): encode the (B, Se) source once,
     then decode autoregressively with a cached decoder
@@ -307,7 +326,7 @@ def generate_seq2seq(model_cfg, precision, params, input_ids,
         logits, cache = _seq2seq_decode_step(
             decoder, params, cache, ids, enc, attention_mask)
         rng, step_rng = jax.random.split(rng)
-        nxt = _sample(logits, step_rng, temperature, top_k)
+        nxt = _sample(logits, step_rng, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)

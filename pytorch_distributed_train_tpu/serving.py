@@ -91,13 +91,13 @@ def _insert_row(big_cache, row_cache, r, true_len):
     return jax.tree.map(one, big_cache, row_cache)
 
 
-@partial(jax.jit, static_argnums=(3,))
-def _sample_rows(logits, rng, temperature, top_k: int):
+@partial(jax.jit, static_argnums=(3, 4))
+def _sample_rows(logits, rng, temperature, top_k: int, top_p: float):
     """Per-row sampling: rows with temperature 0 are greedy, others sample
-    at their own temperature under a shared static top-k."""
+    at their own temperature under shared static top-k/top-p."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     f = filter_logits(logits, jnp.maximum(temperature, 1e-6)[:, None],
-                      top_k)
+                      top_k, top_p)
     sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
     return jnp.where(temperature == 0.0, greedy, sampled)
 
@@ -139,11 +139,12 @@ class ContinuousBatcher:
 
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,
-                 rng=None, min_bucket: int = 16):
+                 top_p: float = 0.0, rng=None, min_bucket: int = 16):
         self.model = build_serving_model(model_cfg, precision)
         self.params = params
         self.slots = slots
         self.top_k = top_k
+        self.top_p = top_p
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.cache = init_cache(self.model, slots)
         self.max_seq_len = self.model.max_seq_len
@@ -210,7 +211,7 @@ class ContinuousBatcher:
         self.rng, step_rng = jax.random.split(self.rng)
         first = int(_sample_rows(
             last, step_rng, jnp.asarray([req.temperature], jnp.float32),
-            self.top_k)[0])
+            self.top_k, self.top_p)[0])
         self.stats["prefills"] += 1
         self.stats["generated_tokens"] += 1
         self._req[r] = req
@@ -254,7 +255,8 @@ class ContinuousBatcher:
             self.model, self.params, self.cache, ids)
         self.rng, step_rng = jax.random.split(self.rng)
         nxt = np.asarray(_sample_rows(
-            logits, step_rng, jnp.asarray(self._temp), self.top_k))
+            logits, step_rng, jnp.asarray(self._temp), self.top_k,
+            self.top_p))
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots
         for r in active:

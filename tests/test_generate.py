@@ -203,3 +203,59 @@ def test_tensor_parallel_decode_matches_single_device(devices8):
     qout = generate(model, qsharded, ids, 8, mesh=mesh)
     qref = generate(model, quant.quantize_tree(params), ids, 8)
     np.testing.assert_array_equal(np.asarray(qref), np.asarray(qout))
+
+
+# ------------------------------------------------------- top-p (nucleus)
+
+def test_filter_logits_top_p_keeps_smallest_sufficient_prefix():
+    """Known distribution: probs [.5,.3,.15,.05]. top_p=.75 keeps {0,1}
+    (mass before token 1 is .5 < .75; before token 2 is .8 >= .75);
+    top_p=.85 keeps {0,1,2}; the argmax always survives even at tiny p."""
+    from pytorch_distributed_train_tpu.generate import filter_logits
+
+    probs = np.array([0.5, 0.3, 0.15, 0.05], np.float32)
+    logits = jnp.asarray(np.log(probs))
+
+    out = np.asarray(filter_logits(logits, 1.0, 0, top_p=0.75))
+    assert np.isfinite(out[:2]).all() and np.isinf(out[2:]).all()
+    out = np.asarray(filter_logits(logits, 1.0, 0, top_p=0.85))
+    assert np.isfinite(out[:3]).all() and np.isinf(out[3:]).all()
+    out = np.asarray(filter_logits(logits, 1.0, 0, top_p=0.01))
+    assert np.isfinite(out[0]) and np.isinf(out[1:]).all()
+    # renormalized kept mass is the original probs renormalized
+    kept = np.asarray(jax.nn.softmax(filter_logits(logits, 1.0, 0,
+                                                   top_p=0.75)))
+    np.testing.assert_allclose(kept[:2], probs[:2] / probs[:2].sum(),
+                               rtol=1e-5)
+
+
+def test_filter_logits_top_p_composes_with_top_k_and_batches():
+    from pytorch_distributed_train_tpu.generate import filter_logits
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(3, 16)).astype(np.float32))
+    out = np.asarray(filter_logits(logits, 0.7, 8, top_p=0.9))
+    # top-k bound holds per row, nucleus can only shrink the kept set
+    assert (np.isfinite(out).sum(-1) <= 8).all()
+    assert (np.isfinite(out).sum(-1) >= 1).all()
+
+
+def test_generate_top_p_samples_only_from_nucleus(setup):
+    """Statistical anchor: every token generate() emits under top_p must
+    lie in the nucleus of its step distribution — checked by re-running
+    the same rng chain and intersecting with the filtered support."""
+    from pytorch_distributed_train_tpu.generate import filter_logits
+
+    cfg, train_model, params, ids = setup
+    dm = build_decode_model(cfg, PrecisionConfig())
+    prompt = ids[:1, :4]
+    out = generate(dm, params, prompt, 6, temperature=1.0, top_p=0.8,
+                   rng=jax.random.PRNGKey(3))
+    seq = np.asarray(out)[0]
+    # teacher-forced re-scoring of each emitted token's step distribution
+    full = train_model.apply({"params": params}, out, train=False)
+    for t in range(4, seq.shape[0]):
+        step_logits = jnp.asarray(full[0, t - 1])
+        kept = np.isfinite(np.asarray(
+            filter_logits(step_logits, 1.0, 0, top_p=0.8)))
+        assert kept[seq[t]], f"token at {t} outside the nucleus"

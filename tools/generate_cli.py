@@ -38,9 +38,12 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.0,
+                   help="nucleus sampling threshold in (0,1); 0 -> off")
     p.add_argument("--num-beams", type=int, default=0,
-                   help="beam-search decoding (causal-LM families; "
-                        "overrides temperature/top-k; 0 → off)")
+                   help="beam-search decoding; overrides temperature/"
+                        "top-k/top-p (beams expand the full "
+                        "distribution); 0 → off")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quantize", default="", choices=["", "int8"])
     p.add_argument("--tp", type=int, default=0,
@@ -132,7 +135,7 @@ def main(argv=None) -> int:
                     out = np.asarray(generate_seq2seq(
                         model_cfg, cfg.precision, params, ids,
                         args.max_new_tokens, temperature=args.temperature,
-                        top_k=args.top_k,
+                        top_k=args.top_k, top_p=args.top_p,
                         rng=jax.random.PRNGKey(args.seed + i),
                         eos_id=tok.eos_id))
                 emit(i, text, out[0].tolist())
@@ -166,6 +169,7 @@ def main(argv=None) -> int:
                 out = np.asarray(generate(
                     model, params, ids, args.max_new_tokens,
                     temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p,
                     rng=jax.random.PRNGKey(args.seed + i),
                     eos_id=tok.eos_id, mesh=mesh))
             emit(i, text, out[0, len(e):].tolist())
