@@ -38,6 +38,9 @@ class GPT2Attention(nn.Module):
     # S>1 appends at the running offset instead of prefilling from 0
     # (speculative.py's verify pass — same contract as llama.py)
     decode_multi: bool = False
+    # Per-row cache offsets for continuous batching (serving.py) — same
+    # contract as llama.py decode_rows: cache_index is (B,)
+    decode_rows: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -58,18 +61,40 @@ class GPT2Attention(nn.Module):
                                 (B, L, self.num_heads, head_dim), k.dtype)
             c_v = self.variable("cache", "cached_value", jnp.zeros,
                                 (B, L, self.num_heads, head_dim), v.dtype)
+            if self.decode_rows and self.decode_multi:
+                raise ValueError(
+                    "decode_rows and decode_multi are mutually exclusive "
+                    "(speculative decoding runs scalar-index caches)")
+            idx_shape = (B,) if self.decode_rows else ()
             c_i = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
+                                lambda: jnp.zeros(idx_shape, jnp.int32))
             if S > 1 and not self.decode_multi:
                 # prefill from position 0 (generate.py contract)
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
                     c_k.value, k, 0, 1)
                 c_v.value = jax.lax.dynamic_update_slice_in_dim(
                     c_v.value, v, 0, 1)
-                c_i.value = jnp.full((), S, jnp.int32)
+                c_i.value = jnp.full(idx_shape, S, jnp.int32)
                 y = dot_product_attention(q, k, v, causal=True,
                                           impl=self.attn_impl,
                                           window=self.window)
+            elif self.decode_rows:
+                # per-row continuation (cf. llama.py): row b's S tokens
+                # append at ITS offset idx[b]; vmap'd updates, per-row mask
+                idx = c_i.value  # (B,)
+                upd = lambda c, new, i: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+                    c, new, i, 0)
+                c_k.value = jax.vmap(upd)(c_k.value, k, idx)
+                c_v.value = jax.vmap(upd)(c_v.value, v, idx)
+                c_i.value = idx + S
+                q_pos = idx[:, None] + jnp.arange(S)  # (B, S)
+                k_pos = jnp.arange(L)
+                mask = k_pos[None, None, :] <= q_pos[:, :, None]
+                if self.window:
+                    mask &= (q_pos[:, :, None] - k_pos[None, None, :]
+                             ) < self.window
+                y = dot_product_attention(q, c_k.value, c_v.value,
+                                          mask=mask[:, None], impl="xla")
             else:
                 idx = c_i.value
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
@@ -110,6 +135,7 @@ class GPT2Block(nn.Module):
     quant: str = ""
     decode: bool = False
     decode_multi: bool = False
+    decode_rows: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -126,6 +152,7 @@ class GPT2Block(nn.Module):
                           attn_impl=self.attn_impl, window=self.window,
                           quant=self.quant, decode=self.decode,
                           decode_multi=self.decode_multi,
+                          decode_rows=self.decode_rows,
                           name="attn")(h),
             deterministic=self.deterministic)
         h = ln("ln_2")(x).astype(self.dtype)
@@ -164,6 +191,8 @@ class GPT2LMHead(nn.Module):
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # Multi-token continuation in decode mode (speculative.py verify pass)
     decode_multi: bool = False
+    # Per-row cache/position offsets for continuous batching (serving.py)
+    decode_rows: bool = False
     # Fused chunked head+CE over the tied embedding (losses.chunked_causal_ce)
     fused_loss: bool = False
     act: "object | None" = None
@@ -178,21 +207,28 @@ class GPT2LMHead(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (self.max_seq_len, self.hidden_size),
                          self.param_dtype)
+        pos_shape = (B,) if self.decode_rows else ()
         if self.decode and (S == 1 or self.decode_multi):
             # step(s) at the running offset: single-token decode, or a
             # multi-token continuation (speculative.py verify — positions
-            # are the absolute idx..idx+S-1, same as the attention cache)
+            # are the absolute idx..idx+S-1, same as the attention cache).
+            # decode_rows: each row slices wpe at ITS own offset.
             p_i = self.variable("cache", "pos_index",
-                                lambda: jnp.zeros((), jnp.int32))
-            pos = jax.lax.dynamic_slice_in_dim(wpe, p_i.value, S, 0)
+                                lambda: jnp.zeros(pos_shape, jnp.int32))
+            if self.decode_rows:
+                pos = jax.vmap(
+                    lambda i: jax.lax.dynamic_slice_in_dim(wpe, i, S, 0)
+                )(p_i.value)  # (B, S, C)
+            else:
+                pos = jax.lax.dynamic_slice_in_dim(wpe, p_i.value, S, 0)[None]
             p_i.value = p_i.value + S
         else:
-            pos = wpe[:S]
+            pos = wpe[:S][None]
             if self.decode:
                 p_i = self.variable("cache", "pos_index",
-                                    lambda: jnp.zeros((), jnp.int32))
-                p_i.value = jnp.full((), S, jnp.int32)
-        x = wte(input_ids) + pos[None]
+                                    lambda: jnp.zeros(pos_shape, jnp.int32))
+                p_i.value = jnp.full(pos_shape, S, jnp.int32)
+        x = wte(input_ids) + pos
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         x = x.astype(self.dtype)
         if self.act is not None:
@@ -208,6 +244,7 @@ class GPT2LMHead(nn.Module):
                 self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
                 window=self.attention_window, quant=self.quant_training,
                 decode=self.decode, decode_multi=self.decode_multi,
+                decode_rows=self.decode_rows,
                 name=f"h{i}",
             )(x)
             if self.act is not None:

@@ -54,7 +54,7 @@ def build_serving_model(model_cfg: ModelConfig, precision: PrecisionConfig):
                for f in dataclasses.fields(model)):
         raise ValueError(
             f"model {model_cfg.name!r} has no decode_rows mode (continuous "
-            "batching currently covers the llama family)")
+            "batching covers the llama and gpt2 families)")
     return dataclasses.replace(model, decode_rows=True)
 
 
@@ -79,8 +79,9 @@ def _insert_row(big_cache, row_cache, r, true_len):
     """Scatter a freshly prefilled B=1 cache into slot ``r`` of the pool.
 
     K/V leaves copy the FULL row (zeros beyond the prompt erase the
-    previous occupant); the (B,) cache_index sets slot r to the prompt's
-    true length (the prefill wrote the padded length)."""
+    previous occupant); the (B,) index counters — cache_index, and gpt2's
+    pos_index — set slot r to the prompt's true length (the prefill wrote
+    the padded length)."""
     def one(big, row):
         if big.ndim >= 2:  # (B, L, H, D) K/V buffers
             return jax.lax.dynamic_update_slice(
@@ -134,7 +135,9 @@ class ContinuousBatcher:
     slots (one B=1 bucketed prefill each), then advance every slot one
     token in a single batched decode step. Sampling law matches
     generate(): greedy at temperature 0, categorical over
-    temperature-scaled top-k logits otherwise.
+    temperature-scaled top-k/top-p-filtered logits otherwise
+    (generate.filter_logits — temperature is per-request, top-k/top-p
+    are batcher-wide).
     """
 
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
