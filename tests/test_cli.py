@@ -123,6 +123,37 @@ def test_generate_cli_end_to_end(tmp_path, capfd):
     assert rc == 0
     assert "prompt 0" in capfd.readouterr().out
 
+    # continuous batching: greedy serving output == lockstep output
+    rc = generate_cli.main(
+        ["--config", "llama2_7b", "--safetensors", str(st),
+         "--prompt", "hello", "--prompt", "world!",
+         "--max-new-tokens", "6", "--serve-slots", "2"]
+        + [f"--set={s}" for s in shrink])
+    served = capfd.readouterr().out
+    assert rc == 0, served
+
+    def blocks(text):
+        """(header, full-completion) pairs, order-independent — the
+        completion spans every line until the next header (byte-tokenizer
+        output can itself contain newlines)."""
+        out, cur = {}, None
+        for line in text.splitlines():
+            if line.startswith("=== prompt"):
+                cur = line
+                out[cur] = []
+            elif cur is not None:
+                out[cur].append(line)
+        return sorted((h, "\n".join(b)) for h, b in out.items())
+
+    assert blocks(served) == blocks(out)
+
+    rc = generate_cli.main(
+        ["--config", "llama2_7b", "--safetensors", str(st),
+         "--prompt", "x", "--serve-slots", "2", "--num-beams", "2"]
+        + [f"--set={s}" for s in shrink])
+    assert rc == 2
+    assert "serve-slots" in capfd.readouterr().err
+
 
 def test_generate_cli_user_errors_one_line(tmp_path, capfd):
     sys.path.insert(0, os.path.join(REPO, "tools"))

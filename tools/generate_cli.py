@@ -48,6 +48,12 @@ def main(argv=None) -> int:
     p.add_argument("--quantize", default="", choices=["", "int8"])
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel ways over local devices (0 → off)")
+    p.add_argument("--serve-slots", type=int, default=0, metavar="SLOTS",
+                   help="continuous batching (serving.ContinuousBatcher): "
+                        "run ALL prompts concurrently through this many "
+                        "cache slots instead of one lockstep generate() "
+                        "per prompt; completions print as they finish "
+                        "(causal-LM families; 0 → off)")
     args = p.parse_args(argv)
 
     prompts = []
@@ -97,6 +103,12 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--num-beams with --tp is unsupported (beam search "
                 "drives the single-device step)")
+        if args.serve_slots > 0 and (is_t5 or args.num_beams >= 1
+                                     or args.tp > 1):
+            raise ValueError(
+                "--serve-slots is causal-LM continuous batching; it "
+                "composes with sampling flags but not --num-beams/--tp, "
+                "and t5 serving is lockstep for now")
         init_inputs = ((jnp.zeros((1, 2), jnp.int32),
                         jnp.zeros((1, 2), jnp.int32)) if is_t5
                        else (jnp.zeros((1, 2), jnp.int32),))
@@ -139,6 +151,25 @@ def main(argv=None) -> int:
                         rng=jax.random.PRNGKey(args.seed + i),
                         eos_id=tok.eos_id))
                 emit(i, text, out[0].tolist())
+            return 0
+
+        if args.serve_slots > 0:
+            from pytorch_distributed_train_tpu.serving import (
+                ContinuousBatcher,
+            )
+
+            b = ContinuousBatcher(
+                model_cfg, cfg.precision, params,
+                slots=args.serve_slots, top_k=args.top_k,
+                top_p=args.top_p, rng=jax.random.PRNGKey(args.seed))
+            uid_to_i = {}
+            for i, e in enumerate(encoded):
+                uid_to_i[b.submit(e, args.max_new_tokens,
+                                  temperature=args.temperature,
+                                  eos_id=tok.eos_id)] = i
+            for c in b.run():
+                i = uid_to_i[c.uid]
+                emit(i, prompts[i], c.tokens)
             return 0
 
         model = build_decode_model(model_cfg, cfg.precision)
