@@ -165,10 +165,11 @@ class T5MLP(nn.Module):
 class T5DecodeAttention(nn.Module):
     """Single-token decoder SELF-attention with a KV cache (generation
     path, generate.generate_seq2seq). Mirrors llama's decode discipline:
-    static (B, L, H, D) buffers + an index scalar, absolute-position
+    static (B, L, H, D) buffers + a cache_index (scalar, or (B,) under
+    ``decode_rows`` — serving.py's per-row offsets), absolute-position
     masking of the unwritten tail. The block-0 relative-bias table is
     looked up per step for the query's absolute position; later blocks
-    receive the computed (1, H, 1, L) bias."""
+    receive the computed bias ((1, H, 1, L), or (B, H, 1, L) per-row)."""
 
     num_heads: int
     rel_bias: bool
@@ -177,6 +178,10 @@ class T5DecodeAttention(nn.Module):
     max_len: int
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    # Per-row cache offsets for continuous batching (serving.py) — same
+    # contract as llama/gpt2 decode_rows: cache_index is (B,), and the
+    # relative-position bias / mask are computed per row.
+    decode_rows: bool = False
 
     @nn.compact
     def __call__(self, x, position_bias=None):
@@ -198,11 +203,20 @@ class T5DecodeAttention(nn.Module):
                             (B, L, self.num_heads, head_dim), k.dtype)
         c_v = self.variable("cache", "cached_value", jnp.zeros,
                             (B, L, self.num_heads, head_dim), v.dtype)
+        idx_shape = (B,) if self.decode_rows else ()
         c_i = self.variable("cache", "cache_index",
-                            lambda: jnp.zeros((), jnp.int32))
+                            lambda: jnp.zeros(idx_shape, jnp.int32))
         idx = c_i.value
-        c_k.value = jax.lax.dynamic_update_slice_in_dim(c_k.value, k, idx, 1)
-        c_v.value = jax.lax.dynamic_update_slice_in_dim(c_v.value, v, idx, 1)
+        if self.decode_rows:
+            upd = lambda c, new, i: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+                c, new, i, 0)
+            c_k.value = jax.vmap(upd)(c_k.value, k, idx)
+            c_v.value = jax.vmap(upd)(c_v.value, v, idx)
+        else:
+            c_k.value = jax.lax.dynamic_update_slice_in_dim(
+                c_k.value, k, idx, 1)
+            c_v.value = jax.lax.dynamic_update_slice_in_dim(
+                c_v.value, v, idx, 1)
         c_i.value = idx + 1
         k_pos = jnp.arange(L)
         if self.rel_bias:
@@ -211,18 +225,28 @@ class T5DecodeAttention(nn.Module):
                 self.rel_pos_buckets, self.num_heads,
                 embedding_init=nn.initializers.normal(C ** -0.5),
                 param_dtype=self.param_dtype, name="rel_bias")
-            buckets = relative_position_bucket(
-                (k_pos - idx).astype(jnp.int32), False,
-                self.rel_pos_buckets, self.rel_pos_max_distance)
-            position_bias = jnp.transpose(
-                table(buckets), (1, 0))[None, :, None, :]  # (1, H, 1, L)
+            if self.decode_rows:
+                # (B, L) relative distances — one bias row per slot offset
+                rel = (k_pos[None, :] - idx[:, None]).astype(jnp.int32)
+                buckets = relative_position_bucket(
+                    rel, False, self.rel_pos_buckets,
+                    self.rel_pos_max_distance)
+                position_bias = jnp.transpose(
+                    table(buckets), (0, 2, 1))[:, :, None, :]  # (B,H,1,L)
+            else:
+                buckets = relative_position_bucket(
+                    (k_pos - idx).astype(jnp.int32), False,
+                    self.rel_pos_buckets, self.rel_pos_max_distance)
+                position_bias = jnp.transpose(
+                    table(buckets), (1, 0))[None, :, None, :]  # (1,H,1,L)
             position_bias = position_bias.astype(jnp.float32)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, c_k.value,
                             preferred_element_type=jnp.float32)
         if position_bias is not None:
             scores = scores + position_bias
-        scores = jnp.where(k_pos[None, None, None, :] <= idx, scores,
-                           jnp.float32(-1e9))
+        live = (k_pos[None, None, None, :]
+                <= (idx[:, None, None, None] if self.decode_rows else idx))
+        scores = jnp.where(live, scores, jnp.float32(-1e9))
         probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
         y = jnp.einsum("bhqk,bkhd->bqhd", probs, c_v.value)
         out = nn.DenseGeneral(
@@ -410,6 +434,7 @@ class T5DecodeBlock(nn.Module):
     max_len: int
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    decode_rows: bool = False
 
     @nn.compact
     def __call__(self, x, enc, enc_mask=None, position_bias=None):
@@ -419,7 +444,8 @@ class T5DecodeBlock(nn.Module):
             rel_pos_buckets=self.rel_pos_buckets,
             rel_pos_max_distance=self.rel_pos_max_distance,
             max_len=self.max_len, dtype=self.dtype,
-            param_dtype=self.param_dtype, name="self_attn",
+            param_dtype=self.param_dtype, decode_rows=self.decode_rows,
+            name="self_attn",
         )(h, position_bias=position_bias)
         x = x + h
         h = RMSNorm(self.eps, name="ln_cross")(x)
@@ -496,6 +522,7 @@ class T5DecodeStep(nn.Module):
     tie_head: bool
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    decode_rows: bool = False
 
     @nn.compact
     def __call__(self, dec_ids, enc, enc_mask=None):
@@ -515,6 +542,7 @@ class T5DecodeStep(nn.Module):
                 rel_pos_max_distance=self.rel_pos_max_distance,
                 eps=self.layer_norm_eps, max_len=self.max_decode_len,
                 dtype=self.dtype, param_dtype=self.param_dtype,
+                decode_rows=self.decode_rows,
                 name=f"dec_block{i}",
             )(y, enc, enc_mask=mask4, position_bias=bias)
         y = RMSNorm(self.layer_norm_eps, name="dec_final_norm")(y)
@@ -546,9 +574,10 @@ def t5_encoder(cfg, dtype, param_dtype) -> T5Encoder:
         layer_norm_eps=1e-6, dtype=dtype, param_dtype=param_dtype)
 
 
-def t5_decode_step(cfg, dtype, param_dtype, max_decode_len: int
-                   ) -> T5DecodeStep:
+def t5_decode_step(cfg, dtype, param_dtype, max_decode_len: int,
+                   decode_rows: bool = False) -> T5DecodeStep:
     return T5DecodeStep(
+        decode_rows=decode_rows,
         vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
         decoder_layers=getattr(cfg, "decoder_layers", 0) or cfg.num_layers,
         num_heads=cfg.num_heads, mlp_dim=cfg.mlp_dim,

@@ -151,15 +151,20 @@ class ContinuousBatcher:
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.cache = init_cache(self.model, slots)
         self.max_seq_len = self.model.max_seq_len
+        self._build_buckets(self.max_seq_len, min_bucket)
+        self._init_slot_state(slots)
+
+    def _build_buckets(self, cap: int, min_bucket: int) -> None:
         # power-of-two prefill buckets bound compile count to
-        # log2(max_seq_len / min_bucket) + 1 prefill executables
+        # log2(cap / min_bucket) + 1 prefill executables
         self.buckets = []
         b = min_bucket
-        while b < self.max_seq_len:
+        while b < cap:
             self.buckets.append(b)
             b *= 2
-        self.buckets.append(self.max_seq_len)
+        self.buckets.append(cap)
 
+    def _init_slot_state(self, slots: int) -> None:
         self.queue: deque[Request] = deque()
         self._next_uid = 0
         # host-side slot state
@@ -180,16 +185,19 @@ class ContinuousBatcher:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 "(admission always samples the first continuation token)")
-        if len(prompt) + max_new_tokens > self.max_seq_len:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_seq_len "
-                f"({self.max_seq_len})")
+        self._check_request(len(prompt), max_new_tokens)
         uid = self._next_uid
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, max_new_tokens,
                                   temperature, eos_id))
         return uid
+
+    def _check_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        if prompt_len + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.max_seq_len})")
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -233,6 +241,12 @@ class ContinuousBatcher:
         return Completion(req.uid, req.prompt, self._generated[r],
                           "eos" if done_eos else "length")
 
+    def _decode(self, ids):
+        """One batched decode step over all slots; returns (B, V) logits."""
+        logits, self.cache = _decode_step(
+            self.model, self.params, self.cache, ids)
+        return logits
+
     @property
     def active_slots(self) -> list[int]:
         return [r for r in range(self.slots) if self._req[r] is not None]
@@ -253,9 +267,7 @@ class ContinuousBatcher:
         # free rows feed token 0 and are ignored (their cache_index
         # free-runs — reset at the next admit, clamped writes stay in the
         # dead row).
-        ids = jnp.asarray(self._pending)[:, None]
-        logits, self.cache = _decode_step(
-            self.model, self.params, self.cache, ids)
+        logits = self._decode(jnp.asarray(self._pending)[:, None])
         self.rng, step_rng = jax.random.split(self.rng)
         nxt = np.asarray(_sample_rows(
             logits, step_rng, jnp.asarray(self._temp), self.top_k,
@@ -277,3 +289,127 @@ class ContinuousBatcher:
         as they finish (arrival-order-independent)."""
         while self.queue or self.active_slots:
             yield from self.step()
+
+
+# ------------------------------------------------------ seq2seq (t5) serving
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _insert_enc_row(enc_buf, mask_buf, enc_row, mask_row, r):
+    """Write a freshly encoded B=1 source into slot ``r`` of the encoder
+    pool. ``enc_row`` is bucket-length; columns beyond it keep the old
+    occupant's values but ``mask_row`` (full source-cap width, zeros past
+    the new source) makes them invisible to cross-attention."""
+    enc_buf = jax.lax.dynamic_update_slice(
+        enc_buf, enc_row.astype(enc_buf.dtype), (r, 0, 0))
+    mask_buf = jax.lax.dynamic_update_slice(mask_buf, mask_row, (r, 0))
+    return enc_buf, mask_buf
+
+
+class Seq2SeqContinuousBatcher(ContinuousBatcher):
+    """Continuous batching for encoder-decoder (t5) models.
+
+    A submitted ``prompt`` is the SOURCE sequence: admission encodes it
+    once at B=1 (padded to a power-of-two bucket), scatters the encoder
+    rows into a static (slots, source_cap, C) pool, and zeroes the slot's
+    decoder cache row. Decoding then advances every slot one target token
+    per batched step exactly like the causal batcher — per-row decoder
+    cache offsets (models/t5.py decode_rows), fixed per-slot encoder rows,
+    cross-attention masked to each slot's true source length. T5
+    conventions by default: the decoder starts from pad id 0; pass
+    ``eos_id=1`` per request to stop at T5's EOS.
+    """
+
+    def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
+                 params: Any, *, slots: int = 4, top_k: int = 0,
+                 top_p: float = 0.0, rng=None, min_bucket: int = 16,
+                 source_cap: int = 0, decoder_start_id: int = 0):
+        from pytorch_distributed_train_tpu.models.t5 import (
+            t5_decode_step,
+            t5_encoder,
+        )
+
+        if not model_cfg.name.startswith("t5"):
+            raise ValueError(
+                f"Seq2SeqContinuousBatcher serves the t5 family, got "
+                f"{model_cfg.name!r}")
+        dtype = jnp.dtype(precision.compute_dtype)
+        param_dtype = jnp.dtype(precision.param_dtype)
+        self.encoder = t5_encoder(model_cfg, dtype, param_dtype)
+        self.model = t5_decode_step(model_cfg, dtype, param_dtype,
+                                    max_decode_len=model_cfg.max_seq_len,
+                                    decode_rows=True)
+        self.params = params
+        self.slots = slots
+        self.top_k = top_k
+        self.top_p = top_p
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.max_seq_len = model_cfg.max_seq_len
+        self.source_cap = source_cap or model_cfg.max_seq_len
+        self.decoder_start_id = decoder_start_id
+        self._build_buckets(self.source_cap, min_bucket)
+
+        from pytorch_distributed_train_tpu.generate import (
+            _seq2seq_cache_shapes,
+        )
+
+        self._enc = jnp.zeros((slots, self.source_cap,
+                               model_cfg.hidden_size), dtype)
+        self._enc_mask = jnp.zeros((slots, self.source_cap), jnp.int32)
+        shapes = _seq2seq_cache_shapes(self.model, slots, self._enc.shape,
+                                       str(dtype))
+        self.cache = jax.tree.map(lambda sh: jnp.zeros(sh.shape, sh.dtype),
+                                  shapes)
+        # One immutable zero template for decoder-row resets: _insert_row
+        # donates only the pool (argnum 0), so reusing this every admit is
+        # safe and skips a per-admission KV-tree allocation.
+        self._zero_row = jax.tree.map(
+            lambda sh: jnp.zeros(sh.shape, sh.dtype),
+            _seq2seq_cache_shapes(self.model, 1, (1,) + self._enc.shape[1:],
+                                  str(dtype)))
+        self._init_slot_state(slots)
+
+    def _check_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        if prompt_len > self.source_cap:
+            raise ValueError(
+                f"source ({prompt_len}) exceeds source_cap "
+                f"({self.source_cap})")
+        if max_new_tokens + 1 > self.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) + start token exceeds "
+                f"max_seq_len ({self.max_seq_len})")
+
+    def _admit(self, r: int, req: Request) -> Completion | None:
+        """Encode the source into slot ``r`` and reset its decoder row.
+        Unlike the causal batcher, admission emits NO token — the next
+        batched step feeds the decoder-start id and samples the first."""
+        from pytorch_distributed_train_tpu.generate import _seq2seq_encode
+
+        P = self._bucket(len(req.prompt))
+        ids = np.zeros((1, P), np.int32)
+        ids[0, : len(req.prompt)] = req.prompt
+        mask = np.zeros((1, self.source_cap), np.int32)
+        mask[0, : len(req.prompt)] = 1
+        enc_row = _seq2seq_encode(self.encoder, self.params,
+                                  jnp.asarray(ids),
+                                  jnp.asarray(mask[:, :P]))
+        self._enc, self._enc_mask = _insert_enc_row(
+            self._enc, self._enc_mask, enc_row, jnp.asarray(mask),
+            jnp.int32(r))
+        self.cache = _insert_row(self.cache, self._zero_row, jnp.int32(r),
+                                 jnp.int32(0))
+        self.stats["prefills"] += 1
+        self._req[r] = req
+        self._generated[r] = []
+        self._pending[r] = self.decoder_start_id
+        self._temp[r] = req.temperature
+        return None  # first token arrives at the next batched step
+
+    def _decode(self, ids):
+        from pytorch_distributed_train_tpu.generate import (
+            _seq2seq_decode_step,
+        )
+
+        logits, self.cache = _seq2seq_decode_step(
+            self.model, self.params, self.cache, ids, self._enc,
+            self._enc_mask)
+        return logits

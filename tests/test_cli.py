@@ -214,6 +214,16 @@ def test_generate_cli_t5(tmp_path, capfd):
     assert rc == 0
     assert "prompt 0" in capfd.readouterr().out
 
+    # continuous batching serves t5 too; greedy == lockstep
+    rc = generate_cli.main(
+        ["--config", "t5_small", "--safetensors", str(st),
+         "--prompt", "translate this", "--max-new-tokens", "5",
+         "--serve-slots", "2"]
+        + [f"--set={s}" for s in shrink])
+    served = capfd.readouterr().out
+    assert rc == 0, served
+    assert served == out
+
     rc = generate_cli.main(
         ["--config", "t5_small", "--safetensors", str(st),
          "--prompt", "hi", "--max-new-tokens", "3", "--tp", "2"]

@@ -160,3 +160,77 @@ def test_gpt2_matches_lockstep_generate():
     for uid, p in zip(uids, prompts):
         assert done[uid].tokens == _reference(cfg, params, p, 5), \
             "gpt2 slot diverged from lockstep generate()"
+
+
+# ------------------------------------------------------- seq2seq (t5)
+
+def _t5_cfg():
+    return ModelConfig(name="t5", vocab_size=53, hidden_size=32,
+                       num_layers=2, num_heads=4, mlp_dim=64,
+                       max_seq_len=24, dropout_rate=0.0)
+
+
+@pytest.fixture(scope="module")
+def t5_setup():
+    cfg = _t5_cfg()
+    model = build_model(cfg, PrecisionConfig())
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 4), jnp.int32),
+                        jnp.zeros((1, 2), jnp.int32),
+                        train=False)["params"]
+    return cfg, params
+
+
+def _t5_reference(cfg, params, src, n, eos_id=None):
+    from pytorch_distributed_train_tpu.generate import generate_seq2seq
+
+    out = generate_seq2seq(cfg, PrecisionConfig(), params,
+                           jnp.asarray([src], jnp.int32), n, eos_id=eos_id)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def test_t5_serving_matches_lockstep(t5_setup):
+    """Mixed source lengths over fewer slots than requests: every target
+    must equal the lockstep generate_seq2seq output — pins the per-row
+    decoder offsets, the per-slot relative-bias rows, and the
+    cross-attention masking of each slot's padded source."""
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    cfg, params = t5_setup
+    rng = np.random.default_rng(4)
+    sources = [list(map(int, rng.integers(2, 53, n))) for n in (3, 15, 8)]
+    budgets = [6, 4, 7]
+    b = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    uids = [b.submit(s, n) for s, n in zip(sources, budgets)]
+    done = {c.uid: c for c in b.run()}
+    assert sorted(done) == sorted(uids)
+    for uid, s, n in zip(uids, sources, budgets):
+        assert done[uid].tokens == _t5_reference(cfg, params, s, n), \
+            f"t5 request {uid} diverged from lockstep generate_seq2seq()"
+
+
+def test_t5_serving_eos_frees_slot(t5_setup):
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    cfg, params = t5_setup
+    src = [5, 9, 3, 17]
+    ref = _t5_reference(cfg, params, src, 8)
+    eos = ref[2]
+    b = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    uid = b.submit(src, 8, eos_id=eos)
+    done = {c.uid: c for c in b.run()}
+    assert done[uid].finish_reason == "eos"
+    assert done[uid].tokens == ref[:3]
+
+
+def test_t5_serving_refuses_causal_models(t5_setup):
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    with pytest.raises(ValueError, match="t5 family"):
+        Seq2SeqContinuousBatcher(_cfg(), PrecisionConfig(), None)

@@ -53,7 +53,7 @@ def main(argv=None) -> int:
                         "run ALL prompts concurrently through this many "
                         "cache slots instead of one lockstep generate() "
                         "per prompt; completions print as they finish "
-                        "(causal-LM families; 0 → off)")
+                        "(causal + t5 families; 0 → off)")
     args = p.parse_args(argv)
 
     prompts = []
@@ -103,12 +103,10 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--num-beams with --tp is unsupported (beam search "
                 "drives the single-device step)")
-        if args.serve_slots > 0 and (is_t5 or args.num_beams >= 1
-                                     or args.tp > 1):
+        if args.serve_slots > 0 and (args.num_beams >= 1 or args.tp > 1):
             raise ValueError(
-                "--serve-slots is causal-LM continuous batching; it "
-                "composes with sampling flags but not --num-beams/--tp, "
-                "and t5 serving is lockstep for now")
+                "--serve-slots is continuous batching; it composes with "
+                "sampling flags but not --num-beams/--tp")
         init_inputs = ((jnp.zeros((1, 2), jnp.int32),
                         jnp.zeros((1, 2), jnp.int32)) if is_t5
                        else (jnp.zeros((1, 2), jnp.int32),))
@@ -130,6 +128,25 @@ def main(argv=None) -> int:
             from pytorch_distributed_train_tpu.generate import (
                 generate_seq2seq,
             )
+
+            if args.serve_slots > 0:
+                from pytorch_distributed_train_tpu.serving import (
+                    Seq2SeqContinuousBatcher,
+                )
+
+                b = Seq2SeqContinuousBatcher(
+                    model_cfg, cfg.precision, params,
+                    slots=args.serve_slots, top_k=args.top_k,
+                    top_p=args.top_p, rng=jax.random.PRNGKey(args.seed))
+                uid_to_i = {}
+                for i, e in enumerate(encoded):
+                    uid_to_i[b.submit(e, args.max_new_tokens,
+                                      temperature=args.temperature,
+                                      eos_id=tok.eos_id)] = i
+                for c in b.run():
+                    i = uid_to_i[c.uid]
+                    emit(i, prompts[i], c.tokens)
+                return 0
 
             for i, (text, e) in enumerate(zip(prompts, encoded)):
                 ids = jnp.asarray(np.asarray(e, np.int32)[None, :])
