@@ -95,6 +95,13 @@ class ModelConfig:
     # ring attention skips out-of-band hops, ulysses windows its full-seq
     # local core.
     attention_window: int = 0
+    # Packed-block document isolation (llama/gpt2 training): >= 0 names
+    # the EOS id that delimits documents inside packed seq_len blocks
+    # (data/text.py packing). Attention is then masked across documents
+    # and rope/wpe positions restart at 0 per document — each doc trains
+    # exactly as if unpacked. -1 = off (simple packing: docs see their
+    # pack-mates' tails; the GPT-2/llama-pretrain default).
+    segment_eos_id: int = -1
     # Pipeline parallelism (model name "llama_pp"; SURVEY §2.3 PP row):
     # microbatch count (0 → = stage count), schedule ("gpipe" | "1f1b" |
     # "interleaved"), and chunks per device for the interleaved schedule.

@@ -36,6 +36,11 @@ def build_decode_model(model_cfg: ModelConfig, precision: PrecisionConfig):
     if getattr(cfg, "fused_lm_loss", False):
         # generation needs logits; the fused head returns CE sums
         cfg = dataclasses.replace(cfg, fused_lm_loss=False)
+    if getattr(cfg, "segment_eos_id", -1) >= 0:
+        # packed-document isolation is a TRAINING feature; decode serves
+        # one unpacked sequence per row, where isolation is vacuous — a
+        # packed-trained config must still generate without overrides
+        cfg = dataclasses.replace(cfg, segment_eos_id=-1)
     model = build_model(cfg, precision)
     if not any(f.name == "decode" for f in dataclasses.fields(model)):
         raise ValueError(

@@ -43,7 +43,7 @@ class GPT2Attention(nn.Module):
     decode_rows: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segments=None):
         from pytorch_distributed_train_tpu.quant import quant_dot_general
 
         B, S, C = x.shape
@@ -113,7 +113,7 @@ class GPT2Attention(nn.Module):
         else:
             y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
                                       impl=self.attn_impl,
-                                      window=self.window)
+                                      window=self.window, segments=segments)
         return nn.DenseGeneral(
             C, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
             dot_general=dg,
@@ -138,7 +138,7 @@ class GPT2Block(nn.Module):
     decode_rows: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segments=None):
         from pytorch_distributed_train_tpu.quant import quant_dot_general
 
         ln = lambda name: nn.LayerNorm(  # noqa: E731
@@ -153,7 +153,7 @@ class GPT2Block(nn.Module):
                           quant=self.quant, decode=self.decode,
                           decode_multi=self.decode_multi,
                           decode_rows=self.decode_rows,
-                          name="attn")(h),
+                          name="attn")(h, segments=segments),
             deterministic=self.deterministic)
         h = ln("ln_2")(x).astype(self.dtype)
         dg = quant_dot_general(self.quant)
@@ -195,12 +195,30 @@ class GPT2LMHead(nn.Module):
     decode_rows: bool = False
     # Fused chunked head+CE over the tied embedding (losses.chunked_causal_ce)
     fused_loss: bool = False
+    # Packed-block document isolation (see llama.py segment_eos_id)
+    segment_eos_id: int = -1
     act: "object | None" = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True, loss_mask=None):
         deterministic = not train
         B, S = input_ids.shape
+        segments = seg_positions = None
+        if self.segment_eos_id >= 0:
+            if self.decode:
+                raise ValueError(
+                    "segment_eos_id is a packed-TRAINING feature; decode "
+                    "serves one unpacked sequence per row")
+            if self.cp is not None and self.cp.active:
+                raise ValueError(
+                    "segment_eos_id with context parallelism is not "
+                    "supported; use context=1 for packed-isolation runs")
+            from pytorch_distributed_train_tpu.models.llama import (
+                packed_segments,
+            )
+
+            segments, seg_positions = packed_segments(input_ids,
+                                                      self.segment_eos_id)
         wte = nn.Embed(self.vocab_size, self.hidden_size,
                        embedding_init=nn.initializers.normal(0.02),
                        param_dtype=self.param_dtype, name="wte")
@@ -223,7 +241,9 @@ class GPT2LMHead(nn.Module):
                 pos = jax.lax.dynamic_slice_in_dim(wpe, p_i.value, S, 0)[None]
             p_i.value = p_i.value + S
         else:
-            pos = wpe[:S][None]
+            # packed segments: each document's positions restart at 0
+            pos = (wpe[seg_positions] if seg_positions is not None
+                   else wpe[:S][None])
             if self.decode:
                 p_i = self.variable("cache", "pos_index",
                                     lambda: jnp.zeros(pos_shape, jnp.int32))
@@ -246,7 +266,7 @@ class GPT2LMHead(nn.Module):
                 decode=self.decode, decode_multi=self.decode_multi,
                 decode_rows=self.decode_rows,
                 name=f"h{i}",
-            )(x)
+            )(x, segments=segments)
             if self.act is not None:
                 x = self.act.constrain(x)
 
@@ -275,6 +295,7 @@ def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
         attn_impl=getattr(cfg, "attention_impl", "auto"),
         attention_window=getattr(cfg, "attention_window", 0),
         quant_training=getattr(cfg, "quant_training", ""),
+        segment_eos_id=getattr(cfg, "segment_eos_id", -1),
         fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,

@@ -68,6 +68,29 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def packed_segments(input_ids: jnp.ndarray, eos_id: int):
+    """Document structure of an EOS-packed block, derived at trace time.
+
+    Packed LM blocks (data/text.py: docs joined by EOS, cut to seq_len)
+    otherwise let attention leak across document boundaries. Returns
+    (segments (B, S) int32 — the 1-based document id of every token (the
+    EOS belongs to the document it ends); attention restricts to equal
+    ids (ops/attention.py ``segments=``, which builds masks tile-by-tile
+    on the chunked path instead of materialising (B, 1, S, S)) — and
+    positions (B, S) int32 — each token's offset WITHIN its document, so
+    rope/wpe treat every document as starting at position 0, exactly as
+    if it were alone in the batch)."""
+    B, S = input_ids.shape
+    is_eos = input_ids == eos_id
+    # token t starts a new segment iff t == 0 or token t-1 was EOS
+    is_start = jnp.concatenate(
+        [jnp.ones((B, 1), bool), is_eos[:, :-1]], axis=1)
+    seg = jnp.cumsum(is_start.astype(jnp.int32), axis=1)  # (B, S), 1-based
+    t = jnp.arange(S, dtype=jnp.int32)[None, :]
+    starts = jax.lax.cummax(jnp.where(is_start, t, 0), axis=1)
+    return seg, t - starts
+
+
 def apply_rope_rows(x: jnp.ndarray, cos: jnp.ndarray,
                     sin: jnp.ndarray) -> jnp.ndarray:
     """Per-row-position rope: x (B, S, H, D), cos/sin (B, S, D/2) — each
@@ -107,7 +130,7 @@ class LlamaAttention(nn.Module):
     decode_rows: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segments=None, positions=None):
         B, S, C = x.shape
         head_dim = C // self.num_heads
         from pytorch_distributed_train_tpu.quant import quant_dot_general
@@ -210,12 +233,17 @@ class LlamaAttention(nn.Module):
         else:
             cos, sin = rope_frequencies(head_dim, S, self.rope_theta,
                                              self.rope_scaling)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
+            if positions is not None:
+                # packed segments: each document restarts at position 0
+                q = apply_rope_rows(q, cos[positions], sin[positions])
+                k = apply_rope_rows(k, cos[positions], sin[positions])
+            else:
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
 
             y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
                                       impl=self.attn_impl,
-                                      window=self.window)
+                                      window=self.window, segments=segments)
         y = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype, dot_general=dg,
@@ -265,7 +293,7 @@ class LlamaBlock(nn.Module):
     decode_rows: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segments=None, positions=None):
         h = RMSNorm(self.rms_norm_eps, name="input_norm")(x)
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.rope_theta,
@@ -274,7 +302,7 @@ class LlamaBlock(nn.Module):
             window=self.window, quant=self.quant, decode=self.decode,
             decode_multi=self.decode_multi, decode_rows=self.decode_rows,
             name="attn",
-        )(h)
+        )(h, segments=segments, positions=positions)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         if self.moe is not None:
             from pytorch_distributed_train_tpu.ops.moe import MoeMLP
@@ -317,6 +345,11 @@ class LlamaForCausalLM(nn.Module):
     quant_training: str = ""
     # Sliding-window attention span (Mistral recipe; 0 = full causal).
     attention_window: int = 0
+    # Packed-block document isolation (packed_segments): >= 0 names the
+    # EOS id delimiting documents; attention masks across documents and
+    # positions restart per document. -1 = off (documents attend across
+    # pack boundaries, the simple-packing default).
+    segment_eos_id: int = -1
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # Multi-token continuation in decode mode (speculative.py verify pass)
     decode_multi: bool = False
@@ -335,6 +368,19 @@ class LlamaForCausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, train: bool = True, loss_mask=None):
         del train  # no dropout in the Llama-2 pretrain recipe
+        segments = positions = None
+        if self.segment_eos_id >= 0:
+            if self.decode:
+                raise ValueError(
+                    "segment_eos_id is a packed-TRAINING feature; decode "
+                    "serves one unpacked sequence per row")
+            if self.cp is not None and self.cp.active:
+                raise ValueError(
+                    "segment_eos_id with context parallelism is not "
+                    "supported (the segment mask spans the full sequence); "
+                    "use context=1 for packed-isolation runs")
+            segments, positions = packed_segments(input_ids,
+                                                   self.segment_eos_id)
         x = nn.Embed(
             self.vocab_size, self.hidden_size,
             embedding_init=nn.initializers.normal(0.02),
@@ -358,7 +404,7 @@ class LlamaForCausalLM(nn.Module):
                 quant=self.quant_training, decode=self.decode,
                 decode_multi=self.decode_multi, decode_rows=self.decode_rows,
                 name=f"layer{i}",
-            )(x)
+            )(x, segments=segments, positions=positions)
             if self.act is not None:
                 x = self.act.constrain(x)
 
@@ -412,6 +458,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         quant_training=getattr(cfg, "quant_training", ""),
         attn_impl=getattr(cfg, "attention_impl", "auto"),
         attention_window=getattr(cfg, "attention_window", 0),
+        segment_eos_id=getattr(cfg, "segment_eos_id", -1),
         fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,

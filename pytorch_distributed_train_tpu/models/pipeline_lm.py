@@ -201,6 +201,10 @@ class PipelinedLlama:
 
 
 def llama_pp(cfg, dtype, param_dtype, *, mesh, cp=None) -> PipelinedLlama:
+    if getattr(cfg, "segment_eos_id", -1) >= 0:
+        raise ValueError(
+            "segment_eos_id (packed-document isolation) is not supported "
+            "by the pipelined llama; use name='llama' for packed runs")
     return PipelinedLlama(
         cfg, dtype, param_dtype, mesh=mesh, cp=cp,
         num_microbatches=cfg.pipeline_microbatches,

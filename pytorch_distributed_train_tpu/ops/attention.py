@@ -109,6 +109,7 @@ def dot_product_attention(
     impl: str = "auto",  # auto | xla | pallas | chunked
     cp: ContextParallelConfig | None = None,
     window: int = 0,  # >0: sliding window — attend to the last `window` keys
+    segments: jax.Array | None = None,  # (B, S) ids; attend only within ==
 ) -> jax.Array:
     """Multi-head attention core, GQA-aware.
 
@@ -146,6 +147,21 @@ def dot_product_attention(
         impl = env
     elif impl == "auto":
         impl = _default_impl
+    if segments is not None:
+        # Packed-document isolation (models pass the (B, S) segment ids,
+        # NOT a materialised (B, 1, S, S) mask — the xla path builds it
+        # where it materialises S^2 scores anyway, the chunked path
+        # builds one (B, 1, chunk, Sk) tile at a time).
+        if q.shape[1] != k.shape[1]:
+            raise ValueError("segments requires self-attention shapes")
+        if impl == "pallas":
+            raise ValueError(
+                "the pallas flash kernel does not take segment ids "
+                "(packed-document isolation) — use impl='xla' or "
+                "'chunked' for segment_eos_id runs")
+        if cp is not None and cp.active:
+            raise NotImplementedError(
+                "segments with context parallelism is unsupported")
     if cp is not None and cp.active:
         if cp.impl == "ring":
             if mask is not None:
@@ -174,7 +190,7 @@ def dot_product_attention(
                 tensor_axis=cp.tensor_axis, impl=impl,
             )
         raise ValueError(f"unknown context_impl {cp.impl!r}")
-    if impl in ("auto", "pallas"):
+    if impl in ("auto", "pallas") and segments is None:
         from pytorch_distributed_train_tpu.ops import flash_attention as _fa
 
         on_tpu = _on_tpu()
@@ -206,7 +222,11 @@ def dot_product_attention(
         # dense path OOMs on; BERT seq512 −3.6% (tile overhead) → dense
         # stays the short-seq default.
         return _chunked_attention(q, k, v, causal=causal, mask=mask,
-                                  softmax_dtype=softmax_dtype, window=window)
+                                  softmax_dtype=softmax_dtype, window=window,
+                                  segments=segments)
+    if segments is not None:
+        seg_mask = (segments[:, None, :, None] == segments[:, None, None, :])
+        mask = seg_mask if mask is None else (mask & seg_mask)
     return _xla_attention(q, k, v, causal=causal, mask=mask,
                           softmax_dtype=softmax_dtype, window=window)
 
@@ -261,7 +281,8 @@ _AUTO_CHUNK_MIN_SEQ = 1024
 
 
 def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
-                       chunk: int = _CHUNK_Q, window: int = 0):
+                       chunk: int = _CHUNK_Q, window: int = 0,
+                       segments=None):
     """Memory-efficient attention in pure XLA: flash-attention's streaming
     structure (process the score matrix in tiles, never materialise it
     whole) expressed as a sequential `lax.map` over query chunks with the
@@ -287,6 +308,10 @@ def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
     _, Sk, _, _ = k.shape
     k, v = expand_kv_heads(k, v, H)
     if Sq <= chunk:
+        if segments is not None:
+            seg_mask = (segments[:, None, :, None]
+                        == segments[:, None, None, :])
+            mask = seg_mask if mask is None else (mask & seg_mask)
         return _xla_attention(q, k, v, causal=causal, mask=mask,
                               softmax_dtype=softmax_dtype, window=window)
 
@@ -315,8 +340,15 @@ def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
     # band instead of scoring (and masking away) the whole key axis:
     # O(Sq * window) work, the compute win windowing exists for. Only when
     # no explicit mask rides along (its key axis would need slicing too).
+    if segments is not None and pad:
+        # padded query rows get segment id -1: they match nothing real
+        seg_padded = jnp.pad(segments, ((0, 0), (0, pad)),
+                             constant_values=-1)
+    else:
+        seg_padded = segments
     band_width = min(Sk, (window + chunk - 1)) if window else Sk
-    use_band = bool(window) and mask is None and band_width < Sk
+    use_band = (bool(window) and mask is None and segments is None
+                and band_width < Sk)
 
     def body(args):
         q_tile, start = args
@@ -346,6 +378,14 @@ def _chunked_attention(q, k, v, *, causal, mask, softmax_dtype,
                 tile_mask = jax.lax.dynamic_slice_in_dim(mask, start, chunk,
                                                          axis=2)
             logits = jnp.where(tile_mask, logits, _neg_inf(softmax_dtype))
+        if seg_padded is not None:
+            # one (B, 1, chunk, Sk) segment tile at a time — never the
+            # full (B, 1, Sq, Sk) mask (the whole point of this path)
+            seg_q = jax.lax.dynamic_slice_in_dim(seg_padded, start, chunk,
+                                                 axis=1)
+            seg_tile = (seg_q[:, None, :, None]
+                        == seg_padded[:, None, None, :Sk])
+            logits = jnp.where(seg_tile, logits, _neg_inf(softmax_dtype))
         # Padded query rows (beyond Sq) mask everything out → uniform
         # softmax over garbage; harmless, dropped by the final slice.
         probs = jax.nn.softmax(logits, axis=-1).astype(orig_dtype)

@@ -143,16 +143,19 @@ class ContinuousBatcher:
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,
                  top_p: float = 0.0, rng=None, min_bucket: int = 16):
+        self._init_common(params, slots, top_k, top_p, rng)
         self.model = build_serving_model(model_cfg, precision)
+        self.cache = init_cache(self.model, slots)
+        self.max_seq_len = self.model.max_seq_len
+        self._build_buckets(self.max_seq_len, min_bucket)
+        self._init_slot_state(slots)
+
+    def _init_common(self, params, slots, top_k, top_p, rng) -> None:
         self.params = params
         self.slots = slots
         self.top_k = top_k
         self.top_p = top_p
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
-        self.cache = init_cache(self.model, slots)
-        self.max_seq_len = self.model.max_seq_len
-        self._build_buckets(self.max_seq_len, min_bucket)
-        self._init_slot_state(slots)
 
     def _build_buckets(self, cap: int, min_bucket: int) -> None:
         # power-of-two prefill buckets bound compile count to
@@ -334,15 +337,11 @@ class Seq2SeqContinuousBatcher(ContinuousBatcher):
                 f"{model_cfg.name!r}")
         dtype = jnp.dtype(precision.compute_dtype)
         param_dtype = jnp.dtype(precision.param_dtype)
+        self._init_common(params, slots, top_k, top_p, rng)
         self.encoder = t5_encoder(model_cfg, dtype, param_dtype)
         self.model = t5_decode_step(model_cfg, dtype, param_dtype,
                                     max_decode_len=model_cfg.max_seq_len,
                                     decode_rows=True)
-        self.params = params
-        self.slots = slots
-        self.top_k = top_k
-        self.top_p = top_p
-        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.max_seq_len = model_cfg.max_seq_len
         self.source_cap = source_cap or model_cfg.max_seq_len
         self.decoder_start_id = decoder_start_id
