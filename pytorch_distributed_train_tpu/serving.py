@@ -46,6 +46,30 @@ from pytorch_distributed_train_tpu.generate import (
 )
 
 
+def load_params_for_serving(cfg, safetensors_path: str,
+                            quantize: str = ""):
+    """Load torch-layout safetensors weights for a prepared TrainConfig —
+    the shape template comes from one eval_shape init (no real init), and
+    ``quantize='int8'`` converts to the weight-only int8 tree. Shared by
+    tools/generate_cli.py and tools/serve_http.py so the loading pipeline
+    cannot diverge between the two entrypoints."""
+    from pytorch_distributed_train_tpu import quant
+    from pytorch_distributed_train_tpu.interop import load_flax_safetensors
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    is_t5 = cfg.model.name.startswith("t5")
+    init_inputs = ((jnp.zeros((1, 2), jnp.int32),) * 2 if is_t5
+                   else (jnp.zeros((1, 2), jnp.int32),))
+    template = jax.eval_shape(
+        lambda: build_model(cfg.model, cfg.precision).init(
+            {"params": jax.random.PRNGKey(0)}, *init_inputs,
+            train=False))["params"]
+    params = load_flax_safetensors(safetensors_path, template)
+    if quantize == "int8":
+        params = jax.jit(quant.quantize_tree)(params)
+    return params
+
+
 def build_serving_model(model_cfg: ModelConfig, precision: PrecisionConfig):
     """The continuous-batching twin of a decode model: per-row cache
     offsets enabled (models/llama.py decode_rows)."""

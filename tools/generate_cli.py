@@ -71,7 +71,6 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from pytorch_distributed_train_tpu import quant
     from pytorch_distributed_train_tpu.config import get_preset
     from pytorch_distributed_train_tpu.data.text import load_tokenizer
     from pytorch_distributed_train_tpu.generate import (
@@ -79,9 +78,6 @@ def main(argv=None) -> int:
         generate,
         shard_decode_params,
     )
-    from pytorch_distributed_train_tpu.interop import load_flax_safetensors
-    from pytorch_distributed_train_tpu.models.registry import build_model
-
     try:
         cfg = get_preset(args.config)
         cfg.apply_overrides(args.set)
@@ -107,16 +103,12 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--serve-slots is continuous batching; it composes with "
                 "sampling flags but not --num-beams/--tp")
-        init_inputs = ((jnp.zeros((1, 2), jnp.int32),
-                        jnp.zeros((1, 2), jnp.int32)) if is_t5
-                       else (jnp.zeros((1, 2), jnp.int32),))
-        template = jax.eval_shape(
-            lambda: build_model(model_cfg, cfg.precision).init(
-                {"params": jax.random.PRNGKey(0)},
-                *init_inputs, train=False))["params"]
-        params = load_flax_safetensors(args.safetensors, template)
-        if args.quantize == "int8":
-            params = jax.jit(quant.quantize_tree)(params)
+        from pytorch_distributed_train_tpu.serving import (
+            load_params_for_serving,
+        )
+
+        params = load_params_for_serving(cfg, args.safetensors,
+                                         args.quantize)
 
         def emit(i, text, new):
             if tok.eos_id in new:

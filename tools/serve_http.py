@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""HTTP serving endpoint over the continuous batcher — the end-user
+service surface (torch-ecosystem analogue: TGI / vLLM's OpenAI-style
+server, scoped to stdlib http.server: zero extra dependencies).
+
+    python tools/serve_http.py --config llama2_7b \
+        --safetensors model.st --tokenizer /models/llama2-tok \
+        --port 8000 --slots 8 [--quantize int8]
+
+    curl -s localhost:8000/v1/completions -d '{
+        "prompt": "The capital of France is",
+        "max_tokens": 32, "temperature": 0.7}'
+
+API (JSON over POST, one object per request):
+- ``POST /v1/completions``: {prompt, max_tokens?, temperature?} →
+  {text, finish_reason, usage:{prompt_tokens, completion_tokens}}.
+  ``top_k``/``top_p`` are SERVER-wide flags (static jit args — per-request
+  values would recompile; temperature is the per-request knob).
+- ``GET /healthz``: {status, stats} — liveness + batcher counters.
+
+Threading model: request handler threads (ThreadingHTTPServer) enqueue
+into the batcher under a lock and wait on a per-request event; ONE
+scheduler thread drives ``batcher.step()`` — all device work stays on a
+single thread, handlers only block on Python events. Requests admit into
+free slots mid-stream, so concurrent callers batch together on the chip
+without knowing about each other.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class BatcherService:
+    """Thread-safe facade over a (seq2seq-aware) continuous batcher: a
+    single scheduler thread steps the device; callers submit and wait."""
+
+    def __init__(self, batcher, tokenizer, *, idle_sleep_s: float = 0.005,
+                 max_new_default: int = 64):
+        self.batcher = batcher
+        self.tok = tokenizer
+        self.max_new_default = max_new_default
+        self._lock = threading.Lock()
+        self._done: dict[int, object] = {}
+        self._events: dict[int, threading.Event] = {}
+        self._abandoned: set[int] = set()  # timed-out uids: discard results
+        self.error: str | None = None  # scheduler-death reason (terminal)
+        self._idle_sleep_s = idle_sleep_s
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                with self._lock:
+                    busy = bool(self.batcher.queue
+                                or self.batcher.active_slots)
+                    finished = self.batcher.step() if busy else []
+                    for c in finished:
+                        if c.uid in self._abandoned:
+                            self._abandoned.discard(c.uid)
+                            continue  # waiter gave up; drop, don't leak
+                        self._done[c.uid] = c
+                        ev = self._events.pop(c.uid, None)
+                        if ev is not None:
+                            ev.set()
+            except Exception as e:  # noqa: BLE001 — must not die silently
+                # Device/compile errors are terminal for the only decode
+                # thread: record the reason (healthz flips to error), fail
+                # every waiter immediately instead of letting them time out.
+                self.error = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    for ev in self._events.values():
+                        ev.set()
+                    self._events.clear()
+                return
+            if not busy:
+                time.sleep(self._idle_sleep_s)
+
+    def healthy(self) -> bool:
+        return self.error is None and self._thread.is_alive()
+
+    def complete(self, prompt: str, max_tokens: int, temperature: float,
+                 timeout_s: float = 600.0) -> dict:
+        if self.error is not None:
+            raise RuntimeError(f"scheduler dead: {self.error}")
+        ids = self.tok.encode(prompt)
+        if not ids:
+            raise ValueError("empty prompt after tokenization")
+        ev = threading.Event()
+        with self._lock:
+            uid = self.batcher.submit(ids, max_tokens,
+                                      temperature=temperature,
+                                      eos_id=self.tok.eos_id)
+            self._events[uid] = ev
+        if not ev.wait(timeout_s):
+            with self._lock:
+                self._events.pop(uid, None)
+                self._abandoned.add(uid)
+            raise TimeoutError(f"request {uid} timed out after {timeout_s}s")
+        with self._lock:
+            c = self._done.pop(uid, None)
+        if c is None:  # woken by the scheduler-death path
+            raise RuntimeError(f"scheduler dead: {self.error}")
+        new = c.tokens
+        if self.tok.eos_id in new:
+            new = new[: new.index(self.tok.eos_id)]
+        return {
+            "text": self.tok.decode(new),
+            "finish_reason": c.finish_reason,
+            "usage": {"prompt_tokens": len(ids),
+                      "completion_tokens": len(c.tokens)},
+        }
+
+    def stats(self) -> dict:
+        # Snapshot WITHOUT the step lock: the counters are plain ints
+        # mutated only by the scheduler thread, and a liveness probe must
+        # not block behind a minutes-long first-compile step quantum.
+        return dict(self.batcher.stats)
+
+    def shutdown(self):
+        self._stop = True
+        self._thread.join(timeout=5)
+
+
+def make_handler(service: BatcherService):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, obj: dict):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if service.healthy():
+                    self._send(200, {"status": "ok",
+                                     "stats": service.stats()})
+                else:
+                    self._send(503, {"status": "error",
+                                     "error": service.error,
+                                     "stats": service.stats()})
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/v1/completions":
+                self._send(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                out = service.complete(
+                    str(req["prompt"]),
+                    int(req.get("max_tokens", service.max_new_default)),
+                    float(req.get("temperature", 0.0)),
+                )
+                self._send(200, out)
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": f"{e.args[0] if e.args else e}"})
+            except (TimeoutError, RuntimeError) as e:
+                self._send(503, {"error": str(e)})
+
+    return Handler
+
+
+def build_service(args) -> BatcherService:
+    import jax
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.data.text import load_tokenizer
+    from pytorch_distributed_train_tpu.serving import (
+        ContinuousBatcher,
+        Seq2SeqContinuousBatcher,
+        load_params_for_serving,
+    )
+
+    cfg = get_preset(args.config)
+    cfg.apply_overrides(args.set)
+    tok = load_tokenizer(args.tokenizer)
+    params = load_params_for_serving(cfg, args.safetensors, args.quantize)
+    cls = (Seq2SeqContinuousBatcher if cfg.model.name.startswith("t5")
+           else ContinuousBatcher)
+    batcher = cls(cfg.model, cfg.precision, params, slots=args.slots,
+                  top_k=args.top_k, top_p=args.top_p,
+                  rng=jax.random.PRNGKey(args.seed))
+    return BatcherService(batcher, tok,
+                          max_new_default=args.max_new_default)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="llama2_7b")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE")
+    p.add_argument("--safetensors", required=True)
+    p.add_argument("--tokenizer", default="",
+                   help="local HF tokenizer dir; empty → byte tokenizer")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-new-default", type=int, default=64)
+    p.add_argument("--quantize", default="", choices=["", "int8"])
+    args = p.parse_args(argv)
+
+    try:
+        service = build_service(args)
+    except (KeyError, ValueError, FileNotFoundError, OSError) as e:
+        print(f"serve_http: error: {e.args[0] if e.args else e}",
+              file=sys.stderr)
+        return 2
+    server = ThreadingHTTPServer((args.host, args.port),
+                                 make_handler(service))
+    print(f"serving on http://{args.host}:{server.server_address[1]} "
+          f"(slots={args.slots})", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
