@@ -91,25 +91,34 @@ class BatcherService:
 
     def complete(self, prompt: str, max_tokens: int, temperature: float,
                  timeout_s: float = 600.0) -> dict:
-        if self.error is not None:
-            raise RuntimeError(f"scheduler dead: {self.error}")
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
         ev = threading.Event()
         with self._lock:
+            # Checked UNDER the lock: the scheduler's death path clears
+            # _events under this lock, so registering after a pre-lock
+            # check could enqueue an event nothing will ever set.
+            if self.error is not None:
+                raise RuntimeError(f"scheduler dead: {self.error}")
             uid = self.batcher.submit(ids, max_tokens,
                                       temperature=temperature,
                                       eos_id=self.tok.eos_id)
             self._events[uid] = ev
-        if not ev.wait(timeout_s):
-            with self._lock:
+        timed_out = not ev.wait(timeout_s)
+        with self._lock:
+            # The completion may have landed in the wait→lock window even
+            # on the timeout path — prefer returning it over abandoning
+            # (which would leak the stored result forever: uids never
+            # repeat, so nothing else would pop it).
+            c = self._done.pop(uid, None)
+            if timed_out and c is None:
                 self._events.pop(uid, None)
                 self._abandoned.add(uid)
-            raise TimeoutError(f"request {uid} timed out after {timeout_s}s")
-        with self._lock:
-            c = self._done.pop(uid, None)
-        if c is None:  # woken by the scheduler-death path
+        if c is None:
+            if timed_out:
+                raise TimeoutError(
+                    f"request {uid} timed out after {timeout_s}s")
             raise RuntimeError(f"scheduler dead: {self.error}")
         new = c.tokens
         if self.tok.eos_id in new:
