@@ -268,6 +268,20 @@ class ContinuousBatcher:
         return Completion(req.uid, req.prompt, self._generated[r],
                           "eos" if done_eos else "length")
 
+    def new_tokens_since(self, seen: dict[int, int]) -> dict[int, list[int]]:
+        """uid -> ids generated beyond seen[uid], for every ACTIVE slot
+        whose uid appears in ``seen``. The supported tap for streaming
+        consumers (tools/serve_http.py) — callers never touch slot state.
+        Tokens of requests that just FINISHED are not here; read them from
+        the step()/run() Completion."""
+        out: dict[int, list[int]] = {}
+        for r in self.active_slots:
+            uid = self._req[r].uid
+            n = seen.get(uid)
+            if n is not None and len(self._generated[r]) > n:
+                out[uid] = self._generated[r][n:]
+        return out
+
     def _decode(self, ids):
         """One batched decode step over all slots; returns (B, V) logits."""
         logits, self.cache = _decode_step(

@@ -131,3 +131,31 @@ def test_scheduler_death_flips_healthz_and_fails_fast():
     assert not service.healthy()
     assert "XLA OOM" in service.error
     service.shutdown()
+
+
+def test_streaming_matches_non_streamed(server):
+    """SSE stream: concatenated deltas == the non-streamed completion
+    text, with [DONE] terminating the event stream."""
+    port, cfg, params, tok = server
+    prompt = "stream me please"
+    _, plain = _post(port, {"prompt": prompt, "max_tokens": 8})
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps({"prompt": prompt, "max_tokens": 8,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        raw = r.read().decode()
+    chunks = [json.loads(line[len("data: "):])
+              for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    assert raw.rstrip().endswith("data: [DONE]")
+    text = "".join(c.get("delta", "") for c in chunks)
+    assert text == plain["text"]
+    final = chunks[-1]
+    assert final["finish_reason"] == plain["finish_reason"]
+    assert final["usage"] == plain["usage"]
+    # genuinely incremental: more than one delta chunk for 8 tokens
+    assert sum(1 for c in chunks if c.get("delta")) > 1

@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue as queue_mod
 import sys
 import threading
 import time
@@ -51,6 +52,8 @@ class BatcherService:
         self._lock = threading.Lock()
         self._done: dict[int, object] = {}
         self._events: dict[int, threading.Event] = {}
+        self._streams: dict[int, queue_mod.Queue] = {}  # uid -> chunk queue
+        self._stream_seen: dict[int, int] = {}  # tokens already pushed
         self._abandoned: set[int] = set()  # timed-out uids: discard results
         self.error: str | None = None  # scheduler-death reason (terminal)
         self._idle_sleep_s = idle_sleep_s
@@ -65,10 +68,24 @@ class BatcherService:
                     busy = bool(self.batcher.queue
                                 or self.batcher.active_slots)
                     finished = self.batcher.step() if busy else []
+                    # push newly generated tokens to streaming waiters
+                    fresh = self.batcher.new_tokens_since(self._stream_seen)
+                    for uid, toks in fresh.items():
+                        self._streams[uid].put(("tokens", toks))
+                        self._stream_seen[uid] += len(toks)
                     for c in finished:
                         if c.uid in self._abandoned:
                             self._abandoned.discard(c.uid)
+                            self._streams.pop(c.uid, None)
+                            self._stream_seen.pop(c.uid, None)
                             continue  # waiter gave up; drop, don't leak
+                        q = self._streams.pop(c.uid, None)
+                        if q is not None:
+                            seen = self._stream_seen.pop(c.uid, 0)
+                            if len(c.tokens) > seen:
+                                q.put(("tokens", c.tokens[seen:]))
+                            q.put(("done", c))
+                            continue  # streamed: never stored in _done
                         self._done[c.uid] = c
                         ev = self._events.pop(c.uid, None)
                         if ev is not None:
@@ -82,6 +99,10 @@ class BatcherService:
                     for ev in self._events.values():
                         ev.set()
                     self._events.clear()
+                    for q in self._streams.values():
+                        q.put(("error", self.error))
+                    self._streams.clear()
+                    self._stream_seen.clear()
                 return
             if not busy:
                 time.sleep(self._idle_sleep_s)
@@ -130,6 +151,54 @@ class BatcherService:
                       "completion_tokens": len(c.tokens)},
         }
 
+    def stream(self, prompt: str, max_tokens: int, temperature: float,
+               timeout_s: float = 600.0):
+        """Returns (uid, chunk iterator). Validation and submission run
+        EAGERLY (so callers can reject before committing to a response);
+        the iterator yields (new_token_ids, completion_or_None) chunks as
+        the batched decode produces them, ending with the Completion.
+        ``timeout_s`` bounds the wait for EACH chunk. A caller that stops
+        consuming must call ``abandon_stream(uid)``."""
+        ids = self.tok.encode(prompt)
+        if not ids:
+            raise ValueError("empty prompt after tokenization")
+        q: queue_mod.Queue = queue_mod.Queue()
+        with self._lock:
+            if self.error is not None:
+                raise RuntimeError(f"scheduler dead: {self.error}")
+            uid = self.batcher.submit(ids, max_tokens,
+                                      temperature=temperature,
+                                      eos_id=self.tok.eos_id)
+            self._streams[uid] = q
+            self._stream_seen[uid] = 0
+
+        def chunks():
+            while True:
+                try:
+                    kind, payload = q.get(timeout=timeout_s)
+                except queue_mod.Empty:
+                    self.abandon_stream(uid)
+                    raise TimeoutError(
+                        f"request {uid} produced no chunk for {timeout_s}s")
+                if kind == "tokens":
+                    yield payload, None
+                elif kind == "done":
+                    yield [], payload
+                    return
+                else:  # "error"
+                    raise RuntimeError(f"scheduler dead: {payload}")
+
+        return uid, chunks()
+
+    def abandon_stream(self, uid: int) -> None:
+        """Stop tracking a streaming request whose consumer went away
+        (client disconnect, chunk timeout): its eventual completion is
+        discarded instead of queueing chunks nobody reads."""
+        with self._lock:
+            self._streams.pop(uid, None)
+            self._stream_seen.pop(uid, None)
+            self._abandoned.add(uid)
+
     def stats(self) -> dict:
         # Snapshot WITHOUT the step lock: the counters are plain ints
         # mutated only by the scheduler thread, and a liveness probe must
@@ -173,16 +242,78 @@ def make_handler(service: BatcherService):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                out = service.complete(
-                    str(req["prompt"]),
-                    int(req.get("max_tokens", service.max_new_default)),
-                    float(req.get("temperature", 0.0)),
-                )
+                prompt = str(req["prompt"])
+                max_tokens = int(req.get("max_tokens",
+                                         service.max_new_default))
+                temperature = float(req.get("temperature", 0.0))
+                if req.get("stream"):
+                    # eager submit: validation errors raise BEFORE any
+                    # headers go out, so they get a clean 400/503
+                    uid, chunks = service.stream(prompt, max_tokens,
+                                                 temperature)
+                    self._stream_sse(uid, chunks)
+                    return
+                out = service.complete(prompt, max_tokens, temperature)
                 self._send(200, out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
             except (TimeoutError, RuntimeError) as e:
                 self._send(503, {"error": str(e)})
+
+        def _stream_sse(self, uid, chunks):
+            """Server-sent events: one `data:` chunk per decode tick with
+            the TEXT DELTA. Deltas come from re-decoding ALL tokens so
+            far and holding back trailing replacement chars (an
+            incomplete multi-byte sequence decodes to U+FFFD until its
+            continuation bytes arrive — emitting it early would corrupt
+            the stream); held-back chars flush at completion, when
+            genuinely-invalid bytes are known to be final. Ends with a
+            finish_reason chunk then `data: [DONE]`. Mid-stream errors
+            become an SSE `error` event (the 200 already went out);
+            client disconnects abandon the request in the batcher.
+            """
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()  # close-delimited body (HTTP/1.0 default)
+
+            def emit(obj):
+                self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+                self.wfile.flush()
+
+            acc: list[int] = []
+            sent_text = ""
+            stopped = False
+            try:
+                for toks, comp in chunks:
+                    if not stopped and toks:
+                        acc.extend(toks)
+                        if service.tok.eos_id in acc:
+                            acc = acc[: acc.index(service.tok.eos_id)]
+                            stopped = True
+                        text = service.tok.decode(acc)
+                        stable = (text if stopped
+                                  else text.rstrip("\ufffd"))
+                        if len(stable) > len(sent_text):
+                            emit({"delta": stable[len(sent_text):]})
+                            sent_text = stable
+                    if comp is not None:
+                        final = service.tok.decode(acc)
+                        tail = final[len(sent_text):]
+                        emit({"delta": tail,
+                              "finish_reason": comp.finish_reason,
+                              "usage": {
+                                  "prompt_tokens": len(comp.prompt),
+                                  "completion_tokens": len(comp.tokens)}})
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except OSError:  # client went away mid-stream
+                service.abandon_stream(uid)
+            except (TimeoutError, RuntimeError) as e:
+                try:
+                    emit({"error": str(e)})
+                except OSError:
+                    service.abandon_stream(uid)
 
     return Handler
 
