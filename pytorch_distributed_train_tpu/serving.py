@@ -39,6 +39,8 @@ import numpy as np
 
 from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
 from pytorch_distributed_train_tpu.generate import (
+    _cache_shapes,
+    _cache_shardings,
     _decode_step,
     build_decode_model,
     filter_logits,
@@ -166,13 +168,30 @@ class ContinuousBatcher:
 
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,
-                 top_p: float = 0.0, rng=None, min_bucket: int = 16):
+                 top_p: float = 0.0, rng=None, min_bucket: int = 16,
+                 mesh=None):
         self._init_common(params, slots, top_k, top_p, rng)
+        self.mesh = mesh
         self.model = build_serving_model(model_cfg, precision)
-        self.cache = init_cache(self.model, slots)
+        self.cache = self._alloc_cache(slots)
         self.max_seq_len = self.model.max_seq_len
         self._build_buckets(self.max_seq_len, min_bucket)
         self._init_slot_state(slots)
+
+    def _alloc_cache(self, batch: int):
+        """Zeroed KV cache for ``batch`` rows — allocated DIRECTLY into
+        its mesh layout under multi-chip serving (``mesh=``: params came
+        from generate.shard_decode_params; cache heads live beside their
+        q/k/v columns on 'tensor', same as generate(mesh=)). GSPMD then
+        propagates the layouts through the unchanged jitted steps."""
+        if self.mesh is None:
+            return init_cache(self.model, batch)
+        # device_put, not a per-call jit: a fresh jit(lambda) here would
+        # retrace+recompile on EVERY admission (jit caches key on the
+        # function object) — admission must stay compile-free steady-state
+        shapes = _cache_shapes(self.model, batch)
+        zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        return jax.device_put(zeros, _cache_shardings(self.mesh, shapes))
 
     def _init_common(self, params, slots, top_k, top_p, rng) -> None:
         self.params = params
@@ -239,7 +258,7 @@ class ContinuousBatcher:
         P = self._bucket(len(req.prompt))
         ids = np.zeros((1, P), np.int32)
         ids[0, : len(req.prompt)] = req.prompt
-        row_cache = init_cache(self.model, 1)
+        row_cache = self._alloc_cache(1)
         last, row_cache = _prefill_step(
             self.model, self.params, row_cache, jnp.asarray(ids),
             jnp.asarray([len(req.prompt)], jnp.int32))

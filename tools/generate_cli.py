@@ -99,10 +99,10 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--num-beams with --tp is unsupported (beam search "
                 "drives the single-device step)")
-        if args.serve_slots > 0 and (args.num_beams >= 1 or args.tp > 1):
+        if args.serve_slots > 0 and args.num_beams >= 1:
             raise ValueError(
                 "--serve-slots is continuous batching; it composes with "
-                "sampling flags but not --num-beams/--tp")
+                "sampling flags and --tp but not --num-beams")
         from pytorch_distributed_train_tpu.serving import (
             load_params_for_serving,
         )
@@ -167,10 +167,22 @@ def main(argv=None) -> int:
                 ContinuousBatcher,
             )
 
+            serve_mesh = None
+            if args.tp > 1:
+                from pytorch_distributed_train_tpu.config import MeshConfig
+                from pytorch_distributed_train_tpu.parallel.mesh import (
+                    build_mesh,
+                )
+
+                serve_mesh = build_mesh(
+                    MeshConfig(tensor=args.tp, data=1, fsdp=1))
+                params = shard_decode_params(model_cfg.name, serve_mesh,
+                                             params)
             b = ContinuousBatcher(
                 model_cfg, cfg.precision, params,
                 slots=args.serve_slots, top_k=args.top_k,
-                top_p=args.top_p, rng=jax.random.PRNGKey(args.seed))
+                top_p=args.top_p, rng=jax.random.PRNGKey(args.seed),
+                mesh=serve_mesh)
             uid_to_i = {}
             for i, e in enumerate(encoded):
                 uid_to_i[b.submit(e, args.max_new_tokens,

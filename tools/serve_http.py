@@ -193,9 +193,13 @@ class BatcherService:
     def abandon_stream(self, uid: int) -> None:
         """Stop tracking a streaming request whose consumer went away
         (client disconnect, chunk timeout): its eventual completion is
-        discarded instead of queueing chunks nobody reads."""
+        discarded instead of queueing chunks nobody reads. A no-op once
+        the request already finished (the scheduler popped its stream) —
+        marking it abandoned then would leak the set entry forever, since
+        its uid never appears in a finished list again."""
         with self._lock:
-            self._streams.pop(uid, None)
+            if self._streams.pop(uid, None) is None:
+                return
             self._stream_seen.pop(uid, None)
             self._abandoned.add(uid)
 
