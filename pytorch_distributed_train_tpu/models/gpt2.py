@@ -61,10 +61,9 @@ class GPT2Attention(nn.Module):
                                 (B, L, self.num_heads, head_dim), k.dtype)
             c_v = self.variable("cache", "cached_value", jnp.zeros,
                                 (B, L, self.num_heads, head_dim), v.dtype)
-            if self.decode_rows and self.decode_multi:
-                raise ValueError(
-                    "decode_rows and decode_multi are mutually exclusive "
-                    "(speculative decoding runs scalar-index caches)")
+            # decode_rows + decode_multi = MULTI-TOKEN rows continuation
+            # (serving.py session resume ingests a whole user turn at each
+            # row's offset); plain decode_rows steps are its S=1 case.
             idx_shape = (B,) if self.decode_rows else ()
             c_i = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros(idx_shape, jnp.int32))

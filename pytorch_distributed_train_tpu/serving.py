@@ -118,6 +118,26 @@ def _insert_row(big_cache, row_cache, r, true_len):
     return jax.tree.map(one, big_cache, row_cache)
 
 
+@jax.jit
+def _gather_row(big_cache, r):
+    """Extract slot ``r`` as a B=1 cache tree (the inverse of
+    _insert_row) — session resume runs its multi-token continuation on
+    the extracted row, then scatters it back."""
+    return jax.tree.map(
+        lambda x: jax.lax.dynamic_slice_in_dim(x, r, 1, 0), big_cache)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _set_row_index(row_cache, pos):
+    """Pin a B=1 cache's position counters (cache_index, gpt2's
+    pos_index) to ``pos``: a PARKED row's counters free-ran while other
+    slots decoded (its garbage writes stay masked/overwritten — see
+    ContinuousBatcher session notes), so resume re-anchors them before
+    ingesting the next turn."""
+    return jax.tree.map(
+        lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
+
+
 @partial(jax.jit, static_argnums=(3, 4))
 def _sample_rows(logits, rng, temperature, top_k: int, top_p: float):
     """Per-row sampling: rows with temperature 0 are greedy, others sample
@@ -136,6 +156,8 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     eos_id: int | None = None
+    keep: bool = False          # park the slot on finish (chat sessions)
+    session: int | None = None  # continue a parked session's cache
 
 
 @dataclasses.dataclass
@@ -144,6 +166,10 @@ class Completion:
     prompt: list[int]
     tokens: list[int]  # generated continuation (includes eos if emitted)
     finish_reason: str  # "eos" | "length"
+    # Session handle when the request ran with keep=True: pass as
+    # submit(session=...) to continue this conversation from its resident
+    # KV cache (no re-prefill of the earlier turns).
+    session: int | None = None
 
 
 class ContinuousBatcher:
@@ -166,6 +192,8 @@ class ContinuousBatcher:
     are batcher-wide).
     """
 
+    supports_sessions = True  # multi-turn KV reuse (causal families)
+
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,
                  top_p: float = 0.0, rng=None, min_bucket: int = 16,
@@ -173,6 +201,9 @@ class ContinuousBatcher:
         self._init_common(params, slots, top_k, top_p, rng)
         self.mesh = mesh
         self.model = build_serving_model(model_cfg, precision)
+        # session resume ingests multi-token turns at per-row offsets
+        self._model_multi = dataclasses.replace(self.model,
+                                                decode_multi=True)
         self.cache = self._alloc_cache(slots)
         self.max_seq_len = self.model.max_seq_len
         self._build_buckets(self.max_seq_len, min_bucket)
@@ -218,12 +249,22 @@ class ContinuousBatcher:
         self._generated: list[list[int]] = [[] for _ in range(slots)]
         self._pending = np.zeros(slots, np.int32)  # next input token per slot
         self._temp = np.zeros(slots, np.float32)
-        self.stats = {"steps": 0, "prefills": 0, "generated_tokens": 0,
-                      "slot_token_slots": 0}
+        self._pos = np.zeros(slots, np.int64)  # tokens INGESTED per slot
+        # parked chat sessions: sid -> (slot, ingested pos, last token).
+        # A parked row's K/V stays resident while other slots decode: its
+        # counters free-run and each step writes ONE garbage K/V at its
+        # running offset, but every such position is beyond the pinned
+        # resume index (masked) and is overwritten by real tokens before
+        # the mask ever exposes it — same discipline as dead rows.
+        self._parked: dict[int, tuple[int, int, int]] = {}
+        self._parked_slots: set[int] = set()
+        self.stats = {"steps": 0, "prefills": 0, "resumes": 0,
+                      "generated_tokens": 0, "slot_token_slots": 0}
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, *,
-               temperature: float = 0.0, eos_id: int | None = None) -> int:
+               temperature: float = 0.0, eos_id: int | None = None,
+               keep: bool = False, session: int | None = None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -231,11 +272,28 @@ class ContinuousBatcher:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 "(admission always samples the first continuation token)")
-        self._check_request(len(prompt), max_new_tokens)
+        if (keep or session is not None) and not self.supports_sessions:
+            raise ValueError(
+                f"{type(self).__name__} does not support chat sessions")
+        if session is not None:
+            if session not in self._parked:
+                raise ValueError(
+                    f"unknown session {session} (never kept, already "
+                    "resumed, or evicted under slot pressure)")
+            _, pos, _ = self._parked[session]
+            # resume ingests [last unconsumed token] + prompt
+            if pos + 1 + len(prompt) + max_new_tokens > self.max_seq_len:
+                raise ValueError(
+                    f"session at position {pos} + turn ({len(prompt)}) + "
+                    f"max_new_tokens ({max_new_tokens}) exceeds "
+                    f"max_seq_len ({self.max_seq_len})")
+        else:
+            self._check_request(len(prompt), max_new_tokens)
         uid = self._next_uid
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, max_new_tokens,
-                                  temperature, eos_id))
+                                  temperature, eos_id, keep=keep,
+                                  session=session))
         return uid
 
     def _check_request(self, prompt_len: int, max_new_tokens: int) -> None:
@@ -265,16 +323,55 @@ class ContinuousBatcher:
         self.cache = _insert_row(
             self.cache, row_cache, jnp.int32(r),
             jnp.int32(len(req.prompt)))
+        self.stats["prefills"] += 1
+        return self._start_slot(r, req, len(req.prompt), last)
+
+    def _admit_resume(self, req: Request) -> Completion | None:
+        """Continue a parked session in ITS OWN slot: extract the row,
+        pin its free-ran counters back to the parked position, ingest
+        [last unconsumed token] + the new turn in one bucketed
+        multi-token continuation, scatter back."""
+        r, pos, last_tok = self._parked.pop(req.session)
+        self._parked_slots.discard(r)
+        turn = [last_tok] + req.prompt
+        T = len(turn)
+        Tb = self._bucket(T)
+        if pos + Tb > self.max_seq_len:
+            # exact-fit tail pad instead of the power-of-two bucket: the
+            # vmap'd dynamic_update_slice would CLAMP an overhanging
+            # write, shifting real tokens. (Rare — only near context end;
+            # costs one extra compile per distinct tail length.)
+            Tb = self.max_seq_len - pos
+        ids = np.zeros((1, Tb), np.int32)
+        ids[0, :T] = turn
+        row = _gather_row(self.cache, jnp.int32(r))
+        row = _set_row_index(row, jnp.int32(pos))
+        # _prefill_step doubles as the continuation executable: the
+        # static model arg (decode_multi twin) keys a separate compile
+        # that appends at the row's offset instead of position 0.
+        last, row = _prefill_step(
+            self._model_multi, self.params, row, jnp.asarray(ids),
+            jnp.asarray([T], jnp.int32))
+        self.cache = _insert_row(self.cache, row, jnp.int32(r),
+                                 jnp.int32(pos + T))
+        self.stats["resumes"] += 1
+        return self._start_slot(r, req, pos + T, last)
+
+    def _start_slot(self, r: int, req: Request, pos: int,
+                    last_logits) -> Completion | None:
+        """Shared admission tail: sample the first token and activate the
+        slot; returns a Completion iff that token already finishes."""
         self.rng, step_rng = jax.random.split(self.rng)
         first = int(_sample_rows(
-            last, step_rng, jnp.asarray([req.temperature], jnp.float32),
+            last_logits, step_rng,
+            jnp.asarray([req.temperature], jnp.float32),
             self.top_k, self.top_p)[0])
-        self.stats["prefills"] += 1
         self.stats["generated_tokens"] += 1
         self._req[r] = req
         self._generated[r] = [first]
         self._pending[r] = first
         self._temp[r] = req.temperature
+        self._pos[r] = pos
         return self._maybe_finish(r, first)
 
     def _maybe_finish(self, r: int, token: int) -> Completion | None:
@@ -284,8 +381,30 @@ class ContinuousBatcher:
         if not (done_eos or done_len):
             return None
         self._req[r] = None  # slot free; cache row is dead until re-admit
+        session = None
+        if req.keep:
+            # Park: the conversation's K/V stays resident. The LAST
+            # sampled token was never fed back (its K/V is not in the
+            # cache), so it rides in the parked tuple and is prepended to
+            # the next turn at resume.
+            session = req.uid
+            self._parked[session] = (r, int(self._pos[r]),
+                                     self._generated[r][-1])
+            self._parked_slots.add(r)
         return Completion(req.uid, req.prompt, self._generated[r],
-                          "eos" if done_eos else "length")
+                          "eos" if done_eos else "length", session=session)
+
+    def _evict_lru_parked(self) -> int | None:
+        """Free the oldest parked slot not referenced by a queued resume;
+        its session dies (a later submit(session=) raises). Returns the
+        freed slot, or None if every parked session has a pending resume."""
+        queued = {q.session for q in self.queue if q.session is not None}
+        for sid in self._parked:  # insertion order == park order (LRU)
+            if sid not in queued:
+                r, _, _ = self._parked.pop(sid)
+                self._parked_slots.discard(r)
+                return r
+        return None
 
     def new_tokens_since(self, seen: dict[int, int]) -> dict[int, list[int]]:
         """uid -> ids generated beyond seen[uid], for every ACTIVE slot
@@ -311,15 +430,44 @@ class ContinuousBatcher:
     def active_slots(self) -> list[int]:
         return [r for r in range(self.slots) if self._req[r] is not None]
 
-    def step(self) -> list[Completion]:
-        """One scheduler quantum: admit into free slots, then one batched
-        decode step advancing every active slot by one token."""
-        finished: list[Completion] = []
+    def _free_slot(self) -> int | None:
         for r in range(self.slots):
-            if self._req[r] is None and self.queue:
-                done = self._admit(r, self.queue.popleft())
-                if done is not None:
-                    finished.append(done)
+            if self._req[r] is None and r not in self._parked_slots:
+                return r
+        return self._evict_lru_parked()
+
+    def step(self) -> list[Completion]:
+        """One scheduler quantum: admit ALL queued session resumes (their
+        slots are reserved — a capacity-blocked fresh request at the
+        queue head must not starve them into a livelock), then fresh
+        requests into free slots (evicting the LRU parked session under
+        pressure), then one batched decode step advancing every active
+        slot by one token."""
+        finished: list[Completion] = []
+        fresh: deque[Request] = deque()
+        while self.queue:
+            req = self.queue.popleft()
+            if req.session is None:
+                fresh.append(req)
+                continue
+            if req.session not in self._parked:
+                # evicted between submit and admission (extreme slot
+                # pressure): surface as a failed completion rather
+                # than raising inside the scheduler
+                finished.append(Completion(
+                    req.uid, req.prompt, [], "session_evicted"))
+                continue
+            done = self._admit_resume(req)
+            if done is not None:
+                finished.append(done)
+        self.queue = fresh
+        while self.queue:
+            r = self._free_slot()
+            if r is None:
+                break  # every slot active or resume-reserved
+            done = self._admit(r, self.queue.popleft())
+            if done is not None:
+                finished.append(done)
         active = self.active_slots
         if not active:
             return finished
@@ -338,6 +486,7 @@ class ContinuousBatcher:
             tok = int(nxt[r])
             self._generated[r].append(tok)
             self._pending[r] = tok
+            self._pos[r] += 1  # the fed token's K/V is now in the cache
             self.stats["generated_tokens"] += 1
             done = self._maybe_finish(r, tok)
             if done is not None:
@@ -378,6 +527,8 @@ class Seq2SeqContinuousBatcher(ContinuousBatcher):
     conventions by default: the decoder starts from pad id 0; pass
     ``eos_id=1`` per request to stop at T5's EOS.
     """
+
+    supports_sessions = False  # the decoder restarts per request
 
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,

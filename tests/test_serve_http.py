@@ -159,3 +159,27 @@ def test_streaming_matches_non_streamed(server):
     assert final["usage"] == plain["usage"]
     # genuinely incremental: more than one delta chunk for 8 tokens
     assert sum(1 for c in chunks if c.get("delta")) > 1
+
+
+def test_http_chat_session_two_turns(server):
+    """keep=true returns a session id; posting it continues the
+    conversation from the resident cache and matches the lockstep run on
+    the concatenated history."""
+    port, cfg, params, tok = server
+    t1, t2 = "first turn text", " and the second turn"
+    _, out1 = _post(port, {"prompt": t1, "max_tokens": 6, "keep": True})
+    assert out1["session"] is not None
+    _, out2 = _post(port, {"prompt": t2, "max_tokens": 6,
+                           "session": out1["session"]})
+
+    dm = build_decode_model(cfg, PrecisionConfig())
+    ids1 = tok.encode(t1)
+    ref1 = generate(dm, params, jnp.asarray([ids1], jnp.int32), 6,
+                    eos_id=tok.eos_id)
+    hist = [int(t) for t in np.asarray(ref1)[0]] + tok.encode(t2)
+    ref2 = generate(dm, params, jnp.asarray([hist], jnp.int32), 6,
+                    eos_id=tok.eos_id)
+    new = [int(t) for t in np.asarray(ref2)[0, len(hist):]]
+    if tok.eos_id in new:
+        new = new[: new.index(tok.eos_id)]
+    assert out2["text"] == tok.decode(new)

@@ -257,3 +257,98 @@ def test_tensor_parallel_serving_matches_single_device(setup):
     for uid, p in zip(uids, prompts):
         assert done[uid].tokens == _reference(cfg, params, p, 5), \
             "TP serving diverged from single-device"
+
+
+# --------------------------------------------------------- chat sessions
+
+def test_session_resume_matches_full_conversation(setup):
+    """The multi-turn anchor: turn 2 resumed from a parked session must
+    produce EXACTLY what lockstep generate() produces on the whole
+    concatenated conversation — the parked K/V (which free-ran through
+    other slots' steps between turns) is bit-equivalent to a fresh
+    prefill of the full history."""
+    cfg, params = setup
+    turn1, turn2 = [7, 3, 9, 2], [11, 5, 6]
+    k1, k2 = 5, 6
+
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=3)
+    u1 = b.submit(turn1, k1, keep=True)
+    done = {c.uid: c for c in b.run()}
+    sid = done[u1].session
+    assert sid is not None
+    gen1 = done[u1].tokens
+
+    # churn the batcher between turns: other requests decode while the
+    # session sits parked (its counters free-run; resume must not care)
+    b.submit([1, 2, 3], 7)
+    b.submit([4, 4, 4, 4, 4, 4, 4, 4], 4)
+    list(b.run())
+
+    u2 = b.submit(turn2, k2, session=sid)
+    done2 = {c.uid: c for c in b.run()}
+    gen2 = done2[u2].tokens
+
+    full_prompt = turn1 + gen1 + turn2
+    assert gen2 == _reference(cfg, params, full_prompt, k2), \
+        "session resume diverged from full-conversation lockstep"
+
+
+def test_session_chained_turns(setup):
+    """Three turns chained keep->resume->resume, checked against the
+    full conversation each time."""
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    history = [9, 1, 4]
+    sid = None
+    for i, (turn, k) in enumerate([(None, 4), ([2, 8], 3), ([5], 4)]):
+        prompt = history if sid is None else turn
+        uid = b.submit(prompt, k, keep=True, session=sid)
+        done = {c.uid: c for c in b.run()}
+        gen = done[uid].tokens
+        sid = done[uid].session
+        if turn is not None:
+            history = history + turn
+        assert gen == _reference(cfg, params, history, k), f"turn {i}"
+        history = history + gen
+
+
+def test_session_eviction_under_slot_pressure(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u1 = b.submit([1, 2], 2, keep=True)
+    done = {c.uid: c for c in b.run()}
+    sid = done[u1].session
+    # a fresh request needs the only slot -> the parked session evicts
+    u2 = b.submit([3, 4, 5], 2)
+    done = {c.uid: c for c in b.run()}
+    assert done[u2].finish_reason == "length"
+    with pytest.raises(ValueError, match="unknown session"):
+        b.submit([6], 2, session=sid)
+
+
+def test_t5_batcher_refuses_sessions(t5_setup):
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    cfg, params = t5_setup
+    b = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    with pytest.raises(ValueError, match="sessions"):
+        b.submit([1, 2], 3, keep=True)
+
+
+def test_no_livelock_fresh_head_blocks_behind_parked_resume(setup):
+    """slots=1: a fresh request queued AHEAD of a resume for the only
+    (parked) slot must not livelock the scheduler — the resume admits
+    first (its slot is reserved), then the fresh request takes over."""
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u1 = b.submit([1, 2], 2, keep=True)
+    done = {c.uid: c for c in b.run()}
+    sid = done[u1].session
+    uf = b.submit([3, 4, 5], 2)          # fresh, queue head
+    ur = b.submit([6], 2, session=sid)   # resume behind it
+    done = {c.uid: c for c in b.run()}
+    assert set(done) == {uf, ur}
+    assert done[ur].finish_reason == "length"
+    assert done[uf].finish_reason == "length"

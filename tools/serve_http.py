@@ -12,8 +12,14 @@ server, scoped to stdlib http.server: zero extra dependencies).
         "max_tokens": 32, "temperature": 0.7}'
 
 API (JSON over POST, one object per request):
-- ``POST /v1/completions``: {prompt, max_tokens?, temperature?} →
-  {text, finish_reason, usage:{prompt_tokens, completion_tokens}}.
+- ``POST /v1/completions``: {prompt, max_tokens?, temperature?, keep?,
+  session?} → {text, finish_reason, session,
+  usage:{prompt_tokens, completion_tokens}}. ``keep: true`` parks the
+  request's KV cache and returns a ``session`` id; posting that id as
+  ``session`` continues the conversation from the resident cache (the
+  prompt is then just the NEW turn — no resend of history). Sessions
+  evict LRU under slot pressure (a resume then 404s in-band with
+  finish_reason "session_evicted").
   ``top_k``/``top_p`` are SERVER-wide flags (static jit args — per-request
   values would recompile; temperature is the per-request knob).
 - ``GET /healthz``: {status, stats} — liveness + batcher counters.
@@ -111,7 +117,8 @@ class BatcherService:
         return self.error is None and self._thread.is_alive()
 
     def complete(self, prompt: str, max_tokens: int, temperature: float,
-                 timeout_s: float = 600.0) -> dict:
+                 timeout_s: float = 600.0, *, keep: bool = False,
+                 session: int | None = None) -> dict:
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
@@ -124,7 +131,8 @@ class BatcherService:
                 raise RuntimeError(f"scheduler dead: {self.error}")
             uid = self.batcher.submit(ids, max_tokens,
                                       temperature=temperature,
-                                      eos_id=self.tok.eos_id)
+                                      eos_id=self.tok.eos_id,
+                                      keep=keep, session=session)
             self._events[uid] = ev
         timed_out = not ev.wait(timeout_s)
         with self._lock:
@@ -147,6 +155,7 @@ class BatcherService:
         return {
             "text": self.tok.decode(new),
             "finish_reason": c.finish_reason,
+            "session": c.session,
             "usage": {"prompt_tokens": len(ids),
                       "completion_tokens": len(c.tokens)},
         }
@@ -250,14 +259,22 @@ def make_handler(service: BatcherService):
                 max_tokens = int(req.get("max_tokens",
                                          service.max_new_default))
                 temperature = float(req.get("temperature", 0.0))
+                keep = bool(req.get("keep", False))
+                session = req.get("session")
+                session = int(session) if session is not None else None
                 if req.get("stream"):
+                    if keep or session is not None:
+                        raise ValueError(
+                            "sessions compose with non-streamed "
+                            "completions only (for now)")
                     # eager submit: validation errors raise BEFORE any
                     # headers go out, so they get a clean 400/503
                     uid, chunks = service.stream(prompt, max_tokens,
                                                  temperature)
                     self._stream_sse(uid, chunks)
                     return
-                out = service.complete(prompt, max_tokens, temperature)
+                out = service.complete(prompt, max_tokens, temperature,
+                                       keep=keep, session=session)
                 self._send(200, out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
