@@ -51,6 +51,35 @@ def server():
     service.shutdown()
 
 
+
+
+def _sse_chunks(port, body, timeout=300):
+    """POST a streaming request; return (raw, parsed data chunks)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        raw = r.read().decode()
+    chunks = [json.loads(line[len("data: "):])
+              for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    return raw, chunks
+
+
+def _lockstep_text(cfg, params, tok, prompt_ids, n):
+    """Greedy lockstep continuation of token ids, eos-trimmed, decoded —
+    the single reference all HTTP tests compare against."""
+    dm = build_decode_model(cfg, PrecisionConfig())
+    out = generate(dm, params, jnp.asarray([prompt_ids], jnp.int32), n,
+                   eos_id=tok.eos_id)
+    new = [int(t) for t in np.asarray(out)[0, len(prompt_ids):]]
+    if tok.eos_id in new:
+        new = new[: new.index(tok.eos_id)]
+    return tok.decode(new), [int(t) for t in np.asarray(out)[0]]
+
+
 def _post(port, obj, timeout=300):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/v1/completions",
@@ -75,16 +104,11 @@ def test_concurrent_completions_match_lockstep(server):
     for t in threads:
         t.join(timeout=300)
 
-    dm = build_decode_model(cfg, PrecisionConfig())
     for text, (status, out) in zip(prompts, results):
         assert status == 200
         ids = tok.encode(text)
-        ref = generate(dm, params, jnp.asarray([ids], jnp.int32), 8,
-                       eos_id=tok.eos_id)
-        new = [int(t) for t in np.asarray(ref)[0, len(ids):]]
-        if tok.eos_id in new:
-            new = new[: new.index(tok.eos_id)]
-        assert out["text"] == tok.decode(new), text
+        ref_text, _ = _lockstep_text(cfg, params, tok, ids, 8)
+        assert out["text"] == ref_text, text
         assert out["usage"]["prompt_tokens"] == len(ids)
 
 
@@ -140,17 +164,8 @@ def test_streaming_matches_non_streamed(server):
     prompt = "stream me please"
     _, plain = _post(port, {"prompt": prompt, "max_tokens": 8})
 
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/v1/completions",
-        data=json.dumps({"prompt": prompt, "max_tokens": 8,
-                         "stream": True}).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=300) as r:
-        assert r.headers["Content-Type"] == "text/event-stream"
-        raw = r.read().decode()
-    chunks = [json.loads(line[len("data: "):])
-              for line in raw.splitlines()
-              if line.startswith("data: ") and line != "data: [DONE]"]
+    raw, chunks = _sse_chunks(port, {"prompt": prompt, "max_tokens": 8,
+                                     "stream": True})
     assert raw.rstrip().endswith("data: [DONE]")
     text = "".join(c.get("delta", "") for c in chunks)
     assert text == plain["text"]
@@ -172,14 +187,26 @@ def test_http_chat_session_two_turns(server):
     _, out2 = _post(port, {"prompt": t2, "max_tokens": 6,
                            "session": out1["session"]})
 
-    dm = build_decode_model(cfg, PrecisionConfig())
-    ids1 = tok.encode(t1)
-    ref1 = generate(dm, params, jnp.asarray([ids1], jnp.int32), 6,
-                    eos_id=tok.eos_id)
-    hist = [int(t) for t in np.asarray(ref1)[0]] + tok.encode(t2)
-    ref2 = generate(dm, params, jnp.asarray([hist], jnp.int32), 6,
-                    eos_id=tok.eos_id)
-    new = [int(t) for t in np.asarray(ref2)[0, len(hist):]]
-    if tok.eos_id in new:
-        new = new[: new.index(tok.eos_id)]
-    assert out2["text"] == tok.decode(new)
+    _, full1 = _lockstep_text(cfg, params, tok, tok.encode(t1), 6)
+    hist = full1 + tok.encode(t2)
+    ref_text, _ = _lockstep_text(cfg, params, tok, hist, 6)
+    assert out2["text"] == ref_text
+
+
+def test_streamed_session_turn_then_resume(server):
+    """Turn 1 streams with keep=true (session id arrives in the final SSE
+    chunk); turn 2 resumes non-streamed and matches the lockstep run on
+    the concatenated history."""
+    port, cfg, params, tok = server
+    t1, t2 = "chat: streamed opener", " followup"
+    _, chunks = _sse_chunks(port, {"prompt": t1, "max_tokens": 5,
+                                   "stream": True, "keep": True})
+    sid = chunks[-1]["session"]
+    assert sid is not None
+
+    _, out2 = _post(port, {"prompt": t2, "max_tokens": 5, "session": sid})
+
+    _, full1 = _lockstep_text(cfg, params, tok, tok.encode(t1), 5)
+    hist = full1 + tok.encode(t2)
+    ref_text, _ = _lockstep_text(cfg, params, tok, hist, 5)
+    assert out2["text"] == ref_text

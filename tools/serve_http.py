@@ -161,7 +161,8 @@ class BatcherService:
         }
 
     def stream(self, prompt: str, max_tokens: int, temperature: float,
-               timeout_s: float = 600.0):
+               timeout_s: float = 600.0, *, keep: bool = False,
+               session: int | None = None):
         """Returns (uid, chunk iterator). Validation and submission run
         EAGERLY (so callers can reject before committing to a response);
         the iterator yields (new_token_ids, completion_or_None) chunks as
@@ -177,7 +178,8 @@ class BatcherService:
                 raise RuntimeError(f"scheduler dead: {self.error}")
             uid = self.batcher.submit(ids, max_tokens,
                                       temperature=temperature,
-                                      eos_id=self.tok.eos_id)
+                                      eos_id=self.tok.eos_id,
+                                      keep=keep, session=session)
             self._streams[uid] = q
             self._stream_seen[uid] = 0
 
@@ -263,14 +265,11 @@ def make_handler(service: BatcherService):
                 session = req.get("session")
                 session = int(session) if session is not None else None
                 if req.get("stream"):
-                    if keep or session is not None:
-                        raise ValueError(
-                            "sessions compose with non-streamed "
-                            "completions only (for now)")
                     # eager submit: validation errors raise BEFORE any
                     # headers go out, so they get a clean 400/503
                     uid, chunks = service.stream(prompt, max_tokens,
-                                                 temperature)
+                                                 temperature, keep=keep,
+                                                 session=session)
                     self._stream_sse(uid, chunks)
                     return
                 out = service.complete(prompt, max_tokens, temperature,
@@ -323,6 +322,7 @@ def make_handler(service: BatcherService):
                         tail = final[len(sent_text):]
                         emit({"delta": tail,
                               "finish_reason": comp.finish_reason,
+                              "session": comp.session,
                               "usage": {
                                   "prompt_tokens": len(comp.prompt),
                                   "completion_tokens": len(comp.tokens)}})
