@@ -396,6 +396,8 @@ def serve_bench(args) -> None:
     from pytorch_distributed_train_tpu.models.registry import build_model
     from pytorch_distributed_train_tpu.serving import ContinuousBatcher
 
+    from pytorch_distributed_train_tpu import quant
+
     if args.model != "llama":
         raise SystemExit("--serve supports --model llama")
     n_req = args.serve
@@ -403,7 +405,11 @@ def serve_bench(args) -> None:
     dims = _llama_dims(args.tiny)
     p_lo, p_hi = (4, 12) if args.tiny else (32, 256)
     b_lo, b_hi = (2, 6) if args.tiny else (16, 96)
-    max_len = 32 if args.tiny else 512
+    turns = max(args.serve_turns, 1)
+    # chat workload: later turns are shorter than openers
+    t_lo, t_hi = (2, 6) if args.tiny else (16, 64)
+    max_len = (32 * turns if args.tiny
+               else min(4096, 512 * turns))
     model_cfg = ModelConfig(name="llama", **dims, max_seq_len=max_len,
                             attention_impl="xla")
     precision = PrecisionConfig(compute_dtype="bfloat16")
@@ -414,41 +420,109 @@ def serve_bench(args) -> None:
                                    jnp.zeros((1, 8), jnp.int32),
                                    train=False)["params"]
     )(jax.random.PRNGKey(0))
+    if args.quantize == "int8":
+        params = jax.jit(quant.quantize_tree)(params)
     _touch()
 
     rng = np.random.default_rng(0)
+    V = dims["vocab_size"]
     reqs = [(rng.integers(p_lo, p_hi + 1), rng.integers(b_lo, b_hi + 1))
             for _ in range(n_req)]
+    extra_turns = [[(rng.integers(t_lo, t_hi + 1),
+                     rng.integers(b_lo, b_hi + 1))
+                    for _ in range(turns - 1)] for _ in range(n_req)]
 
     def make_batcher():
         return ContinuousBatcher(model_cfg, precision, params, slots=slots)
 
-    # Warm every executable the timed run will hit: one short request per
-    # DISTINCT prefill bucket, plus the shared batched step. Executables
-    # cache across batchers (structurally equal static module args).
+    def run_workload(b) -> int:
+        """Drive the full (possibly multi-turn) workload; returns total
+        generated tokens. Multi-turn: sessions resume by default; with
+        --serve-resend each turn re-prefills the FULL history instead
+        (the no-session baseline the session arm is measured against)."""
+        conv_of_uid: dict[int, int] = {}
+        turn_of_conv = [0] * n_req
+        history = [list(rng.integers(0, V, int(reqs[i][0])))
+                   for i in range(n_req)]
+        for i in range(n_req):
+            uid = b.submit(history[i], int(reqs[i][1]),
+                           keep=turns > 1 and not args.serve_resend)
+            conv_of_uid[uid] = i
+        remaining = n_req * turns
+        while remaining:
+            for c in b.step():
+                i = conv_of_uid.pop(c.uid)
+                remaining -= 1
+                t = turn_of_conv[i] = turn_of_conv[i] + 1
+                if t >= turns:
+                    continue
+                n_turn, budget = extra_turns[i][t - 1]
+                turn_toks = list(rng.integers(0, V, int(n_turn)))
+                last = t >= turns - 1
+                if args.serve_resend:
+                    history[i] += c.tokens + turn_toks
+                    uid = b.submit(history[i], int(budget))
+                else:
+                    uid = b.submit(turn_toks, int(budget),
+                                   keep=not last, session=c.session)
+                conv_of_uid[uid] = i
+        return b.stats["generated_tokens"]
+
+    # Warm EXACTLY the executables the timed run will hit. The workload's
+    # submit lengths are deterministic a priori — every request
+    # length-finishes (no eos), so turn t's history is opener +
+    # sum(budgets + turn lengths so far) — which makes the prefill and
+    # resume bucket sets computable before running anything. Executables
+    # cache across batchers (structurally equal static module args), so
+    # compiles land here, not inside the timed A/B (which would skew the
+    # session-vs-resend comparison by unequal compile time).
+    prefill_lens, resume_lens = set(), set()
+    for i in range(n_req):
+        hist, budget = int(reqs[i][0]), int(reqs[i][1])
+        prefill_lens.add(hist)
+        for n_turn, next_budget in extra_turns[i]:
+            if args.serve_resend:
+                hist += budget + int(n_turn)
+                prefill_lens.add(hist)
+                budget = int(next_budget)
+            else:
+                resume_lens.add(1 + int(n_turn))
     warm = make_batcher()
-    for bucket in sorted({warm._bucket(int(n)) for n, _ in reqs}):
-        warm.submit(rng.integers(0, dims["vocab_size"], bucket), 2)
+    for bucket in sorted({warm._bucket(n) for n in prefill_lens}):
+        warm.submit(rng.integers(0, V, bucket), 2)
     list(warm.run())
+    if resume_lens:
+        # chain resumes on one parked session, one per DISTINCT resume
+        # bucket (turn length bucket-1 → ingest 1+len fills it exactly)
+        uid = warm.submit(rng.integers(0, V, 4), 2, keep=True)
+        for bucket in sorted({warm._bucket(n) for n in resume_lens}):
+            done = {c.uid: c for c in warm.run()}
+            uid = warm.submit(rng.integers(0, V, bucket - 1), 2,
+                              keep=True, session=done[uid].session)
+        list(warm.run())
     _disarm_watchdog()
 
     b = make_batcher()
-    for n, budget in reqs:
-        b.submit(rng.integers(0, dims["vocab_size"], int(n)), int(budget))
     t0 = time.perf_counter()
-    done = list(b.run())
+    total = run_workload(b)
     wall = time.perf_counter() - t0
-    assert len(done) == n_req
-    occupancy = (b.stats["generated_tokens"] - b.stats["prefills"]) / max(
-        b.stats["slot_token_slots"], 1)
-    suffix = "_tiny" if args.tiny else ""
+    occupancy = (b.stats["generated_tokens"] - b.stats["prefills"]
+                 - b.stats["resumes"]) / max(b.stats["slot_token_slots"], 1)
+    suffix = ("_int8" if args.quantize else "") + (
+        "_tiny" if args.tiny else "")
+    arm = ""
+    if turns > 1:
+        arm = "_chat_resend" if args.serve_resend else "_chat"
     print(json.dumps({
-        "metric": f"llama_serve{suffix}_tokens_per_sec_per_chip",
-        "value": round(b.stats["generated_tokens"] / wall, 2),
+        "metric": f"llama_serve{arm}{suffix}_tokens_per_sec_per_chip",
+        "value": round(total / wall, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": 1.0,
         "requests": n_req,
+        "turns": turns,
         "slots": slots,
+        "prefills": b.stats["prefills"],
+        "resumes": b.stats["resumes"],
         "occupancy": round(occupancy, 3),
     }))
 
@@ -569,6 +643,13 @@ def main() -> None:
                    help="llama only: continuous-batching serving bench — "
                         "drain N mixed-length requests through "
                         "--batch-per-chip slots (see serve_bench)")
+    p.add_argument("--serve-turns", type=int, default=1, metavar="T",
+                   help="with --serve: chat workload — each request is a "
+                        "T-turn conversation resumed via KV sessions")
+    p.add_argument("--serve-resend", action="store_true",
+                   help="with --serve-turns: re-prefill the FULL history "
+                        "each turn instead of resuming the session (the "
+                        "no-session baseline the session arm beats)")
     p.add_argument("--spec-self", action="store_true",
                    help="with --speculative: draft == target (acceptance-1 "
                         "machinery ceiling instead of the random-draft "
