@@ -158,6 +158,10 @@ class Request:
     eos_id: int | None = None
     keep: bool = False          # park the slot on finish (chat sessions)
     session: int | None = None  # continue a parked session's cache
+    # FORK a parked entry instead of consuming it: the request copies the
+    # parked row (shared-prefix cache — e.g. one preloaded system prompt
+    # serving many requests) into a free slot; the template survives.
+    prefix: int | None = None
 
 
 @dataclasses.dataclass
@@ -256,15 +260,17 @@ class ContinuousBatcher:
         # running offset, but every such position is beyond the pinned
         # resume index (masked) and is overwritten by real tokens before
         # the mask ever exposes it — same discipline as dead rows.
-        self._parked: dict[int, tuple[int, int, int]] = {}
+        self._parked: dict[int, tuple[int, int, int | None]] = {}
         self._parked_slots: set[int] = set()
         self.stats = {"steps": 0, "prefills": 0, "resumes": 0,
-                      "generated_tokens": 0, "slot_token_slots": 0}
+                      "forks": 0, "generated_tokens": 0,
+                      "slot_token_slots": 0}
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, eos_id: int | None = None,
-               keep: bool = False, session: int | None = None) -> int:
+               keep: bool = False, session: int | None = None,
+               prefix: int | None = None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -272,17 +278,23 @@ class ContinuousBatcher:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
                 "(admission always samples the first continuation token)")
-        if (keep or session is not None) and not self.supports_sessions:
+        if ((keep or session is not None or prefix is not None)
+                and not self.supports_sessions):
             raise ValueError(
                 f"{type(self).__name__} does not support chat sessions")
-        if session is not None:
-            if session not in self._parked:
+        if session is not None and prefix is not None:
+            raise ValueError("session= (consume) and prefix= (fork) are "
+                             "mutually exclusive")
+        ref = session if session is not None else prefix
+        if ref is not None:
+            if ref not in self._parked:
                 raise ValueError(
-                    f"unknown session {session} (never kept, already "
-                    "resumed, or evicted under slot pressure)")
-            _, pos, _ = self._parked[session]
-            # resume ingests [last unconsumed token] + prompt
-            if pos + 1 + len(prompt) + max_new_tokens > self.max_seq_len:
+                    f"unknown session {ref} (never kept/preloaded, "
+                    "already resumed, or evicted under slot pressure)")
+            _, pos, last_tok = self._parked[ref]
+            # continuation ingests [last unconsumed token +] prompt
+            extra = 0 if last_tok is None else 1
+            if pos + extra + len(prompt) + max_new_tokens > self.max_seq_len:
                 raise ValueError(
                     f"session at position {pos} + turn ({len(prompt)}) + "
                     f"max_new_tokens ({max_new_tokens}) exceeds "
@@ -293,8 +305,37 @@ class ContinuousBatcher:
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, max_new_tokens,
                                   temperature, eos_id, keep=keep,
-                                  session=session))
+                                  session=session, prefix=prefix))
         return uid
+
+    def preload(self, prompt) -> int:
+        '''Prefill ``prompt`` into a slot and park it WITHOUT
+        generating: a shared-prefix template (e.g. a system prompt).
+        Serve from it with ``submit(user_turn, n, prefix=sid)`` — each
+        such request FORKS the resident rows into its own slot, so one
+        preload amortizes across any number of requests. Consumes one
+        slot until evicted (LRU, like kept sessions).'''
+        if not self.supports_sessions:
+            raise ValueError(
+                f"{type(self).__name__} does not support chat sessions")
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds max_seq_len "
+                f"({self.max_seq_len})")
+        r = self._free_slot()
+        if r is None:
+            raise RuntimeError(
+                "no slot available for preload (all active or reserved "
+                "by sessions with queued continuations)")
+        self._prefill_into(r, prompt)
+        sid = self._next_uid
+        self._next_uid += 1
+        self._parked[sid] = (r, len(prompt), None)  # no unconsumed token
+        self._parked_slots.add(r)
+        return sid
 
     def _check_request(self, prompt_len: int, max_new_tokens: int) -> None:
         if prompt_len + max_new_tokens > self.max_seq_len:
@@ -310,30 +351,51 @@ class ContinuousBatcher:
         raise ValueError(f"prompt length {n} exceeds max bucket")
 
     # ---------------------------------------------------------- scheduler
-    def _admit(self, r: int, req: Request) -> Completion | None:
-        """Prefill ``req`` into slot ``r``; returns a Completion iff the
-        very first sampled token already finishes the request."""
-        P = self._bucket(len(req.prompt))
+    def _prefill_into(self, r: int, prompt: list[int]):
+        """Bucket-padded B=1 prefill scattered into slot ``r``; returns
+        the last-real-token logits. Shared by request admission and
+        template preloading."""
+        P = self._bucket(len(prompt))
         ids = np.zeros((1, P), np.int32)
-        ids[0, : len(req.prompt)] = req.prompt
+        ids[0, : len(prompt)] = prompt
         row_cache = self._alloc_cache(1)
         last, row_cache = _prefill_step(
             self.model, self.params, row_cache, jnp.asarray(ids),
-            jnp.asarray([len(req.prompt)], jnp.int32))
-        self.cache = _insert_row(
-            self.cache, row_cache, jnp.int32(r),
-            jnp.int32(len(req.prompt)))
+            jnp.asarray([len(prompt)], jnp.int32))
+        self.cache = _insert_row(self.cache, row_cache, jnp.int32(r),
+                                 jnp.int32(len(prompt)))
         self.stats["prefills"] += 1
+        return last
+
+    def _admit(self, r: int, req: Request) -> Completion | None:
+        """Prefill ``req`` into slot ``r``; returns a Completion iff the
+        very first sampled token already finishes the request."""
+        last = self._prefill_into(r, req.prompt)
         return self._start_slot(r, req, len(req.prompt), last)
 
     def _admit_resume(self, req: Request) -> Completion | None:
-        """Continue a parked session in ITS OWN slot: extract the row,
-        pin its free-ran counters back to the parked position, ingest
-        [last unconsumed token] + the new turn in one bucketed
-        multi-token continuation, scatter back."""
+        """Continue a parked session in ITS OWN slot (consuming the
+        parked entry)."""
         r, pos, last_tok = self._parked.pop(req.session)
         self._parked_slots.discard(r)
-        turn = [last_tok] + req.prompt
+        self.stats["resumes"] += 1
+        return self._continue_into(r, r, pos, last_tok, req)
+
+    def _admit_fork(self, r_target: int, req: Request) -> Completion | None:
+        """FORK a parked template (shared prefix) into a free slot: the
+        template row is read, not consumed — it keeps serving forks."""
+        r_src, pos, last_tok = self._parked[req.prefix]
+        self.stats["forks"] += 1
+        return self._continue_into(r_src, r_target, pos, last_tok, req)
+
+    def _continue_into(self, r_src: int, r_target: int, pos: int,
+                      last_tok: int | None,
+                      req: Request) -> Completion | None:
+        """Shared continuation: extract row ``r_src``, pin its free-ran
+        counters back to ``pos``, ingest [last unconsumed token +] the
+        new turn in one bucketed multi-token continuation, scatter into
+        ``r_target``."""
+        turn = ([] if last_tok is None else [last_tok]) + req.prompt
         T = len(turn)
         Tb = self._bucket(T)
         if pos + Tb > self.max_seq_len:
@@ -344,7 +406,7 @@ class ContinuousBatcher:
             Tb = self.max_seq_len - pos
         ids = np.zeros((1, Tb), np.int32)
         ids[0, :T] = turn
-        row = _gather_row(self.cache, jnp.int32(r))
+        row = _gather_row(self.cache, jnp.int32(r_src))
         row = _set_row_index(row, jnp.int32(pos))
         # _prefill_step doubles as the continuation executable: the
         # static model arg (decode_multi twin) keys a separate compile
@@ -352,10 +414,9 @@ class ContinuousBatcher:
         last, row = _prefill_step(
             self._model_multi, self.params, row, jnp.asarray(ids),
             jnp.asarray([T], jnp.int32))
-        self.cache = _insert_row(self.cache, row, jnp.int32(r),
+        self.cache = _insert_row(self.cache, row, jnp.int32(r_target),
                                  jnp.int32(pos + T))
-        self.stats["resumes"] += 1
-        return self._start_slot(r, req, pos + T, last)
+        return self._start_slot(r_target, req, pos + T, last)
 
     def _start_slot(self, r: int, req: Request, pos: int,
                     last_logits) -> Completion | None:
@@ -394,13 +455,20 @@ class ContinuousBatcher:
         return Completion(req.uid, req.prompt, self._generated[r],
                           "eos" if done_eos else "length", session=session)
 
-    def _evict_lru_parked(self) -> int | None:
-        """Free the oldest parked slot not referenced by a queued resume;
-        its session dies (a later submit(session=) raises). Returns the
-        freed slot, or None if every parked session has a pending resume."""
+    def _evict_lru_parked(self, force: bool = False) -> int | None:
+        """Free the oldest parked slot not referenced by a queued
+        resume/fork; its session dies (a later submit(session=) raises).
+        Returns the freed slot, or None if every parked session has a
+        pending continuation. ``force`` drops the protection — the
+        DEADLOCK breaker for when nothing is active and every slot is a
+        protected template (e.g. slots=1 with a queued fork of the only
+        template: the fork needs a second slot that can never appear);
+        the sacrificed session's queued continuations then surface as
+        session_evicted completions instead of hanging forever."""
         queued = {q.session for q in self.queue if q.session is not None}
-        for sid in self._parked:  # insertion order == park order (LRU)
-            if sid not in queued:
+        queued |= {q.prefix for q in self.queue if q.prefix is not None}
+        for sid in list(self._parked):  # insertion order == LRU
+            if force or sid not in queued:
                 r, _, _ = self._parked.pop(sid)
                 self._parked_slots.discard(r)
                 return r
@@ -462,10 +530,30 @@ class ContinuousBatcher:
                 finished.append(done)
         self.queue = fresh
         while self.queue:
+            req = self.queue[0]
+            if req.prefix is not None and req.prefix not in self._parked:
+                # template evicted between submit and admission
+                self.queue.popleft()
+                finished.append(Completion(
+                    req.uid, req.prompt, [], "session_evicted"))
+                continue
             r = self._free_slot()
+            if r is None and not self.active_slots:
+                # nothing is decoding, so no slot will EVER drain:
+                # sacrifice a protected template rather than deadlock
+                r = self._evict_lru_parked(force=True)
             if r is None:
                 break  # every slot active or resume-reserved
-            done = self._admit(r, self.queue.popleft())
+            self.queue.popleft()
+            if req.prefix is not None and req.prefix not in self._parked:
+                # the force-eviction above sacrificed THIS fork's own
+                # template (slots=1 case: the fork could never get a
+                # second slot anyway) — surface, don't KeyError
+                finished.append(Completion(
+                    req.uid, req.prompt, [], "session_evicted"))
+                continue
+            done = (self._admit_fork(r, req) if req.prefix is not None
+                    else self._admit(r, req))
             if done is not None:
                 finished.append(done)
         active = self.active_slots

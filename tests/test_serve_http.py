@@ -210,3 +210,26 @@ def test_streamed_session_turn_then_resume(server):
     hist = full1 + tok.encode(t2)
     ref_text, _ = _lockstep_text(cfg, params, tok, hist, 5)
     assert out2["text"] == ref_text
+
+
+def test_http_prefix_preload_and_fork(server):
+    """POST /v1/preload parks a system prompt; completions forking it
+    match lockstep on the concatenated prompt."""
+    port, cfg, params, tok = server
+    system, user = "system: be terse. ", "hello"
+    with urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/preload",
+            data=json.dumps({"prompt": system}).encode(),
+            headers={"Content-Type": "application/json"}),
+            timeout=300) as r:
+        sid = json.loads(r.read())["session"]
+    _, out = _post(port, {"prompt": user, "max_tokens": 6, "prefix": sid})
+    ref_text, _ = _lockstep_text(cfg, params, tok,
+                                 tok.encode(system) + tok.encode(user), 6)
+    assert out["text"] == ref_text
+    # template survives: second fork works too
+    _, out2 = _post(port, {"prompt": "again", "max_tokens": 4,
+                           "prefix": sid})
+    ref2, _ = _lockstep_text(cfg, params, tok,
+                             tok.encode(system) + tok.encode("again"), 4)
+    assert out2["text"] == ref2

@@ -352,3 +352,79 @@ def test_no_livelock_fresh_head_blocks_behind_parked_resume(setup):
     assert set(done) == {uf, ur}
     assert done[ur].finish_reason == "length"
     assert done[uf].finish_reason == "length"
+
+
+# ------------------------------------------------------- prefix caching
+
+def test_prefix_fork_matches_concatenated_prompt(setup):
+    """One preloaded system prompt serves many forks: each fork's output
+    must equal lockstep generate() on system+user, the template must
+    survive all forks, and only ONE prefill of the system prompt ever
+    runs."""
+    cfg, params = setup
+    system = [7, 7, 3, 9, 2, 5]
+    users = [[11, 4], [6, 1, 8], [13]]
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=3)
+    sid = b.preload(system)
+    uids = [b.submit(u, 5, prefix=sid) for u in users]
+    done = {c.uid: c for c in b.run()}
+    for uid, u in zip(uids, users):
+        assert done[uid].tokens == _reference(cfg, params, system + u, 5), \
+            "fork diverged from lockstep on the concatenated prompt"
+    assert b.stats["prefills"] == 1  # the system prompt, once
+    assert b.stats["forks"] == len(users)
+    # template still parked: a later fork still works
+    u4 = b.submit([2, 2], 4, prefix=sid)
+    done = {c.uid: c for c in b.run()}
+    assert done[u4].tokens == _reference(cfg, params, system + [2, 2], 4)
+
+
+def test_prefix_fork_with_keep_creates_independent_session(setup):
+    cfg, params = setup
+    system = [5, 9, 1, 3]
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=3)
+    sid = b.preload(system)
+    u1 = b.submit([4, 2], 3, prefix=sid, keep=True)
+    done = {c.uid: c for c in b.run()}
+    chat_sid = done[u1].session
+    gen1 = done[u1].tokens
+    # continue the forked chat; the template is untouched
+    u2 = b.submit([8], 4, session=chat_sid)
+    done = {c.uid: c for c in b.run()}
+    hist = system + [4, 2] + gen1 + [8]
+    assert done[u2].tokens == _reference(cfg, params, hist, 4)
+    u3 = b.submit([1], 3, prefix=sid)  # template still serves forks
+    done = {c.uid: c for c in b.run()}
+    assert done[u3].tokens == _reference(cfg, params, system + [1], 3)
+
+
+def test_preload_capacity_and_eviction(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    sid = b.preload([1, 2, 3])
+    # the only slot is template-reserved; a fresh request evicts it (LRU)
+    uf = b.submit([4, 5], 2)
+    done = {c.uid: c for c in b.run()}
+    assert done[uf].finish_reason == "length"
+    with pytest.raises(ValueError, match="unknown session"):
+        b.submit([6], 2, prefix=sid)
+
+
+def test_session_and_prefix_mutually_exclusive(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    sid = b.preload([1, 2])
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        b.submit([3], 2, session=sid, prefix=sid)
+
+
+def test_fork_with_one_slot_does_not_deadlock(setup):
+    """slots=1: a fork needs a slot BESIDES its template — impossible at
+    one slot. The scheduler must sacrifice the template (the fork then
+    surfaces as session_evicted) instead of spinning forever."""
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    sid = b.preload([1, 2, 3])
+    uid = b.submit([4, 5], 2, prefix=sid)
+    done = {c.uid: c for c in b.run()}
+    assert done[uid].finish_reason == "session_evicted"

@@ -22,6 +22,10 @@ API (JSON over POST, one object per request):
   finish_reason "session_evicted").
   ``top_k``/``top_p`` are SERVER-wide flags (static jit args — per-request
   values would recompile; temperature is the per-request knob).
+- ``POST /v1/preload``: {prompt} → {session} — prefill a shared prefix
+  (system prompt) once and park it; completions posted with
+  ``prefix: <session>`` FORK it (the template survives, so one preload
+  serves any number of requests).
 - ``GET /healthz``: {status, stats} — liveness + batcher counters.
 
 Threading model: request handler threads (ThreadingHTTPServer) enqueue
@@ -116,9 +120,20 @@ class BatcherService:
     def healthy(self) -> bool:
         return self.error is None and self._thread.is_alive()
 
+    def preload(self, prompt: str) -> int:
+        """Park a shared-prefix template; returns its session id."""
+        ids = self.tok.encode(prompt)
+        if not ids:
+            raise ValueError("empty prompt after tokenization")
+        with self._lock:
+            if self.error is not None:
+                raise RuntimeError(f"scheduler dead: {self.error}")
+            return self.batcher.preload(ids)
+
     def complete(self, prompt: str, max_tokens: int, temperature: float,
                  timeout_s: float = 600.0, *, keep: bool = False,
-                 session: int | None = None) -> dict:
+                 session: int | None = None,
+                 prefix: int | None = None) -> dict:
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
@@ -132,7 +147,8 @@ class BatcherService:
             uid = self.batcher.submit(ids, max_tokens,
                                       temperature=temperature,
                                       eos_id=self.tok.eos_id,
-                                      keep=keep, session=session)
+                                      keep=keep, session=session,
+                                      prefix=prefix)
             self._events[uid] = ev
         timed_out = not ev.wait(timeout_s)
         with self._lock:
@@ -162,7 +178,7 @@ class BatcherService:
 
     def stream(self, prompt: str, max_tokens: int, temperature: float,
                timeout_s: float = 600.0, *, keep: bool = False,
-               session: int | None = None):
+               session: int | None = None, prefix: int | None = None):
         """Returns (uid, chunk iterator). Validation and submission run
         EAGERLY (so callers can reject before committing to a response);
         the iterator yields (new_token_ids, completion_or_None) chunks as
@@ -179,7 +195,8 @@ class BatcherService:
             uid = self.batcher.submit(ids, max_tokens,
                                       temperature=temperature,
                                       eos_id=self.tok.eos_id,
-                                      keep=keep, session=session)
+                                      keep=keep, session=session,
+                                      prefix=prefix)
             self._streams[uid] = q
             self._stream_seen[uid] = 0
 
@@ -251,33 +268,41 @@ def make_handler(service: BatcherService):
                 self._send(404, {"error": "unknown path"})
 
         def do_POST(self):
-            if self.path != "/v1/completions":
+            if self.path not in ("/v1/completions", "/v1/preload"):
                 self._send(404, {"error": "unknown path"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 prompt = str(req["prompt"])
+                if self.path == "/v1/preload":
+                    self._send(200, {"session": service.preload(prompt)})
+                    return
                 max_tokens = int(req.get("max_tokens",
                                          service.max_new_default))
                 temperature = float(req.get("temperature", 0.0))
                 keep = bool(req.get("keep", False))
                 session = req.get("session")
                 session = int(session) if session is not None else None
+                prefix = req.get("prefix")
+                prefix = int(prefix) if prefix is not None else None
                 if req.get("stream"):
                     # eager submit: validation errors raise BEFORE any
                     # headers go out, so they get a clean 400/503
                     uid, chunks = service.stream(prompt, max_tokens,
                                                  temperature, keep=keep,
-                                                 session=session)
+                                                 session=session,
+                                                 prefix=prefix)
                     self._stream_sse(uid, chunks)
                     return
                 out = service.complete(prompt, max_tokens, temperature,
-                                       keep=keep, session=session)
+                                       keep=keep, session=session,
+                                       prefix=prefix)
                 self._send(200, out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
             except (TimeoutError, RuntimeError) as e:
+                # RuntimeError: scheduler dead OR no slot for preload
                 self._send(503, {"error": str(e)})
 
         def _stream_sse(self, uid, chunks):
