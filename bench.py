@@ -406,10 +406,14 @@ def serve_bench(args) -> None:
     p_lo, p_hi = (4, 12) if args.tiny else (32, 256)
     b_lo, b_hi = (2, 6) if args.tiny else (16, 96)
     turns = max(args.serve_turns, 1)
+    if turns > 1 and args.serve_prefix:
+        raise SystemExit("--serve-turns and --serve-prefix are separate "
+                         "workloads; pick one")
     # chat workload: later turns are shorter than openers
     t_lo, t_hi = (2, 6) if args.tiny else (16, 64)
-    max_len = (32 * turns if args.tiny
-               else min(4096, 512 * turns))
+    prefix_len = args.serve_prefix
+    max_len = (32 * turns + prefix_len if args.tiny
+               else min(4096, 512 * turns + prefix_len))
     model_cfg = ModelConfig(name="llama", **dims, max_seq_len=max_len,
                             attention_impl="xla")
     precision = PrecisionConfig(compute_dtype="bfloat16")
@@ -434,6 +438,26 @@ def serve_bench(args) -> None:
 
     def make_batcher():
         return ContinuousBatcher(model_cfg, precision, params, slots=slots)
+
+    def run_prefix_workload(b) -> int:
+        """Shared-system-prompt workload: every request = prefix_len
+        system tokens + its own user turn. Fork arm: ONE preload serves
+        all requests; resend arm: each request re-prefills
+        system+user."""
+        system = list(rng.integers(0, V, prefix_len))
+        sid = None if args.serve_resend else b.preload(system)
+        for i in range(n_req):
+            user = list(rng.integers(0, V, int(reqs[i][0])))
+            if args.serve_resend:
+                b.submit(system + user, int(reqs[i][1]))
+            else:
+                b.submit(user, int(reqs[i][1]), prefix=sid)
+        n = 0
+        for c in b.run():
+            assert c.finish_reason == "length", c.finish_reason
+            n += 1
+        assert n == n_req
+        return b.stats["generated_tokens"]
 
     def run_workload(b) -> int:
         """Drive the full (possibly multi-turn) workload; returns total
@@ -476,17 +500,24 @@ def serve_bench(args) -> None:
     # cache across batchers (structurally equal static module args), so
     # compiles land here, not inside the timed A/B (which would skew the
     # session-vs-resend comparison by unequal compile time).
-    prefill_lens, resume_lens = set(), set()
-    for i in range(n_req):
-        hist, budget = int(reqs[i][0]), int(reqs[i][1])
-        prefill_lens.add(hist)
-        for n_turn, next_budget in extra_turns[i]:
-            if args.serve_resend:
-                hist += budget + int(n_turn)
-                prefill_lens.add(hist)
-                budget = int(next_budget)
-            else:
-                resume_lens.add(1 + int(n_turn))
+    prefill_lens, resume_lens, fork_lens = set(), set(), set()
+    if prefix_len:
+        if args.serve_resend:
+            prefill_lens = {prefix_len + int(n) for n, _ in reqs}
+        else:
+            prefill_lens = {prefix_len}
+            fork_lens = {int(n) for n, _ in reqs}  # forked turn ingests
+    else:
+        for i in range(n_req):
+            hist, budget = int(reqs[i][0]), int(reqs[i][1])
+            prefill_lens.add(hist)
+            for n_turn, next_budget in extra_turns[i]:
+                if args.serve_resend:
+                    hist += budget + int(n_turn)
+                    prefill_lens.add(hist)
+                    budget = int(next_budget)
+                else:
+                    resume_lens.add(1 + int(n_turn))
     warm = make_batcher()
     for bucket in sorted({warm._bucket(n) for n in prefill_lens}):
         warm.submit(rng.integers(0, V, bucket), 2)
@@ -500,19 +531,30 @@ def serve_bench(args) -> None:
             uid = warm.submit(rng.integers(0, V, bucket - 1), 2,
                               keep=True, session=done[uid].session)
         list(warm.run())
+    if fork_lens:
+        # warm the fork-continuation buckets off one throwaway template
+        # (fork ingest is the turn alone: templates carry no unconsumed
+        # token, so bucket(len) == the timed executable's shape)
+        wsid = warm.preload(rng.integers(0, V, 4))
+        for bucket in sorted({warm._bucket(n) for n in fork_lens}):
+            warm.submit(rng.integers(0, V, bucket), 2, prefix=wsid)
+        list(warm.run())
     _disarm_watchdog()
 
     b = make_batcher()
     t0 = time.perf_counter()
-    total = run_workload(b)
+    total = run_prefix_workload(b) if prefix_len else run_workload(b)
     wall = time.perf_counter() - t0
     occupancy = (b.stats["generated_tokens"] - b.stats["prefills"]
-                 - b.stats["resumes"]) / max(b.stats["slot_token_slots"], 1)
+                 - b.stats["resumes"] - b.stats["forks"]
+                 ) / max(b.stats["slot_token_slots"], 1)
     suffix = ("_int8" if args.quantize else "") + (
         "_tiny" if args.tiny else "")
     arm = ""
     if turns > 1:
         arm = "_chat_resend" if args.serve_resend else "_chat"
+    elif prefix_len:
+        arm = "_prefix_resend" if args.serve_resend else "_prefix"
     print(json.dumps({
         "metric": f"llama_serve{arm}{suffix}_tokens_per_sec_per_chip",
         "value": round(total / wall, 2),
@@ -523,6 +565,7 @@ def serve_bench(args) -> None:
         "slots": slots,
         "prefills": b.stats["prefills"],
         "resumes": b.stats["resumes"],
+        "forks": b.stats["forks"],
         "occupancy": round(occupancy, 3),
     }))
 
@@ -647,9 +690,18 @@ def main() -> None:
                    help="with --serve: chat workload — each request is a "
                         "T-turn conversation resumed via KV sessions")
     p.add_argument("--serve-resend", action="store_true",
-                   help="with --serve-turns: re-prefill the FULL history "
-                        "each turn instead of resuming the session (the "
-                        "no-session baseline the session arm beats)")
+                   help="with --serve-turns/--serve-prefix: re-prefill "
+                        "instead of resuming/forking (the no-cache "
+                        "baseline the session/prefix arms beat)")
+    p.add_argument("--serve-prefix", type=int, default=0, metavar="LEN",
+                   help="with --serve: all requests share a LEN-token "
+                        "system prompt, served via ONE preloaded "
+                        "template forked per request (--serve-resend: "
+                        "re-prefill system+user each time instead). The "
+                        "template occupies one slot for the whole run — "
+                        "the fork arm pays 1/slots occupancy to save "
+                        "LEN-token prefills, so it wins when LEN is "
+                        "large relative to user turns and slots")
     p.add_argument("--spec-self", action="store_true",
                    help="with --speculative: draft == target (acceptance-1 "
                         "machinery ceiling instead of the random-draft "
