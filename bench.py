@@ -412,8 +412,19 @@ def serve_bench(args) -> None:
     # chat workload: later turns are shorter than openers
     t_lo, t_hi = (2, 6) if args.tiny else (16, 64)
     prefix_len = args.serve_prefix
+    if prefix_len and slots < 2:
+        raise SystemExit(
+            "--serve-prefix needs --batch-per-chip >= 2: the template "
+            "occupies one slot for the whole run")
+    # headroom = the longest request the workload can draw (opener/user
+    # turn + budget); the cap guards HBM, not correctness — refuse
+    # prefixes that would eat the headroom rather than truncate silently
     max_len = (32 * turns + prefix_len if args.tiny
-               else min(4096, 512 * turns + prefix_len))
+               else min(4096, 512 * turns) + prefix_len)
+    if not args.tiny and max_len > 8192:
+        raise SystemExit(
+            f"--serve-prefix {prefix_len} pushes max_seq_len to "
+            f"{max_len} (> 8192); lower the prefix length")
     model_cfg = ModelConfig(name="llama", **dims, max_seq_len=max_len,
                             attention_impl="xla")
     precision = PrecisionConfig(compute_dtype="bfloat16")
@@ -545,8 +556,11 @@ def serve_bench(args) -> None:
     t0 = time.perf_counter()
     total = run_prefix_workload(b) if prefix_len else run_workload(b)
     wall = time.perf_counter() - t0
-    occupancy = (b.stats["generated_tokens"] - b.stats["prefills"]
-                 - b.stats["resumes"] - b.stats["forks"]
+    # admission tokens: every REQUEST prefill/resume/fork samples one
+    # token outside a batched step; preloads prefill but admit nothing
+    admissions = (b.stats["prefills"] - b.stats["preloads"]
+                  + b.stats["resumes"] + b.stats["forks"])
+    occupancy = (b.stats["generated_tokens"] - admissions
                  ) / max(b.stats["slot_token_slots"], 1)
     suffix = ("_int8" if args.quantize else "") + (
         "_tiny" if args.tiny else "")
@@ -562,6 +576,7 @@ def serve_bench(args) -> None:
         "vs_baseline": 1.0,
         "requests": n_req,
         "turns": turns,
+        "prefix_len": prefix_len,
         "slots": slots,
         "prefills": b.stats["prefills"],
         "resumes": b.stats["resumes"],

@@ -262,8 +262,8 @@ class ContinuousBatcher:
         # the mask ever exposes it — same discipline as dead rows.
         self._parked: dict[int, tuple[int, int, int | None]] = {}
         self._parked_slots: set[int] = set()
-        self.stats = {"steps": 0, "prefills": 0, "resumes": 0,
-                      "forks": 0, "generated_tokens": 0,
+        self.stats = {"steps": 0, "prefills": 0, "preloads": 0,
+                      "resumes": 0, "forks": 0, "generated_tokens": 0,
                       "slot_token_slots": 0}
 
     # ------------------------------------------------------------- intake
@@ -331,6 +331,7 @@ class ContinuousBatcher:
                 "no slot available for preload (all active or reserved "
                 "by sessions with queued continuations)")
         self._prefill_into(r, prompt)
+        self.stats["preloads"] += 1  # a prefill that admits NO token
         sid = self._next_uid
         self._next_uid += 1
         self._parked[sid] = (r, len(prompt), None)  # no unconsumed token
