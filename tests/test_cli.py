@@ -230,3 +230,46 @@ def test_generate_cli_t5(tmp_path, capfd):
         + [f"--set={s}" for s in shrink])
     assert rc == 2
     assert "t5 serving" in capfd.readouterr().err
+
+
+def test_chat_cli_multi_turn(tmp_path, capfd, monkeypatch):
+    """Scripted REPL session: two turns share one KV session (resumes=1),
+    /reset starts a fresh conversation forked off the system template."""
+    import io
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.interop import save_torch_safetensors
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chat_cli
+
+    shrink = ["model.vocab_size=300", "model.hidden_size=32",
+              "model.num_layers=2", "model.num_heads=4",
+              "model.num_kv_heads=4", "model.mlp_dim=64",
+              "model.max_seq_len=96", "model.fused_lm_loss=false",
+              "model.remat=false"]
+    cfg = get_preset("llama2_7b")
+    cfg.apply_overrides(shrink)
+    model = build_model(cfg.model, cfg.precision)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 2), jnp.int32), train=False)["params"]
+    st = tmp_path / "w.st"
+    save_torch_safetensors(params, str(st))
+
+    monkeypatch.setattr(
+        "sys.stdin",
+        io.StringIO("hello\nsecond turn\n/stats\n/reset\nfresh\n/quit\n"))
+    rc = chat_cli.main(
+        ["--config", "llama2_7b", "--safetensors", str(st),
+         "--system", "sys: ", "--max-new-tokens", "4",
+         "--temperature", "0"] + [f"--set={s}" for s in shrink])
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    assert "system prompt preloaded" in out
+    assert "'resumes': 1" in out      # turn 2 resumed turn 1's session
+    assert "'forks': 1" in out  # /stats printed pre-reset: exactly one
+    assert "[new conversation]" in out

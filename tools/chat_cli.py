@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Interactive chat REPL over the serving stack — the quickest way to
+talk to a trained/exported model from a terminal.
+
+    python tools/chat_cli.py --config llama2_7b \
+        --safetensors model.st --tokenizer /models/llama2-tok \
+        [--system "You are terse."] [--temperature 0.7] [--top-p 0.9]
+
+Each turn resumes the SAME KV session (serving.py keep/session), so the
+conversation history stays resident on the chip — turn latency scales
+with the new turn's length, not the transcript's. `--system` preloads
+the system prompt as a shared-prefix template and forks the chat off it.
+
+Commands: /reset (new conversation, reusing the system template),
+/stats (batcher counters), /quit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(args):
+    import jax
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.data.text import load_tokenizer
+    from pytorch_distributed_train_tpu.serving import (
+        ContinuousBatcher,
+        load_params_for_serving,
+    )
+
+    cfg = get_preset(args.config)
+    cfg.apply_overrides(args.set)
+    tok = load_tokenizer(args.tokenizer)
+    params = load_params_for_serving(cfg, args.safetensors, args.quantize)
+    # 2 slots: one holds the system template (when --system), one chats.
+    # A lone chat without a system prompt still only needs one.
+    b = ContinuousBatcher(cfg.model, cfg.precision, params, slots=2,
+                          top_k=args.top_k, top_p=args.top_p,
+                          rng=jax.random.PRNGKey(args.seed))
+    return cfg, tok, b
+
+
+def chat_loop(args, tok, batcher, out=sys.stdout) -> int:
+    """The REPL proper; factored from main() so tests can drive it with
+    a scripted stdin and a tiny model."""
+    template = None
+    if args.system:
+        sys_ids = tok.encode(args.system)
+        try:
+            template = batcher.preload(sys_ids)
+        except (ValueError, RuntimeError) as e:
+            print(f"chat_cli: error: {e.args[0] if e.args else e}",
+                  file=sys.stderr)
+            return 2
+        print(f"[system prompt preloaded: {len(sys_ids)} tokens]",
+              file=out)
+    session = None
+
+    def one_turn(text: str) -> None:
+        nonlocal session
+        kw = {}
+        if session is not None:
+            kw["session"] = session
+        elif template is not None:
+            kw["prefix"] = template
+        uid = batcher.submit(tok.encode(text), args.max_new_tokens,
+                             temperature=args.temperature,
+                             eos_id=tok.eos_id, keep=True, **kw)
+        done = {c.uid: c for c in batcher.run()}
+        c = done[uid]
+        session = c.session
+        new = c.tokens
+        if tok.eos_id in new:
+            new = new[: new.index(tok.eos_id)]
+        print(tok.decode(new), file=out, flush=True)
+
+    for line in sys.stdin:
+        line = line.rstrip("\n")
+        if not line.strip():
+            continue
+        if line.strip() == "/quit":
+            break
+        if line.strip() == "/reset":
+            session = None  # old session stays parked until LRU-evicted
+            print("[new conversation]", file=out)
+            continue
+        if line.strip() == "/stats":
+            print(batcher.stats, file=out)
+            continue
+        try:
+            one_turn(line)
+        except ValueError as e:
+            # context exhausted or similar — start fresh rather than die
+            print(f"[error: {e.args[0] if e.args else e}; /reset to "
+                  "continue]", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="llama2_7b")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE")
+    p.add_argument("--safetensors", required=True)
+    p.add_argument("--tokenizer", default="",
+                   help="local HF tokenizer dir; empty → byte tokenizer")
+    p.add_argument("--system", default="",
+                   help="system prompt, preloaded once as a prefix template")
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quantize", default="", choices=["", "int8"])
+    args = p.parse_args(argv)
+    try:
+        cfg, tok, batcher = build(args)
+    except (KeyError, ValueError, FileNotFoundError, OSError) as e:
+        print(f"chat_cli: error: {e.args[0] if e.args else e}",
+              file=sys.stderr)
+        return 2
+    if sys.stdin.isatty():
+        print("[chat ready — /reset, /stats, /quit]", flush=True)
+    return chat_loop(args, tok, batcher)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
