@@ -61,6 +61,10 @@ class ModelConfig:
     # stretches the usable context to rope_scaling x the pretrain length
     # (set max_seq_len accordingly; positions divide by the factor).
     rope_scaling: float = 1.0
+    # "linear" (positions divide by the factor; fine-tune for quality) or
+    # "ntk" (base rescales, high frequencies preserved; often works
+    # zero-shot) — models/llama.py rope_frequencies
+    rope_scaling_type: str = "linear"
     rms_norm_eps: float = 1e-5
     # T5 family (models/t5.py): decoder stack depth (0 → = num_layers) and
     # the bucketed relative-position-bias geometry.

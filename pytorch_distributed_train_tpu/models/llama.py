@@ -45,13 +45,26 @@ class RMSNorm(nn.Module):
 
 
 def rope_frequencies(head_dim: int, max_seq_len: int, theta: float,
-                     scaling: float = 1.0) -> tuple:
+                     scaling: float = 1.0,
+                     scaling_type: str = "linear") -> tuple:
     """Precompute cos/sin tables (S, head_dim/2) in fp32.
 
-    ``scaling`` > 1 is linear position interpolation (Chen et al. 2023;
-    HF rope_scaling type "linear"): positions divide by the factor, so a
-    model trained at L tokens serves scaling*L — rope(t, scaling=k) ==
-    rope(t/k) exactly."""
+    ``scaling`` > 1 stretches the usable context to scaling x the
+    pretrain length, two recipes (HF rope_scaling types):
+    - "linear" (Chen et al. 2023): positions divide by the factor —
+      rope(t, scaling=k) == rope(t/k) exactly; uniform compression.
+    - "ntk" (NTK-aware, bloc97 2023 / HF "dynamic" at fixed factor):
+      the BASE rescales (theta' = theta * k^(D/(D-2))) so the lowest
+      frequencies stretch ~k x while the highest (local-order
+      resolution) stay nearly untouched — often usable without any
+      fine-tuning, unlike linear."""
+    if scaling_type not in ("linear", "ntk"):
+        raise ValueError(
+            f"rope_scaling_type must be 'linear' or 'ntk', got "
+            f"{scaling_type!r}")
+    if scaling_type == "ntk" and scaling != 1.0:
+        theta = theta * scaling ** (head_dim / (head_dim - 2))
+        scaling = 1.0  # positions stay integral; the base does the work
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     t = jnp.arange(max_seq_len, dtype=jnp.float32) / scaling
     freqs = jnp.outer(t, inv_freq)  # (S, D/2)
@@ -111,6 +124,7 @@ class LlamaAttention(nn.Module):
     max_seq_len: int
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    rope_scaling_type: str = "linear"  # linear | ntk (rope_frequencies)
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
     window: int = 0  # sliding-window attention (0 = full causal)
@@ -164,7 +178,8 @@ class LlamaAttention(nn.Module):
                 # O(S^2), not O(S*L) over the padded cache — and the
                 # configured attn_impl (incl. Pallas) still applies.
                 cos, sin = rope_frequencies(head_dim, S, self.rope_theta,
-                                             self.rope_scaling)
+                                             self.rope_scaling,
+                                             self.rope_scaling_type)
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
@@ -181,7 +196,8 @@ class LlamaAttention(nn.Module):
                 # into one scatter; positions/mask are per-row too.
                 idx = c_i.value  # (B,)
                 cos, sin = rope_frequencies(head_dim, L, self.rope_theta,
-                                            self.rope_scaling)
+                                            self.rope_scaling,
+                                            self.rope_scaling_type)
                 take = lambda tbl, i: jax.lax.dynamic_slice_in_dim(  # noqa: E731
                     tbl, i, S, 0)
                 cos_r = jax.vmap(take, (None, 0))(cos, idx)
@@ -209,7 +225,8 @@ class LlamaAttention(nn.Module):
                 # the mask below is causal across the new tokens too.
                 idx = c_i.value
                 cos, sin = rope_frequencies(head_dim, L, self.rope_theta,
-                                             self.rope_scaling)
+                                             self.rope_scaling,
+                                             self.rope_scaling_type)
                 cos = jax.lax.dynamic_slice_in_dim(cos, idx, S, 0)
                 sin = jax.lax.dynamic_slice_in_dim(sin, idx, S, 0)
                 q = apply_rope(q, cos, sin)
@@ -231,7 +248,8 @@ class LlamaAttention(nn.Module):
                                           impl="xla")
         else:
             cos, sin = rope_frequencies(head_dim, S, self.rope_theta,
-                                             self.rope_scaling)
+                                             self.rope_scaling,
+                                             self.rope_scaling_type)
             if positions is not None:
                 # packed segments: each document restarts at position 0
                 q = apply_rope_rows(q, cos[positions], sin[positions])
@@ -282,6 +300,7 @@ class LlamaBlock(nn.Module):
     rms_norm_eps: float
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    rope_scaling_type: str = "linear"
     cp: ContextParallelConfig | None = None
     moe: "MoeSpec | None" = None  # set → MoE FFN instead of dense (ops/moe.py)
     attn_impl: str = "auto"
@@ -297,7 +316,8 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.rope_theta,
             self.rope_scaling, self.max_seq_len, self.dtype,
-            self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
+            self.param_dtype, rope_scaling_type=self.rope_scaling_type,
+            cp=self.cp, attn_impl=self.attn_impl,
             window=self.window, quant=self.quant, decode=self.decode,
             decode_multi=self.decode_multi, decode_rows=self.decode_rows,
             name="attn",
@@ -326,9 +346,11 @@ class LlamaForCausalLM(nn.Module):
     mlp_dim: int = 11008
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
-    # Linear position interpolation factor (HF rope_scaling "linear"):
-    # serve/fine-tune at rope_scaling x the pretrain context.
+    # Position-interpolation factor: serve/fine-tune at rope_scaling x
+    # the pretrain context, by "linear" (positions divide) or "ntk"
+    # (base rescales; often usable without fine-tuning) recipe.
     rope_scaling: float = 1.0
+    rope_scaling_type: str = "linear"
     rms_norm_eps: float = 1e-5
     remat: bool = True
     remat_policy: str = "full"  # full | dots | dots_no_batch (models/remat.py)
@@ -398,6 +420,7 @@ class LlamaForCausalLM(nn.Module):
                 self.num_heads, self.num_kv_heads, self.mlp_dim,
                 self.rope_theta, self.rope_scaling, self.max_seq_len,
                 self.rms_norm_eps, self.dtype, self.param_dtype,
+                rope_scaling_type=self.rope_scaling_type,
                 cp=self.cp, moe=moe,
                 attn_impl=self.attn_impl, window=self.attention_window,
                 quant=self.quant_training, decode=self.decode,
@@ -468,6 +491,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         max_seq_len=cfg.max_seq_len,
         rope_theta=cfg.rope_theta,
         rope_scaling=getattr(cfg, "rope_scaling", 1.0),
+        rope_scaling_type=getattr(cfg, "rope_scaling_type", "linear"),
         rms_norm_eps=cfg.rms_norm_eps,
         remat=cfg.remat,
         remat_policy=getattr(cfg, "remat_policy", "full"),

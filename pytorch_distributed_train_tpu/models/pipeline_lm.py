@@ -84,7 +84,9 @@ class PipelinedLlama:
             cfg.num_heads, cfg.num_kv_heads or cfg.num_heads, cfg.mlp_dim,
             cfg.rope_theta, getattr(cfg, "rope_scaling", 1.0),
             cfg.max_seq_len, cfg.rms_norm_eps,
-            dtype, param_dtype, cp=cp, moe=moe,
+            dtype, param_dtype,
+            rope_scaling_type=getattr(cfg, "rope_scaling_type", "linear"),
+            cp=cp, moe=moe,
             attn_impl=getattr(cfg, "attention_impl", "auto"),
             window=getattr(cfg, "attention_window", 0),
             quant=getattr(cfg, "quant_training", ""),

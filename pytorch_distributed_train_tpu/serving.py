@@ -72,6 +72,15 @@ def load_params_for_serving(cfg, safetensors_path: str,
     return params
 
 
+def trim_at_eos(tokens: list[int], eos_id: int | None) -> list[int]:
+    """Cut a generated continuation at its first EOS (exclusive) — THE
+    eos-trim rule shared by every serving entrypoint (generate CLI, HTTP
+    server, chat REPL)."""
+    if eos_id is not None and eos_id in tokens:
+        return tokens[: tokens.index(eos_id)]
+    return tokens
+
+
 def build_serving_model(model_cfg: ModelConfig, precision: PrecisionConfig):
     """The continuous-batching twin of a decode model: per-row cache
     offsets enabled (models/llama.py decode_rows)."""

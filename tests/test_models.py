@@ -220,3 +220,84 @@ def test_rope_linear_scaling_interpolates_positions():
     assert not np.allclose(np.asarray(logits), np.asarray(logits_b))
     np.testing.assert_allclose(np.asarray(logits[:, 0]),
                                np.asarray(logits_b[:, 0]), rtol=2e-4)
+
+
+def test_rope_ntk_scaling_preserves_high_frequencies():
+    """NTK-aware scaling: the highest-frequency rotary pair (i=0,
+    inv_freq=1 regardless of base) is EXACTLY the unscaled rope, while
+    the lowest frequency stretches ~scaling x (the recipe's point:
+    local order intact, long-range capacity extended). Linear scaling by
+    contrast compresses every frequency uniformly."""
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.models.llama import rope_frequencies
+
+    D, S, theta, k = 64, 128, 10000.0, 4.0
+    cos0, sin0 = rope_frequencies(D, S, theta)
+    cos_ntk, sin_ntk = rope_frequencies(D, S, theta, k, "ntk")
+    cos_lin, _ = rope_frequencies(D, S, theta, k, "linear")
+
+    # i=0: inv_freq = theta'^0 = 1 for ANY base — identical to unscaled
+    np.testing.assert_allclose(np.asarray(cos_ntk[:, 0]),
+                               np.asarray(cos0[:, 0]), rtol=1e-6)
+    # linear scaling changes i=0 (cos(t/k) != cos(t))
+    assert not np.allclose(np.asarray(cos_lin[:, 0]),
+                           np.asarray(cos0[:, 0]), atol=1e-3)
+    # lowest frequency: angle ratio ≈ theta/theta'^((D-2)/D) = 1/k
+    t = S - 1
+    ang0 = t * theta ** (-(D - 2) / D)
+    ang_ntk = float(np.arctan2(np.asarray(sin_ntk[t, -1]),
+                               np.asarray(cos_ntk[t, -1])))
+    assert abs(ang_ntk - ang0 / k) < 1e-3 * ang0
+
+    import pytest
+
+    with pytest.raises(ValueError, match="rope_scaling_type"):
+        rope_frequencies(D, S, theta, k, "yarn")
+
+
+def test_rope_ntk_threads_through_model_and_decode():
+    """model.rope_scaling_type=ntk: train forward differs from linear at
+    the same factor, and the KV-cache decode path matches the train
+    forward position-for-position (the decode branches thread the type
+    too)."""
+    import dataclasses
+
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model,
+        init_cache,
+    )
+
+    cfg = ModelConfig(name="llama", vocab_size=61, hidden_size=32,
+                      num_layers=2, num_heads=4, num_kv_heads=2, mlp_dim=64,
+                      max_seq_len=24, rope_scaling=4.0,
+                      rope_scaling_type="ntk")
+    model = build_model(cfg, PrecisionConfig())
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 61, (1, 10)),
+                      jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, ids,
+                        train=False)["params"]
+    full = model.apply({"params": params}, ids, train=False)
+
+    linear = dataclasses.replace(model, rope_scaling_type="linear")
+    assert not np.allclose(np.asarray(full),
+                           np.asarray(linear.apply({"params": params}, ids,
+                                                   train=False)), atol=1e-3)
+
+    dm = build_decode_model(cfg, PrecisionConfig())
+    cache = init_cache(dm, 1)
+    logits, cache = dm.apply({"params": params, "cache": cache},
+                             ids[:, :6], train=False, mutable=["cache"])
+    cache = cache["cache"]
+    outs = [np.asarray(logits)]
+    for t in range(6, 10):
+        logits, cache = dm.apply({"params": params, "cache": cache},
+                                 ids[:, t:t + 1], train=False,
+                                 mutable=["cache"])
+        cache = cache["cache"]
+        outs.append(np.asarray(logits))
+    stitched = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(stitched, np.asarray(full), rtol=2e-4,
+                               atol=2e-4)

@@ -43,7 +43,7 @@ def build(args):
     b = ContinuousBatcher(cfg.model, cfg.precision, params, slots=2,
                           top_k=args.top_k, top_p=args.top_p,
                           rng=jax.random.PRNGKey(args.seed))
-    return cfg, tok, b
+    return tok, b
 
 
 def chat_loop(args, tok, batcher, out=sys.stdout) -> int:
@@ -65,20 +65,26 @@ def chat_loop(args, tok, batcher, out=sys.stdout) -> int:
     def one_turn(text: str) -> None:
         nonlocal session
         kw = {}
+        # Turn boundaries for a BASE LM: a trailing newline separates the
+        # user turn from the model's reply, and resumed turns open with
+        # one so the previous (possibly length-capped) reply doesn't run
+        # straight into the new input token stream.
+        payload = ("\n" + text + "\n") if session is not None \
+            else (text + "\n")
         if session is not None:
             kw["session"] = session
         elif template is not None:
             kw["prefix"] = template
-        uid = batcher.submit(tok.encode(text), args.max_new_tokens,
+        uid = batcher.submit(tok.encode(payload), args.max_new_tokens,
                              temperature=args.temperature,
                              eos_id=tok.eos_id, keep=True, **kw)
         done = {c.uid: c for c in batcher.run()}
         c = done[uid]
         session = c.session
-        new = c.tokens
-        if tok.eos_id in new:
-            new = new[: new.index(tok.eos_id)]
-        print(tok.decode(new), file=out, flush=True)
+        from pytorch_distributed_train_tpu.serving import trim_at_eos
+
+        print(tok.decode(trim_at_eos(c.tokens, tok.eos_id)), file=out,
+              flush=True)
 
     for line in sys.stdin:
         line = line.rstrip("\n")
@@ -119,7 +125,7 @@ def main(argv=None) -> int:
     p.add_argument("--quantize", default="", choices=["", "int8"])
     args = p.parse_args(argv)
     try:
-        cfg, tok, batcher = build(args)
+        tok, batcher = build(args)
     except (KeyError, ValueError, FileNotFoundError, OSError) as e:
         print(f"chat_cli: error: {e.args[0] if e.args else e}",
               file=sys.stderr)

@@ -110,11 +110,11 @@ def main(argv=None) -> int:
         params = load_params_for_serving(cfg, args.safetensors,
                                          args.quantize)
 
+        from pytorch_distributed_train_tpu.serving import trim_at_eos
+
         def emit(i, text, new):
-            if tok.eos_id in new:
-                new = new[: new.index(tok.eos_id)]
             print(f"=== prompt {i}: {text!r}")
-            print(tok.decode(new))
+            print(tok.decode(trim_at_eos(new, tok.eos_id)))
 
         if is_t5:
             from pytorch_distributed_train_tpu.generate import (
