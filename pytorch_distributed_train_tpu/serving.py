@@ -484,6 +484,22 @@ class ContinuousBatcher:
                 return r
         return None
 
+    def cancel(self, uid: int) -> bool:
+        """Stop a request: de-queue it, or free its active slot (the row
+        is dead until re-admitted, like any finished slot). Parked
+        sessions are untouched — canceling a queued resume leaves its
+        session parked. Returns whether anything was canceled; a
+        canceled request yields NO Completion."""
+        for i, q in enumerate(self.queue):
+            if q.uid == uid:
+                del self.queue[i]
+                return True
+        for r in range(self.slots):
+            if self._req[r] is not None and self._req[r].uid == uid:
+                self._req[r] = None
+                return True
+        return False
+
     def new_tokens_since(self, seen: dict[int, int]) -> dict[int, list[int]]:
         """uid -> ids generated beyond seen[uid], for every ACTIVE slot
         whose uid appears in ``seen``. The supported tap for streaming

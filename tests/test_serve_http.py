@@ -233,3 +233,39 @@ def test_http_prefix_preload_and_fork(server):
     ref2, _ = _lockstep_text(cfg, params, tok,
                              tok.encode(system) + tok.encode("again"), 4)
     assert out2["text"] == ref2
+
+
+def test_stop_sequences_cancel_and_trim(server):
+    """A stop string drawn from the reference continuation must truncate
+    the output BEFORE it, flip finish_reason to 'stop', and cancel the
+    on-device request early (fewer completion tokens than the budget);
+    streamed responses never emit the stop text."""
+    port, cfg, params, tok = server
+    prompt = "stop test prompt"
+    _, free = _post(port, {"prompt": prompt, "max_tokens": 12})
+    full = free["text"]
+    assert len(full) >= 4
+    stop = full[2:4]  # guaranteed to occur
+    want = full[: full.find(stop)]
+
+    _, out = _post(port, {"prompt": prompt, "max_tokens": 12,
+                          "stop": [stop]})
+    assert out["finish_reason"] == "stop"
+    assert out["text"] == want
+    assert stop not in out["text"]
+    assert out["usage"]["completion_tokens"] <= 12
+
+    raw, chunks = _sse_chunks(port, {"prompt": prompt, "max_tokens": 12,
+                                     "stream": True, "stop": [stop]})
+    text = "".join(c.get("delta", "") for c in chunks)
+    assert text == want
+    assert chunks[-1]["finish_reason"] == "stop"
+    assert raw.rstrip().endswith("data: [DONE]")
+
+
+def test_stop_with_keep_refused(server):
+    port, *_ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(port, {"prompt": "x", "max_tokens": 4, "keep": True,
+                     "stop": ["q"]})
+    assert e.value.code == 400

@@ -428,3 +428,19 @@ def test_fork_with_one_slot_does_not_deadlock(setup):
     uid = b.submit([4, 5], 2, prefix=sid)
     done = {c.uid: c for c in b.run()}
     assert done[uid].finish_reason == "session_evicted"
+
+
+def test_cancel_queued_and_active(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u1 = b.submit([1, 2, 3], 20)
+    u2 = b.submit([4, 5], 3)        # queued behind u1
+    assert b.cancel(u2) is True     # de-queued before admission
+    b.step()                        # u1 active now
+    assert b.cancel(u1) is True     # frees the active slot
+    assert b.cancel(999) is False
+    done = list(b.run())
+    assert done == []               # canceled requests yield nothing
+    u3 = b.submit([6], 2)           # the freed slot serves new work
+    done = {c.uid: c for c in b.run()}
+    assert done[u3].finish_reason == "length"

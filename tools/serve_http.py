@@ -13,8 +13,12 @@ server, scoped to stdlib http.server: zero extra dependencies).
 
 API (JSON over POST, one object per request):
 - ``POST /v1/completions``: {prompt, max_tokens?, temperature?, keep?,
-  session?} → {text, finish_reason, session,
-  usage:{prompt_tokens, completion_tokens}}. ``keep: true`` parks the
+  session?, stop?} → {text, finish_reason, session,
+  usage:{prompt_tokens, completion_tokens}}. ``stop`` is a list of
+  strings: generation CANCELS at the first occurrence (the match is
+  excluded from the text, finish_reason "stop"); streamed responses
+  hold back any tail that could still become a stop match. stop+keep
+  is refused (a canceled request parks no session). ``keep: true`` parks the
   request's KV cache and returns a ``session`` id; posting that id as
   ``session`` continues the conversation from the resident cache (the
   prompt is then just the NEW turn — no resend of history). Sessions
@@ -48,6 +52,32 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
+
+
+
+def _find_stop(text: str, stops: list[str]):
+    """Earliest stop-string occurrence in ``text`` (index, len) or None."""
+    best = None
+    for st in stops:
+        i = text.find(st)
+        if i >= 0 and (best is None or i < best[0]):
+            best = (i, len(st))
+    return best
+
+
+def _stop_holdback(text: str, stops: list[str]) -> int:
+    """Length of the longest text SUFFIX that is a proper prefix of some
+    stop string — the tail a streamer must hold back because the next
+    tokens could complete a stop match."""
+    h = 0
+    for st in stops:
+        for k in range(min(len(st) - 1, len(text)), 0, -1):
+            if text.endswith(st[:k]):
+                h = max(h, k)
+                break
+    return h
 
 
 class BatcherService:
@@ -132,8 +162,16 @@ class BatcherService:
 
     def complete(self, prompt: str, max_tokens: int, temperature: float,
                  timeout_s: float = 600.0, *, keep: bool = False,
-                 session: int | None = None,
-                 prefix: int | None = None) -> dict:
+                 session: int | None = None, prefix: int | None = None,
+                 stop: list[str] | None = None) -> dict:
+        if stop:
+            if keep:
+                raise ValueError(
+                    "stop with keep is unsupported (a stop-canceled "
+                    "request parks no session)")
+            return self._complete_with_stop(
+                prompt, max_tokens, temperature, timeout_s,
+                session=session, prefix=prefix, stop=stop)
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
@@ -176,6 +214,41 @@ class BatcherService:
                       "completion_tokens": len(c.tokens)},
         }
 
+    def _complete_with_stop(self, prompt, max_tokens, temperature,
+                            timeout_s, *, session, prefix,
+                            stop) -> dict:
+        """Stop-sequence completions ride the streaming tap: decode the
+        accumulated text each tick, CANCEL the request at the first stop
+        match (it stops consuming decode steps), trim the match out."""
+        uid, n_prompt, chunks = self.stream(prompt, max_tokens,
+                                            temperature, timeout_s,
+                                            session=session,
+                                            prefix=prefix)
+        acc: list[int] = []
+        comp = None
+        for toks, c in chunks:
+            acc.extend(toks)
+            if c is not None:
+                comp = c
+                break
+            text = self.tok.decode(trim_at_eos(acc, self.tok.eos_id))
+            hit = _find_stop(text, stop)
+            if hit is not None:
+                self.cancel_stream(uid)
+                return {"text": text[: hit[0]], "finish_reason": "stop",
+                        "session": None,
+                        "usage": {"prompt_tokens": n_prompt,
+                                  "completion_tokens": len(acc)}}
+        # finished naturally — the final flush may still contain a stop
+        text = self.tok.decode(trim_at_eos(comp.tokens, self.tok.eos_id))
+        hit = _find_stop(text, stop)
+        reason = comp.finish_reason
+        if hit is not None:
+            text, reason = text[: hit[0]], "stop"
+        return {"text": text, "finish_reason": reason, "session": None,
+                "usage": {"prompt_tokens": n_prompt,
+                          "completion_tokens": len(comp.tokens)}}
+
     def stream(self, prompt: str, max_tokens: int, temperature: float,
                timeout_s: float = 600.0, *, keep: bool = False,
                session: int | None = None, prefix: int | None = None):
@@ -183,8 +256,10 @@ class BatcherService:
         EAGERLY (so callers can reject before committing to a response);
         the iterator yields (new_token_ids, completion_or_None) chunks as
         the batched decode produces them, ending with the Completion.
-        ``timeout_s`` bounds the wait for EACH chunk. A caller that stops
-        consuming must call ``abandon_stream(uid)``."""
+        Returns (uid, prompt_token_count, iterator); ``timeout_s`` bounds
+        the wait for EACH chunk. A caller that stops consuming must call
+        ``abandon_stream(uid)`` (or ``cancel_stream`` to also stop the
+        decode)."""
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
@@ -216,7 +291,19 @@ class BatcherService:
                 else:  # "error"
                     raise RuntimeError(f"scheduler dead: {payload}")
 
-        return uid, chunks()
+        return uid, len(ids), chunks()
+
+    def cancel_stream(self, uid: int) -> None:
+        """Cancel an in-flight streamed request (stop-sequence match) and
+        drop its tap. Unlike abandon_stream this adds NO _abandoned
+        marker: a canceled request never produces the future Completion
+        that would clear it (the marker would leak per stop forever); if
+        it raced to completion first, its result was already routed to
+        the (now unread) chunk queue and dies with it."""
+        with self._lock:
+            self.batcher.cancel(uid)
+            self._streams.pop(uid, None)
+            self._stream_seen.pop(uid, None)
 
     def abandon_stream(self, uid: int) -> None:
         """Stop tracking a streaming request whose consumer went away
@@ -286,18 +373,27 @@ def make_handler(service: BatcherService):
                 session = int(session) if session is not None else None
                 prefix = req.get("prefix")
                 prefix = int(prefix) if prefix is not None else None
+                stop = req.get("stop")
+                if stop is not None:
+                    if isinstance(stop, str):
+                        stop = [stop]
+                    stop = [str(x) for x in stop if str(x)]
                 if req.get("stream"):
+                    if stop and keep:
+                        raise ValueError(
+                            "stop with keep is unsupported (a "
+                            "stop-canceled request parks no session)")
                     # eager submit: validation errors raise BEFORE any
                     # headers go out, so they get a clean 400/503
-                    uid, chunks = service.stream(prompt, max_tokens,
-                                                 temperature, keep=keep,
-                                                 session=session,
-                                                 prefix=prefix)
-                    self._stream_sse(uid, chunks)
+                    uid, n_prompt, chunks = service.stream(
+                        prompt, max_tokens, temperature, keep=keep,
+                        session=session, prefix=prefix)
+                    self._stream_sse(uid, chunks, stop=stop,
+                                     n_prompt=n_prompt)
                     return
                 out = service.complete(prompt, max_tokens, temperature,
                                        keep=keep, session=session,
-                                       prefix=prefix)
+                                       prefix=prefix, stop=stop)
                 self._send(200, out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
@@ -305,7 +401,7 @@ def make_handler(service: BatcherService):
                 # RuntimeError: scheduler dead OR no slot for preload
                 self._send(503, {"error": str(e)})
 
-        def _stream_sse(self, uid, chunks):
+        def _stream_sse(self, uid, chunks, stop=None, n_prompt=0):
             """Server-sent events: one `data:` chunk per decode tick with
             the TEXT DELTA. Deltas come from re-decoding ALL tokens so
             far and holding back trailing replacement chars (an
@@ -333,20 +429,46 @@ def make_handler(service: BatcherService):
                 for toks, comp in chunks:
                     if not stopped and toks:
                         acc.extend(toks)
-                        if service.tok.eos_id in acc:
-                            acc = acc[: acc.index(service.tok.eos_id)]
-                            stopped = True
+                        trimmed = trim_at_eos(acc, service.tok.eos_id)
+                        stopped = len(trimmed) < len(acc)
+                        acc = trimmed
                         text = service.tok.decode(acc)
+                        if stop:
+                            hit = _find_stop(text, stop)
+                            if hit is not None:
+                                # cancel on-device work; emit up to the
+                                # match and finish with reason "stop"
+                                service.cancel_stream(uid)
+                                cut = text[: hit[0]]
+                                if len(cut) > len(sent_text):
+                                    emit({"delta": cut[len(sent_text):]})
+                                emit({"delta": "",
+                                      "finish_reason": "stop",
+                                      "session": None,
+                                      "usage": {
+                                          "prompt_tokens": n_prompt,
+                                          "completion_tokens": len(acc)}})
+                                break
                         stable = (text if stopped
                                   else text.rstrip("\ufffd"))
+                        if stop:
+                            # hold back any tail that could still grow
+                            # into a stop match next tick
+                            h = _stop_holdback(stable, stop)
+                            stable = stable[: len(stable) - h]
                         if len(stable) > len(sent_text):
                             emit({"delta": stable[len(sent_text):]})
                             sent_text = stable
                     if comp is not None:
                         final = service.tok.decode(acc)
+                        reason = comp.finish_reason
+                        if stop:
+                            hit = _find_stop(final, stop)
+                            if hit is not None:
+                                final, reason = final[: hit[0]], "stop"
                         tail = final[len(sent_text):]
                         emit({"delta": tail,
-                              "finish_reason": comp.finish_reason,
+                              "finish_reason": reason,
                               "session": comp.session,
                               "usage": {
                                   "prompt_tokens": len(comp.prompt),
