@@ -150,12 +150,18 @@ def _set_row_index(row_cache, pos):
 @partial(jax.jit, static_argnums=(3, 4))
 def _sample_rows(logits, rng, temperature, top_k: int, top_p: float):
     """Per-row sampling: rows with temperature 0 are greedy, others sample
-    at their own temperature under shared static top-k/top-p."""
+    at their own temperature under shared static top-k/top-p. Also
+    returns each emitted token's log-probability under the RAW model
+    distribution (pre-temperature/filtering — comparable across requests
+    regardless of their sampling settings)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     f = filter_logits(logits, jnp.maximum(temperature, 1e-6)[:, None],
                       top_k, top_p)
     sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature == 0.0, greedy, sampled)
+    tok = jnp.where(temperature == 0.0, greedy, sampled)
+    raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lp = jnp.take_along_axis(raw_logp, tok[:, None], axis=-1)[:, 0]
+    return tok, lp
 
 
 @dataclasses.dataclass
@@ -183,6 +189,9 @@ class Completion:
     # submit(session=...) to continue this conversation from its resident
     # KV cache (no re-prefill of the earlier turns).
     session: int | None = None
+    # Per-token log-probability of each generated token under the RAW
+    # model distribution (parallel to ``tokens``).
+    logprobs: list[float] = dataclasses.field(default_factory=list)
 
 
 class ContinuousBatcher:
@@ -260,6 +269,7 @@ class ContinuousBatcher:
         # host-side slot state
         self._req: list[Request | None] = [None] * slots
         self._generated: list[list[int]] = [[] for _ in range(slots)]
+        self._logprobs: list[list[float]] = [[] for _ in range(slots)]
         self._pending = np.zeros(slots, np.int32)  # next input token per slot
         self._temp = np.zeros(slots, np.float32)
         self._pos = np.zeros(slots, np.int64)  # tokens INGESTED per slot
@@ -433,13 +443,15 @@ class ContinuousBatcher:
         """Shared admission tail: sample the first token and activate the
         slot; returns a Completion iff that token already finishes."""
         self.rng, step_rng = jax.random.split(self.rng)
-        first = int(_sample_rows(
+        tok, lp = _sample_rows(
             last_logits, step_rng,
             jnp.asarray([req.temperature], jnp.float32),
-            self.top_k, self.top_p)[0])
+            self.top_k, self.top_p)
+        first = int(tok[0])
         self.stats["generated_tokens"] += 1
         self._req[r] = req
         self._generated[r] = [first]
+        self._logprobs[r] = [float(lp[0])]
         self._pending[r] = first
         self._temp[r] = req.temperature
         self._pos[r] = pos
@@ -463,7 +475,8 @@ class ContinuousBatcher:
                                      self._generated[r][-1])
             self._parked_slots.add(r)
         return Completion(req.uid, req.prompt, self._generated[r],
-                          "eos" if done_eos else "length", session=session)
+                          "eos" if done_eos else "length", session=session,
+                          logprobs=self._logprobs[r])
 
     def _evict_lru_parked(self, force: bool = False) -> int | None:
         """Free the oldest parked slot not referenced by a queued
@@ -591,14 +604,16 @@ class ContinuousBatcher:
         # dead row).
         logits = self._decode(jnp.asarray(self._pending)[:, None])
         self.rng, step_rng = jax.random.split(self.rng)
-        nxt = np.asarray(_sample_rows(
+        nxt_dev, lp_dev = _sample_rows(
             logits, step_rng, jnp.asarray(self._temp), self.top_k,
-            self.top_p))
+            self.top_p)
+        nxt, lps = np.asarray(nxt_dev), np.asarray(lp_dev)
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots
         for r in active:
             tok = int(nxt[r])
             self._generated[r].append(tok)
+            self._logprobs[r].append(float(lps[r]))
             self._pending[r] = tok
             self._pos[r] += 1  # the fed token's K/V is now in the cache
             self.stats["generated_tokens"] += 1
@@ -721,6 +736,7 @@ class Seq2SeqContinuousBatcher(ContinuousBatcher):
         self.stats["prefills"] += 1
         self._req[r] = req
         self._generated[r] = []
+        self._logprobs[r] = []
         self._pending[r] = self.decoder_start_id
         self._temp[r] = req.temperature
         return None  # first token arrives at the next batched step

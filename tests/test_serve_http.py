@@ -269,3 +269,14 @@ def test_stop_with_keep_refused(server):
         _post(port, {"prompt": "x", "max_tokens": 4, "keep": True,
                      "stop": ["q"]})
     assert e.value.code == 400
+
+
+def test_http_logprobs_field(server):
+    port, *_ = server
+    _, out = _post(port, {"prompt": "lp test", "max_tokens": 5,
+                          "logprobs": True})
+    assert "logprobs" in out
+    assert len(out["logprobs"]) <= 5
+    assert all(v <= 0.0 for v in out["logprobs"])
+    _, out2 = _post(port, {"prompt": "lp test", "max_tokens": 5})
+    assert "logprobs" not in out2

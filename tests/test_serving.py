@@ -209,6 +209,8 @@ def test_t5_serving_matches_lockstep(t5_setup):
     for uid, s, n in zip(uids, sources, budgets):
         assert done[uid].tokens == _t5_reference(cfg, params, s, n), \
             f"t5 request {uid} diverged from lockstep generate_seq2seq()"
+        # slot reuse must not leak the previous occupant's logprobs
+        assert len(done[uid].logprobs) == len(done[uid].tokens)
 
 
 def test_t5_serving_eos_frees_slot(t5_setup):
@@ -444,3 +446,27 @@ def test_cancel_queued_and_active(setup):
     u3 = b.submit([6], 2)           # the freed slot serves new work
     done = {c.uid: c for c in b.run()}
     assert done[u3].finish_reason == "length"
+
+
+def test_logprobs_accompany_tokens(setup):
+    """Every generated token carries its raw-model log-probability; for a
+    greedy request each must equal the max of the teacher-forced
+    log-softmax at that position."""
+    cfg, params = setup
+    prompt = [3, 1, 4, 1, 5]
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    uid = b.submit(prompt, 6)
+    done = {c.uid: c for c in b.run()}
+    c = done[uid]
+    assert len(c.logprobs) == len(c.tokens) == 6
+    assert all(lp <= 0.0 for lp in c.logprobs)
+
+    full_model = build_model(cfg, PrecisionConfig())
+    seq = jnp.asarray([prompt + c.tokens], jnp.int32)
+    logits = full_model.apply({"params": params}, seq, train=False)
+    lp_all = np.asarray(jax.nn.log_softmax(
+        np.asarray(logits[0], np.float32), -1))
+    for i, (tok, lp) in enumerate(zip(c.tokens, c.logprobs)):
+        pos = len(prompt) - 1 + i
+        assert abs(lp - lp_all[pos, tok]) < 1e-3, i
+        assert abs(lp - lp_all[pos].max()) < 1e-3, i  # greedy == argmax

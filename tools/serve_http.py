@@ -26,6 +26,8 @@ API (JSON over POST, one object per request):
   finish_reason "session_evicted").
   ``top_k``/``top_p`` are SERVER-wide flags (static jit args — per-request
   values would recompile; temperature is the per-request knob).
+  ``logprobs: true`` adds each generated token's log-probability under
+  the raw model distribution.
 - ``POST /v1/preload``: {prompt} → {session} — prefill a shared prefix
   (system prompt) once and park it; completions posted with
   ``prefix: <session>`` FORK it (the template survives, so one preload
@@ -58,12 +60,14 @@ from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
 
 
 def _find_stop(text: str, stops: list[str]):
-    """Earliest stop-string occurrence in ``text`` (index, len) or None."""
+    """Index of the earliest stop-string occurrence in ``text``, or
+    None. (Only the cut position matters — the match itself is always
+    excluded from the output.)"""
     best = None
     for st in stops:
         i = text.find(st)
-        if i >= 0 and (best is None or i < best[0]):
-            best = (i, len(st))
+        if i >= 0 and (best is None or i < best):
+            best = i
     return best
 
 
@@ -163,7 +167,8 @@ class BatcherService:
     def complete(self, prompt: str, max_tokens: int, temperature: float,
                  timeout_s: float = 600.0, *, keep: bool = False,
                  session: int | None = None, prefix: int | None = None,
-                 stop: list[str] | None = None) -> dict:
+                 stop: list[str] | None = None,
+                 logprobs: bool = False) -> dict:
         if stop:
             if keep:
                 raise ValueError(
@@ -171,7 +176,8 @@ class BatcherService:
                     "request parks no session)")
             return self._complete_with_stop(
                 prompt, max_tokens, temperature, timeout_s,
-                session=session, prefix=prefix, stop=stop)
+                session=session, prefix=prefix, stop=stop,
+                logprobs=logprobs)
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
@@ -203,20 +209,22 @@ class BatcherService:
                 raise TimeoutError(
                     f"request {uid} timed out after {timeout_s}s")
             raise RuntimeError(f"scheduler dead: {self.error}")
-        new = c.tokens
-        if self.tok.eos_id in new:
-            new = new[: new.index(self.tok.eos_id)]
-        return {
+        new = trim_at_eos(c.tokens, self.tok.eos_id)
+        out = {
             "text": self.tok.decode(new),
             "finish_reason": c.finish_reason,
             "session": c.session,
             "usage": {"prompt_tokens": len(ids),
                       "completion_tokens": len(c.tokens)},
         }
+        if logprobs:
+            out["logprobs"] = [round(v, 6)
+                               for v in c.logprobs[: len(new)]]
+        return out
 
     def _complete_with_stop(self, prompt, max_tokens, temperature,
-                            timeout_s, *, session, prefix,
-                            stop) -> dict:
+                            timeout_s, *, session, prefix, stop,
+                            logprobs: bool = False) -> dict:
         """Stop-sequence completions ride the streaming tap: decode the
         accumulated text each tick, CANCEL the request at the first stop
         match (it stops consuming decode steps), trim the match out."""
@@ -231,23 +239,35 @@ class BatcherService:
             if c is not None:
                 comp = c
                 break
-            text = self.tok.decode(trim_at_eos(acc, self.tok.eos_id))
+            kept = trim_at_eos(acc, self.tok.eos_id)
+            text = self.tok.decode(kept)
             hit = _find_stop(text, stop)
             if hit is not None:
                 self.cancel_stream(uid)
-                return {"text": text[: hit[0]], "finish_reason": "stop",
-                        "session": None,
-                        "usage": {"prompt_tokens": n_prompt,
-                                  "completion_tokens": len(acc)}}
+                out = {"text": text[: hit], "finish_reason": "stop",
+                       "session": None,
+                       "usage": {"prompt_tokens": n_prompt,
+                                 "completion_tokens": len(acc)}}
+                if logprobs:
+                    # the streaming tap carries token ids only; a
+                    # stop-canceled request has no Completion to read
+                    # per-token logprobs from — explicit null, not absent
+                    out["logprobs"] = None
+                return out
         # finished naturally — the final flush may still contain a stop
-        text = self.tok.decode(trim_at_eos(comp.tokens, self.tok.eos_id))
+        kept = trim_at_eos(comp.tokens, self.tok.eos_id)
+        text = self.tok.decode(kept)
         hit = _find_stop(text, stop)
         reason = comp.finish_reason
         if hit is not None:
-            text, reason = text[: hit[0]], "stop"
-        return {"text": text, "finish_reason": reason, "session": None,
-                "usage": {"prompt_tokens": n_prompt,
-                          "completion_tokens": len(comp.tokens)}}
+            text, reason = text[: hit], "stop"
+        out = {"text": text, "finish_reason": reason, "session": None,
+               "usage": {"prompt_tokens": n_prompt,
+                         "completion_tokens": len(comp.tokens)}}
+        if logprobs:
+            out["logprobs"] = [round(v, 6)
+                               for v in comp.logprobs[: len(kept)]]
+        return out
 
     def stream(self, prompt: str, max_tokens: int, temperature: float,
                timeout_s: float = 600.0, *, keep: bool = False,
@@ -393,7 +413,9 @@ def make_handler(service: BatcherService):
                     return
                 out = service.complete(prompt, max_tokens, temperature,
                                        keep=keep, session=session,
-                                       prefix=prefix, stop=stop)
+                                       prefix=prefix, stop=stop,
+                                       logprobs=bool(
+                                           req.get("logprobs", False)))
                 self._send(200, out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
@@ -439,7 +461,7 @@ def make_handler(service: BatcherService):
                                 # cancel on-device work; emit up to the
                                 # match and finish with reason "stop"
                                 service.cancel_stream(uid)
-                                cut = text[: hit[0]]
+                                cut = text[: hit]
                                 if len(cut) > len(sent_text):
                                     emit({"delta": cut[len(sent_text):]})
                                 emit({"delta": "",
@@ -465,7 +487,7 @@ def make_handler(service: BatcherService):
                         if stop:
                             hit = _find_stop(final, stop)
                             if hit is not None:
-                                final, reason = final[: hit[0]], "stop"
+                                final, reason = final[: hit], "stop"
                         tail = final[len(sent_text):]
                         emit({"delta": tail,
                               "finish_reason": reason,
