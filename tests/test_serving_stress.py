@@ -1,0 +1,144 @@
+"""Randomized scheduler soak for the continuous batcher.
+
+A few hundred scheduler quanta of random arrivals mixing every request
+kind the API offers — plain, keep, resume, preload, fork, cancel — with
+random slot pressure. Invariants checked at every completion and at the
+end:
+
+1. every non-canceled submission completes exactly once (or surfaces as
+   session_evicted), canceled ones never do;
+2. logprobs stay parallel to tokens;
+3. every GREEDY plain completion equals its lockstep generate() run —
+   the correctness anchor holding under arbitrary interleaving, not
+   just the hand-written scenarios.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+from pytorch_distributed_train_tpu.generate import (
+    build_decode_model,
+    generate,
+)
+from pytorch_distributed_train_tpu.models.registry import build_model
+from pytorch_distributed_train_tpu.serving import ContinuousBatcher
+
+V = 47
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(name="llama", vocab_size=V, hidden_size=32,
+                      num_layers=2, num_heads=4, num_kv_heads=2, mlp_dim=48,
+                      max_seq_len=64)
+    model = build_model(cfg, PrecisionConfig())
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    return cfg, params
+
+
+def test_randomized_scheduler_soak(setup):
+    cfg, params = setup
+    rng = np.random.default_rng(42)
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=3)
+
+    live: dict[int, dict] = {}   # uid -> bookkeeping for open requests
+    canceled: set[int] = set()
+    sessions: list[int] = []     # parked session ids believed alive
+    templates: list[int] = []    # preloaded prefix ids believed alive
+    completed: dict[int, object] = {}
+    n_submitted = 0
+
+    def submit_random():
+        nonlocal n_submitted
+        kind = rng.choice(["plain", "keep", "resume", "fork", "preload",
+                           "cancel"], p=[0.35, 0.15, 0.15, 0.1, 0.1, 0.15])
+        prompt = list(map(int, rng.integers(0, V, int(rng.integers(2, 9)))))
+        budget = int(rng.integers(1, 6))
+        try:
+            if kind == "preload":
+                if len(templates) < 2:
+                    templates.append(b.preload(prompt))
+                return
+            if kind == "cancel":
+                if live:
+                    uid = int(rng.choice(list(live)))
+                    if b.cancel(uid):
+                        canceled.add(uid)
+                        live.pop(uid)
+                return
+            kw: dict = {}
+            if kind == "keep":
+                kw["keep"] = True
+            elif kind == "resume" and sessions:
+                kw["session"] = sessions.pop(
+                    int(rng.integers(0, len(sessions))))
+            elif kind == "fork" and templates:
+                kw["prefix"] = templates[
+                    int(rng.integers(0, len(templates)))]
+            uid = b.submit(prompt, budget, **kw)
+            live[uid] = {"prompt": prompt, "budget": budget,
+                         "plain": not kw}
+            n_submitted += 1
+        except (ValueError, RuntimeError):
+            # evicted session/template or capacity refusal — the API's
+            # documented failure modes; the soak keeps going, but a DEAD
+            # template id must leave the pool or preload never
+            # replenishes it and the fork path goes unexercised
+            if "prefix" in kw and kw["prefix"] in templates:
+                templates.remove(kw["prefix"])
+            return
+
+    for quantum in range(250):
+        for _ in range(int(rng.integers(0, 3))):
+            submit_random()
+        for c in b.step():
+            assert c.uid in live, f"completion for unknown uid {c.uid}"
+            assert c.uid not in completed, f"duplicate completion {c.uid}"
+            meta = live.pop(c.uid)
+            completed[c.uid] = (c, meta)
+            if c.finish_reason == "session_evicted":
+                continue
+            assert len(c.logprobs) == len(c.tokens)
+            assert len(c.tokens) <= meta["budget"]
+            if c.session is not None:
+                sessions.append(c.session)
+
+    # drain everything still queued/active — same strictness as the
+    # main loop (a phantom or duplicate completion here is a bug too)
+    for c in b.run():
+        assert c.uid in live, f"drain completion for unknown uid {c.uid}"
+        assert c.uid not in completed, f"duplicate completion {c.uid}"
+        meta = live.pop(c.uid)
+        completed[c.uid] = (c, meta)
+        if c.finish_reason != "session_evicted":
+            assert len(c.logprobs) == len(c.tokens)
+
+    assert not live, f"requests lost by the scheduler: {sorted(live)}"
+    assert canceled.isdisjoint(completed), "canceled request completed"
+    assert len(completed) + len(canceled) == n_submitted
+    # the mix must actually exercise every admission path
+    assert b.stats["resumes"] > 0, "no session resume ever ran"
+    assert b.stats["forks"] > 0, "no template fork ever ran"
+    assert b.stats["preloads"] > 0
+
+    # every GREEDY PLAIN completion (no session/prefix: prompt is the
+    # whole context from position 0) must equal lockstep generate()
+    dm = build_decode_model(cfg, PrecisionConfig())
+    checked = 0
+    for uid, (c, meta) in completed.items():
+        if not meta["plain"] or c.finish_reason == "session_evicted" \
+                or checked >= 10:
+            continue
+        ref = generate(dm, params, jnp.asarray([c.prompt], jnp.int32),
+                       len(c.tokens))
+        assert c.tokens == [int(t) for t in
+                            np.asarray(ref)[0, len(c.prompt):]], \
+            f"plain request {uid} diverged from lockstep under load"
+        checked += 1
+    assert checked >= 5, (
+        f"only {checked} plain completions to verify — tune the mix")
