@@ -59,6 +59,8 @@ def test_randomized_scheduler_soak(setup):
                            "cancel"], p=[0.35, 0.15, 0.15, 0.1, 0.1, 0.15])
         prompt = list(map(int, rng.integers(0, V, int(rng.integers(2, 9)))))
         budget = int(rng.integers(1, 6))
+        kw: dict = {}  # BEFORE the try: the except block reads it on the
+        # preload path too (which raises before the kind branches set it)
         try:
             if kind == "preload":
                 if len(templates) < 2:
@@ -71,7 +73,6 @@ def test_randomized_scheduler_soak(setup):
                         canceled.add(uid)
                         live.pop(uid)
                 return
-            kw: dict = {}
             if kind == "keep":
                 kw["keep"] = True
             elif kind == "resume" and sessions:

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Run the full queued benchmark battery and write one JSON report.
+
+The moment the device lease recovers, every measurement docs/ROADMAP.md
+has been queuing runs with ONE command:
+
+    python tools/bench_sweep.py                 # full battery
+    python tools/bench_sweep.py --only serve    # name-substring filter
+    python tools/bench_sweep.py --dry-run       # print commands only
+
+Each arm is `bench.py` in a subprocess (its own watchdog + structured
+tpu_unavailable record apply); failures are recorded and the sweep
+continues. Results land in BENCH_SWEEP.json: {name: {cmd, rc, parsed,
+seconds}} — parsed is bench.py's JSON line when one was emitted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The ROADMAP battery. Names are stable keys for --only and the report.
+ARMS: list[tuple[str, list[str]]] = [
+    ("resnet50_baseline", []),
+    ("resnet50_s2d_stem", ["--stem", "space_to_depth"]),
+    ("vit_b16", ["--model", "vit_b16"]),
+    ("bert_base_mlm", ["--model", "bert_base"]),
+    ("llama_train_best", ["--model", "llama", "--fused-head",
+                          "--optimizer", "adafactor"]),
+    ("llama_quant_training_int8", ["--model", "llama",
+                                   "--quant-training", "int8"]),
+    ("t5_train", ["--model", "t5"]),
+    ("llama_decode", ["--model", "llama", "--decode-tokens", "64"]),
+    ("llama_decode_int8", ["--model", "llama", "--decode-tokens", "64",
+                           "--quantize", "int8"]),
+    ("llama_spec_floor", ["--model", "llama", "--speculative", "4"]),
+    ("llama_spec_ceiling", ["--model", "llama", "--speculative", "4",
+                            "--spec-self"]),
+    ("serve_mixed", ["--model", "llama", "--serve", "64"]),
+    ("serve_chat_sessions", ["--model", "llama", "--serve", "32",
+                             "--serve-turns", "4"]),
+    ("serve_chat_resend", ["--model", "llama", "--serve", "32",
+                           "--serve-turns", "4", "--serve-resend"]),
+    ("serve_prefix_fork", ["--model", "llama", "--serve", "32",
+                           "--serve-prefix", "1024"]),
+    ("serve_prefix_resend", ["--model", "llama", "--serve", "32",
+                             "--serve-prefix", "1024", "--serve-resend"]),
+    ("host_pipeline_decode_native", ["--model", "pipeline",
+                                     "--pipeline-decode",
+                                     "--decoder", "native"]),
+]
+
+
+def run_arm(name: str, extra: list[str], timeout_s: int,
+            tiny: bool) -> dict:
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), *extra]
+    if tiny:
+        cmd.append("--tiny")
+    # The child's bring-up watchdog must fire BEFORE our subprocess
+    # timeout, or a hang-mode wedged lease dies as a structureless
+    # rc=124 instead of bench.py's tpu_unavailable record — and the
+    # sweep's early-abort (which keys on that record) never triggers.
+    env = {**os.environ,
+           "BENCH_TIMEOUT_S": str(max(timeout_s - 120, 60))}
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, cwd=REPO, env=env)
+        rc, out = proc.returncode, proc.stdout
+        tail = (proc.stderr or "")[-800:]
+    except subprocess.TimeoutExpired as e:
+        rc, out = 124, (e.stdout or "")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        tail = "sweep-level timeout"
+    parsed = None
+    for line in reversed((out or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    return {"cmd": " ".join(cmd), "rc": rc, "parsed": parsed,
+            "seconds": round(time.time() - t0, 1),
+            **({} if rc == 0 else {"stderr_tail": tail})}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--only", default="",
+                   help="run arms whose name contains this substring")
+    p.add_argument("--timeout", type=int, default=1200,
+                   help="per-arm wall clock budget (seconds)")
+    p.add_argument("--tiny", action="store_true",
+                   help="CI smoke: pass --tiny to the arms that take it "
+                        "(numbers are NOT comparable to real runs)")
+    p.add_argument("--out", default=os.path.join(REPO, "BENCH_SWEEP.json"))
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    arms = [(n, a) for n, a in ARMS if args.only in n]
+    if args.tiny:
+        # --tiny exists on the llama decode/spec/serve benches only
+        arms = [(n, a) for n, a in arms
+                if any(k in n for k in ("decode", "spec", "serve"))
+                and "host" not in n]
+    if not arms:
+        print(f"no arms match --only {args.only!r}", file=sys.stderr)
+        return 2
+    if args.dry_run:
+        for name, extra in arms:
+            print(f"{name}: python bench.py {' '.join(extra)}"
+                  f"{' --tiny' if args.tiny else ''}")
+        return 0
+
+    report: dict[str, dict] = {}
+    for i, (name, extra) in enumerate(arms, 1):
+        print(f"[{i}/{len(arms)}] {name} ...", flush=True)
+        report[name] = run_arm(name, extra, args.timeout, args.tiny)
+        r = report[name]
+        status = (r["parsed"]["metric"] + "=" + str(r["parsed"]["value"])
+                  if r["parsed"] and r["parsed"].get("metric")
+                  else f"rc={r['rc']}")
+        print(f"    {status} ({r['seconds']}s)", flush=True)
+        with open(args.out, "w") as f:  # persist incrementally
+            json.dump(report, f, indent=1)
+        if (r["parsed"] and r["parsed"].get("error") == "tpu_unavailable"
+                ) or r["rc"] == 124:
+            print("device lease unavailable (or arm hang) — aborting "
+                  "the sweep (every further arm would fail the same "
+                  "way)", file=sys.stderr)
+            return 3
+    ok = sum(1 for r in report.values() if r["rc"] == 0)
+    print(f"done: {ok}/{len(report)} arms ok → {args.out}")
+    return 0 if ok == len(report) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
