@@ -134,7 +134,8 @@ def _decode_step(model, params, cache, ids):
     return logits[:, -1], updated["cache"]
 
 
-def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0):
+def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0,
+                  min_p: float = 0.0):
     """THE sampling law's logit filtering — temperature scaling, top-k
     truncation, then top-p (nucleus) truncation. Single definition shared
     by the direct sampler below, speculative.py's draft/verify
@@ -145,7 +146,11 @@ def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0):
     must be > 0. ``top_p`` in (0, 1) keeps the smallest sorted prefix
     whose cumulative probability reaches top_p (HF semantics: a token
     survives iff the mass strictly BEFORE it is < top_p, so the argmax
-    always survives); 0 disables."""
+    always survives); 0 disables. ``min_p`` in (0, 1) keeps tokens whose
+    probability is >= min_p x the max probability (Nguyen et al. 2024 —
+    an entropy-adaptive floor: permissive when the model is uncertain,
+    strict when confident; applies after top-k/top-p, argmax always
+    survives); 0 disables."""
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
@@ -163,22 +168,26 @@ def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0):
         keep = jnp.take_along_axis(before < top_p,
                                    jnp.argsort(srt_idx, axis=-1), axis=-1)
         logits = jnp.where(keep, logits, -jnp.inf)
+    if 0.0 < min_p < 1.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        logits = jnp.where(probs >= floor, logits, -jnp.inf)
     return logits
 
 
 def _sample(logits, rng, temperature: float, top_k: int,
-            top_p: float = 0.0):
+            top_p: float = 0.0, min_p: float = 0.0):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(
-        rng, filter_logits(logits, temperature, top_k, top_p), axis=-1
-    ).astype(jnp.int32)
+        rng, filter_logits(logits, temperature, top_k, top_p, min_p),
+        axis=-1).astype(jnp.int32)
 
 
 def generate(model, params, prompt_ids, max_new_tokens: int,
              *, temperature: float = 0.0, top_k: int = 0,
-             top_p: float = 0.0, rng=None, eos_id: int | None = None,
-             mesh=None) -> jnp.ndarray:
+             top_p: float = 0.0, min_p: float = 0.0, rng=None,
+             eos_id: int | None = None, mesh=None) -> jnp.ndarray:
     """Generate continuations for a (B, S) int32 prompt batch.
 
     Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
@@ -218,7 +227,7 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
     done = jnp.zeros((B,), bool)
     for i in range(max_new_tokens):
         rng, step_rng = jax.random.split(rng)
-        nxt = _sample(logits, step_rng, temperature, top_k, top_p)
+        nxt = _sample(logits, step_rng, temperature, top_k, top_p, min_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
@@ -305,7 +314,8 @@ def _alloc_cache(decoder, batch: int, enc):
 
 def generate_seq2seq(model_cfg, precision, params, input_ids,
                      max_new_tokens: int, *, temperature: float = 0.0,
-                     top_k: int = 0, top_p: float = 0.0, rng=None,
+                     top_k: int = 0, top_p: float = 0.0,
+                     min_p: float = 0.0, rng=None,
                      eos_id: int | None = 1, decoder_start_id: int = 0,
                      attention_mask=None) -> jnp.ndarray:
     """Encoder-decoder generation (t5): encode the (B, Se) source once,
@@ -331,7 +341,7 @@ def generate_seq2seq(model_cfg, precision, params, input_ids,
         logits, cache = _seq2seq_decode_step(
             decoder, params, cache, ids, enc, attention_mask)
         rng, step_rng = jax.random.split(rng)
-        nxt = _sample(logits, step_rng, temperature, top_k, top_p)
+        nxt = _sample(logits, step_rng, temperature, top_k, top_p, min_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)

@@ -147,16 +147,17 @@ def _set_row_index(row_cache, pos):
         lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
 
 
-@partial(jax.jit, static_argnums=(3, 4))
-def _sample_rows(logits, rng, temperature, top_k: int, top_p: float):
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def _sample_rows(logits, rng, temperature, top_k: int, top_p: float,
+                 min_p: float = 0.0):
     """Per-row sampling: rows with temperature 0 are greedy, others sample
-    at their own temperature under shared static top-k/top-p. Also
+    at their own temperature under shared static top-k/top-p/min-p. Also
     returns each emitted token's log-probability under the RAW model
     distribution (pre-temperature/filtering — comparable across requests
     regardless of their sampling settings)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     f = filter_logits(logits, jnp.maximum(temperature, 1e-6)[:, None],
-                      top_k, top_p)
+                      top_k, top_p, min_p)
     sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
     tok = jnp.where(temperature == 0.0, greedy, sampled)
     raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -218,9 +219,9 @@ class ContinuousBatcher:
 
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,
-                 top_p: float = 0.0, rng=None, min_bucket: int = 16,
-                 mesh=None):
-        self._init_common(params, slots, top_k, top_p, rng)
+                 top_p: float = 0.0, min_p: float = 0.0, rng=None,
+                 min_bucket: int = 16, mesh=None):
+        self._init_common(params, slots, top_k, top_p, rng, min_p)
         self.mesh = mesh
         self.model = build_serving_model(model_cfg, precision)
         # session resume ingests multi-token turns at per-row offsets
@@ -246,11 +247,13 @@ class ContinuousBatcher:
         zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
         return jax.device_put(zeros, _cache_shardings(self.mesh, shapes))
 
-    def _init_common(self, params, slots, top_k, top_p, rng) -> None:
+    def _init_common(self, params, slots, top_k, top_p, rng,
+                     min_p: float = 0.0) -> None:
         self.params = params
         self.slots = slots
         self.top_k = top_k
         self.top_p = top_p
+        self.min_p = min_p
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def _build_buckets(self, cap: int, min_bucket: int) -> None:
@@ -446,7 +449,7 @@ class ContinuousBatcher:
         tok, lp = _sample_rows(
             last_logits, step_rng,
             jnp.asarray([req.temperature], jnp.float32),
-            self.top_k, self.top_p)
+            self.top_k, self.top_p, self.min_p)
         first = int(tok[0])
         self.stats["generated_tokens"] += 1
         self._req[r] = req
@@ -606,7 +609,7 @@ class ContinuousBatcher:
         self.rng, step_rng = jax.random.split(self.rng)
         nxt_dev, lp_dev = _sample_rows(
             logits, step_rng, jnp.asarray(self._temp), self.top_k,
-            self.top_p)
+            self.top_p, self.min_p)
         nxt, lps = np.asarray(nxt_dev), np.asarray(lp_dev)
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots
@@ -661,8 +664,9 @@ class Seq2SeqContinuousBatcher(ContinuousBatcher):
 
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,
-                 top_p: float = 0.0, rng=None, min_bucket: int = 16,
-                 source_cap: int = 0, decoder_start_id: int = 0):
+                 top_p: float = 0.0, min_p: float = 0.0, rng=None,
+                 min_bucket: int = 16, source_cap: int = 0,
+                 decoder_start_id: int = 0):
         from pytorch_distributed_train_tpu.models.t5 import (
             t5_decode_step,
             t5_encoder,
@@ -674,7 +678,7 @@ class Seq2SeqContinuousBatcher(ContinuousBatcher):
                 f"{model_cfg.name!r}")
         dtype = jnp.dtype(precision.compute_dtype)
         param_dtype = jnp.dtype(precision.param_dtype)
-        self._init_common(params, slots, top_k, top_p, rng)
+        self._init_common(params, slots, top_k, top_p, rng, min_p)
         self.encoder = t5_encoder(model_cfg, dtype, param_dtype)
         self.model = t5_decode_step(model_cfg, dtype, param_dtype,
                                     max_decode_len=model_cfg.max_seq_len,

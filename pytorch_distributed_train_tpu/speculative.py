@@ -49,13 +49,13 @@ from pytorch_distributed_train_tpu.generate import (
 
 
 def _filtered_probs(logits, temperature: float, top_k: int,
-                    top_p: float = 0.0):
+                    top_p: float = 0.0, min_p: float = 0.0):
     """Temperature/top-k/top-p-adjusted probabilities. Both models' laws
     are modified identically — via generate.filter_logits, the SAME
     filtering generate() samples with — and spec sampling is exact w.r.t.
     the modified target law (the standard convention). logits: (..., V)."""
-    return jax.nn.softmax(filter_logits(logits, temperature, top_k, top_p),
-                          axis=-1)
+    return jax.nn.softmax(
+        filter_logits(logits, temperature, top_k, top_p, min_p), axis=-1)
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
@@ -71,24 +71,25 @@ def _step_logits(model, params, cache, ids):
     return logits, updated["cache"]
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnums=(2, 3, 4, 5))
 def _draft_sample(logits_last, rng, temperature: float, top_k: int,
-                  top_p: float = 0.0):
+                  top_p: float = 0.0, min_p: float = 0.0):
     """One fused dispatch per proposed token: (token, draft probs)."""
     if temperature == 0.0:
         # _accept's greedy branch never reads p_draft — skip the
         # full-vocab softmax and return a placeholder.
         return (jnp.argmax(logits_last).astype(jnp.int32),
                 jnp.zeros((logits_last.shape[-1],), jnp.float32))
-    p = _filtered_probs(logits_last, temperature, top_k, top_p)
+    p = _filtered_probs(logits_last, temperature, top_k, top_p, min_p)
     tok = jax.random.categorical(
         rng, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
     return tok, p
 
 
-@partial(jax.jit, static_argnums=(3, 4, 5, 7))
+@partial(jax.jit, static_argnums=(3, 4, 5, 7, 8))
 def _accept(rng, draft_tokens, p_draft, k: int, temperature: float,
-            top_k: int, t_logits, top_p: float = 0.0):
+            top_k: int, t_logits, top_p: float = 0.0,
+            min_p: float = 0.0):
     """The accept/resample decision, fused on device.
 
     draft_tokens: (k,) int32; p_draft: (k, V) draft probabilities for the
@@ -108,7 +109,8 @@ def _accept(rng, draft_tokens, p_draft, k: int, temperature: float,
         # rejected → the target's own argmax at position n; all accepted
         # → bonus argmax. Both are t_choice[n].
         return n, t_choice[n]
-    p_t = _filtered_probs(t_logits, temperature, top_k, top_p)  # (k+1, V)
+    p_t = _filtered_probs(t_logits, temperature, top_k, top_p,
+                          min_p)  # (k+1, V)
     p_t_k = p_t[:k]
     rng_u, rng_res, rng_bonus = jax.random.split(rng, 3)
     p_d_tok = jnp.take_along_axis(
@@ -151,7 +153,8 @@ def speculative_generate(model_cfg, precision, params,
                          draft_model_cfg, draft_params,
                          prompt_ids, max_new_tokens: int,
                          *, k: int = 4, temperature: float = 0.0,
-                         top_k: int = 0, top_p: float = 0.0, rng=None,
+                         top_k: int = 0, top_p: float = 0.0,
+                         min_p: float = 0.0, rng=None,
                          eos_id: int | None = None,
                          return_stats: bool = False):
     """Generate ``max_new_tokens`` continuation tokens for a (1, S)
@@ -219,7 +222,7 @@ def speculative_generate(model_cfg, precision, params,
         for i in range(k):
             rng, r = jax.random.split(rng)
             tok, p = _draft_sample(logits[0, -1], r, temperature, top_k,
-                                   top_p)
+                                   top_p, min_p)
             draft_tokens.append(tok)
             draft_probs.append(p)
             if i + 1 < k:  # d_k's own forward is never needed this round
@@ -235,7 +238,7 @@ def speculative_generate(model_cfg, precision, params,
             target_multi, params, t_cache, v_in)
         rng, r = jax.random.split(rng)
         n, nxt = _accept(r, draft_vec, p_draft, k, temperature, top_k,
-                         t_logits[0].astype(jnp.float32), top_p)
+                         t_logits[0].astype(jnp.float32), top_p, min_p)
         n = int(n)
 
         # ---- commit + roll both caches back to the accepted prefix

@@ -259,3 +259,24 @@ def test_generate_top_p_samples_only_from_nucleus(setup):
         kept = np.isfinite(np.asarray(
             filter_logits(step_logits, 1.0, 0, top_p=0.8)))
         assert kept[seq[t]], f"token at {t} outside the nucleus"
+
+
+def test_filter_logits_min_p_adaptive_floor():
+    """min_p keeps tokens with prob >= min_p * p_max: strict when the
+    model is confident, permissive when uncertain — and the argmax
+    always survives."""
+    from pytorch_distributed_train_tpu.generate import filter_logits
+
+    # confident: probs ~ [0.85, 0.1, 0.04, 0.01] -> min_p=0.2 keeps {0}
+    conf = jnp.asarray(np.log(np.array([0.85, 0.1, 0.04, 0.01],
+                                       np.float32)))
+    out = np.asarray(filter_logits(conf, 1.0, 0, min_p=0.2))
+    assert np.isfinite(out[0]) and np.isinf(out[1:]).all()
+    # uncertain: near-uniform -> the same min_p keeps everything
+    unc = jnp.asarray(np.log(np.array([0.26, 0.25, 0.25, 0.24],
+                                      np.float32)))
+    out = np.asarray(filter_logits(unc, 1.0, 0, min_p=0.2))
+    assert np.isfinite(out).all()
+    # composes after top-k: top_k=2 then min_p floors within the pair
+    out = np.asarray(filter_logits(conf, 1.0, 2, min_p=0.5))
+    assert np.isfinite(out[0]) and np.isinf(out[1:]).all()

@@ -42,6 +42,7 @@ def build(args):
     # A lone chat without a system prompt still only needs one.
     b = ContinuousBatcher(cfg.model, cfg.precision, params, slots=2,
                           top_k=args.top_k, top_p=args.top_p,
+                          min_p=args.min_p,
                           rng=jax.random.PRNGKey(args.seed))
     return tok, b
 
@@ -121,6 +122,7 @@ def main(argv=None) -> int:
     p.add_argument("--temperature", type=float, default=0.7)
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=0.0)
+    p.add_argument("--min-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quantize", default="", choices=["", "int8"])
     args = p.parse_args(argv)

@@ -40,9 +40,12 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=0.0,
                    help="nucleus sampling threshold in (0,1); 0 -> off")
+    p.add_argument("--min-p", type=float, default=0.0,
+                   help="min-p sampling: keep tokens with prob >= min_p "
+                        "x max prob (entropy-adaptive; 0 -> off)")
     p.add_argument("--num-beams", type=int, default=0,
                    help="beam-search decoding; overrides temperature/"
-                        "top-k/top-p (beams expand the full "
+                        "top-k/top-p/min-p (beams expand the full "
                         "distribution); 0 → off")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quantize", default="", choices=["", "int8"])
@@ -129,7 +132,8 @@ def main(argv=None) -> int:
                 b = Seq2SeqContinuousBatcher(
                     model_cfg, cfg.precision, params,
                     slots=args.serve_slots, top_k=args.top_k,
-                    top_p=args.top_p, rng=jax.random.PRNGKey(args.seed))
+                    top_p=args.top_p, min_p=args.min_p,
+                    rng=jax.random.PRNGKey(args.seed))
                 uid_to_i = {}
                 for i, e in enumerate(encoded):
                     uid_to_i[b.submit(e, args.max_new_tokens,
@@ -157,6 +161,7 @@ def main(argv=None) -> int:
                         model_cfg, cfg.precision, params, ids,
                         args.max_new_tokens, temperature=args.temperature,
                         top_k=args.top_k, top_p=args.top_p,
+                        min_p=args.min_p,
                         rng=jax.random.PRNGKey(args.seed + i),
                         eos_id=tok.eos_id))
                 emit(i, text, out[0].tolist())
@@ -181,8 +186,8 @@ def main(argv=None) -> int:
             b = ContinuousBatcher(
                 model_cfg, cfg.precision, params,
                 slots=args.serve_slots, top_k=args.top_k,
-                top_p=args.top_p, rng=jax.random.PRNGKey(args.seed),
-                mesh=serve_mesh)
+                top_p=args.top_p, min_p=args.min_p,
+                rng=jax.random.PRNGKey(args.seed), mesh=serve_mesh)
             uid_to_i = {}
             for i, e in enumerate(encoded):
                 uid_to_i[b.submit(e, args.max_new_tokens,
@@ -221,7 +226,7 @@ def main(argv=None) -> int:
                 out = np.asarray(generate(
                     model, params, ids, args.max_new_tokens,
                     temperature=args.temperature, top_k=args.top_k,
-                    top_p=args.top_p,
+                    top_p=args.top_p, min_p=args.min_p,
                     rng=jax.random.PRNGKey(args.seed + i),
                     eos_id=tok.eos_id, mesh=mesh))
             emit(i, text, out[0, len(e):].tolist())

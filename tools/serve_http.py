@@ -24,8 +24,9 @@ API (JSON over POST, one object per request):
   prompt is then just the NEW turn — no resend of history). Sessions
   evict LRU under slot pressure (a resume then 404s in-band with
   finish_reason "session_evicted").
-  ``top_k``/``top_p`` are SERVER-wide flags (static jit args — per-request
-  values would recompile; temperature is the per-request knob).
+  ``top_k``/``top_p``/``min_p`` are SERVER-wide flags (static jit args —
+  per-request values would recompile; temperature is the per-request
+  knob).
   ``logprobs: true`` adds each generated token's log-probability under
   the raw model distribution.
 - ``POST /v1/preload``: {prompt} → {session} — prefill a shared prefix
@@ -526,7 +527,7 @@ def build_service(args) -> BatcherService:
     cls = (Seq2SeqContinuousBatcher if cfg.model.name.startswith("t5")
            else ContinuousBatcher)
     batcher = cls(cfg.model, cfg.precision, params, slots=args.slots,
-                  top_k=args.top_k, top_p=args.top_p,
+                  top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
                   rng=jax.random.PRNGKey(args.seed))
     return BatcherService(batcher, tok,
                           max_new_default=args.max_new_default)
@@ -544,6 +545,7 @@ def main(argv=None) -> int:
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=0.0)
+    p.add_argument("--min-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max-new-default", type=int, default=64)
     p.add_argument("--quantize", default="", choices=["", "int8"])
