@@ -97,6 +97,8 @@ class CheckpointManager:
             # ckpt written before EMA was enabled: restore without the
             # mirror, re-seed it from params below
             template.pop("ema_params")
+        if "swa_count" in template and not self._ckpt_has(step, "swa_count"):
+            template.pop("swa_count")  # pre-SWA ckpt: count restarts at 0
         restored = self.mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -116,6 +118,11 @@ class CheckpointManager:
             # EMA was enabled has no mirror — re-seed from restored params.
             state = state.replace(
                 ema_params=sav.get("ema_params", sav["params"]))
+        if getattr(abstract_state, "swa_count", None) is not None:
+            # Without this the resumed running mean would weight its next
+            # snapshot 1/1 and erase every pre-restart fold.
+            state = state.replace(
+                swa_count=sav.get("swa_count", jnp.int32(0)))
         if abstract_state.dynamic_scale is not None and "dynamic_scale" in sav:
             state = state.replace(
                 dynamic_scale=abstract_state.dynamic_scale.replace(**sav["dynamic_scale"])
@@ -283,6 +290,8 @@ def _savable(state: TrainState) -> dict[str, Any]:
     }
     if state.ema_params is not None:
         d["ema_params"] = state.ema_params
+    if getattr(state, "swa_count", None) is not None:
+        d["swa_count"] = state.swa_count
     if state.dynamic_scale is not None:
         d["dynamic_scale"] = {
             "scale": state.dynamic_scale.scale,

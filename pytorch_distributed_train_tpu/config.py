@@ -270,6 +270,19 @@ class OptimConfig:
     # Polyak/EMA weight averaging (torch-recipe "model EMA"): decay per
     # step, 0 → off. Eval runs on the EMA mirror when enabled.
     ema_decay: float = 0.0
+    # Stochastic Weight Averaging (torch.optim.swa_utils): from the
+    # swa_start_step-th OPTIMIZER UPDATE on (denominated like
+    # warmup_steps — under accum_steps one update spans accum micro-
+    # steps), the mirror keeps the EQUAL-WEIGHT running mean of params
+    # sampled every swa_every updates; eval runs on it (same mirror as
+    # EMA — the two are mutually exclusive). Like torch's AveragedModel,
+    # BN stats are NOT re-estimated automatically (torch needs an
+    # explicit update_bn pass too).
+    swa_start_step: int = 0  # 0 → off
+    swa_every: int = 1
+    # SWALR: constant LR once SWA collection starts (0 → keep the base
+    # schedule running)
+    swa_lr: float = 0.0
     # Grad-compression hook (SURVEY C8 ddp_comm_hooks equivalent):
     # "none" | "bf16" | "fp16" | "powersgd" (grad_hooks.py)
     grad_hook: str = "none"

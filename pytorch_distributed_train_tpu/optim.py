@@ -16,6 +16,7 @@ import itertools
 import re
 
 import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -187,6 +188,18 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0,
         opt_cfg, max(1, total_steps // accum),
         max(1, steps_per_epoch // accum) if steps_per_epoch else 0,
     )
+    swa_start = getattr(opt_cfg, "swa_start_step", 0)
+    swa_lr = getattr(opt_cfg, "swa_lr", 0.0)
+    if swa_start > 0 and swa_lr > 0.0:
+        # SWALR (torch.optim.swa_utils.SWALR): hold a constant LR once
+        # SWA collection starts — averaging wants iterates bouncing
+        # around a flat region at fixed step size, not a decayed-to-zero
+        # tail. Denominated in optimizer updates like warmup.
+        base_sched = sched
+        start_upd = max(swa_start, 1)  # already denominated in updates
+
+        def sched(count):  # noqa: F811 — deliberate wrap
+            return jnp.where(count >= start_upd, swa_lr, base_sched(count))
     parts = []
     # Comm-hook analogue (SURVEY C8): compression runs where the DDP hook
     # did — on the raw gradient, before clipping and the optimizer.

@@ -111,7 +111,8 @@ def _tree_finite(tree) -> jnp.ndarray:
 
 
 def make_train_step(model, loss_fn: Callable, tx,
-                    ema_decay: float = 0.0, mixup=None,
+                    ema_decay: float = 0.0, swa_start: int = 0,
+                    swa_every: int = 1, mixup=None,
                     module_grad_norms: bool = False,
                     param_transform: Callable | None = None,
                     teacher_fn: Callable | None = None) -> Callable:
@@ -124,6 +125,12 @@ def make_train_step(model, loss_fn: Callable, tx,
     so it costs a few reductions, not a host transfer per param."""
     if not 0.0 <= ema_decay < 1.0:
         raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
+    if swa_start > 0 and ema_decay > 0.0:
+        raise ValueError(
+            "ema_decay and swa_start_step are mutually exclusive — both "
+            "own the single averaged-params mirror")
+    if swa_every < 1:
+        raise ValueError(f"swa_every must be >= 1, got {swa_every}")
 
     def train_step(state: TrainState, batch: dict, rng: jax.Array):
         # Per-step dropout key: fold the step counter into the base key —
@@ -165,7 +172,9 @@ def make_train_step(model, loss_fn: Callable, tx,
             grads = jax.tree.map(lambda g: g / scale, grads)
             finite = _tree_finite(grads)
             stepped = state.apply_gradients(tx, grads, new_stats,
-                                            ema_decay=ema_decay, loss=loss)
+                                            ema_decay=ema_decay,
+                                            swa_start=swa_start,
+                                            swa_every=swa_every, loss=loss)
             skipped = state.replace(step=state.step + 1)  # step advances either way
             new_state = jax.tree.map(
                 lambda new, old: jnp.where(finite, new, old), stepped, skipped
@@ -176,7 +185,10 @@ def make_train_step(model, loss_fn: Callable, tx,
             metrics_extra = {"loss_scale": scale, "grads_finite": finite}
         else:
             new_state = state.apply_gradients(tx, grads, new_stats,
-                                              ema_decay=ema_decay, loss=loss)
+                                              ema_decay=ema_decay,
+                                              swa_start=swa_start,
+                                              swa_every=swa_every,
+                                              loss=loss)
             metrics_extra = {}
 
         gnorm = optax_global_norm(grads)

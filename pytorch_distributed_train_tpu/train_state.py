@@ -74,10 +74,14 @@ class TrainState:
     # mirror updated ema = d*ema + (1-d)*params each step; None when off.
     # Params only — BN stats are not averaged (matters only for BN models;
     # the classic EMA consumer here is ViT, which has none).
+    # SWA (torch.optim.swa_utils) reuses the SAME mirror with an
+    # equal-weight running mean; swa_count is how many snapshots it holds.
     ema_params: Any = None
+    swa_count: Any = None  # i32 scalar when SWA is on, else None
 
     def apply_gradients(self, tx: optax.GradientTransformation, grads,
                         new_batch_stats=None, ema_decay: float = 0.0,
+                        swa_start: int = 0, swa_every: int = 1,
                         loss=None):
         # reduce_on_plateau in the chain REQUIRES value=; other chains
         # reject the kwarg. Detect the plateau state structurally (trace-
@@ -91,7 +95,31 @@ class TrainState:
                 grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         ema = self.ema_params
-        if ema is not None and ema_decay > 0.0:
+        swa_count = self.swa_count
+        if ema is not None and swa_start > 0:
+            # SWA: from the swa_start-th OPTIMIZER UPDATE on (the same
+            # denomination as warmup_steps), fold every swa_every-th
+            # update's params into the equal-weight running mean
+            # avg += (p - avg)/(n+1). Under MultiSteps the update counter
+            # is gradient_step, so accumulation cannot alias the stride.
+            if isinstance(new_opt_state, optax.MultiStepsState):
+                upd = new_opt_state.gradient_step
+                boundary = new_opt_state.mini_step == 0
+            else:
+                upd = self.step + 1
+                boundary = jnp.bool_(True)
+            take = boundary & (upd >= swa_start) & (
+                (upd - swa_start) % swa_every == 0)
+            n = swa_count + take.astype(jnp.int32)
+            ema = jax.tree.map(
+                lambda avg, p: jnp.where(
+                    take,
+                    avg + (p.astype(avg.dtype) - avg)
+                    / jnp.maximum(n, 1).astype(avg.dtype),
+                    avg),
+                ema, new_params)
+            swa_count = n
+        elif ema is not None and ema_decay > 0.0:
             stepped = optax.incremental_update(new_params, ema,
                                                1.0 - ema_decay)
             if isinstance(new_opt_state, optax.MultiStepsState):
@@ -113,6 +141,7 @@ class TrainState:
                 new_batch_stats if new_batch_stats is not None else self.batch_stats
             ),
             ema_params=ema,
+            swa_count=swa_count,
         )
 
     @property
@@ -122,12 +151,13 @@ class TrainState:
 
     @classmethod
     def create(cls, *, params, tx, batch_stats=None, dynamic_scale=None,
-               ema: bool = False):
+               ema: bool = False, swa: bool = False):
         return cls(
             step=jnp.int32(0),
             params=params,
             opt_state=tx.init(params),
             batch_stats=batch_stats if batch_stats is not None else {},
             dynamic_scale=dynamic_scale,
-            ema_params=params if ema else None,
+            ema_params=params if (ema or swa) else None,
+            swa_count=jnp.int32(0) if swa else None,
         )

@@ -193,7 +193,9 @@ class Trainer:
             param_transform = lambda p: lora_lib.merge(p, cfg.lora)  # noqa: E731
         train_step = steps_lib.make_train_step(
             self.model, self.loss_fn, self.tx,
-            ema_decay=cfg.optim.ema_decay, mixup=mixup,
+            ema_decay=cfg.optim.ema_decay,
+            swa_start=getattr(cfg.optim, "swa_start_step", 0),
+            swa_every=getattr(cfg.optim, "swa_every", 1), mixup=mixup,
             module_grad_norms=cfg.obs.log_module_grad_norms,
             param_transform=param_transform,
             teacher_fn=self.teacher_fn)
@@ -314,6 +316,7 @@ class Trainer:
         return TrainState.create(
             params=params, tx=self.tx, batch_stats=batch_stats,
             dynamic_scale=ds, ema=self.cfg.optim.ema_decay > 0.0,
+            swa=getattr(self.cfg.optim, "swa_start_step", 0) > 0,
         )
 
     def _dummy_inputs(self) -> tuple:

@@ -556,3 +556,116 @@ def test_lion_sign_updates_and_single_moment():
                      jax.tree_util.tree_leaves(adam_tx.init(params))
                      if getattr(l, "ndim", 0) >= 2)
     assert lion_elems == adam_elems // 2
+
+
+# --------------------------------------------------------------- SWA
+
+def test_swa_mirror_is_exact_running_mean():
+    """From swa_start on, the mirror must equal the arithmetic mean of
+    the params after every swa_every-th optimizer step — checked exactly
+    against host-side snapshots."""
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.asarray([1.0, 2.0])}
+    state = TrainState.create(params=params, tx=tx, swa=True)
+    grads = {"w": jnp.asarray([1.0, -1.0])}
+    snapshots = []
+    for i in range(6):
+        state = state.apply_gradients(tx, grads, swa_start=3, swa_every=1)
+        snapshots.append(np.asarray(state.params["w"]))
+    want = np.mean(snapshots[2:], axis=0)  # steps 3..6 inclusive
+    np.testing.assert_allclose(np.asarray(state.ema_params["w"]), want,
+                               rtol=1e-6)
+    assert int(state.swa_count) == 4
+    # eval runs on the mirror
+    np.testing.assert_allclose(np.asarray(state.eval_params["w"]), want,
+                               rtol=1e-6)
+
+
+def test_swa_every_strides_the_snapshots():
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    tx = optax.sgd(0.5)
+    state = TrainState.create(params={"w": jnp.asarray(0.0)}, tx=tx,
+                              swa=True)
+    grads = {"w": jnp.asarray(-1.0)}  # params: 0.5, 1.0, 1.5, ...
+    snaps = []
+    for i in range(8):
+        state = state.apply_gradients(tx, grads, swa_start=2, swa_every=3)
+        snaps.append(float(state.params["w"]))
+    # qualifying steps: 2, 5, 8 → params 1.0, 2.5, 4.0 → mean 2.5
+    np.testing.assert_allclose(float(state.ema_params["w"]), 2.5,
+                               rtol=1e-6)
+    assert int(state.swa_count) == 3
+
+
+def test_swalr_holds_constant_after_start():
+    from pytorch_distributed_train_tpu.config import OptimConfig
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+
+    cfg = OptimConfig(name="sgd", learning_rate=1.0, schedule="cosine",
+                      warmup_steps=0, swa_start_step=50, swa_lr=0.05)
+    _, sched = make_optimizer(cfg, total_steps=100)
+    assert float(sched(10)) > 0.5          # cosine still high early
+    assert abs(float(sched(60)) - 0.05) < 1e-9
+    assert abs(float(sched(99)) - 0.05) < 1e-9
+
+
+def test_swa_and_ema_mutually_exclusive():
+    import pytest
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import OptimConfig
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+
+    tx, _ = make_optimizer(OptimConfig(name="sgd", learning_rate=0.1,
+                                       schedule="constant",
+                                       warmup_steps=0), total_steps=10)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        steps_lib.make_train_step(None, get_loss_fn("softmax_xent"), tx,
+                                  ema_decay=0.9, swa_start=5)
+
+
+def test_swa_stride_counts_optimizer_updates_under_accumulation():
+    """accum=2, swa_every=2: snapshots fold at UPDATES 2, 4 (micro-steps
+    4, 8), never at intermediate micro-steps — the stride is denominated
+    in optimizer updates, immune to accumulation aliasing."""
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    tx = optax.MultiSteps(optax.sgd(0.5), 2)
+    state = TrainState.create(params={"w": jnp.asarray(0.0)}, tx=tx,
+                              swa=True)
+    grads = {"w": jnp.asarray(-1.0)}
+    counts = []
+    for i in range(8):
+        state = state.apply_gradients(tx, grads, swa_start=2, swa_every=2)
+        counts.append(int(state.swa_count))
+    # updates complete at micro-steps 2,4,6,8 (gradient_step 1..4);
+    # qualifying updates are 2 and 4 -> folds land at micro 4 and 8
+    assert counts == [0, 0, 0, 1, 1, 1, 1, 2]
+
+
+def test_swa_mirror_keeps_param_dtype():
+    import optax
+
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.asarray([1.0, 2.0], jnp.bfloat16)}
+    state = TrainState.create(params=params, tx=tx, swa=True)
+    for _ in range(4):
+        state = state.apply_gradients(
+            tx, {"w": jnp.asarray([1.0, -1.0], jnp.bfloat16)},
+            swa_start=2, swa_every=1)
+    assert state.ema_params["w"].dtype == jnp.bfloat16
