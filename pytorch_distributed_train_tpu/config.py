@@ -283,6 +283,12 @@ class OptimConfig:
     # SWALR: constant LR once SWA collection starts (0 → keep the base
     # schedule running)
     swa_lr: float = 0.0
+    # torch swa_utils.update_bn analogue: after training, re-estimate BN
+    # statistics for the AVERAGED weights over this many training
+    # batches (averaged weights + stale stats is the classic SWA
+    # mistake). 0 → off; no-op for BN-free models. Runs before the
+    # final evaluation when SWA/EMA is on.
+    swa_update_bn_batches: int = 0
     # Grad-compression hook (SURVEY C8 ddp_comm_hooks equivalent):
     # "none" | "bf16" | "fp16" | "powersgd" (grad_hooks.py)
     grad_hook: str = "none"

@@ -138,6 +138,11 @@ class ResNet(nn.Module):
     num_filters: int = 64
     cifar_stem: bool = False
     stem: str = "conv"  # conv | space_to_depth (ImageNet stem only)
+    # 0.0 turns each train-mode call's running stats into exactly THAT
+    # batch's stats — the probe trainer.update_bn uses to re-estimate
+    # statistics for averaged (SWA/EMA) weights, torch swa_utils
+    # update_bn style
+    bn_momentum: float = 0.9
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -153,7 +158,7 @@ class ResNet(nn.Module):
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
-            momentum=0.9,
+            momentum=self.bn_momentum,
             epsilon=1e-5,
             # stats are fp32 regardless (flax force_float32_reductions);
             # outputs follow the compute dtype to halve elementwise bandwidth

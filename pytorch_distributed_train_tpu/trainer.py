@@ -49,6 +49,14 @@ class Trainer:
             os.makedirs(cfg.obs.compile_cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir",
                               cfg.obs.compile_cache_dir)
+        if (getattr(cfg.optim, "swa_update_bn_batches", 0) > 0
+                and cfg.optim.ema_decay == 0.0
+                and getattr(cfg.optim, "swa_start_step", 0) == 0):
+            raise ValueError(
+                "optim.swa_update_bn_batches requires weight averaging "
+                "(set optim.swa_start_step or optim.ema_decay) — "
+                "silently ignoring the knob would ship stale-stats "
+                "results the user believes were re-estimated")
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         self.batch_axes = tuple(cfg.mesh.batch_axes)
         self.model = build_model(cfg.model, cfg.precision,
@@ -378,6 +386,27 @@ class Trainer:
                     # validation metric is the acceptance-matrix number
                     self.evaluate(step)
                 self.meter.reset_clock()  # epoch boundary: don't count eval time
+            if (getattr(cfg.optim, "swa_update_bn_batches", 0) > 0
+                    and self.state.ema_params is not None
+                    and self.state.batch_stats
+                    and (self.state.swa_count is None
+                         or int(self.state.swa_count) > 0)):
+                # torch swa_utils recipe: averaged weights need freshly
+                # estimated BN stats. Guards: an SWA run that never
+                # reached swa_start has an INIT-weights mirror — stats
+                # estimated under it would poison the checkpoint. The
+                # fresh stats exist for the MIRROR; the eval (logged
+                # under eval_swa, the deliverable metric — also what the
+                # best-checkpoint tracker sees) runs on them, then the
+                # trajectory stats come back so the cadence checkpoint
+                # stays consistent with state.params for resume (torch
+                # keeps swa_model's BN stats separate for the same
+                # reason).
+                trajectory_stats = self.state.batch_stats
+                self.update_bn(cfg.optim.swa_update_bn_batches)
+                self.evaluate(step, prefix="eval_swa")
+                self.state = self.state.replace(
+                    batch_stats=trajectory_stats)
         finally:
             self.heartbeat.stop()
             self.ckpt.save(self.state, epoch=epoch, force=True, step=step)
@@ -412,7 +441,49 @@ class Trainer:
             host.update(device_memory_metrics())
         self.logger.log(step, host, prefix="train")
 
-    def evaluate(self, step: int) -> dict:
+    def update_bn(self, num_batches: int = 50) -> None:
+        """Re-estimate BN running statistics for the CURRENT eval params
+        (the SWA/EMA mirror when averaging is on) — torch
+        swa_utils.update_bn: averaged weights shift every layer's
+        activation distribution, so the stats collected along the
+        trajectory are wrong for them. Mechanism: a probe model with
+        bn_momentum=0 makes one train-mode apply return exactly ONE
+        batch's statistics; the cumulative average over ``num_batches``
+        training batches (mean of batch means/vars — torch's
+        momentum=None CMA computes the same) replaces state.batch_stats.
+        No-op for stat-free models."""
+        if not self.state.batch_stats:
+            return
+        if not any(f.name == "bn_momentum"
+                   for f in dataclasses.fields(self.model)):
+            return
+        probe = dataclasses.replace(self.model, bn_momentum=0.0)
+        params = self.state.eval_params
+
+        @jax.jit
+        def batch_stats_of(stats, batch):
+            _, updated = probe.apply(
+                {"params": params, "batch_stats": stats},
+                *steps_lib.model_inputs(batch), train=True,
+                mutable=["batch_stats"])
+            return updated["batch_stats"]
+
+        total = None
+        n = 0
+        for batch in self.train_epoch_fn(0):
+            stats = batch_stats_of(self.state.batch_stats, batch)
+            total = stats if total is None else jax.tree.map(
+                jnp.add, total, stats)
+            n += 1
+            if n >= num_batches:
+                break
+        if n == 0:
+            return
+        avg = jax.tree.map(lambda t: t / n, total)
+        self.state = self.state.replace(batch_stats=avg)
+        self.recorder.record("update_bn", int(self.state.step), batches=n)
+
+    def evaluate(self, step: int, prefix: str = "eval") -> dict:
         sums: dict[str, float] = {}
         n = 0
         for batch in self.eval_epoch_fn(0):
@@ -423,7 +494,7 @@ class Trainer:
         if n == 0:
             return {}
         avg = {k: v / n for k, v in sums.items()}
-        self.logger.log(step, avg, prefix="eval")
+        self.logger.log(step, avg, prefix=prefix)
         if self.best_ckpt is not None:
             if self.best_ckpt.update(
                     avg, self.state, step=step,

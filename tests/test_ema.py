@@ -197,3 +197,75 @@ def test_ema_checkpoint_roundtrip(devices8, tmp_path):
                     jax.tree_util.tree_leaves(restored.ema_params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mgr.close()
+
+
+# --------------------------------------------------- SWA update_bn
+
+def test_update_bn_reestimates_stats_for_averaged_weights(tmp_path):
+    """update_bn must replace batch_stats with the cumulative average of
+    per-batch statistics computed UNDER THE MIRROR weights (the torch
+    swa_utils.update_bn recipe) — checked against a manual momentum-0
+    recomputation, and the trainer hook must run it before the final
+    eval."""
+    import dataclasses
+
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    cfg = get_preset("resnet18_cifar10")
+    cfg.apply_overrides([
+        "data.dataset=synthetic_images", "data.synthetic_size=128",
+        "data.batch_size=32", "optim.swa_start_step=2", "optim.swa_lr=0.01",
+        "optim.swa_update_bn_batches=3",
+        f"checkpoint.dir={tmp_path}/ck", "checkpoint.save_every_steps=0",
+        "checkpoint.async_save=false", "obs.log_every_steps=100",
+    ])
+    tr = Trainer(cfg)
+    tr.fit(max_steps=4)
+    state = tr.state
+    assert state.ema_params is not None and int(state.swa_count) >= 1
+    # the fit hook restores TRAJECTORY stats afterwards (the cadence
+    # checkpoint must stay consistent with state.params for resume), so
+    # verify the mechanism by invoking update_bn directly:
+    trajectory = jax.tree.map(np.asarray, state.batch_stats)
+    tr.update_bn(3)
+    got = jax.tree.map(np.asarray, tr.state.batch_stats)
+
+    # manual recomputation: momentum-0 probe over the same first 3 batches
+    probe = dataclasses.replace(tr.model, bn_momentum=0.0)
+    total, n = None, 0
+    for batch in tr.train_epoch_fn(0):
+        _, upd = probe.apply(
+            {"params": state.eval_params,
+             "batch_stats": state.batch_stats},
+            batch["image"], train=True, mutable=["batch_stats"])
+        stats = upd["batch_stats"]
+        total = stats if total is None else jax.tree.map(
+            jnp.add, total, stats)
+        n += 1
+        if n == 3:
+            break
+    want = jax.tree.map(lambda t: np.asarray(t / n), total)
+    for w, g in zip(jax.tree_util.tree_leaves(want),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+    # and the re-estimated stats genuinely differ from the trajectory's
+    diffs = [float(np.abs(a - b).max()) for a, b in
+             zip(jax.tree_util.tree_leaves(trajectory),
+                 jax.tree_util.tree_leaves(got))]
+    assert max(diffs) > 1e-6
+
+
+def test_update_bn_knob_without_averaging_refused(tmp_path):
+    import pytest
+
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    cfg = get_preset("resnet18_cifar10")
+    cfg.apply_overrides(["optim.swa_update_bn_batches=10",
+                         f"checkpoint.dir={tmp_path}/ck"])
+    with pytest.raises(ValueError, match="weight averaging"):
+        Trainer(cfg)
