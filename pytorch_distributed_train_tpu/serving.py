@@ -500,6 +500,16 @@ class ContinuousBatcher:
                 return r
         return None
 
+    def release(self, sid: int) -> bool:
+        """Explicitly drop a parked session/template (frees its slot now
+        instead of waiting for LRU pressure). Queued continuations of it
+        will surface as session_evicted."""
+        entry = self._parked.pop(sid, None)
+        if entry is None:
+            return False
+        self._parked_slots.discard(entry[0])
+        return True
+
     def cancel(self, uid: int) -> bool:
         """Stop a request: de-queue it, or free its active slot (the row
         is dead until re-admitted, like any finished slot). Parked

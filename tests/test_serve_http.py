@@ -280,3 +280,66 @@ def test_http_logprobs_field(server):
     assert all(v <= 0.0 for v in out["logprobs"])
     _, out2 = _post(port, {"prompt": "lp test", "max_tokens": 5})
     assert "logprobs" not in out2
+
+
+def test_n_completions_share_one_prefill(server):
+    """n sampled completions: one prefill (the shared template), n forks,
+    distinct outputs at temperature>0, template released afterwards."""
+    port, *_ = server
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=60) as r:
+        before = json.loads(r.read())["stats"]
+    _, out = _post(port, {"prompt": "sample from me", "max_tokens": 8,
+                          "temperature": 1.2, "n": 3, "logprobs": True})
+    assert len(out["choices"]) == 3
+    assert all("finish_reason" in c and "logprobs" in c
+               for c in out["choices"])
+    assert len({c["text"] for c in out["choices"]}) >= 2  # sampled
+    assert out["usage"]["completion_tokens"] <= 24
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=60) as r:
+        after = json.loads(r.read())["stats"]
+    assert after["preloads"] - before["preloads"] == 1
+    assert after["forks"] - before["forks"] == 3
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(port, {"prompt": "x", "max_tokens": 4, "n": 3})  # greedy
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(port, {"prompt": "x", "max_tokens": 4, "n": 2,
+                     "temperature": 1.0, "stream": True})
+    assert e.value.code == 400
+
+
+def test_n_completions_on_seq2seq_without_sessions():
+    """T5 servers have no session support: n>1 falls back to n plain
+    submits (n prefills) instead of failing with a sessions error."""
+    import serve_http
+
+    from pytorch_distributed_train_tpu.config import ModelConfig
+    from pytorch_distributed_train_tpu.config import (
+        PrecisionConfig as PC,
+    )
+    from pytorch_distributed_train_tpu.data.text import load_tokenizer
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    cfg = ModelConfig(name="t5", vocab_size=300, hidden_size=32,
+                      num_layers=2, num_heads=4, mlp_dim=64,
+                      max_seq_len=48, dropout_rate=0.0)
+    model = build_model(cfg, PC())
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 4), jnp.int32),
+                        jnp.zeros((1, 2), jnp.int32),
+                        train=False)["params"]
+    tok = load_tokenizer("")
+    b = Seq2SeqContinuousBatcher(cfg, PC(), params, slots=3)
+    service = serve_http.BatcherService(b, tok)
+    try:
+        out = service.complete_n("translate me", 5, 1.0, 3)
+        assert len(out["choices"]) == 3
+        assert b.stats["prefills"] == 3 and b.stats["preloads"] == 0
+    finally:
+        service.shutdown()

@@ -470,3 +470,17 @@ def test_logprobs_accompany_tokens(setup):
         pos = len(prompt) - 1 + i
         assert abs(lp - lp_all[pos, tok]) < 1e-3, i
         assert abs(lp - lp_all[pos].max()) < 1e-3, i  # greedy == argmax
+
+
+def test_release_frees_template_slot(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    sid = b.preload([1, 2, 3])
+    assert b.release(sid) is True
+    assert b.release(sid) is False  # already gone
+    with pytest.raises(ValueError, match="unknown session"):
+        b.submit([4], 2, prefix=sid)
+    # both slots usable again
+    u1, u2 = b.submit([5, 6], 2), b.submit([7], 2)
+    done = {c.uid for c in b.run()}
+    assert done == {u1, u2}

@@ -28,7 +28,11 @@ API (JSON over POST, one object per request):
   per-request values would recompile; temperature is the per-request
   knob).
   ``logprobs: true`` adds each generated token's log-probability under
-  the raw model distribution.
+  the raw model distribution. ``n: k`` returns k INDEPENDENT sampled
+  completions as ``choices`` (the prompt prefills once — a temporary
+  prefix template forks k ways — so extra completions cost decode
+  only); requires temperature > 0 (greedy duplicates are refused) and
+  composes with logprobs but not stream/keep/session/stop.
 - ``POST /v1/preload``: {prompt} → {session} — prefill a shared prefix
   (system prompt) once and park it; completions posted with
   ``prefix: <session>`` FORK it (the template survives, so one preload
@@ -164,6 +168,94 @@ class BatcherService:
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
             return self.batcher.preload(ids)
+
+    def complete_n(self, prompt: str, max_tokens: int,
+                   temperature: float, n: int,
+                   timeout_s: float = 600.0, *,
+                   logprobs: bool = False) -> dict:
+        """k independent sampled completions of one prompt. The prompt
+        minus its last token prefills ONCE into a temporary prefix
+        template; each of the k forks ingests just that final token (a
+        fork must ingest something to have logits to sample from) and
+        decodes its own continuation — the forks batch together in the
+        decode step, so extra completions cost decode only. The template
+        is released when all k land."""
+        if n < 2:
+            raise ValueError("n must be >= 2 (plain complete() covers 1)")
+        if temperature <= 0.0:
+            raise ValueError(
+                "n > 1 with temperature 0 would return n identical "
+                "greedy completions — set a temperature")
+        ids = self.tok.encode(prompt)
+        if not ids:
+            raise ValueError("empty prompt after tokenization")
+        events: dict[int, threading.Event] = {}
+        sid = None
+        # the shared-prefill trick needs session support (causal
+        # batchers) and a >= 2-token prompt; otherwise n plain submits
+        # still serve the request — just paying n prefills
+        share = (getattr(self.batcher, "supports_sessions", False)
+                 and len(ids) >= 2)
+
+        def _cleanup_locked():
+            """Release the template and withdraw every fork: cancel the
+            unfinished (they then never complete — no abandon marker
+            needed), drop any already-landed results (the lock excludes
+            the scheduler, so cancel-vs-finish cannot race)."""
+            nonlocal sid
+            if sid is not None:
+                self.batcher.release(sid)
+                sid = None
+            for uid in events:
+                if not self.batcher.cancel(uid):
+                    self._done.pop(uid, None)
+                self._events.pop(uid, None)
+
+        with self._lock:
+            if self.error is not None:
+                raise RuntimeError(f"scheduler dead: {self.error}")
+            try:
+                if share:
+                    sid = self.batcher.preload(ids[:-1])
+                for _ in range(n):
+                    uid = self.batcher.submit(
+                        ids[-1:] if share else ids, max_tokens,
+                        temperature=temperature, eos_id=self.tok.eos_id,
+                        prefix=sid)
+                    events[uid] = threading.Event()
+                    self._events[uid] = events[uid]
+            except (ValueError, RuntimeError):
+                _cleanup_locked()
+                raise
+        try:
+            choices = []
+            total_generated = 0
+            for uid, ev in events.items():
+                if not ev.wait(timeout_s):
+                    raise TimeoutError(f"completion {uid} timed out")
+                with self._lock:
+                    c = self._done.pop(uid, None)
+                if c is None:
+                    raise RuntimeError(f"scheduler dead: {self.error}")
+                total_generated += len(c.tokens)
+                new = trim_at_eos(c.tokens, self.tok.eos_id)
+                choice = {"text": self.tok.decode(new),
+                          "finish_reason": c.finish_reason}
+                if logprobs:
+                    choice["logprobs"] = [round(v, 6)
+                                          for v in c.logprobs[: len(new)]]
+                choices.append(choice)
+            with self._lock:
+                if sid is not None:
+                    self.batcher.release(sid)
+                    sid = None
+        except BaseException:
+            with self._lock:
+                _cleanup_locked()
+            raise
+        return {"choices": choices, "session": None,
+                "usage": {"prompt_tokens": len(ids),
+                          "completion_tokens": total_generated}}
 
     def complete(self, prompt: str, max_tokens: int, temperature: float,
                  timeout_s: float = 600.0, *, keep: bool = False,
@@ -399,6 +491,17 @@ def make_handler(service: BatcherService):
                     if isinstance(stop, str):
                         stop = [stop]
                     stop = [str(x) for x in stop if str(x)]
+                n = int(req.get("n", 1))
+                if n > 1:
+                    if (req.get("stream") or keep or session is not None
+                            or prefix is not None or stop):
+                        raise ValueError(
+                            "n > 1 composes with logprobs only (not "
+                            "stream/keep/session/prefix/stop)")
+                    self._send(200, service.complete_n(
+                        prompt, max_tokens, temperature, n,
+                        logprobs=bool(req.get("logprobs", False))))
+                    return
                 if req.get("stream"):
                     if stop and keep:
                         raise ValueError(
