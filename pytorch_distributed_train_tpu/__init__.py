@@ -25,3 +25,33 @@ from pytorch_distributed_train_tpu.config import (  # noqa: F401
     get_preset,
     list_presets,
 )
+
+# Lazy top-level façade for the training/serving surface: `from
+# pytorch_distributed_train_tpu import Trainer, generate` works without
+# paying every submodule's import (and jit registration) cost up front.
+_LAZY = {
+    "Trainer": "pytorch_distributed_train_tpu.trainer",
+    "TrainState": "pytorch_distributed_train_tpu.train_state",
+    "generate": "pytorch_distributed_train_tpu.generate",
+    "generate_seq2seq": "pytorch_distributed_train_tpu.generate",
+    "beam_search": "pytorch_distributed_train_tpu.generate",
+    "beam_search_seq2seq": "pytorch_distributed_train_tpu.generate",
+    "filter_logits": "pytorch_distributed_train_tpu.generate",
+    "speculative_generate": "pytorch_distributed_train_tpu.speculative",
+    "ContinuousBatcher": "pytorch_distributed_train_tpu.serving",
+    "Seq2SeqContinuousBatcher": "pytorch_distributed_train_tpu.serving",
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
