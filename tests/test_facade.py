@@ -1,0 +1,30 @@
+"""Top-level package façade: the lazy re-exports resolve, unknown names
+fail loudly, and dir() advertises the public surface."""
+
+import pytorch_distributed_train_tpu as pdt
+
+
+def test_lazy_exports_resolve():
+    assert pdt.Trainer.__name__ == "Trainer"
+    assert pdt.TrainState.__name__ == "TrainState"
+    assert callable(pdt.generate)
+    assert callable(pdt.generate_seq2seq)
+    assert callable(pdt.beam_search) and callable(pdt.beam_search_seq2seq)
+    assert callable(pdt.filter_logits)
+    assert callable(pdt.speculative_generate)
+    assert pdt.ContinuousBatcher.__name__ == "ContinuousBatcher"
+    assert issubclass(pdt.Seq2SeqContinuousBatcher, pdt.ContinuousBatcher)
+
+
+def test_unknown_attribute_is_loud():
+    import pytest
+
+    with pytest.raises(AttributeError, match="no_such_symbol"):
+        pdt.no_such_symbol
+
+
+def test_dir_lists_facade():
+    names = dir(pdt)
+    for want in ("Trainer", "generate", "ContinuousBatcher",
+                 "get_preset", "TrainConfig"):
+        assert want in names
