@@ -29,10 +29,14 @@ from pytorch_distributed_train_tpu.config import (  # noqa: F401
 # Lazy top-level façade for the training/serving surface: `from
 # pytorch_distributed_train_tpu import Trainer, generate` works without
 # paying every submodule's import (and jit registration) cost up front.
+# NOTE: no facade name may equal a submodule name ("generate" the
+# function vs .generate the module): importing the submodule anywhere
+# rebinds the package attribute to the MODULE, permanently shadowing the
+# lazy export. The function is reachable as generate_tokens here or as
+# pytorch_distributed_train_tpu.generate.generate.
 _LAZY = {
     "Trainer": "pytorch_distributed_train_tpu.trainer",
     "TrainState": "pytorch_distributed_train_tpu.train_state",
-    "generate": "pytorch_distributed_train_tpu.generate",
     "generate_seq2seq": "pytorch_distributed_train_tpu.generate",
     "beam_search": "pytorch_distributed_train_tpu.generate",
     "beam_search_seq2seq": "pytorch_distributed_train_tpu.generate",
@@ -44,6 +48,9 @@ _LAZY = {
 
 
 def __getattr__(name):
+    if name == "generate_tokens":  # alias: see the note above _LAZY
+        from pytorch_distributed_train_tpu.generate import generate
+        return generate
     target = _LAZY.get(name)
     if target is None:
         raise AttributeError(
@@ -54,4 +61,4 @@ def __getattr__(name):
 
 
 def __dir__():
-    return sorted(list(globals()) + list(_LAZY))
+    return sorted(list(globals()) + list(_LAZY) + ["generate_tokens"])

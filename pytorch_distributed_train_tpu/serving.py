@@ -500,6 +500,19 @@ class ContinuousBatcher:
                 return r
         return None
 
+    def can_preload(self) -> bool:
+        """Pure capacity check: would preload() find a slot right now?
+        True when a slot is free, or some parked entry is evictable
+        (not referenced by a queued continuation). No side effects —
+        callers use it to fall back instead of catching preload's
+        RuntimeError (which would also swallow device errors)."""
+        for r in range(self.slots):
+            if self._req[r] is None and r not in self._parked_slots:
+                return True
+        queued = {q.session for q in self.queue if q.session is not None}
+        queued |= {q.prefix for q in self.queue if q.prefix is not None}
+        return any(sid not in queued for sid in self._parked)
+
     def release(self, sid: int) -> bool:
         """Explicitly drop a parked session/template (frees its slot now
         instead of waiting for LRU pressure). Queued continuations of it

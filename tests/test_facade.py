@@ -7,7 +7,7 @@ import pytorch_distributed_train_tpu as pdt
 def test_lazy_exports_resolve():
     assert pdt.Trainer.__name__ == "Trainer"
     assert pdt.TrainState.__name__ == "TrainState"
-    assert callable(pdt.generate)
+    assert callable(pdt.generate_tokens)
     assert callable(pdt.generate_seq2seq)
     assert callable(pdt.beam_search) and callable(pdt.beam_search_seq2seq)
     assert callable(pdt.filter_logits)
@@ -25,6 +25,17 @@ def test_unknown_attribute_is_loud():
 
 def test_dir_lists_facade():
     names = dir(pdt)
-    for want in ("Trainer", "generate", "ContinuousBatcher",
+    for want in ("Trainer", "generate_tokens", "ContinuousBatcher",
                  "get_preset", "TrainConfig"):
         assert want in names
+
+
+def test_facade_survives_submodule_shadowing():
+    """Importing the generate SUBMODULE rebinds pdt.generate to the
+    module (CPython import semantics) — the facade must still serve the
+    function under its non-colliding name."""
+    import pytorch_distributed_train_tpu.generate as gen_mod
+
+    assert pdt.generate is gen_mod          # the module won
+    assert callable(pdt.generate_tokens)    # the facade still works
+    assert pdt.generate_tokens is gen_mod.generate

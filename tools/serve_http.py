@@ -215,11 +215,18 @@ class BatcherService:
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
             try:
-                if share:
+                if share and self.batcher.can_preload():
+                    # (a pure capacity check, not except RuntimeError: a
+                    # broad catch would also swallow device errors from
+                    # the synchronous template prefill)
                     sid = self.batcher.preload(ids[:-1])
+                # else: every slot busy right now — a template can't
+                # queue, but plain submits can; fall back to n
+                # independent prefills rather than 503ing a request
+                # that only needs to wait its turn
                 for _ in range(n):
                     uid = self.batcher.submit(
-                        ids[-1:] if share else ids, max_tokens,
+                        ids[-1:] if sid is not None else ids, max_tokens,
                         temperature=temperature, eos_id=self.tok.eos_id,
                         prefix=sid)
                     events[uid] = threading.Event()
