@@ -21,10 +21,28 @@ permutation itself is Grain's, not byte-identical to data/sampler.py.
 
 from __future__ import annotations
 
+import os
 from typing import Iterator
 
 import jax
 import numpy as np
+
+
+def bounded_workers(requested: int, avail: int | None = None) -> int:
+    """Cap Grain worker PROCESSES by what the host can actually run.
+
+    Worker processes exist to escape the GIL onto OTHER cores
+    (torch:utils/data/_utils/worker.py:244 — same rationale); on a host
+    with no core to spare they only add spawn+IPC contention against the
+    consumer. Measured on this repo's 1-core sandbox: the uncapped
+    process arm produced no batch within 550 s (BASELINE.md r2 "DNF"),
+    while worker_count=0 (in-process loading, Grain's supported
+    degenerate mode) streams fine. Cap = cpu_count - 1 (one core stays
+    with the consumer/train loop), never more than requested.
+    """
+    if avail is None:
+        avail = os.cpu_count() or 1
+    return max(0, min(requested, avail - 1))
 
 
 class _IndexSource:
@@ -84,7 +102,7 @@ class GrainHostDataLoader:
         self.host_batch = global_batch // self.num_hosts
         self.seed = data_cfg.seed
         self.shuffle = train and data_cfg.shuffle
-        self.num_workers = data_cfg.num_workers
+        self.num_workers = bounded_workers(data_cfg.num_workers)
         self.read_buffer = max(2, data_cfg.prefetch)
 
     @property

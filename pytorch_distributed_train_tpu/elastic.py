@@ -18,6 +18,14 @@ path). So this agent:
    ``max_restarts`` times (elastic agent semantics, whole-gang flavor);
 4. exits 0 only when every worker exits 0.
 
+With ``--min-nnodes`` the world is DYNAMIC (torchrun's min/max-nnodes,
+torch:distributed/elastic/rendezvous/dynamic_rendezvous.py:1148): each
+restart generation rendezvouses whichever node agents survive, and once
+the window passes proceeds with >= min_nnodes of them — NUM_PROCESSES
+shrinks, node indices re-densify, workers rebuild the mesh from the new
+device count and resume from the latest Orbax checkpoint
+(reshard-on-restore), keeping the configured GLOBAL batch intact.
+
 Workers can use ``worker_store()`` for launcher-mediated KV exchange and
 barriers (the same role c10d's store plays for init handshakes).
 """
@@ -46,6 +54,23 @@ class LaunchConfig:
     master_addr: str = "127.0.0.1"
     store_port: int = 0  # 0 → ephemeral (single-node only)
     env: dict | None = None
+    # Dynamic membership (torchrun's min/max-nnodes semantics,
+    # torch:distributed/elastic/rendezvous/dynamic_rendezvous.py:1148):
+    # 0 → the world is FIXED at nnodes (default; a lost node means the
+    # job retries until the scheduler replaces it). >0 → each restart
+    # generation rendezvouses whoever shows up within
+    # ``rendezvous_window_s`` and proceeds DEGRADED once >= min_nnodes
+    # nodes arrived: NUM_PROCESSES shrinks, workers rebuild the mesh
+    # from the surviving device count, and training resumes from the
+    # latest checkpoint via reshard-on-restore (the global batch stays
+    # constant — data.local_batch_size divides by process_count). Node 0
+    # must survive: it hosts the store + JAX coordinator.
+    min_nnodes: int = 0
+    rendezvous_window_s: float = 10.0
+    # Hard ceiling on a rendezvous round: below min_nnodes arrivals when
+    # it expires → the round FAILS (rc 44) instead of spinning forever
+    # (matches the fixed-world barrier's 600 s bound).
+    rendezvous_timeout_s: float = 600.0
 
 
 def _free_port() -> int:
@@ -63,6 +88,9 @@ class ElasticAgent:
         self.coord_port = None
         self.procs: list[subprocess.Popen] = []
         self.agent_client = None  # agent↔agent coordination (nnodes > 1)
+        self._world_nodes = cfg.nnodes  # current generation's node count
+        self._members = list(range(cfg.nnodes))  # original ranks, this gen
+        self._last_gen = 0
 
     # ------------------------------------------------------------ lifecycle
     def _start_store(self) -> None:
@@ -86,12 +114,17 @@ class ElasticAgent:
                 coord = c.get("coord", timeout_ms=120_000).decode()
             self.coord_port = int(coord.rsplit(":", 1)[1])
 
-    def _spawn(self, restart_gen: int) -> None:
+    def _spawn(self, restart_gen: int, world_nodes: int | None = None,
+               node_index: int | None = None) -> None:
         cfg = self.cfg
-        world = cfg.nnodes * cfg.nprocs
+        if world_nodes is None:
+            world_nodes = cfg.nnodes
+        if node_index is None:
+            node_index = cfg.node_rank
+        world = world_nodes * cfg.nprocs
         self.procs = []
         for local in range(cfg.nprocs):
-            rank = cfg.node_rank * cfg.nprocs + local
+            rank = node_index * cfg.nprocs + local
             env = dict(os.environ)
             env.update(cfg.env or {})
             env.update({
@@ -136,13 +169,38 @@ class ElasticAgent:
                 self.agent_client = StoreClient(host, self.store_port,
                                                 timeout_ms=120_000)
             for gen in range(cfg.max_restarts + 1):
+                members = list(range(cfg.nnodes))
+                node_index = cfg.node_rank
+                self._last_gen = gen
                 if self.agent_client is not None:
-                    # Gang restarts are whole-JOB: every node's agent meets
-                    # here before (re)spawning, so no generation skew.
-                    self.agent_client.barrier(
-                        f"agents/spawn/{gen}", cfg.nnodes, cfg.node_rank,
-                        timeout_ms=600_000)
-                self._spawn(gen)
+                    if cfg.min_nnodes > 0:
+                        try:
+                            rdzv = self._rendezvous(gen)
+                        except (TimeoutError, OSError) as e:
+                            # TimeoutError: the round never filled (node 0)
+                            # or the world key never appeared. OSError: the
+                            # store died under us — node 0 tears it down
+                            # when ITS round fails, and a surviving peer's
+                            # blocked get comes back as a connection error,
+                            # which is the same condition, not a crash.
+                            self._log(f"rendezvous failed: "
+                                      f"{type(e).__name__}: {e}")
+                            return 44
+                        if rdzv is None:
+                            self._log(f"excluded from rendezvous gen {gen} "
+                                      "(arrived after the round closed); "
+                                      "exiting for scheduler re-admission")
+                            return 43
+                        members, node_index = rdzv
+                    else:
+                        # Gang restarts are whole-JOB: every node's agent
+                        # meets here before (re)spawning, no generation skew.
+                        self.agent_client.barrier(
+                            f"agents/spawn/{gen}", cfg.nnodes, cfg.node_rank,
+                            timeout_ms=600_000)
+                self._world_nodes = len(members)
+                self._members = members
+                self._spawn(gen, len(members), node_index)
                 rc = self._monitor(gen)
                 if rc == 0:
                     self._log("all workers exited cleanly")
@@ -157,16 +215,105 @@ class ElasticAgent:
         finally:
             if self.agent_client is not None:
                 # Node 0 hosts the store every other agent is still polling:
-                # meet before teardown, else their clients die mid-request.
+                # it must leave LAST. Non-host agents drop a per-rank exit
+                # flag and go; node 0 waits for every member of the final
+                # generation (per-rank + per-gen keys, so a node that died
+                # or exited in an EARLIER generation can't release node 0
+                # before a still-monitoring survivor is done — a stale
+                # arrival on a shared barrier did exactly that). A dead
+                # peer can't wedge shutdown: the waits share one deadline
+                # and timeouts are swallowed.
                 try:
-                    self.agent_client.barrier(
-                        "agents/exit", self.cfg.nnodes, self.cfg.node_rank,
-                        timeout_ms=60_000)
+                    if self.cfg.node_rank == 0:
+                        deadline = time.time() + 60.0
+                        for r in self._members:
+                            if r == 0:
+                                continue
+                            left_ms = max(1, int((deadline - time.time())
+                                                 * 1000))
+                            try:
+                                self.agent_client.wait(
+                                    f"agents/exit/{self._last_gen}/{r}",
+                                    timeout_ms=left_ms)
+                            except TimeoutError:
+                                pass
+                    else:
+                        self.agent_client.set(
+                            f"agents/exit/{self._last_gen}/"
+                            f"{self.cfg.node_rank}", b"1")
                 except Exception:
                     pass  # a dead peer must not wedge shutdown
                 self.agent_client.close()
             if self.server is not None:
                 self.server.stop()
+
+    def _rendezvous(self, gen: int) -> tuple[list[int], int] | None:
+        """Dynamic-membership rendezvous for generation ``gen``.
+
+        The degraded-restart path (SURVEY C11;
+        torch:...dynamic_rendezvous.py:1148 rendezvouses [min, max] nodes
+        the same way): every surviving agent registers; node 0 closes the
+        round when all ``nnodes`` arrived, or — once
+        ``rendezvous_window_s`` has passed — when at least ``min_nnodes``
+        did. Members get DENSE new node indices in node_rank order, so
+        ranks stay contiguous for the shrunken world.
+
+        Returns (members, node_index) — members as ORIGINAL node ranks in
+        ascending order — or None when this node arrived after the round
+        closed (excluded — exit and let the scheduler re-admit it next
+        generation). Raises TimeoutError when fewer than min_nnodes nodes
+        ever arrive within ``rendezvous_timeout_s`` (the round is dead).
+        """
+        c = self.agent_client
+        cfg = self.cfg
+        c.set(f"rdzv/{gen}/member/{cfg.node_rank}", b"1")
+        c.add(f"rdzv/{gen}/count", 1)
+        if cfg.node_rank == 0:
+            deadline = time.time() + cfg.rendezvous_window_s
+            hard_deadline = time.time() + cfg.rendezvous_timeout_s
+            while True:
+                n = c.add(f"rdzv/{gen}/count", 0)
+                if n >= cfg.nnodes:
+                    break
+                if n >= max(cfg.min_nnodes, 1) and time.time() >= deadline:
+                    self._log(f"rendezvous gen {gen}: window closed with "
+                              f"{n}/{cfg.nnodes} nodes — proceeding degraded")
+                    break
+                if time.time() >= hard_deadline:
+                    raise TimeoutError(
+                        f"rendezvous gen {gen}: only {n} of min "
+                        f"{max(cfg.min_nnodes, 1)} nodes arrived within "
+                        f"{cfg.rendezvous_timeout_s:.0f}s")
+                time.sleep(0.1)
+            # Enumerate members. Every registrant set() its member key
+            # BEFORE add()ing the count, so >= n keys exist by now — keep
+            # sweeping until we've found at least n (a 1 ms probe could
+            # drop an already-counted node on a loaded host, ejecting a
+            # healthy member and shrinking the gang below the count that
+            # closed the round).
+            n_final = c.add(f"rdzv/{gen}/count", 0)
+            members: list[int] = []
+            sweep_deadline = time.time() + 30.0
+            while True:
+                members = []
+                for r in range(cfg.nnodes):
+                    try:
+                        c.get(f"rdzv/{gen}/member/{r}", timeout_ms=50)
+                        members.append(r)
+                    except TimeoutError:
+                        pass
+                if len(members) >= n_final or time.time() >= sweep_deadline:
+                    break
+                time.sleep(0.05)
+            c.set(f"rdzv/{gen}/world", ",".join(map(str, members)).encode())
+        else:
+            raw = c.get(f"rdzv/{gen}/world",
+                        timeout_ms=int(cfg.rendezvous_timeout_s * 1000)
+                        ).decode()
+            members = [int(r) for r in raw.split(",") if r]
+        if cfg.node_rank not in members:
+            return None
+        return members, members.index(cfg.node_rank)
 
     def _peer_failure(self, gen: int) -> int | None:
         """rc another node published for this generation, or None."""
@@ -202,7 +349,7 @@ class ElasticAgent:
                         return 0
                     local_done = True
                     n = self.agent_client.add(f"gang/ok/{gen}", 1)
-                    if n == self.cfg.nnodes:
+                    if n == self._world_nodes:
                         self.agent_client.set(f"gang/alldone/{gen}", b"1")
             else:
                 try:
@@ -240,6 +387,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--master-addr", default="127.0.0.1")
     p.add_argument("--store-port", type=int, default=0,
                    help="required (nonzero) when nnodes > 1")
+    p.add_argument("--min-nnodes", type=int, default=0,
+                   help="degraded-restart floor: restart generations "
+                        "proceed with >= this many nodes once the "
+                        "rendezvous window passes (0 = fixed world; "
+                        "torchrun's min/max-nnodes analogue)")
+    p.add_argument("--rendezvous-window", type=float, default=10.0,
+                   help="seconds node 0 waits for stragglers before "
+                        "closing a degraded rendezvous round")
     p.add_argument("--monitor-interval", type=float, default=0.5)
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command, e.g. train.py --config ...")
@@ -253,11 +408,15 @@ def main(argv: list[str] | None = None) -> int:
         p.error("--store-port must be fixed when nnodes > 1")
     if cmd[0].endswith(".py"):
         cmd = [sys.executable] + cmd
+    if args.min_nnodes > args.nnodes:
+        p.error("--min-nnodes cannot exceed --nnodes")
     cfg = LaunchConfig(
         nprocs=args.nprocs, max_restarts=args.max_restarts,
         nnodes=args.nnodes, node_rank=args.node_rank,
         master_addr=args.master_addr, store_port=args.store_port,
         monitor_interval_s=args.monitor_interval,
+        min_nnodes=args.min_nnodes,
+        rendezvous_window_s=args.rendezvous_window,
     )
     return ElasticAgent(cfg, cmd).run()
 

@@ -144,9 +144,14 @@ def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0,
     ``temperature`` is a positive scalar OR an array broadcastable against
     ``logits`` (serving passes (B, 1) per-row temperatures); every entry
     must be > 0. ``top_p`` in (0, 1) keeps the smallest sorted prefix
-    whose cumulative probability reaches top_p (HF semantics: a token
-    survives iff the mass strictly BEFORE it is < top_p, so the argmax
-    always survives); 0 disables. ``min_p`` in (0, 1) keeps tokens whose
+    whose cumulative probability reaches top_p (a token survives iff the
+    mass strictly BEFORE it is < top_p, so the argmax always survives).
+    Boundary convention: when a prefix's mass lands EXACTLY on top_p the
+    next token is dropped — the same strict rule as the installed
+    transformers 4.57.6 TopPLogitsWarper (ascending sort, remove iff
+    inclusive-cum <= 1-top_p ⟺ keep iff exclusive-desc-mass < top_p;
+    OLDER HF releases used the shifted-descending form, which kept the
+    boundary token — differs only at exact fp equality). 0 disables. ``min_p`` in (0, 1) keeps tokens whose
     probability is >= min_p x the max probability (Nguyen et al. 2024 —
     an entropy-adaptive floor: permissive when the model is uncertain,
     strict when confident; applies after top-k/top-p, argmax always

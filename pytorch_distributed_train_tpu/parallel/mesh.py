@@ -46,6 +46,86 @@ def mesh_shape_from_config(mesh_cfg, n_devices: int | None = None) -> dict[str, 
     return sizes
 
 
+def _hybrid_split(shape: tuple[int, ...],
+                  n_slices: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Split a global mesh shape into (per-slice ICI shape, DCN shape).
+
+    Elementwise ici*dcn == shape. The DCN factor goes on the OUTERMOST
+    axis (MESH_AXES order) that divides the slice count — 'stage' first
+    (pipeline P2P is the most DCN-tolerant traffic), then 'data'
+    (gradient all-reduce is latency-tolerant). Landing on tensor/context
+    warns loudly (per-layer collectives over DCN — a config smell);
+    raises when no axis divides.
+    """
+    for i, s in enumerate(shape):
+        if s >= n_slices and s % n_slices == 0:
+            if MESH_AXES[i] in ("tensor", "context"):
+                # Divisible, but only by a latency-critical axis: per-layer
+                # TP/CP collectives over DCN run orders of magnitude slower
+                # than ICI. Proceed (correctness is unaffected) but say so
+                # loudly — the config, not this split, is what's wrong.
+                import warnings
+
+                warnings.warn(
+                    f"multi-slice DCN factor landed on the "
+                    f"latency-critical '{MESH_AXES[i]}' axis "
+                    f"({dict(zip(MESH_AXES, shape))}, {n_slices} slices): "
+                    "every per-layer collective will cross DCN. Give "
+                    "stage/data/fsdp a multiple of the slice count.")
+            ici = list(shape)
+            ici[i] = s // n_slices
+            dcn = [1] * len(shape)
+            dcn[i] = n_slices
+            return tuple(ici), tuple(dcn)
+    raise ValueError(
+        f"no mesh axis in {dict(zip(MESH_AXES, shape))} divisible by the "
+        f"{n_slices} slices — put stage/data parallelism across slices")
+
+
+def device_grid(shape: tuple[int, ...], devices) -> "np.ndarray":
+    """Topology-aware device placement for the mesh axes.
+
+    The analogue of NCCL's ring/tree graph construction from the physical
+    fabric (torch:include/torch/csrc/distributed/c10d/ProcessGroupNCCL.hpp:315
+    builds communicator topology at init): on real TPU backends
+    ``mesh_utils.create_device_mesh`` reads chip coordinates and lays the
+    innermost axes on neighbor ICI links (a naive ``jax.devices()`` reshape
+    is only adjacency-correct by accident beyond one host — the
+    latency-critical 'tensor'/'context' axes could land on non-neighbor
+    chips). Multi-slice (DCN-connected) device sets route through
+    ``create_hybrid_device_mesh`` with the slice factor on the outermost
+    divisible axis (see _hybrid_split). Fake CPU test devices keep the
+    plain reshape — they have no topology and the identity order keeps
+    tests deterministic.
+    """
+    devs = list(devices)
+    if getattr(devs[0], "platform", "cpu") == "cpu":
+        return np.asarray(devs).reshape(shape)
+    from jax.experimental import mesh_utils
+
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if n_slices > 1:
+        # Outside the try: an indivisible slice count is a CONFIG error
+        # with an actionable message — it must reach the user, not be
+        # downgraded to the torus-assignment fallback below.
+        ici, dcn = _hybrid_split(shape, n_slices)
+    try:
+        if n_slices > 1:
+            return mesh_utils.create_hybrid_device_mesh(
+                ici, dcn, devices=devs)
+        return mesh_utils.create_device_mesh(shape, devices=devs)
+    except ValueError as e:
+        # Unmappable shape for this physical topology (e.g. an axis split
+        # no torus assignment satisfies): train with the naive order
+        # rather than not at all — correctness is unaffected, only
+        # collective locality.
+        import warnings
+
+        warnings.warn(f"topology-aware mesh assignment failed ({e}); "
+                      "falling back to enumeration order")
+        return np.asarray(devs).reshape(shape)
+
+
 def build_mesh(mesh_cfg=None, devices: Sequence[jax.Device] | None = None) -> Mesh:
     """Build the global mesh.
 
@@ -54,18 +134,19 @@ def build_mesh(mesh_cfg=None, devices: Sequence[jax.Device] | None = None) -> Me
     ``data`` (cross-slice tolerant — gradient all-reduce is latency-tolerant),
     ``tensor``/``context`` innermost (latency-critical per-layer collectives
     ride neighbor ICI links). This is the layout recipe from the scaling-book
-    mental model.
+    mental model; :func:`device_grid` realizes it against the physical
+    topology on real backends.
     """
     if devices is None:
         devices = jax.devices()
-    devices = np.asarray(devices)
+    devices = list(np.asarray(devices).reshape(-1))
     if mesh_cfg is None:
         sizes = {ax: 1 for ax in MESH_AXES}
-        sizes["data"] = devices.size
+        sizes["data"] = len(devices)
     else:
-        sizes = mesh_shape_from_config(mesh_cfg, devices.size)
+        sizes = mesh_shape_from_config(mesh_cfg, len(devices))
     shape = tuple(sizes[ax] for ax in MESH_AXES)
-    return Mesh(devices.reshape(shape), MESH_AXES)
+    return Mesh(device_grid(shape, devices), MESH_AXES)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -44,9 +44,57 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 P = PartitionSpec
+
+
+def _constrain_microbatch(x_mb, mesh: Mesh,
+                          batch_axes=("data", "fsdp"),
+                          outbound: bool = False) -> jax.Array:
+    """Keep GSPMD from leaving batch-sharding on the microbatch-INDEX dim.
+
+    ``microbatch()``'s reshape (B, ...) → (M, mb, ...) makes the sharded
+    batch dim split as (M, mb) with the sharding propagating onto M (the
+    scanned dim) — and GSPMD cannot move sharding BETWEEN dims in one hop:
+    it falls back to replicate-then-repartition with a loud
+    spmd_partitioner.cc "Involuntary full rematerialization" warning
+    (observed in MULTICHIP_r02), and the same fallback fires inside the
+    shard_map entry every step. The dim-move is staged here as two
+    transitions the partitioner IS efficient at:
+      1. constrain to fully-replicated — one all-gather over the batch
+         axes (the same bytes the silent fallback already moved, now as a
+         first-class collective);
+      2. constrain to the target layout — mb over whatever batch axes
+         divide it, M unsharded — a local slice, free.
+    The scan body then finds its input already laid out the way it wants
+    (per-tick microbatches sharded over data), so no further cross-dim
+    moves exist anywhere in the pipeline program.
+
+    The OUTPUT needs the mirror treatment (``outbound=True``): the
+    cotangent flowing back from the downstream ``unmicrobatch`` reshape
+    arrives batch-sharded on the scanned dim, and the transpose of a
+    sharding constraint is the same constraint — so the staged pair runs
+    gather→slice in the backward exactly as the inbound pair does in the
+    forward.
+    """
+    mb = x_mb.shape[1]
+    chosen: list[str] = []
+    prod = 1
+    for a in batch_axes:
+        n = mesh.shape.get(a, 1)
+        if n > 1 and mb % (prod * n) == 0:
+            chosen.append(a)
+            prod *= n
+    replicated = NamedSharding(mesh, P(*([None] * x_mb.ndim)))
+    target = NamedSharding(
+        mesh, P(None, tuple(chosen) if chosen else None,
+                *([None] * (x_mb.ndim - 2))))
+    if outbound:
+        x_mb = jax.lax.with_sharding_constraint(x_mb, target)
+        return jax.lax.with_sharding_constraint(x_mb, replicated)
+    x_mb = jax.lax.with_sharding_constraint(x_mb, replicated)
+    return jax.lax.with_sharding_constraint(x_mb, target)
 
 
 def num_stages(mesh: Mesh, stage_axis: str = "stage") -> int:
@@ -132,6 +180,7 @@ def spmd_pipeline(
         return out, aux
 
     param_specs = jax.tree.map(lambda _: P(stage_axis), stage_params)
+    x_mb = _constrain_microbatch(x_mb, mesh)
     out, aux = jax.shard_map(
         run,
         mesh=mesh,
@@ -140,6 +189,7 @@ def spmd_pipeline(
         axis_names=frozenset({stage_axis}),
         check_vma=False,
     )(stage_params, x_mb)
+    out = _constrain_microbatch(out, mesh, outbound=True)
     return (out, aux) if with_aux else out
 
 
@@ -247,6 +297,7 @@ def spmd_pipeline_interleaved(
         return jnp.concatenate(outs, axis=0), total_aux
 
     param_specs = jax.tree.map(lambda _: P(None, stage_axis), chunk_params)
+    x_mb = _constrain_microbatch(x_mb, mesh)
     out, aux = jax.shard_map(
         run,
         mesh=mesh,
@@ -255,6 +306,7 @@ def spmd_pipeline_interleaved(
         axis_names=frozenset({stage_axis}),
         check_vma=False,
     )(chunk_params, x_mb)
+    out = _constrain_microbatch(out, mesh, outbound=True)
     return (out, aux) if with_aux else out
 
 

@@ -408,6 +408,11 @@ class ContinuousBatcher:
         """FORK a parked template (shared prefix) into a free slot: the
         template row is read, not consumed — it keeps serving forks."""
         r_src, pos, last_tok = self._parked[req.prefix]
+        # Refresh the template's LRU position (dict insertion order IS the
+        # eviction order): without the re-insert a hot, frequently-forked
+        # template stays oldest and dies before stale idle sessions.
+        del self._parked[req.prefix]
+        self._parked[req.prefix] = (r_src, pos, last_tok)
         self.stats["forks"] += 1
         return self._continue_into(r_src, r_target, pos, last_tok, req)
 

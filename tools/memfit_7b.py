@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""7B memory-fit evidence via AOT compile analysis (VERDICT r2 #9).
+
+``BASELINE.json:11`` ("llama2_7b pretrain, GSPMD sharding") is the hardest
+[SPEC] row and, without pod hardware, the only honest way to ground a
+"fits on N chips" claim is the compiler's own accounting:
+``jit(...).lower().compile()`` runs the FULL XLA pipeline — SPMD
+partitioner, layout, buffer assignment — without allocating a single
+parameter, and ``compiled.memory_analysis()`` then reports per-device
+argument/output/temp/code sizes. We compile the real fused-loss train
+step for the llama2_7b preset over fake CPU meshes of 8/16/32 devices
+and tabulate per-device HBM against the chips' capacities.
+
+Caveats (recorded in the table, not hidden):
+- CPU-backend buffer assignment differs from TPU's in layout padding and
+  fusion temps; argument/output sizes (params, optimizer state, grads —
+  the dominant terms at 7B) are dtype-exact, temps are an estimate.
+- Activation temps depend on remat policy; the preset compiles with its
+  shipping ``remat=True`` config.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=32 \
+      python tools/memfit_7b.py [--mesh-devices 8 16 32] [--out docs/MEMFIT_7B.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HBM_PER_CHIP = {  # bytes, marketing GB -> usable ~= capacity here
+    "v5e": 16 * 1024**3,
+    "v5p": 95 * 1024**3,
+}
+
+
+def _mesh_cfg_for(n: int):
+    """The llama2_7b scaling ladder: fsdp-major (ZeRO-3 is what makes 7B
+    fit at all), tensor=2 once there's room — mirroring the preset docs."""
+    from pytorch_distributed_train_tpu.config import MeshConfig
+
+    if n == 8:
+        return MeshConfig(data=1, fsdp=8)
+    if n == 16:
+        return MeshConfig(data=1, fsdp=8, tensor=2)
+    if n == 32:
+        return MeshConfig(data=2, fsdp=8, tensor=2)
+    return MeshConfig(data=1, fsdp=n)
+
+
+def measure(n_devices: int, batch_per_device: int = 1) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import get_preset
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise SystemExit(
+            f"need {n_devices} fake devices "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    cfg = get_preset("llama2_7b")
+    mesh_cfg = _mesh_cfg_for(n_devices)
+    mesh = build_mesh(mesh_cfg, devices[:n_devices])
+    model = build_model(cfg.model, cfg.precision, mesh=mesh, mesh_cfg=mesh_cfg)
+    tx, _ = make_optimizer(cfg.optim, total_steps=100)
+    rules = rules_for_model(cfg.model.name)
+
+    def init_state(rng):
+        ids = jnp.zeros((2, cfg.model.max_seq_len), jnp.int32)
+        variables = model.init({"params": rng}, ids, train=False)
+        return TrainState.create(params=variables["params"], tx=tx)
+
+    state_shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    sharding = steps_lib.state_shardings(mesh, rules, state_shape)
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(model, get_loss_fn(cfg.loss), tx),
+        mesh, sharding,
+    )
+    batch = {"input_ids": jax.ShapeDtypeStruct(
+        (batch_per_device * n_devices, cfg.model.max_seq_len), jnp.int32)}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    t0 = time.time()
+    print(f"[memfit] lowering {n_devices}-device "
+          f"{dict((k, v) for k, v in mesh.shape.items() if v > 1)} ...",
+          flush=True)
+    lowered = step.lower(state_shape, batch, rng)
+    print(f"[memfit] lowered in {time.time() - t0:.0f}s; compiling "
+          "(XLA full pipeline, no buffers) ...", flush=True)
+    t0 = time.time()
+    compiled = lowered.compile()
+    compile_s = time.time() - t0
+    ma = compiled.memory_analysis()
+    res = {
+        "n_devices": n_devices,
+        "mesh": {k: v for k, v in mesh.shape.items() if v > 1},
+        "batch_global": batch_per_device * n_devices,
+        "compile_s": round(compile_s, 1),
+        "arg_bytes": int(ma.argument_size_in_bytes),
+        "out_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+    }
+    # Donated state aliases args<->outputs: resident = args + temps
+    # (+ non-aliased outputs, tiny metrics). Peak adds transient slack the
+    # analysis already folds into temps.
+    res["resident_bytes"] = res["arg_bytes"] + res["temp_bytes"]
+    return res
+
+
+def fmt_gb(b: int) -> str:
+    return f"{b / 1024**3:.2f}"
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mesh-devices", type=int, nargs="+", default=[8, 16, 32])
+    p.add_argument("--batch-per-device", type=int, default=1)
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    rows = []
+    for n in args.mesh_devices:
+        r = measure(n, args.batch_per_device)
+        rows.append(r)
+        print(f"[memfit] {n} devices {r['mesh']}: args {fmt_gb(r['arg_bytes'])} "
+              f"GiB + temps {fmt_gb(r['temp_bytes'])} GiB = "
+              f"{fmt_gb(r['resident_bytes'])} GiB/device "
+              f"(compile {r['compile_s']}s)", flush=True)
+
+    lines = [
+        "# MEMFIT — llama2_7b per-device HBM from AOT compile analysis",
+        "",
+        "Generated by `tools/memfit_7b.py` (see its docstring for the",
+        "methodology and CPU-backend caveats). `resident` = sharded",
+        "arguments (params + adamw mu/nu fp32 + step scalars) + XLA temp",
+        "buffers (activations under the preset's remat policy, fusion",
+        "scratch). Donated state aliases outputs onto arguments.",
+        "",
+        "| devices | mesh | global batch | args GiB/dev | temps GiB/dev |"
+        " resident GiB/dev | fits v5e (16G) | fits v5p (95G) |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        res = r["resident_bytes"]
+        lines.append(
+            f"| {r['n_devices']} | {r['mesh']} | {r['batch_global']} "
+            f"| {fmt_gb(r['arg_bytes'])} | {fmt_gb(r['temp_bytes'])} "
+            f"| {fmt_gb(res)} "
+            f"| {'yes' if res < HBM_PER_CHIP['v5e'] else 'NO'} "
+            f"| {'yes' if res < HBM_PER_CHIP['v5p'] else 'NO'} |")
+    doc = "\n".join(lines) + "\n"
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc)
+        print(f"[memfit] wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,13 @@ class BatcherService:
                             self._abandoned.discard(c.uid)
                             self._streams.pop(c.uid, None)
                             self._stream_seen.pop(c.uid, None)
+                            # A keep=True completion parks its session in
+                            # the batcher — but the waiter is gone, so no
+                            # client will ever learn (or release) the sid.
+                            # Free the slot instead of squatting until LRU
+                            # pressure happens to evict it.
+                            if getattr(c, "session", None) is not None:
+                                self.batcher.release(c.session)
                             continue  # waiter gave up; drop, don't leak
                         q = self._streams.pop(c.uid, None)
                         if q is not None:
@@ -237,8 +244,11 @@ class BatcherService:
         try:
             choices = []
             total_generated = 0
+            # One timeout budget for the whole request, not timeout_s per
+            # fork: waits are sequential, so each gets what remains.
+            deadline = time.monotonic() + timeout_s
             for uid, ev in events.items():
-                if not ev.wait(timeout_s):
+                if not ev.wait(max(0.0, deadline - time.monotonic())):
                     raise TimeoutError(f"completion {uid} timed out")
                 with self._lock:
                     c = self._done.pop(uid, None)

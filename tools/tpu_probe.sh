@@ -1,0 +1,13 @@
+#!/bin/sh
+# TPU lease health probe — delegates to bench.probe_once (the canonical
+# probe definition) so this manual gate and bench.py's automated
+# bring-up retry can never drift. rc 0 = chip executed work.
+cd "$(dirname "$0")/.." || exit 2
+python -c "
+import sys
+sys.path.insert(0, '.')
+from bench import probe_once
+ok, detail = probe_once(float('${1:-90}'))
+print(detail)
+sys.exit(0 if ok else 3)
+"
