@@ -265,17 +265,15 @@ def pipeline_decode_bench(args) -> None:
     bench)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the TPU here
     _bringup_done[0] = True  # host-only mode
-    import io
     import shutil
-    import tarfile
     import tempfile
 
     import numpy as np
-    from PIL import Image
 
     from pytorch_distributed_train_tpu.config import DataConfig
     from pytorch_distributed_train_tpu.data.datasets import (
         TarShardImageDataset,
+        write_jpeg_tar_shard,
     )
 
     n = 2048
@@ -288,26 +286,7 @@ def pipeline_decode_bench(args) -> None:
     try:
         rng = np.random.default_rng(0)
         shard = os.path.join(tmp, "bench-000000.tar")
-        with tarfile.open(shard, "w") as tf:
-            for i in range(n):
-                # Photo-like statistics: low-res noise upsampled smooth —
-                # JPEG entropy (and decode cost) close to real photos,
-                # unlike raw noise (pathological worst case).
-                W = int(rng.integers(256, 513))
-                H = int(rng.integers(256, 513))
-                base = rng.integers(0, 256, (H // 8, W // 8, 3), np.uint8)
-                im = Image.fromarray(base).resize((W, H), Image.BILINEAR)
-                buf = io.BytesIO()
-                im.save(buf, "JPEG", quality=85)
-                data = buf.getvalue()
-                info = tarfile.TarInfo(f"{i:06d}.jpg")
-                info.size = len(data)
-                tf.addfile(info, io.BytesIO(data))
-                cls = str(int(rng.integers(0, 1000))).encode()
-                info = tarfile.TarInfo(f"{i:06d}.cls")
-                info.size = len(cls)
-                tf.addfile(info, io.BytesIO(cls))
-                _touch()
+        write_jpeg_tar_shard(shard, n, rng, per_image=_touch)
         workers = args.workers or (os.cpu_count() or 1)
         ds = TarShardImageDataset(shard, args.image_size, train=True,
                                   native_decode=args.decoder == "native",
@@ -323,7 +302,14 @@ def pipeline_decode_bench(args) -> None:
                 GrainHostDataLoader,
             )
 
-            loader = GrainHostDataLoader(ds, cfg, train=True)
+            # num_hosts/host_id EXPLICIT: the defaults call
+            # jax.process_count(), which initializes the device backend —
+            # on this sandbox the axon hook then blocks forever when the
+            # TPU lease is wedged. This (not host-core contention) was
+            # round 2's grain-arm DNF: a host-only bench must never touch
+            # the device. The threads arm below always passed them.
+            loader = GrainHostDataLoader(ds, cfg, train=True,
+                                         num_hosts=1, host_id=0)
         else:
             from pytorch_distributed_train_tpu.data.pipeline import (
                 HostDataLoader,

@@ -354,6 +354,52 @@ class ImageFolderDataset:
         return {"image": x, "label": np.int32(label)}
 
 
+def write_jpeg_tar_shard(path: str, n: int, rng, *, start_key: int = 0,
+                         size_range: tuple[int, int] = (256, 513),
+                         fixed_size: int | None = None,
+                         num_classes: int = 1000, quality: int = 85,
+                         per_image=None) -> None:
+    """Synthesize ONE WebDataset-convention tar shard of photo-like JPEGs.
+
+    The single writer for the ``<key>.jpg + <key>.cls`` layout that
+    :class:`TarShardImageDataset` reads — bench.py's decode arm,
+    tools/sustained_drill.py, and the pipeline/grain tests all call this,
+    so the shard contract lives in exactly one place. "Photo-like" =
+    low-res noise upsampled smooth: JPEG entropy (and decode cost) tracks
+    real photos, where raw noise is the pathological worst case.
+    ``per_image`` (optional) is called once per written image (progress /
+    watchdog touch hooks). Writes directly to ``path`` — callers needing
+    atomicity write to a temp name and rename.
+    """
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    with tarfile.open(path, "w") as tf:
+        for k in range(n):
+            if fixed_size is not None:
+                W = H = fixed_size
+            else:
+                W = int(rng.integers(*size_range))
+                H = int(rng.integers(*size_range))
+            base = rng.integers(0, 256, (max(H // 8, 1), max(W // 8, 1), 3),
+                                np.uint8)
+            im = Image.fromarray(base).resize((W, H), Image.BILINEAR)
+            buf = io.BytesIO()
+            im.save(buf, "JPEG", quality=quality)
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"{start_key + k:06d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            cls = str(int(rng.integers(0, num_classes))).encode()
+            info = tarfile.TarInfo(f"{start_key + k:06d}.cls")
+            info.size = len(cls)
+            tf.addfile(info, io.BytesIO(cls))
+            if per_image is not None:
+                per_image()
+
+
 class TarShardImageDataset(ImageFolderDataset):
     """WebDataset-convention tar shards: each ``.tar`` holds ``<key>.jpg``
     (or .jpeg/.png) + ``<key>.cls`` (class index as ASCII) pairs. The

@@ -42,7 +42,17 @@ def bounded_workers(requested: int, avail: int | None = None) -> int:
     """
     if avail is None:
         avail = os.cpu_count() or 1
-    return max(0, min(requested, avail - 1))
+    bounded = max(0, min(requested, avail - 1))
+    if bounded < requested:
+        # Say so: a configured worker count silently collapsing to
+        # in-process loading would read as an unexplained throughput drop.
+        import warnings
+
+        warnings.warn(
+            f"grain num_workers={requested} clamped to {bounded} "
+            f"({avail} host core(s); worker processes need a spare core "
+            "— 0 = in-process loading)")
+    return bounded
 
 
 class _IndexSource:
@@ -88,6 +98,10 @@ class GrainHostDataLoader:
                  num_hosts: int | None = None, host_id: int | None = None):
         self.dataset = dataset
         self.train = train
+        # NOTE: the defaults initialize the device backend (process_count
+        # → jax.devices()); host-only callers (benches, tools) must pass
+        # num_hosts/host_id explicitly so a wedged accelerator lease can
+        # never stall a pure-host data pipeline.
         self.num_hosts = (num_hosts if num_hosts is not None
                           else jax.process_count())
         self.host_id = host_id if host_id is not None else jax.process_index()
@@ -160,7 +174,11 @@ class GrainHostDataLoader:
                 gp.Batch(batch_size=self.host_batch, drop_remainder=False),
             ],
             worker_count=self.num_workers,
-            read_options=gp.ReadOptions(prefetch_buffer_size=self.read_buffer),
+            # Read threads capped at the prefetch depth (grain warns —
+            # and may error later — when threads can't all be in flight).
+            read_options=gp.ReadOptions(
+                num_threads=max(1, min(16, self.read_buffer)),
+                prefetch_buffer_size=self.read_buffer),
         )
         n_steps = self.steps_per_epoch - start_batch
         for b, batch in enumerate(loader):

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
@@ -28,6 +29,27 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from pytorch_distributed_train_tpu.data.sampler import DistributedSampler
+
+
+class StallStats:
+    """Input-stall accounting: cumulative time the CONSUMER blocked waiting
+    for the host pipeline to produce a batch.
+
+    The feed-ratio question (SURVEY §7.4.1 — the #1-ranked hard part) is
+    whether the host can keep the chip fed; sustained-run acceptance is
+    "input_stall_pct < 5" (BASELINE.json:8 drill). The counter sits at the
+    producer-queue get: with async device_put downstream, that wait IS the
+    time the step loop would have idled on input. Plain float adds under
+    the GIL — one writer (the consumer thread) — no lock needed.
+    """
+
+    def __init__(self) -> None:
+        self.waits = 0
+        self.wait_s = 0.0
+
+    def add(self, dt: float) -> None:
+        self.waits += 1
+        self.wait_s += dt
 
 
 class HostDataLoader:
@@ -143,11 +165,13 @@ class _Producer(threading.Thread):
 
     _DONE = object()
 
-    def __init__(self, it: Iterator, depth: int):
+    def __init__(self, it: Iterator, depth: int,
+                 stats: StallStats | None = None):
         super().__init__(daemon=True)
         self.it = it
         self.q: queue.Queue = queue.Queue(maxsize=depth)
         self.error: BaseException | None = None
+        self.stats = stats
         self._stopped = threading.Event()
         self.start()
 
@@ -185,7 +209,13 @@ class _Producer(threading.Thread):
     def __iter__(self):
         try:
             while True:
+                t0 = time.perf_counter()
                 item = self.q.get()
+                if self.stats is not None:
+                    # Non-empty-queue gets cost microseconds; genuine
+                    # stalls dominate the sum, so unconditional adds keep
+                    # the hot path branch-free and the number honest.
+                    self.stats.add(time.perf_counter() - t0)
                 if item is self._DONE:
                     if self.error is not None:
                         raise self.error
@@ -250,10 +280,12 @@ def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
         loader = GrainHostDataLoader(dataset, data_cfg, train=train)
     else:
         loader = HostDataLoader(dataset, data_cfg, train=train)
+    loader.stall_stats = StallStats()  # read by the trainer's log window
 
     def epoch_fn(epoch: int, start_batch: int = 0) -> Iterator[dict]:
         host_iter = iter(_Producer(loader.epoch(epoch, start_batch),
-                                   depth=max(2, data_cfg.prefetch)))
+                                   depth=max(2, data_cfg.prefetch),
+                                   stats=loader.stall_stats))
         if sync_check_every:
             from pytorch_distributed_train_tpu.utils.debug import check_input_sync
 

@@ -168,50 +168,49 @@ class ElasticAgent:
                 host = "127.0.0.1" if cfg.node_rank == 0 else cfg.master_addr
                 self.agent_client = StoreClient(host, self.store_port,
                                                 timeout_ms=120_000)
-            for gen in range(cfg.max_restarts + 1):
+            rnd = 0  # rendezvous round == RESTART_GENERATION (store-global)
+            restarts_used = 0
+            while True:
                 members = list(range(cfg.nnodes))
                 node_index = cfg.node_rank
-                self._last_gen = gen
                 if self.agent_client is not None:
                     if cfg.min_nnodes > 0:
                         try:
-                            rdzv = self._rendezvous(gen)
+                            rnd, members, node_index = \
+                                self._rendezvous_round(rnd)
                         except (TimeoutError, OSError) as e:
-                            # TimeoutError: the round never filled (node 0)
-                            # or the world key never appeared. OSError: the
-                            # store died under us — node 0 tears it down
-                            # when ITS round fails, and a surviving peer's
-                            # blocked get comes back as a connection error,
-                            # which is the same condition, not a crash.
+                            # TimeoutError: the round never filled (node 0),
+                            # no further round opened for a waiting-excluded
+                            # node, or the world key never appeared.
+                            # OSError: the store died under us — node 0
+                            # tears it down when ITS round fails, and a
+                            # surviving peer's blocked get comes back as a
+                            # connection error; same condition, not a crash.
                             self._log(f"rendezvous failed: "
                                       f"{type(e).__name__}: {e}")
                             return 44
-                        if rdzv is None:
-                            self._log(f"excluded from rendezvous gen {gen} "
-                                      "(arrived after the round closed); "
-                                      "exiting for scheduler re-admission")
-                            return 43
-                        members, node_index = rdzv
                     else:
                         # Gang restarts are whole-JOB: every node's agent
                         # meets here before (re)spawning, no generation skew.
                         self.agent_client.barrier(
-                            f"agents/spawn/{gen}", cfg.nnodes, cfg.node_rank,
+                            f"agents/spawn/{rnd}", cfg.nnodes, cfg.node_rank,
                             timeout_ms=600_000)
+                self._last_gen = rnd
                 self._world_nodes = len(members)
                 self._members = members
-                self._spawn(gen, len(members), node_index)
-                rc = self._monitor(gen)
+                self._spawn(rnd, len(members), node_index)
+                rc = self._monitor(rnd)
                 if rc == 0:
                     self._log("all workers exited cleanly")
                     return 0
-                if gen == self.cfg.max_restarts:
+                if restarts_used >= cfg.max_restarts:
                     self._log(f"worker failed (rc={rc}); restart budget "
-                              f"exhausted after {gen} restarts")
+                              f"exhausted after {restarts_used} restarts")
                     return rc
+                restarts_used += 1
+                rnd += 1
                 self._log(f"worker failed (rc={rc}); restarting gang "
-                          f"({gen + 1}/{self.cfg.max_restarts})")
-            return 1
+                          f"({restarts_used}/{cfg.max_restarts})")
         finally:
             if self.agent_client is not None:
                 # Node 0 hosts the store every other agent is still polling:
@@ -247,8 +246,8 @@ class ElasticAgent:
             if self.server is not None:
                 self.server.stop()
 
-    def _rendezvous(self, gen: int) -> tuple[list[int], int] | None:
-        """Dynamic-membership rendezvous for generation ``gen``.
+    def _rendezvous_round(self, rnd: int) -> tuple[int, list[int], int]:
+        """Dynamic-membership rendezvous; returns (round, members, index).
 
         The degraded-restart path (SURVEY C11;
         torch:...dynamic_rendezvous.py:1148 rendezvouses [min, max] nodes
@@ -258,62 +257,88 @@ class ElasticAgent:
         did. Members get DENSE new node indices in node_rank order, so
         ranks stay contiguous for the shrunken world.
 
-        Returns (members, node_index) — members as ORIGINAL node ranks in
-        ascending order — or None when this node arrived after the round
-        closed (excluded — exit and let the scheduler re-admit it next
-        generation). Raises TimeoutError when fewer than min_nnodes nodes
-        ever arrive within ``rendezvous_timeout_s`` (the round is dead).
+        Rounds are STORE-GLOBAL, not loop-local: node 0 publishes the
+        round it is opening under ``rdzv/open``, and every other agent
+        syncs to ``max(local, open)`` before registering — so an agent
+        relaunched by the scheduler (fresh process, local round 0) joins
+        the job's CURRENT round instead of replaying a stale one's world
+        key with the original NUM_PROCESSES. A node that arrives after a
+        round closed doesn't exit: it pre-registers for the NEXT round and
+        blocks until node 0 opens it (on the next gang restart) — the
+        torchrun late-joiner behavior. Raises TimeoutError when a round
+        never fills (node 0) or no joinable round appears within
+        ``rendezvous_timeout_s`` (waiting node: the job likely finished).
         """
         c = self.agent_client
         cfg = self.cfg
-        c.set(f"rdzv/{gen}/member/{cfg.node_rank}", b"1")
-        c.add(f"rdzv/{gen}/count", 1)
         if cfg.node_rank == 0:
-            deadline = time.time() + cfg.rendezvous_window_s
-            hard_deadline = time.time() + cfg.rendezvous_timeout_s
-            while True:
-                n = c.add(f"rdzv/{gen}/count", 0)
-                if n >= cfg.nnodes:
-                    break
-                if n >= max(cfg.min_nnodes, 1) and time.time() >= deadline:
-                    self._log(f"rendezvous gen {gen}: window closed with "
-                              f"{n}/{cfg.nnodes} nodes — proceeding degraded")
-                    break
-                if time.time() >= hard_deadline:
-                    raise TimeoutError(
-                        f"rendezvous gen {gen}: only {n} of min "
-                        f"{max(cfg.min_nnodes, 1)} nodes arrived within "
-                        f"{cfg.rendezvous_timeout_s:.0f}s")
-                time.sleep(0.1)
-            # Enumerate members. Every registrant set() its member key
-            # BEFORE add()ing the count, so >= n keys exist by now — keep
-            # sweeping until we've found at least n (a 1 ms probe could
-            # drop an already-counted node on a loaded host, ejecting a
-            # healthy member and shrinking the gang below the count that
-            # closed the round).
-            n_final = c.add(f"rdzv/{gen}/count", 0)
-            members: list[int] = []
-            sweep_deadline = time.time() + 30.0
-            while True:
-                members = []
-                for r in range(cfg.nnodes):
-                    try:
-                        c.get(f"rdzv/{gen}/member/{r}", timeout_ms=50)
-                        members.append(r)
-                    except TimeoutError:
-                        pass
-                if len(members) >= n_final or time.time() >= sweep_deadline:
-                    break
-                time.sleep(0.05)
-            c.set(f"rdzv/{gen}/world", ",".join(map(str, members)).encode())
-        else:
-            raw = c.get(f"rdzv/{gen}/world",
-                        timeout_ms=int(cfg.rendezvous_timeout_s * 1000)
-                        ).decode()
+            c.set("rdzv/open", str(rnd).encode())
+            c.set(f"rdzv/{rnd}/member/0", b"1")
+            c.add(f"rdzv/{rnd}/count", 1)
+            members = self._close_round(rnd)
+            return rnd, members, members.index(0)
+        deadline = time.time() + cfg.rendezvous_timeout_s
+        while True:
+            left_ms = max(1, int((deadline - time.time()) * 1000))
+            cur = int(c.get("rdzv/open", timeout_ms=left_ms).decode())
+            rnd = max(rnd, cur)
+            c.set(f"rdzv/{rnd}/member/{cfg.node_rank}", b"1")
+            c.add(f"rdzv/{rnd}/count", 1)
+            left_ms = max(1, int((deadline - time.time()) * 1000))
+            raw = c.get(f"rdzv/{rnd}/world", timeout_ms=left_ms).decode()
             members = [int(r) for r in raw.split(",") if r]
-        if cfg.node_rank not in members:
-            return None
-        return members, members.index(cfg.node_rank)
+            if cfg.node_rank in members:
+                return rnd, members, members.index(cfg.node_rank)
+            self._log(f"excluded from round {rnd} (arrived after it "
+                      "closed); pre-registering for the next round")
+            rnd += 1
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"no joinable round within "
+                    f"{cfg.rendezvous_timeout_s:.0f}s (last tried {rnd})")
+
+    def _close_round(self, rnd: int) -> list[int]:
+        """Node 0: wait out the round's window, then publish the member
+        list (the world) for generation ``rnd``."""
+        c = self.agent_client
+        cfg = self.cfg
+        deadline = time.time() + cfg.rendezvous_window_s
+        hard_deadline = time.time() + cfg.rendezvous_timeout_s
+        while True:
+            n = c.add(f"rdzv/{rnd}/count", 0)
+            if n >= cfg.nnodes:
+                break
+            if n >= max(cfg.min_nnodes, 1) and time.time() >= deadline:
+                self._log(f"rendezvous round {rnd}: window closed with "
+                          f"{n}/{cfg.nnodes} nodes — proceeding degraded")
+                break
+            if time.time() >= hard_deadline:
+                raise TimeoutError(
+                    f"rendezvous round {rnd}: only {n} of min "
+                    f"{max(cfg.min_nnodes, 1)} nodes arrived within "
+                    f"{cfg.rendezvous_timeout_s:.0f}s")
+            time.sleep(0.1)
+        # Enumerate members. Every registrant set() its member key BEFORE
+        # add()ing the count, so >= n keys exist by now — keep sweeping
+        # until we've found at least n (a too-short probe could drop an
+        # already-counted node on a loaded host, ejecting a healthy member
+        # and shrinking the gang below the count that closed the round).
+        n_final = c.add(f"rdzv/{rnd}/count", 0)
+        members: list[int] = []
+        sweep_deadline = time.time() + 30.0
+        while True:
+            members = []
+            for r in range(cfg.nnodes):
+                try:
+                    c.get(f"rdzv/{rnd}/member/{r}", timeout_ms=50)
+                    members.append(r)
+                except TimeoutError:
+                    pass
+            if len(members) >= n_final or time.time() >= sweep_deadline:
+                break
+            time.sleep(0.05)
+        c.set(f"rdzv/{rnd}/world", ",".join(map(str, members)).encode())
+        return members
 
     def _peer_failure(self, gen: int) -> int | None:
         """rc another node published for this generation, or None."""

@@ -380,6 +380,10 @@ class Trainer:
                     if (cfg.eval_every_steps and
                             step % cfg.eval_every_steps == 0):
                         self.evaluate(step)
+                        # Mid-epoch eval: keep its wall time out of the
+                        # step-time percentiles AND the input-stall
+                        # denominator (meter.total_s).
+                        self.meter.reset_clock()
                 epoch += 1
                 if not cfg.eval_every_steps:
                     # every epoch boundary INCLUDING the last: the final
@@ -437,6 +441,22 @@ class Trainer:
             host[f"{unit}_per_sec"] = tput
             host[f"{unit}_per_sec_per_chip"] = tput / jax.device_count()
         host["epoch"] = step // max(self.steps_per_epoch, 1)
+        stats = getattr(self.train_loader, "stall_stats", None)
+        if stats is not None:
+            # Per-log-window input stall fraction: what % of the window the
+            # consumer spent blocked on the host pipeline (SURVEY §7.4.1;
+            # sustained-drill acceptance is < 5%).
+            # Denominator = in-loop stepping time (meter.total_s), NOT
+            # wall time between log calls: a window spanning an eval pass
+            # or checkpoint wait would otherwise dilute the stall fraction
+            # the sustained-drill <5% acceptance gates on.
+            loop_s = self.meter.total_s
+            prev = getattr(self, "_stall_prev", None)
+            if prev is not None and loop_s > prev[1]:
+                host["input_stall_pct"] = round(
+                    100.0 * max(0.0, stats.wait_s - prev[0])
+                    / (loop_s - prev[1]), 3)
+            self._stall_prev = (stats.wait_s, loop_s)
         if self.cfg.obs.log_memory:
             host.update(device_memory_metrics())
         self.logger.log(step, host, prefix="train")

@@ -24,6 +24,11 @@ class Meter:
     def __init__(self, window: int = 200):
         self.times: deque[float] = deque(maxlen=window)
         self._last: float | None = None
+        # Cumulative in-loop stepping seconds (never windowed, never
+        # reset): gaps excluded by reset_clock (eval passes, epoch
+        # boundaries) don't count — the honest denominator for rates that
+        # must not be diluted by off-loop work (input_stall_pct).
+        self.total_s = 0.0
 
     def tick(self) -> float | None:
         now = time.perf_counter()
@@ -31,6 +36,7 @@ class Meter:
         if self._last is not None:
             dt = now - self._last
             self.times.append(dt)
+            self.total_s += dt
         self._last = now
         return dt
 

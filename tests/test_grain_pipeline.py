@@ -122,31 +122,14 @@ def test_grain_streams_real_jpeg_decode(tmp_path):
     """End-to-end evidence for the C17 multiprocess arm on THIS host: real
     JPEG bytes through TarShardImageDataset inside the grain pipeline —
     the exact workload whose uncapped process arm DNF'd in round 2."""
-    import io
-    import tarfile
-
-    from PIL import Image
-
     from pytorch_distributed_train_tpu.data.datasets import (
         TarShardImageDataset,
+        write_jpeg_tar_shard,
     )
 
     rng = np.random.default_rng(0)
     shard = tmp_path / "shard-000000.tar"
-    with tarfile.open(shard, "w") as tf:
-        for i in range(16):
-            im = Image.fromarray(
-                rng.integers(0, 256, (64, 64, 3), dtype=np.uint8))
-            buf = io.BytesIO()
-            im.save(buf, "JPEG", quality=85)
-            data = buf.getvalue()
-            info = tarfile.TarInfo(f"{i:06d}.jpg")
-            info.size = len(data)
-            tf.addfile(info, io.BytesIO(data))
-            cls = str(int(rng.integers(0, 10))).encode()
-            info = tarfile.TarInfo(f"{i:06d}.cls")
-            info.size = len(cls)
-            tf.addfile(info, io.BytesIO(cls))
+    write_jpeg_tar_shard(str(shard), 16, rng, fixed_size=64, num_classes=10)
     ds = TarShardImageDataset(str(shard), 32, train=True)
     cfg = dataclasses.replace(CFG, batch_size=8, num_workers=2)
     loader = GrainHostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)

@@ -369,3 +369,45 @@ def test_tar_shard_rejects_compressed_and_bounds_handles(tmp_path):
     for i in range(len(ds)):
         ds.get_item(i, rng)
     assert len(ds._local.files) == 1  # bounded despite touching all shards
+
+
+def test_stall_stats_counts_consumer_waits():
+    """StallStats measures time the consumer blocks on the producer queue
+    (the input_stall_pct metric the sustained drill gates on): a slow
+    producer accumulates wait seconds; a fast one stays near zero."""
+    import time as _time
+
+    from pytorch_distributed_train_tpu.data.pipeline import (
+        StallStats,
+        _Producer,
+    )
+
+    def slow_gen():
+        for i in range(4):
+            _time.sleep(0.05)
+            yield i
+
+    stats = StallStats()
+    out = list(iter(_Producer(slow_gen(), depth=2, stats=stats)))
+    assert out == [0, 1, 2, 3]
+    assert stats.waits >= 4
+    assert stats.wait_s > 0.1  # consumer blocked most of ~0.2s production
+
+    fast = StallStats()
+    list(iter(_Producer(iter(range(64)), depth=2, stats=fast)))
+    assert fast.wait_s < 0.2
+
+
+def test_build_input_pipeline_attaches_stall_stats(devices8):
+    from pytorch_distributed_train_tpu.data.pipeline import (
+        build_input_pipeline,
+    )
+
+    ds = synthetic_images(32, 8, 10, seed=0)
+    cfg = DataConfig(batch_size=8, synthetic_size=32)
+    mesh = build_mesh(MeshConfig(data=-1), devices8)
+    loader, epoch_fn = build_input_pipeline(ds, cfg, mesh, train=True,
+                                            batch_axes=("data",))
+    batches = list(epoch_fn(0))
+    assert len(batches) == 4
+    assert loader.stall_stats.waits >= 4

@@ -3,7 +3,7 @@
 # probe definition) so this manual gate and bench.py's automated
 # bring-up retry can never drift. rc 0 = chip executed work.
 cd "$(dirname "$0")/.." || exit 2
-python -c "
+${PYTHON:-python3} -c "
 import sys
 sys.path.insert(0, '.')
 from bench import probe_once
