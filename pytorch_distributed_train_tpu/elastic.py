@@ -191,7 +191,18 @@ class ElasticAgent:
                             return 44
                     else:
                         # Gang restarts are whole-JOB: every node's agent
-                        # meets here before (re)spawning, no generation skew.
+                        # meets here before (re)spawning, no generation
+                        # skew. The barrier key syncs through the same
+                        # store-global round as the dynamic path — an
+                        # agent relaunched mid-job must not sit on
+                        # barrier/0 while peers wait on barrier/k.
+                        if cfg.node_rank == 0:
+                            self.agent_client.set("rdzv/open",
+                                                  str(rnd).encode())
+                        else:
+                            cur = int(self.agent_client.get(
+                                "rdzv/open", timeout_ms=600_000).decode())
+                            rnd = max(rnd, cur)
                         self.agent_client.barrier(
                             f"agents/spawn/{rnd}", cfg.nnodes, cfg.node_rank,
                             timeout_ms=600_000)
@@ -285,7 +296,21 @@ class ElasticAgent:
             c.set(f"rdzv/{rnd}/member/{cfg.node_rank}", b"1")
             c.add(f"rdzv/{rnd}/count", 1)
             left_ms = max(1, int((deadline - time.time()) * 1000))
-            raw = c.get(f"rdzv/{rnd}/world", timeout_ms=left_ms).decode()
+            try:
+                raw = c.get(f"rdzv/{rnd}/world", timeout_ms=left_ms).decode()
+            except TimeoutError:
+                # Leaving without un-registering would poison the round:
+                # when a later failure finally opens it, the world would
+                # include this long-gone node and the gang would hang
+                # waiting for its ranks. (A close racing this cleanup can
+                # still publish us — narrow window, bounded by the
+                # monitor's failure path.)
+                try:
+                    c.delete(f"rdzv/{rnd}/member/{cfg.node_rank}")
+                    c.add(f"rdzv/{rnd}/count", -1)
+                except Exception:
+                    pass
+                raise
             members = [int(r) for r in raw.split(",") if r]
             if cfg.node_rank in members:
                 return rnd, members, members.index(cfg.node_rank)

@@ -369,7 +369,20 @@ class Trainer:
                     step += 1
                     self._maybe_inject_fault(step)
                     self._maybe_inject_stall(step)
-                    self.meter.tick()
+                    if self.meter.tick() is None:
+                        # Priming tick (first step after a clock reset —
+                        # epoch boundary or mid-epoch eval): its interval
+                        # is excluded from meter.total_s, so drop the
+                        # matching stall seconds (the producer cold-start
+                        # wait) from the numerator too. Numerator and
+                        # denominator must cover the SAME intervals or
+                        # input_stall_pct can exceed 100% and spuriously
+                        # fail the sustained drill's <5% gate.
+                        stats = getattr(self.train_loader, "stall_stats",
+                                        None)
+                        if stats is not None:
+                            self._stall_prev = (stats.wait_s,
+                                                self.meter.total_s)
                     self.heartbeat.beat()
                     self.recorder.record("step", step)
                     if step % cfg.obs.log_every_steps == 0 or step == limit:

@@ -1,0 +1,60 @@
+"""7B memory-fit tooling (VERDICT r2 #9; BASELINE.json:11).
+
+The full tool compiles the real llama2_7b step at probe depths — too slow
+for CI — but its exact-args estimator is pure shape math and must stay
+correct: `args` is the dominant, backend-independent term every fit claim
+in docs/MEMFIT_7B.md rests on. Pin it against a hand-computed tiny model.
+"""
+
+import dataclasses
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "tools")
+
+
+def test_exact_arg_bytes_matches_hand_count(devices8):
+    from memfit_7b import _exact_arg_bytes
+
+    from pytorch_distributed_train_tpu.config import MeshConfig, get_preset
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+
+    cfg = get_preset("llama2_7b")
+    cfg.model = dataclasses.replace(
+        cfg.model, hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=4,
+        mlp_dim=128, vocab_size=256, max_seq_len=32)
+    mesh_cfg = MeshConfig(data=2, fsdp=2, tensor=2)
+    mesh = build_mesh(mesh_cfg, devices8)
+    got = _exact_arg_bytes(cfg, mesh, mesh_cfg)
+
+    # Hand count: full (unsharded) state bytes, then verify the sharded
+    # per-device figure sits in the only possible window — between
+    # fully-sharded-over-4 (fsdp x tensor; 'data' never shards params)
+    # and fully replicated.
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    model = build_model(cfg.model, cfg.precision, mesh=mesh,
+                        mesh_cfg=mesh_cfg)
+    tx, _ = make_optimizer(cfg.optim, total_steps=10)
+
+    def init_state(rng):
+        ids = jnp.zeros((2, cfg.model.max_seq_len), jnp.int32)
+        variables = model.init({"params": rng}, ids, train=False)
+        return TrainState.create(params=variables["params"], tx=tx)
+
+    shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    full = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in jax.tree.leaves(shape))
+    assert full / 4 < got < full, (got, full)
+
+    # Monotonicity: more fsdp shards → fewer per-device bytes.
+    mesh_cfg2 = MeshConfig(data=1, fsdp=4, tensor=2)
+    mesh2 = build_mesh(mesh_cfg2, devices8)
+    got2 = _exact_arg_bytes(cfg, mesh2, mesh_cfg2)
+    assert got2 < got

@@ -30,14 +30,30 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _shard_ok(path: str, n: int) -> bool:
+    """A reusable shard must hold exactly ``n`` images — a leftover from a
+    smaller --images run would silently shrink the dataset below what the
+    throughput accounting (and the larger-than-page-cache premise) assume."""
+    import tarfile
+
+    try:
+        with tarfile.open(path) as tf:  # header scan only
+            return sum(1 for x in tf.getnames() if x.endswith(".jpg")) == n
+    except Exception:
+        return False
+
+
 def _write_shard(path: str, n: int, rng, start_key: int = 0) -> None:
-    """One shard via the shared writer (atomic via rename, resumable)."""
+    """One shard via the shared writer (atomic via rename; resumable only
+    when the existing shard's size checks out)."""
     from pytorch_distributed_train_tpu.data.datasets import (
         write_jpeg_tar_shard,
     )
 
-    if os.path.exists(path):  # resumable synthesis
-        return
+    if os.path.exists(path):
+        if _shard_ok(path, n):
+            return
+        os.remove(path)  # stale partial/mis-sized shard from another run
     tmp = path + ".tmp"
     write_jpeg_tar_shard(tmp, n, rng, start_key=start_key)
     os.rename(tmp, path)
