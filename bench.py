@@ -868,7 +868,8 @@ def main() -> None:
 
     if vision:
         model_cfg = ModelConfig(name=args.model, num_classes=1000,
-                                image_size=args.image_size, stem=args.stem)
+                                image_size=args.image_size, stem=args.stem,
+                                attention_impl=args.attention_impl)
         loss_name = "softmax_xent"
         opt = OptimConfig(name="momentum", learning_rate=0.1,
                           schedule="constant", warmup_steps=0)

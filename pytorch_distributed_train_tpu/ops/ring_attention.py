@@ -126,15 +126,18 @@ def ring_attention_local(
         from pytorch_distributed_train_tpu.ops import flash_attention as _fa
         from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
 
-        # The kernel wants pre-expanded KV heads. Expanding before the loop
-        # means the rotating chunks carry H (not Hkv) heads over ICI — the
-        # einsum path expands per hop instead. TODO(perf): index kv blocks
-        # as h // rep inside the kernel to rotate un-expanded chunks.
-        k, v = expand_kv_heads(k, v, H)
-
+        # GQA expansion happens INSIDE the per-hop chunk, like the einsum
+        # path: the rotating chunks then carry Hkv (not H) heads over ICI —
+        # an H/Hkv reduction of ring traffic, the scarce resource here.
+        # The expansion itself is a local HBM broadcast the hop's compute
+        # hides, and autodiff transposes it to a segment-sum so dk/dv
+        # rotate at Hkv size in the backward too. (A further step —
+        # indexing kv blocks as h // rep inside the kernel — would also
+        # drop the local materialization; tracked as a kernel TODO.)
         def chunk(q_, k_, v_, qp, kp):
+            k_e, v_e = expand_kv_heads(k_, v_, H)
             return _fa.flash_attention_chunk(
-                q_, k_, v_, qp, kp, causal=causal, window=window,
+                q_, k_e, v_e, qp, kp, causal=causal, window=window,
                 interpret=interpret)
 
         chunk = jax.checkpoint(chunk)

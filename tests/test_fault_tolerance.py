@@ -297,3 +297,69 @@ def test_sigterm_preemption_saves_resumable_checkpoint(tmp_path):
     step = mgr.latest_step()
     assert step is not None and step >= 1
     mgr.close()
+
+
+def test_degraded_mesh_resume_keeps_global_batch(tmp_path, devices8):
+    """The training-level half of degraded restart (VERDICT r2 #7): a run
+    checkpointed on a 4-device data mesh resumes on a 2-device mesh —
+    Orbax reshards the state onto the smaller mesh, the step counter
+    continues, the configured GLOBAL batch (and so steps_per_epoch and
+    the data order) is unchanged, and training proceeds to the same loss
+    trajectory a healthy-world run of equal steps produces."""
+    from pytorch_distributed_train_tpu.config import (
+        MeshConfig,
+        get_preset,
+    )
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    def make_cfg(ckpt_dir):
+        cfg = get_preset("resnet18_cifar10")
+        cfg.model.image_size = 32
+        cfg.data.dataset = "synthetic_images"
+        cfg.data.synthetic_size = 128
+        cfg.data.batch_size = 32  # divisible by both world shapes
+        cfg.checkpoint.dir = str(ckpt_dir)
+        cfg.checkpoint.save_every_steps = 3
+        cfg.checkpoint.async_save = False
+        cfg.eval_every_steps = 0
+        cfg.epochs = 0
+        cfg.obs.log_every_steps = 100
+        return cfg
+
+    def run(cfg, mesh, steps):
+        cfg.total_steps = steps
+        t = Trainer(cfg, mesh=mesh)
+        seen = {}
+        orig = t._log_train
+
+        def capture(step, metrics):
+            seen[step] = float(np.asarray(metrics["loss"]))
+            return orig(step, metrics)
+
+        t._log_train = capture
+        cfg.obs.log_every_steps = 1
+        t.fit()
+        return seen
+
+    # Healthy-world reference: 6 steps on the 4-device mesh.
+    ref_cfg = make_cfg(tmp_path / "ref")
+    mesh4 = build_mesh(MeshConfig(data=4), devices8[:4])
+    ref = run(ref_cfg, mesh4, steps=6)
+
+    # Degraded path: 3 steps on 4 devices (checkpoint at 3), then RESUME
+    # on a 2-device mesh for the remaining 3.
+    cfg = make_cfg(tmp_path / "deg")
+    part1 = run(cfg, mesh4, steps=3)
+    mesh2 = build_mesh(MeshConfig(data=2), devices8[:2])
+    cfg2 = make_cfg(tmp_path / "deg")
+    part2 = run(cfg2, mesh2, steps=6)
+
+    assert max(part1) == 3 and max(part2) == 6
+    assert min(part2) == 4, f"resume replayed steps: {sorted(part2)}"
+    # Same loss trajectory as the never-degraded run: the global batch,
+    # sampler order, and restored state are all world-size independent.
+    for s in sorted(set(ref) & set(part2)):
+        np.testing.assert_allclose(
+            part2[s], ref[s], rtol=1e-4,
+            err_msg=f"step {s}: degraded resume diverged")

@@ -30,6 +30,11 @@ ARMS: list[tuple[str, list[str]]] = [
     ("resnet50_baseline", []),
     ("resnet50_s2d_stem", ["--stem", "space_to_depth"]),
     ("vit_b16", ["--model", "vit_b16"]),
+    # ViT attention A/B at its seq-197 shape (VERDICT r2 weak #2: the
+    # north-star MFU chase lists a fused-attention arm for ViT): dense XLA
+    # is the current auto choice below seq 1024 — measure the alternative.
+    ("vit_b16_chunked_attn", ["--model", "vit_b16",
+                              "--attention-impl", "chunked"]),
     ("bert_base_mlm", ["--model", "bert_base"]),
     ("llama_train_best", ["--model", "llama", "--fused-head",
                           "--optimizer", "adafactor"]),
@@ -54,6 +59,27 @@ ARMS: list[tuple[str, list[str]]] = [
     ("host_pipeline_decode_native", ["--model", "pipeline",
                                      "--pipeline-decode",
                                      "--decoder", "native"]),
+    # C17 multiprocess-loader arms (grain): first measured 2026-07-31 on
+    # the 1-core sandbox (in-process mode); on real multi-core TPU hosts
+    # these record the process-worker numbers the torch comparison wants.
+    ("host_pipeline_decode_grain_native", ["--model", "pipeline",
+                                           "--pipeline-decode",
+                                           "--loader", "grain",
+                                           "--decoder", "native"]),
+    ("host_pipeline_decode_grain_pil", ["--model", "pipeline",
+                                        "--pipeline-decode",
+                                        "--loader", "grain",
+                                        "--decoder", "pil"]),
+]
+
+# Arms that are NOT bench.py invocations. The sustained drill (VERDICT r2
+# #5 / BASELINE.json:8) runs the real trainer on a synthesized multi-GB
+# tar set for wall-clock minutes — only worth the time on a healthy chip,
+# so it joins the sweep behind the same probe gate.
+EXTRA_ARMS: list[tuple[str, list[str]]] = [
+    ("sustained_resnet50_10min",
+     [sys.executable, os.path.join(REPO, "tools", "sustained_drill.py"),
+      "--minutes", "10"]),
 ]
 
 
@@ -62,6 +88,10 @@ def run_arm(name: str, extra: list[str], timeout_s: int,
     cmd = [sys.executable, os.path.join(REPO, "bench.py"), *extra]
     if tiny:
         cmd.append("--tiny")
+    return run_cmd(cmd, timeout_s)
+
+
+def run_cmd(cmd: list[str], timeout_s: int) -> dict:
     # The child's bring-up watchdog must fire BEFORE our subprocess
     # timeout, or a hang-mode wedged lease dies as a structureless
     # rc=124 instead of bench.py's tpu_unavailable record — and the
@@ -107,37 +137,56 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     arms = [(n, a) for n, a in ARMS if args.only in n]
+    extra_arms = [] if args.tiny else [
+        (n, c) for n, c in EXTRA_ARMS if args.only in n]
     if args.tiny:
         # --tiny exists on the llama decode/spec/serve benches only
         arms = [(n, a) for n, a in arms
                 if any(k in n for k in ("decode", "spec", "serve"))
                 and "host" not in n]
-    if not arms:
+    if not arms and not extra_arms:
         print(f"no arms match --only {args.only!r}", file=sys.stderr)
         return 2
     if args.dry_run:
         for name, extra in arms:
             print(f"{name}: python bench.py {' '.join(extra)}"
                   f"{' --tiny' if args.tiny else ''}")
+        for name, cmd in extra_arms:
+            print(f"{name}: {' '.join(cmd[1:] if cmd[0] == sys.executable else cmd)}")
         return 0
 
     report: dict[str, dict] = {}
-    for i, (name, extra) in enumerate(arms, 1):
-        print(f"[{i}/{len(arms)}] {name} ...", flush=True)
-        report[name] = run_arm(name, extra, args.timeout, args.tiny)
-        r = report[name]
+
+    def record(name: str, r: dict) -> None:
+        report[name] = r
         status = (r["parsed"]["metric"] + "=" + str(r["parsed"]["value"])
                   if r["parsed"] and r["parsed"].get("metric")
                   else f"rc={r['rc']}")
         print(f"    {status} ({r['seconds']}s)", flush=True)
         with open(args.out, "w") as f:  # persist incrementally
             json.dump(report, f, indent=1)
+
+    for i, (name, extra) in enumerate(arms, 1):
+        print(f"[{i}/{len(arms)}] {name} ...", flush=True)
+        record(name, run_arm(name, extra, args.timeout, args.tiny))
+        r = report[name]
         if (r["parsed"] and r["parsed"].get("error") == "tpu_unavailable"
                 ) or r["rc"] == 124:
             print("device lease unavailable (or arm hang) — aborting "
                   "the sweep (every further arm would fail the same "
                   "way)", file=sys.stderr)
             return 3
+    # Non-bench arms (sustained drill): long-horizon — run only when every
+    # quick arm passed (a sweep with failures shouldn't burn 10+ minutes
+    # of lease on the drill); --only can still target them directly.
+    quick_ok = all(r["rc"] == 0 for r in report.values())
+    if extra_arms and (quick_ok or not arms):
+        for name, cmd in extra_arms:
+            print(f"[extra] {name} ...", flush=True)
+            record(name, run_cmd(cmd, timeout_s=max(args.timeout, 2400)))
+    elif extra_arms:
+        print("skipping extra arms (quick arms had failures)",
+              file=sys.stderr)
     ok = sum(1 for r in report.values() if r["rc"] == 0)
     print(f"done: {ok}/{len(report)} arms ok → {args.out}")
     return 0 if ok == len(report) else 1
