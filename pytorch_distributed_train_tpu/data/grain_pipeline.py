@@ -118,6 +118,24 @@ class GrainHostDataLoader:
         self.shuffle = train and data_cfg.shuffle
         self.num_workers = bounded_workers(data_cfg.num_workers)
         self.read_buffer = max(2, data_cfg.prefetch)
+        self.weighted = None
+        if train and getattr(data_cfg, "weighted_sampling", ""):
+            # torch WeightedRandomSampler parity under the PROCESS loader
+            # too: the weighted draw replaces Grain's uniform IndexSampler
+            # by using the epoch's record order (host-sharded, seed+epoch
+            # deterministic — data/sampler.py) as an explicit array
+            # source, the same mechanism the mid-epoch resume path uses.
+            # One semantic nuance vs the threads loader: with replacement,
+            # a record drawn twice in an epoch reuses the same augment rng
+            # (keyed on the record index), where the threads loader draws
+            # fresh. Construction/validation shared with HostDataLoader
+            # (sampler.make_weighted_sampler).
+            from pytorch_distributed_train_tpu.data.sampler import (
+                make_weighted_sampler,
+            )
+
+            self.weighted = make_weighted_sampler(
+                dataset, data_cfg, self.num_hosts, self.host_id)
 
     @property
     def steps_per_epoch(self) -> int:
@@ -144,7 +162,20 @@ class GrainHostDataLoader:
     def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
         import grain.python as gp
 
-        if start_batch > 0:
+        if self.weighted is not None:
+            self.weighted.set_epoch(epoch)
+            n = self.steps_per_epoch * self.host_batch
+            # ndarray slice straight into grain (len/__getitem__ suffice;
+            # the load transform ints each element): no per-epoch
+            # million-object list build, compact worker pickles.
+            source: object = self.weighted.indices()[
+                start_batch * self.host_batch:n]
+            order_sampler = gp.IndexSampler(
+                num_records=len(source), shuffle=False,
+                seed=self.seed + epoch, num_epochs=1,
+                shard_options=gp.NoSharding(),
+            )
+        elif start_batch > 0:
             # Mid-epoch resume: enumerate the epoch's record order from the
             # sampler (pure index math), slice, and run a sequential pass —
             # O(skip) index reads instead of materializing skipped batches

@@ -77,25 +77,13 @@ class HostDataLoader:
         self.global_batch = global_batch
         self.seed = data_cfg.seed
         self.num_workers = data_cfg.num_workers
-        weighted = train and getattr(data_cfg, "weighted_sampling", "")
-        if weighted:
-            if weighted != "inverse_class":
-                raise ValueError(
-                    f"weighted_sampling must be '' or 'inverse_class', "
-                    f"got {weighted!r}")
+        if train and getattr(data_cfg, "weighted_sampling", ""):
             from pytorch_distributed_train_tpu.data.sampler import (
-                WeightedDistributedSampler, inverse_class_weights,
+                make_weighted_sampler,
             )
 
-            labels = getattr(dataset, "arrays", {}).get("label")
-            if labels is None:
-                raise ValueError(
-                    "weighted_sampling='inverse_class' needs an array-style "
-                    "dataset with a 'label' array")
-            self.sampler = WeightedDistributedSampler(
-                inverse_class_weights(labels), self.num_hosts, self.host_id,
-                seed=data_cfg.seed,
-            )
+            self.sampler = make_weighted_sampler(
+                dataset, data_cfg, self.num_hosts, self.host_id)
         else:
             self.sampler = DistributedSampler(
                 len(dataset), self.num_hosts, self.host_id,
@@ -267,12 +255,6 @@ def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
     consumer thread (collectives must not race the step's collectives).
     """
     if getattr(data_cfg, "loader", "threads") == "grain":
-        if train and getattr(data_cfg, "weighted_sampling", ""):
-            raise ValueError(
-                "weighted_sampling is implemented by the 'threads' loader "
-                "only; grain's IndexSampler draws uniformly — set "
-                "data.loader='threads' (silently ignoring the knob would "
-                "train on the unweighted distribution)")
         from pytorch_distributed_train_tpu.data.grain_pipeline import (
             GrainHostDataLoader,
         )

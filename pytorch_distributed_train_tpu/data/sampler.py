@@ -113,3 +113,23 @@ def inverse_class_weights(labels: np.ndarray) -> np.ndarray:
     _, inverse, counts = np.unique(labels, return_inverse=True,
                                    return_counts=True)
     return (1.0 / counts)[inverse]
+
+
+def make_weighted_sampler(dataset, data_cfg, num_hosts: int, host_id: int):
+    """Shared factory for the ``weighted_sampling`` knob — the 'threads'
+    and 'grain' loaders must construct (and reject) identically, or the
+    train distribution silently depends on the loader choice."""
+    scheme = getattr(data_cfg, "weighted_sampling", "")
+    if scheme != "inverse_class":
+        raise ValueError(
+            f"weighted_sampling must be '' or 'inverse_class', "
+            f"got {scheme!r}")
+    labels = getattr(dataset, "arrays", {}).get("label")
+    if labels is None:
+        raise ValueError(
+            "weighted_sampling='inverse_class' needs an array-style "
+            "dataset with a 'label' array")
+    return WeightedDistributedSampler(
+        inverse_class_weights(labels), num_hosts, host_id,
+        seed=data_cfg.seed,
+    )

@@ -184,3 +184,51 @@ def test_same_record_same_epoch_draw_is_deterministic_across_runs():
     # different epoch → different draws (reshuffle + new rng keying)
     c = np.concatenate([b["image"] for b in loader.epoch(1)])
     assert not np.array_equal(np.concatenate(a), c)
+
+
+def test_grain_weighted_sampling_oversamples_rare_class():
+    """torch WeightedRandomSampler parity under the PROCESS loader too
+    (previously a threads-loader-only feature): the weighted draw becomes
+    the epoch's explicit record order, flowing through grain's pipeline."""
+    from pytorch_distributed_train_tpu.data.datasets import ArrayDataset
+
+    labels = np.array([0] * 90 + [1] * 10, np.int32)
+    ds = ArrayDataset({"image": np.zeros((100, 2, 2, 3), np.float32),
+                       "label": labels})
+    cfg = dataclasses.replace(CFG, batch_size=20,
+                              weighted_sampling="inverse_class")
+    loader = GrainHostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)
+    got = np.concatenate([b["label"] for b in loader.epoch(0)])
+    assert len(got) == 100
+    assert 0.35 < (got == 1).mean() < 0.65  # balanced in expectation
+
+    # Deterministic per (seed, epoch); reshuffles across epochs.
+    again = np.concatenate([b["label"] for b in loader.epoch(0)])
+    np.testing.assert_array_equal(got, again)
+    other = np.concatenate([b["label"] for b in loader.epoch(1)])
+    assert not np.array_equal(got, other)
+
+    # Eval stays unweighted; bad datasets still rejected.
+    ev = GrainHostDataLoader(ds, cfg, train=False, num_hosts=1, host_id=0)
+    assert ev.weighted is None
+    import pytest
+
+    with pytest.raises(ValueError, match="label"):
+        GrainHostDataLoader(
+            ArrayDataset({"x": np.zeros(10, np.float32)}), cfg, train=True,
+            num_hosts=1, host_id=0)
+
+
+def test_grain_weighted_mid_epoch_resume_matches():
+    from pytorch_distributed_train_tpu.data.datasets import ArrayDataset
+
+    labels = np.arange(64, dtype=np.int32)
+    ds = ArrayDataset({"image": np.zeros((64, 2, 2, 3), np.float32),
+                       "label": labels})
+    cfg = dataclasses.replace(CFG, batch_size=8,
+                              weighted_sampling="inverse_class")
+    loader = GrainHostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)
+    full = [b["label"] for b in loader.epoch(2)]
+    resumed = [b["label"] for b in loader.epoch(2, start_batch=3)]
+    np.testing.assert_array_equal(np.concatenate(full[3:]),
+                                  np.concatenate(resumed))

@@ -187,21 +187,6 @@ def test_cosine_restarts_validation():
                                   restart_period=-5), total_steps=100)
 
 
-def test_grain_loader_rejects_weighted_sampling():
-    from pytorch_distributed_train_tpu.config import DataConfig
-    from pytorch_distributed_train_tpu.data.datasets import ArrayDataset
-    from pytorch_distributed_train_tpu.data.pipeline import (
-        build_input_pipeline,
-    )
-
-    ds = ArrayDataset({"image": np.zeros((16, 2, 2, 3), np.float32),
-                       "label": np.zeros(16, np.int32)})
-    cfg = DataConfig(batch_size=8, loader="grain",
-                     weighted_sampling="inverse_class")
-    with pytest.raises(ValueError, match="threads"):
-        build_input_pipeline(ds, cfg, None, train=True)
-
-
 def _leaf_dtypes(tree):
     return {jnp.asarray(x).dtype.name for x in jax.tree.leaves(tree)}
 

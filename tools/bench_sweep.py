@@ -30,11 +30,12 @@ ARMS: list[tuple[str, list[str]]] = [
     ("resnet50_baseline", []),
     ("resnet50_s2d_stem", ["--stem", "space_to_depth"]),
     ("vit_b16", ["--model", "vit_b16"]),
-    # ViT attention A/B at its seq-197 shape (VERDICT r2 weak #2: the
-    # north-star MFU chase lists a fused-attention arm for ViT): dense XLA
-    # is the current auto choice below seq 1024 — measure the alternative.
-    ("vit_b16_chunked_attn", ["--model", "vit_b16",
-                              "--attention-impl", "chunked"]),
+    # ViT batch-scaling probe (MFU chase, VERDICT r2 weak #2): at seq 197
+    # the attention backends are equivalent (chunked tiles start at 256 —
+    # a chunked "A/B" would measure dense vs dense), so the lever to probe
+    # is per-chip batch: 742 img/s at bs128 leaves the MXU underfed if
+    # step time is launch/HBM-bound rather than FLOPs-bound.
+    ("vit_b16_bs256", ["--model", "vit_b16", "--batch-per-chip", "256"]),
     ("bert_base_mlm", ["--model", "bert_base"]),
     ("llama_train_best", ["--model", "llama", "--fused-head",
                           "--optimizer", "adafactor"]),

@@ -1,0 +1,25 @@
+#!/bin/sh
+# Watch the TPU lease; the moment a probe passes, run the full queued
+# benchmark battery (tools/bench_sweep.py -> BENCH_SWEEP.json). The
+# round-1/2/3 pattern is a lease wedged for hours that may heal at any
+# time — a human-free capture path means a recovery window is never
+# missed. Single-instance via pidfile; probe cadence 300 s.
+cd "$(dirname "$0")/.." || exit 2
+PIDFILE=/tmp/lease_watch.pid
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+    echo "lease_watch already running (pid $(cat "$PIDFILE"))"
+    exit 0
+fi
+echo $$ > "$PIDFILE"
+echo "[lease_watch] $(date -u +%FT%TZ) watching (probe every 300s)"
+while :; do
+    if sh tools/tpu_probe.sh 90 >/dev/null 2>&1; then
+        echo "[lease_watch] $(date -u +%FT%TZ) lease HEALTHY — running sweep"
+        ${PYTHON:-python3} tools/bench_sweep.py --timeout 1500
+        rc=$?
+        echo "[lease_watch] $(date -u +%FT%TZ) sweep done rc=$rc"
+        [ "$rc" -ne 3 ] && break   # rc 3 = lease re-wedged mid-sweep: keep watching
+    fi
+    sleep 300
+done
+rm -f "$PIDFILE"
