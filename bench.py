@@ -395,8 +395,9 @@ def decode_bench(args) -> None:
         lambda r: train_model.init({"params": r}, ids[:1, :8],
                                    train=False)["params"]
     )(jax.random.PRNGKey(0))
-    if args.quantize == "int8":
-        params = jax.jit(quant.quantize_tree)(params)
+    if args.quantize:
+        params = jax.jit(lambda p: quant.quantize_tree_named(
+            p, args.quantize))(params)
     model = build_decode_model(model_cfg, precision)
     _touch()
 
@@ -422,7 +423,7 @@ def decode_bench(args) -> None:
     wall = time.perf_counter() - t0
     # Single-device generation (no mesh) — per-chip IS the run's rate.
     per_chip = bpc * (new_tokens - 1) / wall
-    suffix = ("_int8" if args.quantize else "") + (
+    suffix = (f"_{args.quantize}" if args.quantize else "") + (
         "_tiny" if args.tiny else "")
     print(json.dumps({
         "metric": f"llama_decode{suffix}_tokens_per_sec_per_chip",
@@ -500,8 +501,9 @@ def serve_bench(args) -> None:
                                    jnp.zeros((1, 8), jnp.int32),
                                    train=False)["params"]
     )(jax.random.PRNGKey(0))
-    if args.quantize == "int8":
-        params = jax.jit(quant.quantize_tree)(params)
+    if args.quantize:
+        params = jax.jit(lambda p: quant.quantize_tree_named(
+            p, args.quantize))(params)
     _touch()
 
     rng = np.random.default_rng(0)
@@ -627,7 +629,7 @@ def serve_bench(args) -> None:
                   + b.stats["resumes"] + b.stats["forks"])
     occupancy = (b.stats["generated_tokens"] - admissions
                  ) / max(b.stats["slot_token_slots"], 1)
-    suffix = ("_int8" if args.quantize else "") + (
+    suffix = (f"_{args.quantize}" if args.quantize else "") + (
         "_tiny" if args.tiny else "")
     arm = ""
     if turns > 1:
@@ -786,8 +788,9 @@ def main() -> None:
                    help="with --speculative: draft == target (acceptance-1 "
                         "machinery ceiling instead of the random-draft "
                         "floor)")
-    p.add_argument("--quantize", default="", choices=["", "int8"],
-                   help="decode bench: weight-only int8 params (quant.py)")
+    p.add_argument("--quantize", default="", choices=["", "int8", "int4"],
+                   help="decode bench: weight-only int8 (per-channel) or "
+                        "int4 (group-wise) params (quant.py)")
     p.add_argument("--quant-training", default="", choices=["", "int8"],
                    help="llama training bench: AQT-style int8 QAT matmuls "
                         "(quant.int8_dot_general — int8 MXU path)")

@@ -86,15 +86,29 @@ def shard_decode_params(model_name: str, mesh, params) -> Any:
 
     rules = rules_for_model(model_name)
     is_q = quant._is_quant_leaf
-    proxy = jax.tree.map(lambda x: x[quant._W] if is_q(x) else x,
+    proxy = jax.tree.map(lambda x: x[quant.weight_key(x)] if is_q(x) else x,
                          params, is_leaf=is_q)
     kernel_shardings = rules.tree_shardings(mesh, proxy)
 
     def expand(leaf, sh):
         if not is_q(leaf):
             return sh
-        scale_spec = validate_spec(sh.spec, leaf[quant._S].shape, mesh)
-        return {quant._W: sh,
+        wk = quant.weight_key(leaf)
+        w = leaf[wk]
+        scale_shape = leaf[quant._S].shape
+        spec = sh.spec
+        if wk == quant._W4:
+            # int4 scales carry ONE extra dim (the grouped axis split to
+            # (n_groups, 1)): derive their spec by splitting the kernel
+            # spec's entry at that axis — group count keeps the kernel
+            # dim's sharding (validate_spec replicates it when the group
+            # count doesn't divide), the size-1 inner dim replicates.
+            axis, _ = quant._int4_grouping(w.shape, scale_shape)
+            entries = tuple(spec) + (None,) * (w.ndim - len(tuple(spec)))
+            spec = P(*entries[:axis], entries[axis], None,
+                     *entries[axis + 1:])
+        scale_spec = validate_spec(spec, scale_shape, mesh)
+        return {wk: sh,
                 quant._S: NamedSharding(mesh, scale_spec)}
 
     sharding_tree = jax.tree.map(expand, params, kernel_shardings,

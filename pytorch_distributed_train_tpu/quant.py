@@ -26,18 +26,20 @@ import re
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import traverse_util
 
 # Leaf-struct keys. A dict with exactly these keys is a quantized leaf —
 # still a valid pytree, so quantized trees flow through jit/device_put
 # unchanged.
 _W, _S = "w_int8", "scale"
+_W4 = "w_int4"
 
 DEFAULT_INCLUDE = r"(kernel|embedding)$"
 
 
 def _is_quant_leaf(x) -> bool:
-    return isinstance(x, dict) and set(x.keys()) == {_W, _S}
+    return isinstance(x, dict) and set(x.keys()) in ({_W, _S}, {_W4, _S})
 
 
 def quantize_leaf(w: jax.Array, axes: tuple[int, ...] | None = None) -> dict:
@@ -66,25 +68,82 @@ def quantize_leaf(w: jax.Array, axes: tuple[int, ...] | None = None) -> dict:
     return {_W: q.astype(jnp.int8), _S: scale.astype(jnp.float32)}
 
 
+def quantize_leaf_int4(w: jax.Array, group_size: int = 128) -> dict:
+    """Symmetric int4 (±7) with GROUP-wise absmax scales along the
+    largest axis.
+
+    Half the HBM of int8 again — the lever for HBM-bound decode, where
+    every token re-reads all params. int4's 15 levels need finer scale
+    granularity than a whole channel: groups of ``group_size`` along the
+    array's largest axis (any grouping reconstructs the weight
+    elementwise since decode dequantizes BEFORE the matmul — see
+    quantize_leaf; finer groups only tighten the absmax/14 error bound).
+    When the axis doesn't divide, the whole axis is one group (int8-style
+    granularity at int4 width). Scale shape = w.shape with the grouped
+    axis split to (n_groups, 1) — w.ndim+1 dims, so the dequant can
+    recover the grouping from shapes alone (no metadata in the struct).
+    Storage: jnp.int4 (XLA packs two per byte on TPU; numpy-side arrays
+    are byte-per-element, so host-RAM savings appear only on device).
+    """
+    axis = int(np.argmax(w.shape))
+    K = w.shape[axis]
+    G = group_size if group_size > 0 and K % group_size == 0 else K
+    grouped = w.shape[:axis] + (K // G, G) + w.shape[axis + 1:]
+    w32 = w.astype(jnp.float32).reshape(grouped)
+    absmax = jnp.max(jnp.abs(w32), axis=axis + 1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -7, 7)
+    return {_W4: q.reshape(w.shape).astype(jnp.int4),
+            _S: scale.astype(jnp.float32)}
+
+
+def _int4_grouping(w_shape, scale_shape):
+    """Recover (axis, group) from the shape relation quantize_leaf_int4
+    establishes: scale has one extra dim, inserted at the grouped axis."""
+    for i in range(len(w_shape)):
+        ng = scale_shape[i]
+        if (scale_shape[:i] == w_shape[:i]
+                and scale_shape[i + 1] == 1
+                and scale_shape[i + 2:] == w_shape[i + 1:]
+                and ng > 0 and w_shape[i] % ng == 0):
+            return i, w_shape[i] // ng
+    raise ValueError(f"unrecognized int4 scale shape {scale_shape} "
+                     f"for weight {w_shape}")
+
+
 def dequantize_leaf(q: dict, dtype=jnp.bfloat16) -> jax.Array:
+    if _W4 in q:
+        w, scale = q[_W4], q[_S]
+        axis, G = _int4_grouping(w.shape, scale.shape)
+        grouped = w.shape[:axis] + (w.shape[axis] // G, G) + w.shape[axis + 1:]
+        out = w.astype(jnp.float32).reshape(grouped) * scale
+        return out.reshape(w.shape).astype(dtype)
     return (q[_W].astype(jnp.float32) * q[_S]).astype(dtype)
 
 
-def quantize_tree(params, include: str = DEFAULT_INCLUDE):
+def quantize_tree(params, include: str = DEFAULT_INCLUDE, bits: int = 8,
+                  group_size: int = 128):
     """Params tree → same-structure tree with matching kernels replaced by
-    {w_int8, scale} structs. ``include`` is a regex over the '/'-joined
-    param path (same convention as partition rules / decay_exclude)."""
+    {w_int8|w_int4, scale} structs. ``include`` is a regex over the
+    '/'-joined param path (same convention as partition rules /
+    decay_exclude); ``bits`` selects the width (8 = per-channel scales,
+    4 = group-wise, see quantize_leaf_int4)."""
+    if bits not in (4, 8):
+        raise ValueError(f"quantize bits must be 4 or 8, got {bits}")
     pat = re.compile(include)
     flat = traverse_util.flatten_dict(params)
     out = {}
     for path, leaf in flat.items():
         name = "/".join(map(str, path))
         if leaf.ndim >= 2 and pat.search(name):
-            # Embedding tables scale per ROW (reduce the hidden axis):
-            # right for lookup (each token's row has its own step) and for
-            # the transposed tied-head matmul (row == output channel).
-            axes = (-1,) if name.endswith("embedding") else None
-            out[path] = quantize_leaf(leaf, axes)
+            if bits == 4:
+                out[path] = quantize_leaf_int4(leaf, group_size)
+            else:
+                # Embedding tables scale per ROW (reduce the hidden axis):
+                # right for lookup (each token's row has its own step) and
+                # for the transposed tied-head matmul (row == out channel).
+                axes = (-1,) if name.endswith("embedding") else None
+                out[path] = quantize_leaf(leaf, axes)
         else:
             out[path] = leaf
     return traverse_util.unflatten_dict(out)
@@ -106,8 +165,16 @@ def is_quantized(params) -> bool:
 
 
 def tree_param_bytes(params) -> int:
-    return sum(x.size * x.dtype.itemsize
-               for x in jax.tree_util.tree_leaves(params))
+    """Logical parameter bytes (int4 counts 0.5/elem — what the packed
+    DEVICE representation costs; numpy-side int4 arrays are stored a byte
+    per element, so host RAM differs)."""
+    total = 0.0
+    for x in jax.tree_util.tree_leaves(params):
+        if x.dtype == jnp.int4:
+            total += x.size * 0.5
+        else:
+            total += x.size * x.dtype.itemsize
+    return int(total)
 
 
 # ===================================================== int8 TRAINING (QAT)
@@ -185,3 +252,18 @@ def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
     compatibility; the int8 path fixes its own accumulation type."""
     del precision, preferred_element_type
     return _int8_dot(lhs, rhs, dimension_numbers)
+
+
+def weight_key(leaf: dict) -> str:
+    """The weight key of a quant struct ('w_int8' or 'w_int4')."""
+    return _W if _W in leaf else _W4
+
+
+def quantize_tree_named(params, mode: str):
+    """CLI-string dispatch ('int8'|'int4') — THE mapping every entrypoint
+    (bench decode/serve arms, serving.load_params_for_serving) goes
+    through, so a bench can never measure a different quantization recipe
+    than the server loads."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"quantize must be 'int8' or 'int4', got {mode!r}")
+    return quantize_tree(params, bits=8 if mode == "int8" else 4)

@@ -29,6 +29,7 @@ TPU-first shape discipline (SURVEY §7.4.5 — no dynamic shapes):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from collections import deque
 from functools import partial
 from typing import Any
@@ -52,9 +53,10 @@ def load_params_for_serving(cfg, safetensors_path: str,
                             quantize: str = ""):
     """Load torch-layout safetensors weights for a prepared TrainConfig —
     the shape template comes from one eval_shape init (no real init), and
-    ``quantize='int8'`` converts to the weight-only int8 tree. Shared by
-    tools/generate_cli.py and tools/serve_http.py so the loading pipeline
-    cannot diverge between the two entrypoints."""
+    ``quantize='int8'|'int4'`` converts to the weight-only quantized tree
+    (int4: group-wise scales, half int8's HBM — quant.quantize_leaf_int4).
+    Shared by tools/generate_cli.py and tools/serve_http.py so the loading
+    pipeline cannot diverge between the two entrypoints."""
     from pytorch_distributed_train_tpu import quant
     from pytorch_distributed_train_tpu.interop import load_flax_safetensors
     from pytorch_distributed_train_tpu.models.registry import build_model
@@ -67,8 +69,9 @@ def load_params_for_serving(cfg, safetensors_path: str,
             {"params": jax.random.PRNGKey(0)}, *init_inputs,
             train=False))["params"]
     params = load_flax_safetensors(safetensors_path, template)
-    if quantize == "int8":
-        params = jax.jit(quant.quantize_tree)(params)
+    if quantize:
+        params = jax.jit(
+            lambda p: quant.quantize_tree_named(p, quantize))(params)
     return params
 
 

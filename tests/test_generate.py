@@ -204,6 +204,14 @@ def test_tensor_parallel_decode_matches_single_device(devices8):
     qref = generate(model, quant.quantize_tree(params), ids, 8)
     np.testing.assert_array_equal(np.asarray(qref), np.asarray(qout))
 
+    # int4 trees shard too: the group-split scale derives its spec from
+    # the kernel's (one extra size-1 dim replicates)
+    q4 = quant.quantize_tree(params, bits=4)
+    q4sharded = shard_decode_params(cfg.name, mesh, q4)
+    q4out = generate(model, q4sharded, ids, 8, mesh=mesh)
+    q4ref = generate(model, q4, ids, 8)
+    np.testing.assert_array_equal(np.asarray(q4ref), np.asarray(q4out))
+
 
 # ------------------------------------------------------- top-p (nucleus)
 

@@ -245,3 +245,77 @@ def test_quant_training_guarded_to_llama(tmp_path):
     cfg.checkpoint.dir = str(tmp_path)
     with pytest.raises(ValueError, match="quant_training"):
         Trainer(cfg)
+
+
+def test_int4_leaf_roundtrip_and_grouping():
+    """Group-wise int4: error bounded by each group's absmax/14 half-step;
+    the grouping axis/size is recoverable from shapes alone (the struct
+    carries no metadata)."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((256, 32)) * 0.1, jnp.float32)
+    q = quant.quantize_leaf_int4(w, group_size=128)
+    assert q["w_int4"].dtype == jnp.int4
+    assert q["scale"].shape == (2, 1, 32)  # 256 → 2 groups of 128
+    back = np.asarray(quant.dequantize_leaf(q, jnp.float32))
+    scale = np.asarray(q["scale"])  # half-step bound per group
+    err = np.abs(back - np.asarray(w)).reshape(2, 128, 32)
+    assert np.all(err <= scale / 2 + 1e-6)
+    # int4 error is larger than int8's but bounded ~absmax/14 per group
+    assert err.max() <= np.abs(np.asarray(w)).max() / 14 * 1.05
+
+    # Indivisible axis → one group (int8-granularity at int4 width)
+    w2 = jnp.asarray(rng.standard_normal((100, 8)), jnp.float32)
+    q2 = quant.quantize_leaf_int4(w2, group_size=128)
+    assert q2["scale"].shape == (1, 1, 8)
+    # zero guard
+    back0 = quant.dequantize_leaf(
+        quant.quantize_leaf_int4(jnp.zeros((128, 4))), jnp.float32)
+    assert np.all(np.asarray(back0) == 0.0)
+
+
+def test_int4_tree_and_bytes():
+    params = {
+        "attn": {"q_proj": {"kernel": jnp.ones((128, 64))}},
+        "embed": {"embedding": jnp.ones((256, 64))},
+        "norm": {"scale": jnp.ones((64,))},
+    }
+    q = quant.quantize_tree(params, bits=4)
+    assert quant.is_quantized(q)
+    assert set(q["attn"]["q_proj"]["kernel"].keys()) == {"w_int4", "scale"}
+    # logical bytes: ~1/8 of fp32 (packed device representation)
+    assert quant.tree_param_bytes(q) < 0.2 * quant.tree_param_bytes(params)
+    d = quant.dequantize_tree(q, jnp.float32)
+    assert (jax.tree_util.tree_structure(d)
+            == jax.tree_util.tree_structure(params))
+    with pytest.raises(ValueError, match="bits"):
+        quant.quantize_tree(params, bits=2)
+
+
+def test_int4_generate_matches_fp_argmax_mostly():
+    """Weight-only int4 decode must stay CLOSE to the fp model: greedy
+    generations from the same prompt agree on most steps (int4 is lossier
+    than int8 — exact match isn't the bar; trajectory sanity is)."""
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model,
+        generate,
+    )
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    V, S = 128, 24
+    cfg = ModelConfig(name="llama", vocab_size=V, hidden_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4,
+                      mlp_dim=128, max_seq_len=S)
+    prec = PrecisionConfig(compute_dtype="float32")
+    params = build_model(cfg, prec).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    model = build_decode_model(cfg, prec)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, V, (2, 8)), jnp.int32)
+    fp = np.asarray(generate(model, params, prompt, 8))
+    q4 = np.asarray(generate(
+        model, jax.jit(lambda p: quant.quantize_tree(p, bits=4))(params),
+        prompt, 8))
+    gen_fp, gen_q4 = fp[:, 8:], q4[:, 8:]
+    agree = (gen_fp == gen_q4).mean()
+    assert agree >= 0.5, (agree, gen_fp, gen_q4)

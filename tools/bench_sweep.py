@@ -45,6 +45,8 @@ ARMS: list[tuple[str, list[str]]] = [
     ("llama_decode", ["--model", "llama", "--decode-tokens", "64"]),
     ("llama_decode_int8", ["--model", "llama", "--decode-tokens", "64",
                            "--quantize", "int8"]),
+    ("llama_decode_int4", ["--model", "llama", "--decode-tokens", "64",
+                           "--quantize", "int4"]),
     ("llama_spec_floor", ["--model", "llama", "--speculative", "4"]),
     ("llama_spec_ceiling", ["--model", "llama", "--speculative", "4",
                             "--spec-self"]),

@@ -48,7 +48,7 @@ def main(argv=None) -> int:
                         "top-k/top-p/min-p (beams expand the full "
                         "distribution); 0 → off")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--quantize", default="", choices=["", "int8"])
+    p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel ways over local devices (0 → off)")
     p.add_argument("--serve-slots", type=int, default=0, metavar="SLOTS",

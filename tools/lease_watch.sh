@@ -11,6 +11,7 @@ if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
     exit 0
 fi
 echo $$ > "$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT INT TERM
 echo "[lease_watch] $(date -u +%FT%TZ) watching (probe every 300s)"
 while :; do
     if sh tools/tpu_probe.sh 90 >/dev/null 2>&1; then
@@ -22,4 +23,3 @@ while :; do
     fi
     sleep 300
 done
-rm -f "$PIDFILE"

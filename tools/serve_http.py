@@ -668,7 +668,7 @@ def main(argv=None) -> int:
     p.add_argument("--min-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max-new-default", type=int, default=64)
-    p.add_argument("--quantize", default="", choices=["", "int8"])
+    p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
     args = p.parse_args(argv)
 
     try:
