@@ -29,7 +29,6 @@ TPU-first shape discipline (SURVEY §7.4.5 — no dynamic shapes):
 from __future__ import annotations
 
 import dataclasses
-import functools
 from collections import deque
 from functools import partial
 from typing import Any

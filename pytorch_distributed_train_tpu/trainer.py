@@ -340,6 +340,40 @@ class Trainer:
         return self.cfg.data.batch_size * self.cfg.data.seq_len  # tokens/step
 
     # ------------------------------------------------------------------ loop
+    def compile_report(self) -> dict:
+        """AOT-compile the train step (no step runs) and return the
+        compiler's per-device memory accounting — the `--compile-only`
+        "will this config fit" probe (the torch-world analogue is running
+        a step and reading torch.cuda.memory_summary; XLA can answer
+        before any step executes). Args/outputs alias through donation,
+        so resident ≈ args + temps. Backend caveat: XLA:CPU gives remat
+        regions distinct temp allocations (see tools/memfit_7b.py) — on
+        CPU treat temps as an upper bound."""
+        first = next(iter(self.train_loader.epoch(0)))
+        gb = self.cfg.data.batch_size
+        batch = {
+            k: jax.ShapeDtypeStruct((gb,) + np.asarray(v).shape[1:],
+                                    np.asarray(v).dtype)
+            for k, v in first.items()
+        }
+        t0 = time.time()
+        compiled = self.train_step.lower(
+            self.state, batch, self.step_rng).compile()
+        out = {"compile_s": round(time.time() - t0, 1),
+               "n_devices": jax.device_count()}
+        try:
+            ma = compiled.memory_analysis()
+            out.update(
+                arg_bytes=int(ma.argument_size_in_bytes),
+                out_bytes=int(ma.output_size_in_bytes),
+                temp_bytes=int(ma.temp_size_in_bytes),
+                resident_bytes=int(ma.argument_size_in_bytes
+                                   + ma.temp_size_in_bytes),
+            )
+        except Exception as e:  # pragma: no cover - backend-dependent
+            out["memory_analysis_error"] = f"{type(e).__name__}: {e}"
+        return out
+
     def fit(self, max_steps: int | None = None) -> TrainState:
         cfg = self.cfg
         limit = min(self.total_steps, max_steps or self.total_steps)

@@ -273,3 +273,23 @@ def test_chat_cli_multi_turn(tmp_path, capfd, monkeypatch):
     assert "'resumes': 1" in out      # turn 2 resumed turn 1's session
     assert "'forks': 1" in out  # /stats printed pre-reset: exactly one
     assert "[new conversation]" in out
+
+
+def test_compile_only_memory_report(tmp_path, capfd):
+    """--compile-only AOT-compiles the step and prints the per-device
+    memory report without running a step (the 'will it fit' probe)."""
+    import json as json_mod
+
+    sys.path.insert(0, REPO)
+    import train
+
+    rc = train.main(["--config", "resnet18_cifar10", "--compile-only",
+                     *_overrides(tmp_path)])
+    assert rc == 0
+    out = capfd.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("{"))
+    rep = json_mod.loads(line)
+    assert rep["compile_only"] is True
+    assert rep["arg_bytes"] > 1_000_000  # resnet18 params + opt state
+    assert rep["resident_bytes"] >= rep["arg_bytes"]
+    assert "[train]" not in out  # no step ran

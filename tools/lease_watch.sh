@@ -11,7 +11,8 @@ if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
     exit 0
 fi
 echo $$ > "$PIDFILE"
-trap 'rm -f "$PIDFILE"' EXIT INT TERM
+trap 'rm -f "$PIDFILE"' EXIT
+trap 'rm -f "$PIDFILE"; exit 1' INT TERM
 echo "[lease_watch] $(date -u +%FT%TZ) watching (probe every 300s)"
 while :; do
     if sh tools/tpu_probe.sh 90 >/dev/null 2>&1; then

@@ -43,6 +43,11 @@ def parse_args(argv=None):
                    help="restore the latest checkpoint (per --resume) and "
                         "run one validation pass, then exit — the "
                         "reference's validate() mode")
+    p.add_argument("--compile-only", action="store_true",
+                   help="AOT-compile the train step and print the "
+                        "compiler's per-device memory report (one JSON "
+                        "line), then exit without training — the "
+                        "'will this config fit' probe")
     p.add_argument("--export-safetensors", default="", metavar="PATH",
                    help="restore the latest checkpoint (or init) and write "
                         "a torch-layout safetensors file, then exit "
@@ -122,6 +127,12 @@ def main(argv=None) -> int:
         return 0
     if args.import_safetensors:
         trainer.import_params(args.import_safetensors)
+    if args.compile_only:
+        report = trainer.compile_report()
+        print(json.dumps({"compile_only": True, "preset": cfg.preset,
+                          **report}), flush=True)
+        trainer.close()
+        return 0
     if args.eval_only:
         if not (trainer.resumed or args.import_safetensors):
             print("[eval-only] ERROR: no checkpoint restored and no "
