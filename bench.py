@@ -384,6 +384,7 @@ def decode_bench(args) -> None:
         name="llama", **dims,
         max_seq_len=min(args.seq_len, prompt_len + new_tokens + 1),
         attention_impl="xla",  # decode steps are single-token; dense is right
+        kv_cache_dtype=args.kv_cache_dtype,
     )
     precision = PrecisionConfig(compute_dtype="bfloat16")
     _touch()
@@ -492,7 +493,8 @@ def serve_bench(args) -> None:
             f"--serve-prefix {prefix_len} pushes max_seq_len to "
             f"{max_len} (> 8192); lower the prefix length")
     model_cfg = ModelConfig(name="llama", **dims, max_seq_len=max_len,
-                            attention_impl="xla")
+                            attention_impl="xla",
+                            kv_cache_dtype=args.kv_cache_dtype)
     precision = PrecisionConfig(compute_dtype="bfloat16")
     _touch()
     train_model = build_model(model_cfg, precision)
@@ -788,6 +790,10 @@ def main() -> None:
                    help="with --speculative: draft == target (acceptance-1 "
                         "machinery ceiling instead of the random-draft "
                         "floor)")
+    p.add_argument("--kv-cache-dtype", default="",
+                   choices=["", "bfloat16", "float8_e4m3fn", "float8_e5m2"],
+                   help="decode/serve benches: KV-cache STORAGE dtype "
+                        "(fp8 halves the per-step cache read)")
     p.add_argument("--quantize", default="", choices=["", "int8", "int4"],
                    help="decode bench: weight-only int8 (per-channel) or "
                         "int4 (group-wise) params (quant.py)")

@@ -99,6 +99,11 @@ class ModelConfig:
     # ring attention skips out-of-band hops, ulysses windows its full-seq
     # local core.
     attention_window: int = 0
+    # KV-cache STORAGE dtype for decode/serving ("" = compute dtype).
+    # "float8_e4m3fn" halves cache HBM and the per-step cache read —
+    # decode's bandwidth bill (the fp8-KV recipe of production servers);
+    # llama + gpt2 families. Training attention is untouched.
+    kv_cache_dtype: str = ""
     # Packed-block document isolation (llama/gpt2 training): >= 0 names
     # the EOS id that delimits documents inside packed seq_len blocks
     # (data/text.py packing). Attention is then masked across documents

@@ -34,6 +34,7 @@ class GPT2Attention(nn.Module):
     attn_impl: str = "auto"
     window: int = 0  # sliding-window attention (0 = full causal)
     quant: str = ""  # "" | "int8" (quant.int8_dot_general QAT matmuls)
+    kv_cache_dtype: str = ""  # cache STORAGE dtype (llama.py contract)
     decode: bool = False  # KV cache (same contract as llama.py decode)
     # S>1 appends at the running offset instead of prefilling from 0
     # (speculative.py's verify pass — same contract as llama.py)
@@ -57,10 +58,15 @@ class GPT2Attention(nn.Module):
         q, k, v = proj("q_proj")(x), proj("k_proj")(x), proj("v_proj")(x)
         if self.decode:
             L = self.max_seq_len
+            from pytorch_distributed_train_tpu.models.llama import (
+                resolve_kv_dtype,
+            )
+
+            cdt = resolve_kv_dtype(self.kv_cache_dtype, k.dtype)
             c_k = self.variable("cache", "cached_key", jnp.zeros,
-                                (B, L, self.num_heads, head_dim), k.dtype)
+                                (B, L, self.num_heads, head_dim), cdt)
             c_v = self.variable("cache", "cached_value", jnp.zeros,
-                                (B, L, self.num_heads, head_dim), v.dtype)
+                                (B, L, self.num_heads, head_dim), cdt)
             # decode_rows + decode_multi = MULTI-TOKEN rows continuation
             # (serving.py session resume ingests a whole user turn at each
             # row's offset); plain decode_rows steps are its S=1 case.
@@ -70,9 +76,9 @@ class GPT2Attention(nn.Module):
             if S > 1 and not self.decode_multi:
                 # prefill from position 0 (generate.py contract)
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_k.value, k, 0, 1)
+                    c_k.value, k.astype(cdt), 0, 1)
                 c_v.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_v.value, v, 0, 1)
+                    c_v.value, v.astype(cdt), 0, 1)
                 c_i.value = jnp.full(idx_shape, S, jnp.int32)
                 y = dot_product_attention(q, k, v, causal=True,
                                           impl=self.attn_impl,
@@ -83,8 +89,8 @@ class GPT2Attention(nn.Module):
                 idx = c_i.value  # (B,)
                 upd = lambda c, new, i: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
                     c, new, i, 0)
-                c_k.value = jax.vmap(upd)(c_k.value, k, idx)
-                c_v.value = jax.vmap(upd)(c_v.value, v, idx)
+                c_k.value = jax.vmap(upd)(c_k.value, k.astype(cdt), idx)
+                c_v.value = jax.vmap(upd)(c_v.value, v.astype(cdt), idx)
                 c_i.value = idx + S
                 q_pos = idx[:, None] + jnp.arange(S)  # (B, S)
                 k_pos = jnp.arange(L)
@@ -92,14 +98,15 @@ class GPT2Attention(nn.Module):
                 if self.window:
                     mask &= (q_pos[:, :, None] - k_pos[None, None, :]
                              ) < self.window
-                y = dot_product_attention(q, c_k.value, c_v.value,
+                y = dot_product_attention(q, c_k.value.astype(self.dtype),
+                                          c_v.value.astype(self.dtype),
                                           mask=mask[:, None], impl="xla")
             else:
                 idx = c_i.value
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_k.value, k, idx, 1)
+                    c_k.value, k.astype(cdt), idx, 1)
                 c_v.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_v.value, v, idx, 1)
+                    c_v.value, v.astype(cdt), idx, 1)
                 c_i.value = idx + S
                 q_pos = idx + jnp.arange(S)
                 k_pos = jnp.arange(L)
@@ -107,8 +114,9 @@ class GPT2Attention(nn.Module):
                 if self.window:
                     mask &= (q_pos[:, None] - k_pos[None, :]) < self.window
                 mask = mask[None, None]
-                y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
-                                          impl="xla")
+                y = dot_product_attention(q, c_k.value.astype(self.dtype),
+                                          c_v.value.astype(self.dtype),
+                                          mask=mask, impl="xla")
         else:
             y = dot_product_attention(q, k, v, causal=True, cp=self.cp,
                                       impl=self.attn_impl,
@@ -132,6 +140,7 @@ class GPT2Block(nn.Module):
     attn_impl: str = "auto"
     window: int = 0
     quant: str = ""
+    kv_cache_dtype: str = ""
     decode: bool = False
     decode_multi: bool = False
     decode_rows: bool = False
@@ -149,7 +158,9 @@ class GPT2Block(nn.Module):
             GPT2Attention(self.num_heads, self.max_seq_len, self.dtype,
                           self.param_dtype, cp=self.cp,
                           attn_impl=self.attn_impl, window=self.window,
-                          quant=self.quant, decode=self.decode,
+                          quant=self.quant,
+                          kv_cache_dtype=self.kv_cache_dtype,
+                          decode=self.decode,
                           decode_multi=self.decode_multi,
                           decode_rows=self.decode_rows,
                           name="attn")(h, segments=segments),
@@ -187,6 +198,7 @@ class GPT2LMHead(nn.Module):
     attn_impl: str = "auto"
     attention_window: int = 0  # sliding window (0 = full causal)
     quant_training: str = ""  # "" | "int8" AQT matmuls (tied head stays fp)
+    kv_cache_dtype: str = ""  # cache STORAGE dtype (llama.py contract)
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
     # Multi-token continuation in decode mode (speculative.py verify pass)
     decode_multi: bool = False
@@ -262,6 +274,7 @@ class GPT2LMHead(nn.Module):
                 self.dropout_rate, deterministic, self.dtype,
                 self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
                 window=self.attention_window, quant=self.quant_training,
+                kv_cache_dtype=self.kv_cache_dtype,
                 decode=self.decode, decode_multi=self.decode_multi,
                 decode_rows=self.decode_rows,
                 name=f"h{i}",
@@ -288,11 +301,15 @@ class GPT2LMHead(nn.Module):
 
 
 def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
+    from pytorch_distributed_train_tpu.models.llama import resolve_kv_dtype
+
+    resolve_kv_dtype(getattr(cfg, "kv_cache_dtype", ""), dtype)  # validate NOW
     return GPT2LMHead(
         cp=cp,
         act=act,
         attn_impl=getattr(cfg, "attention_impl", "auto"),
         attention_window=getattr(cfg, "attention_window", 0),
+        kv_cache_dtype=getattr(cfg, "kv_cache_dtype", ""),
         quant_training=getattr(cfg, "quant_training", ""),
         segment_eos_id=getattr(cfg, "segment_eos_id", -1),
         fused_loss=getattr(cfg, "fused_lm_loss", False),

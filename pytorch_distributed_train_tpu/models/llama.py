@@ -116,6 +116,21 @@ def apply_rope_rows(x: jnp.ndarray, cos: jnp.ndarray,
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+KV_CACHE_DTYPES = ("", "bfloat16", "float16", "float8_e4m3fn",
+                   "float8_e5m2")
+
+
+def resolve_kv_dtype(kv_cache_dtype: str, default):
+    """Validate + resolve the KV-cache storage dtype — ONE rule for every
+    model family, erroring with the config key and allowed values instead
+    of a numpy dtype error buried in a jit trace."""
+    if kv_cache_dtype not in KV_CACHE_DTYPES:
+        raise ValueError(
+            f"model.kv_cache_dtype must be one of {KV_CACHE_DTYPES}, "
+            f"got {kv_cache_dtype!r}")
+    return jnp.dtype(kv_cache_dtype) if kv_cache_dtype else default
+
+
 class LlamaAttention(nn.Module):
     num_heads: int
     num_kv_heads: int
@@ -129,6 +144,12 @@ class LlamaAttention(nn.Module):
     attn_impl: str = "auto"  # threaded from ModelConfig.attention_impl
     window: int = 0  # sliding-window attention (0 = full causal)
     quant: str = ""  # "" | "int8" — AQT QAT matmuls (quant.int8_dot_general)
+    # KV-cache STORAGE dtype ("" = compute dtype). "float8_e4m3fn" halves
+    # cache HBM (and the per-step cache read — decode's bandwidth bill)
+    # with a cast at write and read; no scales to manage (the fp8 KV
+    # recipe production servers use; e4m3's ±448 range covers rope'd
+    # K/V activations). Train-path attention is untouched.
+    kv_cache_dtype: str = ""
     # Autoregressive decode: maintain a (B, max_seq_len, H_kv, D) KV cache in
     # the flax 'cache' collection (the idiomatic flax decode pattern — torch
     # analogue: HF past_key_values). Works for both the prefill call (S>1 at
@@ -161,10 +182,11 @@ class LlamaAttention(nn.Module):
 
         if self.decode:
             L = self.max_seq_len
+            cdt = resolve_kv_dtype(self.kv_cache_dtype, k.dtype)
             c_k = self.variable("cache", "cached_key", jnp.zeros,
-                                (B, L, self.num_kv_heads, head_dim), k.dtype)
+                                (B, L, self.num_kv_heads, head_dim), cdt)
             c_v = self.variable("cache", "cached_value", jnp.zeros,
-                                (B, L, self.num_kv_heads, head_dim), v.dtype)
+                                (B, L, self.num_kv_heads, head_dim), cdt)
             # decode_rows + decode_multi = MULTI-TOKEN rows continuation
             # (serving.py session resume ingests a whole user turn at each
             # row's offset); plain decode_rows steps are its S=1 case.
@@ -183,9 +205,9 @@ class LlamaAttention(nn.Module):
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_k.value, k, 0, 1)
+                    c_k.value, k.astype(cdt), 0, 1)
                 c_v.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_v.value, v, 0, 1)
+                    c_v.value, v.astype(cdt), 0, 1)
                 c_i.value = jnp.full(idx_shape, S, jnp.int32)
                 y = dot_product_attention(q, k, v, causal=True,
                                           impl=self.attn_impl,
@@ -206,8 +228,8 @@ class LlamaAttention(nn.Module):
                 k = apply_rope_rows(k, cos_r, sin_r)
                 upd = lambda c, new, i: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
                     c, new, i, 0)
-                c_k.value = jax.vmap(upd)(c_k.value, k, idx)
-                c_v.value = jax.vmap(upd)(c_v.value, v, idx)
+                c_k.value = jax.vmap(upd)(c_k.value, k.astype(cdt), idx)
+                c_v.value = jax.vmap(upd)(c_v.value, v.astype(cdt), idx)
                 c_i.value = idx + S
                 q_pos = idx[:, None] + jnp.arange(S)  # (B, S)
                 k_pos = jnp.arange(L)
@@ -215,7 +237,8 @@ class LlamaAttention(nn.Module):
                 if self.window:
                     mask &= (q_pos[:, :, None] - k_pos[None, None, :]
                              ) < self.window
-                y = dot_product_attention(q, c_k.value, c_v.value,
+                y = dot_product_attention(q, c_k.value.astype(self.dtype),
+                                          c_v.value.astype(self.dtype),
                                           mask=mask[:, None], impl="xla")
             else:
                 # Step(s) at the running offset (dynamic index). Handles
@@ -232,9 +255,9 @@ class LlamaAttention(nn.Module):
                 q = apply_rope(q, cos, sin)
                 k = apply_rope(k, cos, sin)
                 c_k.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_k.value, k, idx, 1)
+                    c_k.value, k.astype(cdt), idx, 1)
                 c_v.value = jax.lax.dynamic_update_slice_in_dim(
-                    c_v.value, v, idx, 1)
+                    c_v.value, v.astype(cdt), idx, 1)
                 c_i.value = idx + S
                 # mask against absolute positions; the unwritten cache tail
                 # (> idx) is masked out so the static length leaks nothing
@@ -244,8 +267,9 @@ class LlamaAttention(nn.Module):
                 if self.window:
                     mask &= (q_pos[:, None] - k_pos[None, :]) < self.window
                 mask = mask[None, None]
-                y = dot_product_attention(q, c_k.value, c_v.value, mask=mask,
-                                          impl="xla")
+                y = dot_product_attention(q, c_k.value.astype(self.dtype),
+                                          c_v.value.astype(self.dtype),
+                                          mask=mask, impl="xla")
         else:
             cos, sin = rope_frequencies(head_dim, S, self.rope_theta,
                                              self.rope_scaling,
@@ -306,6 +330,7 @@ class LlamaBlock(nn.Module):
     attn_impl: str = "auto"
     window: int = 0
     quant: str = ""
+    kv_cache_dtype: str = ""
     decode: bool = False
     decode_multi: bool = False
     decode_rows: bool = False
@@ -318,7 +343,8 @@ class LlamaBlock(nn.Module):
             self.rope_scaling, self.max_seq_len, self.dtype,
             self.param_dtype, rope_scaling_type=self.rope_scaling_type,
             cp=self.cp, attn_impl=self.attn_impl,
-            window=self.window, quant=self.quant, decode=self.decode,
+            window=self.window, quant=self.quant,
+            kv_cache_dtype=self.kv_cache_dtype, decode=self.decode,
             decode_multi=self.decode_multi, decode_rows=self.decode_rows,
             name="attn",
         )(h, segments=segments, positions=positions)
@@ -372,6 +398,7 @@ class LlamaForCausalLM(nn.Module):
     # pack boundaries, the simple-packing default).
     segment_eos_id: int = -1
     decode: bool = False  # KV-cache autoregressive mode (generate.py)
+    kv_cache_dtype: str = ""  # "" | fp8 dtypes — cache STORAGE dtype
     # Multi-token continuation in decode mode (speculative.py verify pass)
     decode_multi: bool = False
     # Per-row cache offsets for continuous-batching serving (serving.py)
@@ -423,7 +450,8 @@ class LlamaForCausalLM(nn.Module):
                 rope_scaling_type=self.rope_scaling_type,
                 cp=self.cp, moe=moe,
                 attn_impl=self.attn_impl, window=self.attention_window,
-                quant=self.quant_training, decode=self.decode,
+                quant=self.quant_training,
+                kv_cache_dtype=self.kv_cache_dtype, decode=self.decode,
                 decode_multi=self.decode_multi, decode_rows=self.decode_rows,
                 name=f"layer{i}",
             )(x, segments=segments, positions=positions)
@@ -460,6 +488,7 @@ class LlamaForCausalLM(nn.Module):
 
 
 def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
+    resolve_kv_dtype(getattr(cfg, "kv_cache_dtype", ""), dtype)  # validate NOW
     moe = None
     if getattr(cfg, "num_experts", 0) > 1:
         from pytorch_distributed_train_tpu.ops.moe import MoeSpec
@@ -480,6 +509,7 @@ def llama(cfg, dtype, param_dtype, cp=None, act=None) -> LlamaForCausalLM:
         quant_training=getattr(cfg, "quant_training", ""),
         attn_impl=getattr(cfg, "attention_impl", "auto"),
         attention_window=getattr(cfg, "attention_window", 0),
+        kv_cache_dtype=getattr(cfg, "kv_cache_dtype", ""),
         segment_eos_id=getattr(cfg, "segment_eos_id", -1),
         fused_loss=getattr(cfg, "fused_lm_loss", False),
         vocab_size=cfg.vocab_size,

@@ -288,3 +288,55 @@ def test_filter_logits_min_p_adaptive_floor():
     # composes after top-k: top_k=2 then min_p floors within the pair
     out = np.asarray(filter_logits(conf, 1.0, 2, min_p=0.5))
     assert np.isfinite(out[0]) and np.isinf(out[1:]).all()
+
+
+def test_fp8_kv_cache_storage_and_trajectory():
+    """model.kv_cache_dtype=float8_e4m3fn: the decode cache STORES fp8
+    (half the per-step cache read — decode's bandwidth bill) while
+    compute stays in the model dtype; greedy trajectories track the
+    full-precision cache closely. Covers llama and gpt2 (same contract)."""
+    import dataclasses
+
+    from pytorch_distributed_train_tpu.generate import init_cache
+
+    for fam in ("llama", "gpt2"):
+        cfg = ModelConfig(name=fam, vocab_size=128, hidden_size=64,
+                          num_layers=2, num_heads=4, num_kv_heads=4,
+                          mlp_dim=128, max_seq_len=24)
+        prec = PrecisionConfig(compute_dtype="float32")
+        params = build_model(cfg, prec).init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (2, 8)), jnp.int32)
+        ref = np.asarray(
+            generate(build_decode_model(cfg, prec), params, prompt, 8))
+        cfg8 = dataclasses.replace(cfg, kv_cache_dtype="float8_e4m3fn")
+        m8 = build_decode_model(cfg8, prec)
+        kv = [x for x in jax.tree_util.tree_leaves(init_cache(m8, 2))
+              if x.ndim == 4]
+        assert kv and all(x.dtype == jnp.float8_e4m3fn for x in kv)
+        out = np.asarray(generate(m8, params, prompt, 8))
+        agree = (ref[:, 8:] == out[:, 8:]).mean()
+        assert agree >= 0.75, (fam, agree)
+
+
+def test_fp8_kv_cache_serving_batcher():
+    """Continuous batching on an fp8 KV cache: per-row scatter/gather and
+    session park/resume all run on the fp8 buffers."""
+    from pytorch_distributed_train_tpu.serving import ContinuousBatcher
+
+    cfg = ModelConfig(name="llama", vocab_size=128, hidden_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4,
+                      mlp_dim=128, max_seq_len=32,
+                      kv_cache_dtype="float8_e4m3fn")
+    prec = PrecisionConfig(compute_dtype="float32")
+    params = build_model(cfg, prec).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    b = ContinuousBatcher(cfg, prec, params, slots=2)
+    u1 = b.submit([3, 5, 7], 4)
+    u2 = b.submit(list(range(2, 10)), 3)
+    done = {c.uid: c for c in b.run()}
+    assert set(done) == {u1, u2}
+    assert len(done[u1].tokens) == 4 and len(done[u2].tokens) == 3

@@ -47,6 +47,8 @@ ARMS: list[tuple[str, list[str]]] = [
                            "--quantize", "int8"]),
     ("llama_decode_int4", ["--model", "llama", "--decode-tokens", "64",
                            "--quantize", "int4"]),
+    ("llama_decode_fp8kv", ["--model", "llama", "--decode-tokens", "64",
+                            "--kv-cache-dtype", "float8_e4m3fn"]),
     ("llama_spec_floor", ["--model", "llama", "--speculative", "4"]),
     ("llama_spec_ceiling", ["--model", "llama", "--speculative", "4",
                             "--spec-self"]),
