@@ -690,6 +690,7 @@ def spec_bench(args) -> None:
                         num_heads=8, num_kv_heads=8, mlp_dim=1376))
     max_len = prompt_len + new_tokens + k + 2
     cfg = ModelConfig(name="llama", **dims, max_seq_len=max_len,
+                      kv_cache_dtype=args.kv_cache_dtype,
                       attention_impl="xla")
     precision = PrecisionConfig(compute_dtype="bfloat16")
     _touch()
@@ -705,6 +706,7 @@ def spec_bench(args) -> None:
         draft_cfg, draft_params, arm = cfg, params, "self"
     else:
         draft_cfg = ModelConfig(name="llama", **d_dims, max_seq_len=max_len,
+                                kv_cache_dtype=args.kv_cache_dtype,
                                 attention_impl="xla")
         draft_params, arm = init_params(draft_cfg, 1), "randdraft"
     _touch()

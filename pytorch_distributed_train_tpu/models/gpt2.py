@@ -19,6 +19,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from pytorch_distributed_train_tpu.models.llama import resolve_kv_dtype
 from pytorch_distributed_train_tpu.ops.attention import (
     ContextParallelConfig,
     dot_product_attention,
@@ -58,10 +59,6 @@ class GPT2Attention(nn.Module):
         q, k, v = proj("q_proj")(x), proj("k_proj")(x), proj("v_proj")(x)
         if self.decode:
             L = self.max_seq_len
-            from pytorch_distributed_train_tpu.models.llama import (
-                resolve_kv_dtype,
-            )
-
             cdt = resolve_kv_dtype(self.kv_cache_dtype, k.dtype)
             c_k = self.variable("cache", "cached_key", jnp.zeros,
                                 (B, L, self.num_heads, head_dim), cdt)
@@ -301,8 +298,6 @@ class GPT2LMHead(nn.Module):
 
 
 def gpt2(cfg, dtype, param_dtype, cp=None, act=None) -> GPT2LMHead:
-    from pytorch_distributed_train_tpu.models.llama import resolve_kv_dtype
-
     resolve_kv_dtype(getattr(cfg, "kv_cache_dtype", ""), dtype)  # validate NOW
     return GPT2LMHead(
         cp=cp,

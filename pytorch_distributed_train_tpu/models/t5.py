@@ -40,7 +40,10 @@ from flax import linen as nn
 
 # T5's LayerNorm IS llama's RMSNorm (scale-only, fp32 mean-square, no
 # mean subtraction) — one implementation in the zoo, eps=1e-6 here.
-from pytorch_distributed_train_tpu.models.llama import RMSNorm  # noqa: E402
+from pytorch_distributed_train_tpu.models.llama import (
+    RMSNorm,
+    resolve_kv_dtype,
+)  # noqa: E402
 
 
 def relative_position_bucket(relative_position, bidirectional: bool,
@@ -182,6 +185,7 @@ class T5DecodeAttention(nn.Module):
     # contract as llama/gpt2 decode_rows: cache_index is (B,), and the
     # relative-position bias / mask are computed per row.
     decode_rows: bool = False
+    kv_cache_dtype: str = ""  # cache STORAGE dtype (llama.py contract)
 
     @nn.compact
     def __call__(self, x, position_bias=None):
@@ -199,10 +203,11 @@ class T5DecodeAttention(nn.Module):
         k = proj(kv_std, "k_proj")(x)
         v = proj(kv_std, "v_proj")(x)
         L = self.max_len
+        cdt = resolve_kv_dtype(self.kv_cache_dtype, k.dtype)
         c_k = self.variable("cache", "cached_key", jnp.zeros,
-                            (B, L, self.num_heads, head_dim), k.dtype)
+                            (B, L, self.num_heads, head_dim), cdt)
         c_v = self.variable("cache", "cached_value", jnp.zeros,
-                            (B, L, self.num_heads, head_dim), v.dtype)
+                            (B, L, self.num_heads, head_dim), cdt)
         idx_shape = (B,) if self.decode_rows else ()
         c_i = self.variable("cache", "cache_index",
                             lambda: jnp.zeros(idx_shape, jnp.int32))
@@ -210,13 +215,13 @@ class T5DecodeAttention(nn.Module):
         if self.decode_rows:
             upd = lambda c, new, i: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
                 c, new, i, 0)
-            c_k.value = jax.vmap(upd)(c_k.value, k, idx)
-            c_v.value = jax.vmap(upd)(c_v.value, v, idx)
+            c_k.value = jax.vmap(upd)(c_k.value, k.astype(cdt), idx)
+            c_v.value = jax.vmap(upd)(c_v.value, v.astype(cdt), idx)
         else:
             c_k.value = jax.lax.dynamic_update_slice_in_dim(
-                c_k.value, k, idx, 1)
+                c_k.value, k.astype(cdt), idx, 1)
             c_v.value = jax.lax.dynamic_update_slice_in_dim(
-                c_v.value, v, idx, 1)
+                c_v.value, v.astype(cdt), idx, 1)
         c_i.value = idx + 1
         k_pos = jnp.arange(L)
         if self.rel_bias:
@@ -240,7 +245,8 @@ class T5DecodeAttention(nn.Module):
                 position_bias = jnp.transpose(
                     table(buckets), (1, 0))[None, :, None, :]  # (1,H,1,L)
             position_bias = position_bias.astype(jnp.float32)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, c_k.value,
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q,
+                            c_k.value.astype(self.dtype),
                             preferred_element_type=jnp.float32)
         if position_bias is not None:
             scores = scores + position_bias
@@ -248,7 +254,8 @@ class T5DecodeAttention(nn.Module):
                 <= (idx[:, None, None, None] if self.decode_rows else idx))
         scores = jnp.where(live, scores, jnp.float32(-1e9))
         probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-        y = jnp.einsum("bhqk,bkhd->bqhd", probs, c_v.value)
+        y = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                       c_v.value.astype(self.dtype))
         out = nn.DenseGeneral(
             C, axis=(-2, -1), use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -435,6 +442,7 @@ class T5DecodeBlock(nn.Module):
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     decode_rows: bool = False
+    kv_cache_dtype: str = ""
 
     @nn.compact
     def __call__(self, x, enc, enc_mask=None, position_bias=None):
@@ -445,6 +453,7 @@ class T5DecodeBlock(nn.Module):
             rel_pos_max_distance=self.rel_pos_max_distance,
             max_len=self.max_len, dtype=self.dtype,
             param_dtype=self.param_dtype, decode_rows=self.decode_rows,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="self_attn",
         )(h, position_bias=position_bias)
         x = x + h
@@ -523,6 +532,7 @@ class T5DecodeStep(nn.Module):
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     decode_rows: bool = False
+    kv_cache_dtype: str = ""
 
     @nn.compact
     def __call__(self, dec_ids, enc, enc_mask=None):
@@ -543,6 +553,7 @@ class T5DecodeStep(nn.Module):
                 eps=self.layer_norm_eps, max_len=self.max_decode_len,
                 dtype=self.dtype, param_dtype=self.param_dtype,
                 decode_rows=self.decode_rows,
+                kv_cache_dtype=self.kv_cache_dtype,
                 name=f"dec_block{i}",
             )(y, enc, enc_mask=mask4, position_bias=bias)
         y = RMSNorm(self.layer_norm_eps, name="dec_final_norm")(y)
@@ -576,8 +587,10 @@ def t5_encoder(cfg, dtype, param_dtype) -> T5Encoder:
 
 def t5_decode_step(cfg, dtype, param_dtype, max_decode_len: int,
                    decode_rows: bool = False) -> T5DecodeStep:
+    resolve_kv_dtype(getattr(cfg, "kv_cache_dtype", ""), dtype)  # validate
     return T5DecodeStep(
         decode_rows=decode_rows,
+        kv_cache_dtype=getattr(cfg, "kv_cache_dtype", ""),
         vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
         decoder_layers=getattr(cfg, "decoder_layers", 0) or cfg.num_layers,
         num_heads=cfg.num_heads, mlp_dim=cfg.mlp_dim,

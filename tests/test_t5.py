@@ -261,3 +261,27 @@ def test_t5_trainer_e2e(tmp_path):
     state = t.fit()
     assert int(state.step) == 2
     t.close()
+
+
+def test_t5_fp8_kv_cache_decode():
+    """kv_cache_dtype=float8_e4m3fn on the t5 decoder self-attention cache
+    (cross-attention recomputes from the encoder, no cache): buffers store
+    fp8 and greedy generation tracks the full-precision cache."""
+    import dataclasses
+
+    import numpy as np
+
+    from pytorch_distributed_train_tpu.generate import generate_seq2seq
+
+    cfg = _cfg()
+    _, params = _model_and_params(cfg)
+    prec = PrecisionConfig(compute_dtype="float32")
+    src = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (2, 6)),
+        jnp.int32)
+    ref = np.asarray(generate_seq2seq(cfg, prec, params, src, 6,
+                                      temperature=0.0, eos_id=None))
+    cfg8 = dataclasses.replace(cfg, kv_cache_dtype="float8_e4m3fn")
+    out = np.asarray(generate_seq2seq(cfg8, prec, params, src, 6,
+                                      temperature=0.0, eos_id=None))
+    assert (ref == out).mean() >= 0.75, (ref, out)
