@@ -194,6 +194,55 @@ def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0,
     return logits
 
 
+def apply_penalties(logits, counts, *, repetition_penalty: float = 1.0,
+                    presence_penalty: float = 0.0,
+                    frequency_penalty: float = 0.0):
+    """Context-aware logit penalties, applied on RAW logits BEFORE the
+    temperature/top-k/top-p warpers (HF's processor-before-warper order).
+
+    ``counts`` is (B, V) fp32 occurrence counts of each vocab id in the
+    row's text so far (prompt + generated — both HF's repetition_penalty
+    and the OpenAI penalties consider the full context). Penalties may be
+    scalars or (B,)/(B, 1) arrays (serving passes per-request values):
+    - repetition_penalty (HF CTRL rule, >1 discourages): seen tokens'
+      positive logits divide by p, negative multiply by p.
+    - presence_penalty (OpenAI, additive): subtract p once for any seen
+      token.
+    - frequency_penalty (OpenAI, additive): subtract p x count.
+    """
+    logits = logits.astype(jnp.float32)
+    seen = counts > 0
+
+    def bcol(p):  # scalar or (B,)/(B,1) → broadcastable against (B, V)
+        p = jnp.asarray(p, jnp.float32)
+        return p[:, None] if p.ndim == 1 else p
+
+    rp = bcol(repetition_penalty)
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(seen & (rp != 1.0), penalized, logits)
+    logits = logits - bcol(presence_penalty) * seen.astype(jnp.float32)
+    logits = logits - bcol(frequency_penalty) * counts
+    return logits
+
+
+def token_counts(ids, vocab_size: int, pad_id: int | None = None):
+    """(B, S) ids → (B, V) fp32 occurrence counts (the `counts` input of
+    apply_penalties). ``pad_id`` rows are excluded (right-padded
+    prompts must not penalize the pad token)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    w = jnp.ones(ids.shape, jnp.float32)
+    if pad_id is not None:
+        w = jnp.where(ids == pad_id, 0.0, w)
+    B = ids.shape[0]
+    counts = jnp.zeros((B, vocab_size), jnp.float32)
+    return counts.at[jnp.arange(B)[:, None], ids].add(w)
+
+
+def bump_counts(counts, tok):
+    """Add one emitted token per row to the (B, V) counts."""
+    return counts.at[jnp.arange(counts.shape[0]), tok].add(1.0)
+
+
 def _sample(logits, rng, temperature: float, top_k: int,
             top_p: float = 0.0, min_p: float = 0.0):
     if temperature == 0.0:
@@ -206,7 +255,10 @@ def _sample(logits, rng, temperature: float, top_k: int,
 def generate(model, params, prompt_ids, max_new_tokens: int,
              *, temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0, min_p: float = 0.0, rng=None,
-             eos_id: int | None = None, mesh=None) -> jnp.ndarray:
+             eos_id: int | None = None, mesh=None,
+             repetition_penalty: float = 1.0,
+             presence_penalty: float = 0.0,
+             frequency_penalty: float = 0.0) -> jnp.ndarray:
     """Generate continuations for a (B, S) int32 prompt batch.
 
     Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
@@ -215,7 +267,9 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
     "prefill this cache from position 0"; continuation past a prefill is
     single-token steps only. With ``temperature=0`` decoding is greedy and
     deterministic; ``eos_id`` freezes finished rows (emitted tokens stay
-    ``eos_id``).
+    ``eos_id``). Repetition/presence/frequency penalties follow
+    :func:`apply_penalties` (HF/OpenAI semantics over prompt+generated;
+    active only when set — the off path adds no per-step work).
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     B, S = prompt_ids.shape
@@ -242,14 +296,27 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
         cache = init_cache(model, B)
     logits, cache = _decode_step(model, params, cache, prompt_ids)  # prefill
 
+    if repetition_penalty <= 0.0:
+        raise ValueError("repetition_penalty must be > 0 (1.0 = off)")
+    penalized = (repetition_penalty != 1.0 or presence_penalty != 0.0
+                 or frequency_penalty != 0.0)
+    counts = (token_counts(prompt_ids, logits.shape[-1])
+              if penalized else None)
     out = [prompt_ids]
     done = jnp.zeros((B,), bool)
     for i in range(max_new_tokens):
         rng, step_rng = jax.random.split(rng)
+        if penalized:
+            logits = apply_penalties(
+                logits, counts, repetition_penalty=repetition_penalty,
+                presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty)
         nxt = _sample(logits, step_rng, temperature, top_k, top_p, min_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
+        if penalized:
+            counts = bump_counts(counts, nxt)
         out.append(nxt[:, None])
         if i + 1 < max_new_tokens:  # last sample needs no further forward
             logits, cache = _decode_step(model, params, cache, nxt[:, None])

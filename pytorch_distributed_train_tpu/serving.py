@@ -149,6 +149,29 @@ def _set_row_index(row_cache, pos):
         lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
 
 
+@partial(jax.jit, static_argnums=(7, 8, 9))
+def _sample_rows_penalized(logits, rng, temperature, counts, rep, pres,
+                           freq, top_k: int, top_p: float,
+                           min_p: float = 0.0):
+    """_sample_rows with per-row context penalties applied to the raw
+    logits first (generate.apply_penalties). The returned logprob stays
+    the RAW pre-penalty distribution — comparable across requests
+    regardless of their penalty settings (same contract as temperature)."""
+    from pytorch_distributed_train_tpu.generate import apply_penalties
+
+    raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    penalized = apply_penalties(logits, counts, repetition_penalty=rep,
+                                presence_penalty=pres,
+                                frequency_penalty=freq)
+    greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
+    f = filter_logits(penalized, jnp.maximum(temperature, 1e-6)[:, None],
+                      top_k, top_p, min_p)
+    sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
+    tok = jnp.where(temperature == 0.0, greedy, sampled)
+    lp = jnp.take_along_axis(raw_logp, tok[:, None], axis=-1)[:, 0]
+    return tok, lp
+
+
 @partial(jax.jit, static_argnums=(3, 4, 5))
 def _sample_rows(logits, rng, temperature, top_k: int, top_p: float,
                  min_p: float = 0.0):
@@ -180,6 +203,13 @@ class Request:
     # parked row (shared-prefix cache — e.g. one preloaded system prompt
     # serving many requests) into a free slot; the template survives.
     prefix: int | None = None
+    # Context-aware logit penalties (generate.apply_penalties — HF CTRL
+    # rule + the OpenAI additive pair). Scope: THIS request's prompt +
+    # its generated tokens (a resumed session's earlier turns are not
+    # re-counted — they live only as KV).
+    repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
 
 @dataclasses.dataclass
@@ -216,6 +246,8 @@ class ContinuousBatcher:
     (generate.filter_logits — temperature is per-request, top-k/top-p
     are batcher-wide).
     """
+
+    _count_prompt = True  # penalties count the prompt (causal-LM context)
 
     supports_sessions = True  # multi-turn KV reuse (causal families)
 
@@ -277,6 +309,13 @@ class ContinuousBatcher:
         self._logprobs: list[list[float]] = [[] for _ in range(slots)]
         self._pending = np.zeros(slots, np.int32)  # next input token per slot
         self._temp = np.zeros(slots, np.float32)
+        # per-slot penalty settings + (slots, V) context token counts
+        # (host-side; shipped to the device only on penalized steps)
+        self._rep = np.ones(slots, np.float32)
+        self._pres = np.zeros(slots, np.float32)
+        self._freq = np.zeros(slots, np.float32)
+        self._counts = np.zeros((slots, self.model.vocab_size),
+                                np.float32)
         self._pos = np.zeros(slots, np.int64)  # tokens INGESTED per slot
         # parked chat sessions: sid -> (slot, ingested pos, last token).
         # A parked row's K/V stays resident while other slots decode: its
@@ -294,10 +333,15 @@ class ContinuousBatcher:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, eos_id: int | None = None,
                keep: bool = False, session: int | None = None,
-               prefix: int | None = None) -> int:
+               prefix: int | None = None,
+               repetition_penalty: float = 1.0,
+               presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
+        if repetition_penalty <= 0.0:
+            raise ValueError("repetition_penalty must be > 0 (1.0 = off)")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
@@ -329,7 +373,10 @@ class ContinuousBatcher:
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, max_new_tokens,
                                   temperature, eos_id, keep=keep,
-                                  session=session, prefix=prefix))
+                                  session=session, prefix=prefix,
+                                  repetition_penalty=repetition_penalty,
+                                  presence_penalty=presence_penalty,
+                                  frequency_penalty=frequency_penalty))
         return uid
 
     def preload(self, prompt) -> int:
@@ -453,11 +500,36 @@ class ContinuousBatcher:
         """Shared admission tail: sample the first token and activate the
         slot; returns a Completion iff that token already finishes."""
         self.rng, step_rng = jax.random.split(self.rng)
-        tok, lp = _sample_rows(
-            last_logits, step_rng,
-            jnp.asarray([req.temperature], jnp.float32),
-            self.top_k, self.top_p, self.min_p)
+        self._rep[r] = req.repetition_penalty
+        self._pres[r] = req.presence_penalty
+        self._freq[r] = req.frequency_penalty
+        self._counts[r] = 0.0
+        penalized = (req.repetition_penalty != 1.0
+                     or req.presence_penalty != 0.0
+                     or req.frequency_penalty != 0.0)
+        if penalized and self._count_prompt:
+            # Causal LMs: the prompt is part of the penalized context.
+            # Seq2seq overrides this off — its "prompt" is the ENCODER
+            # source, and penalties score the decoder stream only (HF
+            # applies repetition_penalty to decoder ids the same way).
+            np.add.at(self._counts[r],
+                      np.asarray(req.prompt, np.int64), 1.0)
+            tok, lp = _sample_rows_penalized(
+                last_logits, step_rng,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray(self._counts[r:r + 1]),
+                jnp.asarray([req.repetition_penalty], jnp.float32),
+                jnp.asarray([req.presence_penalty], jnp.float32),
+                jnp.asarray([req.frequency_penalty], jnp.float32),
+                self.top_k, self.top_p, self.min_p)
+        else:
+            tok, lp = _sample_rows(
+                last_logits, step_rng,
+                jnp.asarray([req.temperature], jnp.float32),
+                self.top_k, self.top_p, self.min_p)
         first = int(tok[0])
+        if penalized:
+            self._counts[r, first] += 1.0
         self.stats["generated_tokens"] += 1
         self._req[r] = req
         self._generated[r] = [first]
@@ -474,6 +546,10 @@ class ContinuousBatcher:
         if not (done_eos or done_len):
             return None
         self._req[r] = None  # slot free; cache row is dead until re-admit
+        # Reset penalty settings with the slot: a stale rep != 1 on a free
+        # row would keep routing EVERY step through the penalized sampler
+        # (and its counts transfer) long after the request finished.
+        self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
         session = None
         if req.keep:
             # Park: the conversation's K/V stays resident. The LAST
@@ -543,6 +619,10 @@ class ContinuousBatcher:
         for r in range(self.slots):
             if self._req[r] is not None and self._req[r].uid == uid:
                 self._req[r] = None
+                # Same reset _maybe_finish performs: a stale rep != 1 on
+                # the freed row would route every later step through the
+                # penalized sampler (and its counts transfer).
+                self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
                 return True
         return False
 
@@ -637,9 +717,22 @@ class ContinuousBatcher:
         # dead row).
         logits = self._decode(jnp.asarray(self._pending)[:, None])
         self.rng, step_rng = jax.random.split(self.rng)
-        nxt_dev, lp_dev = _sample_rows(
-            logits, step_rng, jnp.asarray(self._temp), self.top_k,
-            self.top_p, self.min_p)
+        any_penalized = (np.any(self._rep != 1.0)
+                         or np.any(self._pres != 0.0)
+                         or np.any(self._freq != 0.0))
+        if any_penalized:
+            # Penalty-free rows carry (rep=1, pres=0, freq=0) → identity,
+            # so one batched penalized step serves the mixed case; the
+            # counts transfer happens only on these steps.
+            nxt_dev, lp_dev = _sample_rows_penalized(
+                logits, step_rng, jnp.asarray(self._temp),
+                jnp.asarray(self._counts), jnp.asarray(self._rep),
+                jnp.asarray(self._pres), jnp.asarray(self._freq),
+                self.top_k, self.top_p, self.min_p)
+        else:
+            nxt_dev, lp_dev = _sample_rows(
+                logits, step_rng, jnp.asarray(self._temp), self.top_k,
+                self.top_p, self.min_p)
         nxt, lps = np.asarray(nxt_dev), np.asarray(lp_dev)
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots
@@ -647,6 +740,8 @@ class ContinuousBatcher:
             tok = int(nxt[r])
             self._generated[r].append(tok)
             self._logprobs[r].append(float(lps[r]))
+            if any_penalized:
+                self._counts[r, tok] += 1.0
             self._pending[r] = tok
             self._pos[r] += 1  # the fed token's K/V is now in the cache
             self.stats["generated_tokens"] += 1
@@ -677,6 +772,8 @@ def _insert_enc_row(enc_buf, mask_buf, enc_row, mask_row, r):
 
 
 class Seq2SeqContinuousBatcher(ContinuousBatcher):
+    _count_prompt = False
+
     """Continuous batching for encoder-decoder (t5) models.
 
     A submitted ``prompt`` is the SOURCE sequence: admission encodes it
@@ -773,6 +870,13 @@ class Seq2SeqContinuousBatcher(ContinuousBatcher):
         self._logprobs[r] = []
         self._pending[r] = self.decoder_start_id
         self._temp[r] = req.temperature
+        # Penalties score the DECODER stream only (_count_prompt=False —
+        # the "prompt" here is the encoder source): start from an empty
+        # count row; step() bumps it per emitted token.
+        self._rep[r] = req.repetition_penalty
+        self._pres[r] = req.presence_penalty
+        self._freq[r] = req.frequency_penalty
+        self._counts[r] = 0.0
         return None  # first token arrives at the next batched step
 
     def _decode(self, ids):

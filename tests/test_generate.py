@@ -340,3 +340,92 @@ def test_fp8_kv_cache_serving_batcher():
     done = {c.uid: c for c in b.run()}
     assert set(done) == {u1, u2}
     assert len(done[u1].tokens) == 4 and len(done[u2].tokens) == 3
+
+
+def test_apply_penalties_matches_hf_repetition_processor():
+    """Pin the CTRL repetition rule bit-for-bit against the installed
+    transformers RepetitionPenaltyLogitsProcessor."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    from transformers.generation.logits_process import (
+        RepetitionPenaltyLogitsProcessor,
+    )
+
+    from pytorch_distributed_train_tpu.generate import (
+        apply_penalties,
+        token_counts,
+    )
+
+    rng = np.random.default_rng(0)
+    V = 32
+    ids = rng.integers(0, V, (3, 10))
+    logits = rng.standard_normal((3, V)).astype(np.float32)
+    for p in (1.3, 0.7):
+        theirs = RepetitionPenaltyLogitsProcessor(penalty=p)(
+            torch.from_numpy(ids), torch.from_numpy(logits.copy())).numpy()
+        ours = np.asarray(apply_penalties(
+            jnp.asarray(logits), token_counts(jnp.asarray(ids), V),
+            repetition_penalty=p))
+        np.testing.assert_allclose(ours, theirs, rtol=1e-6)
+
+
+def test_apply_penalties_openai_semantics():
+    from pytorch_distributed_train_tpu.generate import (
+        apply_penalties,
+        bump_counts,
+        token_counts,
+    )
+
+    V = 8
+    ids = jnp.asarray([[1, 1, 1, 2]], jnp.int32)
+    counts = token_counts(ids, V)
+    assert counts[0, 1] == 3.0 and counts[0, 2] == 1.0
+    counts = bump_counts(counts, jnp.asarray([2], jnp.int32))
+    assert counts[0, 2] == 2.0
+    logits = jnp.zeros((1, V), jnp.float32)
+    out = np.asarray(apply_penalties(logits, counts,
+                                     presence_penalty=0.5,
+                                     frequency_penalty=0.25))
+    # token 1: -0.5 (presence) - 3*0.25; token 2: -0.5 - 2*0.25; unseen 0
+    np.testing.assert_allclose(out[0, 1], -1.25)
+    np.testing.assert_allclose(out[0, 2], -1.0)
+    np.testing.assert_allclose(out[0, 0], 0.0)
+    # per-row penalty arrays (the serving path): row 0 penalized, row 1 not
+    logits2 = jnp.ones((2, V), jnp.float32)
+    counts2 = token_counts(jnp.asarray([[3, 3], [4, 4]], jnp.int32), V)
+    out2 = np.asarray(apply_penalties(
+        logits2, counts2, repetition_penalty=jnp.asarray([2.0, 1.0])))
+    np.testing.assert_allclose(out2[0, 3], 0.5)
+    np.testing.assert_allclose(out2[1, 4], 1.0)
+    # pad exclusion
+    c = token_counts(jnp.asarray([[5, 0, 0]], jnp.int32), V, pad_id=0)
+    assert c[0, 0] == 0.0 and c[0, 5] == 1.0
+
+
+def test_generate_with_repetition_penalty_breaks_loops():
+    """A strong repetition penalty must change greedy output vs the
+    unpenalized run whenever that run repeats tokens (and penalized
+    output must repeat no more than the baseline)."""
+    cfg = ModelConfig(name="llama", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      mlp_dim=64, max_seq_len=24)
+    prec = PrecisionConfig(compute_dtype="float32")
+    params = build_model(cfg, prec).init(
+        {"params": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    model = build_decode_model(cfg, prec)
+    prompt = jnp.asarray([[7, 7, 7, 7, 7, 7, 7, 7]], jnp.int32)
+    base = np.asarray(generate(model, params, prompt, 10))[:, 8:]
+    pen = np.asarray(generate(model, params, prompt, 10,
+                              repetition_penalty=5.0))[:, 8:]
+
+    def max_run(x):
+        m = r = 1
+        for a, b in zip(x[:-1], x[1:]):
+            r = r + 1 if a == b else 1
+            m = max(m, r)
+        return m
+
+    assert max_run(pen[0].tolist()) <= max_run(base[0].tolist())
+    assert not np.array_equal(base, pen)

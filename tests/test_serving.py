@@ -484,3 +484,76 @@ def test_release_frees_template_slot(setup):
     u1, u2 = b.submit([5, 6], 2), b.submit([7], 2)
     done = {c.uid for c in b.run()}
     assert done == {u1, u2}
+
+
+def test_penalized_request_matches_lockstep_generate(setup):
+    """A greedy request with repetition_penalty through the batcher must
+    equal generate()'s penalized lockstep output (same penalty law over
+    prompt+generated), and an unpenalized request in the SAME batch must
+    be unaffected by its penalized neighbor."""
+    cfg, params = setup
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model,
+        generate,
+    )
+
+    prompt = [7, 7, 7, 7, 7, 7]
+    n = 8
+    dm = build_decode_model(cfg, PrecisionConfig())
+    ref_pen = np.asarray(generate(
+        dm, params, jnp.asarray([prompt], jnp.int32), n,
+        repetition_penalty=3.0))[0, len(prompt):].tolist()
+    ref_plain = np.asarray(generate(
+        dm, params, jnp.asarray([prompt], jnp.int32), n))[0,
+                                                          len(prompt):].tolist()
+
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    u_pen = b.submit(prompt, n, repetition_penalty=3.0)
+    u_plain = b.submit(prompt, n)
+    done = {c.uid: c for c in b.run()}
+    assert done[u_pen].tokens == ref_pen
+    assert done[u_plain].tokens == ref_plain
+    assert ref_pen != ref_plain  # the penalty actually changed the path
+
+
+def test_penalty_validation_and_openai_fields(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        b.submit([1, 2], 2, repetition_penalty=0.0)
+    # presence/frequency accepted and the run completes
+    u = b.submit([1, 2, 3], 4, presence_penalty=0.4, frequency_penalty=0.2)
+    done = {c.uid: c for c in b.run()}
+    assert len(done[u].tokens) == 4
+
+
+def test_seq2seq_penalties_score_decoder_stream():
+    """Seq2seq penalties must actually engage (decoder-stream counts, the
+    encoder source is NOT context): a strong presence penalty forbids a
+    token from repeating in the decoded stream vs the plain run."""
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    cfg = ModelConfig(name="t5", vocab_size=64, hidden_size=32,
+                      num_layers=2, decoder_layers=2, num_heads=4,
+                      mlp_dim=64, max_seq_len=32, dropout_rate=0.0)
+    params = build_model(cfg, PrecisionConfig()).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 6), jnp.int32), jnp.zeros((1, 2), jnp.int32),
+        train=False)["params"]
+    src = [5, 9, 12, 3]
+    b = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    u_plain = b.submit(src, 10)
+    u_pen = b.submit(src, 10, repetition_penalty=50.0,
+                     presence_penalty=20.0)
+    done = {c.uid: c for c in b.run()}
+    plain, pen = done[u_plain].tokens, done[u_pen].tokens
+    # the penalized stream cannot emit the same token twice in a row
+    assert all(a != b2 for a, b2 in zip(pen[:-1], pen[1:])), pen
+    # (plain output on a random tiny model typically loops — if it
+    # happens not to, the no-consecutive-repeat property above still
+    # proves the penalty engaged only if outputs differ; assert that
+    # when the plain run has repeats)
+    if any(a == b2 for a, b2 in zip(plain[:-1], plain[1:])):
+        assert pen != plain

@@ -47,6 +47,13 @@ def main(argv=None) -> int:
                    help="beam-search decoding; overrides temperature/"
                         "top-k/top-p/min-p (beams expand the full "
                         "distribution); 0 → off")
+    p.add_argument("--repetition-penalty", type=float, default=1.0,
+                   help="HF CTRL rule over prompt+generated (>1 "
+                        "discourages repeats; 1 = off)")
+    p.add_argument("--presence-penalty", type=float, default=0.0,
+                   help="OpenAI additive penalty for any seen token")
+    p.add_argument("--frequency-penalty", type=float, default=0.0,
+                   help="OpenAI additive penalty x occurrence count")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
     p.add_argument("--tp", type=int, default=0,
@@ -190,9 +197,12 @@ def main(argv=None) -> int:
                 rng=jax.random.PRNGKey(args.seed), mesh=serve_mesh)
             uid_to_i = {}
             for i, e in enumerate(encoded):
-                uid_to_i[b.submit(e, args.max_new_tokens,
-                                  temperature=args.temperature,
-                                  eos_id=tok.eos_id)] = i
+                uid_to_i[b.submit(
+                    e, args.max_new_tokens,
+                    temperature=args.temperature, eos_id=tok.eos_id,
+                    repetition_penalty=args.repetition_penalty,
+                    presence_penalty=args.presence_penalty,
+                    frequency_penalty=args.frequency_penalty)] = i
             for c in b.run():
                 i = uid_to_i[c.uid]
                 emit(i, prompts[i], c.tokens)
@@ -228,7 +238,10 @@ def main(argv=None) -> int:
                     temperature=args.temperature, top_k=args.top_k,
                     top_p=args.top_p, min_p=args.min_p,
                     rng=jax.random.PRNGKey(args.seed + i),
-                    eos_id=tok.eos_id, mesh=mesh))
+                    eos_id=tok.eos_id, mesh=mesh,
+                    repetition_penalty=args.repetition_penalty,
+                    presence_penalty=args.presence_penalty,
+                    frequency_penalty=args.frequency_penalty))
             emit(i, text, out[0, len(e):].tolist())
         return 0
     except (KeyError, ValueError, FileNotFoundError, OSError) as e:

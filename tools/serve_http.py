@@ -179,7 +179,8 @@ class BatcherService:
     def complete_n(self, prompt: str, max_tokens: int,
                    temperature: float, n: int,
                    timeout_s: float = 600.0, *,
-                   logprobs: bool = False) -> dict:
+                   logprobs: bool = False,
+                   penalties: dict | None = None) -> dict:
         """k independent sampled completions of one prompt. The prompt
         minus its last token prefills ONCE into a temporary prefix
         template; each of the k forks ingests just that final token (a
@@ -198,11 +199,17 @@ class BatcherService:
             raise ValueError("empty prompt after tokenization")
         events: dict[int, threading.Event] = {}
         sid = None
+        # Penalized n>1 requests always prefill the FULL prompt per fork:
+        # the shared-prefix template would leave only the final token in
+        # each fork's penalty context, making the distribution depend on
+        # slot availability (template admitted or not). Deterministic
+        # semantics beat the saved prefills.
+        force_full_prompt = bool(penalties)
         # the shared-prefill trick needs session support (causal
         # batchers) and a >= 2-token prompt; otherwise n plain submits
         # still serve the request — just paying n prefills
         share = (getattr(self.batcher, "supports_sessions", False)
-                 and len(ids) >= 2)
+                 and len(ids) >= 2 and not force_full_prompt)
 
         def _cleanup_locked():
             """Release the template and withdraw every fork: cancel the
@@ -235,7 +242,7 @@ class BatcherService:
                     uid = self.batcher.submit(
                         ids[-1:] if sid is not None else ids, max_tokens,
                         temperature=temperature, eos_id=self.tok.eos_id,
-                        prefix=sid)
+                        prefix=sid, **(penalties or {}))
                     events[uid] = threading.Event()
                     self._events[uid] = events[uid]
             except (ValueError, RuntimeError):
@@ -278,7 +285,8 @@ class BatcherService:
                  timeout_s: float = 600.0, *, keep: bool = False,
                  session: int | None = None, prefix: int | None = None,
                  stop: list[str] | None = None,
-                 logprobs: bool = False) -> dict:
+                 logprobs: bool = False,
+                 penalties: dict | None = None) -> dict:
         if stop:
             if keep:
                 raise ValueError(
@@ -287,7 +295,7 @@ class BatcherService:
             return self._complete_with_stop(
                 prompt, max_tokens, temperature, timeout_s,
                 session=session, prefix=prefix, stop=stop,
-                logprobs=logprobs)
+                logprobs=logprobs, penalties=penalties)
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
@@ -302,7 +310,7 @@ class BatcherService:
                                       temperature=temperature,
                                       eos_id=self.tok.eos_id,
                                       keep=keep, session=session,
-                                      prefix=prefix)
+                                      prefix=prefix, **(penalties or {}))
             self._events[uid] = ev
         timed_out = not ev.wait(timeout_s)
         with self._lock:
@@ -334,14 +342,16 @@ class BatcherService:
 
     def _complete_with_stop(self, prompt, max_tokens, temperature,
                             timeout_s, *, session, prefix, stop,
-                            logprobs: bool = False) -> dict:
+                            logprobs: bool = False,
+                            penalties: dict | None = None) -> dict:
         """Stop-sequence completions ride the streaming tap: decode the
         accumulated text each tick, CANCEL the request at the first stop
         match (it stops consuming decode steps), trim the match out."""
         uid, n_prompt, chunks = self.stream(prompt, max_tokens,
                                             temperature, timeout_s,
                                             session=session,
-                                            prefix=prefix)
+                                            prefix=prefix,
+                                            penalties=penalties)
         acc: list[int] = []
         comp = None
         for toks, c in chunks:
@@ -381,7 +391,8 @@ class BatcherService:
 
     def stream(self, prompt: str, max_tokens: int, temperature: float,
                timeout_s: float = 600.0, *, keep: bool = False,
-               session: int | None = None, prefix: int | None = None):
+               session: int | None = None, prefix: int | None = None,
+               penalties: dict | None = None):
         """Returns (uid, chunk iterator). Validation and submission run
         EAGERLY (so callers can reject before committing to a response);
         the iterator yields (new_token_ids, completion_or_None) chunks as
@@ -401,7 +412,7 @@ class BatcherService:
                                       temperature=temperature,
                                       eos_id=self.tok.eos_id,
                                       keep=keep, session=session,
-                                      prefix=prefix)
+                                      prefix=prefix, **(penalties or {}))
             self._streams[uid] = q
             self._stream_seen[uid] = 0
 
@@ -508,6 +519,11 @@ def make_handler(service: BatcherService):
                     if isinstance(stop, str):
                         stop = [stop]
                     stop = [str(x) for x in stop if str(x)]
+                penalties = {
+                    k: float(req[k])
+                    for k in ("repetition_penalty", "presence_penalty",
+                              "frequency_penalty") if k in req
+                }
                 n = int(req.get("n", 1))
                 if n > 1:
                     if (req.get("stream") or keep or session is not None
@@ -517,7 +533,8 @@ def make_handler(service: BatcherService):
                             "stream/keep/session/prefix/stop)")
                     self._send(200, service.complete_n(
                         prompt, max_tokens, temperature, n,
-                        logprobs=bool(req.get("logprobs", False))))
+                        logprobs=bool(req.get("logprobs", False)),
+                        penalties=penalties))
                     return
                 if req.get("stream"):
                     if stop and keep:
@@ -528,7 +545,8 @@ def make_handler(service: BatcherService):
                     # headers go out, so they get a clean 400/503
                     uid, n_prompt, chunks = service.stream(
                         prompt, max_tokens, temperature, keep=keep,
-                        session=session, prefix=prefix)
+                        session=session, prefix=prefix,
+                        penalties=penalties)
                     self._stream_sse(uid, chunks, stop=stop,
                                      n_prompt=n_prompt)
                     return
@@ -536,7 +554,8 @@ def make_handler(service: BatcherService):
                                        keep=keep, session=session,
                                        prefix=prefix, stop=stop,
                                        logprobs=bool(
-                                           req.get("logprobs", False)))
+                                           req.get("logprobs", False)),
+                                       penalties=penalties)
                 self._send(200, out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
