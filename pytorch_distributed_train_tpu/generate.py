@@ -243,6 +243,21 @@ def bump_counts(counts, tok):
     return counts.at[jnp.arange(counts.shape[0]), tok].add(1.0)
 
 
+def bias_vector(logit_bias: dict, vocab_size: int):
+    """OpenAI ``logit_bias`` ({token_id: bias in [-100, 100]}) → a (V,)
+    fp32 vector added to the logits AFTER penalties, before the
+    temperature/top-k/top-p warpers. -100 is a practical ban, +100 a
+    practical force (exclusive selection)."""
+    v = np.zeros((vocab_size,), np.float32)
+    for k, b in logit_bias.items():
+        i = int(k)
+        if not 0 <= i < vocab_size:
+            raise ValueError(
+                f"logit_bias token id {i} out of range [0, {vocab_size})")
+        v[i] = float(b)
+    return jnp.asarray(v)
+
+
 def _sample(logits, rng, temperature: float, top_k: int,
             top_p: float = 0.0, min_p: float = 0.0):
     if temperature == 0.0:
@@ -258,7 +273,8 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
              eos_id: int | None = None, mesh=None,
              repetition_penalty: float = 1.0,
              presence_penalty: float = 0.0,
-             frequency_penalty: float = 0.0) -> jnp.ndarray:
+             frequency_penalty: float = 0.0,
+             logit_bias: dict | None = None) -> jnp.ndarray:
     """Generate continuations for a (B, S) int32 prompt batch.
 
     Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
@@ -302,6 +318,8 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
                  or frequency_penalty != 0.0)
     counts = (token_counts(prompt_ids, logits.shape[-1])
               if penalized else None)
+    bias = (bias_vector(logit_bias, logits.shape[-1])
+            if logit_bias else None)
     out = [prompt_ids]
     done = jnp.zeros((B,), bool)
     for i in range(max_new_tokens):
@@ -311,6 +329,8 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
                 logits, counts, repetition_penalty=repetition_penalty,
                 presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty)
+        if bias is not None:
+            logits = logits + bias[None, :]
         nxt = _sample(logits, step_rng, temperature, top_k, top_p, min_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)

@@ -149,9 +149,9 @@ def _set_row_index(row_cache, pos):
         lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
 
 
-@partial(jax.jit, static_argnums=(7, 8, 9))
+@partial(jax.jit, static_argnums=(8, 9, 10))
 def _sample_rows_penalized(logits, rng, temperature, counts, rep, pres,
-                           freq, top_k: int, top_p: float,
+                           freq, bias, top_k: int, top_p: float,
                            min_p: float = 0.0):
     """_sample_rows with per-row context penalties applied to the raw
     logits first (generate.apply_penalties). The returned logprob stays
@@ -162,7 +162,7 @@ def _sample_rows_penalized(logits, rng, temperature, counts, rep, pres,
     raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     penalized = apply_penalties(logits, counts, repetition_penalty=rep,
                                 presence_penalty=pres,
-                                frequency_penalty=freq)
+                                frequency_penalty=freq) + bias
     greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
     f = filter_logits(penalized, jnp.maximum(temperature, 1e-6)[:, None],
                       top_k, top_p, min_p)
@@ -210,6 +210,9 @@ class Request:
     repetition_penalty: float = 1.0
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # OpenAI logit_bias ({token_id: bias in [-100, 100]}), added to raw
+    # logits after penalties, before the warpers.
+    logit_bias: dict | None = None
 
 
 @dataclasses.dataclass
@@ -316,6 +319,8 @@ class ContinuousBatcher:
         self._freq = np.zeros(slots, np.float32)
         self._counts = np.zeros((slots, self.model.vocab_size),
                                 np.float32)
+        self._bias = np.zeros((slots, self.model.vocab_size), np.float32)
+        self._has_bias = np.zeros(slots, bool)  # O(slots) routing flag
         self._pos = np.zeros(slots, np.int64)  # tokens INGESTED per slot
         # parked chat sessions: sid -> (slot, ingested pos, last token).
         # A parked row's K/V stays resident while other slots decode: its
@@ -336,12 +341,19 @@ class ContinuousBatcher:
                prefix: int | None = None,
                repetition_penalty: float = 1.0,
                presence_penalty: float = 0.0,
-               frequency_penalty: float = 0.0) -> int:
+               frequency_penalty: float = 0.0,
+               logit_bias: dict | None = None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
         if repetition_penalty <= 0.0:
             raise ValueError("repetition_penalty must be > 0 (1.0 = off)")
+        if logit_bias:
+            V = self.model.vocab_size
+            for k in logit_bias:
+                if not 0 <= int(k) < V:
+                    raise ValueError(
+                        f"logit_bias token id {k} out of range [0, {V})")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
@@ -376,7 +388,8 @@ class ContinuousBatcher:
                                   session=session, prefix=prefix,
                                   repetition_penalty=repetition_penalty,
                                   presence_penalty=presence_penalty,
-                                  frequency_penalty=frequency_penalty))
+                                  frequency_penalty=frequency_penalty,
+                                  logit_bias=logit_bias))
         return uid
 
     def preload(self, prompt) -> int:
@@ -495,18 +508,30 @@ class ContinuousBatcher:
                                  jnp.int32(pos + T))
         return self._start_slot(r_target, req, pos + T, last)
 
+    def _set_row_sampling_state(self, r: int, req: Request) -> None:
+        """ONE place that loads a slot's per-request sampling state
+        (penalties + logit bias) — shared by the causal admission tail
+        and the seq2seq _admit override."""
+        self._rep[r] = req.repetition_penalty
+        self._pres[r] = req.presence_penalty
+        self._freq[r] = req.frequency_penalty
+        self._counts[r] = 0.0
+        self._bias[r] = 0.0
+        self._has_bias[r] = bool(req.logit_bias)
+        if req.logit_bias:
+            for k, v in req.logit_bias.items():
+                self._bias[r, int(k)] = float(v)
+
     def _start_slot(self, r: int, req: Request, pos: int,
                     last_logits) -> Completion | None:
         """Shared admission tail: sample the first token and activate the
         slot; returns a Completion iff that token already finishes."""
         self.rng, step_rng = jax.random.split(self.rng)
-        self._rep[r] = req.repetition_penalty
-        self._pres[r] = req.presence_penalty
-        self._freq[r] = req.frequency_penalty
-        self._counts[r] = 0.0
+        self._set_row_sampling_state(r, req)
         penalized = (req.repetition_penalty != 1.0
                      or req.presence_penalty != 0.0
-                     or req.frequency_penalty != 0.0)
+                     or req.frequency_penalty != 0.0
+                     or bool(req.logit_bias))
         if penalized and self._count_prompt:
             # Causal LMs: the prompt is part of the penalized context.
             # Seq2seq overrides this off — its "prompt" is the ENCODER
@@ -521,6 +546,8 @@ class ContinuousBatcher:
                 jnp.asarray([req.repetition_penalty], jnp.float32),
                 jnp.asarray([req.presence_penalty], jnp.float32),
                 jnp.asarray([req.frequency_penalty], jnp.float32),
+                (jnp.asarray(self._bias[r:r + 1]) if req.logit_bias
+                 else jnp.float32(0.0)),
                 self.top_k, self.top_p, self.min_p)
         else:
             tok, lp = _sample_rows(
@@ -550,6 +577,10 @@ class ContinuousBatcher:
         # row would keep routing EVERY step through the penalized sampler
         # (and its counts transfer) long after the request finished.
         self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
+        # Row cleared WITH the flag: a stale row would still ship (wrong)
+        # whenever some other row keeps the penalized path engaged.
+        self._bias[r] = 0.0
+        self._has_bias[r] = False
         session = None
         if req.keep:
             # Park: the conversation's K/V stays resident. The LAST
@@ -623,6 +654,8 @@ class ContinuousBatcher:
                 # the freed row would route every later step through the
                 # penalized sampler (and its counts transfer).
                 self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
+                self._bias[r] = 0.0
+                self._has_bias[r] = False
                 return True
         return False
 
@@ -719,7 +752,8 @@ class ContinuousBatcher:
         self.rng, step_rng = jax.random.split(self.rng)
         any_penalized = (np.any(self._rep != 1.0)
                          or np.any(self._pres != 0.0)
-                         or np.any(self._freq != 0.0))
+                         or np.any(self._freq != 0.0)
+                         or np.any(self._has_bias))
         if any_penalized:
             # Penalty-free rows carry (rep=1, pres=0, freq=0) → identity,
             # so one batched penalized step serves the mixed case; the
@@ -728,6 +762,11 @@ class ContinuousBatcher:
                 logits, step_rng, jnp.asarray(self._temp),
                 jnp.asarray(self._counts), jnp.asarray(self._rep),
                 jnp.asarray(self._pres), jnp.asarray(self._freq),
+                # No biased row → ship a broadcastable scalar zero, not
+                # the (slots, V) zero matrix (its own compiled variant;
+                # two shapes total, both stable).
+                (jnp.asarray(self._bias) if self._has_bias.any()
+                 else jnp.float32(0.0)),
                 self.top_k, self.top_p, self.min_p)
         else:
             nxt_dev, lp_dev = _sample_rows(
@@ -873,10 +912,7 @@ class Seq2SeqContinuousBatcher(ContinuousBatcher):
         # Penalties score the DECODER stream only (_count_prompt=False —
         # the "prompt" here is the encoder source): start from an empty
         # count row; step() bumps it per emitted token.
-        self._rep[r] = req.repetition_penalty
-        self._pres[r] = req.presence_penalty
-        self._freq[r] = req.frequency_penalty
-        self._counts[r] = 0.0
+        self._set_row_sampling_state(r, req)
         return None  # first token arrives at the next batched step
 
     def _decode(self, ids):

@@ -380,3 +380,29 @@ def test_http_penalty_fields_change_output(server):
         raise AssertionError("expected 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_http_logit_bias_bans_token(server):
+    """OpenAI-convention logit_bias (string token-id keys) reaches the
+    batcher: banning the plain run's first generated token changes it."""
+    port, cfg, params, tok = server
+    prompt = "bias me"
+    _, plain = _post(port, {"prompt": prompt, "max_tokens": 6})
+    ids = tok.encode(prompt)
+    dm = build_decode_model(cfg, PrecisionConfig())
+    first = int(np.asarray(generate(
+        dm, params, jnp.asarray([ids], jnp.int32), 1))[0, len(ids)])
+    s, out = _post(port, {"prompt": prompt, "max_tokens": 6,
+                          "logit_bias": {str(first): -100}})
+    assert s == 200
+    # Exact parity with generate()'s biased lockstep law — stronger than
+    # any text-roundtrip heuristic (which is vacuous on empty output).
+    ref = np.asarray(generate(dm, params, jnp.asarray([ids], jnp.int32), 6,
+                              eos_id=tok.eos_id,
+                              logit_bias={first: -100.0}))
+    new = [int(x) for x in ref[0, len(ids):]]
+    if tok.eos_id in new:
+        new = new[: new.index(tok.eos_id)]
+    assert out["text"] == tok.decode(new)
+    assert first not in new[:1]
+    del plain  # plain-path equality is covered by the lockstep tests

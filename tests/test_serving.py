@@ -557,3 +557,40 @@ def test_seq2seq_penalties_score_decoder_stream():
     # when the plain run has repeats)
     if any(a == b2 for a, b2 in zip(plain[:-1], plain[1:])):
         assert pen != plain
+
+
+def test_logit_bias_bans_and_forces(setup):
+    """OpenAI logit_bias through the batcher: -100 bans a token the plain
+    greedy run emits; +100 on a chosen token forces it every step."""
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    prompt = [3, 1, 4, 1, 5]
+    u = b.submit(prompt, 6)
+    plain = {c.uid: c for c in b.run()}[u].tokens
+    banned = plain[0]
+    u2 = b.submit(prompt, 6, logit_bias={banned: -100.0})
+    out = {c.uid: c for c in b.run()}[u2].tokens
+    assert banned not in out, (banned, out)
+    u3 = b.submit(prompt, 4, logit_bias={7: 100.0})
+    forced = {c.uid: c for c in b.run()}[u3].tokens
+    assert forced == [7, 7, 7, 7]
+    with pytest.raises(ValueError, match="out of range"):
+        b.submit(prompt, 2, logit_bias={10 ** 6: -1.0})
+
+
+def test_logit_bias_generate_matches_batcher(setup):
+    cfg, params = setup
+    from pytorch_distributed_train_tpu.generate import (
+        build_decode_model,
+        generate,
+    )
+
+    prompt = [3, 1, 4, 1, 5]
+    dm = build_decode_model(cfg, PrecisionConfig())
+    ref = np.asarray(generate(dm, params,
+                              jnp.asarray([prompt], jnp.int32), 6,
+                              logit_bias={2: 100.0}))[0, len(prompt):]
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    u = b.submit(prompt, 6, logit_bias={2: 100.0})
+    out = {c.uid: c for c in b.run()}[u].tokens
+    assert out == ref.tolist() == [2] * 6

@@ -204,7 +204,11 @@ class BatcherService:
         # each fork's penalty context, making the distribution depend on
         # slot availability (template admitted or not). Deterministic
         # semantics beat the saved prefills.
-        force_full_prompt = bool(penalties)
+        # Only COUNT-based penalties need the full prompt in each fork's
+        # context (logit_bias is context-independent — the preload trick
+        # stays deterministic under it).
+        force_full_prompt = any(k != "logit_bias"
+                                for k in (penalties or {}))
         # the shared-prefill trick needs session support (causal
         # batchers) and a >= 2-token prompt; otherwise n plain submits
         # still serve the request — just paying n prefills
@@ -524,6 +528,11 @@ def make_handler(service: BatcherService):
                     for k in ("repetition_penalty", "presence_penalty",
                               "frequency_penalty") if k in req
                 }
+                if "logit_bias" in req:
+                    # OpenAI convention: string token-id keys
+                    penalties["logit_bias"] = {
+                        int(k): float(v)
+                        for k, v in dict(req["logit_bias"]).items()}
                 n = int(req.get("n", 1))
                 if n > 1:
                     if (req.get("stream") or keep or session is not None
