@@ -194,24 +194,34 @@ def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0,
     return logits
 
 
-def apply_penalties(logits, counts, *, repetition_penalty: float = 1.0,
+def apply_penalties(logits, counts, *, gen_counts=None,
+                    repetition_penalty: float = 1.0,
                     presence_penalty: float = 0.0,
                     frequency_penalty: float = 0.0):
     """Context-aware logit penalties, applied on RAW logits BEFORE the
     temperature/top-k/top-p warpers (HF's processor-before-warper order).
 
-    ``counts`` is (B, V) fp32 occurrence counts of each vocab id in the
-    row's text so far (prompt + generated — both HF's repetition_penalty
-    and the OpenAI penalties consider the full context). Penalties may be
-    scalars or (B,)/(B, 1) arrays (serving passes per-request values):
+    Two count tensors because the two conventions score different text:
+    - ``counts`` (B, V): prompt + generated — HF's repetition_penalty
+      considers the full context.
+    - ``gen_counts`` (B, V): GENERATED tokens only — the OpenAI/vLLM
+      presence/frequency penalties never score the prompt (an
+      OpenAI-compatible server must not penalize a token merely for
+      appearing in the user's input). Defaults to ``counts`` for callers
+      that deliberately share one context; generate()/serving pass the
+      split for OpenAI parity.
+
+    Penalties may be scalars or (B,)/(B, 1) arrays (serving passes
+    per-request values):
     - repetition_penalty (HF CTRL rule, >1 discourages): seen tokens'
       positive logits divide by p, negative multiply by p.
-    - presence_penalty (OpenAI, additive): subtract p once for any seen
-      token.
-    - frequency_penalty (OpenAI, additive): subtract p x count.
+    - presence_penalty (OpenAI, additive): subtract p once for any
+      generated token.
+    - frequency_penalty (OpenAI, additive): subtract p x generated count.
     """
     logits = logits.astype(jnp.float32)
     seen = counts > 0
+    gc = counts if gen_counts is None else gen_counts
 
     def bcol(p):  # scalar or (B,)/(B,1) → broadcastable against (B, V)
         p = jnp.asarray(p, jnp.float32)
@@ -220,8 +230,8 @@ def apply_penalties(logits, counts, *, repetition_penalty: float = 1.0,
     rp = bcol(repetition_penalty)
     penalized = jnp.where(logits > 0, logits / rp, logits * rp)
     logits = jnp.where(seen & (rp != 1.0), penalized, logits)
-    logits = logits - bcol(presence_penalty) * seen.astype(jnp.float32)
-    logits = logits - bcol(frequency_penalty) * counts
+    logits = logits - bcol(presence_penalty) * (gc > 0).astype(jnp.float32)
+    logits = logits - bcol(frequency_penalty) * gc
     return logits
 
 
@@ -249,13 +259,30 @@ def bias_vector(logit_bias: dict, vocab_size: int):
     temperature/top-k/top-p warpers. -100 is a practical ban, +100 a
     practical force (exclusive selection)."""
     v = np.zeros((vocab_size,), np.float32)
+    for i, b in validate_logit_bias(logit_bias, vocab_size).items():
+        v[i] = b
+    return jnp.asarray(v)
+
+
+def validate_logit_bias(logit_bias: dict, vocab_size: int
+                        ) -> dict[int, float]:
+    """ONE definition of the OpenAI logit_bias contract (ids in
+    [0, vocab), values in [-100, 100] — out-of-range is an error, not a
+    silent super-ban), shared by bias_vector and serving's submit so the
+    two admission paths can never diverge. Returns normalized
+    {int id: float bias}."""
+    out: dict[int, float] = {}
     for k, b in logit_bias.items():
         i = int(k)
         if not 0 <= i < vocab_size:
             raise ValueError(
                 f"logit_bias token id {i} out of range [0, {vocab_size})")
-        v[i] = float(b)
-    return jnp.asarray(v)
+        b = float(b)
+        if not -100.0 <= b <= 100.0:
+            raise ValueError(
+                f"logit_bias value {b} for token {i} outside [-100, 100]")
+        out[i] = b
+    return out
 
 
 def _sample(logits, rng, temperature: float, top_k: int,
@@ -274,7 +301,8 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
              repetition_penalty: float = 1.0,
              presence_penalty: float = 0.0,
              frequency_penalty: float = 0.0,
-             logit_bias: dict | None = None) -> jnp.ndarray:
+             logit_bias: dict | None = None,
+             pad_id: int | None = None) -> jnp.ndarray:
     """Generate continuations for a (B, S) int32 prompt batch.
 
     Returns (B, S + max_new_tokens) ids. Prefill consumes the prompt in one
@@ -284,8 +312,11 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
     single-token steps only. With ``temperature=0`` decoding is greedy and
     deterministic; ``eos_id`` freezes finished rows (emitted tokens stay
     ``eos_id``). Repetition/presence/frequency penalties follow
-    :func:`apply_penalties` (HF/OpenAI semantics over prompt+generated;
-    active only when set — the off path adds no per-step work).
+    :func:`apply_penalties` — repetition scores prompt+generated (HF),
+    presence/frequency score generated tokens only (OpenAI/vLLM); active
+    only when set — the off path adds no per-step work. ``pad_id``
+    (default: ``eos_id``) is excluded from the prompt's repetition
+    context so right-padded batches don't penalize the pad token.
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     B, S = prompt_ids.shape
@@ -316,8 +347,14 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
         raise ValueError("repetition_penalty must be > 0 (1.0 = off)")
     penalized = (repetition_penalty != 1.0 or presence_penalty != 0.0
                  or frequency_penalty != 0.0)
-    counts = (token_counts(prompt_ids, logits.shape[-1])
+    # Prompt tokens feed ONLY the repetition context (counts); the OpenAI
+    # additive penalties score generated tokens (gen_counts), which start
+    # empty. Pad/eos exclusion keeps right-padded rows from penalizing
+    # the pad token on every step.
+    _pad = pad_id if pad_id is not None else eos_id
+    counts = (token_counts(prompt_ids, logits.shape[-1], pad_id=_pad)
               if penalized else None)
+    gen_counts = jnp.zeros_like(counts) if penalized else None
     bias = (bias_vector(logit_bias, logits.shape[-1])
             if logit_bias else None)
     out = [prompt_ids]
@@ -326,7 +363,8 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
         rng, step_rng = jax.random.split(rng)
         if penalized:
             logits = apply_penalties(
-                logits, counts, repetition_penalty=repetition_penalty,
+                logits, counts, gen_counts=gen_counts,
+                repetition_penalty=repetition_penalty,
                 presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty)
         if bias is not None:
@@ -337,6 +375,7 @@ def generate(model, params, prompt_ids, max_new_tokens: int,
             done = done | (nxt == eos_id)
         if penalized:
             counts = bump_counts(counts, nxt)
+            gen_counts = bump_counts(gen_counts, nxt)
         out.append(nxt[:, None])
         if i + 1 < max_new_tokens:  # last sample needs no further forward
             logits, cache = _decode_step(model, params, cache, nxt[:, None])

@@ -149,18 +149,21 @@ def _set_row_index(row_cache, pos):
         lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
 
 
-@partial(jax.jit, static_argnums=(8, 9, 10))
-def _sample_rows_penalized(logits, rng, temperature, counts, rep, pres,
-                           freq, bias, top_k: int, top_p: float,
+@partial(jax.jit, static_argnums=(9, 10, 11))
+def _sample_rows_penalized(logits, rng, temperature, counts, gen_counts,
+                           rep, pres, freq, bias, top_k: int, top_p: float,
                            min_p: float = 0.0):
     """_sample_rows with per-row context penalties applied to the raw
-    logits first (generate.apply_penalties). The returned logprob stays
-    the RAW pre-penalty distribution — comparable across requests
-    regardless of their penalty settings (same contract as temperature)."""
+    logits first (generate.apply_penalties — counts: prompt+generated
+    for repetition; gen_counts: generated-only for the OpenAI additive
+    penalties). The returned logprob stays the RAW pre-penalty
+    distribution — comparable across requests regardless of their
+    penalty settings (same contract as temperature)."""
     from pytorch_distributed_train_tpu.generate import apply_penalties
 
     raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    penalized = apply_penalties(logits, counts, repetition_penalty=rep,
+    penalized = apply_penalties(logits, counts, gen_counts=gen_counts,
+                                repetition_penalty=rep,
                                 presence_penalty=pres,
                                 frequency_penalty=freq) + bias
     greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
@@ -319,6 +322,10 @@ class ContinuousBatcher:
         self._freq = np.zeros(slots, np.float32)
         self._counts = np.zeros((slots, self.model.vocab_size),
                                 np.float32)
+        # generated-only counts: the OpenAI presence/frequency context
+        # (prompt tokens feed _counts — the repetition context — only)
+        self._gen_counts = np.zeros((slots, self.model.vocab_size),
+                                    np.float32)
         self._bias = np.zeros((slots, self.model.vocab_size), np.float32)
         self._has_bias = np.zeros(slots, bool)  # O(slots) routing flag
         self._pos = np.zeros(slots, np.int64)  # tokens INGESTED per slot
@@ -349,11 +356,11 @@ class ContinuousBatcher:
         if repetition_penalty <= 0.0:
             raise ValueError("repetition_penalty must be > 0 (1.0 = off)")
         if logit_bias:
-            V = self.model.vocab_size
-            for k in logit_bias:
-                if not 0 <= int(k) < V:
-                    raise ValueError(
-                        f"logit_bias token id {k} out of range [0, {V})")
+            from pytorch_distributed_train_tpu.generate import (
+                validate_logit_bias,
+            )
+
+            validate_logit_bias(logit_bias, self.model.vocab_size)
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens} "
@@ -516,6 +523,7 @@ class ContinuousBatcher:
         self._pres[r] = req.presence_penalty
         self._freq[r] = req.frequency_penalty
         self._counts[r] = 0.0
+        self._gen_counts[r] = 0.0
         self._bias[r] = 0.0
         self._has_bias[r] = bool(req.logit_bias)
         if req.logit_bias:
@@ -532,17 +540,23 @@ class ContinuousBatcher:
                      or req.presence_penalty != 0.0
                      or req.frequency_penalty != 0.0
                      or bool(req.logit_bias))
-        if penalized and self._count_prompt:
-            # Causal LMs: the prompt is part of the penalized context.
-            # Seq2seq overrides this off — its "prompt" is the ENCODER
-            # source, and penalties score the decoder stream only (HF
-            # applies repetition_penalty to decoder ids the same way).
-            np.add.at(self._counts[r],
-                      np.asarray(req.prompt, np.int64), 1.0)
+        if penalized:
+            if self._count_prompt:
+                # Causal LMs: the prompt joins the REPETITION context
+                # (_counts) only — the OpenAI additive penalties score
+                # generated tokens (_gen_counts, empty at admission).
+                # Seq2seq overrides this off — its "prompt" is the
+                # ENCODER source (HF applies repetition_penalty to
+                # decoder ids the same way); its first token still
+                # routes through the penalized sampler so logit_bias
+                # applies from token one.
+                np.add.at(self._counts[r],
+                          np.asarray(req.prompt, np.int64), 1.0)
             tok, lp = _sample_rows_penalized(
                 last_logits, step_rng,
                 jnp.asarray([req.temperature], jnp.float32),
                 jnp.asarray(self._counts[r:r + 1]),
+                jnp.asarray(self._gen_counts[r:r + 1]),
                 jnp.asarray([req.repetition_penalty], jnp.float32),
                 jnp.asarray([req.presence_penalty], jnp.float32),
                 jnp.asarray([req.frequency_penalty], jnp.float32),
@@ -557,6 +571,7 @@ class ContinuousBatcher:
         first = int(tok[0])
         if penalized:
             self._counts[r, first] += 1.0
+            self._gen_counts[r, first] += 1.0
         self.stats["generated_tokens"] += 1
         self._req[r] = req
         self._generated[r] = [first]
@@ -760,7 +775,8 @@ class ContinuousBatcher:
             # counts transfer happens only on these steps.
             nxt_dev, lp_dev = _sample_rows_penalized(
                 logits, step_rng, jnp.asarray(self._temp),
-                jnp.asarray(self._counts), jnp.asarray(self._rep),
+                jnp.asarray(self._counts), jnp.asarray(self._gen_counts),
+                jnp.asarray(self._rep),
                 jnp.asarray(self._pres), jnp.asarray(self._freq),
                 # No biased row → ship a broadcastable scalar zero, not
                 # the (slots, V) zero matrix (its own compiled variant;
@@ -781,6 +797,7 @@ class ContinuousBatcher:
             self._logprobs[r].append(float(lps[r]))
             if any_penalized:
                 self._counts[r, tok] += 1.0
+                self._gen_counts[r, tok] += 1.0
             self._pending[r] = tok
             self._pos[r] += 1  # the fed token's K/V is now in the cache
             self.stats["generated_tokens"] += 1

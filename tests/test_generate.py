@@ -429,3 +429,96 @@ def test_generate_with_repetition_penalty_breaks_loops():
 
     assert max_run(pen[0].tolist()) <= max_run(base[0].tolist())
     assert not np.array_equal(base, pen)
+
+
+def test_openai_penalties_score_generated_only():
+    """ADVICE r3: presence/frequency count GENERATED tokens (the
+    OpenAI/vLLM convention) — prompt occurrences must not move the
+    additive penalties (they stay the repetition context), so the split
+    counts change only what each convention says they change."""
+    from pytorch_distributed_train_tpu.generate import (
+        apply_penalties,
+        token_counts,
+    )
+
+    V = 8
+    prompt_counts = token_counts(jnp.asarray([[3, 3, 5]], jnp.int32), V)
+    gen_counts = token_counts(jnp.asarray([[6]], jnp.int32), V)
+    logits = jnp.zeros((1, V), jnp.float32)
+    out = np.asarray(apply_penalties(
+        logits, prompt_counts, gen_counts=gen_counts,
+        presence_penalty=0.5, frequency_penalty=0.25))
+    # prompt-only tokens 3/5: untouched by the additive penalties
+    np.testing.assert_allclose(out[0, 3], 0.0)
+    np.testing.assert_allclose(out[0, 5], 0.0)
+    # generated token 6: presence + 1x frequency
+    np.testing.assert_allclose(out[0, 6], -0.75)
+    # repetition scores the `counts` context alone (callers keep it as
+    # prompt+generated; gen_counts never feeds the repetition rule)
+    out_rep = np.asarray(apply_penalties(
+        jnp.ones((1, V), jnp.float32), prompt_counts,
+        gen_counts=gen_counts, repetition_penalty=2.0))
+    np.testing.assert_allclose(out_rep[0, 3], 0.5)
+    np.testing.assert_allclose(out_rep[0, 6], 1.0)  # not in counts
+
+
+def test_generate_first_token_unmoved_by_additive_penalties():
+    """With generated-only counts, the FIRST sampled token's distribution
+    cannot depend on presence/frequency settings (empty generated
+    context) — under the old prompt-counting behavior a prompt full of
+    one token shifted it from step one."""
+    cfg = ModelConfig(name="llama", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      mlp_dim=64, max_seq_len=16)
+    prec = PrecisionConfig(compute_dtype="float32")
+    params = build_model(cfg, prec).init(
+        {"params": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    model = build_decode_model(cfg, prec)
+    prompt = jnp.asarray([[9, 9, 9, 9, 9, 9, 9, 9]], jnp.int32)
+    base = np.asarray(generate(model, params, prompt, 1))
+    pen = np.asarray(generate(model, params, prompt, 1,
+                              presence_penalty=50.0,
+                              frequency_penalty=10.0))
+    np.testing.assert_array_equal(base, pen)
+
+
+def test_generate_repetition_context_excludes_pad(monkeypatch):
+    """generate() threads pad exclusion (default: eos_id) into the
+    prompt's repetition counts — a right-padded batch must not penalize
+    the pad/eos token on every row (ADVICE r3)."""
+    import pytorch_distributed_train_tpu.generate as gen_mod
+
+    seen = {}
+    orig = gen_mod.token_counts
+
+    def spy(ids, vocab, pad_id=None):
+        seen["pad_id"] = pad_id
+        return orig(ids, vocab, pad_id=pad_id)
+
+    monkeypatch.setattr(gen_mod, "token_counts", spy)
+    cfg = ModelConfig(name="llama", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      mlp_dim=64, max_seq_len=16)
+    prec = PrecisionConfig(compute_dtype="float32")
+    params = build_model(cfg, prec).init(
+        {"params": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    model = build_decode_model(cfg, prec)
+    prompt = jnp.asarray([[5, 4, 7, 7]], jnp.int32)
+    gen_mod.generate(model, params, prompt, 2, eos_id=7,
+                     repetition_penalty=2.0)
+    assert seen["pad_id"] == 7
+    gen_mod.generate(model, params, prompt, 2, eos_id=7, pad_id=0,
+                     repetition_penalty=2.0)
+    assert seen["pad_id"] == 0  # explicit pad_id wins over eos default
+
+
+def test_bias_vector_rejects_out_of_range_values():
+    from pytorch_distributed_train_tpu.generate import bias_vector
+
+    with pytest.raises(ValueError, match=r"\[-100, 100\]"):
+        bias_vector({3: 250.0}, 8)
+    v = np.asarray(bias_vector({3: -100.0, 4: 100.0}, 8))
+    np.testing.assert_allclose(v[3], -100.0)
+    np.testing.assert_allclose(v[4], 100.0)

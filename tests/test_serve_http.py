@@ -406,3 +406,46 @@ def test_http_logit_bias_bans_token(server):
     assert out["text"] == tok.decode(new)
     assert first not in new[:1]
     del plain  # plain-path equality is covered by the lockstep tests
+
+
+def test_n_explicit_default_penalties_keep_shared_prefill(server):
+    """ADVICE r3: a client sending the explicit OpenAI defaults
+    (rep=1.0, pres/freq=0.0) must NOT lose the shared-prefix
+    optimization — effective values gate, not key presence. And since
+    presence/frequency score generated tokens only, a real presence
+    penalty keeps the shared path too; only repetition (which scores
+    the prompt) forces full per-fork prefills."""
+    port, *_ = server
+
+    def stats():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=60) as r:
+            return json.loads(r.read())["stats"]
+
+    before = stats()
+    _, out = _post(port, {"prompt": "defaults are free", "max_tokens": 4,
+                          "temperature": 1.0, "n": 2,
+                          "repetition_penalty": 1.0,
+                          "presence_penalty": 0.0,
+                          "frequency_penalty": 0.0})
+    assert len(out["choices"]) == 2
+    mid = stats()
+    assert mid["preloads"] - before["preloads"] == 1
+    assert mid["forks"] - before["forks"] == 2
+
+    # generated-only additive penalty: shared path still allowed
+    _, out = _post(port, {"prompt": "presence is gen-only",
+                          "max_tokens": 4, "temperature": 1.0, "n": 2,
+                          "presence_penalty": 1.2})
+    after = stats()
+    assert after["preloads"] - mid["preloads"] == 1
+    assert after["forks"] - mid["forks"] == 2
+
+    # repetition scores the prompt: full prefill per completion
+    _, out = _post(port, {"prompt": "repetition forces full",
+                          "max_tokens": 4, "temperature": 1.0, "n": 2,
+                          "repetition_penalty": 1.5})
+    last = stats()
+    assert last["preloads"] - after["preloads"] == 0
+    assert last["forks"] - after["forks"] == 0
+    assert last["prefills"] - after["prefills"] == 2

@@ -594,3 +594,64 @@ def test_logit_bias_generate_matches_batcher(setup):
     u = b.submit(prompt, 6, logit_bias={2: 100.0})
     out = {c.uid: c for c in b.run()}[u].tokens
     assert out == ref.tolist() == [2] * 6
+
+
+def test_batcher_first_token_unmoved_by_additive_penalties(setup):
+    """OpenAI semantics (ADVICE r3): presence/frequency count generated
+    tokens only, so the first sampled token matches the unpenalized
+    greedy one even when the prompt is saturated with a single token."""
+    cfg, params = setup
+    prompt = [9] * 8
+    b1 = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u1 = b1.submit(prompt, 1)
+    b2 = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u2 = b2.submit(prompt, 1, presence_penalty=50.0,
+                   frequency_penalty=10.0)
+    t1 = {c.uid: c for c in b1.run()}[u1].tokens
+    t2 = {c.uid: c for c in b2.run()}[u2].tokens
+    assert t1 == t2
+
+
+def test_batcher_additive_penalties_engage_on_generated(setup):
+    """...but once tokens ARE generated, a strong presence penalty must
+    forbid consecutive repeats (the generated-only context engages)."""
+    cfg, params = setup
+    prompt = [9] * 8
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u = b.submit(prompt, 8, presence_penalty=50.0)
+    toks = {c.uid: c for c in b.run()}[u].tokens
+    assert all(a != b2 for a, b2 in zip(toks[:-1], toks[1:])), toks
+
+
+def test_submit_rejects_out_of_range_logit_bias(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    with pytest.raises(ValueError, match=r"\[-100, 100\]"):
+        b.submit([1, 2], 2, logit_bias={3: 150.0})
+    with pytest.raises(ValueError, match=r"\[-100, 100\]"):
+        b.submit([1, 2], 2, logit_bias={3: -101.0})
+
+
+def test_seq2seq_logit_bias_applies_to_first_token():
+    """The admission sampler must honor logit_bias from token one even
+    when the batcher does not count the prompt (seq2seq): a -100 ban on
+    the greedy first token forces a different first token."""
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    cfg = ModelConfig(name="t5", vocab_size=64, hidden_size=32,
+                      num_layers=2, decoder_layers=2, num_heads=4,
+                      mlp_dim=64, max_seq_len=32, dropout_rate=0.0)
+    params = build_model(cfg, PrecisionConfig()).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 6), jnp.int32), jnp.zeros((1, 2), jnp.int32),
+        train=False)["params"]
+    src = [5, 9, 12, 3]
+    b = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u = b.submit(src, 2)
+    first = {c.uid: c for c in b.run()}[u].tokens[0]
+    b2 = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    u2 = b2.submit(src, 2, logit_bias={int(first): -100.0})
+    first_banned = {c.uid: c for c in b2.run()}[u2].tokens[0]
+    assert first_banned != first

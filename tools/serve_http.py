@@ -199,16 +199,19 @@ class BatcherService:
             raise ValueError("empty prompt after tokenization")
         events: dict[int, threading.Event] = {}
         sid = None
-        # Penalized n>1 requests always prefill the FULL prompt per fork:
-        # the shared-prefix template would leave only the final token in
-        # each fork's penalty context, making the distribution depend on
-        # slot availability (template admitted or not). Deterministic
-        # semantics beat the saved prefills.
-        # Only COUNT-based penalties need the full prompt in each fork's
-        # context (logit_bias is context-independent — the preload trick
-        # stays deterministic under it).
-        force_full_prompt = any(k != "logit_bias"
-                                for k in (penalties or {}))
+        # Repetition-penalized n>1 requests always prefill the FULL
+        # prompt per fork: the shared-prefix template would leave only
+        # the final token in each fork's repetition context, making the
+        # distribution depend on slot availability (template admitted or
+        # not). Deterministic semantics beat the saved prefills.
+        # Only repetition_penalty scores the prompt — presence/frequency
+        # count generated tokens only (OpenAI semantics) and logit_bias
+        # is context-independent, so neither disables the shared-prefix
+        # optimization; and EFFECTIVE values gate, not key presence (a
+        # client sending the explicit OpenAI defaults must not lose the
+        # optimization).
+        force_full_prompt = (
+            float((penalties or {}).get("repetition_penalty", 1.0)) != 1.0)
         # the shared-prefill trick needs session support (causal
         # batchers) and a >= 2-token prompt; otherwise n plain submits
         # still serve the request — just paying n prefills
