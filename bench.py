@@ -57,13 +57,84 @@ _BACKEND_ERR_MARKERS = (
 )
 
 
+_LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LKG.json")
+
+
+def _load_lkg() -> dict:
+    try:
+        with open(_LKG_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _update_lkg(record: dict) -> None:
+    """Record a successful measurement as the metric's last-known-good
+    row. The LKG store exists so a later wedged-lease round still emits
+    numbers with provenance instead of a bare null (VERDICT r3 #1)."""
+    if not record.get("metric"):
+        return
+    lkg = _load_lkg()
+    rows = lkg.setdefault("rows", {})
+    rows[record["metric"]] = {
+        **{k: v for k, v in record.items() if k != "metric"},
+        "measured": time.strftime("%Y-%m-%d"),
+        "argv": " ".join(sys.argv[1:]),
+    }
+    try:
+        # Atomic replace: the bench runs under a kill-on-stall watchdog,
+        # and a truncate-then-die would destroy the whole LKG history
+        # this feature exists to preserve.
+        tmp = _LKG_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(lkg, f, indent=1, sort_keys=True)
+        os.replace(tmp, _LKG_PATH)
+    except OSError:
+        pass  # read-only checkout: the printed record still stands
+
+
+def _emit(record: dict, device_metric: bool = True) -> None:
+    """Print the one-line JSON record and, when it is a real hardware
+    measurement (TPU backend; host-pipeline benches pass False and are
+    recorded unconditionally), persist it as last-known-good."""
+    print(json.dumps(record), flush=True)
+    if device_metric:
+        try:
+            import jax
+
+            if jax.devices()[0].platform != "tpu":
+                return  # CPU smoke numbers must never pose as LKG
+        except Exception:
+            return
+    _update_lkg(record)
+
+
 def _emit_backend_unavailable(detail: str) -> None:
-    print(json.dumps({
+    """Structured no-hardware record. Never a bare null when measured
+    numbers exist on disk: the last-known-good rows ride along, stamped
+    stale so the reader can't mistake them for this round's capture."""
+    out = {
         "error": "tpu_unavailable",
         "detail": detail[-1500:],
         "metric": None,
         "value": None,
-    }), flush=True)
+    }
+    lkg = _load_lkg()
+    if lkg.get("rows"):
+        try:
+            mtime = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(_LKG_PATH)))
+        except OSError:
+            mtime = None
+        out["stale"] = True
+        out["last_known_good"] = {
+            "note": "prior successful measurements (NOT this run's): "
+                    "see per-row 'measured' dates",
+            "file_mtime": mtime,
+            "rows": lkg["rows"],
+        }
+    print(json.dumps(out), flush=True)
 
 
 def probe_once(timeout_s: float = 90.0) -> tuple[bool, str]:
@@ -245,12 +316,12 @@ def pipeline_bench(args) -> None:
     wall = time.perf_counter() - t0
     native = "native" if imgops.available() else "numpy"
     metric = f"input_pipeline_{native}_images_per_sec"
-    print(json.dumps({
+    _emit({
         "metric": metric,
         "value": round(seen / wall, 2),
         "unit": "images/sec (host)",
         "vs_baseline": 1.0,
-    }))
+    }, device_metric=False)
 
 
 def pipeline_decode_bench(args) -> None:
@@ -344,7 +415,7 @@ def pipeline_decode_bench(args) -> None:
         # core-starved hosts. Recorded so grain numbers from different
         # host shapes are never conflated.
         record["grain_workers"] = loader.num_workers
-    print(json.dumps(record))
+    _emit(record, device_metric=False)
 
 
 def decode_bench(args) -> None:
@@ -426,12 +497,12 @@ def decode_bench(args) -> None:
     per_chip = bpc * (new_tokens - 1) / wall
     suffix = (f"_{args.quantize}" if args.quantize else "") + (
         "_tiny" if args.tiny else "")
-    print(json.dumps({
+    _emit({
         "metric": f"llama_decode{suffix}_tokens_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": 1.0,
-    }))
+    })
 
 
 def _llama_dims(tiny: bool) -> dict:
@@ -638,7 +709,7 @@ def serve_bench(args) -> None:
         arm = "_chat_resend" if args.serve_resend else "_chat"
     elif prefix_len:
         arm = "_prefix_resend" if args.serve_resend else "_prefix"
-    print(json.dumps({
+    _emit({
         "metric": f"llama_serve{arm}{suffix}_tokens_per_sec_per_chip",
         "value": round(total / wall, 2),
         "unit": "tokens/sec/chip",
@@ -651,7 +722,7 @@ def serve_bench(args) -> None:
         "resumes": b.stats["resumes"],
         "forks": b.stats["forks"],
         "occupancy": round(occupancy, 3),
-    }))
+    })
 
 
 def spec_bench(args) -> None:
@@ -726,14 +797,14 @@ def spec_bench(args) -> None:
         new_tokens, k=k, temperature=0.0, return_stats=True)
     wall = time.perf_counter() - t0
     suffix = "_tiny" if args.tiny else ""
-    print(json.dumps({
+    _emit({
         "metric": f"llama_spec_{arm}_k{k}{suffix}_tokens_per_sec",
         "value": round((out.shape[1] - prompt_len) / wall, 2),
         "unit": "tokens/sec (B=1)",
         "vs_baseline": 1.0,
         "accept_rate": round(stats["accept_rate"], 4),
         "tokens_per_round": round(stats["tokens_per_round"], 3),
-    }))
+    })
 
 
 def main() -> None:
@@ -831,6 +902,15 @@ def main() -> None:
                         "'chunked' is the pure-XLA flash-style path: O(S* "
                         "chunk) memory, compiles everywhere.")
     args = p.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # The env var alone does not stick on hosts whose sitecustomize
+        # force-registers a TPU plugin (this sandbox's axon hook): the
+        # config update is what actually pins the backend, and a wedged
+        # lease otherwise hangs a "CPU" smoke run forever.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     if timeout_s > 0:
@@ -1078,12 +1158,25 @@ def main() -> None:
         with open(baseline_path, "w") as f:
             json.dump(base, f, indent=1)
 
-    print(json.dumps({
+    record = {
         "metric": metric,
         "value": round(per_chip, 2),
         "unit": f"{unit_noun}/sec/chip",
         "vs_baseline": round(vs, 4),
-    }))
+    }
+    # MFU accounting (VERDICT r3 #2): analytic model FLOPs/item (2xMACs,
+    # train = 3x fwd — utils/flops.py conventions) over the detected
+    # chip's bf16 peak. None on CPU backends (no MXU peak to divide by).
+    from pytorch_distributed_train_tpu.utils import flops as flops_lib
+
+    fpi = flops_lib.train_flops_per_item(model_cfg, None if vision else seq)
+    peak = flops_lib.device_peak_flops(jax.devices()[0])
+    mfu = flops_lib.mfu_pct(per_chip, fpi, peak)
+    if fpi is not None:
+        record["model_gflops_per_item"] = round(fpi / 1e9, 3)
+    if mfu is not None:
+        record["mfu_pct"] = round(mfu, 2)
+    _emit(record)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,7 @@ from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
 from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
 from pytorch_distributed_train_tpu.train_state import DynamicScale, TrainState
 from pytorch_distributed_train_tpu.utils import debug as debug_lib
+from pytorch_distributed_train_tpu.utils import flops as flops_lib
 from pytorch_distributed_train_tpu.utils.metrics import Meter, MetricLogger
 from pytorch_distributed_train_tpu.utils.watchdog import FlightRecorder, Heartbeat
 
@@ -271,6 +272,15 @@ class Trainer:
         tb_dir = f"{cfg.checkpoint.dir}/tb" if cfg.obs.tensorboard else ""
         self.logger = MetricLogger(jsonl, tb_dir)
         self.meter = Meter()
+        # MFU accounting (utils/flops.py): analytic train FLOPs per
+        # throughput item over the chip's bf16 peak; either side unknown
+        # (unlisted model, CPU backend) disables the metric, never the run.
+        self._flops_per_item = flops_lib.train_flops_per_item(
+            cfg.model, getattr(cfg.data, "seq_len", None) or None)
+        try:
+            self._peak_flops = flops_lib.device_peak_flops(jax.devices()[0])
+        except Exception:
+            self._peak_flops = None
         self.recorder = FlightRecorder(dump_dir=cfg.checkpoint.dir)
         self.recorder.install_signal_dump()
         self.heartbeat = Heartbeat(cfg.obs.heartbeat_timeout_s, self.recorder)
@@ -487,6 +497,10 @@ class Trainer:
             unit = "images" if self.cfg.loss == "softmax_xent" else "tokens"
             host[f"{unit}_per_sec"] = tput
             host[f"{unit}_per_sec_per_chip"] = tput / jax.device_count()
+            mfu = flops_lib.mfu_pct(host[f"{unit}_per_sec_per_chip"],
+                                    self._flops_per_item, self._peak_flops)
+            if mfu is not None:
+                host["mfu_pct"] = round(mfu, 2)
         host["epoch"] = step // max(self.steps_per_epoch, 1)
         stats = getattr(self.train_loader, "stall_stats", None)
         if stats is not None:
@@ -548,6 +562,11 @@ class Trainer:
             return
         avg = jax.tree.map(lambda t: t / n, total)
         self.state = self.state.replace(batch_stats=avg)
+        if self.state.ema_batch_stats is not None:
+            # eval reads the EMA stats mirror when one exists: the freshly
+            # re-estimated stats (computed under eval_params) must land
+            # there too or update_bn would be invisible to EMA eval.
+            self.state = self.state.replace(ema_batch_stats=avg)
         self.recorder.record("update_bn", int(self.state.step), batches=n)
 
     def evaluate(self, step: int, prefix: str = "eval") -> dict:
