@@ -99,6 +99,10 @@ class CheckpointManager:
             template.pop("ema_params")
         if "swa_count" in template and not self._ckpt_has(step, "swa_count"):
             template.pop("swa_count")  # pre-SWA ckpt: count restarts at 0
+        if ("ema_batch_stats" in template
+                and not self._ckpt_has(step, "ema_batch_stats")):
+            # ckpt from before the stats mirror existed: re-seed below
+            template.pop("ema_batch_stats")
         restored = self.mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -118,6 +122,12 @@ class CheckpointManager:
             # EMA was enabled has no mirror — re-seed from restored params.
             state = state.replace(
                 ema_params=sav.get("ema_params", sav["params"]))
+        if getattr(abstract_state, "ema_batch_stats", None) is not None:
+            # Stats mirror: older ckpts re-seed from the trajectory stats
+            # (the pre-mirror eval behavior, converging under the decay).
+            state = state.replace(
+                ema_batch_stats=sav.get("ema_batch_stats",
+                                        sav["batch_stats"]))
         if getattr(abstract_state, "swa_count", None) is not None:
             # Without this the resumed running mean would weight its next
             # snapshot 1/1 and erase every pre-restart fold.
@@ -290,6 +300,8 @@ def _savable(state: TrainState) -> dict[str, Any]:
     }
     if state.ema_params is not None:
         d["ema_params"] = state.ema_params
+    if getattr(state, "ema_batch_stats", None) is not None:
+        d["ema_batch_stats"] = state.ema_batch_stats
     if getattr(state, "swa_count", None) is not None:
         d["swa_count"] = state.swa_count
     if state.dynamic_scale is not None:

@@ -232,7 +232,9 @@ def make_eval_step(model, loss_fn: Callable,
         if param_transform is not None:
             params = param_transform(params)
         logits, _, _ = apply_model(
-            model, params, state.batch_stats, batch,
+            # eval_batch_stats: the EMA stats mirror when EMA is on —
+            # averaged weights + trajectory stats mis-normalize BN models
+            model, params, state.eval_batch_stats, batch,
             train=False, dropout_rng=None,
         )
         loss, aux = loss_fn(logits, batch)
