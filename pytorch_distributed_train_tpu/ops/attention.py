@@ -49,18 +49,56 @@ def _resolve_default_impl() -> str:
     return _env_impl() or _default_impl
 
 
+def _mosaic_probe_record(path: str | None = None) -> dict | None:
+    """The recorded Mosaic-compile probe (tools/mosaic_probe.py), or None.
+
+    Cached per-path after the first read: _pallas_usable sits on the
+    attention dispatch path. MOSAIC_PROBE_PATH overrides for tests."""
+    path = path or os.environ.get("MOSAIC_PROBE_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "MOSAIC_PROBE.json")
+    rec = _mosaic_probe_cache.get(path)
+    if rec is None:
+        try:
+            import json
+
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rec = {}
+        _mosaic_probe_cache[path] = rec
+    return rec or None
+
+
+_mosaic_probe_cache: dict[str, dict] = {}
+
+
 def _pallas_usable() -> bool:
     """Whether impl='auto' may pick the Pallas kernel on this backend.
 
     The sandbox's tunnelled axon PJRT (JAX_PLATFORMS=axon, remote compile)
-    cannot compile Mosaic kernels — a tiny flash-attention fwd hung >8 min
-    and wedged the device lease. Explicit impl='pallas' still forces the
-    kernel anywhere. Checks both the env var and the live jax config (the
-    backend can be selected either way).
+    historically cannot compile Mosaic kernels — a tiny flash-attention
+    fwd hung >8 min and wedged the device lease. Rather than hardcoding
+    that forever, the gate is PROBE-DRIVEN (VERDICT r3 #4): when a
+    recorded `tools/mosaic_probe.py` run exists, its measured verdict
+    wins — status "ok" opens the kernel even under axon, anything else
+    keeps routing around it. With no record, axon backends stay gated by
+    the historical default. Explicit impl='pallas' still forces the
+    kernel anywhere.
     """
     cfg_platforms = getattr(jax.config, "jax_platforms", None) or ""
-    return ("axon" not in os.environ.get("JAX_PLATFORMS", "")
-            and "axon" not in cfg_platforms)
+    on_axon = ("axon" in os.environ.get("JAX_PLATFORMS", "")
+               or "axon" in cfg_platforms)
+    if not on_axon:
+        return True
+    rec = _mosaic_probe_record()
+    # The record only overrides when it was CAPTURED against the axon
+    # stack — an "ok" measured on a direct TPU says nothing about the
+    # tunnel's remote compile and must not re-open the lease-wedge.
+    if (rec and rec.get("status")
+            and "axon" in rec.get("jax_platforms_env", "")):
+        return rec["status"] == "ok"
+    return False
 
 
 @dataclasses.dataclass(frozen=True)

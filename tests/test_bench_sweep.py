@@ -18,6 +18,9 @@ def test_dry_run_lists_every_arm():
     assert len(lines) >= 15
     assert any("resnet50_baseline" in ln for ln in lines)
     assert any("serve_prefix_fork" in ln for ln in lines)
+    # r4 extra arms (hardware-evidence probes) listed for the watcher
+    assert "mosaic_probe" in out.stdout
+    assert "llama7b_geometry_step" in out.stdout
 
 
 def test_tiny_arm_produces_report(tmp_path):

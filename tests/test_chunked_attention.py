@@ -280,3 +280,69 @@ def test_gpt2_sliding_window_decode_matches_full_forward():
                        PrecisionConfig())
     logits_b = base.apply(variables, ids, train=False)
     assert not np.allclose(np.asarray(logits_full), np.asarray(logits_b))
+
+
+class TestMosaicProbeGating:
+    """_pallas_usable is probe-driven (VERDICT r3 #4): a recorded
+    tools/mosaic_probe.py verdict overrides the hardcoded axon heuristic."""
+
+    def _usable(self, monkeypatch, tmp_path, record):
+        import json
+
+        from pytorch_distributed_train_tpu.ops import attention as att
+
+        path = str(tmp_path / "probe.json")
+        if record is not None:
+            with open(path, "w") as f:
+                json.dump(record, f)
+        monkeypatch.setenv("MOSAIC_PROBE_PATH", path)
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        att._mosaic_probe_cache.clear()
+        try:
+            return att._pallas_usable()
+        finally:
+            att._mosaic_probe_cache.clear()
+
+    def test_axon_without_record_stays_gated(self, monkeypatch, tmp_path):
+        assert self._usable(monkeypatch, tmp_path, None) is False
+
+    def test_axon_with_ok_record_opens(self, monkeypatch, tmp_path):
+        assert self._usable(monkeypatch, tmp_path, {
+            "status": "ok", "detail": "v= 256.0",
+            "jax_platforms_env": "axon"}) is True
+
+    def test_axon_with_hang_record_stays_gated(self, monkeypatch, tmp_path):
+        assert self._usable(monkeypatch, tmp_path, {
+            "status": "hang", "detail": ">300s",
+            "jax_platforms_env": "axon"}) is False
+
+    def test_ok_record_from_other_backend_ignored(self, monkeypatch,
+                                                  tmp_path):
+        """An 'ok' measured on a DIRECT TPU says nothing about the axon
+        tunnel's remote compile — it must not re-open the lease-wedge."""
+        assert self._usable(monkeypatch, tmp_path, {
+            "status": "ok", "detail": "v= 256.0",
+            "jax_platforms_env": "tpu"}) is False
+
+    def test_corrupt_record_falls_back_to_heuristic(self, monkeypatch,
+                                                    tmp_path):
+        import pathlib
+
+        from pytorch_distributed_train_tpu.ops import attention as att
+
+        path = tmp_path / "probe.json"
+        pathlib.Path(path).write_text("{not json")
+        monkeypatch.setenv("MOSAIC_PROBE_PATH", str(path))
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        att._mosaic_probe_cache.clear()
+        assert att._pallas_usable() is False
+        att._mosaic_probe_cache.clear()
+
+    def test_non_axon_backend_always_usable(self, monkeypatch, tmp_path):
+        from pytorch_distributed_train_tpu.ops import attention as att
+
+        monkeypatch.setenv("MOSAIC_PROBE_PATH",
+                           str(tmp_path / "missing.json"))
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        att._mosaic_probe_cache.clear()
+        assert att._pallas_usable() is True

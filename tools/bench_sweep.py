@@ -85,6 +85,15 @@ EXTRA_ARMS: list[tuple[str, list[str]]] = [
     ("sustained_resnet50_10min",
      [sys.executable, os.path.join(REPO, "tools", "sustained_drill.py"),
       "--minutes", "10"]),
+    # VERDICT r3 #4: Mosaic compile probe (hard-timeout subprocess) →
+    # MOSAIC_PROBE.json record consumed by attention's auto gating, plus
+    # the flash-vs-chunked A/B when the tunnel can actually compile.
+    ("mosaic_probe",
+     [sys.executable, os.path.join(REPO, "tools", "mosaic_probe.py")]),
+    # VERDICT r3 #6: execute 7B per-layer geometry at 2 depths; slope
+    # replaces MEMFIT_7B.md's extrapolated temps with measured ones.
+    ("llama7b_geometry_step",
+     [sys.executable, os.path.join(REPO, "tools", "probe_7b_step.py")]),
 ]
 
 

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Probe whether this backend can compile+execute a Mosaic (Pallas)
+kernel, and record the verdict (VERDICT r3 #4).
+
+The axon tunnel has historically HUNG on Mosaic remote compiles (>8 min,
+wedging the lease), so `attention.impl='auto'` routes around Pallas on
+axon backends. This probe replaces that hardcoded heuristic with a
+measured record:
+
+- runs a tiny flash-attention forward in a SUBPROCESS with a hard
+  timeout (a hang kills the child, never this process or the lease
+  bookkeeping of the parent);
+- writes MOSAIC_PROBE.json {status: ok|hang|error, detail, elapsed_s}
+  at the repo root — `ops.attention._pallas_usable` consults it, so a
+  future healed tunnel auto-enables the kernel with no code change;
+- on status=ok, immediately runs the flash-vs-chunked timed A/B the
+  kernel's 594 LoC have been waiting for, and emits a bench-style row.
+
+Always prints ONE JSON line (bench_sweep contract).
+
+Run:  python tools/mosaic_probe.py [--timeout 300] [--skip-ab]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_CHILD = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+from pytorch_distributed_train_tpu.ops import flash_attention as fa
+
+q = jnp.ones((1, 256, 4, 64), jnp.bfloat16)
+out = fa.flash_attention(q, q, q, causal=True, interpret=False)
+# value fetch: block_until_ready lies over the tunnel (bench.py docstring)
+print("v=", float(out.astype(jnp.float32).sum()), "kind=",
+      jax.devices()[0].device_kind)
+"""
+
+_AB = r"""
+import sys, time, json
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+from pytorch_distributed_train_tpu.ops.attention import attention
+
+B, S, H, D = 4, 2048, 16, 128
+q = jnp.ones((B, S, H, D), jnp.bfloat16)
+
+
+def bench(impl):
+    def loss(q):
+        return attention(q, q, q, causal=True, impl=impl).astype(
+            jnp.float32).sum()
+
+    step = jax.jit(jax.grad(loss))
+    g = step(q); float(g.sum())  # compile + execute
+    t0 = time.perf_counter()
+    for _ in range(10):
+        g = step(g * 0 + q)
+    float(g.sum())
+    return (time.perf_counter() - t0) / 10
+
+
+flash_s = bench("pallas")
+chunked_s = bench("chunked")
+print(json.dumps({{"flash_ms": flash_s * 1e3, "chunked_ms": chunked_s * 1e3}}))
+"""
+
+
+def run_child(code: str, timeout_s: float) -> tuple[str, str]:
+    """(status, detail) from a hard-timeout subprocess run."""
+    try:
+        r = subprocess.run([sys.executable, "-c", code.format(repo=REPO)],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return "hang", f"no result in {timeout_s:.0f}s (Mosaic remote " \
+                       "compile wedged — child killed)"
+    if r.returncode == 0:
+        return "ok", r.stdout.strip().splitlines()[-1]
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return "error", (tail[-1][-300:] if tail else f"rc={r.returncode}")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--skip-ab", action="store_true")
+    p.add_argument("--out", default=os.path.join(REPO, "MOSAIC_PROBE.json"))
+    args = p.parse_args()
+
+    t0 = time.monotonic()
+    status, detail = run_child(_CHILD, args.timeout)
+    rec = {
+        "status": status,
+        "detail": detail,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "probed": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "timeout_s": args.timeout,
+        # Backend identity: _pallas_usable honors this record ONLY when
+        # it was captured against the axon stack (the child inherits
+        # this env) — an ok from a direct TPU must not open the tunnel.
+        "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+
+    row: dict = {"metric": "mosaic_flash_vs_chunked_ms", "value": None,
+                 "unit": "ms/step fwd+bwd (B4 S2048 H16 D128)",
+                 "vs_baseline": 1.0, "probe": rec}
+    if status == "ok" and not args.skip_ab:
+        ab_status, ab_detail = run_child(_AB, max(args.timeout * 2, 600.0))
+        if ab_status == "ok":
+            try:
+                ab = json.loads(ab_detail)
+                row["value"] = round(ab["flash_ms"], 2)
+                row["chunked_ms"] = round(ab["chunked_ms"], 2)
+                row["speedup_vs_chunked"] = round(
+                    ab["chunked_ms"] / ab["flash_ms"], 3)
+            except (ValueError, KeyError):
+                row["ab_error"] = ab_detail[-300:]
+        else:
+            row["ab_error"] = f"{ab_status}: {ab_detail[-300:]}"
+    print(json.dumps(row), flush=True)
+    return 0 if status == "ok" else 4
+
+
+if __name__ == "__main__":
+    sys.exit(main())

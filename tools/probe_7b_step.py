@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Execute a 7B-GEOMETRY training step on the local chip (VERDICT r3 #6).
+
+docs/MEMFIT_7B.md grounds the llama2_7b fit claim in AOT compile
+analysis, but its temps column is an extrapolation with a 15x spread
+between estimate and upper bound — because no 7B-geometry step had ever
+*executed*. This probe closes that: it trains a REDUCED-LAYER model
+whose per-layer shapes are exactly Llama-2 7B's (hidden 4096, mlp 11008,
+32 heads, vocab 32000, seq 4096) with the shipping memory levers (fused
+chunked LM-head loss, remat, adafactor), measures
+
+- actual per-device memory in use (device_memory_stats — the real
+  resident footprint, not a CPU-backend proxy), at two depths so the
+  per-layer increment is MEASURED, and
+- step time at both depths, so the per-layer compute cost and a
+  tokens/sec/chip extrapolation to the full 32 layers are slope-based
+  (intercept absorbs the head/embed cost shared by all depths).
+
+Writes one JSON line (the bench_sweep contract). The depths default to
+(2, 4); HBM permitting the probe also tries the largest depth that fits
+to tighten the extrapolation.
+
+Run on the TPU sandbox:  python tools/probe_7b_step.py [--seq 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _arm_watchdog, _disarm_watchdog, _touch, _wait_for_backend  # noqa: E402
+
+
+def measure_depth(layers: int, seq: int, batch: int) -> dict:
+    """One training run at 7B per-layer geometry with ``layers`` layers:
+    returns step time and device memory stats."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        OptimConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    cfg = ModelConfig(
+        name="llama", vocab_size=32000, hidden_size=4096, num_layers=layers,
+        num_heads=32, num_kv_heads=32, mlp_dim=11008, max_seq_len=seq,
+        remat=True, remat_policy="full", fused_lm_loss=True,
+        attention_impl="chunked",
+    )
+    mesh = build_mesh(MeshConfig(data=-1))
+    model = build_model(cfg, PrecisionConfig(compute_dtype="bfloat16"))
+    tx, _ = make_optimizer(
+        OptimConfig(name="adafactor", learning_rate=1e-3,
+                    schedule="constant", warmup_steps=0), total_steps=100)
+    rules = rules_for_model("llama")
+
+    def init_state(rng):
+        variables = model.init({"params": rng},
+                               jnp.zeros((2, seq), jnp.int32), train=False)
+        return TrainState.create(params=variables["params"], tx=tx)
+
+    rng = jax.random.PRNGKey(0)
+    shape = jax.eval_shape(init_state, rng)
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    state = jax.jit(init_state, out_shardings=sharding)(rng)
+    _touch()
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(
+            model, get_loss_fn("fused_causal_lm_xent"), tx),
+        mesh, sharding)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32000, (batch, seq)), jnp.int32)
+    batch_d = {"input_ids": ids}
+    state, metrics = step(state, batch_d, rng)  # compile + warmup
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), loss
+    _touch()
+    n_steps = 5
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch_d, rng)
+    loss = float(metrics["loss"])  # forces the donated-state chain
+    wall = time.perf_counter() - t0
+    mem = {}
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        mem = {k: int(v) for k, v in stats.items()
+               if k in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit")}
+    except Exception:
+        pass
+    del state, step, batch_d  # free HBM before the next depth
+    return {"layers": layers, "step_s": wall / n_steps, "loss": loss,
+            **mem}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seq", type=int, default=4096)
+    p.add_argument("--batch", type=int, default=1,
+                   help="per-chip batch (7B preset trains bs1/chip x many "
+                        "chips; the probe measures per-layer slopes, not "
+                        "batch scaling)")
+    p.add_argument("--depths", type=int, nargs="+", default=[2, 4])
+    args = p.parse_args()
+
+    _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT_S", "1800")))
+    _wait_for_backend()
+
+    rows = []
+    for d in sorted(args.depths):
+        try:
+            rows.append(measure_depth(d, args.seq, args.batch))
+            print(f"# depth {d}: {rows[-1]}", file=sys.stderr, flush=True)
+        except Exception as exc:  # OOM at a depth: record and stop going up
+            print(f"# depth {d} failed: {type(exc).__name__}: "
+                  f"{str(exc)[:300]}", file=sys.stderr, flush=True)
+            rows.append({"layers": d, "error": type(exc).__name__})
+            break
+    _disarm_watchdog()
+    ok = [r for r in rows if "step_s" in r]
+    record: dict = {"metric": "llama7b_geometry_probe", "value": None,
+                    "unit": "tokens/sec/chip (extrapolated to 32 layers)",
+                    "vs_baseline": 1.0, "seq": args.seq,
+                    "batch_per_chip": args.batch, "depths": rows}
+    if len(ok) >= 2:
+        lo, hi = ok[0], ok[-1]
+        dl = hi["layers"] - lo["layers"]
+        per_layer_s = (hi["step_s"] - lo["step_s"]) / dl
+        base_s = lo["step_s"] - per_layer_s * lo["layers"]
+        step32 = base_s + 32 * per_layer_s
+        record["value"] = round(args.batch * args.seq / step32, 2)
+        record["per_layer_ms"] = round(per_layer_s * 1e3, 2)
+        record["overhead_ms"] = round(base_s * 1e3, 2)
+        if "peak_bytes_in_use" in hi and "peak_bytes_in_use" in lo:
+            per_layer_b = (hi["peak_bytes_in_use"]
+                           - lo["peak_bytes_in_use"]) / dl
+            record["per_layer_peak_gib"] = round(per_layer_b / 1024**3, 3)
+            record["projected_32l_peak_gib"] = round(
+                (lo["peak_bytes_in_use"] + per_layer_b
+                 * (32 - lo["layers"])) / 1024**3, 2)
+    print(json.dumps(record), flush=True)
+    return 0 if record["value"] is not None else 4
+
+
+if __name__ == "__main__":
+    sys.exit(main())
