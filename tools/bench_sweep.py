@@ -94,6 +94,14 @@ EXTRA_ARMS: list[tuple[str, list[str]]] = [
     # replaces MEMFIT_7B.md's extrapolated temps with measured ones.
     ("llama7b_geometry_step",
      [sys.executable, os.path.join(REPO, "tools", "probe_7b_step.py")]),
+    # VERDICT r3 #3: profiler-backed limiter breakdown for the weakest
+    # MFU rows — XPlane per-class % + top ops on the default shapes.
+    ("resnet50_profile_toptops",
+     [sys.executable, os.path.join(REPO, "tools", "profile_toptops.py"),
+      "--model", "resnet50"]),
+    ("vit_b16_profile_toptops",
+     [sys.executable, os.path.join(REPO, "tools", "profile_toptops.py"),
+      "--model", "vit_b16"]),
 ]
 
 

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Profile the north-star (or any vision/LM bench config) and report the
+top-ops limiter breakdown (VERDICT r3 #3).
+
+Runs a short profiled training window (jax.profiler.trace) on the
+default bench shapes, then aggregates the XPlane dump with
+utils/xplane: per-class ms (fusion / convolution / matmul / collective /
+copy / infeed) and the top ops. This is the profiler-backed answer to
+"what limits ResNet-50's MFU" — a JSON line the sweep captures, plus the
+human-readable table on stderr.
+
+Run on hardware:  python tools/profile_toptops.py [--model resnet50]
+                  [--steps 10] [--keep-dump DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _arm_watchdog, _disarm_watchdog, _touch, _wait_for_backend  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="resnet50",
+                   help="resnet50|vit_b16|bert_base|llama")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-per-chip", type=int, default=0)
+    p.add_argument("--stem", default="conv",
+                   choices=["conv", "space_to_depth"])
+    p.add_argument("--keep-dump", default="",
+                   help="persist the xplane dump here (default: tmp, "
+                        "deleted)")
+    p.add_argument("--top", type=int, default=12)
+    args = p.parse_args()
+
+    _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT_S", "1800")))
+    _wait_for_backend()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        OptimConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+    from pytorch_distributed_train_tpu.train_state import TrainState
+    from pytorch_distributed_train_tpu.utils import flops as flops_lib
+    from pytorch_distributed_train_tpu.utils import xplane
+
+    vision = args.model in ("resnet18", "resnet50", "vit_b16")
+    if vision:
+        cfg = ModelConfig(name=args.model, num_classes=1000, image_size=224,
+                          stem=args.stem)
+        loss_name = "softmax_xent"
+        opt = OptimConfig(name="momentum", learning_rate=0.1,
+                          schedule="constant", warmup_steps=0)
+        bpc = args.batch_per_chip or 128
+    elif args.model == "bert_base":
+        cfg = ModelConfig(name="bert_base", vocab_size=30522,
+                          hidden_size=768, num_layers=12, num_heads=12,
+                          mlp_dim=3072, max_seq_len=512)
+        loss_name = "mlm_xent"
+        opt = OptimConfig(name="lamb", learning_rate=1e-3,
+                          schedule="constant", warmup_steps=0)
+        bpc = args.batch_per_chip or 32
+    else:
+        cfg = ModelConfig(name="llama", vocab_size=32000, hidden_size=2048,
+                          num_layers=16, num_heads=16, num_kv_heads=16,
+                          mlp_dim=5504, max_seq_len=2048, remat=True,
+                          fused_lm_loss=True, attention_impl="auto")
+        loss_name = "fused_causal_lm_xent"
+        opt = OptimConfig(name="adafactor", learning_rate=1e-3,
+                          schedule="constant", warmup_steps=0)
+        bpc = args.batch_per_chip or 4
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    model = build_model(cfg, PrecisionConfig(compute_dtype="bfloat16"))
+    tx, _ = make_optimizer(opt, total_steps=1000)
+    rules = rules_for_model(args.model)
+
+    def init_state(rng):
+        if vision:
+            dummy = (jnp.zeros((2, 224, 224, 3)),)
+        else:
+            dummy = (jnp.zeros((2, cfg.max_seq_len), jnp.int32),)
+        variables = model.init({"params": rng}, *dummy, train=False)
+        return TrainState.create(params=variables["params"], tx=tx,
+                                 batch_stats=variables.get("batch_stats",
+                                                           {}))
+
+    rng = jax.random.PRNGKey(0)
+    shape = jax.eval_shape(init_state, rng)
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    state = jax.jit(init_state, out_shardings=sharding)(rng)
+    _touch()
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(model, get_loss_fn(loss_name), tx),
+        mesh, sharding)
+
+    n = bpc * jax.device_count()
+    gen = np.random.default_rng(0)
+    if vision:
+        batch = {"image": jnp.asarray(
+            gen.standard_normal((n, 224, 224, 3)), jnp.float32),
+            "label": jnp.asarray(gen.integers(0, 1000, n), jnp.int32)}
+        items = n
+    elif args.model == "bert_base":
+        from pytorch_distributed_train_tpu.data.datasets import (
+            synthetic_mlm,
+        )
+
+        ds = synthetic_mlm(n, 512, cfg.vocab_size, mlm_prob=0.15)
+        batch = {k: jnp.asarray(v) for k, v in
+                 ds.get_batch(np.arange(n), gen, train=True).items()}
+        items = n * 512
+    else:
+        batch = {"input_ids": jnp.asarray(
+            gen.integers(0, cfg.vocab_size, (n, cfg.max_seq_len)),
+            jnp.int32)}
+        items = n * cfg.max_seq_len
+
+    for _ in range(3):  # compile + warm
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    _disarm_watchdog()
+
+    dump = args.keep_dump or tempfile.mkdtemp(prefix="toptops-")
+    try:
+        with jax.profiler.trace(dump):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, metrics = step(state, batch, rng)
+            loss = float(metrics["loss"])
+            wall = time.perf_counter() - t0
+        assert np.isfinite(loss)
+        per_step = wall / args.steps
+        per_chip = items / per_step / jax.device_count()
+
+        files = xplane.find_xplane_files(dump)
+        planes = []
+        if files:
+            planes = xplane.summarize_xspace(xplane.load_xspace(files[0]))
+            print(xplane.report(dump, top=args.top), file=sys.stderr,
+                  flush=True)
+        by_class, top_ops = {}, []
+        if planes:
+            dev = planes[0]
+            scale = 100.0 / max(dev["total_ms"], 1e-9)
+            by_class = {c: round(ms * scale, 1)
+                        for c, ms in dev["by_class"].items()}
+            top_ops = [{"op": name[:120], "ms": round(ms, 2), "n": cnt}
+                       for name, ms, cnt in dev["ops"][:args.top]]
+        fpi = flops_lib.train_flops_per_item(
+            cfg, None if vision else cfg.max_seq_len)
+        mfu = flops_lib.mfu_pct(per_chip,
+                                fpi, flops_lib.device_peak_flops())
+        print(json.dumps({
+            "metric": f"{args.model}_profile_step_ms",
+            "value": round(per_step * 1e3, 2),
+            "unit": "ms/step (profiled window)",
+            "vs_baseline": 1.0,
+            "items_per_sec_per_chip": round(per_chip, 2),
+            "mfu_pct": round(mfu, 2) if mfu is not None else None,
+            "by_class_pct": by_class,
+            "top_ops": top_ops,
+        }), flush=True)
+    finally:
+        if not args.keep_dump:
+            shutil.rmtree(dump, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
