@@ -240,14 +240,8 @@ def dot_product_attention(
             if impl == "pallas" or (
                 on_tpu and _pallas_usable() and _fa.profitable(q)
             ):
-                from pytorch_distributed_train_tpu.ops.cp_common import (
-                    expand_kv_heads,
-                )
-
-                # GQA: expand KV for the kernel.
-                # TODO(perf): index kv blocks as b // rep in the kernel
-                # instead of materialising the repeat in HBM.
-                k, v = expand_kv_heads(k, v, q.shape[2])
+                # GQA is native in the kernel (KV BlockSpec index_map
+                # b // rep) — no expanded K/V copy in HBM.
                 return _fa.flash_attention(q, k, v, causal=causal,
                                            window=window,
                                            interpret=not on_tpu)

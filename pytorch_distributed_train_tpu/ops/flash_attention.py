@@ -12,6 +12,13 @@ Layout: inputs (B, S, H, D) are reshaped to (B·H, S, D); the kernel grid is
 be 64/128/256 (lane-aligned); S must divide by the block sizes. Softmax math
 is fp32 regardless of input dtype (matches ops.attention policy).
 
+GQA is native (r4): K/V stay at Hkv heads in HBM; the batch-major head
+order makes q row b's KV row exactly b // rep (rep = H/Hkv), so sharing is
+a BlockSpec index_map, not a materialised repeat — K/V read bandwidth drops
+by rep. The dK/dV backward adds a rep grid axis that revisits each KV tile
+once per query head in its group (first visit zeroes the accumulators,
+last writes out).
+
 Causal masking skips whole KV blocks above the diagonal (no wasted MXU work)
 and applies an iota mask only on diagonal blocks. Sliding-window attention
 (``window > 0``) additionally skips KV blocks entirely below the band, so
@@ -225,6 +232,11 @@ def _fwd(q3, k3, v3, q_pos=None, kv_pos=None, *, causal, scale,
          block_q, block_k, window, interpret, out_dtype=None):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
+    # GQA without HBM expansion (ROADMAP kernel follow-up): q3 is flattened
+    # batch-major with heads in order, so q row b = (batch·Hkv + kvh)·rep + r
+    # and its KV row is simply b // rep — an index_map, not a materialized
+    # repeat. rep == 1 is the MHA/pre-expanded case (identity map).
+    rep = BH // k3.shape[0]
     nq, nk = Sq // block_q, Sk // block_k
     grid = (BH, nq, nk)
     has_pos = q_pos is not None
@@ -238,8 +250,8 @@ def _fwd(q3, k3, v3, q_pos=None, kv_pos=None, *, causal, scale,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // rep, j, 0)),
     ]
     args = [q3, k3, v3]
     if has_pos:
@@ -334,6 +346,10 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, scale, window, has_pos):
 
 
 def _bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, window, has_pos):
+    """Grid (B·Hkv, nk, rep, nq): one (block_k, D) dK/dV tile. The rep axis
+    revisits the SAME KV tile for each of the rep query heads sharing it
+    (GQA) — first visit (r==0, qi==0) zeroes the accumulators, every visit
+    adds, the last (r==rep-1, qi==nq-1) writes out. rep==1 is MHA."""
     if has_pos:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          qpos_ref, kpos_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -342,10 +358,12 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, window, has_pos):
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
         qpos_ref = kpos_ref = None
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    r = pl.program_id(2)
+    qi = pl.program_id(3)
+    rep = pl.num_programs(2)
+    nq = pl.num_programs(3)
 
-    @pl.when(qi == 0)
+    @pl.when((qi == 0) & (r == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -388,7 +406,7 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, window, has_pos):
     else:
         pl.when(needed)(_body)
 
-    @pl.when(qi == nq - 1)
+    @pl.when((qi == nq - 1) & (r == rep - 1))
     def _fin():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -398,6 +416,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, q_pos=None, kv_pos=None, *, causal,
          scale, block_q, block_k, window, interpret, dlse=None):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
+    rep = BH // k3.shape[0]  # GQA group size (see _fwd); 1 = MHA
     nq, nk = Sq // block_q, Sk // block_k
     has_pos = q_pos is not None
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
@@ -410,8 +429,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, q_pos=None, kv_pos=None, *, causal,
 
     dq_in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // rep, j, 0)),
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -435,30 +454,32 @@ def _bwd(q3, k3, v3, o3, lse, do3, q_pos=None, kv_pos=None, *, causal,
         interpret=interpret,
     )(*dq_args)
 
+    # dK/dV grid (B·Hkv, nk, rep, nq): q-side rows for KV row b are
+    # b·rep + r — the inverse of the forward's b // rep map.
     dkv_in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, j, r, i: (b * rep + r, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, j, r, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, j, r, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, j, r, i: (b * rep + r, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, r, i: (b * rep + r, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, r, i: (b * rep + r, i, 0)),
     ]
     dkv_args = [q3, k3, v3, do3, lse, delta]
     if has_pos:
         dkv_in_specs += [
-            pl.BlockSpec((block_q, 1), lambda b, j, i: (i, 0)),
-            pl.BlockSpec((block_k, 1), lambda b, j, i: (j, 0)),
+            pl.BlockSpec((block_q, 1), lambda b, j, r, i: (i, 0)),
+            pl.BlockSpec((block_k, 1), lambda b, j, r, i: (j, 0)),
         ]
         dkv_args += [q_pos, kv_pos]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale, window=window,
                           has_pos=has_pos),
-        grid=(BH, nk, nq),
+        grid=(BH // rep, nk, rep, nq),
         in_specs=dkv_in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, r, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, r, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k3.shape, k3.dtype),
@@ -469,7 +490,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, q_pos=None, kv_pos=None, *, causal,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary"),
         ),
         interpret=interpret,
     )(*dkv_args)
@@ -509,15 +531,20 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False) -> jax.Array:
-    """(B, S, H, D) attention via the Pallas kernel. GQA callers must repeat
-    KV heads first (ops.attention does). ``window`` > 0 restricts each query
-    to its trailing ``window`` keys (requires causal — enforced upstream)."""
-    if q.shape[2] != k.shape[2] or k.shape != v.shape:
-        raise ValueError(
-            f"flash_attention needs pre-expanded KV heads: q {q.shape}, "
-            f"k {k.shape}, v {v.shape}"
-        )
+    """(B, S, H, D) attention via the Pallas kernel. GQA (Hkv < H,
+    H % Hkv == 0) is NATIVE: K/V stay at Hkv heads in HBM and the kernel's
+    BlockSpec index_map (q row b → KV row b // rep) shares each KV tile
+    across its query group — no expanded copy is ever materialised
+    (forward reads H/Hkv x less K/V bandwidth than an expand-first
+    design). ``window`` > 0 restricts each query to its trailing
+    ``window`` keys (requires causal — enforced upstream)."""
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H and (Hkv == 0 or H % Hkv != 0):
+        raise ValueError(
+            f"invalid GQA ratio: {H} query heads over {Hkv} KV heads")
     bq = min(block_q, S)
     bk = min(block_k, S)
     scale = float(1.0 / (D ** 0.5))

@@ -195,7 +195,7 @@ def test_dispatch_windowed_pallas_impl():
 
 
 def test_dispatch_pallas_impl_covers_gqa_expansion():
-    """impl='pallas' runs the real dispatch path (incl. KV expansion) in
+    """impl='pallas' runs the real dispatch path (GQA native in-kernel) in
     interpret mode on CPU — the CI seam for lines only a TPU would hit."""
     from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
 
@@ -209,10 +209,46 @@ def test_dispatch_pallas_impl_covers_gqa_expansion():
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_rejects_unexpanded_gqa():
+def test_flash_native_gqa_matches_expanded_reference():
+    """GQA without HBM expansion (r4 kernel follow-up): the kernel's
+    b // rep KV index_map must reproduce the expand-first math exactly —
+    forward AND all three grads (dK/dV accumulate over the rep query
+    heads sharing each KV tile via the revisit grid axis)."""
+    rep = 2
+    q, _, _ = _make_qkv(B=2, S=256, H=4, D=64, seed=5)
+    _, k, v = _make_qkv(B=2, S=256, H=2, D=64, seed=7)
+
+    def expand(x):
+        return jnp.repeat(x, rep, axis=2)
+
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = _xla(q, expand(k), expand(v), causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        # expansion INSIDE the loss → grad wrt the unexpanded k/v is the
+        # group-sum of the expanded grads, exactly what native GQA owes
+        return jnp.sum(_xla(q, expand(k), expand(v), True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-3,
+            err_msg=f"d{name} mismatch (native GQA)",
+        )
+
+
+def test_flash_rejects_invalid_gqa_ratio():
     q, _, _ = _make_qkv(B=1, S=256, H=4, D=64)
-    _, k, v = _make_qkv(B=1, S=256, H=2, D=64)
-    with pytest.raises(ValueError, match="pre-expanded"):
+    _, k, v = _make_qkv(B=1, S=256, H=3, D=64)
+    with pytest.raises(ValueError, match="GQA ratio"):
         flash_attention(q, k, v, interpret=True)
 
 
