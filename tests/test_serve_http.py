@@ -449,3 +449,108 @@ def test_n_explicit_default_penalties_keep_shared_prefill(server):
     assert last["preloads"] - after["preloads"] == 0
     assert last["forks"] - after["forks"] == 0
     assert last["prefills"] - after["prefills"] == 2
+
+
+def _post_chat(port, obj, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_chat_completions_openai_shape(server):
+    """/v1/chat/completions: OpenAI schema in, chat.completion out, and
+    the (template-less byte tokenizer) rendering equals the documented
+    ChatML-ish fallback posted to /v1/completions."""
+    port, _, _, tok = server
+    messages = [{"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hi there"}]
+    _, out = _post_chat(port, {"messages": messages, "max_tokens": 6})
+    assert out["object"] == "chat.completion"
+    (choice,) = out["choices"]
+    assert choice["index"] == 0
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert choice["finish_reason"] in ("length", "eos", "stop")
+    assert out["usage"]["completion_tokens"] <= 6
+
+    # parity with the raw endpoint under the documented fallback render
+    import serve_http
+
+    rendered = serve_http.render_chat(messages, tok)
+    assert rendered.endswith("<|assistant|>\n")
+    _, raw = _post(port, {"prompt": rendered, "max_tokens": 6})
+    assert raw["text"] == choice["message"]["content"]
+
+
+def test_chat_completions_n_and_validation(server):
+    port, *_ = server
+    _, out = _post_chat(port, {
+        "messages": [{"role": "user", "content": "sample"}],
+        "max_tokens": 4, "temperature": 1.1, "n": 2})
+    assert out["object"] == "chat.completion"
+    assert [c["index"] for c in out["choices"]] == [0, 1]
+    assert all(c["message"]["role"] == "assistant"
+               for c in out["choices"])
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_chat(port, {"messages": [
+            {"role": "user", "content": "x"}], "keep": True})
+    assert e.value.code == 400  # stateless endpoint
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_chat(port, {"messages": []})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_chat(port, {"messages": [
+            {"role": "narrator", "content": "x"}]})
+    assert e.value.code == 400
+
+
+def test_chat_completions_stream_chunks(server):
+    """Streaming chat emits OpenAI chat.completion.chunk deltas whose
+    concatenation equals the non-streamed content, ending with a
+    finish_reason chunk and [DONE]."""
+    port, *_ = server
+    messages = [{"role": "user", "content": "stream me"}]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps({"messages": messages, "max_tokens": 5,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        raw = r.read().decode()
+    datas = [json.loads(ln[6:]) for ln in raw.splitlines()
+             if ln.startswith("data: ") and ln != "data: [DONE]"]
+    assert raw.rstrip().endswith("data: [DONE]")
+    assert all(d["object"] == "chat.completion.chunk" for d in datas)
+    text = "".join(d["choices"][0]["delta"].get("content", "")
+                   for d in datas)
+    finishes = [d["choices"][0]["finish_reason"] for d in datas]
+    assert finishes[-1] in ("length", "eos", "stop")
+    assert all(f is None for f in finishes[:-1])
+    _, plain = _post_chat(port, {"messages": messages, "max_tokens": 5})
+    assert text == plain["choices"][0]["message"]["content"]
+
+
+def test_render_chat_uses_hf_template_when_present():
+    """A tokenizer shipping a chat_template renders through it (the
+    model's canonical formatting), not the fallback."""
+    import serve_http
+
+    class FakeInner:
+        chat_template = "{% for m in messages %}...{% endfor %}"
+
+        def apply_chat_template(self, msgs, tokenize,
+                                add_generation_prompt):
+            assert not tokenize and add_generation_prompt
+            return "TPL:" + "|".join(m["role"] for m in msgs) + ":"
+
+    class FakeTok:
+        _tok = FakeInner()
+
+    out = serve_http.render_chat(
+        [{"role": "system", "content": "s"},
+         {"role": "user", "content": "u"}], FakeTok())
+    assert out == "TPL:system|user:"

@@ -37,6 +37,16 @@ API (JSON over POST, one object per request):
   (system prompt) once and park it; completions posted with
   ``prefix: <session>`` FORK it (the template survives, so one preload
   serves any number of requests).
+- ``POST /v1/chat/completions``: OpenAI chat schema — {messages:
+  [{role, content}...], max_tokens?, temperature?, n?, stop?, stream?,
+  logprobs?, penalties, logit_bias?} → {object: "chat.completion",
+  choices: [{index, message: {role, content}, finish_reason}], usage}.
+  Messages render through the tokenizer's own chat template when it
+  ships one (HF ``apply_chat_template`` with the generation prompt),
+  else a ChatML-ish `<|role|>` fallback. Streaming emits OpenAI
+  ``chat.completion.chunk`` deltas. Stateless by definition (full
+  history per call) — keep/session/prefix are refused here; resident-KV
+  conversations live on ``/v1/completions``.
 - ``GET /healthz``: {status, stats} — liveness + batcher counters.
 
 Threading model: request handler threads (ThreadingHTTPServer) enqueue
@@ -62,6 +72,51 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
 
+
+
+def render_chat(messages, tok) -> str:
+    """OpenAI ``messages`` → prompt text. When the tokenizer ships a chat
+    template (HF tokenizers: ``chat_template``), rendering is the model's
+    own (apply_chat_template with the generation prompt appended) — an
+    OpenAI client pointed here gets the model's canonical formatting.
+    Otherwise a ChatML-ish fallback keeps the endpoint usable with the
+    byte tokenizer / template-less tokenizers (documented divergence:
+    role markers are `<|role|>` lines, not model-specific tokens)."""
+    msgs = []
+    for m in messages:
+        role, content = str(m["role"]), str(m["content"])
+        if role not in ("system", "user", "assistant", "tool"):
+            raise ValueError(f"unknown chat role {role!r}")
+        msgs.append({"role": role, "content": content})
+    if not msgs:
+        raise ValueError("messages must be non-empty")
+    inner = getattr(tok, "_tok", None)
+    if inner is not None and getattr(inner, "chat_template", None):
+        return inner.apply_chat_template(msgs, tokenize=False,
+                                         add_generation_prompt=True)
+    return "".join(f"<|{m['role']}|>\n{m['content']}\n" for m in msgs) \
+        + "<|assistant|>\n"
+
+
+def _chat_response(out: dict) -> dict:
+    """Completion-shaped service result → OpenAI chat.completion shape."""
+    if "choices" in out:  # complete_n already returns choices
+        choices = [{"index": i,
+                    "message": {"role": "assistant",
+                                "content": c["text"]},
+                    "finish_reason": c.get("finish_reason"),
+                    **({"logprobs": c["logprobs"]} if "logprobs" in c
+                       else {})}
+                   for i, c in enumerate(out["choices"])]
+    else:
+        choices = [{"index": 0,
+                    "message": {"role": "assistant",
+                                "content": out["text"]},
+                    "finish_reason": out.get("finish_reason"),
+                    **({"logprobs": out["logprobs"]}
+                       if "logprobs" in out else {})}]
+    return {"object": "chat.completion", "choices": choices,
+            "usage": out.get("usage", {})}
 
 
 def _find_stop(text: str, stops: list[str]):
@@ -503,13 +558,26 @@ def make_handler(service: BatcherService):
                 self._send(404, {"error": "unknown path"})
 
         def do_POST(self):
-            if self.path not in ("/v1/completions", "/v1/preload"):
+            if self.path not in ("/v1/completions", "/v1/preload",
+                                 "/v1/chat/completions"):
                 self._send(404, {"error": "unknown path"})
                 return
+            chat = self.path == "/v1/chat/completions"
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                prompt = str(req["prompt"])
+                if chat:
+                    # OpenAI chat is STATELESS (full history per call) —
+                    # the resident-KV session/prefix machinery belongs to
+                    # the completions endpoint.
+                    if any(k in req for k in ("keep", "session", "prefix")):
+                        raise ValueError(
+                            "chat/completions is stateless (full messages "
+                            "per call); keep/session/prefix live on "
+                            "/v1/completions")
+                    prompt = render_chat(req["messages"], service.tok)
+                else:
+                    prompt = str(req["prompt"])
                 if self.path == "/v1/preload":
                     self._send(200, {"session": service.preload(prompt)})
                     return
@@ -543,10 +611,11 @@ def make_handler(service: BatcherService):
                         raise ValueError(
                             "n > 1 composes with logprobs only (not "
                             "stream/keep/session/prefix/stop)")
-                    self._send(200, service.complete_n(
+                    out = service.complete_n(
                         prompt, max_tokens, temperature, n,
                         logprobs=bool(req.get("logprobs", False)),
-                        penalties=penalties))
+                        penalties=penalties)
+                    self._send(200, _chat_response(out) if chat else out)
                     return
                 if req.get("stream"):
                     if stop and keep:
@@ -560,7 +629,7 @@ def make_handler(service: BatcherService):
                         session=session, prefix=prefix,
                         penalties=penalties)
                     self._stream_sse(uid, chunks, stop=stop,
-                                     n_prompt=n_prompt)
+                                     n_prompt=n_prompt, chat=chat)
                     return
                 out = service.complete(prompt, max_tokens, temperature,
                                        keep=keep, session=session,
@@ -568,14 +637,15 @@ def make_handler(service: BatcherService):
                                        logprobs=bool(
                                            req.get("logprobs", False)),
                                        penalties=penalties)
-                self._send(200, out)
+                self._send(200, _chat_response(out) if chat else out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
             except (TimeoutError, RuntimeError) as e:
                 # RuntimeError: scheduler dead OR no slot for preload
                 self._send(503, {"error": str(e)})
 
-        def _stream_sse(self, uid, chunks, stop=None, n_prompt=0):
+        def _stream_sse(self, uid, chunks, stop=None, n_prompt=0,
+                        chat=False):
             """Server-sent events: one `data:` chunk per decode tick with
             the TEXT DELTA. Deltas come from re-decoding ALL tokens so
             far and holding back trailing replacement chars (an
@@ -593,6 +663,20 @@ def make_handler(service: BatcherService):
             self.end_headers()  # close-delimited body (HTTP/1.0 default)
 
             def emit(obj):
+                if chat and ("delta" in obj or "finish_reason" in obj):
+                    # OpenAI chat.completion.chunk shape; error events
+                    # pass through untranslated.
+                    obj = {
+                        "object": "chat.completion.chunk",
+                        "choices": [{
+                            "index": 0,
+                            "delta": ({"content": obj["delta"]}
+                                      if obj.get("delta") else {}),
+                            "finish_reason": obj.get("finish_reason"),
+                        }],
+                        **({"usage": obj["usage"]}
+                           if "usage" in obj else {}),
+                    }
                 self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
                 self.wfile.flush()
 
