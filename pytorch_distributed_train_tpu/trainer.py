@@ -350,17 +350,20 @@ class Trainer:
         return self.cfg.data.batch_size * self.cfg.data.seq_len  # tokens/step
 
     # ------------------------------------------------------------------ loop
-    def compile_report(self) -> dict:
+    def compile_report(self, batch_size: int | None = None) -> dict:
         """AOT-compile the train step (no step runs) and return the
         compiler's per-device memory accounting — the `--compile-only`
         "will this config fit" probe (the torch-world analogue is running
         a step and reading torch.cuda.memory_summary; XLA can answer
         before any step executes). Args/outputs alias through donation,
-        so resident ≈ args + temps. Backend caveat: XLA:CPU gives remat
+        so resident ≈ args + temps. ``batch_size`` overrides the config's
+        GLOBAL batch for this lowering only (the state and step function
+        are batch-shape-agnostic — find_batch_size re-lowers at many
+        sizes off one Trainer). Backend caveat: XLA:CPU gives remat
         regions distinct temp allocations (see tools/memfit_7b.py) — on
         CPU treat temps as an upper bound."""
         first = next(iter(self.train_loader.epoch(0)))
-        gb = self.cfg.data.batch_size
+        gb = batch_size or self.cfg.data.batch_size
         batch = {
             k: jax.ShapeDtypeStruct((gb,) + np.asarray(v).shape[1:],
                                     np.asarray(v).dtype)
@@ -370,7 +373,8 @@ class Trainer:
         compiled = self.train_step.lower(
             self.state, batch, self.step_rng).compile()
         out = {"compile_s": round(time.time() - t0, 1),
-               "n_devices": jax.device_count()}
+               "n_devices": jax.device_count(),
+               "global_batch": gb}
         try:
             ma = compiled.memory_analysis()
             out.update(
@@ -383,6 +387,68 @@ class Trainer:
         except Exception as e:  # pragma: no cover - backend-dependent
             out["memory_analysis_error"] = f"{type(e).__name__}: {e}"
         return out
+
+    def find_batch_size(self, budget_bytes: int | None = None,
+                        max_global: int = 1 << 20) -> dict:
+        """Largest fitting GLOBAL batch by AOT memory accounting (the
+        torch-world auto_scale_batch_size, but from the compiler instead
+        of OOM-probing real steps — no device memory is ever touched).
+
+        Doubles from the configured batch while the compiled step's
+        per-device resident bytes fit ``budget_bytes`` (default: the
+        device's reported memory limit), then bisects. Candidates stay
+        multiples of the mesh's batch-axis extent (data x fsdp) so every
+        probe is a shardable shape. Returns {fits: [...probes...],
+        best_global, best_per_chip, budget_bytes}; a config whose
+        CONFIGURED batch already exceeds the budget reports best 0."""
+        if budget_bytes is None:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            budget_bytes = stats.get("bytes_limit")
+            if not budget_bytes:
+                raise ValueError(
+                    "device reports no memory limit (CPU backend?) — "
+                    "pass an explicit budget (--hbm-gb)")
+        # Batch-axis extent from the BUILT mesh (config axes may be -1 =
+        # fill-with-remaining-devices).
+        unit = 1
+        for ax in ("data", "fsdp"):
+            unit *= max(self.mesh.shape.get(ax, 1), 1)
+
+        probes: list[dict] = []
+
+        def fits(gb: int) -> bool:
+            rep = self.compile_report(batch_size=gb)
+            rep["fits"] = (rep.get("resident_bytes", budget_bytes + 1)
+                           <= budget_bytes)
+            probes.append(rep)
+            if jax.process_index() == 0:
+                print(f"[find-batch-size] global={gb} resident="
+                      f"{rep.get('resident_bytes', -1) / 1024**3:.2f} GiB "
+                      f"budget={budget_bytes / 1024**3:.2f} GiB "
+                      f"fits={rep['fits']}", flush=True)
+            return rep["fits"]
+
+        base = max(self.cfg.data.batch_size // unit, 1) * unit
+        lo = 0
+        gb = base
+        while gb <= max_global and fits(gb):
+            lo, gb = gb, gb * 2
+        if lo == 0:  # configured batch itself does not fit
+            return {"budget_bytes": budget_bytes, "best_global": 0,
+                    "best_per_chip": 0, "probes": probes}
+        hi = gb  # known not to fit (or beyond max_global)
+        # bisect on multiples of `unit` in (lo, hi)
+        while hi - lo > unit:
+            mid = ((lo + hi) // 2) // unit * unit
+            if mid in (lo, hi):
+                break
+            if fits(mid):
+                lo = mid
+            else:
+                hi = mid
+        return {"budget_bytes": budget_bytes, "best_global": lo,
+                "best_per_chip": lo // max(jax.device_count(), 1),
+                "probes": probes}
 
     def fit(self, max_steps: int | None = None) -> TrainState:
         cfg = self.cfg

@@ -293,3 +293,37 @@ def test_compile_only_memory_report(tmp_path, capfd):
     assert rep["arg_bytes"] > 1_000_000  # resnet18 params + opt state
     assert rep["resident_bytes"] >= rep["arg_bytes"]
     assert "[train]" not in out  # no step ran
+
+
+def test_find_batch_size_bisects_to_budget(tmp_path, capfd):
+    """--find-batch-size probes the largest fitting GLOBAL batch via AOT
+    memory accounting: doubles then bisects, never runs a step, honors
+    an explicit budget, and a budget below the model's own footprint
+    reports best 0 with rc 4."""
+    import json as json_mod
+
+    sys.path.insert(0, REPO)
+    import train
+
+    rc = train.main(["--config", "resnet18_cifar10", "--find-batch-size",
+                     "--hbm-gb", "1.0", *_overrides(tmp_path)])
+    assert rc == 0
+    out = capfd.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("{"))
+    rep = json_mod.loads(line)
+    assert rep["find_batch_size"] is True
+    assert rep["best_global"] > 0
+    assert rep["best_per_chip"] == rep["best_global"] // 8  # 8 fake devs
+    fits = {p["global_batch"]: p["fits"] for p in rep["probes"]}
+    # monotone law: everything <= best fits, anything probed above fails
+    assert all(f for g, f in fits.items() if g <= rep["best_global"])
+    assert all(not f for g, f in fits.items() if g > rep["best_global"])
+    assert "[train]" not in out  # no step ran
+
+    # impossible budget: the configured batch itself does not fit
+    rc = train.main(["--config", "resnet18_cifar10", "--find-batch-size",
+                     "--hbm-gb", "0.0001", *_overrides(tmp_path)])
+    assert rc == 4
+    out = capfd.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("{"))
+    assert json_mod.loads(line)["best_global"] == 0

@@ -48,6 +48,16 @@ def parse_args(argv=None):
                         "compiler's per-device memory report (one JSON "
                         "line), then exit without training — the "
                         "'will this config fit' probe")
+    p.add_argument("--find-batch-size", action="store_true",
+                   help="AOT-probe the largest fitting GLOBAL batch "
+                        "(double then bisect on the compiler's per-device "
+                        "memory accounting; no step executes) and print "
+                        "one JSON line, then exit")
+    p.add_argument("--hbm-gb", type=float, default=0.0,
+                   help="with --find-batch-size: per-device memory budget "
+                        "in GiB (default: the device's reported limit; "
+                        "REQUIRED on CPU backends, whose temps are an "
+                        "upper bound — see tools/memfit_7b.py)")
     p.add_argument("--export-safetensors", default="", metavar="PATH",
                    help="restore the latest checkpoint (or init) and write "
                         "a torch-layout safetensors file, then exit "
@@ -133,6 +143,13 @@ def main(argv=None) -> int:
                           **report}), flush=True)
         trainer.close()
         return 0
+    if args.find_batch_size:
+        budget = int(args.hbm_gb * 1024**3) if args.hbm_gb else None
+        report = trainer.find_batch_size(budget_bytes=budget)
+        print(json.dumps({"find_batch_size": True, "preset": cfg.preset,
+                          **report}), flush=True)
+        trainer.close()
+        return 0 if report["best_global"] else 4
     if args.eval_only:
         if not (trainer.resumed or args.import_safetensors):
             print("[eval-only] ERROR: no checkpoint restored and no "
