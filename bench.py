@@ -497,12 +497,29 @@ def decode_bench(args) -> None:
     per_chip = bpc * (new_tokens - 1) / wall
     suffix = (f"_{args.quantize}" if args.quantize else "") + (
         "_tiny" if args.tiny else "")
-    _emit({
+    record = {
         "metric": f"llama_decode{suffix}_tokens_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": 1.0,
-    })
+    }
+    # MBU — decode's utilization measure (bandwidth-bound, so MFU would
+    # mislead): bytes moved per token (weights/B + KV read at the run's
+    # average fill) over the chip's HBM peak. The quantization levers
+    # change the numerator exactly as documented (utils/flops.py).
+    from pytorch_distributed_train_tpu.utils import flops as flops_lib
+
+    wbytes = {"int8": 1.0, "int4": 0.5}.get(args.quantize, 2.0)
+    kvbytes = 1.0 if args.kv_cache_dtype.startswith("float8") else 2.0
+    bpt = flops_lib.decode_bytes_per_token(
+        model_cfg, batch=bpc, avg_position=prompt_len + new_tokens / 2,
+        weight_bytes_per_param=wbytes, kv_bytes_per_elt=kvbytes)
+    mbu = flops_lib.mbu_pct(per_chip, bpt,
+                            flops_lib.device_hbm_bandwidth())
+    record["model_mb_per_token"] = round(bpt / 1e6, 3)
+    if mbu is not None:
+        record["mbu_pct"] = round(mbu, 2)
+    _emit(record)
 
 
 def _llama_dims(tiny: bool) -> dict:

@@ -48,6 +48,36 @@ _PEAK_TFLOPS_BF16: tuple[tuple[str, float], ...] = (
     ("v2", 45.0),
 )
 
+# HBM bandwidth GB/s per chip (public spec sheets), same matching rules.
+# Decode is bandwidth-bound, so MBU — bytes actually moved per second
+# over this peak — is its utilization measure, as MFU is training's.
+_HBM_GBPS: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 1638.0),
+    ("v6e", 1638.0),
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v5p", 2765.0),
+    ("v5", 2765.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def device_hbm_bandwidth(device: Any = None) -> float | None:
+    """HBM bytes/sec of ``device`` (default jax.devices()[0]); None off-TPU."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    if getattr(device, "platform", "") != "tpu":
+        return None
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, gbps in _HBM_GBPS:
+        if sub in kind:
+            return gbps * 1e9
+    return None
+
 
 def device_peak_flops(device: Any = None) -> float | None:
     """bf16 peak FLOP/s of ``device`` (default: jax.devices()[0]), or
@@ -236,6 +266,51 @@ def train_flops_per_item(model_cfg, seq: int | None = None) -> float | None:
     """fwd + bwd FLOPs per item for one training step (3 x forward)."""
     fwd = fwd_flops_per_item(model_cfg, seq)
     return None if fwd is None else 3.0 * fwd
+
+
+def llama_param_count(cfg) -> float:
+    """Exact parameter count for models/llama.py's architecture (GQA,
+    SwiGLU, untied head; norms counted — they read like everything else)."""
+    d, m, h = cfg.hidden_size, cfg.mlp_dim, cfg.num_heads
+    hkv = cfg.num_kv_heads or h
+    dh = d // h
+    per_layer = (
+        d * d + d * d                 # q_proj + o_proj
+        + 2 * d * (hkv * dh)          # k_proj + v_proj
+        + 3 * d * m                   # SwiGLU gate/up/down
+        + 2 * d                       # two RMSNorm scales
+    )
+    return (cfg.num_layers * per_layer
+            + 2 * cfg.vocab_size * d  # embedding + untied head
+            + d)                      # final norm
+
+
+def decode_bytes_per_token(cfg, *, batch: int, avg_position: float,
+                           weight_bytes_per_param: float = 2.0,
+                           kv_bytes_per_elt: float = 2.0) -> float:
+    """HBM bytes a llama-family model must MOVE per generated token: the
+    full weight read amortized over the batch (every row shares one pass)
+    plus the row's own K/V cache read at ``avg_position`` fill. This is
+    the decode-side roofline denominator — tokens/sec x this, over the
+    chip's HBM bandwidth, is MBU. Weight/kv byte sizes parameterize the
+    quantization levers (int8 = 1, int4 = 0.5, fp8 kv = 1)."""
+    d, h = cfg.hidden_size, cfg.num_heads
+    hkv = cfg.num_kv_heads or h
+    dh = d // h
+    weights = llama_param_count(cfg) * weight_bytes_per_param / max(batch, 1)
+    kv_read = 2.0 * cfg.num_layers * hkv * dh * avg_position \
+        * kv_bytes_per_elt
+    return weights + kv_read
+
+
+def mbu_pct(tokens_per_sec_per_chip: float, bytes_per_token: float | None,
+            bandwidth: float | None) -> float | None:
+    """Model-bandwidth utilization %: moved bytes/sec over HBM peak."""
+    if not bytes_per_token or not bandwidth:
+        return None
+    if not math.isfinite(tokens_per_sec_per_chip):
+        return None
+    return 100.0 * tokens_per_sec_per_chip * bytes_per_token / bandwidth
 
 
 def mfu_pct(items_per_sec_per_chip: float, flops_per_item: float | None,

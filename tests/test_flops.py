@@ -219,3 +219,67 @@ class TestBenchGracefulDegrade:
         for metric, row in lkg["rows"].items():
             assert "per_sec" in metric
             assert row["value"] > 0 and row["measured"]
+
+
+class TestDecodeBandwidth:
+    """MBU accounting — decode's bandwidth-roofline counterpart of MFU."""
+
+    def _1b(self):
+        return ModelConfig(name="llama", vocab_size=32000, hidden_size=2048,
+                           num_layers=16, num_heads=16, num_kv_heads=16,
+                           mlp_dim=5504, max_seq_len=2048)
+
+    def test_llama_1b_param_count(self):
+        # layers: 4*2048^2 (q,k,v,o MHA) + 3*2048*5504 (SwiGLU) + 2*2048
+        # (norms); embed+head: 2*32000*2048; final norm 2048
+        expect = 16 * (4 * 2048**2 + 3 * 2048 * 5504 + 2 * 2048) \
+            + 2 * 32000 * 2048 + 2048
+        n = flops.llama_param_count(self._1b())
+        assert n == pytest.approx(expect, rel=1e-9)
+        assert 0.9e9 < n < 1.0e9  # the '~1B' bench model
+
+    def test_gqa_shrinks_kv_read_not_weights_much(self):
+        mha = self._1b()
+        import dataclasses
+
+        gqa = dataclasses.replace(mha, num_kv_heads=4)
+        b_mha = flops.decode_bytes_per_token(mha, batch=1, avg_position=1024)
+        b_gqa = flops.decode_bytes_per_token(gqa, batch=1, avg_position=1024)
+        kv_delta = 2.0 * 16 * (16 - 4) * 128 * 1024 * 2.0  # layers*(dHkv)*Dh*pos*2B
+        w_delta = 2.0 * 16 * 2 * 2048 * (2048 - 512)       # k+v proj params
+        assert b_mha - b_gqa == pytest.approx(kv_delta + w_delta, rel=1e-6)
+
+    def test_batch_amortizes_weights_only(self):
+        cfg = self._1b()
+        b1 = flops.decode_bytes_per_token(cfg, batch=1, avg_position=512)
+        b8 = flops.decode_bytes_per_token(cfg, batch=8, avg_position=512)
+        weights = flops.llama_param_count(cfg) * 2.0
+        assert b1 - b8 == pytest.approx(weights * (1 - 1 / 8), rel=1e-9)
+
+    def test_quant_levers_scale_bytes(self):
+        cfg = self._1b()
+        full = flops.decode_bytes_per_token(cfg, batch=1, avg_position=0)
+        int4 = flops.decode_bytes_per_token(
+            cfg, batch=1, avg_position=0, weight_bytes_per_param=0.5)
+        assert int4 == pytest.approx(full / 4)
+        kv_only_full = flops.decode_bytes_per_token(
+            cfg, batch=10**9, avg_position=1024)
+        kv_only_fp8 = flops.decode_bytes_per_token(
+            cfg, batch=10**9, avg_position=1024, kv_bytes_per_elt=1.0)
+        assert kv_only_fp8 == pytest.approx(kv_only_full / 2, rel=1e-3)
+
+    def test_bandwidth_table(self):
+        assert flops.device_hbm_bandwidth(
+            _FakeDevice("tpu", "TPU v5 lite")) == 819e9
+        assert flops.device_hbm_bandwidth(
+            _FakeDevice("tpu", "TPU v5p")) == 2765e9
+        assert flops.device_hbm_bandwidth(_FakeDevice("cpu", "cpu")) is None
+
+    def test_mbu_headline_sanity(self):
+        """The measured bs8 decode row (BASELINE.md queue: ~2k tok/s/chip
+        expected at 1B bf16) would read ~30% MBU-ish; pin only the
+        formula, not the prediction: 1 token/s at 1 byte/token over
+        1 B/s = 100%."""
+        assert flops.mbu_pct(1.0, 1.0, 1.0) == 100.0
+        assert flops.mbu_pct(1.0, None, 1.0) is None
+        assert flops.mbu_pct(1.0, 1.0, None) is None
