@@ -84,11 +84,15 @@ def supported(q, k, v, *, causal: bool, mask, window: int = 0) -> bool:
 
 def chunk_supported(q, k, v) -> bool:
     """Shape gate for :func:`flash_attention_chunk` (ring inner kernel):
-    KV heads pre-expanded, lane-aligned D, block-divisible LOCAL seq lens
-    (Sq is the device's Q shard, Sk the rotating chunk — they may differ)."""
+    GQA-or-MHA heads (Hkv divides H — native in-kernel sharing, r4),
+    lane-aligned D, block-divisible LOCAL seq lens (Sq is the device's Q
+    shard, Sk the rotating chunk — they may differ)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    if k.shape[2] != H or k.shape != v.shape:
+    Hkv = k.shape[2]
+    if k.shape != v.shape:
+        return False
+    if Hkv != H and (Hkv == 0 or H % Hkv != 0):
         return False
     if D not in (64, 128, 256):
         return False
@@ -597,7 +601,8 @@ def flash_attention_chunk(q, k, v, q_pos, kv_pos, *, causal: bool,
     """One Q shard against ONE K/V chunk with explicit global positions —
     the ring-attention inner step (ops/ring_attention.py).
 
-    q: (B, Sq, H, D); k/v: (B, Sk, H, D) pre-expanded; q_pos: (Sq,) i32;
+    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D) — GQA taken UNEXPANDED (the
+    in-kernel b // rep sharing, r4); q_pos: (Sq,) i32;
     kv_pos: (Sk,) i32 (traced — they rotate with the chunk).
     Returns (o, lse): o (B, Sq, H, D) fp32 normalized WITHIN the chunk,
     lse (B, H, Sq) fp32, NEG_INF on fully-masked rows — the contract
@@ -612,8 +617,9 @@ def flash_attention_chunk(q, k, v, q_pos, kv_pos, *, causal: bool,
     qp = q_pos.astype(jnp.int32).reshape(Sq, 1)
     kp = kv_pos.astype(jnp.int32).reshape(Sk, 1)
 
-    def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+    def to3(x):  # per-tensor head count: k/v stay at Hkv rows (GQA)
+        return x.transpose(0, 2, 1, 3).reshape(B * x.shape[2],
+                                               x.shape[1], D)
 
     o3, lse = _flash_chunk(to3(q), to3(k), to3(v), qp, kp, causal, scale,
                            (bq, bk), interpret, int(window))

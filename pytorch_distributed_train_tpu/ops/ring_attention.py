@@ -124,20 +124,16 @@ def ring_attention_local(
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     if chunk_impl == "pallas":
         from pytorch_distributed_train_tpu.ops import flash_attention as _fa
-        from pytorch_distributed_train_tpu.ops.cp_common import expand_kv_heads
 
-        # GQA expansion happens INSIDE the per-hop chunk, like the einsum
-        # path: the rotating chunks then carry Hkv (not H) heads over ICI —
-        # an H/Hkv reduction of ring traffic, the scarce resource here.
-        # The expansion itself is a local HBM broadcast the hop's compute
-        # hides, and autodiff transposes it to a segment-sum so dk/dv
-        # rotate at Hkv size in the backward too. (A further step —
-        # indexing kv blocks as h // rep inside the kernel — would also
-        # drop the local materialization; tracked as a kernel TODO.)
+        # The rotating chunks carry Hkv (not H) heads over ICI — an
+        # H/Hkv reduction of ring traffic, the scarce resource here —
+        # and since r4 the kernel takes them UNEXPANDED too (in-kernel
+        # b // rep KV sharing): no local HBM broadcast per hop, and the
+        # kernel's rep-axis dK/dV accumulation hands back Hkv-sized
+        # cotangents that rotate at Hkv size in the backward.
         def chunk(q_, k_, v_, qp, kp):
-            k_e, v_e = expand_kv_heads(k_, v_, H)
             return _fa.flash_attention_chunk(
-                q_, k_e, v_e, qp, kp, causal=causal, window=window,
+                q_, k_, v_, qp, kp, causal=causal, window=window,
                 interpret=interpret)
 
         chunk = jax.checkpoint(chunk)
