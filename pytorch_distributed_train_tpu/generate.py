@@ -157,7 +157,9 @@ def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0,
     generate()'), and serving.py's per-row sampler.
     ``temperature`` is a positive scalar OR an array broadcastable against
     ``logits`` (serving passes (B, 1) per-row temperatures); every entry
-    must be > 0. ``top_p`` in (0, 1) keeps the smallest sorted prefix
+    must be > 0. ``top_p``/``min_p`` likewise accept a scalar or a (B, 1)
+    per-row array (out-of-range array entries = disabled for that row).
+    ``top_p`` in (0, 1) keeps the smallest sorted prefix
     whose cumulative probability reaches top_p (a token survives iff the
     mass strictly BEFORE it is < top_p, so the argmax always survives).
     Boundary convention: when a prefix's mass lands EXACTLY on top_p the
@@ -174,22 +176,34 @@ def filter_logits(logits, temperature, top_k: int, top_p: float = 0.0,
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if 0.0 < top_p < 1.0:
+
+    # top_p / min_p accept a python float (static: disabled values skip
+    # the work entirely at trace time) OR a traced array broadcastable
+    # against (B, 1) — the serving batchers pass PER-ROW values, where
+    # out-of-range entries mean "disabled" and resolve to keep-all inside
+    # the graph (they can't prune the computation, only its result).
+    p_static = isinstance(top_p, (int, float))
+    if (not p_static) or 0.0 < top_p < 1.0:
         # Mask by SORTED INDEX, not by threshold value: ties at the
         # nucleus boundary (common in bf16 / int8-dequant logits) must not
         # widen the kept set beyond the prefix. Stable argsort breaks ties
         # by original position; the inverse permutation (argsort of the
         # ranks) scatters the sorted keep-mask back.
+        p_eff = top_p if p_static else jnp.where(
+            (top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
         srt_idx = jnp.argsort(-logits, axis=-1)
         srt = jnp.take_along_axis(logits, srt_idx, axis=-1)
         p_srt = jax.nn.softmax(srt, axis=-1)
         before = jnp.cumsum(p_srt, axis=-1) - p_srt  # exclusive cumsum
-        keep = jnp.take_along_axis(before < top_p,
+        keep = jnp.take_along_axis(before < p_eff,
                                    jnp.argsort(srt_idx, axis=-1), axis=-1)
         logits = jnp.where(keep, logits, -jnp.inf)
-    if 0.0 < min_p < 1.0:
+    m_static = isinstance(min_p, (int, float))
+    if (not m_static) or 0.0 < min_p < 1.0:
+        m_eff = min_p if m_static else jnp.where(
+            (min_p > 0.0) & (min_p < 1.0), min_p, 0.0)
         probs = jax.nn.softmax(logits, axis=-1)
-        floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        floor = m_eff * jnp.max(probs, axis=-1, keepdims=True)
         logits = jnp.where(probs >= floor, logits, -jnp.inf)
     return logits
 

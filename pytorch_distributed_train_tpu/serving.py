@@ -149,10 +149,10 @@ def _set_row_index(row_cache, pos):
         lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
 
 
-@partial(jax.jit, static_argnums=(9, 10, 11))
+@partial(jax.jit, static_argnums=(11,))
 def _sample_rows_penalized(logits, rng, temperature, counts, gen_counts,
-                           rep, pres, freq, bias, top_k: int, top_p: float,
-                           min_p: float = 0.0):
+                           rep, pres, freq, bias, top_p, min_p,
+                           top_k: int):
     """_sample_rows with per-row context penalties applied to the raw
     logits first (generate.apply_penalties — counts: prompt+generated
     for repetition; gen_counts: generated-only for the OpenAI additive
@@ -168,24 +168,26 @@ def _sample_rows_penalized(logits, rng, temperature, counts, gen_counts,
                                 frequency_penalty=freq) + bias
     greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
     f = filter_logits(penalized, jnp.maximum(temperature, 1e-6)[:, None],
-                      top_k, top_p, min_p)
+                      top_k, top_p[:, None], min_p[:, None])
     sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
     tok = jnp.where(temperature == 0.0, greedy, sampled)
     lp = jnp.take_along_axis(raw_logp, tok[:, None], axis=-1)[:, 0]
     return tok, lp
 
 
-@partial(jax.jit, static_argnums=(3, 4, 5))
-def _sample_rows(logits, rng, temperature, top_k: int, top_p: float,
-                 min_p: float = 0.0):
+@partial(jax.jit, static_argnums=(5,))
+def _sample_rows(logits, rng, temperature, top_p, min_p, top_k: int):
     """Per-row sampling: rows with temperature 0 are greedy, others sample
-    at their own temperature under shared static top-k/top-p/min-p. Also
-    returns each emitted token's log-probability under the RAW model
-    distribution (pre-temperature/filtering — comparable across requests
-    regardless of their sampling settings)."""
+    at their own temperature under PER-ROW top-p/min-p (traced (B,)
+    operands — OpenAI requests carry top_p, so it cannot be a static
+    recompile-per-value arg; out-of-range entries disable per row) and a
+    server-wide static top-k. Also returns each emitted token's
+    log-probability under the RAW model distribution (pre-temperature/
+    filtering — comparable across requests regardless of their sampling
+    settings)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     f = filter_logits(logits, jnp.maximum(temperature, 1e-6)[:, None],
-                      top_k, top_p, min_p)
+                      top_k, top_p[:, None], min_p[:, None])
     sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
     tok = jnp.where(temperature == 0.0, greedy, sampled)
     raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -216,6 +218,11 @@ class Request:
     # OpenAI logit_bias ({token_id: bias in [-100, 100]}), added to raw
     # logits after penalties, before the warpers.
     logit_bias: dict | None = None
+    # Per-request nucleus / min-p (OpenAI requests carry top_p): None →
+    # the batcher's server-wide default. Traced per-row operands — no
+    # recompile per value; top_k stays server-wide (static in the jit).
+    top_p: float | None = None
+    min_p: float | None = None
 
 
 @dataclasses.dataclass
@@ -320,6 +327,9 @@ class ContinuousBatcher:
         self._rep = np.ones(slots, np.float32)
         self._pres = np.zeros(slots, np.float32)
         self._freq = np.zeros(slots, np.float32)
+        # per-row nucleus/min-p (request override of the server default)
+        self._top_p = np.full(slots, self.top_p, np.float32)
+        self._min_p = np.full(slots, self.min_p, np.float32)
         self._counts = np.zeros((slots, self.model.vocab_size),
                                 np.float32)
         # generated-only counts: the OpenAI presence/frequency context
@@ -349,12 +359,17 @@ class ContinuousBatcher:
                repetition_penalty: float = 1.0,
                presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
-               logit_bias: dict | None = None) -> int:
+               logit_bias: dict | None = None,
+               top_p: float | None = None,
+               min_p: float | None = None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
         if repetition_penalty <= 0.0:
             raise ValueError("repetition_penalty must be > 0 (1.0 = off)")
+        for name, val in (("top_p", top_p), ("min_p", min_p)):
+            if val is not None and not 0.0 <= val <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {val}")
         if logit_bias:
             from pytorch_distributed_train_tpu.generate import (
                 validate_logit_bias,
@@ -396,7 +411,8 @@ class ContinuousBatcher:
                                   repetition_penalty=repetition_penalty,
                                   presence_penalty=presence_penalty,
                                   frequency_penalty=frequency_penalty,
-                                  logit_bias=logit_bias))
+                                  logit_bias=logit_bias,
+                                  top_p=top_p, min_p=min_p))
         return uid
 
     def preload(self, prompt) -> int:
@@ -522,6 +538,8 @@ class ContinuousBatcher:
         self._rep[r] = req.repetition_penalty
         self._pres[r] = req.presence_penalty
         self._freq[r] = req.frequency_penalty
+        self._top_p[r] = self.top_p if req.top_p is None else req.top_p
+        self._min_p[r] = self.min_p if req.min_p is None else req.min_p
         self._counts[r] = 0.0
         self._gen_counts[r] = 0.0
         self._bias[r] = 0.0
@@ -562,12 +580,16 @@ class ContinuousBatcher:
                 jnp.asarray([req.frequency_penalty], jnp.float32),
                 (jnp.asarray(self._bias[r:r + 1]) if req.logit_bias
                  else jnp.float32(0.0)),
-                self.top_k, self.top_p, self.min_p)
+                jnp.asarray(self._top_p[r:r + 1]),
+                jnp.asarray(self._min_p[r:r + 1]),
+                self.top_k)
         else:
             tok, lp = _sample_rows(
                 last_logits, step_rng,
                 jnp.asarray([req.temperature], jnp.float32),
-                self.top_k, self.top_p, self.min_p)
+                jnp.asarray(self._top_p[r:r + 1]),
+                jnp.asarray(self._min_p[r:r + 1]),
+                self.top_k)
         first = int(tok[0])
         if penalized:
             self._counts[r, first] += 1.0
@@ -592,6 +614,7 @@ class ContinuousBatcher:
         # row would keep routing EVERY step through the penalized sampler
         # (and its counts transfer) long after the request finished.
         self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
+        self._top_p[r], self._min_p[r] = self.top_p, self.min_p
         # Row cleared WITH the flag: a stale row would still ship (wrong)
         # whenever some other row keeps the penalized path engaged.
         self._bias[r] = 0.0
@@ -669,6 +692,7 @@ class ContinuousBatcher:
                 # the freed row would route every later step through the
                 # penalized sampler (and its counts transfer).
                 self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
+                self._top_p[r], self._min_p[r] = self.top_p, self.min_p
                 self._bias[r] = 0.0
                 self._has_bias[r] = False
                 return True
@@ -783,11 +807,13 @@ class ContinuousBatcher:
                 # two shapes total, both stable).
                 (jnp.asarray(self._bias) if self._has_bias.any()
                  else jnp.float32(0.0)),
-                self.top_k, self.top_p, self.min_p)
+                jnp.asarray(self._top_p), jnp.asarray(self._min_p),
+                self.top_k)
         else:
             nxt_dev, lp_dev = _sample_rows(
-                logits, step_rng, jnp.asarray(self._temp), self.top_k,
-                self.top_p, self.min_p)
+                logits, step_rng, jnp.asarray(self._temp),
+                jnp.asarray(self._top_p), jnp.asarray(self._min_p),
+                self.top_k)
         nxt, lps = np.asarray(nxt_dev), np.asarray(lp_dev)
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots

@@ -554,3 +554,20 @@ def test_render_chat_uses_hf_template_when_present():
         [{"role": "system", "content": "s"},
          {"role": "user", "content": "u"}], FakeTok())
     assert out == "TPL:system|user:"
+
+
+def test_http_per_request_top_p_accepted(server):
+    """top_p/min_p ride each request (OpenAI fields) — accepted on both
+    endpoints, validated in-band."""
+    port, *_ = server
+    _, out = _post(port, {"prompt": "nucleus", "max_tokens": 4,
+                          "temperature": 1.0, "top_p": 0.7})
+    assert out["finish_reason"] in ("length", "eos")
+    _, out = _post_chat(port, {
+        "messages": [{"role": "user", "content": "nucleus"}],
+        "max_tokens": 4, "temperature": 1.0, "top_p": 0.7,
+        "min_p": 0.02})
+    assert out["object"] == "chat.completion"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(port, {"prompt": "x", "max_tokens": 2, "top_p": 2.0})
+    assert e.value.code == 400

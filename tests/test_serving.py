@@ -655,3 +655,66 @@ def test_seq2seq_logit_bias_applies_to_first_token():
     u2 = b2.submit(src, 2, logit_bias={int(first): -100.0})
     first_banned = {c.uid: c for c in b2.run()}[u2].tokens[0]
     assert first_banned != first
+
+
+def test_filter_logits_array_matches_scalar_per_row():
+    """The per-row top_p/min_p array path must equal the scalar path
+    row-for-row (including disabled rows: out-of-range array entries =
+    keep-all, exactly what scalar 0.0 does at trace time)."""
+    from pytorch_distributed_train_tpu.generate import filter_logits
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((3, 32)), jnp.float32)
+    ps = [0.3, 0.9, 0.0]       # row 2: disabled
+    ms = [0.0, 0.05, 0.2]      # row 0: disabled
+    arr = filter_logits(
+        logits, 1.0, 0,
+        top_p=jnp.asarray(ps, jnp.float32)[:, None],
+        min_p=jnp.asarray(ms, jnp.float32)[:, None])
+    for i, (p, m) in enumerate(zip(ps, ms)):
+        ref = filter_logits(logits[i:i + 1], 1.0, 0, top_p=p, min_p=m)
+        np.testing.assert_array_equal(np.asarray(arr[i]),
+                                      np.asarray(ref[0]))
+
+
+def test_per_request_top_p_matches_server_wide(setup):
+    """A request carrying top_p must sample exactly as a batcher whose
+    SERVER-wide top_p is that value (same seed): the per-row operand is
+    the same law, just scoped to the request."""
+    cfg, params = setup
+    prompt = [5, 9, 2, 14]
+    rng = jax.random.PRNGKey(7)
+    b_server = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1,
+                                 top_p=0.5, rng=rng)
+    u1 = b_server.submit(prompt, 6, temperature=1.3)
+    t_server = {c.uid: c for c in b_server.run()}[u1].tokens
+    b_req = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1,
+                              rng=rng)
+    u2 = b_req.submit(prompt, 6, temperature=1.3, top_p=0.5)
+    t_req = {c.uid: c for c in b_req.run()}[u2].tokens
+    assert t_server == t_req
+
+    # and the override is per-REQUEST: the next (default) request on the
+    # same batcher is NOT nucleus-filtered (equals a no-top_p run)
+    b_plain = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1,
+                                rng=rng)
+    u3 = b_plain.submit(prompt, 6, temperature=1.3)
+    t_plain = {c.uid: c for c in b_plain.run()}[u3].tokens
+    u4 = b_req.submit(prompt, 6, temperature=1.3)
+    t_after = {c.uid: c for c in b_req.run()}[u4].tokens
+    # same batcher, fresh request, default settings — the row reset must
+    # have cleared the 0.5 override (rng advanced, so compare against a
+    # DISTRIBUTION property instead of exact tokens: the reset row uses
+    # keep-all filtering, which the law test above pins; here just assert
+    # the slot state went back to the server default)
+    assert float(b_req._top_p[0]) == b_req.top_p
+    assert t_plain is not None and t_after is not None
+
+
+def test_submit_validates_top_p_range(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    with pytest.raises(ValueError, match="top_p"):
+        b.submit([1, 2], 2, top_p=1.5)
+    with pytest.raises(ValueError, match="min_p"):
+        b.submit([1, 2], 2, min_p=-0.1)

@@ -24,9 +24,10 @@ API (JSON over POST, one object per request):
   prompt is then just the NEW turn — no resend of history). Sessions
   evict LRU under slot pressure (a resume then 404s in-band with
   finish_reason "session_evicted").
-  ``top_k``/``top_p``/``min_p`` are SERVER-wide flags (static jit args —
-  per-request values would recompile; temperature is the per-request
-  knob).
+  ``top_p``/``min_p`` are PER-REQUEST (traced per-row operands — the
+  OpenAI fields; out-of-range disables; server flags give the default);
+  ``top_k`` stays a SERVER-wide flag (a static jit arg — per-request
+  values would recompile).
   ``logprobs: true`` adds each generated token's log-probability under
   the raw model distribution. ``n: k`` returns k INDEPENDENT sampled
   completions as ``choices`` (the prompt prefills once — a temporary
@@ -597,7 +598,8 @@ def make_handler(service: BatcherService):
                 penalties = {
                     k: float(req[k])
                     for k in ("repetition_penalty", "presence_penalty",
-                              "frequency_penalty") if k in req
+                              "frequency_penalty", "top_p", "min_p")
+                    if k in req
                 }
                 if "logit_bias" in req:
                     # OpenAI convention: string token-id keys
