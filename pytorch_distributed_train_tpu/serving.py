@@ -267,8 +267,10 @@ class ContinuousBatcher:
     def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
                  params: Any, *, slots: int = 4, top_k: int = 0,
                  top_p: float = 0.0, min_p: float = 0.0, rng=None,
-                 min_bucket: int = 16, mesh=None):
-        self._init_common(params, slots, top_k, top_p, rng, min_p)
+                 min_bucket: int = 16, mesh=None,
+                 auto_prefix_min: int = 0):
+        self._init_common(params, slots, top_k, top_p, rng, min_p,
+                          auto_prefix_min)
         self.mesh = mesh
         self.model = build_serving_model(model_cfg, precision)
         # session resume ingests multi-token turns at per-row offsets
@@ -295,12 +297,17 @@ class ContinuousBatcher:
         return jax.device_put(zeros, _cache_shardings(self.mesh, shapes))
 
     def _init_common(self, params, slots, top_k, top_p, rng,
-                     min_p: float = 0.0) -> None:
+                     min_p: float = 0.0,
+                     auto_prefix_min: int = 0) -> None:
         self.params = params
         self.slots = slots
         self.top_k = top_k
         self.top_p = top_p
         self.min_p = min_p
+        # >0: submit() auto-forks from a preloaded template of >= this
+        # many tokens when it prefixes the prompt (explicit prefix= and
+        # sessions always win; 0 disables)
+        self.auto_prefix_min = auto_prefix_min
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def _build_buckets(self, cap: int, min_bucket: int) -> None:
@@ -347,9 +354,11 @@ class ContinuousBatcher:
         # the mask ever exposes it — same discipline as dead rows.
         self._parked: dict[int, tuple[int, int, int | None]] = {}
         self._parked_slots: set[int] = set()
+        # preload-template token registry (auto_prefix_min matching)
+        self._template_tokens: dict[int, list[int]] = {}
         self.stats = {"steps": 0, "prefills": 0, "preloads": 0,
                       "resumes": 0, "forks": 0, "generated_tokens": 0,
-                      "slot_token_slots": 0}
+                      "slot_token_slots": 0, "auto_prefix_hits": 0}
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, *,
@@ -387,6 +396,23 @@ class ContinuousBatcher:
         if session is not None and prefix is not None:
             raise ValueError("session= (consume) and prefix= (fork) are "
                              "mutually exclusive")
+        if (self.auto_prefix_min > 0 and session is None
+                and prefix is None):
+            # Automatic prefix cache: fork from the LONGEST still-parked
+            # preloaded template that strictly prefixes this prompt (the
+            # remainder must be non-empty — fork ingest needs a token).
+            # Kept sessions never match (only preload() registers), and
+            # explicit prefix=/session= win by the guard above.
+            best, best_len = None, 0
+            for sid, toks in self._template_tokens.items():
+                n = len(toks)
+                if (sid in self._parked and n >= self.auto_prefix_min
+                        and best_len < n < len(prompt)
+                        and prompt[:n] == toks):
+                    best, best_len = sid, n
+            if best is not None:
+                prefix, prompt = best, prompt[best_len:]
+                self.stats["auto_prefix_hits"] += 1
         ref = session if session is not None else prefix
         if ref is not None:
             if ref not in self._parked:
@@ -443,6 +469,10 @@ class ContinuousBatcher:
         self._next_uid += 1
         self._parked[sid] = (r, len(prompt), None)  # no unconsumed token
         self._parked_slots.add(r)
+        # token registry for auto_prefix_min matching (templates only —
+        # kept SESSIONS never auto-match: their content is a
+        # conversation, not a shared prefix)
+        self._template_tokens[sid] = list(prompt)
         return sid
 
     def _check_request(self, prompt_len: int, max_new_tokens: int) -> None:
@@ -649,6 +679,7 @@ class ContinuousBatcher:
             if force or sid not in queued:
                 r, _, _ = self._parked.pop(sid)
                 self._parked_slots.discard(r)
+                self._template_tokens.pop(sid, None)
                 return r
         return None
 
@@ -673,6 +704,7 @@ class ContinuousBatcher:
         if entry is None:
             return False
         self._parked_slots.discard(entry[0])
+        self._template_tokens.pop(sid, None)
         return True
 
     def cancel(self, uid: int) -> bool:

@@ -718,3 +718,75 @@ def test_submit_validates_top_p_range(setup):
         b.submit([1, 2], 2, top_p=1.5)
     with pytest.raises(ValueError, match="min_p"):
         b.submit([1, 2], 2, min_p=-0.1)
+
+
+def test_auto_prefix_forks_from_matching_template(setup):
+    """auto_prefix_min: a submit whose prompt starts with a preloaded
+    template's tokens forks from it automatically — output identical to
+    the explicit-prefix fork AND to the no-template full prefill (greedy),
+    with the prefill savings visible in stats."""
+    cfg, params = setup
+    system = [7, 3, 9, 11, 2, 5]
+    turn = [4, 8, 1]
+    b_plain = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    u0 = b_plain.submit(system + turn, 5)
+    ref = {c.uid: c for c in b_plain.run()}[u0].tokens
+
+    b_auto = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                               auto_prefix_min=4)
+    sid = b_auto.preload(system)
+    u1 = b_auto.submit(system + turn, 5)  # no explicit prefix=
+    got = {c.uid: c for c in b_auto.run()}[u1].tokens
+    assert got == ref
+    assert b_auto.stats["auto_prefix_hits"] == 1
+    assert b_auto.stats["forks"] == 1
+    assert sid in b_auto._parked  # template survives the fork
+
+
+def test_auto_prefix_respects_min_and_exact_match(setup):
+    cfg, params = setup
+    short = [7, 3]
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                          auto_prefix_min=4)
+    b.preload(short)
+    # template shorter than the threshold: no auto fork
+    u = b.submit(short + [4, 8], 3)
+    _ = {c.uid: c for c in b.run()}[u]
+    assert b.stats["auto_prefix_hits"] == 0
+    # prompt EXACTLY equal to a template: remainder would be empty —
+    # no auto fork (fork ingest needs a token), plain prefill instead
+    long = [7, 3, 9, 11, 2]
+    b2 = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                           auto_prefix_min=4)
+    b2.preload(long)
+    u2 = b2.submit(list(long), 3)
+    _ = {c.uid: c for c in b2.run()}[u2]
+    assert b2.stats["auto_prefix_hits"] == 0
+
+
+def test_auto_prefix_prefers_longest_template(setup):
+    cfg, params = setup
+    a = [7, 3, 9, 11]
+    ab = [7, 3, 9, 11, 2, 5, 13, 6]
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=3,
+                          auto_prefix_min=2)
+    b.preload(a)
+    sid_long = b.preload(ab)
+    u = b.submit(ab + [4, 8], 3)
+    done = {c.uid: c for c in b.run()}[u]
+    assert b.stats["auto_prefix_hits"] == 1
+    # longest match wins: the fork ingested only [4, 8] (2 tokens) on
+    # top of the 8-token template — visible via the trimmed prompt
+    assert done.prompt == [4, 8]
+    assert sid_long in b._parked
+
+
+def test_auto_prefix_off_by_default(setup):
+    cfg, params = setup
+    system = [7, 3, 9, 11, 2, 5]
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    b.preload(system)
+    u = b.submit(system + [4], 3)
+    _ = {c.uid: c for c in b.run()}[u]
+    assert b.stats["auto_prefix_hits"] == 0
+    assert b.stats["forks"] == 0

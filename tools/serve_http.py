@@ -37,7 +37,12 @@ API (JSON over POST, one object per request):
 - ``POST /v1/preload``: {prompt} → {session} — prefill a shared prefix
   (system prompt) once and park it; completions posted with
   ``prefix: <session>`` FORK it (the template survives, so one preload
-  serves any number of requests).
+  serves any number of requests). With ``--auto-prefix-min N`` the
+  server forks AUTOMATICALLY whenever a prompt starts with a preloaded
+  template of >= N tokens (longest match wins; explicit
+  ``prefix``/``session`` always take precedence) — preload once, then
+  every client that resends the system prompt verbatim gets the cached
+  prefill without knowing the feature exists.
 - ``POST /v1/chat/completions``: OpenAI chat schema — {messages:
   [{role, content}...], max_tokens?, temperature?, n?, stop?, stream?,
   logprobs?, penalties, logit_bias?} → {object: "chat.completion",
@@ -763,9 +768,11 @@ def build_service(args) -> BatcherService:
     params = load_params_for_serving(cfg, args.safetensors, args.quantize)
     cls = (Seq2SeqContinuousBatcher if cfg.model.name.startswith("t5")
            else ContinuousBatcher)
+    extra = ({} if cfg.model.name.startswith("t5")
+             else {"auto_prefix_min": args.auto_prefix_min})
     batcher = cls(cfg.model, cfg.precision, params, slots=args.slots,
                   top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
-                  rng=jax.random.PRNGKey(args.seed))
+                  rng=jax.random.PRNGKey(args.seed), **extra)
     return BatcherService(batcher, tok,
                           max_new_default=args.max_new_default)
 
@@ -785,6 +792,11 @@ def main(argv=None) -> int:
     p.add_argument("--min-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max-new-default", type=int, default=64)
+    p.add_argument("--auto-prefix-min", type=int, default=0,
+                   help="auto-fork completions from any PRELOADED "
+                        "template of >= N tokens that prefixes the "
+                        "prompt (0 = off); explicit prefix=/session= "
+                        "always win")
     p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
     args = p.parse_args(argv)
 
