@@ -149,10 +149,30 @@ def _set_row_index(row_cache, pos):
         lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
 
 
-@partial(jax.jit, static_argnums=(11,))
+def _row_keys(rng, seeds, ntok):
+    """Per-row sampling keys: seeded rows (seed >= 0) use their own
+    deterministic chain fold_in(PRNGKey(seed), tokens_generated) — output
+    reproducible regardless of batch composition or slot assignment;
+    unseeded rows fold the shared per-step key by row index."""
+    seeded = jax.vmap(
+        lambda s, n: jax.random.fold_in(
+            jax.random.PRNGKey(s.astype(jnp.uint32)), n)
+    )(seeds, ntok)
+    shared = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(seeds.shape[0]))
+    return jnp.where((seeds >= 0)[:, None], seeded, shared)
+
+
+def _sample_filtered(f, keys):
+    return jax.vmap(
+        lambda k, row: jax.random.categorical(k, row)
+    )(keys, f).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(13,))
 def _sample_rows_penalized(logits, rng, temperature, counts, gen_counts,
-                           rep, pres, freq, bias, top_p, min_p,
-                           top_k: int):
+                           rep, pres, freq, bias, top_p, min_p, seeds,
+                           ntok, top_k: int):
     """_sample_rows with per-row context penalties applied to the raw
     logits first (generate.apply_penalties — counts: prompt+generated
     for repetition; gen_counts: generated-only for the OpenAI additive
@@ -169,26 +189,28 @@ def _sample_rows_penalized(logits, rng, temperature, counts, gen_counts,
     greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
     f = filter_logits(penalized, jnp.maximum(temperature, 1e-6)[:, None],
                       top_k, top_p[:, None], min_p[:, None])
-    sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
+    sampled = _sample_filtered(f, _row_keys(rng, seeds, ntok))
     tok = jnp.where(temperature == 0.0, greedy, sampled)
     lp = jnp.take_along_axis(raw_logp, tok[:, None], axis=-1)[:, 0]
     return tok, lp
 
 
-@partial(jax.jit, static_argnums=(5,))
-def _sample_rows(logits, rng, temperature, top_p, min_p, top_k: int):
+@partial(jax.jit, static_argnums=(7,))
+def _sample_rows(logits, rng, temperature, top_p, min_p, seeds, ntok,
+                 top_k: int):
     """Per-row sampling: rows with temperature 0 are greedy, others sample
     at their own temperature under PER-ROW top-p/min-p (traced (B,)
     operands — OpenAI requests carry top_p, so it cannot be a static
-    recompile-per-value arg; out-of-range entries disable per row) and a
-    server-wide static top-k. Also returns each emitted token's
-    log-probability under the RAW model distribution (pre-temperature/
-    filtering — comparable across requests regardless of their sampling
-    settings)."""
+    recompile-per-value arg; out-of-range entries disable per row), with
+    PER-ROW keys (seeded requests reproduce independently of batch
+    composition — _row_keys) and a server-wide static top-k. Also returns
+    each emitted token's log-probability under the RAW model distribution
+    (pre-temperature/filtering — comparable across requests regardless of
+    their sampling settings)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     f = filter_logits(logits, jnp.maximum(temperature, 1e-6)[:, None],
                       top_k, top_p[:, None], min_p[:, None])
-    sampled = jax.random.categorical(rng, f, axis=-1).astype(jnp.int32)
+    sampled = _sample_filtered(f, _row_keys(rng, seeds, ntok))
     tok = jnp.where(temperature == 0.0, greedy, sampled)
     raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     lp = jnp.take_along_axis(raw_logp, tok[:, None], axis=-1)[:, 0]
@@ -223,6 +245,12 @@ class Request:
     # recompile per value; top_k stays server-wide (static in the jit).
     top_p: float | None = None
     min_p: float | None = None
+    # Per-request rng seed (OpenAI `seed`): a seeded request samples from
+    # its OWN key chain fold_in(PRNGKey(seed), tokens_generated_so_far),
+    # so its output is reproducible and INDEPENDENT of batch composition
+    # (what else is in flight, which slot it landed in). None → the
+    # batcher's shared per-step stream.
+    seed: int | None = None
 
 
 @dataclasses.dataclass
@@ -337,6 +365,8 @@ class ContinuousBatcher:
         # per-row nucleus/min-p (request override of the server default)
         self._top_p = np.full(slots, self.top_p, np.float32)
         self._min_p = np.full(slots, self.min_p, np.float32)
+        # per-row request seed (-1 = unseeded: shared per-step stream)
+        self._seed = np.full(slots, -1, np.int64)
         self._counts = np.zeros((slots, self.model.vocab_size),
                                 np.float32)
         # generated-only counts: the OpenAI presence/frequency context
@@ -370,7 +400,8 @@ class ContinuousBatcher:
                frequency_penalty: float = 0.0,
                logit_bias: dict | None = None,
                top_p: float | None = None,
-               min_p: float | None = None) -> int:
+               min_p: float | None = None,
+               seed: int | None = None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -438,7 +469,9 @@ class ContinuousBatcher:
                                   presence_penalty=presence_penalty,
                                   frequency_penalty=frequency_penalty,
                                   logit_bias=logit_bias,
-                                  top_p=top_p, min_p=min_p))
+                                  top_p=top_p, min_p=min_p,
+                                  seed=None if seed is None
+                                  else int(seed)))
         return uid
 
     def preload(self, prompt) -> int:
@@ -570,6 +603,7 @@ class ContinuousBatcher:
         self._freq[r] = req.frequency_penalty
         self._top_p[r] = self.top_p if req.top_p is None else req.top_p
         self._min_p[r] = self.min_p if req.min_p is None else req.min_p
+        self._seed[r] = -1 if req.seed is None else req.seed
         self._counts[r] = 0.0
         self._gen_counts[r] = 0.0
         self._bias[r] = 0.0
@@ -612,6 +646,8 @@ class ContinuousBatcher:
                  else jnp.float32(0.0)),
                 jnp.asarray(self._top_p[r:r + 1]),
                 jnp.asarray(self._min_p[r:r + 1]),
+                jnp.asarray(self._seed[r:r + 1]),
+                jnp.zeros(1, jnp.int32),  # first token: nothing generated
                 self.top_k)
         else:
             tok, lp = _sample_rows(
@@ -619,6 +655,8 @@ class ContinuousBatcher:
                 jnp.asarray([req.temperature], jnp.float32),
                 jnp.asarray(self._top_p[r:r + 1]),
                 jnp.asarray(self._min_p[r:r + 1]),
+                jnp.asarray(self._seed[r:r + 1]),
+                jnp.zeros(1, jnp.int32),
                 self.top_k)
         first = int(tok[0])
         if penalized:
@@ -645,6 +683,7 @@ class ContinuousBatcher:
         # (and its counts transfer) long after the request finished.
         self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
         self._top_p[r], self._min_p[r] = self.top_p, self.min_p
+        self._seed[r] = -1
         # Row cleared WITH the flag: a stale row would still ship (wrong)
         # whenever some other row keeps the penalized path engaged.
         self._bias[r] = 0.0
@@ -725,6 +764,7 @@ class ContinuousBatcher:
                 # penalized sampler (and its counts transfer).
                 self._rep[r], self._pres[r], self._freq[r] = 1.0, 0.0, 0.0
                 self._top_p[r], self._min_p[r] = self.top_p, self.min_p
+                self._seed[r] = -1
                 self._bias[r] = 0.0
                 self._has_bias[r] = False
                 return True
@@ -821,6 +861,9 @@ class ContinuousBatcher:
         # dead row).
         logits = self._decode(jnp.asarray(self._pending)[:, None])
         self.rng, step_rng = jax.random.split(self.rng)
+        # seeded rows' key chain advances by GENERATED count (inactive
+        # rows' stale counts are harmless — their draws are discarded)
+        ntok = jnp.asarray([len(g) for g in self._generated], jnp.int32)
         any_penalized = (np.any(self._rep != 1.0)
                          or np.any(self._pres != 0.0)
                          or np.any(self._freq != 0.0)
@@ -840,11 +883,13 @@ class ContinuousBatcher:
                 (jnp.asarray(self._bias) if self._has_bias.any()
                  else jnp.float32(0.0)),
                 jnp.asarray(self._top_p), jnp.asarray(self._min_p),
+                jnp.asarray(self._seed), ntok,
                 self.top_k)
         else:
             nxt_dev, lp_dev = _sample_rows(
                 logits, step_rng, jnp.asarray(self._temp),
                 jnp.asarray(self._top_p), jnp.asarray(self._min_p),
+                jnp.asarray(self._seed), ntok,
                 self.top_k)
         nxt, lps = np.asarray(nxt_dev), np.asarray(lp_dev)
         self.stats["steps"] += 1

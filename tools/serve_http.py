@@ -27,7 +27,11 @@ API (JSON over POST, one object per request):
   ``top_p``/``min_p`` are PER-REQUEST (traced per-row operands — the
   OpenAI fields; out-of-range disables; server flags give the default);
   ``top_k`` stays a SERVER-wide flag (a static jit arg — per-request
-  values would recompile).
+  values would recompile). ``seed`` (OpenAI field) makes a sampled
+  request REPRODUCIBLE independent of batch composition: seeded rows
+  draw from their own fold_in(PRNGKey(seed), n_generated) chain, so the
+  same request returns the same tokens no matter what else is in
+  flight.
   ``logprobs: true`` adds each generated token's log-probability under
   the raw model distribution. ``n: k`` returns k INDEPENDENT sampled
   completions as ``choices`` (the prompt prefills once — a temporary
@@ -606,6 +610,10 @@ def make_handler(service: BatcherService):
                               "frequency_penalty", "top_p", "min_p")
                     if k in req
                 }
+                if "seed" in req and req["seed"] is not None:
+                    # OpenAI `seed`: reproducible sampling independent of
+                    # batch composition (per-row key chain in serving)
+                    penalties["seed"] = int(req["seed"])
                 if "logit_bias" in req:
                     # OpenAI convention: string token-id keys
                     penalties["logit_bias"] = {
