@@ -247,3 +247,32 @@ def test_pipeline_compiles_without_involuntary_remat(devices8, capfd):
     assert np.isfinite(float(metrics["loss"]))
     err = capfd.readouterr().err
     assert "Involuntary full rematerialization" not in err, err[-2000:]
+
+
+def test_interleaved_dense_packing_multi_group_odd_chunks(devices8):
+    """The r4 DENSE schedule packs groups with zero drain; this pins the
+    forward at a shape the original test never hits — C=3 chunks (6
+    layers over 2 stages) and G=3 groups (M=6 microbatches) — against
+    the sequential stack, so the residue/group index arithmetic
+    (rho = (t-s) mod S, g = (t-rho)//V, v = (t-rho) mod V) is exercised
+    across multiple group boundaries and odd laps."""
+    mesh_cfg = MeshConfig(stage=2, data=2, fsdp=2)
+    mesh = build_mesh(mesh_cfg, devices8)
+    cfg = ModelConfig(**{**TINY, "num_layers": 6},
+                      pipeline_schedule="interleaved",
+                      pipeline_chunks=3, pipeline_microbatches=6)
+    model = build_model(cfg, PrecisionConfig(), mesh=mesh, mesh_cfg=mesh_cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(9).integers(0, 64, (12, 16)), jnp.int32
+    )
+    variables = model.init({"params": jax.random.PRNGKey(1)}, ids)
+    p = dict(variables["params"])
+    p["blocks"] = jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[3:]), p.pop("blocks_csl")
+    )
+    with mesh:
+        out_pp = jax.jit(lambda v: model.apply(v, ids))(variables)
+        out_ref = jax.jit(
+            lambda v: _reference_logits(model, v, ids))({"params": p})
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_ref),
+                               atol=2e-5, rtol=2e-5)
