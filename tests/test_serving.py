@@ -830,3 +830,30 @@ def test_seed_with_greedy_is_inert(setup):
     u2 = b2.submit(prompt, 4)
     assert {c.uid: c for c in b1.run()}[u1].tokens == \
         {c.uid: c for c in b2.run()}[u2].tokens
+
+
+def test_seq2seq_seeded_request_reproduces(setup):
+    """seed/top_p thread through the shared admission path for the t5
+    batcher too — same seeded request, same output, different traffic."""
+    from pytorch_distributed_train_tpu.serving import (
+        Seq2SeqContinuousBatcher,
+    )
+
+    cfg = ModelConfig(name="t5", vocab_size=64, hidden_size=32,
+                      num_layers=2, decoder_layers=2, num_heads=4,
+                      mlp_dim=64, max_seq_len=32, dropout_rate=0.0)
+    params = build_model(cfg, PrecisionConfig()).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 6), jnp.int32), jnp.zeros((1, 2), jnp.int32),
+        train=False)["params"]
+    src = [5, 9, 12, 3]
+    b1 = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                                  rng=jax.random.PRNGKey(3))
+    u1 = b1.submit(src, 6, temperature=1.1, seed=11, top_p=0.9)
+    alone = {c.uid: c for c in b1.run()}[u1].tokens
+    b2 = Seq2SeqContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                                  rng=jax.random.PRNGKey(77))
+    b2.submit([8, 2, 4], 9, temperature=0.7)  # neighbor traffic
+    u2 = b2.submit(src, 6, temperature=1.1, seed=11, top_p=0.9)
+    busy = {c.uid: c for c in b2.run()}[u2].tokens
+    assert alone == busy
