@@ -270,3 +270,123 @@ def speculative_generate(model_cfg, precision, params,
             "tokens_per_round": (len(tokens) - S) / max(rounds, 1),
         }
     return out
+
+
+# --------------------------------------------------- prompt-lookup variant
+
+def propose_from_context(tokens: list[int], k: int, ngram: int) -> list[int] | None:
+    """Prompt-lookup proposal (vLLM's ngram speculator / HF
+    prompt_lookup_num_tokens): find the MOST RECENT earlier occurrence of
+    the trailing ``ngram`` tokens in the context and copy the k tokens
+    that followed it. Returns None when no earlier occurrence (with at
+    least one following token) exists. Host-side list matching — B=1 and
+    a few hundred tokens; the device never sees this."""
+    if len(tokens) <= ngram:
+        return None
+    tail = tokens[-ngram:]
+    # newest match first: repetitions late in the text predict better
+    for start in range(len(tokens) - ngram - 1, -1, -1):
+        if tokens[start:start + ngram] == tail:
+            follow = tokens[start + ngram:start + ngram + k]
+            if follow:
+                # pad a short window by repeating its last token — the
+                # verify pass prices k+1 tokens regardless, and wrong
+                # tails just reject
+                return follow + [follow[-1]] * (k - len(follow))
+    return None
+
+
+def prompt_lookup_generate(model_cfg, precision, params, prompt_ids,
+                           max_new_tokens: int, *, k: int = 4,
+                           ngram: int = 3, temperature: float = 0.0,
+                           top_k: int = 0, top_p: float = 0.0,
+                           min_p: float = 0.0, rng=None,
+                           eos_id: int | None = None,
+                           return_stats: bool = False):
+    """Draft-FREE speculative decoding: proposals come from n-gram
+    lookup over the sequence's own history instead of a draft model —
+    the regime where generation repeats its context (summarization,
+    code edits, RAG answers quoting sources) gets multi-token commits
+    for zero extra model cost.
+
+    Exactness: a lookup proposal is a POINT MASS, and the Leviathan
+    accept/resample rule with p_draft = one_hot(d_i) reduces to "accept
+    d_i with prob p_target(d_i), else resample from p_target with d_i
+    zeroed out" — still exactly the target-only law (the shared _accept
+    kernel is reused with one-hot draft rows). Greedy: accept while the
+    copied token IS the argmax; output equals generate() token-for-token.
+    Rounds with no match propose a repeat of the pending token — garbage
+    that rejects at position 0, making the round exactly a plain decode
+    step at the same bandwidth cost (the k+1-token verify reads the
+    weights once, like any step)."""
+    import dataclasses
+
+    target = build_decode_model(model_cfg, precision)
+    target_multi = dataclasses.replace(target, decode_multi=True)
+
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, S = prompt_ids.shape
+    if B != 1:
+        raise ValueError(
+            f"prompt-lookup decoding is B=1 (got B={B}); see "
+            "speculative_generate")
+    if ngram < 1 or k < 1:
+        raise ValueError(f"need ngram >= 1 and k >= 1, got {ngram}, {k}")
+    horizon = S + max_new_tokens + k + 1
+    if horizon > model_cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({S}) + new ({max_new_tokens}) + speculation margin "
+            f"({k + 1}) exceeds max_seq_len ({model_cfg.max_seq_len})")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    tokens = [int(t) for t in prompt_ids[0]]
+    t_cache = init_cache(target, 1)
+    if S > 1:
+        _, t_cache = _step_logits(target, params, t_cache,
+                                  prompt_ids[:, :-1])
+    produced = 0
+    rounds = accepted_total = matched_rounds = 0
+    V = model_cfg.vocab_size
+
+    while produced < max_new_tokens:
+        C = len(tokens) - 1  # committed-and-cached; tokens[-1] pending
+        proposal = propose_from_context(tokens, k, ngram)
+        if proposal is None:
+            proposal = [tokens[-1]] * k  # rejects at 0 → plain step
+        else:
+            matched_rounds += 1
+        draft_vec = jnp.asarray(proposal, jnp.int32)
+        p_draft = jax.nn.one_hot(draft_vec, V)  # point-mass "draft law"
+
+        v_in = jnp.concatenate(
+            [jnp.asarray([tokens[-1]], jnp.int32), draft_vec])[None, :]
+        t_logits, t_cache = _step_logits(
+            target_multi, params, t_cache, v_in)
+        rng, r = jax.random.split(rng)
+        n, nxt = _accept(r, draft_vec, p_draft, k, temperature, top_k,
+                         t_logits[0].astype(jnp.float32), top_p, min_p)
+        n = int(n)
+
+        new_tokens = [int(t) for t in draft_vec[:n]] + [int(nxt)]
+        tokens.extend(new_tokens)
+        produced += len(new_tokens)
+        rounds += 1
+        accepted_total += n
+        t_cache = _set_cache_index(t_cache, C + 1 + n)
+        if eos_id is not None and eos_id in new_tokens:
+            cut = len(tokens) - len(new_tokens) + new_tokens.index(eos_id)
+            tokens = tokens[: cut + 1]
+            break
+
+    tokens = tokens[: S + max_new_tokens]
+    if eos_id is not None and len(tokens) < S + max_new_tokens:
+        tokens += [eos_id] * (S + max_new_tokens - len(tokens))
+    out = jnp.asarray([tokens], jnp.int32)
+    if return_stats:
+        return out, {
+            "rounds": rounds,
+            "accept_rate": accepted_total / max(rounds * k, 1),
+            "tokens_per_round": (len(tokens) - S) / max(rounds, 1),
+            "match_rate": matched_rounds / max(rounds, 1),
+        }
+    return out

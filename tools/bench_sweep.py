@@ -52,6 +52,11 @@ ARMS: list[tuple[str, list[str]]] = [
     ("llama_spec_floor", ["--model", "llama", "--speculative", "4"]),
     ("llama_spec_ceiling", ["--model", "llama", "--speculative", "4",
                             "--spec-self"]),
+    ("llama_spec_plookup", ["--model", "llama", "--speculative", "4",
+                            "--prompt-lookup", "3"]),
+    ("llama_spec_plookup_periodic", ["--model", "llama", "--speculative",
+                                     "4", "--prompt-lookup", "3",
+                                     "--plookup-periodic"]),
     ("serve_mixed", ["--model", "llama", "--serve", "64"]),
     ("serve_chat_sessions", ["--model", "llama", "--serve", "32",
                              "--serve-turns", "4"]),
