@@ -605,7 +605,8 @@ def serve_bench(args) -> None:
                     for _ in range(turns - 1)] for _ in range(n_req)]
 
     def make_batcher():
-        return ContinuousBatcher(model_cfg, precision, params, slots=slots)
+        return ContinuousBatcher(model_cfg, precision, params, slots=slots,
+                                 spec_k=args.serve_spec)
 
     def run_prefix_workload(b) -> int:
         """Shared-system-prompt workload: every request = prefix_len
@@ -726,6 +727,8 @@ def serve_bench(args) -> None:
         arm = "_chat_resend" if args.serve_resend else "_chat"
     elif prefix_len:
         arm = "_prefix_resend" if args.serve_resend else "_prefix"
+    if args.serve_spec:
+        arm += f"_spec{args.serve_spec}"
     _emit({
         "metric": f"llama_serve{arm}{suffix}_tokens_per_sec_per_chip",
         "value": round(total / wall, 2),
@@ -898,6 +901,11 @@ def main() -> None:
                    help="with --serve-turns/--serve-prefix: re-prefill "
                         "instead of resuming/forking (the no-cache "
                         "baseline the session/prefix arms beat)")
+    p.add_argument("--serve-spec", type=int, default=0, metavar="K",
+                   help="with --serve: prompt-lookup speculative serving "
+                        "(K proposals per row per step; random-token "
+                        "workloads measure the overhead floor — real "
+                        "text with repetition measures the win)")
     p.add_argument("--serve-prefix", type=int, default=0, metavar="LEN",
                    help="with --serve: all requests share a LEN-token "
                         "system prompt, served via ONE preloaded "

@@ -149,6 +149,98 @@ def _set_row_index(row_cache, pos):
         lambda x: jnp.full_like(x, pos) if x.ndim == 1 else x, row_cache)
 
 
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _decode_multi_logits(model, params, cache, ids):
+    """Batched multi-token continuation returning ALL positions' logits
+    (B, S, V) — the speculative verify step (``_decode_step`` keeps only
+    the last position, which is all plain decode needs)."""
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
+    logits, updated = model.apply(
+        {"params": params, "cache": cache}, ids, train=False,
+        mutable=["cache"],
+    )
+    return logits, updated["cache"]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _set_row_indices(cache, idx_vec):
+    """Vector form of _set_row_index: pin EVERY row's position counters
+    (cache_index, gpt2's pos_index) to its own value — the per-row
+    speculative rollback (rows rewind to pending + accepted prefix;
+    parked/dead rows' values are don't-cares, same masking discipline as
+    free-running counters)."""
+    return jax.tree.map(
+        lambda x: idx_vec.astype(x.dtype) if x.ndim == 1 else x, cache)
+
+
+@partial(jax.jit, static_argnums=(8,))
+def _spec_verify_rows(logits, rng, temperature, drafts, top_p, min_p,
+                      seeds, ntok, top_k: int):
+    """Per-row prompt-lookup acceptance over a batched (B, k+1) verify.
+
+    logits: (B, k+1, V) — position j is the distribution AFTER ingesting
+    input column j (col 0 = the row's pending token, cols 1..k = the
+    draft proposals), so drafts[:, i] is scored by logits[:, i].
+    Point-mass draft law (speculative.prompt_lookup_generate): accept
+    d_i with prob p_t(d_i) (greedy rows: iff d_i is the argmax), residual
+    = p_t with d_i zeroed. Mixed greedy/sampled rows resolve by traced
+    temperature. Returns (n, nxt, d_logp, nxt_logp): accepted count
+    (B,), the resample/bonus token (B,), and RAW-distribution logprobs
+    for the drafts (B, k) and nxt (B,) — the logprobs contract matches
+    the plain samplers."""
+    B, k1, V = logits.shape
+    k = k1 - 1
+    logits = logits.astype(jnp.float32)
+    raw_logp = jax.nn.log_softmax(logits, axis=-1)
+    t_choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+    greedy = (temperature == 0.0)
+
+    f = filter_logits(logits,
+                      jnp.maximum(temperature, 1e-6)[:, None, None],
+                      top_k, top_p[:, None, None], min_p[:, None, None])
+    p_t = jax.nn.softmax(f, axis=-1)
+    p_t_k = p_t[:, :k]
+    p_t_tok = jnp.take_along_axis(p_t_k, drafts[:, :, None],
+                                  axis=-1)[:, :, 0]  # (B, k)
+
+    keys = _row_keys(rng, seeds, ntok)
+    k3 = jax.vmap(lambda kk: jax.random.split(kk, 3))(keys)  # (B, 3, 2)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(k3[:, 0])
+    accept = jnp.where(greedy[:, None],
+                       t_choice[:, :k] == drafts,
+                       u < p_t_tok)
+    n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=-1), axis=-1)
+
+    row = jnp.minimum(n, k - 1)
+    p_rej = jnp.take_along_axis(
+        p_t_k, row[:, None, None], axis=1)[:, 0]  # (B, V)
+    d_rej = jnp.take_along_axis(drafts, row[:, None], axis=1)[:, 0]
+    residual = p_rej.at[jnp.arange(B), d_rej].set(0.0)
+    mass = jnp.sum(residual, axis=-1, keepdims=True)
+    residual = jnp.where(mass > 0, residual / jnp.maximum(mass, 1e-20),
+                         p_rej)
+    resampled = jax.vmap(
+        lambda kk, pr: jax.random.categorical(
+            kk, jnp.log(jnp.maximum(pr, 1e-30)))
+    )(k3[:, 1], residual).astype(jnp.int32)
+    bonus = jax.vmap(
+        lambda kk, pb: jax.random.categorical(
+            kk, jnp.log(jnp.maximum(pb, 1e-30)))
+    )(k3[:, 2], p_t[:, k]).astype(jnp.int32)
+    nxt_sampled = jnp.where(n < k, resampled, bonus)
+    nxt_greedy = jnp.take_along_axis(t_choice, n[:, None], axis=1)[:, 0]
+    nxt = jnp.where(greedy, nxt_greedy, nxt_sampled).astype(jnp.int32)
+
+    d_logp = jnp.take_along_axis(raw_logp[:, :k], drafts[:, :, None],
+                                 axis=-1)[:, :, 0]  # (B, k)
+    nxt_row = jnp.take_along_axis(raw_logp, n[:, None, None],
+                                  axis=1)[:, 0]  # (B, V)
+    nxt_logp = jnp.take_along_axis(nxt_row, nxt[:, None], axis=-1)[:, 0]
+    return n, nxt, d_logp, nxt_logp
+
+
 def _row_keys(rng, seeds, ntok):
     """Per-row sampling keys: seeded rows (seed >= 0) use their own
     deterministic chain fold_in(PRNGKey(seed), tokens_generated) — output
@@ -296,9 +388,25 @@ class ContinuousBatcher:
                  params: Any, *, slots: int = 4, top_k: int = 0,
                  top_p: float = 0.0, min_p: float = 0.0, rng=None,
                  min_bucket: int = 16, mesh=None,
-                 auto_prefix_min: int = 0):
+                 auto_prefix_min: int = 0,
+                 spec_k: int = 0, spec_ngram: int = 3):
         self._init_common(params, slots, top_k, top_p, rng, min_p,
                           auto_prefix_min)
+        # Prompt-lookup SPECULATIVE serving (opt-in): every batched step
+        # verifies k proposals per row copied from the row's own history
+        # (speculative.propose_from_context) in one (slots, k+1) forward
+        # — per-row acceptance, per-row cache rollback. The k+1-token
+        # verify reads the weights once, like a 1-token step, so rounds
+        # that accept are nearly free and rounds that reject cost a
+        # plain step. Exact-sampling law (point-mass drafts). Penalized/
+        # biased requests are refused while enabled (the accept kernel
+        # scores the plain filtered law).
+        if spec_k < 0 or (spec_k > 0 and spec_ngram < 1):
+            raise ValueError(
+                f"need spec_k >= 0 and spec_ngram >= 1, got "
+                f"{spec_k}, {spec_ngram}")
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
         self.mesh = mesh
         self.model = build_serving_model(model_cfg, precision)
         # session resume ingests multi-token turns at per-row offsets
@@ -336,6 +444,7 @@ class ContinuousBatcher:
         # many tokens when it prefixes the prompt (explicit prefix= and
         # sessions always win; 0 disables)
         self.auto_prefix_min = auto_prefix_min
+        self.spec_k = 0  # causal batcher may enable; seq2seq never
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def _build_buckets(self, cap: int, min_bucket: int) -> None:
@@ -410,6 +519,13 @@ class ContinuousBatcher:
         for name, val in (("top_p", top_p), ("min_p", min_p)):
             if val is not None and not 0.0 <= val <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {val}")
+        if getattr(self, "spec_k", 0) and (
+                repetition_penalty != 1.0 or presence_penalty != 0.0
+                or frequency_penalty != 0.0 or logit_bias):
+            raise ValueError(
+                "speculative serving (spec_k > 0) does not compose with "
+                "penalties/logit_bias — the accept kernel scores the "
+                "plain filtered law; disable spec_k or drop the fields")
         if logit_bias:
             from pytorch_distributed_train_tpu.generate import (
                 validate_logit_bias,
@@ -453,13 +569,20 @@ class ContinuousBatcher:
             _, pos, last_tok = self._parked[ref]
             # continuation ingests [last unconsumed token +] prompt
             extra = 0 if last_tok is None else 1
-            if pos + extra + len(prompt) + max_new_tokens > self.max_seq_len:
+            # spec margin: a verify step writes spec_k+1 entries from the
+            # row's position — without headroom the clamped dynamic
+            # update would silently corrupt the tail slots
+            margin = getattr(self, "spec_k", 0)
+            if (pos + extra + len(prompt) + max_new_tokens + margin
+                    > self.max_seq_len):
                 raise ValueError(
                     f"session at position {pos} + turn ({len(prompt)}) + "
-                    f"max_new_tokens ({max_new_tokens}) exceeds "
-                    f"max_seq_len ({self.max_seq_len})")
+                    f"max_new_tokens ({max_new_tokens}) + spec margin "
+                    f"({margin}) exceeds max_seq_len ({self.max_seq_len})")
         else:
-            self._check_request(len(prompt), max_new_tokens)
+            self._check_request(
+                len(prompt),
+                max_new_tokens + getattr(self, "spec_k", 0))
         uid = self._next_uid
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, max_new_tokens,
@@ -855,6 +978,8 @@ class ContinuousBatcher:
         active = self.active_slots
         if not active:
             return finished
+        if self.spec_k:
+            return finished + self._spec_step(active)
         # Rows needing >=1 more token feed their pending sampled token;
         # free rows feed token 0 and are ignored (their cache_index
         # free-runs — reset at the next admit, clamped writes stay in the
@@ -907,6 +1032,73 @@ class ContinuousBatcher:
             done = self._maybe_finish(r, tok)
             if done is not None:
                 finished.append(done)
+        return finished
+
+    def _spec_step(self, active: list[int]) -> list[Completion]:
+        """One prompt-lookup speculative round over all slots: per-row
+        n-gram proposals, ONE (slots, k+1) verify forward, per-row
+        acceptance and cache rollback. Commits 1..k+1 tokens per active
+        row; output law identical to the plain path (point-mass accept).
+        """
+        from pytorch_distributed_train_tpu.speculative import (
+            propose_from_context,
+        )
+
+        k = self.spec_k
+        finished: list[Completion] = []
+        props = np.zeros((self.slots, k), np.int32)
+        for r in active:
+            ctx = list(self._req[r].prompt) + self._generated[r]
+            p = propose_from_context(ctx, k, self.spec_ngram)
+            # no match → a known-reject proposal: the round degrades to
+            # exactly one committed token, a plain step's outcome
+            props[r] = p if p is not None else [int(self._pending[r])] * k
+        ids = np.concatenate([self._pending[:, None], props], axis=1)
+        logits, self.cache = _decode_multi_logits(
+            self._model_multi, self.params, self.cache, jnp.asarray(ids))
+        self.rng, step_rng = jax.random.split(self.rng)
+        ntok = jnp.asarray([len(g) for g in self._generated], jnp.int32)
+        n_dev, nxt_dev, dlp_dev, nlp_dev = _spec_verify_rows(
+            logits, step_rng, jnp.asarray(self._temp),
+            jnp.asarray(props), jnp.asarray(self._top_p),
+            jnp.asarray(self._min_p), jnp.asarray(self._seed), ntok,
+            self.top_k)
+        n_acc = np.asarray(n_dev)
+        nxt = np.asarray(nxt_dev)
+        d_lp = np.asarray(dlp_dev)
+        n_lp = np.asarray(nlp_dev)
+        self.stats["steps"] += 1
+        self.stats["slot_token_slots"] += self.slots * (k + 1)
+        self.stats["spec_rounds"] = self.stats.get("spec_rounds", 0) \
+            + len(active)
+        for r in active:
+            n_r = int(n_acc[r])
+            self.stats["spec_accepted"] = self.stats.get(
+                "spec_accepted", 0) + n_r
+            committed = [int(props[r, i]) for i in range(n_r)] \
+                + [int(nxt[r])]
+            lps = [float(d_lp[r, i]) for i in range(n_r)] \
+                + [float(n_lp[r])]
+            base = int(self._pos[r])
+            done = None
+            for i, (tok, lp) in enumerate(zip(committed, lps)):
+                self._generated[r].append(tok)
+                self._logprobs[r].append(lp)
+                # ingested = pending + accepted d_1..d_i (the token being
+                # committed is the NOT-ingested rider — same invariant as
+                # the plain step, so _maybe_finish's parking math holds)
+                self._pos[r] = base + 1 + i
+                self._pending[r] = tok
+                self.stats["generated_tokens"] += 1
+                done = self._maybe_finish(r, tok)
+                if done is not None:
+                    finished.append(done)
+                    break
+        # rewind every row's counters: the verify advanced them by k+1;
+        # live rows resume at pending + accepted, other rows don't care
+        # (dead rows reset at admit, parked rows re-pin at resume)
+        self.cache = _set_row_indices(
+            self.cache, jnp.asarray(self._pos, jnp.int32))
         return finished
 
     def run(self):

@@ -58,6 +58,8 @@ ARMS: list[tuple[str, list[str]]] = [
                                      "4", "--prompt-lookup", "3",
                                      "--plookup-periodic"]),
     ("serve_mixed", ["--model", "llama", "--serve", "64"]),
+    ("serve_mixed_spec", ["--model", "llama", "--serve", "64",
+                          "--serve-spec", "4"]),
     ("serve_chat_sessions", ["--model", "llama", "--serve", "32",
                              "--serve-turns", "4"]),
     ("serve_chat_resend", ["--model", "llama", "--serve", "32",

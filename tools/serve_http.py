@@ -777,7 +777,9 @@ def build_service(args) -> BatcherService:
     cls = (Seq2SeqContinuousBatcher if cfg.model.name.startswith("t5")
            else ContinuousBatcher)
     extra = ({} if cfg.model.name.startswith("t5")
-             else {"auto_prefix_min": args.auto_prefix_min})
+             else {"auto_prefix_min": args.auto_prefix_min,
+                   "spec_k": args.spec_k,
+                   "spec_ngram": args.spec_ngram})
     batcher = cls(cfg.model, cfg.precision, params, slots=args.slots,
                   top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
                   rng=jax.random.PRNGKey(args.seed), **extra)
@@ -805,6 +807,12 @@ def main(argv=None) -> int:
                         "template of >= N tokens that prefixes the "
                         "prompt (0 = off); explicit prefix=/session= "
                         "always win")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="prompt-lookup SPECULATIVE serving: verify K "
+                        "n-gram proposals per row per step (0 = off; "
+                        "refuses penalties/logit_bias requests while on)")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="with --spec-k: n-gram length for the lookup")
     p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
     args = p.parse_args(argv)
 
