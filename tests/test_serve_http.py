@@ -571,3 +571,50 @@ def test_http_per_request_top_p_accepted(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(port, {"prompt": "x", "max_tokens": 2, "top_p": 2.0})
     assert e.value.code == 400
+
+
+def test_http_over_speculative_batcher():
+    """The HTTP service runs unchanged over a spec-enabled batcher:
+    completions succeed (greedy = same law), penalized requests 400
+    in-band with the spec message."""
+    import threading as _threading
+    from http.server import ThreadingHTTPServer
+
+    import serve_http
+
+    cfg = ModelConfig(name="llama", vocab_size=300, hidden_size=32,
+                      num_layers=2, num_heads=4, num_kv_heads=4,
+                      mlp_dim=64, max_seq_len=96)
+    model = build_model(cfg, PrecisionConfig())
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    tok = load_tokenizer("")
+    batcher = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                                spec_k=3, spec_ngram=2)
+    service = serve_http.BatcherService(batcher, tok)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                serve_http.make_handler(service))
+    t = _threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    try:
+        _, out = _post(port, {"prompt": "abcabcabcabc", "max_tokens": 8})
+        assert out["finish_reason"] in ("length", "eos")
+        assert out["usage"]["completion_tokens"] <= 8
+        # plain batcher parity at temperature 0
+        plain = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+        u = plain.submit(tok.encode("abcabcabcabc"), 8,
+                         eos_id=tok.eos_id)
+        ref = {c.uid: c for c in plain.run()}[u]
+        from pytorch_distributed_train_tpu.serving import trim_at_eos
+
+        assert out["text"] == tok.decode(trim_at_eos(ref.tokens,
+                                                     tok.eos_id))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, {"prompt": "x y z", "max_tokens": 4,
+                         "repetition_penalty": 2.0})
+        assert e.value.code == 400
+        assert batcher.stats["spec_rounds"] >= 1
+    finally:
+        httpd.shutdown()
+        service.shutdown()
