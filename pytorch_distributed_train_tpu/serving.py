@@ -29,6 +29,7 @@ TPU-first shape discipline (SURVEY §7.4.5 — no dynamic shapes):
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from functools import partial
 from typing import Any
@@ -175,25 +176,29 @@ def _set_row_indices(cache, idx_vec):
         lambda x: idx_vec.astype(x.dtype) if x.ndim == 1 else x, cache)
 
 
-@partial(jax.jit, static_argnums=(8,))
-def _spec_verify_rows(logits, rng, temperature, drafts, top_p, min_p,
-                      seeds, ntok, top_k: int):
+def _spec_accept_core(raw_logits, eff_logits, rng, temperature, drafts,
+                      top_p, min_p, seeds, ntok, top_k: int):
     """Per-row prompt-lookup acceptance over a batched (B, k+1) verify.
 
-    logits: (B, k+1, V) — position j is the distribution AFTER ingesting
-    input column j (col 0 = the row's pending token, cols 1..k = the
-    draft proposals), so drafts[:, i] is scored by logits[:, i].
+    raw_logits: (B, k+1, V) — position j is the distribution AFTER
+    ingesting input column j (col 0 = the row's pending token, cols
+    1..k = the draft proposals), so drafts[:, i] is scored by position
+    i. eff_logits is the law actually sampled from — equal to
+    raw_logits on the plain path, penalty/bias-adjusted per position on
+    the penalized path (counts advance per accepted draft — the
+    cumulative one-hots in _spec_verify_rows_penalized).
     Point-mass draft law (speculative.prompt_lookup_generate): accept
-    d_i with prob p_t(d_i) (greedy rows: iff d_i is the argmax), residual
-    = p_t with d_i zeroed. Mixed greedy/sampled rows resolve by traced
-    temperature. Returns (n, nxt, d_logp, nxt_logp): accepted count
-    (B,), the resample/bonus token (B,), and RAW-distribution logprobs
-    for the drafts (B, k) and nxt (B,) — the logprobs contract matches
-    the plain samplers."""
-    B, k1, V = logits.shape
+    d_i with prob p_t(d_i) (greedy rows: iff d_i is the argmax of the
+    effective law), residual = p_t with d_i zeroed. Mixed greedy/
+    sampled rows resolve by traced temperature. Returns (n, nxt,
+    d_logp, nxt_logp): accepted count (B,), the resample/bonus token
+    (B,), and RAW-distribution logprobs for the drafts (B, k) and nxt
+    (B,) — the logprobs contract matches the plain samplers (raw
+    pre-penalty distribution, comparable across requests)."""
+    B, k1, V = raw_logits.shape
     k = k1 - 1
-    logits = logits.astype(jnp.float32)
-    raw_logp = jax.nn.log_softmax(logits, axis=-1)
+    raw_logp = jax.nn.log_softmax(raw_logits.astype(jnp.float32), axis=-1)
+    logits = eff_logits.astype(jnp.float32)
     t_choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
     greedy = (temperature == 0.0)
 
@@ -239,6 +244,57 @@ def _spec_verify_rows(logits, rng, temperature, drafts, top_p, min_p,
                                   axis=1)[:, 0]  # (B, V)
     nxt_logp = jnp.take_along_axis(nxt_row, nxt[:, None], axis=-1)[:, 0]
     return n, nxt, d_logp, nxt_logp
+
+
+@partial(jax.jit, static_argnums=(8,))
+def _spec_verify_rows(logits, rng, temperature, drafts, top_p, min_p,
+                      seeds, ntok, top_k: int):
+    """Plain-path speculative acceptance: effective law == raw law."""
+    return _spec_accept_core(logits, logits, rng, temperature, drafts,
+                             top_p, min_p, seeds, ntok, top_k)
+
+
+@partial(jax.jit, static_argnums=(14,))
+def _spec_verify_rows_penalized(logits, rng, temperature, drafts,
+                                counts, gen_counts, rep, pres, freq,
+                                bias, top_p, min_p, seeds, ntok,
+                                top_k: int):
+    """Speculative acceptance under per-row context penalties + logit
+    bias: the SAME adjustment the lockstep penalized sampler applies,
+    per verify position, with counts ADVANCED per accepted draft.
+
+    The subtlety: position i's target law must score a context in which
+    drafts 0..i-1 were already committed (that is the sequence the row
+    would have walked token-by-token). Cumulative one-hots of the draft
+    tokens shift both count tensors per position — positions past the
+    first rejection are dead (cumprod acceptance) so their laws being
+    "wrong about the future" is irrelevant, and the residual/bonus rows
+    (position n) see exactly the n accepted drafts. This makes greedy
+    penalized spec-serving token-for-token equal to penalized lockstep
+    decoding, and sampled rows exact w.r.t. the penalized law.
+
+    bias: scalar 0.0 (no biased row) or (B, V) — broadcast over the
+    k+1 verify positions (logit_bias is context-free, so it does not
+    advance)."""
+    from pytorch_distributed_train_tpu.generate import apply_penalties
+
+    B, k1, V = logits.shape
+    k = k1 - 1
+    oh = jax.nn.one_hot(drafts, V, dtype=jnp.float32)  # (B, k, V)
+    # cum[:, i] = one-hots of drafts 0..i-1 (position 0 sees none)
+    cum = jnp.concatenate(
+        [jnp.zeros((B, 1, V), jnp.float32),
+         jnp.cumsum(oh, axis=1)], axis=1)  # (B, k+1, V)
+    counts_i = counts[:, None, :] + cum
+    gen_i = gen_counts[:, None, :] + cum
+    eff = jax.vmap(
+        lambda lg, c, g: apply_penalties(
+            lg, c, gen_counts=g, repetition_penalty=rep,
+            presence_penalty=pres, frequency_penalty=freq),
+        in_axes=(1, 1, 1), out_axes=1)(logits, counts_i, gen_i)
+    eff = eff + (bias if jnp.ndim(bias) == 0 else bias[:, None, :])
+    return _spec_accept_core(logits, eff, rng, temperature, drafts,
+                             top_p, min_p, seeds, ntok, top_k)
 
 
 def _row_keys(rng, seeds, ntok):
@@ -307,6 +363,52 @@ def _sample_rows(logits, rng, temperature, top_p, min_p, seeds, ntok,
     raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     lp = jnp.take_along_axis(raw_logp, tok[:, None], axis=-1)[:, 0]
     return tok, lp
+
+
+def _ngram_build(ctx: list[int], ngram: int) -> dict:
+    """Index every ``ngram``-gram of ``ctx`` to its (latest, previous)
+    start positions. The incremental replacement for
+    speculative.propose_from_context's full backward rescan: the tail's
+    own occurrence is always the latest insert, so (latest, previous)
+    is exactly enough to answer "most recent occurrence STRICTLY before
+    the tail" — the rescan's semantics — in O(1)."""
+    idx: dict = {}
+    for i in range(len(ctx) - ngram + 1):
+        key = tuple(ctx[i:i + ngram])
+        prev = idx.get(key)
+        idx[key] = (i, None if prev is None else prev[0])
+    return idx
+
+
+def _ngram_append(ctx: list[int], idx: dict, tok: int,
+                  ngram: int) -> None:
+    """O(1) per committed token: append and index the one new ngram."""
+    ctx.append(tok)
+    if len(ctx) >= ngram:
+        i = len(ctx) - ngram
+        key = tuple(ctx[i:])
+        prev = idx.get(key)
+        idx[key] = (i, None if prev is None else prev[0])
+
+
+def _ngram_propose(ctx: list[int], idx: dict, ngram: int,
+                   k: int) -> list[int] | None:
+    """Index-backed prompt-lookup proposal — same result, token for
+    token, as speculative.propose_from_context(ctx, k, ngram), without
+    the O(context) rescan per row per round."""
+    if len(ctx) <= ngram:
+        return None
+    ent = idx.get(tuple(ctx[-ngram:]))
+    if ent is None:
+        return None
+    latest, prev = ent
+    pos = prev if latest == len(ctx) - ngram else latest
+    if pos is None:
+        return None
+    follow = ctx[pos + ngram: pos + ngram + k]
+    if not follow:
+        return None
+    return follow + [follow[-1]] * (k - len(follow))
 
 
 @dataclasses.dataclass
@@ -398,9 +500,10 @@ class ContinuousBatcher:
         # — per-row acceptance, per-row cache rollback. The k+1-token
         # verify reads the weights once, like a 1-token step, so rounds
         # that accept are nearly free and rounds that reject cost a
-        # plain step. Exact-sampling law (point-mass drafts). Penalized/
-        # biased requests are refused while enabled (the accept kernel
-        # scores the plain filtered law).
+        # plain step. Exact-sampling law (point-mass drafts), including
+        # penalized/biased rows: the penalized accept kernel advances
+        # the count context per accepted draft, so its output law is
+        # identical to the penalized lockstep path.
         if spec_k < 0 or (spec_k > 0 and spec_ngram < 1):
             raise ValueError(
                 f"need spec_k >= 0 and spec_ngram >= 1, got "
@@ -495,9 +598,22 @@ class ContinuousBatcher:
         self._parked_slots: set[int] = set()
         # preload-template token registry (auto_prefix_min matching)
         self._template_tokens: dict[int, list[int]] = {}
+        # speculative proposal context: per-slot token list (this
+        # request's prompt + generated) + its incremental ngram index
+        # (_ngram_build/_ngram_append) — maintained only when spec_k > 0
+        self._ctx: list[list[int]] = [[] for _ in range(slots)]
+        self._ngram_idx: list[dict] = [{} for _ in range(slots)]
+        # host_ms/device_ms: wall-clock split of the decode loop —
+        # host_ms is Python scheduling + proposal building + commit
+        # bookkeeping, device_ms the dispatch-to-materialization block
+        # (the np.asarray sync). admit_ms is the mixed admission span
+        # (queue handling + prefill compute). The split makes a
+        # host-bound serving loop (e.g. proposal scans at long
+        # contexts) visible instead of silently eroding throughput.
         self.stats = {"steps": 0, "prefills": 0, "preloads": 0,
                       "resumes": 0, "forks": 0, "generated_tokens": 0,
-                      "slot_token_slots": 0, "auto_prefix_hits": 0}
+                      "slot_token_slots": 0, "auto_prefix_hits": 0,
+                      "host_ms": 0.0, "device_ms": 0.0, "admit_ms": 0.0}
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, *,
@@ -519,13 +635,12 @@ class ContinuousBatcher:
         for name, val in (("top_p", top_p), ("min_p", min_p)):
             if val is not None and not 0.0 <= val <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {val}")
-        if getattr(self, "spec_k", 0) and (
-                repetition_penalty != 1.0 or presence_penalty != 0.0
-                or frequency_penalty != 0.0 or logit_bias):
+        if seed is not None and not 0 <= int(seed) < 2**32:
+            # _row_keys builds PRNGKey(seed mod 2^32): out-of-range seeds
+            # would silently alias (and negatives would collide with the
+            # internal -1 unseeded sentinel) — make it explicit instead.
             raise ValueError(
-                "speculative serving (spec_k > 0) does not compose with "
-                "penalties/logit_bias — the accept kernel scores the "
-                "plain filtered law; disable spec_k or drop the fields")
+                f"seed must be in [0, 2**32), got {seed}")
         if logit_bias:
             from pytorch_distributed_train_tpu.generate import (
                 validate_logit_bias,
@@ -544,12 +659,19 @@ class ContinuousBatcher:
             raise ValueError("session= (consume) and prefix= (fork) are "
                              "mutually exclusive")
         if (self.auto_prefix_min > 0 and session is None
-                and prefix is None):
+                and prefix is None and repetition_penalty == 1.0):
             # Automatic prefix cache: fork from the LONGEST still-parked
             # preloaded template that strictly prefixes this prompt (the
             # remainder must be non-empty — fork ingest needs a token).
             # Kept sessions never match (only preload() registers), and
             # explicit prefix=/session= win by the guard above.
+            # repetition_penalty != 1.0 BYPASSES the match: the rewrite
+            # truncates the request's penalty context to the remainder,
+            # so the same request would sample from different
+            # distributions depending on cache state (the nondeterminism
+            # force_full_prompt exists to avoid). Presence/frequency
+            # count generated tokens only and logit_bias is context-free
+            # — only repetition needs the bypass.
             best, best_len = None, 0
             for sid, toks in self._template_tokens.items():
                 n = len(toks)
@@ -610,16 +732,27 @@ class ContinuousBatcher:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
-        if len(prompt) >= self.max_seq_len:
+        # Spec headroom: _spec_step re-pins EVERY row (templates included)
+        # to _pos each round, so each verify writes spec_k+1 K/V entries
+        # starting AT the template's length — without this margin the
+        # clamped dynamic update would slide those garbage writes back
+        # INTO the template's real content.
+        margin = getattr(self, "spec_k", 0)
+        if len(prompt) + margin + 1 > self.max_seq_len:
             raise ValueError(
-                f"prompt ({len(prompt)}) exceeds max_seq_len "
-                f"({self.max_seq_len})")
+                f"prompt ({len(prompt)}) + spec margin ({margin + 1}) "
+                f"exceeds max_seq_len ({self.max_seq_len})")
         r = self._free_slot()
         if r is None:
             raise RuntimeError(
                 "no slot available for preload (all active or reserved "
                 "by sessions with queued continuations)")
         self._prefill_into(r, prompt)
+        # Host-side position mirrors the cache_index _prefill_into pinned:
+        # _spec_step's final _set_row_indices rewinds ALL rows to _pos, so
+        # a stale _pos here would rewind the template into its own content
+        # and every verify round would overwrite real K/V.
+        self._pos[r] = len(prompt)
         self.stats["preloads"] += 1  # a prefill that admits NO token
         sid = self._next_uid
         self._next_uid += 1
@@ -792,6 +925,13 @@ class ContinuousBatcher:
         self._pending[r] = first
         self._temp[r] = req.temperature
         self._pos[r] = pos
+        if self.spec_k:
+            # proposal context = THIS request's prompt + generated
+            # (resumed sessions' earlier turns live only as KV — same
+            # scope as the penalty context)
+            self._ctx[r] = list(req.prompt) + [first]
+            self._ngram_idx[r] = _ngram_build(self._ctx[r],
+                                              self.spec_ngram)
         return self._maybe_finish(r, first)
 
     def _maybe_finish(self, r: int, token: int) -> Completion | None:
@@ -930,6 +1070,7 @@ class ContinuousBatcher:
         requests into free slots (evicting the LRU parked session under
         pressure), then one batched decode step advancing every active
         slot by one token."""
+        t_admit = time.perf_counter()
         finished: list[Completion] = []
         fresh: deque[Request] = deque()
         while self.queue:
@@ -976,6 +1117,7 @@ class ContinuousBatcher:
             if done is not None:
                 finished.append(done)
         active = self.active_slots
+        self.stats["admit_ms"] += (time.perf_counter() - t_admit) * 1e3
         if not active:
             return finished
         if self.spec_k:
@@ -984,6 +1126,7 @@ class ContinuousBatcher:
         # free rows feed token 0 and are ignored (their cache_index
         # free-runs — reset at the next admit, clamped writes stay in the
         # dead row).
+        t_dev = time.perf_counter()
         logits = self._decode(jnp.asarray(self._pending)[:, None])
         self.rng, step_rng = jax.random.split(self.rng)
         # seeded rows' key chain advances by GENERATED count (inactive
@@ -1017,6 +1160,8 @@ class ContinuousBatcher:
                 jnp.asarray(self._seed), ntok,
                 self.top_k)
         nxt, lps = np.asarray(nxt_dev), np.asarray(lp_dev)
+        t_host = time.perf_counter()
+        self.stats["device_ms"] += (t_host - t_dev) * 1e3
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots
         for r in active:
@@ -1032,41 +1177,63 @@ class ContinuousBatcher:
             done = self._maybe_finish(r, tok)
             if done is not None:
                 finished.append(done)
+        self.stats["host_ms"] += (time.perf_counter() - t_host) * 1e3
         return finished
 
     def _spec_step(self, active: list[int]) -> list[Completion]:
         """One prompt-lookup speculative round over all slots: per-row
-        n-gram proposals, ONE (slots, k+1) verify forward, per-row
+        n-gram proposals from the incremental index (O(1) per row, not
+        an O(context) rescan), ONE (slots, k+1) verify forward, per-row
         acceptance and cache rollback. Commits 1..k+1 tokens per active
-        row; output law identical to the plain path (point-mass accept).
-        """
-        from pytorch_distributed_train_tpu.speculative import (
-            propose_from_context,
-        )
-
+        row; output law identical to the plain path (point-mass accept),
+        including penalized/biased rows (the penalized kernel advances
+        the count context per accepted draft)."""
         k = self.spec_k
         finished: list[Completion] = []
+        t_prop = time.perf_counter()
         props = np.zeros((self.slots, k), np.int32)
         for r in active:
-            ctx = list(self._req[r].prompt) + self._generated[r]
-            p = propose_from_context(ctx, k, self.spec_ngram)
+            p = _ngram_propose(self._ctx[r], self._ngram_idx[r],
+                               self.spec_ngram, k)
             # no match → a known-reject proposal: the round degrades to
             # exactly one committed token, a plain step's outcome
             props[r] = p if p is not None else [int(self._pending[r])] * k
+        t_dev = time.perf_counter()
+        self.stats["host_ms"] += (t_dev - t_prop) * 1e3
         ids = np.concatenate([self._pending[:, None], props], axis=1)
         logits, self.cache = _decode_multi_logits(
             self._model_multi, self.params, self.cache, jnp.asarray(ids))
         self.rng, step_rng = jax.random.split(self.rng)
         ntok = jnp.asarray([len(g) for g in self._generated], jnp.int32)
-        n_dev, nxt_dev, dlp_dev, nlp_dev = _spec_verify_rows(
-            logits, step_rng, jnp.asarray(self._temp),
-            jnp.asarray(props), jnp.asarray(self._top_p),
-            jnp.asarray(self._min_p), jnp.asarray(self._seed), ntok,
-            self.top_k)
+        any_penalized = (np.any(self._rep != 1.0)
+                         or np.any(self._pres != 0.0)
+                         or np.any(self._freq != 0.0)
+                         or np.any(self._has_bias))
+        if any_penalized:
+            # Penalty-free rows carry identity settings, so one batched
+            # penalized verify serves the mixed case (same routing rule
+            # as the plain step).
+            n_dev, nxt_dev, dlp_dev, nlp_dev = _spec_verify_rows_penalized(
+                logits, step_rng, jnp.asarray(self._temp),
+                jnp.asarray(props), jnp.asarray(self._counts),
+                jnp.asarray(self._gen_counts), jnp.asarray(self._rep),
+                jnp.asarray(self._pres), jnp.asarray(self._freq),
+                (jnp.asarray(self._bias) if self._has_bias.any()
+                 else jnp.float32(0.0)),
+                jnp.asarray(self._top_p), jnp.asarray(self._min_p),
+                jnp.asarray(self._seed), ntok, self.top_k)
+        else:
+            n_dev, nxt_dev, dlp_dev, nlp_dev = _spec_verify_rows(
+                logits, step_rng, jnp.asarray(self._temp),
+                jnp.asarray(props), jnp.asarray(self._top_p),
+                jnp.asarray(self._min_p), jnp.asarray(self._seed), ntok,
+                self.top_k)
         n_acc = np.asarray(n_dev)
         nxt = np.asarray(nxt_dev)
         d_lp = np.asarray(dlp_dev)
         n_lp = np.asarray(nlp_dev)
+        t_host = time.perf_counter()
+        self.stats["device_ms"] += (t_host - t_dev) * 1e3
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots * (k + 1)
         self.stats["spec_rounds"] = self.stats.get("spec_rounds", 0) \
@@ -1084,6 +1251,13 @@ class ContinuousBatcher:
             for i, (tok, lp) in enumerate(zip(committed, lps)):
                 self._generated[r].append(tok)
                 self._logprobs[r].append(lp)
+                _ngram_append(self._ctx[r], self._ngram_idx[r], tok,
+                              self.spec_ngram)
+                if any_penalized:
+                    # mirror of the kernel's cumulative count advance —
+                    # committed tokens join both penalty contexts
+                    self._counts[r, tok] += 1.0
+                    self._gen_counts[r, tok] += 1.0
                 # ingested = pending + accepted d_1..d_i (the token being
                 # committed is the NOT-ingested rider — same invariant as
                 # the plain step, so _maybe_finish's parking math holds)
@@ -1099,6 +1273,7 @@ class ContinuousBatcher:
         # (dead rows reset at admit, parked rows re-pin at resume)
         self.cache = _set_row_indices(
             self.cache, jnp.asarray(self._pos, jnp.int32))
+        self.stats["host_ms"] += (time.perf_counter() - t_host) * 1e3
         return finished
 
     def run(self):

@@ -575,8 +575,9 @@ def test_http_per_request_top_p_accepted(server):
 
 def test_http_over_speculative_batcher():
     """The HTTP service runs unchanged over a spec-enabled batcher:
-    completions succeed (greedy = same law), penalized requests 400
-    in-band with the spec message."""
+    completions succeed (greedy = same law), and penalized requests
+    pass through — the penalized accept kernel preserves the lockstep
+    law, so the OpenAI surface never degrades under --spec-k."""
     import threading as _threading
     from http.server import ThreadingHTTPServer
 
@@ -610,10 +611,17 @@ def test_http_over_speculative_batcher():
 
         assert out["text"] == tok.decode(trim_at_eos(ref.tokens,
                                                      tok.eos_id))
-        with pytest.raises(urllib.error.HTTPError) as e:
-            _post(port, {"prompt": "x y z", "max_tokens": 4,
-                         "repetition_penalty": 2.0})
-        assert e.value.code == 400
+        # Penalized request over the spec batcher: served, and
+        # token-identical to the penalized PLAIN batcher at greedy.
+        _, pen = _post(port, {"prompt": "x y x y x y x y", "max_tokens": 6,
+                              "repetition_penalty": 2.0})
+        assert pen["finish_reason"] in ("length", "eos")
+        plain2 = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+        u2 = plain2.submit(tok.encode("x y x y x y x y"), 6,
+                           eos_id=tok.eos_id, repetition_penalty=2.0)
+        ref2 = {c.uid: c for c in plain2.run()}[u2]
+        assert pen["text"] == tok.decode(trim_at_eos(ref2.tokens,
+                                                     tok.eos_id))
         assert batcher.stats["spec_rounds"] >= 1
     finally:
         httpd.shutdown()

@@ -781,6 +781,33 @@ def test_auto_prefix_prefers_longest_template(setup):
     assert sid_long in b._parked
 
 
+def test_auto_prefix_bypassed_for_repetition_penalty(setup):
+    """repetition_penalty != 1.0 skips the auto-prefix match: the
+    rewrite would truncate the penalty context to the remainder, so the
+    same request would sample differently depending on whether a
+    template happened to be parked. Presence/frequency (generated-only)
+    and logit_bias (context-free) still auto-fork."""
+    cfg, params = setup
+    system = [7, 3, 9, 11, 2, 5]
+    turn = [4, 8, 1, 4, 8, 1]
+    # ground truth: penalized full-prompt decode, no templates anywhere
+    b_ref = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+    u0 = b_ref.submit(system + turn, 6, repetition_penalty=1.7)
+    ref = {c.uid: c for c in b_ref.run()}[u0].tokens
+
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                          auto_prefix_min=4)
+    b.preload(system)
+    u1 = b.submit(system + turn, 6, repetition_penalty=1.7)
+    got = {c.uid: c for c in b.run()}[u1].tokens
+    assert got == ref  # identical law whether or not a template parked
+    assert b.stats["auto_prefix_hits"] == 0  # the match was bypassed
+    # generated-only penalties keep the optimization
+    u2 = b.submit(system + turn, 4, presence_penalty=0.5)
+    _ = {c.uid: c for c in b.run()}[u2]
+    assert b.stats["auto_prefix_hits"] == 1
+
+
 def test_auto_prefix_off_by_default(setup):
     cfg, params = setup
     system = [7, 3, 9, 11, 2, 5]
@@ -938,23 +965,182 @@ class TestSpeculativeServing:
         assert r3.tokens == r4.tokens
         assert r3.finish_reason == r4.finish_reason == "eos"
 
-    def test_spec_refuses_penalties(self, setup):
-        b = self._mk(setup, slots=1, spec_k=2)
-        with pytest.raises(ValueError, match="spec"):
-            b.submit([1, 2, 3], 4, repetition_penalty=1.5)
-        with pytest.raises(ValueError, match="spec"):
-            b.submit([1, 2, 3], 4, logit_bias={2: -100.0})
+    def test_penalized_spec_matches_penalized_plain(self, setup):
+        """Penalties/logit_bias COMPOSE with speculation: the penalized
+        accept kernel advances each row's count context per accepted
+        draft, so greedy outputs match the penalized plain batcher
+        token-for-token — including rounds that commit several tokens
+        (the mid-acceptance count-bump subtlety)."""
+        reqs = [
+            (([7, 8, 9] * 5)[:13], 10, dict(repetition_penalty=1.8)),
+            ([5, 9, 2, 14, 3, 5, 9, 2, 14], 8,
+             dict(presence_penalty=0.9, frequency_penalty=0.4)),
+            ([4, 4, 1] * 4, 8, dict(logit_bias={4: -8.0, 9: 3.0})),
+            ([6, 2, 6, 2, 6, 2], 6, {}),  # unpenalized neighbor
+        ]
+        plain = self._mk(setup, slots=4)
+        uids = [plain.submit(p, n, **kw) for p, n, kw in reqs]
+        ref = {c.uid: c.tokens for c in plain.run()}
+        spec = self._mk(setup, slots=4, spec_k=4, spec_ngram=3)
+        uids2 = [spec.submit(p, n, **kw) for p, n, kw in reqs]
+        got = {c.uid: c.tokens for c in spec.run()}
+        for u1, u2 in zip(uids, uids2):
+            assert ref[u1] == got[u2], (ref[u1], got[u2])
 
-    def test_seeded_sampling_reproduces_under_speculation(self, setup):
+    def test_penalized_spec_matches_lockstep_generate(self, setup):
         cfg, params = setup
+        prompt = [6, 2, 6, 2, 6, 2, 6, 2, 6, 2]
+        n = 9
+        dm = build_decode_model(cfg, PrecisionConfig())
+        ref = np.asarray(generate(
+            dm, params, jnp.asarray([prompt], jnp.int32), n,
+            repetition_penalty=1.6,
+            presence_penalty=0.3))[0, len(prompt):].tolist()
+        b = self._mk(setup, slots=2, spec_k=3, spec_ngram=2)
+        u = b.submit(prompt, n, repetition_penalty=1.6,
+                     presence_penalty=0.3)
+        got = {c.uid: c for c in b.run()}[u]
+        assert got.tokens == ref
+        assert len(got.logprobs) == len(got.tokens)
+        assert all(lp <= 0.0 for lp in got.logprobs)
+
+    def test_penalized_rows_actually_accept_drafts(self, setup):
+        """Proof the mid-acceptance count-advance path executes: a
+        logit_bias-pinned row (bias +100 forces one token, making
+        generation periodic — the regime prompt lookup wins) routed
+        through the PENALIZED kernel accepts drafts, and its output
+        still matches the penalized plain batcher. (Repetition-penalized
+        rows legitimately reject most proposals — the penalty fights
+        the repetition the lookup bets on — so acceptance must be
+        proven on a row where the two cooperate.)"""
+        cfg, params = setup
+        prompt = [5, 5, 5, 5, 5]
+        kw = dict(logit_bias={5: 100.0}, presence_penalty=0.2)
+        plain = self._mk(setup, slots=1)
+        u0 = plain.submit(prompt, 8, **kw)
+        ref = {c.uid: c for c in plain.run()}[u0].tokens
+        b = self._mk(setup, slots=1, spec_k=3, spec_ngram=2)
+        u = b.submit(prompt, 8, **kw)
+        got = {c.uid: c for c in b.run()}[u].tokens
+        assert got == ref == [5] * 8
+        # the only row is penalized+biased → every accepted draft came
+        # from the penalized accept kernel's count-advanced law
+        assert b.stats.get("spec_accepted", 0) >= 1
+
+    def test_seeded_penalized_reproduces_under_speculation(self, setup):
+        """A seeded, penalized, SAMPLED request under speculation is
+        batch-composition independent (same contract as the plain
+        path)."""
         prompt = [7, 8, 9, 7, 8, 9, 7, 8]
+        kw = dict(temperature=1.1, seed=21, repetition_penalty=1.4)
         b1 = self._mk(setup, slots=2, spec_k=3, spec_ngram=2,
                       rng=jax.random.PRNGKey(5))
-        u1 = b1.submit(prompt, 6, temperature=1.1, seed=21)
+        u1 = b1.submit(prompt, 6, **kw)
         alone = {c.uid: c for c in b1.run()}[u1].tokens
         b2 = self._mk(setup, slots=2, spec_k=3, spec_ngram=2,
                       rng=jax.random.PRNGKey(777))
         b2.submit([2, 12, 4], 8, temperature=0.8)
-        u2 = b2.submit(prompt, 6, temperature=1.1, seed=21)
+        u2 = b2.submit(prompt, 6, **kw)
         busy = {c.uid: c for c in b2.run()}[u2].tokens
         assert alone == busy
+
+    def test_preload_fork_parity_under_speculation(self, setup):
+        """A preloaded template survives speculative traffic intact:
+        every spec round re-pins ALL rows (the template included) to
+        _pos, so preload must record the template's true position — a
+        stale 0 would let each verify write k+1 garbage K/V entries
+        INTO the template content, corrupting every later fork."""
+        cfg, params = setup
+        template = [3, 14, 15, 9, 2, 6]
+        tail = [5, 3, 5, 3, 5]
+        ref = _reference(cfg, params, template + tail, 7)
+
+        spec = self._mk(setup, slots=3, spec_k=3, spec_ngram=2)
+        sid = spec.preload(template)
+        # spec traffic while the template is parked: rounds re-pin its
+        # row every step — with the fix its writes stay beyond the
+        # template's content
+        u0 = spec.submit([7, 8, 9, 7, 8, 9, 7, 8], 10)
+        _ = {c.uid: c for c in spec.run()}
+        u1 = spec.submit(tail, 7, prefix=sid)
+        got = {c.uid: c for c in spec.run()}[u1]
+        assert got.tokens == ref
+        # and the template keeps serving (fork, not consume)
+        u2 = spec.submit(tail, 7, prefix=sid)
+        got2 = {c.uid: c for c in spec.run()}[u2]
+        assert got2.tokens == ref
+
+    def test_preload_enforces_spec_headroom(self, setup):
+        """preload rejects templates whose pinned-row verify writes
+        could clamp back into template content (len + spec_k + 1 must
+        fit max_seq_len)."""
+        cfg, _ = setup
+        b = self._mk(setup, slots=1, spec_k=3)
+        with pytest.raises(ValueError, match="spec margin"):
+            b.preload(list(range(2, 2 + cfg.max_seq_len - 3)))
+        # same length is fine without speculation
+        b2 = self._mk(setup, slots=1)
+        b2.preload([2] * (cfg.max_seq_len - 3))
+
+    def test_host_device_time_split_exposed(self, setup):
+        b = self._mk(setup, slots=2, spec_k=3, spec_ngram=2)
+        b.submit([7, 8, 9, 7, 8, 9, 7], 6)
+        list(b.run())
+        assert b.stats["device_ms"] > 0.0
+        assert b.stats["host_ms"] >= 0.0
+        assert b.stats["admit_ms"] > 0.0
+
+
+def test_ngram_index_matches_rescan_proposals():
+    """The incremental per-row n-gram index proposes EXACTLY what the
+    O(context) backward rescan (speculative.propose_from_context)
+    proposes, at every step of random token streams — the index is a
+    pure speedup, not a semantics change."""
+    from pytorch_distributed_train_tpu.serving import (
+        _ngram_append,
+        _ngram_build,
+        _ngram_propose,
+    )
+    from pytorch_distributed_train_tpu.speculative import (
+        propose_from_context,
+    )
+
+    rng = np.random.default_rng(7)
+    for ngram, k, vocab in ((2, 3, 4), (3, 4, 3), (1, 2, 5)):
+        base = [int(t) for t in rng.integers(0, vocab, 6)]
+        ctx = list(base)
+        idx = _ngram_build(ctx, ngram)
+        for step in range(60):
+            assert _ngram_propose(ctx, idx, ngram, k) == \
+                propose_from_context(ctx, k, ngram), \
+                (ngram, k, step, ctx)
+            _ngram_append(ctx, idx, int(rng.integers(0, vocab)), ngram)
+
+
+def test_seed_range_validated(setup):
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=1)
+    for bad in (-1, -5, 2**32):
+        with pytest.raises(ValueError, match="seed"):
+            b.submit([1, 2, 3], 4, seed=bad)
+    b.submit([1, 2, 3], 4, seed=2**32 - 1)  # boundary ok
+
+
+def test_seeded_sampling_reproduces_under_speculation(setup):
+    """Unpenalized seeded sampling under speculation stays
+    batch-composition independent (module-level twin of the in-class
+    penalized variant)."""
+    cfg, params = setup
+    prompt = [7, 8, 9, 7, 8, 9, 7, 8]
+    b1 = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                           spec_k=3, spec_ngram=2,
+                           rng=jax.random.PRNGKey(5))
+    u1 = b1.submit(prompt, 6, temperature=1.1, seed=21)
+    alone = {c.uid: c for c in b1.run()}[u1].tokens
+    b2 = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2,
+                           spec_k=3, spec_ngram=2,
+                           rng=jax.random.PRNGKey(777))
+    b2.submit([2, 12, 4], 8, temperature=0.8)
+    u2 = b2.submit(prompt, 6, temperature=1.1, seed=21)
+    busy = {c.uid: c for c in b2.run()}[u2].tokens
+    assert alone == busy

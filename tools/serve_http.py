@@ -810,7 +810,9 @@ def main(argv=None) -> int:
     p.add_argument("--spec-k", type=int, default=0,
                    help="prompt-lookup SPECULATIVE serving: verify K "
                         "n-gram proposals per row per step (0 = off; "
-                        "refuses penalties/logit_bias requests while on)")
+                        "composes with penalties/logit_bias — the "
+                        "penalized accept kernel preserves the lockstep "
+                        "law)")
     p.add_argument("--spec-ngram", type=int, default=3,
                    help="with --spec-k: n-gram length for the lookup")
     p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
