@@ -43,6 +43,7 @@ _LAZY = {
     "filter_logits": "pytorch_distributed_train_tpu.generate",
     "speculative_generate": "pytorch_distributed_train_tpu.speculative",
     "ContinuousBatcher": "pytorch_distributed_train_tpu.serving",
+    "PagedContinuousBatcher": "pytorch_distributed_train_tpu.serving",
     "Seq2SeqContinuousBatcher": "pytorch_distributed_train_tpu.serving",
 }
 

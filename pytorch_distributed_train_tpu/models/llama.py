@@ -163,9 +163,24 @@ class LlamaAttention(nn.Module):
     # row decodes at ITS OWN sequence offset, so serving slots at different
     # positions share one batched step. Prefill still starts rows at 0.
     decode_rows: bool = False
+    # PAGED KV cache (serving.PagedContinuousBatcher — the vLLM
+    # PagedAttention role, TPU-shaped): K/V live in a FLAT pool of
+    # ``paged_blocks`` fixed-size blocks of ``page_size`` tokens,
+    # (paged_blocks * page_size, H_kv, D) per layer, and each row maps
+    # logical block j -> physical block via the (B, max_blocks)
+    # ``block_tables`` argument (host-managed; sentinel ``paged_blocks``
+    # marks unallocated entries, whose writes DROP and reads FILL zero —
+    # out-of-bounds semantics do the masking, no branches). Resident KV
+    # scales with actual sequence lengths instead of B x max_seq_len
+    # worst-case rows. decode_rows-only (serving prefills on a dense B=1
+    # row model and scatters the range into blocks).
+    paged: bool = False
+    page_size: int = 0
+    paged_blocks: int = 0
 
     @nn.compact
-    def __call__(self, x, segments=None, positions=None):
+    def __call__(self, x, segments=None, positions=None,
+                 block_tables=None):
         B, S, C = x.shape
         head_dim = C // self.num_heads
         from pytorch_distributed_train_tpu.quant import quant_dot_general
@@ -180,7 +195,96 @@ class LlamaAttention(nn.Module):
         k = proj(self.num_kv_heads, "k_proj")(x)
         v = proj(self.num_kv_heads, "v_proj")(x)
 
-        if self.decode:
+        if self.decode and self.paged:
+            # Paged KV: flat per-layer pools + host block tables. Only
+            # the decode_rows step/continuation shapes exist here —
+            # serving prefills on a dense B=1 row model and scatters
+            # the range into blocks (serving._paged_scatter_row_range).
+            if not self.decode_rows:
+                raise ValueError(
+                    "paged KV cache requires decode_rows (continuous "
+                    "batching); dense decode has no block tables")
+            nb, bs = self.paged_blocks, self.page_size
+            if nb < 1 or bs < 1:
+                raise ValueError(
+                    f"paged=True needs page_size >= 1 and paged_blocks "
+                    f">= 1, got {bs}, {nb}")
+            mb = -(-self.max_seq_len // bs)  # logical blocks per row
+            Lp = mb * bs
+            cdt = resolve_kv_dtype(self.kv_cache_dtype, k.dtype)
+            p_k = self.variable("cache", "pool_key", jnp.zeros,
+                                (nb * bs, self.num_kv_heads, head_dim),
+                                cdt)
+            p_v = self.variable("cache", "pool_value", jnp.zeros,
+                                (nb * bs, self.num_kv_heads, head_dim),
+                                cdt)
+            c_i = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((B,), jnp.int32))
+            if S > 1 and not self.decode_multi:
+                raise ValueError(
+                    "paged prefill is unsupported: prefill on the dense "
+                    "row model and scatter the range into blocks")
+            tables = (block_tables if block_tables is not None
+                      else jnp.full((B, mb), nb, jnp.int32))  # init trace
+            idx = c_i.value  # (B,)
+            cos, sin = rope_frequencies(head_dim, self.max_seq_len,
+                                        self.rope_theta,
+                                        self.rope_scaling,
+                                        self.rope_scaling_type)
+            take = lambda tbl, i: jax.lax.dynamic_slice_in_dim(  # noqa: E731
+                tbl, i, S, 0)
+            q = apply_rope_rows(q, jax.vmap(take, (None, 0))(cos, idx),
+                                jax.vmap(take, (None, 0))(sin, idx))
+            k = apply_rope_rows(k, jax.vmap(take, (None, 0))(cos, idx),
+                                jax.vmap(take, (None, 0))(sin, idx))
+            # Scatter the S new tokens through the block map. Logical
+            # block indices clip into the table (gather default);
+            # unallocated/dead entries hold the sentinel ``nb`` so their
+            # physical index lands out of bounds and the write DROPS —
+            # free-running dead rows and re-pinned parked rows stay
+            # harmless with zero host branching, the same discipline as
+            # the dense cache's masked garbage writes.
+            pos = idx[:, None] + jnp.arange(S)  # (B, S)
+            # Clamp the FLAT position (the dense path's clamp-to-end
+            # discipline): a parked row's free-running index must pile
+            # its garbage writes on the single final position Lp-1 —
+            # clamping block and offset separately would instead cycle
+            # writes through the whole last block, corrupting a parked
+            # session's real tail content over time. Lp-1 is always
+            # masked (k_pos <= q_pos < L <= Lp never reaches it before
+            # a real write does).
+            pos_w = jnp.clip(pos, 0, Lp - 1)
+            pb = jnp.take_along_axis(tables, pos_w // bs, axis=1)
+            phys = pb * bs + pos_w % bs  # (B, S); >= nb*bs if unallocated
+            kv_shape = (B * S, self.num_kv_heads, head_dim)
+            p_k.value = p_k.value.at[phys.reshape(-1)].set(
+                k.astype(cdt).reshape(kv_shape), mode="drop")
+            p_v.value = p_v.value.at[phys.reshape(-1)].set(
+                v.astype(cdt).reshape(kv_shape), mode="drop")
+            c_i.value = idx + S
+            # Gather each row's logical view (B, Lp) out of the pool —
+            # unallocated blocks read zeros (mode='fill'), and the
+            # position mask hides everything past the row's offset
+            # anyway. Transient: one (B, Lp, H_kv, D) buffer per layer
+            # (freed across layers); RESIDENT KV is just the pool.
+            jpos = jnp.arange(Lp)
+            physg = (jnp.take(tables, jpos // bs, axis=1) * bs
+                     + jpos % bs)  # (B, Lp)
+            k_all = jnp.take(p_k.value, physg.reshape(-1), axis=0,
+                             mode="fill", fill_value=0).reshape(
+                                 B, Lp, self.num_kv_heads, head_dim)
+            v_all = jnp.take(p_v.value, physg.reshape(-1), axis=0,
+                             mode="fill", fill_value=0).reshape(
+                                 B, Lp, self.num_kv_heads, head_dim)
+            k_pos = jnp.arange(Lp)
+            mask = k_pos[None, None, :] <= pos[:, :, None]  # (B, S, Lp)
+            if self.window:
+                mask &= (pos[:, :, None] - k_pos[None, None, :]
+                         ) < self.window
+            y = dot_product_attention(q, k_all.astype(self.dtype),
+                                      v_all.astype(self.dtype),
+                                      mask=mask[:, None], impl="xla")
+        elif self.decode:
             L = self.max_seq_len
             cdt = resolve_kv_dtype(self.kv_cache_dtype, k.dtype)
             c_k = self.variable("cache", "cached_key", jnp.zeros,
@@ -334,9 +438,13 @@ class LlamaBlock(nn.Module):
     decode: bool = False
     decode_multi: bool = False
     decode_rows: bool = False
+    paged: bool = False
+    page_size: int = 0
+    paged_blocks: int = 0
 
     @nn.compact
-    def __call__(self, x, segments=None, positions=None):
+    def __call__(self, x, segments=None, positions=None,
+                 block_tables=None):
         h = RMSNorm(self.rms_norm_eps, name="input_norm")(x)
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.rope_theta,
@@ -346,8 +454,11 @@ class LlamaBlock(nn.Module):
             window=self.window, quant=self.quant,
             kv_cache_dtype=self.kv_cache_dtype, decode=self.decode,
             decode_multi=self.decode_multi, decode_rows=self.decode_rows,
+            paged=self.paged, page_size=self.page_size,
+            paged_blocks=self.paged_blocks,
             name="attn",
-        )(h, segments=segments, positions=positions)
+        )(h, segments=segments, positions=positions,
+          block_tables=block_tables)
         h = RMSNorm(self.rms_norm_eps, name="post_attn_norm")(x)
         if self.moe is not None:
             from pytorch_distributed_train_tpu.ops.moe import MoeMLP
@@ -403,6 +514,11 @@ class LlamaForCausalLM(nn.Module):
     decode_multi: bool = False
     # Per-row cache offsets for continuous-batching serving (serving.py)
     decode_rows: bool = False
+    # Paged KV pool (serving.PagedContinuousBatcher): block-granular
+    # cache residency with host block tables (see LlamaAttention.paged)
+    paged: bool = False
+    page_size: int = 0
+    paged_blocks: int = 0
     # Fused chunked head+CE (losses.chunked_causal_ce): __call__ returns
     # {'loss_sum','weight_sum'} instead of logits — (B,S,V) fp32 logits
     # never materialize. Pair with loss="fused_causal_lm_xent".
@@ -414,7 +530,8 @@ class LlamaForCausalLM(nn.Module):
     act: "object | None" = None
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True, loss_mask=None):
+    def __call__(self, input_ids, train: bool = True, loss_mask=None,
+                 block_tables=None):
         del train  # no dropout in the Llama-2 pretrain recipe
         segments = positions = None
         if self.segment_eos_id >= 0:
@@ -453,8 +570,11 @@ class LlamaForCausalLM(nn.Module):
                 quant=self.quant_training,
                 kv_cache_dtype=self.kv_cache_dtype, decode=self.decode,
                 decode_multi=self.decode_multi, decode_rows=self.decode_rows,
+                paged=self.paged, page_size=self.page_size,
+                paged_blocks=self.paged_blocks,
                 name=f"layer{i}",
-            )(x, segments=segments, positions=positions)
+            )(x, segments=segments, positions=positions,
+              block_tables=block_tables)
             if self.act is not None:
                 x = self.act.constrain(x)
 

@@ -511,7 +511,7 @@ class ContinuousBatcher:
         self.spec_k = spec_k
         self.spec_ngram = spec_ngram
         self.mesh = mesh
-        self.model = build_serving_model(model_cfg, precision)
+        self.model = self._build_batched_model(model_cfg, precision)
         # session resume ingests multi-token turns at per-row offsets
         self._model_multi = dataclasses.replace(self.model,
                                                 decode_multi=True)
@@ -519,6 +519,11 @@ class ContinuousBatcher:
         self.max_seq_len = self.model.max_seq_len
         self._build_buckets(self.max_seq_len, min_bucket)
         self._init_slot_state(slots)
+
+    def _build_batched_model(self, model_cfg, precision):
+        """The model the batched decode step runs (paged subclass adds
+        the pool/table flags here)."""
+        return build_serving_model(model_cfg, precision)
 
     def _alloc_cache(self, batch: int):
         """Zeroed KV cache for ``batch`` rows — allocated DIRECTLY into
@@ -588,6 +593,9 @@ class ContinuousBatcher:
         self._bias = np.zeros((slots, self.model.vocab_size), np.float32)
         self._has_bias = np.zeros(slots, bool)  # O(slots) routing flag
         self._pos = np.zeros(slots, np.int64)  # tokens INGESTED per slot
+        # sids shielded from LRU eviction while their fork is mid-
+        # admission (see _evict_lru_parked)
+        self._evict_protect: set[int] = set()
         # parked chat sessions: sid -> (slot, ingested pos, last token).
         # A parked row's K/V stays resident while other slots decode: its
         # counters free-run and each step writes ONE garbage K/V at its
@@ -777,6 +785,40 @@ class ContinuousBatcher:
                 return b
         raise ValueError(f"prompt length {n} exceeds max bucket")
 
+    # ------------------------------------------------- row-cache hooks
+    # The B=1 prefill/continuation machinery runs on a DENSE row cache
+    # in every batcher; only how a finished row lands in (and is read
+    # back out of) the batched pool differs. The paged subclass
+    # overrides these five hooks; the scheduler above them is shared.
+    @property
+    def _row_model(self):
+        return self.model
+
+    @property
+    def _row_model_multi(self):
+        return self._model_multi
+
+    def _alloc_row_cache(self):
+        return self._alloc_cache(1)
+
+    def _install_row(self, r: int, row_cache, true_len: int) -> None:
+        """Land a freshly prefilled B=1 row cache in slot ``r``."""
+        self.cache = _insert_row(self.cache, row_cache, jnp.int32(r),
+                                 jnp.int32(true_len))
+
+    def _extract_row(self, r: int, pos: int):
+        """Slot ``r`` as a B=1 dense row cache with counters pinned to
+        ``pos`` (session resume / template fork read path)."""
+        row = _gather_row(self.cache, jnp.int32(r))
+        return _set_row_index(row, jnp.int32(pos))
+
+    def _install_row_range(self, r: int, row_cache, pos: int,
+                           T: int) -> None:
+        """Land a continued row back in slot ``r`` with ``T`` new
+        tokens ingested at offset ``pos``."""
+        self.cache = _insert_row(self.cache, row_cache, jnp.int32(r),
+                                 jnp.int32(pos + T))
+
     # ---------------------------------------------------------- scheduler
     def _prefill_into(self, r: int, prompt: list[int]):
         """Bucket-padded B=1 prefill scattered into slot ``r``; returns
@@ -785,12 +827,11 @@ class ContinuousBatcher:
         P = self._bucket(len(prompt))
         ids = np.zeros((1, P), np.int32)
         ids[0, : len(prompt)] = prompt
-        row_cache = self._alloc_cache(1)
+        row_cache = self._alloc_row_cache()
         last, row_cache = _prefill_step(
-            self.model, self.params, row_cache, jnp.asarray(ids),
+            self._row_model, self.params, row_cache, jnp.asarray(ids),
             jnp.asarray([len(prompt)], jnp.int32))
-        self.cache = _insert_row(self.cache, row_cache, jnp.int32(r),
-                                 jnp.int32(len(prompt)))
+        self._install_row(r, row_cache, len(prompt))
         self.stats["prefills"] += 1
         return last
 
@@ -838,16 +879,14 @@ class ContinuousBatcher:
             Tb = self.max_seq_len - pos
         ids = np.zeros((1, Tb), np.int32)
         ids[0, :T] = turn
-        row = _gather_row(self.cache, jnp.int32(r_src))
-        row = _set_row_index(row, jnp.int32(pos))
+        row = self._extract_row(r_src, pos)
         # _prefill_step doubles as the continuation executable: the
         # static model arg (decode_multi twin) keys a separate compile
         # that appends at the row's offset instead of position 0.
         last, row = _prefill_step(
-            self._model_multi, self.params, row, jnp.asarray(ids),
+            self._row_model_multi, self.params, row, jnp.asarray(ids),
             jnp.asarray([T], jnp.int32))
-        self.cache = _insert_row(self.cache, row, jnp.int32(r_target),
-                                 jnp.int32(pos + T))
+        self._install_row_range(r_target, row, pos, T)
         return self._start_slot(r_target, req, pos + T, last)
 
     def _set_row_sampling_state(self, r: int, req: Request) -> None:
@@ -977,7 +1016,15 @@ class ContinuousBatcher:
         session_evicted completions instead of hanging forever."""
         queued = {q.session for q in self.queue if q.session is not None}
         queued |= {q.prefix for q in self.queue if q.prefix is not None}
+        # _evict_protect: sids that must survive even a forced eviction —
+        # a fork ALREADY POPPED from the queue is mid-admission against
+        # its template (the queued-set above no longer sees it); evicting
+        # that template under block/slot pressure would corrupt the
+        # copy-on-write source mid-share (paged) or KeyError the
+        # scheduler (dense).
         for sid in list(self._parked):  # insertion order == LRU
+            if sid in self._evict_protect:
+                continue
             if force or sid not in queued:
                 r, _, _ = self._parked.pop(sid)
                 self._parked_slots.discard(r)
@@ -985,12 +1032,17 @@ class ContinuousBatcher:
                 return r
         return None
 
-    def can_preload(self) -> bool:
+    def can_preload(self, prompt_len: int | None = None) -> bool:
         """Pure capacity check: would preload() find a slot right now?
         True when a slot is free, or some parked entry is evictable
         (not referenced by a queued continuation). No side effects —
         callers use it to fall back instead of catching preload's
-        RuntimeError (which would also swallow device errors)."""
+        RuntimeError (which would also swallow device errors).
+        ``prompt_len`` (the template's token count) lets capacity-
+        constrained subclasses (paged) also check block availability;
+        the dense batcher's slots are full-length rows, so it is
+        ignored here."""
+        del prompt_len
         for r in range(self.slots):
             if self._req[r] is None and r not in self._parked_slots:
                 return True
@@ -1051,6 +1103,13 @@ class ContinuousBatcher:
         """One batched decode step over all slots; returns (B, V) logits."""
         logits, self.cache = _decode_step(
             self.model, self.params, self.cache, ids)
+        return logits
+
+    def _decode_multi(self, ids):
+        """Batched multi-token step returning ALL positions' logits —
+        the speculative verify forward."""
+        logits, self.cache = _decode_multi_logits(
+            self._model_multi, self.params, self.cache, ids)
         return logits
 
     @property
@@ -1201,8 +1260,7 @@ class ContinuousBatcher:
         t_dev = time.perf_counter()
         self.stats["host_ms"] += (t_dev - t_prop) * 1e3
         ids = np.concatenate([self._pending[:, None], props], axis=1)
-        logits, self.cache = _decode_multi_logits(
-            self._model_multi, self.params, self.cache, jnp.asarray(ids))
+        logits = self._decode_multi(jnp.asarray(ids))
         self.rng, step_rng = jax.random.split(self.rng)
         ntok = jnp.asarray([len(g) for g in self._generated], jnp.int32)
         any_penalized = (np.any(self._rep != 1.0)
@@ -1281,6 +1339,369 @@ class ContinuousBatcher:
         as they finish (arrival-order-independent)."""
         while self.queue or self.active_slots:
             yield from self.step()
+
+
+# ------------------------------------------------------ paged KV serving
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _paged_decode_step(model, params, cache, ids, tables):
+    """The batched decode step over a paged pool — identical contract to
+    generate._decode_step plus the host block tables."""
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
+    logits, updated = model.apply(
+        {"params": params, "cache": cache}, ids, train=False,
+        mutable=["cache"], block_tables=tables,
+    )
+    return logits[:, -1], updated["cache"]
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _paged_decode_multi(model, params, cache, ids, tables):
+    """Paged twin of _decode_multi_logits (speculative verify)."""
+    from pytorch_distributed_train_tpu import quant
+
+    params = quant.dequantize_tree(params, model.dtype)
+    logits, updated = model.apply(
+        {"params": params, "cache": cache}, ids, train=False,
+        mutable=["cache"], block_tables=tables,
+    )
+    return logits, updated["cache"]
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _paged_gather_row(paged_cache, dense_zero, phys):
+    """One slot's logical K/V view gathered out of the pools into a
+    dense B=1 row cache (``phys``: (L,) physical token indices, OOB
+    where unallocated — those positions read zero and stay masked).
+    The inverse of _paged_scatter_row; pairs pool_key<->cached_key
+    leaves by path."""
+    from flax import traverse_util
+
+    pf = traverse_util.flatten_dict(paged_cache, sep="/")
+    df = traverse_util.flatten_dict(dense_zero, sep="/")
+    out = {}
+    for path, leaf in df.items():
+        name = path.rsplit("/", 1)[-1]
+        if name in ("cached_key", "cached_value"):
+            pool = pf[path.replace("cached_", "pool_")]
+            L = leaf.shape[1]
+            out[path] = jnp.take(
+                pool, phys[:L], axis=0, mode="fill",
+                fill_value=0)[None].astype(leaf.dtype)
+        else:
+            out[path] = leaf  # index counters: caller pins them
+    return traverse_util.unflatten_dict(out, sep="/")
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _paged_scatter_row(paged_cache, row_cache, phys, r, new_index):
+    """Land a dense B=1 row cache in slot ``r`` of the paged pools: the
+    FULL logical row scatters through ``phys`` (writes to unallocated /
+    sentinel positions drop — one executable regardless of how much of
+    the row is real), and slot r's cache_index pins to ``new_index``.
+    Writing the whole row is correct even over fork-shared blocks: a
+    shared block's region was gathered unmodified from those very
+    blocks, so the write-back is value-identical; only the new range
+    differs, and it lands in owned blocks by the sharing rule (forks
+    never share the block containing the fork point — it is copied)."""
+    from flax import traverse_util
+
+    pf = traverse_util.flatten_dict(paged_cache, sep="/")
+    rf = traverse_util.flatten_dict(row_cache, sep="/")
+    out = {}
+    for path, leaf in pf.items():
+        name = path.rsplit("/", 1)[-1]
+        if name in ("pool_key", "pool_value"):
+            row = rf[path.replace("pool_", "cached_")]  # (1, L, H, D)
+            L = row.shape[1]
+            out[path] = leaf.at[phys[:L]].set(
+                row[0].astype(leaf.dtype), mode="drop")
+        elif name == "cache_index":
+            out[path] = leaf.at[r].set(new_index.astype(leaf.dtype))
+        else:
+            out[path] = leaf
+    return traverse_util.unflatten_dict(out, sep="/")
+
+
+@partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def _paged_copy_block(paged_cache, src, dst, bs: int):
+    """Copy physical block ``src`` -> ``dst`` in every layer's pools —
+    the copy-on-write step for a fork whose prefix ends mid-block."""
+    from flax import traverse_util
+
+    pf = traverse_util.flatten_dict(paged_cache, sep="/")
+    out = {}
+    for path, leaf in pf.items():
+        if path.rsplit("/", 1)[-1] in ("pool_key", "pool_value"):
+            blk = jax.lax.dynamic_slice_in_dim(leaf, src * bs, bs, 0)
+            out[path] = jax.lax.dynamic_update_slice_in_dim(
+                leaf, blk, dst * bs, 0)
+        else:
+            out[path] = leaf
+    return traverse_util.unflatten_dict(out, sep="/")
+
+
+class PagedContinuousBatcher(ContinuousBatcher):
+    """Continuous batching over a PAGED KV cache — the vLLM
+    PagedAttention role, TPU-shaped (SURVEY §7.4.5's static-shape
+    discipline kept: every executable still has static shapes; paging
+    changes WHERE rows live, not the shapes the compiler sees).
+
+    The dense batcher reserves one (slots, max_seq_len, H_kv, D) row
+    per slot per layer — every slot pays worst-case length in HBM. Here
+    K/V live in a flat pool of ``page_blocks`` blocks of ``page_size``
+    tokens; each slot maps logical block j -> physical block through a
+    host-managed table, so RESIDENT KV scales with actual sequence
+    lengths: on a 16 GB chip that is the serving capacity currency.
+    Blocks are refcounted — prefix forks (templates, sessions) share
+    full blocks copy-on-write (the block containing the fork point is
+    copied; the rest alias), so one preloaded system prompt costs its
+    own blocks once no matter how many requests fork it.
+
+    Out-of-bounds semantics do the policing, not branches: unallocated
+    table entries hold the sentinel ``page_blocks``, so a dead row's
+    free-running writes and a parked row's speculative-margin writes
+    land out of bounds and DROP (scatter mode='drop'), and gathers from
+    unallocated blocks read zero (mode='fill') behind the position mask
+    — the paged analogue of the dense batcher's masked-garbage-row
+    discipline.
+
+    Scheduling: blocks allocate on demand (admission takes the prompt's
+    blocks; each decode step takes at most one more per active row).
+    On exhaustion the LRU unreferenced parked session is evicted; if
+    nothing is evictable the step raises RuntimeError — there is no
+    vLLM-style preempt-and-recompute yet (size ``page_blocks`` for the
+    workload; ``submit`` rejects any single request that could not fit
+    the pool even alone). v1 scope: llama-family models, single chip
+    (``mesh`` unsupported — shard the pool's head axis over 'tensor'
+    the way _cache_shardings does for dense rows when it lands).
+    """
+
+    def __init__(self, model_cfg: ModelConfig, precision: PrecisionConfig,
+                 params: Any, *, slots: int = 4, page_size: int = 16,
+                 page_blocks: int = 0, top_k: int = 0, top_p: float = 0.0,
+                 min_p: float = 0.0, rng=None, min_bucket: int = 16,
+                 auto_prefix_min: int = 0, spec_k: int = 0,
+                 spec_ngram: int = 3):
+        if not model_cfg.name.startswith("llama"):
+            raise ValueError(
+                f"paged serving covers the llama family (per-row rope "
+                f"offsets, no learned-position counters), got "
+                f"{model_cfg.name!r}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self._page = page_size
+        self._mb = -(-model_cfg.max_seq_len // page_size)
+        # default pool = dense-equivalent capacity (the win then comes
+        # from raising slots, not shrinking the pool)
+        self._nblk = page_blocks or slots * self._mb
+        super().__init__(model_cfg, precision, params, slots=slots,
+                         top_k=top_k, top_p=top_p, min_p=min_p, rng=rng,
+                         min_bucket=min_bucket,
+                         auto_prefix_min=auto_prefix_min,
+                         spec_k=spec_k, spec_ngram=spec_ngram)
+        self._dense_model = build_serving_model(model_cfg, precision)
+        self._dense_multi = dataclasses.replace(self._dense_model,
+                                                decode_multi=True)
+        # host allocator: free stack + per-block refcounts + per-slot
+        # block tables (sentinel self._nblk = unallocated)
+        self._free_list = list(range(self._nblk))[::-1]
+        self._refcnt = np.zeros(self._nblk, np.int64)
+        self._tables = np.full((slots, self._mb), self._nblk, np.int32)
+        self._nalloc = np.zeros(slots, np.int64)
+
+    # ------------------------------------------------------ model hooks
+    def _build_batched_model(self, model_cfg, precision):
+        m = build_serving_model(model_cfg, precision)
+        return dataclasses.replace(m, paged=True, page_size=self._page,
+                                   paged_blocks=self._nblk)
+
+    @property
+    def _row_model(self):
+        return self._dense_model
+
+    @property
+    def _row_model_multi(self):
+        return self._dense_multi
+
+    def _alloc_row_cache(self):
+        return init_cache(self._dense_model, 1)
+
+    # -------------------------------------------------- block allocator
+    def blocks_in_use(self) -> int:
+        return self._nblk - len(self._free_list)
+
+    def _blocks_needed(self, pos_end: int) -> int:
+        return -(-pos_end // self._page)
+
+    def _ensure_blocks(self, r: int, pos_end: int) -> None:
+        """Grow slot ``r``'s table to cover logical positions
+        [0, pos_end), evicting LRU parked sessions under pressure.
+        Capped at the table width: a speculative round straddling the
+        context end asks for pos + k + 1 > max_seq_len, whose excess
+        writes the in-kernel flat clamp already piles on Lp-1 — they
+        need no blocks (and the table has no column for them)."""
+        need = min(self._blocks_needed(pos_end), self._mb)
+        while int(self._nalloc[r]) < need:
+            # evicting a fork-shared template may free zero blocks
+            # (refcounts stay > 0) — keep evicting until one frees
+            while not self._free_list:
+                if self._evict_lru_parked() is None:
+                    raise RuntimeError(
+                        f"KV block pool exhausted ({self._nblk} blocks "
+                        f"of {self._page} tokens, all in use and no "
+                        "parked session evictable) — raise page_blocks "
+                        "or lower concurrency")
+            b = self._free_list.pop()
+            self._tables[r, int(self._nalloc[r])] = b
+            self._refcnt[b] = 1
+            self._nalloc[r] += 1
+
+    def _free_slot_blocks(self, r: int) -> None:
+        for j in range(int(self._nalloc[r])):
+            b = int(self._tables[r, j])
+            self._refcnt[b] -= 1
+            if self._refcnt[b] == 0:
+                self._free_list.append(b)
+        self._tables[r, :] = self._nblk
+        self._nalloc[r] = 0
+
+    def _share_blocks(self, src: int, dst: int, pos: int) -> None:
+        """Fork-time aliasing: dst shares src's FULL blocks below
+        ``pos`` (refcount++); the block containing ``pos`` (if partial)
+        is copied — the only block a fork can ever write below its new
+        range."""
+        self._free_slot_blocks(dst)
+        full = pos // self._page
+        for j in range(full):
+            b = int(self._tables[src, j])
+            self._tables[dst, j] = b
+            self._refcnt[b] += 1
+        self._nalloc[dst] = full
+        if pos % self._page:
+            self._ensure_blocks(dst, pos)  # exactly one fresh block
+            self.cache = _paged_copy_block(
+                self.cache, jnp.int32(int(self._tables[src, full])),
+                jnp.int32(int(self._tables[dst, full])), self._page)
+
+    def _phys_row(self, r: int) -> np.ndarray:
+        """(max_seq_len,) physical token indices of slot ``r`` (OOB
+        sentinel where unallocated)."""
+        j = np.arange(self.max_seq_len)
+        pb = self._tables[r, j // self._page].astype(np.int64)
+        return (pb * self._page + j % self._page).astype(np.int32)
+
+    # ------------------------------------------------------- row hooks
+    def _install_row(self, r: int, row_cache, true_len: int) -> None:
+        self._free_slot_blocks(r)  # idempotent; covers any stale state
+        self._ensure_blocks(r, true_len)
+        self.cache = _paged_scatter_row(
+            self.cache, row_cache, jnp.asarray(self._phys_row(r)),
+            jnp.int32(r), jnp.int32(true_len))
+
+    def _extract_row(self, r: int, pos: int):
+        row = _paged_gather_row(self.cache, self._alloc_row_cache(),
+                                jnp.asarray(self._phys_row(r)))
+        return _set_row_index(row, jnp.int32(pos))
+
+    def _install_row_range(self, r: int, row_cache, pos: int,
+                           T: int) -> None:
+        self._ensure_blocks(r, pos + T)
+        self.cache = _paged_scatter_row(
+            self.cache, row_cache, jnp.asarray(self._phys_row(r)),
+            jnp.int32(r), jnp.int32(pos + T))
+
+    # ------------------------------------------------- lifecycle frees
+    def _admit_fork(self, r_target: int, req: Request):
+        # Shield the source template for the whole admission: the fork
+        # was already popped from the queue, so the LRU evictor's
+        # queued-protection no longer covers it — block pressure during
+        # _share_blocks/_ensure_blocks could otherwise evict and
+        # sentinel the very blocks being shared/copied.
+        r_src, pos, _ = self._parked[req.prefix]
+        self._evict_protect.add(req.prefix)
+        try:
+            self._share_blocks(r_src, r_target, pos)
+            return super()._admit_fork(r_target, req)
+        finally:
+            self._evict_protect.discard(req.prefix)
+
+    def _maybe_finish(self, r: int, token: int):
+        done = super()._maybe_finish(r, token)
+        if done is not None and done.session is None:
+            self._free_slot_blocks(r)
+        return done
+
+    def cancel(self, uid: int) -> bool:
+        slot = next((r for r in range(self.slots)
+                     if self._req[r] is not None
+                     and self._req[r].uid == uid), None)
+        ok = super().cancel(uid)
+        if ok and slot is not None:
+            self._free_slot_blocks(slot)
+        return ok
+
+    def _evict_lru_parked(self, force: bool = False) -> int | None:
+        r = super()._evict_lru_parked(force)
+        if r is not None:
+            self._free_slot_blocks(r)
+        return r
+
+    def release(self, sid: int) -> bool:
+        entry = self._parked.get(sid)
+        ok = super().release(sid)
+        if ok and entry is not None:
+            self._free_slot_blocks(entry[0])
+        return ok
+
+    def _check_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        super()._check_request(prompt_len, max_new_tokens)
+        if self._blocks_needed(prompt_len + max_new_tokens) > self._nblk:
+            raise ValueError(
+                f"request needs {self._blocks_needed(prompt_len + max_new_tokens)} "
+                f"KV blocks but the pool holds {self._nblk} — raise "
+                "page_blocks")
+
+    def can_preload(self, prompt_len: int | None = None) -> bool:
+        """Slot capacity AND block capacity: a free slot is worthless
+        if the pool cannot hold the template — preload() would raise
+        pool-exhausted and the caller's graceful fallback (n plain
+        submits) would never engage."""
+        if not super().can_preload():
+            return False
+        # blocks reclaimable without touching queued continuations
+        queued = {q.session for q in self.queue if q.session is not None}
+        queued |= {q.prefix for q in self.queue if q.prefix is not None}
+        reclaimable = 0
+        for sid, (r, _, _) in self._parked.items():
+            if sid in queued or sid in self._evict_protect:
+                continue
+            reclaimable += sum(
+                1 for j in range(int(self._nalloc[r]))
+                if self._refcnt[int(self._tables[r, j])] == 1)
+        need = (self._blocks_needed(prompt_len)
+                if prompt_len is not None else 1)
+        return len(self._free_list) + reclaimable >= need
+
+    # -------------------------------------------------- batched steps
+    def _decode(self, ids):
+        for r in self.active_slots:
+            self._ensure_blocks(r, int(self._pos[r]) + 1)
+        logits, self.cache = _paged_decode_step(
+            self.model, self.params, self.cache, ids,
+            jnp.asarray(self._tables))
+        return logits
+
+    def _decode_multi(self, ids):
+        S = int(ids.shape[1])
+        for r in self.active_slots:
+            self._ensure_blocks(r, int(self._pos[r]) + S)
+        logits, self.cache = _paged_decode_multi(
+            self._model_multi, self.params, self.cache, ids,
+            jnp.asarray(self._tables))
+        return logits
 
 
 # ------------------------------------------------------ seq2seq (t5) serving

@@ -573,6 +573,58 @@ def test_http_per_request_top_p_accepted(server):
     assert e.value.code == 400
 
 
+def test_http_over_paged_batcher():
+    """The HTTP service runs unchanged over a PAGED batcher — same
+    responses token-for-token as the dense batcher, with block-pool
+    residency underneath (sessions + forks included)."""
+    import threading as _threading
+    from http.server import ThreadingHTTPServer
+
+    import serve_http
+
+    from pytorch_distributed_train_tpu.serving import (
+        PagedContinuousBatcher,
+        trim_at_eos,
+    )
+
+    cfg = ModelConfig(name="llama", vocab_size=300, hidden_size=32,
+                      num_layers=2, num_heads=4, num_kv_heads=4,
+                      mlp_dim=64, max_seq_len=96)
+    model = build_model(cfg, PrecisionConfig())
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    tok = load_tokenizer("")
+    batcher = PagedContinuousBatcher(cfg, PrecisionConfig(), params,
+                                     slots=2, page_size=16)
+    service = serve_http.BatcherService(batcher, tok)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                serve_http.make_handler(service))
+    t = _threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    try:
+        _, out = _post(port, {"prompt": "hello paged", "max_tokens": 6})
+        assert out["finish_reason"] in ("length", "eos")
+        plain = ContinuousBatcher(cfg, PrecisionConfig(), params, slots=2)
+        u = plain.submit(tok.encode("hello paged"), 6, eos_id=tok.eos_id)
+        ref = {c.uid: c for c in plain.run()}[u]
+        assert out["text"] == tok.decode(trim_at_eos(ref.tokens,
+                                                     tok.eos_id))
+        # a session round-trip stays resident in the block pool
+        _, c1 = _post(port, {"prompt": "turn one", "max_tokens": 4,
+                             "keep": True})
+        sid = c1["session"]
+        assert sid is not None
+        assert batcher.blocks_in_use() > 0  # parked session resident
+        _, c2 = _post(port, {"prompt": "turn two", "max_tokens": 4,
+                             "session": sid})
+        assert c2["finish_reason"] in ("length", "eos")
+        assert batcher.blocks_in_use() == 0  # consumed resume freed all
+    finally:
+        httpd.shutdown()
+        service.shutdown()
+
+
 def test_http_over_speculative_batcher():
     """The HTTP service runs unchanged over a spec-enabled batcher:
     completions succeed (greedy = same law), and penalized requests

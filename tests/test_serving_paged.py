@@ -1,0 +1,260 @@
+"""Paged KV-cache serving (serving.PagedContinuousBatcher).
+
+Correctness anchor: paging changes WHERE cache rows live (block pool +
+per-slot tables), never WHAT any request decodes — every test here
+asserts token-for-token equality against the dense ContinuousBatcher
+(itself pinned to lockstep generate() in test_serving.py), across
+admission, sessions, forks, speculation, and penalties. The capacity
+test then shows the point of the exercise: more resident mixed-length
+sessions than the dense batcher could hold in the same KV HBM.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_train_tpu.config import ModelConfig, PrecisionConfig
+from pytorch_distributed_train_tpu.models.registry import build_model
+from pytorch_distributed_train_tpu.serving import (
+    ContinuousBatcher,
+    PagedContinuousBatcher,
+)
+
+V, C, L, H, MLP, MAXLEN = 61, 32, 2, 2, 48, 48
+PAGE = 8  # 6 logical blocks per row at MAXLEN=48
+
+
+def _cfg(**kw):
+    base = dict(name="llama", vocab_size=V, hidden_size=C, num_layers=L,
+                num_heads=H, num_kv_heads=H, mlp_dim=MLP, max_seq_len=MAXLEN)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    model = build_model(cfg, PrecisionConfig())
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    return cfg, params
+
+
+def _dense(setup, **kw):
+    cfg, params = setup
+    return ContinuousBatcher(cfg, PrecisionConfig(), params, **kw)
+
+
+def _paged(setup, **kw):
+    cfg, params = setup
+    kw.setdefault("page_size", PAGE)
+    return PagedContinuousBatcher(cfg, PrecisionConfig(), params, **kw)
+
+
+def test_paged_matches_dense_mixed_lengths(setup):
+    rng = np.random.default_rng(3)
+    prompts = [list(map(int, rng.integers(0, V, n))) for n in (3, 9, 17, 5)]
+    budgets = [6, 3, 8, 5]
+    d = _dense(setup, slots=2)
+    du = [d.submit(p, n) for p, n in zip(prompts, budgets)]
+    ref = {c.uid: c.tokens for c in d.run()}
+    p = _paged(setup, slots=2)
+    pu = [p.submit(q, n) for q, n in zip(prompts, budgets)]
+    got = {c.uid: c.tokens for c in p.run()}
+    for a, b in zip(du, pu):
+        assert ref[a] == got[b], (ref[a], got[b])
+
+
+def test_paged_sessions_park_and_resume(setup):
+    d = _dense(setup, slots=2)
+    u1 = d.submit([5, 9, 2, 14], 5, keep=True)
+    c1 = {c.uid: c for c in d.run()}[u1]
+    u2 = d.submit([7, 3], 4, session=c1.session)
+    ref = {c.uid: c for c in d.run()}[u2].tokens
+
+    p = _paged(setup, slots=2)
+    v1 = p.submit([5, 9, 2, 14], 5, keep=True)
+    b1 = {c.uid: c for c in p.run()}[v1]
+    assert b1.tokens == c1.tokens
+    v2 = p.submit([7, 3], 4, session=b1.session)
+    got = {c.uid: c for c in p.run()}[v2].tokens
+    assert got == ref
+
+
+def test_paged_fork_shares_blocks_copy_on_write(setup):
+    """Forks of a preloaded template decode identically to the dense
+    batcher AND alias the template's full blocks instead of copying
+    them — the refcounted block economy that makes one system prompt
+    cost its own KV once."""
+    template = [3, 14, 15, 9, 2, 6, 5, 3, 11]  # 9 tokens: 1 full + 1 partial
+    tail = [4, 8]
+    d = _dense(setup, slots=3)
+    sid_d = d.preload(template)
+    du = [d.submit(tail, 6, prefix=sid_d) for _ in range(2)]
+    ref = {c.uid: c.tokens for c in d.run()}
+
+    p = _paged(setup, slots=3)
+    sid = p.preload(template)
+    used_template_only = p.blocks_in_use()
+    pu = [p.submit(tail, 6, prefix=sid) for _ in range(2)]
+    got = {c.uid: c.tokens for c in p.run()}
+    for a, b in zip(du, pu):
+        assert ref[a] == got[b]
+    assert got[pu[0]] == got[pu[1]]  # greedy forks agree
+    # template: 2 blocks. Each fork at pos=9 (mid-block): copies the
+    # partial block, SHARES the full one, and allocates for its own
+    # tail — never a full re-reservation of the prefix.
+    assert used_template_only == 2
+    per_fork_peak = (p.blocks_in_use() - used_template_only) / 2
+    assert per_fork_peak < 6  # < a dense-equivalent full row (6 blocks)
+
+
+def test_paged_speculative_parity(setup):
+    reqs = [([7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8], 10),
+            ([5, 9, 2, 14, 3], 6)]
+    d = _dense(setup, slots=2, spec_k=3, spec_ngram=2)
+    du = [d.submit(p, n) for p, n in reqs]
+    ref = {c.uid: c.tokens for c in d.run()}
+    p = _paged(setup, slots=2, spec_k=3, spec_ngram=2)
+    pu = [p.submit(q, n) for q, n in reqs]
+    got = {c.uid: c.tokens for c in p.run()}
+    for a, b in zip(du, pu):
+        assert ref[a] == got[b]
+    assert p.stats.get("spec_rounds", 0) >= 1
+
+
+def test_paged_penalized_parity(setup):
+    kw = dict(repetition_penalty=1.6, presence_penalty=0.3,
+              logit_bias={4: 2.5})
+    d = _dense(setup, slots=1)
+    u0 = d.submit([6, 2, 6, 2, 6, 2], 8, **kw)
+    ref = {c.uid: c for c in d.run()}[u0].tokens
+    p = _paged(setup, slots=1)
+    u1 = p.submit([6, 2, 6, 2, 6, 2], 8, **kw)
+    got = {c.uid: c for c in p.run()}[u1].tokens
+    assert got == ref
+
+
+def test_paged_capacity_beats_dense_reservation(setup):
+    """THE paged payoff: 8 mixed-length sessions stay RESIDENT in a
+    pool of 24 blocks — the KV HBM of just 4 dense worst-case rows
+    (4 slots x 6 blocks) — and every one of them resumes correctly.
+    The dense batcher at equal KV HBM tops out at 4 parked sessions
+    (slots = rows = 4); paged holds 2x."""
+    rng = np.random.default_rng(11)
+    prompts = [list(map(int, rng.integers(0, V, n)))
+               for n in (4, 6, 3, 7, 5, 4, 6, 3)]
+    # dense ground truth for each conversation, run independently
+    refs = []
+    for q in prompts:
+        d = _dense(setup, slots=1)
+        u = d.submit(q, 4, keep=True)
+        c1 = {c.uid: c for c in d.run()}[u]
+        u2 = d.submit([9, 1], 3, session=c1.session)
+        refs.append((c1.tokens,
+                     {c.uid: c for c in d.run()}[u2].tokens))
+
+    p = _paged(setup, slots=8, page_blocks=24)
+    sids, firsts = [], []
+    for q in prompts:
+        u = p.submit(q, 4, keep=True)
+        c1 = {c.uid: c for c in p.run()}[u]
+        sids.append(c1.session)
+        firsts.append(c1.tokens)
+    assert len(p._parked) == 8          # 8 resident sessions...
+    assert p.blocks_in_use() <= 24      # ...inside 4 dense rows of HBM
+    for i, sid in enumerate(sids):
+        assert firsts[i] == refs[i][0]
+        u2 = p.submit([9, 1], 3, session=sid)
+        got = {c.uid: c for c in p.run()}[u2].tokens
+        assert got == refs[i][1], i
+
+
+def test_paged_pool_bounds_and_exhaustion(setup):
+    # a single request that could never fit the pool is rejected upfront
+    p = _paged(setup, slots=2, page_blocks=3)
+    with pytest.raises(ValueError, match="KV blocks"):
+        p.submit(list(range(2, 30)), 10)
+    # two requests that fit alone but not together, nothing evictable:
+    # the step raises pool-exhausted instead of corrupting
+    p2 = _paged(setup, slots=2, page_blocks=3)
+    p2.submit([5] * 10, 8)
+    p2.submit([7] * 10, 8)
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        list(p2.run())
+
+
+def test_paged_eviction_recycles_blocks(setup):
+    """LRU parked sessions evict under block pressure; their blocks
+    recycle and a later resume of the evicted session surfaces as
+    session_evicted (same contract as dense slot-pressure eviction)."""
+    p = _paged(setup, slots=2, page_blocks=6)
+    u1 = p.submit([5, 9, 2, 14, 3, 7, 11, 2, 4], 4, keep=True)  # 2 blocks
+    c1 = {c.uid: c for c in p.run()}[u1]
+    before = p.blocks_in_use()
+    # a fat request (5 of the 6 blocks: 20 prompt + 16 new = 36 pos)
+    # forces eviction of the parked session (only 4 blocks are free)
+    u2 = p.submit([6] * 20, 16)
+    got = {c.uid: c for c in p.run()}[u2]
+    assert got.finish_reason in ("length", "eos")
+    assert c1.session not in p._parked
+    # dead request's blocks freed too
+    assert p.blocks_in_use() == 0
+    assert before > 0
+    with pytest.raises(ValueError, match="unknown session"):
+        p.submit([1, 2], 3, session=c1.session)
+
+
+def test_paged_cancel_frees_blocks(setup):
+    p = _paged(setup, slots=2)
+    u = p.submit([5, 9, 2, 14, 3], 12)
+    p.step()  # admit + first decode
+    assert p.blocks_in_use() > 0
+    assert p.cancel(u)
+    assert p.blocks_in_use() == 0
+
+
+def test_paged_fork_cannot_evict_own_template_mid_admission(setup):
+    """A fork popped from the queue is no longer in the evictor's
+    queued-protection set; block pressure during its own admission must
+    NOT evict (and sentinel) the very template being shared — the
+    failure surfaces as pool-exhausted with the template INTACT, never
+    as silent copy-on-write corruption."""
+    p = _paged(setup, slots=2, page_blocks=2)
+    sid = p.preload([3, 14, 15, 9, 2, 6, 5, 3, 11])  # 2 blocks = whole pool
+    p.submit([4, 8], 4, prefix=sid)
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        list(p.run())
+    # the template survived its failed fork untouched
+    assert sid in p._parked
+    r_src = p._parked[sid][0]
+    assert int(p._nalloc[r_src]) == 2
+    assert all(int(t) < p._nblk for t in p._tables[r_src, :2])
+
+
+def test_paged_can_preload_accounts_for_blocks(setup):
+    """can_preload on the paged batcher must check BLOCK capacity, not
+    just slots: a free slot with an exhausted pool would make the HTTP
+    n>1 path preload into a RuntimeError instead of falling back to
+    plain submits."""
+    p = _paged(setup, slots=3, page_blocks=4)
+    # one fat active request holds 3 of the 4 blocks
+    p.submit([5] * 20, 12)
+    p.step()
+    assert p.blocks_in_use() == 3
+    assert any(p._req[r] is None for r in range(p.slots))  # slots free
+    assert p.can_preload(4)      # a 1-block template still fits
+    assert not p.can_preload(9)  # a 2-block template does not
+    # dense semantics would have said yes — that asymmetry is the bug
+    d = _dense(setup, slots=3)
+    d.submit([5] * 20, 12)
+    d.step()
+    assert d.can_preload(9)
+
+
+def test_paged_rejects_non_llama(setup):
+    cfg = _cfg(name="gpt2")
+    with pytest.raises(ValueError, match="llama"):
+        PagedContinuousBatcher(cfg, PrecisionConfig(), None)

@@ -301,7 +301,7 @@ class BatcherService:
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
             try:
-                if share and self.batcher.can_preload():
+                if share and self.batcher.can_preload(len(ids) - 1):
                     # (a pure capacity check, not except RuntimeError: a
                     # broad catch would also swallow device errors from
                     # the synchronous template prefill)
@@ -766,6 +766,7 @@ def build_service(args) -> BatcherService:
     from pytorch_distributed_train_tpu.data.text import load_tokenizer
     from pytorch_distributed_train_tpu.serving import (
         ContinuousBatcher,
+        PagedContinuousBatcher,
         Seq2SeqContinuousBatcher,
         load_params_for_serving,
     )
@@ -774,12 +775,18 @@ def build_service(args) -> BatcherService:
     cfg.apply_overrides(args.set)
     tok = load_tokenizer(args.tokenizer)
     params = load_params_for_serving(cfg, args.safetensors, args.quantize)
-    cls = (Seq2SeqContinuousBatcher if cfg.model.name.startswith("t5")
-           else ContinuousBatcher)
-    extra = ({} if cfg.model.name.startswith("t5")
-             else {"auto_prefix_min": args.auto_prefix_min,
-                   "spec_k": args.spec_k,
-                   "spec_ngram": args.spec_ngram})
+    if cfg.model.name.startswith("t5"):
+        cls, extra = Seq2SeqContinuousBatcher, {}
+    else:
+        extra = {"auto_prefix_min": args.auto_prefix_min,
+                 "spec_k": args.spec_k,
+                 "spec_ngram": args.spec_ngram}
+        if args.page_size > 0:
+            cls = PagedContinuousBatcher
+            extra["page_size"] = args.page_size
+            extra["page_blocks"] = args.page_blocks
+        else:
+            cls = ContinuousBatcher
     batcher = cls(cfg.model, cfg.precision, params, slots=args.slots,
                   top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
                   rng=jax.random.PRNGKey(args.seed), **extra)
@@ -815,6 +822,15 @@ def main(argv=None) -> int:
                         "law)")
     p.add_argument("--spec-ngram", type=int, default=3,
                    help="with --spec-k: n-gram length for the lookup")
+    p.add_argument("--page-size", type=int, default=0,
+                   help="PAGED KV cache: tokens per block (0 = dense "
+                        "per-slot reservation). Resident KV then scales "
+                        "with actual lengths; forks share prefix blocks "
+                        "copy-on-write (llama family)")
+    p.add_argument("--page-blocks", type=int, default=0,
+                   help="with --page-size: pool size in blocks (0 = "
+                        "dense-equivalent slots*ceil(max_seq_len/"
+                        "page_size))")
     p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
     args = p.parse_args(argv)
 
