@@ -183,7 +183,21 @@ def _wait_for_backend() -> None:
     attempts = []
     backoff = 5.0
     while True:
-        ok, detail = probe_once(probe_timeout_s)
+        # The deadline bounds TOTAL wall-clock, probe time included: a
+        # probe launched near the deadline gets only the remaining
+        # budget (floor 10 s — below that a tunnel probe can't prove
+        # anything), so the loop can no longer overshoot its stated
+        # budget by a full probe_timeout (BENCH_r04 ran 676 s against
+        # a 600 s budget).
+        elapsed = time.monotonic() - t0
+        remaining = deadline_s - elapsed
+        if remaining <= 0:
+            _emit_backend_unavailable(
+                f"backend unhealthy after {len(attempts)} probes over "
+                f"{elapsed:.0f}s (retry budget {deadline_s:.0f}s); last: "
+                f"{attempts[-1] if attempts else 'none'}")
+            os._exit(3)
+        ok, detail = probe_once(min(probe_timeout_s, max(10.0, remaining)))
         if ok:
             if attempts:
                 print(f"bench.py: backend healthy after "
@@ -197,14 +211,6 @@ def _wait_for_backend() -> None:
               f"({attempts[-1]}); {elapsed:.0f}/{deadline_s:.0f}s elapsed",
               file=sys.stderr, flush=True)
         _touch()  # deliberate retry, not a hang: hold off the watchdog
-        if elapsed >= deadline_s:
-            _emit_backend_unavailable(
-                f"backend unhealthy after {len(attempts)} probes over "
-                f"{elapsed:.0f}s (retry budget {deadline_s:.0f}s); last: "
-                f"{attempts[-1]}")
-            os._exit(3)
-        # Never sleep past the budget: the last probe may start right at
-        # the deadline, but no budget is left unused while we sleep.
         time.sleep(min(backoff, max(0.1, deadline_s - elapsed)))
         backoff = min(backoff * 2, 60.0)
 
@@ -605,6 +611,14 @@ def serve_bench(args) -> None:
                     for _ in range(turns - 1)] for _ in range(n_req)]
 
     def make_batcher():
+        if args.serve_paged:
+            from pytorch_distributed_train_tpu.serving import (
+                PagedContinuousBatcher,
+            )
+
+            return PagedContinuousBatcher(
+                model_cfg, precision, params, slots=slots,
+                page_size=args.serve_paged, spec_k=args.serve_spec)
         return ContinuousBatcher(model_cfg, precision, params, slots=slots,
                                  spec_k=args.serve_spec)
 
@@ -729,6 +743,8 @@ def serve_bench(args) -> None:
         arm = "_prefix_resend" if args.serve_resend else "_prefix"
     if args.serve_spec:
         arm += f"_spec{args.serve_spec}"
+    if args.serve_paged:
+        arm += f"_paged{args.serve_paged}"
     _emit({
         "metric": f"llama_serve{arm}{suffix}_tokens_per_sec_per_chip",
         "value": round(total / wall, 2),
@@ -906,6 +922,11 @@ def main() -> None:
                         "(K proposals per row per step; random-token "
                         "workloads measure the overhead floor — real "
                         "text with repetition measures the win)")
+    p.add_argument("--serve-paged", type=int, default=0, metavar="PAGE",
+                   help="with --serve: PAGED KV cache with PAGE-token "
+                        "blocks (dense-equivalent pool; measures the "
+                        "paging overhead/win vs the per-slot "
+                        "reservation at identical workload)")
     p.add_argument("--serve-prefix", type=int, default=0, metavar="LEN",
                    help="with --serve: all requests share a LEN-token "
                         "system prompt, served via ONE preloaded "

@@ -584,6 +584,10 @@ class ContinuousBatcher:
         self._min_p = np.full(slots, self.min_p, np.float32)
         # per-row request seed (-1 = unseeded: shared per-step stream)
         self._seed = np.full(slots, -1, np.int64)
+        # seeded-chain offset: a preempted-and-requeued request resumes
+        # its fold_in(PRNGKey(seed), ntok) chain where it left off —
+        # ntok shipped to the samplers is base + len(generated)
+        self._ntok_base = np.zeros(slots, np.int32)
         self._counts = np.zeros((slots, self.model.vocab_size),
                                 np.float32)
         # generated-only counts: the OpenAI presence/frequency context
@@ -889,6 +893,17 @@ class ContinuousBatcher:
         self._install_row_range(r_target, row, pos, T)
         return self._start_slot(r_target, req, pos + T, last)
 
+    def _post_admission_state(self, r: int, req: Request) -> None:
+        """Subclass hook: runs after _set_row_sampling_state, before the
+        admission sample (see _start_slot). Base: nothing."""
+
+    def _can_admit(self, req: Request) -> bool:
+        """Subclass hook: may the scheduler admit ``req`` right now
+        beyond slot availability? Base: always (slots are the only
+        dense capacity). Returning False leaves the request queued."""
+        del req
+        return True
+
     def _set_row_sampling_state(self, r: int, req: Request) -> None:
         """ONE place that loads a slot's per-request sampling state
         (penalties + logit bias) — shared by the causal admission tail
@@ -899,6 +914,7 @@ class ContinuousBatcher:
         self._top_p[r] = self.top_p if req.top_p is None else req.top_p
         self._min_p[r] = self.min_p if req.min_p is None else req.min_p
         self._seed[r] = -1 if req.seed is None else req.seed
+        self._ntok_base[r] = 0
         self._counts[r] = 0.0
         self._gen_counts[r] = 0.0
         self._bias[r] = 0.0
@@ -913,6 +929,10 @@ class ContinuousBatcher:
         slot; returns a Completion iff that token already finishes."""
         self.rng, step_rng = jax.random.split(self.rng)
         self._set_row_sampling_state(r, req)
+        # hook for subclass admission state that must land BEFORE the
+        # first-token sampling (paged preemption: seeded-chain offset +
+        # generated-count restoration for requeued requests)
+        self._post_admission_state(r, req)
         penalized = (req.repetition_penalty != 1.0
                      or req.presence_penalty != 0.0
                      or req.frequency_penalty != 0.0
@@ -942,7 +962,9 @@ class ContinuousBatcher:
                 jnp.asarray(self._top_p[r:r + 1]),
                 jnp.asarray(self._min_p[r:r + 1]),
                 jnp.asarray(self._seed[r:r + 1]),
-                jnp.zeros(1, jnp.int32),  # first token: nothing generated
+                # nothing generated THIS admission; requeued requests
+                # carry their pre-preemption draw count in _ntok_base
+                jnp.asarray(self._ntok_base[r:r + 1], jnp.int32),
                 self.top_k)
         else:
             tok, lp = _sample_rows(
@@ -951,7 +973,7 @@ class ContinuousBatcher:
                 jnp.asarray(self._top_p[r:r + 1]),
                 jnp.asarray(self._min_p[r:r + 1]),
                 jnp.asarray(self._seed[r:r + 1]),
-                jnp.zeros(1, jnp.int32),
+                jnp.asarray(self._ntok_base[r:r + 1], jnp.int32),
                 self.top_k)
         first = int(tok[0])
         if penalized:
@@ -1156,6 +1178,13 @@ class ContinuousBatcher:
                 finished.append(Completion(
                     req.uid, req.prompt, [], "session_evicted"))
                 continue
+            if not self._can_admit(req):
+                # subclass capacity gate (paged: block budget). Checked
+                # BEFORE the slot search: _free_slot may LRU-evict a
+                # parked session to produce a slot, and destroying a
+                # live session for an admission that then fails the
+                # gate would be a pure loss.
+                break
             r = self._free_slot()
             if r is None and not self.active_slots:
                 # nothing is decoding, so no slot will EVER drain:
@@ -1188,9 +1217,12 @@ class ContinuousBatcher:
         t_dev = time.perf_counter()
         logits = self._decode(jnp.asarray(self._pending)[:, None])
         self.rng, step_rng = jax.random.split(self.rng)
-        # seeded rows' key chain advances by GENERATED count (inactive
-        # rows' stale counts are harmless — their draws are discarded)
-        ntok = jnp.asarray([len(g) for g in self._generated], jnp.int32)
+        # seeded rows' key chain advances by GENERATED count + any
+        # pre-preemption base (inactive rows' stale counts are harmless
+        # — their draws are discarded)
+        ntok = jnp.asarray(
+            self._ntok_base + np.asarray(
+                [len(g) for g in self._generated], np.int32), jnp.int32)
         any_penalized = (np.any(self._rep != 1.0)
                          or np.any(self._pres != 0.0)
                          or np.any(self._freq != 0.0)
@@ -1224,6 +1256,8 @@ class ContinuousBatcher:
         self.stats["steps"] += 1
         self.stats["slot_token_slots"] += self.slots
         for r in active:
+            if self._req[r] is None:
+                continue  # preempted mid-step (paged block pressure)
             tok = int(nxt[r])
             self._generated[r].append(tok)
             self._logprobs[r].append(float(lps[r]))
@@ -1262,7 +1296,9 @@ class ContinuousBatcher:
         ids = np.concatenate([self._pending[:, None], props], axis=1)
         logits = self._decode_multi(jnp.asarray(ids))
         self.rng, step_rng = jax.random.split(self.rng)
-        ntok = jnp.asarray([len(g) for g in self._generated], jnp.int32)
+        ntok = jnp.asarray(
+            self._ntok_base + np.asarray(
+                [len(g) for g in self._generated], np.int32), jnp.int32)
         any_penalized = (np.any(self._rep != 1.0)
                          or np.any(self._pres != 0.0)
                          or np.any(self._freq != 0.0)
@@ -1297,6 +1333,8 @@ class ContinuousBatcher:
         self.stats["spec_rounds"] = self.stats.get("spec_rounds", 0) \
             + len(active)
         for r in active:
+            if self._req[r] is None:
+                continue  # preempted mid-step (paged block pressure)
             n_r = int(n_acc[r])
             self.stats["spec_accepted"] = self.stats.get(
                 "spec_accepted", 0) + n_r
@@ -1511,6 +1549,12 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._refcnt = np.zeros(self._nblk, np.int64)
         self._tables = np.full((slots, self._mb), self._nblk, np.int32)
         self._nalloc = np.zeros(slots, np.int64)
+        # preempt-and-recompute bookkeeping: uid -> stash of the
+        # pre-preemption state (original prompt, committed tokens +
+        # logprobs, seeded-chain offset) for completion stitching and
+        # exact seeded resumption
+        self._preempted: dict[int, dict] = {}
+        self.stats["preemptions"] = 0
 
     # ------------------------------------------------------ model hooks
     def _build_batched_model(self, model_cfg, precision):
@@ -1538,7 +1582,12 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def _ensure_blocks(self, r: int, pos_end: int) -> None:
         """Grow slot ``r``'s table to cover logical positions
-        [0, pos_end), evicting LRU parked sessions under pressure.
+        [0, pos_end), reclaiming under pressure in escalation order:
+        evict LRU parked sessions, then PREEMPT the youngest plain
+        active request (free its blocks, requeue it for re-prefill —
+        the vLLM recompute policy; greedy and seeded-sampled outputs
+        are bit-identical to the uninterrupted run, unseeded sampled
+        rows redraw from the same law), and only then raise.
         Capped at the table width: a speculative round straddling the
         context end asks for pos + k + 1 > max_seq_len, whose excess
         writes the in-kernel flat clamp already piles on Lp-1 — they
@@ -1548,12 +1597,17 @@ class PagedContinuousBatcher(ContinuousBatcher):
             # evicting a fork-shared template may free zero blocks
             # (refcounts stay > 0) — keep evicting until one frees
             while not self._free_list:
-                if self._evict_lru_parked() is None:
+                if self._evict_lru_parked() is not None:
+                    continue
+                v = self._preempt_victim(exclude=r)
+                if v is None:
                     raise RuntimeError(
                         f"KV block pool exhausted ({self._nblk} blocks "
-                        f"of {self._page} tokens, all in use and no "
-                        "parked session evictable) — raise page_blocks "
+                        f"of {self._page} tokens, all in use, no "
+                        "parked session evictable and no plain active "
+                        "request preemptible) — raise page_blocks "
                         "or lower concurrency")
+                self._preempt_slot(v)
             b = self._free_list.pop()
             self._tables[r, int(self._nalloc[r])] = b
             self._refcnt[b] = 1
@@ -1585,6 +1639,75 @@ class PagedContinuousBatcher(ContinuousBatcher):
             self.cache = _paged_copy_block(
                 self.cache, jnp.int32(int(self._tables[src, full])),
                 jnp.int32(int(self._tables[dst, full])), self._page)
+
+    def _preempt_victim(self, exclude: int) -> int | None:
+        """The youngest (latest-admitted, LIFO — least work lost) plain
+        active slot. keep/session/prefix requests are never victims:
+        their context lives partly in resident KV (earlier turns, a
+        shared template) and cannot be reconstructed from the request
+        alone."""
+        best, best_uid = None, -1
+        for s in self.active_slots:
+            req = self._req[s]
+            if s == exclude or req.keep or req.session is not None \
+                    or req.prefix is not None:
+                continue
+            if req.uid > best_uid:
+                best, best_uid = s, req.uid
+        return best
+
+    def _preempt_slot(self, v: int) -> None:
+        """Free slot ``v``'s blocks and requeue its request for
+        re-prefill: the requeued prompt is original prompt + committed
+        tokens MINUS the pending one (whose K/V was never ingested) —
+        re-admission's first sample then re-derives the pending token
+        (identical under greedy and seeded rows via the _ntok_base
+        chain offset; unseeded sampled rows redraw from the same law).
+        Committed tokens/logprobs stash per-uid for completion
+        stitching; repeated preemption of the same request
+        accumulates."""
+        req = self._req[v]
+        gen = self._generated[v]
+        lps = self._logprobs[v]
+        stash = self._preempted.get(req.uid)
+        if stash is None:
+            stash = {"prompt": req.prompt, "tokens": [], "logprobs": [],
+                     "ntok_base": 0}
+            self._preempted[req.uid] = stash
+        # committed = everything but the pending rider (gen[-1]); its
+        # draw is re-made at re-admission (chain position preserved)
+        stash["tokens"] += gen[:-1]
+        stash["logprobs"] += lps[:-1]
+        stash["ntok_base"] += len(gen) - 1
+        requeued = dataclasses.replace(
+            req,
+            prompt=list(req.prompt) + gen[:-1],
+            max_new_tokens=req.max_new_tokens - (len(gen) - 1))
+        self._req[v] = None
+        self._rep[v], self._pres[v], self._freq[v] = 1.0, 0.0, 0.0
+        self._top_p[v], self._min_p[v] = self.top_p, self.min_p
+        self._seed[v] = -1
+        self._ntok_base[v] = 0
+        self._bias[v] = 0.0
+        self._has_bias[v] = False
+        self._free_slot_blocks(v)
+        self.queue.appendleft(requeued)
+        self.stats["preemptions"] += 1
+
+    def _post_admission_state(self, r: int, req: Request) -> None:
+        stash = self._preempted.get(req.uid)
+        if stash is None:
+            return
+        # resume the seeded fold_in chain where the preempted run left
+        # off, and restore the GENERATED-only penalty context: the
+        # stashed tokens ride inside req.prompt (so _counts — the
+        # repetition context — already has them) but OpenAI presence/
+        # frequency must keep scoring them as generated output
+        self._ntok_base[r] = stash["ntok_base"]
+        if stash["tokens"] and (req.presence_penalty != 0.0
+                                or req.frequency_penalty != 0.0):
+            np.add.at(self._gen_counts[r],
+                      np.asarray(stash["tokens"], np.int64), 1.0)
 
     def _phys_row(self, r: int) -> np.ndarray:
         """(max_seq_len,) physical token indices of slot ``r`` (OOB
@@ -1632,6 +1755,15 @@ class PagedContinuousBatcher(ContinuousBatcher):
         done = super()._maybe_finish(r, token)
         if done is not None and done.session is None:
             self._free_slot_blocks(r)
+        if done is not None:
+            stash = self._preempted.pop(done.uid, None)
+            if stash is not None:
+                # stitch the pre-preemption span back: the consumer
+                # sees ONE completion for the original request
+                done = dataclasses.replace(
+                    done, prompt=stash["prompt"],
+                    tokens=stash["tokens"] + done.tokens,
+                    logprobs=stash["logprobs"] + done.logprobs)
         return done
 
     def cancel(self, uid: int) -> bool:
@@ -1641,6 +1773,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         ok = super().cancel(uid)
         if ok and slot is not None:
             self._free_slot_blocks(slot)
+        if ok:
+            self._preempted.pop(uid, None)
         return ok
 
     def _evict_lru_parked(self, force: bool = False) -> int | None:
@@ -1664,14 +1798,10 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 f"KV blocks but the pool holds {self._nblk} — raise "
                 "page_blocks")
 
-    def can_preload(self, prompt_len: int | None = None) -> bool:
-        """Slot capacity AND block capacity: a free slot is worthless
-        if the pool cannot hold the template — preload() would raise
-        pool-exhausted and the caller's graceful fallback (n plain
-        submits) would never engage."""
-        if not super().can_preload():
-            return False
-        # blocks reclaimable without touching queued continuations
+    def _reclaimable_blocks(self) -> int:
+        """Blocks that LRU eviction could free right now — parked
+        entries not referenced by queued continuations, counting only
+        their sole-owner (refcount-1) blocks."""
         queued = {q.session for q in self.queue if q.session is not None}
         queued |= {q.prefix for q in self.queue if q.prefix is not None}
         reclaimable = 0
@@ -1681,13 +1811,40 @@ class PagedContinuousBatcher(ContinuousBatcher):
             reclaimable += sum(
                 1 for j in range(int(self._nalloc[r]))
                 if self._refcnt[int(self._tables[r, j])] == 1)
+        return reclaimable
+
+    def can_preload(self, prompt_len: int | None = None) -> bool:
+        """Slot capacity AND block capacity: a free slot is worthless
+        if the pool cannot hold the template — preload() would raise
+        pool-exhausted and the caller's graceful fallback (n plain
+        submits) would never engage."""
+        if not super().can_preload():
+            return False
         need = (self._blocks_needed(prompt_len)
                 if prompt_len is not None else 1)
-        return len(self._free_list) + reclaimable >= need
+        return len(self._free_list) + self._reclaimable_blocks() >= need
+
+    def _can_admit(self, req: Request) -> bool:
+        """Block-budget admission gate: while other requests are
+        draining, a fresh request waits until the pool can hold its
+        prompt + first decode block — admitting early would just
+        preempt it (or someone else) immediately. With nothing active
+        the gate opens unconditionally: nothing will ever drain, so
+        admission must proceed and _ensure_blocks either reclaims
+        (evict/preempt) or raises the honest exhaustion error."""
+        if not self.active_slots:
+            return True
+        # a fork's prompt is just its turn remainder (the template is
+        # shared/aliased); +1 covers the possible partial-block copy
+        need = self._blocks_needed(len(req.prompt) + 1) + (
+            1 if req.prefix is not None else 0)
+        return len(self._free_list) + self._reclaimable_blocks() >= need
 
     # -------------------------------------------------- batched steps
     def _decode(self, ids):
         for r in self.active_slots:
+            if self._req[r] is None:
+                continue  # preempted by an earlier row's _ensure_blocks
             self._ensure_blocks(r, int(self._pos[r]) + 1)
         logits, self.cache = _paged_decode_step(
             self.model, self.params, self.cache, ids,
@@ -1697,11 +1854,32 @@ class PagedContinuousBatcher(ContinuousBatcher):
     def _decode_multi(self, ids):
         S = int(ids.shape[1])
         for r in self.active_slots:
+            if self._req[r] is None:
+                continue  # preempted by an earlier row's _ensure_blocks
             self._ensure_blocks(r, int(self._pos[r]) + S)
         logits, self.cache = _paged_decode_multi(
             self._model_multi, self.params, self.cache, ids,
             jnp.asarray(self._tables))
         return logits
+
+    def new_tokens_since(self, seen: dict[int, int]) -> dict[int, list[int]]:
+        """Preemption-aware streaming tap: a consumer's seen-count is
+        ABSOLUTE over the request's full output, but a requeued
+        request's _generated restarts after its committed span folded
+        into the prompt — so index into stash + generated, keeping
+        deltas gap- and duplicate-free across preemptions."""
+        out: dict[int, list[int]] = {}
+        for r in self.active_slots:
+            uid = self._req[r].uid
+            n = seen.get(uid)
+            if n is None:
+                continue
+            stash = self._preempted.get(uid)
+            full = (stash["tokens"] + self._generated[r]
+                    if stash else self._generated[r])
+            if len(full) > n:
+                out[uid] = full[n:]
+        return out
 
 
 # ------------------------------------------------------ seq2seq (t5) serving

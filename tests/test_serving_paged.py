@@ -177,13 +177,29 @@ def test_paged_pool_bounds_and_exhaustion(setup):
     p = _paged(setup, slots=2, page_blocks=3)
     with pytest.raises(ValueError, match="KV blocks"):
         p.submit(list(range(2, 30)), 10)
-    # two requests that fit alone but not together, nothing evictable:
-    # the step raises pool-exhausted instead of corrupting
+    # two requests that fit alone but not together: the block-budget
+    # admission gate serializes them — BOTH complete correctly (the
+    # r5 upgrade from raising pool-exhausted mid-flight)
     p2 = _paged(setup, slots=2, page_blocks=3)
-    p2.submit([5] * 10, 8)
-    p2.submit([7] * 10, 8)
+    d = _dense(setup, slots=2)
+    refs = {}
+    for prompt, n in (([5] * 10, 8), ([7] * 10, 8)):
+        u = d.submit(prompt, n)
+        refs[p2.submit(prompt, n)] = u
+    ref_done = {c.uid: c.tokens for c in d.run()}
+    got = {c.uid: c.tokens for c in p2.run()}
+    for pu, du in refs.items():
+        assert got[pu] == ref_done[du]
+    # TRUE exhaustion still raises honestly: a resumed session's growth
+    # with no parked entry to evict and no plain active to preempt
+    # (session-resumed rows are never victims)
+    p3 = _paged(setup, slots=3, page_blocks=2)
+    u1 = p3.submit([5] * 9, 5, keep=True)  # parks at pos 13 (2 blocks)
+    c1 = {c.uid: c for c in p3.run()}[u1]
+    u2 = p3.submit([7] * 6, 12, session=c1.session)  # grows past 16
+    del u2
     with pytest.raises(RuntimeError, match="pool exhausted"):
-        list(p2.run())
+        list(p3.run())
 
 
 def test_paged_eviction_recycles_blocks(setup):
@@ -252,6 +268,94 @@ def test_paged_can_preload_accounts_for_blocks(setup):
     d.submit([5] * 20, 12)
     d.step()
     assert d.can_preload(9)
+
+
+def test_preemption_recompute_greedy_parity(setup):
+    """Block pressure preempts the YOUNGEST plain active request
+    (vLLM's recompute policy: free its blocks, requeue, re-prefill) —
+    and every request, preempted included, still produces exactly the
+    dense batcher's tokens."""
+    reqs = [([5, 9, 2, 14, 3, 7, 11, 2, 4], 12),
+            ([8, 1, 6, 12, 2, 9, 4, 4, 7], 12),
+            ([3, 3, 10, 5, 13, 2, 8, 1, 6], 12)]
+    d = _dense(setup, slots=4)
+    du = [d.submit(p, n) for p, n in reqs]
+    ref = {c.uid: c.tokens for c in d.run()}
+    # pool of 6 blocks: three 2-block admissions fill it; every row's
+    # growth past position 16 must reclaim — the third (youngest)
+    # request gets preempted and recomputed
+    p = _paged(setup, slots=4, page_blocks=6)
+    pu = [p.submit(q, n) for q, n in reqs]
+    got = {c.uid: c for c in p.run()}
+    for a, b in zip(du, pu):
+        assert ref[a] == got[b].tokens, (ref[a], got[b].tokens)
+        assert len(got[b].logprobs) == len(got[b].tokens)
+        assert got[b].prompt == reqs[pu.index(b)][0]  # stitched prompt
+    assert p.stats["preemptions"] >= 1
+    assert p._preempted == {}  # every stash consumed
+
+
+def test_preempted_seeded_request_reproduces_exactly(setup):
+    """A SEEDED sampled request that gets preempted and recomputed
+    emits byte-identical tokens to its uninterrupted run — the
+    _ntok_base chain offset resumes fold_in(PRNGKey(seed), n) exactly
+    where the preempted run left off."""
+    victim = ([4, 11, 2, 9, 6, 1, 13, 5, 3], 12)
+    kw = dict(temperature=1.1, seed=77)
+    alone = _paged(setup, slots=1, page_blocks=6)
+    u0 = alone.submit(victim[0], victim[1], **kw)
+    ref = {c.uid: c for c in alone.run()}[u0].tokens
+
+    p = _paged(setup, slots=4, page_blocks=6)
+    p.submit([5, 9, 2, 14, 3, 7, 11, 2, 4], 12)
+    p.submit([8, 1, 6, 12, 2, 9, 4, 4, 7], 12)
+    u = p.submit(victim[0], victim[1], **kw)  # youngest → the victim
+    got = {c.uid: c for c in p.run()}[u].tokens
+    assert p.stats["preemptions"] >= 1
+    assert got == ref
+
+
+def test_streaming_across_preemption_no_gaps_or_dupes(setup):
+    """new_tokens_since uses ABSOLUTE indices over stash + generated,
+    so a streaming consumer polling across a preemption sees every
+    token exactly once, and the accumulated stream equals the final
+    stitched completion."""
+    p = _paged(setup, slots=4, page_blocks=6)
+    p.submit([5, 9, 2, 14, 3, 7, 11, 2, 4], 12)
+    p.submit([8, 1, 6, 12, 2, 9, 4, 4, 7], 12)
+    u = p.submit([3, 3, 10, 5, 13, 2, 8, 1, 6], 12)  # the victim
+    seen = {u: 0}
+    streamed: list[int] = []
+    done = None
+    while done is None or p.active_slots or p.queue:
+        for tap in p.new_tokens_since(seen).values():
+            streamed += tap
+            seen[u] += len(tap)
+        for c in p.step():
+            if c.uid == u:
+                done = c
+        if done is not None and not p.active_slots and not p.queue:
+            break
+    assert p.stats["preemptions"] >= 1
+    # stream + finish-flush tail == the stitched completion exactly
+    assert streamed == done.tokens[:len(streamed)]
+    assert streamed + done.tokens[len(streamed):] == done.tokens
+    assert len(done.tokens) == 12
+
+
+def test_keep_requests_never_preempted(setup):
+    """keep/session/prefix requests hold context in resident KV that a
+    re-prefill cannot reconstruct — they are never preemption victims
+    (the plain neighbor is)."""
+    p = _paged(setup, slots=4, page_blocks=6)
+    uk = p.submit([5, 9, 2, 14, 3, 7, 11, 2, 4], 12, keep=True)
+    p.submit([8, 1, 6, 12, 2, 9, 4, 4, 7], 12)
+    up = p.submit([3, 3, 10, 5, 13, 2, 8, 1, 6], 12)  # youngest plain
+    done = {c.uid: c for c in p.run()}
+    assert p.stats["preemptions"] >= 1
+    assert done[uk].session is not None  # the kept session survived
+    # and the preempted plain request still completed in full
+    assert len(done[up].tokens) == 12
 
 
 def test_paged_rejects_non_llama(setup):

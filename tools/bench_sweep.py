@@ -60,6 +60,8 @@ ARMS: list[tuple[str, list[str]]] = [
     ("serve_mixed", ["--model", "llama", "--serve", "64"]),
     ("serve_mixed_spec", ["--model", "llama", "--serve", "64",
                           "--serve-spec", "4"]),
+    ("serve_mixed_paged", ["--model", "llama", "--serve", "64",
+                           "--serve-paged", "128"]),
     ("serve_chat_sessions", ["--model", "llama", "--serve", "32",
                              "--serve-turns", "4"]),
     ("serve_chat_resend", ["--model", "llama", "--serve", "32",

@@ -125,7 +125,37 @@ def _exact_arg_bytes(cfg, mesh, mesh_cfg) -> int:
     return total
 
 
-def measure(n_devices: int, batch_per_device: int = 1) -> dict:
+TPU_TOPOLOGY_FOR = {4: "v5e:2x2x1", 8: "v5e:4x2x1", 16: "v5e:4x4x1",
+                    32: "v5e:8x4x1"}
+
+
+def _devices_for(n_devices: int, platform: str):
+    """CPU fake devices, or REAL v5e topology devices (round-5
+    discovery: the local libtpu serves deviceless AOT, so the 7B step
+    can compile against ACTUAL TPU buffer assignment — temps become a
+    measurement of the compiler's allocation, not a CPU-arena
+    extrapolation)."""
+    import jax
+
+    if platform == "tpu":
+        from jax.experimental import topologies
+
+        name = TPU_TOPOLOGY_FOR.get(n_devices)
+        if name is None:
+            raise SystemExit(f"no v5e topology mapped for {n_devices}")
+        topo = topologies.get_topology_desc(topology_name=name,
+                                            platform="tpu")
+        return list(topo.devices)
+    devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise SystemExit(
+            f"need {n_devices} fake devices "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    return devices[:n_devices]
+
+
+def measure(n_devices: int, batch_per_device: int = 1,
+            platform: str = "cpu") -> dict:
     """Per-device HBM for the llama2_7b step on an ``n_devices`` mesh.
 
     Two-part methodology (each part using the tool best suited to it):
@@ -152,11 +182,7 @@ def measure(n_devices: int, batch_per_device: int = 1) -> dict:
     from pytorch_distributed_train_tpu.config import get_preset
     from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
 
-    devices = jax.devices("cpu")
-    if len(devices) < n_devices:
-        raise SystemExit(
-            f"need {n_devices} fake devices "
-            f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    devices = _devices_for(n_devices, platform)
     cfg = get_preset("llama2_7b")
     # Pin the attention impl the TPU run would take: 'auto' resolves to the
     # chunked flash-style path at seq 4096 on TPU backends; letting the
@@ -187,13 +213,22 @@ def measure(n_devices: int, batch_per_device: int = 1) -> dict:
     residual = b_loc * cfg.model.max_seq_len * (cfg.model.hidden_size // tp) * 2
     res = {
         "n_devices": n_devices,
+        "platform": platform,
         "mesh": {k: v for k, v in mesh.shape.items() if v > 1},
         "batch_global": batch_global,
         "compile_s": round(time.time() - t0, 1),
         "arg_bytes": int(arg_bytes),
-        "temp_cpu_upper_bytes": int(C + W * L),
-        "temp_tpu_est_bytes": int(max(C, 0) + W + residual * L),
     }
+    if platform == "tpu":
+        # REAL v5e buffer assignment: the slope model needs no arena
+        # correction — C + W*L is what the TPU compiler itself would
+        # allocate at L layers (linearity of the remat regions is the
+        # only extrapolation left).
+        res["temp_tpu_est_bytes"] = int(max(C + W * L, 0))
+        res["temp_cpu_upper_bytes"] = res["temp_tpu_est_bytes"]
+    else:
+        res["temp_cpu_upper_bytes"] = int(C + W * L)
+        res["temp_tpu_est_bytes"] = int(max(C, 0) + W + residual * L)
     res["resident_bytes"] = res["arg_bytes"] + res["temp_tpu_est_bytes"]
     res["resident_upper_bytes"] = res["arg_bytes"] + res["temp_cpu_upper_bytes"]
     return res
@@ -207,6 +242,9 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--mesh-devices", type=int, nargs="+", default=[8, 16, 32])
     p.add_argument("--batch-per-device", type=int, default=1)
+    p.add_argument("--platform", default="cpu", choices=["cpu", "tpu"],
+                   help="tpu = deviceless v5e-topology AOT (real TPU "
+                        "buffer assignment; needs the local libtpu)")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
@@ -217,7 +255,7 @@ def main() -> None:
 
     rows = []
     for n in args.mesh_devices:
-        r = measure(n, args.batch_per_device)
+        r = measure(n, args.batch_per_device, args.platform)
         rows.append(r)
         print(f"[memfit] {n} devices {r['mesh']}: args "
               f"{fmt_gb(r['arg_bytes'])} GiB + temps est "

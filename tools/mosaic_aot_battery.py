@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Compile every Pallas kernel variant FOR REAL TPU — no device needed.
+
+Until round 5 the Pallas flash-attention kernels were validated in
+interpret mode only: the axon tunnel hangs on RUNTIME Mosaic compiles
+(BASELINE.md caveat), and four rounds of wedged lease meant the kernels
+had never been through the actual Mosaic -> TPU pipeline. This tool
+closes most of that gap deviceless: `jax.experimental.topologies` +
+the local libtpu compile AOT against a v5e topology, so every kernel
+variant below runs the REAL Mosaic lowering, Mosaic->LLO, vector
+layout assignment, and XLA:TPU buffer assignment. Compile success +
+cost analysis is not execution — numerics on hardware remain pending —
+but it eliminates the entire class of "kernel won't build for TPU"
+failures (unsupported ops, layout constraints, VMEM overflows,
+misaligned block shapes) that interpret mode cannot see.
+
+Writes MOSAIC_AOT.json: per-variant ok/error + cost/memory analysis.
+
+Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/mosaic_aot_battery.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _topology():
+    from jax.experimental import topologies
+
+    return topologies.get_topology_desc(topology_name="v5e:2x2x1",
+                                        platform="tpu")
+
+
+def _compile(fn, args, shardings=None) -> dict:
+    import jax
+
+    t0 = time.time()
+    try:
+        lowered = jax.jit(fn).lower(*args)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ma = compiled.memory_analysis()
+        return {
+            "ok": True,
+            "compile_s": round(time.time() - t0, 1),
+            "bytes_accessed_mib": round(
+                float(ca.get("bytes accessed", 0.0)) / 2**20, 2),
+            "temp_mib": round(
+                getattr(ma, "temp_size_in_bytes", 0) / 2**20, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — record, don't crash battery
+        return {"ok": False, "compile_s": round(time.time() - t0, 1),
+                "error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_train_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_chunk,
+    )
+
+    topo = _topology()
+    dev0 = topo.devices[0]
+    sh1 = jax.sharding.SingleDeviceSharding(dev0)
+
+    B, S, H, D = 1, 1024, 4, 64
+    Hkv = 2  # GQA variants: 4 query heads over 2 KV heads
+
+    def sds(shape, dtype=jnp.bfloat16):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh1)
+
+    q = sds((B, S, H, D))
+    kv = sds((B, S, H, D))
+    kv_g = sds((B, S, Hkv, D))
+
+    out = {"tool": "mosaic_aot_battery", "topology": "v5e:2x2x1",
+           "date": time.strftime("%Y-%m-%d"),
+           "note": ("AOT Mosaic->TPU compile validation (deviceless); "
+                    "proves the kernels build for real v5e — execution "
+                    "numerics still pending a healthy lease"),
+           "variants": {}}
+    V = out["variants"]
+
+    # ---- forward variants
+    V["fwd.causal"] = _compile(
+        functools.partial(flash_attention, causal=True), (q, kv, kv))
+    V["fwd.full"] = _compile(
+        functools.partial(flash_attention, causal=False), (q, kv, kv))
+    V["fwd.causal.gqa"] = _compile(
+        functools.partial(flash_attention, causal=True), (q, kv_g, kv_g))
+    V["fwd.causal.window256"] = _compile(
+        functools.partial(flash_attention, causal=True, window=256),
+        (q, kv, kv))
+
+    # ---- backward variants (grad through the custom VJP = both bwd
+    # kernels: dq and the accumulating dkv)
+    def loss(q_, k_, v_, **kw):
+        return flash_attention(q_, k_, v_, **kw).astype(jnp.float32).sum()
+
+    V["bwd.causal"] = _compile(
+        jax.grad(functools.partial(loss, causal=True), argnums=(0, 1, 2)),
+        (q, kv, kv))
+    V["bwd.causal.gqa"] = _compile(
+        jax.grad(functools.partial(loss, causal=True), argnums=(0, 1, 2)),
+        (q, kv_g, kv_g))
+    V["bwd.causal.window256"] = _compile(
+        jax.grad(functools.partial(loss, causal=True, window=256),
+                 argnums=(0, 1, 2)),
+        (q, kv, kv))
+
+    # ---- ring chunk kernel (traced global positions, GQA unexpanded)
+    qpos = jax.ShapeDtypeStruct((256,), jnp.int32, sharding=sh1)
+    kpos = jax.ShapeDtypeStruct((256,), jnp.int32, sharding=sh1)
+    V["chunk.causal.gqa"] = _compile(
+        functools.partial(flash_attention_chunk, causal=True),
+        (sds((B, 256, H, D)), sds((B, 256, Hkv, D)),
+         sds((B, 256, Hkv, D)), qpos, kpos))
+
+    # ---- ring attention end-to-end: Mosaic INSIDE shard_map with
+    # ppermute collectives over a real 4-device v5e mesh — the
+    # long-context production path. ring_attention_local is called
+    # directly with interpret=False (the public wrapper's impl gating
+    # keys interpret on the RUNTIME backend, which is CPU here; the
+    # point of this battery is the TPU lowering).
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_train_tpu.ops.ring_attention import (
+        ring_attention_local,
+    )
+
+    mesh = Mesh(np.asarray(topo.devices).reshape(4), ("context",))
+    seq_spec = P(None, "context", None, None)
+    seq_sh = NamedSharding(mesh, seq_spec)
+
+    def ring_fn(q_, k_, v_):
+        body = functools.partial(
+            ring_attention_local, axis_name="context", axis_size=4,
+            causal=True, chunk_impl="pallas", interpret=False)
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(seq_spec, seq_spec, seq_spec),
+                             out_specs=seq_spec,
+                             check_vma=False)(q_, k_, v_)
+
+    V["ring.pallas.4dev"] = _compile(
+        ring_fn,
+        (jax.ShapeDtypeStruct((B, 2048, H, D), jnp.bfloat16,
+                              sharding=seq_sh),
+         jax.ShapeDtypeStruct((B, 2048, Hkv, D), jnp.bfloat16,
+                              sharding=seq_sh),
+         jax.ShapeDtypeStruct((B, 2048, Hkv, D), jnp.bfloat16,
+                              sharding=seq_sh)))
+
+    n_ok = sum(1 for v in V.values() if v["ok"])
+    out["summary"] = f"{n_ok}/{len(V)} variants compile for v5e"
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MOSAIC_AOT.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"summary": out["summary"],
+                      "failures": {k: v.get("error") for k, v in V.items()
+                                   if not v["ok"]}}))
+    return 0 if n_ok == len(V) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
