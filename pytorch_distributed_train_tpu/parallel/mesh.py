@@ -114,16 +114,25 @@ def device_grid(shape: tuple[int, ...], devices) -> "np.ndarray":
             return mesh_utils.create_hybrid_device_mesh(
                 ici, dcn, devices=devs)
         return mesh_utils.create_device_mesh(shape, devices=devs)
-    except ValueError as e:
-        # Unmappable shape for this physical topology (e.g. an axis split
-        # no torus assignment satisfies): train with the naive order
-        # rather than not at all — correctness is unaffected, only
-        # collective locality.
-        import warnings
+    except (ValueError, NotImplementedError) as e1:
+        # First escalation: many logical axes over few physical torus
+        # dims (the 6-axis mesh on a 4x4 v5e raises NotImplementedError
+        # unless physical axes may split) — still topology-aware.
+        err = f"first attempt: {e1}"
+        try:
+            if n_slices <= 1:
+                return mesh_utils.create_device_mesh(
+                    shape, devices=devs, allow_split_physical_axes=True)
+        except (ValueError, NotImplementedError) as e2:
+            err += f"; split-axes escalation: {e2}"
+    # Unmappable shape for this physical topology: train with the naive
+    # order rather than not at all — correctness is unaffected, only
+    # collective locality.
+    import warnings
 
-        warnings.warn(f"topology-aware mesh assignment failed ({e}); "
-                      "falling back to enumeration order")
-        return np.asarray(devs).reshape(shape)
+    warnings.warn(f"topology-aware mesh assignment failed ({err}); "
+                  "falling back to enumeration order")
+    return np.asarray(devs).reshape(shape)
 
 
 def build_mesh(mesh_cfg=None, devices: Sequence[jax.Device] | None = None) -> Mesh:

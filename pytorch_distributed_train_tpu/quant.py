@@ -72,8 +72,12 @@ def quantize_leaf_int4(w: jax.Array, group_size: int = 128) -> dict:
     """Symmetric int4 (±7) with GROUP-wise absmax scales along the
     largest axis.
 
-    Half the HBM of int8 again — the lever for HBM-bound decode, where
-    every token re-reads all params. int4's 15 levels need finer scale
+    Half the RESIDENT HBM of int8 again. Bandwidth caveat (round-5 AOT
+    finding, AOT_AB.json): on the dequantize-before-matmul path XLA
+    materializes the bf16 weights each step, so per-step HBM TRAFFIC
+    is bf16-sized regardless of storage width (int4's extra unpack
+    even adds temps) — the capacity win is real, the latency win needs
+    the fused in-VMEM dequant kernels (ops/quant_matmul.py). int4's 15 levels need finer scale
     granularity than a whole channel: groups of ``group_size`` along the
     array's largest axis (any grouping reconstructs the weight
     elementwise since decode dequantizes BEFORE the matmul — see

@@ -165,6 +165,32 @@ def main(argv=None) -> int:
          jax.ShapeDtypeStruct((B, 2048, Hkv, D), jnp.bfloat16,
                               sharding=seq_sh)))
 
+    # ---- fused weight-dequant GEMV kernels (ops/quant_matmul.py):
+    # the AOT_AB.json finding was that XLA materializes bf16 weights on
+    # the weight-only decode path; these variants prove the fused
+    # kernels (a) compile for v5e and (b) stream the QUANTIZED bytes —
+    # compare against the unfused dequant@matmul at identical shapes.
+    from pytorch_distributed_train_tpu import quant
+    from pytorch_distributed_train_tpu.ops.quant_matmul import (
+        quant_matmul,
+    )
+
+    Hq, Nq = 2048, 5504
+    wq = jax.ShapeDtypeStruct((Hq, Nq), jnp.float32)
+    q8 = jax.eval_shape(quant.quantize_leaf, wq)
+    q4 = jax.eval_shape(quant.quantize_leaf_int4, wq)
+    x1 = sds((1, Hq), jnp.bfloat16)
+    s8 = {k: sds(v.shape, v.dtype) for k, v in q8.items()}
+    s4 = {k: sds(v.shape, v.dtype) for k, v in q4.items()}
+    V["w8.gemv.fused"] = _compile(quant_matmul, (x1, s8))
+    V["w4.gemv.fused"] = _compile(quant_matmul, (x1, s4))
+    V["w4.gemv.unfused"] = _compile(
+        lambda x_, q_: x_ @ quant.dequantize_leaf(q_, jnp.bfloat16),
+        (x1, s4))
+    V["w8.gemv.unfused"] = _compile(
+        lambda x_, q_: x_ @ quant.dequantize_leaf(q_, jnp.bfloat16),
+        (x1, s8))
+
     n_ok = sum(1 for v in V.values() if v["ok"])
     out["summary"] = f"{n_ok}/{len(V)} variants compile for v5e"
     path = os.path.join(os.path.dirname(os.path.dirname(
