@@ -7,12 +7,18 @@ Selected via ``DataConfig.loader = "grain"``. Duck-types HostDataLoader
 (``steps_per_epoch``, ``epoch(epoch, start_batch)``) so the rest of the
 input pipeline — producer thread, HBM prefetch, sync checks — is shared.
 
-Reuses the datasets unchanged: a MapTransform pulls one record through the
-dataset's own ``get_item``/``get_batch`` (batch of 1), so augmentation
-(incl. the native imgops path) runs inside Grain's worker processes, off
-the GIL and off the step path. Augment randomness does NOT use Grain's
-sampler-position rng: each record's rng is keyed on (seed, epoch, record
-index), which makes mid-epoch resume draws bit-exact (see _LoadRecord).
+Reuses the datasets unchanged, with the transform SHAPE picked per
+dataset style (round-5 restructure — BASELINE.md "grain gap"):
+item-style datasets map per record through ``get_item`` then batch;
+``get_batch`` datasets batch the CHEAP index stream FIRST and make ONE
+``get_batch`` call per host batch — grain's per-element machinery
+amortizes by the batch size and the native batch decoder
+(native/jpegdec.cpp) gets real batches. Augment randomness does NOT
+use Grain's sampler-position rng: item-style records key their rng on
+(seed, epoch, record index) and batched loads on (seed, epoch, the
+batch's full index tuple) — both make mid-epoch resume draws bit-exact
+(resumes slice at batch boundaries, so batch composition is identical
+to the uninterrupted epoch; see _LoadRecord/_LoadBatch).
 
 Sharding/shuffle semantics mirror DistributedSampler (C16): per-epoch
 reseeded shuffle, host-sharded with drop_remainder — though the shuffle
@@ -72,8 +78,6 @@ class _IndexSource:
 def _make_load_transform(dataset, train: bool, seed: int, epoch: int):
     import grain.python as gp
 
-    item_style = getattr(dataset, "is_item_style", False)
-
     class _LoadRecord(gp.MapTransform):
         """Augment rng keyed on (seed, epoch, RECORD index) — not Grain's
         sampler-position rng — so a mid-epoch resume (which re-enumerates
@@ -83,12 +87,48 @@ def _make_load_transform(dataset, train: bool, seed: int, epoch: int):
         def map(self, i):
             rng = np.random.default_rng(
                 np.random.SeedSequence((seed, epoch, int(i))))
-            if item_style:
-                return dataset.get_item(int(i), rng)
-            batch1 = dataset.get_batch(np.asarray([int(i)]), rng, train)
-            return {k: v[0] for k, v in batch1.items()}
+            return dataset.get_item(int(i), rng)
 
     return _LoadRecord()
+
+
+def _make_batch_load_transform(dataset, train: bool, seed: int,
+                               epoch: int):
+    """Batched load for get_batch-style datasets: ONE dataset call per
+    host batch instead of per record.
+
+    Round-5 profiling (BASELINE.md, tools/grain_profile.py): the
+    per-record formulation cost ~1.1 ms/record of pure grain machinery
+    on this host — every record paid the map->stats->batch iterator
+    chain and a read-thread handoff, and the NATIVE batch decoder
+    (native/jpegdec.cpp) was reduced to batch-of-1 calls. Batching the
+    cheap index stream FIRST amortizes all of it by the batch size and
+    hands the native decoder real batches (its parallel_for threads
+    engage again on multi-core hosts).
+
+    Resume exactness is preserved at the granularity resumes actually
+    happen: epoch(start_batch=) slices at BATCH boundaries, so batch
+    composition is identical to the uninterrupted epoch and the rng —
+    keyed on (seed, epoch, the batch's FULL index tuple) — draws
+    identically. (The old per-record keying was stricter than any
+    resume point could observe; the batch-granular convention also
+    matches the threads loader's.)"""
+    import grain.python as gp
+
+    class _LoadBatch(gp.MapTransform):
+        def map(self, idx):
+            idx = np.asarray(idx, np.int64)
+            # key on the FULL index tuple, not idx[0]: weighted
+            # sampling with replacement can put the same record first
+            # in two different batches, and a first-index key would
+            # give both batches element-wise identical augmentation
+            # streams — whole-batch correlation. The full-composition
+            # key collides only when the entire batch repeats.
+            rng = np.random.default_rng(np.random.SeedSequence(
+                (seed, epoch) + tuple(int(t) for t in idx)))
+            return dataset.get_batch(idx, rng, train)
+
+    return _LoadBatch()
 
 
 class GrainHostDataLoader:
@@ -125,11 +165,13 @@ class GrainHostDataLoader:
             # by using the epoch's record order (host-sharded, seed+epoch
             # deterministic — data/sampler.py) as an explicit array
             # source, the same mechanism the mid-epoch resume path uses.
-            # One semantic nuance vs the threads loader: with replacement,
-            # a record drawn twice in an epoch reuses the same augment rng
-            # (keyed on the record index), where the threads loader draws
-            # fresh. Construction/validation shared with HostDataLoader
-            # (sampler.make_weighted_sampler).
+            # Augment-rng nuance vs the threads loader, per transform
+            # shape: ITEM-style records drawn twice in an epoch (with
+            # replacement) reuse the same per-record rng where the
+            # threads loader draws fresh; BATCHED get_batch loads key
+            # on the batch's full index tuple, so only an entirely
+            # repeated batch repeats its draws. Construction/validation
+            # shared with HostDataLoader (sampler.make_weighted_sampler).
             from pytorch_distributed_train_tpu.data.sampler import (
                 make_weighted_sampler,
             )
@@ -196,20 +238,39 @@ class GrainHostDataLoader:
         else:
             source = _IndexSource(len(self.dataset))
             order_sampler = self._sampler(epoch)
+        if getattr(self.dataset, "is_item_style", False):
+            # per-record load (PIL/item datasets), then batch
+            ops = [
+                _make_load_transform(self.dataset, self.train,
+                                     self.seed, epoch),
+                gp.Batch(batch_size=self.host_batch,
+                         drop_remainder=False),
+            ]
+            read = gp.ReadOptions(
+                num_threads=max(1, min(16, self.read_buffer)),
+                prefetch_buffer_size=self.read_buffer)
+        else:
+            # get_batch datasets: batch the CHEAP index stream first,
+            # then one dataset call per batch (_make_batch_load_
+            # transform docstring has the round-5 profiling story).
+            # Elements crossing grain's read threads are ints, so a
+            # deeper prefetch costs nothing and keeps the consumer fed.
+            ops = [
+                gp.Batch(batch_size=self.host_batch,
+                         drop_remainder=False),
+                _make_batch_load_transform(self.dataset, self.train,
+                                           self.seed, epoch),
+            ]
+            read = gp.ReadOptions(
+                num_threads=max(1, min(16, self.read_buffer)),
+                prefetch_buffer_size=max(
+                    self.read_buffer, 2 * self.host_batch))
         loader = gp.DataLoader(
             data_source=source,
             sampler=order_sampler,
-            operations=[
-                _make_load_transform(self.dataset, self.train,
-                                     self.seed, epoch),
-                gp.Batch(batch_size=self.host_batch, drop_remainder=False),
-            ],
+            operations=ops,
             worker_count=self.num_workers,
-            # Read threads capped at the prefetch depth (grain warns —
-            # and may error later — when threads can't all be in flight).
-            read_options=gp.ReadOptions(
-                num_threads=max(1, min(16, self.read_buffer)),
-                prefetch_buffer_size=self.read_buffer),
+            read_options=read,
         )
         n_steps = self.steps_per_epoch - start_batch
         for b, batch in enumerate(loader):

@@ -113,11 +113,12 @@ def quant_matmul(x: jax.Array, q: dict, *, interpret: bool = False,
         w, scale = q[quant._W4], q[quant._S]
         axis, G = quant._int4_grouping(w.shape, scale.shape)
         N = w.shape[1]
-        if w.ndim != 2 or axis != 1 or G != TILE_N or N % TILE_N:
+        if (w.ndim != 2 or w.shape[0] != H or axis != 1 or G != TILE_N
+                or N % TILE_N):
             raise ValueError(
-                f"W4 fused matmul needs a 2D weight grouped along axis "
-                f"1 with G == {TILE_N} and N % {TILE_N} == 0, got "
-                f"shape {w.shape}, axis {axis}, G {G}")
+                f"W4 fused matmul needs a 2D (H={H}, N) weight grouped "
+                f"along axis 1 with G == {TILE_N} and N % {TILE_N} == "
+                f"0, got shape {w.shape}, axis {axis}, G {G}")
         s2t = scale.reshape(H, N // G).T  # (NG, H): row g scales tile g
         out = pl.pallas_call(
             functools.partial(_w4_kernel, out_dtype=out_dtype),
@@ -133,12 +134,12 @@ def quant_matmul(x: jax.Array, q: dict, *, interpret: bool = False,
         )(x2, w, s2t)
     else:
         w, scale = q[quant._W], q[quant._S]
-        if w.ndim != 2 or w.shape[1] % TILE_N or scale.shape != (
-                1, w.shape[1]):
+        if (w.ndim != 2 or w.shape[0] != H or w.shape[1] % TILE_N
+                or scale.shape != (1, w.shape[1])):
             raise ValueError(
-                f"W8 fused matmul needs a 2D weight with per-output "
-                f"(1, N) scales and N % {TILE_N} == 0, got w "
-                f"{w.shape}, scale {scale.shape}")
+                f"W8 fused matmul needs a 2D (H={H}, N) weight with "
+                f"per-output (1, N) scales and N % {TILE_N} == 0, got "
+                f"w {w.shape}, scale {scale.shape}")
         N = w.shape[1]
         out = pl.pallas_call(
             functools.partial(_w8_kernel, out_dtype=out_dtype),

@@ -31,7 +31,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def _run_epoch(loader) -> tuple[int, float]:
     it = loader.epoch(0)
-    next(it)  # warm
+    if next(it, None) is None:  # warm
+        raise SystemExit(
+            "epoch yielded zero batches — shrink --batch or raise --n")
     t0 = time.perf_counter()
     seen = 0
     for b in it:
@@ -80,9 +82,14 @@ def main(argv=None) -> int:
 
         grain = GrainHostDataLoader(ds, cfg, train=True, num_hosts=1,
                                     host_id=0)
+        # Throughput epoch runs UNPROFILED — cProfile adds per-call
+        # overhead that would inflate exactly the gap this tool
+        # quantifies; a second, profiled epoch supplies the cost
+        # centers only.
+        seen_g, wall_g = _run_epoch(grain)
         prof = cProfile.Profile()
         prof.enable()
-        seen_g, wall_g = _run_epoch(grain)
+        _run_epoch(grain)
         prof.disable()
 
         s = io.StringIO()
