@@ -7,18 +7,19 @@ Selected via ``DataConfig.loader = "grain"``. Duck-types HostDataLoader
 (``steps_per_epoch``, ``epoch(epoch, start_batch)``) so the rest of the
 input pipeline — producer thread, HBM prefetch, sync checks — is shared.
 
-Reuses the datasets unchanged, with the transform SHAPE picked per
-dataset style (round-5 restructure — BASELINE.md "grain gap"):
-item-style datasets map per record through ``get_item`` then batch;
-``get_batch`` datasets batch the CHEAP index stream FIRST and make ONE
-``get_batch`` call per host batch — grain's per-element machinery
-amortizes by the batch size and the native batch decoder
-(native/jpegdec.cpp) gets real batches. Augment randomness does NOT
-use Grain's sampler-position rng: item-style records key their rng on
-(seed, epoch, record index) and batched loads on (seed, epoch, the
-batch's full index tuple) — both make mid-epoch resume draws bit-exact
-(resumes slice at batch boundaries, so batch composition is identical
-to the uninterrupted epoch; see _LoadRecord/_LoadBatch).
+Reuses the datasets unchanged. The element grain moves is a whole HOST
+BATCH of record indices (round-5 restructure — BASELINE.md "grain
+gap"): batching lives in the SOURCE, before grain's worker sharding,
+so batch composition is invariant to worker_count and a mid-epoch
+resume slices the epoch order at exact batch boundaries (see
+_BatchIndexSource for why operation-level gp.Batch cannot give
+either). One map call per batch also amortizes grain's per-element
+machinery by the batch size and hands the native batch decoder
+(native/jpegdec.cpp) real batches. Augment randomness does NOT use
+Grain's sampler-position rng: item-style records key their rng on
+(seed, epoch, record index) — bit-exact under ANY regrouping — and
+``get_batch`` loads on (seed, epoch, the batch's full index tuple),
+which the source's batch-boundary invariant makes resume-exact.
 
 Sharding/shuffle semantics mirror DistributedSampler (C16): per-epoch
 reseeded shuffle, host-sharded with drop_remainder — though the shuffle
@@ -61,69 +62,64 @@ def bounded_workers(requested: int, avail: int | None = None) -> int:
     return bounded
 
 
-class _IndexSource:
-    """Grain source yielding record indices; transforms do the real work
-    (keeps dataset objects out of the pickled source when possible)."""
+class _BatchIndexSource:
+    """Grain source over whole BATCHES of record indices.
 
-    def __init__(self, n: int):
-        self._n = n
+    Batching happens HERE — in the source, BEFORE grain's worker
+    sharding — which is the load-bearing design choice: grain
+    stride-shards the element stream across worker processes and runs
+    `gp.Batch` inside each worker, so batches formed by an operation
+    are composed of worker-strided subsequences and their composition
+    CHANGES with worker_count (and a mid-epoch resume that slices the
+    source contiguously reproduces neither the set nor the order the
+    uninterrupted run consumed). With one-element-per-batch sources,
+    workers stride over batches, grain's deterministic interleave
+    restores source order, and batch b is ALWAYS epoch-order slice
+    [b*B:(b+1)*B] — invariant to worker_count, exactly what
+    epoch(start_batch=) slicing assumes."""
+
+    def __init__(self, order: np.ndarray, batch: int):
+        self._order = order
+        self._batch = batch
 
     def __len__(self) -> int:
-        return self._n
+        return (len(self._order) + self._batch - 1) // self._batch
 
-    def __getitem__(self, i: int) -> int:
-        return int(i)
-
-
-def _make_load_transform(dataset, train: bool, seed: int, epoch: int):
-    import grain.python as gp
-
-    class _LoadRecord(gp.MapTransform):
-        """Augment rng keyed on (seed, epoch, RECORD index) — not Grain's
-        sampler-position rng — so a mid-epoch resume (which re-enumerates
-        the tail at shifted positions) reproduces the exact per-record
-        draws of the uninterrupted epoch."""
-
-        def map(self, i):
-            rng = np.random.default_rng(
-                np.random.SeedSequence((seed, epoch, int(i))))
-            return dataset.get_item(int(i), rng)
-
-    return _LoadRecord()
+    def __getitem__(self, b: int) -> np.ndarray:
+        return self._order[b * self._batch:(b + 1) * self._batch]
 
 
-def _make_batch_load_transform(dataset, train: bool, seed: int,
-                               epoch: int):
-    """Batched load for get_batch-style datasets: ONE dataset call per
-    host batch instead of per record.
+def _make_load_transform(dataset, item_style: bool, train: bool,
+                         seed: int, epoch: int):
+    """One MapTransform per host BATCH (an index array element).
 
-    Round-5 profiling (BASELINE.md, tools/grain_profile.py): the
-    per-record formulation cost ~1.1 ms/record of pure grain machinery
-    on this host — every record paid the map->stats->batch iterator
-    chain and a read-thread handoff, and the NATIVE batch decoder
-    (native/jpegdec.cpp) was reduced to batch-of-1 calls. Batching the
-    cheap index stream FIRST amortizes all of it by the batch size and
-    hands the native decoder real batches (its parallel_for threads
-    engage again on multi-core hosts).
+    get_batch datasets get ONE dataset call per batch — round-5
+    profiling (BASELINE.md, tools/grain_profile.py) measured
+    ~1.1 ms/record of pure grain machinery in the per-record
+    formulation, and batch-of-1 calls starved the native batch decoder
+    (native/jpegdec.cpp); whole-batch elements amortize the machinery
+    by the batch size and hand the decoder real batches. Their rng is
+    keyed on (seed, epoch, the batch's FULL index tuple) — the full
+    tuple, not idx[0], because weighted sampling with replacement can
+    repeat a first element across different batches.
 
-    Resume exactness is preserved at the granularity resumes actually
-    happen: epoch(start_batch=) slices at BATCH boundaries, so batch
-    composition is identical to the uninterrupted epoch and the rng —
-    keyed on (seed, epoch, the batch's FULL index tuple) — draws
-    identically. (The old per-record keying was stricter than any
-    resume point could observe; the batch-granular convention also
-    matches the threads loader's.)"""
+    Item-style records keep per-RECORD keying (seed, epoch, record
+    index): each record's augment draws are bit-exact regardless of
+    how batches regroup, the strongest reproducibility convention and
+    the one the threads loader's resume tests pin."""
     import grain.python as gp
 
     class _LoadBatch(gp.MapTransform):
         def map(self, idx):
             idx = np.asarray(idx, np.int64)
-            # key on the FULL index tuple, not idx[0]: weighted
-            # sampling with replacement can put the same record first
-            # in two different batches, and a first-index key would
-            # give both batches element-wise identical augmentation
-            # streams — whole-batch correlation. The full-composition
-            # key collides only when the entire batch repeats.
+            if item_style:
+                items = [
+                    dataset.get_item(int(i), np.random.default_rng(
+                        np.random.SeedSequence((seed, epoch, int(i)))))
+                    for i in idx
+                ]
+                return {k: np.stack([it[k] for it in items])
+                        for k in items[0]}
             rng = np.random.default_rng(np.random.SeedSequence(
                 (seed, epoch) + tuple(int(t) for t in idx)))
             return dataset.get_batch(idx, rng, train)
@@ -201,70 +197,47 @@ class GrainHostDataLoader:
             num_epochs=1,
         )
 
-    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
-        import grain.python as gp
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """This host's record order for the epoch, as one int64 array.
 
+        Weighted sampling has it materialized already; otherwise it is
+        enumerated from grain's IndexSampler (pure index math, no IO —
+        ~O(n) python at iterator construction, overlapped with compile
+        by the producer thread). An explicit order array is what lets
+        batching live in the SOURCE (see _BatchIndexSource) and resume
+        slice at exact batch boundaries."""
         if self.weighted is not None:
             self.weighted.set_epoch(epoch)
             n = self.steps_per_epoch * self.host_batch
-            # ndarray slice straight into grain (len/__getitem__ suffice;
-            # the load transform ints each element): no per-epoch
-            # million-object list build, compact worker pickles.
-            source: object = self.weighted.indices()[
-                start_batch * self.host_batch:n]
-            order_sampler = gp.IndexSampler(
-                num_records=len(source), shuffle=False,
-                seed=self.seed + epoch, num_epochs=1,
-                shard_options=gp.NoSharding(),
-            )
-        elif start_batch > 0:
-            # Mid-epoch resume: enumerate the epoch's record order from the
-            # sampler (pure index math), slice, and run a sequential pass —
-            # O(skip) index reads instead of materializing skipped batches
-            # through the workers. Data order AND augment draws match the
-            # uninterrupted epoch (the load transform keys its rng on the
-            # record index travelling through the sliced source).
-            sampler = self._sampler(epoch)
-            n = min(self.steps_per_epoch * self.host_batch,
-                    len(self.dataset) // self.num_hosts)
-            ids = [int(sampler[i].record_key)
-                   for i in range(start_batch * self.host_batch, n)]
-            source: object = ids
-            order_sampler = gp.IndexSampler(
-                num_records=len(ids), shuffle=False,
-                seed=self.seed + epoch, num_epochs=1,
-                shard_options=gp.NoSharding(),
-            )
-        else:
-            source = _IndexSource(len(self.dataset))
-            order_sampler = self._sampler(epoch)
-        if getattr(self.dataset, "is_item_style", False):
-            # per-record load (PIL/item datasets), then batch
-            ops = [
-                _make_load_transform(self.dataset, self.train,
-                                     self.seed, epoch),
-                gp.Batch(batch_size=self.host_batch,
-                         drop_remainder=False),
-            ]
-            read = gp.ReadOptions(
-                num_threads=max(1, min(16, self.read_buffer)),
-                prefetch_buffer_size=self.read_buffer)
-        else:
-            # get_batch datasets: batch the CHEAP index stream first,
-            # then one dataset call per batch (_make_batch_load_
-            # transform docstring has the round-5 profiling story).
-            # Elements crossing grain's read threads are ints, so a
-            # deeper prefetch costs nothing and keeps the consumer fed.
-            ops = [
-                gp.Batch(batch_size=self.host_batch,
-                         drop_remainder=False),
-                _make_batch_load_transform(self.dataset, self.train,
-                                           self.seed, epoch),
-            ]
-            read = gp.ReadOptions(
-                num_threads=max(1, min(16, self.read_buffer)),
-                prefetch_buffer_size=max(
-                    self.read_buffer, 2 * self.host_batch))
+            return np.asarray(self.weighted.indices()[:n], np.int64)
+        sampler = self._sampler(epoch)
+        n = min(self.steps_per_epoch * self.host_batch,
+                len(self.dataset) // self.num_hosts)
+        # Sharded IndexSamplers are indexed by GLOBAL stream position:
+        # shard s owns positions s, s+shard_count, ... (contiguous
+        # indexing silently REPEATS records — verified against grain
+        # 0.2.15, and the root of a multi-host resume bug in the
+        # pre-round-5 path).
+        return np.fromiter(
+            (sampler[self.host_id + k * self.num_hosts].record_key
+             for k in range(n)), np.int64, count=n)
+
+    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
+        import grain.python as gp
+
+        order = self._epoch_order(epoch)[start_batch * self.host_batch:]
+        source = _BatchIndexSource(order, self.host_batch)
+        order_sampler = gp.IndexSampler(
+            num_records=len(source), shuffle=False,
+            seed=self.seed + epoch, num_epochs=1,
+            shard_options=gp.NoSharding(),
+        )
+        ops = [_make_load_transform(
+            self.dataset, getattr(self.dataset, "is_item_style", False),
+            self.train, self.seed, epoch)]
+        read = gp.ReadOptions(
+            num_threads=max(1, min(16, self.read_buffer)),
+            prefetch_buffer_size=self.read_buffer)
         loader = gp.DataLoader(
             data_source=source,
             sampler=order_sampler,
@@ -272,10 +245,7 @@ class GrainHostDataLoader:
             worker_count=self.num_workers,
             read_options=read,
         )
-        n_steps = self.steps_per_epoch - start_batch
-        for b, batch in enumerate(loader):
-            if b >= n_steps:
-                break
+        for batch in loader:
             out = {k: np.asarray(v) for k, v in batch.items()}
             short = self.host_batch - len(next(iter(out.values())))
             if short > 0:

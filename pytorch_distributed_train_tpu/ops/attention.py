@@ -97,7 +97,17 @@ def _pallas_usable() -> bool:
     # tunnel's remote compile and must not re-open the lease-wedge.
     if (rec and rec.get("status")
             and "axon" in rec.get("jax_platforms_env", "")):
-        return rec["status"] == "ok"
+        if rec["status"] != "ok":
+            return False
+        # When the probe also timed the flash-vs-chunked A/B, auto must
+        # pick the measured WINNER: an ok-but-slower kernel (v5e probe
+        # 2026-08-02: flash 125.7ms vs chunked 17.7ms fwd+bwd) would
+        # otherwise silently regress every impl='auto' caller. Explicit
+        # impl='pallas' still forces the kernel for tuning work.
+        flash, chunked = rec.get("flash_ms"), rec.get("chunked_ms")
+        if flash is not None and chunked is not None:
+            return float(flash) <= float(chunked)
+        return True
     return False
 
 

@@ -1,0 +1,36 @@
+"""Deviceless-compile environment hygiene.
+
+When a parent python held a LIVE axon lease, its sitecustomize exports
+the device identity (TPU_WORKER_HOSTNAMES / TPU_TOPOLOGY=1x1 /
+TPU_ACCELERATOR_TYPE / ...) into os.environ and children inherit it;
+libtpu then rejects a deviceless ``get_topology_desc`` for a DIFFERENT
+topology (e.g. v5e:2x2x1) as conflicting. Tools that compile against a
+TPU topology without a device (tools/aot_ab.py, tools/memfit_7b.py,
+tools/mosaic_aot_battery.py) — and the test that gates them — must
+drop the inherited identity BEFORE any libtpu init, from one shared
+list so a newly leaked variable cannot silently diverge between them.
+"""
+
+from __future__ import annotations
+
+import os
+
+AXON_IDENTITY_VARS = (
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_WORKER_ID",
+    "TPU_TOPOLOGY",
+    "TPU_ACCELERATOR_TYPE",
+    "AXON_POOL_SVC_OVERRIDE",
+)
+
+
+def scrub_axon_identity(env: dict | None = None) -> dict:
+    """Remove a live-lease parent's exported device identity.
+
+    Mutates ``os.environ`` by default; pass an env dict (e.g. a
+    subprocess env about to be handed to ``subprocess.run``) to scrub
+    that instead. Returns the scrubbed mapping."""
+    target = os.environ if env is None else env
+    for var in AXON_IDENTITY_VARS:
+        target.pop(var, None)
+    return target

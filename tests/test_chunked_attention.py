@@ -311,6 +311,20 @@ class TestMosaicProbeGating:
             "status": "ok", "detail": "v= 256.0",
             "jax_platforms_env": "axon"}) is True
 
+    def test_ok_but_measured_slower_stays_gated(self, monkeypatch, tmp_path):
+        """An ok-but-slower kernel (the 2026-08-02 v5e A/B: flash 125.7ms
+        vs chunked 17.7ms) must not win impl='auto' on compilability alone."""
+        assert self._usable(monkeypatch, tmp_path, {
+            "status": "ok", "detail": "v= 256.0",
+            "jax_platforms_env": "axon",
+            "flash_ms": 125.65, "chunked_ms": 17.7}) is False
+
+    def test_ok_and_measured_faster_opens(self, monkeypatch, tmp_path):
+        assert self._usable(monkeypatch, tmp_path, {
+            "status": "ok", "detail": "v= 256.0",
+            "jax_platforms_env": "axon",
+            "flash_ms": 12.0, "chunked_ms": 17.7}) is True
+
     def test_axon_with_hang_record_stays_gated(self, monkeypatch, tmp_path):
         assert self._usable(monkeypatch, tmp_path, {
             "status": "hang", "detail": ">300s",

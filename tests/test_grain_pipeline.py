@@ -95,6 +95,35 @@ def test_multiprocess_workers(monkeypatch):
     assert batches[0]["image"].shape == (16, 8, 8, 3)
 
 
+def test_batch_composition_invariant_to_worker_count(monkeypatch):
+    """Batching lives in the SOURCE, so batch b is epoch-order slice
+    [b*B:(b+1)*B] under ANY worker_count — operation-level gp.Batch
+    would stride-shard records across workers and regroup them
+    (composition a function of worker_count, and resume slicing wrong).
+    Pins bit-exact equality of every batch between in-process and
+    2-process loading, plus a mid-epoch resume UNDER workers."""
+    from pytorch_distributed_train_tpu.data import grain_pipeline
+
+    monkeypatch.setattr(grain_pipeline.os, "cpu_count", lambda: 4)
+    ds = synthetic_images(64, 8, 10, seed=0)
+    base = dataclasses.replace(CFG, batch_size=8)
+    loaders = {
+        w: GrainHostDataLoader(ds, dataclasses.replace(base, num_workers=w),
+                               train=True, num_hosts=1, host_id=0)
+        for w in (0, 2)
+    }
+    a = list(loaders[0].epoch(1))
+    b = list(loaders[2].epoch(1))
+    assert len(a) == len(b) == 8
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x["image"], y["image"])
+        np.testing.assert_array_equal(x["label"], y["label"])
+    resumed = list(loaders[2].epoch(1, start_batch=5))
+    assert len(resumed) == 3
+    for x, y in zip(a[5:], resumed):
+        np.testing.assert_array_equal(x["image"], y["image"])
+
+
 def test_workers_bounded_by_host_cores():
     """The C17 partial's root cause (VERDICT r2 #6): grain worker
     PROCESSES on a core-starved host contend the consumer to a standstill

@@ -18,14 +18,30 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@functools.cache
 def _topology_available() -> bool:
-    try:
-        from jax.experimental import topologies
+    """Probe local-libtpu deviceless topology support IN A SUBPROCESS.
 
-        topologies.get_topology_desc(topology_name="v5e:2x2x1",
-                                     platform="tpu")
-        return True
-    except Exception:  # noqa: BLE001 — any failure = no local libtpu
+    Probing in-process would initialize libtpu inside the pytest parent,
+    and a parent that holds libtpu's process-level state breaks every
+    tool child's own init — the probe would pass here and then fail the
+    very tools it gates. The child scrubs the live-lease device identity
+    exactly as the tools do (see tools/aot_ab.py)."""
+    code = (
+        "from pytorch_distributed_train_tpu.utils.deviceless import"
+        " scrub_axon_identity\n"
+        "scrub_axon_identity()\n"
+        "from jax.experimental import topologies\n"
+        "topologies.get_topology_desc(topology_name='v5e:2x2x1',"
+        " platform='tpu')\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": ""}
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=120, env=env, cwd=ROOT).returncode == 0
+    except subprocess.TimeoutExpired:
         return False
 
 

@@ -38,6 +38,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pytorch_distributed_train_tpu.utils.deviceless import (  # noqa: E402
+    scrub_axon_identity,
+)
+
+scrub_axon_identity()
+
 
 def _probe_tpu_topology():
     """Can this sandbox compile deviceless against a TPU topology?

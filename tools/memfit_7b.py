@@ -31,6 +31,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pytorch_distributed_train_tpu.utils.deviceless import (  # noqa: E402
+    scrub_axon_identity,
+)
+
+scrub_axon_identity()
+
 HBM_PER_CHIP = {  # bytes, marketing GB -> usable ~= capacity here
     "v5e": 16 * 1024**3,
     "v5p": 95 * 1024**3,

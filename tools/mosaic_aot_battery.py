@@ -29,6 +29,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pytorch_distributed_train_tpu.utils.deviceless import (  # noqa: E402
+    scrub_axon_identity,
+)
+
+scrub_axon_identity()
+
 
 def _topology():
     from jax.experimental import topologies

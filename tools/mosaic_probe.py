@@ -50,7 +50,7 @@ _AB = r"""
 import sys, time, json
 sys.path.insert(0, {repo!r})
 import jax, jax.numpy as jnp
-from pytorch_distributed_train_tpu.ops.attention import attention
+from pytorch_distributed_train_tpu.ops.attention import dot_product_attention
 
 B, S, H, D = 4, 2048, 16, 128
 q = jnp.ones((B, S, H, D), jnp.bfloat16)
@@ -58,7 +58,7 @@ q = jnp.ones((B, S, H, D), jnp.bfloat16)
 
 def bench(impl):
     def loss(q):
-        return attention(q, q, q, causal=True, impl=impl).astype(
+        return dot_product_attention(q, q, q, causal=True, impl=impl).astype(
             jnp.float32).sum()
 
     step = jax.jit(jax.grad(loss))
@@ -100,6 +100,28 @@ def main() -> int:
 
     t0 = time.monotonic()
     status, detail = run_child(_CHILD, args.timeout)
+    # Carry forward previously measured A/B timings: a --skip-ab recheck
+    # (or a failed A/B child) must not erase the flash-vs-chunked record
+    # that keeps _pallas_usable's auto-gate honest — a timing-less "ok"
+    # would reopen a measured-slower kernel. Fresh A/B results below
+    # overwrite these.
+    prev_ab = {}
+    try:
+        with open(args.out) as f:
+            old = json.load(f)
+        # Backend identity must match: timings measured on a direct TPU
+        # say nothing about the tunnel (and vice versa) — relabeling
+        # them under the current env could reopen a kernel the current
+        # backend measured slower.
+        if (old.get("jax_platforms_env")
+                == os.environ.get("JAX_PLATFORMS", "")
+                and "flash_ms" in old and "chunked_ms" in old):
+            prev_ab = {"flash_ms": old["flash_ms"],
+                       "chunked_ms": old["chunked_ms"],
+                       "ab_measured": old.get("ab_measured",
+                                              old.get("probed"))}
+    except (OSError, ValueError):
+        pass
     rec = {
         "status": status,
         "detail": detail,
@@ -110,6 +132,7 @@ def main() -> int:
         # it was captured against the axon stack (the child inherits
         # this env) — an ok from a direct TPU must not open the tunnel.
         "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+        **prev_ab,
     }
     with open(args.out, "w") as f:
         json.dump(rec, f, indent=1)
@@ -126,6 +149,15 @@ def main() -> int:
                 row["chunked_ms"] = round(ab["chunked_ms"], 2)
                 row["speedup_vs_chunked"] = round(
                     ab["chunked_ms"] / ab["flash_ms"], 3)
+                # Persist the measured A/B into the record so
+                # _pallas_usable's auto-gate can pick the WINNER, not
+                # merely the compilable: an ok-but-slower kernel must
+                # not silently regress impl='auto' users.
+                rec["flash_ms"] = row["value"]
+                rec["chunked_ms"] = row["chunked_ms"]
+                rec["ab_measured"] = rec["probed"]
+                with open(args.out, "w") as f:
+                    json.dump(rec, f, indent=1)
             except (ValueError, KeyError):
                 row["ab_error"] = ab_detail[-300:]
         else:
