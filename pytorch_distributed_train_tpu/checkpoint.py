@@ -22,6 +22,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from pytorch_distributed_train_tpu.obs.spans import span
 from pytorch_distributed_train_tpu.train_state import TrainState
 
 
@@ -61,14 +62,19 @@ class CheckpointManager:
             self.mgr.delete(step)
         meta = {"epoch": epoch, "config": self.config_json,
                 **(extra_meta or {})}
-        saved = self.mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(_savable(state)),
-                meta=ocp.args.JsonSave(meta),
-            ),
-            force=force,
-        )
+        # The span covers the BLOCKING portion only: under async_save the
+        # TensorStore writes continue past it (their tail shows up in
+        # checkpoint.wait spans) — exactly the host-stall attribution the
+        # goodput ckpt bucket wants.
+        with span("checkpoint.save", step=step):
+            saved = self.mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(_savable(state)),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+                force=force,
+            )
         return bool(saved)
 
     def maybe_save(self, state: TrainState, *, epoch: int = 0,
@@ -103,13 +109,14 @@ class CheckpointManager:
                 and not self._ckpt_has(step, "ema_batch_stats")):
             # ckpt from before the stats mirror existed: re-seed below
             template.pop("ema_batch_stats")
-        restored = self.mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(template),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+        with span("checkpoint.restore", step=step):
+            restored = self.mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(template),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
         sav = restored["state"]
         state = abstract_state.replace(
             step=sav["step"],
@@ -216,7 +223,8 @@ class CheckpointManager:
             return {}
 
     def wait(self) -> None:
-        self.mgr.wait_until_finished()
+        with span("checkpoint.wait"):
+            self.mgr.wait_until_finished()
 
     def close(self) -> None:
         self.mgr.wait_until_finished()

@@ -411,6 +411,22 @@ class ObsConfig:
     # Log device memory (HBM bytes_in_use / peak) with train metrics.
     # No-op on backends that don't report memory_stats (CPU).
     log_memory: bool = False
+    # Live Prometheus exposition sidecar (obs/exposition.py): 0 = off
+    # (default — a port bind is a side effect), >0 = bind that port,
+    # -1 = ephemeral OS-assigned port (tests / several trainers per
+    # host; read it back from Trainer.metrics_server.port). Serves
+    # GET /metrics (text format v0.0.4) and /healthz.
+    metrics_port: int = 0
+    # Chrome trace.json of host spans (obs/spans.py), written by process
+    # 0 when fit() ends ("" → <checkpoint.dir>/trace.json). Load in
+    # chrome://tracing or Perfetto next to the xplane device trace.
+    trace_path: str = ""
+    # Cross-host straggler aggregation (obs/cluster.py): at log cadence
+    # every host contributes {step_time_p50, input_stall_pct, hbm_used}
+    # via process_allgather; rank-0 logs cluster min/med/max plus the
+    # arg-max host id. Only adds log keys when process_count > 1; the
+    # collective runs off the step path (log cadence, consumer thread).
+    straggler_metrics: bool = True
     # Per-top-level-module grad norms in the train metrics
     # (grad_norm/<module> keys) — which block explodes/vanishes.
     log_module_grad_norms: bool = False

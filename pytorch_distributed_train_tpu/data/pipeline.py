@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from pytorch_distributed_train_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_train_tpu.obs.spans import span as _span
 
 
 class StallStats:
@@ -41,15 +42,26 @@ class StallStats:
     producer-queue get: with async device_put downstream, that wait IS the
     time the step loop would have idled on input. Plain float adds under
     the GIL — one writer (the consumer thread) — no lock needed.
+
+    Each add also mirrors into the scrape registry
+    (``input_stall_seconds_total{split=...}``) so a live /metrics poll
+    sees the stall trend without waiting for the next JSONL window.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, split: str = "train") -> None:
         self.waits = 0
         self.wait_s = 0.0
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        self._counter = get_registry().counter(
+            "input_stall_seconds_total", labels={"split": split},
+            help="cumulative seconds the consumer blocked on the host "
+                 "input pipeline")
 
     def add(self, dt: float) -> None:
         self.waits += 1
         self.wait_s += dt
+        self._counter.inc(dt)
 
 
 class HostDataLoader:
@@ -163,9 +175,22 @@ class _Producer(threading.Thread):
         self._stopped = threading.Event()
         self.start()
 
+    _EXHAUSTED = object()
+
     def run(self):
         try:
-            for item in self.it:
+            it = iter(self.it)
+            while True:
+                # span per produced batch: the trace shows host collate
+                # time interleaved with the consumer's step spans (the
+                # two-thread overlap the pipeline exists to create).
+                # next(it, sentinel), not try/except StopIteration — a
+                # StopIteration raised through the span contextmanager
+                # generator would become a PEP 479 RuntimeError.
+                with _span("data.produce"):
+                    item = next(it, self._EXHAUSTED)
+                if item is self._EXHAUSTED:
+                    break
                 while not self._stopped.is_set():
                     try:
                         self.q.put(item, timeout=0.1)
@@ -262,7 +287,8 @@ def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
         loader = GrainHostDataLoader(dataset, data_cfg, train=train)
     else:
         loader = HostDataLoader(dataset, data_cfg, train=train)
-    loader.stall_stats = StallStats()  # read by the trainer's log window
+    # read by the trainer's log window; mirrored to /metrics by split
+    loader.stall_stats = StallStats(split="train" if train else "eval")
 
     def epoch_fn(epoch: int, start_batch: int = 0) -> Iterator[dict]:
         host_iter = iter(_Producer(loader.epoch(epoch, start_batch),

@@ -1,0 +1,32 @@
+"""Unified observability layer (SURVEY §5.3a/§5.5; Goodput-style
+accounting per PAPER.md C25/C26).
+
+Four host-side parts, all wired through the existing trainer /
+checkpoint / data / serving layers:
+
+- ``spans``     — ``span("checkpoint.save")`` context-manager tracing
+                  into a ring buffer (dumped by the watchdog on abort),
+                  exportable as Chrome ``trace.json`` for side-by-side
+                  viewing with xplane device traces.
+- ``registry``  — process-wide counters / gauges / histograms with
+                  Prometheus text exposition; ``MetricLogger.log`` feeds
+                  it so JSONL, TensorBoard and a scrape see the same
+                  numbers.
+- ``exposition``— the ``/metrics`` scrape surface: a handler snippet for
+                  existing HTTP servers (tools/serve_http.py) and a
+                  standalone opt-in sidecar (``cfg.obs.metrics_port``).
+- ``cluster``   — cross-host min/median/max (+ arg-max host) of per-host
+                  health numbers via ``process_allgather`` — stragglers
+                  become a first-class logged metric.
+- ``goodput``   — wall-time decomposition into named buckets
+                  (init/compile/step/input_stall/ckpt/eval/idle) and the
+                  productive-time ``goodput_pct``.
+
+Everything here is plain-Python host code: no jax import at module
+scope except in ``cluster`` (which is lazy), so data-loader worker
+processes can use spans/metrics without touching the device backend.
+"""
+
+from pytorch_distributed_train_tpu.obs.goodput import GoodputTracker  # noqa: F401
+from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: F401
+from pytorch_distributed_train_tpu.obs.spans import get_recorder, span  # noqa: F401

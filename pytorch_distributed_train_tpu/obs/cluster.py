@@ -1,0 +1,58 @@
+"""Cross-host straggler aggregation (SURVEY §5.3a; the Megatron-style
+flight-recorder rationale applied to HEALTH numbers, not collectives).
+
+On a multi-host pod every process logs its own step-time percentiles,
+but rank-0's console only shows rank-0's numbers — a single straggling
+host (thermal throttle, sick NIC, noisy neighbor on its VM) is invisible
+until the sustained drill's aggregate gate fails. Here, at log cadence,
+every host contributes a small vector of health numbers and rank-0 logs
+the cluster min / median / max plus WHICH host is the max — stragglers
+become a first-class logged metric instead of a post-mortem discovery.
+
+Mechanics: ``multihost_utils.process_allgather`` over a fixed-order
+float vector (keys sorted, so all hosts agree on layout — the same
+must-agree contract as debug.check_input_sync). The gather is a blocking
+collective: it runs on the consumer thread at log cadence only, never on
+the step path, and all hosts call it symmetrically (the call site in
+trainer._log_train executes on every process; only the logging after it
+is rank-0 gated).
+
+Single-host runs skip the collective entirely and return the degenerate
+summary (min=med=max=self, max_host=0) so the logged schema is identical
+everywhere — dashboards don't fork on topology.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def summarize(local: dict[str, float],
+              process_index: int | None = None,
+              process_count: int | None = None) -> dict[str, float]:
+    """Aggregate per-host health numbers across hosts.
+
+    Returns ``{<key>_min, <key>_med, <key>_max, <key>_max_host}`` for
+    every key of ``local``. Keys must be present on ALL hosts (fixed
+    schema — the caller builds the dict from always-present meters,
+    substituting 0.0 where a backend doesn't report, e.g. hbm on CPU).
+    """
+    import jax
+
+    n = jax.process_count() if process_count is None else process_count
+    keys = sorted(local)
+    vec = np.asarray([float(local[k]) for k in keys], np.float64)
+    if n <= 1:
+        rows = vec[None, :]
+    else:
+        from jax.experimental import multihost_utils
+
+        rows = np.asarray(multihost_utils.process_allgather(vec))
+    out: dict[str, float] = {}
+    for j, k in enumerate(keys):
+        col = rows[:, j]
+        out[f"{k}_min"] = float(np.min(col))
+        out[f"{k}_med"] = float(np.median(col))
+        out[f"{k}_max"] = float(np.max(col))
+        out[f"{k}_max_host"] = int(np.argmax(col))
+    return out

@@ -1,0 +1,74 @@
+"""Live ``/metrics`` exposition: the scrape surfaces over the registry.
+
+Two deployment shapes, one renderer (registry.render):
+
+- **Existing HTTP server** — tools/serve_http.py adds a ``GET /metrics``
+  route that returns ``render_metrics()``; the serving process then
+  exposes batcher counters, request histograms and span durations on the
+  same port as the API.
+- **Trainer sidecar** — a training process has no HTTP surface, so
+  ``cfg.obs.metrics_port != 0`` starts ``MetricsServer``: a stdlib
+  ThreadingHTTPServer on a daemon thread serving ``/metrics`` (and
+  ``/healthz`` for liveness probes). Opt-in because a port bind is a
+  side effect no test/bench run should pay by default. Port ``-1``
+  binds an OS-assigned ephemeral port (tests, several trainers on one
+  host) — read it back from ``server.port``.
+
+The scrape handler never touches device state or locks shared with the
+step loop: it reads plain-python counters, so a wedged train step can
+still be scraped (exactly when you need the numbers most).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def render_metrics() -> str:
+    """The exposition body — shared by every scrape surface."""
+    return get_registry().render()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet: scrapes are periodic
+        pass
+
+    def do_GET(self):
+        if self.path.split("?", 1)[0] == "/metrics":
+            body = render_metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+        elif self.path == "/healthz":
+            body = b'{"status": "ok"}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsServer:
+    """Opt-in scrape sidecar for processes without an HTTP surface."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        # -1 → ephemeral (the OS picks); 0 is the "off" config sentinel
+        # and never reaches here.
+        self._httpd = ThreadingHTTPServer((host, max(port, 0)), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-exposition")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

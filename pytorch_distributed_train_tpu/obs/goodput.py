@@ -1,0 +1,80 @@
+"""Goodput accounting: decompose cumulative wall time into named buckets.
+
+The Goodput-measurement framing (PAPER.md SURVEY §5.3a context; Google's
+"Goodput" for ML training): of all wall-clock seconds a job has been
+alive, how many went to PRODUCTIVE training steps vs overhead — compile,
+input stalls, checkpointing, eval, and unattributed idle. A run that
+reports 95% MFU during steps but spends a third of its life recompiling
+or blocked on the input pipeline has terrible goodput, and nothing in a
+step-time percentile shows it.
+
+Buckets (fixed vocabulary, so dashboards can stack them):
+
+    init        — Trainer construction (mesh, model init, data, restore)
+    compile     — first execution of the jitted train step per fit()
+                  (jit compile + the first step's run; the standard
+                  host-side attribution — XLA doesn't expose the split
+                  without a profiler session)
+    step        — train_step dispatch + the host sync absorbed by the
+                  NEXT dispatch (the steady-state productive bucket)
+    input_stall — blocked in the batch iterator's next() (host pipeline
+                  behind; same wait StallStats counts, attributed here
+                  to wall time)
+    ckpt        — maybe_save / final save / wait_until_finished
+    eval        — evaluate() passes
+    idle        — everything unattributed (logging, BN re-estimation,
+                  inter-epoch bookkeeping)
+
+``idle`` is computed as wall − Σ(known), so the buckets sum to wall time
+EXACTLY by construction; the acceptance tolerance (5%) guards against a
+tracker bug making idle negative, not float drift.
+
+``goodput_pct = 100 * step / wall`` — the productive-time definition.
+``compile`` is deliberately excluded from the numerator: restart-heavy
+jobs (elastic preemption) lose goodput to recompiles and that loss is
+the thing this metric exists to surface.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+BUCKETS = ("init", "compile", "step", "input_stall", "ckpt", "eval", "idle")
+
+
+class GoodputTracker:
+    def __init__(self, t0: float | None = None):
+        self.t0 = time.perf_counter() if t0 is None else t0
+        self.buckets: dict[str, float] = {b: 0.0 for b in BUCKETS if b != "idle"}
+
+    def account(self, bucket: str, seconds: float) -> None:
+        if bucket == "idle":
+            raise ValueError("idle is derived (wall - sum), never accounted")
+        self.buckets[bucket] = self.buckets.get(bucket, 0.0) + max(0.0, seconds)
+
+    @contextlib.contextmanager
+    def measure(self, bucket: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.account(bucket, time.perf_counter() - t0)
+
+    # ------------------------------------------------------------ report
+    def wall_s(self, now: float | None = None) -> float:
+        return (time.perf_counter() if now is None else now) - self.t0
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """``{goodput_pct, goodput_wall_s, goodput_s_<bucket>...}`` —
+        flat float keys so the dict drops straight into MetricLogger.log
+        (and from there into JSONL/TB/scrape)."""
+        wall = max(self.wall_s(now), 1e-9)
+        known = sum(self.buckets.values())
+        out = {f"goodput_s_{b}": round(v, 4)
+               for b, v in self.buckets.items()}
+        out["goodput_s_idle"] = round(max(0.0, wall - known), 4)
+        out["goodput_wall_s"] = round(wall, 4)
+        out["goodput_pct"] = round(
+            100.0 * self.buckets.get("step", 0.0) / wall, 2)
+        return out

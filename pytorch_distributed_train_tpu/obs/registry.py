@@ -1,0 +1,211 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+The pull complement to the push-style MetricLogger: JSONL/TensorBoard
+record history for post-hoc analysis, a scrape answers "what is this
+process doing RIGHT NOW" without touching the run directory. One
+registry per process (``get_registry``); ``MetricLogger.log`` mirrors
+every numeric metric into it as a gauge, so the scrape and the JSONL
+always agree — no second bookkeeping path to drift.
+
+Instruments (the standard Prometheus trio, stdlib-only):
+- ``Counter``   — monotonically increasing float (``_total`` names).
+- ``Gauge``     — set-to-current value.
+- ``Histogram`` — cumulative buckets + ``_sum``/``_count`` (classic
+  Prometheus ``le`` semantics). Default buckets are exponential from
+  1 ms to ~2 min — sized for step/span durations in seconds.
+
+Exposition follows the text format v0.0.4 (``# HELP`` / ``# TYPE`` then
+one line per labeled series); ``render()`` is what both the serve_http
+``/metrics`` route and the trainer sidecar (obs/exposition.py) return.
+
+Thread model: get-or-create goes through one lock; the hot mutators
+(inc/set/observe) are plain float ops under the GIL — same stance as
+data/pipeline.py's StallStats. A scrape may see a histogram mid-update
+(count ahead of sum by one observation); Prometheus scrapes tolerate
+that by design.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# step/span durations in SECONDS: 1ms .. ~131s, doubling
+_DEFAULT_BUCKETS = tuple(0.001 * 2 ** i for i in range(18))
+
+_INVALID = str.maketrans(
+    {c: "_" for c in r"""!"#$%&'()*+,-./;<=>?@[\]^`{|}~ """})
+
+
+def sanitize_name(name: str) -> str:
+    """Metric-name charset is [a-zA-Z_:][a-zA-Z0-9_:]*; JSONL keys like
+    ``step_time_ms_p50`` pass through, ``grad_norm/encoder`` does not."""
+    name = str(name).translate(_INVALID)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+class Counter:
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+
+class Gauge:
+    def __init__(self) -> None:
+        self.value = 0.0
+        self.updated_at = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+        self.updated_at = time.time()
+
+
+class Histogram:
+    def __init__(self, buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.uppers = tuple(sorted(buckets))
+        self.counts = [0] * len(self.uppers)  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.sum += v
+        self.count += 1
+        for i, ub in enumerate(self.uppers):
+            if v <= ub:
+                self.counts[i] += 1
+                break
+        # above the last bound: lands only in the implicit +Inf bucket
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        out, acc = [], 0
+        for ub, c in zip(self.uppers, self.counts):
+            acc += c
+            out.append((ub, acc))
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> (type, help, {label_items_tuple: instrument})
+        self._families: dict[str, tuple[str, str, dict]] = {}
+
+    # ------------------------------------------------------ get-or-create
+    def _get(self, kind: str, name: str, labels: dict | None, help: str,
+             factory):
+        name = sanitize_name(name)
+        key = tuple(sorted((str(k), str(v))
+                           for k, v in (labels or {}).items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = (kind, help, {})
+                self._families[name] = fam
+            if fam[0] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam[0]}, "
+                    f"requested {kind}")
+            inst = fam[2].get(key)
+            if inst is None:
+                inst = fam[2][key] = factory()
+            return inst
+
+    def counter(self, name: str, labels: dict | None = None,
+                help: str = "") -> Counter:
+        return self._get("counter", name, labels, help, Counter)
+
+    def gauge(self, name: str, labels: dict | None = None,
+              help: str = "") -> Gauge:
+        return self._get("gauge", name, labels, help, Gauge)
+
+    def histogram(self, name: str, labels: dict | None = None,
+                  help: str = "",
+                  buckets: tuple[float, ...] | None = None) -> Histogram:
+        return self._get("histogram", name, labels, help,
+                         lambda: Histogram(buckets or _DEFAULT_BUCKETS))
+
+    # --------------------------------------------------------- bulk feed
+    def set_from_mapping(self, metrics: dict, prefix: str = "") -> None:
+        """Mirror a MetricLogger record: every numeric value becomes a
+        gauge ``<prefix>_<key>`` (non-numerics skipped). Called on every
+        ``log``, so the scrape always shows the latest logged window."""
+        for k, v in metrics.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            name = sanitize_name(f"{prefix}_{k}" if prefix else k)
+            self.gauge(name).set(v)
+
+    # ---------------------------------------------------------- renderer
+    def render(self) -> str:
+        """Prometheus text format v0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            fams = {n: (k, h, dict(series))
+                    for n, (k, h, series) in sorted(self._families.items())}
+        for name, (kind, help, series) in fams.items():
+            if help:
+                lines.append(f"# HELP {name} {help}")
+            lines.append(f"# TYPE {name} {kind}")
+            for key, inst in series.items():
+                if kind == "histogram":
+                    for ub, acc in inst.cumulative():
+                        le = 'le="%s"' % _fmt_value(ub)
+                        lines.append(
+                            f"{name}_bucket{_fmt_labels(key, le)} {acc}")
+                    inf_le = 'le="+Inf"'
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(key, inf_le)}"
+                        f" {inst.count}")
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(key)}"
+                        f" {_fmt_value(inst.sum)}")
+                    lines.append(f"{name}_count{_fmt_labels(key)}"
+                                 f" {inst.count}")
+                else:
+                    lines.append(f"{name}{_fmt_labels(key)}"
+                                 f" {_fmt_value(inst.value)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Drop every family — tests only (the process registry is
+        otherwise append-only for scrape stability)."""
+        with self._lock:
+            self._families.clear()
+
+
+_GLOBAL: MetricsRegistry | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = MetricsRegistry()
+    return _GLOBAL

@@ -1,0 +1,193 @@
+"""Host-side trace spans: a ring buffer of timed regions + Chrome export.
+
+The device side of "where does wall-clock go" is already covered by the
+xplane profiler window (utils/xplane.py); what was missing is the HOST
+side — compile vs step vs input stall vs checkpoint vs eval vs request
+handling. ``span("checkpoint.save")`` costs two ``perf_counter`` calls
+and one ring slot, cheap enough for per-step use; the ring holds the
+last ``capacity`` completed spans so the watchdog can dump "what was the
+host doing" on abort (utils/watchdog.py attaches the recorder next to
+the FlightRecorder event ring).
+
+Export is the Chrome ``trace.json`` array format (``ph: "X"`` complete
+events, microsecond timestamps) — load it in chrome://tracing or
+Perfetto alongside the xplane-derived device trace; both clocks are
+host epoch-anchored so the two align (docs/observability.md).
+
+Thread model: completed spans append under the GIL (list assignment into
+a preallocated ring is atomic enough, same design as FlightRecorder);
+the nesting stack is thread-local so producer threads and HTTP handler
+threads nest independently. Each span records its thread name — the
+Chrome export maps it to ``tid`` rows.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+
+class Span:
+    """One completed timed region."""
+
+    __slots__ = ("name", "t0", "dur_s", "thread", "depth", "args")
+
+    def __init__(self, name: str, t0: float, dur_s: float, thread: str,
+                 depth: int, args: dict):
+        self.name = name
+        self.t0 = t0  # epoch seconds (time.time clock)
+        self.dur_s = dur_s
+        self.thread = thread
+        self.depth = depth
+        self.args = args
+
+    def to_chrome(self, pid: int) -> dict:
+        ev = {
+            "name": self.name,
+            "ph": "X",
+            "ts": self.t0 * 1e6,  # Chrome wants microseconds
+            "dur": self.dur_s * 1e6,
+            "pid": pid,
+            "tid": self.thread,
+        }
+        if self.args:
+            ev["args"] = self.args
+        return ev
+
+
+class SpanRecorder:
+    """Fixed-capacity ring of completed spans + thread-local nest stacks."""
+
+    def __init__(self, capacity: int = 4096, feed_registry: bool = True):
+        self.capacity = capacity
+        self.buf: list[Span | None] = [None] * capacity
+        self.n = 0  # total spans ever completed
+        self._local = threading.local()
+        self._feed_registry = feed_registry
+        # slot-claim + n++ is a read-modify-write pair; concurrent
+        # completions (producer thread vs step loop vs HTTP handlers)
+        # could otherwise double-write a slot and leave a None hole
+        # that crashes chrome_trace. Held for two assignments only.
+        self._commit_lock = threading.Lock()
+        # thread-name -> that thread's open-span stack. The stack is
+        # only MUTATED by its own thread; the dict gives other threads
+        # (watchdog abort dump) read access the pure thread-local
+        # couldn't — a wedged main-thread checkpoint.save must be
+        # visible from the heartbeat thread.
+        self._stacks: dict[str, list] = {}
+
+    # ------------------------------------------------------------- record
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+            self._stacks[threading.current_thread().name] = st
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """Time a region. Nesting is tracked per thread (``depth``);
+        exceptions propagate — the span still records, flagged
+        ``error=True`` so an aborted checkpoint save is visible in the
+        dump."""
+        stack = self._stack()
+        stack.append(name)
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            args = {**args, "error": True}
+            raise
+        finally:
+            dur = time.perf_counter() - t0
+            depth = len(stack) - 1
+            stack.pop()
+            sp = Span(name, wall0, dur, threading.current_thread().name,
+                      depth, args)
+            with self._commit_lock:
+                self.buf[self.n % self.capacity] = sp
+                self.n += 1
+            if self._feed_registry:
+                # every span is scrape-visible as a labeled histogram —
+                # the decode-wait / ckpt-time numbers come for free
+                from pytorch_distributed_train_tpu.obs.registry import (
+                    get_registry,
+                )
+
+                get_registry().histogram(
+                    "span_seconds", labels={"name": name},
+                    help="duration of host trace spans by span name",
+                ).observe(dur)
+
+    # -------------------------------------------------------------- read
+    def events(self) -> list[Span]:
+        """Completed spans, oldest first (ring order). None-filtered: a
+        reader racing an in-flight commit may see a not-yet-filled slot."""
+        if self.n <= self.capacity:
+            snap = self.buf[: self.n]
+        else:
+            i = self.n % self.capacity
+            snap = self.buf[i:] + self.buf[:i]
+        return [s for s in snap if s is not None]
+
+    def active(self) -> list[str]:
+        """This thread's currently-open span names, outermost first."""
+        return list(self._stack())
+
+    def active_all(self) -> dict[str, list[str]]:
+        """EVERY thread's open spans (non-empty stacks only) — the abort
+        dump runs on the heartbeat thread, where ``active()`` is vacuous."""
+        return {t: list(st) for t, st in list(self._stacks.items()) if st}
+
+    def clear(self) -> None:
+        self.buf = [None] * self.capacity
+        self.n = 0
+
+    # ------------------------------------------------------------ export
+    def chrome_trace(self) -> dict:
+        pid = os.getpid()
+        return {
+            "traceEvents": [s.to_chrome(pid) for s in self.events()],
+            "displayTimeUnit": "ms",
+        }
+
+    def dump_chrome_trace(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def write_text(self, out) -> None:
+        """Human dump (watchdog abort path): last spans, one per line."""
+        evs = self.events()
+        out.write(f"=== trace spans: last {len(evs)} "
+                  f"(of {self.n} total) ===\n")
+        for s in evs:
+            out.write(f"{s.t0:.3f} {'  ' * s.depth}{s.name} "
+                      f"{s.dur_s * 1e3:.2f}ms thread={s.thread} {s.args}\n")
+        out.flush()
+
+
+_GLOBAL: SpanRecorder | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_recorder() -> SpanRecorder:
+    """The process-wide recorder: trainer, checkpoint, data producers and
+    HTTP handlers all record into one ring, so the exported trace shows
+    their interleaving on a single timeline."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = SpanRecorder()
+    return _GLOBAL
+
+
+def span(name: str, **args):
+    """``with span("trainer.eval"): ...`` against the global recorder."""
+    return get_recorder().span(name, **args)

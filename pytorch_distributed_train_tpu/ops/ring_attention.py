@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+from pytorch_distributed_train_tpu.utils.compat import shard_map
+
 NEG_INF = -1e30
 
 P = PartitionSpec
@@ -306,8 +308,8 @@ def ring_attention(
                 causal=causal, window=window, q_pos=pos, kv_pos=pos,
                 chunk_impl=chunk_impl, interpret=interpret)
 
-        o = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                          out_specs=spec, check_vma=False)(q, k, v)
+        o = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)(q, k, v)
         return jnp.take(o, inv, axis=1)
 
     fn = functools.partial(
@@ -315,7 +317,7 @@ def ring_attention(
         causal=causal, window=window, chunk_impl=chunk_impl,
         interpret=interpret,
     )
-    return jax.shard_map(
+    return shard_map(
         lambda a, b, c: fn(a, b, c),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

@@ -24,6 +24,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec
 
 from pytorch_distributed_train_tpu.ops import attention as attention_lib
+from pytorch_distributed_train_tpu.utils.compat import shard_map
 
 P = PartitionSpec
 
@@ -117,7 +118,7 @@ def ulysses_attention(
         causal=causal, window=window, impl=impl,
     )
     if mask is None:
-        return jax.shard_map(
+        return shard_map(
             lambda a, b, c: fn(a, b, c),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
@@ -125,7 +126,7 @@ def ulysses_attention(
     # Mask stays full-seq: sharded on batch only, replicated over context.
     mask_spec = P(divisible_axes(mask.shape[0], batch_axes, mesh),
                   *([None] * (mask.ndim - 1)))
-    return jax.shard_map(
+    return shard_map(
         lambda a, b, c, m: fn(a, b, c, m),
         mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec,
         check_vma=False,

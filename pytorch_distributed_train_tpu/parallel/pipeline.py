@@ -49,6 +49,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from pytorch_distributed_train_tpu.utils.compat import shard_map
+
 P = PartitionSpec
 
 
@@ -184,7 +186,7 @@ def spmd_pipeline(
 
     param_specs = jax.tree.map(lambda _: P(stage_axis), stage_params)
     x_mb = _constrain_microbatch(x_mb, mesh)
-    out, aux = jax.shard_map(
+    out, aux = shard_map(
         run,
         mesh=mesh,
         in_specs=(param_specs, P()),
@@ -310,7 +312,7 @@ def spmd_pipeline_interleaved(
 
     param_specs = jax.tree.map(lambda _: P(None, stage_axis), chunk_params)
     x_mb = _constrain_microbatch(x_mb, mesh)
-    out, aux = jax.shard_map(
+    out, aux = shard_map(
         run,
         mesh=mesh,
         in_specs=(param_specs, P()),

@@ -9,6 +9,7 @@ its TPU-native mechanism per SURVEY §7.2.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 import jax
@@ -26,6 +27,10 @@ from pytorch_distributed_train_tpu.config import TrainConfig
 from pytorch_distributed_train_tpu.data.datasets import build_dataset
 from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
 from pytorch_distributed_train_tpu.models.registry import build_model
+from pytorch_distributed_train_tpu.obs import cluster as cluster_lib
+from pytorch_distributed_train_tpu.obs import spans as spans_lib
+from pytorch_distributed_train_tpu.obs.goodput import GoodputTracker
+from pytorch_distributed_train_tpu.obs.registry import get_registry
 from pytorch_distributed_train_tpu.optim import make_optimizer, plateau_scale
 from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
 from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
@@ -38,6 +43,12 @@ from pytorch_distributed_train_tpu.utils.watchdog import FlightRecorder, Heartbe
 
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None):
+        # Goodput clock starts at construction: mesh/model/data/restore
+        # time is the init bucket (obs/goodput.py) — a job that spends
+        # minutes rebuilding state per restart should see it in the
+        # summary, not have it vanish into pre-fit limbo.
+        _t_init0 = time.perf_counter()
+        self.goodput = GoodputTracker(t0=_t_init0)
         self.cfg = cfg
         if cfg.obs.debug_nans:
             debug_lib.enable_nan_debugging()
@@ -285,6 +296,29 @@ class Trainer:
         self.recorder.install_signal_dump()
         self.heartbeat = Heartbeat(cfg.obs.heartbeat_timeout_s, self.recorder)
         self._profiling = False
+        # ---- unified obs layer (obs/): spans + registry + goodput.
+        # One process-wide span ring — checkpoint saves, data producer
+        # threads and the step loop interleave on a single exported
+        # timeline; the watchdog dumps it on abort next to its events.
+        self.spans = spans_lib.get_recorder()
+        self.recorder.attach_spans(self.spans)
+        self.registry = get_registry()
+        self._step_hist = self.registry.histogram(
+            "train_step_seconds",
+            help="wall seconds between consecutive train-step completions "
+                 "(meter intervals; excludes compile and eval gaps)")
+        self.metrics_server = None
+        if cfg.obs.metrics_port:
+            from pytorch_distributed_train_tpu.obs.exposition import (
+                MetricsServer,
+            )
+
+            self.metrics_server = MetricsServer(cfg.obs.metrics_port)
+            if jax.process_index() == 0:
+                print(f"[obs] /metrics on port {self.metrics_server.port}",
+                      flush=True)
+        self._stepped = False  # first train_step call = compile bucket
+        self.goodput.account("init", time.perf_counter() - _t_init0)
 
     # ------------------------------------------------------------------ init
     def _warm_start_lora_base(self):
@@ -465,13 +499,24 @@ class Trainer:
                 start_b = max(0, step - epoch * self.steps_per_epoch)
                 if start_b >= self.steps_per_epoch:
                     start_b = 0  # stale epoch meta; just run a fresh epoch
-                for batch in self.train_epoch_fn(epoch, start_b):
+                for batch in self._timed_batches(
+                        self.train_epoch_fn(epoch, start_b)):
                     if step >= limit:
                         break
                     self._maybe_profile(step)
-                    self.state, metrics = self.train_step(
-                        self.state, batch, self.step_rng
-                    )
+                    # First execution per process = jit trace + compile
+                    # (+ one step); goodput attributes it to the compile
+                    # bucket — recompile cost on restart-heavy jobs is
+                    # precisely what goodput accounting exists to show.
+                    is_first = not self._stepped
+                    t_body = time.perf_counter()
+                    with self.spans.span(
+                            "train.compile" if is_first else "train.step",
+                            step=step):
+                        self.state, metrics = self.train_step(
+                            self.state, batch, self.step_rng
+                        )
+                    self._stepped = True
                     # Host-side step counter: int(state.step) every step
                     # would sync the device and serialize async dispatch
                     # (the jitted step increments state.step identically,
@@ -479,7 +524,10 @@ class Trainer:
                     step += 1
                     self._maybe_inject_fault(step)
                     self._maybe_inject_stall(step)
-                    if self.meter.tick() is None:
+                    dt_tick = self.meter.tick()
+                    if dt_tick is not None:
+                        self._step_hist.observe(dt_tick)
+                    if dt_tick is None:
                         # Priming tick (first step after a clock reset —
                         # epoch boundary or mid-epoch eval): its interval
                         # is excluded from meter.total_s, so drop the
@@ -497,12 +545,21 @@ class Trainer:
                     self.recorder.record("step", step)
                     if step % cfg.obs.log_every_steps == 0 or step == limit:
                         self._log_train(step, metrics)
-                    if self.ckpt.maybe_save(self.state, epoch=epoch,
-                                            step=step):
-                        self.recorder.record("ckpt", step)
+                    # The step bucket closes AFTER the (cadenced) log:
+                    # _log_train's device sync is where async-dispatched
+                    # compute gets waited on host-side, and that wait is
+                    # step time, not idle.
+                    self.goodput.account(
+                        "compile" if is_first else "step",
+                        time.perf_counter() - t_body)
+                    with self.goodput.measure("ckpt"):
+                        if self.ckpt.maybe_save(self.state, epoch=epoch,
+                                                step=step):
+                            self.recorder.record("ckpt", step)
                     if (cfg.eval_every_steps and
                             step % cfg.eval_every_steps == 0):
-                        self.evaluate(step)
+                        with self.goodput.measure("eval"):
+                            self.evaluate(step)
                         # Mid-epoch eval: keep its wall time out of the
                         # step-time percentiles AND the input-stall
                         # denominator (meter.total_s).
@@ -511,7 +568,8 @@ class Trainer:
                 if not cfg.eval_every_steps:
                     # every epoch boundary INCLUDING the last: the final
                     # validation metric is the acceptance-matrix number
-                    self.evaluate(step)
+                    with self.goodput.measure("eval"):
+                        self.evaluate(step)
                 self.meter.reset_clock()  # epoch boundary: don't count eval time
             if (getattr(cfg.optim, "swa_update_bn_batches", 0) > 0
                     and self.state.ema_params is not None
@@ -536,17 +594,58 @@ class Trainer:
                     batch_stats=trajectory_stats)
         finally:
             self.heartbeat.stop()
-            self.ckpt.save(self.state, epoch=epoch, force=True, step=step)
-            self.ckpt.wait()
+            with self.goodput.measure("ckpt"):
+                self.ckpt.save(self.state, epoch=epoch, force=True,
+                               step=step)
+                self.ckpt.wait()
             if self.best_ckpt is not None:
                 self.best_ckpt.close()
             self.logger.log(
                 step,
-                {"wall_time_s": time.time() - t_start, **self.meter.percentiles()},
+                {"wall_time_s": time.time() - t_start,
+                 **self.meter.percentiles(), **self.goodput.snapshot()},
                 prefix="summary",
             )
             self.logger.close()
+            self._dump_trace()
         return self.state
+
+    def _timed_batches(self, it):
+        """Yield from the epoch iterator, accounting time blocked in its
+        next() to the goodput input_stall bucket — the host-pipeline wait
+        as the STEP LOOP experiences it (device_put assembly included),
+        complementing StallStats' producer-queue view."""
+        it = iter(it)
+        _done = object()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                batch = next(it, _done)
+                self.goodput.account("input_stall",
+                                     time.perf_counter() - t0)
+                if batch is _done:
+                    return
+                yield batch
+        finally:
+            # Propagate early exits (step cap break) to the underlying
+            # generator NOW — device_prefetch's finally stops the
+            # producer thread; leaving that to GC would leak it until
+            # collection.
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    def _dump_trace(self) -> None:
+        """Write the host span ring as Chrome trace.json (process 0).
+        Best-effort: observability must never fail the run."""
+        if jax.process_index() != 0:
+            return
+        path = self.cfg.obs.trace_path or os.path.join(
+            self.cfg.checkpoint.dir, "trace.json")
+        try:
+            self.spans.dump_chrome_trace(path)
+        except Exception:
+            pass  # incl. unserializable span args — never fail the run
 
     def _log_train(self, step: int, metrics: dict) -> None:
         host = {k: float(np.asarray(v)) for k, v in metrics.items()}
@@ -586,6 +685,18 @@ class Trainer:
             self._stall_prev = (stats.wait_s, loop_s)
         if self.cfg.obs.log_memory:
             host.update(device_memory_metrics())
+        host["goodput_pct"] = self.goodput.snapshot()["goodput_pct"]
+        if self.cfg.obs.straggler_metrics and jax.process_count() > 1:
+            # Cross-host health gather (obs/cluster.py): every host
+            # calls this symmetrically (the collective is inside), only
+            # the logging below is rank-0. Fixed key schema — absent
+            # backends contribute 0.0, never a missing key.
+            hbm = device_memory_metrics().get("hbm_gb_in_use", 0.0)
+            host.update(cluster_lib.summarize({
+                "step_time_p50": host.get("step_time_ms_p50", 0.0),
+                "input_stall_pct": host.get("input_stall_pct", 0.0),
+                "hbm_used": hbm,
+            }))
         self.logger.log(step, host, prefix="train")
 
     def update_bn(self, num_batches: int = 50) -> None:
@@ -638,11 +749,12 @@ class Trainer:
     def evaluate(self, step: int, prefix: str = "eval") -> dict:
         sums: dict[str, float] = {}
         n = 0
-        for batch in self.eval_epoch_fn(0):
-            m = self.eval_step(self.state, batch)
-            for k, v in m.items():
-                sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
-            n += 1
+        with self.spans.span("train.eval", step=step):
+            for batch in self.eval_epoch_fn(0):
+                m = self.eval_step(self.state, batch)
+                for k, v in m.items():
+                    sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
+                n += 1
         if n == 0:
             return {}
         avg = {k: v / n for k, v in sums.items()}
@@ -722,6 +834,9 @@ class Trainer:
         if self.best_ckpt is not None:
             self.best_ckpt.close()
         self.logger.close()
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
 
 def device_memory_metrics() -> dict:

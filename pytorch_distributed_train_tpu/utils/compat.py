@@ -1,0 +1,45 @@
+"""JAX API compatibility shims.
+
+The codebase targets the current public API; the deployment containers
+sometimes pin an older jax. Each shim resolves the modern spelling when
+present and falls back to the legacy one, so the same source runs on
+both — the alternative (pinning the old spelling) rots the moment the
+container catches up.
+
+``shard_map``: public ``jax.shard_map`` (with ``check_vma`` /
+``axis_names``) vs legacy ``jax.experimental.shard_map.shard_map``
+(``check_rep`` / complementary ``auto``). Semantics map 1:1:
+``check_vma`` and ``check_rep`` are the same per-shard replication
+check under its two names, and legacy ``auto`` is the complement of
+``axis_names`` over the mesh axes (modern: which axes ARE manual;
+legacy: which axes are NOT).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: bool | None = None, axis_names=None):
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    kw = {}
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    # ``axis_names`` is intentionally NOT mapped to legacy ``auto``:
+    # partial-auto regions on old jax lower axis_index to a PartitionId
+    # instruction old XLA's SPMD partitioner rejects ("meaning is
+    # ambiguous"). Full-manual is numerically identical — axes the
+    # caller wanted auto just see replicated data (in_specs that do not
+    # name them), costing redundant compute on those axes only under
+    # legacy jax.
+    return _legacy(f, mesh, in_specs, out_specs, **kw)

@@ -81,13 +81,19 @@ class MetricLogger:
                 self._tb = None
 
     def log(self, step: int, metrics: dict, prefix: str = "train") -> None:
-        if not self.is_main:
-            return
         record = {"step": step, "ts": time.time()}
         for k, v in metrics.items():
             if hasattr(v, "item"):
                 v = float(np.asarray(v))
             record[k] = v
+        # EVERY process mirrors its record into the scrape registry
+        # (obs/registry.py) — a straggling non-zero host's sidecar must
+        # show that host's own numbers; JSONL/TB/console stay rank-0.
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        get_registry().set_from_mapping(record, prefix=prefix)
+        if not self.is_main:
+            return
         if self._jsonl:
             self._jsonl.write(json.dumps({"tag": prefix, **record}) + "\n")
         if self._tb:

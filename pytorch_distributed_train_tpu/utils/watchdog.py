@@ -35,6 +35,14 @@ class FlightRecorder:
         self.n = 0
         self.dump_dir = dump_dir
         self._installed = False
+        self._spans = None  # obs.spans.SpanRecorder, via attach_spans
+
+    def attach_spans(self, recorder) -> None:
+        """Dump this span ring (obs/spans.py) next to the event ring on
+        abort/SIGTERM — "which step" from the events, "doing WHAT inside
+        the step" from the spans (the wedged checkpoint save or input
+        wait is then in the post-mortem, not inferred)."""
+        self._spans = recorder
 
     def record(self, kind: str, step: int, **info) -> None:
         self.buf[self.n % self.capacity] = (time.time(), kind, step, info)
@@ -51,6 +59,17 @@ class FlightRecorder:
         for ts, kind, step, info in self.events():
             out.write(f"{ts:.3f} {kind} step={step} {info}\n")
         out.flush()
+        if self._spans is not None:
+            try:
+                self._spans.write_text(out)
+                # all threads' stacks, not active(): the heartbeat-abort
+                # dump runs on the monitor thread, and the wedged span
+                # (a stuck checkpoint.save) is open on the MAIN thread
+                for t, names in self._spans.active_all().items():
+                    out.write(f"open spans [{t}]: {names}\n")
+                out.flush()
+            except Exception:
+                pass  # diagnostics must never crash the dump path
 
     def dump(self, out=None) -> None:
         self._write(out or sys.stderr)

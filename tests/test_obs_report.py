@@ -1,0 +1,82 @@
+"""tools/obs_report.py: the one-screen run report renders the goodput
+breakdown, step-time trend, straggler table and span summary from
+fixture artifacts (no Trainer run — the fixture mirrors the JSONL/trace
+schema the e2e test in test_observability.py pins)."""
+
+import json
+import sys
+
+ROOT_TOOLS = __file__.rsplit("/tests/", 1)[0] + "/tools"
+sys.path.insert(0, ROOT_TOOLS)
+
+import obs_report  # noqa: E402
+
+
+def _write_fixture(tmp_path, with_stragglers=True):
+    recs = []
+    for i, step in enumerate((50, 100, 150)):
+        r = {"tag": "train", "step": step, "ts": 1000.0 + i,
+             "loss": 2.0 - 0.1 * i, "step_time_ms_p50": 100.0 + i,
+             "step_time_ms_p99": 140.0 + i, "input_stall_pct": 0.5,
+             "goodput_pct": 80.0 + i}
+        if with_stragglers:
+            for key, base in (("step_time_p50", 100.0),
+                              ("input_stall_pct", 0.5),
+                              ("hbm_used", 10.0)):
+                r.update({f"{key}_min": base, f"{key}_med": base + 1,
+                          f"{key}_max": base + 5, f"{key}_max_host": 3})
+        recs.append(r)
+    recs.append({"tag": "summary", "step": 150, "ts": 1003.0,
+                 "wall_time_s": 60.0, "goodput_wall_s": 60.0,
+                 "goodput_pct": 81.0, "goodput_s_init": 5.0,
+                 "goodput_s_compile": 5.0, "goodput_s_step": 48.6,
+                 "goodput_s_input_stall": 0.4, "goodput_s_ckpt": 0.5,
+                 "goodput_s_eval": 0.4, "goodput_s_idle": 0.1})
+    jsonl = tmp_path / "metrics.jsonl"
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in recs)
+                     + "{torn line\n")
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "train.step", "ph": "X", "ts": 0.0, "dur": 100_000.0,
+         "pid": 1, "tid": "MainThread"},
+        {"name": "checkpoint.save", "ph": "X", "ts": 10.0,
+         "dur": 500_000.0, "pid": 1, "tid": "MainThread"},
+    ]}))
+    return jsonl, trace
+
+
+def test_report_renders_all_sections(tmp_path, capsys):
+    _write_fixture(tmp_path)
+    rc = obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "goodput: 81.0% productive of 60.0s wall" in out
+    assert "step-time trend" in out and "150" in out
+    assert "stragglers" in out and "max host" in out
+    # chronic straggler: host 3 was the max in every window
+    assert "host 3 (3x)" in out
+    assert "checkpoint.save" in out and "train.step" in out
+
+
+def test_report_crashed_run_falls_back_to_running_pct(tmp_path, capsys):
+    """A run that died before fit()'s finally has train records but no
+    summary — the report must still show the running goodput."""
+    jsonl, _ = _write_fixture(tmp_path, with_stragglers=False)
+    recs = [json.loads(line) for line in jsonl.read_text().splitlines()
+            if line.startswith("{\"")]
+    torn = [r for r in recs if r["tag"] != "summary"]
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in torn))
+    assert obs_report.main(["--jsonl", str(jsonl)]) == 0
+    out = capsys.readouterr().out
+    assert "goodput: 82.0% productive (running pct at step 150" in out
+
+
+def test_report_handles_missing_artifacts(tmp_path, capsys):
+    jsonl, _ = _write_fixture(tmp_path, with_stragglers=False)
+    rc = obs_report.main(["--jsonl", str(jsonl)])  # no trace given
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no cross-host aggregates" in out
+    assert "no trace file" in out
+    # missing jsonl → exit 2, not a traceback
+    assert obs_report.main(["--run-dir", str(tmp_path / "nope")]) == 2

@@ -8,6 +8,7 @@ a jax upgrade or refactor that silently breaks the evidence pipeline
 fails the suite instead of the next wedged-lease round.
 """
 
+import functools
 import json
 import os
 import subprocess

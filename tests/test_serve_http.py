@@ -124,6 +124,28 @@ def test_healthz_and_errors(server):
     assert e.value.code == 400
 
 
+def test_metrics_scrape_exposes_batcher_and_requests(server):
+    """GET /metrics (obs/exposition.py): Prometheus text format with the
+    batcher gauges and per-path request counters."""
+    port, *_ = server
+    _post(port, {"prompt": "ab", "max_tokens": 2})  # ensure >= 1 request
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=60) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        body = r.read().decode()
+    series = {}
+    for line in body.strip().splitlines():
+        if not line.startswith("#"):
+            key, value = line.rsplit(" ", 1)
+            series[key] = float(value)  # every line parses
+    # batcher counters mirrored as gauges at scrape time
+    assert "serve_batcher_generated_tokens" in series
+    # earlier tests in this module POSTed completions through this server
+    hits = [k for k in series if k.startswith("http_requests_total")]
+    assert hits, body[:800]
+
+
 def test_scheduler_death_flips_healthz_and_fails_fast():
     """A device error in the decode loop must not leave a zombie server:
     waiters fail immediately and /healthz reports the error."""

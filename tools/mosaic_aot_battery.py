@@ -154,13 +154,17 @@ def main(argv=None) -> int:
     seq_sh = NamedSharding(mesh, seq_spec)
 
     def ring_fn(q_, k_, v_):
+        # deferred like every jax import here: scrub_axon_identity()
+        # must run before anything touches jax (compat imports it)
+        from pytorch_distributed_train_tpu.utils.compat import shard_map
+
         body = functools.partial(
             ring_attention_local, axis_name="context", axis_size=4,
             causal=True, chunk_impl="pallas", interpret=False)
-        return jax.shard_map(body, mesh=mesh,
-                             in_specs=(seq_spec, seq_spec, seq_spec),
-                             out_specs=seq_spec,
-                             check_vma=False)(q_, k_, v_)
+        return shard_map(body, mesh=mesh,
+                         in_specs=(seq_spec, seq_spec, seq_spec),
+                         out_specs=seq_spec,
+                         check_vma=False)(q_, k_, v_)
 
     V["ring.pallas.4dev"] = _compile(
         ring_fn,

@@ -152,9 +152,12 @@ def main() -> int:
                 # Persist the measured A/B into the record so
                 # _pallas_usable's auto-gate can pick the WINNER, not
                 # merely the compilable: an ok-but-slower kernel must
-                # not silently regress impl='auto' users.
-                rec["flash_ms"] = row["value"]
-                rec["chunked_ms"] = row["chunked_ms"]
+                # not silently regress impl='auto' users. UNROUNDED —
+                # the gate compares these floats exactly (flash <=
+                # chunked), and a near-tie can flip under 2-decimal
+                # rounding; the bench row above rounds for display only.
+                rec["flash_ms"] = ab["flash_ms"]
+                rec["chunked_ms"] = ab["chunked_ms"]
                 rec["ab_measured"] = rec["probed"]
                 with open(args.out, "w") as f:
                     json.dump(rec, f, indent=1)

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""One-screen run report from a run's observability artifacts.
+
+    python tools/obs_report.py --run-dir checkpoints/
+    python tools/obs_report.py --jsonl metrics.jsonl --trace trace.json
+
+Reads the ``metrics.jsonl`` the MetricLogger writes and (optionally) the
+Chrome ``trace.json`` the span recorder exports, and prints:
+
+- the goodput breakdown (wall-time buckets from the summary record; a
+  run that died before its summary still reports the last train
+  record's running goodput_pct — the crashed-run case a report tool
+  exists for),
+- the step-time p50/p99 trend over the logged windows,
+- the cluster straggler table (multi-host runs logging
+  ``obs.straggler_metrics`` aggregates),
+- top span names by total time (from the trace file).
+
+Pure stdlib + the repo; no jax import — safe on a login host against a
+run directory on shared storage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_jsonl(path: str) -> list[dict]:
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line of a crashed run
+    return recs
+
+
+def _bar(frac: float, width: int = 30) -> str:
+    n = max(0, min(width, round(frac * width)))
+    return "#" * n + "." * (width - n)
+
+
+def goodput_section(recs: list[dict]) -> list[str]:
+    src = None
+    for r in reversed(recs):
+        if any(k.startswith("goodput_s_") for k in r):
+            src = r
+            break
+    if src is None:
+        # Crashed run: no summary record was written. Train records
+        # carry only the running pct — report that instead of nothing.
+        for r in reversed(recs):
+            if "goodput_pct" in r:
+                return [f"goodput: {r['goodput_pct']:.1f}% productive "
+                        f"(running pct at step {r.get('step')}; run died "
+                        "before the summary breakdown)"]
+        return ["goodput: no goodput records (pre-obs run?)"]
+    wall = float(src.get("goodput_wall_s", 0.0)) or sum(
+        v for k, v in src.items() if k.startswith("goodput_s_"))
+    out = [f"goodput: {src.get('goodput_pct', 0.0):.1f}% productive of "
+           f"{wall:.1f}s wall (tag={src.get('tag')}, step={src.get('step')})"]
+    for k in sorted((k for k in src if k.startswith("goodput_s_")),
+                    key=lambda k: -float(src[k])):
+        v = float(src[k])
+        out.append(f"  {k[len('goodput_s_'):]:<12} {v:>10.2f}s "
+                   f"{_bar(v / wall if wall else 0.0)} "
+                   f"{100.0 * v / wall if wall else 0.0:5.1f}%")
+    return out
+
+
+def trend_section(recs: list[dict], width: int = 8) -> list[str]:
+    rows = [r for r in recs
+            if r.get("tag") == "train" and "step_time_ms_p50" in r]
+    if not rows:
+        return ["step-time: no windows logged"]
+    out = ["step-time trend (per log window):",
+           f"  {'step':>8} {'p50 ms':>10} {'p99 ms':>10} "
+           f"{'stall %':>8} {'goodput %':>10}"]
+    # First/last windows matter most; elide the middle to keep one screen
+    show = (rows if len(rows) <= 2 * width
+            else rows[:width] + [None] + rows[-width:])
+    for r in show:
+        if r is None:
+            out.append(f"  {'...':>8}")
+            continue
+        out.append(
+            f"  {r['step']:>8} {r['step_time_ms_p50']:>10.2f} "
+            f"{r.get('step_time_ms_p99', float('nan')):>10.2f} "
+            f"{r.get('input_stall_pct', 0.0):>8.2f} "
+            f"{r.get('goodput_pct', float('nan')):>10.2f}")
+    return out
+
+
+def straggler_section(recs: list[dict]) -> list[str]:
+    rows = [r for r in recs
+            if r.get("tag") == "train" and "step_time_p50_max" in r]
+    if not rows:
+        return ["stragglers: no cross-host aggregates "
+                "(single host, or obs.straggler_metrics off)"]
+    last = rows[-1]
+    out = [f"stragglers (last window, step {last['step']}):",
+           f"  {'metric':<18} {'min':>10} {'med':>10} {'max':>10} "
+           f"{'max host':>9}"]
+    for key in ("step_time_p50", "input_stall_pct", "hbm_used"):
+        if f"{key}_max" not in last:
+            continue
+        out.append(f"  {key:<18} {last[f'{key}_min']:>10.3f} "
+                   f"{last[f'{key}_med']:>10.3f} {last[f'{key}_max']:>10.3f} "
+                   f"{int(last[f'{key}_max_host']):>9}")
+    # Chronic straggler: the host that is the step-time max most often
+    hosts = [int(r["step_time_p50_max_host"]) for r in rows
+             if "step_time_p50_max_host" in r]
+    if hosts:
+        worst = max(set(hosts), key=hosts.count)
+        out.append(f"  step-time max host over {len(hosts)} windows: "
+                   f"host {worst} ({hosts.count(worst)}x)")
+    return out
+
+
+def spans_section(trace_path: str, top: int = 8) -> list[str]:
+    if not trace_path or not os.path.exists(trace_path):
+        return ["spans: no trace file"]
+    try:
+        with open(trace_path) as f:
+            events = json.load(f).get("traceEvents", [])
+    except ValueError:
+        return [f"spans: unreadable trace {trace_path}"]
+    agg: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        agg.setdefault(e["name"], []).append(float(e.get("dur", 0.0)) / 1e6)
+    if not agg:
+        return ["spans: trace has no complete events"]
+    out = [f"spans ({sum(len(v) for v in agg.values())} events, "
+           f"top {min(top, len(agg))} by total time):",
+           f"  {'name':<28} {'count':>7} {'total s':>10} {'mean ms':>10}"]
+    for name, durs in sorted(agg.items(),
+                             key=lambda kv: -sum(kv[1]))[:top]:
+        tot = sum(durs)
+        out.append(f"  {name:<28} {len(durs):>7} {tot:>10.2f} "
+                   f"{1e3 * tot / len(durs):>10.2f}")
+    return out
+
+
+def report(jsonl_path: str, trace_path: str = "") -> str:
+    recs = load_jsonl(jsonl_path)
+    lines = [f"== run report: {jsonl_path} ({len(recs)} records) =="]
+    for section in (goodput_section(recs), trend_section(recs),
+                    straggler_section(recs),
+                    spans_section(trace_path)):
+        lines.append("")
+        lines.extend(section)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--run-dir", default="",
+                   help="run directory holding metrics.jsonl (+ trace.json)")
+    p.add_argument("--jsonl", default="", help="explicit metrics.jsonl path")
+    p.add_argument("--trace", default="", help="explicit trace.json path")
+    args = p.parse_args(argv)
+    jsonl = args.jsonl or (os.path.join(args.run_dir, "metrics.jsonl")
+                           if args.run_dir else "")
+    if not jsonl or not os.path.exists(jsonl):
+        print(f"obs_report: no metrics.jsonl at {jsonl!r} "
+              "(--run-dir or --jsonl)", file=sys.stderr)
+        return 2
+    trace = args.trace or (os.path.join(args.run_dir, "trace.json")
+                           if args.run_dir else "")
+    print(report(jsonl, trace))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

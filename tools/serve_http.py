@@ -80,6 +80,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pytorch_distributed_train_tpu.obs.exposition import (  # noqa: E402
+    CONTENT_TYPE as _METRICS_CONTENT_TYPE,
+    render_metrics,
+)
+from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
+from pytorch_distributed_train_tpu.obs.spans import span  # noqa: E402
 from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
 
 
@@ -564,6 +570,22 @@ def make_handler(service: BatcherService):
                     self._send(503, {"status": "error",
                                      "error": service.error,
                                      "stats": service.stats()})
+            elif self.path.split("?", 1)[0] == "/metrics":
+                # Prometheus scrape (obs/): request counters + latency
+                # histograms + batcher gauges, same registry the trainer
+                # sidecar serves. Reads plain counters only — never the
+                # scheduler lock, so a wedged decode stays scrapable.
+                for k, v in service.stats().items():
+                    if isinstance(v, (int, float)):
+                        get_registry().gauge(
+                            f"serve_batcher_{k}",
+                            help="continuous-batcher counter").set(v)
+                body = render_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", _METRICS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._send(404, {"error": "unknown path"})
 
@@ -572,6 +594,20 @@ def make_handler(service: BatcherService):
                                  "/v1/chat/completions"):
                 self._send(404, {"error": "unknown path"})
                 return
+            # Request-handling observability: a counter per path and a
+            # span covering the handler (wait + decode + serialization)
+            # — span durations land in the span_seconds{name=...}
+            # histogram, so /metrics carries request latency for free.
+            get_registry().counter(
+                "http_requests_total", labels={"path": self.path},
+                help="requests by path").inc()
+            # full path in the name: '/v1/completions' and
+            # '/v1/chat/completions' must be distinct histogram series
+            with span("http." + self.path.strip("/").replace("/", "."),
+                      path=self.path):
+                self._handle_post()
+
+        def _handle_post(self):
             chat = self.path == "/v1/chat/completions"
             try:
                 n = int(self.headers.get("Content-Length", 0))
