@@ -20,8 +20,12 @@ import os
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from pytorch_distributed_train_tpu.faults import integrity
+from pytorch_distributed_train_tpu.faults import registry as faults_registry
+from pytorch_distributed_train_tpu.faults import retry as retry_lib
 from pytorch_distributed_train_tpu.obs.spans import span
 from pytorch_distributed_train_tpu.train_state import TrainState
 
@@ -66,8 +70,12 @@ class CheckpointManager:
         # TensorStore writes continue past it (their tail shows up in
         # checkpoint.wait spans) — exactly the host-stall attribution the
         # goodput ckpt bucket wants.
-        with span("checkpoint.save", step=step):
-            saved = self.mgr.save(
+        def _do_save():
+            # `ckpt.save_io` fault point: an armed schedule raises an
+            # InjectedFault(OSError) here, exercising the same
+            # retry/backoff path a real transient write error takes.
+            faults_registry.maybe_fire("ckpt.save_io", step=step)
+            return self.mgr.save(
                 step,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardSave(_savable(state)),
@@ -75,6 +83,13 @@ class CheckpointManager:
                 ),
                 force=force,
             )
+
+        with span("checkpoint.save", step=step):
+            saved = retry_lib.retry_call(_do_save, point="ckpt.save_io")
+        # Manifests for steps whose commit already landed (this one, when
+        # saving synchronously; earlier ones under async — Orbax waits
+        # out the previous in-flight write before starting a new one).
+        self._finalize_manifests()
         return bool(saved)
 
     def maybe_save(self, state: TrainState, *, epoch: int = 0,
@@ -85,17 +100,68 @@ class CheckpointManager:
             return self.save(state, epoch=epoch, step=step)
         return False
 
+    # ------------------------------------------------------------ integrity
+    def _finalize_manifests(self) -> None:
+        """Write manifests for committed-but-unmanifested steps and prune
+        manifests of garbage-collected ones. Idempotent and cheap when
+        nothing changed; called after save/wait/close so an async commit
+        always gets its manifest at the next opportunity."""
+        if not getattr(self.cfg, "integrity", True):
+            return
+        try:
+            steps = self.mgr.all_steps()
+        except Exception:
+            return  # manager already closed — nothing to finalize
+        integrity.prune_manifests(self.dir, steps)
+        for s in steps:
+            # all_steps() lists an in-flight async save whose directory
+            # is still tmp-named; skip it — the next call picks it up.
+            if integrity.has_manifest(self.dir, s):
+                continue
+            if not integrity.step_committed(self.dir, s):
+                continue
+            try:
+                integrity.write_manifest(self.dir, s, self.config_json)
+            except OSError as e:  # manifest failure must not fail the run
+                print(f"[ckpt] manifest write for step {s} failed: {e}",
+                      flush=True)
+
     # --------------------------------------------------------------- restore
     def latest_step(self) -> int | None:
         return self.mgr.latest_step()
+
+    def latest_good_step(self) -> int | None:
+        """Newest step that passes integrity verification, falling back
+        past partial/corrupt steps (each skip is logged and counted —
+        a resume that silently lands N*save_every steps earlier than
+        the operator believes is its own kind of fault)."""
+        if not getattr(self.cfg, "integrity", True):
+            return self.latest_step()
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        for s in sorted(self.mgr.all_steps(), reverse=True):
+            if not integrity.step_committed(self.dir, s):
+                continue  # in-flight async save, not a corruption
+            ok, reason = integrity.verify_step(self.dir, s)
+            if ok is None or ok:
+                return s  # verified, or pre-manifest (trusted)
+            get_registry().counter(
+                "ckpt_integrity_failures_total",
+                help="checkpoint steps skipped on restore after failing "
+                     "manifest verification").inc()
+            print(f"[ckpt] step {s} failed integrity check ({reason}); "
+                  f"falling back to an earlier checkpoint", flush=True)
+        return None
 
     def restore(self, abstract_state: TrainState, step: int | None = None
                 ) -> tuple[TrainState, dict] | None:
         """Restore into the sharding/dtype layout of ``abstract_state``
         (jax.eval_shape + shardings) — reshard-on-restore falls out of
-        Orbax restoring to the target sharding."""
+        Orbax restoring to the target sharding. With no explicit step,
+        restores the newest INTEGRITY-VERIFIED step (an explicit step is
+        restored as asked — the caller is overriding the fallback)."""
         if step is None:
-            step = self.latest_step()
+            step = self.latest_good_step()
         if step is None:
             return None
         template = _savable(abstract_state)
@@ -154,7 +220,7 @@ class CheckpointManager:
         caller's mesh layout; every subtree NOT named (opt_state, the EMA
         mirror — 2-3x params for adam at 7B) is never deserialized."""
         if step is None:
-            step = self.latest_step()
+            step = self.latest_good_step()
         if step is None:
             return None
         # partial_restore=True returns the TEMPLATE LEAVES UNCHANGED for
@@ -225,9 +291,11 @@ class CheckpointManager:
     def wait(self) -> None:
         with span("checkpoint.wait"):
             self.mgr.wait_until_finished()
+        self._finalize_manifests()
 
     def close(self) -> None:
         self.mgr.wait_until_finished()
+        self._finalize_manifests()
         self.mgr.close()
 
 

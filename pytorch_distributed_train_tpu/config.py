@@ -380,6 +380,11 @@ class CheckpointConfig:
     # (max_to_keep=1); resume still uses the latest cadence checkpoint.
     best_metric: str = ""
     best_mode: str = "max"  # max | min
+    # Per-step integrity manifests (faults/integrity.py): after each
+    # Orbax commit, inventory the step's files (sizes + content hashes)
+    # under <dir>/manifests/; restore verifies and falls back past
+    # corrupt/partial steps to the newest verified one.
+    integrity: bool = True
 
 
 @dataclass
@@ -401,6 +406,10 @@ class ObsConfig:
     # counter reaches this value — but only in restart generation 0, so a
     # tpurun-supervised job crashes exactly once and must recover through
     # checkpoint resume. 0 → off. Test hook; no effect on saved state.
+    # DEPRECATED: kept as a back-compat shim routed through the fault
+    # registry as ``step.crash@step=N`` — new scenarios should use
+    # ``faults.inject`` (docs/fault_tolerance.md), which composes
+    # multiple faults per run.
     fault_inject_at_step: int = 0
     # Stall injection (SURVEY §5.3a): WEDGE this process (sleep forever,
     # heartbeat never beats) when the step counter reaches this value —
@@ -436,6 +445,39 @@ class ObsConfig:
     # setting is process-global; "" does not reset a value set by an
     # earlier Trainer in the same process.
     compile_cache_dir: str = ""
+
+
+@dataclass
+class FaultsConfig:
+    """Fault injection + recovery policies (faults/;
+    docs/fault_tolerance.md has the point catalog, schedule grammar and
+    recovery matrix)."""
+
+    # Declarative injection schedule: each entry is
+    # "<point>@key=val[:key=val...]", e.g.
+    #   ("ckpt.save_io@step=3:count=2", "preempt.sigterm@step=5").
+    # Keys: step (trainer step >= N), call (Nth traversal), p
+    # (per-traversal probability, seeded by `seed`), count (times to
+    # fire, default 1), gen (restart generation, default 0; -1 = all),
+    # rc (step.crash exit code), delay (step.straggle seconds). The
+    # PDTT_FAULTS env var appends more specs (subprocess workers,
+    # serving tools).
+    inject: tuple[str, ...] = ()
+    # Seed for probabilistic (p=) specs — chaos soak reproducibility.
+    seed: int = 0
+    # SIGTERM → set-a-flag; the train loop forces a synchronized
+    # checkpoint at the next step boundary, writes a `preempted` marker
+    # in the summary record, and exits cleanly (preempt_exit_code) —
+    # at most one step lost instead of save_every_steps. Off by
+    # default: the legacy behavior (watchdog dumps diagnostics and
+    # exits 143, fit()'s finally saves on the way down) remains.
+    graceful_preemption: bool = False
+    preempt_exit_code: int = 0
+    # Retry policy for fault-guarded I/O (checkpoint save, record
+    # decode): exponential backoff base*2^k capped at max, +jitter.
+    retry_max_attempts: int = 3
+    retry_base_delay_s: float = 0.05
+    retry_max_delay_s: float = 2.0
 
 
 @dataclass
@@ -499,6 +541,7 @@ class TrainConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    faults: FaultsConfig = field(default_factory=FaultsConfig)
     lora: LoraConfig = field(default_factory=LoraConfig)
     distill: DistillConfig = field(default_factory=DistillConfig)
     # Train loop horizon: epochs if >0, else total_steps.
@@ -571,6 +614,7 @@ _SECTIONS = {
     "mesh": MeshConfig,
     "checkpoint": CheckpointConfig,
     "obs": ObsConfig,
+    "faults": FaultsConfig,
     "lora": LoraConfig,
     "distill": DistillConfig,
 }

@@ -132,6 +132,14 @@ def _make_load_transform(dataset, item_style: bool, train: bool,
     class _LoadBatch(gp.MapTransform):
         def map(self, idx):
             idx = np.asarray(idx, np.int64)
+            # Retry/backoff + the `data.decode` fault point come from
+            # the faults package (lazy import: this transform pickles
+            # into grain worker processes, which rebuild their own
+            # process-local schedule from the PDTT_FAULTS env var —
+            # config-driven schedules arm the in-process
+            # worker_count=0 path).
+            from pytorch_distributed_train_tpu import faults as faults_lib
+
             # The span feeds span_seconds{name="data.grain.load_batch"}
             # — the decode wait is a scrapable histogram, so the
             # worker_count=0 throughput question (ADVICE round 5) is
@@ -144,18 +152,32 @@ def _make_load_transform(dataset, item_style: bool, train: bool,
                     # grain's read threads (PIL decode releases the
                     # GIL). Per-record rng keying is position-free, so
                     # thread scheduling cannot perturb reproducibility.
+                    # Substituted records (decode_with_retry's last
+                    # resort) keep the keying: record j's rng is always
+                    # (seed, epoch, j), wherever it lands.
                     def _load(i):
-                        return dataset.get_item(
-                            int(i), np.random.default_rng(
-                                np.random.SeedSequence(
-                                    (seed, epoch, int(i)))))
+                        def load(j):
+                            faults_lib.maybe_fire("data.decode")
+                            return dataset.get_item(
+                                int(j), np.random.default_rng(
+                                    np.random.SeedSequence(
+                                        (seed, epoch, int(j)))))
+
+                        return faults_lib.decode_with_retry(
+                            load, int(i), len(dataset))
 
                     items = list(_decode_pool().map(_load, idx))
                     return {k: np.stack([it[k] for it in items])
                             for k in items[0]}
-                rng = np.random.default_rng(np.random.SeedSequence(
-                    (seed, epoch) + tuple(int(t) for t in idx)))
-                return dataset.get_batch(idx, rng, train)
+
+                def _load_batch():
+                    faults_lib.maybe_fire("data.decode")
+                    rng = np.random.default_rng(np.random.SeedSequence(
+                        (seed, epoch) + tuple(int(t) for t in idx)))
+                    return dataset.get_batch(idx, rng, train)
+
+                return faults_lib.retry_call(_load_batch,
+                                             point="data.decode")
 
     return _LoadBatch()
 

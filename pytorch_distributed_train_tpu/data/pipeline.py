@@ -140,17 +140,33 @@ class HostDataLoader:
             yield self._collate(chunk, rng)
 
     def _collate(self, chunk: np.ndarray, rng: np.random.Generator) -> dict:
+        # `data.decode` fault point + retry/backoff (faults/): transient
+        # decode errors (real or injected) back off and retry; a record
+        # that stays undecodable is substituted-and-counted — static
+        # SPMD batch shapes forbid dropping a row (faults/retry.py).
+        from pytorch_distributed_train_tpu import faults as faults_lib
+
         if not getattr(self.dataset, "is_item_style", False):
-            return self.dataset.get_batch(chunk, rng, self.train)
+            def _load_batch(_i=None):
+                faults_lib.maybe_fire("data.decode")
+                return self.dataset.get_batch(chunk, rng, self.train)
+
+            return faults_lib.retry_call(_load_batch, point="data.decode")
         if self._pool is None:
             self._pool = ThreadPoolExecutor(max_workers=max(1, self.num_workers))
         seeds = rng.integers(0, 2**63, size=len(chunk))
-        items = list(
-            self._pool.map(
-                lambda a: self.dataset.get_item(int(a[0]), np.random.default_rng(int(a[1]))),
-                zip(chunk, seeds),
-            )
-        )
+        n = len(self.dataset)
+
+        def _load_one(a):
+            i, seed = int(a[0]), int(a[1])
+
+            def load(j):
+                faults_lib.maybe_fire("data.decode")
+                return self.dataset.get_item(j, np.random.default_rng(seed))
+
+            return faults_lib.decode_with_retry(load, i, n)
+
+        items = list(self._pool.map(_load_one, zip(chunk, seeds)))
         return {k: np.stack([it[k] for it in items]) for k in items[0]}
 
 

@@ -67,6 +67,13 @@ class LaunchConfig:
     # must survive: it hosts the store + JAX coordinator.
     min_nnodes: int = 0
     rendezvous_window_s: float = 10.0
+    # Worker shutdown escalation: SIGTERM, then SIGKILL once this grace
+    # period expires. A worker wedged in a collective (or one taking a
+    # graceful-preemption checkpoint that outruns the grace) cannot
+    # ignore its way into wedging the gang restart — SIGKILL is
+    # unconditional. Size it to cover a checkpoint save when workers run
+    # with faults.graceful_preemption.
+    shutdown_grace_s: float = 10.0
     # Hard ceiling on a rendezvous round: below min_nnodes arrivals when
     # it expires → the round FAILS (rc 44) instead of spinning forever
     # (matches the fixed-world barrier's 600 s bound).
@@ -141,14 +148,22 @@ class ElasticAgent:
                   f"world {world}, coord :{self.coord_port})")
 
     def _kill_all(self) -> None:
+        """SIGTERM every live worker, then escalate to SIGKILL for any
+        still alive when ``shutdown_grace_s`` expires (torchrun's
+        SignalException escalation): a worker stuck in a collective —
+        or one that installed a SIGTERM handler and wedged inside it —
+        must not be able to stall the gang restart indefinitely."""
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
+        deadline = time.time() + self.cfg.shutdown_grace_s
         for p in self.procs:
             try:
                 p.wait(max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
+                self._log(f"worker pid {p.pid} survived SIGTERM past the "
+                          f"{self.cfg.shutdown_grace_s:.1f}s grace; "
+                          "escalating to SIGKILL")
                 p.kill()
                 p.wait()
 
@@ -446,6 +461,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="seconds node 0 waits for stragglers before "
                         "closing a degraded rendezvous round")
     p.add_argument("--monitor-interval", type=float, default=0.5)
+    p.add_argument("--shutdown-grace", type=float, default=10.0,
+                   help="seconds between SIGTERM and SIGKILL when tearing "
+                        "down workers (raise it when workers checkpoint "
+                        "on SIGTERM — faults.graceful_preemption)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command, e.g. train.py --config ...")
     args = p.parse_args(argv)
@@ -467,6 +486,7 @@ def main(argv: list[str] | None = None) -> int:
         monitor_interval_s=args.monitor_interval,
         min_nnodes=args.min_nnodes,
         rendezvous_window_s=args.rendezvous_window,
+        shutdown_grace_s=args.shutdown_grace,
     )
     return ElasticAgent(cfg, cmd).run()
 

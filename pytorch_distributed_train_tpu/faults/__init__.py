@@ -1,0 +1,44 @@
+"""Fault-injection chaos layer + recovery policies (ISSUE 2 tentpole).
+
+Three pillars, wired through trainer / checkpoint / data / elastic /
+serving (docs/fault_tolerance.md has the catalog and recovery matrix):
+
+- ``registry``   — named fault points (``ckpt.save_io``, ``data.decode``,
+                   ``step.crash``, ``step.straggle``, ``preempt.sigterm``,
+                   ``serve.handler``) driven by a declarative schedule
+                   (``TrainConfig.faults.inject`` / ``PDTT_FAULTS`` env),
+                   counted in ``faults_injected_total{point=...}``.
+- ``retry``      — exponential-backoff + jitter retry policies
+                   (``retries_total``), plus the decode
+                   substitute-and-count last resort
+                   (``records_skipped_total``).
+- ``preemption`` — SIGTERM → checkpoint-and-clean-exit, composing with
+                   the watchdog's diagnostics dump in either install
+                   order.
+- ``integrity``  — per-step checkpoint manifests; ``latest_good_step``
+                   falls back past corrupt/partial steps
+                   (``ckpt_integrity_failures_total``).
+
+Plain host-side Python: no jax at module scope, so data-loader worker
+processes and serving tools can traverse fault points freely.
+"""
+
+from pytorch_distributed_train_tpu.faults.registry import (  # noqa: F401
+    ENV_VAR,
+    FaultSchedule,
+    FaultSpec,
+    InjectedFault,
+    POINTS,
+    configure,
+    get_schedule,
+    maybe_fire,
+    parse_spec,
+    set_step,
+)
+from pytorch_distributed_train_tpu.faults.retry import (  # noqa: F401
+    RetryPolicy,
+    decode_with_retry,
+    default_policy,
+    retry_call,
+    set_default_policy,
+)

@@ -1,0 +1,164 @@
+"""Checkpoint integrity manifests: prove a step is restorable BEFORE
+restoring it.
+
+Orbax's atomic-rename commit protects against a crash DURING a save
+(the half-written step stays under a ``.orbax-checkpoint-tmp-*`` name),
+but nothing protects against corruption AFTER commit — a truncated
+array file from a dying disk, an rsync that copied half a step, an
+operator's stray ``rm``. A resume that restores such a step either
+crashes the gang (best case) or silently trains on garbage. So after
+each commit we write a per-step manifest beside the step tree:
+
+    <ckpt_dir>/manifests/step_<N>.json
+      {"step": N, "config_sha256": ..., "files": {relpath:
+        {"size": bytes, "sha256": hex-or-null}}, "manifest_sha256": ...}
+
+``files`` inventories every file under the committed step directory
+with its size, plus a content hash for files up to ``HASH_MAX_BYTES``
+(sizes catch truncation for free; hashing terabyte-scale shards on
+every save would tax exactly the I/O path checkpointing competes for).
+``manifest_sha256`` self-seals the manifest body. Verification on
+restore checks presence + size + hash; ``latest_good_step`` walks steps
+newest-first and falls back past any step that fails, logging what it
+skipped and counting it in ``ckpt_integrity_failures_total``.
+
+Manifests live OUTSIDE the step directory so Orbax's layout stays
+untouched — and so truncating/deleting files inside a step cannot also
+delete the evidence needed to detect it. Pre-manifest checkpoints
+(written before this layer existed) verify as "unknown" and are
+trusted, preserving resume compatibility.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+MANIFEST_DIRNAME = "manifests"
+# Per-file content-hash cap: sizes are always recorded; content hashes
+# only for files at or under this many bytes (TensorStore shards of a
+# 7B run are GBs each — hashing them doubles save I/O for little
+# marginal protection over the size check).
+HASH_MAX_BYTES = 256 * 1024 * 1024
+
+
+def manifest_path(root: str, step: int) -> str:
+    return os.path.join(root, MANIFEST_DIRNAME, f"step_{int(step)}.json")
+
+
+def has_manifest(root: str, step: int) -> bool:
+    return os.path.exists(manifest_path(root, step))
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, str(int(step)))
+
+
+def step_committed(root: str, step: int) -> bool:
+    """Whether Orbax finished committing this step: the FINAL-named
+    directory exists (an in-flight async save lives under a
+    ``.orbax-checkpoint-tmp-*`` name until its rename)."""
+    return os.path.isdir(step_dir(root, step))
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _inventory(sdir: str) -> dict[str, dict]:
+    files: dict[str, dict] = {}
+    for dirpath, _, names in os.walk(sdir):
+        for name in names:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, sdir)
+            size = os.path.getsize(full)
+            files[rel] = {
+                "size": int(size),
+                "sha256": (_sha256_file(full)
+                           if size <= HASH_MAX_BYTES else None),
+            }
+    return files
+
+
+def _seal(body: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()).hexdigest()
+
+
+def write_manifest(root: str, step: int, config_json: str = "") -> str:
+    """Inventory the committed step and write its manifest atomically
+    (tmp + rename: a manifest must never itself be a partial file)."""
+    sdir = step_dir(root, step)
+    body = {
+        "step": int(step),
+        "config_sha256": hashlib.sha256(
+            (config_json or "").encode()).hexdigest(),
+        "files": _inventory(sdir),
+    }
+    body["manifest_sha256"] = _seal(
+        {k: v for k, v in body.items() if k != "manifest_sha256"})
+    path = manifest_path(root, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(body, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def verify_step(root: str, step: int) -> tuple[bool | None, str]:
+    """(ok, reason). ok=None means "no manifest" — a pre-manifest
+    checkpoint the caller should trust for back-compat."""
+    path = manifest_path(root, step)
+    if not os.path.exists(path):
+        return None, "no manifest"
+    try:
+        with open(path) as f:
+            body = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest: {e}"
+    sealed = body.get("manifest_sha256")
+    if sealed != _seal(
+            {k: v for k, v in body.items() if k != "manifest_sha256"}):
+        return False, "manifest seal mismatch (manifest itself corrupt)"
+    sdir = step_dir(root, step)
+    if not os.path.isdir(sdir):
+        return False, "step directory missing"
+    for rel, meta in body.get("files", {}).items():
+        full = os.path.join(sdir, rel)
+        if not os.path.exists(full):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(full)
+        if size != meta["size"]:
+            return False, (f"size mismatch {rel}: "
+                           f"{size} != {meta['size']}")
+        if meta.get("sha256") and size <= HASH_MAX_BYTES:
+            if _sha256_file(full) != meta["sha256"]:
+                return False, f"content hash mismatch {rel}"
+    return True, "ok"
+
+
+def prune_manifests(root: str, live_steps) -> None:
+    """Drop manifests whose step Orbax already garbage-collected
+    (max_to_keep) — a stale manifest is harmless but misleading."""
+    mdir = os.path.join(root, MANIFEST_DIRNAME)
+    if not os.path.isdir(mdir):
+        return
+    live = {int(s) for s in live_steps}
+    for name in os.listdir(mdir):
+        if not (name.startswith("step_") and name.endswith(".json")):
+            continue
+        try:
+            step = int(name[len("step_"):-len(".json")])
+        except ValueError:
+            continue
+        if step not in live:
+            try:
+                os.remove(os.path.join(mdir, name))
+            except OSError:
+                pass  # best-effort housekeeping

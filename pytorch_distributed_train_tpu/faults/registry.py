@@ -1,0 +1,287 @@
+"""Fault-injection registry: named fault points + a declarative schedule.
+
+The PR-1 observability layer made every run legible; this layer makes
+every FAILURE legible — and scriptable. Call sites that can fail in
+production declare a named fault point (the catalog below) and traverse
+it on the hot path; a schedule parsed from ``TrainConfig.faults.inject``
+(or the ``PDTT_FAULTS`` env var, for subprocess workers and the serving
+tool) decides which traversals actually fire. This replaces the single
+hard-kill hook (``obs.fault_inject_at_step``, now routed through here as
+``step.crash@step=N``) with multi-fault scenarios a test or soak run can
+compose: "two transient checkpoint I/O errors at step 3, then a SIGTERM
+preemption at step 5".
+
+Schedule grammar (one spec per entry)::
+
+    <point>@<key>=<value>[:<key>=<value>...]
+
+    keys: step  — fire once the trainer's step counter reaches this value
+          call  — fire on the Nth traversal of the point (1-based; for
+                  points with no step context, e.g. serve.handler)
+          p     — per-traversal probability (seeded; chaos soak)
+          count — how many times to fire (default 1)
+          gen   — restart generation to fire in (default 0: first
+                  generation only, so a supervised job faults once and
+                  must recover; -1 = every generation)
+          rc    — exit code for step.crash (default 41)
+          delay — straggle sleep seconds for step.straggle (default 2.0)
+
+What firing MEANS is a property of the point, not the spec: I/O-shaped
+points raise ``InjectedFault`` (an OSError, so the retry policies treat
+it exactly like a real transient error), ``step.crash`` hard-exits,
+``step.straggle`` sleeps, ``preempt.sigterm`` delivers a real SIGTERM to
+this process. Every fire increments ``faults_injected_total{point=...}``
+in the obs registry, so a soak run's report can prove the faults
+actually happened.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+
+# point name -> action performed when a matching spec fires
+POINTS: dict[str, str] = {
+    "ckpt.save_io": "raise",     # checkpoint save I/O (checkpoint.py)
+    "data.decode": "raise",      # record decode (data/pipeline, grain)
+    "serve.handler": "raise",    # HTTP request handler (tools/serve_http)
+    "step.crash": "exit",        # hard process kill between steps
+    "step.straggle": "sleep",    # transient slow step (straggler)
+    "preempt.sigterm": "sigterm",  # scheduler preemption drill
+}
+
+
+class InjectedFault(OSError):
+    """An injected transient fault. Subclasses OSError so retry policies
+    treat it exactly like the real I/O error it stands in for."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    point: str
+    step: int | None = None
+    at_call: int | None = None
+    p: float = 0.0
+    count: int = 1
+    gen: int = 0
+    rc: int = 41
+    delay_s: float = 2.0
+    # mutable bookkeeping
+    fired: int = 0
+    calls: int = 0
+
+    def spec_str(self) -> str:
+        parts = []
+        if self.step is not None:
+            parts.append(f"step={self.step}")
+        if self.at_call is not None:
+            parts.append(f"call={self.at_call}")
+        if self.p:
+            parts.append(f"p={self.p}")
+        parts.append(f"count={self.count}")
+        return f"{self.point}@" + ":".join(parts)
+
+
+_INT_KEYS = {"step", "call", "count", "gen", "rc"}
+_FLOAT_KEYS = {"p", "delay"}
+
+
+def parse_spec(spec: str) -> FaultSpec:
+    """``point@key=val[:key=val...]`` → FaultSpec (ValueError on typos:
+    an injection schedule that silently does nothing is itself a silent
+    fault)."""
+    text = spec.strip()
+    if "@" not in text:
+        raise ValueError(
+            f"fault spec {spec!r}: expected '<point>@key=val[:key=val...]' "
+            f"(points: {sorted(POINTS)})")
+    point, _, rest = text.partition("@")
+    point = point.strip()
+    if point not in POINTS:
+        raise ValueError(
+            f"fault spec {spec!r}: unknown point {point!r} "
+            f"(points: {sorted(POINTS)})")
+    out = FaultSpec(point=point)
+    for part in filter(None, (p.strip() for p in rest.split(":"))):
+        if "=" not in part:
+            raise ValueError(f"fault spec {spec!r}: bad clause {part!r}")
+        k, _, v = part.partition("=")
+        k = k.strip()
+        try:
+            if k in _INT_KEYS:
+                val = int(v)
+            elif k in _FLOAT_KEYS:
+                val = float(v)
+            else:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"fault spec {spec!r}: bad clause {part!r} "
+                f"(keys: {sorted(_INT_KEYS | _FLOAT_KEYS)})") from None
+        if k == "step":
+            out.step = val
+        elif k == "call":
+            out.at_call = val
+        elif k == "p":
+            out.p = val
+        elif k == "count":
+            out.count = val
+        elif k == "gen":
+            out.gen = val
+        elif k == "rc":
+            out.rc = val
+        elif k == "delay":
+            out.delay_s = val
+    if out.step is None and out.at_call is None and out.p <= 0.0:
+        raise ValueError(
+            f"fault spec {spec!r}: needs at least one trigger "
+            "(step=, call=, or p=)")
+    return out
+
+
+class FaultSchedule:
+    """Parsed injection schedule + the traversal-time matching logic.
+
+    Thread model: fault points are traversed from the step loop, data
+    producer/decode threads, and HTTP handler threads; matching mutates
+    per-spec counters under one lock (traversals are rare relative to
+    work done between them, and correctness of count= demands atomicity).
+    """
+
+    def __init__(self, specs: tuple[str, ...] = (), seed: int = 0):
+        self.specs = [parse_spec(s) for s in specs]
+        self._lock = threading.Lock()
+        self._step: int | None = None
+        import numpy as np
+
+        self._rng = np.random.default_rng(seed)
+
+    # ------------------------------------------------------------ trainer
+    def set_step(self, step: int) -> None:
+        self._step = step
+
+    # ------------------------------------------------------------ matching
+    def _generation(self) -> str:
+        return os.environ.get("RESTART_GENERATION", "0")
+
+    def check(self, point: str, step: int | None = None) -> FaultSpec | None:
+        """One traversal of ``point``: returns the spec that fires, or
+        None. Firing decrements the spec's remaining count. ``step``
+        overrides the trainer-set counter for this traversal — call
+        sites that know their step (checkpoint save in a tool, a test
+        driving CheckpointManager directly) match step= specs without a
+        Trainer loop running set_step."""
+        if point not in POINTS:
+            raise KeyError(f"undeclared fault point {point!r} "
+                           f"(catalog: {sorted(POINTS)})")
+        gen = self._generation()
+        cur_step = step if step is not None else self._step
+        with self._lock:
+            for spec in self.specs:
+                if spec.point != point:
+                    continue
+                if spec.gen >= 0 and gen != str(spec.gen):
+                    continue
+                spec.calls += 1
+                if spec.fired >= spec.count:
+                    continue
+                if spec.step is not None and (
+                        cur_step is None or cur_step < spec.step):
+                    continue
+                if spec.at_call is not None and spec.calls < spec.at_call:
+                    continue
+                if spec.p > 0.0 and not (self._rng.random() < spec.p):
+                    continue
+                spec.fired += 1
+                return spec
+        return None
+
+    # -------------------------------------------------------------- firing
+    def maybe_fire(self, point: str, step: int | None = None) -> bool:
+        """Traverse ``point``; perform the point's action if a spec fires.
+
+        Returns False when nothing fired. ``raise``-kind points raise
+        InjectedFault; exit/sleep/sigterm perform their side effect and
+        return True."""
+        spec = self.check(point, step=step)
+        if spec is None:
+            return False
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "faults_injected_total", labels={"point": point},
+            help="deliberately injected faults by fault point").inc()
+        action = POINTS[point]
+        at = f" at step {step}" if step is not None else ""
+        if action == "exit":
+            print(f"[fault-inject] killing process{at} ({point})",
+                  flush=True)
+            os._exit(spec.rc)
+        if action == "sleep":
+            print(f"[fault-inject] straggling {spec.delay_s}s{at} "
+                  f"({point})", flush=True)
+            time.sleep(spec.delay_s)
+            return True
+        if action == "sigterm":
+            print(f"[fault-inject] SIGTERM to self{at} ({point})",
+                  flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return True
+        raise InjectedFault(
+            f"injected fault: {point}{at} ({spec.spec_str()})")
+
+
+# ------------------------------------------------------------- process-global
+_SCHEDULE: FaultSchedule | None = None
+_LOCK = threading.Lock()
+
+ENV_VAR = "PDTT_FAULTS"
+
+
+def _env_specs() -> tuple[str, ...]:
+    raw = os.environ.get(ENV_VAR, "")
+    return tuple(s.strip() for s in raw.split(",") if s.strip())
+
+
+def configure(specs: tuple[str, ...] = (), seed: int = 0,
+              legacy_crash_step: int = 0) -> FaultSchedule:
+    """Install the process-global schedule from config specs + the
+    PDTT_FAULTS env var. ``legacy_crash_step`` routes the deprecated
+    ``obs.fault_inject_at_step`` hook through the registry as
+    ``step.crash@step=N`` (generation 0 only — the original contract)."""
+    global _SCHEDULE
+    all_specs = tuple(specs) + _env_specs()
+    if legacy_crash_step:
+        all_specs += (f"step.crash@step={int(legacy_crash_step)}",)
+    sched = FaultSchedule(all_specs, seed=seed)
+    with _LOCK:
+        _SCHEDULE = sched
+    return sched
+
+
+def get_schedule() -> FaultSchedule:
+    """The process-global schedule; lazily built from PDTT_FAULTS alone
+    when nothing configured one (serving tools, data workers)."""
+    global _SCHEDULE
+    if _SCHEDULE is None:
+        with _LOCK:
+            if _SCHEDULE is None:
+                _SCHEDULE = FaultSchedule(_env_specs())
+    return _SCHEDULE
+
+
+def maybe_fire(point: str, step: int | None = None) -> bool:
+    return get_schedule().maybe_fire(point, step=step)
+
+
+def set_step(step: int) -> None:
+    get_schedule().set_step(step)
+
+
+def _reset_for_tests() -> None:
+    global _SCHEDULE
+    with _LOCK:
+        _SCHEDULE = None

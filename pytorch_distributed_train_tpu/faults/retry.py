@@ -1,0 +1,113 @@
+"""Retry/backoff recovery policies (exponential backoff + jitter).
+
+The absorb-in-place half of the fault story: a transient I/O error on a
+checkpoint save or a record decode should cost milliseconds of backoff,
+not a whole-gang restart (minutes of re-init + re-compile + restore —
+exactly the goodput hole SURVEY §5.3 describes). Every retry is counted
+(``retries_total{point=...}``) and printed — a policy that absorbs
+faults silently would hide a dying disk until the job ran out of
+attempts at 3 a.m.
+
+``decode_with_retry`` adds the data-pipeline-specific last resort:
+SPMD batches have static shapes, so a record that stays undecodable
+after all attempts cannot simply be dropped — it is SUBSTITUTED with a
+neighboring record, counted in ``records_skipped_total``, and reported
+on stderr (the torch DataLoader convention of raising and killing the
+epoch trades one bad JPEG for the whole job; we trade it for one
+duplicated sample).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import sys
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter: float = 0.5  # +[0, jitter) fraction of the delay, decorrelates
+    retry_on: tuple = (OSError,)
+
+
+_DEFAULT = RetryPolicy()
+
+
+def default_policy() -> RetryPolicy:
+    return _DEFAULT
+
+
+def set_default_policy(policy: RetryPolicy) -> None:
+    """Install the process default (Trainer wires it from
+    ``TrainConfig.faults``); call sites that pass no policy get it."""
+    global _DEFAULT
+    _DEFAULT = policy
+
+
+def _counter(point: str):
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+    return get_registry().counter(
+        "retries_total", labels={"point": point or "unlabeled"},
+        help="operations retried after a transient fault, by fault point")
+
+
+def retry_call(fn, *, policy: RetryPolicy | None = None, point: str = ""):
+    """Call ``fn()``; on a retryable exception back off and try again,
+    up to ``policy.max_attempts`` total attempts. The LAST failure
+    propagates — retry exhaustion is the caller's fault to escalate, not
+    this helper's to swallow."""
+    policy = policy or _DEFAULT
+    delay = policy.base_delay_s
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except policy.retry_on as e:
+            if attempt >= policy.max_attempts:
+                raise
+            _counter(point).inc()
+            print(f"[retry] {point or 'op'} attempt {attempt}/"
+                  f"{policy.max_attempts} failed ({type(e).__name__}: {e}); "
+                  f"retrying in {delay:.3f}s", file=sys.stderr, flush=True)
+            time.sleep(delay * (1.0 + policy.jitter * random.random()))
+            delay = min(delay * 2.0, policy.max_delay_s)
+            attempt += 1
+
+
+def decode_with_retry(load, index: int, n_records: int, *,
+                      policy: RetryPolicy | None = None,
+                      max_substitutes: int = 2):
+    """Decode record ``index`` via ``load(i)`` with retry; on exhaustion
+    substitute up to ``max_substitutes`` neighboring records (static
+    SPMD batch shapes forbid dropping a row). Never silent: the skip is
+    counted and printed. Raises the final error only when the
+    substitutes fail too."""
+    policy = policy or _DEFAULT
+    try:
+        return retry_call(lambda: load(int(index)), policy=policy,
+                          point="data.decode")
+    except policy.retry_on as e:
+        last = e
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+    get_registry().counter(
+        "records_skipped_total",
+        help="records replaced by a substitute after decode retries "
+             "were exhausted").inc()
+    for k in range(1, max_substitutes + 1):
+        sub = (int(index) + k) % max(n_records, 1)
+        print(f"[decode] record {index} undecodable after "
+              f"{policy.max_attempts} attempts ({type(last).__name__}: "
+              f"{last}); substituting record {sub}",
+              file=sys.stderr, flush=True)
+        try:
+            return retry_call(lambda: load(sub), policy=policy,
+                              point="data.decode")
+        except policy.retry_on as e:
+            last = e
+    raise last

@@ -160,6 +160,31 @@ class MetricsRegistry:
             name = sanitize_name(f"{prefix}_{k}" if prefix else k)
             self.gauge(name).set(v)
 
+    # ------------------------------------------------------------ readers
+    def get_value(self, name: str, labels: dict | None = None) -> float | None:
+        """Current value of one counter/gauge series, or None when the
+        series doesn't exist (tests + tools read back what the fault
+        layer counted without parsing the text exposition)."""
+        name = sanitize_name(name)
+        key = tuple(sorted((str(k), str(v))
+                           for k, v in (labels or {}).items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam[0] == "histogram":
+                return None
+            inst = fam[2].get(key)
+            return None if inst is None else float(inst.value)
+
+    def family_total(self, name: str) -> float:
+        """Sum over every label set of a counter/gauge family (0.0 when
+        absent) — e.g. retries_total across all fault points."""
+        name = sanitize_name(name)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam[0] == "histogram":
+                return 0.0
+            return float(sum(inst.value for inst in fam[2].values()))
+
     # ---------------------------------------------------------- renderer
     def render(self) -> str:
         """Prometheus text format v0.0.4."""

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorch_distributed_train_tpu import faults as faults_lib
 from pytorch_distributed_train_tpu import lora as lora_lib
 from pytorch_distributed_train_tpu import losses as losses_lib
 from pytorch_distributed_train_tpu import steps as steps_lib
@@ -50,6 +51,17 @@ class Trainer:
         _t_init0 = time.perf_counter()
         self.goodput = GoodputTracker(t0=_t_init0)
         self.cfg = cfg
+        # ---- fault schedule + recovery policies (faults/): configured
+        # before data/checkpoint construction so every fault point those
+        # layers traverse is already armed. obs.fault_inject_at_step is
+        # the deprecated single-kill hook, routed through the registry.
+        self.faults = faults_lib.configure(
+            tuple(cfg.faults.inject), seed=cfg.faults.seed,
+            legacy_crash_step=cfg.obs.fault_inject_at_step)
+        faults_lib.set_default_policy(faults_lib.RetryPolicy(
+            max_attempts=cfg.faults.retry_max_attempts,
+            base_delay_s=cfg.faults.retry_base_delay_s,
+            max_delay_s=cfg.faults.retry_max_delay_s))
         if cfg.obs.debug_nans:
             debug_lib.enable_nan_debugging()
         if cfg.obs.compile_cache_dir:
@@ -244,7 +256,7 @@ class Trainer:
                           if cfg.checkpoint.best_metric else None)
         if (cfg.lora.rank > 0 and cfg.lora.base_checkpoint
                 and (cfg.checkpoint.resume == "none"
-                     or self.ckpt.latest_step() is None)):
+                     or self.ckpt.latest_good_step() is None)):
             # Fresh LoRA run: pull the frozen base from the pretrained
             # checkpoint. A restarted run (resume enabled + own ckpt
             # present) skips this — its resume below restores
@@ -294,6 +306,19 @@ class Trainer:
             self._peak_flops = None
         self.recorder = FlightRecorder(dump_dir=cfg.checkpoint.dir)
         self.recorder.install_signal_dump()
+        # Graceful preemption (faults/preemption.py): SIGTERM sets a
+        # flag; the step loop checkpoints and exits cleanly. Composes
+        # with the dump handler above in either install order — the
+        # dump still happens, but the loop owns process exit.
+        self.preempt = None
+        self._preempted = False
+        if cfg.faults.graceful_preemption:
+            from pytorch_distributed_train_tpu.faults.preemption import (
+                PreemptionHandler,
+            )
+
+            self.preempt = PreemptionHandler()
+            self.preempt.install()
         self.heartbeat = Heartbeat(cfg.obs.heartbeat_timeout_s, self.recorder)
         self._profiling = False
         # ---- unified obs layer (obs/): spans + registry + goodput.
@@ -564,6 +589,19 @@ class Trainer:
                         # step-time percentiles AND the input-stall
                         # denominator (meter.total_s).
                         self.meter.reset_clock()
+                    if self.preempt is not None and self.preempt.requested:
+                        # Graceful preemption: stop at this step boundary;
+                        # fit()'s finally force-saves the synchronized
+                        # checkpoint and the summary carries the marker.
+                        self._preempted = True
+                        self.recorder.record("preempt", step)
+                        if jax.process_index() == 0:
+                            print(f"[preempt] stopping at step {step}; "
+                                  "checkpointing and exiting cleanly",
+                                  flush=True)
+                        break
+                if self._preempted:
+                    break
                 epoch += 1
                 if not cfg.eval_every_steps:
                     # every epoch boundary INCLUDING the last: the final
@@ -571,7 +609,8 @@ class Trainer:
                     with self.goodput.measure("eval"):
                         self.evaluate(step)
                 self.meter.reset_clock()  # epoch boundary: don't count eval time
-            if (getattr(cfg.optim, "swa_update_bn_batches", 0) > 0
+            if (not self._preempted
+                    and getattr(cfg.optim, "swa_update_bn_batches", 0) > 0
                     and self.state.ema_params is not None
                     and self.state.batch_stats
                     and (self.state.swa_count is None
@@ -603,6 +642,7 @@ class Trainer:
             self.logger.log(
                 step,
                 {"wall_time_s": time.time() - t_start,
+                 "preempted": int(self._preempted),
                  **self.meter.percentiles(), **self.goodput.snapshot()},
                 prefix="summary",
             )
@@ -769,16 +809,16 @@ class Trainer:
         return avg
 
     def _maybe_inject_fault(self, step: int) -> None:
-        """SURVEY §5.3c: hard-kill between steps, first generation only —
-        the elastic-recovery test path (no finally-save, no flush; exactly
-        what a real host loss looks like to the launcher)."""
-        import os
-
-        fault = self.cfg.obs.fault_inject_at_step
-        if (fault and step >= fault
-                and os.environ.get("RESTART_GENERATION", "0") == "0"):
-            print(f"[fault-inject] killing process at step {step}", flush=True)
-            os._exit(41)
+        """Step-boundary fault points (faults/registry.py): hard-kill
+        (``step.crash`` — SURVEY §5.3c, no finally-save, no flush;
+        exactly what a real host loss looks like to the launcher),
+        transient straggle (``step.straggle``), and self-delivered
+        preemption (``preempt.sigterm``). ``obs.fault_inject_at_step``
+        arrives here too, shimmed to ``step.crash@step=N``."""
+        self.faults.set_step(step)
+        self.faults.maybe_fire("step.crash", step=step)
+        self.faults.maybe_fire("step.straggle", step=step)
+        self.faults.maybe_fire("preempt.sigterm", step=step)
 
     def _maybe_inject_stall(self, step: int) -> None:
         """SURVEY §5.3a: wedge (don't crash) this step, first generation
@@ -827,6 +867,12 @@ class Trainer:
             jax.profiler.stop_trace()
             self._profiling = False
             self.recorder.record("profile_stop", step)
+
+    @property
+    def preempted(self) -> bool:
+        """Did a graceful SIGTERM preemption end fit() early? (train.py
+        maps this to ``faults.preempt_exit_code``.)"""
+        return self._preempted
 
     def close(self) -> None:
         self.heartbeat.stop()

@@ -28,6 +28,17 @@ import threading
 import time
 
 
+def _graceful_preemption_armed() -> bool:
+    """Is a graceful-preemption handler (faults/preemption.py) armed?
+    Read via sys.modules so merely asking never imports the faults
+    package — if it was never imported, nobody armed it."""
+    mod = sys.modules.get("pytorch_distributed_train_tpu.faults.preemption")
+    try:
+        return bool(mod and mod.armed())
+    except Exception:
+        return False
+
+
 class FlightRecorder:
     def __init__(self, capacity: int = 256, dump_dir: str = ""):
         self.capacity = capacity
@@ -84,7 +95,13 @@ class FlightRecorder:
 
     def install_signal_dump(self) -> None:
         """Dump ring + stacks on SIGTERM (scheduler preemption) — the
-        analogue of the NCCL watchdog's debug dump on timeout."""
+        analogue of the NCCL watchdog's debug dump on timeout.
+
+        Chains to any previously-installed handler instead of
+        overwriting it, and leaves process exit to the train loop when a
+        graceful-preemption handler is armed (faults/preemption.py) —
+        the two compose in either install order. Only with no other
+        handler in play does the legacy terminal ``sys.exit(143)`` run."""
         if self._installed:
             return
         self._installed = True
@@ -93,9 +110,23 @@ class FlightRecorder:
         def _handler(signum, frame):
             self.dump()
             faulthandler.dump_traceback()
-            signal.default_int_handler(signum, frame) if signum == signal.SIGINT else sys.exit(143)
+            if signum == signal.SIGINT:
+                signal.default_int_handler(signum, frame)
+                return
+            if callable(prev) and prev not in (signal.SIG_DFL,
+                                               signal.SIG_IGN):
+                prev(signum, frame)  # chain first (it may raise/exit)
+            if _graceful_preemption_armed():
+                return  # the train loop checkpoints and exits cleanly
+            # No graceful handler armed: keep the legacy guarantee that
+            # SIGTERM terminates (fit()'s finally saves on the way down)
+            # even when some OTHER chained handler returned — otherwise
+            # the job trains through its grace window and gets SIGKILLed
+            # with nothing saved.
+            sys.exit(143)
 
         try:
+            prev = signal.getsignal(signal.SIGTERM)
             signal.signal(signal.SIGTERM, _handler)
         except ValueError:
             pass  # not the main thread (tests)

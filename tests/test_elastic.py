@@ -184,3 +184,33 @@ def test_cli_smoke(tmp_path):
     )
     assert r.returncode == 0, r.stderr
     assert os.path.exists(str(out) + "0") and os.path.exists(str(out) + "1")
+
+
+def test_sigterm_ignoring_worker_gets_sigkilled(tmp_path):
+    """Shutdown escalation (ISSUE 2): a worker that ignores SIGTERM (a
+    stand-in for one wedged in a collective) must be SIGKILLed after the
+    grace period so the gang teardown cannot wedge. Rank 0 fails fast;
+    rank 1 ignores SIGTERM and sleeps far beyond any test timeout — the
+    run completing promptly IS the escalation working."""
+    import time
+
+    stubborn = """
+import os, signal, sys, time
+rank = int(os.environ["PROCESS_ID"])
+if rank == 0:
+    sys.exit(7)  # trigger the gang teardown immediately
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+with open(os.path.join({out!r}, "ignoring"), "w") as f:
+    f.write("armed")
+time.sleep(300)
+"""
+    script = tmp_path / "worker.py"
+    script.write_text(stubborn.format(out=str(tmp_path)))
+    cfg = LaunchConfig(nprocs=2, max_restarts=0, monitor_interval_s=0.1,
+                       shutdown_grace_s=1.0)
+    t0 = time.time()
+    rc = ElasticAgent(cfg, [sys.executable, str(script)]).run()
+    elapsed = time.time() - t0
+    assert rc == 7  # the real failure surfaced, not a hang
+    # grace 1s + monitor + process spawn slack; nowhere near the 300s nap
+    assert elapsed < 60, f"teardown took {elapsed:.1f}s — SIGKILL not sent?"

@@ -363,3 +363,99 @@ def test_degraded_mesh_resume_keeps_global_batch(tmp_path, devices8):
         np.testing.assert_allclose(
             part2[s], ref[s], rtol=1e-4,
             err_msg=f"step {s}: degraded resume diverged")
+
+
+PREEMPT_RESUME_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorch_distributed_train_tpu.config import TrainConfig
+from pytorch_distributed_train_tpu.trainer import Trainer
+
+cfg = TrainConfig()
+cfg.model.name = "resnet18"; cfg.model.num_classes = 10
+cfg.model.image_size = 8
+cfg.data.dataset = "synthetic_images"; cfg.data.synthetic_size = 256
+cfg.data.batch_size = 32; cfg.data.num_workers = 1; cfg.data.prefetch = 2
+cfg.optim.name = "momentum"; cfg.optim.learning_rate = 0.05
+cfg.optim.schedule = "constant"; cfg.optim.warmup_steps = 0
+cfg.total_steps = 8
+cfg.checkpoint.dir = {ckpt!r}
+cfg.checkpoint.save_every_steps = 10**9  # NO cadence saves: only the
+# graceful-preemption path can produce the step-5 checkpoint
+cfg.checkpoint.async_save = False
+cfg.obs.log_every_steps = 1
+cfg.obs.jsonl_path = {metrics!r}
+cfg.faults.graceful_preemption = True
+cfg.faults.inject = ("preempt.sigterm@step=5",)  # gen 0 only (default)
+t = Trainer(cfg)
+t.fit()
+t.close()
+sys.exit(cfg.faults.preempt_exit_code if t.preempted else 0)
+"""
+
+
+@pytest.mark.slow
+def test_sigterm_preempt_resume_reaches_same_loss(tmp_path):
+    """Graceful preemption end-to-end (ISSUE 2 tentpole): SIGTERM (self-
+    injected via the fault registry at step 5) must checkpoint AT step 5
+    and exit cleanly (rc 0); the restarted generation resumes from 5 —
+    one step of loss budget instead of save_every_steps — and reaches
+    the same losses as an uninterrupted run (the same-final-loss
+    property the hard-kill test pins)."""
+    # Uninterrupted reference.
+    rc, ref_metrics = _run_worker(tmp_path, "pref", fault=0,
+                                  supervised=False)
+    assert rc == 0
+    ref = _read_metrics(ref_metrics)
+
+    ckpt = str(tmp_path / "ckpt-preempt")
+    metrics = str(tmp_path / "metrics-preempt.jsonl")
+    script = tmp_path / "worker-preempt.py"
+    script.write_text(PREEMPT_RESUME_WORKER.format(
+        repo=REPO, ckpt=ckpt, metrics=metrics))
+
+    # Generation 0: preempted at step 5, checkpoints, exits cleanly.
+    env = {**os.environ, **CPU_ENV, "RESTART_GENERATION": "0"}
+    r = subprocess.run([sys.executable, str(script)], env=env, timeout=600,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.returncode, r.stderr[-800:])
+    assert "[preempt] SIGTERM received" in r.stdout, r.stdout[-800:]
+    assert "[preempt] stopping at step 5" in r.stdout, r.stdout[-800:]
+    # the chained watchdog handler still dumped diagnostics on the way
+    assert "flight recorder" in r.stderr.lower()
+
+    # The ONLY checkpoint is the preemption save at step 5, verified.
+    from pytorch_distributed_train_tpu.checkpoint import CheckpointManager
+    from pytorch_distributed_train_tpu.config import CheckpointConfig
+    from pytorch_distributed_train_tpu.faults import integrity
+
+    mgr = CheckpointManager(CheckpointConfig(dir=ckpt, async_save=False))
+    assert mgr.latest_good_step() == 5
+    assert integrity.verify_step(mgr.dir, 5)[0] is True
+    mgr.close()
+
+    # "tpurun restart": generation 1 resumes from 5 and completes 8.
+    env["RESTART_GENERATION"] = "1"
+    r2 = subprocess.run([sys.executable, str(script)], env=env, timeout=600,
+                        capture_output=True, text=True)
+    assert r2.returncode == 0, (r2.returncode, r2.stderr[-800:])
+    assert "[resume] restored step 5" in r2.stdout, r2.stdout[-800:]
+
+    got = _read_metrics(metrics)  # jsonl appends across both generations
+    assert max(got) == 8 and max(ref) == 8
+    # summary rows: gen 0 preempted=1, gen 1 preempted=0
+    flags = []
+    with open(metrics) as f:
+        for line in f:
+            row = json.loads(line)
+            if row.get("tag") == "summary":
+                flags.append(row.get("preempted"))
+    assert flags == [1, 0], flags
+    for s in sorted(set(ref) & set(got)):
+        np.testing.assert_allclose(
+            got[s]["loss"], ref[s]["loss"], rtol=1e-4,
+            err_msg=f"step {s}: preempt-resume diverged from "
+                    "uninterrupted run",
+        )

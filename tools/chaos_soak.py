@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""chaos_soak — run a short training job under a randomized (seeded)
+fault schedule and prove it absorbed the chaos.
+
+The CI face of the faults/ layer (ISSUE 2 satellite): where the unit
+tests script one fault each, the soak composes several — transient
+checkpoint save I/O errors, flaky record decodes, a straggling step —
+drawn from a seeded RNG so a failing schedule is exactly reproducible
+by seed. Acceptance:
+
+- training completes all steps;
+- ``retries_total`` > 0 (the faults actually fired AND were absorbed
+  by the retry policies, not skipped);
+- the final checkpoint exists and passes manifest verification
+  (faults/integrity.py) at the expected step.
+
+Usage::
+
+    python tools/chaos_soak.py [--seed 0] [--steps 8] [--out DIR]
+
+Prints one JSON report line; exit 0 = pass. Registered as a slow-marked
+test (tests/test_chaos_soak.py) so tier-1 stays fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_schedule(seed: int, steps: int, attempts: int) -> list[str]:
+    """Randomized-but-reproducible schedule. Injected transient counts
+    stay BELOW the retry budget (count < attempts) so every fault is
+    absorbable — the soak proves recovery, not failure."""
+    rng = random.Random(seed)
+    specs = [
+        # 1-2 transient ckpt save failures at a random cadence step
+        f"ckpt.save_io@step={rng.randrange(2, max(3, steps))}"
+        f":count={rng.randrange(1, attempts)}:gen=-1",
+        # a flaky decode early in the run
+        f"data.decode@call={rng.randrange(1, 4)}"
+        f":count={rng.randrange(1, attempts)}:gen=-1",
+        # one short straggle
+        f"step.straggle@step={rng.randrange(1, steps + 1)}"
+        f":count=1:delay=0.2:gen=-1",
+    ]
+    if rng.random() < 0.5:
+        # probabilistic decode noise, seeded via faults.seed
+        specs.append(f"data.decode@p=0.05:count={attempts - 1}:gen=-1")
+    return specs
+
+
+def run_soak(seed: int = 0, steps: int = 8, out_dir: str = "") -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from pytorch_distributed_train_tpu.checkpoint import CheckpointManager
+    from pytorch_distributed_train_tpu.config import (
+        CheckpointConfig,
+        TrainConfig,
+    )
+    from pytorch_distributed_train_tpu.faults import integrity
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
+    from pytorch_distributed_train_tpu.trainer import Trainer
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="chaos-soak-")
+    cfg = TrainConfig()
+    cfg.model.name = "resnet18"
+    cfg.model.num_classes = 10
+    cfg.model.image_size = 8
+    cfg.data.dataset = "synthetic_images"
+    cfg.data.synthetic_size = 256
+    cfg.data.batch_size = 32
+    cfg.data.num_workers = 1
+    cfg.optim.name = "momentum"
+    cfg.optim.learning_rate = 0.05
+    cfg.optim.schedule = "constant"
+    cfg.optim.warmup_steps = 0
+    cfg.total_steps = steps
+    cfg.checkpoint.dir = os.path.join(out_dir, "ckpt")
+    cfg.checkpoint.save_every_steps = 2
+    cfg.checkpoint.async_save = False
+    cfg.obs.log_every_steps = max(1, steps // 2)
+    cfg.obs.jsonl_path = os.path.join(out_dir, "metrics.jsonl")
+    cfg.faults.seed = seed
+    schedule = build_schedule(seed, steps, cfg.faults.retry_max_attempts)
+    cfg.faults.inject = tuple(schedule)
+
+    trainer = Trainer(cfg)
+    trainer.fit()
+    trainer.close()
+
+    reg = get_registry()
+    injected = reg.family_total("faults_injected_total")
+    retries = reg.family_total("retries_total")
+    mgr = CheckpointManager(CheckpointConfig(dir=cfg.checkpoint.dir,
+                                             async_save=False))
+    final_step = mgr.latest_good_step()
+    verified = (final_step is not None
+                and integrity.verify_step(mgr.dir, final_step)[0] is True)
+    mgr.close()
+    report = {
+        "seed": seed,
+        "steps": steps,
+        "schedule": schedule,
+        "faults_injected_total": injected,
+        "retries_total": retries,
+        "records_skipped_total": reg.family_total("records_skipped_total"),
+        "final_good_step": final_step,
+        "final_manifest_verified": bool(verified),
+        "out_dir": out_dir,
+    }
+    report["ok"] = bool(
+        final_step == steps and verified and retries > 0 and injected > 0)
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--out", default="", help="run dir (default: tempdir)")
+    args = p.parse_args(argv)
+    report = run_soak(seed=args.seed, steps=args.steps, out_dir=args.out)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,10 @@ from pytorch_distributed_train_tpu.obs.exposition import (  # noqa: E402
     CONTENT_TYPE as _METRICS_CONTENT_TYPE,
     render_metrics,
 )
+from pytorch_distributed_train_tpu.faults import (  # noqa: E402
+    InjectedFault,
+    maybe_fire as _maybe_fire_fault,
+)
 from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
 from pytorch_distributed_train_tpu.obs.spans import span  # noqa: E402
 from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
@@ -601,6 +605,15 @@ def make_handler(service: BatcherService):
             get_registry().counter(
                 "http_requests_total", labels={"path": self.path},
                 help="requests by path").inc()
+            # `serve.handler` fault point (faults/; armed via the
+            # PDTT_FAULTS env var): an injected handler fault becomes a
+            # client-visible 503 — the retryable status well-behaved
+            # clients already handle — and a faults_injected_total tick.
+            try:
+                _maybe_fire_fault("serve.handler")
+            except InjectedFault as e:
+                self._send(503, {"error": str(e)})
+                return
             # full path in the name: '/v1/completions' and
             # '/v1/chat/completions' must be distinct histogram series
             with span("http." + self.path.strip("/").replace("/", "."),

@@ -162,6 +162,12 @@ def main(argv=None) -> int:
         return 0 if metrics else 1
     trainer.fit()
     trainer.close()
+    if trainer.preempted:
+        # Graceful SIGTERM preemption: the loop already checkpointed and
+        # the summary carries the `preempted` marker; the exit code is
+        # the operator's contract with the supervisor (default 0 =
+        # clean, so a whole-job reschedule resumes from the checkpoint).
+        return cfg.faults.preempt_exit_code
     return 0
 
 
