@@ -481,6 +481,55 @@ class FaultsConfig:
 
 
 @dataclass
+class SentinelConfig:
+    """Training health sentinel (sentinel/; docs/sentinel.md): numeric
+    fault guard + auto-rewind + cross-host hang diagnosis — recovery for
+    the faults that DON'T crash."""
+
+    # Master switch for the numeric plane: in-graph update gate (a
+    # non-finite grad/loss skips the optimizer update; params unchanged,
+    # sentinel_skipped_steps_total{reason=nonfinite}), the rolling
+    # loss-spike detector, and the auto-rewind loop. Off by default:
+    # spike/streak tracking reads the loss to host every step, which
+    # serializes async dispatch — a real (small) cost the operator opts
+    # into.
+    enabled: bool = False
+    # Loss-spike detector (sentinel/numeric.py): a loss deviating from
+    # the rolling-window median by more than spike_sigma robust sigmas
+    # (MAD * 1.4826) — and by more than spike_min_rel of the median, the
+    # floor that keeps a near-zero early MAD from flagging ordinary
+    # jitter — counts as a bad step. Only healthy losses enter the
+    # window, so divergence can't drag the baseline up after itself.
+    spike_window: int = 64
+    spike_sigma: float = 6.0
+    spike_min_samples: int = 8
+    spike_min_rel: float = 0.1
+    # Auto-rewind: after this many CONSECUTIVE bad steps (non-finite or
+    # spiking), restore the newest integrity-verified checkpoint
+    # (latest_good_step), fast-forward the data stream to it (the exact
+    # mid-epoch start_batch resume), scale the LR by lr_cooldown_factor
+    # (compounds per rewind; persists in the checkpointed opt state) and
+    # continue. max_rewinds bounds a run that keeps diverging — past it
+    # the sentinel raises instead of looping restore-diverge forever.
+    max_consecutive_bad: int = 3
+    lr_cooldown_factor: float = 0.5
+    max_rewinds: int = 8
+    # Liveness plane (sentinel/liveness.py): with a tpurun store present
+    # and hang_timeout_s > 0, every host publishes {step, ts} heartbeats
+    # at heartbeat_every_steps cadence and rank 0 monitors staleness on
+    # its OWN clock (clock-skew immune). On a hang: blamed-host
+    # diagnosis (id + open spans), cluster-wide flight-recorder dump,
+    # exit with hang_exit_code so the elastic agent gang-restarts.
+    # Size hang_timeout_s well above a step time and the longest
+    # checkpoint save; hosts that never heartbeat (first compile) are
+    # never blamed. 0 = off.
+    hang_timeout_s: float = 0.0
+    hang_poll_s: float = 1.0
+    hang_exit_code: int = 43
+    heartbeat_every_steps: int = 1
+
+
+@dataclass
 class LoraConfig:
     """Parameter-efficient fine-tuning (lora.py). ``rank=0`` disables.
 
@@ -542,6 +591,7 @@ class TrainConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
     lora: LoraConfig = field(default_factory=LoraConfig)
     distill: DistillConfig = field(default_factory=DistillConfig)
     # Train loop horizon: epochs if >0, else total_steps.
@@ -615,6 +665,7 @@ _SECTIONS = {
     "checkpoint": CheckpointConfig,
     "obs": ObsConfig,
     "faults": FaultsConfig,
+    "sentinel": SentinelConfig,
     "lora": LoraConfig,
     "distill": DistillConfig,
 }

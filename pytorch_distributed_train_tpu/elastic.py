@@ -78,12 +78,37 @@ class LaunchConfig:
     # it expires → the round FAILS (rc 44) instead of spinning forever
     # (matches the fixed-world barrier's 600 s bound).
     rendezvous_timeout_s: float = 600.0
+    # WINDOWED restart budget (torchrun counts restarts absolutely; a
+    # long job then dies on its Nth transient fault even with days of
+    # healthy running between them, while a crash-looping job burns the
+    # whole budget in seconds). Here a generation that ran at least
+    # ``stable_window_s`` before failing RESETS ``restarts_used`` — the
+    # budget meters crash LOOPS, not lifetime bad luck — and each
+    # respawn backs off exponentially (base * 2^k, capped, +jitter so a
+    # multi-node gang's agents don't respawn in lockstep against a
+    # shared resource).
+    stable_window_s: float = 300.0
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 30.0
+    backoff_jitter: float = 0.25
 
 
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+def _backoff_delay(consecutive_failures: int, base_s: float, max_s: float,
+                   jitter: float, rand=None) -> float:
+    """Respawn delay before restart attempt k (1-based): base * 2^(k-1),
+    capped at max, stretched by up to ``jitter`` fraction of itself
+    (uniform). Pure so tests can pin it."""
+    import random as _random
+
+    rand = rand if rand is not None else _random.random
+    delay = min(base_s * (2 ** max(consecutive_failures - 1, 0)), max_s)
+    return delay * (1.0 + jitter * rand())
 
 
 class ElasticAgent:
@@ -224,19 +249,35 @@ class ElasticAgent:
                 self._last_gen = rnd
                 self._world_nodes = len(members)
                 self._members = members
+                t_spawn = time.time()
                 self._spawn(rnd, len(members), node_index)
                 rc = self._monitor(rnd)
                 if rc == 0:
                     self._log("all workers exited cleanly")
                     return 0
+                ran_s = time.time() - t_spawn
+                if ran_s >= cfg.stable_window_s and restarts_used:
+                    # Windowed budget: this generation ran long enough to
+                    # count as healthy — the failure is fresh bad luck,
+                    # not a continuation of a crash loop.
+                    self._log(f"generation ran {ran_s:.1f}s >= stable "
+                              f"window {cfg.stable_window_s:.1f}s; "
+                              f"resetting restart budget "
+                              f"({restarts_used} used)")
+                    restarts_used = 0
                 if restarts_used >= cfg.max_restarts:
                     self._log(f"worker failed (rc={rc}); restart budget "
                               f"exhausted after {restarts_used} restarts")
                     return rc
                 restarts_used += 1
                 rnd += 1
+                delay = _backoff_delay(restarts_used, cfg.backoff_base_s,
+                                       cfg.backoff_max_s,
+                                       cfg.backoff_jitter)
                 self._log(f"worker failed (rc={rc}); restarting gang "
-                          f"({restarts_used}/{cfg.max_restarts})")
+                          f"({restarts_used}/{cfg.max_restarts}) after "
+                          f"{delay:.2f}s backoff")
+                time.sleep(delay)
         finally:
             if self.agent_client is not None:
                 # Node 0 hosts the store every other agent is still polling:
@@ -465,6 +506,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="seconds between SIGTERM and SIGKILL when tearing "
                         "down workers (raise it when workers checkpoint "
                         "on SIGTERM — faults.graceful_preemption)")
+    p.add_argument("--stable-window", type=float, default=300.0,
+                   help="a generation that runs at least this long before "
+                        "failing resets the restart budget (the budget "
+                        "meters crash LOOPS, not lifetime restarts)")
+    p.add_argument("--backoff-base", type=float, default=1.0,
+                   help="respawn backoff: base seconds, doubling per "
+                        "consecutive fast failure")
+    p.add_argument("--backoff-max", type=float, default=30.0,
+                   help="respawn backoff cap in seconds")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command, e.g. train.py --config ...")
     args = p.parse_args(argv)
@@ -487,6 +537,9 @@ def main(argv: list[str] | None = None) -> int:
         min_nnodes=args.min_nnodes,
         rendezvous_window_s=args.rendezvous_window,
         shutdown_grace_s=args.shutdown_grace,
+        stable_window_s=args.stable_window,
+        backoff_base_s=args.backoff_base,
+        backoff_max_s=args.backoff_max,
     )
     return ElasticAgent(cfg, cmd).run()
 

@@ -51,6 +51,14 @@ POINTS: dict[str, str] = {
     "step.crash": "exit",        # hard process kill between steps
     "step.straggle": "sleep",    # transient slow step (straggler)
     "preempt.sigterm": "sigterm",  # scheduler preemption drill
+    # Sentinel drill points (sentinel/; docs/sentinel.md). "flag" points
+    # only RETURN True — the call site performs the corruption, because
+    # what "a numeric fault" means is a property of the trainer (poison
+    # the next batch / inflate the observed loss), not of this registry.
+    "step.nan": "flag",          # trainer poisons the next batch to NaN
+    "step.loss_spike": "flag",   # trainer inflates the OBSERVED loss
+    "host.hang": "hang",         # wedge this host forever (collective
+                                 # deadlock seen from outside)
 }
 
 
@@ -230,6 +238,26 @@ class FaultSchedule:
                   flush=True)
             os.kill(os.getpid(), signal.SIGTERM)
             return True
+        if action == "flag":
+            # The corruption itself is the call site's job (trainer:
+            # batch poisoning for step.nan, observed-loss inflation for
+            # step.loss_spike) — firing only reports the schedule match.
+            print(f"[fault-inject] flagging {point}{at}", flush=True)
+            return True
+        if action == "hang":
+            # Wedge THIS host forever inside an open span, so the
+            # cross-host liveness monitor (sentinel/liveness.py) can
+            # name the phase it is "stuck" in: the local heartbeat
+            # never beats again, the store heartbeat goes stale, and
+            # only an external abort ends this — exactly what a wedged
+            # collective looks like from outside.
+            print(f"[fault-inject] wedging host forever{at} ({point})",
+                  flush=True)
+            from pytorch_distributed_train_tpu.obs.spans import span
+
+            with span("fault.host_hang", step=step):
+                while True:
+                    time.sleep(60)
         raise InjectedFault(
             f"injected fault: {point}{at} ({spec.spec_str()})")
 

@@ -170,7 +170,7 @@ def make_schedule(opt_cfg, total_steps: int, steps_per_epoch: int = 0):
 
 
 def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0,
-                   param_mask=None):
+                   param_mask=None, sentinel_cooldown: bool = False):
     """Build the full optax transform chain.
 
     Order matters: clip → optimizer(+wd) → accumulate. Weight decay is
@@ -182,6 +182,11 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0,
     counts); with accumulation the inner schedule advances once per
     ``accum_steps``, so horizons are converted to optimizer updates here.
     ``warmup_steps`` is therefore denominated in optimizer updates.
+
+    ``sentinel_cooldown`` appends the sentinel's stateful LR-cooldown
+    transform (sentinel/numeric.py) as the LAST chain element — like
+    layer_lr_decay/plateau it scales FINAL updates, which is equivalent
+    to scaling the LR. It stays 1.0 until an auto-rewind scales it down.
     """
     accum = max(opt_cfg.accum_steps, 1)
     sched = make_schedule(
@@ -412,6 +417,12 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0,
             accumulation_size=max(opt_cfg.plateau_accumulation, 1),
             min_scale=opt_cfg.plateau_min_scale,
         ))
+    if sentinel_cooldown:
+        from pytorch_distributed_train_tpu.sentinel.numeric import (
+            cooldown_transform,
+        )
+
+        parts.append(cooldown_transform())
     tx = optax.chain(*parts)
     if param_mask is not None:
         # LoRA-style trainable/frozen masking. Must wrap INSIDE MultiSteps:

@@ -1,0 +1,44 @@
+"""Training health sentinel (ISSUE 3 tentpole): detect and recover from
+the faults that DON'T crash.
+
+PR 2's faults/ layer covers faults that kill a process (crash, SIGTERM,
+corrupt checkpoint). The costliest production failures are quieter: a
+non-finite gradient silently poisons the params, a diverging loss burns
+thousands of steps before a human notices, and one wedged host deadlocks
+every collective while each peer's LOCAL watchdog sees its own steps
+still completing (it is blocked, not dead). Three planes close that gap:
+
+- ``numeric``  — in-graph update gate (a non-finite grad/loss skips the
+                 optimizer update, params unchanged), a rolling
+                 median+MAD loss-spike detector, and the LR-cooldown
+                 optax transform the auto-rewind path scales.
+- rewind       — lives in the Trainer loop: after
+                 ``sentinel.max_consecutive_bad`` bad steps it restores
+                 the newest integrity-verified checkpoint
+                 (faults/integrity ``latest_good_step``), fast-forwards
+                 the data pipeline via the existing ``start_batch``
+                 resume, and applies the LR cooldown.
+- ``liveness`` — per-host ``{step, phase, ts}`` heartbeats through the
+                 elastic launcher's store (elastic.worker_store) plus a
+                 coordinator-side monitor that names the wedged host and
+                 its open span, triggers a cluster-wide flight-recorder
+                 dump, and exits with a distinct rc so the elastic
+                 agent's gang restart bounds the outage.
+
+Everything is counted in the obs registry
+(``sentinel_skipped_steps_total{reason=}``, ``sentinel_rewinds_total``,
+``sentinel_hangs_total``) and driven deterministically in tests by the
+``step.nan`` / ``step.loss_spike`` / ``host.hang`` fault points
+(faults/registry.py). docs/sentinel.md has the full story.
+"""
+
+from pytorch_distributed_train_tpu.sentinel.numeric import (  # noqa: F401
+    CooldownState,
+    SpikeDetector,
+    cooldown_scale,
+    cooldown_transform,
+    scale_cooldown,
+)
+from pytorch_distributed_train_tpu.sentinel.liveness import (  # noqa: F401
+    LivenessPlane,
+)

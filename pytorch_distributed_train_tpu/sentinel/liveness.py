@@ -1,0 +1,276 @@
+"""Cross-host liveness plane: store heartbeats + coordinator hang monitor.
+
+The failure mode utils/watchdog.py CANNOT see: one host wedges (stuck
+DCN link, deadlocked collective, runaway host-side op) and every OTHER
+host blocks inside the same collective. Each peer's local Heartbeat
+monitor only knows its own steps stopped — it cannot say WHOSE fault
+that is, and when every host aborts at its own local timeout the
+post-mortem names nobody. This plane answers the attribution question:
+
+- every host publishes ``{step, ts}`` heartbeats through the elastic
+  launcher's KV store (elastic.worker_store) at step cadence, plus a
+  background ``phase`` record carrying its currently-open trace spans
+  (obs/spans.py ``active_all`` — readable even while the main thread is
+  wedged, which is the whole point);
+- the coordinator (env rank 0) runs a monitor thread that watches for a
+  heartbeat going STALE — unchanged on the monitor's own clock for
+  ``hang_timeout_s`` (receiver-side staleness: immune to clock skew) —
+  then names the blamed host id and its open spans, sets a store key
+  that makes EVERY host's watcher thread dump its flight recorder
+  (cluster-wide post-mortem, not just the blamed host's), and exits
+  with ``exit_code`` so the elastic agent's whole-gang restart turns a
+  silent deadlock into a diagnosed, bounded-time outage.
+
+Hosts that have never heartbeat are NOT blamed — a gang stuck in
+first-compile must not be diagnosed as hung (init-phase wedges belong
+to the local heartbeat / scheduler timeout). Identity comes from the
+launcher env contract (``PROCESS_ID`` / ``NUM_PROCESSES`` /
+``RESTART_GENERATION``), not jax.distributed, so the plane works in any
+process tpurun spawns — including single-device workers in tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class LivenessPlane:
+    """Heartbeat publisher (every host) + hang monitor (rank 0).
+
+    ``store_factory`` returns a NEW store client per call (StoreClient
+    connections are not shared across threads); by default it is
+    elastic.worker_store, which yields None outside a tpurun job — the
+    plane then disables itself (``active`` False).
+    """
+
+    def __init__(self, *, hang_timeout_s: float, poll_s: float = 1.0,
+                 exit_code: int = 43, every_steps: int = 1,
+                 recorder=None, spans=None, store_factory=None,
+                 rank: int | None = None, world: int | None = None,
+                 gen: str | None = None, exit_fn=None):
+        from pytorch_distributed_train_tpu.elastic import worker_store
+
+        self.hang_timeout_s = hang_timeout_s
+        self.poll_s = max(0.05, poll_s)
+        self.exit_code = exit_code
+        self.every_steps = max(1, every_steps)
+        self.recorder = recorder
+        self.spans = spans
+        self._factory = store_factory or worker_store
+        self.rank = rank if rank is not None else _env_int("PROCESS_ID", 0)
+        self.world = (world if world is not None
+                      else _env_int("NUM_PROCESSES", 1))
+        self.gen = gen if gen is not None else os.environ.get(
+            "RESTART_GENERATION", "0")
+        self._exit = exit_fn or (lambda rc: os._exit(rc))
+        self._stop = threading.Event()
+        self._dumped = False
+        self._beat_store = None
+        self._threads: list[threading.Thread] = []
+        self.active = False
+        self.blamed: dict | None = None  # monitor's diagnosis (rank 0)
+
+    # ------------------------------------------------------------- keys
+    def _key(self, kind: str, rank: int | None = None) -> str:
+        base = f"sentinel/{self.gen}/{kind}"
+        return base if rank is None else f"{base}/{rank}"
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> bool:
+        """Connect and spawn the watcher (+ monitor on rank 0). Returns
+        False (plane inactive) when no launcher store is reachable."""
+        try:
+            self._beat_store = self._factory()
+        except OSError:
+            self._beat_store = None
+        if self._beat_store is None:
+            return False
+        self.active = True
+        watcher = threading.Thread(target=self._watch, daemon=True,
+                                   name="sentinel-liveness-watch")
+        watcher.start()
+        self._threads.append(watcher)
+        if self.rank == 0:
+            monitor = threading.Thread(target=self._monitor, daemon=True,
+                                       name="sentinel-hang-monitor")
+            monitor.start()
+            self._threads.append(monitor)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if self._beat_store is not None:
+            try:
+                self._beat_store.close()
+            except Exception:
+                pass
+            self._beat_store = None
+        self.active = False
+
+    # ------------------------------------------------------------ publish
+    def _publish_hb(self, step: int) -> None:
+        try:
+            self._beat_store.set(
+                self._key("hb", self.rank),
+                json.dumps({"step": int(step), "ts": time.time()}).encode())
+        except Exception:
+            pass  # best-effort: a flaky store must never fail training
+
+    def beat(self, step: int) -> None:
+        """Publish this host's heartbeat (call at step boundaries, main
+        thread — a wedged step loop stops beating, which is the signal)."""
+        self._last_step = step
+        if not self.active or step % self.every_steps:
+            return
+        self._publish_hb(step)
+
+    def pulse(self) -> None:
+        """Heartbeat from OUTSIDE the step loop — eval batches, BN
+        re-estimation, the final synchronized save. Liveness means "this
+        host is making progress", not "a train step completed"; without
+        these pulses any legitimately long non-step phase would go
+        heartbeat-silent and the monitor would blame a healthy host."""
+        if not self.active:
+            return
+        self._publish_hb(getattr(self, "_last_step", 0))
+
+    def _open_spans(self) -> dict:
+        if self.spans is None:
+            return {}
+        try:
+            return self.spans.active_all()
+        except Exception:
+            return {}
+
+    # ------------------------------------------------------------ watcher
+    def _watch(self) -> None:
+        """Every host: publish the phase record (open spans — readable
+        while the main thread is wedged) and obey cluster-dump orders."""
+        store = None
+        try:
+            store = self._factory()
+            while not self._stop.wait(self.poll_s):
+                store.set(
+                    self._key("phase", self.rank),
+                    json.dumps({"spans": self._open_spans(),
+                                "ts": time.time()}).encode())
+                try:
+                    raw = store.get(self._key("dump"), timeout_ms=1)
+                except TimeoutError:
+                    continue
+                self._dump_local(json.loads(raw.decode()))
+        except Exception:
+            pass  # store gone (teardown/agent death): the plane goes dark
+        finally:
+            if store is not None:
+                try:
+                    store.close()
+                except Exception:
+                    pass
+
+    def _dump_local(self, order: dict) -> None:
+        if self._dumped or self.recorder is None:
+            return
+        self._dumped = True
+        try:
+            self.recorder.dump(
+                reason=f"cluster hang dump: host {order.get('rank')} "
+                       f"blamed ({order.get('detail', '')})",
+                suffix="_hang")
+        except Exception:
+            pass  # diagnostics must never crash the dump path
+
+    # ------------------------------------------------------------ monitor
+    def _monitor(self) -> None:
+        """Rank 0: receiver-side staleness over every host's heartbeat."""
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        store = None
+        # rank -> (last raw payload, last-change monotonic ts); hosts
+        # enter only once they have heartbeat at least once.
+        seen: dict[int, tuple[bytes, float]] = {}
+        try:
+            store = self._factory()
+            while not self._stop.wait(self.poll_s):
+                now = time.monotonic()
+                stale: tuple[int, float, bytes] | None = None
+                for r in range(self.world):
+                    try:
+                        raw = store.get(self._key("hb", r), timeout_ms=50)
+                    except TimeoutError:
+                        continue  # never started: not blamable (see module doc)
+                    prev = seen.get(r)
+                    if prev is None or prev[0] != raw:
+                        seen[r] = (raw, now)
+                        continue
+                    age = now - prev[1]
+                    if age > self.hang_timeout_s and (
+                            stale is None or age > stale[1]):
+                        stale = (r, age, raw)
+                if stale is None:
+                    continue
+                rank, age, raw = stale
+                self._diagnose(store, rank, age, raw, get_registry())
+                return
+        except Exception:
+            pass  # store gone: the gang is already coming down
+        finally:
+            if store is not None:
+                try:
+                    store.close()
+                except Exception:
+                    pass
+
+    def _diagnose(self, store, rank: int, age: float, raw: bytes,
+                  registry) -> None:
+        hb = {}
+        try:
+            hb = json.loads(raw.decode())
+        except ValueError:
+            pass
+        phase: dict = {}
+        try:
+            phase = json.loads(store.get(
+                self._key("phase", rank), timeout_ms=200).decode())
+        except Exception:
+            pass
+        detail = (f"last step {hb.get('step')}, no heartbeat for "
+                  f"{age:.1f}s, open spans {phase.get('spans') or {}}")
+        self.blamed = {"rank": rank, "age_s": round(age, 1),
+                       "step": hb.get("step"),
+                       "spans": phase.get("spans") or {}}
+        registry.counter(
+            "sentinel_hangs_total",
+            help="cross-host hangs diagnosed by the liveness monitor").inc()
+        print(f"[sentinel] host {rank} appears HUNG: {detail} — "
+              f"triggering cluster flight-recorder dump and exiting "
+              f"rc={self.exit_code} for gang restart", flush=True)
+        if self.recorder is not None:
+            try:
+                self.recorder.record("hang_blamed", int(hb.get("step") or -1),
+                                     rank=rank, age_s=round(age, 1))
+            except Exception:
+                pass
+        try:
+            store.set(self._key("dump"),
+                      json.dumps({"rank": rank, "detail": detail}).encode())
+        except Exception:
+            pass
+        # Let every host's watcher see the dump order (they poll at
+        # poll_s), dump our own ring directly, then hand the outage to
+        # the elastic agent via the distinct exit code.
+        self._dump_local({"rank": rank, "detail": detail})
+        time.sleep(min(3.0, 2 * self.poll_s))
+        self._exit(self.exit_code)

@@ -1,0 +1,137 @@
+"""Numeric fault guard: loss-spike detection + the LR-cooldown transform.
+
+The in-graph half of the guard lives in steps.py (``numeric_guard=True``
+gates the optimizer update on all-finite grads/loss, the GradScaler
+skip-step pattern generalized to unscaled training); this module holds
+the HOST-side half the Trainer loop drives:
+
+- ``SpikeDetector`` — a rolling window of recent healthy losses; a new
+  loss is a spike when it deviates from the window median by more than
+  ``spike_sigma`` robust standard deviations (MAD * 1.4826 — the robust
+  sigma estimate, immune to the spike itself contaminating the
+  statistic the way a mean/std window would be).
+- ``cooldown_transform`` — an optax transform appended to the optimizer
+  chain whose state carries a single LR scale factor. The auto-rewind
+  path multiplies it down (``scale_cooldown``) AFTER restoring the
+  checkpoint, so the replayed steps rerun at reduced LR — the standard
+  divergence-recovery recipe (restore + cool down) without rebuilding
+  or recompiling the jitted step: the factor is an opt_state leaf, a
+  traced input, and it persists through subsequent checkpoints.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import NamedTuple
+
+# 1.4826 * MAD estimates sigma for a normal distribution; the constant
+# makes spike_sigma readable as "standard deviations".
+_MAD_TO_SIGMA = 1.4826
+
+
+class SpikeDetector:
+    """Rolling median+MAD divergence detector over HEALTHY losses.
+
+    Only losses accepted as healthy enter the window — a diverging run
+    must not drag the baseline up after it (that would let a slow ramp
+    to 10x loss pass as 'normal'). ``spike_min_rel`` is an absolute
+    floor on the deviation (relative to the median): early windows over
+    near-identical losses have a near-zero MAD, and without the floor
+    ordinary jitter would read as a many-sigma spike.
+    """
+
+    def __init__(self, window: int = 64, sigma: float = 6.0,
+                 min_samples: int = 8, min_rel: float = 0.1):
+        if window < 2:
+            raise ValueError(f"spike window must be >= 2, got {window}")
+        self.window: deque[float] = deque(maxlen=window)
+        self.sigma = sigma
+        self.min_samples = max(2, min_samples)
+        self.min_rel = min_rel
+
+    def is_spike(self, loss: float) -> bool:
+        """Would ``loss`` be a spike against the current window? Does
+        NOT add it — call ``add`` for losses judged healthy."""
+        if len(self.window) < self.min_samples:
+            return False
+        xs = sorted(self.window)
+        n = len(xs)
+        med = (xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2]))
+        devs = sorted(abs(x - med) for x in xs)
+        mad = (devs[n // 2] if n % 2
+               else 0.5 * (devs[n // 2 - 1] + devs[n // 2]))
+        threshold = max(self.sigma * _MAD_TO_SIGMA * mad,
+                        self.min_rel * abs(med))
+        return abs(loss - med) > threshold
+
+    def add(self, loss: float) -> None:
+        self.window.append(loss)
+
+    def reset(self) -> None:
+        """Forget the window (after a rewind: the replayed region's
+        losses re-enter from scratch — the pre-rewind tail may contain
+        the very divergence being recovered from)."""
+        self.window.clear()
+
+
+class CooldownState(NamedTuple):
+    """Optax state for ``cooldown_transform``: one replicated f32 scale."""
+
+    scale: object  # jnp scalar; object-typed to keep jax out of cold paths
+
+
+def cooldown_transform():
+    """Optax transform scaling final updates by a stateful factor
+    (1.0 = no-op). Appended LAST in the optimizer chain (like
+    layer_lr_decay / reduce_on_plateau: scaling final updates == scaling
+    the LR — before the optimizer, adam's normalization would undo it).
+    The update never changes the factor itself; only the host-side
+    rewind path does, via ``scale_cooldown``."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(params):
+        del params
+        return CooldownState(scale=jnp.float32(1.0))
+
+    def update(updates, state, params=None):
+        del params
+        updates = jax.tree.map(lambda u: u * state.scale.astype(u.dtype),
+                               updates)
+        return updates, state
+
+    return optax.GradientTransformation(init, update)
+
+
+def _map_cooldown(opt_state, fn):
+    import jax
+
+    return jax.tree.map(
+        lambda s: fn(s) if isinstance(s, CooldownState) else s,
+        opt_state, is_leaf=lambda s: isinstance(s, CooldownState))
+
+
+def scale_cooldown(opt_state, factor: float):
+    """Multiply the cooldown factor in an optimizer-state tree by
+    ``factor`` (the rewind path calls this AFTER restore, so the factor
+    compounds across repeated rewinds and survives in checkpoints).
+    Returns the state unchanged when no cooldown transform is in the
+    chain."""
+    import jax.numpy as jnp
+
+    return _map_cooldown(
+        opt_state,
+        lambda s: CooldownState(scale=s.scale * jnp.float32(factor)))
+
+
+def cooldown_scale(opt_state) -> float | None:
+    """Current cooldown factor, or None when the transform isn't in the
+    chain — the logging hook (effective LR = schedule * plateau * this)."""
+    hits: list = []
+    _map_cooldown(opt_state, lambda s: (hits.append(s.scale), s)[1])
+    if not hits:
+        return None
+    import numpy as np
+
+    return float(np.asarray(hits[0]))

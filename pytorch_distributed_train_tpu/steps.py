@@ -115,14 +115,22 @@ def make_train_step(model, loss_fn: Callable, tx,
                     swa_every: int = 1, mixup=None,
                     module_grad_norms: bool = False,
                     param_transform: Callable | None = None,
-                    teacher_fn: Callable | None = None) -> Callable:
+                    teacher_fn: Callable | None = None,
+                    numeric_guard: bool = False) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
     closes over the optax transform (and the static EMA decay / mixup
     transform); jit-wrapped by the caller with explicit shardings.
     ``module_grad_norms`` adds per-top-level-module grad norms to the
     metrics (grad_norm/<module> keys) — the torch-recipe debugging habit
     of watching which block's gradients explode/vanish; computed in-graph,
-    so it costs a few reductions, not a host transfer per param."""
+    so it costs a few reductions, not a host transfer per param.
+    ``numeric_guard`` (sentinel/) generalizes the GradScaler skip-step to
+    UNSCALED training: a non-finite grad or loss skips the optimizer
+    update in-graph (params/opt-state unchanged, step still advances)
+    and reports ``update_skipped`` in the metrics — one NaN batch costs
+    one skipped step instead of permanently poisoned params. With
+    dynamic loss scaling the scaler's own finite gate already does this;
+    the guard then only widens the check to include the loss value."""
     if not 0.0 <= ema_decay < 1.0:
         raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
     if swa_start > 0 and ema_decay > 0.0:
@@ -170,7 +178,13 @@ def make_train_step(model, loss_fn: Callable, tx,
             # GradScaler semantics (torch:amp/grad_scaler.py:302,375,484):
             # unscale, check finite, skip update on overflow, adjust scale.
             grads = jax.tree.map(lambda g: g / scale, grads)
-            finite = _tree_finite(grads)
+            grads_ok = _tree_finite(grads)
+            finite = grads_ok
+            if numeric_guard:
+                # sentinel: a finite-grads / non-finite-loss step (rare
+                # but real: an inf loss whose grad zeroed out) must not
+                # feed the EMA/plateau machinery a poisoned loss either.
+                finite &= jnp.isfinite(loss)
             stepped = state.apply_gradients(tx, grads, new_stats,
                                             ema_decay=ema_decay,
                                             swa_start=swa_start,
@@ -179,10 +193,33 @@ def make_train_step(model, loss_fn: Callable, tx,
             new_state = jax.tree.map(
                 lambda new, old: jnp.where(finite, new, old), stepped, skipped
             )
+            # The scaler adjusts on GRAD overflow only (GradScaler
+            # semantics): a non-finite loss with finite grads skips the
+            # update above but must not shrink the loss scale.
             new_state = new_state.replace(
-                dynamic_scale=state.dynamic_scale.update(finite)
+                dynamic_scale=state.dynamic_scale.update(grads_ok)
             )
-            metrics_extra = {"loss_scale": scale, "grads_finite": finite}
+            metrics_extra = {"loss_scale": scale, "grads_finite": grads_ok}
+            if numeric_guard:
+                metrics_extra["update_skipped"] = 1.0 - finite.astype(
+                    jnp.float32)
+        elif numeric_guard:
+            # Unscaled training gets the same skip-step gate (sentinel/
+            # numeric guard): both branches are computed in-graph and the
+            # select is elementwise — no host round-trip, no recompile.
+            finite = _tree_finite(grads) & jnp.isfinite(loss)
+            stepped = state.apply_gradients(tx, grads, new_stats,
+                                            ema_decay=ema_decay,
+                                            swa_start=swa_start,
+                                            swa_every=swa_every, loss=loss)
+            skipped = state.replace(step=state.step + 1)
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old), stepped, skipped
+            )
+            metrics_extra = {
+                "grads_finite": finite,
+                "update_skipped": 1.0 - finite.astype(jnp.float32),
+            }
         else:
             new_state = state.apply_gradients(tx, grads, new_stats,
                                               ema_decay=ema_decay,

@@ -35,6 +35,7 @@ from pytorch_distributed_train_tpu.obs.registry import get_registry
 from pytorch_distributed_train_tpu.optim import make_optimizer, plateau_scale
 from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
 from pytorch_distributed_train_tpu.parallel.partition import rules_for_model
+from pytorch_distributed_train_tpu.sentinel import numeric as sentinel_numeric
 from pytorch_distributed_train_tpu.train_state import DynamicScale, TrainState
 from pytorch_distributed_train_tpu.utils import debug as debug_lib
 from pytorch_distributed_train_tpu.utils import flops as flops_lib
@@ -190,6 +191,7 @@ class Trainer:
             cfg.optim, self.total_steps, self.steps_per_epoch,
             param_mask=(lambda tx: lora_lib.mask_optimizer(tx, cfg.lora))
             if cfg.lora.rank > 0 else None,
+            sentinel_cooldown=cfg.sentinel.enabled,
         )
 
         # ---- state (sharded init: params materialize directly into their
@@ -230,7 +232,8 @@ class Trainer:
             swa_every=getattr(cfg.optim, "swa_every", 1), mixup=mixup,
             module_grad_norms=cfg.obs.log_module_grad_norms,
             param_transform=param_transform,
-            teacher_fn=self.teacher_fn)
+            teacher_fn=self.teacher_fn,
+            numeric_guard=cfg.sentinel.enabled)
         if cfg.optim.offload_state:
             train_step = steps_lib.offload_opt_state(
                 train_step, opt_dev_sharding, self.state_sharding.opt_state)
@@ -343,6 +346,39 @@ class Trainer:
                 print(f"[obs] /metrics on port {self.metrics_server.port}",
                       flush=True)
         self._stepped = False  # first train_step call = compile bucket
+        # ---- training health sentinel (sentinel/): numeric plane state
+        # (the in-graph gate is already inside the jitted step; this is
+        # the host-side spike window + rewind bookkeeping) and the
+        # cross-host liveness plane (store heartbeats + hang monitor).
+        self._sentinel_on = cfg.sentinel.enabled
+        self._spike = None
+        self._bad_streak = 0
+        self._rewinds = 0
+        self._sentinel_skipped = 0
+        self._sentinel_aborted = False
+        if self._sentinel_on:
+            self._spike = sentinel_numeric.SpikeDetector(
+                window=cfg.sentinel.spike_window,
+                sigma=cfg.sentinel.spike_sigma,
+                min_samples=cfg.sentinel.spike_min_samples,
+                min_rel=cfg.sentinel.spike_min_rel)
+        self.liveness = None
+        if cfg.sentinel.hang_timeout_s > 0:
+            from pytorch_distributed_train_tpu.sentinel.liveness import (
+                LivenessPlane,
+            )
+
+            plane = LivenessPlane(
+                hang_timeout_s=cfg.sentinel.hang_timeout_s,
+                poll_s=cfg.sentinel.hang_poll_s,
+                exit_code=cfg.sentinel.hang_exit_code,
+                every_steps=cfg.sentinel.heartbeat_every_steps,
+                recorder=self.recorder, spans=self.spans)
+            if plane.start():
+                self.liveness = plane
+                print(f"[sentinel] liveness plane up (host {plane.rank}/"
+                      f"{plane.world}, timeout "
+                      f"{cfg.sentinel.hang_timeout_s}s)", flush=True)
         self.goodput.account("init", time.perf_counter() - _t_init0)
 
     # ------------------------------------------------------------------ init
@@ -524,11 +560,23 @@ class Trainer:
                 start_b = max(0, step - epoch * self.steps_per_epoch)
                 if start_b >= self.steps_per_epoch:
                     start_b = 0  # stale epoch meta; just run a fresh epoch
+                rewound = False
                 for batch in self._timed_batches(
                         self.train_epoch_fn(epoch, start_b)):
                     if step >= limit:
                         break
                     self._maybe_profile(step)
+                    # Sentinel drill points (flag-kind: firing only
+                    # reports a match; the corruption is ours to stage).
+                    # step.nan@step=N poisons the batch of the step that
+                    # completes as N+1 — the in-graph guard must then
+                    # skip exactly that update. step.loss_spike inflates
+                    # only the OBSERVED loss (detection drill; params
+                    # untouched).
+                    inflate_loss = self.faults.maybe_fire(
+                        "step.loss_spike", step=step)
+                    if self.faults.maybe_fire("step.nan", step=step):
+                        batch = _poison_batch_nan(batch)
                     # First execution per process = jit trace + compile
                     # (+ one step); goodput attributes it to the compile
                     # bucket — recompile cost on restart-heavy jobs is
@@ -567,6 +615,8 @@ class Trainer:
                             self._stall_prev = (stats.wait_s,
                                                 self.meter.total_s)
                     self.heartbeat.beat()
+                    if self.liveness is not None:
+                        self.liveness.beat(step)
                     self.recorder.record("step", step)
                     if step % cfg.obs.log_every_steps == 0 or step == limit:
                         self._log_train(step, metrics)
@@ -577,9 +627,25 @@ class Trainer:
                     self.goodput.account(
                         "compile" if is_first else "step",
                         time.perf_counter() - t_body)
+                    if self._sentinel_on and self._sentinel_observe(
+                            step, metrics, inflate_loss):
+                        # Auto-rewind: BEFORE the cadence save below, so
+                        # the diverged state is never checkpointed on
+                        # the way out. The while loop re-enters with the
+                        # rewound step and the exact mid-epoch
+                        # start_batch fast-forward.
+                        step = self._sentinel_rewind(step)
+                        epoch = step // max(self.steps_per_epoch, 1)
+                        self.meter.reset_clock()
+                        rewound = True
+                        break
                     with self.goodput.measure("ckpt"):
-                        if self.ckpt.maybe_save(self.state, epoch=epoch,
-                                                step=step):
+                        # A state under suspicion (mid bad-streak: spiking
+                        # but finite, so updates DID apply) must not be
+                        # checkpointed — the coming rewind would otherwise
+                        # restore the very divergence it escapes.
+                        if self._bad_streak == 0 and self.ckpt.maybe_save(
+                                self.state, epoch=epoch, step=step):
                             self.recorder.record("ckpt", step)
                     if (cfg.eval_every_steps and
                             step % cfg.eval_every_steps == 0):
@@ -602,6 +668,8 @@ class Trainer:
                         break
                 if self._preempted:
                     break
+                if rewound:
+                    continue  # re-enter at the restored step, not a new epoch
                 epoch += 1
                 if not cfg.eval_every_steps:
                     # every epoch boundary INCLUDING the last: the final
@@ -633,9 +701,21 @@ class Trainer:
                     batch_stats=trajectory_stats)
         finally:
             self.heartbeat.stop()
+            # NOTE: the liveness plane deliberately OUTLIVES fit() (it
+            # stops in close()): a multi-host job that finished its loop
+            # can still wedge in the final synchronized save or in a
+            # peer's teardown barrier, and the hang monitor must keep
+            # watching exactly through that window.
+            if self.liveness is not None:
+                self.liveness.pulse()  # the final save can be minutes-long
             with self.goodput.measure("ckpt"):
-                self.ckpt.save(self.state, epoch=epoch, force=True,
-                               step=step)
+                # A sentinel abort (rewind budget exhausted) means the
+                # live state is known-diverged: force-saving it would
+                # make it the newest verified checkpoint and trap every
+                # later generation in a restore/diverge loop.
+                if not self._sentinel_aborted:
+                    self.ckpt.save(self.state, epoch=epoch, force=True,
+                                   step=step)
                 self.ckpt.wait()
             if self.best_ckpt is not None:
                 self.best_ckpt.close()
@@ -643,6 +723,8 @@ class Trainer:
                 step,
                 {"wall_time_s": time.time() - t_start,
                  "preempted": int(self._preempted),
+                 "rewinds": self._rewinds,
+                 "sentinel_skipped_steps": self._sentinel_skipped,
                  **self.meter.percentiles(), **self.goodput.snapshot()},
                 prefix="summary",
             )
@@ -725,6 +807,14 @@ class Trainer:
             self._stall_prev = (stats.wait_s, loop_s)
         if self.cfg.obs.log_memory:
             host.update(device_memory_metrics())
+        if self._sentinel_on:
+            scale = sentinel_numeric.cooldown_scale(self.state.opt_state)
+            if scale is not None and scale != 1.0:
+                # post-rewind cooldown: fold into the reported lr like
+                # the plateau scale above (effective lr = schedule *
+                # plateau * cooldown)
+                host["lr_cooldown_scale"] = scale
+                host["lr"] *= scale
         host["goodput_pct"] = self.goodput.snapshot()["goodput_pct"]
         if self.cfg.obs.straggler_metrics and jax.process_count() > 1:
             # Cross-host health gather (obs/cluster.py): every host
@@ -769,6 +859,8 @@ class Trainer:
         total = None
         n = 0
         for batch in self.train_epoch_fn(0):
+            if self.liveness is not None:
+                self.liveness.pulse()  # same non-step liveness as eval
             stats = batch_stats_of(self.state.batch_stats, batch)
             total = stats if total is None else jax.tree.map(
                 jnp.add, total, stats)
@@ -791,6 +883,10 @@ class Trainer:
         n = 0
         with self.spans.span("train.eval", step=step):
             for batch in self.eval_epoch_fn(0):
+                if self.liveness is not None:
+                    # eval runs can dwarf hang_timeout_s; a healthy host
+                    # mid-eval must not read as wedged to the monitor
+                    self.liveness.pulse()
                 m = self.eval_step(self.state, batch)
                 for k, v in m.items():
                     sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
@@ -819,6 +915,11 @@ class Trainer:
         self.faults.maybe_fire("step.crash", step=step)
         self.faults.maybe_fire("step.straggle", step=step)
         self.faults.maybe_fire("preempt.sigterm", step=step)
+        # host.hang wedges HERE — after the step completed but BEFORE
+        # this step's heartbeat/liveness beat, so both the local monitor
+        # and the cross-host liveness plane see a step that never
+        # finishes (sentinel/liveness.py drives the diagnosis).
+        self.faults.maybe_fire("host.hang", step=step)
 
     def _maybe_inject_stall(self, step: int) -> None:
         """SURVEY §5.3a: wedge (don't crash) this step, first generation
@@ -833,6 +934,95 @@ class Trainer:
             print(f"[stall-inject] wedging at step {step}", flush=True)
             while True:  # only the heartbeat abort ends this
                 time.sleep(60)
+
+    # ------------------------------------------------------------- sentinel
+    def _sentinel_observe(self, step: int, metrics: dict,
+                          inflate_loss: bool = False) -> bool:
+        """Host half of the numeric guard: classify the completed step as
+        healthy / nonfinite / spiking, maintain the consecutive-bad
+        streak, and return True when the streak says rewind. Reads the
+        loss to host — a device sync per step, the cost the
+        ``sentinel.enabled`` knob opts into (documented in config.py)."""
+        import math
+
+        loss = float(np.asarray(metrics["loss"]))
+        gate_skipped = ("update_skipped" in metrics
+                        and float(np.asarray(metrics["update_skipped"])) > 0)
+        if inflate_loss:
+            # step.loss_spike drill: corrupt only the OBSERVED value —
+            # the detection->rewind path exercises end to end while the
+            # actual params stay healthy.
+            loss = loss * 1e6 if math.isfinite(loss) else loss
+        reason = None
+        if gate_skipped or not math.isfinite(loss):
+            reason = "nonfinite"
+            self._sentinel_skipped += 1
+        elif self._spike.is_spike(loss):
+            reason = "loss_spike"
+        else:
+            self._spike.add(loss)
+            self._bad_streak = 0
+        if reason is None:
+            return False
+        self._bad_streak += 1
+        self.registry.counter(
+            "sentinel_skipped_steps_total", labels={"reason": reason},
+            help="train steps judged bad by the sentinel (nonfinite "
+                 "update skipped in-graph, or loss spike flagged)").inc()
+        self.registry.gauge(
+            "sentinel_bad_streak",
+            help="current consecutive bad-step count").set(self._bad_streak)
+        print(f"[sentinel] step {step}: {reason} "
+              f"(loss={loss:.6g}, streak "
+              f"{self._bad_streak}/{self.cfg.sentinel.max_consecutive_bad})",
+              flush=True)
+        self.recorder.record("sentinel_bad_step", step, reason=reason)
+        return self._bad_streak >= self.cfg.sentinel.max_consecutive_bad
+
+    def _sentinel_rewind(self, step: int) -> int:
+        """Restore the newest integrity-verified checkpoint, apply the
+        LR cooldown, and hand the (possibly earlier) step counter back
+        to the loop — which re-enters the epoch with the exact
+        ``start_batch`` fast-forward. Returns the step to resume from
+        (``step`` unchanged when there is nothing to rewind to)."""
+        scfg = self.cfg.sentinel
+        if self._rewinds >= scfg.max_rewinds:
+            # Flag BEFORE raising: fit()'s finally must not force-save
+            # the known-diverged live state over the rewind target.
+            self._sentinel_aborted = True
+            raise RuntimeError(
+                f"[sentinel] rewind budget exhausted "
+                f"({self._rewinds}/{scfg.max_rewinds}): training keeps "
+                "diverging after repeated restore+cooldown — aborting "
+                "rather than looping restore/diverge forever")
+        self._bad_streak = 0
+        self._spike.reset()
+        self.ckpt.wait()  # a mid-flight async save must commit before we pick
+        good = self.ckpt.latest_good_step()
+        restored = (self.ckpt.restore(self.state, step=good)
+                    if good is not None else None)
+        if restored is None:
+            print(f"[sentinel] step {step}: rewind wanted but no verified "
+                  "checkpoint exists — resetting the detector and "
+                  "continuing in place", flush=True)
+            return step
+        self.state, _meta = restored
+        self.state = self.state.replace(
+            opt_state=sentinel_numeric.scale_cooldown(
+                self.state.opt_state, scfg.lr_cooldown_factor))
+        self._rewinds += 1
+        scale = sentinel_numeric.cooldown_scale(self.state.opt_state)
+        self.registry.counter(
+            "sentinel_rewinds_total",
+            help="auto-rewinds to the last verified checkpoint after a "
+                 "bad-step streak").inc()
+        self.recorder.record("sentinel_rewind", step, to=good,
+                             lr_scale=scale)
+        print(f"[sentinel] rewinding from step {step} to verified step "
+              f"{good} (rewind {self._rewinds}/{scfg.max_rewinds}, "
+              f"lr cooldown x{scfg.lr_cooldown_factor} -> total scale "
+              f"{scale})", flush=True)
+        return good
 
     def import_params(self, path: str) -> None:
         """Warm-start params from a (torch-layout) safetensors file
@@ -876,6 +1066,8 @@ class Trainer:
 
     def close(self) -> None:
         self.heartbeat.stop()
+        if self.liveness is not None:
+            self.liveness.stop()
         self.ckpt.close()
         if self.best_ckpt is not None:
             self.best_ckpt.close()
@@ -883,6 +1075,29 @@ class Trainer:
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None
+
+
+def _poison_batch_nan(batch: dict) -> dict:
+    """``step.nan`` drill: overwrite every float-dtype batch field with
+    NaN — the loss and grads of the next step go non-finite exactly the
+    way a corrupted record or overflowed activation would make them, and
+    the in-graph guard must absorb it. Elementwise op on the sharded
+    arrays: layout preserved, no host round-trip. Integer-only batches
+    (token ids with no mask/teacher field) have nothing to poison; the
+    drill warns instead of silently passing."""
+    out = {}
+    poisoned = False
+    for k, v in batch.items():
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            out[k] = v * jnp.asarray(jnp.nan, dtype=v.dtype)
+            poisoned = True
+        else:
+            out[k] = v
+    if not poisoned:
+        print("[fault-inject] step.nan: no float field in the batch to "
+              "poison (integer-only inputs) — step left healthy",
+              flush=True)
+    return out
 
 
 def device_memory_metrics() -> dict:

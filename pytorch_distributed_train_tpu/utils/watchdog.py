@@ -65,7 +65,13 @@ class FlightRecorder:
         i = self.n % self.capacity
         return [e for e in self.buf[i:] + self.buf[:i]]
 
-    def _write(self, out) -> None:
+    def _write(self, out, reason: str = "") -> None:
+        if reason:
+            # Who ordered this dump and why — the cluster-wide hang dump
+            # (sentinel/liveness.py) names the blamed host here, so a
+            # post-mortem reading ONE file knows whether this host was
+            # the wedged one or a bystander dumped for context.
+            out.write(f"=== dump reason: {reason} ===\n")
         out.write(f"=== flight recorder: last {min(self.n, self.capacity)} events ===\n")
         for ts, kind, step, info in self.events():
             out.write(f"{ts:.3f} {kind} step={step} {info}\n")
@@ -82,14 +88,19 @@ class FlightRecorder:
             except Exception:
                 pass  # diagnostics must never crash the dump path
 
-    def dump(self, out=None) -> None:
-        self._write(out or sys.stderr)
+    def dump(self, out=None, reason: str = "", suffix: str = "") -> None:
+        """``suffix`` distinguishes dump FILES with different causes in
+        one process (the cluster hang dump must survive the SIGTERM
+        teardown dump that follows it — same pid, same default path,
+        mode "w" would clobber it)."""
+        self._write(out or sys.stderr, reason)
         if self.dump_dir and out is None:
             try:
                 os.makedirs(self.dump_dir, exist_ok=True)
-                path = os.path.join(self.dump_dir, f"flight_{os.getpid()}.log")
+                path = os.path.join(self.dump_dir,
+                                    f"flight_{os.getpid()}{suffix}.log")
                 with open(path, "w") as f:
-                    self._write(f)
+                    self._write(f, reason)
             except OSError:
                 pass  # diagnostics must never crash the dump path
 

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Cross-check the fault-point catalog in docs/fault_tolerance.md against
+the live registry (faults/registry.py POINTS) — in BOTH directions.
+
+The fault layer's whole value is legibility: an operator reads the doc's
+catalog to write an injection schedule, and a point that exists in code
+but not in the doc (or vice versa) is exactly the silent drift this
+repo's "a schedule that silently does nothing is itself a silent fault"
+stance forbids. Run standalone in CI::
+
+    python tools/check_fault_points.py      # exit 0 = in sync
+
+or as a test (tests/test_sentinel.py imports and asserts main() == 0).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DOC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "docs", "fault_tolerance.md")
+
+_ROW = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`\s*\|")
+
+
+def documented_points(doc_path: str = DOC) -> set[str]:
+    """Point names from the first column of the '## Fault-point catalog'
+    table (only that section: the grammar examples and recovery matrix
+    mention points too, but the catalog is the contract)."""
+    points: set[str] = set()
+    in_catalog = False
+    with open(doc_path) as f:
+        for line in f:
+            if line.startswith("## "):
+                in_catalog = line.strip().lower() == "## fault-point catalog"
+                continue
+            if not in_catalog:
+                continue
+            m = _ROW.match(line)
+            if m:
+                points.add(m.group(1))
+    return points
+
+
+def main(argv: list[str] | None = None) -> int:
+    del argv
+    from pytorch_distributed_train_tpu.faults.registry import POINTS
+
+    doc = documented_points()
+    code = set(POINTS)
+    undocumented = sorted(code - doc)
+    phantom = sorted(doc - code)
+    if not doc:
+        print(f"check_fault_points: FOUND NO catalog rows in {DOC} — "
+              "was the table renamed?", file=sys.stderr)
+        return 1
+    ok = True
+    if undocumented:
+        print(f"check_fault_points: points in faults/registry.py but "
+              f"MISSING from the doc catalog: {undocumented}",
+              file=sys.stderr)
+        ok = False
+    if phantom:
+        print(f"check_fault_points: points documented in the catalog but "
+              f"ABSENT from faults/registry.py: {phantom}", file=sys.stderr)
+        ok = False
+    if ok:
+        print(f"check_fault_points: {len(code)} fault points in sync "
+              "between code and docs")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,7 @@ import argparse
 import json
 import os
 import queue as queue_mod
+import signal
 import sys
 import threading
 import time
@@ -552,7 +553,90 @@ class BatcherService:
         self._thread.join(timeout=5)
 
 
-def make_handler(service: BatcherService):
+class GracefulDrain:
+    """SIGTERM → drain-and-exit for the HTTP server (the load-balancer
+    contract every production rollout needs): stop ACCEPTING work (new
+    POSTs get a retryable 503, ``/healthz`` flips to ``draining`` so the
+    LB pulls this backend), let IN-FLIGHT requests finish — bounded by
+    ``grace_s``, a wedged decode must not outlive the scheduler's
+    SIGKILL — then stop the server and the batcher thread cleanly.
+
+    The SIGTERM handler CHAINS to whatever was installed before it (the
+    same convention as faults/preemption.py and the watchdog dump
+    handler), so composing with diagnostics handlers works in either
+    install order. ``request_drain()`` is also callable directly (tests,
+    an admin endpoint)."""
+
+    def __init__(self, server, service, grace_s: float = 30.0):
+        self.server = server
+        self.service = service
+        self.grace_s = grace_s
+        self.draining = False
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._prev = None
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------- request gate
+    def begin_request(self) -> bool:
+        """Admit one request; False once draining (caller answers 503)."""
+        with self._lock:
+            if self.draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def end_request(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    # ------------------------------------------------------------ drain
+    def install(self) -> None:
+        try:
+            self._prev = signal.getsignal(signal.SIGTERM)
+            signal.signal(signal.SIGTERM, self._handle)
+        except ValueError:
+            pass  # not the main thread (tests drive request_drain directly)
+
+    def _handle(self, signum, frame) -> None:
+        self.request_drain()
+        prev = self._prev
+        if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
+            prev(signum, frame)
+
+    def request_drain(self) -> None:
+        with self._lock:
+            if self.draining:
+                return
+            self.draining = True
+        print(f"[serve] draining: no new requests; waiting up to "
+              f"{self.grace_s:.0f}s for in-flight to finish", flush=True)
+        # The actual wait runs off-thread: a signal handler (or a test)
+        # must return immediately, and server.shutdown() deadlocks when
+        # called from a handler thread the server is joining.
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="serve-drain")
+        self._thread.start()
+
+    def _drain(self) -> None:
+        deadline = time.time() + self.grace_s
+        while time.time() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            leftover = self._inflight
+        if leftover:
+            print(f"[serve] drain grace expired with {leftover} request(s) "
+                  "still in flight — shutting down anyway", flush=True)
+        else:
+            print("[serve] drained; shutting down", flush=True)
+        self.server.shutdown()  # unblocks serve_forever()
+        self.service.shutdown()
+
+
+def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet by default
             pass
@@ -567,7 +651,12 @@ def make_handler(service: BatcherService):
 
         def do_GET(self):
             if self.path == "/healthz":
-                if service.healthy():
+                if drain is not None and drain.draining:
+                    # 503 so load balancers stop routing here; the body
+                    # says WHY (a drain, not a failure).
+                    self._send(503, {"status": "draining",
+                                     "stats": service.stats()})
+                elif service.healthy():
                     self._send(200, {"status": "ok",
                                      "stats": service.stats()})
                 else:
@@ -598,6 +687,19 @@ def make_handler(service: BatcherService):
                                  "/v1/chat/completions"):
                 self._send(404, {"error": "unknown path"})
                 return
+            if drain is not None and not drain.begin_request():
+                # Draining: the retryable status (the same contract as
+                # an injected handler fault) — clients re-resolve and
+                # land on a healthy backend.
+                self._send(503, {"error": "server draining"})
+                return
+            try:
+                self._do_post_admitted()
+            finally:
+                if drain is not None:
+                    drain.end_request()
+
+        def _do_post_admitted(self):
             # Request-handling observability: a counter per path and a
             # span covering the handler (wait + decode + serialization)
             # — span durations land in the span_seconds{name=...}
@@ -881,6 +983,10 @@ def main(argv=None) -> int:
                         "dense-equivalent slots*ceil(max_seq_len/"
                         "page_size))")
     p.add_argument("--quantize", default="", choices=["", "int8", "int4"])
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="seconds SIGTERM waits for in-flight requests "
+                        "before shutting down (graceful drain; size "
+                        "below the scheduler's kill grace)")
     args = p.parse_args(argv)
 
     try:
@@ -889,8 +995,10 @@ def main(argv=None) -> int:
         print(f"serve_http: error: {e.args[0] if e.args else e}",
               file=sys.stderr)
         return 2
-    server = ThreadingHTTPServer((args.host, args.port),
-                                 make_handler(service))
+    server = ThreadingHTTPServer((args.host, args.port), None)
+    drain = GracefulDrain(server, service, grace_s=args.drain_grace)
+    server.RequestHandlerClass = make_handler(service, drain)
+    drain.install()
     print(f"serving on http://{args.host}:{server.server_address[1]} "
           f"(slots={args.slots})", flush=True)
     try:
@@ -898,7 +1006,7 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        service.shutdown()
+        service.shutdown()  # idempotent: the drain path already did this
     return 0
 
 
