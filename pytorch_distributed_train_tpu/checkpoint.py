@@ -183,33 +183,7 @@ class CheckpointManager:
                     meta=ocp.args.JsonRestore(),
                 ),
             )
-        sav = restored["state"]
-        state = abstract_state.replace(
-            step=sav["step"],
-            params=sav["params"],
-            opt_state=_merge_opt_state(abstract_state.opt_state, sav["opt_state"]),
-            batch_stats=sav["batch_stats"],
-        )
-        if abstract_state.ema_params is not None:
-            # Resume with EMA on: restore the mirror; a ckpt written before
-            # EMA was enabled has no mirror — re-seed from restored params.
-            state = state.replace(
-                ema_params=sav.get("ema_params", sav["params"]))
-        if getattr(abstract_state, "ema_batch_stats", None) is not None:
-            # Stats mirror: older ckpts re-seed from the trajectory stats
-            # (the pre-mirror eval behavior, converging under the decay).
-            state = state.replace(
-                ema_batch_stats=sav.get("ema_batch_stats",
-                                        sav["batch_stats"]))
-        if getattr(abstract_state, "swa_count", None) is not None:
-            # Without this the resumed running mean would weight its next
-            # snapshot 1/1 and erase every pre-restart fold.
-            state = state.replace(
-                swa_count=sav.get("swa_count", jnp.int32(0)))
-        if abstract_state.dynamic_scale is not None and "dynamic_scale" in sav:
-            state = state.replace(
-                dynamic_scale=abstract_state.dynamic_scale.replace(**sav["dynamic_scale"])
-            )
+        state = apply_restored(abstract_state, restored["state"])
         return state, (restored["meta"] or {})
 
     def restore_partial(self, item: dict,
@@ -364,10 +338,52 @@ class BestCheckpointTracker:
             self.mgr.close()
 
 
+def apply_restored(abstract_state: TrainState, sav: dict) -> TrainState:
+    """Rebuild a TrainState from a restored ``_savable`` dict, using
+    ``abstract_state`` for structure (opt_state treedef, which optional
+    mirrors exist). Shared by the Orbax restore above and the hot-tier
+    restores in ckpt/manager.py — both hand back the same dict shape,
+    so the resume semantics (mirror re-seeding, pre-SWA back-compat)
+    cannot drift between tiers."""
+    state = abstract_state.replace(
+        step=sav["step"],
+        params=sav["params"],
+        opt_state=_merge_opt_state(abstract_state.opt_state,
+                                   sav["opt_state"]),
+        batch_stats=sav["batch_stats"],
+    )
+    if abstract_state.ema_params is not None:
+        # Resume with EMA on: restore the mirror; a ckpt written before
+        # EMA was enabled has no mirror — re-seed from restored params.
+        state = state.replace(
+            ema_params=sav.get("ema_params", sav["params"]))
+    if getattr(abstract_state, "ema_batch_stats", None) is not None:
+        # Stats mirror: older ckpts re-seed from the trajectory stats
+        # (the pre-mirror eval behavior, converging under the decay).
+        state = state.replace(
+            ema_batch_stats=sav.get("ema_batch_stats",
+                                    sav["batch_stats"]))
+    if getattr(abstract_state, "swa_count", None) is not None:
+        # Without this the resumed running mean would weight its next
+        # snapshot 1/1 and erase every pre-restart fold.
+        state = state.replace(
+            swa_count=sav.get("swa_count", jnp.int32(0)))
+    if abstract_state.dynamic_scale is not None and "dynamic_scale" in sav:
+        state = state.replace(
+            dynamic_scale=abstract_state.dynamic_scale.replace(
+                **sav["dynamic_scale"]))
+    return state
+
+
 def _savable(state: TrainState) -> dict[str, Any]:
     """TrainState → plain dict pytree (drops the non-pytree tx; keeps a
     stable state_dict-like naming scheme for cross-framework legibility —
-    SURVEY §7.4.2)."""
+    SURVEY §7.4.2). A dict passes through unchanged: the tiered plane
+    (ckpt/manager.py) snapshots the savable form once at the step
+    boundary and hands the host copy back here for the background Orbax
+    persist."""
+    if isinstance(state, dict):
+        return dict(state)
     d = {
         "step": state.step,
         "params": state.params,

@@ -1,0 +1,15 @@
+"""Tiered asynchronous checkpointing plane (docs/checkpointing.md).
+
+Splits every checkpoint into a blocking device→host snapshot and a
+background persist, keeps the last K sealed snapshots hot (host RAM +
+per-host local disk), exchanges snapshots between hosts over the
+launcher's KV store, and garbage-collects all tiers under one retention
+policy. ``build_checkpoint_manager`` is the entry point; the
+``checkpoint.tiered`` config flag selects this plane over the plain
+Orbax-backed ``CheckpointManager``.
+"""
+
+from pytorch_distributed_train_tpu.ckpt.manager import (  # noqa: F401
+    TieredCheckpointManager,
+    build_checkpoint_manager,
+)

@@ -1,0 +1,171 @@
+"""Hot checkpoint tiers: host RAM and per-host local disk.
+
+The persistent (Orbax) tier is durable but slow to both write and read;
+the faults the sentinel and the elastic agent actually recover from —
+loss divergence, a crashed worker respawned on the SAME host — don't
+need durability, they need the newest good state back *now*. Two hot
+tiers provide that:
+
+- **RamTier** — the last K sealed ``Snapshot``s, in-process. Serves a
+  sentinel auto-rewind (same process, milliseconds) and is lost with
+  the process, by design.
+- **DiskTier** — the same snapshots spilled to a per-host local
+  directory (``<ckpt dir>/hot/host_<n>`` by default). Survives a
+  process kill, so a same-host elastic gang restart restores without
+  re-reading persistent storage. Layout per step::
+
+      <root>/step_<N>/meta.json   (snapshot header: CRCs, sealed flag)
+      <root>/step_<N>/data.npz    (flatten-ordered leaves)
+
+  Spills are atomic (write into ``step_<N>.tmp``, fsync-less
+  ``os.replace`` rename): a kill mid-spill leaves a tmp directory the
+  next process ignores and GCs, never a half-step that parses.
+
+Both tiers are inventory + bytes only; *what a tree means* (structure,
+shardings) always comes from the restorer's template — see
+ckpt/snapshot.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+
+from pytorch_distributed_train_tpu.ckpt import snapshot as snapshot_lib
+
+
+class RamTier:
+    """Step → sealed-or-sealing Snapshot, bounded by retention GC (the
+    manager evicts; this class only stores). Thread model: the step
+    loop puts, the persister seals/spills, a rewind gets — one lock."""
+
+    def __init__(self):
+        self._snaps: dict[int, snapshot_lib.Snapshot] = {}
+        self._lock = threading.Lock()
+
+    def put(self, snap: snapshot_lib.Snapshot) -> None:
+        with self._lock:
+            self._snaps[snap.step] = snap
+
+    def get(self, step: int) -> snapshot_lib.Snapshot | None:
+        with self._lock:
+            return self._snaps.get(int(step))
+
+    def steps(self) -> list[int]:
+        with self._lock:
+            return sorted(self._snaps)
+
+    def sealed_steps(self) -> list[int]:
+        with self._lock:
+            return sorted(s for s, snap in self._snaps.items() if snap.sealed)
+
+    def evict(self, step: int) -> None:
+        with self._lock:
+            self._snaps.pop(int(step), None)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(s.nbytes() for s in self._snaps.values())
+
+
+class DiskTier:
+    """Per-host local spill directory for sealed snapshots."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step)}")
+
+    # ---------------------------------------------------------------- write
+    def spill(self, snap: snapshot_lib.Snapshot) -> str:
+        """Atomically write a sealed snapshot; returns the step dir."""
+        final = self._step_dir(snap.step)
+        if os.path.isdir(final):
+            return final
+        tmp = final + ".tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "data.npz"), "wb") as f:
+            f.write(snapshot_lib.serialize_leaves(snap))
+        with open(os.path.join(tmp, "meta.json"), "wb") as f:
+            f.write(snapshot_lib.header_json(snap))
+        os.replace(tmp, final)
+        return final
+
+    # ----------------------------------------------------------------- read
+    def steps(self) -> list[int]:
+        """Committed (final-named) step dirs, oldest→newest. Tmp dirs
+        from a mid-spill kill are invisible here."""
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            if not name.startswith("step_") or name.endswith(".tmp"):
+                continue
+            try:
+                out.append(int(name[len("step_"):]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def sealed_steps(self) -> list[int]:
+        out = []
+        for s in self.steps():
+            header = self.header(s)  # one read+parse per step
+            if header is not None and header.get("sealed"):
+                out.append(s)
+        return out
+
+    def header(self, step: int) -> dict | None:
+        try:
+            with open(os.path.join(self._step_dir(step), "meta.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def load(self, step: int) -> tuple[list[np.ndarray], dict] | None:
+        """CRC-verified (leaves, header) for a spilled step, or None
+        when the step is absent/corrupt — the caller falls back a
+        tier, it never restores unverified bytes from here."""
+        header = self.header(step)
+        if header is None:
+            return None
+        try:
+            with open(os.path.join(self._step_dir(step), "data.npz"),
+                      "rb") as f:
+                payload = f.read()
+        except OSError:
+            return None
+        if not snapshot_lib.verify_payload(payload, header):
+            return None
+        return snapshot_lib.deserialize_leaves(payload), header
+
+    # ------------------------------------------------------------------- gc
+    def evict(self, step: int) -> None:
+        shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    def gc_tmp(self) -> None:
+        """Drop leftover ``.tmp`` dirs from a mid-spill kill."""
+        if not os.path.isdir(self.root):
+            return
+        for name in os.listdir(self.root):
+            if name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    def step_nbytes(self, step: int) -> int:
+        sdir = self._step_dir(step)
+        total = 0
+        for dirpath, _, names in os.walk(sdir):
+            for n in names:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, n))
+                except OSError:
+                    pass
+        return total

@@ -1,0 +1,545 @@
+"""Tiered checkpoint manager: async save, hot restore, coherent GC.
+
+``TieredCheckpointManager`` wraps the Orbax-backed ``CheckpointManager``
+(checkpoint.py) with the tier stack this package provides::
+
+    save boundary:   snapshot (device→host copy; the ONLY blocking part)
+                       └─ background persister thread:
+                            seal → disk spill → peer publish
+                            → Orbax write + integrity manifest → GC
+    restore:         RAM → local disk → peer store → Orbax
+                     (each tier verified; corruption falls through)
+
+The public surface mirrors ``CheckpointManager`` (save / maybe_save /
+restore / latest_good_step / wait / close), so trainer.py, the sentinel
+rewind, and the elastic resume path switch planes with a config flag
+(``checkpoint.tiered``) instead of new call sites.
+
+Metric contract (obs registry):
+
+- ``ckpt_blocking_ms`` / ``ckpt_last_blocking_ms`` — snapshot copy time,
+  the step loop's whole exposure to a save.
+- ``ckpt_persist_ms`` / ``ckpt_last_persist_ms`` — background pipeline
+  time for the same step (seal→…→manifest).
+- ``ckpt_drain_ms`` + the ``ckpt.drain`` goodput bucket — back-pressure:
+  the previous persist was still in flight when this boundary arrived
+  (at most one persist runs at a time; see ckpt/persister.py).
+- ``ckpt_restore_tier_total{tier=ram|disk|peer|orbax}`` — which tier
+  served each restore (the sentinel-rewind acceptance gate).
+- ``ckpt_hot_corrupt_total`` — hot candidates that failed verification
+  and were fallen past.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import jax
+
+from pytorch_distributed_train_tpu import checkpoint as checkpoint_lib
+from pytorch_distributed_train_tpu.ckpt import hot_tier, peer, retention
+from pytorch_distributed_train_tpu.ckpt import snapshot as snapshot_lib
+from pytorch_distributed_train_tpu.ckpt.persister import Persister
+from pytorch_distributed_train_tpu.faults import registry as faults_registry
+from pytorch_distributed_train_tpu.faults import retry as retry_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+from pytorch_distributed_train_tpu.obs.spans import span
+
+# millisecond-denominated histograms (the registry default is seconds)
+_MS_BUCKETS = tuple(0.5 * 2 ** i for i in range(20))  # 0.5ms .. ~262s
+
+
+def hot_dir_for(ckpt_cfg, host: int) -> str:
+    """Per-host local spill directory: hosts must not share one (their
+    shards differ and a dying host's half-spill must not shadow a
+    healthy sibling's)."""
+    base = getattr(ckpt_cfg, "hot_dir", "") or os.path.join(
+        ckpt_cfg.dir, "hot")
+    return os.path.join(base, f"host_{int(host)}")
+
+
+class TieredCheckpointManager:
+    def __init__(self, ckpt_cfg, config_json: str = "", *,
+                 goodput=None, store=None, host_id: int | None = None,
+                 peer_hosts=None):
+        self.cfg = ckpt_cfg
+        # The inner Orbax manager always saves SYNCHRONOUSLY: asynchrony
+        # lives in our persister thread, and stacking Orbax's async
+        # machinery under it would leave wait() with two queues to
+        # reason about.
+        self.persistent = checkpoint_lib.CheckpointManager(
+            dataclasses.replace(ckpt_cfg, async_save=False), config_json)
+        self.dir = self.persistent.dir
+        self.goodput = goodput
+        self.host = int(host_id if host_id is not None
+                        else jax.process_index())
+        self._peer_hosts = peer_hosts
+        self._store = store
+        self._store_resolved = store is not None
+        self.ram = hot_tier.RamTier()
+        self.disk = None
+        if getattr(ckpt_cfg, "hot_disk", True):
+            self.disk = hot_tier.DiskTier(hot_dir_for(ckpt_cfg, self.host))
+            self.disk.gc_tmp()
+        self.persister = Persister()
+        self._snapshot_unsupported = False  # sticky sync-save fallback
+        reg = get_registry()
+        self._blocking_hist = reg.histogram(
+            "ckpt_blocking_ms", buckets=_MS_BUCKETS,
+            help="step-boundary blocking milliseconds per tiered save "
+                 "(device->host snapshot only)")
+        self._persist_hist = reg.histogram(
+            "ckpt_persist_ms", buckets=_MS_BUCKETS,
+            help="background persist milliseconds per tiered save "
+                 "(seal + spill + publish + Orbax + manifest)")
+        self._drain_hist = reg.histogram(
+            "ckpt_drain_ms", buckets=_MS_BUCKETS,
+            help="milliseconds a save boundary waited for the previous "
+                 "persist (back-pressure)")
+
+    # ---------------------------------------------------------------- store
+    def _get_store(self):
+        if not self._store_resolved:
+            self._store_resolved = True
+            try:
+                from pytorch_distributed_train_tpu.elastic import worker_store
+
+                self._store = worker_store()
+            except Exception:
+                self._store = None
+        return self._store
+
+    def _hosts(self):
+        if self._peer_hosts is not None:
+            return list(self._peer_hosts)
+        return list(range(jax.process_count()))
+
+    # ----------------------------------------------------------------- save
+    def _known_steps(self) -> set[int]:
+        known = set(self.ram.steps())
+        if self.disk is not None:
+            known.update(self.disk.steps())
+        try:
+            known.update(int(s) for s in self.persistent.mgr.all_steps())
+        except Exception:
+            pass
+        return known
+
+    def save(self, state, *, epoch: int = 0, force: bool = False,
+             step: int | None = None, overwrite: bool = False,
+             extra_meta: dict | None = None) -> bool:
+        if step is None:
+            step = int(state.step)
+        if step in self._known_steps() and not overwrite:
+            return False  # same contract as CheckpointManager.save
+        # Back-pressure: at most one persist in flight. Waiting here is
+        # the honest cost of a save cadence faster than storage — it is
+        # measured (ckpt_drain_ms) and re-attributed to the ckpt.drain
+        # goodput bucket, never hidden in an unbounded snapshot queue.
+        if self.persister.busy:
+            with span("checkpoint.drain", step=step):
+                try:
+                    waited = self.persister.drain()
+                except TimeoutError:
+                    raise
+                except Exception:
+                    # terminal failure of the PREVIOUS persist: already
+                    # printed + counted by the persister; this boundary
+                    # still gets its own snapshot/persist attempt
+                    waited = 0.0
+            self._drain_hist.observe(waited * 1e3)
+            if self.goodput is not None and waited > 0:
+                self.goodput.reattribute("ckpt", "ckpt.drain", waited)
+        if overwrite and step in self._known_steps():
+            # Stale hot copies of the step must go AFTER the drain: an
+            # in-flight persist of the OLD snapshot would otherwise
+            # re-spill it mid-eviction, and the fresh spill's idempotence
+            # guard would then keep the superseded bytes as the disk-
+            # tier restore source. (Persistent-tier overwrite is handled
+            # by CheckpointManager.save itself.)
+            self.ram.evict(step)
+            if self.disk is not None:
+                self.disk.evict(step)
+        meta = {"epoch": int(epoch), **(extra_meta or {})}
+        if self._snapshot_unsupported:
+            # Sticky from the first failure: a multi-host job whose
+            # arrays span hosts must not re-copy gigabytes host-side
+            # and re-fail at every save boundary.
+            return self.persistent.save(
+                state, epoch=epoch, force=force, step=step,
+                overwrite=overwrite, extra_meta=extra_meta)
+        t0 = time.perf_counter()
+        try:
+            with span("checkpoint.snapshot", step=step):
+                snap = snapshot_lib.take_snapshot(
+                    checkpoint_lib._savable(state), step=step, epoch=epoch,
+                    meta=meta, origin=self.dir)
+        except Exception as e:
+            self._snapshot_unsupported = True
+            # Non-fully-addressable arrays (multi-host GSPMD spanning
+            # hosts): the hot plane can't copy them out — fall back to
+            # the sharded synchronous Orbax path rather than guess.
+            get_registry().counter(
+                "ckpt_snapshot_fallback_total",
+                help="tiered saves that fell back to the synchronous "
+                     "Orbax path (snapshot not host-addressable)").inc()
+            print(f"[ckpt] snapshot of step {step} not host-addressable "
+                  f"({type(e).__name__}: {e}); saving synchronously",
+                  flush=True)
+            return self.persistent.save(
+                state, epoch=epoch, force=force, step=step,
+                overwrite=overwrite, extra_meta=extra_meta)
+        blocking_ms = (time.perf_counter() - t0) * 1e3
+        self._blocking_hist.observe(blocking_ms)
+        get_registry().gauge(
+            "ckpt_last_blocking_ms",
+            help="snapshot copy ms of the most recent tiered save").set(
+            blocking_ms)
+        self.ram.put(snap)
+        self.persister.submit(
+            snap, lambda s: self._persist(s, force=force,
+                                          overwrite=overwrite,
+                                          extra_meta=extra_meta))
+        return True
+
+    def maybe_save(self, state, *, epoch: int = 0,
+                   step: int | None = None) -> bool:
+        if step is None:
+            step = int(state.step)
+        if self.cfg.save_every_steps and step % self.cfg.save_every_steps == 0:
+            return self.save(state, epoch=epoch, step=step)
+        return False
+
+    # ------------------------------------------------------------- persist
+    def _persist(self, snap: snapshot_lib.Snapshot, *, force: bool,
+                 overwrite: bool, extra_meta: dict | None) -> None:
+        """Persister-thread pipeline for one snapshot. Ordering is the
+        recovery contract: by the time the (retryable, killable) Orbax
+        write starts, the snapshot is already sealed and spilled — a
+        kill during persist costs durability of THIS step on the
+        persistent tier only; the hot tiers still restore it."""
+        t0 = time.perf_counter()
+        with span("checkpoint.persist", step=snap.step):
+            snapshot_lib.seal(snap)
+            if self.disk is not None:
+                try:
+                    self.disk.spill(snap)
+                except OSError as e:
+                    print(f"[ckpt] hot-disk spill of step {snap.step} "
+                          f"failed ({e}); RAM + persistent tiers remain",
+                          flush=True)
+            self._maybe_publish(snap)
+
+            def _orbax_save():
+                # `ckpt.persist_io` fault point: transient persistent-
+                # storage errors on the BACKGROUND path, distinct from
+                # ckpt.save_io (the save call itself) so chaos schedules
+                # can target the async plane specifically.
+                faults_registry.maybe_fire("ckpt.persist_io",
+                                           step=snap.step)
+                return self.persistent.save(
+                    snap.tree, epoch=snap.epoch, step=snap.step,
+                    force=force, overwrite=overwrite,
+                    extra_meta=extra_meta)
+
+            retry_lib.retry_call(_orbax_save, point="ckpt.persist_io")
+        persist_ms = (time.perf_counter() - t0) * 1e3
+        self._persist_hist.observe(persist_ms)
+        get_registry().gauge(
+            "ckpt_last_persist_ms",
+            help="background persist ms of the most recent tiered "
+                 "save").set(persist_ms)
+        self._gc()
+
+    def _maybe_publish(self, snap: snapshot_lib.Snapshot) -> None:
+        if not getattr(self.cfg, "peer_fetch", True):
+            return
+        cap = getattr(self.cfg, "peer_publish_max_bytes", 64 << 20)
+        if snap.nbytes() > cap:
+            # Pre-filter on raw bytes (the npz payload is never smaller)
+            # so over-cap models skip the whole serialize — otherwise
+            # every persist of a big model would encode a full payload
+            # only to discard it against the cap.
+            return  # store-sized models only; disk + Orbax tiers remain
+        store = self._get_store()
+        if store is None:
+            return
+        payload = snapshot_lib.serialize_leaves(snap)
+        if len(payload) > cap:
+            return
+        try:
+            peer.publish(store, self.host, snapshot_lib.snapshot_meta(snap),
+                         payload)
+        except Exception as e:
+            print(f"[ckpt] peer publish of step {snap.step} failed "
+                  f"({type(e).__name__}: {e}); continuing", flush=True)
+
+    # ------------------------------------------------------------------- gc
+    def _gc(self) -> None:
+        """Retention over BOTH hot tiers, coherent with the persistent
+        tier: the newest manifest-verified persistent step and the
+        newest sealed hot step are pinned — GC can never delete the
+        state the next recovery would reach for. (The persistent tier
+        itself ages under Orbax's max_to_keep, unchanged.)"""
+        pins = set()
+        try:
+            verified = self.persistent.latest_good_step()
+            if verified is not None:
+                pins.add(int(verified))
+        except Exception:
+            pass
+        keep_last = max(int(getattr(self.cfg, "hot_keep", 2)), 1)
+        keep_every = int(getattr(self.cfg, "keep_every", 0))
+        sealed = self.ram.sealed_steps()
+        if sealed:
+            pins.add(sealed[-1])
+        for s in retention.plan_evictions(self.ram.steps(),
+                                          keep_last=keep_last,
+                                          keep_every=keep_every,
+                                          pinned=pins):
+            self.ram.evict(s)
+        if self.disk is not None:
+            disk_sealed = self.disk.sealed_steps()
+            disk_pins = set(pins)
+            if disk_sealed:
+                disk_pins.add(disk_sealed[-1])
+            for s in retention.plan_evictions(self.disk.steps(),
+                                              keep_last=keep_last,
+                                              keep_every=keep_every,
+                                              pinned=disk_pins):
+                self.disk.evict(s)
+
+    # -------------------------------------------------------------- restore
+    def _own_header(self, header: dict) -> bool:
+        """Run identity for hot snapshots: the origin (persistent dir)
+        must be THIS run's. Empty origin (hand-built snapshot in a
+        test) is trusted — the guard targets reused scratch dirs."""
+        origin = header.get("origin", "")
+        return not origin or origin == self.dir
+
+    def _disk_sealed_own(self) -> list[int]:
+        if self.disk is None:
+            return []
+        return [s for s in self.disk.sealed_steps()
+                if self._own_header(self.disk.header(s) or {})]
+
+    def _peer_steps(self) -> list[int]:
+        """Steps peers advertise on the KV store — a cross-host restart
+        must see a snapshot that outlived its (dead) writer there, or a
+        step=None resume would never reach the peer tier."""
+        if not getattr(self.cfg, "peer_fetch", True):
+            return []
+        store = self._get_store()
+        if store is None:
+            return []
+        try:
+            return sorted(peer.advertised_steps(store, self._hosts())
+                          .values())
+        except Exception:
+            return []
+
+    def latest_step(self) -> int | None:
+        cands = [self.persistent.latest_step()]
+        cands += self.ram.sealed_steps()[-1:]
+        cands += self._disk_sealed_own()[-1:]
+        cands += self._peer_steps()[-1:]
+        cands = [c for c in cands if c is not None]
+        return max(cands) if cands else None
+
+    def latest_good_step(self) -> int | None:
+        """Newest restorable step across every tier: sealed hot
+        snapshots are checksum-verified (this package's integrity),
+        peer-advertised snapshots are CRC-verified at fetch time, and
+        persistent steps are manifest-verified (faults/integrity.py).
+        A candidate that fails its verification at restore time falls
+        through to the next tier / the newest persistent step."""
+        cands = [self.persistent.latest_good_step()]
+        cands += self.ram.sealed_steps()[-1:]
+        cands += self._disk_sealed_own()[-1:]
+        cands += self._peer_steps()[-1:]
+        cands = [c for c in cands if c is not None]
+        return max(cands) if cands else None
+
+    def _tier_counter(self, tier: str):
+        return get_registry().counter(
+            "ckpt_restore_tier_total", labels={"tier": tier},
+            help="restores served, by tier (ram/disk/peer/orbax)")
+
+    def _corrupt_counter(self):
+        return get_registry().counter(
+            "ckpt_hot_corrupt_total",
+            help="hot-tier restore candidates that failed checksum/"
+                 "structure verification and were fallen past")
+
+    def restore(self, abstract_state, step: int | None = None):
+        target = step
+        if target is None:
+            target = self.latest_good_step()
+        if target is None:
+            return None
+        out = self._restore_hot(abstract_state, int(target))
+        if out is not None:
+            return out
+        # Persistent fallback. The target may be hot-only (never
+        # committed, or its persist died): restore the newest verified
+        # persistent step instead of failing the resume.
+        from pytorch_distributed_train_tpu.faults import integrity
+
+        if not integrity.step_committed(self.dir, int(target)):
+            fallback = self.persistent.latest_good_step()
+            if fallback is None:
+                return None
+            if int(fallback) != int(target):
+                print(f"[ckpt] step {target} unavailable in any tier; "
+                      f"falling back to persistent step {fallback}",
+                      flush=True)
+            target = fallback
+        restored = self.persistent.restore(abstract_state, step=int(target))
+        if restored is not None:
+            self._tier_counter("orbax").inc()
+        return restored
+
+    def _restore_hot(self, abstract_state, step: int):
+        template = checkpoint_lib._savable(abstract_state)
+        # --- RAM
+        snap = self.ram.get(step)
+        if snap is not None and snap.sealed:
+            if snapshot_lib.verify(snap):
+                out = self._place_tree(abstract_state, template, snap.tree,
+                                       {"epoch": snap.epoch, **snap.meta})
+                if out is not None:
+                    self._tier_counter("ram").inc()
+                    return out
+            else:
+                self._corrupt_counter().inc()
+                print(f"[ckpt] RAM snapshot of step {step} failed "
+                      "verification; trying the next tier", flush=True)
+        # --- local disk
+        if self.disk is not None:
+            loaded = self.disk.load(step)  # None for absent OR corrupt
+            if loaded is not None and not self._own_header(loaded[1]):
+                # A node-local hot_dir outliving its run: matching
+                # shapes/dtypes are NOT identity — never hand this run
+                # another experiment's state.
+                print(f"[ckpt] disk snapshot of step {step} belongs to "
+                      f"run {loaded[1].get('origin')!r}, not "
+                      f"{self.dir!r}; skipping the tier", flush=True)
+            elif loaded is not None:
+                leaves, header = loaded
+                out = self._place_leaves(abstract_state, template, leaves,
+                                         header)
+                if out is not None:
+                    self._tier_counter("disk").inc()
+                    return out
+            elif step in self.disk.steps():
+                self._corrupt_counter().inc()
+                print(f"[ckpt] disk snapshot of step {step} failed "
+                      "verification; trying the next tier", flush=True)
+        # --- peers
+        out = self._restore_peer(abstract_state, template, step)
+        if out is not None:
+            self._tier_counter("peer").inc()
+            return out
+        return None
+
+    def _restore_peer(self, abstract_state, template, step: int):
+        if not getattr(self.cfg, "peer_fetch", True):
+            return None
+        store = self._get_store()
+        if store is None:
+            return None
+        try:
+            fetched = retry_lib.retry_call(
+                lambda: peer.fetch(store, step, self._hosts()),
+                point="ckpt.peer_fetch")
+        except OSError as e:
+            print(f"[ckpt] peer fetch of step {step} failed after "
+                  f"retries ({type(e).__name__}: {e}); falling back to "
+                  "persistent storage", flush=True)
+            return None
+        if fetched is None:
+            return None
+        payload, header = fetched
+        if not snapshot_lib.verify_payload(payload, header):
+            self._corrupt_counter().inc()
+            return None
+        leaves = snapshot_lib.deserialize_leaves(payload)
+        return self._place_leaves(abstract_state, template, leaves, header)
+
+    # ------------------------------------------------------- placement glue
+    def _place_leaves(self, abstract_state, template, leaves, header):
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        if not snapshot_lib.leaves_match_template(leaves, t_leaves):
+            self._corrupt_counter().inc()
+            return None
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        meta = {"epoch": int(header.get("epoch", 0)),
+                **(header.get("meta") or {})}
+        return self._place_tree(abstract_state, template, tree, meta)
+
+    def _place_tree(self, abstract_state, template, tree, meta):
+        """Host tree → device arrays in the template's shardings →
+        rebuilt TrainState. None on structure mismatch (a checkpoint
+        from a different config: fall through to Orbax, whose partial-
+        template pruning handles cross-version resume)."""
+        try:
+            placed = jax.tree.map(
+                lambda t, h: jax.device_put(h, getattr(t, "sharding", None)),
+                template, tree)
+            state = checkpoint_lib.apply_restored(abstract_state, placed)
+        except (ValueError, TypeError, KeyError) as e:
+            self._corrupt_counter().inc()
+            print(f"[ckpt] hot snapshot does not match the live state "
+                  f"structure ({type(e).__name__}: {e}); trying the next "
+                  "tier", flush=True)
+            return None
+        return state, dict(meta)
+
+    # ------------------------------------------------------------ passthru
+    def read_meta(self, step: int | None = None) -> dict:
+        return self.persistent.read_meta(step)
+
+    def steps_by_tier(self) -> dict[str, list[int]]:
+        out = {"ram": self.ram.sealed_steps(),
+               "disk": self.disk.sealed_steps() if self.disk else [],
+               "persistent": []}
+        try:
+            out["persistent"] = sorted(
+                int(s) for s in self.persistent.mgr.all_steps())
+        except Exception:
+            pass
+        return out
+
+    def wait(self) -> None:
+        """Drain the in-flight persist (re-raising its terminal error —
+        a force-save caller must know its checkpoint didn't land), then
+        finalize manifests."""
+        with span("checkpoint.wait"):
+            self.persister.drain()
+        self.persistent.wait()
+
+    def close(self) -> None:
+        try:
+            self.persister.stop()
+        except Exception as e:
+            print(f"[ckpt] persister stop: {type(e).__name__}: {e}",
+                  flush=True)
+        self.persistent.close()
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:
+                pass
+            self._store = None
+
+
+def build_checkpoint_manager(ckpt_cfg, config_json: str = "", *,
+                             goodput=None):
+    """``checkpoint.tiered`` selects the plane; every caller (trainer,
+    tools) goes through here so the flag is the only divergence point."""
+    if getattr(ckpt_cfg, "tiered", False):
+        return TieredCheckpointManager(ckpt_cfg, config_json,
+                                       goodput=goodput)
+    return checkpoint_lib.CheckpointManager(ckpt_cfg, config_json)

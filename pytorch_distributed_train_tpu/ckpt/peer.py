@@ -1,0 +1,124 @@
+"""Peer snapshot exchange over the launcher's KV store.
+
+Cross-host restart path: a worker respawned on a DIFFERENT host has an
+empty RAM tier and someone else's disk tier, but its peers (or the
+launcher outliving the workers) may still hold the newest sealed
+snapshot. Rather than round-tripping through persistent storage, each
+host publishes its newest sealed snapshot to the rendezvous store
+(native/store.cpp — the same KV plane elastic.py and the liveness
+sentinel already ride), and a restoring worker fetches it chunk by
+chunk before falling back to Orbax.
+
+Wire layout (all keys under one namespace)::
+
+    ckptp/<host>/meta            JSON: snapshot header + chunking info
+    ckptp/<host>/<step>/c<i>     payload chunks (<= CHUNK_BYTES each)
+
+Chunks are written BEFORE the meta key: a reader that sees meta can
+read every chunk it names (the store has no transactions; ordering is
+the atomicity). Only the newest sealed step is published per host —
+the previous step's chunks are deleted after the new meta lands, so
+store memory stays bounded at ~one snapshot per host.
+
+This plane is for models whose per-host snapshot fits comfortably in
+the store (``checkpoint.peer_publish_max_bytes`` gates publication);
+a 7B-scale run keeps the disk + Orbax tiers and simply never
+publishes. The ``ckpt.peer_fetch`` fault point injects transport
+errors into the fetch path; exhausted retries fall back to Orbax,
+never fail the restore.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+
+from pytorch_distributed_train_tpu.faults import registry as faults_registry
+
+CHUNK_BYTES = 512 * 1024  # store get() buffers default to 1 MiB
+_NS = "ckptp"
+
+
+def _meta_key(host: int) -> str:
+    return f"{_NS}/{int(host)}/meta"
+
+
+def _chunk_key(host: int, step: int, i: int) -> str:
+    return f"{_NS}/{int(host)}/{int(step)}/c{int(i)}"
+
+
+def publish(store, host: int, header: dict, payload: bytes,
+            chunk_bytes: int = CHUNK_BYTES) -> None:
+    """Publish (header, payload) as this host's newest sealed snapshot,
+    replacing (and then deleting) the previously published step."""
+    prev = None
+    try:
+        prev = json.loads(store.get(_meta_key(host), timeout_ms=1).decode())
+    except Exception:
+        prev = None  # nothing published yet
+    n_chunks = max(1, (len(payload) + chunk_bytes - 1) // chunk_bytes)
+    step = int(header["step"])
+    for i in range(n_chunks):
+        store.set(_chunk_key(host, step, i),
+                  payload[i * chunk_bytes:(i + 1) * chunk_bytes])
+    meta = dict(header)
+    meta.update(n_chunks=n_chunks, payload_bytes=len(payload),
+                payload_crc32=zlib.crc32(payload))
+    store.set(_meta_key(host), json.dumps(meta, sort_keys=True).encode())
+    if prev is not None and int(prev.get("step", -1)) != step:
+        for i in range(int(prev.get("n_chunks", 0))):
+            try:
+                store.delete(_chunk_key(host, int(prev["step"]), i))
+            except Exception:
+                pass  # best-effort housekeeping
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+    get_registry().gauge(
+        "ckpt_peer_published_step",
+        help="newest snapshot step this host has published to the "
+             "peer store").set(step)
+
+
+def fetch(store, step: int, hosts, *, self_host: int | None = None,
+          chunk_timeout_ms: int = 10_000) -> tuple[bytes, dict] | None:
+    """(payload, header) for ``step`` from the first peer advertising
+    it, or None. CRC-verified end to end; a corrupt transfer reads as
+    "not found" and the caller falls back to Orbax."""
+    faults_registry.maybe_fire("ckpt.peer_fetch", step=step)
+    for host in hosts:
+        if self_host is not None and int(host) == int(self_host):
+            continue
+        try:
+            meta = json.loads(
+                store.get(_meta_key(host), timeout_ms=50).decode())
+        except Exception:
+            continue  # host never published / key expired with the store
+        if int(meta.get("step", -1)) != int(step) or not meta.get("sealed"):
+            continue
+        chunks = []
+        try:
+            for i in range(int(meta["n_chunks"])):
+                chunks.append(store.get(_chunk_key(host, step, i),
+                                        timeout_ms=chunk_timeout_ms))
+        except Exception:
+            continue  # racing a re-publish; try the next peer
+        payload = b"".join(chunks)
+        if (len(payload) != int(meta["payload_bytes"])
+                or zlib.crc32(payload) != int(meta["payload_crc32"])):
+            continue
+        return payload, meta
+    return None
+
+
+def advertised_steps(store, hosts) -> dict[int, int]:
+    """host → published step, for every peer with a meta key (the
+    inspector tool and restore-target selection read this)."""
+    out: dict[int, int] = {}
+    for host in hosts:
+        try:
+            meta = json.loads(
+                store.get(_meta_key(host), timeout_ms=50).decode())
+            out[int(host)] = int(meta["step"])
+        except Exception:
+            continue
+    return out

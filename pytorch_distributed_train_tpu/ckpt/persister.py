@@ -1,0 +1,123 @@
+"""Background persister: one in-flight persist, explicit back-pressure.
+
+The step loop hands a freshly taken snapshot to ``submit()`` and keeps
+training; this thread runs the persist pipeline (seal → disk spill →
+peer publish → Orbax write + manifest → retention GC, assembled by
+ckpt/manager.py) against the immutable host copy.
+
+At most ONE persist is in flight. If the next save boundary arrives
+while the previous persist is still writing, the caller must ``drain()``
+first — that wait is the back-pressure signal (the ``ckpt.drain``
+goodput bucket): persistent storage is slower than the save cadence,
+and hiding that by queueing snapshots would grow host RAM until OOM at
+exactly the moment (degraded storage) it matters most.
+
+A persist that raises is terminal for that snapshot: the error is
+printed and counted (``ckpt_persist_failures_total``), the snapshot is
+marked ``persist_failed`` (it remains a valid hot restore source — the
+arrays are intact), and the persister stays alive for the next submit.
+The exception is also re-raised to the next ``drain()``/``stop()``
+caller so a synchronous save boundary (final force-save, preemption)
+still escalates instead of silently losing the job's last checkpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Persister:
+    def __init__(self, name: str = "ckpt-persister"):
+        self._cond = threading.Condition()
+        self._job = None            # (snapshot, callable) or None
+        self._busy = False
+        self._stopping = False
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- callers
+    @property
+    def busy(self) -> bool:
+        with self._cond:
+            return self._busy or self._job is not None
+
+    def submit(self, snap, job) -> None:
+        """Hand (snapshot, job-callable) to the thread. The caller must
+        have drained first; submitting over an in-flight persist raises
+        — the single-slot invariant is the whole point."""
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("persister is stopped")
+            if self._busy or self._job is not None:
+                raise RuntimeError(
+                    "persist already in flight — drain() before submit()")
+            # A new persist supersedes the previous one's outcome: an
+            # undrained terminal error from an EARLIER snapshot must not
+            # lie in wait for hours and then poison an unrelated
+            # drain()/wait() caller (it was already printed + counted);
+            # drain() reports only the MOST RECENT persist's failure.
+            self._error = None
+            self._job = (snap, job)
+            self._cond.notify_all()
+
+    def drain(self, timeout: float | None = None) -> float:
+        """Block until no persist is in flight; returns seconds waited.
+        Re-raises a terminal persist error exactly once (see module
+        docstring)."""
+        t0 = time.perf_counter()
+        with self._cond:
+            while self._busy or self._job is not None:
+                if not self._cond.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"persist did not drain within {timeout}s")
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+        return time.perf_counter() - t0
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Drain and join. Errors from the last persist propagate."""
+        try:
+            self.drain(timeout=timeout)
+        finally:
+            with self._cond:
+                self._stopping = True
+                self._cond.notify_all()
+            self._thread.join(timeout=timeout)
+
+    # -------------------------------------------------------------- thread
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._job is None and not self._stopping:
+                    self._cond.wait()
+                if self._job is None and self._stopping:
+                    return
+                snap, job = self._job
+                self._job = None
+                self._busy = True
+            try:
+                job(snap)
+            except BaseException as e:  # noqa: BLE001 — must not die
+                snap.persist_failed = True
+                print(f"[ckpt] background persist of step {snap.step} "
+                      f"FAILED ({type(e).__name__}: {e}); newest sealed "
+                      "hot snapshot remains the restore source",
+                      flush=True)
+                from pytorch_distributed_train_tpu.obs.registry import (
+                    get_registry,
+                )
+
+                get_registry().counter(
+                    "ckpt_persist_failures_total",
+                    help="background checkpoint persists that failed "
+                         "terminally (snapshot stays hot-restorable)").inc()
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()

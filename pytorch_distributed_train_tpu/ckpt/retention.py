@@ -1,0 +1,42 @@
+"""Retention policy for the hot checkpoint tiers.
+
+One pure planner shared by the RAM and disk tiers so they age
+coherently (a step evicted from RAM but kept on disk is fine; a step
+the policy *pins* is pinned in both). The persistent (Orbax) tier keeps
+its own ``max_to_keep`` — this module governs only the hot copies.
+
+Keep rules, in priority order:
+
+- **pin** — steps the caller marks unevictable. The manager always pins
+  the newest integrity-verified persistent step (``latest_good_step``)
+  and the newest sealed hot step: GC must never delete the state every
+  recovery path would reach for next.
+- **keep-every-K** — ``step % keep_every == 0`` survives (sparse
+  long-horizon rewind points). 0 disables.
+- **keep-last-N** — the newest ``keep_last`` steps survive.
+
+Everything else is evicted. The planner returns the eviction list; the
+manager applies it to each tier.
+"""
+
+from __future__ import annotations
+
+
+def plan_evictions(steps, *, keep_last: int, keep_every: int = 0,
+                   pinned=()) -> list[int]:
+    """Steps to evict from a hot tier holding ``steps``.
+
+    >>> plan_evictions([1, 2, 3, 4], keep_last=2)
+    [1, 2]
+    >>> plan_evictions([10, 20, 30, 40], keep_last=1, keep_every=20)
+    [10, 30]
+    >>> plan_evictions([1, 2, 3], keep_last=1, pinned=[1])
+    [2]
+    """
+    steps = sorted(int(s) for s in steps)
+    pins = {int(s) for s in pinned}
+    keep = set(steps[-max(int(keep_last), 0):] if keep_last > 0 else [])
+    if keep_every > 0:
+        keep |= {s for s in steps if s % keep_every == 0}
+    keep |= pins & set(steps)
+    return [s for s in steps if s not in keep]

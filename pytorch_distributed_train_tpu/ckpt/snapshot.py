@@ -1,0 +1,173 @@
+"""Device→host snapshots: the blocking half of an async checkpoint.
+
+A tiered save (ckpt/manager.py) splits a checkpoint into two phases:
+
+1. **snapshot** — copy the live state's device arrays into host RAM.
+   This is the only part the step loop waits for (``ckpt_blocking_ms``);
+   it is bounded by HBM→host bandwidth, not by persistent-storage I/O.
+2. **persist** — everything after the copy (seal, local-disk spill, peer
+   publish, the Orbax write + manifest) runs on a background thread
+   against the immutable host copy while training continues.
+
+A ``Snapshot`` becomes **sealed** once per-leaf CRCs are computed over
+the host arrays (ckpt/persister.py does this first, before any I/O):
+sealed snapshots are what the hot tier may serve on restore, and the
+CRCs are what lets a restore distinguish "hot copy intact" from "hot
+copy corrupt, fall back a tier".
+
+Serialization (disk spill / peer transfer) is leaf-ordered: the restorer
+always holds an abstract template of the state it wants (the trainer's
+live TrainState), so the wire format carries only the ordered flattened
+leaves plus a JSON meta block — the template's treedef rebuilds the
+structure, and any template/payload mismatch is detected by leaf count/
+shape/dtype instead of trusting a pickled treedef.
+
+Single-controller caveat: ``take_snapshot`` gathers each array with
+``np.asarray``, which requires the arrays to be fully addressable from
+this process (true for single-host jobs and for per-process test
+workers). A multi-host GSPMD job whose arrays span hosts falls back to
+the synchronous Orbax path (ckpt/manager.py catches the error) — per-
+shard host snapshots are the documented follow-up, not silently wrong
+data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import time
+import zlib
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """One host-RAM copy of a savable state tree (checkpoint._savable
+    layout: plain dict of params/opt_state/... with array leaves)."""
+
+    step: int
+    epoch: int
+    tree: dict
+    meta: dict = dataclasses.field(default_factory=dict)
+    # Which run this snapshot belongs to (the persistent checkpoint
+    # dir): a node-local hot_dir outliving its run must not hand a NEW
+    # experiment the old one's state just because shapes/dtypes match —
+    # restore compares this against its own dir (ckpt/manager.py).
+    origin: str = ""
+    created_at: float = dataclasses.field(default_factory=time.time)
+    # leaf CRCs in flatten order, computed at seal time (persister
+    # thread — off the step loop's critical path)
+    checksums: tuple[int, ...] | None = None
+    sealed: bool = False
+    # the background Orbax persist for this snapshot failed terminally:
+    # the snapshot is still a valid restore source (the arrays are
+    # intact), but the step never became a committed persistent step
+    persist_failed: bool = False
+
+    def nbytes(self) -> int:
+        return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+            self.tree))
+
+
+def take_snapshot(savable: dict, *, step: int, epoch: int = 0,
+                  meta: dict | None = None, origin: str = "") -> Snapshot:
+    """Blocking device→host copy of a ``checkpoint._savable`` dict.
+
+    ``np.asarray`` waits for in-flight computation producing each leaf
+    and then copies it out — the whole step-boundary cost of an async
+    save. Leaves already on host (numpy) are copied too: the snapshot
+    must be immutable while the persister works on it."""
+    tree = jax.tree.map(lambda x: np.array(jax.device_get(x)), savable)
+    return Snapshot(step=int(step), epoch=int(epoch), tree=tree,
+                    meta=dict(meta or {}), origin=origin)
+
+
+def _leaf_crc(leaf: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(leaf).tobytes())
+
+
+def seal(snap: Snapshot) -> Snapshot:
+    """Compute per-leaf CRCs and mark the snapshot sealed. RAM-bandwidth
+    work (no I/O) — the persister runs it before any persistence so the
+    hot tier gains a verified restore source within milliseconds of the
+    save boundary."""
+    leaves = jax.tree_util.tree_leaves(snap.tree)
+    snap.checksums = tuple(_leaf_crc(leaf) for leaf in leaves)
+    snap.sealed = True
+    return snap
+
+
+def verify(snap: Snapshot) -> bool:
+    """Recompute leaf CRCs against the seal — False for unsealed or
+    corrupted-in-RAM snapshots (the caller falls back a tier)."""
+    if not snap.sealed or snap.checksums is None:
+        return False
+    leaves = jax.tree_util.tree_leaves(snap.tree)
+    if len(leaves) != len(snap.checksums):
+        return False
+    return all(_leaf_crc(leaf) == crc
+               for leaf, crc in zip(leaves, snap.checksums))
+
+
+# ------------------------------------------------------------- wire format
+def snapshot_meta(snap: Snapshot) -> dict:
+    """The JSON-serializable header that travels with the leaves (disk
+    meta.json / peer store meta key)."""
+    return {
+        "step": snap.step,
+        "epoch": snap.epoch,
+        "meta": snap.meta,
+        "origin": snap.origin,
+        "created_at": snap.created_at,
+        "checksums": list(snap.checksums or ()),
+        "sealed": bool(snap.sealed),
+    }
+
+
+def serialize_leaves(snap: Snapshot) -> bytes:
+    """Flatten-order ``.npz`` of the snapshot's leaves (``leaf_<i>``
+    keys). Structure is NOT serialized — the restorer's template
+    supplies it (see module docstring)."""
+    leaves = jax.tree_util.tree_leaves(snap.tree)
+    buf = io.BytesIO()
+    np.savez(buf, **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def deserialize_leaves(payload: bytes) -> list[np.ndarray]:
+    with np.load(io.BytesIO(payload)) as z:
+        return [z[f"leaf_{i}"] for i in range(len(z.files))]
+
+
+def leaves_match_template(leaves: list, template_leaves: list) -> bool:
+    """Count + shape + dtype agreement — the precondition for
+    unflattening foreign leaves with the template's treedef."""
+    if len(leaves) != len(template_leaves):
+        return False
+    for got, want in zip(leaves, template_leaves):
+        if tuple(got.shape) != tuple(want.shape):
+            return False
+        if np.dtype(got.dtype) != np.dtype(want.dtype):
+            return False
+    return True
+
+
+def verify_payload(payload: bytes, header: dict) -> bool:
+    """Header CRCs vs the deserialized leaves (disk/peer integrity)."""
+    if not header.get("sealed"):
+        return False
+    crcs = header.get("checksums") or []
+    try:
+        leaves = deserialize_leaves(payload)
+    except Exception:
+        return False
+    if len(leaves) != len(crcs):
+        return False
+    return all(_leaf_crc(leaf) == crc for leaf, crc in zip(leaves, crcs))
+
+
+def header_json(snap: Snapshot) -> bytes:
+    return json.dumps(snapshot_meta(snap), sort_keys=True).encode()

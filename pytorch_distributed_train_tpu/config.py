@@ -385,6 +385,38 @@ class CheckpointConfig:
     # under <dir>/manifests/; restore verifies and falls back past
     # corrupt/partial steps to the newest verified one.
     integrity: bool = True
+    # ---- tiered async checkpointing plane (ckpt/; docs/checkpointing.md)
+    # tiered=true replaces the plain Orbax manager with the tiered one:
+    # at a save boundary the step loop blocks only for the device->host
+    # snapshot copy (ckpt_blocking_ms); a background persister thread
+    # runs seal -> local-disk spill -> peer publish -> Orbax write +
+    # manifest (ckpt_persist_ms), with at most ONE persist in flight
+    # (an early next boundary waits — the ckpt.drain goodput bucket).
+    # Restores (sentinel rewind, elastic resume) try RAM -> local disk
+    # -> peer store -> Orbax, each tier verified.
+    tiered: bool = False
+    # Hot retention: keep the newest hot_keep sealed snapshots per tier
+    # (RAM and local disk age under the same policy), plus every step
+    # divisible by keep_every (0 = off). The newest manifest-verified
+    # persistent step and the newest sealed hot step are always pinned.
+    hot_keep: int = 2
+    keep_every: int = 0
+    # Local-disk spill tier: per-host sealed-snapshot copies that
+    # survive a process kill (same-host elastic restart restores in ms).
+    # Root dir "" -> <dir>/hot (each host appends host_<n>) — a
+    # single-host convenience. On a multi-host deployment whose <dir>
+    # is shared/network storage, point hot_dir at NODE-LOCAL scratch
+    # (/tmp, local SSD): spilling to the same shared FS Orbax writes
+    # would double persistent-storage traffic and forfeit the
+    # fast-local-restart property the tier exists for.
+    hot_disk: bool = True
+    hot_dir: str = ""
+    # Cross-host peer exchange over the launcher's KV store: each host
+    # publishes its newest sealed snapshot (<= peer_publish_max_bytes;
+    # larger models skip publication and keep disk+Orbax tiers) and a
+    # restoring worker fetches it before touching persistent storage.
+    peer_fetch: bool = True
+    peer_publish_max_bytes: int = 64 * 1024 * 1024
 
 
 @dataclass

@@ -91,6 +91,20 @@ class LaunchConfig:
     backoff_base_s: float = 1.0
     backoff_max_s: float = 30.0
     backoff_jitter: float = 0.25
+    # Persistent XLA compile cache base dir: each worker gets its OWN
+    # subdirectory (<base>/worker_<rank>, via PDTT_COMPILE_CACHE_DIR).
+    # This container's jax loads truncated cache entries without
+    # validation, so a worker killed mid-cache-write (crash drill,
+    # SIGKILL escalation) sharing one dir would poison its siblings and
+    # every later generation (CHANGES PR 3). Worker rank is stable
+    # across generations, so each worker still reuses its own entries.
+    compile_cache_base: str = ""
+
+
+def worker_cache_dir(base: str, rank) -> str:
+    """Per-worker compile-cache subdir — one writer per directory, so a
+    mid-write kill can only ever poison the killed worker's own cache."""
+    return os.path.join(base, f"worker_{rank}")
 
 
 def _free_port() -> int:
@@ -168,6 +182,9 @@ class ElasticAgent:
                 "TPUSTORE_ADDR": f"{cfg.master_addr}:{self.store_port}",
                 "RESTART_GENERATION": str(restart_gen),
             })
+            if cfg.compile_cache_base:
+                env["PDTT_COMPILE_CACHE_DIR"] = worker_cache_dir(
+                    cfg.compile_cache_base, rank)
             self.procs.append(subprocess.Popen(self.cmd, env=env))
         self._log(f"spawned {cfg.nprocs} workers (gen {restart_gen}, "
                   f"world {world}, coord :{self.coord_port})")
@@ -515,6 +532,11 @@ def main(argv: list[str] | None = None) -> int:
                         "consecutive fast failure")
     p.add_argument("--backoff-max", type=float, default=30.0,
                    help="respawn backoff cap in seconds")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="persistent XLA compile cache BASE dir; each "
+                        "worker gets <base>/worker_<rank> so a killed "
+                        "worker's truncated cache entry cannot poison "
+                        "siblings or later generations")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command, e.g. train.py --config ...")
     args = p.parse_args(argv)
@@ -540,6 +562,7 @@ def main(argv: list[str] | None = None) -> int:
         stable_window_s=args.stable_window,
         backoff_base_s=args.backoff_base,
         backoff_max_s=args.backoff_max,
+        compile_cache_base=args.compile_cache_dir,
     )
     return ElasticAgent(cfg, cmd).run()
 

@@ -46,6 +46,12 @@ import time
 # point name -> action performed when a matching spec fires
 POINTS: dict[str, str] = {
     "ckpt.save_io": "raise",     # checkpoint save I/O (checkpoint.py)
+    "ckpt.persist_io": "raise",  # background persist I/O (ckpt/manager.py
+                                 # persister thread — the async plane's
+                                 # Orbax write, distinct from save_io)
+    "ckpt.peer_fetch": "raise",  # peer snapshot fetch over the KV store
+                                 # (ckpt/peer.py; exhausted retries fall
+                                 # back to persistent storage)
     "data.decode": "raise",      # record decode (data/pipeline, grain)
     "serve.handler": "raise",    # HTTP request handler (tools/serve_http)
     "step.crash": "exit",        # hard process kill between steps

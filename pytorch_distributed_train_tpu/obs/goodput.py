@@ -25,6 +25,15 @@ Buckets (fixed vocabulary, so dashboards can stack them):
     idle        — everything unattributed (logging, BN re-estimation,
                   inter-epoch bookkeeping)
 
+One optional bucket appears only when the tiered checkpoint plane
+produces it (so non-tiered runs keep the exact fixed vocabulary):
+
+    ckpt.drain  — save-boundary waits for a still-in-flight background
+                  persist (ckpt/ back-pressure; carved out of ckpt via
+                  ``reattribute`` so the two are separable on a
+                  dashboard: ckpt = unavoidable snapshot cost,
+                  ckpt.drain = storage slower than the save cadence)
+
 ``idle`` is computed as wall − Σ(known), so the buckets sum to wall time
 EXACTLY by construction; the acceptance tolerance (5%) guards against a
 tracker bug making idle negative, not float drift.
@@ -52,6 +61,22 @@ class GoodputTracker:
         if bucket == "idle":
             raise ValueError("idle is derived (wall - sum), never accounted")
         self.buckets[bucket] = self.buckets.get(bucket, 0.0) + max(0.0, seconds)
+
+    def reattribute(self, from_bucket: str, to_bucket: str,
+                    seconds: float) -> None:
+        """Move ``seconds`` from one bucket to another — for a callee
+        that can split a caller's ``measure()`` window more precisely
+        than the caller can (the tiered checkpoint manager carves its
+        back-pressure drain out of the trainer's ckpt window). Sum over
+        buckets is preserved exactly; the donor may dip negative for
+        the instants between this call and the enclosing measure()'s
+        account (a scrape race, corrected at window close)."""
+        if "idle" in (from_bucket, to_bucket):
+            raise ValueError("idle is derived (wall - sum), never accounted")
+        seconds = max(0.0, seconds)
+        self.buckets[from_bucket] = (
+            self.buckets.get(from_bucket, 0.0) - seconds)
+        self.buckets[to_bucket] = self.buckets.get(to_bucket, 0.0) + seconds
 
     @contextlib.contextmanager
     def measure(self, bucket: str):

@@ -24,6 +24,7 @@ from pytorch_distributed_train_tpu.checkpoint import (
     BestCheckpointTracker,
     CheckpointManager,
 )
+from pytorch_distributed_train_tpu.ckpt import build_checkpoint_manager
 from pytorch_distributed_train_tpu.config import TrainConfig
 from pytorch_distributed_train_tpu.data.datasets import build_dataset
 from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
@@ -65,15 +66,30 @@ class Trainer:
             max_delay_s=cfg.faults.retry_max_delay_s))
         if cfg.obs.debug_nans:
             debug_lib.enable_nan_debugging()
-        if cfg.obs.compile_cache_dir:
+        cache_dir = cfg.obs.compile_cache_dir
+        if cache_dir:
+            # Per-worker subdir under tpurun: this container's jax loads
+            # truncated cache entries without validation, so a worker
+            # killed mid-cache-write (crash drill, SIGKILL escalation)
+            # would poison every sibling and later generation sharing
+            # the dir (CHANGES PR 3 gotcha). Worker id is stable across
+            # restart generations, so each worker still reuses ITS cache.
+            wid = os.environ.get("PROCESS_ID")
+            if wid is not None:
+                from pytorch_distributed_train_tpu.elastic import (
+                    worker_cache_dir,
+                )
+
+                cache_dir = worker_cache_dir(cache_dir, wid)
+        elif os.environ.get("PDTT_COMPILE_CACHE_DIR"):
+            # tpurun --compile-cache-dir derived a per-worker dir for us
+            cache_dir = os.environ["PDTT_COMPILE_CACHE_DIR"]
+        if cache_dir:
             # Persistent XLA compile cache: restart-and-resume (the SPMD
             # elasticity model, SURVEY §5.3) skips the minutes-scale GSPMD
             # recompiles of large models.
-            import os
-
-            os.makedirs(cfg.obs.compile_cache_dir, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir",
-                              cfg.obs.compile_cache_dir)
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
         if (getattr(cfg.optim, "swa_update_bn_batches", 0) > 0
                 and cfg.optim.ema_decay == 0.0
                 and getattr(cfg.optim, "swa_start_step", 0) == 0):
@@ -254,7 +270,12 @@ class Trainer:
                   f"{n:,} params ({100.0 * t / n:.2f}%)", flush=True)
 
         # ---- checkpoint + resume (auto is the default path, SURVEY §5.3b)
-        self.ckpt = CheckpointManager(cfg.checkpoint, cfg.to_json())
+        # checkpoint.tiered selects the async tiered plane (ckpt/):
+        # snapshot-only blocking at save boundaries, hot RAM/disk/peer
+        # restore tiers, back-pressure drain re-attributed to the
+        # ckpt.drain goodput bucket.
+        self.ckpt = build_checkpoint_manager(
+            cfg.checkpoint, cfg.to_json(), goodput=self.goodput)
         self.best_ckpt = (BestCheckpointTracker(cfg.checkpoint, cfg.to_json())
                           if cfg.checkpoint.best_metric else None)
         if (cfg.lora.rank > 0 and cfg.lora.base_checkpoint
@@ -997,7 +1018,20 @@ class Trainer:
                 "rather than looping restore/diverge forever")
         self._bad_streak = 0
         self._spike.reset()
-        self.ckpt.wait()  # a mid-flight async save must commit before we pick
+        try:
+            # a mid-flight async save must commit before we pick
+            self.ckpt.wait()
+        except OSError as e:
+            # A terminal BACKGROUND persist failure (tiered plane)
+            # re-raises at the next wait — here that history must not
+            # abort the rewind: letting it unwind would reach fit()'s
+            # finally with _sentinel_aborted unset and force-save the
+            # known-diverged live state. The failed step's sealed hot
+            # snapshot is still a valid rewind source, and the failure
+            # was already printed and counted when it happened.
+            print(f"[sentinel] ignoring earlier checkpoint persist "
+                  f"failure during rewind ({type(e).__name__}: {e})",
+                  flush=True)
         good = self.ckpt.latest_good_step()
         restored = (self.ckpt.restore(self.state, step=good)
                     if good is not None else None)

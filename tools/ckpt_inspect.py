@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Inspect a checkpoint directory across every tier of the checkpoint
+plane (docs/checkpointing.md): persistent Orbax steps with their
+integrity-manifest verdicts, per-host hot-disk snapshots with their
+seal/CRC status, and what the retention policy would (not) evict.
+
+    python tools/ckpt_inspect.py --dir runs/exp1/ckpt
+    python tools/ckpt_inspect.py --dir runs/exp1/ckpt --hot-keep 2 --keep-every 1000
+
+Read-only: nothing is deleted, verified-on-read only (the same checks a
+restore performs). Exit 0 when the directory parses — an operator
+answering "what would a restore land on right now?" should not need a
+Python REPL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _, names in os.walk(path):
+        for name in names:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+            except OSError:
+                pass
+    return total
+
+
+def inspect_dir(root: str, *, hot_keep: int = 2, keep_every: int = 0,
+                out=sys.stdout) -> dict:
+    """Gather + print the report; returns the structured form (tests)."""
+    from pytorch_distributed_train_tpu.ckpt import hot_tier, retention
+    from pytorch_distributed_train_tpu.faults import integrity
+
+    report: dict = {"dir": root, "persistent": [], "hot": {}}
+    print(f"checkpoint dir: {root}", file=out)
+
+    # ---- persistent tier (Orbax step dirs + manifests)
+    steps = []
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if name.isdigit() and os.path.isdir(os.path.join(root, name)):
+                steps.append(int(name))
+    newest_verified = None
+    print(f"\npersistent tier ({len(steps)} steps):", file=out)
+    for s in sorted(steps):
+        ok, reason = integrity.verify_step(root, s)
+        verdict = ("verified" if ok else
+                   "trusted (pre-manifest)" if ok is None else
+                   f"CORRUPT: {reason}")
+        if ok:
+            newest_verified = s
+        size = _dir_bytes(integrity.step_dir(root, s))
+        report["persistent"].append(
+            {"step": s, "verdict": verdict, "bytes": size})
+        print(f"  step {s:>10}  {_fmt_bytes(size):>10}  {verdict}",
+              file=out)
+    if not steps:
+        print("  (none)", file=out)
+
+    # ---- hot disk tier(s): <root>/hot/host_<n>
+    hot_root = os.path.join(root, "hot")
+    hosts = []
+    if os.path.isdir(hot_root):
+        hosts = sorted(n for n in os.listdir(hot_root)
+                       if n.startswith("host_"))
+    for host in hosts:
+        tier = hot_tier.DiskTier(os.path.join(hot_root, host))
+        rows = []
+        print(f"\nhot disk tier [{host}] "
+              f"({len(tier.steps())} steps):", file=out)
+        pins = set()
+        if newest_verified is not None:
+            pins.add(newest_verified)
+        sealed = tier.sealed_steps()
+        if sealed:
+            pins.add(sealed[-1])
+        evict = set(retention.plan_evictions(
+            tier.steps(), keep_last=hot_keep, keep_every=keep_every,
+            pinned=pins))
+        for s in tier.steps():
+            ok = tier.load(s) is not None  # CRC-verified read
+            header = tier.header(s) or {}
+            status = ("sealed+verified" if ok else
+                      "sealed but CORRUPT" if header.get("sealed") else
+                      "unsealed")
+            pin = ("PINNED" if s in pins else
+                   "evictable" if s in evict else "kept")
+            size = tier.step_nbytes(s)
+            rows.append({"step": s, "status": status, "gc": pin,
+                         "bytes": size})
+            print(f"  step {s:>10}  {_fmt_bytes(size):>10}  "
+                  f"{status:<20} gc={pin}", file=out)
+        if not tier.steps():
+            print("  (none)", file=out)
+        report["hot"][host] = rows
+    if not hosts:
+        print("\nhot disk tier: (none)", file=out)
+
+    # ---- the answer an operator actually wants
+    hot_best = max((r["step"] for rows in report["hot"].values()
+                    for r in rows if r["status"] == "sealed+verified"),
+                   default=None)
+    cands = [c for c in (newest_verified, hot_best) if c is not None]
+    landing = max(cands) if cands else None
+    report["newest_verified_persistent"] = newest_verified
+    report["newest_sealed_hot"] = hot_best
+    report["restore_would_land_on"] = landing
+    print(f"\nnewest verified persistent step: {newest_verified}",
+          file=out)
+    print(f"newest sealed hot step:          {hot_best}", file=out)
+    print(f"a restore now would land on:     {landing}", file=out)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Inspect checkpoint tiers, manifest verdicts, and "
+                    "retention-pin status.")
+    p.add_argument("--dir", required=True, help="checkpoint directory")
+    p.add_argument("--hot-keep", type=int, default=2,
+                   help="retention keep-last-N to evaluate pins against")
+    p.add_argument("--keep-every", type=int, default=0,
+                   help="retention keep-every-K to evaluate pins against")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        print(f"ckpt_inspect: no such directory: {args.dir}",
+              file=sys.stderr)
+        return 1
+    inspect_dir(args.dir, hot_keep=args.hot_keep,
+                keep_every=args.keep_every)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
