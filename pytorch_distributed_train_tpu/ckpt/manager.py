@@ -44,6 +44,7 @@ from pytorch_distributed_train_tpu.ckpt import snapshot as snapshot_lib
 from pytorch_distributed_train_tpu.ckpt.persister import Persister
 from pytorch_distributed_train_tpu.faults import registry as faults_registry
 from pytorch_distributed_train_tpu.faults import retry as retry_lib
+from pytorch_distributed_train_tpu.obs import events as events_lib
 from pytorch_distributed_train_tpu.obs.registry import get_registry
 from pytorch_distributed_train_tpu.obs.spans import span
 
@@ -197,6 +198,8 @@ class TieredCheckpointManager:
             "ckpt_last_blocking_ms",
             help="snapshot copy ms of the most recent tiered save").set(
             blocking_ms)
+        events_lib.emit("ckpt", "snapshot", step=step,
+                        blocking_ms=round(blocking_ms, 3))
         self.ram.put(snap)
         self.persister.submit(
             snap, lambda s: self._persist(s, force=force,
@@ -251,6 +254,8 @@ class TieredCheckpointManager:
             "ckpt_last_persist_ms",
             help="background persist ms of the most recent tiered "
                  "save").set(persist_ms)
+        events_lib.emit("ckpt", "persist", step=snap.step,
+                        persist_ms=round(persist_ms, 3))
         self._gc()
 
     def _maybe_publish(self, snap: snapshot_lib.Snapshot) -> None:
@@ -367,6 +372,10 @@ class TieredCheckpointManager:
             "ckpt_restore_tier_total", labels={"tier": tier},
             help="restores served, by tier (ram/disk/peer/orbax)")
 
+    def _note_tier(self, tier: str, step) -> None:
+        self._tier_counter(tier).inc()
+        events_lib.emit("ckpt", "restore_tier", step=step, tier=tier)
+
     def _corrupt_counter(self):
         return get_registry().counter(
             "ckpt_hot_corrupt_total",
@@ -398,7 +407,7 @@ class TieredCheckpointManager:
             target = fallback
         restored = self.persistent.restore(abstract_state, step=int(target))
         if restored is not None:
-            self._tier_counter("orbax").inc()
+            self._note_tier("orbax", int(target))
         return restored
 
     def _restore_hot(self, abstract_state, step: int):
@@ -410,7 +419,7 @@ class TieredCheckpointManager:
                 out = self._place_tree(abstract_state, template, snap.tree,
                                        {"epoch": snap.epoch, **snap.meta})
                 if out is not None:
-                    self._tier_counter("ram").inc()
+                    self._note_tier("ram", step)
                     return out
             else:
                 self._corrupt_counter().inc()
@@ -431,7 +440,7 @@ class TieredCheckpointManager:
                 out = self._place_leaves(abstract_state, template, leaves,
                                          header)
                 if out is not None:
-                    self._tier_counter("disk").inc()
+                    self._note_tier("disk", step)
                     return out
             elif step in self.disk.steps():
                 self._corrupt_counter().inc()
@@ -440,7 +449,7 @@ class TieredCheckpointManager:
         # --- peers
         out = self._restore_peer(abstract_state, template, step)
         if out is not None:
-            self._tier_counter("peer").inc()
+            self._note_tier("peer", step)
             return out
         return None
 

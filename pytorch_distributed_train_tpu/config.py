@@ -427,9 +427,46 @@ class ObsConfig:
     log_every_steps: int = 50
     jsonl_path: str = ""  # "" → <ckpt dir>/metrics.jsonl
     tensorboard: bool = False
+    # Legacy fixed profiler window — now a shim over the managed
+    # profiler plane (obs/profiler.py): profile_num_steps > 0 pre-queues
+    # ONE capture at profile_start_step writing into profile_dir's root
+    # (old output layout, exempt from the capture ring).
     profile_start_step: int = 0  # 0 → profiling off
     profile_num_steps: int = 0
     profile_dir: str = "profiles"
+    # ---- event journal (obs/events.py; docs/observability.md schema).
+    # Append-only per-host JSONL of structured run events (faults,
+    # sentinel verdicts, ckpt traffic, restarts, captures) merged by
+    # tools/timeline_report.py. "" dir → <checkpoint.dir>/events; the
+    # PDTT_EVENTS_DIR env var (tpurun --events-dir) overrides "".
+    events: bool = True
+    events_dir: str = ""
+    # ---- managed profiler plane (obs/profiler.py): bounded N-step
+    # jax.profiler windows with an artifact ring, triggered on cadence,
+    # on demand (trigger file / POST /profile; store-coordinated under
+    # tpurun so all hosts capture the same steps), and by anomaly hooks.
+    profile_window_steps: int = 5   # steps per managed capture
+    profile_every_steps: int = 0    # cadence trigger (0 = off)
+    profile_ring: int = 4           # completed capture dirs retained
+    profile_trigger_file: str = ""  # "" → <checkpoint.dir>/PROFILE
+    # Anomaly auto-capture (sentinel loss-spike, straggler blame, the
+    # step-time/input-stall regression detectors). Off by default: an
+    # unattended jax.profiler session is a real side effect (CPU+disk)
+    # the operator opts into; anomaly EVENTS are journaled regardless.
+    profile_on_anomaly: bool = False
+    profile_cooldown_steps: int = 200  # min steps between auto-captures
+    # Rolling median+MAD regression detectors (sentinel/numeric.py
+    # SpikeDetector pointed at wall-clock health): step time per step,
+    # input-stall % per log window.
+    profile_regress_window: int = 64
+    profile_regress_sigma: float = 8.0
+    profile_regress_min_samples: int = 16
+    profile_regress_min_rel: float = 0.5
+    profile_stall_min_pct: float = 5.0  # abs floor for stall anomalies
+    # Straggler blame trigger: cluster max step-time p50 >= ratio x the
+    # median (needs obs.straggler_metrics + multi-host). 0 = off.
+    profile_straggler_ratio: float = 2.0
+    profile_top_ops: int = 5        # rows in the journaled xplane summary
     heartbeat_timeout_s: float = 0.0  # 0 → heartbeat monitor off
     debug_nans: bool = False
     # Cross-host input-divergence check cadence (0 → off); SURVEY §5.2

@@ -99,6 +99,12 @@ class LaunchConfig:
     # every later generation (CHANGES PR 3). Worker rank is stable
     # across generations, so each worker still reuses its own entries.
     compile_cache_base: str = ""
+    # Shared event-journal directory (obs/events.py): the agent journals
+    # spawn/failure/restart events there and exports it to workers as
+    # PDTT_EVENTS_DIR, so tools/timeline_report.py merges the launcher's
+    # view of an outage with every host's. "" = agent does not journal
+    # (workers still default to <checkpoint.dir>/events).
+    events_dir: str = ""
 
 
 def worker_cache_dir(base: str, rank) -> str:
@@ -185,7 +191,11 @@ class ElasticAgent:
             if cfg.compile_cache_base:
                 env["PDTT_COMPILE_CACHE_DIR"] = worker_cache_dir(
                     cfg.compile_cache_base, rank)
+            if cfg.events_dir:
+                env["PDTT_EVENTS_DIR"] = cfg.events_dir
             self.procs.append(subprocess.Popen(self.cmd, env=env))
+        self._emit("spawn", gen=restart_gen, world=world,
+                   nprocs=cfg.nprocs)
         self._log(f"spawned {cfg.nprocs} workers (gen {restart_gen}, "
                   f"world {world}, coord :{self.coord_port})")
 
@@ -212,10 +222,27 @@ class ElasticAgent:
     def _log(self, msg: str) -> None:
         print(f"[tpurun] {msg}", flush=True)
 
+    def _emit(self, name: str, **detail) -> None:
+        """Journal one launcher event (category ``elastic``) — no-op
+        unless ``events_dir`` was configured. Best-effort: supervision
+        must never die of a full disk."""
+        if not self.cfg.events_dir:
+            return
+        try:
+            from pytorch_distributed_train_tpu.obs import events as evl
+
+            evl.emit("elastic", name, **detail)
+        except Exception:
+            pass
+
     # ---------------------------------------------------------------- run
     def run(self) -> int:
-        self._start_store()
         cfg = self.cfg
+        if cfg.events_dir:
+            from pytorch_distributed_train_tpu.obs import events as evl
+
+            evl.configure(cfg.events_dir, who=f"agent{cfg.node_rank}")
+        self._start_store()
         try:
             if cfg.nnodes > 1:
                 from pytorch_distributed_train_tpu.native.store import (
@@ -270,8 +297,10 @@ class ElasticAgent:
                 self._spawn(rnd, len(members), node_index)
                 rc = self._monitor(rnd)
                 if rc == 0:
+                    self._emit("done", gen=rnd)
                     self._log("all workers exited cleanly")
                     return 0
+                self._emit("worker_failed", gen=rnd, rc=rc)
                 ran_s = time.time() - t_spawn
                 if ran_s >= cfg.stable_window_s and restarts_used:
                     # Windowed budget: this generation ran long enough to
@@ -283,6 +312,8 @@ class ElasticAgent:
                               f"({restarts_used} used)")
                     restarts_used = 0
                 if restarts_used >= cfg.max_restarts:
+                    self._emit("budget_exhausted", rc=rc,
+                               restarts=restarts_used)
                     self._log(f"worker failed (rc={rc}); restart budget "
                               f"exhausted after {restarts_used} restarts")
                     return rc
@@ -291,6 +322,9 @@ class ElasticAgent:
                 delay = _backoff_delay(restarts_used, cfg.backoff_base_s,
                                        cfg.backoff_max_s,
                                        cfg.backoff_jitter)
+                self._emit("restart", gen=rnd, rc=rc,
+                           restarts=restarts_used,
+                           delay_s=round(delay, 2))
                 self._log(f"worker failed (rc={rc}); restarting gang "
                           f"({restarts_used}/{cfg.max_restarts}) after "
                           f"{delay:.2f}s backoff")
@@ -407,6 +441,8 @@ class ElasticAgent:
             if n >= cfg.nnodes:
                 break
             if n >= max(cfg.min_nnodes, 1) and time.time() >= deadline:
+                self._emit("rendezvous_degraded", gen=rnd, nodes=n,
+                           of=cfg.nnodes)
                 self._log(f"rendezvous round {rnd}: window closed with "
                           f"{n}/{cfg.nnodes} nodes — proceeding degraded")
                 break
@@ -537,6 +573,11 @@ def main(argv: list[str] | None = None) -> int:
                         "worker gets <base>/worker_<rank> so a killed "
                         "worker's truncated cache entry cannot poison "
                         "siblings or later generations")
+    p.add_argument("--events-dir", default="",
+                   help="shared event-journal directory (obs/events.py): "
+                        "the agent journals spawn/failure/restart events "
+                        "there and workers inherit it via PDTT_EVENTS_DIR "
+                        "— one directory, every process's timeline")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command, e.g. train.py --config ...")
     args = p.parse_args(argv)
@@ -563,6 +604,7 @@ def main(argv: list[str] | None = None) -> int:
         backoff_base_s=args.backoff_base,
         backoff_max_s=args.backoff_max,
         compile_cache_base=args.compile_cache_dir,
+        events_dir=args.events_dir,
     )
     return ElasticAgent(cfg, cmd).run()
 

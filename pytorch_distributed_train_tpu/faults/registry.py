@@ -223,12 +223,18 @@ class FaultSchedule:
         spec = self.check(point, step=step)
         if spec is None:
             return False
+        from pytorch_distributed_train_tpu.obs import events as events_lib
         from pytorch_distributed_train_tpu.obs.registry import get_registry
 
         get_registry().counter(
             "faults_injected_total", labels={"point": point},
             help="deliberately injected faults by fault point").inc()
         action = POINTS[point]
+        # Journal BEFORE the action runs: step.crash hard-exits and
+        # host.hang never returns — the flushed-per-line journal is the
+        # only record that survives either.
+        events_lib.emit("fault", point, step=step, action=action,
+                        spec=spec.spec_str())
         at = f" at step {step}" if step is not None else ""
         if action == "exit":
             print(f"[fault-inject] killing process{at} ({point})",

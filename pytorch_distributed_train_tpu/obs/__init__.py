@@ -21,12 +21,22 @@ checkpoint / data / serving layers:
 - ``goodput``   — wall-time decomposition into named buckets
                   (init/compile/step/input_stall/ckpt/eval/idle) and the
                   productive-time ``goodput_pct``.
+- ``events``    — append-only per-host JSONL journal of structured run
+                  events (faults, sentinel verdicts, ckpt traffic,
+                  restarts, captures); tools/timeline_report.py merges
+                  every host's into one cross-host timeline.
+- ``profiler``  — managed ``jax.profiler`` plane: bounded N-step capture
+                  windows with an artifact ring, opened on cadence, on
+                  demand (trigger file / POST /profile / launcher-store
+                  coordination) or by anomaly hooks, each auto-summarized
+                  via the xplane top-ops report and journaled.
 
 Everything here is plain-Python host code: no jax import at module
 scope except in ``cluster`` (which is lazy), so data-loader worker
 processes can use spans/metrics without touching the device backend.
 """
 
+from pytorch_distributed_train_tpu.obs.events import emit, get_journal  # noqa: F401
 from pytorch_distributed_train_tpu.obs.goodput import GoodputTracker  # noqa: F401
 from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: F401
 from pytorch_distributed_train_tpu.obs.spans import get_recorder, span  # noqa: F401

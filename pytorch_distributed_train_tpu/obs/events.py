@@ -1,0 +1,199 @@
+"""Append-only structured event journal: one JSONL file per host.
+
+Every subsystem that changes the SHAPE of a run — fault injections,
+sentinel skips/rewinds, checkpoint tier traffic, elastic restarts,
+preemptions, profiler captures — already prints a log line, but prose
+logs from N hosts across M restart generations cannot be merged back
+into "what happened to this run" without archaeology. This journal is
+the machine-readable spine those subsystems emit into instead: one
+record per event, append-only, per-host files that a post-mortem tool
+(tools/timeline_report.py) merges into a single cross-host timeline.
+
+Record schema (one JSON object per line)::
+
+    {ts, step, host, gen, category, name, detail}
+
+    ts       — epoch seconds (time.time; the same clock the span
+               recorder anchors to, so journals and traces align)
+    step     — trainer step counter, or null for steps-less contexts
+               (the elastic agent, serving tools)
+    host     — writer identity: "host<rank>" for workers (PROCESS_ID),
+               "agent<node>" for the launcher
+    gen      — RESTART_GENERATION at write time (journals append across
+               restarts; gen is what separates the lives)
+    category — one of CATEGORIES below (validated: a typo'd category is
+               a silent fault, same stance as faults/registry.py)
+    name     — event name within the category (e.g. "rewind")
+    detail   — free-form JSON-serializable kwargs from the emitter
+
+Categories are a CLOSED catalog, cross-checked against the table in
+docs/observability.md by tools/check_events.py (the check_fault_points
+pattern): an event stream readers can't interpret is noise.
+
+Thread model: emitters run on the step loop, persister thread, liveness
+watcher and HTTP handlers; one lock serializes the write+flush pair.
+Every emit also increments ``obs_events_total{category=}`` whether or
+not a sink is configured, so scrape dashboards see event rates even
+when nobody journals to disk. Journaling is best-effort: a full disk
+must degrade the post-mortem, never the run.
+
+No jax at module scope (the obs/ package contract): the elastic agent
+and data workers journal without touching a device backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+# category -> one-line meaning (the docs/observability.md table mirrors
+# this; tools/check_events.py keeps the two in sync both ways)
+CATEGORIES: dict[str, str] = {
+    "lifecycle": "process milestones: trainer init, fit start/end",
+    "fault": "injected fault fires (faults/registry.py)",
+    "sentinel": "numeric/liveness verdicts: bad steps, rewinds, hangs",
+    "ckpt": "checkpoint traffic: saves, persists, restores by tier",
+    "elastic": "launcher: spawns, worker failures, gang restarts",
+    "preempt": "graceful preemption markers",
+    "anomaly": "detector firings: loss spikes, stragglers, regressions",
+    "profile": "managed profiler captures and their summaries",
+}
+
+
+class EventJournal:
+    """One writer, one append-only JSONL file (lazily opened)."""
+
+    def __init__(self, dir_path: str | None = None, who: str | None = None,
+                 gen: str | None = None):
+        self.dir = dir_path
+        self.who = who if who is not None else (
+            f"host{os.environ.get('PROCESS_ID', '0')}")
+        self.gen = gen if gen is not None else os.environ.get(
+            "RESTART_GENERATION", "0")
+        self._lock = threading.Lock()
+        self._fh = None
+        self._failed = False  # print the sink failure once, then drop
+
+    @property
+    def path(self) -> str | None:
+        if not self.dir:
+            return None
+        return os.path.join(self.dir, f"events_{self.who}.jsonl")
+
+    def emit(self, category: str, name: str, step: int | None = None,
+             **detail) -> None:
+        if category not in CATEGORIES:
+            raise KeyError(
+                f"unknown event category {category!r} "
+                f"(catalog: {sorted(CATEGORIES)})")
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "obs_events_total", labels={"category": category},
+            help="journaled structured events by category").inc()
+        if not self.dir or self._failed:
+            return
+        rec = {"ts": time.time(),
+               "step": None if step is None else int(step),
+               "host": self.who, "gen": self.gen,
+               "category": category, "name": name, "detail": detail}
+        try:
+            line = json.dumps(rec, default=repr)
+        except (TypeError, ValueError):
+            rec["detail"] = {"unserializable": repr(detail)}
+            line = json.dumps(rec)
+        with self._lock:
+            try:
+                if self._fh is None:
+                    os.makedirs(self.dir, exist_ok=True)
+                    self._fh = open(self.path, "a")
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError as e:
+                self._failed = True
+                print(f"[events] journal sink failed ({e}); further "
+                      "events counted but not persisted", flush=True)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# ------------------------------------------------------------ process-global
+_GLOBAL: EventJournal | None = None
+_LOCK = threading.Lock()
+
+ENV_VAR = "PDTT_EVENTS_DIR"
+
+
+def configure(dir_path: str | None, who: str | None = None,
+              gen: str | None = None) -> EventJournal:
+    """Install the process-global journal. ``dir_path`` None means
+    metrics-only (events counted, nothing persisted). Reconfiguring
+    closes the previous sink (several Trainers per test process)."""
+    global _GLOBAL
+    j = EventJournal(dir_path, who=who, gen=gen)
+    with _LOCK:
+        prev, _GLOBAL = _GLOBAL, j
+    if prev is not None:
+        prev.close()
+    return j
+
+
+def get_journal() -> EventJournal:
+    """The process-global journal; lazily built from PDTT_EVENTS_DIR
+    alone when nothing configured one (elastic agent, data workers,
+    serving tools)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = EventJournal(os.environ.get(ENV_VAR) or None)
+    return _GLOBAL
+
+
+def emit(category: str, name: str, step: int | None = None, **detail) -> None:
+    """``emit("sentinel", "rewind", step=6, to=4)`` against the global
+    journal — the one-liner call sites use."""
+    get_journal().emit(category, name, step=step, **detail)
+
+
+def load_events(dir_path: str) -> list[dict]:
+    """Read every ``events_*.jsonl`` under ``dir_path``, merged and
+    ts-sorted (the timeline/report tools' loader). Torn tail lines of a
+    crashed writer are skipped."""
+    import glob
+
+    recs: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(dir_path, "events_*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and "category" in rec:
+                        recs.append(rec)
+        except OSError:
+            continue
+    recs.sort(key=lambda r: r.get("ts", 0.0))
+    return recs
+
+
+def _reset_for_tests() -> None:
+    global _GLOBAL
+    with _LOCK:
+        prev, _GLOBAL = _GLOBAL, None
+    if prev is not None:
+        prev.close()

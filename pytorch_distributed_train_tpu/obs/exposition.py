@@ -21,6 +21,7 @@ still be scraped (exactly when you need the numbers most).
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -32,6 +33,32 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 def render_metrics() -> str:
     """The exposition body — shared by every scrape surface."""
     return get_registry().render()
+
+
+# ``POST /profile`` hook: the trainer registers a callback that opens a
+# managed profiler capture (obs/profiler.py) — the sidecar is often the
+# ONLY reachable surface of a misbehaving remote run, which is exactly
+# when an on-demand capture is wanted. The callback may return a
+# CaptureRequest (step-windowed), a capture-dir string (time-bounded),
+# or None (a window is already open).
+_PROFILE_TRIGGER = None
+_TRIGGER_LOCK = threading.Lock()
+
+
+def set_profile_trigger(fn) -> None:
+    """Install (or clear, with None) the capture-request callback."""
+    global _PROFILE_TRIGGER
+    with _TRIGGER_LOCK:
+        _PROFILE_TRIGGER = fn
+
+
+def clear_profile_trigger(fn) -> None:
+    """Clear the callback ONLY if ``fn`` is still the installed one — a
+    closing Trainer must not detach a newer Trainer's sidecar route."""
+    global _PROFILE_TRIGGER
+    with _TRIGGER_LOCK:
+        if _PROFILE_TRIGGER is fn:
+            _PROFILE_TRIGGER = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -51,6 +78,42 @@ class _Handler(BaseHTTPRequestHandler):
             body = b"not found\n"
             self.send_response(404)
             self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        if self.path.split("?", 1)[0] != "/profile":
+            body, code = b"not found\n", 404
+        else:
+            with _TRIGGER_LOCK:
+                fn = _PROFILE_TRIGGER
+            if fn is None:
+                body, code = (b'{"error": "no profiler attached"}\n', 503)
+            else:
+                try:
+                    req = fn()
+                    if req is None:
+                        body = b'{"error": "capture already open"}\n'
+                        code = 409
+                    elif isinstance(req, str):  # time-bounded: the dir
+                        body = (json.dumps({"status": "capturing",
+                                            "dir": req}).encode() + b"\n")
+                        code = 202
+                    else:
+                        body = (json.dumps(
+                            {"status": "requested",
+                             "reason": getattr(req, "reason", "http"),
+                             "start_step": getattr(req, "start_step", None),
+                             "window": getattr(req, "window", None)})
+                            .encode() + b"\n")
+                        code = 202
+                except Exception as e:  # the scrape surface must survive
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    code = 500
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

@@ -185,6 +185,13 @@ class LivenessPlane:
             return
         self._dumped = True
         try:
+            from pytorch_distributed_train_tpu.obs import events as evl
+
+            evl.emit("sentinel", "cluster_dump",
+                     blamed=order.get("rank"))
+        except Exception:
+            pass
+        try:
             self.recorder.dump(
                 reason=f"cluster hang dump: host {order.get('rank')} "
                        f"blamed ({order.get('detail', '')})",
@@ -254,6 +261,14 @@ class LivenessPlane:
         registry.counter(
             "sentinel_hangs_total",
             help="cross-host hangs diagnosed by the liveness monitor").inc()
+        try:
+            from pytorch_distributed_train_tpu.obs import events as evl
+
+            evl.emit("sentinel", "hang_blamed", step=hb.get("step"),
+                     rank=rank, age_s=round(age, 1),
+                     spans=phase.get("spans") or {})
+        except Exception:
+            pass  # diagnostics must never block the restart
         print(f"[sentinel] host {rank} appears HUNG: {detail} — "
               f"triggering cluster flight-recorder dump and exiting "
               f"rc={self.exit_code} for gang restart", flush=True)

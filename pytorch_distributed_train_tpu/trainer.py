@@ -30,6 +30,8 @@ from pytorch_distributed_train_tpu.data.datasets import build_dataset
 from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
 from pytorch_distributed_train_tpu.models.registry import build_model
 from pytorch_distributed_train_tpu.obs import cluster as cluster_lib
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs import profiler as profiler_lib
 from pytorch_distributed_train_tpu.obs import spans as spans_lib
 from pytorch_distributed_train_tpu.obs.goodput import GoodputTracker
 from pytorch_distributed_train_tpu.obs.registry import get_registry
@@ -53,6 +55,14 @@ class Trainer:
         _t_init0 = time.perf_counter()
         self.goodput = GoodputTracker(t0=_t_init0)
         self.cfg = cfg
+        # ---- event journal (obs/events.py): configured FIRST so every
+        # later construction phase (fault schedule, data, restore) can
+        # journal. PDTT_EVENTS_DIR (tpurun --events-dir) beats the
+        # per-run default so agent + all hosts share one directory.
+        self.journal = events_lib.configure(
+            (cfg.obs.events_dir or os.environ.get(events_lib.ENV_VAR)
+             or os.path.join(cfg.checkpoint.dir, "events"))
+            if cfg.obs.events else None)
         # ---- fault schedule + recovery policies (faults/): configured
         # before data/checkpoint construction so every fault point those
         # layers traverse is already armed. obs.fault_inject_at_step is
@@ -306,6 +316,10 @@ class Trainer:
                 self.state, meta = restored
                 self.resumed = True
                 self.start_epoch = int(meta.get("epoch", 0))
+                events_lib.emit("ckpt", "restore",
+                                step=int(self.state.step),
+                                epoch=self.start_epoch,
+                                source=resume_mode)
                 if jax.process_index() == 0:
                     print(f"[resume] restored step {int(self.state.step)} "
                           f"(epoch {self.start_epoch})", flush=True)
@@ -344,7 +358,12 @@ class Trainer:
             self.preempt = PreemptionHandler()
             self.preempt.install()
         self.heartbeat = Heartbeat(cfg.obs.heartbeat_timeout_s, self.recorder)
-        self._profiling = False
+        # ---- managed profiler plane (obs/profiler.py): bounded capture
+        # windows on cadence / on demand / on anomaly; the legacy
+        # obs.profile_* fixed window rides through it as a shim.
+        self.profiler = profiler_lib.ManagedProfiler(
+            cfg.obs, run_dir=cfg.checkpoint.dir)
+        self.profiler.start()
         # ---- unified obs layer (obs/): spans + registry + goodput.
         # One process-wide span ring — checkpoint saves, data producer
         # threads and the step loop interleave on a single exported
@@ -363,6 +382,17 @@ class Trainer:
             )
 
             self.metrics_server = MetricsServer(cfg.obs.metrics_port)
+            # POST /profile on the sidecar opens a TIME-bounded capture
+            # (capture_for_seconds, not a step window): the route's
+            # whole point is poking a run that may be wedged, and a
+            # step-windowed request would wait forever on a step loop
+            # that never advances.
+            from pytorch_distributed_train_tpu.obs import exposition
+
+            self._profile_trigger = (
+                lambda: self.profiler.capture_for_seconds(10.0,
+                                                          reason="http"))
+            exposition.set_profile_trigger(self._profile_trigger)
             if jax.process_index() == 0:
                 print(f"[obs] /metrics on port {self.metrics_server.port}",
                       flush=True)
@@ -400,6 +430,10 @@ class Trainer:
                 print(f"[sentinel] liveness plane up (host {plane.rank}/"
                       f"{plane.world}, timeout "
                       f"{cfg.sentinel.hang_timeout_s}s)", flush=True)
+        events_lib.emit("lifecycle", "trainer_init",
+                        step=int(self.state.step), resumed=self.resumed,
+                        world=jax.process_count(),
+                        init_s=round(time.perf_counter() - _t_init0, 3))
         self.goodput.account("init", time.perf_counter() - _t_init0)
 
     # ------------------------------------------------------------------ init
@@ -572,6 +606,8 @@ class Trainer:
         step = int(self.state.step)
         epoch = self.start_epoch
         t_start = time.time()
+        events_lib.emit("lifecycle", "fit_start", step=step, limit=limit,
+                        epoch=epoch)
         try:
             while step < limit:
                 self.recorder.record("epoch_start", step, epoch=epoch)
@@ -586,7 +622,7 @@ class Trainer:
                         self.train_epoch_fn(epoch, start_b)):
                     if step >= limit:
                         break
-                    self._maybe_profile(step)
+                    self.profiler.on_step(step)
                     # Sentinel drill points (flag-kind: firing only
                     # reports a match; the corruption is ours to stage).
                     # step.nan@step=N poisons the batch of the step that
@@ -621,6 +657,11 @@ class Trainer:
                     dt_tick = self.meter.tick()
                     if dt_tick is not None:
                         self._step_hist.observe(dt_tick)
+                        # step-time regression detector (anomaly plane):
+                        # a meter tick that spikes off the rolling
+                        # median+MAD baseline journals an anomaly and
+                        # (opt-in) opens a capture window
+                        self.profiler.observe_step_time(dt_tick, step)
                     if dt_tick is None:
                         # Priming tick (first step after a clock reset —
                         # epoch boundary or mid-epoch eval): its interval
@@ -668,6 +709,8 @@ class Trainer:
                         if self._bad_streak == 0 and self.ckpt.maybe_save(
                                 self.state, epoch=epoch, step=step):
                             self.recorder.record("ckpt", step)
+                            events_lib.emit("ckpt", "save", step=step,
+                                            epoch=epoch)
                     if (cfg.eval_every_steps and
                             step % cfg.eval_every_steps == 0):
                         with self.goodput.measure("eval"):
@@ -682,6 +725,7 @@ class Trainer:
                         # checkpoint and the summary carries the marker.
                         self._preempted = True
                         self.recorder.record("preempt", step)
+                        events_lib.emit("preempt", "sigterm", step=step)
                         if jax.process_index() == 0:
                             print(f"[preempt] stopping at step {step}; "
                                   "checkpointing and exiting cleanly",
@@ -722,6 +766,10 @@ class Trainer:
                     batch_stats=trajectory_stats)
         finally:
             self.heartbeat.stop()
+            # A capture window still open at the horizon (or on an
+            # abort) must stop + summarize NOW — an unterminated
+            # profiler session would leak into teardown.
+            self.profiler.finish(step)
             # NOTE: the liveness plane deliberately OUTLIVES fit() (it
             # stops in close()): a multi-host job that finished its loop
             # can still wedge in the final synchronized save or in a
@@ -735,8 +783,10 @@ class Trainer:
                 # make it the newest verified checkpoint and trap every
                 # later generation in a restore/diverge loop.
                 if not self._sentinel_aborted:
-                    self.ckpt.save(self.state, epoch=epoch, force=True,
-                                   step=step)
+                    if self.ckpt.save(self.state, epoch=epoch, force=True,
+                                      step=step):
+                        events_lib.emit("ckpt", "save", step=step,
+                                        epoch=epoch, final=True)
                 self.ckpt.wait()
             if self.best_ckpt is not None:
                 self.best_ckpt.close()
@@ -751,6 +801,10 @@ class Trainer:
             )
             self.logger.close()
             self._dump_trace()
+            events_lib.emit("lifecycle", "fit_end", step=step,
+                            preempted=self._preempted,
+                            rewinds=self._rewinds,
+                            wall_s=round(time.time() - t_start, 3))
         return self.state
 
     def _timed_batches(self, it):
@@ -825,6 +879,10 @@ class Trainer:
                 host["input_stall_pct"] = round(
                     100.0 * max(0.0, stats.wait_s - prev[0])
                     / (loop_s - prev[1]), 3)
+                # input-stall regression detector (anomaly plane): one
+                # observation per log window
+                self.profiler.observe_stall_pct(host["input_stall_pct"],
+                                                step)
             self._stall_prev = (stats.wait_s, loop_s)
         if self.cfg.obs.log_memory:
             host.update(device_memory_metrics())
@@ -843,11 +901,22 @@ class Trainer:
             # the logging below is rank-0. Fixed key schema — absent
             # backends contribute 0.0, never a missing key.
             hbm = device_memory_metrics().get("hbm_gb_in_use", 0.0)
-            host.update(cluster_lib.summarize({
+            agg = cluster_lib.summarize({
                 "step_time_p50": host.get("step_time_ms_p50", 0.0),
                 "input_stall_pct": host.get("input_stall_pct", 0.0),
                 "hbm_used": hbm,
-            }))
+            })
+            host.update(agg)
+            # Straggler blame trigger: every host computes the same
+            # aggregate at the same step, so each fires the anomaly
+            # locally and the capture windows align by construction.
+            blamed = profiler_lib.straggler_blame(
+                agg, self.cfg.obs.profile_straggler_ratio)
+            if blamed is not None:
+                self.profiler.anomaly(
+                    "straggler", step, host=blamed,
+                    p50_max=round(agg["step_time_p50_max"], 3),
+                    p50_med=round(agg["step_time_p50_med"], 3))
         self.logger.log(step, host, prefix="train")
 
     def update_bn(self, num_batches: int = 50) -> None:
@@ -998,6 +1067,14 @@ class Trainer:
               f"{self._bad_streak}/{self.cfg.sentinel.max_consecutive_bad})",
               flush=True)
         self.recorder.record("sentinel_bad_step", step, reason=reason)
+        events_lib.emit("sentinel", "bad_step", step=step, reason=reason,
+                        loss=loss, streak=self._bad_streak)
+        if reason == "loss_spike":
+            # anomaly hook: journal + (opt-in) open a capture window —
+            # the profile of the steps AROUND a spike is the evidence
+            # the post-mortem never has
+            self.profiler.anomaly("loss_spike", step, loss=loss,
+                                  streak=self._bad_streak)
         return self._bad_streak >= self.cfg.sentinel.max_consecutive_bad
 
     def _sentinel_rewind(self, step: int) -> int:
@@ -1011,6 +1088,8 @@ class Trainer:
             # Flag BEFORE raising: fit()'s finally must not force-save
             # the known-diverged live state over the rewind target.
             self._sentinel_aborted = True
+            events_lib.emit("sentinel", "abort", step=step,
+                            rewinds=self._rewinds)
             raise RuntimeError(
                 f"[sentinel] rewind budget exhausted "
                 f"({self._rewinds}/{scfg.max_rewinds}): training keeps "
@@ -1052,6 +1131,8 @@ class Trainer:
                  "bad-step streak").inc()
         self.recorder.record("sentinel_rewind", step, to=good,
                              lr_scale=scale)
+        events_lib.emit("sentinel", "rewind", step=step, to=int(good),
+                        lr_scale=scale, rewind=self._rewinds)
         print(f"[sentinel] rewinding from step {step} to verified step "
               f"{good} (rewind {self._rewinds}/{scfg.max_rewinds}, "
               f"lr cooldown x{scfg.lr_cooldown_factor} -> total scale "
@@ -1078,20 +1159,6 @@ class Trainer:
         if jax.process_index() == 0:
             print(f"[interop] warm-started params from {path}", flush=True)
 
-    # ------------------------------------------------------------- profiling
-    def _maybe_profile(self, step: int) -> None:
-        obs = self.cfg.obs
-        if not obs.profile_num_steps:
-            return
-        if step == obs.profile_start_step and not self._profiling:
-            jax.profiler.start_trace(obs.profile_dir)
-            self._profiling = True
-            self.recorder.record("profile_start", step)
-        elif self._profiling and step >= obs.profile_start_step + obs.profile_num_steps:
-            jax.profiler.stop_trace()
-            self._profiling = False
-            self.recorder.record("profile_stop", step)
-
     @property
     def preempted(self) -> bool:
         """Did a graceful SIGTERM preemption end fit() early? (train.py
@@ -1100,6 +1167,7 @@ class Trainer:
 
     def close(self) -> None:
         self.heartbeat.stop()
+        self.profiler.finish()
         if self.liveness is not None:
             self.liveness.stop()
         self.ckpt.close()
@@ -1107,6 +1175,11 @@ class Trainer:
             self.best_ckpt.close()
         self.logger.close()
         if self.metrics_server is not None:
+            from pytorch_distributed_train_tpu.obs import exposition
+
+            # compare-and-clear: a newer Trainer's trigger (several
+            # Trainers per test process) must survive this close
+            exposition.clear_profile_trigger(self._profile_trigger)
             self.metrics_server.close()
             self.metrics_server = None
 

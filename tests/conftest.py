@@ -8,7 +8,31 @@ we override both the env and the live jax config before any backend is
 instantiated.
 """
 
+import faulthandler
 import os
+import signal
+
+# The -q suite occasionally dies SILENTLY (~13% of full runs): no
+# traceback, no failing test name — just a truncated dot line. Leave a
+# corpse next time: faulthandler catches hard crashes (SIGSEGV/SIGABRT
+# — e.g. a poisoned XLA compile-cache entry), the SIGTERM hook catches
+# the tier-1 `timeout` kill (dump every thread's stack, then chain to
+# the previous disposition), and PDTT_TEST_DUMP_AFTER_S arms a one-shot
+# all-stacks dump shortly before a known wall-clock cap (e.g. 840 for
+# the 870s tier-1 budget) so a WEDGED test names itself even if the
+# SIGTERM never lands. Best-effort: a test that installs its own
+# SIGTERM handler in-process (preemption drills) overrides the hook.
+faulthandler.enable()
+try:
+    faulthandler.register(signal.SIGTERM, chain=True)
+except (AttributeError, ValueError, OSError):
+    pass  # platform without register(), or not the main thread
+_dump_after = os.environ.get("PDTT_TEST_DUMP_AFTER_S")
+if _dump_after:
+    try:
+        faulthandler.dump_traceback_later(float(_dump_after), exit=False)
+    except ValueError:
+        pass
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:

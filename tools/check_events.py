@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Cross-check the event-category catalog in docs/observability.md
+against the live catalog (obs/events.py CATEGORIES) AND the emitters —
+in every direction.
+
+Same stance as tools/check_fault_points.py: the journal's whole value
+is legibility, and a category that exists in code but not in the doc
+(or is documented but never emitted, or emitted but undeclared) is
+silent drift. Checks:
+
+1. doc table rows == CATEGORIES (both ways);
+2. every ``emit("<category>", ...)`` literal in the source names a
+   declared category (an undeclared one would raise at runtime — catch
+   it in CI instead);
+3. every declared category has at least one emitter call site (a
+   category nothing can produce is a dead doc row).
+
+Run standalone in CI::
+
+    python tools/check_events.py      # exit 0 = in sync
+
+or as a test (tests/test_timeline_profiler.py asserts main() == 0).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DOC = os.path.join(REPO, "docs", "observability.md")
+
+_ROW = re.compile(r"^\|\s*`([a-z_]+)`\s*\|")
+# events_lib.emit("cat", ...) / evl.emit("cat", ...) / journal.emit(...)
+# — any attribute-call named emit with a string-literal first argument
+_EMIT = re.compile(r"\bemit\(\s*\n?\s*\"([a-z_]+)\"")
+
+
+def documented_categories(doc_path: str = DOC) -> set[str]:
+    """Category names from the first column of the '## Event categories'
+    table (only that section)."""
+    cats: set[str] = set()
+    in_table = False
+    with open(doc_path) as f:
+        for line in f:
+            if line.startswith("## "):
+                in_table = line.strip().lower() == "## event categories"
+                continue
+            if in_table:
+                m = _ROW.match(line)
+                if m:
+                    cats.add(m.group(1))
+    return cats
+
+
+def emitted_categories() -> set[str]:
+    """Category literals at every emit() call site in the package and
+    tools (excluding obs/events.py itself — the definition, not a use)."""
+    cats: set[str] = set()
+    roots = (os.path.join(REPO, "pytorch_distributed_train_tpu"),
+             os.path.join(REPO, "tools"))
+    skip = (os.path.join("obs", "events.py"),  # the definition
+            "check_events.py")                 # this checker's own docs
+    for root in roots:
+        for path in glob.glob(os.path.join(root, "**", "*.py"),
+                              recursive=True):
+            if path.endswith(skip):
+                continue
+            try:
+                with open(path) as f:
+                    cats.update(_EMIT.findall(f.read()))
+            except OSError:
+                continue
+    return cats
+
+
+def main(argv: list[str] | None = None) -> int:
+    del argv
+    from pytorch_distributed_train_tpu.obs.events import CATEGORIES
+
+    code = set(CATEGORIES)
+    doc = documented_categories()
+    used = emitted_categories()
+    ok = True
+    if not doc:
+        print(f"check_events: FOUND NO catalog rows in {DOC} — was the "
+              "'## Event categories' table renamed?", file=sys.stderr)
+        return 1
+    undocumented = sorted(code - doc)
+    phantom = sorted(doc - code)
+    undeclared = sorted(used - code)
+    unemitted = sorted(code - used)
+    if undocumented:
+        print(f"check_events: categories in obs/events.py but MISSING "
+              f"from the doc catalog: {undocumented}", file=sys.stderr)
+        ok = False
+    if phantom:
+        print(f"check_events: categories documented but ABSENT from "
+              f"obs/events.py: {phantom}", file=sys.stderr)
+        ok = False
+    if undeclared:
+        print(f"check_events: emit() call sites using UNDECLARED "
+              f"categories (would raise at runtime): {undeclared}",
+              file=sys.stderr)
+        ok = False
+    if unemitted:
+        print(f"check_events: declared categories with NO emitter call "
+              f"site: {unemitted}", file=sys.stderr)
+        ok = False
+    if ok:
+        print(f"check_events: {len(code)} event categories in sync "
+              "between code, docs and emitters")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

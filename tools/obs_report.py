@@ -14,7 +14,10 @@ Chrome ``trace.json`` the span recorder exports, and prints:
 - the step-time p50/p99 trend over the logged windows,
 - the cluster straggler table (multi-host runs logging
   ``obs.straggler_metrics`` aggregates),
-- top span names by total time (from the trace file).
+- top span names by total time (from the trace file),
+- the event-journal summary (obs/events.py: counts per category, the
+  last rewind / restart / profiler capture) — the one-line version of
+  tools/timeline_report.py's full cross-host timeline.
 
 Pure stdlib + the repo; no jax import — safe on a login host against a
 run directory on shared storage.
@@ -150,12 +153,57 @@ def spans_section(trace_path: str, top: int = 8) -> list[str]:
     return out
 
 
-def report(jsonl_path: str, trace_path: str = "") -> str:
+def events_section(events_dir: str) -> list[str]:
+    """Journal summary: per-category counts + the newest occurrence of
+    the events an operator reaches for first (rewind/restart/capture)."""
+    if not events_dir or not os.path.isdir(events_dir):
+        return ["events: no journal directory (obs.events off, or a "
+                "pre-journal run)"]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from pytorch_distributed_train_tpu.obs.events import load_events
+
+    events = load_events(events_dir)
+    if not events:
+        return [f"events: journal at {events_dir} is empty"]
+    by_cat: dict[str, int] = {}
+    for e in events:
+        by_cat[e.get("category", "?")] = by_cat.get(
+            e.get("category", "?"), 0) + 1
+    out = [f"events ({len(events)} journaled, "
+           f"{len({e.get('host') for e in events})} writers): "
+           + "  ".join(f"{c}={n}" for c, n in sorted(
+               by_cat.items(), key=lambda kv: -kv[1]))]
+    for label, pred in (
+            ("last rewind", lambda e: e.get("category") == "sentinel"
+             and e.get("name") == "rewind"),
+            ("last restart", lambda e: e.get("category") == "elastic"
+             and e.get("name") in ("restart", "spawn")),
+            ("last capture", lambda e: e.get("category") == "profile"
+             and e.get("name") == "capture_end"),
+    ):
+        hit = next((e for e in reversed(events) if pred(e)), None)
+        if hit is None:
+            out.append(f"  {label:<12} -")
+            continue
+        detail = " ".join(
+            f"{k}={v}" for k, v in (hit.get("detail") or {}).items()
+            if k != "summary")[:64]
+        out.append(f"  {label:<12} {hit.get('name')}@step "
+                   f"{hit.get('step')} [{hit.get('host')} "
+                   f"g{hit.get('gen')}] {detail}".rstrip())
+    out.append("  (full cross-host story: tools/timeline_report.py)")
+    return out
+
+
+def report(jsonl_path: str, trace_path: str = "",
+           events_dir: str = "") -> str:
     recs = load_jsonl(jsonl_path)
     lines = [f"== run report: {jsonl_path} ({len(recs)} records) =="]
     for section in (goodput_section(recs), trend_section(recs),
                     straggler_section(recs),
-                    spans_section(trace_path)):
+                    spans_section(trace_path),
+                    events_section(events_dir)):
         lines.append("")
         lines.extend(section)
     return "\n".join(lines)
@@ -167,6 +215,9 @@ def main(argv=None) -> int:
                    help="run directory holding metrics.jsonl (+ trace.json)")
     p.add_argument("--jsonl", default="", help="explicit metrics.jsonl path")
     p.add_argument("--trace", default="", help="explicit trace.json path")
+    p.add_argument("--events", default="",
+                   help="explicit events directory "
+                        "(default <run-dir>/events)")
     args = p.parse_args(argv)
     jsonl = args.jsonl or (os.path.join(args.run_dir, "metrics.jsonl")
                            if args.run_dir else "")
@@ -176,7 +227,9 @@ def main(argv=None) -> int:
         return 2
     trace = args.trace or (os.path.join(args.run_dir, "trace.json")
                            if args.run_dir else "")
-    print(report(jsonl, trace))
+    events_dir = args.events or (os.path.join(args.run_dir, "events")
+                                 if args.run_dir else "")
+    print(report(jsonl, trace, events_dir))
     return 0
 
 

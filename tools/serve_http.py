@@ -93,6 +93,28 @@ from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E40
 from pytorch_distributed_train_tpu.obs.spans import span  # noqa: E402
 from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
 
+_PROFILER = None
+_PROFILER_LOCK = threading.Lock()
+
+
+def _serving_profiler():
+    """Lazy managed-profiler instance for the serving process (the
+    ``POST /profile`` route): ad-hoc time-bounded captures into
+    ``./profiles`` (or PDTT_PROFILE_DIR), ring-retained and
+    xplane-summarized like the trainer's."""
+    global _PROFILER
+    with _PROFILER_LOCK:
+        if _PROFILER is None:
+            from pytorch_distributed_train_tpu.config import ObsConfig
+            from pytorch_distributed_train_tpu.obs.profiler import (
+                ManagedProfiler,
+            )
+
+            cfg = ObsConfig(profile_dir=os.environ.get(
+                "PDTT_PROFILE_DIR", "profiles"))
+            _PROFILER = ManagedProfiler(cfg, run_dir=".")
+        return _PROFILER
+
 
 
 def render_chat(messages, tok) -> str:
@@ -683,6 +705,39 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                 self._send(404, {"error": "unknown path"})
 
         def do_POST(self):
+            if self.path.split("?", 1)[0] == "/profile":
+                # On-demand capture of the SERVING process (managed
+                # profiler plane, obs/profiler.py): time-bounded since
+                # there is no step loop to count windows in. Body:
+                # {"seconds": N} (default 3, capped at 60). Subject to
+                # the drain gate like any other POST: a draining server
+                # must not accept new profiling work whose stop timer
+                # would outlive the process.
+                if drain is not None and not drain.begin_request():
+                    self._send(503, {"error": "server draining"})
+                    return
+                try:
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n) or b"{}")
+                        seconds = min(60.0, max(
+                            0.1, float(req.get("seconds", 3.0))))
+                        logdir = _serving_profiler().capture_for_seconds(
+                            seconds, reason="http")
+                    except Exception as e:
+                        self._send(500,
+                                   {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    if logdir is None:
+                        self._send(409, {"error": "capture already open"})
+                    else:
+                        self._send(202, {"status": "capturing",
+                                         "seconds": seconds,
+                                         "dir": logdir})
+                finally:
+                    if drain is not None:
+                        drain.end_request()
+                return
             if self.path not in ("/v1/completions", "/v1/preload",
                                  "/v1/chat/completions"):
                 self._send(404, {"error": "unknown path"})

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Unified cross-host run timeline from the event journals.
+
+    python tools/timeline_report.py --run-dir checkpoints/
+    python tools/timeline_report.py --run-dir checkpoints/ --out run_trace.json
+
+Merges every host's (and the launcher agent's) append-only event
+journal (``<run>/events/events_*.jsonl``, obs/events.py) with the
+goodput summary from ``metrics.jsonl`` and the host span trace
+(``trace.json``) into:
+
+- a ONE-SCREEN text timeline, chronological across hosts, restarts and
+  generations — restarts, rewinds, fault fires and profiler captures
+  marked so "what happened to this run" is one read, not archaeology;
+- causal chains: every journaled anomaly paired with the capture it
+  opened and the recovery that followed (sentinel rewind / elastic
+  restart / preemption) — the anomaly→capture→recovery story;
+- optionally (``--out``) a Chrome/Perfetto ``trace.json``: the span
+  ring's complete events merged with one instant event per journal
+  record, one process row per host, loadable in ui.perfetto.dev.
+
+Pure stdlib + the repo's obs package; no jax import — safe on a login
+host against a run directory on shared storage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_train_tpu.obs.events import load_events  # noqa: E402
+
+# categories whose events headline the timeline (the rest still show,
+# but these carry the run's SHAPE)
+_MARKS = {
+    "fault": "FAULT",
+    "anomaly": "ANOMALY",
+    "profile": "PROFILE",
+    "sentinel": "SENTINEL",
+    "elastic": "ELASTIC",
+    "preempt": "PREEMPT",
+    "lifecycle": "",
+    "ckpt": "",
+}
+
+# event (category, name) pairs that count as RECOVERY for chain-building
+_RECOVERIES = {
+    ("sentinel", "rewind"),
+    ("elastic", "restart"),
+    ("ckpt", "restore"),
+    ("ckpt", "restore_tier"),
+    ("preempt", "sigterm"),
+}
+
+
+def _fmt_detail(detail: dict, limit: int = 72) -> str:
+    if not detail:
+        return ""
+    parts = []
+    for k, v in detail.items():
+        if k == "summary":
+            continue  # multi-line xplane text: referenced, not inlined
+        parts.append(f"{k}={v}")
+    text = " ".join(parts)
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
+def timeline_lines(events: list[dict], width: int = 48) -> list[str]:
+    """Chronological one-line-per-event view; the middle is elided past
+    ``width`` lines (first/last matter most — init and the outage)."""
+    if not events:
+        return ["timeline: no journaled events (obs.events off, or a "
+                "pre-journal run)"]
+    t0 = events[0].get("ts", 0.0)
+    rows = []
+    for e in events:
+        mark = _MARKS.get(e.get("category", ""), "")
+        step = e.get("step")
+        rows.append(
+            f"  +{e.get('ts', 0.0) - t0:9.3f}s {e.get('host', '?'):>8} "
+            f"g{e.get('gen', '?')} {('step ' + str(step)) if step is not None else '':>9} "
+            f"{(mark or e.get('category', '')):>8} "
+            f"{e.get('name', '')} {_fmt_detail(e.get('detail') or {})}".rstrip())
+    out = [f"timeline ({len(events)} events, "
+           f"{len({e.get('host') for e in events})} writers):"]
+    if len(rows) <= width:
+        out.extend(rows)
+    else:
+        half = width // 2
+        out.extend(rows[:half])
+        out.append(f"  ... {len(rows) - 2 * half} events elided ...")
+        out.extend(rows[-half:])
+    return out
+
+
+def causal_chains(events: list[dict]) -> list[str]:
+    """Pair each anomaly with the capture it opened and the recovery
+    that followed — the journal's whole reason to exist, as text."""
+    anomalies = [e for e in events if e.get("category") == "anomaly"]
+    if not anomalies:
+        return ["chains: no anomalies journaled"]
+    out = [f"anomaly chains ({len(anomalies)}):"]
+    for a in anomalies:
+        ts = a.get("ts", 0.0)
+        host = a.get("host")
+
+        def _capture(name, a=a, ts=ts, host=host):
+            # Only a capture the anomaly actually OPENED counts as its
+            # capture: the reason journaled at capture time carries the
+            # trigger kind, so an unrelated cadence window that happens
+            # to close right after the anomaly is not claimed for it.
+            return next(
+                (e for e in events
+                 if e.get("category") == "profile"
+                 and e.get("name") == name
+                 and e.get("host") == host and e.get("ts", 0.0) >= ts
+                 and (e.get("detail") or {}).get("reason")
+                 == a.get("name")), None)
+
+        capture = _capture("capture_end") or _capture("capture_start")
+        recovery = next(
+            (e for e in events
+             if (e.get("category"), e.get("name")) in _RECOVERIES
+             and e.get("ts", 0.0) >= ts), None)
+        line = (f"  {a.get('name')}@step {a.get('step')} [{host}] "
+                f"{_fmt_detail(a.get('detail') or {}, 40)}")
+        if capture is not None:
+            d = capture.get("detail") or {}
+            line += (f" -> capture {os.path.basename(str(d.get('dir', '?')))}"
+                     f" ({capture.get('name')})")
+        else:
+            line += " -> no capture (profile_on_anomaly off / cooldown)"
+        if recovery is not None:
+            line += (f" -> {recovery.get('category')}.{recovery.get('name')}"
+                     f"@step {recovery.get('step')} "
+                     f"{_fmt_detail(recovery.get('detail') or {}, 32)}")
+        else:
+            line += " -> no recovery event"
+        out.append(line)
+    return out
+
+
+def counts_section(events: list[dict]) -> list[str]:
+    by_cat: dict[str, int] = {}
+    for e in events:
+        by_cat[e.get("category", "?")] = by_cat.get(
+            e.get("category", "?"), 0) + 1
+    gens = sorted({str(e.get("gen")) for e in events})
+    out = [f"event counts (generations seen: {', '.join(gens) or '-'}):"]
+    for cat in sorted(by_cat, key=lambda c: -by_cat[c]):
+        out.append(f"  {cat:<10} {by_cat[cat]:>6}")
+    return out
+
+
+def goodput_line(jsonl_path: str) -> list[str]:
+    if not jsonl_path or not os.path.exists(jsonl_path):
+        return ["goodput: no metrics.jsonl"]
+    last = None
+    try:
+        with open(jsonl_path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if "goodput_pct" in r:
+                    last = r
+    except OSError:
+        return ["goodput: unreadable metrics.jsonl"]
+    if last is None:
+        return ["goodput: no goodput records"]
+    return [f"goodput: {last['goodput_pct']:.1f}% productive "
+            f"(tag={last.get('tag')}, step={last.get('step')}; full "
+            "breakdown in tools/obs_report.py)"]
+
+
+# ------------------------------------------------------------ perfetto out
+def perfetto_trace(events: list[dict], trace_path: str = "") -> dict:
+    """Spans (complete events, pass-through) + journal instants, one
+    process row per host so Perfetto lays the cluster out side by side."""
+    trace_events: list[dict] = []
+    if trace_path and os.path.exists(trace_path):
+        try:
+            with open(trace_path) as f:
+                trace_events = list(json.load(f).get("traceEvents", []))
+        except (ValueError, OSError):
+            pass
+    hosts = sorted({e.get("host", "?") for e in events})
+    # Journal rows get pids ABOVE every pid the span trace already uses
+    # (spans carry real os.getpid() values — often 1 in a container):
+    # a collision would rename the span process and fold two writers'
+    # rows together.
+    used = {int(e["pid"]) for e in trace_events
+            if isinstance(e.get("pid"), (int, float))}
+    base = max(used, default=0) + 1
+    pid_of = {h: base + i for i, h in enumerate(hosts)}
+    for h, pid in pid_of.items():
+        trace_events.append({"name": "process_name", "ph": "M", "pid": pid,
+                             "args": {"name": str(h)}})
+    for e in events:
+        ev = {
+            "name": f"{e.get('category')}.{e.get('name')}",
+            "ph": "i",
+            "s": "g",  # global scope: the instant line spans all rows
+            "ts": e.get("ts", 0.0) * 1e6,
+            "pid": pid_of.get(e.get("host", "?"), 0),
+            "tid": e.get("category", "event"),
+        }
+        args = {k: v for k, v in (e.get("detail") or {}).items()
+                if k != "summary"}
+        if e.get("step") is not None:
+            args["step"] = e["step"]
+        args["gen"] = e.get("gen")
+        ev["args"] = args
+        trace_events.append(ev)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def report(events_dir: str, jsonl_path: str = "",
+           trace_path: str = "") -> str:
+    events = load_events(events_dir)
+    lines = [f"== run timeline: {events_dir} =="]
+    for section in (counts_section(events), goodput_line(jsonl_path),
+                    timeline_lines(events), causal_chains(events)):
+        lines.append("")
+        lines.extend(section)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--run-dir", default="",
+                   help="run directory (events/ + metrics.jsonl + "
+                        "trace.json underneath)")
+    p.add_argument("--events", default="",
+                   help="explicit events directory (default "
+                        "<run-dir>/events)")
+    p.add_argument("--jsonl", default="", help="explicit metrics.jsonl")
+    p.add_argument("--trace", default="", help="explicit trace.json")
+    p.add_argument("--out", default="",
+                   help="also write a merged Chrome/Perfetto trace.json "
+                        "(spans + journal instants) to this path")
+    args = p.parse_args(argv)
+    events_dir = args.events or (os.path.join(args.run_dir, "events")
+                                 if args.run_dir else "")
+    if not events_dir or not os.path.isdir(events_dir):
+        print(f"timeline_report: no events directory at {events_dir!r} "
+              "(--run-dir or --events)", file=sys.stderr)
+        return 2
+    jsonl = args.jsonl or (os.path.join(args.run_dir, "metrics.jsonl")
+                           if args.run_dir else "")
+    trace = args.trace or (os.path.join(args.run_dir, "trace.json")
+                           if args.run_dir else "")
+    print(report(events_dir, jsonl, trace))
+    if args.out:
+        merged = perfetto_trace(load_events(events_dir), trace)
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(f"\nwrote merged Perfetto trace: {args.out} "
+              f"({len(merged['traceEvents'])} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
