@@ -28,10 +28,12 @@ from pytorch_distributed_train_tpu.faults import registry as faults_registry
 from pytorch_distributed_train_tpu.faults import retry as retry_lib
 from pytorch_distributed_train_tpu.obs.spans import span
 from pytorch_distributed_train_tpu.train_state import TrainState
+from pytorch_distributed_train_tpu.utils import compat
 
 
 class CheckpointManager:
-    def __init__(self, ckpt_cfg, config_json: str = ""):
+    def __init__(self, ckpt_cfg, config_json: str = "", *,
+                 run_meta: dict | None = None):
         self.cfg = ckpt_cfg
         path = os.path.abspath(ckpt_cfg.dir)
         os.makedirs(path, exist_ok=True)
@@ -42,6 +44,11 @@ class CheckpointManager:
         )
         self.mgr = ocp.CheckpointManager(path, options=options)
         self.config_json = config_json
+        # Folded into every step's meta JSON. The elastic-reshard plane
+        # records {world, global_batch} here so a resumed generation can
+        # detect a topology change (trainer emits the reshard event) and
+        # refuse a silently-different global batch (docs/elastic.md).
+        self.run_meta = dict(run_meta or {})
 
     # ------------------------------------------------------------------ save
     def save(self, state: TrainState, *, epoch: int = 0, force: bool = False,
@@ -65,7 +72,7 @@ class CheckpointManager:
             self.mgr.wait_until_finished()
             self.mgr.delete(step)
         meta = {"epoch": epoch, "config": self.config_json,
-                **(extra_meta or {})}
+                **self.run_meta, **(extra_meta or {})}
         # The span covers the BLOCKING portion only: under async_save the
         # TensorStore writes continue past it (their tail shows up in
         # checkpoint.wait spans) — exactly the host-stall attribution the
@@ -213,11 +220,11 @@ class CheckpointManager:
         # deserializer; without it PyTreeRestore silently restores every
         # array single-device (an all-gather-to-chip-0 OOM at 7B).
         restore_args = ocp.checkpoint_utils.construct_restore_args(item)
+        # partial restore spelled per installed orbax (partial_restore=
+        # kwarg vs the legacy transforms={} idiom) — utils/compat.py.
         return ckptr.restore(
             item_dir,
-            args=ocp.args.PyTreeRestore(item=item,
-                                        restore_args=restore_args,
-                                        partial_restore=True),
+            args=compat.pytree_restore_args(ocp, item, restore_args),
         )
 
     def restore_params_only(self, abstract_params: Any,
@@ -233,11 +240,11 @@ class CheckpointManager:
         """Top-level keys of the saved state tree at ``step`` (read from
         the item's own pytree metadata — the manager's item_metadata needs
         a handler registry this codepath doesn't keep), or None when the
-        metadata cannot be read."""
+        metadata cannot be read. Metadata SHAPE differs per orbax
+        version (utils/compat.py)."""
         try:
-            meta = ocp.PyTreeCheckpointer().metadata(
-                os.path.join(self.dir, str(step), "state"))
-            return set(dict(meta.item_metadata.tree).keys())
+            return compat.pytree_metadata_keys(
+                ocp, os.path.join(self.dir, str(step), "state"))
         except Exception:
             return None
 

@@ -52,6 +52,20 @@ from pytorch_distributed_train_tpu.obs.spans import span
 _MS_BUCKETS = tuple(0.5 * 2 ** i for i in range(20))  # 0.5ms .. ~262s
 
 
+def _default_host_id() -> int:
+    """This host's peer-plane identity. A tpurun gang of SINGLE-process
+    jax runtimes (the CPU drills; one-runtime-per-host deployments) has
+    jax.process_index()==0 on EVERY worker — publishing under it would
+    collide all hosts onto one store slot. The launcher env rank is the
+    truth whenever the launcher world is wider than the jax one."""
+    from pytorch_distributed_train_tpu.elastic import elastic_world
+
+    world, rank = elastic_world()
+    if world > jax.process_count():
+        return rank
+    return jax.process_index()
+
+
 def hot_dir_for(ckpt_cfg, host: int) -> str:
     """Per-host local spill directory: hosts must not share one (their
     shards differ and a dying host's half-spill must not shadow a
@@ -64,18 +78,19 @@ def hot_dir_for(ckpt_cfg, host: int) -> str:
 class TieredCheckpointManager:
     def __init__(self, ckpt_cfg, config_json: str = "", *,
                  goodput=None, store=None, host_id: int | None = None,
-                 peer_hosts=None):
+                 peer_hosts=None, run_meta: dict | None = None):
         self.cfg = ckpt_cfg
         # The inner Orbax manager always saves SYNCHRONOUSLY: asynchrony
         # lives in our persister thread, and stacking Orbax's async
         # machinery under it would leave wait() with two queues to
         # reason about.
         self.persistent = checkpoint_lib.CheckpointManager(
-            dataclasses.replace(ckpt_cfg, async_save=False), config_json)
+            dataclasses.replace(ckpt_cfg, async_save=False), config_json,
+            run_meta=run_meta)
         self.dir = self.persistent.dir
         self.goodput = goodput
         self.host = int(host_id if host_id is not None
-                        else jax.process_index())
+                        else _default_host_id())
         self._peer_hosts = peer_hosts
         self._store = store
         self._store_resolved = store is not None
@@ -113,9 +128,20 @@ class TieredCheckpointManager:
         return self._store
 
     def _hosts(self):
+        """Host ids that may have published peer snapshots. After an
+        elastic SHRINK the current world is smaller than the one that
+        published — enumerate the job's MAXIMUM world (the agent's
+        elastic/world_max store key), so a lost host's still-stored
+        snapshot stays reachable from its old rank."""
         if self._peer_hosts is not None:
             return list(self._peer_hosts)
-        return list(range(jax.process_count()))
+        from pytorch_distributed_train_tpu.elastic import (
+            elastic_world,
+            store_world_max,
+        )
+
+        fallback = max(jax.process_count(), elastic_world()[0])
+        return list(range(store_world_max(self._get_store(), fallback)))
 
     # ----------------------------------------------------------------- save
     def _known_steps(self) -> set[int]:
@@ -163,11 +189,21 @@ class TieredCheckpointManager:
             self.ram.evict(step)
             if self.disk is not None:
                 self.disk.evict(step)
-        meta = {"epoch": int(epoch), **(extra_meta or {})}
+        # run_meta (world/global_batch bookkeeping) rides the snapshot
+        # header too: a hot-tier restore must detect a reshard exactly
+        # like an Orbax one.
+        meta = {"epoch": int(epoch), **self.persistent.run_meta,
+                **(extra_meta or {})}
         if self._snapshot_unsupported:
             # Sticky from the first failure: a multi-host job whose
             # arrays span hosts must not re-copy gigabytes host-side
-            # and re-fail at every save boundary.
+            # and re-fail at every save boundary. The peer tier still
+            # exists for it: each host publishes only the SHARDS it
+            # owns (snapshot.take_shard_snapshot), and a restoring
+            # survivor reassembles the global leaves from every host's
+            # payload — the elastic-reshard fast path that skips the
+            # Orbax round-trip.
+            self._publish_shards(state, step=step, epoch=epoch, meta=meta)
             return self.persistent.save(
                 state, epoch=epoch, force=force, step=step,
                 overwrite=overwrite, extra_meta=extra_meta)
@@ -189,6 +225,10 @@ class TieredCheckpointManager:
             print(f"[ckpt] snapshot of step {step} not host-addressable "
                   f"({type(e).__name__}: {e}); saving synchronously",
                   flush=True)
+            # Publish shards on THIS save too, not only the sticky
+            # branch: a host lost before the next boundary must find
+            # the first fallback step on the peer plane as well.
+            self._publish_shards(state, step=step, epoch=epoch, meta=meta)
             return self.persistent.save(
                 state, epoch=epoch, force=force, step=step,
                 overwrite=overwrite, extra_meta=extra_meta)
@@ -257,6 +297,34 @@ class TieredCheckpointManager:
         events_lib.emit("ckpt", "persist", step=snap.step,
                         persist_ms=round(persist_ms, 3))
         self._gc()
+
+    def _publish_shards(self, state, *, step, epoch, meta) -> None:
+        """Best-effort per-host shard publication for states the full
+        snapshot cannot copy (multi-host GSPMD). Synchronous but small:
+        only this host's owned shards are serialized."""
+        if not getattr(self.cfg, "peer_fetch", True):
+            return
+        store = self._get_store()
+        if store is None:
+            return
+        try:
+            savable = checkpoint_lib._savable(state)
+            cap = getattr(self.cfg, "peer_publish_max_bytes", 64 << 20)
+            if snapshot_lib.owned_shard_nbytes(savable) > cap:
+                # Pre-filter on raw bytes (the npz payload is never
+                # smaller), same as _maybe_publish: a 7B-scale run in
+                # this branch must not pay device→host copies + encode
+                # on EVERY save boundary just to discard the payload.
+                return
+            payload, header = snapshot_lib.take_shard_snapshot(
+                savable, step=step, epoch=epoch, meta=meta,
+                origin=self.dir)
+            if len(payload) > cap:
+                return
+            peer.publish(store, self.host, header, payload)
+        except Exception as e:
+            print(f"[ckpt] shard publish of step {step} failed "
+                  f"({type(e).__name__}: {e}); continuing", flush=True)
 
     def _maybe_publish(self, snap: snapshot_lib.Snapshot) -> None:
         if not getattr(self.cfg, "peer_fetch", True):
@@ -461,7 +529,7 @@ class TieredCheckpointManager:
             return None
         try:
             fetched = retry_lib.retry_call(
-                lambda: peer.fetch(store, step, self._hosts()),
+                lambda: peer.fetch_state(store, step, self._hosts()),
                 point="ckpt.peer_fetch")
         except OSError as e:
             print(f"[ckpt] peer fetch of step {step} failed after "
@@ -470,7 +538,14 @@ class TieredCheckpointManager:
             return None
         if fetched is None:
             return None
-        payload, header = fetched
+        kind, data, header = fetched
+        if kind == "leaves":
+            # shard publications, reassembled + CRC-verified by
+            # peer.fetch_state (elastic reshard: the assembly is
+            # mesh-agnostic, _place_leaves reshards into the template)
+            return self._place_leaves(abstract_state, template, data,
+                                      header)
+        payload = data
         if not snapshot_lib.verify_payload(payload, header):
             self._corrupt_counter().inc()
             return None
@@ -545,10 +620,11 @@ class TieredCheckpointManager:
 
 
 def build_checkpoint_manager(ckpt_cfg, config_json: str = "", *,
-                             goodput=None):
+                             goodput=None, run_meta: dict | None = None):
     """``checkpoint.tiered`` selects the plane; every caller (trainer,
     tools) goes through here so the flag is the only divergence point."""
     if getattr(ckpt_cfg, "tiered", False):
         return TieredCheckpointManager(ckpt_cfg, config_json,
-                                       goodput=goodput)
-    return checkpoint_lib.CheckpointManager(ckpt_cfg, config_json)
+                                       goodput=goodput, run_meta=run_meta)
+    return checkpoint_lib.CheckpointManager(ckpt_cfg, config_json,
+                                            run_meta=run_meta)

@@ -79,35 +79,30 @@ def publish(store, host: int, header: dict, payload: bytes,
              "peer store").set(step)
 
 
-def fetch(store, step: int, hosts, *, self_host: int | None = None,
-          chunk_timeout_ms: int = 10_000) -> tuple[bytes, dict] | None:
-    """(payload, header) for ``step`` from the first peer advertising
-    it, or None. CRC-verified end to end; a corrupt transfer reads as
-    "not found" and the caller falls back to Orbax."""
-    faults_registry.maybe_fire("ckpt.peer_fetch", step=step)
-    for host in hosts:
-        if self_host is not None and int(host) == int(self_host):
-            continue
-        try:
-            meta = json.loads(
-                store.get(_meta_key(host), timeout_ms=50).decode())
-        except Exception:
-            continue  # host never published / key expired with the store
-        if int(meta.get("step", -1)) != int(step) or not meta.get("sealed"):
-            continue
-        chunks = []
-        try:
-            for i in range(int(meta["n_chunks"])):
-                chunks.append(store.get(_chunk_key(host, step, i),
-                                        timeout_ms=chunk_timeout_ms))
-        except Exception:
-            continue  # racing a re-publish; try the next peer
-        payload = b"".join(chunks)
-        if (len(payload) != int(meta["payload_bytes"])
-                or zlib.crc32(payload) != int(meta["payload_crc32"])):
-            continue
-        return payload, meta
-    return None
+def _fetch_host(store, host: int, step: int,
+                chunk_timeout_ms: int) -> tuple[bytes, dict] | None:
+    """One host's (payload, header) for ``step`` — complete and
+    chunk-consistent — or None. CRC-verified end to end; a corrupt
+    transfer reads as "not found"."""
+    try:
+        meta = json.loads(
+            store.get(_meta_key(host), timeout_ms=50).decode())
+    except Exception:
+        return None  # host never published / key expired with the store
+    if int(meta.get("step", -1)) != int(step) or not meta.get("sealed"):
+        return None
+    chunks = []
+    try:
+        for i in range(int(meta["n_chunks"])):
+            chunks.append(store.get(_chunk_key(host, step, i),
+                                    timeout_ms=chunk_timeout_ms))
+    except Exception:
+        return None  # racing a re-publish
+    payload = b"".join(chunks)
+    if (len(payload) != int(meta["payload_bytes"])
+            or zlib.crc32(payload) != int(meta["payload_crc32"])):
+        return None
+    return payload, meta
 
 
 def advertised_steps(store, hosts) -> dict[int, int]:
@@ -122,3 +117,38 @@ def advertised_steps(store, hosts) -> dict[int, int]:
         except Exception:
             continue
     return out
+
+
+def fetch_state(store, step: int, hosts, *,
+                chunk_timeout_ms: int = 10_000):
+    """Restore-side entry for the elastic-reshard plane: the newest
+    publication of ``step``, whatever its wire format.
+
+    Returns ``("full", payload, header)`` when any host published the
+    whole-leaves snapshot (single-host-addressable jobs — the common
+    case; the FIRST verified full payload returns immediately, one
+    host's download), or ``("leaves", leaves, header)`` when hosts
+    published SHARD payloads (multi-host GSPMD): every advertising
+    host's pieces — including a dead host's, whose chunks outlive it on
+    the store — are CRC-verified and reassembled into global
+    flatten-order leaves, ready to device_put into ANY mesh's
+    shardings. None when neither path yields a complete, verified
+    state."""
+    from pytorch_distributed_train_tpu.ckpt import snapshot as snapshot_lib
+
+    faults_registry.maybe_fire("ckpt.peer_fetch", step=step)
+    shard_payloads = []
+    for host in hosts:
+        got = _fetch_host(store, host, step, chunk_timeout_ms)
+        if got is None:
+            continue
+        payload, header = got
+        if header.get("shard_format") == 1:
+            shard_payloads.append((payload, header))
+        else:
+            return "full", payload, header
+    if shard_payloads:
+        assembled = snapshot_lib.assemble_shards(shard_payloads)
+        if assembled is not None:
+            return "leaves", assembled[0], assembled[1]
+    return None

@@ -171,3 +171,144 @@ def verify_payload(payload: bytes, header: dict) -> bool:
 
 def header_json(snap: Snapshot) -> bytes:
     return json.dumps(snapshot_meta(snap), sort_keys=True).encode()
+
+
+# ----------------------------------------------------- shard wire format
+#
+# Elastic resharding (docs/elastic.md): a multi-host GSPMD job's arrays
+# span hosts, so no single host can take (or publish) the full-leaf
+# snapshot above. Instead each host ships only the array shards it OWNS
+# (addressable + replica_id 0 — exactly one owner per global element),
+# and a restoring host reassembles the GLOBAL leaves from every host's
+# payload — including a dead host's, whose chunks outlive it on the
+# launcher store — then device_puts them into the NEW mesh's shardings.
+# Wire layout: ``part_<k>`` npz entries plus a header carrying
+#
+#     shard_format: 1
+#     leaves:  [{shape, dtype}, ...]          # global, flatten order
+#     parts:   [{leaf, start, crc}, ...]      # this payload's pieces
+#
+# Assembly verifies per-part CRCs and full element coverage — a missing
+# host reads as "incomplete", never as silently-zeroed state.
+
+
+def owned_shard_nbytes(savable: dict, owned=None) -> int:
+    """Raw bytes ``take_shard_snapshot`` would copy host-side for THIS
+    host — the npz payload is never smaller, so callers pre-filter the
+    publish cap on it WITHOUT paying the device→host copies + encode
+    (``.nbytes`` on a device shard is metadata, not a transfer)."""
+    if owned is None:
+        owned = lambda shard: shard.replica_id == 0  # noqa: E731
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(savable):
+        if hasattr(leaf, "addressable_shards"):
+            total += sum(int(s.data.nbytes)
+                         for s in leaf.addressable_shards if owned(s))
+        else:
+            total += int(np.asarray(leaf).nbytes)
+    return total
+
+
+def take_shard_snapshot(savable: dict, *, step: int, epoch: int = 0,
+                        meta: dict | None = None, origin: str = "",
+                        owned=None) -> tuple[bytes, dict]:
+    """(payload, sealed header) holding THIS host's owned shards of a
+    ``checkpoint._savable`` dict. ``owned`` overrides the ownership
+    predicate (tests simulate hosts by partitioning device ids);
+    the default owns addressable replica-0 shards."""
+    if owned is None:
+        owned = lambda shard: shard.replica_id == 0  # noqa: E731
+    leaves = jax.tree_util.tree_leaves(savable)
+    index: list[dict] = []
+    shapes: list[dict] = []
+    parts: list[np.ndarray] = []
+    for i, leaf in enumerate(leaves):
+        shapes.append({"shape": list(getattr(leaf, "shape", ())),
+                       "dtype": str(np.dtype(leaf.dtype))})
+        if hasattr(leaf, "addressable_shards"):
+            for shard in leaf.addressable_shards:
+                if not owned(shard):
+                    continue
+                data = np.asarray(shard.data)
+                start = [0 if s.start is None else int(s.start)
+                         for s in shard.index]
+                start += [0] * (data.ndim - len(start))
+                parts.append(data)
+                index.append({"leaf": i, "start": start,
+                              "crc": _leaf_crc(data)})
+        else:
+            # host-resident leaf (numpy/scalar): one full-cover part —
+            # every publisher owns it; assembly tolerates identical
+            # overlap via the coverage mask
+            data = np.asarray(leaf)
+            parts.append(data)
+            index.append({"leaf": i, "start": [0] * data.ndim,
+                          "crc": _leaf_crc(data)})
+    buf = io.BytesIO()
+    np.savez(buf, **{f"part_{k}": p for k, p in enumerate(parts)})
+    header = {
+        "step": int(step), "epoch": int(epoch), "meta": dict(meta or {}),
+        "origin": origin, "created_at": time.time(), "sealed": True,
+        "shard_format": 1, "leaves": shapes, "parts": index,
+    }
+    return buf.getvalue(), header
+
+
+def verify_shard_payload(payload: bytes, header: dict) -> bool:
+    """Per-part CRC check of one host's shard payload."""
+    if not header.get("sealed") or header.get("shard_format") != 1:
+        return False
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            parts = [z[f"part_{k}"] for k in range(len(z.files))]
+    except Exception:
+        return False
+    idx = header.get("parts") or []
+    if len(parts) != len(idx):
+        return False
+    return all(_leaf_crc(p) == rec["crc"] for p, rec in zip(parts, idx))
+
+
+def assemble_shards(fetched: list[tuple[bytes, dict]]
+                    ) -> tuple[list[np.ndarray], dict] | None:
+    """Rebuild GLOBAL flatten-order leaves from every host's (payload,
+    header). None when headers disagree, any part fails its CRC, or
+    coverage is incomplete (a host's shards are missing and nobody else
+    owned those elements) — the caller falls back a tier."""
+    if not fetched:
+        return None
+    ref = fetched[0][1]
+    shapes = ref.get("leaves") or []
+    if not shapes or ref.get("shard_format") != 1:
+        return None
+    leaves = [np.zeros(tuple(s["shape"]), np.dtype(s["dtype"]))
+              for s in shapes]
+    masks = [np.zeros(tuple(s["shape"]), bool) for s in shapes]
+    for payload, header in fetched:
+        if (header.get("shard_format") != 1
+                or header.get("leaves") != shapes
+                or header.get("step") != ref.get("step")):
+            return None
+        if not verify_shard_payload(payload, header):
+            return None
+        with np.load(io.BytesIO(payload)) as z:
+            parts = [z[f"part_{k}"] for k in range(len(z.files))]
+        for part, rec in zip(parts, header["parts"]):
+            i = int(rec["leaf"])
+            if not 0 <= i < len(leaves):
+                return None
+            sl = tuple(slice(s, s + n)
+                       for s, n in zip(rec["start"], part.shape))
+            if part.ndim != leaves[i].ndim:
+                if part.ndim == 0 and leaves[i].ndim == 0:
+                    sl = ()
+                else:
+                    return None
+            try:
+                leaves[i][sl] = part
+                masks[i][sl] = True
+            except (ValueError, IndexError):
+                return None
+    if not all(m.all() for m in masks):
+        return None  # incomplete coverage: someone's shards are missing
+    return leaves, dict(ref)

@@ -170,6 +170,15 @@ class DataConfig:
     # "" | "inverse_class" — torch WeightedRandomSampler recipe: train-time
     # draws WITH replacement ∝ 1/class-frequency (array datasets w/ labels)
     weighted_sampling: str = ""
+    # Elastic resharding (docs/elastic.md): shard the input stream by the
+    # LAUNCHER world (NUM_PROCESSES / PROCESS_ID — elastic.elastic_world)
+    # instead of the jax process world. For tpurun gangs whose workers
+    # are single-process jax runtimes (the CPU drills; one-runtime-per-
+    # host deployments): a degraded generation then recomputes per-host
+    # shards from the SHRUNKEN world mid-epoch — the global batch stays
+    # fixed, per-host batch rescales, and the union of all hosts' batch
+    # b is the same global index set at any world size.
+    elastic_shards: bool = False
     # Batch augmentation (device-side, ops/mixup.py — the torchvision/timm
     # --mixup-alpha/--cutmix-alpha recipe knobs); 0.0 disables.
     mixup_alpha: float = 0.0

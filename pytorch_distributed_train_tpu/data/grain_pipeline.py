@@ -240,12 +240,18 @@ class GrainHostDataLoader:
     def _sampler(self, epoch: int):
         import grain.python as gp
 
+        # UNSHARDED on purpose (elastic resharding, docs/elastic.md):
+        # grain's ShardOptions splits the record range into CONTIGUOUS
+        # blocks and shuffles within each, so the set of records behind
+        # global batch b would change with shard_count — a gang that
+        # shrinks mid-epoch could then replay or skip records. One
+        # GLOBAL shuffle (seed+epoch) with hosts taking strided
+        # positions keeps the union of all hosts' batch b equal to the
+        # same global slice at ANY world size, which is exactly the
+        # invariant the mid-epoch start_batch fast-forward assumes.
         return gp.IndexSampler(
             num_records=len(self.dataset),
-            shard_options=gp.ShardOptions(
-                shard_index=self.host_id, shard_count=self.num_hosts,
-                drop_remainder=True,
-            ),
+            shard_options=gp.NoSharding(),
             shuffle=self.shuffle,
             # per-epoch reshuffle ≡ DistributedSampler.set_epoch (C16)
             seed=self.seed + epoch,
@@ -260,7 +266,11 @@ class GrainHostDataLoader:
         ~O(n) python at iterator construction, overlapped with compile
         by the producer thread). An explicit order array is what lets
         batching live in the SOURCE (see _BatchIndexSource) and resume
-        slice at exact batch boundaries."""
+        slice at exact batch boundaries. Host h takes positions
+        h, h+world, ... of the GLOBAL shuffled stream (the
+        DistributedSampler stride, C16), so the per-host order is a
+        pure function of (seed, epoch, world, host) — shard_count
+        changes reshard the SAME epoch-global order."""
         if self.weighted is not None:
             self.weighted.set_epoch(epoch)
             n = self.steps_per_epoch * self.host_batch
@@ -268,11 +278,6 @@ class GrainHostDataLoader:
         sampler = self._sampler(epoch)
         n = min(self.steps_per_epoch * self.host_batch,
                 len(self.dataset) // self.num_hosts)
-        # Sharded IndexSamplers are indexed by GLOBAL stream position:
-        # shard s owns positions s, s+shard_count, ... (contiguous
-        # indexing silently REPEATS records — verified against grain
-        # 0.2.15, and the root of a multi-host resume bug in the
-        # pre-round-5 path).
         return np.fromiter(
             (sampler[self.host_id + k * self.num_hosts].record_key
              for k in range(n)), np.int64, count=n)

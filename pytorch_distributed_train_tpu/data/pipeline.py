@@ -285,7 +285,9 @@ def device_prefetch(host_batches: Iterator[dict], mesh, batch_axes=("data", "fsd
 
 
 def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
-                         batch_axes=("data", "fsdp"), sync_check_every: int = 0):
+                         batch_axes=("data", "fsdp"), sync_check_every: int = 0,
+                         num_hosts: int | None = None,
+                         host_id: int | None = None):
     """Convenience: loader + producer thread + device prefetch.
 
     Returns (loader, epoch_fn) where epoch_fn(epoch) yields device-resident
@@ -294,15 +296,20 @@ def build_input_pipeline(dataset, data_cfg, mesh, *, train: bool,
     array assembly — after assembly all hosts see identical global shapes by
     construction, so checking there would be vacuous. The check runs on the
     consumer thread (collectives must not race the step's collectives).
+    ``num_hosts``/``host_id`` override the jax process world for the
+    loader's sharding — the elastic-reshard path (``data.elastic_shards``)
+    passes the LAUNCHER world here, recomputed per restart generation.
     """
     if getattr(data_cfg, "loader", "threads") == "grain":
         from pytorch_distributed_train_tpu.data.grain_pipeline import (
             GrainHostDataLoader,
         )
 
-        loader = GrainHostDataLoader(dataset, data_cfg, train=train)
+        loader = GrainHostDataLoader(dataset, data_cfg, train=train,
+                                     num_hosts=num_hosts, host_id=host_id)
     else:
-        loader = HostDataLoader(dataset, data_cfg, train=train)
+        loader = HostDataLoader(dataset, data_cfg, train=train,
+                                num_hosts=num_hosts, host_id=host_id)
     # read by the trainer's log window; mirrored to /metrics by split
     loader.stall_stats = StallStats(split="train" if train else "eval")
 

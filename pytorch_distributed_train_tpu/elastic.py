@@ -33,6 +33,7 @@ barriers (the same role c10d's store plays for init handshakes).
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import signal
 import socket
@@ -113,6 +114,78 @@ def worker_cache_dir(base: str, rank) -> str:
     return os.path.join(base, f"worker_{rank}")
 
 
+# Store keys of the elastic world plane (docs/elastic.md): the agent
+# publishes each generation's membership and the job's maximum world so
+# workers can reshard data/checkpoints without parsing launcher logs.
+WORLD_KEY_PREFIX = "elastic/world/"
+WORLD_MAX_KEY = "elastic/world_max"
+
+
+def elastic_world() -> tuple[int, int]:
+    """(world, rank) of THIS restart generation from the launcher env
+    contract (``NUM_PROCESSES`` / ``PROCESS_ID``); (1, 0) outside
+    tpurun (both vars absent). This is the worker-side source of truth
+    for elastic data sharding (``data.elastic_shards``): a degraded
+    generation's env already carries the SHRUNKEN world and the
+    re-densified rank.
+
+    A PRESENT but inconsistent contract (non-numeric, or a stale rank
+    outside [0, world)) raises: silently treating it as a 1-host world
+    would make this host train on the FULL dataset and global batch
+    while its peers shard theirs — duplicated records and a skewed
+    effective batch with no error anywhere."""
+    w = os.environ.get("NUM_PROCESSES")
+    r = os.environ.get("PROCESS_ID")
+    if w is None and r is None:
+        return 1, 0
+    if w is None or r is None:
+        # Half a contract is no contract: defaulting the missing var
+        # would silently put every host on rank 0 (or world 1).
+        raise RuntimeError(
+            f"corrupt launcher env contract: NUM_PROCESSES={w!r} "
+            f"PROCESS_ID={r!r} must be set together")
+    try:
+        world = int(w)
+        rank = int(r)
+    except ValueError as e:
+        raise RuntimeError(
+            f"corrupt launcher env contract: NUM_PROCESSES={w!r} "
+            f"PROCESS_ID={r!r} must both be integers") from e
+    if world < 1 or not 0 <= rank < world:
+        raise RuntimeError(
+            f"corrupt launcher env contract: PROCESS_ID={rank} outside "
+            f"[0, NUM_PROCESSES={world}) — a stale env from an earlier "
+            "generation?")
+    return world, rank
+
+
+def store_world_max(store, default: int = 0) -> int:
+    """The job's gen-0 world size, read back from the launcher store
+    (``WORLD_MAX_KEY``), or ``default`` when absent/unreachable. Host
+    ids are dense, so ``range(store_world_max(...))`` enumerates every
+    rank that could EVER have published a peer snapshot — including
+    ranks lost to a shrink."""
+    if store is None:
+        return default
+    try:
+        return max(default, int(store.get(WORLD_MAX_KEY,
+                                          timeout_ms=50).decode()))
+    except Exception:
+        return default
+
+
+def store_world(store, gen: int) -> dict | None:
+    """The membership record the agent published for generation ``gen``
+    (``_publish_world``), or None."""
+    if store is None:
+        return None
+    try:
+        return json.loads(store.get(f"{WORLD_KEY_PREFIX}{int(gen)}",
+                                    timeout_ms=50).decode())
+    except Exception:
+        return None
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
@@ -158,6 +231,13 @@ class ElasticAgent:
             with StoreClient("127.0.0.1", self.store_port) as c:
                 c.set("coord", f"{self.cfg.master_addr}:{self.coord_port}"
                       .encode())
+                # The job's MAXIMUM world (gen-0 size): host ids are
+                # dense in [0, world_max), so a restoring worker after a
+                # shrink can still enumerate peer-store snapshots that
+                # were published under the OLD (larger) world's ranks —
+                # ckpt/peer.py reads this through store_world_max().
+                c.set(WORLD_MAX_KEY,
+                      str(self.cfg.nnodes * self.cfg.nprocs).encode())
         else:
             from pytorch_distributed_train_tpu.native.store import StoreClient
 
@@ -198,6 +278,34 @@ class ElasticAgent:
                    nprocs=cfg.nprocs)
         self._log(f"spawned {cfg.nprocs} workers (gen {restart_gen}, "
                   f"world {world}, coord :{self.coord_port})")
+
+    def _publish_world(self, rnd: int, members: list[int],
+                       nprocs: int) -> None:
+        """Node 0: publish this generation's world to the store
+        (``elastic/world/<gen>``) BEFORE spawning it — elastic
+        resharding's contract that workers (and post-mortem tools) can
+        read what the gang believed the world was, per generation,
+        without parsing launcher logs. Best-effort: supervision never
+        dies of a store hiccup."""
+        if self.cfg.node_rank != 0:
+            return
+        try:
+            from pytorch_distributed_train_tpu.native.store import (
+                StoreClient,
+            )
+
+            c = self.agent_client
+            transient = c is None
+            if transient:  # single-node job: no agent↔agent client
+                c = StoreClient("127.0.0.1", self.store_port)
+            c.set(f"{WORLD_KEY_PREFIX}{rnd}", json.dumps(
+                {"gen": rnd, "nodes": len(members), "nprocs": nprocs,
+                 "world": len(members) * nprocs,
+                 "members": list(members)}, sort_keys=True).encode())
+            if transient:
+                c.close()
+        except Exception:
+            pass
 
     def _kill_all(self) -> None:
         """SIGTERM every live worker, then escalate to SIGKILL for any
@@ -293,6 +401,11 @@ class ElasticAgent:
                 self._last_gen = rnd
                 self._world_nodes = len(members)
                 self._members = members
+                self._publish_world(rnd, members, cfg.nprocs)
+                if len(members) != cfg.nnodes:
+                    self._emit("reshard", gen=rnd, nodes=len(members),
+                               of=cfg.nnodes,
+                               world=len(members) * cfg.nprocs)
                 t_spawn = time.time()
                 self._spawn(rnd, len(members), node_index)
                 rc = self._monitor(rnd)

@@ -56,6 +56,11 @@ POINTS: dict[str, str] = {
     "serve.handler": "raise",    # HTTP request handler (tools/serve_http)
     "step.crash": "exit",        # hard process kill between steps
     "step.straggle": "sleep",    # transient slow step (straggler)
+    "elastic.shrink": "exit",    # permanent host loss (rc 45): under a
+                                 # min_nnodes launcher whose node has no
+                                 # restart budget, the gang re-rendezvouses
+                                 # DEGRADED and resumes resharded —
+                                 # docs/elastic.md shrink drill
     "preempt.sigterm": "sigterm",  # scheduler preemption drill
     # Sentinel drill points (sentinel/; docs/sentinel.md). "flag" points
     # only RETURN True — the call site performs the corruption, because
@@ -119,6 +124,11 @@ def parse_spec(spec: str) -> FaultSpec:
             f"fault spec {spec!r}: unknown point {point!r} "
             f"(points: {sorted(POINTS)})")
     out = FaultSpec(point=point)
+    if point == "elastic.shrink":
+        # Distinct default rc: a supervising drill (tools/chaos_soak.py
+        # --shrink) tells "host permanently lost" apart from step.crash's
+        # generic 41. rc= in the spec still overrides.
+        out.rc = 45
     for part in filter(None, (p.strip() for p in rest.split(":"))):
         if "=" not in part:
             raise ValueError(f"fault spec {spec!r}: bad clause {part!r}")

@@ -82,6 +82,27 @@ def validate_spec(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh
     return PartitionSpec(*out)
 
 
+def replication_fallback_dims(spec: PartitionSpec, shape: tuple[int, ...],
+                              sizes: dict[str, int]) -> list[int]:
+    """Dims of ``shape`` that a mesh with the given axis sizes could NOT
+    shard as ``spec`` asks — ``validate_spec`` would replicate them.
+
+    The dict-of-sizes twin of ``validate_spec``: the elastic-reshard
+    feasibility question ("can this checkpoint restore onto mesh X?",
+    tools/ckpt_inspect.py --mesh) must be answerable WITHOUT
+    constructing a jax Mesh, whose device grid needs the target
+    machine's actual devices."""
+    out = []
+    for i, entry in enumerate(list(spec)[: len(shape)]):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = int(np.prod([sizes.get(a, 1) for a in axes]))
+        if size > 1 and shape[i] % size != 0:
+            out.append(i)
+    return out
+
+
 def path_name(path) -> str:
     """'/'-joined readable name for a jax key path."""
     parts = []

@@ -191,17 +191,46 @@ class Trainer:
                     cfg.distill.temperature)
         self.rules = rules_for_model(cfg.model.name)
 
+        # ---- elastic world (docs/elastic.md): with data.elastic_shards
+        # the LAUNCHER env (NUM_PROCESSES / PROCESS_ID) — not the jax
+        # process world — decides the data sharding, so a degraded
+        # tpurun generation reshards the input stream to the surviving
+        # hosts. The GLOBAL batch stays fixed (per-host batch rescales);
+        # the loaders reject a world the batch cannot divide by.
+        self.data_world: tuple[int, int] | None = None
+        if cfg.data.elastic_shards:
+            from pytorch_distributed_train_tpu.elastic import elastic_world
+
+            self.data_world = elastic_world()
+            if self.data_world[0] < jax.process_count():
+                # elastic_world() == (1, 0) here means the env contract
+                # is ABSENT (valid alone, catastrophic combined with a
+                # multi-process jax world: every host would load the
+                # full global batch — silent record duplication).
+                raise RuntimeError(
+                    f"data.elastic_shards: launcher world "
+                    f"{self.data_world[0]} < jax process world "
+                    f"{jax.process_count()} — the NUM_PROCESSES/"
+                    "PROCESS_ID env contract is missing or stale; "
+                    "sharding by it would duplicate records across "
+                    "hosts")
+        self.world = (self.data_world[0] if self.data_world is not None
+                      else jax.process_count())
+
         # ---- data
+        dw = self.data_world or (None, None)
         self.train_ds = build_dataset(cfg.data, cfg.model, train=True)
         self.train_loader, self.train_epoch_fn = build_input_pipeline(
             self.train_ds, cfg.data, self.mesh, train=True,
             batch_axes=self.batch_axes,
             sync_check_every=cfg.obs.check_input_sync_every,
+            num_hosts=dw[0], host_id=dw[1],
         )
         self.eval_ds = build_dataset(cfg.data, cfg.model, train=False)
         self.eval_loader, self.eval_epoch_fn = build_input_pipeline(
             self.eval_ds, cfg.data, self.mesh, train=False,
             batch_axes=self.batch_axes,
+            num_hosts=dw[0], host_id=dw[1],
         )
 
         # ---- horizon
@@ -284,8 +313,15 @@ class Trainer:
         # snapshot-only blocking at save boundaries, hot RAM/disk/peer
         # restore tiers, back-pressure drain re-attributed to the
         # ckpt.drain goodput bucket.
+        # run_meta: every saved step records the world + global batch it
+        # was trained under, so a resumed generation can tell a reshard
+        # from a plain restart (and refuse a silently-changed global
+        # batch — the one bookkeeping mistake that would corrupt LR/data
+        # semantics without any error).
         self.ckpt = build_checkpoint_manager(
-            cfg.checkpoint, cfg.to_json(), goodput=self.goodput)
+            cfg.checkpoint, cfg.to_json(), goodput=self.goodput,
+            run_meta={"world": self.world,
+                      "global_batch": cfg.data.batch_size})
         self.best_ckpt = (BestCheckpointTracker(cfg.checkpoint, cfg.to_json())
                           if cfg.checkpoint.best_metric else None)
         if (cfg.lora.rank > 0 and cfg.lora.base_checkpoint
@@ -323,6 +359,7 @@ class Trainer:
                 if jax.process_index() == 0:
                     print(f"[resume] restored step {int(self.state.step)} "
                           f"(epoch {self.start_epoch})", flush=True)
+                self._note_reshard(meta)
             elif resume_mode not in ("auto",):
                 raise FileNotFoundError(
                     f"checkpoint.resume={resume_mode!r} has no checkpoint to restore"
@@ -432,11 +469,49 @@ class Trainer:
                       f"{cfg.sentinel.hang_timeout_s}s)", flush=True)
         events_lib.emit("lifecycle", "trainer_init",
                         step=int(self.state.step), resumed=self.resumed,
-                        world=jax.process_count(),
+                        world=self.world,
                         init_s=round(time.perf_counter() - _t_init0, 3))
         self.goodput.account("init", time.perf_counter() - _t_init0)
 
     # ------------------------------------------------------------------ init
+    def _note_reshard(self, meta: dict) -> None:
+        """Elastic reshard bookkeeping at restore time (docs/elastic.md).
+
+        The checkpoint's run_meta says what world/global-batch it was
+        written under. A changed WORLD is the supported reshard: the
+        restore above already re-derived shardings for the new mesh and
+        the loaders already recomputed per-host shards — journal it
+        (the event the acceptance drill and timeline_report look for)
+        and carry on. A changed GLOBAL BATCH under elastic_shards is
+        refused loudly: the documented policy keeps the global batch
+        fixed across generations (per-host batch rescales), because a
+        silently different global batch shifts the LR schedule's
+        step<->data mapping and every union-of-shards guarantee."""
+        saved_world = meta.get("world")
+        saved_gb = meta.get("global_batch")
+        if (self.cfg.data.elastic_shards and saved_gb is not None
+                and int(saved_gb) != int(self.cfg.data.batch_size)):
+            raise ValueError(
+                f"elastic resume with a different GLOBAL batch "
+                f"(checkpoint: {saved_gb}, config: "
+                f"{self.cfg.data.batch_size}): the reshard policy keeps "
+                "the global batch fixed and rescales the per-host batch "
+                "— change data.batch_size back, or start a fresh run")
+        if saved_world is None or int(saved_world) == int(self.world):
+            return
+        detail = dict(from_world=int(saved_world), to_world=int(self.world),
+                      global_batch=int(self.cfg.data.batch_size),
+                      devices=jax.device_count())
+        events_lib.emit("elastic", "reshard", step=int(self.state.step),
+                        **detail)
+        if getattr(self, "recorder", None) is not None:
+            self.recorder.record("reshard", int(self.state.step), **detail)
+        print(f"[elastic] resharded restore: checkpoint written on world "
+              f"{saved_world}, resuming on world {self.world} "
+              f"(global batch {self.cfg.data.batch_size} fixed; per-host "
+              f"batch {self.cfg.data.batch_size // max(self.world, 1)})",
+              flush=True)
+
     def _warm_start_lora_base(self):
         """lora.base_checkpoint: restore the BASE params subtree from a
         pretrained run's latest checkpoint into this run's (adapter-
@@ -711,6 +786,12 @@ class Trainer:
                             self.recorder.record("ckpt", step)
                             events_lib.emit("ckpt", "save", step=step,
                                             epoch=epoch)
+                            if self.liveness is not None:
+                                # A synchronous cadence save (or a tiered
+                                # back-pressure drain) can outlast
+                                # hang_timeout_s on a loaded host; saving
+                                # is progress, not a wedge.
+                                self.liveness.pulse()
                     if (cfg.eval_every_steps and
                             step % cfg.eval_every_steps == 0):
                         with self.goodput.measure("eval"):
@@ -1003,6 +1084,13 @@ class Trainer:
         arrives here too, shimmed to ``step.crash@step=N``."""
         self.faults.set_step(step)
         self.faults.maybe_fire("step.crash", step=step)
+        # elastic.shrink: permanent host loss (rc 45, no finally-save).
+        # Same mechanics as step.crash; the distinct point + rc lets a
+        # shrink drill (docs/elastic.md, tools/chaos_soak.py --shrink)
+        # schedule "this host never comes back" declaratively — under a
+        # min_nnodes launcher the survivors re-rendezvous DEGRADED and
+        # resume resharded.
+        self.faults.maybe_fire("elastic.shrink", step=step)
         self.faults.maybe_fire("step.straggle", step=step)
         self.faults.maybe_fire("preempt.sigterm", step=step)
         # host.hang wedges HERE — after the step completed but BEFORE

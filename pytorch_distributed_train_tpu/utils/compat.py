@@ -13,9 +13,19 @@ container catches up.
 check under its two names, and legacy ``auto`` is the complement of
 ``axis_names`` over the mesh axes (modern: which axes ARE manual;
 legacy: which axes are NOT).
+
+``pytree_restore_args``: modern orbax spells partial restore as
+``PyTreeRestore(..., partial_restore=True)``; older orbax (this
+container's 0.7.0) rejects the kwarg but expresses the same contract
+with ``transforms={}`` — with ``transforms_default_to_original=True``
+(the default) an empty transforms dict restores exactly the template's
+leaves from their original saved values and never materializes subtrees
+the template does not name.
 """
 
 from __future__ import annotations
+
+import inspect
 
 import jax
 
@@ -43,3 +53,34 @@ def shard_map(f, *, mesh, in_specs, out_specs,
     # name them), costing redundant compute on those axes only under
     # legacy jax.
     return _legacy(f, mesh, in_specs, out_specs, **kw)
+
+
+def pytree_metadata_tree(ocp, item_dir: str) -> dict:
+    """A saved pytree item's metadata TREE (leaves expose .shape/.dtype).
+    Modern orbax returns a metadata object exposing
+    ``.item_metadata.tree``; legacy orbax (0.7.x) returns the tree
+    itself as a plain dict. Raises whatever the underlying reader
+    raises — the caller decides whether unreadable metadata is an error
+    or a "trust the layout" fallback."""
+    meta = ocp.PyTreeCheckpointer().metadata(item_dir)
+    if isinstance(meta, dict):
+        return meta
+    return dict(meta.item_metadata.tree)
+
+
+def pytree_metadata_keys(ocp, item_dir: str) -> set[str]:
+    """Top-level keys of a saved pytree item, either orbax spelling."""
+    return set(pytree_metadata_tree(ocp, item_dir).keys())
+
+
+def pytree_restore_args(ocp, item, restore_args):
+    """``ocp.args.PyTreeRestore`` for a PARTIAL restore, spelled for
+    whichever orbax is installed (see module docstring). ``item`` names
+    only the subtrees to restore; everything else in the checkpoint is
+    never deserialized on either spelling."""
+    params = inspect.signature(ocp.args.PyTreeRestore.__init__).parameters
+    if "partial_restore" in params:
+        return ocp.args.PyTreeRestore(item=item, restore_args=restore_args,
+                                      partial_restore=True)
+    return ocp.args.PyTreeRestore(item=item, restore_args=restore_args,
+                                  transforms={})

@@ -15,6 +15,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
+def test_shrink_drill_resumes_degraded_with_verified_ckpt(tmp_path):
+    """tools/chaos_soak.py --shrink (docs/elastic.md): kill one node
+    permanently mid-run; the survivor re-rendezvouses degraded, resumes
+    resharded with a monotone step count, completes the horizon, and
+    the final checkpoint verifies. Subprocess for schedule/registry
+    isolation, like the soak."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    env.pop("RESTART_GENERATION", None)
+    env.pop("PDTT_FAULTS", None)
+    env.pop("PDTT_EVENTS_DIR", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--shrink", "--seed", "0", "--steps", "6", "--out",
+         str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True
+    assert report["rcs"] == {"0": 0, "1": 45}  # rc 45 = permanent loss
+    assert report["completed"] and report["monotone"]
+    assert report["final_good_step"] == 6
+    assert report["final_manifest_verified"] is True
+    assert report["reshard_event"] and report["rendezvous_degraded"]
+
+
+@pytest.mark.slow
 def test_chaos_soak_completes_with_retries_and_verified_ckpt(tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}

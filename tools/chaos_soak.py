@@ -17,9 +17,19 @@ by seed. Acceptance:
 Usage::
 
     python tools/chaos_soak.py [--seed 0] [--steps 8] [--out DIR]
+    python tools/chaos_soak.py --shrink [--seed 0] [--steps 6]
 
-Prints one JSON report line; exit 0 = pass. Registered as a slow-marked
-test (tests/test_chaos_soak.py) so tier-1 stays fast.
+``--shrink`` runs the elastic shrink drill instead (docs/elastic.md):
+a 2-node tpurun gang (``min_nnodes=1``) where node 1 fires the
+``elastic.shrink`` fault point mid-run and NEVER comes back (its agent
+has no restart budget). Acceptance: the surviving node re-rendezvouses
+degraded, restores the last checkpoint resharded onto the 1-host
+world, finishes the horizon with a monotone per-generation step count,
+the final checkpoint passes manifest verification at the horizon step,
+and the event journal carries the ``elastic``/``reshard`` record.
+
+Prints one JSON report line; exit 0 = pass. Registered as slow-marked
+tests (tests/test_chaos_soak.py) so tier-1 stays fast.
 """
 
 from __future__ import annotations
@@ -122,13 +132,163 @@ def run_soak(seed: int = 0, steps: int = 8, out_dir: str = "") -> dict:
     return report
 
 
+_SHRINK_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorch_distributed_train_tpu.config import TrainConfig
+from pytorch_distributed_train_tpu.trainer import Trainer
+
+rank = int(os.environ["PROCESS_ID"])
+out = {out!r}
+cfg = TrainConfig()
+cfg.model.name = "resnet18"; cfg.model.num_classes = 10
+cfg.model.image_size = 8
+cfg.data.dataset = "synthetic_images"; cfg.data.synthetic_size = 48
+cfg.data.batch_size = 12; cfg.data.num_workers = 1
+cfg.data.elastic_shards = True
+cfg.optim.name = "momentum"; cfg.optim.learning_rate = 0.05
+cfg.optim.schedule = "constant"; cfg.optim.warmup_steps = 0
+cfg.total_steps = {steps}
+cfg.checkpoint.dir = os.path.join(out, f"ckpt-{{rank}}")
+cfg.checkpoint.save_every_steps = 2
+cfg.checkpoint.tiered = True
+cfg.obs.log_every_steps = 1
+cfg.obs.jsonl_path = os.path.join(out, f"metrics-{{rank}}.jsonl")
+if rank == 1:
+    # generation 0 only (the default): node 1 is permanently lost
+    cfg.faults.inject = ("elastic.shrink@step={shrink_step}",)
+t = Trainer(cfg)
+t.fit()
+t.close()
+"""
+
+
+def run_shrink_drill(seed: int = 0, steps: int = 6,
+                     out_dir: str = "") -> dict:
+    """Seeded elastic shrink drill (docs/elastic.md): 2-node gang, node 1
+    permanently lost mid-run, survivor resumes degraded at world 1."""
+    import socket
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from pytorch_distributed_train_tpu.elastic import (
+        ElasticAgent,
+        LaunchConfig,
+    )
+    from pytorch_distributed_train_tpu.obs.events import load_events
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="shrink-drill-")
+    os.makedirs(out_dir, exist_ok=True)
+    rng = random.Random(seed)
+    shrink_step = rng.randrange(2, max(3, steps - 1))
+    script = os.path.join(out_dir, "worker.py")
+    with open(script, "w") as f:
+        f.write(_SHRINK_WORKER.format(repo=repo, out=out_dir, steps=steps,
+                                      shrink_step=shrink_step))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    events_dir = os.path.join(out_dir, "events")
+    rcs: dict[int, int] = {}
+
+    def agent(node_rank: int, max_restarts: int) -> None:
+        cfg = LaunchConfig(
+            nprocs=1, max_restarts=max_restarts, monitor_interval_s=0.1,
+            nnodes=2, node_rank=node_rank, master_addr="127.0.0.1",
+            store_port=port, min_nnodes=1, rendezvous_window_s=2.0,
+            backoff_base_s=0.05, backoff_max_s=0.1, env=env,
+            events_dir=events_dir)
+        rcs[node_rank] = ElasticAgent(
+            cfg, [sys.executable, script]).run()
+
+    # Node 1 gets no restart budget: once its worker exits 45 it leaves
+    # for good — the "machine lost" simulation. Daemon threads: a
+    # wedged agent past the join timeout must fail the report and let
+    # the CLI exit, not block interpreter shutdown forever.
+    threads = [threading.Thread(target=agent, args=(r, m), daemon=True)
+               for r, m in ((0, 2), (1, 0))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+
+    # Per-generation monotone step count from the survivor's metrics.
+    steps_seen: list[int] = []
+    metrics_path = os.path.join(out_dir, "metrics-0.jsonl")
+    try:
+        with open(metrics_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("tag") == "train":
+                    steps_seen.append(int(rec["step"]))
+    except OSError:
+        pass
+    # the resume rewinds to the last checkpoint — split into runs at the
+    # rewind point and require each run strictly monotone
+    monotone = bool(steps_seen)
+    resumed_from = None
+    for a, b in zip(steps_seen, steps_seen[1:]):
+        if b <= a:
+            if resumed_from is not None:  # more than one rewind: fail
+                monotone = False
+                break
+            resumed_from = b
+    completed = bool(steps_seen) and max(steps_seen, default=0) == steps
+
+    from pytorch_distributed_train_tpu.checkpoint import CheckpointManager
+    from pytorch_distributed_train_tpu.config import CheckpointConfig
+    from pytorch_distributed_train_tpu.faults import integrity
+
+    mgr = CheckpointManager(CheckpointConfig(
+        dir=os.path.join(out_dir, "ckpt-0"), async_save=False))
+    final_step = mgr.latest_good_step()
+    verified = (final_step is not None
+                and integrity.verify_step(mgr.dir, final_step)[0] is True)
+    mgr.close()
+
+    events = load_events(events_dir)
+    resharded = any(e.get("category") == "elastic"
+                    and e.get("name") == "reshard" for e in events)
+    degraded = any(e.get("category") == "elastic"
+                   and e.get("name") == "rendezvous_degraded"
+                   for e in events)
+    report = {
+        "seed": seed, "steps": steps, "shrink_step": shrink_step,
+        "rcs": {str(k): v for k, v in sorted(rcs.items())},
+        "survivor_steps": steps_seen, "resumed_from": resumed_from,
+        "monotone": monotone, "completed": completed,
+        "final_good_step": final_step,
+        "final_manifest_verified": bool(verified),
+        "reshard_event": resharded, "rendezvous_degraded": degraded,
+        "out_dir": out_dir,
+    }
+    report["ok"] = bool(
+        rcs.get(0) == 0 and rcs.get(1) == 45 and completed and monotone
+        and final_step == steps and verified and resharded and degraded)
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--steps", type=int, default=0,
+                   help="horizon (default 8, or 6 with --shrink)")
     p.add_argument("--out", default="", help="run dir (default: tempdir)")
+    p.add_argument("--shrink", action="store_true",
+                   help="run the elastic shrink drill instead of the "
+                        "multi-fault soak (docs/elastic.md)")
     args = p.parse_args(argv)
-    report = run_soak(seed=args.seed, steps=args.steps, out_dir=args.out)
+    if args.shrink:
+        report = run_shrink_drill(seed=args.seed, steps=args.steps or 6,
+                                  out_dir=args.out)
+    else:
+        report = run_soak(seed=args.seed, steps=args.steps or 8,
+                          out_dir=args.out)
     print(json.dumps(report))
     return 0 if report["ok"] else 1
 

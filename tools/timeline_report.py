@@ -56,6 +56,16 @@ _RECOVERIES = {
     ("preempt", "sigterm"),
 }
 
+# (category, name) pairs eliding must never drop: the run's SHAPE —
+# restarts, reshard lifecycle (docs/elastic.md), rewinds, preemption —
+# stays one read even when thousands of routine events surround it
+_LANDMARKS = _RECOVERIES | {
+    ("elastic", "reshard"),
+    ("elastic", "rendezvous_degraded"),
+    ("elastic", "budget_exhausted"),
+    ("sentinel", "hang_blamed"),
+}
+
 
 def _fmt_detail(detail: dict, limit: int = 72) -> str:
     if not detail:
@@ -89,11 +99,24 @@ def timeline_lines(events: list[dict], width: int = 48) -> list[str]:
            f"{len({e.get('host') for e in events})} writers):"]
     if len(rows) <= width:
         out.extend(rows)
-    else:
-        half = width // 2
-        out.extend(rows[:half])
-        out.append(f"  ... {len(rows) - 2 * half} events elided ...")
-        out.extend(rows[-half:])
+        return out
+    # Elide the middle — but landmark events (restarts, reshards,
+    # rewinds) survive it in chronological place: they are what the
+    # reader opened the timeline to find.
+    half = width // 2
+    out.extend(rows[:half])
+    elided = 0
+    for e, row in list(zip(events, rows))[half:len(rows) - half]:
+        if (e.get("category"), e.get("name")) in _LANDMARKS:
+            if elided:
+                out.append(f"  ... {elided} events elided ...")
+                elided = 0
+            out.append(row)
+        else:
+            elided += 1
+    if elided:
+        out.append(f"  ... {elided} events elided ...")
+    out.extend(rows[-half:])
     return out
 
 
