@@ -186,6 +186,47 @@ def store_world(store, gen: int) -> dict | None:
         return None
 
 
+# Serving replica registry on the same store (docs/serving_reliability
+# .md): each ``serve_http --advertise`` process claims the next index
+# and publishes its address; the router enumerates the counter and
+# probes whatever it finds. Dead entries are fine — a replica that
+# restarts claims a NEW index and the router's health prober marks the
+# stale address down; the registry is a discovery hint, /healthz is
+# the truth.
+SERVE_REPLICA_COUNT_KEY = "serve/replicas_n"
+SERVE_REPLICA_KEY_PREFIX = "serve/replica/"
+
+
+def publish_replica(store, addr: str) -> int:
+    """Register a serving replica's ``host:port`` with the launcher
+    store; returns its registry index."""
+    idx = int(store.add(SERVE_REPLICA_COUNT_KEY, 1)) - 1
+    store.set(f"{SERVE_REPLICA_KEY_PREFIX}{idx}", addr.encode())
+    return idx
+
+
+def discover_replicas(store) -> list[str]:
+    """Every address ever advertised (order = registration order; the
+    prober, not this list, decides liveness). Empty when nothing
+    registered or the store is unreachable."""
+    if store is None:
+        return []
+    try:
+        # the counter is an add() key (raw int64 on the wire): a
+        # zero-delta add reads it back — and creates 0 when absent
+        n = int(store.add(SERVE_REPLICA_COUNT_KEY, 0))
+    except Exception:
+        return []
+    out: list[str] = []
+    for i in range(n):
+        try:
+            out.append(store.get(f"{SERVE_REPLICA_KEY_PREFIX}{i}",
+                                 timeout_ms=200).decode())
+        except Exception:
+            continue  # claimed index whose set never landed
+    return out
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))

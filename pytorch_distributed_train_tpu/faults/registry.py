@@ -54,6 +54,19 @@ POINTS: dict[str, str] = {
                                  # back to persistent storage)
     "data.decode": "raise",      # record decode (data/pipeline, grain)
     "serve.handler": "raise",    # HTTP request handler (tools/serve_http)
+    # Serving reliability plane drill points (serving_plane/;
+    # docs/serving_reliability.md). Same stance as the sentinel "flag"
+    # points: what a serving fault MEANS is a property of the service
+    # loop, not this registry.
+    "serve.deadline": "flag",    # scheduler force-expires the oldest
+                                 # in-flight request's deadline (504 +
+                                 # slot reclaim, deterministically)
+    "serve.slot_leak": "flag",   # abandon path SKIPS its cancel/release
+                                 # — recreates the pre-fix slot leak the
+                                 # leak sweep must then catch
+    "serve.slow_decode": "sleep",  # delay injected into the batcher's
+                                   # decode quantum (tail-latency spike
+                                   # the TTFT/inter-token detector sees)
     "step.crash": "exit",        # hard process kill between steps
     "step.straggle": "sleep",    # transient slow step (straggler)
     "elastic.shrink": "exit",    # permanent host loss (rc 45): under a

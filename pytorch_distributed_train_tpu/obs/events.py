@@ -59,6 +59,8 @@ CATEGORIES: dict[str, str] = {
     "preempt": "graceful preemption markers",
     "anomaly": "detector firings: loss spikes, stragglers, regressions",
     "profile": "managed profiler captures and their summaries",
+    "serve": "request-path reliability: sheds, deadline expiries, slot "
+             "leaks, drains, router failovers and hedges",
 }
 
 

@@ -51,11 +51,24 @@ import time
 
 BUCKETS = ("init", "compile", "step", "input_stall", "ckpt", "eval", "idle")
 
+# The serving-side vocabulary (serving_plane/): the continuous batcher's
+# wall time decomposes into admission prefills, batched decode quanta,
+# injected/detected stalls, and the idle remainder. ``productive`` for a
+# serving loop is prefill+decode — time the chip spent on requests.
+SERVE_BUCKETS = ("prefill", "decode", "stalled", "idle")
+
 
 class GoodputTracker:
-    def __init__(self, t0: float | None = None):
+    def __init__(self, t0: float | None = None,
+                 buckets: tuple[str, ...] = BUCKETS,
+                 productive: tuple[str, ...] | str = "step"):
         self.t0 = time.perf_counter() if t0 is None else t0
-        self.buckets: dict[str, float] = {b: 0.0 for b in BUCKETS if b != "idle"}
+        self.buckets: dict[str, float] = {b: 0.0 for b in buckets
+                                          if b != "idle"}
+        # which bucket(s) count as productive in goodput_pct: the train
+        # vocabulary's "step", the serving vocabulary's prefill+decode
+        self._productive = ((productive,) if isinstance(productive, str)
+                            else tuple(productive))
 
     def account(self, bucket: str, seconds: float) -> None:
         if bucket == "idle":
@@ -101,5 +114,6 @@ class GoodputTracker:
         out["goodput_s_idle"] = round(max(0.0, wall - known), 4)
         out["goodput_wall_s"] = round(wall, 4)
         out["goodput_pct"] = round(
-            100.0 * self.buckets.get("step", 0.0) / wall, 2)
+            100.0 * sum(self.buckets.get(b, 0.0)
+                        for b in self._productive) / wall, 2)
         return out

@@ -1138,6 +1138,27 @@ class ContinuousBatcher:
     def active_slots(self) -> list[int]:
         return [r for r in range(self.slots) if self._req[r] is not None]
 
+    def active_uids(self) -> list[int]:
+        """uids currently holding a slot — the serving reliability
+        plane's leak sweep compares these against its live waiters
+        (serving_plane/; a slot whose waiter died must be reclaimed,
+        never squat until LRU pressure)."""
+        return [self._req[r].uid for r in self.active_slots]
+
+    def slot_accounting(self) -> dict:
+        """Slot/KV occupancy snapshot for /healthz and the slot-leak
+        tests: every slot is exactly one of active / parked / free, and
+        the queue depth rides along (the admission controller's primary
+        signal). Paged batchers add their block-pool occupancy."""
+        active = len(self.active_slots)
+        parked = len(self._parked_slots)
+        out = {"slots": self.slots, "active": active, "parked": parked,
+               "free": self.slots - active - parked,
+               "queued": len(self.queue)}
+        if hasattr(self, "blocks_in_use"):
+            out["blocks_in_use"] = int(self.blocks_in_use())
+        return out
+
     def _free_slot(self) -> int | None:
         for r in range(self.slots):
             if self._req[r] is None and r not in self._parked_slots:

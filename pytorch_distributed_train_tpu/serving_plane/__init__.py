@@ -1,0 +1,54 @@
+"""Serving reliability plane: the request-path twin of the training
+planes (docs/serving_reliability.md).
+
+PRs 1-6 taught *training* to detect, survive, diagnose and profile
+every failure mode we inject; this package gives the continuous
+batcher behind ``tools/serve_http.py`` the complementary story —
+requests that time out (deadlines → 504 with the KV slot reclaimed),
+shed (bounded admission → 429 + Retry-After instead of collapse),
+hedge and fail over across replicas (serving_plane/router.py behind
+``tools/serve_router.py``) — instrumented through the SAME obs planes:
+SLO metrics into the registry, a ``serve`` event-journal category, and
+tail-latency anomalies that can fire the PR-5 managed profiler.
+
+Layout:
+
+- ``slo.py``        — per-request SLO lifecycle (queue wait, TTFT,
+                      inter-token percentiles, tokens/s) + deadlines
+- ``admission.py``  — bounded-queue load shedding (429 + Retry-After)
+- ``anomaly.py``    — median+MAD tail-latency detector (sentinel math)
+                      with a managed-profiler capture hook
+- ``plane.py``      — ``ReliabilityPlane``: the facade BatcherService
+                      threads through submit / step / finish
+- ``router.py``     — multi-replica routing core (health, least-
+                      outstanding balancing, retry, hedging, rolling
+                      restart) for ``tools/serve_router.py``
+- ``testing.py``    — deterministic fakes (token batcher, profiler
+                      backend) shared by tests and ``tools/slo_soak.py``
+
+No jax at module scope anywhere in this package (the obs/ contract):
+the router and the fakes must run on a login host / in a subprocess
+without touching a device backend.
+"""
+
+from pytorch_distributed_train_tpu.serving_plane.admission import (
+    AdmissionController,
+)
+from pytorch_distributed_train_tpu.serving_plane.anomaly import (
+    TailLatencyMonitor,
+)
+from pytorch_distributed_train_tpu.serving_plane.plane import (
+    DeadlineExceeded,
+    OverloadShed,
+    ReliabilityPlane,
+)
+from pytorch_distributed_train_tpu.serving_plane.slo import SloTracker
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineExceeded",
+    "OverloadShed",
+    "ReliabilityPlane",
+    "SloTracker",
+    "TailLatencyMonitor",
+]

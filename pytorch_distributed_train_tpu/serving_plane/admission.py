@@ -1,0 +1,62 @@
+"""Admission control: overload must degrade, never collapse.
+
+An unbounded intake queue turns overload into the worst possible
+failure mode: every request is accepted, every request times out, and
+the batcher burns its decode budget on work whose waiters are long
+gone. The controller bounds the queue two ways:
+
+- **depth** (``max_queue_depth``): a hard cap on requests waiting for
+  a slot — the classic bounded-queue shed.
+- **estimated TTFT** (``shed_ttft_s``): shed once the p50-based
+  estimate of a NEW request's time-to-first-token exceeds the knob —
+  depth alone misreads a fleet where each queued request is cheap (or
+  expensive); latency is what the SLO is written in.
+
+A shed answer is HTTP 429 with ``Retry-After`` (the estimate, bounded)
+so well-behaved clients back off instead of hammering; the state
+(``ok`` / ``shedding``) is exported on /healthz so the router stops
+picking a shedding replica before its clients ever see the 429s.
+
+Both knobs 0 = off (the default: existing single-user deployments keep
+their unbounded behavior).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class AdmissionController:
+    def __init__(self, max_queue_depth: int = 0, shed_ttft_s: float = 0.0,
+                 retry_after_max_s: float = 30.0):
+        if max_queue_depth < 0 or shed_ttft_s < 0:
+            raise ValueError(
+                f"admission knobs must be >= 0 (0 = off), got "
+                f"max_queue_depth={max_queue_depth} "
+                f"shed_ttft_s={shed_ttft_s}")
+        self.max_queue_depth = int(max_queue_depth)
+        self.shed_ttft_s = float(shed_ttft_s)
+        self.retry_after_max_s = float(retry_after_max_s)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.max_queue_depth or self.shed_ttft_s)
+
+    def check(self, queue_depth: int, est_ttft_s: float) -> float | None:
+        """None = admit; else the Retry-After to answer the shed with.
+        The retry hint is the TTFT estimate when latency shed, else a
+        depth-proportional guess — clamped to [1, retry_after_max_s]
+        and integral (the HTTP header is delta-seconds)."""
+        over_depth = (self.max_queue_depth
+                      and queue_depth >= self.max_queue_depth)
+        over_ttft = (self.shed_ttft_s
+                     and est_ttft_s > self.shed_ttft_s)
+        if not (over_depth or over_ttft):
+            return None
+        hint = est_ttft_s if over_ttft else max(1.0, est_ttft_s)
+        return float(min(self.retry_after_max_s,
+                         max(1.0, math.ceil(hint))))
+
+    def state(self, queue_depth: int, est_ttft_s: float) -> str:
+        return ("shedding" if self.check(queue_depth, est_ttft_s)
+                is not None else "ok")

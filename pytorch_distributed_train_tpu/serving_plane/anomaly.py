@@ -1,0 +1,91 @@
+"""Tail-latency anomaly detection: the serving twin of the PR-5 hooks.
+
+The sentinel's median+MAD ``SpikeDetector`` (sentinel/numeric.py) —
+already pointed at losses (PR 3) and step times / input stalls (PR 5)
+— here watches the two request-path series whose tails pages are
+written about: TTFT per request and inter-token latency per decode
+tick. Healthy-only windows, robust statistics, an absolute floor so a
+sub-millisecond baseline cannot flag scheduler jitter — the same
+failure model, a different clock.
+
+On a spike the monitor:
+
+1. journals an ``anomaly`` event (``ttft_regression`` /
+   ``inter_token_regression``) — the category timeline_report builds
+   causal chains from — plus a ``serve``/``tail_latency`` event so the
+   request-path story reads complete in its own category;
+2. optionally fires the PR-5 managed profiler: serving has no step
+   counter, so the capture is the time-bounded ad-hoc kind
+   (``capture_for_seconds``), cooldown-limited by WALL time the way
+   the trainer's is by steps.
+"""
+
+from __future__ import annotations
+
+import time
+
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+from pytorch_distributed_train_tpu.sentinel.numeric import SpikeDetector
+
+
+class TailLatencyMonitor:
+    def __init__(self, *, window: int = 64, sigma: float = 6.0,
+                 min_samples: int = 16, min_rel: float = 0.5,
+                 profiler=None, capture_seconds: float = 2.0,
+                 cooldown_s: float = 60.0):
+        self._ttft_det = SpikeDetector(window=window, sigma=sigma,
+                                       min_samples=min_samples,
+                                       min_rel=min_rel)
+        self._itl_det = SpikeDetector(window=window, sigma=sigma,
+                                      min_samples=min_samples,
+                                      min_rel=min_rel)
+        self.profiler = profiler
+        self.capture_seconds = capture_seconds
+        self.cooldown_s = cooldown_s
+        self._last_capture_ts: float | None = None
+
+    def observe_ttft(self, s: float, now: float | None = None) -> bool:
+        return self._observe(self._ttft_det, "ttft_regression", s, now)
+
+    def observe_inter_token(self, s: float,
+                            now: float | None = None) -> bool:
+        return self._observe(self._itl_det, "inter_token_regression", s,
+                             now)
+
+    def _observe(self, det: SpikeDetector, kind: str, s: float,
+                 now: float | None) -> bool:
+        if not det.is_spike(s):
+            det.add(s)
+            return False
+        # Re-baseline after firing (the PR-5 step-time stance): nothing
+        # "recovers" a persistent latency shift on this host — without
+        # the reset a regressed replica would journal one anomaly per
+        # request forever. The fresh window adopts the new regime
+        # within min_samples ticks.
+        det.reset()
+        self._anomaly(kind, s, time.monotonic() if now is None else now)
+        return True
+
+    def _anomaly(self, kind: str, value_s: float, now: float) -> None:
+        events_lib.emit("anomaly", kind, latency_ms=round(value_s * 1e3, 3))
+        events_lib.emit("serve", "tail_latency", kind=kind,
+                        latency_ms=round(value_s * 1e3, 3))
+        get_registry().counter(
+            "serve_tail_anomalies_total", labels={"kind": kind},
+            help="tail-latency detector firings on the request "
+                 "path").inc()
+        if self.profiler is None:
+            return
+        if (self._last_capture_ts is not None
+                and now - self._last_capture_ts < self.cooldown_s):
+            return
+        self._last_capture_ts = now
+        try:
+            # reason == anomaly kind: timeline_report's causal-chain
+            # matcher pairs the capture with THIS anomaly by it
+            self.profiler.capture_for_seconds(self.capture_seconds,
+                                              reason=kind)
+        except Exception as e:  # noqa: BLE001 — detection must outlive it
+            print(f"[serve] tail-latency capture failed "
+                  f"({type(e).__name__}: {e})", flush=True)

@@ -1,0 +1,174 @@
+"""ReliabilityPlane: the facade BatcherService threads the plane through.
+
+One object owns the request-path reliability state of a serving
+process: SLO tracking (slo.py), admission control (admission.py), the
+tail-latency monitor (anomaly.py), deadline bookkeeping, and a
+goodput-style decomposition of the scheduler loop's wall time
+(prefill / decode / stalled / idle — obs/goodput.py with the serving
+vocabulary). ``tools/serve_http.py`` builds one from its CLI knobs and
+calls into it from exactly three places:
+
+- handler threads at intake: ``admit_or_raise`` (→ 429 +
+  ``Retry-After``), ``resolve_deadline`` + ``on_submit``;
+- the scheduler loop after each step quantum: ``on_admitted`` /
+  ``on_tokens`` / ``on_finish`` and the two sweeps —
+  ``take_expired`` (deadlines → cancel + 504; also where the
+  ``serve.deadline`` drill point force-expires the oldest request)
+  and the service's slot-leak sweep (which reports through
+  ``note_leak``);
+- ``/healthz``: ``snapshot`` (lock-free with respect to the scheduler).
+
+Everything here is host-side Python over plain floats — the plane adds
+no device work to the request path.
+"""
+
+from __future__ import annotations
+
+import time
+
+from pytorch_distributed_train_tpu.faults import maybe_fire as _maybe_fire
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.goodput import (
+    SERVE_BUCKETS,
+    GoodputTracker,
+)
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+from pytorch_distributed_train_tpu.serving_plane.admission import (
+    AdmissionController,
+)
+from pytorch_distributed_train_tpu.serving_plane.slo import SloTracker
+
+
+class OverloadShed(RuntimeError):
+    """Admission refused: answer 429 with ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float, message: str):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's wall-clock budget expired: answer 504. The
+    batcher-side cancel already reclaimed its slot/KV."""
+
+
+class ReliabilityPlane:
+    def __init__(self, *, max_queue_depth: int = 0,
+                 shed_ttft_s: float = 0.0,
+                 deadline_default_s: float = 0.0,
+                 deadline_max_s: float = 0.0,
+                 slots: int = 1, slo_window: int = 512,
+                 monitor=None):
+        self.slo = SloTracker(window=slo_window)
+        self.admission = AdmissionController(
+            max_queue_depth=max_queue_depth, shed_ttft_s=shed_ttft_s)
+        self.monitor = monitor
+        self.slots = max(1, int(slots))
+        self.deadline_default_s = float(deadline_default_s)
+        self.deadline_max_s = float(deadline_max_s)
+        self.goodput = GoodputTracker(buckets=SERVE_BUCKETS,
+                                      productive=("prefill", "decode"))
+
+    # ------------------------------------------------------------- intake
+    def resolve_deadline(self, requested_s) -> float | None:
+        """Per-request budget seconds → absolute monotonic expiry (or
+        None when deadlines are off for this request). The server
+        default applies when the request carries none; ``deadline_max_s``
+        caps what a client may ask for (a fleet knob: one greedy client
+        must not park on a slot for an hour)."""
+        budget = (self.deadline_default_s if requested_s is None
+                  else float(requested_s))
+        if budget <= 0:
+            return None
+        if self.deadline_max_s > 0:
+            budget = min(budget, self.deadline_max_s)
+        return time.monotonic() + budget
+
+    def admit_or_raise(self, queue_depth: int) -> None:
+        if not self.admission.enabled:
+            return
+        est = self.slo.est_ttft_s(queue_depth, self.slots)
+        retry_after = self.admission.check(queue_depth, est)
+        if retry_after is None:
+            return
+        self.slo.shed()
+        get_registry().counter(
+            "serve_shed_total",
+            help="requests refused by admission control (429)").inc()
+        events_lib.emit("serve", "request_shed", queue_depth=queue_depth,
+                        est_ttft_ms=round(est * 1e3, 1),
+                        retry_after_s=retry_after)
+        raise OverloadShed(
+            retry_after, f"overloaded: queue depth {queue_depth}, "
+            f"estimated TTFT {est:.2f}s — retry after {retry_after:.0f}s")
+
+    def admission_state(self, queue_depth: int) -> str:
+        if not self.admission.enabled:
+            return "ok"
+        return self.admission.state(
+            queue_depth, self.slo.est_ttft_s(queue_depth, self.slots))
+
+    # --------------------------------------------------------- step loop
+    def on_submit(self, uid: int, deadline_ts: float | None,
+                  now: float | None = None) -> None:
+        self.slo.on_submit(uid, deadline_ts, now=now)
+
+    def on_admitted(self, uid: int, now: float | None = None) -> None:
+        self.slo.on_admit(uid, now=now)
+
+    def on_tokens(self, uid: int, k: int,
+                  now: float | None = None) -> None:
+        ttft = self.slo.on_tokens(uid, k, now=now)
+        if self.monitor is not None and ttft is not None:
+            self.monitor.observe_ttft(ttft, now=now)
+
+    def on_inter_token(self, s: float, now: float | None = None) -> None:
+        """Per-tick decode-cadence sample (step quantum / tokens
+        surfaced) — fed by the scheduler loop once per step so the
+        detector sees the batcher's cadence even when every consumer
+        is a non-streaming waiter."""
+        if self.monitor is not None and s > 0:
+            self.monitor.observe_inter_token(s, now=now)
+
+    def on_finish(self, uid: int, outcome: str,
+                  now: float | None = None) -> None:
+        self.slo.on_finish(uid, outcome, now=now)
+
+    def take_expired(self, now: float | None = None) -> list[int]:
+        """uids to cancel-and-504 this sweep: real deadline expiries
+        plus (``serve.deadline`` drill) a forced expiry of the oldest
+        in-flight request."""
+        now = time.monotonic() if now is None else now
+        expired = self.slo.expired(now=now)
+        if self.slo.inflight() and _maybe_fire("serve.deadline"):
+            forced = self.slo.oldest_inflight()
+            if forced is not None and forced not in expired:
+                expired.append(forced)
+        if expired:
+            get_registry().counter(
+                "serve_deadline_expired_total",
+                help="requests cancelled at their deadline (504)").inc(
+                    len(expired))
+        return expired
+
+    def note_leak(self, uid: int, where: str) -> None:
+        """The service's slot-leak sweep found (and reclaimed) a slot
+        whose waiter died — count it, journal it, close the record."""
+        get_registry().counter(
+            "serve_slot_leaks_total",
+            help="KV slots found held with no live waiter (reclaimed "
+                 "by the leak sweep)").inc()
+        events_lib.emit("serve", "slot_leak", uid=uid, where=where)
+        self.slo.on_finish(uid, "leak")
+
+    # ------------------------------------------------------------- report
+    def snapshot(self, queue_depth: int, slot_accounting: dict) -> dict:
+        """The /healthz reliability section: admission state, queue
+        depth, slot occupancy, SLO percentiles, goodput split."""
+        return {
+            "admission": self.admission_state(queue_depth),
+            "queue_depth": queue_depth,
+            "slots": slot_accounting,
+            "slo": self.slo.snapshot(),
+            "goodput": self.goodput.snapshot(),
+        }

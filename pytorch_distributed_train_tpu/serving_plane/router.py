@@ -1,0 +1,473 @@
+"""Multi-replica routing core: health, balancing, retry, hedging.
+
+The fault-tolerance story serving needs but a single process cannot
+give: a thin front over N serve_http replicas that keeps answering
+while individual replicas die, drain, or straggle. The HTTP surface
+lives in ``tools/serve_router.py``; this module is the logic so tests
+drive it in-process:
+
+- **ReplicaSet** — the routable world: per-replica state
+  (``up | draining | down``), outstanding-request counts (the
+  balancing signal), and the last /healthz body (queue depth,
+  admission state — so a ``shedding`` replica stops receiving work
+  before its clients ever see a 429).
+- **HealthProber** — background /healthz probes; state flips are
+  journaled (``serve``/``replica_down`` / ``replica_up``) so an outage
+  reads out of the same cross-host timeline as everything else.
+- **Router** — pick the up replica with the fewest outstanding
+  requests; RETRY idempotent requests on connect failure or a
+  retryable status (a dead or draining replica costs a failover, not
+  an error); optionally HEDGE a straggling completion onto a second
+  replica after a latency-percentile delay (first answer wins);
+  ``rolling_restart`` walks every replica through serve_http's
+  existing drain path one at a time.
+
+Idempotency rule: a request is retried/hedged only when re-executing
+it cannot duplicate side effects — plain completions (and ``n``/chat
+ones). ``keep``/``session``/``prefix`` requests mutate replica-local
+KV state, are pinned to the replica that owns the session, and never
+retry; streams retry only before the first relayed byte (the HTTP
+front's job).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue as queue_mod
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+from pytorch_distributed_train_tpu.serving_plane.slo import percentile
+
+# statuses a healthy twin could serve better: shed (429), gateway-ish
+# (502), draining / scheduler-dead (503)
+RETRYABLE_STATUSES = (429, 502, 503)
+
+
+def http_json(addr: str, path: str, body: bytes | None,
+              timeout: float) -> tuple[int, bytes]:
+    """One HTTP exchange with a replica. Returns (status, body) for ANY
+    HTTP status (error statuses are routing inputs here, not
+    exceptions); raises OSError only for connect/transport failure."""
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=body,
+        headers={"Content-Type": "application/json"} if body else {},
+        method="POST" if body is not None else "GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except urllib.error.URLError as e:
+        raise OSError(str(e.reason)) from e
+
+
+@dataclasses.dataclass
+class Replica:
+    addr: str
+    state: str = "up"            # up | draining | down
+    outstanding: int = 0
+    fails: int = 0               # consecutive probe failures
+    healthz: dict = dataclasses.field(default_factory=dict)
+
+
+class ReplicaSet:
+    def __init__(self, addrs: tuple[str, ...] = ()):
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        for a in addrs:
+            self.add(a)
+
+    def add(self, addr: str) -> None:
+        with self._lock:
+            if addr not in self._replicas:
+                self._replicas[addr] = Replica(addr)
+
+    def addrs(self) -> list[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def get(self, addr: str) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(addr)
+
+    def mark(self, addr: str, state: str, healthz: dict | None = None,
+             fails: int | None = None) -> None:
+        """Set a replica's state; up<->down/draining flips are
+        journaled — the router's view of an outage belongs in the same
+        timeline as the replica's own drain events."""
+        with self._lock:
+            r = self._replicas.get(addr)
+            if r is None:
+                return
+            prev = r.state
+            r.state = state
+            if healthz is not None:
+                r.healthz = healthz
+            if fails is not None:
+                r.fails = fails
+        if prev != state:
+            events_lib.emit(
+                "serve",
+                "replica_up" if state == "up" else "replica_down",
+                addr=addr, prev=prev, state=state)
+            get_registry().counter(
+                "serve_replica_flips_total", labels={"state": state},
+                help="router-observed replica state changes").inc()
+
+    def note_fail(self, addr: str) -> int:
+        """Bump and return a replica's consecutive probe-failure count
+        (the prober's down_after debounce)."""
+        with self._lock:
+            r = self._replicas.get(addr)
+            if r is None:
+                return 0
+            r.fails += 1
+            return r.fails
+
+    def begin(self, addr: str) -> None:
+        with self._lock:
+            r = self._replicas.get(addr)
+            if r is not None:
+                r.outstanding += 1
+
+    def end(self, addr: str) -> None:
+        with self._lock:
+            r = self._replicas.get(addr)
+            if r is not None:
+                r.outstanding = max(0, r.outstanding - 1)
+
+    def pick(self, exclude: set[str] = frozenset()) -> str | None:
+        """Least-outstanding routable replica. A replica whose own
+        admission state says ``shedding`` ranks after every non-
+        shedding one — the router backs off before the 429s start."""
+        with self._lock:
+            cands = [r for r in self._replicas.values()
+                     if r.state == "up" and r.addr not in exclude]
+            if not cands:
+                return None
+            return min(
+                cands,
+                key=lambda r: (r.healthz.get("admission") == "shedding",
+                               r.outstanding, r.addr)).addr
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [{"addr": r.addr, "state": r.state,
+                     "outstanding": r.outstanding,
+                     "admission": r.healthz.get("admission"),
+                     "queue_depth": r.healthz.get("queue_depth")}
+                    for r in self._replicas.values()]
+
+
+class HealthProber:
+    """Background /healthz probing. 200 → up; 503 whose body says
+    ``draining`` → draining (routable never, but expected back); any
+    other 5xx body → down; ``down_after`` consecutive connect failures
+    → down (one lost packet must not evict a replica)."""
+
+    def __init__(self, replicas: ReplicaSet, *, interval_s: float = 0.5,
+                 down_after: int = 2, timeout_s: float = 2.0,
+                 fetch=None, refresh=None):
+        self.replicas = replicas
+        self.interval_s = interval_s
+        self.down_after = max(1, down_after)
+        self.timeout_s = timeout_s
+        self._fetch = fetch or self._http_fetch
+        # optional discovery hook (elastic.discover_replicas): called
+        # each round so replicas advertised after router start join the
+        # routable set without a restart
+        self._refresh = refresh
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _http_fetch(self, addr: str) -> tuple[int, dict]:
+        status, body = http_json(addr, "/healthz", None, self.timeout_s)
+        try:
+            return status, json.loads(body)
+        except ValueError:
+            return status, {}
+
+    def probe_once(self) -> None:
+        if self._refresh is not None:
+            try:
+                for addr in self._refresh():
+                    self.replicas.add(addr)
+            except Exception:
+                pass  # discovery store flaked: probe what we have
+        for addr in self.replicas.addrs():
+            try:
+                status, health = self._fetch(addr)
+            except OSError:
+                if self.replicas.note_fail(addr) >= self.down_after:
+                    self.replicas.mark(addr, "down")
+                continue
+            flat = dict(health)
+            flat.setdefault("admission",
+                            (health.get("reliability") or {}).get(
+                                "admission"))
+            flat.setdefault("queue_depth",
+                            (health.get("reliability") or {}).get(
+                                "queue_depth"))
+            if status == 200:
+                self.replicas.mark(addr, "up", healthz=flat, fails=0)
+            elif health.get("status") == "draining":
+                self.replicas.mark(addr, "draining", healthz=flat,
+                                   fails=0)
+            else:
+                self.replicas.mark(addr, "down", healthz=flat, fails=0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.probe_once()
+            except Exception as e:  # noqa: BLE001 — the prober must live
+                print(f"[router] probe error {type(e).__name__}: {e}",
+                      flush=True)
+
+    def start(self) -> None:
+        self.probe_once()  # synchronous first pass: route immediately
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="router-health-prober")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+class Router:
+    def __init__(self, replicas: ReplicaSet, *, timeout_s: float = 600.0,
+                 hedge_after_s: float = 0.0, hedge_pct: float = 0.0,
+                 hedge_min_s: float = 0.05, lat_window: int = 256,
+                 sessions_max: int = 4096):
+        self.replicas = replicas
+        self.timeout_s = timeout_s
+        self.hedge_after_s = hedge_after_s
+        self.hedge_pct = hedge_pct
+        self.hedge_min_s = hedge_min_s
+        self._lat: deque[float] = deque(maxlen=lat_window)
+        self._lat_lock = threading.Lock()
+        # session id -> owning replica (sessions are replica-local KV);
+        # insertion-ordered and bounded — see note_session. Read and
+        # mutated from concurrent handler threads: all access under
+        # _sessions_lock (an unlocked eviction loop races next(iter())
+        # against concurrent inserts/pops).
+        self.sessions: dict[int, str] = {}
+        self.sessions_max = sessions_max
+        self._sessions_lock = threading.Lock()
+
+    # ------------------------------------------------------------- policy
+    def classify(self, body: dict) -> tuple[str | None, bool]:
+        """(pinned_addr, idempotent) for a request body. Session-bound
+        requests go to the replica that parked the session and never
+        retry; everything else is fair game."""
+        sid = body.get("session", body.get("prefix"))
+        if sid is not None:
+            try:
+                sid = int(sid)
+            except (TypeError, ValueError):
+                # malformed session id: forward unpinned so the replica
+                # answers its documented 400 (the router must not crash
+                # on client input)
+                return None, False
+            with self._sessions_lock:
+                return self.sessions.get(sid), False
+        return None, not body.get("keep", False)
+
+    def note_session(self, response_body: bytes, addr: str) -> None:
+        """Record session ownership from a completed response so later
+        ``session=``/``prefix=`` turns route home. The map is bounded
+        (oldest entries evicted — replicas LRU-evict their parked
+        sessions under pressure anyway, so an evicted mapping matches a
+        session that was about to die server-side too)."""
+        try:
+            sid = json.loads(response_body).get("session")
+        except (ValueError, AttributeError):
+            return
+        if sid is None:
+            return
+        with self._sessions_lock:
+            self.sessions[int(sid)] = addr
+            while len(self.sessions) > self.sessions_max:
+                self.sessions.pop(next(iter(self.sessions)))
+
+    def hedge_delay(self) -> float | None:
+        """Delay before a second copy goes out: the configured
+        percentile of recent request latencies (floored), or the fixed
+        knob. None = hedging off."""
+        if self.hedge_pct > 0:
+            with self._lat_lock:
+                xs = sorted(self._lat)
+            if len(xs) >= 8:
+                return max(self.hedge_min_s,
+                           percentile(xs, self.hedge_pct))
+            return None  # not enough signal yet
+        return self.hedge_after_s if self.hedge_after_s > 0 else None
+
+    # ------------------------------------------------------------ request
+    def _single(self, addr: str, path: str, body: bytes,
+                out: queue_mod.Queue) -> None:
+        self.replicas.begin(addr)
+        t0 = time.monotonic()
+        try:
+            status, rbody = http_json(addr, path, body, self.timeout_s)
+        except OSError as e:
+            out.put((addr, "conn_fail", 0, str(e).encode()))
+            return
+        finally:
+            self.replicas.end(addr)
+        if status in RETRYABLE_STATUSES:
+            out.put((addr, "retryable", status, rbody))
+            return
+        with self._lat_lock:
+            self._lat.append(time.monotonic() - t0)
+        out.put((addr, "ok", status, rbody))
+
+    def request(self, path: str, body_bytes: bytes,
+                body: dict) -> tuple[int, bytes]:
+        """Route one non-streaming POST. Returns (status, body)."""
+        pinned, idempotent = self.classify(body)
+        if pinned is not None:
+            rep = self.replicas.get(pinned)
+            if rep is None or rep.state != "up":
+                return 503, json.dumps(
+                    {"error": f"session replica {pinned} unavailable"}
+                ).encode()
+            out: queue_mod.Queue = queue_mod.Queue()
+            self._single(pinned, path, body_bytes, out)
+            _, kind, status, rbody = out.get()
+            if kind == "conn_fail":
+                return 502, json.dumps(
+                    {"error": "session replica unreachable"}).encode()
+            return status, rbody
+        tried: set[str] = set()
+        last: tuple[int, bytes] | None = None
+        while True:
+            addr = self.replicas.pick(exclude=tried)
+            if addr is None:
+                if last is not None:
+                    return last
+                return 503, json.dumps(
+                    {"error": "no replica available"}).encode()
+            tried.add(addr)
+            result = self._attempt_hedged(addr, path, body_bytes, tried,
+                                          hedge=idempotent)
+            a, kind, status, rbody = result
+            if kind == "ok":
+                if not idempotent:
+                    self.note_session(rbody, a)
+                return status, rbody
+            if not idempotent:
+                # non-idempotent requests never re-execute: surface the
+                # transport/retryable failure honestly
+                return (status or 502), rbody
+            events_lib.emit("serve", "failover", addr=a, path=path,
+                            reason=kind, status=status)
+            get_registry().counter(
+                "serve_failovers_total",
+                help="requests retried on another replica").inc()
+            last = ((status or 502), rbody)
+
+    def _attempt_hedged(self, addr: str, path: str, body_bytes: bytes,
+                        tried: set[str], hedge: bool):
+        """One attempt with optional hedging: fire ``addr``, and if no
+        answer lands within the hedge delay, fire a second copy at the
+        next-best replica; first completed answer wins (an 'ok' beats a
+        pending primary; a hedged replica that also fails leaves the
+        failover loop to continue)."""
+        out: queue_mod.Queue = queue_mod.Queue()
+        threading.Thread(target=self._single,
+                         args=(addr, path, body_bytes, out),
+                         daemon=True).start()
+        delay = self.hedge_delay() if hedge else None
+        hedged_addr = None
+        if delay is not None:
+            try:
+                return out.get(timeout=delay)
+            except queue_mod.Empty:
+                hedged_addr = self.replicas.pick(exclude=tried | {addr})
+            if hedged_addr is not None:
+                events_lib.emit("serve", "hedge", slow=addr,
+                                hedge=hedged_addr, path=path,
+                                after_s=round(delay, 4))
+                get_registry().counter(
+                    "serve_hedges_total",
+                    help="straggler completions hedged onto a second "
+                         "replica").inc()
+                threading.Thread(
+                    target=self._single,
+                    args=(hedged_addr, path, body_bytes, out),
+                    daemon=True).start()
+        results = []
+        expect = 1 + (1 if hedged_addr is not None else 0)
+        for _ in range(expect):
+            r = out.get()
+            if r[1] == "ok":
+                if hedged_addr is not None:
+                    events_lib.emit("serve", "hedge_win", addr=r[0],
+                                    path=path)
+                    tried.add(hedged_addr)
+                return r
+            results.append(r)
+        if hedged_addr is not None:
+            tried.add(hedged_addr)
+        return results[0]
+
+    # ----------------------------------------------------- rolling restart
+    def rolling_restart(self, *, drain_path: str = "/admin/drain",
+                        poll_s: float = 0.2, down_timeout_s: float = 30.0,
+                        wait_back_s: float = 60.0) -> list[dict]:
+        """Walk every replica through serve_http's drain path, one at a
+        time: stop routing to it, POST the drain, wait for it to leave
+        (its supervisor restarts it), and wait for it to come BACK
+        (``wait_back_s`` — on by default: draining the next replica
+        while the previous one is still down would take a 2-replica
+        fleet fully offline, exactly what a rolling restart exists to
+        avoid; after the timeout the walk proceeds anyway so a dead
+        supervisor degrades the restart instead of wedging it) —
+        in-flight requests finish, new ones land on the others, so a
+        fleet-wide restart costs zero failed requests."""
+        report = []
+        for addr in list(self.replicas.addrs()):
+            rep = self.replicas.get(addr)
+            if rep is None or rep.state == "down":
+                report.append({"addr": addr, "skipped": "down"})
+                continue
+            events_lib.emit("serve", "rolling_drain", addr=addr)
+            self.replicas.mark(addr, "draining")
+            try:
+                http_json(addr, drain_path, b"{}", 5.0)
+            except OSError:
+                pass  # already gone: counts as drained
+            deadline = time.monotonic() + down_timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    http_json(addr, "/healthz", None, 1.0)
+                except OSError:
+                    break  # exited: drained
+                time.sleep(poll_s)  # still draining in-flight work
+            self.replicas.mark(addr, "down")
+            entry = {"addr": addr, "drained": True}
+            if wait_back_s > 0:
+                back_by = time.monotonic() + wait_back_s
+                while time.monotonic() < back_by:
+                    try:
+                        status, _ = http_json(addr, "/healthz", None, 1.0)
+                    except OSError:
+                        time.sleep(poll_s)
+                        continue
+                    if status == 200:
+                        self.replicas.mark(addr, "up")
+                        entry["back"] = True
+                        break
+                    time.sleep(poll_s)
+            report.append(entry)
+        return report

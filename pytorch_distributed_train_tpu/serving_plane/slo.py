@@ -1,0 +1,217 @@
+"""Per-request SLO accounting: the serving plane's measurement half.
+
+Every request the BatcherService admits gets a lifecycle record —
+submit, queue exit, first token, per-tick token arrivals, finish — and
+the tracker turns those into the latency numbers a serving fleet is
+actually judged on:
+
+- **TTFT** (submit → first token): the user-visible "it started".
+- **inter-token latency**: the streaming cadence; its tail is what a
+  slow decode step / straggling replica shows up in first.
+- **queue wait** (submit → admission): the overload signal admission
+  control throttles on.
+- **tokens/s** per finished request, and request outcomes by class
+  (``ok`` / ``deadline`` / ``shed`` / ``timeout`` / ``abandoned`` /
+  ``cancelled`` / ``leak``).
+
+Samples land in BOTH a rolling window (p50/p95/p99 in ``snapshot()``,
+the /healthz surface the router balances on) and the process obs
+registry (``serve_ttft_seconds`` etc. histograms — the Prometheus
+scrape). Deadlines ride the same records: each request may carry an
+absolute expiry (monotonic clock); ``expired()`` is what the service
+loop sweeps between decode steps.
+
+Thread model: called under the BatcherService lock for mutation;
+``snapshot()`` is called WITHOUT it from /healthz (a health probe must
+not block behind a wedged decode), so the internal lock here only
+guards the record dict and windows — O(window) worst case, never
+device work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+# finer than the span-duration default: TTFT/inter-token targets live in
+# the 1 ms .. 10 s range
+_LAT_BUCKETS = tuple(0.001 * 2 ** i for i in range(15))
+
+OUTCOMES = ("ok", "deadline", "shed", "timeout", "abandoned",
+            "cancelled", "leak", "error", "session_evicted")
+
+
+def percentile(sorted_xs: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted window (tiny n —
+    the rolling windows here — so exactness beats interpolation)."""
+    if not sorted_xs:
+        return 0.0
+    i = min(len(sorted_xs) - 1, max(0, int(q * len(sorted_xs))))
+    return sorted_xs[i]
+
+
+@dataclasses.dataclass
+class _Req:
+    t_submit: float
+    deadline_ts: float | None = None   # monotonic expiry, None = none
+    t_admit: float | None = None
+    t_last: float | None = None        # last token arrival
+    tokens: int = 0
+
+
+class SloTracker:
+    def __init__(self, window: int = 512):
+        self._lock = threading.Lock()
+        self._reqs: dict[int, _Req] = {}
+        self._ttft: deque[float] = deque(maxlen=window)
+        self._itl: deque[float] = deque(maxlen=window)
+        self._queue_wait: deque[float] = deque(maxlen=window)
+        self._tok_s: deque[float] = deque(maxlen=window)
+        self.outcomes: dict[str, int] = {o: 0 for o in OUTCOMES}
+
+    # ------------------------------------------------------------ lifecycle
+    def on_submit(self, uid: int, deadline_ts: float | None,
+                  now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._reqs[uid] = _Req(t_submit=now, deadline_ts=deadline_ts)
+
+    def on_admit(self, uid: int, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            r = self._reqs.get(uid)
+            if r is None or r.t_admit is not None:
+                return
+            r.t_admit = now
+            wait = max(0.0, now - r.t_submit)
+            self._queue_wait.append(wait)
+        get_registry().histogram(
+            "serve_queue_wait_seconds", buckets=_LAT_BUCKETS,
+            help="submit -> admission wait per request").observe(wait)
+
+    def on_tokens(self, uid: int, k: int, now: float | None = None
+                  ) -> float | None:
+        """``k`` new tokens surfaced for ``uid``. Returns the TTFT
+        sample when these are the request's FIRST tokens (the caller
+        feeds it to the tail-latency monitor), else None."""
+        if k <= 0:
+            return None
+        now = time.monotonic() if now is None else now
+        ttft = None
+        itl = None
+        with self._lock:
+            r = self._reqs.get(uid)
+            if r is None:
+                return None
+            if r.t_last is None:
+                ttft = max(0.0, now - r.t_submit)
+                self._ttft.append(ttft)
+                if r.t_admit is None:
+                    # admission and first token are one event for the
+                    # causal batcher (admission samples token one)
+                    r.t_admit = now
+                    self._queue_wait.append(
+                        max(0.0, now - r.t_submit))
+            else:
+                itl = max(0.0, now - r.t_last) / k
+                self._itl.append(itl)
+            r.t_last = now
+            r.tokens += k
+        reg = get_registry()
+        if ttft is not None:
+            reg.histogram(
+                "serve_ttft_seconds", buckets=_LAT_BUCKETS,
+                help="submit -> first token per request").observe(ttft)
+        if itl is not None and ttft is None:
+            reg.histogram(
+                "serve_inter_token_seconds", buckets=_LAT_BUCKETS,
+                help="per-token decode cadence (batched step "
+                     "quantum / tokens surfaced)").observe(itl)
+        return ttft
+
+    def on_finish(self, uid: int, outcome: str,
+                  now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        outcome = outcome if outcome in OUTCOMES else "error"
+        with self._lock:
+            r = self._reqs.pop(uid, None)
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+            if r is not None and outcome == "ok" and r.tokens > 0:
+                dur = max(1e-9, now - r.t_submit)
+                self._tok_s.append(r.tokens / dur)
+        reg = get_registry()
+        reg.counter("serve_requests_total", labels={"outcome": outcome},
+                    help="finished serving requests by outcome").inc()
+        if r is not None and outcome == "ok":
+            reg.histogram(
+                "serve_request_seconds",
+                help="submit -> finish per completed request").observe(
+                    max(0.0, now - r.t_submit))
+
+    # ------------------------------------------------------------ deadlines
+    def shed(self) -> None:
+        """A request refused at the door (never got a uid/record)."""
+        with self._lock:
+            self.outcomes["shed"] = self.outcomes.get("shed", 0) + 1
+        get_registry().counter(
+            "serve_requests_total", labels={"outcome": "shed"},
+            help="finished serving requests by outcome").inc()
+
+    def expired(self, now: float | None = None) -> list[int]:
+        """uids whose deadline has passed, oldest-submitted first."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            out = [(r.t_submit, uid) for uid, r in self._reqs.items()
+                   if r.deadline_ts is not None and now > r.deadline_ts]
+        return [uid for _, uid in sorted(out)]
+
+    def oldest_inflight(self) -> int | None:
+        """The longest-waiting tracked request — what the
+        ``serve.deadline`` drill point force-expires."""
+        with self._lock:
+            if not self._reqs:
+                return None
+            return min(self._reqs.items(),
+                       key=lambda kv: kv[1].t_submit)[0]
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._reqs)
+
+    # ------------------------------------------------------------- report
+    def est_ttft_s(self, queue_depth: int, slots: int) -> float:
+        """Admission-control estimate of a NEW request's TTFT: the
+        recent p50 scaled by how many queued requests must admit ahead
+        of it (each admission is one prefill quantum; ``slots`` of them
+        drain per wave). Deliberately simple and monotone in depth —
+        the knob it feeds (``shed_ttft_s``) is a shed threshold, not a
+        promise."""
+        with self._lock:
+            xs = sorted(self._ttft)
+        p50 = percentile(xs, 0.50)
+        return p50 * (1.0 + queue_depth / max(1, slots))
+
+    def snapshot(self) -> dict:
+        """Flat dict for /healthz + obs_report: rolling p50/p95/p99 of
+        every SLO series (seconds) + outcome counts."""
+        with self._lock:
+            ttft = sorted(self._ttft)
+            itl = sorted(self._itl)
+            qw = sorted(self._queue_wait)
+            toks = sorted(self._tok_s)
+            outcomes = dict(self.outcomes)
+            inflight = len(self._reqs)
+        out = {"inflight": inflight,
+               "outcomes": {k: v for k, v in outcomes.items() if v}}
+        for name, xs in (("ttft_s", ttft), ("inter_token_s", itl),
+                         ("queue_wait_s", qw)):
+            out[name] = {"n": len(xs),
+                         "p50": round(percentile(xs, 0.50), 6),
+                         "p95": round(percentile(xs, 0.95), 6),
+                         "p99": round(percentile(xs, 0.99), 6)}
+        out["tokens_per_s_p50"] = round(percentile(toks, 0.50), 3)
+        return out

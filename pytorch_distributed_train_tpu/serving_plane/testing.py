@@ -1,0 +1,274 @@
+"""Deterministic fakes for the serving reliability plane.
+
+The plane's whole job is policing timing and lifecycle, which a real
+model on a 2-core CI box makes both slow and noisy. These fakes give
+tests, ``tools/slo_soak.py`` and ``tools/serve_http.py --fake-backend``
+a batcher with the EXACT scheduler contract of
+``serving.ContinuousBatcher`` (queue / step / cancel / sessions /
+streaming tap / slot accounting) but a pure-Python token source with a
+controllable per-step delay — so deadline, admission, leak and router
+tests measure the plane, not XLA compile time.
+
+Importing this module pulls serving.py (for the Request/Completion
+wire types the service consumes) and therefore jax — but never builds
+a model or touches a device, so a --fake-backend replica subprocess
+boots in import time, not compile time; that is what makes the
+multi-replica router drill testable at all.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from pytorch_distributed_train_tpu.serving import Completion, Request
+
+
+class FakeByteTok:
+    """Byte-level tokenizer stand-in (encode = raw bytes; decode is
+    printable-ascii so SSE deltas stay valid JSON). ``eos_id`` None:
+    fake completions finish by length only — deterministic durations
+    are the point."""
+
+    eos_id = None
+
+    def encode(self, text: str) -> list[int]:
+        return [b % 256 for b in text.encode("utf-8")] or [0]
+
+    def decode(self, ids) -> str:
+        return "".join(chr(97 + (int(t) % 26)) for t in ids)
+
+
+class FakeTokenBatcher:
+    """ContinuousBatcher-shaped token mill.
+
+    Tokens are a pure function of (prompt, uid, position) so two forks
+    of one prompt differ (the ``n>1`` path needs distinct choices) and
+    reruns are bit-stable. ``step_delay_s`` sleeps once per step() —
+    the decode-quantum knob deadline/tail tests turn."""
+
+    supports_sessions = True
+
+    def __init__(self, *, slots: int = 4, step_delay_s: float = 0.0,
+                 vocab: int = 250):
+        self.slots = slots
+        self.step_delay_s = step_delay_s
+        self.vocab = vocab
+        self.queue: deque[Request] = deque()
+        self._next_uid = 0
+        self._req: list[Request | None] = [None] * slots
+        self._generated: list[list[int]] = [[] for _ in range(slots)]
+        self._parked: dict[int, int] = {}  # sid -> slot
+        self._parked_slots: set[int] = set()
+        self.stats = {"steps": 0, "prefills": 0, "preloads": 0,
+                      "resumes": 0, "forks": 0, "generated_tokens": 0,
+                      "admit_ms": 0.0, "device_ms": 0.0, "host_ms": 0.0}
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens: int, *, temperature=0.0,
+               eos_id=None, keep=False, session=None, prefix=None,
+               **_kw) -> int:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if session is not None and session not in self._parked:
+            raise ValueError(f"unknown session {session}")
+        if prefix is not None and prefix not in self._parked:
+            raise ValueError(f"unknown session {prefix}")
+        uid = self._next_uid
+        self._next_uid += 1
+        self.queue.append(Request(uid, prompt, max_new_tokens,
+                                  temperature, eos_id, keep=keep,
+                                  session=session, prefix=prefix))
+        return uid
+
+    def _evict_parked(self) -> int | None:
+        """Free the oldest parked session not referenced by a queued
+        continuation — the real batcher's LRU-eviction contract, which
+        can_preload()'s True answer promises preload() will honor."""
+        queued = {q.session for q in self.queue if q.session is not None}
+        queued |= {q.prefix for q in self.queue if q.prefix is not None}
+        for sid in list(self._parked):
+            if sid in queued:
+                continue
+            r = self._parked.pop(sid)
+            self._parked_slots.discard(r)
+            return r
+        return None
+
+    def preload(self, prompt) -> int:
+        r = self._free_slot()
+        if r is None:
+            r = self._evict_parked()
+        if r is None:
+            raise RuntimeError("no slot available for preload")
+        sid = self._next_uid
+        self._next_uid += 1
+        self._parked[sid] = r
+        self._parked_slots.add(r)
+        self.stats["preloads"] += 1
+        return sid
+
+    def can_preload(self, prompt_len=None) -> bool:
+        del prompt_len
+        if self._free_slot() is not None:
+            return True
+        queued = {q.session for q in self.queue if q.session is not None}
+        queued |= {q.prefix for q in self.queue if q.prefix is not None}
+        return any(sid not in queued for sid in self._parked)
+
+    def release(self, sid: int) -> bool:
+        r = self._parked.pop(sid, None)
+        if r is None:
+            return False
+        self._parked_slots.discard(r)
+        return True
+
+    def cancel(self, uid: int) -> bool:
+        for i, q in enumerate(self.queue):
+            if q.uid == uid:
+                del self.queue[i]
+                return True
+        for r in range(self.slots):
+            if self._req[r] is not None and self._req[r].uid == uid:
+                self._req[r] = None
+                return True
+        return False
+
+    # --------------------------------------------------------- accounting
+    @property
+    def active_slots(self) -> list[int]:
+        return [r for r in range(self.slots) if self._req[r] is not None]
+
+    def active_uids(self) -> list[int]:
+        return [self._req[r].uid for r in self.active_slots]
+
+    def slot_accounting(self) -> dict:
+        active = len(self.active_slots)
+        parked = len(self._parked_slots)
+        return {"slots": self.slots, "active": active, "parked": parked,
+                "free": self.slots - active - parked,
+                "queued": len(self.queue)}
+
+    def new_tokens_since(self, seen: dict[int, int]) -> dict[int, list[int]]:
+        out: dict[int, list[int]] = {}
+        for r in self.active_slots:
+            uid = self._req[r].uid
+            n = seen.get(uid)
+            if n is not None and len(self._generated[r]) > n:
+                out[uid] = self._generated[r][n:]
+        return out
+
+    # ---------------------------------------------------------- scheduler
+    def _free_slot(self) -> int | None:
+        for r in range(self.slots):
+            if self._req[r] is None and r not in self._parked_slots:
+                return r
+        return None
+
+    def _token(self, req: Request, n: int) -> int:
+        return (sum(req.prompt) + 13 * req.uid + n) % self.vocab
+
+    def _start(self, r: int, req: Request) -> Completion | None:
+        self._req[r] = req
+        self._generated[r] = [self._token(req, 0)]
+        self.stats["prefills"] += 1
+        self.stats["generated_tokens"] += 1
+        return self._maybe_finish(r)
+
+    def _maybe_finish(self, r: int) -> Completion | None:
+        req = self._req[r]
+        gen = self._generated[r]
+        done_eos = req.eos_id is not None and gen[-1] == req.eos_id
+        if not done_eos and len(gen) < req.max_new_tokens:
+            return None
+        self._req[r] = None
+        session = None
+        if req.keep:
+            session = req.uid
+            self._parked[session] = r
+            self._parked_slots.add(r)
+        return Completion(req.uid, req.prompt, gen,
+                          "eos" if done_eos else "length",
+                          session=session,
+                          logprobs=[-0.5] * len(gen))
+
+    def step(self) -> list[Completion]:
+        finished: list[Completion] = []
+        t0 = time.perf_counter()
+        while self.queue:
+            req = self.queue[0]
+            if req.session is not None:
+                r = self._parked.pop(req.session, None)
+                if r is None:
+                    self.queue.popleft()
+                    finished.append(Completion(req.uid, req.prompt, [],
+                                               "session_evicted"))
+                    continue
+                self._parked_slots.discard(r)
+                self.stats["resumes"] += 1
+            else:
+                r = self._free_slot()
+                if r is None:
+                    break
+                if req.prefix is not None:
+                    if req.prefix not in self._parked:
+                        self.queue.popleft()
+                        finished.append(Completion(
+                            req.uid, req.prompt, [], "session_evicted"))
+                        continue
+                    self.stats["forks"] += 1
+            self.queue.popleft()
+            done = self._start(r, req)
+            if done is not None:
+                finished.append(done)
+        self.stats["admit_ms"] += (time.perf_counter() - t0) * 1e3
+        active = self.active_slots
+        if not active:
+            return finished
+        t_dev = time.perf_counter()
+        if self.step_delay_s:
+            time.sleep(self.step_delay_s)
+        for r in active:
+            if self._req[r] is None:
+                continue
+            self._generated[r].append(
+                self._token(self._req[r], len(self._generated[r])))
+            self.stats["generated_tokens"] += 1
+            done = self._maybe_finish(r)
+            if done is not None:
+                finished.append(done)
+        self.stats["steps"] += 1
+        self.stats["device_ms"] += (time.perf_counter() - t_dev) * 1e3
+        return finished
+
+    def run(self):
+        while self.queue or self.active_slots:
+            yield from self.step()
+
+
+class FakeCaptureBackend:
+    """Managed-profiler backend that records window open/close by
+    writing a marker file — enough for the acceptance drill to assert
+    "a capture fired" from a subprocess (PDTT_PROFILE_BACKEND=fake)."""
+
+    def __init__(self):
+        self.dirs: list[str] = []
+        self._open: str | None = None
+
+    def start(self, logdir: str) -> None:
+        import os
+
+        os.makedirs(logdir, exist_ok=True)
+        self._open = logdir
+        self.dirs.append(logdir)
+
+    def stop(self) -> None:
+        import os
+
+        if self._open is not None:
+            with open(os.path.join(self._open, "FAKE_CAPTURE"), "w") as f:
+                f.write("ok\n")
+            self._open = None

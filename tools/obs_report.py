@@ -153,17 +153,26 @@ def spans_section(trace_path: str, top: int = 8) -> list[str]:
     return out
 
 
-def events_section(events_dir: str) -> list[str]:
-    """Journal summary: per-category counts + the newest occurrence of
-    the events an operator reaches for first (rewind/restart/capture)."""
+def _load_events(events_dir: str) -> list[dict] | None:
+    """Parse the journal once (None = no journal directory at all)."""
     if not events_dir or not os.path.isdir(events_dir):
-        return ["events: no journal directory (obs.events off, or a "
-                "pre-journal run)"]
+        return None
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from pytorch_distributed_train_tpu.obs.events import load_events
 
-    events = load_events(events_dir)
+    return load_events(events_dir)
+
+
+def events_section(events_dir: str,
+                   events: list[dict] | None = None) -> list[str]:
+    """Journal summary: per-category counts + the newest occurrence of
+    the events an operator reaches for first (rewind/restart/capture)."""
+    if events is None:
+        events = _load_events(events_dir)
+    if events is None:
+        return ["events: no journal directory (obs.events off, or a "
+                "pre-journal run)"]
     if not events:
         return [f"events: journal at {events_dir} is empty"]
     by_cat: dict[str, int] = {}
@@ -196,14 +205,55 @@ def events_section(events_dir: str) -> list[str]:
     return out
 
 
+def serving_section(events_dir: str,
+                    events: list[dict] | None = None) -> list[str]:
+    """Serving-SLO summary from the ``serve`` journal category
+    (docs/serving_reliability.md): reliability-event counts by name +
+    the newest tail-latency / failover / drain — the one-line health of
+    the request path. A run with no serve events (training-only) gets a
+    single quiet line."""
+    if events is None:
+        events = _load_events(events_dir)
+    if events is None:
+        return []
+    serve = [e for e in events if e.get("category") == "serve"]
+    if not serve:
+        return ["serving: no serve events (training-only run, or the "
+                "reliability plane saw no incidents)"]
+    by_name: dict[str, int] = {}
+    for e in serve:
+        by_name[e.get("name", "?")] = by_name.get(e.get("name", "?"), 0) + 1
+    out = [f"serving ({len(serve)} serve events): "
+           + "  ".join(f"{n}={c}" for n, c in sorted(
+               by_name.items(), key=lambda kv: -kv[1]))]
+    for label, name in (("last tail anomaly", "tail_latency"),
+                        ("last failover", "failover"),
+                        ("last hedge", "hedge"),
+                        ("last drain", "drain_begin")):
+        hit = next((e for e in reversed(serve) if e.get("name") == name),
+                   None)
+        if hit is None:
+            out.append(f"  {label:<17} -")
+            continue
+        detail = " ".join(f"{k}={v}" for k, v in
+                          (hit.get("detail") or {}).items())[:56]
+        out.append(f"  {label:<17} [{hit.get('host')} "
+                   f"g{hit.get('gen')}] {detail}".rstrip())
+    return out
+
+
 def report(jsonl_path: str, trace_path: str = "",
            events_dir: str = "") -> str:
     recs = load_jsonl(jsonl_path)
     lines = [f"== run report: {jsonl_path} ({len(recs)} records) =="]
+    events = _load_events(events_dir)
     for section in (goodput_section(recs), trend_section(recs),
                     straggler_section(recs),
                     spans_section(trace_path),
-                    events_section(events_dir)):
+                    events_section(events_dir, events),
+                    serving_section(events_dir, events)):
+        if not section:
+            continue
         lines.append("")
         lines.extend(section)
     return "\n".join(lines)

@@ -57,7 +57,22 @@ API (JSON over POST, one object per request):
   ``chat.completion.chunk`` deltas. Stateless by definition (full
   history per call) — keep/session/prefix are refused here; resident-KV
   conversations live on ``/v1/completions``.
-- ``GET /healthz``: {status, stats} — liveness + batcher counters.
+- ``GET /healthz``: {status, reliability, stats} — liveness + batcher
+  counters + the reliability section (queue depth, slot occupancy,
+  admission state ``ok|shedding|draining``, SLO snapshot) the router's
+  probe and balancing read.
+- ``POST /admin/drain``: trigger the graceful drain over HTTP (same
+  path as SIGTERM; what the router's rolling restart walks).
+
+Reliability plane (serving_plane/, docs/serving_reliability.md):
+per-request deadlines (``deadline_s`` field or ``--deadline-default``;
+expiry cancels in the batcher — the KV slot frees NOW — and answers
+504), admission control (``--max-queue-depth`` / ``--shed-ttft`` →
+429 + ``Retry-After``), SLO metrics (TTFT / inter-token / queue-wait
+percentiles through /healthz and the obs registry), a goodput split of
+the scheduler loop (prefill/decode/stalled/idle), and a median+MAD
+tail-latency detector that journals ``serve`` events and can fire the
+managed profiler (``--profile-on-tail``).
 
 Threading model: request handler threads (ThreadingHTTPServer) enqueue
 into the batcher under a lock and wait on a per-request event; ONE
@@ -81,6 +96,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
 from pytorch_distributed_train_tpu.obs.exposition import (  # noqa: E402
     CONTENT_TYPE as _METRICS_CONTENT_TYPE,
     render_metrics,
@@ -92,16 +108,29 @@ from pytorch_distributed_train_tpu.faults import (  # noqa: E402
 from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
 from pytorch_distributed_train_tpu.obs.spans import span  # noqa: E402
 from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
+from pytorch_distributed_train_tpu.serving_plane import (  # noqa: E402
+    DeadlineExceeded,
+    OverloadShed,
+    ReliabilityPlane,
+    TailLatencyMonitor,
+)
 
 _PROFILER = None
 _PROFILER_LOCK = threading.Lock()
 
+# _done marker for a request cancelled at its deadline: the waiter maps
+# it to DeadlineExceeded (504), never to a Completion
+_DEADLINE = object()
+
 
 def _serving_profiler():
     """Lazy managed-profiler instance for the serving process (the
-    ``POST /profile`` route): ad-hoc time-bounded captures into
-    ``./profiles`` (or PDTT_PROFILE_DIR), ring-retained and
-    xplane-summarized like the trainer's."""
+    ``POST /profile`` route + tail-latency anomaly captures): ad-hoc
+    time-bounded captures into ``./profiles`` (or PDTT_PROFILE_DIR),
+    ring-retained and xplane-summarized like the trainer's.
+    ``PDTT_PROFILE_BACKEND=fake`` swaps in the marker-file backend
+    (serving_plane/testing.py) so subprocess drills can assert a
+    capture fired without a real jax trace session."""
     global _PROFILER
     with _PROFILER_LOCK:
         if _PROFILER is None:
@@ -110,9 +139,15 @@ def _serving_profiler():
                 ManagedProfiler,
             )
 
+            backend = None
+            if os.environ.get("PDTT_PROFILE_BACKEND") == "fake":
+                from pytorch_distributed_train_tpu.serving_plane.testing \
+                    import FakeCaptureBackend
+
+                backend = FakeCaptureBackend()
             cfg = ObsConfig(profile_dir=os.environ.get(
                 "PDTT_PROFILE_DIR", "profiles"))
-            _PROFILER = ManagedProfiler(cfg, run_dir=".")
+            _PROFILER = ManagedProfiler(cfg, run_dir=".", backend=backend)
         return _PROFILER
 
 
@@ -192,16 +227,30 @@ class BatcherService:
     single scheduler thread steps the device; callers submit and wait."""
 
     def __init__(self, batcher, tokenizer, *, idle_sleep_s: float = 0.005,
-                 max_new_default: int = 64):
+                 max_new_default: int = 64,
+                 plane: ReliabilityPlane | None = None,
+                 orphan_grace_s: float = 5.0):
         self.batcher = batcher
         self.tok = tokenizer
         self.max_new_default = max_new_default
+        # Reliability plane (serving_plane/): SLO tracking always on;
+        # admission control and deadlines engage only when its knobs
+        # are set, so a default-constructed service behaves as before.
+        self.plane = plane if plane is not None else ReliabilityPlane(
+            slots=getattr(batcher, "slots", 1))
         self._lock = threading.Lock()
         self._done: dict[int, object] = {}
+        self._done_ts: dict[int, float] = {}  # landing time (leak sweep)
         self._events: dict[int, threading.Event] = {}
         self._streams: dict[int, queue_mod.Queue] = {}  # uid -> chunk queue
         self._stream_seen: dict[int, int] = {}  # tokens already pushed
-        self._abandoned: set[int] = set()  # timed-out uids: discard results
+        # uid -> (chunk queue, landing ts) for streams whose keep=True
+        # completion LANDED (scheduler popped _streams) but whose waiter
+        # has not consumed the "done" yet: keeps the parked session
+        # reachable if the waiter dies in that window (leak sweep GC)
+        self._landed: dict[int, tuple] = {}
+        self._token_seen: dict[int, int] = {}  # SLO tap over EVERY request
+        self._orphan_grace_s = orphan_grace_s
         self.error: str | None = None  # scheduler-death reason (terminal)
         self._idle_sleep_s = idle_sleep_s
         self._stop = False
@@ -214,36 +263,90 @@ class BatcherService:
                 with self._lock:
                     busy = bool(self.batcher.queue
                                 or self.batcher.active_slots)
+                    stall_s = 0.0
+                    if busy:
+                        # `serve.slow_decode` fault point: an injected
+                        # delay in the decode quantum — the tail-latency
+                        # spike the TTFT/inter-token detectors exist to
+                        # catch; its sleep lands in the 'stalled' bucket
+                        # but still counts into the CADENCE sample below
+                        # (the user-visible inter-token gap includes it)
+                        t_stall = time.perf_counter()
+                        if _maybe_fire_fault("serve.slow_decode"):
+                            stall_s = time.perf_counter() - t_stall
+                            self.plane.goodput.account("stalled", stall_s)
+                    queued_before = {q.uid for q in self.batcher.queue
+                                     if hasattr(q, "uid")}
+                    admit0 = self.batcher.stats.get("admit_ms", 0.0)
+                    t_step = time.perf_counter()
                     finished = self.batcher.step() if busy else []
-                    # push newly generated tokens to streaming waiters
-                    fresh = self.batcher.new_tokens_since(self._stream_seen)
-                    for uid, toks in fresh.items():
-                        self._streams[uid].put(("tokens", toks))
-                        self._stream_seen[uid] += len(toks)
+                    step_dt = time.perf_counter() - t_step
+                    now = time.monotonic()
+                    if busy:
+                        # goodput split of the quantum: the batcher's own
+                        # admit_ms meter is the prefill share, the rest
+                        # is the batched decode
+                        prefill_s = max(0.0, (self.batcher.stats.get(
+                            "admit_ms", 0.0) - admit0) / 1e3)
+                        self.plane.goodput.account("prefill", prefill_s)
+                        self.plane.goodput.account(
+                            "decode", max(0.0, step_dt - prefill_s))
+                        queued_after = {q.uid for q in self.batcher.queue
+                                        if hasattr(q, "uid")}
+                        for uid in queued_before - queued_after:
+                            self.plane.on_admitted(uid, now=now)
+                    # one scan feeds both consumers: _token_seen covers
+                    # EVERY live request (streams included — the two
+                    # cursors advance in lockstep from submit), so the
+                    # SLO tap and the stream push share its fresh map
+                    total_new = 0
+                    if self._token_seen:
+                        for uid, toks in self.batcher.new_tokens_since(
+                                self._token_seen).items():
+                            self._token_seen[uid] += len(toks)
+                            total_new += len(toks)
+                            self.plane.on_tokens(uid, len(toks), now=now)
+                            q = self._streams.get(uid)
+                            if q is not None:
+                                q.put(("tokens", toks))
+                                self._stream_seen[uid] += len(toks)
+                    if busy and total_new:
+                        # decode cadence: quantum / tokens surfaced — the
+                        # inter-token series the tail detector watches
+                        # (stall included: it is user-visible latency)
+                        self.plane.on_inter_token(
+                            (stall_s + step_dt) / total_new, now=now)
                     for c in finished:
-                        if c.uid in self._abandoned:
-                            self._abandoned.discard(c.uid)
-                            self._streams.pop(c.uid, None)
-                            self._stream_seen.pop(c.uid, None)
-                            # A keep=True completion parks its session in
-                            # the batcher — but the waiter is gone, so no
-                            # client will ever learn (or release) the sid.
-                            # Free the slot instead of squatting until LRU
-                            # pressure happens to evict it.
-                            if getattr(c, "session", None) is not None:
-                                self.batcher.release(c.session)
-                            continue  # waiter gave up; drop, don't leak
+                        seen = self._token_seen.pop(c.uid, None)
+                        if seen is not None:
+                            if len(c.tokens) > seen:
+                                self.plane.on_tokens(
+                                    c.uid, len(c.tokens) - seen, now=now)
+                            self.plane.on_finish(
+                                c.uid,
+                                "ok" if c.finish_reason in ("eos", "length")
+                                else c.finish_reason, now=now)
                         q = self._streams.pop(c.uid, None)
                         if q is not None:
-                            seen = self._stream_seen.pop(c.uid, 0)
-                            if len(c.tokens) > seen:
-                                q.put(("tokens", c.tokens[seen:]))
+                            seen_s = self._stream_seen.pop(c.uid, 0)
+                            if len(c.tokens) > seen_s:
+                                q.put(("tokens", c.tokens[seen_s:]))
                             q.put(("done", c))
+                            if getattr(c, "session", None) is not None:
+                                # parked session in flight to the waiter:
+                                # stay reachable until it is consumed
+                                self._landed[c.uid] = (q, now)
                             continue  # streamed: never stored in _done
                         self._done[c.uid] = c
-                        ev = self._events.pop(c.uid, None)
+                        self._done_ts[c.uid] = now
+                        # NOT popped: the _events entry is the waiter's
+                        # liveness marker — the waiter removes it when it
+                        # collects, so the orphan sweep can tell "waiter
+                        # slow to wake" from "waiter gone" exactly
+                        ev = self._events.get(c.uid)
                         if ev is not None:
                             ev.set()
+                    self._sweep_locked(now)
             except Exception as e:  # noqa: BLE001 — must not die silently
                 # Device/compile errors are terminal for the only decode
                 # thread: record the reason (healthz flips to error), fail
@@ -257,9 +360,105 @@ class BatcherService:
                         q.put(("error", self.error))
                     self._streams.clear()
                     self._stream_seen.clear()
+                    self._token_seen.clear()
+                    self._landed.clear()
                 return
             if not busy:
                 time.sleep(self._idle_sleep_s)
+            else:
+                # Fairness gap: python locks are unfair — released and
+                # immediately re-acquired by this loop, a busy scheduler
+                # can starve handler threads (submit, cancel, SHED) for
+                # the whole busy period. One zero-sleep yields the GIL
+                # so a waiting handler actually wins the lock; intake
+                # must stay responsive exactly when the server is busy.
+                time.sleep(0)
+
+    # -------------------------------------------- reliability plane hooks
+    def _register_locked(self, uid: int, deadline_ts: float | None) -> None:
+        """Track a freshly submitted request (SLO record + token tap).
+        Runs in the same lock block as the submit, so the leak sweep
+        can never see a slot-holding uid it does not know."""
+        self._token_seen[uid] = 0
+        self.plane.on_submit(uid, deadline_ts)
+
+    def _forget_locked(self, uid: int, outcome: str) -> None:
+        """Close a request's SLO record from a cancel path. A no-op for
+        requests the scheduler already finished (their record closed at
+        completion) — outcomes never double-count."""
+        if self._token_seen.pop(uid, None) is not None:
+            self.plane.on_finish(uid, outcome)
+
+    def _release_dead_queue_session(self, q) -> None:
+        """A cancel raced its request's completion: the Completion is in
+        the (now unread) chunk queue. If it parked a session, release it
+        — otherwise the sid is known to nobody and squats a slot until
+        LRU pressure (the exactly-once half of the slot-leak fix)."""
+        try:
+            while True:
+                kind, payload = q.get_nowait()
+                if kind == "done" and getattr(payload, "session",
+                                              None) is not None:
+                    self.batcher.release(payload.session)
+        except queue_mod.Empty:
+            pass
+
+    def _expire_locked(self, uid: int, now: float) -> None:
+        """Deadline expiry: cancel in the batcher (queued or active —
+        the slot/KV frees NOW, not at natural completion) and fail the
+        waiter with the 504 marker."""
+        self.batcher.cancel(uid)
+        self._token_seen.pop(uid, None)
+        self.plane.on_finish(uid, "deadline", now=now)
+        events_lib.emit("serve", "deadline_expired", uid=uid)
+        q = self._streams.pop(uid, None)
+        if q is not None:
+            self._stream_seen.pop(uid, None)
+            q.put(("expired", f"request {uid} exceeded its deadline"))
+        ev = self._events.pop(uid, None)
+        if ev is not None:
+            self._done[uid] = _DEADLINE
+            self._done_ts[uid] = now
+            ev.set()
+
+    def _sweep_locked(self, now: float) -> None:
+        """Between-steps reliability sweep (scheduler thread, under the
+        service lock): (1) deadline expiries → cancel + 504; (2) slot
+        leaks — any slot-holding request with no live waiter is
+        reclaimed and counted (`serve_slot_leaks_total`), and a landed
+        completion nobody will ever collect has its parked session
+        released after a grace window."""
+        for uid in self.plane.take_expired(now=now):
+            self._expire_locked(uid, now)
+        active_uids = getattr(self.batcher, "active_uids", None)
+        if active_uids is None:
+            return  # minimal fake batchers (tests): no slot surface
+        waiters = set(self._events) | set(self._streams)
+        for uid in active_uids():
+            if uid in waiters or uid in self._done:
+                continue
+            self.batcher.cancel(uid)
+            self._token_seen.pop(uid, None)
+            self.plane.note_leak(uid, "active_slot")
+        for uid, t_done in list(self._done_ts.items()):
+            if uid in self._events or now - t_done < self._orphan_grace_s:
+                continue
+            c = self._done.pop(uid, None)
+            self._done_ts.pop(uid, None)
+            if c is None or c is _DEADLINE:
+                continue
+            if getattr(c, "session", None) is not None:
+                self.batcher.release(c.session)
+            self.plane.note_leak(uid, "orphan_done")
+        for uid, (q, t_land) in list(self._landed.items()):
+            # landed "done" (with a parked session) nobody consumed and
+            # nobody abandoned — a waiter thread that died without its
+            # except path running. Release after the same grace.
+            if now - t_land < self._orphan_grace_s:
+                continue
+            self._landed.pop(uid, None)
+            self._release_dead_queue_session(q)
+            self.plane.note_leak(uid, "orphan_stream")
 
     def healthy(self) -> bool:
         return self.error is None and self._thread.is_alive()
@@ -278,7 +477,8 @@ class BatcherService:
                    temperature: float, n: int,
                    timeout_s: float = 600.0, *,
                    logprobs: bool = False,
-                   penalties: dict | None = None) -> dict:
+                   penalties: dict | None = None,
+                   deadline_s: float | None = None) -> dict:
         """k independent sampled completions of one prompt. The prompt
         minus its last token prefills ONCE into a temporary prefix
         template; each of the k forks ingests just that final token (a
@@ -328,11 +528,15 @@ class BatcherService:
             for uid in events:
                 if not self.batcher.cancel(uid):
                     self._done.pop(uid, None)
+                    self._done_ts.pop(uid, None)
                 self._events.pop(uid, None)
+                self._forget_locked(uid, "cancelled")
 
+        deadline_ts = self.plane.resolve_deadline(deadline_s)
         with self._lock:
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
+            self.plane.admit_or_raise(len(self.batcher.queue))
             try:
                 if share and self.batcher.can_preload(len(ids) - 1):
                     # (a pure capacity check, not except RuntimeError: a
@@ -350,6 +554,7 @@ class BatcherService:
                         prefix=sid, **(penalties or {}))
                     events[uid] = threading.Event()
                     self._events[uid] = events[uid]
+                    self._register_locked(uid, deadline_ts)
             except (ValueError, RuntimeError):
                 _cleanup_locked()
                 raise
@@ -364,6 +569,12 @@ class BatcherService:
                     raise TimeoutError(f"completion {uid} timed out")
                 with self._lock:
                     c = self._done.pop(uid, None)
+                    self._done_ts.pop(uid, None)
+                    self._events.pop(uid, None)
+                if c is _DEADLINE:
+                    raise DeadlineExceeded(
+                        f"request {uid} exceeded its deadline; "
+                        "slot reclaimed")
                 if c is None:
                     raise RuntimeError(f"scheduler dead: {self.error}")
                 total_generated += len(c.tokens)
@@ -391,7 +602,8 @@ class BatcherService:
                  session: int | None = None, prefix: int | None = None,
                  stop: list[str] | None = None,
                  logprobs: bool = False,
-                 penalties: dict | None = None) -> dict:
+                 penalties: dict | None = None,
+                 deadline_s: float | None = None) -> dict:
         if stop:
             if keep:
                 raise ValueError(
@@ -400,10 +612,12 @@ class BatcherService:
             return self._complete_with_stop(
                 prompt, max_tokens, temperature, timeout_s,
                 session=session, prefix=prefix, stop=stop,
-                logprobs=logprobs, penalties=penalties)
+                logprobs=logprobs, penalties=penalties,
+                deadline_s=deadline_s)
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
+        deadline_ts = self.plane.resolve_deadline(deadline_s)
         ev = threading.Event()
         with self._lock:
             # Checked UNDER the lock: the scheduler's death path clears
@@ -411,22 +625,34 @@ class BatcherService:
             # check could enqueue an event nothing will ever set.
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
+            self.plane.admit_or_raise(len(self.batcher.queue))
             uid = self.batcher.submit(ids, max_tokens,
                                       temperature=temperature,
                                       eos_id=self.tok.eos_id,
                                       keep=keep, session=session,
                                       prefix=prefix, **(penalties or {}))
             self._events[uid] = ev
-        timed_out = not ev.wait(timeout_s)
+            self._register_locked(uid, deadline_ts)
+        # the scheduler's deadline sweep answers expiry (504 + slot
+        # reclaim); the local wait only needs to outlast it slightly
+        wait_s = timeout_s if deadline_ts is None else min(
+            timeout_s, max(0.0, deadline_ts - time.monotonic()) + 2.0)
+        timed_out = not ev.wait(wait_s)
         with self._lock:
             # The completion may have landed in the wait→lock window even
-            # on the timeout path — prefer returning it over abandoning
-            # (which would leak the stored result forever: uids never
-            # repeat, so nothing else would pop it).
+            # on the timeout path — prefer returning it over withdrawing.
             c = self._done.pop(uid, None)
+            self._done_ts.pop(uid, None)
+            self._events.pop(uid, None)  # this waiter is done waiting
             if timed_out and c is None:
-                self._events.pop(uid, None)
-                self._abandoned.add(uid)
+                # Withdraw NOW (the slot-leak fix, non-streamed flavor):
+                # a dead waiter's request must not decode on — and hold
+                # its KV slot — until natural completion.
+                self.batcher.cancel(uid)
+                self._forget_locked(uid, "timeout")
+        if c is _DEADLINE:
+            raise DeadlineExceeded(
+                f"request {uid} exceeded its deadline; slot reclaimed")
         if c is None:
             if timed_out:
                 raise TimeoutError(
@@ -448,7 +674,8 @@ class BatcherService:
     def _complete_with_stop(self, prompt, max_tokens, temperature,
                             timeout_s, *, session, prefix, stop,
                             logprobs: bool = False,
-                            penalties: dict | None = None) -> dict:
+                            penalties: dict | None = None,
+                            deadline_s: float | None = None) -> dict:
         """Stop-sequence completions ride the streaming tap: decode the
         accumulated text each tick, CANCEL the request at the first stop
         match (it stops consuming decode steps), trim the match out."""
@@ -456,7 +683,8 @@ class BatcherService:
                                             temperature, timeout_s,
                                             session=session,
                                             prefix=prefix,
-                                            penalties=penalties)
+                                            penalties=penalties,
+                                            deadline_s=deadline_s)
         acc: list[int] = []
         comp = None
         for toks, c in chunks:
@@ -497,22 +725,26 @@ class BatcherService:
     def stream(self, prompt: str, max_tokens: int, temperature: float,
                timeout_s: float = 600.0, *, keep: bool = False,
                session: int | None = None, prefix: int | None = None,
-               penalties: dict | None = None):
+               penalties: dict | None = None,
+               deadline_s: float | None = None):
         """Returns (uid, chunk iterator). Validation and submission run
         EAGERLY (so callers can reject before committing to a response);
         the iterator yields (new_token_ids, completion_or_None) chunks as
         the batched decode produces them, ending with the Completion.
         Returns (uid, prompt_token_count, iterator); ``timeout_s`` bounds
-        the wait for EACH chunk. A caller that stops consuming must call
-        ``abandon_stream(uid)`` (or ``cancel_stream`` to also stop the
-        decode)."""
+        the wait for EACH chunk (a deadline tightens it — a stalled
+        stream expires at the deadline, not at the generic timeout). A
+        caller that stops consuming must call ``abandon_stream(uid)``
+        (or ``cancel_stream`` to also stop the decode)."""
         ids = self.tok.encode(prompt)
         if not ids:
             raise ValueError("empty prompt after tokenization")
+        deadline_ts = self.plane.resolve_deadline(deadline_s)
         q: queue_mod.Queue = queue_mod.Queue()
         with self._lock:
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
+            self.plane.admit_or_raise(len(self.batcher.queue))
             uid = self.batcher.submit(ids, max_tokens,
                                       temperature=temperature,
                                       eos_id=self.tok.eos_id,
@@ -520,20 +752,30 @@ class BatcherService:
                                       prefix=prefix, **(penalties or {}))
             self._streams[uid] = q
             self._stream_seen[uid] = 0
+            self._register_locked(uid, deadline_ts)
 
         def chunks():
             while True:
+                wait_s = timeout_s if deadline_ts is None else min(
+                    timeout_s,
+                    max(0.05, deadline_ts - time.monotonic() + 2.0))
                 try:
-                    kind, payload = q.get(timeout=timeout_s)
+                    kind, payload = q.get(timeout=wait_s)
                 except queue_mod.Empty:
                     self.abandon_stream(uid)
                     raise TimeoutError(
-                        f"request {uid} produced no chunk for {timeout_s}s")
+                        f"request {uid} produced no chunk for {wait_s}s")
                 if kind == "tokens":
                     yield payload, None
                 elif kind == "done":
+                    # consumed: the waiter frame now holds the payload
+                    # (abandon_stream's `landed=` covers it from here)
+                    with self._lock:
+                        self._landed.pop(uid, None)
                     yield [], payload
                     return
+                elif kind == "expired":  # deadline sweep cancelled it
+                    raise DeadlineExceeded(str(payload))
                 else:  # "error"
                     raise RuntimeError(f"scheduler dead: {payload}")
 
@@ -541,28 +783,54 @@ class BatcherService:
 
     def cancel_stream(self, uid: int) -> None:
         """Cancel an in-flight streamed request (stop-sequence match) and
-        drop its tap. Unlike abandon_stream this adds NO _abandoned
-        marker: a canceled request never produces the future Completion
-        that would clear it (the marker would leak per stop forever); if
-        it raced to completion first, its result was already routed to
-        the (now unread) chunk queue and dies with it."""
+        drop its tap. If the request raced to completion first, any
+        session its keep=True completion parked is released from the
+        dead chunk queue — the exactly-once contract of the slot-leak
+        fix (before it, a raced keep-completion's session squatted a
+        slot nobody could ever release)."""
         with self._lock:
-            self.batcher.cancel(uid)
-            self._streams.pop(uid, None)
+            q = self._streams.pop(uid, None)
             self._stream_seen.pop(uid, None)
+            if not self.batcher.cancel(uid):
+                if q is None:  # landed already: the queue moved
+                    q, _ = self._landed.pop(uid, (None, None))
+                if q is not None:
+                    self._release_dead_queue_session(q)
+            self._forget_locked(uid, "cancelled")
 
-    def abandon_stream(self, uid: int) -> None:
+    def abandon_stream(self, uid: int, landed=None) -> None:
         """Stop tracking a streaming request whose consumer went away
-        (client disconnect, chunk timeout): its eventual completion is
-        discarded instead of queueing chunks nobody reads. A no-op once
-        the request already finished (the scheduler popped its stream) —
-        marking it abandoned then would leak the set entry forever, since
-        its uid never appears in a finished list again."""
+        (client disconnect, chunk timeout) — and WITHDRAW it from the
+        batcher. This is the abandoned-stream slot-leak fix: before it,
+        a stream abandoned between submit and first token kept decoding
+        into its KV slot until natural completion, and a keep=True
+        completion then parked a session nobody owned (a permanent slot
+        leak — exactly what the ``serve.slot_leak`` drill injects by
+        skipping the release below; the scheduler's leak sweep must
+        catch and reclaim it). If the completion already LANDED, its
+        queue (still holding the "done") is drained from ``_landed``;
+        ``landed=`` hands over a completion the caller consumed but
+        failed to deliver (final-chunk write died — the client never
+        learned the session id, so its parked session is released). A
+        no-op once the request finished AND its session was delivered."""
         with self._lock:
-            if self._streams.pop(uid, None) is None:
+            q = self._streams.pop(uid, None)
+            if q is None:
+                q, _ = self._landed.pop(uid, (None, None))
+                if q is not None:
+                    self._release_dead_queue_session(q)
+                elif landed is not None and getattr(
+                        landed, "session", None) is not None:
+                    self.batcher.release(landed.session)
                 return
             self._stream_seen.pop(uid, None)
-            self._abandoned.add(uid)
+            if _maybe_fire_fault("serve.slot_leak"):
+                return  # drill: walk away without releasing anything
+            if not self.batcher.cancel(uid):
+                # raced to completion: its parked session (if any) is in
+                # the dead queue — release exactly once
+                self._release_dead_queue_session(q)
+            self._forget_locked(uid, "abandoned")
 
     def stats(self) -> dict:
         # Snapshot WITHOUT the step lock: the counters are plain ints
@@ -631,6 +899,7 @@ class GracefulDrain:
             if self.draining:
                 return
             self.draining = True
+        events_lib.emit("serve", "drain_begin", grace_s=self.grace_s)
         print(f"[serve] draining: no new requests; waiting up to "
               f"{self.grace_s:.0f}s for in-flight to finish", flush=True)
         # The actual wait runs off-thread: a signal handler (or a test)
@@ -654,6 +923,7 @@ class GracefulDrain:
                   "still in flight — shutting down anyway", flush=True)
         else:
             print("[serve] drained; shutting down", flush=True)
+        events_lib.emit("serve", "drain_done", leftover=leftover)
         self.server.shutdown()  # unblocks serve_forever()
         self.service.shutdown()
 
@@ -663,28 +933,49 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
-        def _send(self, code: int, obj: dict):
+        def _send(self, code: int, obj: dict,
+                  headers: dict | None = None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _health_body(self, status: str) -> dict:
+            # Reliability section riding /healthz (lock-free w.r.t. the
+            # scheduler — a probe must not block behind a wedged decode):
+            # queue depth, slot occupancy, admission state and the SLO
+            # snapshot, so the router's balancing/probing needs no
+            # second endpoint. Omitted for plane-less service fakes
+            # (tests): their healthz keeps the pre-plane shape.
+            out = {"status": status, "stats": service.stats()}
+            batcher = getattr(service, "batcher", None)
+            plane = getattr(service, "plane", None)
+            if batcher is None or plane is None:
+                return out
+            depth = len(batcher.queue)
+            acct = getattr(batcher, "slot_accounting", lambda: {})()
+            rel = plane.snapshot(depth, acct)
+            if status == "draining":
+                rel["admission"] = "draining"
+            out["reliability"] = rel
+            return out
 
         def do_GET(self):
             if self.path == "/healthz":
                 if drain is not None and drain.draining:
                     # 503 so load balancers stop routing here; the body
                     # says WHY (a drain, not a failure).
-                    self._send(503, {"status": "draining",
-                                     "stats": service.stats()})
+                    self._send(503, self._health_body("draining"))
                 elif service.healthy():
-                    self._send(200, {"status": "ok",
-                                     "stats": service.stats()})
+                    self._send(200, self._health_body("ok"))
                 else:
-                    self._send(503, {"status": "error",
-                                     "error": service.error,
-                                     "stats": service.stats()})
+                    body = self._health_body("error")
+                    body["error"] = service.error
+                    self._send(503, body)
             elif self.path.split("?", 1)[0] == "/metrics":
                 # Prometheus scrape (obs/): request counters + latency
                 # histograms + batcher gauges, same registry the trainer
@@ -695,6 +986,11 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                         get_registry().gauge(
                             f"serve_batcher_{k}",
                             help="continuous-batcher counter").set(v)
+                for k, v in getattr(getattr(service, "batcher", None),
+                                    "slot_accounting", lambda: {})().items():
+                    get_registry().gauge(
+                        f"serve_slots_{k}",
+                        help="slot/queue occupancy at scrape time").set(v)
                 body = render_metrics().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", _METRICS_CONTENT_TYPE)
@@ -705,6 +1001,15 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                 self._send(404, {"error": "unknown path"})
 
         def do_POST(self):
+            if self.path.split("?", 1)[0] == "/admin/drain":
+                # The drain path over HTTP (same effect as SIGTERM): the
+                # router's rolling restart walks replicas through this.
+                if drain is None:
+                    self._send(503, {"error": "no drain controller"})
+                else:
+                    drain.request_drain()
+                    self._send(202, {"status": "draining"})
+                return
             if self.path.split("?", 1)[0] == "/profile":
                 # On-demand capture of the SERVING process (managed
                 # profiler plane, obs/profiler.py): time-bounded since
@@ -800,6 +1105,12 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                 max_tokens = int(req.get("max_tokens",
                                          service.max_new_default))
                 temperature = float(req.get("temperature", 0.0))
+                # per-request wall-clock budget (serving_plane deadlines:
+                # expiry cancels in the batcher and answers 504; the
+                # server's --deadline-default/--deadline-max knobs apply)
+                deadline_s = req.get("deadline_s")
+                deadline_s = (float(deadline_s)
+                              if deadline_s is not None else None)
                 keep = bool(req.get("keep", False))
                 session = req.get("session")
                 session = int(session) if session is not None else None
@@ -835,7 +1146,7 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                     out = service.complete_n(
                         prompt, max_tokens, temperature, n,
                         logprobs=bool(req.get("logprobs", False)),
-                        penalties=penalties)
+                        penalties=penalties, deadline_s=deadline_s)
                     self._send(200, _chat_response(out) if chat else out)
                     return
                 if req.get("stream"):
@@ -848,7 +1159,7 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                     uid, n_prompt, chunks = service.stream(
                         prompt, max_tokens, temperature, keep=keep,
                         session=session, prefix=prefix,
-                        penalties=penalties)
+                        penalties=penalties, deadline_s=deadline_s)
                     self._stream_sse(uid, chunks, stop=stop,
                                      n_prompt=n_prompt, chat=chat)
                     return
@@ -857,10 +1168,22 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                                        prefix=prefix, stop=stop,
                                        logprobs=bool(
                                            req.get("logprobs", False)),
-                                       penalties=penalties)
+                                       penalties=penalties,
+                                       deadline_s=deadline_s)
                 self._send(200, _chat_response(out) if chat else out)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
+            except OverloadShed as e:
+                # load shedding: the admission controller refused the
+                # queue slot — 429 with the standard back-off header;
+                # the body repeats it so relays (serve_router) can
+                # reconstruct the header they cannot see
+                self._send(429, {"error": str(e),
+                                 "retry_after_s": int(e.retry_after_s)},
+                           headers={"Retry-After":
+                                    str(int(e.retry_after_s))})
+            except DeadlineExceeded as e:
+                self._send(504, {"error": str(e)})
             except (TimeoutError, RuntimeError) as e:
                 # RuntimeError: scheduler dead OR no slot for preload
                 self._send(503, {"error": str(e)})
@@ -904,6 +1227,7 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
             acc: list[int] = []
             sent_text = ""
             stopped = False
+            undelivered = None  # consumed completion not yet sent
             try:
                 for toks, comp in chunks:
                     if not stopped and toks:
@@ -946,16 +1270,18 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                             if hit is not None:
                                 final, reason = final[: hit], "stop"
                         tail = final[len(sent_text):]
+                        undelivered = comp  # until the session goes out
                         emit({"delta": tail,
                               "finish_reason": reason,
                               "session": comp.session,
                               "usage": {
                                   "prompt_tokens": len(comp.prompt),
                                   "completion_tokens": len(comp.tokens)}})
+                        undelivered = None
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
             except OSError:  # client went away mid-stream
-                service.abandon_stream(uid)
+                service.abandon_stream(uid, landed=undelivered)
             except (TimeoutError, RuntimeError) as e:
                 try:
                     emit({"error": str(e)})
@@ -965,7 +1291,41 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
     return Handler
 
 
+def build_plane(args) -> ReliabilityPlane:
+    """ReliabilityPlane from the CLI knobs (docs/serving_reliability.md
+    has the full table). The tail-latency monitor is always armed
+    (journal-only); profiler captures engage with --profile-on-tail."""
+    monitor = None
+    if args.tail_sigma > 0:
+        monitor = TailLatencyMonitor(
+            sigma=args.tail_sigma,
+            profiler=(_serving_profiler() if args.profile_on_tail
+                      else None),
+            capture_seconds=args.tail_capture_seconds,
+            cooldown_s=args.tail_cooldown)
+    return ReliabilityPlane(
+        max_queue_depth=args.max_queue_depth,
+        shed_ttft_s=args.shed_ttft,
+        deadline_default_s=args.deadline_default,
+        deadline_max_s=args.deadline_max,
+        slots=args.slots, monitor=monitor)
+
+
 def build_service(args) -> BatcherService:
+    if args.fake_backend:
+        # Deterministic pure-Python token mill (serving_plane/testing.py)
+        # — the reliability drills' and slo_soak's backend: boots in
+        # import time, decode pace set by --fake-step-delay.
+        from pytorch_distributed_train_tpu.serving_plane.testing import (
+            FakeByteTok,
+            FakeTokenBatcher,
+        )
+
+        batcher = FakeTokenBatcher(slots=args.slots,
+                                   step_delay_s=args.fake_step_delay)
+        return BatcherService(batcher, FakeByteTok(),
+                              max_new_default=args.max_new_default,
+                              plane=build_plane(args))
     import jax
 
     from pytorch_distributed_train_tpu.config import get_preset
@@ -997,14 +1357,16 @@ def build_service(args) -> BatcherService:
                   top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
                   rng=jax.random.PRNGKey(args.seed), **extra)
     return BatcherService(batcher, tok,
-                          max_new_default=args.max_new_default)
+                          max_new_default=args.max_new_default,
+                          plane=build_plane(args))
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default="llama2_7b")
     p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE")
-    p.add_argument("--safetensors", required=True)
+    p.add_argument("--safetensors", default="",
+                   help="model weights (required unless --fake-backend)")
     p.add_argument("--tokenizer", default="",
                    help="local HF tokenizer dir; empty → byte tokenizer")
     p.add_argument("--host", default="127.0.0.1")
@@ -1042,7 +1404,47 @@ def main(argv=None) -> int:
                    help="seconds SIGTERM waits for in-flight requests "
                         "before shutting down (graceful drain; size "
                         "below the scheduler's kill grace)")
+    # ---- serving reliability plane (docs/serving_reliability.md) ----
+    p.add_argument("--max-queue-depth", type=int, default=0,
+                   help="admission control: shed (429 + Retry-After) "
+                        "once this many requests wait for a slot "
+                        "(0 = unbounded)")
+    p.add_argument("--shed-ttft", type=float, default=0.0,
+                   help="admission control: shed once the estimated "
+                        "TTFT for a new request exceeds this many "
+                        "seconds (0 = off)")
+    p.add_argument("--deadline-default", type=float, default=0.0,
+                   help="default per-request wall-clock budget in "
+                        "seconds; expiry cancels the request in the "
+                        "batcher and answers 504 (0 = no default; "
+                        "requests may still send deadline_s)")
+    p.add_argument("--deadline-max", type=float, default=0.0,
+                   help="cap on any client-requested deadline_s "
+                        "(0 = uncapped)")
+    p.add_argument("--tail-sigma", type=float, default=6.0,
+                   help="tail-latency anomaly detector: median+MAD "
+                        "sigma on TTFT / inter-token series "
+                        "(0 = detector off)")
+    p.add_argument("--tail-cooldown", type=float, default=60.0,
+                   help="seconds between anomaly-triggered profiler "
+                        "captures")
+    p.add_argument("--tail-capture-seconds", type=float, default=2.0,
+                   help="length of an anomaly-triggered capture")
+    p.add_argument("--profile-on-tail", action="store_true",
+                   help="fire the managed profiler on tail-latency "
+                        "anomalies (anomalies journal regardless)")
+    p.add_argument("--advertise", action="store_true",
+                   help="register host:port with the elastic launcher "
+                        "store so tools/serve_router.py discovers this "
+                        "replica (needs TPUSTORE_ADDR)")
+    p.add_argument("--fake-backend", action="store_true",
+                   help="serve a deterministic fake token batcher "
+                        "(tests, slo_soak, router drills — no model)")
+    p.add_argument("--fake-step-delay", type=float, default=0.0,
+                   help="with --fake-backend: seconds per decode step")
     args = p.parse_args(argv)
+    if not args.safetensors and not args.fake_backend:
+        p.error("--safetensors is required (or pass --fake-backend)")
 
     try:
         service = build_service(args)
@@ -1054,6 +1456,31 @@ def main(argv=None) -> int:
     drain = GracefulDrain(server, service, grace_s=args.drain_grace)
     server.RequestHandlerClass = make_handler(service, drain)
     drain.install()
+    if args.advertise:
+        from pytorch_distributed_train_tpu.elastic import (
+            publish_replica,
+            worker_store,
+        )
+
+        store = worker_store()
+        if store is None:
+            print("serve_http: --advertise ignored (no TPUSTORE_ADDR)",
+                  flush=True)
+        else:
+            # a wildcard bind is unconnectable from peers: advertise a
+            # routable address instead
+            host = args.host
+            if host in ("", "0.0.0.0", "::"):
+                import socket as _socket
+
+                try:
+                    host = _socket.gethostbyname(_socket.gethostname())
+                except OSError:
+                    host = _socket.gethostname()
+            idx = publish_replica(
+                store, f"{host}:{server.server_address[1]}")
+            print(f"serve_http: advertised as replica {idx} "
+                  f"({host}:{server.server_address[1]})", flush=True)
     print(f"serving on http://{args.host}:{server.server_address[1]} "
           f"(slots={args.slots})", flush=True)
     try:

@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Fault-tolerant multi-replica router over serve_http replicas.
+
+    # replicas (each on its own host/port, e.g. under a supervisor):
+    python tools/serve_http.py ... --port 8000 [--advertise]
+    python tools/serve_http.py ... --port 8001 [--advertise]
+
+    # the front:
+    python tools/serve_router.py --port 8080 \
+        --replica 127.0.0.1:8000 --replica 127.0.0.1:8001
+    # or discover replicas from the elastic launcher store:
+    TPUSTORE_ADDR=host:port python tools/serve_router.py --port 8080 --store
+
+Thin HTTP front (stdlib only, like serve_http) over N replicas, built
+on serving_plane/router.py:
+
+- **discovery** — static ``--replica`` list and/or the elastic
+  launcher store (``--store``: replicas registered by
+  ``serve_http --advertise``, re-read every probe round so late
+  arrivals join without a restart);
+- **health** — background ``/healthz`` probes drive per-replica state
+  (``up | draining | down``); flips are journaled (``serve`` events);
+- **balancing** — least outstanding requests among up replicas; a
+  replica whose own admission state says ``shedding`` ranks last;
+- **retry** — idempotent requests (no keep/session/prefix) retry on a
+  connect failure or retryable status (429/502/503): a dead or
+  draining replica costs a journaled failover, not a client error;
+  streams retry only before the first relayed byte;
+- **hedging** — ``--hedge-after S`` (fixed) or ``--hedge-pct 0.95``
+  (latency percentile): a straggling completion gets a second copy on
+  another replica, first answer wins (journaled ``hedge``/
+  ``hedge_win``);
+- **sessions** — replica-local KV: a ``keep`` completion's session id
+  is mapped to its replica and later ``session``/``prefix`` requests
+  pin there (never retried/hedged). Streamed first turns are not
+  tracked — open sessions with non-streamed requests through the
+  router;
+- **rolling restart** — ``POST /admin/rolling_restart`` (or
+  ``--rolling-restart`` one-shot) walks each replica through
+  serve_http's drain path (``/admin/drain``) one at a time: zero
+  failed requests for a fleet-wide restart.
+
+``GET /healthz`` answers 200 while at least one replica is routable,
+with the per-replica table in the body; ``GET /metrics`` exposes the
+router's own counters (failovers, hedges, replica flips).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
+from pytorch_distributed_train_tpu.obs.exposition import (  # noqa: E402
+    CONTENT_TYPE as _METRICS_CONTENT_TYPE,
+    render_metrics,
+)
+from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
+from pytorch_distributed_train_tpu.serving_plane.router import (  # noqa: E402
+    RETRYABLE_STATUSES,
+    HealthProber,
+    ReplicaSet,
+    Router,
+)
+
+_PROXY_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/preload",
+                "/profile")
+
+
+def make_handler(router: Router, prober: HealthProber):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, obj: dict):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _relay(self, code: int, body: bytes):
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if code == 429:
+                # rebuild the replica's back-off contract from the body
+                # (http_json strips headers): 429 without Retry-After
+                # makes clients hammer the overload admission damps
+                try:
+                    after = json.loads(body).get("retry_after_s")
+                except (ValueError, AttributeError):
+                    after = None
+                if after is not None:
+                    self.send_header("Retry-After", str(int(after)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                snap = router.replicas.snapshot()
+                up = sum(1 for r in snap if r["state"] == "up")
+                self._send(200 if up else 503,
+                           {"status": "ok" if up else "no_replicas",
+                            "up": up, "replicas": snap,
+                            "sessions": len(router.sessions)})
+            elif path == "/metrics":
+                body = render_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", _METRICS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            get_registry().counter(
+                "router_requests_total", labels={"path": path},
+                help="router requests by path").inc()
+            if path == "/admin/rolling_restart":
+                # walk replicas through their drain path off-thread; the
+                # report lands in the journal (serve/rolling_drain per
+                # replica), the client gets an immediate 202
+                threading.Thread(target=router.rolling_restart,
+                                 daemon=True,
+                                 name="rolling-restart").start()
+                self._send(202, {"status": "rolling"})
+                return
+            if path not in _PROXY_PATHS:
+                self._send(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) or b"{}"
+                body = json.loads(raw)
+            except ValueError:
+                self._send(400, {"error": "bad json"})
+                return
+            if isinstance(body, dict) and body.get("stream"):
+                self._proxy_stream(path, raw, body)
+                return
+            status, rbody = router.request(path, raw,
+                                           body if isinstance(body, dict)
+                                           else {})
+            self._relay(status, rbody)
+
+        def _proxy_stream(self, path: str, raw: bytes, body: dict):
+            """SSE passthrough: relay upstream bytes as they arrive.
+            Retry/failover happens only BEFORE the first relayed byte —
+            once deltas went out, re-running the request would duplicate
+            text, so an upstream death mid-stream ends the stream (the
+            client retries; idempotent by its own choice)."""
+            pinned, idempotent = router.classify(body)
+            tried: set[str] = set()
+            while True:
+                addr = pinned or router.replicas.pick(exclude=tried)
+                if addr is None:
+                    self._send(503, {"error": "no replica available"})
+                    return
+                tried.add(addr)
+                router.replicas.begin(addr)
+                try:
+                    upstream = urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"http://{addr}{path}", data=raw,
+                            headers={"Content-Type": "application/json"}),
+                        timeout=router.timeout_s)
+                except urllib.error.HTTPError as e:
+                    router.replicas.end(addr)
+                    if (e.code in RETRYABLE_STATUSES and idempotent
+                            and pinned is None):
+                        self._failover(addr, path, e.code)
+                        continue
+                    self._relay(e.code, e.read())
+                    return
+                except (urllib.error.URLError, OSError):
+                    router.replicas.end(addr)
+                    if pinned is None:
+                        self._failover(addr, path, 0)
+                        continue
+                    self._send(502, {"error": "session replica "
+                                              "unreachable"})
+                    return
+                try:
+                    self.send_response(upstream.status)
+                    self.send_header("Content-Type",
+                                     upstream.headers.get(
+                                         "Content-Type",
+                                         "text/event-stream"))
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    while True:
+                        chunk = upstream.read1(8192)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                except OSError:
+                    pass  # client or upstream went away mid-stream
+                finally:
+                    try:
+                        upstream.close()
+                    except OSError:
+                        pass
+                    router.replicas.end(addr)
+                return
+
+        @staticmethod
+        def _failover(addr: str, path: str, status: int) -> None:
+            events_lib.emit("serve", "failover", addr=addr, path=path,
+                            reason="stream_connect", status=status)
+            get_registry().counter(
+                "serve_failovers_total",
+                help="requests retried on another replica").inc()
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="HOST:PORT", help="static replica address "
+                   "(repeatable)")
+    p.add_argument("--store", action="store_true",
+                   help="discover replicas from the elastic launcher "
+                        "store (TPUSTORE_ADDR; serve_http --advertise)")
+    p.add_argument("--probe-interval", type=float, default=0.5)
+    p.add_argument("--down-after", type=int, default=2,
+                   help="consecutive failed probes before a replica is "
+                        "marked down")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="upstream request timeout seconds")
+    p.add_argument("--hedge-after", type=float, default=0.0,
+                   help="hedge a straggling completion onto a second "
+                        "replica after this many seconds (0 = off)")
+    p.add_argument("--hedge-pct", type=float, default=0.0,
+                   help="or: hedge after this percentile of recent "
+                        "request latencies (e.g. 0.95; needs >= 8 "
+                        "samples; 0 = off)")
+    p.add_argument("--rolling-restart", action="store_true",
+                   help="one-shot: drain every replica in turn through "
+                        "/admin/drain, print the report, exit")
+    args = p.parse_args(argv)
+
+    refresh = None
+    if args.store:
+        from pytorch_distributed_train_tpu.elastic import (
+            discover_replicas,
+            worker_store,
+        )
+
+        store = worker_store()
+        if store is None:
+            print("serve_router: --store needs TPUSTORE_ADDR",
+                  file=sys.stderr)
+            return 2
+        refresh = lambda: discover_replicas(store)  # noqa: E731
+    replicas = ReplicaSet(tuple(args.replica))
+    if not args.replica and refresh is None:
+        print("serve_router: no replicas (--replica or --store)",
+              file=sys.stderr)
+        return 2
+    prober = HealthProber(replicas, interval_s=args.probe_interval,
+                          down_after=args.down_after, refresh=refresh)
+    router = Router(replicas, timeout_s=args.timeout,
+                    hedge_after_s=args.hedge_after,
+                    hedge_pct=args.hedge_pct)
+    prober.start()
+    if args.rolling_restart:
+        report = router.rolling_restart()
+        print(json.dumps(report, indent=2))
+        prober.stop()
+        return 0
+    server = ThreadingHTTPServer((args.host, args.port),
+                                 make_handler(router, prober))
+    print(f"routing on http://{args.host}:{server.server_address[1]} "
+          f"over {len(replicas.addrs())} replica(s)", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        prober.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Seeded SLO soak of the serving reliability plane.
+
+    python tools/slo_soak.py                      # defaults: short soak
+    python tools/slo_soak.py --requests 300 --slow-decode \
+        "p=0.1:count=100000:delay=0.05"
+
+Mixed complete / stream / abandon / cancel / tight-deadline traffic
+from N seeded client threads against the FAKE token batcher
+(serving_plane/testing.py — the plane's behavior under load is the
+subject, not the model), with ``serve.slow_decode`` injected through
+the fault registry so the decode path actually stutters. Asserts the
+reliability plane's contract end to end:
+
+- **zero slot leaks** — ``serve_slot_leaks_total`` unchanged and every
+  slot free once traffic drains (abandoned/cancelled/expired requests
+  all released their slots);
+- **shed rate bounded** — admission control degraded, it didn't
+  collapse (and didn't refuse everything either);
+- **p99 TTFT within budget** — the SLO the whole plane exists to
+  defend, measured by the plane's own tracker.
+
+Exit 0 = all bounds held (the report prints either way). The tier-1
+smoke runs this with small numbers; the slow-marked test soaks longer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from pytorch_distributed_train_tpu.faults import registry as fregistry  # noqa: E402
+from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
+from pytorch_distributed_train_tpu.serving_plane import (  # noqa: E402
+    DeadlineExceeded,
+    OverloadShed,
+    ReliabilityPlane,
+    TailLatencyMonitor,
+)
+from pytorch_distributed_train_tpu.serving_plane.testing import (  # noqa: E402
+    FakeByteTok,
+    FakeTokenBatcher,
+)
+
+
+def run_soak(args) -> dict:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_http
+
+    if args.slow_decode:
+        fregistry.configure(
+            specs=(f"serve.slow_decode@{args.slow_decode}",),
+            seed=args.seed)
+    else:
+        fregistry.configure(seed=args.seed)
+    plane = ReliabilityPlane(
+        max_queue_depth=args.max_queue_depth,
+        shed_ttft_s=args.shed_ttft,
+        deadline_default_s=0.0,  # deadlines are per-request below
+        slots=args.slots,
+        monitor=TailLatencyMonitor(min_samples=8))
+    batcher = FakeTokenBatcher(slots=args.slots,
+                               step_delay_s=args.step_delay)
+    service = serve_http.BatcherService(batcher, FakeByteTok(),
+                                        plane=plane,
+                                        orphan_grace_s=0.5)
+    leaks0 = get_registry().get_value("serve_slot_leaks_total") or 0.0
+    counts = {"ok": 0, "shed": 0, "deadline": 0, "abandoned": 0,
+              "cancelled": 0, "error": 0}
+    lock = threading.Lock()
+
+    def note(k):
+        with lock:
+            counts[k] += 1
+
+    def client(ci: int):
+        rng = np.random.default_rng(args.seed * 1000 + ci)
+        for i in range(args.requests // args.clients):
+            prompt = f"client {ci} req {i} " + "x" * int(rng.integers(1, 24))
+            toks = int(rng.integers(4, 16))
+            kind = ["plain", "plain", "stream", "abandon", "cancel",
+                    "deadline"][int(rng.integers(0, 6))]
+            try:
+                if kind == "plain":
+                    service.complete(prompt, toks, 0.0, timeout_s=30.0)
+                    note("ok")
+                elif kind == "stream":
+                    _, _, chunks = service.stream(prompt, toks, 0.0,
+                                                  timeout_s=30.0)
+                    for _toks, c in chunks:
+                        if c is not None:
+                            break
+                    note("ok")
+                elif kind == "abandon":
+                    uid, _, chunks = service.stream(prompt, toks, 0.0,
+                                                    timeout_s=30.0)
+                    next(chunks, None)  # consume at most one tick
+                    service.abandon_stream(uid)
+                    note("abandoned")
+                elif kind == "cancel":
+                    uid, _, _chunks = service.stream(prompt, toks, 0.0,
+                                                     timeout_s=30.0)
+                    service.cancel_stream(uid)
+                    note("cancelled")
+                else:  # tight deadline: often expires mid-decode
+                    service.complete(
+                        prompt, toks, 0.0, timeout_s=30.0,
+                        deadline_s=float(rng.uniform(0.001, 0.05)))
+                    note("ok")
+            except OverloadShed:
+                note("shed")
+                time.sleep(0.005)  # honor the back-off in spirit
+            except DeadlineExceeded:
+                note("deadline")
+            except (TimeoutError, RuntimeError):
+                note("error")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    # drain: every slot must come back (the leak assertion's setup)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        acct = batcher.slot_accounting()
+        if acct["active"] == 0 and acct["queued"] == 0:
+            break
+        time.sleep(0.02)
+    time.sleep(2 * service._orphan_grace_s)  # let the orphan sweep run
+    wall = time.monotonic() - t0
+    leaks = (get_registry().get_value("serve_slot_leaks_total") or 0.0) \
+        - leaks0
+    acct = batcher.slot_accounting()
+    slo = plane.slo.snapshot()
+    service.shutdown()
+    total = sum(counts.values())
+    shed_rate = counts["shed"] / max(1, total)
+    return {"wall_s": round(wall, 2), "counts": counts,
+            "shed_rate": round(shed_rate, 4),
+            "slot_leaks": int(leaks), "slots": acct,
+            "ttft_p99_s": slo["ttft_s"]["p99"],
+            "inter_token_p99_s": slo["inter_token_s"]["p99"],
+            "scheduler_alive": service.error is None}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--requests", type=int, default=120)
+    p.add_argument("--clients", type=int, default=6)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--step-delay", type=float, default=0.002,
+                   help="fake batcher seconds per decode step")
+    p.add_argument("--max-queue-depth", type=int, default=16)
+    p.add_argument("--shed-ttft", type=float, default=0.0)
+    p.add_argument("--slow-decode",
+                   default="p=0.05:count=1000000:delay=0.03",
+                   help="serve.slow_decode spec clauses ('' = no "
+                        "injection)")
+    p.add_argument("--ttft-budget", type=float, default=2.0,
+                   help="p99 TTFT bound in seconds")
+    p.add_argument("--max-shed-rate", type=float, default=0.5)
+    args = p.parse_args(argv)
+
+    report = run_soak(args)
+    print("== slo_soak report ==")
+    for k, v in report.items():
+        print(f"  {k}: {v}")
+    ok = True
+    if not report["scheduler_alive"]:
+        print("FAIL: scheduler died", file=sys.stderr)
+        ok = False
+    if report["slot_leaks"] != 0:
+        print(f"FAIL: {report['slot_leaks']} slot leak(s)",
+              file=sys.stderr)
+        ok = False
+    if (report["slots"]["active"] != 0 or report["slots"]["queued"] != 0):
+        print(f"FAIL: slots not drained: {report['slots']}",
+              file=sys.stderr)
+        ok = False
+    if report["shed_rate"] > args.max_shed_rate:
+        print(f"FAIL: shed rate {report['shed_rate']} > "
+              f"{args.max_shed_rate}", file=sys.stderr)
+        ok = False
+    if report["ttft_p99_s"] > args.ttft_budget:
+        print(f"FAIL: p99 TTFT {report['ttft_p99_s']}s > "
+              f"{args.ttft_budget}s", file=sys.stderr)
+        ok = False
+    if ok:
+        print("slo_soak: all bounds held")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,7 @@ _MARKS = {
     "sentinel": "SENTINEL",
     "elastic": "ELASTIC",
     "preempt": "PREEMPT",
+    "serve": "SERVE",
     "lifecycle": "",
     "ckpt": "",
 }
@@ -54,6 +55,12 @@ _RECOVERIES = {
     ("ckpt", "restore"),
     ("ckpt", "restore_tier"),
     ("preempt", "sigterm"),
+    # serving-plane recoveries (docs/serving_reliability.md): a hedge or
+    # failover answered the incident on another replica; a drain walked
+    # the afflicted replica out of rotation
+    ("serve", "hedge"),
+    ("serve", "failover"),
+    ("serve", "drain_begin"),
 }
 
 # (category, name) pairs eliding must never drop: the run's SHAPE —
@@ -64,6 +71,9 @@ _LANDMARKS = _RECOVERIES | {
     ("elastic", "rendezvous_degraded"),
     ("elastic", "budget_exhausted"),
     ("sentinel", "hang_blamed"),
+    ("serve", "replica_down"),
+    ("serve", "rolling_drain"),
+    ("serve", "tail_latency"),
 }
 
 
