@@ -357,10 +357,10 @@ class ElasticAgent:
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
-        deadline = time.time() + self.cfg.shutdown_grace_s
+        deadline = time.monotonic() + self.cfg.shutdown_grace_s
         for p in self.procs:
             try:
-                p.wait(max(0.1, deadline - time.time()))
+                p.wait(max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 self._log(f"worker pid {p.pid} survived SIGTERM past the "
                           f"{self.cfg.shutdown_grace_s:.1f}s grace; "
@@ -447,7 +447,7 @@ class ElasticAgent:
                     self._emit("reshard", gen=rnd, nodes=len(members),
                                of=cfg.nnodes,
                                world=len(members) * cfg.nprocs)
-                t_spawn = time.time()
+                t_spawn = time.monotonic()
                 self._spawn(rnd, len(members), node_index)
                 rc = self._monitor(rnd)
                 if rc == 0:
@@ -455,7 +455,7 @@ class ElasticAgent:
                     self._log("all workers exited cleanly")
                     return 0
                 self._emit("worker_failed", gen=rnd, rc=rc)
-                ran_s = time.time() - t_spawn
+                ran_s = time.monotonic() - t_spawn
                 if ran_s >= cfg.stable_window_s and restarts_used:
                     # Windowed budget: this generation ran long enough to
                     # count as healthy — the failure is fresh bad luck,
@@ -496,12 +496,12 @@ class ElasticAgent:
                 # and timeouts are swallowed.
                 try:
                     if self.cfg.node_rank == 0:
-                        deadline = time.time() + 60.0
+                        deadline = time.monotonic() + 60.0
                         for r in self._members:
                             if r == 0:
                                 continue
-                            left_ms = max(1, int((deadline - time.time())
-                                                 * 1000))
+                            left_ms = max(1, int(
+                                (deadline - time.monotonic()) * 1000))
                             try:
                                 self.agent_client.wait(
                                     f"agents/exit/{self._last_gen}/{r}",
@@ -549,14 +549,14 @@ class ElasticAgent:
             c.add(f"rdzv/{rnd}/count", 1)
             members = self._close_round(rnd)
             return rnd, members, members.index(0)
-        deadline = time.time() + cfg.rendezvous_timeout_s
+        deadline = time.monotonic() + cfg.rendezvous_timeout_s
         while True:
-            left_ms = max(1, int((deadline - time.time()) * 1000))
+            left_ms = max(1, int((deadline - time.monotonic()) * 1000))
             cur = int(c.get("rdzv/open", timeout_ms=left_ms).decode())
             rnd = max(rnd, cur)
             c.set(f"rdzv/{rnd}/member/{cfg.node_rank}", b"1")
             c.add(f"rdzv/{rnd}/count", 1)
-            left_ms = max(1, int((deadline - time.time()) * 1000))
+            left_ms = max(1, int((deadline - time.monotonic()) * 1000))
             try:
                 raw = c.get(f"rdzv/{rnd}/world", timeout_ms=left_ms).decode()
             except TimeoutError:
@@ -578,7 +578,7 @@ class ElasticAgent:
             self._log(f"excluded from round {rnd} (arrived after it "
                       "closed); pre-registering for the next round")
             rnd += 1
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"no joinable round within "
                     f"{cfg.rendezvous_timeout_s:.0f}s (last tried {rnd})")
@@ -588,19 +588,19 @@ class ElasticAgent:
         list (the world) for generation ``rnd``."""
         c = self.agent_client
         cfg = self.cfg
-        deadline = time.time() + cfg.rendezvous_window_s
-        hard_deadline = time.time() + cfg.rendezvous_timeout_s
+        deadline = time.monotonic() + cfg.rendezvous_window_s
+        hard_deadline = time.monotonic() + cfg.rendezvous_timeout_s
         while True:
             n = c.add(f"rdzv/{rnd}/count", 0)
             if n >= cfg.nnodes:
                 break
-            if n >= max(cfg.min_nnodes, 1) and time.time() >= deadline:
+            if n >= max(cfg.min_nnodes, 1) and time.monotonic() >= deadline:
                 self._emit("rendezvous_degraded", gen=rnd, nodes=n,
                            of=cfg.nnodes)
                 self._log(f"rendezvous round {rnd}: window closed with "
                           f"{n}/{cfg.nnodes} nodes — proceeding degraded")
                 break
-            if time.time() >= hard_deadline:
+            if time.monotonic() >= hard_deadline:
                 raise TimeoutError(
                     f"rendezvous round {rnd}: only {n} of min "
                     f"{max(cfg.min_nnodes, 1)} nodes arrived within "
@@ -613,7 +613,7 @@ class ElasticAgent:
         # and shrinking the gang below the count that closed the round).
         n_final = c.add(f"rdzv/{rnd}/count", 0)
         members: list[int] = []
-        sweep_deadline = time.time() + 30.0
+        sweep_deadline = time.monotonic() + 30.0
         while True:
             members = []
             for r in range(cfg.nnodes):
@@ -622,7 +622,7 @@ class ElasticAgent:
                     members.append(r)
                 except TimeoutError:
                     pass
-            if len(members) >= n_final or time.time() >= sweep_deadline:
+            if len(members) >= n_final or time.monotonic() >= sweep_deadline:
                 break
             time.sleep(0.05)
         c.set(f"rdzv/{rnd}/world", ",".join(map(str, members)).encode())

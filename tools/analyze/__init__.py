@@ -1,0 +1,11 @@
+"""pdtt-analyze: pluggable AST-based correctness linter for this repo's
+concurrency, clock, tracing and contract invariants.
+
+Run ``python -m tools.analyze`` from the repo root; see
+docs/static_analysis.md for the pass catalog and baseline workflow.
+"""
+
+from tools.analyze.baseline import DEFAULT_BASELINE, Baseline  # noqa: F401
+from tools.analyze.core import (AnalysisPass, Context,  # noqa: F401
+                                Finding, REGISTRY, all_passes,
+                                build_context, register)

@@ -1,0 +1,11 @@
+import os
+import sys
+
+# Runnable both as `python -m tools.analyze` (repo root on sys.path
+# already) and as `python tools/analyze` from anywhere.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools.analyze.cli import main  # noqa: E402
+
+sys.exit(main())

@@ -1,0 +1,100 @@
+"""Baseline suppressions: pre-existing / intentionally-accepted findings.
+
+The baseline file is JSON::
+
+    {"suppressions": [
+        {"pass": "monotonic-clock",
+         "path": "pytorch_distributed_train_tpu/obs/events.py",
+         "key": "rec = {\"ts\": time.time(),",
+         "reason": "journal timestamps are wall-clock on purpose"}]}
+
+Identity is the finding fingerprint (pass, path, key) — the key is the
+stripped source line, so entries survive line-number drift but expire
+the moment the flagged code changes. An entry that matches no current
+finding is *stale*: reported (so fixed violations lose their
+suppression promptly) and dropped by the next ``--write-baseline``.
+Every entry carries a human ``reason`` — a suppression without a why is
+just drift with extra steps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tools.analyze.core import Finding
+
+DEFAULT_BASELINE = os.path.join("tools", "analyze", "baseline.json")
+
+
+class Baseline:
+    def __init__(self, entries: list[dict] | None = None,
+                 path: str | None = None):
+        self.path = path
+        self.entries = list(entries or [])
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entries = data.get("suppressions", [])
+        for e in entries:
+            if not {"pass", "path", "key"} <= set(e):
+                raise ValueError(
+                    f"baseline entry missing pass/path/key: {e!r}")
+        return cls(entries, path=path)
+
+    def apply(self, findings: list[Finding]
+              ) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """Split into (unsuppressed, suppressed, stale_entries)."""
+        by_fp: dict[tuple, dict] = {
+            (e["pass"], e["path"], e["key"]): e for e in self.entries}
+        used: set[tuple] = set()
+        unsuppressed: list[Finding] = []
+        suppressed: list[Finding] = []
+        for f in findings:
+            if f.fingerprint in by_fp:
+                used.add(f.fingerprint)
+                suppressed.append(f)
+            else:
+                unsuppressed.append(f)
+        stale = [e for fp, e in by_fp.items() if fp not in used]
+        return unsuppressed, suppressed, stale
+
+    @staticmethod
+    def write(path: str, findings: list[Finding],
+              previous: "Baseline | None" = None,
+              keep: list[dict] | None = None) -> int:
+        """Rewrite ``path`` to suppress exactly ``findings`` plus the
+        out-of-scope ``keep`` entries, carrying reasons forward from
+        ``previous`` where fingerprints still match (expiry: stale
+        in-scope entries simply aren't rewritten). ``keep`` is how a
+        scoped run (``--only``/explicit paths) avoids silently deleting
+        suppressions it never re-evaluated."""
+        old_reasons: dict[tuple, str] = {}
+        if previous is not None:
+            for e in previous.entries:
+                old_reasons[(e["pass"], e["path"], e["key"])] = \
+                    e.get("reason", "")
+        entries = []
+        seen: set[tuple] = set()
+        for e in sorted(keep or [],
+                        key=lambda e: (e["pass"], e["path"], e["key"])):
+            fp = (e["pass"], e["path"], e["key"])
+            if fp not in seen:
+                seen.add(fp)
+                entries.append(dict(e))
+        for f in sorted(findings, key=lambda f: (f.pass_id, f.path, f.key)):
+            if f.fingerprint in seen:
+                continue
+            seen.add(f.fingerprint)
+            entries.append({
+                "pass": f.pass_id, "path": f.path, "key": f.key,
+                "reason": old_reasons.get(f.fingerprint,
+                                          "TODO: justify or fix"),
+            })
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"suppressions": entries}, f, indent=2,
+                      ensure_ascii=False)
+            f.write("\n")
+        return len(entries)

@@ -1,0 +1,168 @@
+"""pdtt-analyze runner: ``python -m tools.analyze``.
+
+Exit codes: 0 = no unsuppressed findings; 1 = findings; 2 = usage
+error (unknown pass, unreadable baseline). Stale baseline entries are
+reported but don't fail the run — a fixed violation keeping its
+suppression one run too long is safe; the next ``--write-baseline``
+drops it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.analyze import baseline as baseline_lib
+from tools.analyze import core
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="AST-based correctness linter for the repo's "
+                    "concurrency/clock/tracing/contract invariants")
+    p.add_argument("paths", nargs="*",
+                   help="repo-relative files to analyze (default: the "
+                        "whole production surface)")
+    p.add_argument("--only", default=None, metavar="PASS[,PASS...]",
+                   help="run only these passes")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline suppressions file (default: "
+                        f"{baseline_lib.DEFAULT_BASELINE} when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline to suppress every current "
+                        "finding (stale entries expire)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--list-passes", action="store_true",
+                   help="list registered passes and exit")
+    return p
+
+
+def main(argv: list[str] | None = None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    args = build_parser().parse_args(argv)
+    passes = core.all_passes()
+
+    if args.list_passes:
+        for pid in sorted(passes):
+            print(f"{pid:22s} {passes[pid].description}", file=out)
+        return 0
+
+    if args.only:
+        wanted = [p.strip() for p in args.only.split(",") if p.strip()]
+        unknown = [p for p in wanted if p not in passes]
+        if unknown:
+            print(f"analyze: unknown pass(es): {', '.join(unknown)} "
+                  f"(have: {', '.join(sorted(passes))})", file=sys.stderr)
+            return 2
+        passes = {pid: passes[pid] for pid in wanted}
+
+    root = os.path.abspath(args.root) if args.root else _repo_root()
+    paths = list(args.paths) or None
+    if paths:
+        missing = [p for p in paths
+                   if not os.path.isfile(os.path.join(root, p))]
+        if missing:
+            # A typo'd CI path must not stay green having analyzed
+            # nothing — same class of mistake as an unknown pass.
+            print(f"analyze: no such file(s): {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+    ctx = core.build_context(root, paths)
+
+    findings: list[core.Finding] = []
+    for pid in sorted(passes):
+        findings.extend(passes[pid].run(ctx))
+    # A file no pass could parse is unenforced, not clean — surface it
+    # as a finding so the gate fails (baselinable like any other, with
+    # a reason, if someone truly ships unparseable python).
+    for sf in ctx.files:
+        if sf.tree is None:
+            findings.append(core.Finding(
+                "parse-error", sf.path, 1,
+                "file does not parse — every invariant pass skipped it",
+                key="parse-error"))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.key))
+
+    bl = None
+    bl_path = args.baseline
+    if not args.no_baseline:
+        if bl_path is None:
+            default = os.path.join(root, baseline_lib.DEFAULT_BASELINE)
+            bl_path = default if os.path.exists(default) else None
+        if bl_path is not None:
+            if not os.path.exists(bl_path) and args.write_baseline:
+                bl = None  # --write-baseline creates it below
+            else:
+                try:
+                    bl = baseline_lib.Baseline.load(bl_path)
+                except (OSError, ValueError, json.JSONDecodeError) as e:
+                    print(f"analyze: cannot read baseline {bl_path}: {e}",
+                          file=sys.stderr)
+                    return 2
+
+    if args.write_baseline:
+        target = bl_path or os.path.join(root, baseline_lib.DEFAULT_BASELINE)
+        keep: list[dict] = []
+        if bl is not None and (args.only or args.paths):
+            # A scoped run only re-evaluated (selected passes ×
+            # analyzed files): entries outside that product were not
+            # looked at and must survive the rewrite.
+            analyzed = {sf.path for sf in ctx.files}
+            keep = [e for e in bl.entries
+                    if e["pass"] not in passes
+                    or e["path"] not in analyzed]
+        n = baseline_lib.Baseline.write(target, findings, previous=bl,
+                                        keep=keep)
+        print(f"analyze: wrote {n} suppression(s) to "
+              f"{os.path.relpath(target, root)}", file=out)
+        return 0
+
+    if bl is not None:
+        unsuppressed, suppressed, stale = bl.apply(findings)
+    else:
+        unsuppressed, suppressed, stale = findings, [], []
+
+    syntax_errors = [sf.path for sf in ctx.files if sf.tree is None]
+
+    if args.format == "json":
+        json.dump({
+            "passes": sorted(passes),
+            "findings": [f.as_dict() for f in unsuppressed],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline": stale,
+            "syntax_errors": syntax_errors,
+            "counts": {"findings": len(unsuppressed),
+                       "suppressed": len(suppressed),
+                       "stale_baseline": len(stale)},
+        }, out, indent=2, ensure_ascii=False)
+        out.write("\n")
+    else:
+        for f in unsuppressed:
+            print(f.render(), file=out)
+        for e in stale:
+            print(f"analyze: stale baseline entry (nothing matches it "
+                  f"anymore): {e['pass']} {e['path']} {e['key']!r}"
+                  + (f" — {e['reason']}" if e.get("reason") else ""),
+                  file=out)
+        summary = (f"analyze: {len(unsuppressed)} finding(s), "
+                   f"{len(suppressed)} suppressed, {len(stale)} stale "
+                   f"baseline entr{'y' if len(stale) == 1 else 'ies'}, "
+                   f"{len(passes)} pass(es) over {len(ctx.files)} files")
+        print(summary, file=out)
+
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

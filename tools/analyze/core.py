@@ -1,0 +1,293 @@
+"""pdtt-analyze core: the pass framework every plugin builds on.
+
+The repo's correctness planes (serving, ckpt, sentinel, elastic, obs)
+rest on conventions no interpreter enforces: no blocking work under a
+service lock, monotonic clocks for deadline math, host-sync-free jitted
+step functions, and code↔doc catalog sync. Each convention is a *pass*
+here — an AST walk producing :class:`Finding`s — registered into one
+runner so a new invariant is one new module, not one new script.
+
+Contracts:
+
+- a Finding's ``fingerprint`` (pass id, repo-relative path, key) is the
+  baseline-suppression identity; the key defaults to the stripped source
+  line so findings survive unrelated line-number drift;
+- passes see the repo through a :class:`Context` (pre-parsed
+  :class:`SourceFile`s + ``repo_root``) so tests can hand them a tmp
+  tree or a single fixture file;
+- ``include`` patterns scope a pass to the subsystems whose invariant it
+  checks (a trailing ``/`` means prefix, otherwise fnmatch) — noise
+  control is part of the pass contract, not the caller's job.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+
+
+# --------------------------------------------------------------- findings
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    message: str
+    severity: str = "error"   # "error" | "warning" (display only: any
+    key: str = ""             # unsuppressed finding fails the run)
+
+    @property
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.pass_id, self.path, self.key)
+
+    def as_dict(self) -> dict:
+        return {"pass": self.pass_id, "path": self.path, "line": self.line,
+                "severity": self.severity, "message": self.message,
+                "key": self.key}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_id}] "
+                f"{self.severity}: {self.message}")
+
+
+# ------------------------------------------------------------ source files
+class SourceFile:
+    """One parsed python file; ``tree`` is None on syntax errors (the
+    runner reports those once instead of every pass tripping over them).
+    """
+
+    def __init__(self, repo_root: str, relpath: str):
+        self.path = relpath.replace(os.sep, "/")
+        self.abspath = os.path.join(repo_root, relpath)
+        try:
+            with open(self.abspath, encoding="utf-8") as f:
+                self.text = f.read()
+        except UnicodeDecodeError:
+            # One stray latin-1 byte must not kill the CI gate; the
+            # replacement char at worst turns into a SyntaxError below,
+            # which the runner reports as a skipped file.
+            with open(self.abspath, encoding="utf-8",
+                      errors="replace") as f:
+                self.text = f.read()
+        self.lines = self.text.splitlines()
+        try:
+            self.tree: ast.AST | None = ast.parse(self.text,
+                                                  filename=self.path)
+        except SyntaxError:
+            self.tree = None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+# Repo-relative roots the default discovery walks; tests/ is excluded on
+# purpose (test code blocks and wall-clocks freely) and the analyzer's
+# own fixtures are seeded violations, not findings.
+DEFAULT_ROOTS = ("pytorch_distributed_train_tpu", "tools",
+                 "train.py", "tpurun.py", "bench.py")
+EXCLUDE_PARTS = ("__pycache__",)
+EXCLUDE_PREFIXES = ("tools/analyze/fixtures/",)
+
+
+def discover(repo_root: str, roots=DEFAULT_ROOTS) -> list[str]:
+    out: list[str] = []
+    for root in roots:
+        top = os.path.join(repo_root, root)
+        if os.path.isfile(top) and root.endswith(".py"):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d not in EXCLUDE_PARTS]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), repo_root)
+                rel = rel.replace(os.sep, "/")
+                if any(rel.startswith(p) for p in EXCLUDE_PREFIXES):
+                    continue
+                out.append(rel)
+    return sorted(set(out))
+
+
+class Context:
+    """What a pass sees: the parsed files plus the repo root (catalog
+    passes resolve ``docs/`` against it)."""
+
+    def __init__(self, repo_root: str, relpaths: list[str] | None = None):
+        self.repo_root = os.path.abspath(repo_root)
+        # Explicit paths = a PARTIAL view: passes that check global
+        # completeness ("every documented name has a site somewhere")
+        # must skip the direction that needs the whole surface, or a
+        # single-file run drowns in false phantom/unemitted findings.
+        self.partial = relpaths is not None
+        if relpaths is None:
+            relpaths = discover(self.repo_root)
+        self.files: list[SourceFile] = []
+        for rel in relpaths:
+            try:
+                self.files.append(SourceFile(self.repo_root, rel))
+            except OSError:
+                continue
+        self.by_path = {sf.path: sf for sf in self.files}
+
+    def doc_path(self, *parts: str) -> str:
+        return os.path.join(self.repo_root, *parts)
+
+
+def build_context(repo_root: str, paths: list[str] | None = None) -> Context:
+    return Context(repo_root, paths)
+
+
+def path_matches(relpath: str, patterns) -> bool:
+    for pat in patterns:
+        if pat.endswith("/"):
+            if relpath.startswith(pat):
+                return True
+        elif fnmatch.fnmatch(relpath, pat):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- passes
+class AnalysisPass:
+    """Base class: subclass, set ``id``/``description``/``include``,
+    implement ``run(ctx) -> list[Finding]``, and decorate with
+    :func:`register`."""
+
+    id: str = ""
+    description: str = ""
+    include: tuple = ("**",)   # every discovered file by default
+
+    def files(self, ctx: Context):
+        for sf in ctx.files:
+            if sf.tree is not None and path_matches(sf.path, self.include):
+                yield sf
+
+    def finding(self, sf: SourceFile, node: ast.AST, message: str, *,
+                severity: str = "error", key: str | None = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(self.id, sf.path, line, message, severity,
+                       key if key is not None else sf.line_text(line))
+
+    def run(self, ctx: Context) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+REGISTRY: dict[str, AnalysisPass] = {}
+
+
+def register(cls):
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"pass {cls.__name__} has no id")
+    REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_passes() -> dict[str, AnalysisPass]:
+    # Importing the package registers the built-ins exactly once.
+    from tools.analyze import passes  # noqa: F401
+
+    return dict(REGISTRY)
+
+
+# ------------------------------------------------------------ doc tables
+def doc_table_names(doc_path: str, section: str, row_re) -> set:
+    """First backticked column of every table row under the ``## ...``
+    heading ``section`` (case-insensitive, that section only) — the one
+    markdown contract parser all three catalog passes share."""
+    names = set()
+    in_section = False
+    with open(doc_path, encoding="utf-8") as f:
+        for line in f:
+            if line.startswith("## "):
+                in_section = line.strip().lower() == section
+                continue
+            if in_section:
+                m = row_re.match(line)
+                if m:
+                    names.add(m.group(1))
+    return names
+
+
+# ------------------------------------------------------------ AST helpers
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_call_to(node: ast.AST, name: str) -> bool:
+    return isinstance(node, ast.Call) and dotted(node.func) == name
+
+
+def walk_no_nested_defs(body):
+    """Yield nodes from ``body`` statements without descending into
+    nested function/lambda/class bodies — for lexical "runs here, now"
+    questions (a closure defined under a lock does not run under it)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# Condition counts: `with self._cond:` acquires its lock, and
+# Condition.wait is the one blocking call that correctly releases it.
+LOCK_FACTORIES = ("threading.Lock", "threading.RLock",
+                  "threading.Condition")
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """Names X for every ``self.X = threading.Lock()/RLock()`` in the
+    class body (any method — locks made outside __init__ still count)."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if dotted(node.value.func) in LOCK_FACTORIES:
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        out.add(tgt.attr)
+    return out
+
+
+def module_lock_names(tree: ast.AST) -> set[str]:
+    """Module-global ``_LOCK = threading.Lock()`` style names."""
+    out: set[str] = set()
+    for node in getattr(tree, "body", []):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if dotted(node.value.func) in LOCK_FACTORIES:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def withitem_lock_name(item: ast.withitem,
+                       self_locks: set[str],
+                       global_locks: set[str]) -> str | None:
+    """'self._lock' / '_LOCK' when the withitem enters a known lock."""
+    expr = item.context_expr
+    # `with lock:` and `with lock_factory_result:`; also `lock.acquire()`
+    # never appears as a withitem so Call forms are ignored.
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self" and expr.attr in self_locks):
+        return f"self.{expr.attr}"
+    if isinstance(expr, ast.Name) and expr.id in global_locks:
+        return expr.id
+    return None

@@ -1,0 +1,25 @@
+"""Seeded jit-purity violations: host syncs inside jitted functions."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_step(state, batch):
+    print("loss:", state)                 # VIOLATION: trace-time print
+    lr = float(state.lr)                  # VIOLATION: host sync
+    t0 = time.time()                      # VIOLATION: trace-time clock
+    host = np.asarray(batch)              # VIOLATION: host materialization
+    s = state.loss.item()                 # VIOLATION: host sync
+    if batch:                             # VIOLATION: traced-value branch
+        s = s + 1
+    return lr, t0, host, s
+
+
+def wrapped_step(state, batch):
+    print("wrapped")                      # VIOLATION: found via jax.jit(f)
+    return state
+
+
+jitted = jax.jit(wrapped_step, donate_argnums=(0,))

@@ -1,0 +1,33 @@
+"""Clean jit patterns the purity pass must NOT flag."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_step(state, batch):
+    jax.debug.print("loss {}", state)     # the correct print-under-jit
+    if batch.ndim == 3:                   # static shape metadata: fine
+        batch = batch.reshape(len(batch), -1)
+    if state.dynamic_scale is not None:   # pytree structure: static
+        batch = batch * 2
+    return jnp.sum(batch)
+
+
+def helper_not_jitted(batch):
+    # Never jitted (only referenced by name, never wrapped): host work
+    # is allowed here.
+    print("host side")
+    return np.asarray(batch)
+
+
+def outer(config):
+    flag = config.use_extra
+
+    @jax.jit
+    def inner(x):
+        if flag:                          # closure var, not a param: fine
+            x = x + 1
+        return x
+
+    return inner

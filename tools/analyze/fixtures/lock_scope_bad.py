@@ -1,0 +1,44 @@
+"""Seeded lock-scope violations: blocking work under a held lock."""
+import queue
+import subprocess
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._done = threading.Event()
+
+    def quantum(self):
+        with self._lock:
+            time.sleep(0.1)              # VIOLATION: sleep under lock
+            item = self._q.get()         # VIOLATION: queue get under lock
+            self._done.wait(1.0)         # VIOLATION: event wait under lock
+            subprocess.run(["true"])     # VIOLATION: subprocess under lock
+            with open("/tmp/x") as f:    # VIOLATION: file I/O under lock
+                f.read()
+        return item
+
+
+def module_level():
+    with _LOCK:
+        time.sleep(0.5)                  # VIOLATION: global lock held
+
+
+def smuggled_in_withitem(svc):
+    with svc._lock, open("/tmp/y") as f:  # noqa — parse-only fixture
+        return f.name
+
+
+class Smuggler:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def read(self):
+        # VIOLATION: the second withitem evaluates with the lock held
+        with self._lock, open("/tmp/y") as f:
+            return f.name

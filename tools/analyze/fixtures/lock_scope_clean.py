@@ -1,0 +1,28 @@
+"""Clean lock-scope patterns the pass must NOT flag."""
+import threading
+import time
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+
+    def quantum(self):
+        with self._lock:
+            pending = list(range(3))     # pure compute under lock: fine
+        time.sleep(0.1)                  # blocking OUTSIDE the lock
+        with self._lock:
+            def later():
+                time.sleep(1.0)          # closure body: runs later
+            self._cb = later
+        return pending
+
+    def waiter(self):
+        with self._cond:
+            self._cond.wait(1.0)         # Condition.wait releases the lock
+
+    def other(self):
+        with self._stop:                 # not a known lock attr
+            pass

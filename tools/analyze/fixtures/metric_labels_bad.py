@@ -1,0 +1,18 @@
+"""Seeded metric-catalog violations: undocumented name + unbounded labels."""
+
+
+def handler(registry, request_id, outcome):
+    # VIOLATION: name not in any doc catalog (when run against a doc
+    # without this row) + unbounded per-request id label value.
+    registry.counter("fixture_requests_total",
+                     labels={"rid": request_id}).inc()
+    # VIOLATION: f-string label value is unbounded by construction.
+    registry.counter("fixture_errors_total",
+                     labels={"who": f"user-{outcome}"}).inc()
+    # VIOLATION: str(...) label value.
+    registry.gauge("fixture_depth",
+                   labels={"shard": str(outcome)}).set(1)
+    # VIOLATION: labels passed POSITIONALLY (the registry's second
+    # parameter) must be linted the same as labels=.
+    registry.counter("fixture_requests_total",
+                     {"uid": request_id}).inc()

@@ -1,0 +1,28 @@
+"""Seeded monotonic-clock violations: wall time in deadline math."""
+import time
+
+
+def drain(grace_s: float):
+    deadline = time.time() + grace_s          # VIOLATION: wall deadline
+    while time.time() < deadline:             # VIOLATION: wall compare
+        pass
+
+
+def backoff(last_attempt, retry_after_s):
+    elapsed = time.time() - last_attempt
+    if elapsed > retry_after_s:               # VIOLATION: tainted compare
+        return True
+    return False
+
+
+def remaining(store, deadline):
+    left = deadline - time.time()
+    store.get("key", timeout_ms=int(left * 1000))   # VIOLATION: timeout kw
+
+
+class Prober:
+    def __init__(self):
+        self._last_ok = time.time()
+
+    def stale(self, timeout_s):
+        return time.time() - self._last_ok > timeout_s  # VIOLATION: attr taint

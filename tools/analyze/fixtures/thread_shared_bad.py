@@ -1,0 +1,22 @@
+"""Seeded thread-shared-state violation: unlocked cross-thread write."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.result = None
+        self.progress = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for i in range(10):
+            self.progress = i         # VIOLATION: unlocked thread write
+        self._finish()
+
+    def _finish(self):
+        self.result = "done"          # VIOLATION: transitive thread write
+
+    def status(self):
+        return self.progress, self.result   # unlocked main-thread read

@@ -1,0 +1,34 @@
+"""Clean cross-thread patterns the thread-shared pass must NOT flag."""
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._stop = threading.Event()
+        self.progress = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for i in range(10):
+            with self._lock:
+                self.progress = i     # guarded write
+            self._q.put(i)            # queue: its methods ARE the sync
+
+    def status(self):
+        with self._lock:
+            return self.progress      # guarded read
+
+    def stop(self):
+        self._stop.set()              # Event attr: excluded primitive
+
+
+class NoThreads:
+    def __init__(self):
+        self.value = 0
+
+    def bump(self):
+        self.value += 1               # no spawned thread: out of scope

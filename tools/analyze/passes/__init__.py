@@ -1,0 +1,18 @@
+"""Built-in pdtt-analyze passes; importing this package registers them.
+
+To add a pass: drop a module here that subclasses
+``tools.analyze.core.AnalysisPass``, decorate it with ``@register``,
+and import it below — the runner, ``--only`` selection, baseline and
+JSON output all pick it up from the registry. docs/static_analysis.md
+documents the contract.
+"""
+
+from tools.analyze.passes import (  # noqa: F401
+    event_catalog,
+    fault_catalog,
+    jit_purity,
+    lock_scope,
+    metric_catalog,
+    monotonic_clock,
+    thread_shared,
+)

@@ -1,0 +1,115 @@
+"""event-catalog: docs ↔ obs/events.py CATEGORIES ↔ emit() call sites.
+
+The analyzer-plugin port of ``tools/check_events.py`` (now a thin shim
+over this module). Three-way: every declared category is documented,
+every documented category is declared, every ``emit("<cat>", ...)``
+literal names a declared category (an undeclared one raises at
+runtime — catch it in CI instead), and every declared category has at
+least one emitter (a category nothing can produce is a dead doc row).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.analyze.core import (AnalysisPass, Context, Finding, dotted,
+                                register)
+
+_ROW = re.compile(r"^\|\s*`([a-z_]+)`\s*\|")
+DOC_REL = os.path.join("docs", "observability.md")
+SECTION = "## event categories"
+# The definition site and the shim's own docstring are not emitters.
+SKIP_SUFFIXES = (os.path.join("obs", "events.py").replace(os.sep, "/"),
+                 "check_events.py")
+
+
+def documented_categories(doc_path: str) -> set[str]:
+    """Category names from the first column of the '## Event categories'
+    table (only that section)."""
+    from tools.analyze.core import doc_table_names
+
+    return doc_table_names(doc_path, SECTION, _ROW)
+
+
+def declared_categories() -> set[str]:
+    from pytorch_distributed_train_tpu.obs.events import CATEGORIES
+
+    return set(CATEGORIES)
+
+
+def emit_sites(tree: ast.AST) -> list[tuple[str, int]]:
+    """(category, lineno) for every ``emit("<literal>", ...)`` call —
+    func named exactly ``emit`` (bare or attribute), so wrappers like
+    ``self._emit`` with a different first-arg contract don't count."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name != "emit" or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.lineno))
+    return out
+
+
+@register
+class EventCatalogPass(AnalysisPass):
+    id = "event-catalog"
+    description = ("event categories: docs table ↔ obs/events.py "
+                   "CATEGORIES ↔ emit() call sites, three-way")
+    include = ("pytorch_distributed_train_tpu/", "tools/",
+               "train.py", "tpurun.py")
+
+    def run(self, ctx: Context) -> list[Finding]:
+        doc_path = ctx.doc_path(DOC_REL)
+        doc_rel = DOC_REL.replace(os.sep, "/")
+        code = declared_categories()
+        try:
+            doc = documented_categories(doc_path)
+        except OSError:
+            return [Finding(self.id, doc_rel, 1,
+                            "docs/observability.md is unreadable",
+                            key="doc-missing")]
+        if not doc:
+            return [Finding(self.id, doc_rel, 1,
+                            "no rows under '## Event categories' — was "
+                            "the table renamed?", key="catalog-empty")]
+        used: dict[str, tuple[str, int]] = {}
+        undeclared: list[Finding] = []
+        for sf in self.files(ctx):
+            if sf.path.endswith(SKIP_SUFFIXES):
+                continue
+            for cat, line in emit_sites(sf.tree):
+                used.setdefault(cat, (sf.path, line))
+                if cat not in code:
+                    undeclared.append(Finding(
+                        self.id, sf.path, line,
+                        f"emit() uses undeclared category `{cat}` "
+                        f"(would raise at runtime)",
+                        key=f"undeclared:{cat}"))
+        out: list[Finding] = undeclared
+        for c in sorted(code - doc):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"category `{c}` declared in obs/events.py but missing "
+                f"from the doc table", key=f"undocumented:{c}"))
+        for c in sorted(doc - code):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"category `{c}` documented but absent from "
+                f"obs/events.py", key=f"phantom:{c}"))
+        if not ctx.partial:
+            # "No emitter anywhere" needs the whole surface — a
+            # path-scoped run must not report every category dead.
+            for c in sorted(code - set(used)):
+                out.append(Finding(
+                    self.id, doc_rel, 1,
+                    f"category `{c}` has no emitter call site (dead doc "
+                    f"row)", key=f"unemitted:{c}"))
+        return out

@@ -1,0 +1,86 @@
+"""fault-catalog: docs/fault_tolerance.md ↔ faults/registry.py POINTS.
+
+The analyzer-plugin port of ``tools/check_fault_points.py`` (now a thin
+shim over this module): an operator writes injection schedules from the
+doc's catalog table, so a point in code but not the doc — or vice
+versa — is exactly the "schedule that silently does nothing" the fault
+layer forbids.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from tools.analyze.core import AnalysisPass, Context, Finding, register
+
+_ROW = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`\s*\|")
+DOC_REL = os.path.join("docs", "fault_tolerance.md")
+SECTION = "## fault-point catalog"
+
+
+def documented_points(doc_path: str) -> set[str]:
+    """Point names from the first column of the '## Fault-point catalog'
+    table (only that section: the grammar examples and recovery matrix
+    mention points too, but the catalog is the contract)."""
+    from tools.analyze.core import doc_table_names
+
+    return doc_table_names(doc_path, SECTION, _ROW)
+
+
+def registry_points() -> set[str]:
+    from pytorch_distributed_train_tpu.faults.registry import POINTS
+
+    return set(POINTS)
+
+
+def sync_sets(doc_path: str) -> tuple[set[str], set[str]]:
+    """(code, doc) point-name sets — the shim and the pass share this."""
+    return registry_points(), documented_points(doc_path)
+
+
+def _section_line(doc_path: str) -> int:
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if line.strip().lower() == SECTION:
+                    return i
+    except OSError:
+        pass
+    return 1
+
+
+@register
+class FaultCatalogPass(AnalysisPass):
+    id = "fault-catalog"
+    description = ("fault-point names in docs/fault_tolerance.md's "
+                   "catalog ↔ faults/registry.py POINTS, both ways")
+    include = ("pytorch_distributed_train_tpu/faults/",)
+
+    def run(self, ctx: Context) -> list[Finding]:
+        doc_path = ctx.doc_path(DOC_REL)
+        doc_rel = DOC_REL.replace(os.sep, "/")
+        try:
+            code, doc = sync_sets(doc_path)
+        except OSError:
+            return [Finding(self.id, doc_rel, 1,
+                            "docs/fault_tolerance.md is unreadable",
+                            key="doc-missing")]
+        if not doc:
+            return [Finding(self.id, doc_rel, 1,
+                            "no catalog rows under '## Fault-point "
+                            "catalog' — was the table renamed?",
+                            key="catalog-empty")]
+        line = _section_line(doc_path)
+        out: list[Finding] = []
+        for p in sorted(code - doc):
+            out.append(Finding(
+                self.id, doc_rel, line,
+                f"fault point `{p}` exists in faults/registry.py but is "
+                f"missing from the doc catalog", key=f"undocumented:{p}"))
+        for p in sorted(doc - code):
+            out.append(Finding(
+                self.id, doc_rel, line,
+                f"fault point `{p}` is documented in the catalog but "
+                f"absent from faults/registry.py", key=f"phantom:{p}"))
+        return out

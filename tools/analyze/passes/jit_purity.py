@@ -1,0 +1,192 @@
+"""jit-purity: jitted step functions must stay host-sync-free.
+
+A ``float()``/``.item()``/``np.asarray`` inside a jitted function
+forces a device→host transfer at trace time (or a tracer error at
+best); ``print``/``time.*`` run once at trace and never again, which is
+how "debug" output silently lies; a Python ``if`` on a traced value is
+a concretization error waiting for the first shape change. The trainer
+hot path depends on steps staying async — one hidden sync serializes
+the pipeline.
+
+Jitted functions are found two ways, both lexical and conservative:
+
+- decorated with ``jax.jit``/``pjit``/``shard_map`` (bare or via
+  ``partial(jax.jit, ...)``);
+- defined in the module and later *wrapped*: ``jax.jit(f, ...)`` /
+  ``shard_map(f, ...)`` with ``f`` (or ``partial(f, ...)``) naming the
+  local def. A function arriving through a parameter is not resolvable
+  and is skipped — no guessing.
+
+The traced-branch heuristic only fires on an ``if``/``while`` test that
+references a *parameter* of the jitted function directly, excluding
+``.shape``/``.ndim``/``.dtype``/``.size``/``len(...)`` (static at
+trace time) — config flags closed over from outside never trip it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import (AnalysisPass, Context, Finding, dotted,
+                                register)
+
+JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+NP_HOST_FUNCS = {"asarray", "array", "save", "load", "frombuffer"}
+NP_MODULES = {"np", "numpy", "onp"}
+
+
+def _is_jit_dotted(d: str | None) -> bool:
+    return d is not None and (d in JIT_WRAPPERS
+                              or d.split(".")[-1] in JIT_WRAPPERS)
+
+
+def _wrapped_name(call: ast.Call) -> str | None:
+    """f in jax.jit(f, ...) / shard_map(partial(f, ...), ...)."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Call) and \
+            (dotted(arg.func) or "").split(".")[-1] == "partial" and arg.args:
+        arg = arg.args[0]
+    if isinstance(arg, ast.Name):
+        return arg.id
+    return None
+
+
+def _jitted_functions(tree: ast.AST) -> list[ast.FunctionDef]:
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    jitted: dict[int, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                d = dotted(target)
+                if _is_jit_dotted(d):
+                    jitted[id(node)] = node
+                elif (isinstance(dec, ast.Call)
+                      and (dotted(dec.func) or "").endswith("partial")
+                      and dec.args and _is_jit_dotted(dotted(dec.args[0]))):
+                    jitted[id(node)] = node
+        if isinstance(node, ast.Call) and _is_jit_dotted(dotted(node.func)):
+            name = _wrapped_name(node)
+            if name:
+                for fn in by_name.get(name, []):
+                    jitted[id(fn)] = fn
+    return list(jitted.values())
+
+
+def _in_debug_call(parents: list[ast.AST]) -> bool:
+    for p in parents:
+        if isinstance(p, ast.Call):
+            d = dotted(p.func) or ""
+            if d.startswith("jax.debug.") or d.endswith("io_callback") \
+                    or d.endswith("pure_callback"):
+                return True
+    return False
+
+
+def _param_rooted(node: ast.AST, params: set[str]) -> bool:
+    """Does `node` reference a parameter as a (possibly attributed)
+    value, excluding static metadata like .shape/.ndim and len()?"""
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return False  # `x is (not) None`: pytree structure is static
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in STATIC_ATTRS:
+            return False  # treat the whole test as static metadata
+        if isinstance(sub, ast.Call):
+            d = dotted(sub.func)
+            if d == "len" or d == "isinstance":
+                return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in params:
+            return True
+    return False
+
+
+@register
+class JitPurityPass(AnalysisPass):
+    id = "jit-purity"
+    description = ("host syncs (float/.item/np.asarray/print/time.*) and "
+                   "traced-value branches inside jitted functions")
+    include = (
+        "pytorch_distributed_train_tpu/steps.py",
+        "pytorch_distributed_train_tpu/trainer.py",
+        "pytorch_distributed_train_tpu/models/",
+        "pytorch_distributed_train_tpu/parallel/",
+    )
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in self.files(ctx):
+            for fn in _jitted_functions(sf.tree):
+                out.extend(self._check_fn(sf, fn))
+        return out
+
+    def _check_fn(self, sf, fn) -> list[Finding]:
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)} - {"self"}
+        out: list[Finding] = []
+        # Walk with a parent stack so jax.debug.print(...) args are
+        # excused (that's the *correct* spelling of print-under-jit).
+        stack: list[tuple[ast.AST, list[ast.AST]]] = [
+            (n, []) for n in fn.body]
+        while stack:
+            node, parents = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, parents + [node]))
+            if isinstance(node, ast.Call) and not _in_debug_call(parents):
+                d = dotted(node.func)
+                if d == "print":
+                    out.append(self.finding(
+                        sf, node, f"print() inside jitted `{fn.name}` — "
+                        "runs once at trace; use jax.debug.print"))
+                elif d == "float" and node.args and not self._static_arg(
+                        node.args[0]):
+                    out.append(self.finding(
+                        sf, node, f"float() on a traced value inside "
+                        f"jitted `{fn.name}` forces a host sync"))
+                elif d is not None and d.startswith("time."):
+                    out.append(self.finding(
+                        sf, node, f"{d}() inside jitted `{fn.name}` runs "
+                        "at trace time only"))
+                elif d is not None and "." in d and \
+                        d.split(".")[0] in NP_MODULES and \
+                        d.split(".")[-1] in NP_HOST_FUNCS:
+                    out.append(self.finding(
+                        sf, node, f"{d}() inside jitted `{fn.name}` "
+                        "materializes on host — use jnp"))
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item":
+                    out.append(self.finding(
+                        sf, node, f".item() inside jitted `{fn.name}` "
+                        "forces a host sync"))
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "block_until_ready":
+                    out.append(self.finding(
+                        sf, node, f".block_until_ready() inside jitted "
+                        f"`{fn.name}` is a host sync"))
+            elif isinstance(node, (ast.If, ast.While)):
+                if _param_rooted(node.test, params):
+                    out.append(self.finding(
+                        sf, node, f"Python `{type(node).__name__.lower()}`"
+                        f" on a traced parameter of jitted `{fn.name}` — "
+                        "use jax.lax.cond/select (concretization)",
+                        severity="warning"))
+        return out
+
+    @staticmethod
+    def _static_arg(arg: ast.AST) -> bool:
+        """float(1), float(x.shape[0]), float(len(x)) are static."""
+        if isinstance(arg, ast.Constant):
+            return True
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Attribute) and sub.attr in STATIC_ATTRS:
+                return True
+            if isinstance(sub, ast.Call) and dotted(sub.func) == "len":
+                return True
+        return False

@@ -1,0 +1,136 @@
+"""lock-scope: blocking work must not run under a service lock.
+
+PR 7 fixed (by hand, in review) a starvation where the serve scheduler
+held the service lock across its busy quantum; this pass makes the rule
+mechanical: lexically inside ``with self._lock:`` (for any
+``threading.Lock/RLock`` attribute of the class, or a module-global
+lock) no call may sleep, talk to the network, fork a process, do file
+I/O, or block on another synchronization primitive. Closures defined
+under the lock are skipped — they run later, not here.
+
+Scoped to the concurrency planes whose locks sit on request/step/save
+hot paths; a lock held across ``time.sleep`` there is a cross-thread
+stall of intake, shed, scrape or save.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analyze.core import (AnalysisPass, Context, Finding,
+                                class_lock_attrs, dotted,
+                                module_lock_names, register,
+                                walk_no_nested_defs, withitem_lock_name)
+
+# Exact dotted calls that always block.
+BLOCKING_DOTTED = {
+    "time.sleep",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+}
+# Module prefixes whose calls block (spawn/IO heavy).
+BLOCKING_PREFIXES = ("subprocess.", "requests.", "http.client.")
+# Builtins that hit the filesystem.
+BLOCKING_BUILTINS = {"open"}
+# Method names that block on *some* receiver; conservative set — `.get`
+# only counts on queue-ish receivers (a store get with timeout_ms is a
+# different protocol) and `.wait` is excused on condition variables
+# (Condition.wait releases the lock; that's the one correct pattern).
+BLOCKING_METHODS = {"wait", "join", "acquire", "recv", "accept",
+                    "connect", "communicate", "check_output", "urlopen"}
+_QUEUEISH = re.compile(r"(^|_)(q|queue)\d*$")
+_CONDISH = re.compile(r"(cond|cv|condition)", re.I)
+
+
+def _receiver_name(func: ast.Attribute) -> str:
+    d = dotted(func.value)
+    return (d or "").rsplit(".", 1)[-1]
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    func = call.func
+    d = dotted(func)
+    if d is not None:
+        if d in BLOCKING_DOTTED:
+            return f"`{d}(...)`"
+        for pfx in BLOCKING_PREFIXES:
+            if d.startswith(pfx):
+                return f"`{d}(...)`"
+        if d in BLOCKING_BUILTINS:
+            return f"`{d}(...)` (file I/O)"
+    if isinstance(func, ast.Attribute):
+        recv = _receiver_name(func)
+        if func.attr == "get" and _QUEUEISH.search(recv):
+            return f"`{recv}.get(...)` (queue get)"
+        if func.attr in BLOCKING_METHODS:
+            if func.attr == "wait" and _CONDISH.search(recv):
+                return None  # Condition.wait releases the lock
+            return f"`{recv or '<expr>'}.{func.attr}(...)`"
+    # (bare `open(...)` is already caught above: dotted() on an ast.Name
+    # returns its id, so it hits the BLOCKING_BUILTINS check.)
+    return None
+
+
+@register
+class LockScopePass(AnalysisPass):
+    id = "lock-scope"
+    description = ("blocking calls (sleep/net/file/subprocess/wait) "
+                   "lexically inside `with <lock>:` bodies")
+    include = (
+        "pytorch_distributed_train_tpu/serving_plane/",
+        "pytorch_distributed_train_tpu/ckpt/",
+        "pytorch_distributed_train_tpu/sentinel/",
+        "pytorch_distributed_train_tpu/elastic.py",
+        "tools/serve_*.py",
+    )
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in self.files(ctx):
+            global_locks = module_lock_names(sf.tree)
+            # Map every With node to the lock it takes, per class (for
+            # self.X locks) and module-wide (for globals).
+            classes = [n for n in ast.walk(sf.tree)
+                       if isinstance(n, ast.ClassDef)]
+            covered: set[int] = set()
+            for cls in classes:
+                self_locks = class_lock_attrs(cls)
+                for node in ast.walk(cls):
+                    if isinstance(node, ast.With):
+                        covered.add(id(node))
+                        out.extend(self._check_with(
+                            sf, node, self_locks, global_locks))
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.With) and id(node) not in covered:
+                    out.extend(self._check_with(
+                        sf, node, set(), global_locks))
+        return out
+
+    def _check_with(self, sf, node: ast.With, self_locks: set[str],
+                    global_locks: set[str]) -> list[Finding]:
+        held = None
+        lock_idx = -1
+        for i, item in enumerate(node.items):
+            held = withitem_lock_name(item, self_locks, global_locks)
+            if held:
+                lock_idx = i
+                break
+        if not held:
+            return []
+        # Items AFTER the lock item evaluate with the lock already held
+        # (`with self._lock, open(p) as f:` smuggles the I/O in), so
+        # scan their context expressions along with the body.
+        later_items = [n for item in node.items[lock_idx + 1:]
+                       for n in ast.walk(item.context_expr)]
+        out = []
+        for sub in list(walk_no_nested_defs(node.body)) + later_items:
+            if not isinstance(sub, ast.Call):
+                continue
+            reason = _blocking_reason(sub)
+            if reason:
+                out.append(self.finding(
+                    sf, sub,
+                    f"blocking call {reason} while holding `{held}` — "
+                    f"move the blocking work outside the lock"))
+        return out

@@ -1,0 +1,133 @@
+"""metric-catalog: registered metric names ↔ docs/observability.md,
+plus a label-cardinality lint.
+
+Every literal name passed to ``registry.counter/gauge/histogram`` must
+appear in the doc's '## Metric catalog' table and vice versa — the
+third catalog the planes grew (after fault points and event
+categories), previously unenforced. Dynamic names (the MetricLogger
+mirror gauges like ``train_loss``) are variables at the call site and
+are out of scope by construction; the doc table says so.
+
+The cardinality lint rejects label *values* that are unbounded by
+construction: identifiers that look like per-request/per-user ids
+(uid/request_id/session/trace...), f-strings, and ``str(...)`` calls.
+A label value must come from a closed vocabulary or the registry's
+per-series storage grows without bound.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.analyze.core import (AnalysisPass, Context, Finding, dotted,
+                                register)
+
+_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+DOC_REL = os.path.join("docs", "observability.md")
+SECTION = "## metric catalog"
+METRIC_METHODS = {"counter", "gauge", "histogram"}
+UNBOUNDED_ID = re.compile(
+    r"(^|_)(uid|user|userid|user_id|request_id|req_id|session|"
+    r"session_id|trace_id|token)(_|$)", re.I)
+
+
+def documented_metrics(doc_path: str) -> set[str]:
+    from tools.analyze.core import doc_table_names
+
+    return doc_table_names(doc_path, SECTION, _ROW)
+
+
+def metric_sites(tree: ast.AST) -> list[tuple[str, ast.Call]]:
+    """(name, call) for every literal-named counter/gauge/histogram
+    registration."""
+    out: list[tuple[str, ast.Call]] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRIC_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append((node.args[0].value, node))
+    return out
+
+
+def _unbounded_label_value(value: ast.AST) -> str | None:
+    """A human-readable reason when the label value is unbounded."""
+    if isinstance(value, ast.JoinedStr):
+        return "f-string label value"
+    if isinstance(value, ast.Call) and dotted(value.func) == "str":
+        return "str(...) label value"
+    d = dotted(value)
+    if d is not None and UNBOUNDED_ID.search(d.rsplit(".", 1)[-1]):
+        return f"identifier `{d}` looks like a per-request/user id"
+    return None
+
+
+@register
+class MetricCatalogPass(AnalysisPass):
+    id = "metric-catalog"
+    description = ("registry.counter/gauge/histogram names ↔ the doc's "
+                   "metric catalog, plus unbounded-label-value lint")
+    include = ("pytorch_distributed_train_tpu/", "tools/",
+               "train.py", "tpurun.py", "bench.py")
+
+    def run(self, ctx: Context) -> list[Finding]:
+        doc_path = ctx.doc_path(DOC_REL)
+        doc_rel = DOC_REL.replace(os.sep, "/")
+        try:
+            doc = documented_metrics(doc_path)
+        except OSError:
+            return [Finding(self.id, doc_rel, 1,
+                            "docs/observability.md is unreadable",
+                            key="doc-missing")]
+        if not doc:
+            return [Finding(self.id, doc_rel, 1,
+                            "no rows under '## Metric catalog' — was the "
+                            "table renamed?", key="catalog-empty")]
+        out: list[Finding] = []
+        seen: dict[str, tuple[str, int]] = {}
+        for sf in self.files(ctx):
+            if sf.path.startswith("tools/analyze/"):
+                continue  # the linter's own sources name metrics in text
+            for name, call in metric_sites(sf.tree):
+                seen.setdefault(name, (sf.path, call.lineno))
+                if name not in doc:
+                    out.append(Finding(
+                        self.id, sf.path, call.lineno,
+                        f"metric `{name}` is registered here but missing "
+                        f"from the doc's metric catalog",
+                        key=f"undocumented:{name}"))
+                # labels= is the registry's SECOND positional parameter
+                # (counter(name, labels=None, help="")) — lint both
+                # spellings.
+                label_dicts = [kw.value for kw in call.keywords
+                               if kw.arg == "labels"
+                               and isinstance(kw.value, ast.Dict)]
+                if len(call.args) >= 2 and isinstance(call.args[1],
+                                                      ast.Dict):
+                    label_dicts.append(call.args[1])
+                for ld in label_dicts:
+                    for k, v in zip(ld.keys, ld.values):
+                        reason = _unbounded_label_value(v)
+                        if reason:
+                            label = (k.value if isinstance(
+                                k, ast.Constant) else "?")
+                            out.append(Finding(
+                                self.id, sf.path, call.lineno,
+                                f"unbounded label `{label}` on "
+                                f"`{name}`: {reason} — label values "
+                                f"must be a closed vocabulary",
+                                key=f"label:{name}:{label}"))
+        if not ctx.partial:
+            # "No registration site anywhere" needs the whole surface —
+            # a path-scoped run must not report every metric phantom.
+            for name in sorted(doc - set(seen)):
+                out.append(Finding(
+                    self.id, doc_rel, 1,
+                    f"metric `{name}` is documented in the catalog but "
+                    f"has no literal registration site in code",
+                    key=f"phantom:{name}"))
+        return out

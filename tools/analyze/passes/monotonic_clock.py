@@ -1,0 +1,191 @@
+"""monotonic-clock: deadline/timeout/backoff math must not use wall time.
+
+``time.time()`` jumps (NTP step, leap smear, operator clock set); a
+deadline computed from it can expire hours early or never. The serving
+SLO plane, hedging, elastic restart backoff and liveness blame all do
+"now vs deadline" comparisons — those must run on ``time.monotonic()``.
+Wall-clock is *correct* for journaled/event timestamps (humans and
+cross-host merges read those), so the pass only fires when a wall-clock
+reading flows into arithmetic that decides behavior:
+
+- a comparison whose either side contains ``time.time()`` or a value
+  derived from it (per-function + per-class ``self.x`` taint);
+- ``deadline_ish = time.time() + ...`` (names matching
+  deadline/until/expir/_by);
+- a wall-derived value passed to a ``timeout``-named argument.
+
+Comparisons against ``0``/``None`` are existence checks, not duration
+math, and are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analyze.core import (AnalysisPass, Context, Finding, dotted,
+                                register)
+
+WALL_CALLS = {"time.time"}
+_DEADLINEISH = re.compile(r"(deadline|until|expir|_by$)", re.I)
+_TIMEOUTISH = re.compile(r"timeout", re.I)
+
+
+def _contains_wall(node: ast.AST, tainted: set[str],
+                   tainted_attrs: set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and dotted(sub.func) in WALL_CALLS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+        if isinstance(sub, ast.Attribute):
+            d = dotted(sub)
+            if d in tainted_attrs:
+                return True
+    return False
+
+
+def _is_null_check(comp: ast.Compare) -> bool:
+    """`x > 0` / `x is None` style: existence, not duration math."""
+    sides = [comp.left] + list(comp.comparators)
+    for s in sides:
+        if isinstance(s, ast.Constant) and s.value in (0, 0.0, None):
+            return True
+    return False
+
+
+def _assign_names(node: ast.AST):
+    if isinstance(node, ast.Name):
+        yield ("name", node.id)
+    elif isinstance(node, ast.Attribute):
+        d = dotted(node)
+        if d and d.startswith("self."):
+            yield ("attr", d)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _assign_names(elt)
+
+
+class _Scope:
+    """One taint scope: a function body, or module top-level code."""
+
+    def __init__(self, body, tainted_attrs: set[str]):
+        self.body = body
+        self.tainted: set[str] = set()
+        self.tainted_attrs = tainted_attrs
+
+    def collect(self):
+        # Two passes so `a = time.time(); b = a - t0` taints b even when
+        # helper ordering is odd; fixpoint beyond that is overkill.
+        for _ in range(2):
+            for node in self._own_nodes():
+                tgts = None
+                if isinstance(node, ast.Assign):
+                    tgts, value = node.targets, node.value
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    tgts, value = [node.target], node.value
+                if not tgts or value is None:
+                    continue
+                if isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                      ast.Tuple)):
+                    # A timestamp stored in a record/container literal is
+                    # journaling; comparisons on the container's OTHER
+                    # members are unrelated to the wall clock.
+                    continue
+                if _contains_wall(value, self.tainted, self.tainted_attrs):
+                    for t in tgts:
+                        for kind, name in _assign_names(t):
+                            if kind == "name":
+                                self.tainted.add(name)
+
+    def _own_nodes(self):
+        stack = list(self.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # nested scopes are their own taint world
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class MonotonicClockPass(AnalysisPass):
+    id = "monotonic-clock"
+    description = ("wall-clock time.time() flowing into deadline/"
+                   "timeout/backoff/staleness arithmetic")
+    # Whole production surface; tests are excluded by discovery.
+    include = ("**",)
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in self.files(ctx):
+            # Class-wide attr taint: self._t0 = time.time() in any
+            # method taints self._t0 reads in every method.
+            attr_taint: dict[int, set[str]] = {}
+            for cls in [n for n in ast.walk(sf.tree)
+                        if isinstance(n, ast.ClassDef)]:
+                attrs: set[str] = set()
+                for node in ast.walk(cls):
+                    if isinstance(node, ast.Assign) and _contains_wall(
+                            node.value, set(), set()):
+                        for t in node.targets:
+                            for kind, name in _assign_names(t):
+                                if kind == "attr":
+                                    attrs.add(name)
+                for fn in ast.walk(cls):
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        attr_taint[id(fn)] = attrs
+            funcs = [n for n in ast.walk(sf.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for fn in funcs:
+                out.extend(self._check_scope(
+                    sf, fn.body, attr_taint.get(id(fn), set())))
+            # Module top-level statements (scripts).
+            top = [n for n in sf.tree.body
+                   if not isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))]
+            out.extend(self._check_scope(sf, top, set()))
+        return out
+
+    def _check_scope(self, sf, body, tainted_attrs) -> list[Finding]:
+        scope = _Scope(body, tainted_attrs)
+        scope.collect()
+        out: list[Finding] = []
+        seen_lines: set[int] = set()
+
+        def emit(node, msg):
+            if node.lineno not in seen_lines:
+                seen_lines.add(node.lineno)
+                out.append(self.finding(sf, node, msg))
+
+        for node in scope._own_nodes():
+            if isinstance(node, ast.Compare) and not _is_null_check(node):
+                if any(_contains_wall(s, scope.tainted, tainted_attrs)
+                       for s in [node.left] + list(node.comparators)):
+                    emit(node, "wall-clock value in a deadline/staleness "
+                               "comparison — use time.monotonic() "
+                               "(wall jumps misfire deadlines)")
+            elif isinstance(node, ast.Assign):
+                if not isinstance(node.value, ast.BinOp):
+                    continue
+                if not _contains_wall(node.value, set(), set()):
+                    continue  # direct time.time() arithmetic only here
+                for t in node.targets:
+                    for kind, name in _assign_names(t):
+                        if _DEADLINEISH.search(name):
+                            emit(node, f"deadline `{name}` computed from "
+                                       "time.time() — use "
+                                       "time.monotonic()")
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg and _TIMEOUTISH.search(kw.arg) and \
+                            _contains_wall(kw.value, scope.tainted,
+                                           tainted_attrs):
+                        emit(node, f"wall-clock-derived value passed as "
+                                   f"`{kw.arg}=` — compute remaining "
+                                   "time from time.monotonic()")
+        return out

@@ -1,0 +1,181 @@
+"""thread-shared-state: attributes a spawned thread writes unlocked.
+
+A class that does ``threading.Thread(target=self._worker)`` has two
+execution contexts; an attribute the worker (or anything it calls
+through ``self``) *writes* outside the class lock, and another method
+also touches outside the lock, is a data race the GIL only papers over
+for single-opcode accesses. Findings are per (class, attribute) and
+carry warning severity: some of these are deliberately GIL-atomic
+flags — those belong in the baseline with a reason saying so, which is
+itself the documentation the next reader needs.
+
+Skips: ``__init__`` writes (pre-start), attributes that *are*
+synchronization primitives or thread handles (Lock/Event/Queue/
+deque/Thread — their methods are the synchronization), and classes
+with no spawned thread.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import (AnalysisPass, Context, Finding,
+                                class_lock_attrs, dotted,
+                                module_lock_names, register,
+                                withitem_lock_name)
+
+# self.X = <factory>() where the factory yields a thread-safe object or
+# a handle whose cross-thread use is the point.
+SAFE_FACTORIES = ("threading.", "queue.", "collections.deque")
+
+
+def _thread_target_methods(cls: ast.ClassDef) -> set[str]:
+    """Methods named as Thread(target=self.X) anywhere in the class,
+    closed transitively over self-method calls (the worker's helpers
+    run on the worker thread too)."""
+    targets: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and \
+                (dotted(node.func) or "").endswith("Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target" and \
+                        isinstance(kw.value, ast.Attribute) and \
+                        isinstance(kw.value.value, ast.Name) and \
+                        kw.value.value.id == "self":
+                    targets.add(kw.value.attr)
+    if not targets:
+        return targets
+    calls: dict[str, set[str]] = {}
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            callees: set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id == "self":
+                    callees.add(sub.func.attr)
+            calls[node.name] = callees
+    changed = True
+    while changed:
+        changed = False
+        for m in list(targets):
+            for callee in calls.get(m, ()):
+                if callee in calls and callee not in targets:
+                    targets.add(callee)
+                    changed = True
+    return targets
+
+
+def _safe_attrs(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = dotted(node.value.func) or ""
+            if any(d.startswith(p) or d == p.rstrip(".")
+                   for p in SAFE_FACTORIES):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        out.add(tgt.attr)
+    return out
+
+
+class _AttrAccess:
+    __slots__ = ("writes_thread_unlocked", "other_unlocked", "first_line")
+
+    def __init__(self):
+        self.writes_thread_unlocked: list[int] = []
+        self.other_unlocked: list[tuple[str, int]] = []
+        self.first_line = 0
+
+
+@register
+class ThreadSharedStatePass(AnalysisPass):
+    id = "thread-shared-state"
+    description = ("attributes written by a spawned-thread method and "
+                   "accessed elsewhere, both outside the class lock")
+    include = (
+        "pytorch_distributed_train_tpu/serving_plane/",
+        "pytorch_distributed_train_tpu/ckpt/",
+        "pytorch_distributed_train_tpu/sentinel/",
+        "pytorch_distributed_train_tpu/elastic.py",
+        "tools/serve_*.py",
+    )
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in self.files(ctx):
+            global_locks = module_lock_names(sf.tree)
+            for cls in [n for n in ast.walk(sf.tree)
+                        if isinstance(n, ast.ClassDef)]:
+                out.extend(self._check_class(sf, cls, global_locks))
+        return out
+
+    def _check_class(self, sf, cls, global_locks) -> list[Finding]:
+        thread_methods = _thread_target_methods(cls)
+        if not thread_methods:
+            return []
+        locks = class_lock_attrs(cls)
+        skip = _safe_attrs(cls) | locks
+        acc: dict[str, _AttrAccess] = {}
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__":
+                continue
+            on_thread = method.name in thread_methods
+            self._scan(method, on_thread, method.name, locks,
+                       global_locks, skip, acc)
+        out = []
+        for attr, a in sorted(acc.items()):
+            if a.writes_thread_unlocked and a.other_unlocked:
+                other = a.other_unlocked[0]
+                out.append(Finding(
+                    self.id, sf.path, a.writes_thread_unlocked[0],
+                    f"`self.{attr}` is written on the spawned thread "
+                    f"(line {a.writes_thread_unlocked[0]}) and accessed "
+                    f"in `{other[0]}` (line {other[1]}), neither under "
+                    f"the class lock — guard both or baseline with the "
+                    f"reason it is safe", severity="warning",
+                    key=f"{cls.name}.{attr}"))
+        return out
+
+    def _scan(self, method, on_thread, name, locks, global_locks, skip,
+              acc) -> None:
+        # Lexical lock tracking: (node, locked?) DFS.
+        stack: list[tuple[ast.AST, bool]] = [(n, False)
+                                             for n in method.body]
+        while stack:
+            node, locked = stack.pop()
+            if isinstance(node, ast.With):
+                inner = locked or any(
+                    withitem_lock_name(i, locks, global_locks)
+                    for i in node.items)
+                for child in node.body:
+                    stack.append((child, inner))
+                for item in node.items:
+                    stack.append((item, locked))
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # closures: separate execution context
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, locked))
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr not in skip):
+                continue
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            if not is_write and not isinstance(node.ctx, ast.Load):
+                continue
+            a = acc.setdefault(node.attr, _AttrAccess())
+            if locked:
+                continue
+            if on_thread and is_write:
+                a.writes_thread_unlocked.append(node.lineno)
+            elif not on_thread:
+                a.other_unlocked.append((name, node.lineno))

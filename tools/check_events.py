@@ -3,10 +3,11 @@
 against the live catalog (obs/events.py CATEGORIES) AND the emitters —
 in every direction.
 
-Same stance as tools/check_fault_points.py: the journal's whole value
-is legibility, and a category that exists in code but not in the doc
-(or is documented but never emitted, or emitted but undeclared) is
-silent drift. Checks:
+Now a thin shim over the analyzer plugin
+(``tools/analyze/passes/event_catalog.py`` — run it with the rest of
+the suite via ``python -m tools.analyze --only event-catalog``); this
+entry point keeps the documented CI command and the catalog-sync tests
+working unchanged. Checks:
 
 1. doc table rows == CATEGORIES (both ways);
 2. every ``emit("<category>", ...)`` literal in the source names a
@@ -24,9 +25,9 @@ or as a test (tests/test_timeline_profiler.py asserts main() == 0).
 
 from __future__ import annotations
 
+import ast
 import glob
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -34,55 +35,44 @@ sys.path.insert(0, REPO)
 
 DOC = os.path.join(REPO, "docs", "observability.md")
 
-_ROW = re.compile(r"^\|\s*`([a-z_]+)`\s*\|")
-# events_lib.emit("cat", ...) / evl.emit("cat", ...) / journal.emit(...)
-# — any attribute-call named emit with a string-literal first argument
-_EMIT = re.compile(r"\bemit\(\s*\n?\s*\"([a-z_]+)\"")
-
 
 def documented_categories(doc_path: str = DOC) -> set[str]:
-    """Category names from the first column of the '## Event categories'
-    table (only that section)."""
-    cats: set[str] = set()
-    in_table = False
-    with open(doc_path) as f:
-        for line in f:
-            if line.startswith("## "):
-                in_table = line.strip().lower() == "## event categories"
-                continue
-            if in_table:
-                m = _ROW.match(line)
-                if m:
-                    cats.add(m.group(1))
-    return cats
+    """Category names from the doc table (see the plugin for the rules)."""
+    from tools.analyze.passes import event_catalog
+
+    return event_catalog.documented_categories(doc_path)
 
 
 def emitted_categories() -> set[str]:
     """Category literals at every emit() call site in the package and
-    tools (excluding obs/events.py itself — the definition, not a use)."""
+    tools (excluding obs/events.py itself — the definition, not a use —
+    and the analyzer's seeded fixtures)."""
+    from tools.analyze.passes import event_catalog
+
     cats: set[str] = set()
     roots = (os.path.join(REPO, "pytorch_distributed_train_tpu"),
              os.path.join(REPO, "tools"))
-    skip = (os.path.join("obs", "events.py"),  # the definition
-            "check_events.py")                 # this checker's own docs
+    skip = event_catalog.SKIP_SUFFIXES
+    fixtures = os.path.join("tools", "analyze", "fixtures") + os.sep
     for root in roots:
         for path in glob.glob(os.path.join(root, "**", "*.py"),
                               recursive=True):
-            if path.endswith(skip):
+            if path.endswith(skip) or fixtures in path:
                 continue
             try:
-                with open(path) as f:
-                    cats.update(_EMIT.findall(f.read()))
-            except OSError:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
                 continue
+            cats.update(c for c, _ in event_catalog.emit_sites(tree))
     return cats
 
 
 def main(argv: list[str] | None = None) -> int:
     del argv
-    from pytorch_distributed_train_tpu.obs.events import CATEGORIES
+    from tools.analyze.passes import event_catalog
 
-    code = set(CATEGORIES)
+    code = event_catalog.declared_categories()
     doc = documented_categories()
     used = emitted_categories()
     ok = True

@@ -2,11 +2,11 @@
 """Cross-check the fault-point catalog in docs/fault_tolerance.md against
 the live registry (faults/registry.py POINTS) — in BOTH directions.
 
-The fault layer's whole value is legibility: an operator reads the doc's
-catalog to write an injection schedule, and a point that exists in code
-but not in the doc (or vice versa) is exactly the silent drift this
-repo's "a schedule that silently does nothing is itself a silent fault"
-stance forbids. Run standalone in CI::
+Now a thin shim over the analyzer plugin
+(``tools/analyze/passes/fault_catalog.py`` — run it with the rest of
+the suite via ``python -m tools.analyze --only fault-catalog``); this
+entry point keeps the documented CI command and the catalog-sync tests
+working unchanged::
 
     python tools/check_fault_points.py      # exit 0 = in sync
 
@@ -16,7 +16,6 @@ or as a test (tests/test_sentinel.py imports and asserts main() == 0).
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -24,34 +23,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 DOC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "docs", "fault_tolerance.md")
 
-_ROW = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`\s*\|")
-
 
 def documented_points(doc_path: str = DOC) -> set[str]:
-    """Point names from the first column of the '## Fault-point catalog'
-    table (only that section: the grammar examples and recovery matrix
-    mention points too, but the catalog is the contract)."""
-    points: set[str] = set()
-    in_catalog = False
-    with open(doc_path) as f:
-        for line in f:
-            if line.startswith("## "):
-                in_catalog = line.strip().lower() == "## fault-point catalog"
-                continue
-            if not in_catalog:
-                continue
-            m = _ROW.match(line)
-            if m:
-                points.add(m.group(1))
-    return points
+    """Point names from the doc catalog (see the plugin for the rules)."""
+    from tools.analyze.passes import fault_catalog
+
+    return fault_catalog.documented_points(doc_path)
 
 
 def main(argv: list[str] | None = None) -> int:
     del argv
-    from pytorch_distributed_train_tpu.faults.registry import POINTS
+    from tools.analyze.passes import fault_catalog
 
-    doc = documented_points()
-    code = set(POINTS)
+    code, doc = fault_catalog.sync_sets(DOC)
     undocumented = sorted(code - doc)
     phantom = sorted(doc - code)
     if not doc:

@@ -351,8 +351,8 @@ class BatcherService:
                 # Device/compile errors are terminal for the only decode
                 # thread: record the reason (healthz flips to error), fail
                 # every waiter immediately instead of letting them time out.
-                self.error = f"{type(e).__name__}: {e}"
                 with self._lock:
+                    self.error = f"{type(e).__name__}: {e}"
                     for ev in self._events.values():
                         ev.set()
                     self._events.clear()
@@ -910,8 +910,8 @@ class GracefulDrain:
         self._thread.start()
 
     def _drain(self) -> None:
-        deadline = time.time() + self.grace_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + self.grace_s
+        while time.monotonic() < deadline:
             with self._lock:
                 if self._inflight == 0:
                     break

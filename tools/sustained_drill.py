@@ -141,7 +141,7 @@ def main() -> None:
         # Clock starts at the FIRST step (post-compile): the drill
         # measures sustained stepping, and compile time would otherwise
         # swallow short rehearsal budgets entirely.
-        now = time.time()
+        now = time.monotonic()
         if state["deadline"] is None:
             state["deadline"] = now + args.minutes * 60.0
         elif now >= state["deadline"]:
