@@ -94,10 +94,30 @@ def _update_lkg(record: dict) -> None:
         pass  # read-only checkout: the printed record still stands
 
 
+def _ledger_append(record: dict) -> None:
+    """Mirror a measured record into the perf ledger (obs/perf.py;
+    docs/performance.md) — the append-only trajectory the regression
+    gate (tools/perf_ledger --check) compares across rounds. Best-effort
+    by contract: a read-only checkout still prints the record."""
+    try:
+        from pytorch_distributed_train_tpu.obs.perf import (
+            PerfLedger,
+            default_ledger_path,
+        )
+
+        PerfLedger(default_ledger_path(os.path.dirname(
+            os.path.abspath(__file__)))).append_record(record,
+                                                       source="bench")
+    except Exception as e:
+        print(f"bench.py: perf-ledger append failed "
+              f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+
+
 def _emit(record: dict, device_metric: bool = True) -> None:
     """Print the one-line JSON record and, when it is a real hardware
     measurement (TPU backend; host-pipeline benches pass False and are
-    recorded unconditionally), persist it as last-known-good."""
+    recorded unconditionally), persist it as last-known-good and append
+    it to the perf ledger."""
     print(json.dumps(record), flush=True)
     if device_metric:
         try:
@@ -108,6 +128,7 @@ def _emit(record: dict, device_metric: bool = True) -> None:
         except Exception:
             return
     _update_lkg(record)
+    _ledger_append(record)
 
 
 def _emit_backend_unavailable(detail: str) -> None:
@@ -218,6 +239,9 @@ def _wait_for_backend() -> None:
 _progress_ts = [time.monotonic()]
 _watchdog_armed = [False]
 _bringup_done = [False]
+# Process-start anchor for the bench goodput_pct denominator (module
+# import ≈ process start; monotonic so NTP can't skew the split).
+_T_MAIN0 = [time.monotonic()]
 
 
 def _touch() -> None:
@@ -322,12 +346,18 @@ def pipeline_bench(args) -> None:
     wall = time.perf_counter() - t0
     native = "native" if imgops.available() else "numpy"
     metric = f"input_pipeline_{native}_images_per_sec"
-    _emit({
+    record = {
         "metric": metric,
         "value": round(seen / wall, 2),
         "unit": "images/sec (host)",
         "vs_baseline": 1.0,
-    }, device_metric=False)
+    }
+    from pytorch_distributed_train_tpu.obs import perf as perf_lib
+
+    split = perf_lib.get_input_stats().split()
+    if split:
+        record["stall_split"] = split
+    _emit(record, device_metric=False)
 
 
 def pipeline_decode_bench(args) -> None:
@@ -415,6 +445,13 @@ def pipeline_decode_bench(args) -> None:
         "unit": "images/sec (host)",
         "vs_baseline": 1.0,
     }
+    # Staged attribution (obs/perf.py): which stage of the decode
+    # pipeline the wall went to — the per-stage view of the host wall.
+    from pytorch_distributed_train_tpu.obs import perf as perf_lib
+
+    split = perf_lib.get_input_stats().split()
+    if split:
+        record["stall_split"] = split
     if args.loader == "grain":
         # The process-worker count actually used (host-core bounded —
         # grain_pipeline.bounded_workers): 0 = in-process mode on
@@ -1184,9 +1221,11 @@ def main() -> None:
         items_per_step, unit_noun = global_batch * seq, "tokens"
 
     # Timing always excludes compile: at least one warmup step runs.
+    t_warm0 = time.monotonic()
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch, rng)
     float(metrics["loss"])  # value fetch = hard sync (see module docstring)
+    compile_s = time.monotonic() - t_warm0
     _disarm_watchdog()  # warmup executed: backend is healthy
 
     t0 = time.perf_counter()
@@ -1248,7 +1287,22 @@ def main() -> None:
         "value": round(per_chip, 2),
         "unit": f"{unit_noun}/sec/chip",
         "vs_baseline": round(vs, 4),
+        # Bench-local goodput split (obs/goodput.py vocabulary): wall to
+        # warmup/compile vs the timed steady-state steps; goodput_pct is
+        # the timed fraction of the whole bench process life — a bench
+        # that spent ten minutes in backend bring-up says so.
+        "goodput_s_compile": round(compile_s, 3),
+        "goodput_s_step": round(wall, 3),
+        "goodput_pct": round(
+            100.0 * wall / max(time.monotonic() - _T_MAIN0[0], 1e-9), 2),
     }
+    from pytorch_distributed_train_tpu.obs import perf as perf_lib
+
+    # Synthetic device batches: the stall split is usually empty — a
+    # nonzero split here means a real loader fed this bench.
+    split = perf_lib.get_input_stats().split()
+    if split:
+        record["stall_split"] = split
     # MFU accounting (VERDICT r3 #2): analytic model FLOPs/item (2xMACs,
     # train = 3x fwd — utils/flops.py conventions) over the detected
     # chip's bf16 peak. None on CPU backends (no MXU peak to divide by).

@@ -476,6 +476,13 @@ class ObsConfig:
     # median (needs obs.straggler_metrics + multi-host). 0 = off.
     profile_straggler_ratio: float = 2.0
     profile_top_ops: int = 5        # rows in the journaled xplane summary
+    # ---- perf ledger (obs/perf.py; docs/performance.md): rank 0
+    # appends one throughput/MFU/stall-split row per fit() to an
+    # append-only JSONL the regression gate (tools/perf_ledger --check)
+    # compares across runs. "" path → <checkpoint.dir>/perf_ledger.jsonl
+    # (the PDTT_PERF_LEDGER env var overrides "").
+    perf_ledger: bool = True
+    perf_ledger_path: str = ""
     heartbeat_timeout_s: float = 0.0  # 0 → heartbeat monitor off
     debug_nans: bool = False
     # Cross-host input-divergence check cadence (0 → off); SURVEY §5.2

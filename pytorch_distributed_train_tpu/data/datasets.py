@@ -120,9 +120,15 @@ class U8ImageDataset(ArrayDataset):
 
     def get_batch(self, idx, rng, train):
         from pytorch_distributed_train_tpu.native import imgops
+        from pytorch_distributed_train_tpu.obs.perf import stage
 
-        imgs = self.arrays["image"][idx]
+        with stage("read"):
+            imgs = self.arrays["image"][idx]
         B, H, W, C = imgs.shape
+        with stage("augment"):
+            return self._augment_batch(imgs, idx, rng, train, B, imgops)
+
+    def _augment_batch(self, imgs, idx, rng, train, B, imgops):
         if train and self.do_augment:
             ys = rng.integers(0, 2 * self.pad + 1, size=B)
             xs = rng.integers(0, 2 * self.pad + 1, size=B)
@@ -332,25 +338,35 @@ class ImageFolderDataset:
     def get_item(self, i: int, rng: np.random.Generator) -> dict:
         from PIL import Image
 
-        pil, label = self._open_sample(i)
+        from pytorch_distributed_train_tpu.obs.perf import stage
+
+        # Stage attribution (obs/perf.py): read = storage bytes → PIL
+        # handle, decode = compressed bytes → pixels (convert forces the
+        # lazy PIL load), augment = crop/flip/RandAugment/normalize.
+        with stage("read"):
+            pil, label = self._open_sample(i)
         with pil as im:
-            im = im.convert("RGB")
-            if self.train:
-                im = _random_resized_crop(im, self.image_size, rng)
-                if rng.random() < 0.5:
-                    im = im.transpose(Image.FLIP_LEFT_RIGHT)
-                if self.randaugment is not None:
-                    im = self.randaugment(im, rng)
-            else:
-                im = _center_crop(im, self.image_size)
-            x_u8 = np.asarray(im, np.uint8)
+            with stage("decode"):
+                im = im.convert("RGB")
+            with stage("augment"):
+                if self.train:
+                    im = _random_resized_crop(im, self.image_size, rng)
+                    if rng.random() < 0.5:
+                        im = im.transpose(Image.FLIP_LEFT_RIGHT)
+                    if self.randaugment is not None:
+                        im = self.randaugment(im, rng)
+                else:
+                    im = _center_crop(im, self.image_size)
+                x_u8 = np.asarray(im, np.uint8)
         from pytorch_distributed_train_tpu.native import imgops
 
-        if imgops.available():
-            x = imgops.normalize_batch(
-                x_u8[None], IMAGENET_MEAN, IMAGENET_STD, nthreads=1)[0]
-        else:
-            x = (x_u8.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+        with stage("augment"):
+            if imgops.available():
+                x = imgops.normalize_batch(
+                    x_u8[None], IMAGENET_MEAN, IMAGENET_STD, nthreads=1)[0]
+            else:
+                x = (x_u8.astype(np.float32) / 255.0
+                     - IMAGENET_MEAN) / IMAGENET_STD
         return {"image": x, "label": np.int32(label)}
 
 
@@ -522,15 +538,17 @@ class TarShardImageDataset(ImageFolderDataset):
         native/jpegdec.cpp). Corrupt members decode to zeros rather than
         poisoning the epoch."""
         from pytorch_distributed_train_tpu.native import jpegdec
+        from pytorch_distributed_train_tpu.obs.perf import stage
 
         blobs: list[bytes] = []
         labels = np.empty(len(idx), np.int32)
-        for n, i in enumerate(idx):
-            si, off, size, label = self.samples[int(i)]
-            fh = self._handle(si)
-            fh.seek(off)
-            blobs.append(fh.read(size))
-            labels[n] = label
+        with stage("read"):
+            for n, i in enumerate(idx):
+                si, off, size, label = self.samples[int(i)]
+                fh = self._handle(si)
+                fh.seek(off)
+                blobs.append(fh.read(size))
+                labels[n] = label
         dims = jpegdec.dims(blobs)
         B = len(blobs)
         boxes = np.empty((B, 4), np.float32)
@@ -546,9 +564,14 @@ class TarShardImageDataset(ImageFolderDataset):
                 flips[n] = rng.random() < 0.5
             else:
                 boxes[n] = _center_box(W, H)
-        images, fails = jpegdec.decode_batch(
-            blobs, boxes, flips, self.image_size,
-            IMAGENET_MEAN, IMAGENET_STD, nthreads=self.decode_threads)
+        # The fused native pass does decode + crop-resize + normalize in
+        # one C++ call; it is attributed to `decode` whole (decode
+        # dominates, and the fusion is the point — splitting it would
+        # mean un-fusing the kernel to measure it).
+        with stage("decode"):
+            images, fails = jpegdec.decode_batch(
+                blobs, boxes, flips, self.image_size,
+                IMAGENET_MEAN, IMAGENET_STD, nthreads=self.decode_threads)
         if fails:
             # Zero-filled images keep real labels — survivable (one bad
             # sample must not kill an epoch) but must be LOUD: systematic

@@ -305,7 +305,25 @@ class GrainHostDataLoader:
             worker_count=self.num_workers,
             read_options=read,
         )
-        for batch in loader:
+        # Stage attribution (obs/perf.py): with worker PROCESSES the
+        # decode/augment stage timers fire inside the workers where this
+        # process can't see them, so the host-side wait on the IPC
+        # stream is attributed to `read` (fetching finished records).
+        # With worker_count=0 the map runs inline in next() and the
+        # dataset's own read/decode/augment timers already cover it —
+        # timing the wait too would double-count every stage.
+        from pytorch_distributed_train_tpu.obs import perf as perf_lib
+
+        it = iter(loader)
+        _done = object()
+        while True:
+            if self.num_workers > 0:
+                with perf_lib.stage("read"):
+                    batch = next(it, _done)
+            else:
+                batch = next(it, _done)
+            if batch is _done:
+                break
             out = {k: np.asarray(v) for k, v in batch.items()}
             short = self.host_batch - len(next(iter(out.values())))
             if short > 0:

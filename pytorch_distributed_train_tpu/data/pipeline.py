@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from pytorch_distributed_train_tpu.data.sampler import DistributedSampler
+from pytorch_distributed_train_tpu.obs import perf as perf_lib
 from pytorch_distributed_train_tpu.obs.spans import span as _span
 
 
@@ -189,6 +190,16 @@ class _Producer(threading.Thread):
         self.error: BaseException | None = None
         self.stats = stats
         self._stopped = threading.Event()
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        # Prefetch-occupancy gauge (obs/perf.py plane): queue fill
+        # fraction sampled at every consumer get — 0.0 sustained means
+        # the producer never gets ahead (input-bound), 1.0 means the
+        # chip is the bottleneck. The scrapable twin of input_stall_pct.
+        self._occupancy = get_registry().gauge(
+            "input_prefetch_occupancy",
+            help="producer->consumer prefetch queue fill fraction at "
+                 "consumer gets (0 = input-bound, 1 = chip-bound)")
         self.start()
 
     _EXHAUSTED = object()
@@ -238,6 +249,8 @@ class _Producer(threading.Thread):
     def __iter__(self):
         try:
             while True:
+                self._occupancy.set(
+                    self.q.qsize() / max(self.q.maxsize, 1))
                 t0 = time.perf_counter()
                 item = self.q.get()
                 if self.stats is not None:
@@ -265,10 +278,16 @@ def device_prefetch(host_batches: Iterator[dict], mesh, batch_axes=("data", "fsd
     sharding = NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))
 
     def to_device(b: dict) -> dict:
-        return {
-            k: jax.make_array_from_process_local_data(sharding, v)
-            for k, v in b.items()
-        }
+        # h2d stage (obs/perf.py): global-array assembly + the transfer
+        # enqueue. device_put is async, so this times dispatch, not the
+        # DMA itself — a SYNCHRONOUS h2d bottleneck (transfer backlog
+        # applying back-pressure here) still shows up as this stage
+        # dominating the split.
+        with perf_lib.stage("h2d"):
+            return {
+                k: jax.make_array_from_process_local_data(sharding, v)
+                for k, v in b.items()
+            }
 
     buf: deque = deque()
     try:

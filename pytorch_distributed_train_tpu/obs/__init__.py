@@ -30,6 +30,11 @@ checkpoint / data / serving layers:
                   demand (trigger file / POST /profile / launcher-store
                   coordination) or by anomaly hooks, each auto-summarized
                   via the xplane top-ops report and journaled.
+- ``perf``      — performance attribution plane (docs/performance.md):
+                  MFU/roofline + op-class capture attribution, staged
+                  input-pipeline stall timers (read/decode/augment/h2d),
+                  and the append-only perf ledger with its median+MAD
+                  regression gate (tools/perf_ledger.py).
 
 Everything here is plain-Python host code: no jax import at module
 scope except in ``cluster`` (which is lazy), so data-loader worker

@@ -61,6 +61,8 @@ CATEGORIES: dict[str, str] = {
     "profile": "managed profiler captures and their summaries",
     "serve": "request-path reliability: sheds, deadline expiries, slot "
              "leaks, drains, router failovers and hedges",
+    "perf": "performance attribution: per-capture MFU/op-class splits "
+            "and perf-ledger rows (obs/perf.py)",
 }
 
 

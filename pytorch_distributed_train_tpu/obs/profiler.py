@@ -443,6 +443,15 @@ class ManagedProfiler:
             dir=logdir, steps=step - started,
             wall_s=round(time.perf_counter() - t0, 3),
             summary=summary.splitlines()[:12])
+        # Perf attribution (obs/perf.py): op-class split + gauges + one
+        # `perf` journal record per capture. Best-effort inside — an
+        # environment without the xplane proto still keeps the capture.
+        from pytorch_distributed_train_tpu.obs import perf as perf_lib
+
+        mfu = get_registry().get_value("perf_mfu_pct")
+        perf_lib.attribute_capture(
+            logdir, step=step, mfu_pct=mfu,
+            top=getattr(self.cfg, "profile_top_ops", 5))
         print(f"[profiler] capture closed at step {step} ({req.reason}); "
               f"summary:\n{summary}", flush=True)
         if req.in_ring:

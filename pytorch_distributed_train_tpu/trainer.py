@@ -31,6 +31,7 @@ from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
 from pytorch_distributed_train_tpu.models.registry import build_model
 from pytorch_distributed_train_tpu.obs import cluster as cluster_lib
 from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs import perf as perf_lib
 from pytorch_distributed_train_tpu.obs import profiler as profiler_lib
 from pytorch_distributed_train_tpu.obs import spans as spans_lib
 from pytorch_distributed_train_tpu.obs.goodput import GoodputTracker
@@ -434,6 +435,14 @@ class Trainer:
                 print(f"[obs] /metrics on port {self.metrics_server.port}",
                       flush=True)
         self._stepped = False  # first train_step call = compile bucket
+        # Eval's share of the process-global input-stage stats
+        # (obs/perf.py), snapshot-deltas around evaluate(): the summary
+        # stage keys and the ledger's stall_split must blame the TRAIN
+        # pipeline — the thing input_stall measures — not a large eval
+        # set's decode time. (Approximation: the train producer keeps
+        # refilling its bounded queue during eval; the error is capped
+        # by the prefetch depth in batches.)
+        self._eval_stage_s = {s: 0.0 for s in perf_lib.STAGES}
         # ---- training health sentinel (sentinel/): numeric plane state
         # (the in-graph gate is already inside the jitted step; this is
         # the host-side spike window + rewind bookkeeping) and the
@@ -871,15 +880,22 @@ class Trainer:
                 self.ckpt.wait()
             if self.best_ckpt is not None:
                 self.best_ckpt.close()
+            stage_s = self._train_stage_seconds()
             self.logger.log(
                 step,
                 {"wall_time_s": time.time() - t_start,
                  "preempted": int(self._preempted),
                  "rewinds": self._rewinds,
                  "sentinel_skipped_steps": self._sentinel_skipped,
+                 # staged input breakdown (obs/perf.py): the per-stage
+                 # split of the TRAIN host-pipeline work behind
+                 # input_stall (eval's share subtracted)
+                 **{f"input_stage_s_{k}": round(v, 4)
+                    for k, v in stage_s.items() if v > 0},
                  **self.meter.percentiles(), **self.goodput.snapshot()},
                 prefix="summary",
             )
+            self._append_perf_ledger(step)
             self.logger.close()
             self._dump_trace()
             events_lib.emit("lifecycle", "fit_end", step=step,
@@ -887,6 +903,49 @@ class Trainer:
                             rewinds=self._rewinds,
                             wall_s=round(time.time() - t_start, 3))
         return self.state
+
+    def _train_stage_seconds(self) -> dict:
+        """The TRAIN pipeline's share of the process-global input-stage
+        seconds: global totals minus the eval deltas accumulated around
+        evaluate() (obs/perf.py stage vocabulary, floored at 0)."""
+        out = {}
+        for k, v in perf_lib.get_input_stats().snapshot().items():
+            out[k] = max(0.0, v - self._eval_stage_s.get(k, 0.0))
+        return out
+
+    def _append_perf_ledger(self, step: int) -> None:
+        """One perf-ledger row per fit() (rank 0): throughput, MFU,
+        goodput and the stall-stage split — the trainer-side feed of the
+        bench-history regression gate (obs/perf.py, docs/performance.md).
+        Best-effort: the ledger must never fail the run."""
+        cfg = self.cfg
+        if not cfg.obs.perf_ledger or jax.process_index() != 0:
+            return
+        try:
+            tput = self.meter.throughput(self.items_per_step)
+            if tput is None:
+                return  # no timed steps (smoke construction, 0-step fit)
+            unit = "images" if cfg.loss == "softmax_xent" else "tokens"
+            per_chip = tput / jax.device_count()
+            mfu = flops_lib.mfu_pct(per_chip, self._flops_per_item,
+                                    self._peak_flops)
+            goodput = self.goodput.snapshot()
+            path = (cfg.obs.perf_ledger_path
+                    or os.environ.get(perf_lib.ENV_LEDGER)
+                    or os.path.join(cfg.checkpoint.dir,
+                                    "perf_ledger.jsonl"))
+            perf_lib.PerfLedger(path).append(
+                f"{cfg.model.name}_train_{unit}_per_sec_per_chip",
+                round(per_chip, 2), unit=f"{unit}/sec/chip",
+                source="trainer", config=cfg.to_json(),
+                mfu_pct=None if mfu is None else round(mfu, 2),
+                goodput_pct=goodput.get("goodput_pct"),
+                stall_split=perf_lib.normalize_split(
+                    self._train_stage_seconds()) or None,
+                step=step)
+        except Exception as e:
+            print(f"[perf-ledger] trainer append failed "
+                  f"({type(e).__name__}: {e})", flush=True)
 
     def _timed_batches(self, it):
         """Yield from the epoch iterator, accounting time blocked in its
@@ -944,6 +1003,9 @@ class Trainer:
                                     self._flops_per_item, self._peak_flops)
             if mfu is not None:
                 host["mfu_pct"] = round(mfu, 2)
+                # perf plane gauge (obs/perf.py): the scrape-visible MFU
+                # the capture attribution stamps into its journal record
+                perf_lib.record_mfu(host["mfu_pct"])
         host["epoch"] = step // max(self.steps_per_epoch, 1)
         stats = getattr(self.train_loader, "stall_stats", None)
         if stats is not None:
@@ -1052,6 +1114,7 @@ class Trainer:
     def evaluate(self, step: int, prefix: str = "eval") -> dict:
         sums: dict[str, float] = {}
         n = 0
+        stage_pre = perf_lib.get_input_stats().snapshot()
         with self.spans.span("train.eval", step=step):
             for batch in self.eval_epoch_fn(0):
                 if self.liveness is not None:
@@ -1062,6 +1125,8 @@ class Trainer:
                 for k, v in m.items():
                     sums[k] = sums.get(k, 0.0) + float(np.asarray(v))
                 n += 1
+        for k, v in perf_lib.get_input_stats().snapshot().items():
+            self._eval_stage_s[k] += max(0.0, v - stage_pre.get(k, 0.0))
         if n == 0:
             return {}
         avg = {k: v / n for k, v in sums.items()}

@@ -268,6 +268,66 @@ def train_flops_per_item(model_cfg, seq: int | None = None) -> float | None:
     return None if fwd is None else 3.0 * fwd
 
 
+def aot_fwd_flops_per_item(model_cfg, precision_cfg=None, *,
+                           seq: int | None = None,
+                           batch: int = 1) -> float | None:
+    """XLA's own forward FLOP count per item, from jax AOT
+    ``lower(...).cost_analysis()`` — the independent cross-check that
+    keeps the hand-rolled formulas above from silently drifting when a
+    model changes (tests compare this against ``fwd_flops_per_item``
+    within tolerance). HLO-level only: lowering, no backend compile, so
+    it runs in seconds on the CPU test backend. Returns None when the
+    model has no throughput-item convention here (unlisted name) or the
+    lowering exposes no flops estimate.
+
+    The item denominator matches ``fwd_flops_per_item``: images for
+    vision models, tokens for LMs (batch * seq tokens per forward).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu.config import PrecisionConfig
+    from pytorch_distributed_train_tpu.models.registry import build_model
+
+    entry = _FWD.get(model_cfg.name)
+    if entry is None or model_cfg.name == "t5":
+        # t5's per-token amortisation spans two sequences (src + tgt);
+        # the single-input lowering here doesn't model it.
+        return None
+    if precision_cfg is None:
+        # fp32 lowering: cost_analysis counts the same dot/conv flops
+        # regardless, and fp32 avoids backend-specific bf16 expansions.
+        precision_cfg = PrecisionConfig(compute_dtype="float32")
+    model = build_model(model_cfg, precision_cfg)
+    noun = entry[1]
+    if noun == "image":
+        x = jnp.zeros((batch, model_cfg.image_size, model_cfg.image_size,
+                       3), jnp.float32)
+        items = batch
+    else:
+        s = seq or model_cfg.max_seq_len
+        x = jnp.zeros((batch, s), jnp.int32)
+        items = batch * s
+
+    def fwd(params, inputs):
+        return model.apply(params, inputs, train=False)
+
+    params = jax.eval_shape(
+        lambda r: model.init({"params": r}, x, train=False),
+        jax.random.PRNGKey(0))
+    x_shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    try:
+        cost = jax.jit(fwd).lower(params, x_shape).cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):  # some backends wrap per-device
+        cost = cost[0] if cost else {}
+    flops = (cost or {}).get("flops")
+    if not flops or flops <= 0:
+        return None
+    return float(flops) / items
+
+
 def llama_param_count(cfg) -> float:
     """Exact parameter count for models/llama.py's architecture (GQA,
     SwiGLU, untied head; norms counted — they read like everything else)."""

@@ -41,6 +41,56 @@ def classify_op(name: str) -> str:
     return "other"
 
 
+# Perf-attribution taxonomy (obs/perf.py; docs/performance.md): a CLOSED
+# roofline-meaningful vocabulary, distinct from the human report buckets
+# above. Ordered — first match wins — so attention fusions (named
+# "...attn..."/"flash..." by the pallas kernels and xla fusion naming)
+# claim their ops before the generic matmul/elementwise patterns do, and
+# data movement (copy/infeed) is never mistaken for compute. Plain
+# "fusion.N" names are predominantly XLA loop fusions → elementwise; a
+# fusion whose name carries dot/conv hints lands in the right compute
+# class via the earlier patterns.
+PERF_OP_CLASSES = ("matmul", "conv", "attention", "elementwise",
+                   "collective", "infeed")
+
+_PERF_CLASS_PATTERNS = (
+    ("collective", ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all", "psum",
+                    "ppermute")),
+    ("infeed", ("infeed", "outfeed", "send", "recv", "copy",
+                "transfer", "host")),
+    ("attention", ("attention", "attn", "flash", "mha", "sdpa")),
+    ("conv", ("convolution", "conv")),
+    ("matmul", ("dot", "einsum", "gemm", "matmul")),
+    ("elementwise", ("fusion", "add", "subtract", "multiply", "divide",
+                     "exp", "tanh", "rsqrt", "sqrt", "log", "power",
+                     "reduce", "broadcast", "select", "compare",
+                     "convert", "maximum", "minimum", "scatter",
+                     "gather", "slice", "pad", "transpose", "reshape",
+                     "iota", "concatenate", "clamp", "softmax", "norm",
+                     "bitcast", "and", "or", "not", "floor", "sort")),
+)
+
+
+def classify_op_class(name: str) -> str:
+    """HLO-ish op name → perf op class (matmul/conv/attention/
+    elementwise/collective/infeed), "other" when nothing matches."""
+    n = name.lower().lstrip("%")
+    for cls, pats in _PERF_CLASS_PATTERNS:
+        if any(p in n for p in pats):
+            return cls
+    return "other"
+
+
+def opclass_split(ops) -> dict[str, float]:
+    """``[(name, ms, count), ...]`` (summarize_xspace's per-plane op
+    list) → milliseconds per perf op class, zero classes dropped."""
+    out = collections.Counter()
+    for name, ms, _count in ops:
+        out[classify_op_class(name)] += ms
+    return {c: float(ms) for c, ms in out.most_common() if ms > 0}
+
+
 def _import_xplane_pb2():
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore

@@ -205,6 +205,54 @@ def events_section(events_dir: str,
     return out
 
 
+def perf_section(recs: list[dict],
+                 events: list[dict] | None = None) -> list[str]:
+    """Perf-attribution summary (obs/perf.py): achieved MFU, the last
+    capture's op-class split (from the ``perf`` journal category), and
+    the staged input breakdown from the summary record — the one-screen
+    view of 'where did the step go'."""
+    out: list[str] = []
+    mfu_rec = next((r for r in reversed(recs) if "mfu_pct" in r), None)
+    stage_rec = next(
+        (r for r in reversed(recs)
+         if any(k.startswith("input_stage_s_") for k in r)), None)
+    if stage_rec is not None:
+        stages = {k[len("input_stage_s_"):]: float(v)
+                  for k, v in stage_rec.items()
+                  if k.startswith("input_stage_s_")}
+        total = sum(stages.values())
+        out.append("  input stages (host pipeline seconds):")
+        for name, v in sorted(stages.items(), key=lambda kv: -kv[1]):
+            out.append(f"    {name:<8} {v:>10.2f}s "
+                       f"{_bar(v / total if total else 0.0)} "
+                       f"{100.0 * v / total if total else 0.0:5.1f}%")
+    attribution = next(
+        (e for e in reversed(events or [])
+         if e.get("category") == "perf"
+         and e.get("name") == "attribution"
+         and (e.get("detail") or {}).get("opclass_ms")), None)
+    if attribution is not None:
+        d = attribution.get("detail") or {}
+        split = d["opclass_ms"]
+        total = sum(split.values())
+        out.append(f"  op classes (last capture, "
+                   f"{d.get('total_ms', total):.1f} ms on "
+                   f"{d.get('plane', '?')}):")
+        for cls, ms in sorted(split.items(), key=lambda kv: -kv[1]):
+            out.append(f"    {cls:<12} {ms:>10.2f}ms "
+                       f"{_bar(ms / total if total else 0.0)} "
+                       f"{100.0 * ms / total if total else 0.0:5.1f}%")
+    if mfu_rec is None and not out:
+        return ["perf: no attribution records (no mfu_pct metric, no "
+                "perf journal events — pre-perf-plane run?)"]
+    if mfu_rec is not None:
+        head = (f"perf: {mfu_rec['mfu_pct']:.2f}% MFU "
+                f"(tag={mfu_rec.get('tag')}, step={mfu_rec.get('step')})")
+    else:
+        head = "perf: no MFU metric (CPU backend or unlisted model)"
+    return [head] + out
+
+
 def serving_section(events_dir: str,
                     events: list[dict] | None = None) -> list[str]:
     """Serving-SLO summary from the ``serve`` journal category
@@ -248,6 +296,7 @@ def report(jsonl_path: str, trace_path: str = "",
     lines = [f"== run report: {jsonl_path} ({len(recs)} records) =="]
     events = _load_events(events_dir)
     for section in (goodput_section(recs), trend_section(recs),
+                    perf_section(recs, events),
                     straggler_section(recs),
                     spans_section(trace_path),
                     events_section(events_dir, events),

@@ -44,6 +44,7 @@ _MARKS = {
     "elastic": "ELASTIC",
     "preempt": "PREEMPT",
     "serve": "SERVE",
+    "perf": "PERF",
     "lifecycle": "",
     "ckpt": "",
 }
@@ -67,6 +68,9 @@ _RECOVERIES = {
 # restarts, reshard lifecycle (docs/elastic.md), rewinds, preemption —
 # stays one read even when thousands of routine events surround it
 _LANDMARKS = _RECOVERIES | {
+    # a perf-ledger gate failure is run-shaping news (obs/perf.py):
+    # the round where throughput/MFU regressed must survive eliding
+    ("anomaly", "perf_regression"),
     ("elastic", "reshard"),
     ("elastic", "rendezvous_degraded"),
     ("elastic", "budget_exhausted"),
