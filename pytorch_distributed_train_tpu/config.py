@@ -450,6 +450,15 @@ class ObsConfig:
     # PDTT_EVENTS_DIR env var (tpurun --events-dir) overrides "".
     events: bool = True
     events_dir: str = ""
+    # ---- distributed request tracing (obs/tracing.py): trace spill
+    # directory for the tail-based sampler ("" → <checkpoint.dir>/traces,
+    # beside the event journal; PDTT_TRACE_DIR overrides ""), the random
+    # baseline retention percentage, and the slow-trace retention
+    # threshold. Trainer spans carry (gen, step) correlation tags so a
+    # serving tail on a co-resident host lines up against training.
+    trace_dir: str = ""
+    trace_sample_pct: float = 0.0
+    trace_keep_slow_ms: float = 250.0
     # ---- managed profiler plane (obs/profiler.py): bounded N-step
     # jax.profiler windows with an artifact ring, triggered on cadence,
     # on demand (trigger file / POST /profile; store-coordinated under

@@ -30,6 +30,13 @@ checkpoint / data / serving layers:
                   demand (trigger file / POST /profile / launcher-store
                   coordination) or by anomaly hooks, each auto-summarized
                   via the xplane top-ops report and journaled.
+- ``tracing``   — distributed request tracing (docs/observability.md):
+                  W3C-``traceparent``-style context propagated router →
+                  replica → batcher → decode, spans carrying
+                  trace/span/parent ids + (gen, step)/weight-version
+                  correlation tags, and a tail-based sampler spilling
+                  retained trees to per-host JSONL beside the journal
+                  (``tools/timeline_report.py --trace`` merges them).
 - ``perf``      — performance attribution plane (docs/performance.md):
                   MFU/roofline + op-class capture attribution, staged
                   input-pipeline stall timers (read/decode/augment/h2d),

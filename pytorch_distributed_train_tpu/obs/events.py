@@ -25,6 +25,11 @@ Record schema (one JSON object per line)::
                a silent fault, same stance as faults/registry.py)
     name     — event name within the category (e.g. "rewind")
     detail   — free-form JSON-serializable kwargs from the emitter
+    trace    — OPTIONAL: the distributed-trace id (obs/tracing.py) —
+               from the emitting thread's trace scope, or passed
+               explicitly as ``emit(..., trace=...)`` by off-scope
+               emitters — so journal records cross-link with retained
+               trace trees
 
 Categories are a CLOSED catalog, cross-checked against the table in
 docs/observability.md by tools/check_events.py (the check_fault_points
@@ -47,6 +52,8 @@ import json
 import os
 import threading
 import time
+
+from pytorch_distributed_train_tpu.obs import spans as spans_lib
 
 # category -> one-line meaning (the docs/observability.md table mirrors
 # this; tools/check_events.py keeps the two in sync both ways)
@@ -87,7 +94,7 @@ class EventJournal:
         return os.path.join(self.dir, f"events_{self.who}.jsonl")
 
     def emit(self, category: str, name: str, step: int | None = None,
-             **detail) -> None:
+             trace: str | None = None, **detail) -> None:
         if category not in CATEGORIES:
             raise KeyError(
                 f"unknown event category {category!r} "
@@ -103,6 +110,16 @@ class EventJournal:
                "step": None if step is None else int(step),
                "host": self.who, "gen": self.gen,
                "category": category, "name": name, "detail": detail}
+        # correlation: an event emitted inside an active trace scope —
+        # or handed an explicit ``trace=`` id (scheduler threads have
+        # no scope) — carries the trace id top-level, so journal
+        # records and retained trace trees cross-link
+        # (docs/observability.md tracing section)
+        if trace is None:
+            tr = spans_lib.current_trace()
+            trace = tr[0] if tr is not None else None
+        if trace is not None:
+            rec["trace"] = trace
         try:
             line = json.dumps(rec, default=repr)
         except (TypeError, ValueError):
@@ -163,10 +180,13 @@ def get_journal() -> EventJournal:
     return _GLOBAL
 
 
-def emit(category: str, name: str, step: int | None = None, **detail) -> None:
+def emit(category: str, name: str, step: int | None = None,
+         trace: str | None = None, **detail) -> None:
     """``emit("sentinel", "rewind", step=6, to=4)`` against the global
-    journal — the one-liner call sites use."""
-    get_journal().emit(category, name, step=step, **detail)
+    journal — the one-liner call sites use. ``trace=`` overrides the
+    thread-scope trace-id stamp (for emitters running off-scope, like
+    the serving scheduler)."""
+    get_journal().emit(category, name, step=step, trace=trace, **detail)
 
 
 def load_events(dir_path: str) -> list[dict]:

@@ -19,6 +19,17 @@ a preallocated ring is atomic enough, same design as FlightRecorder);
 the nesting stack is thread-local so producer threads and HTTP handler
 threads nest independently. Each span records its thread name — the
 Chrome export maps it to ``tid`` rows.
+
+Distributed tracing (obs/tracing.py, docs/observability.md): inside a
+``trace_scope`` every completed span additionally carries
+``trace_id`` / ``span_id`` / ``parent_id`` — the Dapper-style causal
+identity a request keeps across router → replica → batcher hops — and
+is forwarded to the registered trace sink (the tail-based sampler).
+Spans outside a scope pay nothing new. Process-wide *correlation tags*
+(``set_correlation_tags``: the trainer's (gen, step), a replica's
+weight version) ride every span as a separate ``corr`` dict so the
+cross-process merge can line serving traces up against what the
+co-resident trainer was doing.
 """
 
 from __future__ import annotations
@@ -29,20 +40,87 @@ import os
 import threading
 import time
 
+# ---------------------------------------------------------- trace context
+# The active (trace_id, parent_span_id) of the calling thread, None when
+# untraced. obs/tracing.py owns the wire format + sampling; this module
+# only stamps ids so the hot span path stays import-light.
+_TL_TRACE = threading.local()
+
+# Process-wide correlation tags stamped (as Span.corr, NOT merged into
+# args) on every completed span: {"gen": ..., "step": ...} from the
+# trainer, {"weight_version": ...} from a serving replica.
+_CORR: dict = {}
+
+# Completed spans carrying a trace_id are handed here (obs/tracing.py
+# registers the tail sampler at import). Kept as a late-bound global so
+# spans.py never imports tracing.
+_TRACE_SINK = None
+
+
+def _rand_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def current_trace() -> tuple[str, str | None] | None:
+    """The calling thread's (trace_id, open span id) or None. The second
+    element is what an outbound hop / explicit ``record()`` call should
+    parent to."""
+    return getattr(_TL_TRACE, "ctx", None)
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id: str, parent_id: str | None):
+    """Install a trace context on the calling thread: spans opened inside
+    get real trace/span/parent ids (nested spans parent to each other)."""
+    prev = getattr(_TL_TRACE, "ctx", None)
+    _TL_TRACE.ctx = (trace_id, parent_id)
+    try:
+        yield
+    finally:
+        _TL_TRACE.ctx = prev
+
+
+def set_correlation_tags(**tags) -> None:
+    """Merge process-wide correlation tags stamped on every span
+    (``None`` value removes a tag). The trainer sets ``gen``/``step`` at
+    step cadence; serving sets ``weight_version`` — ROADMAP-4's weight
+    swap updates it and becomes traceable day one."""
+    for k, v in tags.items():
+        if v is None:
+            _CORR.pop(k, None)
+        else:
+            _CORR[k] = v
+
+
+def correlation_tags() -> dict:
+    return dict(_CORR)
+
+
+def set_trace_sink(fn) -> None:
+    global _TRACE_SINK
+    _TRACE_SINK = fn
+
 
 class Span:
     """One completed timed region."""
 
-    __slots__ = ("name", "t0", "dur_s", "thread", "depth", "args")
+    __slots__ = ("name", "t0", "dur_s", "thread", "depth", "args",
+                 "trace_id", "span_id", "parent_id", "corr")
 
     def __init__(self, name: str, t0: float, dur_s: float, thread: str,
-                 depth: int, args: dict):
+                 depth: int, args: dict, trace_id: str | None = None,
+                 span_id: str | None = None, parent_id: str | None = None,
+                 corr: dict | None = None):
         self.name = name
         self.t0 = t0  # epoch seconds (time.time clock)
         self.dur_s = dur_s
         self.thread = thread
         self.depth = depth
         self.args = args
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.corr = corr
 
     def to_chrome(self, pid: int) -> dict:
         ev = {
@@ -53,8 +131,15 @@ class Span:
             "pid": pid,
             "tid": self.thread,
         }
-        if self.args:
-            ev["args"] = self.args
+        args = dict(self.corr) if self.corr else {}
+        args.update(self.args or {})
+        if self.trace_id is not None:
+            args["trace_id"] = self.trace_id
+            args["span_id"] = self.span_id
+            if self.parent_id is not None:
+                args["parent_id"] = self.parent_id
+        if args:
+            ev["args"] = args
         return ev
 
 
@@ -92,9 +177,16 @@ class SpanRecorder:
         """Time a region. Nesting is tracked per thread (``depth``);
         exceptions propagate — the span still records, flagged
         ``error=True`` so an aborted checkpoint save is visible in the
-        dump."""
+        dump. Under an active ``trace_scope`` the span gets trace ids
+        and becomes the parent of spans nested inside it."""
         stack = self._stack()
         stack.append(name)
+        tr = getattr(_TL_TRACE, "ctx", None)
+        trace_id = span_id = parent_id = None
+        if tr is not None:
+            trace_id, parent_id = tr
+            span_id = _rand_id(8)
+            _TL_TRACE.ctx = (trace_id, span_id)
         wall0 = time.time()
         t0 = time.perf_counter()
         try:
@@ -103,25 +195,56 @@ class SpanRecorder:
             args = {**args, "error": True}
             raise
         finally:
+            if tr is not None:
+                _TL_TRACE.ctx = tr
             dur = time.perf_counter() - t0
             depth = len(stack) - 1
             stack.pop()
             sp = Span(name, wall0, dur, threading.current_thread().name,
-                      depth, args)
-            with self._commit_lock:
-                self.buf[self.n % self.capacity] = sp
-                self.n += 1
-            if self._feed_registry:
-                # every span is scrape-visible as a labeled histogram —
-                # the decode-wait / ckpt-time numbers come for free
-                from pytorch_distributed_train_tpu.obs.registry import (
-                    get_registry,
-                )
+                      depth, args, trace_id=trace_id, span_id=span_id,
+                      parent_id=parent_id,
+                      corr=dict(_CORR) if _CORR else None)
+            self._commit(sp)
 
-                get_registry().histogram(
-                    "span_seconds", labels={"name": name},
-                    help="duration of host trace spans by span name",
-                ).observe(dur)
+    def record(self, name: str, t0_wall: float, dur_s: float, *,
+               trace: tuple[str, str | None] | None = None,
+               thread: str | None = None, **args) -> str | None:
+        """Commit a span with EXPLICIT timing — for phases measured by a
+        different thread than the one that owns them (the serving
+        scheduler records each request's queue / prefill / per-quantum
+        decode spans from the step loop). ``trace`` is
+        ``(trace_id, parent_span_id)``; None reads the calling thread's
+        active scope. Returns the new span id (None when untraced)."""
+        if trace is None:
+            trace = current_trace()
+        trace_id = parent_id = span_id = None
+        if trace is not None:
+            trace_id, parent_id = trace
+            span_id = _rand_id(8)
+        sp = Span(name, t0_wall, dur_s,
+                  thread or threading.current_thread().name, 0, args,
+                  trace_id=trace_id, span_id=span_id, parent_id=parent_id,
+                  corr=dict(_CORR) if _CORR else None)
+        self._commit(sp)
+        return span_id
+
+    def _commit(self, sp: Span) -> None:
+        with self._commit_lock:
+            self.buf[self.n % self.capacity] = sp
+            self.n += 1
+        if sp.trace_id is not None and _TRACE_SINK is not None:
+            _TRACE_SINK(sp)
+        if self._feed_registry:
+            # every span is scrape-visible as a labeled histogram —
+            # the decode-wait / ckpt-time numbers come for free
+            from pytorch_distributed_train_tpu.obs.registry import (
+                get_registry,
+            )
+
+            get_registry().histogram(
+                "span_seconds", labels={"name": sp.name},
+                help="duration of host trace spans by span name",
+            ).observe(sp.dur_s)
 
     # -------------------------------------------------------------- read
     def events(self) -> list[Span]:

@@ -1,0 +1,488 @@
+"""Distributed request tracing: W3C-style context + tail-based sampling.
+
+The span ring (obs/spans.py) answers "what was THIS process doing";
+this module makes spans causal ACROSS processes, Dapper/OpenTelemetry
+style, so one slow request can be followed from the router front
+through a replica's admission gate, batcher queue, prefill slot and
+per-decode quanta — and correlated with what the co-resident trainer
+was doing at that (gen, step).
+
+Three parts:
+
+- **context** — :class:`TraceContext` (``trace_id``/``span_id``/
+  ``sampled``) with a W3C-``traceparent``-shaped wire format
+  (``00-<32hex>-<16hex>-<flags>``; flags bit 0 = "retain this trace
+  unconditionally"). The router stamps (or honors) a context on every
+  request; ``tools/serve_http.py`` continues it; every hop activates a
+  :func:`spans.trace_scope` so ordinary ``span(...)`` calls become tree
+  nodes. Serving-path code must reach contexts through
+  :func:`continue_or_start` — minting a fresh id where an inbound
+  context exists breaks the cross-process tree, and the
+  ``trace-hygiene`` pass of ``python -m tools.analyze`` enforces it.
+
+- **tail-based sampler** — keeping every decode-quantum span for every
+  request is unaffordable, so completed traced spans buffer per
+  trace_id in a bounded pending table and the retention decision runs
+  at :meth:`Tracer.finish` (request end), when the tail is known: keep
+  when the trace was *flagged* (hedged / failover / deadline / shed /
+  leak / tail_latency — any incident a plane marked), *forced* (inbound
+  sampled flag: how a router tells the hedge replica to retain), *slow*
+  (``trace_keep_slow_ms``), or in the small random baseline
+  (``trace_sample_pct``). Everything else is dropped. Every cap —
+  pending-trace ring, spans-per-trace, spill-file bytes — drops loudly
+  (``trace_dropped_total{where=}``).
+
+- **spill** — retained trees append to per-host JSONL
+  (``traces_<host>.jsonl``) beside the event journal, one JSON object
+  per flush: ``{trace_id, host, gen, ts, reason, dur_ms, tags,
+  spans:[{name, span_id, parent_id, t0, dur_s, thread, args}]}``.
+  ``tags`` is the process's correlation snapshot (gen/step/
+  weight_version). ``tools/timeline_report.py --trace <id>`` merges
+  router + N replicas + trainer files into one Perfetto tree;
+  ``tools/obs_report.py`` ranks the slowest retained traces.
+
+A process may flush the same trace_id more than once (an in-process
+router + replica each finish their own subtree); readers merge by
+trace_id — span ids are globally unique, so concatenation is safe.
+
+No jax at module scope (the obs/ package contract). Thread model: the
+pending table has its own lock; file I/O runs under a separate lock and
+never inside the pending lock (finish runs on request handler threads,
+never under a service/scheduler lock).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import re
+import threading
+import time
+from collections import OrderedDict
+
+from pytorch_distributed_train_tpu.obs import spans as spans_lib
+
+ENV_DIR = "PDTT_TRACE_DIR"
+ENV_SAMPLE_PCT = "PDTT_TRACE_SAMPLE_PCT"
+ENV_KEEP_SLOW_MS = "PDTT_TRACE_KEEP_SLOW_MS"
+
+_WIRE = re.compile(r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """A position in a trace: the id plus the span new work should
+    parent to. ``span_id`` None = a locally minted root (the first span
+    opened under it becomes the tree root). ``sampled`` True = every
+    process seeing this context must retain its subtree (the hedge /
+    failover propagation bit)."""
+
+    trace_id: str
+    span_id: str | None = None
+    sampled: bool = False
+
+
+def new_trace_id() -> str:
+    return spans_lib._rand_id(16)
+
+
+def new_span_id() -> str:
+    return spans_lib._rand_id(8)
+
+
+def parse_traceparent(header: str | None) -> TraceContext | None:
+    """``00-<trace>-<span>-<flags>`` → context, None for absent or
+    malformed input (a bad client header must not 500 the router)."""
+    if not header or not isinstance(header, str):
+        return None
+    m = _WIRE.match(header.strip().lower())
+    if m is None:
+        return None
+    tid, sid, flags = m.groups()
+    if tid == "0" * 32 or sid == "0" * 16:
+        return None
+    try:
+        sampled = bool(int(flags, 16) & 1)
+    except ValueError:
+        return None
+    return TraceContext(tid, sid, sampled=sampled)
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    return (f"00-{ctx.trace_id}-{ctx.span_id or '0' * 16}-"
+            f"{'01' if ctx.sampled else '00'}")
+
+
+def start_trace() -> TraceContext:
+    """Mint a fresh ROOT context. Request-path code must not call this
+    where an inbound context may exist — use :func:`continue_or_start`;
+    the ``trace-hygiene`` analyze pass enforces it for the serving
+    surface."""
+    return TraceContext(new_trace_id(), None)
+
+
+def continue_or_start(inbound: str | None) -> TraceContext:
+    """Honor an inbound ``traceparent`` (the one sanctioned way for the
+    serving path to obtain a context) or mint a root when none came."""
+    ctx = parse_traceparent(inbound)
+    return ctx if ctx is not None else start_trace()
+
+
+def activate(ctx: TraceContext):
+    """Thread-scope context manager: spans opened inside carry the
+    trace; the sampled flag is noted so a forced trace retains even if
+    the local tail looks healthy."""
+    if ctx.sampled:
+        get_tracer().force(ctx.trace_id)
+    return spans_lib.trace_scope(ctx.trace_id, ctx.span_id)
+
+
+def current_child_context(sampled: bool = False) -> TraceContext | None:
+    """Context for an OUTBOUND hop: the calling thread's open span
+    becomes the remote side's parent. None when untraced or no span is
+    open (nothing to parent to — don't fabricate lineage)."""
+    tr = spans_lib.current_trace()
+    if tr is None or tr[1] is None:
+        return None
+    return TraceContext(tr[0], tr[1], sampled=sampled)
+
+
+def flag(trace_id: str, reason: str) -> None:
+    get_tracer().flag(trace_id, reason)
+
+
+def flag_current(reason: str) -> None:
+    """Flag the calling thread's active trace (if any) for retention —
+    what the shed/deadline/error paths call without needing the id."""
+    tr = spans_lib.current_trace()
+    if tr is not None:
+        get_tracer().flag(tr[0], reason)
+
+
+# --------------------------------------------------------------- sampler
+class Tracer:
+    """Per-process tail sampler + JSONL spill. One instance per process
+    (module global below); every cap drops loudly."""
+
+    def __init__(self, dir_path: str | None = None, *,
+                 who: str | None = None, gen: str | None = None,
+                 sample_pct: float | None = None,
+                 keep_slow_ms: float | None = None,
+                 max_pending: int = 256, max_spans_per_trace: int = 512,
+                 max_file_mb: float = 64.0, rng=None):
+        self.dir = dir_path
+        self.who = who if who is not None else (
+            f"host{os.environ.get('PROCESS_ID', '0')}")
+        self.gen = gen if gen is not None else os.environ.get(
+            "RESTART_GENERATION", "0")
+        self.sample_pct = _env_float(ENV_SAMPLE_PCT, 0.0) \
+            if sample_pct is None else float(sample_pct)
+        self.keep_slow_ms = _env_float(ENV_KEEP_SLOW_MS, 250.0) \
+            if keep_slow_ms is None else float(keep_slow_ms)
+        self.max_pending = max(1, int(max_pending))
+        self.max_spans_per_trace = max(1, int(max_spans_per_trace))
+        self.max_file_bytes = int(max_file_mb * 1024 * 1024)
+        self._rng = rng if rng is not None else random.Random()
+        self._lock = threading.Lock()       # pending/flags tables
+        self._io_lock = threading.Lock()    # spill file write+size
+        self._pending: OrderedDict[str, list] = OrderedDict()
+        # keep-reasons persist past the first finish (an in-process
+        # router + replica both flush the same trace), bounded FIFO
+        self._flags: OrderedDict[str, list[str]] = OrderedDict()
+        self._forced: OrderedDict[str, bool] = OrderedDict()
+        # traces already retained: spans completing AFTER their finish
+        # (a hedge's slow loser attempt) flush as supplement records on
+        # a later finish instead of rotting in pending
+        self._retained: OrderedDict[str, str] = OrderedDict()
+        self._fh = None
+        self._size = 0
+        self._failed = False
+
+    @property
+    def path(self) -> str | None:
+        if not self.dir:
+            return None
+        return os.path.join(self.dir, f"traces_{self.who}.jsonl")
+
+    # ------------------------------------------------------------ intake
+    def add_span(self, sp) -> None:
+        """Sink for completed traced spans (registered with spans.py at
+        import). Buffers per trace; both caps drop loudly."""
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "trace_spans_total",
+            help="traced spans buffered by the tail sampler").inc()
+        dropped: list[tuple[str, int]] = []
+        with self._lock:
+            spans = self._pending.get(sp.trace_id)
+            if spans is None:
+                if len(self._pending) >= self.max_pending:
+                    # evict the oldest unfinished trace: an abandoned
+                    # handler must not pin memory forever
+                    _tid, old = self._pending.popitem(last=False)
+                    dropped.append(("pending_ring", len(old)))
+                spans = self._pending[sp.trace_id] = []
+            if len(spans) >= self.max_spans_per_trace:
+                dropped.append(("span_cap", 1))
+            else:
+                spans.append(sp)
+        for where, n in dropped:
+            self._count_drop(where, n)
+
+    def _count_drop(self, where: str, n: int) -> None:
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "trace_dropped_total", labels={"where": where},
+            help="trace spans/trees dropped by the sampler's ring, "
+                 "per-trace or spill-file caps").inc(n)
+
+    def force(self, trace_id: str) -> None:
+        """Inbound sampled flag: retain this trace unconditionally."""
+        with self._lock:
+            self._forced[trace_id] = True
+            self._trim_marks()
+
+    def flag(self, trace_id: str, reason: str) -> None:
+        """Mark a trace for retention with an incident reason (hedged /
+        failover / deadline / shed / leak / tail_latency / error)."""
+        with self._lock:
+            rs = self._flags.setdefault(trace_id, [])
+            if reason not in rs:
+                rs.append(reason)
+            self._trim_marks()
+
+    def _trim_marks(self) -> None:
+        # flags/forced outlive finish() on purpose (multi-flush traces);
+        # FIFO-bound them so an abandoned mark cannot leak
+        while len(self._flags) > 4 * self.max_pending:
+            self._flags.popitem(last=False)
+        while len(self._forced) > 4 * self.max_pending:
+            self._forced.popitem(last=False)
+
+    # ----------------------------------------------------------- decision
+    def finish(self, trace_id: str, dur_s: float | None = None,
+               error: bool = False) -> str | None:
+        """Close a trace locally: pop its buffered spans and decide
+        retention now that the tail is known. Returns the keep reason
+        (also the ``trace_sampled_total`` label), or None = dropped."""
+        with self._lock:
+            spans = self._pending.pop(trace_id, None) or []
+            flags = list(self._flags.get(trace_id) or [])
+            forced = self._forced.get(trace_id, False)
+        reason = None
+        if flags:
+            reason = flags[0]
+        elif error:
+            reason = "error"
+        elif forced:
+            reason = "flag"
+        elif (dur_s is not None and self.keep_slow_ms > 0
+              and dur_s * 1e3 >= self.keep_slow_ms):
+            reason = "slow"
+        elif (self.sample_pct > 0
+              and self._rng.random() * 100.0 < self.sample_pct):
+            reason = "baseline"
+        if reason is None or not spans:
+            self._flush_late()
+            return None
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "trace_sampled_total", labels={"reason": reason},
+            help="trace trees retained by the tail sampler, by keep "
+                 "reason").inc()
+        with self._lock:
+            self._retained[trace_id] = reason
+            while len(self._retained) > 4 * self.max_pending:
+                self._retained.popitem(last=False)
+        self._spill(trace_id, reason, dur_s, spans, flags=flags)
+        self._flush_late()
+        return reason
+
+    def _flush_late(self) -> None:
+        """Spill pending spans of already-retained traces (a hedge's
+        slow loser completes its attempt span after the winner's finish
+        flushed the tree) as supplement records — merged by trace_id at
+        read time, not re-counted."""
+        with self._lock:
+            late = [(tid, self._retained[tid], self._pending.pop(tid))
+                    for tid in list(self._pending)
+                    if tid in self._retained]
+        for tid, reason, spans in late:
+            if spans:
+                self._spill(tid, reason, None, spans)
+
+    # -------------------------------------------------------------- spill
+    def _spill(self, trace_id: str, reason: str, dur_s: float | None,
+               spans: list, flags: list[str] | None = None) -> None:
+        if not self.dir or self._failed:
+            return
+        rec = {"trace_id": trace_id, "host": self.who, "gen": self.gen,
+               "ts": time.time(), "reason": reason,
+               # every incident mark, not just the primary: a request
+               # that tripped the tail detector AND then 504'd carries
+               # both, so readers can count by either
+               "flags": list(flags) if flags else [reason],
+               "dur_ms": (round(dur_s * 1e3, 3)
+                          if dur_s is not None else None),
+               "tags": spans_lib.correlation_tags(),
+               "spans": [_span_dict(s) for s in spans]}
+        try:
+            line = json.dumps(rec, default=repr) + "\n"
+        except (TypeError, ValueError):
+            return
+        data = line.encode("utf-8")
+        with self._io_lock:
+            try:
+                if self._fh is None:
+                    os.makedirs(self.dir, exist_ok=True)
+                    self._fh = open(self.path, "ab")
+                    self._size = os.path.getsize(self.path)
+                if self._size + len(data) > self.max_file_bytes:
+                    self._count_drop("file_cap", 1)
+                    return
+                self._fh.write(data)
+                self._fh.flush()
+                self._size += len(data)
+            except OSError as e:
+                self._failed = True
+                print(f"[tracing] trace sink failed ({e}); further "
+                      "retained traces counted but not persisted",
+                      flush=True)
+
+    def close(self) -> None:
+        with self._io_lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+def _span_dict(s) -> dict:
+    d = {"name": s.name, "span_id": s.span_id, "parent_id": s.parent_id,
+         "t0": s.t0, "dur_s": round(s.dur_s, 6), "thread": s.thread}
+    if s.args:
+        d["args"] = s.args
+    if s.corr:
+        d["corr"] = s.corr
+    return d
+
+
+def _env_float(var: str, default: float) -> float:
+    try:
+        return float(os.environ[var])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+# ------------------------------------------------------------ process-global
+_GLOBAL: Tracer | None = None
+_LOCK = threading.Lock()
+
+
+def default_dir() -> str | None:
+    """The spill directory when nothing configured one: $PDTT_TRACE_DIR,
+    else a ``traces/`` sibling of the event journal's directory (the
+    ISSUE contract: retained trees live beside the journal)."""
+    d = os.environ.get(ENV_DIR)
+    if d:
+        return d
+    ev = os.environ.get("PDTT_EVENTS_DIR")
+    if ev:
+        return os.path.join(os.path.dirname(ev.rstrip("/")), "traces")
+    return None
+
+
+def configure(dir_path: str | None, **kw) -> Tracer:
+    """Install the process-global tracer (``dir_path`` None = decide and
+    count but never spill). Reconfiguring closes the previous sink."""
+    global _GLOBAL
+    t = Tracer(dir_path, **kw)
+    with _LOCK:
+        prev, _GLOBAL = _GLOBAL, t
+    if prev is not None:
+        prev.close()
+    return t
+
+
+def get_tracer() -> Tracer:
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = Tracer(default_dir())
+    return _GLOBAL
+
+
+def _sink(sp) -> None:
+    get_tracer().add_span(sp)
+
+
+spans_lib.set_trace_sink(_sink)
+
+
+def _reset_for_tests() -> None:
+    global _GLOBAL
+    with _LOCK:
+        prev, _GLOBAL = _GLOBAL, None
+    if prev is not None:
+        prev.close()
+
+
+# ---------------------------------------------------------------- readers
+def load_traces(dir_path: str) -> list[dict]:
+    """Every retained tree under ``dir_path`` (``traces_*.jsonl``),
+    ts-sorted. Torn tail lines of a crashed writer are skipped. One
+    trace_id may appear in several records (one per flushing process /
+    subtree) — :func:`merge_trace` concatenates them."""
+    import glob
+
+    recs: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(dir_path,
+                                              "traces_*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("trace_id"):
+                        recs.append(rec)
+        except OSError:
+            continue
+    recs.sort(key=lambda r: r.get("ts", 0.0))
+    return recs
+
+
+def merge_trace(trees: list[dict], trace_id: str) -> list[dict]:
+    """All spans of one trace across every flushed record, each span
+    annotated with its writer's ``host``/``reason``/``tags``, t0-sorted.
+    ``trace_id`` may be a unique prefix (the ids are long)."""
+    full = {t["trace_id"] for t in trees
+            if t["trace_id"].startswith(trace_id)}
+    if len(full) > 1:
+        raise ValueError(
+            f"trace id prefix {trace_id!r} is ambiguous ({len(full)} "
+            "matches)")
+    out: list[dict] = []
+    for t in trees:
+        if not t["trace_id"].startswith(trace_id):
+            continue
+        for s in t.get("spans") or []:
+            s = dict(s)
+            s["host"] = t.get("host")
+            s["reason"] = t.get("reason")
+            s["tags"] = t.get("tags") or {}
+            out.append(s)
+    out.sort(key=lambda s: s.get("t0", 0.0))
+    return out

@@ -28,6 +28,7 @@ import time
 
 from pytorch_distributed_train_tpu.faults import maybe_fire as _maybe_fire
 from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs import tracing
 from pytorch_distributed_train_tpu.obs.goodput import (
     SERVE_BUCKETS,
     GoodputTracker,
@@ -92,6 +93,9 @@ class ReliabilityPlane:
         if retry_after is None:
             return
         self.slo.shed()
+        # a shed request is exactly the kind of tail the sampler must
+        # retain: flag the caller's active trace (handler thread scope)
+        tracing.flag_current("shed")
         get_registry().counter(
             "serve_shed_total",
             help="requests refused by admission control (429)").inc()
@@ -117,10 +121,14 @@ class ReliabilityPlane:
         self.slo.on_admit(uid, now=now)
 
     def on_tokens(self, uid: int, k: int,
-                  now: float | None = None) -> None:
+                  now: float | None = None) -> bool:
+        """Returns True when THIS request's TTFT tripped the tail
+        detector — the caller flags the request's trace so the very
+        sample that fired the anomaly is retained."""
         ttft = self.slo.on_tokens(uid, k, now=now)
         if self.monitor is not None and ttft is not None:
-            self.monitor.observe_ttft(ttft, now=now)
+            return self.monitor.observe_ttft(ttft, now=now)
+        return False
 
     def on_inter_token(self, s: float, now: float | None = None) -> None:
         """Per-tick decode-cadence sample (step quantum / tokens

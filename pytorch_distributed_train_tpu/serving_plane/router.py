@@ -22,6 +22,13 @@ drive it in-process:
   ``rolling_restart`` walks every replica through serve_http's
   existing drain path one at a time.
 
+Every request carries a distributed trace context (obs/tracing.py):
+the router stamps (or honors an inbound ``traceparent``) and each
+attempt — retry, failover, hedge — is a child span whose context rides
+the wire to the replica; hedge copies are sent pre-sampled so the
+winner's replica retains its subtree even though it is fast and
+healthy. Retention is decided tail-based at request end.
+
 Idempotency rule: a request is retried/hedged only when re-executing
 it cannot duplicate side effects — plain completions (and ``n``/chat
 ones). ``keep``/``session``/``prefix`` requests mutate replica-local
@@ -42,7 +49,10 @@ import urllib.request
 from collections import deque
 
 from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs import spans as spans_lib
+from pytorch_distributed_train_tpu.obs import tracing
 from pytorch_distributed_train_tpu.obs.registry import get_registry
+from pytorch_distributed_train_tpu.obs.spans import span
 from pytorch_distributed_train_tpu.serving_plane.slo import percentile
 
 # statuses a healthy twin could serve better: shed (429), gateway-ish
@@ -51,13 +61,16 @@ RETRYABLE_STATUSES = (429, 502, 503)
 
 
 def http_json(addr: str, path: str, body: bytes | None,
-              timeout: float) -> tuple[int, bytes]:
+              timeout: float,
+              headers: dict | None = None) -> tuple[int, bytes]:
     """One HTTP exchange with a replica. Returns (status, body) for ANY
     HTTP status (error statuses are routing inputs here, not
     exceptions); raises OSError only for connect/transport failure."""
+    hdrs = {"Content-Type": "application/json"} if body else {}
+    if headers:
+        hdrs.update(headers)
     req = urllib.request.Request(
-        f"http://{addr}{path}", data=body,
-        headers={"Content-Type": "application/json"} if body else {},
+        f"http://{addr}{path}", data=body, headers=hdrs,
         method="POST" if body is not None else "GET")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -314,16 +327,40 @@ class Router:
 
     # ------------------------------------------------------------ request
     def _single(self, addr: str, path: str, body: bytes,
-                out: queue_mod.Queue) -> None:
+                out: queue_mod.Queue, parent=None, sampled: bool = False,
+                hedge: bool = False) -> None:
+        """One attempt against one replica. ``parent`` is the request's
+        root :class:`tracing.TraceContext`: the attempt becomes a child
+        span of it and the upstream replica continues the trace through
+        a ``traceparent`` header (``sampled`` set = the replica must
+        retain its subtree — how a hedge's winner gets kept even though
+        it is fast and healthy)."""
         self.replicas.begin(addr)
         t0 = time.monotonic()
+
+        def _do(headers):
+            try:
+                return ("ok", *http_json(addr, path, body, self.timeout_s,
+                                         headers=headers))
+            except OSError as e:
+                return ("conn_fail", 0, str(e).encode())
+
         try:
-            status, rbody = http_json(addr, path, body, self.timeout_s)
-        except OSError as e:
-            out.put((addr, "conn_fail", 0, str(e).encode()))
-            return
+            if parent is not None:
+                with spans_lib.trace_scope(parent.trace_id,
+                                           parent.span_id), \
+                        span("router.attempt", addr=addr, hedge=hedge):
+                    child = tracing.current_child_context(sampled=sampled)
+                    kind, status, rbody = _do(
+                        {"traceparent": tracing.format_traceparent(child)}
+                        if child is not None else None)
+            else:
+                kind, status, rbody = _do(None)
         finally:
             self.replicas.end(addr)
+        if kind == "conn_fail":
+            out.put((addr, "conn_fail", 0, rbody))
+            return
         if status in RETRYABLE_STATUSES:
             out.put((addr, "retryable", status, rbody))
             return
@@ -331,9 +368,30 @@ class Router:
             self._lat.append(time.monotonic() - t0)
         out.put((addr, "ok", status, rbody))
 
-    def request(self, path: str, body_bytes: bytes,
-                body: dict) -> tuple[int, bytes]:
-        """Route one non-streaming POST. Returns (status, body)."""
+    def request(self, path: str, body_bytes: bytes, body: dict,
+                traceparent: str | None = None) -> tuple[int, bytes]:
+        """Route one non-streaming POST. Returns (status, body). Stamps
+        (or honors, via ``traceparent``) a distributed trace context;
+        every attempt — retries, failovers, hedges — is a child span,
+        and the tail sampler decides retention when the request ends."""
+        ctx = tracing.continue_or_start(traceparent)
+        t0 = time.monotonic()
+        with tracing.activate(ctx):
+            with span("router.request", path=path):
+                root = tracing.current_child_context(sampled=ctx.sampled)
+                status, rbody = self._route(path, body_bytes, body, root)
+        tracer = tracing.get_tracer()
+        if status == 504:
+            tracer.flag(ctx.trace_id, "deadline")
+        elif status == 429:
+            tracer.flag(ctx.trace_id, "shed")
+        elif status >= 500:
+            tracer.flag(ctx.trace_id, "error")
+        tracer.finish(ctx.trace_id, dur_s=time.monotonic() - t0)
+        return status, rbody
+
+    def _route(self, path: str, body_bytes: bytes, body: dict,
+               root) -> tuple[int, bytes]:
         pinned, idempotent = self.classify(body)
         if pinned is not None:
             rep = self.replicas.get(pinned)
@@ -342,7 +400,8 @@ class Router:
                     {"error": f"session replica {pinned} unavailable"}
                 ).encode()
             out: queue_mod.Queue = queue_mod.Queue()
-            self._single(pinned, path, body_bytes, out)
+            self._single(pinned, path, body_bytes, out, parent=root,
+                         sampled=root.sampled if root else False)
             _, kind, status, rbody = out.get()
             if kind == "conn_fail":
                 return 502, json.dumps(
@@ -350,6 +409,7 @@ class Router:
             return status, rbody
         tried: set[str] = set()
         last: tuple[int, bytes] | None = None
+        attempt = 0
         while True:
             addr = self.replicas.pick(exclude=tried)
             if addr is None:
@@ -358,8 +418,14 @@ class Router:
                 return 503, json.dumps(
                     {"error": "no replica available"}).encode()
             tried.add(addr)
+            # after a failed first attempt every further hop is an
+            # incident path: force downstream retention so the whole
+            # failover story is reconstructable
+            sampled = (root.sampled if root else False) or attempt > 0
             result = self._attempt_hedged(addr, path, body_bytes, tried,
-                                          hedge=idempotent)
+                                          hedge=idempotent, parent=root,
+                                          sampled=sampled)
+            attempt += 1
             a, kind, status, rbody = result
             if kind == "ok":
                 if not idempotent:
@@ -369,6 +435,8 @@ class Router:
                 # non-idempotent requests never re-execute: surface the
                 # transport/retryable failure honestly
                 return (status or 502), rbody
+            if root is not None:
+                tracing.flag(root.trace_id, "failover")
             events_lib.emit("serve", "failover", addr=a, path=path,
                             reason=kind, status=status)
             get_registry().counter(
@@ -377,7 +445,8 @@ class Router:
             last = ((status or 502), rbody)
 
     def _attempt_hedged(self, addr: str, path: str, body_bytes: bytes,
-                        tried: set[str], hedge: bool):
+                        tried: set[str], hedge: bool, parent=None,
+                        sampled: bool = False):
         """One attempt with optional hedging: fire ``addr``, and if no
         answer lands within the hedge delay, fire a second copy at the
         next-best replica; first completed answer wins (an 'ok' beats a
@@ -386,6 +455,7 @@ class Router:
         out: queue_mod.Queue = queue_mod.Queue()
         threading.Thread(target=self._single,
                          args=(addr, path, body_bytes, out),
+                         kwargs={"parent": parent, "sampled": sampled},
                          daemon=True).start()
         delay = self.hedge_delay() if hedge else None
         hedged_addr = None
@@ -395,6 +465,11 @@ class Router:
             except queue_mod.Empty:
                 hedged_addr = self.replicas.pick(exclude=tried | {addr})
             if hedged_addr is not None:
+                if parent is not None:
+                    # a hedged request is a tail by definition: retain
+                    # the whole tree here AND on the hedge's replica
+                    # (sampled=True below rides the wire to it)
+                    tracing.flag(parent.trace_id, "hedged")
                 events_lib.emit("serve", "hedge", slow=addr,
                                 hedge=hedged_addr, path=path,
                                 after_s=round(delay, 4))
@@ -405,6 +480,8 @@ class Router:
                 threading.Thread(
                     target=self._single,
                     args=(hedged_addr, path, body_bytes, out),
+                    kwargs={"parent": parent, "sampled": True,
+                            "hedge": True},
                     daemon=True).start()
         results = []
         expect = 1 + (1 if hedged_addr is not None else 0)

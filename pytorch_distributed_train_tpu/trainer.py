@@ -34,6 +34,7 @@ from pytorch_distributed_train_tpu.obs import events as events_lib
 from pytorch_distributed_train_tpu.obs import perf as perf_lib
 from pytorch_distributed_train_tpu.obs import profiler as profiler_lib
 from pytorch_distributed_train_tpu.obs import spans as spans_lib
+from pytorch_distributed_train_tpu.obs import tracing
 from pytorch_distributed_train_tpu.obs.goodput import GoodputTracker
 from pytorch_distributed_train_tpu.obs.registry import get_registry
 from pytorch_distributed_train_tpu.optim import make_optimizer, plateau_scale
@@ -64,6 +65,18 @@ class Trainer:
             (cfg.obs.events_dir or os.environ.get(events_lib.ENV_VAR)
              or os.path.join(cfg.checkpoint.dir, "events"))
             if cfg.obs.events else None)
+        # ---- distributed tracing (obs/tracing.py): spill beside the
+        # journal, and stamp (gen, step) correlation tags on every span
+        # so serving traces on a co-resident host line up against what
+        # this trainer was doing — the ROADMAP-4 weight-sync debugging
+        # contract. Step updates at the loop (cheap dict write).
+        tracing.configure(
+            cfg.obs.trace_dir or os.environ.get(tracing.ENV_DIR)
+            or os.path.join(cfg.checkpoint.dir, "traces"),
+            sample_pct=cfg.obs.trace_sample_pct,
+            keep_slow_ms=cfg.obs.trace_keep_slow_ms)
+        spans_lib.set_correlation_tags(
+            gen=os.environ.get("RESTART_GENERATION", "0"))
         # ---- fault schedule + recovery policies (faults/): configured
         # before data/checkpoint construction so every fault point those
         # layers traverse is already armed. obs.fault_inject_at_step is
@@ -724,6 +737,11 @@ class Trainer:
                     # precisely what goodput accounting exists to show.
                     is_first = not self._stepped
                     t_body = time.perf_counter()
+                    # (gen, step) correlation tag: every span completed
+                    # from here on — step-loop, ckpt, producer threads —
+                    # carries the trainer's position, the id serving
+                    # traces correlate against (obs/tracing.py).
+                    spans_lib.set_correlation_tags(step=step)
                     with self.spans.span(
                             "train.compile" if is_first else "train.step",
                             step=step):

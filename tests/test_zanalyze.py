@@ -43,7 +43,8 @@ def run_pass(pass_cls, paths, repo_root=REPO, include=("**",)):
 def test_registry_has_all_passes():
     assert set(core.all_passes()) == {
         "lock-scope", "monotonic-clock", "jit-purity", "fault-catalog",
-        "event-catalog", "metric-catalog", "thread-shared-state"}
+        "event-catalog", "metric-catalog", "thread-shared-state",
+        "trace-hygiene"}
 
 
 def test_pass_catalog_doc_is_the_registry_contract():

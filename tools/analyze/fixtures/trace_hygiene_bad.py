@@ -1,0 +1,34 @@
+"""Seeded trace-hygiene violations (tools/analyze/passes/trace_hygiene).
+
+Lines matter to the test: manual __enter__/__exit__ on span context
+managers, a discarded span cm, and fresh trace-id minting where an
+inbound context exists.
+"""
+
+from pytorch_distributed_train_tpu.obs import tracing
+from pytorch_distributed_train_tpu.obs.spans import span
+
+
+def manual_begin_end(rec):
+    cm = rec.span("work")
+    cm.__enter__()          # finding: manual begin
+    do_work()
+    cm.__exit__(None, None, None)   # finding: manual end
+
+
+def direct_enter():
+    span("request").__enter__()     # finding: manual begin, no exit
+
+
+def discarded():
+    span("quantum")         # finding: cm created and discarded
+
+
+def handler(headers):
+    ctx = tracing.start_trace()          # finding: mint over inbound
+    sid = tracing.new_trace_id()         # finding: mint over inbound
+    return ctx, sid
+
+
+def do_work():
+    pass

@@ -1,0 +1,29 @@
+"""Clean trace-hygiene patterns: with-managed spans, inbound contexts
+continued instead of minted."""
+
+from pytorch_distributed_train_tpu.obs import tracing
+from pytorch_distributed_train_tpu.obs.spans import span
+
+
+def with_managed(rec, step):
+    with span("http.completions", path="/v1/completions"):
+        with rec.span("checkpoint.save", step=step):
+            do_work()
+
+
+def handler(headers):
+    # the sanctioned door: honor inbound, mint only when none exists
+    ctx = tracing.continue_or_start(headers.get("traceparent"))
+    with tracing.activate(ctx):
+        with span("router.request"):
+            do_work()
+    tracing.get_tracer().finish(ctx.trace_id, dur_s=0.1)
+
+
+def explicit_record(rec, t0):
+    # explicit-time recording is not a context manager at all
+    rec.record("serve.decode", t0, 0.01, tokens=3)
+
+
+def do_work():
+    pass

@@ -15,4 +15,5 @@ from tools.analyze.passes import (  # noqa: F401
     metric_catalog,
     monotonic_clock,
     thread_shared,
+    trace_hygiene,
 )

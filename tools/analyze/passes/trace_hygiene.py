@@ -1,0 +1,125 @@
+"""trace-hygiene: span lifecycle + trace-context discipline.
+
+Two invariants the distributed-tracing plane (obs/tracing.py,
+docs/observability.md) rests on:
+
+1. **Spans are context managers.** A span opened with a manual
+   ``__enter__()`` whose ``__exit__()`` is not exception-safe corrupts
+   the thread's nesting stack AND leaks the thread-local trace context
+   — every later span on that thread parents to a ghost. So any
+   ``.__enter__(``/``.__exit__(`` on a ``span(...)`` result (direct or
+   through a variable), and any bare expression-statement ``span(...)``
+   (a discarded context manager times nothing), is a finding; ``with``
+   is the only sanctioned spelling. ``obs/spans.py`` itself is excused
+   (its module-level ``span()`` helper returns the cm by design).
+
+2. **No fresh trace ids where an inbound context exists.** Serving-path
+   code minting with ``start_trace()``/``new_trace_id()`` instead of
+   ``continue_or_start(inbound)`` splits one request into two trees —
+   exactly the cross-process causality the plane exists to keep. The
+   rule is scoped to the request-path surface (``MINT_SCOPE``), where
+   an inbound ``traceparent`` can always exist.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import (AnalysisPass, Context, Finding, dotted,
+                                path_matches, register)
+
+# files where an inbound trace context can exist: minting is forbidden,
+# continue_or_start() is the only door
+MINT_SCOPE = (
+    "pytorch_distributed_train_tpu/serving_plane/",
+    "tools/serve_http.py",
+    "tools/serve_router.py",
+)
+
+MINT_CALLS = ("start_trace", "new_trace_id")
+
+# the cm-discipline rule skips the span machinery itself
+CM_EXCUSED = ("pytorch_distributed_train_tpu/obs/spans.py",)
+
+
+def _is_span_call(node: ast.AST) -> bool:
+    """``span(...)`` or ``<recv>.span(...)`` — the recorder API."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return d is not None and (d == "span" or d.endswith(".span"))
+
+
+@register
+class TraceHygienePass(AnalysisPass):
+    id = "trace-hygiene"
+    description = ("spans must be `with`-managed (no manual "
+                   "__enter__/__exit__, no discarded span cm); serving "
+                   "code must continue_or_start() instead of minting "
+                   "trace ids")
+    include = ("pytorch_distributed_train_tpu/", "tools/",
+               "train.py", "tpurun.py", "bench.py")
+    mint_scope = MINT_SCOPE
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in self.files(ctx):
+            if sf.path.startswith("tools/analyze/"):
+                continue  # the linter's own sources name these in text
+            if sf.path not in CM_EXCUSED:
+                out.extend(self._check_cm_discipline(sf))
+            if path_matches(sf.path, self.mint_scope):
+                out.extend(self._check_minting(sf))
+        return out
+
+    # ------------------------------------------------- rule 1: with-only
+    def _check_cm_discipline(self, sf) -> list[Finding]:
+        out: list[Finding] = []
+        # names assigned from a span(...) call anywhere in the file —
+        # manual __enter__/__exit__ on them is the unbalanced pattern
+        span_names: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and _is_span_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        span_names.add(tgt.id)
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("__enter__", "__exit__")):
+                recv = node.func.value
+                manual = _is_span_call(recv) or (
+                    isinstance(recv, ast.Name) and recv.id in span_names)
+                if manual:
+                    out.append(self.finding(
+                        sf, node,
+                        f"manual `{node.func.attr}()` on a span context "
+                        f"manager — open spans with `with span(...):` "
+                        f"(unbalanced begin/end corrupts the nesting "
+                        f"stack and leaks the trace context)"))
+            elif isinstance(node, ast.Expr) and _is_span_call(node.value):
+                out.append(self.finding(
+                    sf, node.value,
+                    "span context manager created and discarded — it "
+                    "times nothing; use `with span(...):` around the "
+                    "region"))
+        return out
+
+    # ---------------------------------------------- rule 2: no minting
+    def _check_minting(self, sf) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            tail = d.rsplit(".", 1)[-1]
+            if tail in MINT_CALLS:
+                out.append(self.finding(
+                    sf, node,
+                    f"`{d}(...)` mints a fresh trace id on the serving "
+                    f"surface, where an inbound context can exist — use "
+                    f"`tracing.continue_or_start(inbound)` so the "
+                    f"cross-process tree stays one trace"))
+        return out

@@ -17,7 +17,10 @@ Chrome ``trace.json`` the span recorder exports, and prints:
 - top span names by total time (from the trace file),
 - the event-journal summary (obs/events.py: counts per category, the
   last rewind / restart / profiler capture) — the one-line version of
-  tools/timeline_report.py's full cross-host timeline.
+  tools/timeline_report.py's full cross-host timeline,
+- the slowest retained distributed traces (obs/tracing.py: top-K by
+  request duration with the queue/prefill/decode/stream split and the
+  trace ids ``timeline_report --trace`` takes).
 
 Pure stdlib + the repo; no jax import — safe on a login host against a
 run directory on shared storage.
@@ -290,8 +293,54 @@ def serving_section(events_dir: str,
     return out
 
 
+def traces_section(traces_dir: str, top: int = 5) -> list[str]:
+    """Slowest retained distributed traces (obs/tracing.py): top-K by
+    whole-request duration with the per-phase (queue / prefill / decode
+    / stream) time split and the ids ``timeline_report --trace`` takes.
+    Empty when the run kept no traces (training-only, or a healthy
+    fleet under default knobs — which is the point of tail sampling)."""
+    if not traces_dir or not os.path.isdir(traces_dir):
+        return []
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from pytorch_distributed_train_tpu.obs.tracing import load_traces
+
+    trees = load_traces(traces_dir)
+    if not trees:
+        return ["traces: directory present but no retained traces"]
+    # one trace may span several records (router + replicas): group
+    by_id: dict[str, dict] = {}
+    for t in trees:
+        g = by_id.setdefault(t["trace_id"], {
+            "dur_ms": 0.0, "reasons": [], "hosts": set(), "phases": {}})
+        if isinstance(t.get("dur_ms"), (int, float)):
+            g["dur_ms"] = max(g["dur_ms"], float(t["dur_ms"]))
+        if t.get("reason") and t["reason"] not in g["reasons"]:
+            g["reasons"].append(t["reason"])
+        g["hosts"].add(t.get("host", "?"))
+        for s in t.get("spans") or []:
+            name = str(s.get("name", ""))
+            if name.startswith("serve.") and name != "serve.admission":
+                phase = name[len("serve."):]
+                g["phases"][phase] = (g["phases"].get(phase, 0.0)
+                                      + float(s.get("dur_s", 0.0)) * 1e3)
+    ranked = sorted(by_id.items(), key=lambda kv: -kv[1]["dur_ms"])
+    out = [f"slowest traces (top {min(top, len(ranked))} of "
+           f"{len(ranked)} retained):"]
+    for tid, g in ranked[:top]:
+        phases = " ".join(
+            f"{p}={g['phases'][p]:.1f}ms"
+            for p in ("queue", "prefill", "decode", "stream")
+            if p in g["phases"])
+        out.append(f"  {tid[:16]}.. {g['dur_ms']:>9.1f}ms "
+                   f"[{','.join(g['reasons'])}; "
+                   f"{len(g['hosts'])} host(s)] {phases}".rstrip())
+    out.append("  (one tree: tools/timeline_report.py --trace <id>)")
+    return out
+
+
 def report(jsonl_path: str, trace_path: str = "",
-           events_dir: str = "") -> str:
+           events_dir: str = "", traces_dir: str = "") -> str:
     recs = load_jsonl(jsonl_path)
     lines = [f"== run report: {jsonl_path} ({len(recs)} records) =="]
     events = _load_events(events_dir)
@@ -300,7 +349,8 @@ def report(jsonl_path: str, trace_path: str = "",
                     straggler_section(recs),
                     spans_section(trace_path),
                     events_section(events_dir, events),
-                    serving_section(events_dir, events)):
+                    serving_section(events_dir, events),
+                    traces_section(traces_dir)):
         if not section:
             continue
         lines.append("")
@@ -313,10 +363,16 @@ def main(argv=None) -> int:
     p.add_argument("--run-dir", default="",
                    help="run directory holding metrics.jsonl (+ trace.json)")
     p.add_argument("--jsonl", default="", help="explicit metrics.jsonl path")
-    p.add_argument("--trace", default="", help="explicit trace.json path")
+    # --span-trace matches timeline_report.py (whose --trace now selects
+    # a distributed trace id); --trace stays as a compat alias here
+    p.add_argument("--span-trace", "--trace", dest="trace", default="",
+                   help="explicit span trace.json path")
     p.add_argument("--events", default="",
                    help="explicit events directory "
                         "(default <run-dir>/events)")
+    p.add_argument("--traces", default="",
+                   help="retained-traces directory "
+                        "(default <run-dir>/traces)")
     args = p.parse_args(argv)
     jsonl = args.jsonl or (os.path.join(args.run_dir, "metrics.jsonl")
                            if args.run_dir else "")
@@ -328,7 +384,9 @@ def main(argv=None) -> int:
                            if args.run_dir else "")
     events_dir = args.events or (os.path.join(args.run_dir, "events")
                                  if args.run_dir else "")
-    print(report(jsonl, trace, events_dir))
+    traces_dir = args.traces or (os.path.join(args.run_dir, "traces")
+                                 if args.run_dir else "")
+    print(report(jsonl, trace, events_dir, traces_dir))
     return 0
 
 

@@ -74,6 +74,16 @@ the scheduler loop (prefill/decode/stalled/idle), and a median+MAD
 tail-latency detector that journals ``serve`` events and can fire the
 managed profiler (``--profile-on-tail``).
 
+Distributed tracing (obs/tracing.py, docs/observability.md): every
+request continues the router's inbound ``traceparent`` (or roots a new
+trace), the SLO phases — admission, queue wait, prefill, each decode
+quantum, stream delivery — become spans in its tree, and a tail-based
+sampler retains slow/failed/hedged/shed trees (plus a random baseline)
+to per-host JSONL beside the event journal
+(``--trace-dir`` / ``--trace-sample-pct`` / ``--trace-keep-slow-ms``;
+``tools/timeline_report.py --trace <id>`` merges the cross-process
+tree). Spans carry the replica's ``--weight-version`` correlation tag.
+
 Threading model: request handler threads (ThreadingHTTPServer) enqueue
 into the batcher under a lock and wait on a per-request event; ONE
 scheduler thread drives ``batcher.step()`` — all device work stays on a
@@ -97,6 +107,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
+from pytorch_distributed_train_tpu.obs import spans as spans_lib  # noqa: E402
+from pytorch_distributed_train_tpu.obs import tracing  # noqa: E402
 from pytorch_distributed_train_tpu.obs.exposition import (  # noqa: E402
     CONTENT_TYPE as _METRICS_CONTENT_TYPE,
     render_metrics,
@@ -250,6 +262,13 @@ class BatcherService:
         # reachable if the waiter dies in that window (leak sweep GC)
         self._landed: dict[int, tuple] = {}
         self._token_seen: dict[int, int] = {}  # SLO tap over EVERY request
+        # uid -> distributed-trace bookkeeping (obs/tracing.py): the
+        # submitting handler's context + phase timestamps, so the
+        # scheduler can record the request's queue / prefill / per-
+        # quantum decode / stream spans into ITS tree. Mutated only
+        # under self._lock.
+        self._trace: dict[int, dict] = {}
+        self._spans = spans_lib.get_recorder()
         self._orphan_grace_s = orphan_grace_s
         self.error: str | None = None  # scheduler-death reason (terminal)
         self._idle_sleep_s = idle_sleep_s
@@ -264,6 +283,7 @@ class BatcherService:
                     busy = bool(self.batcher.queue
                                 or self.batcher.active_slots)
                     stall_s = 0.0
+                    q_t0w = time.time()  # quantum start, wall clock
                     if busy:
                         # `serve.slow_decode` fault point: an injected
                         # delay in the decode quantum — the tail-latency
@@ -295,6 +315,14 @@ class BatcherService:
                                         if hasattr(q, "uid")}
                         for uid in queued_before - queued_after:
                             self.plane.on_admitted(uid, now=now)
+                            tr = self._trace.get(uid)
+                            if tr is not None and "t_admit_m" not in tr:
+                                tr["t_admit_m"] = now
+                                tr["t_admit_w"] = time.time()
+                                # the queue-wait SLO phase as a span
+                                self._trace_span_locked(
+                                    uid, "serve.queue", tr["tw"],
+                                    now - tr["tm"])
                     # one scan feeds both consumers: _token_seen covers
                     # EVERY live request (streams included — the two
                     # cursors advance in lockstep from submit), so the
@@ -305,7 +333,38 @@ class BatcherService:
                                 self._token_seen).items():
                             self._token_seen[uid] += len(toks)
                             total_new += len(toks)
-                            self.plane.on_tokens(uid, len(toks), now=now)
+                            if self.plane.on_tokens(uid, len(toks),
+                                                    now=now):
+                                # THIS request's TTFT tripped the tail
+                                # detector: retain its trace — the
+                                # anomalous sample itself, not just the
+                                # journal record
+                                tr = self._trace.get(uid)
+                                if tr is not None:
+                                    tracing.flag(tr["tid"],
+                                                 "tail_latency")
+                            tr = self._trace.get(uid)
+                            if tr is not None:
+                                if "t_first_m" not in tr:
+                                    tr["t_first_m"] = now
+                                    tr["t_first_w"] = time.time()
+                                    # fallbacks pair: a request never
+                                    # seen leaving the queue spans
+                                    # submit -> first token (covers its
+                                    # unobserved queue wait too)
+                                    self._trace_span_locked(
+                                        uid, "serve.prefill",
+                                        tr.get("t_admit_w", tr["tw"]),
+                                        now - tr.get("t_admit_m",
+                                                     tr["tm"]),
+                                        tokens=len(toks))
+                                else:
+                                    # one span per decode quantum that
+                                    # surfaced tokens for this request
+                                    self._trace_span_locked(
+                                        uid, "serve.decode", q_t0w,
+                                        stall_s + step_dt,
+                                        tokens=len(toks))
                             q = self._streams.get(uid)
                             if q is not None:
                                 q.put(("tokens", toks))
@@ -319,13 +378,27 @@ class BatcherService:
                     for c in finished:
                         seen = self._token_seen.pop(c.uid, None)
                         if seen is not None:
-                            if len(c.tokens) > seen:
-                                self.plane.on_tokens(
-                                    c.uid, len(c.tokens) - seen, now=now)
+                            if len(c.tokens) > seen and self.plane.\
+                                    on_tokens(c.uid, len(c.tokens) - seen,
+                                              now=now):
+                                # same contract as the token-scan path:
+                                # the request whose TTFT tripped the
+                                # tail detector retains its trace, even
+                                # when its first tokens only surface in
+                                # this finished-completion flush
+                                tr = self._trace.get(c.uid)
+                                if tr is not None:
+                                    tracing.flag(tr["tid"],
+                                                 "tail_latency")
                             self.plane.on_finish(
                                 c.uid,
                                 "ok" if c.finish_reason in ("eos", "length")
                                 else c.finish_reason, now=now)
+                        # after the flag above: this pops the trace entry
+                        self._trace_finish_locked(
+                            c.uid, now,
+                            outcome="ok" if c.finish_reason
+                            in ("eos", "length") else c.finish_reason)
                         q = self._streams.pop(c.uid, None)
                         if q is not None:
                             seen_s = self._stream_seen.pop(c.uid, 0)
@@ -361,6 +434,7 @@ class BatcherService:
                     self._streams.clear()
                     self._stream_seen.clear()
                     self._token_seen.clear()
+                    self._trace.clear()
                     self._landed.clear()
                 return
             if not busy:
@@ -378,9 +452,38 @@ class BatcherService:
     def _register_locked(self, uid: int, deadline_ts: float | None) -> None:
         """Track a freshly submitted request (SLO record + token tap).
         Runs in the same lock block as the submit, so the leak sweep
-        can never see a slot-holding uid it does not know."""
+        can never see a slot-holding uid it does not know. The handler
+        thread's active trace context (if any) is captured here: the
+        scheduler parents the request's phase spans to it."""
         self._token_seen[uid] = 0
+        tr = spans_lib.current_trace()
+        if tr is not None:
+            self._trace[uid] = {"tid": tr[0], "parent": tr[1],
+                                "tw": time.time(),
+                                "tm": time.monotonic()}
         self.plane.on_submit(uid, deadline_ts)
+
+    def _trace_span_locked(self, uid: int, name: str, t0_wall: float,
+                           dur_s: float, **args) -> None:
+        tr = self._trace.get(uid)
+        if tr is not None:
+            self._spans.record(name, t0_wall, max(0.0, dur_s),
+                               trace=(tr["tid"], tr["parent"]), **args)
+
+    def _trace_finish_locked(self, uid: int, now: float,
+                             outcome: str = "ok") -> None:
+        """Close a request's trace bookkeeping: record the stream-
+        delivery phase (first token -> finish) and drop the entry. The
+        retention DECISION stays with whoever owns the trace root (the
+        HTTP handler / router) — the scheduler only contributes spans."""
+        tr = self._trace.pop(uid, None)
+        if tr is None:
+            return
+        if "t_first_m" in tr:
+            self._spans.record("serve.stream", tr["t_first_w"],
+                               max(0.0, now - tr["t_first_m"]),
+                               trace=(tr["tid"], tr["parent"]),
+                               outcome=outcome)
 
     def _forget_locked(self, uid: int, outcome: str) -> None:
         """Close a request's SLO record from a cancel path. A no-op for
@@ -388,6 +491,18 @@ class BatcherService:
         completion) — outcomes never double-count."""
         if self._token_seen.pop(uid, None) is not None:
             self.plane.on_finish(uid, outcome)
+        tr = self._trace.get(uid)
+        if tr is not None and outcome == "timeout":
+            tracing.flag(tr["tid"], "timeout")
+        self._trace_finish_locked(uid, time.monotonic(), outcome=outcome)
+
+    def _record_admission(self, t0_wall: float, t0_mono: float) -> None:
+        """The admission-gate SLO phase as a span (handler thread, only
+        when the caller carries a trace — a plane-less fake service
+        records nothing new)."""
+        if spans_lib.current_trace() is not None:
+            self._spans.record("serve.admission", t0_wall,
+                               max(0.0, time.monotonic() - t0_mono))
 
     def _release_dead_queue_session(self, q) -> None:
         """A cancel raced its request's completion: the Completion is in
@@ -410,7 +525,14 @@ class BatcherService:
         self.batcher.cancel(uid)
         self._token_seen.pop(uid, None)
         self.plane.on_finish(uid, "deadline", now=now)
-        events_lib.emit("serve", "deadline_expired", uid=uid)
+        tr = self._trace.get(uid)
+        if tr is not None:
+            # a 504 is a tail by definition: retain its trace, and let
+            # the journal record cross-link to it
+            tracing.flag(tr["tid"], "deadline")
+        self._trace_finish_locked(uid, now, outcome="deadline")
+        events_lib.emit("serve", "deadline_expired", uid=uid,
+                        trace=tr["tid"] if tr is not None else None)
         q = self._streams.pop(uid, None)
         if q is not None:
             self._stream_seen.pop(uid, None)
@@ -439,6 +561,10 @@ class BatcherService:
                 continue
             self.batcher.cancel(uid)
             self._token_seen.pop(uid, None)
+            tr = self._trace.get(uid)
+            if tr is not None:
+                tracing.flag(tr["tid"], "leak")
+            self._trace_finish_locked(uid, now, outcome="leak")
             self.plane.note_leak(uid, "active_slot")
         for uid, t_done in list(self._done_ts.items()):
             if uid in self._events or now - t_done < self._orphan_grace_s:
@@ -533,6 +659,7 @@ class BatcherService:
                 self._forget_locked(uid, "cancelled")
 
         deadline_ts = self.plane.resolve_deadline(deadline_s)
+        adm_w, adm_m = time.time(), time.monotonic()
         with self._lock:
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
@@ -558,6 +685,7 @@ class BatcherService:
             except (ValueError, RuntimeError):
                 _cleanup_locked()
                 raise
+        self._record_admission(adm_w, adm_m)
         try:
             choices = []
             total_generated = 0
@@ -619,6 +747,7 @@ class BatcherService:
             raise ValueError("empty prompt after tokenization")
         deadline_ts = self.plane.resolve_deadline(deadline_s)
         ev = threading.Event()
+        adm_w, adm_m = time.time(), time.monotonic()
         with self._lock:
             # Checked UNDER the lock: the scheduler's death path clears
             # _events under this lock, so registering after a pre-lock
@@ -633,6 +762,7 @@ class BatcherService:
                                       prefix=prefix, **(penalties or {}))
             self._events[uid] = ev
             self._register_locked(uid, deadline_ts)
+        self._record_admission(adm_w, adm_m)
         # the scheduler's deadline sweep answers expiry (504 + slot
         # reclaim); the local wait only needs to outlast it slightly
         wait_s = timeout_s if deadline_ts is None else min(
@@ -741,6 +871,7 @@ class BatcherService:
             raise ValueError("empty prompt after tokenization")
         deadline_ts = self.plane.resolve_deadline(deadline_s)
         q: queue_mod.Queue = queue_mod.Queue()
+        adm_w, adm_m = time.time(), time.monotonic()
         with self._lock:
             if self.error is not None:
                 raise RuntimeError(f"scheduler dead: {self.error}")
@@ -753,6 +884,7 @@ class BatcherService:
             self._streams[uid] = q
             self._stream_seen[uid] = 0
             self._register_locked(uid, deadline_ts)
+        self._record_admission(adm_w, adm_m)
 
         def chunks():
             while True:
@@ -1076,11 +1208,31 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
             except InjectedFault as e:
                 self._send(503, {"error": str(e)})
                 return
-            # full path in the name: '/v1/completions' and
-            # '/v1/chat/completions' must be distinct histogram series
-            with span("http." + self.path.strip("/").replace("/", "."),
-                      path=self.path):
-                self._handle_post()
+            # Distributed tracing (obs/tracing.py): honor the router's
+            # inbound traceparent (NEVER mint over it — the trace-
+            # hygiene analyze pass enforces this), else start a root.
+            # The http span becomes the replica-side tree root; the
+            # scheduler parents the request's queue/prefill/decode/
+            # stream phase spans under it; the tail-based retention
+            # decision runs when the request ends, below.
+            ctx = tracing.continue_or_start(
+                self.headers.get("traceparent"))
+            t0 = time.monotonic()
+            try:
+                with tracing.activate(ctx):
+                    # full path in the name: '/v1/completions' and
+                    # '/v1/chat/completions' must be distinct histogram
+                    # series
+                    with span("http." + self.path.strip("/")
+                              .replace("/", "."), path=self.path):
+                        self._handle_post()
+            finally:
+                # finally: a client that disconnects mid-write raises
+                # OSError out of _handle_post's response send — the
+                # retention decision (often for an already-flagged 504)
+                # must still run
+                tracing.get_tracer().finish(
+                    ctx.trace_id, dur_s=time.monotonic() - t0)
 
         def _handle_post(self):
             chat = self.path == "/v1/chat/completions"
@@ -1183,9 +1335,11 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                            headers={"Retry-After":
                                     str(int(e.retry_after_s))})
             except DeadlineExceeded as e:
+                tracing.flag_current("deadline")
                 self._send(504, {"error": str(e)})
             except (TimeoutError, RuntimeError) as e:
                 # RuntimeError: scheduler dead OR no slot for preload
+                tracing.flag_current("error")
                 self._send(503, {"error": str(e)})
 
         def _stream_sse(self, uid, chunks, stop=None, n_prompt=0,
@@ -1433,6 +1587,24 @@ def main(argv=None) -> int:
     p.add_argument("--profile-on-tail", action="store_true",
                    help="fire the managed profiler on tail-latency "
                         "anomalies (anomalies journal regardless)")
+    # ---- distributed request tracing (obs/tracing.py) ----
+    p.add_argument("--trace-dir", default="",
+                   help="retained-trace JSONL directory (default "
+                        "$PDTT_TRACE_DIR, else a traces/ sibling of "
+                        "the event journal; empty + no env = traces "
+                        "counted but not spilled)")
+    p.add_argument("--trace-sample-pct", type=float, default=None,
+                   help="random baseline %% of traces retained "
+                        "(default $PDTT_TRACE_SAMPLE_PCT or 0)")
+    p.add_argument("--trace-keep-slow-ms", type=float, default=None,
+                   help="retain any request trace slower than this "
+                        "(tail-based sampling; default "
+                        "$PDTT_TRACE_KEEP_SLOW_MS or 250)")
+    p.add_argument("--weight-version", default="",
+                   help="correlation tag stamped on every span/trace "
+                        "(default: safetensors basename, or 'fake') — "
+                        "an online weight swap updates it, so ROADMAP-4 "
+                        "is traceable day one")
     p.add_argument("--advertise", action="store_true",
                    help="register host:port with the elastic launcher "
                         "store so tools/serve_router.py discovers this "
@@ -1446,6 +1618,14 @@ def main(argv=None) -> int:
     if not args.safetensors and not args.fake_backend:
         p.error("--safetensors is required (or pass --fake-backend)")
 
+    tracing.configure(args.trace_dir or tracing.default_dir(),
+                      sample_pct=args.trace_sample_pct,
+                      keep_slow_ms=args.trace_keep_slow_ms)
+    spans_lib.set_correlation_tags(
+        weight_version=args.weight_version or (
+            os.path.basename(args.safetensors) if args.safetensors
+            else "fake"),
+        gen=os.environ.get("RESTART_GENERATION", "0"))
     try:
         service = build_service(args)
     except (KeyError, ValueError, FileNotFoundError, OSError) as e:

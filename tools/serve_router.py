@@ -38,7 +38,14 @@ on serving_plane/router.py:
 - **rolling restart** — ``POST /admin/rolling_restart`` (or
   ``--rolling-restart`` one-shot) walks each replica through
   serve_http's drain path (``/admin/drain``) one at a time: zero
-  failed requests for a fleet-wide restart.
+  failed requests for a fleet-wide restart;
+- **tracing** — every request gets (or continues, via an inbound
+  ``traceparent`` header) a distributed trace context; attempts,
+  failovers and hedges are child spans, hedge copies are sent
+  pre-sampled so the winner's replica retains its subtree, and the
+  tail sampler spills retained trees beside the event journal
+  (``--trace-dir`` / ``--trace-sample-pct`` / ``--trace-keep-slow-ms``;
+  merge with ``tools/timeline_report.py --trace <id>``).
 
 ``GET /healthz`` answers 200 while at least one replica is routable,
 with the per-replica table in the body; ``GET /metrics`` exposes the
@@ -52,6 +59,7 @@ import json
 import os
 import sys
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -59,11 +67,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
+from pytorch_distributed_train_tpu.obs import tracing  # noqa: E402
 from pytorch_distributed_train_tpu.obs.exposition import (  # noqa: E402
     CONTENT_TYPE as _METRICS_CONTENT_TYPE,
     render_metrics,
 )
 from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
+from pytorch_distributed_train_tpu.obs.spans import span  # noqa: E402
 from pytorch_distributed_train_tpu.serving_plane.router import (  # noqa: E402
     RETRYABLE_STATUSES,
     HealthProber,
@@ -148,20 +158,36 @@ def make_handler(router: Router, prober: HealthProber):
             except ValueError:
                 self._send(400, {"error": "bad json"})
                 return
+            tp = self.headers.get("traceparent")
             if isinstance(body, dict) and body.get("stream"):
-                self._proxy_stream(path, raw, body)
+                self._proxy_stream(path, raw, body, tp)
                 return
             status, rbody = router.request(path, raw,
                                            body if isinstance(body, dict)
-                                           else {})
+                                           else {}, traceparent=tp)
             self._relay(status, rbody)
 
-        def _proxy_stream(self, path: str, raw: bytes, body: dict):
+        def _proxy_stream(self, path: str, raw: bytes, body: dict,
+                          traceparent: str | None = None):
             """SSE passthrough: relay upstream bytes as they arrive.
             Retry/failover happens only BEFORE the first relayed byte —
             once deltas went out, re-running the request would duplicate
             text, so an upstream death mid-stream ends the stream (the
-            client retries; idempotent by its own choice)."""
+            client retries; idempotent by its own choice). The trace
+            context rides the upstream request; a failover flags the
+            trace for retention."""
+            ctx = tracing.continue_or_start(traceparent)
+            t0 = time.monotonic()
+            try:
+                with tracing.activate(ctx):
+                    with span("router.stream", path=path):
+                        self._proxy_stream_traced(path, raw, body, ctx)
+            finally:
+                tracing.get_tracer().finish(
+                    ctx.trace_id, dur_s=time.monotonic() - t0)
+
+        def _proxy_stream_traced(self, path: str, raw: bytes,
+                                 body: dict, ctx):
             pinned, idempotent = router.classify(body)
             tried: set[str] = set()
             while True:
@@ -171,24 +197,30 @@ def make_handler(router: Router, prober: HealthProber):
                     return
                 tried.add(addr)
                 router.replicas.begin(addr)
+                headers = {"Content-Type": "application/json"}
+                child = tracing.current_child_context(
+                    sampled=ctx.sampled or bool(tried - {addr}))
+                if child is not None:
+                    headers["traceparent"] = \
+                        tracing.format_traceparent(child)
                 try:
                     upstream = urllib.request.urlopen(
                         urllib.request.Request(
                             f"http://{addr}{path}", data=raw,
-                            headers={"Content-Type": "application/json"}),
+                            headers=headers),
                         timeout=router.timeout_s)
                 except urllib.error.HTTPError as e:
                     router.replicas.end(addr)
                     if (e.code in RETRYABLE_STATUSES and idempotent
                             and pinned is None):
-                        self._failover(addr, path, e.code)
+                        self._failover(ctx, addr, path, e.code)
                         continue
                     self._relay(e.code, e.read())
                     return
                 except (urllib.error.URLError, OSError):
                     router.replicas.end(addr)
                     if pinned is None:
-                        self._failover(addr, path, 0)
+                        self._failover(ctx, addr, path, 0)
                         continue
                     self._send(502, {"error": "session replica "
                                               "unreachable"})
@@ -218,7 +250,8 @@ def make_handler(router: Router, prober: HealthProber):
                 return
 
         @staticmethod
-        def _failover(addr: str, path: str, status: int) -> None:
+        def _failover(ctx, addr: str, path: str, status: int) -> None:
+            tracing.flag(ctx.trace_id, "failover")
             events_lib.emit("serve", "failover", addr=addr, path=path,
                             reason="stream_connect", status=status)
             get_registry().counter(
@@ -254,7 +287,21 @@ def main(argv=None) -> int:
     p.add_argument("--rolling-restart", action="store_true",
                    help="one-shot: drain every replica in turn through "
                         "/admin/drain, print the report, exit")
+    p.add_argument("--trace-dir", default="",
+                   help="retained-trace JSONL directory (default "
+                        "$PDTT_TRACE_DIR, else a traces/ sibling of "
+                        "the event journal)")
+    p.add_argument("--trace-sample-pct", type=float, default=None,
+                   help="random baseline %% of traces retained")
+    p.add_argument("--trace-keep-slow-ms", type=float, default=None,
+                   help="retain any request trace slower than this "
+                        "(default $PDTT_TRACE_KEEP_SLOW_MS or 250)")
     args = p.parse_args(argv)
+
+    tracing.configure(args.trace_dir or tracing.default_dir(),
+                      who="router",
+                      sample_pct=args.trace_sample_pct,
+                      keep_slow_ms=args.trace_keep_slow_ms)
 
     refresh = None
     if args.store:

@@ -18,7 +18,14 @@ reliability plane's contract end to end:
 - **shed rate bounded** — admission control degraded, it didn't
   collapse (and didn't refuse everything either);
 - **p99 TTFT within budget** — the SLO the whole plane exists to
-  defend, measured by the plane's own tracker.
+  defend, measured by the plane's own tracker;
+- **trace retention under load** (obs/tracing.py) — every client
+  request runs under a trace context: the retained-trace JSONL stays
+  BOUNDED (the sampler's file-size cap is honored while its in-memory
+  pending table rides the ring cap), every 504'd (deadline-expired)
+  request has a retained trace, and — in the router hedge phase, two
+  in-process HTTP replicas (one slow) behind a hedging Router — every
+  hedged request has a retained trace too.
 
 Exit 0 = all bounds held (the report prints either way). The tier-1
 smoke runs this with small numbers; the slow-marked test soaks longer.
@@ -27,8 +34,10 @@ smoke runs this with small numbers; the slow-marked test soaks longer.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -37,6 +46,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 from pytorch_distributed_train_tpu.faults import registry as fregistry  # noqa: E402
+from pytorch_distributed_train_tpu.obs import tracing  # noqa: E402
 from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
 from pytorch_distributed_train_tpu.serving_plane import (  # noqa: E402
     DeadlineExceeded,
@@ -54,6 +64,11 @@ def run_soak(args) -> dict:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import serve_http
 
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="slo_soak_tr_")
+    tracer = tracing.configure(trace_dir, who="soak",
+                               sample_pct=0.0,
+                               keep_slow_ms=args.trace_keep_slow_ms,
+                               max_file_mb=args.trace_cap_mb)
     if args.slow_decode:
         fregistry.configure(
             specs=(f"serve.slow_decode@{args.slow_decode}",),
@@ -72,6 +87,8 @@ def run_soak(args) -> dict:
                                         plane=plane,
                                         orphan_grace_s=0.5)
     leaks0 = get_registry().get_value("serve_slot_leaks_total") or 0.0
+    capdrops0 = get_registry().get_value(
+        "trace_dropped_total", {"where": "file_cap"}) or 0.0
     counts = {"ok": 0, "shed": 0, "deadline": 0, "abandoned": 0,
               "cancelled": 0, "error": 0}
     lock = threading.Lock()
@@ -87,40 +104,54 @@ def run_soak(args) -> dict:
             toks = int(rng.integers(4, 16))
             kind = ["plain", "plain", "stream", "abandon", "cancel",
                     "deadline"][int(rng.integers(0, 6))]
+            # every request runs under a trace context (the soak is its
+            # own client, so minting a root here is the sanctioned
+            # path); the tail sampler decides retention at finish —
+            # deadline-504s are flagged by the service and MUST retain
+            ctx = tracing.start_trace()
+            t_req = time.monotonic()
             try:
-                if kind == "plain":
-                    service.complete(prompt, toks, 0.0, timeout_s=30.0)
-                    note("ok")
-                elif kind == "stream":
-                    _, _, chunks = service.stream(prompt, toks, 0.0,
-                                                  timeout_s=30.0)
-                    for _toks, c in chunks:
-                        if c is not None:
-                            break
-                    note("ok")
-                elif kind == "abandon":
-                    uid, _, chunks = service.stream(prompt, toks, 0.0,
-                                                    timeout_s=30.0)
-                    next(chunks, None)  # consume at most one tick
-                    service.abandon_stream(uid)
-                    note("abandoned")
-                elif kind == "cancel":
-                    uid, _, _chunks = service.stream(prompt, toks, 0.0,
-                                                     timeout_s=30.0)
-                    service.cancel_stream(uid)
-                    note("cancelled")
-                else:  # tight deadline: often expires mid-decode
-                    service.complete(
-                        prompt, toks, 0.0, timeout_s=30.0,
-                        deadline_s=float(rng.uniform(0.001, 0.05)))
-                    note("ok")
-            except OverloadShed:
-                note("shed")
-                time.sleep(0.005)  # honor the back-off in spirit
-            except DeadlineExceeded:
-                note("deadline")
-            except (TimeoutError, RuntimeError):
-                note("error")
+                with tracing.activate(ctx):
+                    one_request(kind, prompt, toks, rng)
+            finally:
+                tracer.finish(ctx.trace_id,
+                              dur_s=time.monotonic() - t_req)
+
+    def one_request(kind, prompt, toks, rng):
+        try:
+            if kind == "plain":
+                service.complete(prompt, toks, 0.0, timeout_s=30.0)
+                note("ok")
+            elif kind == "stream":
+                _, _, chunks = service.stream(prompt, toks, 0.0,
+                                              timeout_s=30.0)
+                for _toks, c in chunks:
+                    if c is not None:
+                        break
+                note("ok")
+            elif kind == "abandon":
+                uid, _, chunks = service.stream(prompt, toks, 0.0,
+                                                timeout_s=30.0)
+                next(chunks, None)  # consume at most one tick
+                service.abandon_stream(uid)
+                note("abandoned")
+            elif kind == "cancel":
+                uid, _, _chunks = service.stream(prompt, toks, 0.0,
+                                                 timeout_s=30.0)
+                service.cancel_stream(uid)
+                note("cancelled")
+            else:  # tight deadline: often expires mid-decode
+                service.complete(
+                    prompt, toks, 0.0, timeout_s=30.0,
+                    deadline_s=float(rng.uniform(0.001, 0.05)))
+                note("ok")
+        except OverloadShed:
+            note("shed")
+            time.sleep(0.005)  # honor the back-off in spirit
+        except DeadlineExceeded:
+            note("deadline")
+        except (TimeoutError, RuntimeError):
+            note("error")
 
     threads = [threading.Thread(target=client, args=(i,))
                for i in range(args.clients)]
@@ -145,12 +176,99 @@ def run_soak(args) -> dict:
     service.shutdown()
     total = sum(counts.values())
     shed_rate = counts["shed"] / max(1, total)
+    # ---- trace-retention accounting (fresh spill dir per soak run)
+    trees = tracing.load_traces(trace_dir)
+    deadline_ids = {t["trace_id"] for t in trees
+                    if "deadline" in (t.get("flags")
+                                      or [t.get("reason")])}
+    trace_bytes = (os.path.getsize(tracer.path)
+                   if tracer.path and os.path.exists(tracer.path) else 0)
     return {"wall_s": round(wall, 2), "counts": counts,
             "shed_rate": round(shed_rate, 4),
             "slot_leaks": int(leaks), "slots": acct,
             "ttft_p99_s": slo["ttft_s"]["p99"],
             "inter_token_p99_s": slo["inter_token_s"]["p99"],
-            "scheduler_alive": service.error is None}
+            "scheduler_alive": service.error is None,
+            "trace_dir": trace_dir,
+            "trace_file_bytes": trace_bytes,
+            "trace_cap_bytes": tracer.max_file_bytes,
+            "trace_file_cap_drops": int((get_registry().get_value(
+                "trace_dropped_total", {"where": "file_cap"}) or 0.0)
+                - capdrops0),
+            "deadline_504s": counts["deadline"],
+            "deadline_traces_retained": len(deadline_ids)}
+
+
+def run_hedge_phase(args) -> dict:
+    """Router hedge phase: two in-process HTTP replicas over fake
+    batchers — one slow by construction — behind a hedging Router.
+    Every hedge the router fires flags its trace, so every hedged
+    request must end retained in the (same) spill dir."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_http
+    from http.server import ThreadingHTTPServer
+
+    from pytorch_distributed_train_tpu.serving_plane.router import (
+        HealthProber,
+        ReplicaSet,
+        Router,
+    )
+
+    fregistry.configure(seed=args.seed)  # no injected faults here
+    reg = get_registry()
+    hedges0 = reg.family_total("serve_hedges_total")
+
+    def mk(delay):
+        svc = serve_http.BatcherService(
+            FakeTokenBatcher(slots=4, step_delay_s=delay), FakeByteTok())
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), None)
+        srv.RequestHandlerClass = serve_http.make_handler(svc, None)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return svc, srv, f"127.0.0.1:{srv.server_address[1]}"
+
+    boxes = [mk(args.hedge_slow_delay), mk(0.002)]
+    rs = ReplicaSet(tuple(b[2] for b in boxes))
+    prober = HealthProber(rs, interval_s=0.2)
+    prober.start()
+    router = Router(rs, timeout_s=30.0, hedge_after_s=args.hedge_after)
+    sent = [0]
+    fails = [0]
+
+    def one(i):
+        body = {"prompt": f"hedge probe {i}", "max_tokens": 5}
+        status, _ = router.request("/v1/completions",
+                                   json.dumps(body).encode(), body)
+        sent[0] += 1
+        fails[0] += status != 200
+    # concurrent rounds so least-outstanding balancing actually spreads
+    # traffic onto the slow replica (a serial client would pin to the
+    # fastest) — run until at least two hedges fired or the cap
+    deadline = time.monotonic() + 30.0
+    i = 0
+    while time.monotonic() < deadline:
+        ts = [threading.Thread(target=one, args=(i + k,))
+              for k in range(3)]
+        i += 3
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        if reg.family_total("serve_hedges_total") - hedges0 >= 2 \
+                or i >= args.hedge_requests:
+            break
+    prober.stop()
+    for svc, srv, _addr in boxes:
+        srv.shutdown()
+        svc.shutdown()
+    hedges = int(reg.family_total("serve_hedges_total") - hedges0)
+    tracer = tracing.get_tracer()
+    trees = tracing.load_traces(tracer.dir or "")
+    hedged_ids = {t["trace_id"] for t in trees
+                  if "hedged" in (t.get("flags")
+                                  or [t.get("reason")])}
+    return {"requests": sent[0], "failed": fails[0],
+            "hedges_fired": hedges,
+            "hedged_traces_retained": len(hedged_ids)}
 
 
 def main(argv=None) -> int:
@@ -170,9 +288,27 @@ def main(argv=None) -> int:
     p.add_argument("--ttft-budget", type=float, default=2.0,
                    help="p99 TTFT bound in seconds")
     p.add_argument("--max-shed-rate", type=float, default=0.5)
+    p.add_argument("--trace-dir", default="",
+                   help="retained-trace spill dir (default: a fresh "
+                        "temp dir, so the retention accounting is "
+                        "exact)")
+    p.add_argument("--trace-keep-slow-ms", type=float, default=250.0)
+    p.add_argument("--trace-cap-mb", type=float, default=4.0,
+                   help="spill-file size cap the soak asserts is "
+                        "honored")
+    p.add_argument("--hedge-requests", type=int, default=30,
+                   help="max requests in the router hedge phase "
+                        "(0 = skip the phase)")
+    p.add_argument("--hedge-after", type=float, default=0.2,
+                   help="router hedge delay in the hedge phase")
+    p.add_argument("--hedge-slow-delay", type=float, default=0.1,
+                   help="slow replica's per-step decode delay in the "
+                        "hedge phase")
     args = p.parse_args(argv)
 
     report = run_soak(args)
+    if args.hedge_requests > 0:
+        report["hedge_phase"] = run_hedge_phase(args)
     print("== slo_soak report ==")
     for k, v in report.items():
         print(f"  {k}: {v}")
@@ -196,6 +332,35 @@ def main(argv=None) -> int:
         print(f"FAIL: p99 TTFT {report['ttft_p99_s']}s > "
               f"{args.ttft_budget}s", file=sys.stderr)
         ok = False
+    # ---- tracing plane bounds (docs/observability.md)
+    if report["trace_file_bytes"] > report["trace_cap_bytes"]:
+        print(f"FAIL: trace JSONL {report['trace_file_bytes']}B over "
+              f"the {report['trace_cap_bytes']}B cap", file=sys.stderr)
+        ok = False
+    # a long soak may legitimately saturate the spill cap — those drops
+    # are counted, not silent, so the retention check credits them
+    # instead of reporting a false regression at saturation
+    if (report["deadline_traces_retained"]
+            + report["trace_file_cap_drops"] < report["deadline_504s"]):
+        print(f"FAIL: {report['deadline_504s']} deadline-504s but only "
+              f"{report['deadline_traces_retained']} retained traces "
+              f"(+{report['trace_file_cap_drops']} cap drops)",
+              file=sys.stderr)
+        ok = False
+    hp = report.get("hedge_phase")
+    if hp is not None:
+        if hp["failed"]:
+            print(f"FAIL: {hp['failed']} hedge-phase request(s) failed",
+                  file=sys.stderr)
+            ok = False
+        if hp["hedges_fired"] == 0:
+            print("FAIL: hedge phase fired no hedges", file=sys.stderr)
+            ok = False
+        if hp["hedged_traces_retained"] < min(hp["hedges_fired"], 1):
+            print(f"FAIL: {hp['hedges_fired']} hedges but "
+                  f"{hp['hedged_traces_retained']} retained hedged "
+                  "trace(s)", file=sys.stderr)
+            ok = False
     if ok:
         print("slo_soak: all bounds held")
     return 0 if ok else 1

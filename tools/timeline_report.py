@@ -3,6 +3,9 @@
 
     python tools/timeline_report.py --run-dir checkpoints/
     python tools/timeline_report.py --run-dir checkpoints/ --out run_trace.json
+    python tools/timeline_report.py --run-dir checkpoints/ --trace 4f2a1c
+    python tools/timeline_report.py --traces checkpoints/traces \
+        --trace 4f2a1c --out one_trace.json
 
 Merges every host's (and the launcher agent's) append-only event
 journal (``<run>/events/events_*.jsonl``, obs/events.py) with the
@@ -17,7 +20,13 @@ goodput summary from ``metrics.jsonl`` and the host span trace
   restart / preemption) — the anomaly→capture→recovery story;
 - optionally (``--out``) a Chrome/Perfetto ``trace.json``: the span
   ring's complete events merged with one instant event per journal
-  record, one process row per host, loadable in ui.perfetto.dev.
+  record, one process row per host, loadable in ui.perfetto.dev;
+- with ``--trace <id>``: ONE distributed trace (obs/tracing.py),
+  merged across every writer's retained-trace JSONL (router + N
+  replicas + trainer) into a parent/child text tree — and, with
+  ``--out``, a Perfetto trace whose rows are one process per host with
+  depth-packed lanes, so the cross-process request tree renders with
+  correct nesting. ``<id>`` may be any unique prefix of the trace id.
 
 Pure stdlib + the repo's obs package; no jax import — safe on a login
 host against a run directory on shared storage.
@@ -215,6 +224,130 @@ def goodput_line(jsonl_path: str) -> list[str]:
             "breakdown in tools/obs_report.py)"]
 
 
+# ------------------------------------------------------- one trace (--trace)
+def _trace_children(spans: list[dict]) -> tuple[list[dict], dict]:
+    """(roots, children-by-parent) for one merged trace. A span whose
+    parent id is unknown (its parent span was never retained — e.g. a
+    subtree whose root lived in an unretained process) is treated as a
+    root so nothing silently disappears."""
+    ids = {s.get("span_id") for s in spans if s.get("span_id")}
+    kids: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        p = s.get("parent_id")
+        if p and p in ids:
+            kids.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    for v in kids.values():
+        v.sort(key=lambda s: s.get("t0", 0.0))
+    roots.sort(key=lambda s: s.get("t0", 0.0))
+    return roots, kids
+
+
+def _fmt_span_args(s: dict) -> str:
+    parts = [f"{k}={v}" for k, v in (s.get("args") or {}).items()]
+    return (" " + " ".join(parts)) if parts else ""
+
+
+def trace_report(trees: list[dict], trace_id: str) -> str:
+    """Text tree of one merged cross-process trace: every span nested
+    under its parent, host + duration + args per line, the per-writer
+    retention reasons and correlation tags up top."""
+    from pytorch_distributed_train_tpu.obs.tracing import merge_trace
+
+    spans = merge_trace(trees, trace_id)
+    if not spans:
+        return f"trace {trace_id}: not retained (no matching tree in " \
+               f"any traces_*.jsonl)"
+    full_id = next(t["trace_id"] for t in trees
+                   if t["trace_id"].startswith(trace_id))
+    writers: dict[str, dict] = {}
+    for t in trees:
+        if t["trace_id"].startswith(trace_id):
+            w = writers.setdefault(t.get("host", "?"),
+                                   {"reason": t.get("reason"),
+                                    "tags": t.get("tags") or {}})
+            w["reason"] = w["reason"] or t.get("reason")
+    t0 = min(s.get("t0", 0.0) for s in spans)
+    lines = [f"== trace {full_id} ==",
+             f"{len(spans)} span(s) across {len(writers)} process(es)"]
+    for host, w in sorted(writers.items()):
+        tags = " ".join(f"{k}={v}" for k, v in w["tags"].items())
+        lines.append(f"  [{host}] kept: {w['reason']}"
+                     + (f"  tags: {tags}" if tags else ""))
+    roots, kids = _trace_children(spans)
+
+    def _walk(s, depth):
+        lines.append(
+            f"  +{s.get('t0', 0.0) - t0:8.3f}s {'  ' * depth}"
+            f"{s.get('name')} {s.get('dur_s', 0.0) * 1e3:.1f}ms "
+            f"[{s.get('host')}]" + _fmt_span_args(s))
+        for c in kids.get(s.get("span_id"), []):
+            _walk(c, depth + 1)
+
+    for r in roots:
+        _walk(r, 0)
+    return "\n".join(lines)
+
+
+def trace_perfetto(trees: list[dict], trace_id: str) -> dict:
+    """One merged trace as Chrome/Perfetto JSON: one process row per
+    host; within a host, spans pack into depth-based lanes (a child's
+    lane is below its parent's; temporally overlapping same-depth
+    siblings — a hedge racing its primary — spread to separate lanes so
+    Perfetto's containment nesting never lies about parentage). Args
+    carry the explicit span/parent ids for programmatic checks."""
+    from pytorch_distributed_train_tpu.obs.tracing import merge_trace
+
+    spans = merge_trace(trees, trace_id)
+    # args must carry the FULL id, not the user's prefix — scripts
+    # correlate the export back against traces_*.jsonl by it
+    full_id = next((t["trace_id"] for t in trees
+                    if t["trace_id"].startswith(trace_id)), trace_id)
+    roots, kids = _trace_children(spans)
+    hosts = sorted({s.get("host", "?") for s in spans})
+    pid_of = {h: i + 1 for i, h in enumerate(hosts)}
+    out: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "args": {"name": h}}
+        for h, pid in pid_of.items()]
+    # lane occupancy per (host, lane): list of (t0, t1) intervals
+    busy: dict[tuple, list] = {}
+
+    def _lane(host, min_lane, t0, t1):
+        lane = min_lane
+        while any(a < t1 and t0 < b for a, b in busy.get((host, lane),
+                                                         ())):
+            lane += 1
+        busy.setdefault((host, lane), []).append((t0, t1))
+        return lane
+
+    def _emit(s, min_lane):
+        host = s.get("host", "?")
+        t0 = float(s.get("t0", 0.0))
+        t1 = t0 + float(s.get("dur_s", 0.0))
+        lane = _lane(host, min_lane, t0, t1)
+        args = dict(s.get("args") or {})
+        args.update({"trace_id": full_id,
+                     "span_id": s.get("span_id"),
+                     "parent_id": s.get("parent_id"),
+                     "host": host})
+        if s.get("tags"):
+            args["tags"] = s["tags"]
+        out.append({"name": s.get("name"), "ph": "X", "ts": t0 * 1e6,
+                    "dur": max(1.0, (t1 - t0) * 1e6),
+                    "pid": pid_of.get(host, 0), "tid": lane,
+                    "args": args})
+        for c in kids.get(s.get("span_id"), []):
+            # depth lanes are per host: a child living in another
+            # process starts at that host's top lane
+            _emit(c, lane + 1 if c.get("host", "?") == host else 0)
+
+    for r in roots:
+        _emit(r, 0)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
 # ------------------------------------------------------------ perfetto out
 def perfetto_trace(events: list[dict], trace_path: str = "") -> dict:
     """Spans (complete events, pass-through) + journal instants, one
@@ -277,11 +410,43 @@ def main(argv=None) -> int:
                    help="explicit events directory (default "
                         "<run-dir>/events)")
     p.add_argument("--jsonl", default="", help="explicit metrics.jsonl")
-    p.add_argument("--trace", default="", help="explicit trace.json")
+    p.add_argument("--span-trace", default="",
+                   help="explicit span trace.json (the ring export)")
+    p.add_argument("--trace", default="", metavar="TRACE_ID",
+                   help="report ONE distributed trace (id or unique "
+                        "prefix) merged across every retained-trace "
+                        "file; --out then writes its Perfetto tree")
+    p.add_argument("--traces", default="",
+                   help="retained-traces directory (default "
+                        "<run-dir>/traces)")
     p.add_argument("--out", default="",
                    help="also write a merged Chrome/Perfetto trace.json "
-                        "(spans + journal instants) to this path")
+                        "(spans + journal instants; with --trace: the "
+                        "one request tree) to this path")
     args = p.parse_args(argv)
+    if args.trace:
+        from pytorch_distributed_train_tpu.obs.tracing import load_traces
+
+        traces_dir = args.traces or (os.path.join(args.run_dir, "traces")
+                                     if args.run_dir else "")
+        if not traces_dir or not os.path.isdir(traces_dir):
+            print(f"timeline_report: no traces directory at "
+                  f"{traces_dir!r} (--run-dir or --traces)",
+                  file=sys.stderr)
+            return 2
+        trees = load_traces(traces_dir)
+        try:
+            print(trace_report(trees, args.trace))
+        except ValueError as e:  # ambiguous prefix
+            print(f"timeline_report: {e}", file=sys.stderr)
+            return 2
+        if args.out:
+            merged = trace_perfetto(trees, args.trace)
+            with open(args.out, "w") as f:
+                json.dump(merged, f)
+            print(f"\nwrote Perfetto trace tree: {args.out} "
+                  f"({len(merged['traceEvents'])} events)")
+        return 0
     events_dir = args.events or (os.path.join(args.run_dir, "events")
                                  if args.run_dir else "")
     if not events_dir or not os.path.isdir(events_dir):
@@ -290,8 +455,8 @@ def main(argv=None) -> int:
         return 2
     jsonl = args.jsonl or (os.path.join(args.run_dir, "metrics.jsonl")
                            if args.run_dir else "")
-    trace = args.trace or (os.path.join(args.run_dir, "trace.json")
-                           if args.run_dir else "")
+    trace = args.span_trace or (os.path.join(args.run_dir, "trace.json")
+                                if args.run_dir else "")
     print(report(events_dir, jsonl, trace))
     if args.out:
         merged = perfetto_trace(load_events(events_dir), trace)
