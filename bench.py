@@ -307,9 +307,20 @@ def pipeline_bench(args) -> None:
     measured here is the per-batch collate cost the train loop overlaps
     with device steps.) Deliberately does NOT seed/read BENCH_BASELINE.json:
     host throughput scales with whatever else shares the host cores, so a
-    cross-run ratio would gate CI on machine load, not on code."""
+    cross-run ratio would gate CI on machine load, not on code.
+
+    ISSUE 12 arms (each its own metric name → fresh ledger trajectory):
+    ``--packed-cache`` stores the dataset as packed shards and reads
+    them through the mmap path (data/packed_cache.py);
+    ``--device-augment`` ships raw u8 (host augment collapses to the
+    read — the stall_split records the shift; the device-side cost is
+    measured by the training benches, not here); ``--mp-workers N``
+    collates in the shared-memory decode pool (data/workers.py)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the TPU here
     _bringup_done[0] = True  # host-only mode: no stall/error here is the TPU's
+    import shutil
+    import tempfile
+
     import numpy as np
 
     from pytorch_distributed_train_tpu.config import DataConfig
@@ -325,33 +336,61 @@ def pipeline_bench(args) -> None:
             f"--batch-per-chip {batch} too large for the {n}-sample "
             "synthetic dataset (need >= 2 batches: 1 warmup + 1 timed)")
     rng = np.random.default_rng(0)
-    ds = U8ImageDataset(
-        rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8),
-        rng.integers(0, 1000, n).astype(np.int32),
-        mean=np.array([0.485, 0.456, 0.406], np.float32),
-        std=np.array([0.229, 0.224, 0.225], np.float32),
-        augment=True,
-    )
-    cfg = DataConfig(batch_size=batch)
-    loader = HostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)
+    images = rng.integers(0, 256, (n, size, size, 3), dtype=np.uint8)
+    labels = rng.integers(0, 1000, n).astype(np.int32)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    tmp = None
+    try:
+        if args.packed_cache:
+            from tools.pack_dataset import pack_arrays
 
-    it = loader.epoch(0)
-    next(it)  # warm caches
-    _touch()
-    t0 = time.perf_counter()
-    seen = 0
-    for b in it:
-        seen += len(b["label"])
-        _touch()  # per-batch progress (host loop is touchable)
-    wall = time.perf_counter() - t0
+            from pytorch_distributed_train_tpu.data.packed_cache import (
+                PackedImageDataset,
+            )
+
+            tmp = tempfile.mkdtemp(prefix="bench-packed-")
+            pack_arrays(images, labels, tmp, split="train",
+                        shard_records=max(batch, n // 4),
+                        meta={"mean": mean.tolist(), "std": std.tolist(),
+                              "pad": 4})
+            del images  # the mmap is the storage under test, not RAM
+            ds = PackedImageDataset(tmp, augment=True, split="train",
+                                    raw_u8=args.device_augment)
+        else:
+            ds = U8ImageDataset(images, labels, mean=mean, std=std,
+                                augment=True, raw_u8=args.device_augment)
+        cfg = DataConfig(batch_size=batch, mp_workers=args.mp_workers)
+        loader = HostDataLoader(ds, cfg, train=True, num_hosts=1, host_id=0)
+
+        it = loader.epoch(0)
+        next(it)  # warm caches (and fork+prime the worker pool)
+        _touch()
+        t0 = time.perf_counter()
+        seen = 0
+        for b in it:
+            seen += len(b["label"])
+            _touch()  # per-batch progress (host loop is touchable)
+        wall = time.perf_counter() - t0
+        loader.close()
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
     native = "native" if imgops.available() else "numpy"
-    metric = f"input_pipeline_{native}_images_per_sec"
+    parts = ["input_pipeline"]
+    if args.packed_cache:
+        parts.append("packed")
+    parts.append("rawu8" if args.device_augment else native)
+    if loader.mp_workers > 0:
+        parts.append(f"mp{loader.mp_workers}")
     record = {
-        "metric": metric,
+        "metric": "_".join(parts) + "_images_per_sec",
         "value": round(seen / wall, 2),
         "unit": "images/sec (host)",
         "vs_baseline": 1.0,
     }
+    if loader.mp_workers > 0:
+        record["mp_workers"] = loader.mp_workers
     from pytorch_distributed_train_tpu.obs import perf as perf_lib
 
     split = perf_lib.get_input_stats().split()
@@ -403,7 +442,7 @@ def pipeline_decode_bench(args) -> None:
             raise SystemExit("--decoder native requested but the jpegdec "
                              "library is unavailable")
         cfg = DataConfig(batch_size=batch, loader=args.loader,
-                         num_workers=workers)
+                         num_workers=workers, mp_workers=args.mp_workers)
         if args.loader == "grain":
             from pytorch_distributed_train_tpu.data.grain_pipeline import (
                 GrainHostDataLoader,
@@ -438,13 +477,21 @@ def pipeline_decode_bench(args) -> None:
             close()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    if args.loader == "grain":
+        # grain + pool: effective count is the pool-clamped num_workers
+        mp_used = loader.num_workers if loader._pool_budget > 0 else 0
+    else:
+        mp_used = loader.mp_workers
+    mp_sfx = f"_mp{mp_used}" if mp_used else ""
     record = {
         "metric": f"input_pipeline_decode_{decoder}_{args.loader}"
-                  "_images_per_sec",
+                  f"{mp_sfx}_images_per_sec",
         "value": round(seen / wall, 2),
         "unit": "images/sec (host)",
         "vs_baseline": 1.0,
     }
+    if mp_used:
+        record["mp_workers"] = mp_used
     # Staged attribution (obs/perf.py): which stage of the decode
     # pipeline the wall went to — the per-stage view of the host wall.
     from pytorch_distributed_train_tpu.obs import perf as perf_lib
@@ -1009,6 +1056,21 @@ def main() -> None:
                    help="decode bench: host loader backend (SURVEY C17)")
     p.add_argument("--workers", type=int, default=0,
                    help="decode bench: loader workers (0 → cpu count)")
+    p.add_argument("--mp-workers", type=int, default=0,
+                   help="pipeline benches: shared-memory decode worker "
+                        "PROCESSES (data/workers.py; 0 = in-process). "
+                        "Clamped to cpu_count-1; metric name records the "
+                        "effective count")
+    p.add_argument("--packed-cache", action="store_true",
+                   help="with --model pipeline: store the synthetic "
+                        "dataset as packed pre-decoded shards "
+                        "(tools/pack_dataset.py format) and read through "
+                        "the mmap path (data/packed_cache.py)")
+    p.add_argument("--device-augment", action="store_true",
+                   help="with --model pipeline: host ships raw uint8 "
+                        "(data.device_augment mode) — measures the host "
+                        "side with the augment share collapsed into "
+                        "device compute")
     p.add_argument("--stem", default="conv", choices=["conv", "space_to_depth"],
                    help="resnet ImageNet stem: space_to_depth is the exact "
                         "MXU-friendly 4x4/s1 rewrite (models/resnet.py)")

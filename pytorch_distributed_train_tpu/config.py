@@ -190,8 +190,39 @@ class DataConfig:
     # or RandAugment is on (PIL-op chain). Same crop policy, plain-bilinear
     # resampling (PIL filters on downscale — statistically equivalent).
     native_decode: bool = False
+    # Shared-memory multi-process decode plane (data/workers.py): >0
+    # runs decode/augment in N forked worker processes writing decoded
+    # batches into preallocated shared-memory ring slots (no pixel
+    # pickling), fronting BOTH loaders. 0 = in-process (threads for the
+    # "threads" loader, grain's own machinery for "grain"). Clamped to
+    # cpu_count-1 (workers.pool_budget); batch composition and resume
+    # semantics are byte-identical to the in-process path.
+    mp_workers: int = 0
+    # Ring depth for the shared-memory pool (0 -> mp_workers + 2).
+    mp_slots: int = 0
+    # Packed pre-decoded sample cache (data/packed_cache.py): directory
+    # of fixed-record u8 shards built by tools/pack_dataset.py. When set
+    # on an image dataset, a valid cache for the split replaces the
+    # decode path with one mmap'd strided read (hit/miss counted in the
+    # registry); absent/invalid caches fall through to the original
+    # dataset. Dataset name "packed_images" reads shards directly from
+    # data_dir (dir or glob).
+    packed_cache_dir: str = ""
+    # Verify shard CRCs at open (full payload read; tools and tests —
+    # training opens skip it and rely on the pack-time CRC).
+    packed_verify: bool = False
+    # Device-side augmentation (ops/device_augment.py): datasets that
+    # can ship raw uint8 pixels skip host-side crop/flip/RandAugment/
+    # normalize; the jitted train step applies them on-device under the
+    # same PRNG-folding discipline as dropout. Host path is unchanged
+    # when off; datasets that cannot ship u8 (synthetic/LM/native-decode
+    # tar) ignore the flag.
+    device_augment: bool = False
     # Host-side RandAugment (data/augment.py; ImageFolder train path).
     # num_ops 0 disables; magnitude in [0, 30] (torchvision's 31 bins).
+    # With device_augment on, the RandAugment op space moves on-device
+    # (photometric/affine u8 ops — ops/device_augment.py documents the
+    # semantic deltas vs the PIL chain).
     randaugment_num_ops: int = 0
     randaugment_magnitude: int = 9
     # LM datasets

@@ -70,14 +70,25 @@ class U8ImageDataset(ArrayDataset):
     either way (both implement reflect-101 padding then (x/255-mean)/std).
     """
 
-    def __init__(self, images_u8: np.ndarray, labels: np.ndarray,
+    def __init__(self, images_u8: np.ndarray | None, labels: np.ndarray,
                  mean: np.ndarray, std: np.ndarray, augment: bool,
-                 pad: int = 4, randaugment=None):
-        super().__init__({"image": images_u8, "label": labels})
+                 pad: int = 4, randaugment=None, raw_u8: bool = False):
+        # images_u8=None is the storage-elsewhere subclass hook (the
+        # packed cache mmaps its pixels): _read_images is overridden and
+        # only labels live in self.arrays.
+        arrays = {"label": labels}
+        if images_u8 is not None:
+            arrays["image"] = images_u8
+        super().__init__(arrays)
         self.mean, self.std = mean, std
         self.do_augment = augment
         self.pad = pad
         self.randaugment = randaugment if augment else None
+        # raw_u8 (data.device_augment): ship uint8 pixels untouched —
+        # crop/flip/RandAugment/normalize move into the jitted step
+        # (ops/device_augment.py), so the host's augment share collapses
+        # to the fancy-index read.
+        self.raw_u8 = raw_u8
         self._ra_pool = None
 
     def __getstate__(self):
@@ -118,12 +129,23 @@ class U8ImageDataset(ArrayDataset):
             zip(imgs_u8, seeds),
         )))
 
+    def _read_images(self, idx) -> np.ndarray:
+        """Pixel gather for a batch — overridden by the packed cache
+        (mmap'd strided read instead of an in-RAM fancy index)."""
+        return self.arrays["image"][idx]
+
     def get_batch(self, idx, rng, train):
         from pytorch_distributed_train_tpu.native import imgops
         from pytorch_distributed_train_tpu.obs.perf import stage
 
         with stage("read"):
-            imgs = self.arrays["image"][idx]
+            imgs = self._read_images(idx)
+        if self.raw_u8:
+            # Device-side augmentation path: the read IS the whole host
+            # cost; pixels leave as uint8 (4x less h2d traffic than the
+            # normalized f32 batch they replace).
+            return {"image": np.ascontiguousarray(imgs),
+                    "label": self.arrays["label"][idx]}
         B, H, W, C = imgs.shape
         with stage("augment"):
             return self._augment_batch(imgs, idx, rng, train, B, imgops)
@@ -307,13 +329,18 @@ class ImageFolderDataset:
     is_item_style = True
 
     def __init__(self, root: str, image_size: int, train: bool,
-                 randaugment=None):
+                 randaugment=None, raw_u8: bool = False):
         from PIL import Image  # noqa: F401  (verify import early)
 
         self.root = root
         self.image_size = image_size
         self.train = train
         self.randaugment = randaugment if train else None
+        # raw_u8 (data.device_augment): decode + crop stay host-side
+        # (RandomResizedCrop IS the decode-adjacent resample); flip,
+        # RandAugment and normalize move into the jitted step, and the
+        # item leaves as HWC uint8.
+        self.raw_u8 = raw_u8
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
         )
@@ -351,13 +378,18 @@ class ImageFolderDataset:
             with stage("augment"):
                 if self.train:
                     im = _random_resized_crop(im, self.image_size, rng)
-                    if rng.random() < 0.5:
-                        im = im.transpose(Image.FLIP_LEFT_RIGHT)
-                    if self.randaugment is not None:
-                        im = self.randaugment(im, rng)
+                    if not self.raw_u8:
+                        if rng.random() < 0.5:
+                            im = im.transpose(Image.FLIP_LEFT_RIGHT)
+                        if self.randaugment is not None:
+                            im = self.randaugment(im, rng)
                 else:
                     im = _center_crop(im, self.image_size)
                 x_u8 = np.asarray(im, np.uint8)
+        if self.raw_u8:
+            # device-augment mode: flip/RandAugment/normalize happen in
+            # the jitted step; the host ships uint8.
+            return {"image": x_u8, "label": np.int32(label)}
         from pytorch_distributed_train_tpu.native import imgops
 
         with stage("augment"):
@@ -430,13 +462,19 @@ class TarShardImageDataset(ImageFolderDataset):
 
     def __init__(self, pattern: str, image_size: int, train: bool,
                  randaugment=None, native_decode: bool = False,
-                 decode_threads: int = 0):
+                 decode_threads: int = 0, raw_u8: bool = False):
         import glob as glob_mod
         import tarfile
 
         self.image_size = image_size
         self.train = train
         self.randaugment = randaugment if train else None
+        # raw_u8 (device augment) needs uint8 out, which the fused
+        # native decode+normalize kernel cannot produce — the PIL
+        # per-item path carries this mode (see ImageFolderDataset).
+        self.raw_u8 = raw_u8
+        if raw_u8:
+            native_decode = False
         self.shards = sorted(glob_mod.glob(pattern))
         if not self.shards:
             raise FileNotFoundError(
@@ -644,17 +682,65 @@ def _center_crop(im, size: int):
 def _build_randaugment(data_cfg, train: bool):
     if not train or data_cfg.randaugment_num_ops <= 0:
         return None
+    # With device augment on, the RandAugment op space runs on-device
+    # inside the jitted step (ops/device_augment.py) — a host-side PIL
+    # chain here would double-augment.
+    if getattr(data_cfg, "device_augment", False):
+        return None
     from pytorch_distributed_train_tpu.data.augment import RandAugment
 
     return RandAugment(data_cfg.randaugment_num_ops,
                        data_cfg.randaugment_magnitude)
 
 
+def _want_raw_u8(data_cfg) -> bool:
+    return bool(getattr(data_cfg, "device_augment", False))
+
+
+def _packed_or_none(data_cfg, train: bool):
+    """data.packed_cache_dir: a valid packed cache for the split
+    replaces the decode path (data/packed_cache.py — hit/miss counted
+    in the registry); anything else falls through to the original
+    dataset build."""
+    cache_dir = getattr(data_cfg, "packed_cache_dir", "")
+    if not cache_dir:
+        return None
+    from pytorch_distributed_train_tpu.data.packed_cache import (
+        load_packed_if_present,
+    )
+
+    return load_packed_if_present(
+        cache_dir, "train" if train else "val", augment=train,
+        randaugment=_build_randaugment(data_cfg, train),
+        verify=getattr(data_cfg, "packed_verify", False),
+        raw_u8=_want_raw_u8(data_cfg))
+
+
 def build_dataset(data_cfg, model_cfg, train: bool):
     name = data_cfg.dataset
+    if name in ("cifar10", "imagenet_folder", "imagenet_tar"):
+        packed = _packed_or_none(data_cfg, train)
+        if packed is not None:
+            return packed
+    if name == "packed_images":
+        # Direct packed-shard dataset: data_dir is a shard directory,
+        # glob, or single file (tools/pack_dataset.py output).
+        from pytorch_distributed_train_tpu.data.packed_cache import (
+            PackedImageDataset,
+        )
+
+        return PackedImageDataset(
+            data_cfg.data_dir, augment=train,
+            randaugment=_build_randaugment(data_cfg, train),
+            verify=getattr(data_cfg, "packed_verify", False),
+            raw_u8=_want_raw_u8(data_cfg),
+            split="train" if train else "val")
     if name == "cifar10":
-        return load_cifar10(data_cfg.data_dir, train,
-                            randaugment=_build_randaugment(data_cfg, train))
+        ds = load_cifar10(data_cfg.data_dir, train,
+                          randaugment=_build_randaugment(data_cfg, train))
+        if _want_raw_u8(data_cfg) and isinstance(ds, U8ImageDataset):
+            ds.raw_u8 = True
+        return ds
     if name == "synthetic_images":
         return synthetic_images(
             data_cfg.synthetic_size, model_cfg.image_size, model_cfg.num_classes,
@@ -669,7 +755,8 @@ def build_dataset(data_cfg, model_cfg, train: bool):
                 model_cfg.num_classes, seed=0 if train else 1,
             )
         return ImageFolderDataset(root, model_cfg.image_size, train,
-                                  randaugment=_build_randaugment(data_cfg, train))
+                                  randaugment=_build_randaugment(data_cfg, train),
+                                  raw_u8=_want_raw_u8(data_cfg))
     if name == "imagenet_tar":
         # WebDataset-style shards: data_dir is a glob per split, e.g.
         # '/data/imagenet-{split}-*.tar' ({split} → train|val), or a
@@ -680,7 +767,8 @@ def build_dataset(data_cfg, model_cfg, train: bool):
             pattern, model_cfg.image_size, train,
             randaugment=_build_randaugment(data_cfg, train),
             native_decode=data_cfg.native_decode,
-            decode_threads=data_cfg.num_workers)
+            decode_threads=data_cfg.num_workers,
+            raw_u8=_want_raw_u8(data_cfg))
     if name == "synthetic_lm":
         return synthetic_lm(
             data_cfg.synthetic_size, data_cfg.seq_len, model_cfg.vocab_size,

@@ -40,22 +40,43 @@ from pytorch_distributed_train_tpu.obs.spans import span as _span
 # batched map (see _make_load_transform). A module global, NOT transform
 # state: MapTransform instances pickle into grain worker processes and a
 # ThreadPoolExecutor does not — each worker process (or the in-process
-# worker_count=0 path) lazily builds its own.
+# worker_count=0 path) lazily builds its own. Pid-guarded: the shared-
+# memory decode pool (data/workers.py) FORKS its workers, and executor
+# threads never survive a fork.
 _DECODE_POOL = None
 
 
 def _decode_pool():
     global _DECODE_POOL
-    if _DECODE_POOL is None:
+    if _DECODE_POOL is None or _DECODE_POOL[0] != os.getpid():
         from concurrent.futures import ThreadPoolExecutor
 
-        _DECODE_POOL = ThreadPoolExecutor(
-            max_workers=max(1, min(8, os.cpu_count() or 1)),
-            thread_name_prefix="grain-decode")
-    return _DECODE_POOL
+        from pytorch_distributed_train_tpu.data import workers as workers_lib
+
+        _DECODE_POOL = (os.getpid(), ThreadPoolExecutor(
+            max_workers=workers_lib.process_thread_budget(
+                min(8, os.cpu_count() or 1)),
+            thread_name_prefix="grain-decode"))
+    return _DECODE_POOL[1]
 
 
-def bounded_workers(requested: int, avail: int | None = None) -> int:
+# Log each distinct clamp once per process — a per-epoch warning for the
+# same configured count is noise, silence is an unexplained throughput
+# drop (satellite: grain clamp fix, ISSUE 12).
+_CLAMP_LOGGED: set = set()
+
+
+def _effective_workers_gauge(loader: str):
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+    return get_registry().gauge(
+        "input_effective_workers", labels={"loader": loader},
+        help="effective input-pipeline worker count after host/pool "
+             "clamping (processes; 0 = in-process loading)")
+
+
+def bounded_workers(requested: int, avail: int | None = None, *,
+                    pool_budget: int = 0) -> int:
     """Cap Grain worker PROCESSES by what the host can actually run.
 
     Worker processes exist to escape the GIL onto OTHER cores
@@ -66,19 +87,33 @@ def bounded_workers(requested: int, avail: int | None = None) -> int:
     while worker_count=0 (in-process loading, Grain's supported
     degenerate mode) streams fine. Cap = cpu_count - 1 (one core stays
     with the consumer/train loop), never more than requested.
+
+    With the shared-memory pool enabled (``pool_budget`` > 0, from
+    ``data.mp_workers``) the old 1-core clamp-to-zero is WRONG: the pool
+    replaces grain's worker machinery outright — its workers block on a
+    queue when idle instead of spinning grain's per-element IPC — so the
+    effective count clamps against the POOL's own budget (floor 1).
+    Either way the decision is logged once per distinct clamp and
+    exposed as the ``input_effective_workers`` gauge.
     """
     if avail is None:
         avail = os.cpu_count() or 1
-    bounded = max(0, min(requested, avail - 1))
-    if bounded < requested:
-        # Say so: a configured worker count silently collapsing to
-        # in-process loading would read as an unexplained throughput drop.
+    if pool_budget > 0:
+        bounded = max(1, min(requested, pool_budget)) if requested > 0 \
+            else pool_budget
+        why = (f"shared-memory pool budget {pool_budget} "
+               f"(data.mp_workers; {avail} host core(s))")
+    else:
+        bounded = max(0, min(requested, avail - 1))
+        why = (f"{avail} host core(s); worker processes need a spare "
+               "core — 0 = in-process loading")
+    if bounded != requested and (requested, bounded) not in _CLAMP_LOGGED:
+        _CLAMP_LOGGED.add((requested, bounded))
         import warnings
 
         warnings.warn(
-            f"grain num_workers={requested} clamped to {bounded} "
-            f"({avail} host core(s); worker processes need a spare core "
-            "— 0 = in-process loading)")
+            f"grain num_workers={requested} clamped to {bounded} ({why})")
+    _effective_workers_gauge("grain").set(bounded)
     return bounded
 
 
@@ -109,6 +144,64 @@ class _BatchIndexSource:
         return self._order[b * self._batch:(b + 1) * self._batch]
 
 
+def load_batch_payload(dataset, item_style: bool, train: bool,
+                       seed: int, epoch: int, idx: np.ndarray) -> dict:
+    """Load ONE host batch under the GRAIN rng-keying convention — the
+    single definition shared by grain's MapTransform (in grain worker
+    processes or in-process under worker_count=0) and the shared-memory
+    decode pool (data/workers.py), so the two process models cannot
+    drift byte-wise.
+
+    Batched (get_batch) rng is keyed on (seed, epoch, the batch's FULL
+    index tuple) — the full tuple, not idx[0], because weighted sampling
+    with replacement can repeat a first element across different
+    batches. Item-style records keep per-RECORD keying (seed, epoch,
+    record index): each record's augment draws are bit-exact regardless
+    of how batches regroup."""
+    idx = np.asarray(idx, np.int64)
+    # Retry/backoff + the `data.decode` fault point come from the
+    # faults package (lazy import: worker processes rebuild their own
+    # process-local schedule from the PDTT_FAULTS env var).
+    from pytorch_distributed_train_tpu import faults as faults_lib
+
+    # The span feeds span_seconds{name="data.grain.load_batch"} — the
+    # decode wait is a scrapable histogram, so the worker_count=0
+    # throughput question (ADVICE round 5) is answerable from /metrics
+    # instead of re-profiling.
+    with _span("data.grain.load_batch", records=int(len(idx))):
+        if item_style:
+            # Per-record decode fans out over a thread pool: under
+            # worker_count=0 the round-5 batched-map restructure had
+            # serialized what used to run on grain's read threads (PIL
+            # decode releases the GIL). Per-record rng keying is
+            # position-free, so thread scheduling cannot perturb
+            # reproducibility. Substituted records (decode_with_retry's
+            # last resort) keep the keying: record j's rng is always
+            # (seed, epoch, j), wherever it lands.
+            def _load(i):
+                def load(j):
+                    faults_lib.maybe_fire("data.decode")
+                    return dataset.get_item(
+                        int(j), np.random.default_rng(
+                            np.random.SeedSequence(
+                                (seed, epoch, int(j)))))
+
+                return faults_lib.decode_with_retry(
+                    load, int(i), len(dataset))
+
+            items = list(_decode_pool().map(_load, idx))
+            return {k: np.stack([it[k] for it in items])
+                    for k in items[0]}
+
+        def _load_batch():
+            faults_lib.maybe_fire("data.decode")
+            rng = np.random.default_rng(np.random.SeedSequence(
+                (seed, epoch) + tuple(int(t) for t in idx)))
+            return dataset.get_batch(idx, rng, train)
+
+        return faults_lib.retry_call(_load_batch, point="data.decode")
+
+
 def _make_load_transform(dataset, item_style: bool, train: bool,
                          seed: int, epoch: int):
     """One MapTransform per host BATCH (an index array element).
@@ -118,66 +211,14 @@ def _make_load_transform(dataset, item_style: bool, train: bool,
     ~1.1 ms/record of pure grain machinery in the per-record
     formulation, and batch-of-1 calls starved the native batch decoder
     (native/jpegdec.cpp); whole-batch elements amortize the machinery
-    by the batch size and hand the decoder real batches. Their rng is
-    keyed on (seed, epoch, the batch's FULL index tuple) — the full
-    tuple, not idx[0], because weighted sampling with replacement can
-    repeat a first element across different batches.
-
-    Item-style records keep per-RECORD keying (seed, epoch, record
-    index): each record's augment draws are bit-exact regardless of
-    how batches regroup, the strongest reproducibility convention and
-    the one the threads loader's resume tests pin."""
+    by the batch size and hand the decoder real batches. Load + rng
+    semantics live in :func:`load_batch_payload`."""
     import grain.python as gp
 
     class _LoadBatch(gp.MapTransform):
         def map(self, idx):
-            idx = np.asarray(idx, np.int64)
-            # Retry/backoff + the `data.decode` fault point come from
-            # the faults package (lazy import: this transform pickles
-            # into grain worker processes, which rebuild their own
-            # process-local schedule from the PDTT_FAULTS env var —
-            # config-driven schedules arm the in-process
-            # worker_count=0 path).
-            from pytorch_distributed_train_tpu import faults as faults_lib
-
-            # The span feeds span_seconds{name="data.grain.load_batch"}
-            # — the decode wait is a scrapable histogram, so the
-            # worker_count=0 throughput question (ADVICE round 5) is
-            # answerable from /metrics instead of re-profiling.
-            with _span("data.grain.load_batch", records=int(len(idx))):
-                if item_style:
-                    # Per-record decode fans out over a thread pool:
-                    # under worker_count=0 the round-5 batched-map
-                    # restructure had serialized what used to run on
-                    # grain's read threads (PIL decode releases the
-                    # GIL). Per-record rng keying is position-free, so
-                    # thread scheduling cannot perturb reproducibility.
-                    # Substituted records (decode_with_retry's last
-                    # resort) keep the keying: record j's rng is always
-                    # (seed, epoch, j), wherever it lands.
-                    def _load(i):
-                        def load(j):
-                            faults_lib.maybe_fire("data.decode")
-                            return dataset.get_item(
-                                int(j), np.random.default_rng(
-                                    np.random.SeedSequence(
-                                        (seed, epoch, int(j)))))
-
-                        return faults_lib.decode_with_retry(
-                            load, int(i), len(dataset))
-
-                    items = list(_decode_pool().map(_load, idx))
-                    return {k: np.stack([it[k] for it in items])
-                            for k in items[0]}
-
-                def _load_batch():
-                    faults_lib.maybe_fire("data.decode")
-                    rng = np.random.default_rng(np.random.SeedSequence(
-                        (seed, epoch) + tuple(int(t) for t in idx)))
-                    return dataset.get_batch(idx, rng, train)
-
-                return faults_lib.retry_call(_load_batch,
-                                             point="data.decode")
+            return load_batch_payload(dataset, item_style, train, seed,
+                                      epoch, idx)
 
     return _LoadBatch()
 
@@ -207,7 +248,21 @@ class GrainHostDataLoader:
         self.host_batch = global_batch // self.num_hosts
         self.seed = data_cfg.seed
         self.shuffle = train and data_cfg.shuffle
-        self.num_workers = bounded_workers(data_cfg.num_workers)
+        # Shared-memory decode pool (data/workers.py): when enabled it
+        # REPLACES grain's worker machinery — the in-process
+        # worker_count=0 degenerate mode this loader was clamped into on
+        # core-starved hosts — so the effective worker count clamps
+        # against the pool's own budget, not cpu_count-1 (ISSUE 12
+        # satellite: the grain bounded_workers fix).
+        from pytorch_distributed_train_tpu.data import workers as workers_lib
+
+        self._pool_budget = (
+            workers_lib.pool_budget(getattr(data_cfg, "mp_workers", 0))
+            if workers_lib.available() else 0)
+        self.num_workers = bounded_workers(
+            data_cfg.num_workers, pool_budget=self._pool_budget)
+        self.mp_slots = getattr(data_cfg, "mp_slots", 0)
+        self._mp_pool = None
         self.read_buffer = max(2, data_cfg.prefetch)
         self.weighted = None
         if train and getattr(data_cfg, "weighted_sampling", ""):
@@ -282,10 +337,67 @@ class GrainHostDataLoader:
             (sampler[self.host_id + k * self.num_hosts].record_key
              for k in range(n)), np.int64, count=n)
 
+    def close(self) -> None:
+        """Release the shared-memory pool (bench/tests)."""
+        if self._mp_pool is not None:
+            self._mp_pool.close()
+            self._mp_pool = None
+
+    def _pad_tail(self, out: dict) -> dict:
+        short = self.host_batch - len(next(iter(out.values())))
+        if short > 0:
+            # Pad the tail batch by wrapping — SPMD needs static shapes
+            # (same invariant as HostDataLoader's eval-tail wrap).
+            out = {
+                k: np.concatenate(
+                    [v, np.tile(v, (short // len(v) + 1,)
+                                + (1,) * (v.ndim - 1))[:short]]
+                )
+                for k, v in out.items()
+            }
+        return out
+
+    def _pool_load(self, task) -> dict:
+        """One (epoch, idx-array) pool task → batch dict, under grain's
+        rng-keying convention (load_batch_payload) — runs inside a
+        forked decode worker; byte-identical to the grain path."""
+        epoch, idx = task
+        return load_batch_payload(
+            self.dataset, getattr(self.dataset, "is_item_style", False),
+            self.train, self.seed, epoch, idx)
+
+    def _epoch_via_pool(self, epoch: int,
+                        order: np.ndarray) -> Iterator[dict]:
+        """Shared-memory pool path: same epoch-order batch slices as the
+        grain source (_BatchIndexSource semantics), decoded in N forked
+        workers. Batch b is ALWAYS epoch-order slice [b*B:(b+1)*B] —
+        invariant to the worker count, resume-exact."""
+        if self._mp_pool is None:
+            from pytorch_distributed_train_tpu.data import (
+                workers as workers_lib,
+            )
+
+            self._mp_pool = workers_lib.SharedMemoryWorkerPool(
+                self._pool_load, self.num_workers, slots=self.mp_slots,
+                post_fork=lambda: workers_lib.reset_thread_local_state(
+                    self.dataset))
+        n_batches = (len(order) + self.host_batch - 1) // self.host_batch
+        tasks = ((epoch, order[b * self.host_batch:
+                               (b + 1) * self.host_batch])
+                 for b in range(n_batches))
+        for out in self._mp_pool.run(tasks):
+            yield self._pad_tail(
+                {k: np.asarray(v) for k, v in out.items()})
+
     def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
+        order = self._epoch_order(epoch)[start_batch * self.host_batch:]
+        if self._pool_budget > 0:
+            return self._epoch_via_pool(epoch, order)
+        return self._epoch_grain(epoch, order)
+
+    def _epoch_grain(self, epoch: int, order: np.ndarray) -> Iterator[dict]:
         import grain.python as gp
 
-        order = self._epoch_order(epoch)[start_batch * self.host_batch:]
         source = _BatchIndexSource(order, self.host_batch)
         order_sampler = gp.IndexSampler(
             num_records=len(source), shuffle=False,
@@ -324,16 +436,4 @@ class GrainHostDataLoader:
                 batch = next(it, _done)
             if batch is _done:
                 break
-            out = {k: np.asarray(v) for k, v in batch.items()}
-            short = self.host_batch - len(next(iter(out.values())))
-            if short > 0:
-                # Pad the tail batch by wrapping — SPMD needs static shapes
-                # (same invariant as HostDataLoader's eval-tail wrap).
-                out = {
-                    k: np.concatenate(
-                        [v, np.tile(v, (short // len(v) + 1,)
-                                    + (1,) * (v.ndim - 1))[:short]]
-                    )
-                    for k, v in out.items()
-                }
-            yield out
+            yield self._pad_tail({k: np.asarray(v) for k, v in batch.items()})

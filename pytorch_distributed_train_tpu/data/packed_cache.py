@@ -1,0 +1,298 @@
+"""Packed pre-decoded sample cache (ROADMAP item 2, ISSUE 12b).
+
+PR 9's staged attribution pinned the native tar-decode preset at
+79% augment / 21% read — but the *decode* presets spend their wall
+re-running libjpeg on bytes that never change between epochs. This
+module trades disk for that work: an on-disk FIXED-RECORD uint8 format,
+built once by ``tools/pack_dataset.py``, that turns the read+decode
+stages into a single mmap'd strided read. One record = one pre-decoded
+HxWxC uint8 image + its label; fixed records mean record *i* lives at a
+computable offset, so a shuffled epoch is pure ``memmap[idx]`` fancy
+indexing — the kernel's page cache does the rest.
+
+Shard layout (little-endian)::
+
+    magic   8 bytes   b"PDTTPCK1"
+    hlen    4 bytes   uint32, length of the JSON header
+    header  hlen      JSON: {n, shape, image_dtype, label_dtype,
+                             crc32, meta{mean, std, ...}}
+    images  n*H*W*C   uint8, C-contiguous (n, H, W, C)
+    labels  n*4       int32
+
+``crc32`` covers the payload (images+labels bytes) — corruption is
+detectable per shard (``verify_shard``), and the pack tool verifies
+what it wrote before declaring success. Readers mmap the images region
+and load labels to RAM (4 bytes/record).
+
+Registry metrics: cache hit/miss at dataset build
+(``packed_cache_{hits,misses}_total``), records served
+(``packed_cache_records_read_total``), CRC failures
+(``packed_cache_crc_failures_total``), and build-side counters from the
+pack tool (``packed_cache_build_records_total`` /
+``packed_cache_build_seconds``).
+
+The reader dataset (:class:`PackedImageDataset`) subclasses
+U8ImageDataset, so the augment/normalize path (native imgops pass,
+RandAugment, device-augment raw-u8 mode) is byte-identical to the
+in-RAM eager path — the identity the tier-1 tests pin.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+
+from pytorch_distributed_train_tpu.data.datasets import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    U8ImageDataset,
+)
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+MAGIC = b"PDTTPCK1"
+SHARD_SUFFIX = ".pdttpack"
+_CRC_CHUNK = 8 << 20
+
+
+def write_packed_shard(path: str, images_u8: np.ndarray,
+                       labels: np.ndarray, meta: dict | None = None) -> dict:
+    """Write ONE shard; returns its header dict. Atomic (tmp+rename):
+    a killed pack job can never leave a half-shard that later opens."""
+    images_u8 = np.ascontiguousarray(images_u8, np.uint8)
+    labels = np.ascontiguousarray(labels, np.int32)
+    if images_u8.ndim != 4:
+        raise ValueError(f"images must be (n,H,W,C), got {images_u8.shape}")
+    if len(images_u8) != len(labels):
+        raise ValueError(
+            f"{len(images_u8)} images vs {len(labels)} labels")
+    crc = zlib.crc32(images_u8)
+    crc = zlib.crc32(labels, crc)
+    header = {
+        "n": int(len(images_u8)),
+        "shape": [int(s) for s in images_u8.shape[1:]],
+        "image_dtype": "|u1",
+        "label_dtype": "<i4",
+        "crc32": int(crc & 0xFFFFFFFF),
+        "meta": meta or {},
+    }
+    blob = json.dumps(header, sort_keys=True).encode()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", len(blob)))
+        f.write(blob)
+        f.write(images_u8)
+        f.write(labels)
+    os.replace(tmp, path)
+    return header
+
+
+def read_header(path: str) -> tuple[dict, int]:
+    """→ (header dict, payload offset). Raises ValueError on a file that
+    is not a packed shard (wrong magic / torn or truncated header) — one
+    exception type, so cache-or-fallthrough callers can't be crashed by
+    a half-copied shard."""
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not a packed shard (magic {magic!r})")
+        raw = f.read(4)
+        if len(raw) < 4:
+            raise ValueError(f"{path}: truncated shard header")
+        (hlen,) = struct.unpack("<I", raw)
+        blob = f.read(hlen)
+        if len(blob) < hlen:
+            raise ValueError(f"{path}: truncated shard header")
+        try:
+            header = json.loads(blob)
+        except ValueError as e:
+            raise ValueError(f"{path}: corrupt shard header ({e})")
+        if not isinstance(header, dict) or "n" not in header \
+                or "shape" not in header or "crc32" not in header:
+            raise ValueError(f"{path}: shard header missing fields")
+        return header, len(MAGIC) + 4 + hlen
+
+
+def verify_shard(path: str) -> bool:
+    """Streaming CRC check of the whole payload against the header's
+    crc32. Counts failures in ``packed_cache_crc_failures_total``."""
+    header, off = read_header(path)
+    crc = 0
+    with open(path, "rb") as f:
+        f.seek(off)
+        while True:
+            chunk = f.read(_CRC_CHUNK)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    ok = (crc & 0xFFFFFFFF) == header["crc32"]
+    if not ok:
+        get_registry().counter(
+            "packed_cache_crc_failures_total",
+            help="packed-cache shards whose payload CRC mismatched the "
+                 "header").inc()
+    return ok
+
+
+class PackedShardReader:
+    """One shard: mmap'd image region + in-RAM labels."""
+
+    def __init__(self, path: str, verify: bool = False):
+        self.path = path
+        self.header, off = read_header(path)
+        if verify and not verify_shard(path):
+            raise ValueError(f"{path}: payload CRC mismatch (corrupt "
+                             "shard — re-run tools/pack_dataset.py)")
+        n = self.header["n"]
+        shape = tuple(self.header["shape"])
+        self.images = np.memmap(path, dtype=np.uint8, mode="r",
+                                offset=off, shape=(n,) + shape)
+        lbl_off = off + n * int(np.prod(shape, dtype=np.int64))
+        self.labels = np.fromfile(path, dtype=np.dtype(
+            self.header["label_dtype"]), count=n, offset=lbl_off
+        ).astype(np.int32)
+
+    def __len__(self) -> int:
+        return self.header["n"]
+
+
+def find_shards(path_or_glob: str, split: str | None = None) -> list[str]:
+    """Resolve a shard set: a directory, a glob, or one file. Sorted —
+    shard order is part of the record-index contract.
+
+    In a SPLIT-ORGANIZED directory (any ``train-*``/``val-*`` prefixed
+    shard present) only the requested split's shards are returned — a
+    missing split is an empty list (→ a loud cache MISS), never a
+    silent fall-through to the other split's data (eval reading train
+    pixels would inflate accuracy without any error). Directories of
+    unprefixed shards (hand-assembled) serve every split."""
+    if os.path.isdir(path_or_glob):
+        all_shards = sorted(glob_mod.glob(os.path.join(
+            path_or_glob, f"*{SHARD_SUFFIX}")))
+        if split:
+            split_organized = any(
+                os.path.basename(s).startswith(("train-", "val-"))
+                for s in all_shards)
+            if split_organized:
+                return [s for s in all_shards
+                        if os.path.basename(s).startswith(f"{split}-")]
+        return all_shards
+    if os.path.isfile(path_or_glob):
+        return [path_or_glob]
+    return sorted(glob_mod.glob(path_or_glob))
+
+
+class PackedImageDataset(U8ImageDataset):
+    """Fixed-record packed shards as a batch-style dataset.
+
+    The read stage is ONE strided gather against the mmap per shard
+    touched; augment/normalize is the inherited U8ImageDataset path
+    (native imgops when built), so batches are byte-identical to an
+    in-RAM U8ImageDataset over the same pixels — decode simply no
+    longer exists as a stage. Mean/std come from the pack-time meta
+    (falling back to the ImageNet constants).
+    """
+
+    def __init__(self, shards: str | list[str], *, augment: bool,
+                 pad: int = 4, randaugment=None, verify: bool = False,
+                 raw_u8: bool = False, split: str | None = None,
+                 mean: np.ndarray | None = None,
+                 std: np.ndarray | None = None):
+        paths = (find_shards(shards, split)
+                 if isinstance(shards, str) else list(shards))
+        if not paths:
+            raise FileNotFoundError(
+                f"no {SHARD_SUFFIX} shards under {shards!r}")
+        self._paths = paths
+        self._verify = verify
+        self._readers = [PackedShardReader(p, verify=verify)
+                         for p in paths]
+        shapes = {tuple(r.header["shape"]) for r in self._readers}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"shards disagree on record shape: {sorted(shapes)}")
+        self._shape = next(iter(shapes))
+        counts = np.array([len(r) for r in self._readers], np.int64)
+        self._starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+        labels = np.concatenate([r.labels for r in self._readers])
+        meta = self._readers[0].header.get("meta", {})
+        if mean is None:
+            mean = np.asarray(meta.get("mean", IMAGENET_MEAN), np.float32)
+        if std is None:
+            std = np.asarray(meta.get("std", IMAGENET_STD), np.float32)
+        super().__init__(None, labels, mean, std, augment=augment,
+                         pad=int(meta.get("pad", pad)),
+                         randaugment=randaugment, raw_u8=raw_u8)
+        self._c_read = get_registry().counter(
+            "packed_cache_records_read_total",
+            help="records served out of the packed pre-decoded cache")
+
+    def __getstate__(self):
+        # memmaps don't travel (grain worker processes pickle the
+        # dataset; the shared-memory pool forks and never gets here):
+        # reopen lazily from paths on the other side.
+        state = super().__getstate__()
+        state["_readers"] = None
+        state["_c_read"] = None
+        return state
+
+    def _ensure_open(self):
+        if self._readers is None:
+            self._readers = [PackedShardReader(p, verify=False)
+                             for p in self._paths]
+        if self._c_read is None:
+            self._c_read = get_registry().counter(
+                "packed_cache_records_read_total",
+                help="records served out of the packed pre-decoded cache")
+
+    def _read_images(self, idx) -> np.ndarray:
+        self._ensure_open()
+        idx = np.asarray(idx, np.int64)
+        out = np.empty((len(idx),) + self._shape, np.uint8)
+        shard_ids = np.searchsorted(self._starts, idx, side="right") - 1
+        for si in np.unique(shard_ids):
+            m = shard_ids == si
+            out[m] = self._readers[si].images[idx[m] - self._starts[si]]
+        self._c_read.inc(len(idx))
+        return out
+
+
+def load_packed_if_present(cache_dir: str, split: str, *, augment: bool,
+                           randaugment=None, verify: bool = False,
+                           raw_u8: bool = False) -> PackedImageDataset | None:
+    """Cache-or-fallthrough used by build_dataset: a valid cache for the
+    split is a HIT (dataset returned), anything else — no dir, no
+    shards, unreadable/corrupt shards — is a MISS (None returned; the
+    caller builds the original decode-path dataset). Counted either way:
+    a run silently falling back to the 3-6x slower decode path must at
+    least be visible on /metrics."""
+    hits = get_registry().counter(
+        "packed_cache_hits_total",
+        help="dataset builds served from a packed cache")
+    misses = get_registry().counter(
+        "packed_cache_misses_total",
+        help="dataset builds that fell back to the decode path "
+             "(no/invalid packed cache)")
+    try:
+        shards = find_shards(cache_dir, split)
+        if not shards:
+            misses.inc()
+            return None
+        ds = PackedImageDataset(shards, augment=augment,
+                                randaugment=randaugment, verify=verify,
+                                raw_u8=raw_u8)
+    except (OSError, ValueError) as e:
+        import sys
+
+        print(f"[packed-cache] {cache_dir!r} ({split}): falling back to "
+              f"decode path ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+        misses.inc()
+        return None
+    hits.inc()
+    return ds

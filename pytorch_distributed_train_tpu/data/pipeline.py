@@ -17,6 +17,7 @@ pin_memory.py:18).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -65,12 +66,75 @@ class StallStats:
         self._counter.inc(dt)
 
 
+# Per-process thread pool for item-style collate INSIDE shared-memory
+# decode workers (data/workers.py): a module global rebuilt lazily per
+# process (pid-guarded — executor threads never survive a fork). The
+# in-process path keeps the loader-owned pool (self._pool) unchanged.
+_ITEM_POOL: tuple[int, ThreadPoolExecutor] | None = None
+
+
+def _item_pool(num_workers: int) -> ThreadPoolExecutor:
+    global _ITEM_POOL
+    if _ITEM_POOL is None or _ITEM_POOL[0] != os.getpid():
+        from pytorch_distributed_train_tpu.data import workers as workers_lib
+
+        _ITEM_POOL = (os.getpid(), ThreadPoolExecutor(
+            max_workers=workers_lib.process_thread_budget(num_workers)))
+    return _ITEM_POOL[1]
+
+
+def collate_chunk(dataset, chunk: np.ndarray, *, seed: int, epoch: int,
+                  batch_index: int, host_id: int, train: bool,
+                  pool=None, num_workers: int = 4) -> dict:
+    """Collate ONE host batch — the single definition of the threads
+    loader's batch semantics, shared byte-exactly by the in-process path
+    (HostDataLoader._collate) and the shared-memory decode workers.
+
+    The per-batch rng is keyed on (seed, epoch, batch-index, host), so
+    batch b is identical wherever (and in whichever process) it is
+    materialized — the invariant every resume/elastic test pins.
+    `data.decode` fault point + retry/backoff (faults/): transient decode
+    errors back off and retry; a record that stays undecodable is
+    substituted-and-counted — static SPMD shapes forbid dropping a row.
+    """
+    from pytorch_distributed_train_tpu import faults as faults_lib
+
+    rng = np.random.default_rng(
+        np.random.SeedSequence((seed, epoch, batch_index, host_id)))
+    if not getattr(dataset, "is_item_style", False):
+        def _load_batch(_i=None):
+            faults_lib.maybe_fire("data.decode")
+            return dataset.get_batch(chunk, rng, train)
+
+        return faults_lib.retry_call(_load_batch, point="data.decode")
+    seeds = rng.integers(0, 2**63, size=len(chunk))
+    n = len(dataset)
+
+    def _load_one(a):
+        i, item_seed = int(a[0]), int(a[1])
+
+        def load(j):
+            faults_lib.maybe_fire("data.decode")
+            return dataset.get_item(j, np.random.default_rng(item_seed))
+
+        return faults_lib.decode_with_retry(load, i, n)
+
+    if pool is None:
+        pool = _item_pool(num_workers)
+    items = list(pool.map(_load_one, zip(chunk, seeds)))
+    return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+
 class HostDataLoader:
     """Per-host loader: yields this host's shard of each global batch.
 
     Length semantics: drop_last=True (training) truncates to full batches —
     required for SPMD static shapes (SURVEY §7.4.5); eval pads the tail batch
     by wrapping (sampler already padded to host-divisibility).
+
+    With ``data.mp_workers > 0`` the collate runs in the shared-memory
+    decode pool (data/workers.py) instead of this process — same batch
+    bytes, same resume semantics, N processes of decode/augment.
     """
 
     def __init__(self, dataset, data_cfg, *, train: bool,
@@ -104,6 +168,16 @@ class HostDataLoader:
                 drop_last=False,
             )
         self._pool: ThreadPoolExecutor | None = None
+        self._owner_pid = os.getpid()
+        # Shared-memory decode pool (data/workers.py) — built lazily on
+        # the first epoch so tests/tools constructing loaders never fork.
+        from pytorch_distributed_train_tpu.data import workers as workers_lib
+
+        self.mp_workers = (
+            workers_lib.pool_budget(getattr(data_cfg, "mp_workers", 0))
+            if workers_lib.available() else 0)
+        self.mp_slots = getattr(data_cfg, "mp_slots", 0)
+        self._mp_pool = None
 
     @property
     def steps_per_epoch(self) -> int:
@@ -112,14 +186,14 @@ class HostDataLoader:
             return n // self.host_batch
         return (n + self.host_batch - 1) // self.host_batch
 
-    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
-        """Yield host-local numpy batches for one epoch.
+    def close(self) -> None:
+        """Release the shared-memory pool (bench/tests; the trainer's
+        daemonic workers die with the process either way)."""
+        if self._mp_pool is not None:
+            self._mp_pool.close()
+            self._mp_pool = None
 
-        ``start_batch`` fast-forwards a mid-epoch resume: the per-batch rng
-        is seeded by (seed, epoch, batch-index, host), so batch b is
-        identical whether or not batches before it were materialized — the
-        resumed stream continues exactly where the crashed run stopped
-        (stronger than the reference, which replays the epoch)."""
+    def _epoch_chunks(self, epoch: int) -> np.ndarray:
         self.sampler.set_epoch(epoch)
         idx = self.sampler.indices()
         n_steps = self.steps_per_epoch
@@ -133,42 +207,55 @@ class HostDataLoader:
             need = n_steps * self.host_batch
             if len(idx) < need:
                 idx = np.resize(idx, need)
-        for b in range(start_batch, n_steps):
-            chunk = idx[b * self.host_batch : (b + 1) * self.host_batch]
-            rng = np.random.default_rng(
-                np.random.SeedSequence((self.seed, epoch, b, self.host_id))
-            )
-            yield self._collate(chunk, rng)
+        return idx
 
-    def _collate(self, chunk: np.ndarray, rng: np.random.Generator) -> dict:
-        # `data.decode` fault point + retry/backoff (faults/): transient
-        # decode errors (real or injected) back off and retry; a record
-        # that stays undecodable is substituted-and-counted — static
-        # SPMD batch shapes forbid dropping a row (faults/retry.py).
-        from pytorch_distributed_train_tpu import faults as faults_lib
+    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict]:
+        """Yield host-local numpy batches for one epoch.
 
-        if not getattr(self.dataset, "is_item_style", False):
-            def _load_batch(_i=None):
-                faults_lib.maybe_fire("data.decode")
-                return self.dataset.get_batch(chunk, rng, self.train)
+        ``start_batch`` fast-forwards a mid-epoch resume: the per-batch rng
+        is seeded by (seed, epoch, batch-index, host), so batch b is
+        identical whether or not batches before it were materialized — the
+        resumed stream continues exactly where the crashed run stopped
+        (stronger than the reference, which replays the epoch). Batch
+        composition is also invariant to ``mp_workers``: the pool receives
+        the SAME (batch-index, chunk) tasks this loop would collate."""
+        idx = self._epoch_chunks(epoch)
+        n_steps = self.steps_per_epoch
+        tasks = ((epoch, b, idx[b * self.host_batch:(b + 1) * self.host_batch])
+                 for b in range(start_batch, n_steps))
+        if self.mp_workers > 0:
+            if self._mp_pool is None:
+                from pytorch_distributed_train_tpu.data import (
+                    workers as workers_lib,
+                )
 
-            return faults_lib.retry_call(_load_batch, point="data.decode")
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(max_workers=max(1, self.num_workers))
-        seeds = rng.integers(0, 2**63, size=len(chunk))
-        n = len(self.dataset)
+                self._mp_pool = workers_lib.SharedMemoryWorkerPool(
+                    self._pool_collate, self.mp_workers,
+                    slots=self.mp_slots,
+                    post_fork=lambda: workers_lib.reset_thread_local_state(
+                        self.dataset))
+            return self._mp_pool.run(tasks)
+        return (self._pool_collate(t) for t in tasks)
 
-        def _load_one(a):
-            i, seed = int(a[0]), int(a[1])
-
-            def load(j):
-                faults_lib.maybe_fire("data.decode")
-                return self.dataset.get_item(j, np.random.default_rng(seed))
-
-            return faults_lib.decode_with_retry(load, i, n)
-
-        items = list(self._pool.map(_load_one, zip(chunk, seeds)))
-        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+    def _pool_collate(self, task) -> dict:
+        """One (epoch, batch-index, chunk) task → batch dict. Runs on
+        the consumer thread OR inside a forked decode worker — both call
+        the same collate_chunk, so the bytes cannot diverge. The loader-
+        owned item thread pool is only usable in the process that built
+        it (executor threads never survive a fork); elsewhere
+        collate_chunk falls back to the per-process module pool."""
+        epoch, b, chunk = task
+        pool = None
+        if getattr(self.dataset, "is_item_style", False) \
+                and os.getpid() == self._owner_pid:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.num_workers))
+            pool = self._pool
+        return collate_chunk(
+            self.dataset, chunk, seed=self.seed, epoch=epoch,
+            batch_index=b, host_id=self.host_id, train=self.train,
+            pool=pool, num_workers=self.num_workers)
 
 
 class _Producer(threading.Thread):

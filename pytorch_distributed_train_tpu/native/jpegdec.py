@@ -51,6 +51,15 @@ def available() -> bool:
 
 
 def default_threads() -> int:
+    # PDTT_NATIVE_THREADS: per-process C++ thread budget — set by the
+    # shared-memory decode pool (data/workers.py) so N worker processes
+    # x the solo default can't oversubscribe the host.
+    env = os.environ.get("PDTT_NATIVE_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
     return max(1, min(8, (os.cpu_count() or 1) // 2))
 
 

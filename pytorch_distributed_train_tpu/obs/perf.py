@@ -96,6 +96,18 @@ class InputStageStats:
         self.calls[stage_name] += 1
         self._counters[stage_name].inc(dt)
 
+    def merge(self, seconds: dict[str, float]) -> None:
+        """Fold another process's stage deltas into this one — the
+        shared-memory decode workers (data/workers.py) time their
+        read/decode/augment stages process-locally and ship the
+        per-batch delta with each result; merging here keeps the
+        attribution (and the scrape counters) whole-pipeline even when
+        the stages run in forked workers. Unknown stage keys are
+        rejected the same way add() rejects them."""
+        for name, dt in seconds.items():
+            if dt > 0.0:
+                self.add(name, dt)
+
     def snapshot(self) -> dict[str, float]:
         return {s: self.seconds[s] for s in STAGES}
 

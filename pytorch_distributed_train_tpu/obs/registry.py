@@ -185,6 +185,30 @@ class MetricsRegistry:
                 return 0.0
             return float(sum(inst.value for inst in fam[2].values()))
 
+    def counter_values(self) -> dict:
+        """{(name, label_items_tuple): value} for every counter series —
+        the snapshot half of cross-process counter shipping (the
+        shared-memory decode workers diff two of these per batch and
+        send the delta home; data/workers.py)."""
+        out = {}
+        with self._lock:
+            for name, (kind, _help, series) in self._families.items():
+                if kind != "counter":
+                    continue
+                for key, inst in series.items():
+                    out[(name, key)] = float(inst.value)
+        return out
+
+    def merge_counter_deltas(self, deltas: dict) -> None:
+        """Apply {(name, label_items_tuple): delta} increments — the
+        receive half of cross-process counter shipping. Families are
+        get-or-created (helpless when new here; the owning module's
+        registration sets help on first local use)."""
+        for (name, key), dv in deltas.items():
+            if dv <= 0:
+                continue
+            self.counter(name, labels=dict(key)).inc(dv)
+
     # ---------------------------------------------------------- renderer
     def render(self) -> str:
         """Prometheus text format v0.0.4."""

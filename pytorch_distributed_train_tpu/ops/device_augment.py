@@ -1,0 +1,380 @@
+"""Device-side image augmentation inside the jitted train step (ISSUE 12c).
+
+PR 9's staged attribution showed the native input preset spending 79%
+of its host wall in *augment* — crop/flip/normalize (and RandAugment
+when on) running on host cores that should be feeding the chip. This
+module moves that work into the jitted step, next to MixUp
+(ops/mixup.py, the existing device-side batch transform): the host
+ships RAW uint8 pixels (4x less h2d traffic than normalized f32), and
+the augment collapses into a few fused elementwise passes on a batch
+already resident in HBM.
+
+PRNG discipline — identical to dropout's: the step folds its base key
+by the step counter, then folds a constant domain tag for the augment
+(steps.py), so draws are deterministic under resume (same step -> same
+crops), no key chain is checkpointed, and augment draws can never
+collide with dropout/mixup streams. Per-image draws come from one
+``jax.random`` call per decision vector (no per-image key splitting).
+
+Semantics:
+
+- **crop/flip/normalize** (array-style datasets): reflect-101 pad +
+  random crop + horizontal flip + (x/255 - mean)/std — the SAME
+  arithmetic as the host paths (datasets._crop_flip / native imgops),
+  exposed as the pure kernel :func:`crop_flip_normalize` so the
+  host/device equivalence is testable with shared draws
+  (tests/test_zinput_plane.py). Item-style decode datasets keep
+  RandomResizedCrop host-side (it is decode-adjacent resampling) and
+  move flip/RandAugment/normalize here.
+- **RandAugment** (``data.randaugment_num_ops > 0``): the torchvision
+  op TABLE (14 ops, 31 magnitude bins, signed-op coin flip — mirroring
+  data/augment.py) reimplemented on uint8 tensors. Photometric ops
+  (brightness/color/contrast/sharpness/posterize/solarize/autocontrast/
+  equalize) match PIL semantics closely; geometric ops (shear/translate/
+  rotate) use NEAREST resampling via an inverse-affine gather, like
+  torchvision's InterpolationMode.NEAREST default. The op space is the
+  same; per-op pixel results are NOT bit-identical to the PIL chain
+  (different resampling internals) — documented in docs/performance.md.
+  Each op is applied batch-wide under a per-image selection mask: 14
+  cheap elementwise passes beat a 14-way vmap'd switch on TPU.
+
+Everything here is shape-static and host-sync-free (jit-purity pass
+scope includes this module): Python branches only on config fields and
+dtypes, never on traced values.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BINS = 31  # torchvision magnitude binning (data/augment.py mirrors it)
+
+
+# --------------------------------------------------------------- kernels
+
+def crop_flip_u8(images_u8, ys, xs, flips, pad: int) -> jnp.ndarray:
+    """Reflect-pad random crop + hflip on uint8, draws PASSED IN — the
+    one definition of the device crop kernel (semantics ==
+    datasets._crop_flip: reflect-101 padding)."""
+    x = jnp.asarray(images_u8)
+    B, H, W, C = x.shape
+    if pad > 0:
+        x = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                    mode="reflect")
+
+        def one(im, y, xo):
+            return jax.lax.dynamic_slice(im, (y, xo, 0), (H, W, C))
+
+        x = jax.vmap(one)(x, jnp.asarray(ys, jnp.int32),
+                          jnp.asarray(xs, jnp.int32))
+    return jnp.where(jnp.asarray(flips, bool)[:, None, None, None],
+                     x[:, :, ::-1, :], x)
+
+
+def crop_flip_normalize(images_u8, ys, xs, flips, pad: int,
+                        mean, std) -> jnp.ndarray:
+    """crop_flip_u8 + u8->f32 normalize — the host-equivalence test
+    surface (== datasets._crop_flip then (x/255 - mean)/std)."""
+    return normalize_u8(crop_flip_u8(images_u8, ys, xs, flips, pad),
+                        mean, std)
+
+
+def normalize_u8(images_u8, mean, std) -> jnp.ndarray:
+    """(x/255 - mean)/std in float32 — the eval-path transform and the
+    tail of every train path."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    return (images_u8.astype(jnp.float32) / 255.0 - mean) / std
+
+
+def _to_u8(x) -> jnp.ndarray:
+    return jnp.clip(jnp.round(x), 0.0, 255.0).astype(jnp.uint8)
+
+
+def _gray(x_f32) -> jnp.ndarray:
+    """ITU-R 601-2 luma, PIL's L-mode weights (keepdims channel)."""
+    w = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    return jnp.sum(x_f32 * w, axis=-1, keepdims=True)
+
+
+def _blend(a, b, factor):
+    """PIL ImageEnhance blend: a + factor*(b - a), factor (B,)-shaped."""
+    f = factor[:, None, None, None]
+    return a + f * (b - a)
+
+
+def _affine_nearest(x_u8, mat) -> jnp.ndarray:
+    """Per-image inverse-affine resample with NEAREST sampling, zero
+    fill — the PIL ``Image.transform(AFFINE, NEAREST, fillcolor=0)``
+    analogue. ``mat`` is (B, 6): x_src = a*x + b*y + c, y_src = d*x +
+    e*y + f (PIL's coefficient convention)."""
+    B, H, W, C = x_u8.shape
+    ys, xs = jnp.mgrid[0:H, 0:W]
+
+    def one(im, m):
+        a, b_, c, d, e, f = m
+        sx = jnp.round(a * xs + b_ * ys + c).astype(jnp.int32)
+        sy = jnp.round(d * xs + e * ys + f).astype(jnp.int32)
+        ok = (sx >= 0) & (sx < W) & (sy >= 0) & (sy < H)
+        gathered = im[jnp.clip(sy, 0, H - 1), jnp.clip(sx, 0, W - 1)]
+        return jnp.where(ok[..., None], gathered, jnp.uint8(0))
+
+    return jax.vmap(one)(x_u8, mat)
+
+
+def _identity_mat(B):
+    return jnp.tile(jnp.asarray([1.0, 0.0, 0.0, 0.0, 1.0, 0.0],
+                                jnp.float32), (B, 1))
+
+
+# Photometric ops: (B,H,W,C) u8 + (B,) magnitude -> u8.
+
+def _op_brightness(x, mag):
+    f = x.astype(jnp.float32)
+    return _to_u8(_blend(jnp.zeros_like(f), f, 1.0 + mag))
+
+
+def _op_color(x, mag):
+    f = x.astype(jnp.float32)
+    return _to_u8(_blend(jnp.broadcast_to(_gray(f), f.shape), f, 1.0 + mag))
+
+
+def _op_contrast(x, mag):
+    f = x.astype(jnp.float32)
+    # PIL Contrast degenerate point: the mean of the L-mode image,
+    # rounded (ImageEnhance uses ImageStat on the grayscale).
+    m = jnp.round(jnp.mean(_gray(f), axis=(1, 2, 3), keepdims=True))
+    return _to_u8(_blend(jnp.broadcast_to(m, f.shape), f, 1.0 + mag))
+
+
+def _op_sharpness(x, mag):
+    f = x.astype(jnp.float32)
+    # PIL SMOOTH kernel: 3x3 [[1,1,1],[1,5,1],[1,1,1]]/13, edges kept.
+    k = jnp.asarray([[1., 1., 1.], [1., 5., 1.], [1., 1., 1.]]) / 13.0
+    blurred = jax.lax.conv_general_dilated(
+        f.transpose(0, 3, 1, 2).reshape(-1, 1, *f.shape[1:3]),
+        k[None, None], (1, 1), "SAME")
+    blurred = blurred.reshape(f.shape[0], f.shape[3],
+                              *f.shape[1:3]).transpose(0, 2, 3, 1)
+    # PIL keeps the 1-pixel border unfiltered.
+    border = jnp.zeros(f.shape[1:3], bool).at[1:-1, 1:-1].set(True)
+    blurred = jnp.where(border[None, :, :, None], blurred, f)
+    return _to_u8(_blend(blurred, f, 1.0 + mag))
+
+
+def _op_posterize(x, mag):
+    bits = mag.astype(jnp.int32)  # bits to KEEP
+    mask = (0xFF00 >> bits).astype(jnp.uint8)  # 8-bit mask, high bits kept
+    return x & mask[:, None, None, None]
+
+
+def _op_solarize(x, mag):
+    thresh = mag[:, None, None, None]
+    return jnp.where(x.astype(jnp.float32) >= thresh, 255 - x, x)
+
+
+def _op_autocontrast(x, _mag):
+    f = x.astype(jnp.float32)
+    lo = jnp.min(f, axis=(1, 2), keepdims=True)
+    hi = jnp.max(f, axis=(1, 2), keepdims=True)
+    scale = 255.0 / jnp.maximum(hi - lo, 1.0)
+    out = (f - lo) * scale
+    return jnp.where(hi > lo, _to_u8(out), x)
+
+
+def _op_equalize(x, _mag):
+    # PIL ImageOps.equalize: per-channel histogram LUT with the
+    # nonzero-step convention.
+    def one_channel(ch):  # (H, W) u8
+        hist = jnp.zeros(256, jnp.int32).at[ch.reshape(-1)].add(1)
+        nonzero = hist > 0
+        last = jnp.max(jnp.where(nonzero, jnp.arange(256), -1))
+        step = (jnp.sum(hist) - hist[last]) // 255
+        cum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(hist)[:-1]])
+        lut = (cum + step // 2) // jnp.maximum(step, 1)
+        lut = jnp.clip(lut, 0, 255).astype(jnp.uint8)
+        return jnp.where(step == 0, ch, lut[ch])
+
+    return jax.vmap(jax.vmap(one_channel, in_axes=-1, out_axes=-1))(x)
+
+
+def _op_shear_x(x, mag):
+    B = x.shape[0]
+    m = _identity_mat(B).at[:, 1].set(mag)
+    return _affine_nearest(x, m)
+
+
+def _op_shear_y(x, mag):
+    B = x.shape[0]
+    m = _identity_mat(B).at[:, 3].set(mag)
+    return _affine_nearest(x, m)
+
+
+def _op_translate_x(x, mag):
+    B = x.shape[0]
+    m = _identity_mat(B).at[:, 2].set(mag)
+    return _affine_nearest(x, m)
+
+
+def _op_translate_y(x, mag):
+    B = x.shape[0]
+    m = _identity_mat(B).at[:, 5].set(mag)
+    return _affine_nearest(x, m)
+
+
+def _op_rotate(x, mag):
+    # rotate about the image center by mag degrees (inverse mapping).
+    B, H, W, _ = x.shape
+    rad = -mag * jnp.pi / 180.0  # inverse rotation
+    cos, sin = jnp.cos(rad), jnp.sin(rad)
+    cx, cy = (W - 1) / 2.0, (H - 1) / 2.0
+    a, b = cos, -sin
+    d, e = sin, cos
+    c = cx - a * cx - b * cy
+    f = cy - d * cx - e * cy
+    return _affine_nearest(x, jnp.stack([a, b, c, d, e, f], axis=-1))
+
+
+def _op_identity(x, _mag):
+    return x
+
+
+def _magnitude_table(height: int, width: int) -> list:
+    """(name, fn, magnitudes[31] | None, signed) — index-aligned with
+    data/augment.py's host table so op draws mean the same thing."""
+    lin = np.linspace
+    return [
+        ("Identity", _op_identity, None, False),
+        ("ShearX", _op_shear_x, lin(0.0, 0.3, _BINS), True),
+        ("ShearY", _op_shear_y, lin(0.0, 0.3, _BINS), True),
+        ("TranslateX", _op_translate_x,
+         lin(0.0, 150.0 / 331.0 * width, _BINS), True),
+        ("TranslateY", _op_translate_y,
+         lin(0.0, 150.0 / 331.0 * height, _BINS), True),
+        ("Rotate", _op_rotate, lin(0.0, 30.0, _BINS), True),
+        ("Brightness", _op_brightness, lin(0.0, 0.9, _BINS), True),
+        ("Color", _op_color, lin(0.0, 0.9, _BINS), True),
+        ("Contrast", _op_contrast, lin(0.0, 0.9, _BINS), True),
+        ("Sharpness", _op_sharpness, lin(0.0, 0.9, _BINS), True),
+        ("Posterize", _op_posterize,
+         8 - np.round(np.arange(_BINS) / ((_BINS - 1) / 4)), False),
+        ("Solarize", _op_solarize, lin(255.0, 0.0, _BINS), False),
+        ("AutoContrast", _op_autocontrast, None, False),
+        ("Equalize", _op_equalize, None, False),
+    ]
+
+
+def randaugment_u8(images_u8, rng, num_ops: int,
+                   magnitude: int) -> jnp.ndarray:
+    """Device RandAugment: ``num_ops`` rounds; each round draws one op
+    index + sign per image and applies every table op batch-wide under
+    the per-image selection mask."""
+    x = jnp.asarray(images_u8)
+    B, H, W, _ = x.shape
+    table = _magnitude_table(H, W)
+    for round_i in range(num_ops):
+        r = jax.random.fold_in(rng, round_i)
+        r_op, r_sign = jax.random.split(r)
+        op_idx = jax.random.randint(r_op, (B,), 0, len(table))
+        neg = jax.random.bernoulli(r_sign, 0.5, (B,))
+        for k, (_name, fn, mags, signed) in enumerate(table):
+            base = float(mags[magnitude]) if mags is not None else 0.0
+            mag = jnp.full((B,), base, jnp.float32)
+            if signed:
+                mag = jnp.where(neg, -mag, mag)
+            sel = (op_idx == k)[:, None, None, None]
+            x = jnp.where(sel, fn(x, mag), x)
+    return x
+
+
+# ------------------------------------------------------------- transform
+
+@dataclass(frozen=True)
+class DeviceAugment:
+    """Batch transform: (batch, rng, train) -> batch with augmented,
+    normalized f32 images. All fields static (closed over by the jitted
+    step — ops/mixup.py's pattern). Batches whose images are NOT uint8
+    pass through untouched: that is the contract with datasets that
+    cannot ship raw u8 (synthetic f32, native-decode tar) — their
+    pixels arrive already normalized and must not be double-processed.
+    """
+
+    mean: tuple = ()
+    std: tuple = ()
+    pad: int = 4              # reflect-pad crop margin; 0 = no crop
+    crop: bool = True         # False for item-style (RRC stayed host-side)
+    flip: bool = True
+    randaugment_num_ops: int = 0
+    randaugment_magnitude: int = 9
+
+    def __call__(self, batch: dict, rng, train: bool = True) -> dict:
+        images = batch.get("image")
+        if images is None or images.dtype != jnp.uint8:
+            return batch
+        B = images.shape[0]
+        if not train:
+            out = dict(batch)
+            out["image"] = normalize_u8(images, self.mean, self.std)
+            return out
+        # torchvision order on u8 throughout: crop -> flip ->
+        # RandAugment -> normalize (normalize is always last, so the
+        # whole u8 chain fuses under jit).
+        r_crop, r_flip, r_ra = jax.random.split(rng, 3)
+        flips = (jax.random.bernoulli(r_flip, 0.5, (B,))
+                 if self.flip else jnp.zeros((B,), bool))
+        if self.crop and self.pad > 0:
+            offs = jax.random.randint(r_crop, (B, 2), 0, 2 * self.pad + 1)
+            x = crop_flip_u8(images, offs[:, 0], offs[:, 1], flips,
+                             self.pad)
+        else:
+            x = jnp.where(flips[:, None, None, None],
+                          images[:, :, ::-1, :], images)
+        if self.randaugment_num_ops > 0:
+            x = randaugment_u8(x, r_ra, self.randaugment_num_ops,
+                               self.randaugment_magnitude)
+        out = dict(batch)
+        out["image"] = normalize_u8(x, self.mean, self.std)
+        return out
+
+
+def build_device_augment(data_cfg, dataset) -> DeviceAugment | None:
+    """Config + dataset -> transform (or None when off / inapplicable).
+
+    The dataset decides applicability: only one that ships raw u8
+    (``raw_u8`` attribute — U8ImageDataset family, packed cache,
+    ImageFolder/tar PIL paths) gets the device transform; its mean/std
+    ride along so host and device normalize with identical constants.
+    """
+    if not getattr(data_cfg, "device_augment", False):
+        return None
+    if not getattr(dataset, "raw_u8", False):
+        import sys
+
+        print("[device-augment] data.device_augment is on but dataset "
+              f"{type(dataset).__name__} cannot ship raw u8 pixels — "
+              "host path unchanged", file=sys.stderr, flush=True)
+        return None
+    from pytorch_distributed_train_tpu.data.datasets import (
+        IMAGENET_MEAN,
+        IMAGENET_STD,
+    )
+
+    mean = np.asarray(getattr(dataset, "mean", IMAGENET_MEAN), np.float32)
+    std = np.asarray(getattr(dataset, "std", IMAGENET_STD), np.float32)
+    item_style = bool(getattr(dataset, "is_item_style", False))
+    return DeviceAugment(
+        mean=tuple(float(v) for v in mean),
+        std=tuple(float(v) for v in std),
+        pad=int(getattr(dataset, "pad", 4)),
+        crop=not item_style,  # item-style: RRC already happened host-side
+        flip=True,
+        randaugment_num_ops=int(getattr(data_cfg, "randaugment_num_ops",
+                                        0)),
+        randaugment_magnitude=int(getattr(data_cfg,
+                                          "randaugment_magnitude", 9)),
+    )

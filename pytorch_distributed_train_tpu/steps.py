@@ -113,6 +113,7 @@ def _tree_finite(tree) -> jnp.ndarray:
 def make_train_step(model, loss_fn: Callable, tx,
                     ema_decay: float = 0.0, swa_start: int = 0,
                     swa_every: int = 1, mixup=None,
+                    device_augment=None,
                     module_grad_norms: bool = False,
                     param_transform: Callable | None = None,
                     teacher_fn: Callable | None = None,
@@ -145,6 +146,14 @@ def make_train_step(model, loss_fn: Callable, tx,
         # deterministic under resume (same step → same mask), no key chain
         # to checkpoint (the reference relies on torch's stateful global RNG).
         dropout_rng = jax.random.fold_in(rng, state.step)
+        if device_augment is not None:
+            # Device-side crop/flip/RandAugment/normalize on the raw u8
+            # batch (ops/device_augment.py) — same fold-in discipline as
+            # dropout (deterministic under resume: same step, same
+            # crops), distinct domain tag so augment draws never collide
+            # with the mixup stream below.
+            batch = device_augment(
+                batch, jax.random.fold_in(dropout_rng, 2), train=True)
         if mixup is not None:
             batch = mixup(batch, jax.random.fold_in(dropout_rng, 1))
         if teacher_fn is not None:
@@ -249,8 +258,13 @@ def optax_global_norm(tree) -> jnp.ndarray:
 def make_eval_step(model, loss_fn: Callable,
                    schedule_free: bool = False,
                    param_transform: Callable | None = None,
-                   teacher_fn: Callable | None = None) -> Callable:
+                   teacher_fn: Callable | None = None,
+                   device_augment=None) -> Callable:
     def eval_step(state: TrainState, batch: dict):
+        if device_augment is not None:
+            # eval ships raw u8 too; the transform reduces to the
+            # deterministic normalize (no draws — rng unused).
+            batch = device_augment(batch, None, train=False)
         if teacher_fn is not None:
             # losses that SCORE AGAINST a frozen model (DPO's reference)
             # need its logits at eval time too

@@ -287,10 +287,17 @@ class Trainer:
             )(init_rng)
 
         # ---- jitted steps
+        from pytorch_distributed_train_tpu.ops.device_augment import (
+            build_device_augment,
+        )
         from pytorch_distributed_train_tpu.ops.mixup import build_mixup
 
         mixup = build_mixup(cfg.data, cfg.model, cfg.label_smoothing,
                             loss=cfg.loss)
+        # Device-side augmentation (ops/device_augment.py): the dataset
+        # decides applicability — only raw-u8 shippers get the transform
+        # (host path byte-unchanged when data.device_augment is off).
+        device_augment = build_device_augment(cfg.data, self.train_ds)
         param_transform = None
         if cfg.lora.rank > 0:
             param_transform = lambda p: lora_lib.merge(p, cfg.lora)  # noqa: E731
@@ -299,6 +306,7 @@ class Trainer:
             ema_decay=cfg.optim.ema_decay,
             swa_start=getattr(cfg.optim, "swa_start_step", 0),
             swa_every=getattr(cfg.optim, "swa_every", 1), mixup=mixup,
+            device_augment=device_augment,
             module_grad_norms=cfg.obs.log_module_grad_norms,
             param_transform=param_transform,
             teacher_fn=self.teacher_fn,
@@ -314,7 +322,9 @@ class Trainer:
                 self.model, self.eval_loss_fn,
                 schedule_free=cfg.optim.name == "schedule_free_adamw",
                 param_transform=param_transform,
-                teacher_fn=self.teacher_fn if cfg.loss == "dpo" else None),
+                teacher_fn=self.teacher_fn if cfg.loss == "dpo" else None,
+                device_augment=build_device_augment(cfg.data,
+                                                    self.eval_ds)),
             self.mesh, self.state_sharding, self.batch_axes,
         )
         if cfg.lora.rank > 0 and jax.process_index() == 0:
@@ -910,6 +920,7 @@ class Trainer:
                  # input_stall (eval's share subtracted)
                  **{f"input_stage_s_{k}": round(v, 4)
                     for k, v in stage_s.items() if v > 0},
+                 **self._input_plane_metrics(),
                  **self.meter.percentiles(), **self.goodput.snapshot()},
                 prefix="summary",
             )
@@ -921,6 +932,31 @@ class Trainer:
                             rewinds=self._rewinds,
                             wall_s=round(time.time() - t_start, 3))
         return self.state
+
+    def _input_plane_metrics(self) -> dict:
+        """Input-plane counters for the summary record (ISSUE 12):
+        shared-memory pool occupancy/batches and packed-cache hit
+        activity, read back from the registry the pool/cache write
+        into. Zero-activity keys are omitted — a run without the pool
+        or cache keeps its summary line unchanged."""
+        from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        out = {}
+        for key, name, kind in (
+                ("input_worker_occupancy", "input_worker_occupancy", "g"),
+                ("input_worker_batches", "input_worker_batches_total", "f"),
+                ("input_effective_workers", "input_effective_workers", "f"),
+                ("packed_cache_hits", "packed_cache_hits_total", "f"),
+                ("packed_cache_misses", "packed_cache_misses_total", "f"),
+                ("packed_cache_records_read",
+                 "packed_cache_records_read_total", "f"),
+        ):
+            v = (reg.get_value(name) if kind == "g"
+                 else reg.family_total(name))
+            if v:
+                out[key] = round(float(v), 4)
+        return out
 
     def _train_stage_seconds(self) -> dict:
         """The TRAIN pipeline's share of the process-global input-stage
@@ -1339,6 +1375,14 @@ class Trainer:
     def close(self) -> None:
         self.heartbeat.stop()
         self.profiler.finish()
+        # shared-memory decode pools (data/workers.py): stop worker
+        # processes + release the rings (daemons would die with the
+        # process anyway; tests build many Trainers per process)
+        for loader in (getattr(self, "train_loader", None),
+                       getattr(self, "eval_loader", None)):
+            close = getattr(loader, "close", None)
+            if close is not None:
+                close()
         if self.liveness is not None:
             self.liveness.stop()
         self.ckpt.close()

@@ -118,6 +118,9 @@ class JitPurityPass(AnalysisPass):
         "pytorch_distributed_train_tpu/trainer.py",
         "pytorch_distributed_train_tpu/models/",
         "pytorch_distributed_train_tpu/parallel/",
+        # device-side augmentation runs inside the jitted step (ISSUE
+        # 12c) — host syncs here would serialize the train pipeline
+        "pytorch_distributed_train_tpu/ops/device_augment.py",
     )
 
     def run(self, ctx: Context) -> list[Finding]:

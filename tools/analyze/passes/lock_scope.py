@@ -82,6 +82,9 @@ class LockScopePass(AnalysisPass):
         "pytorch_distributed_train_tpu/ckpt/",
         "pytorch_distributed_train_tpu/sentinel/",
         "pytorch_distributed_train_tpu/elastic.py",
+        # shared-memory decode plane (ISSUE 12): its queues sit on the
+        # input hot path — no blocking work under any lock here
+        "pytorch_distributed_train_tpu/data/workers.py",
         "tools/serve_*.py",
     )
 

@@ -101,6 +101,9 @@ class ThreadSharedStatePass(AnalysisPass):
         "pytorch_distributed_train_tpu/ckpt/",
         "pytorch_distributed_train_tpu/sentinel/",
         "pytorch_distributed_train_tpu/elastic.py",
+        # shared-memory decode plane: worker processes + a submitter
+        # thread against ring state — in scope from day one (ISSUE 12)
+        "pytorch_distributed_train_tpu/data/workers.py",
         "tools/serve_*.py",
     )
 

@@ -208,6 +208,53 @@ def events_section(events_dir: str,
     return out
 
 
+def input_section(recs: list[dict]) -> list[str]:
+    """Input-pipeline plane (ISSUE 12): stage bars from the summary's
+    staged split + shared-memory worker-pool occupancy + packed-cache
+    hit rate. Quiet (empty) for runs that predate the plane; one line
+    when the run had neither pool nor cache."""
+    stage_rec = next(
+        (r for r in reversed(recs)
+         if any(k.startswith("input_stage_s_") for k in r)), None)
+    pool_rec = next(
+        (r for r in reversed(recs) if "input_worker_batches" in r
+         or "packed_cache_hits" in r or "packed_cache_misses" in r), None)
+    if stage_rec is None and pool_rec is None:
+        return []
+    out = ["input pipeline:"]
+    if stage_rec is not None:
+        stages = {k[len("input_stage_s_"):]: float(v)
+                  for k, v in stage_rec.items()
+                  if k.startswith("input_stage_s_")}
+        total = sum(stages.values())
+        for name, v in sorted(stages.items(), key=lambda kv: -kv[1]):
+            out.append(f"  {name:<8} {v:>10.2f}s "
+                       f"{_bar(v / total if total else 0.0)} "
+                       f"{100.0 * v / total if total else 0.0:5.1f}%")
+    if pool_rec is None:
+        out.append("  (no decode pool / packed cache in this run)")
+        return out
+    if "input_worker_batches" in pool_rec:
+        occ = float(pool_rec.get("input_worker_occupancy", 0.0))
+        out.append(
+            f"  decode pool: {int(pool_rec['input_worker_batches'])} "
+            f"batches via workers, occupancy {100.0 * occ:.1f}% "
+            f"{_bar(occ, 16)}")
+    if "input_effective_workers" in pool_rec:
+        out.append(f"  effective workers: "
+                   f"{int(pool_rec['input_effective_workers'])}")
+    hits = float(pool_rec.get("packed_cache_hits", 0.0))
+    misses = float(pool_rec.get("packed_cache_misses", 0.0))
+    if hits or misses:
+        rate = hits / (hits + misses)
+        out.append(
+            f"  packed cache: {int(hits)} hit(s) / {int(misses)} "
+            f"miss(es) ({100.0 * rate:.0f}% hit rate), "
+            f"{int(pool_rec.get('packed_cache_records_read', 0))} "
+            "records served")
+    return out
+
+
 def perf_section(recs: list[dict],
                  events: list[dict] | None = None) -> list[str]:
     """Perf-attribution summary (obs/perf.py): achieved MFU, the last
@@ -346,6 +393,7 @@ def report(jsonl_path: str, trace_path: str = "",
     events = _load_events(events_dir)
     for section in (goodput_section(recs), trend_section(recs),
                     perf_section(recs, events),
+                    input_section(recs),
                     straggler_section(recs),
                     spans_section(trace_path),
                     events_section(events_dir, events),

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Build a packed pre-decoded sample cache (data/packed_cache.py).
+
+    # ImageNet-layout folder -> packed val cache at 224px
+    python -m tools.pack_dataset --src /data/imagenet/val --out /cache \
+        --split val --size 224
+
+    # WebDataset tar shards -> packed train cache
+    python -m tools.pack_dataset --src '/data/imagenet-train-*.tar' \
+        --out /cache --split train --size 224 --shard-records 8192
+
+Decodes every image ONCE — deterministically (shorter-side resize +
+center crop, the eval transform; no random draws, so the cache bytes
+are a pure function of the source) — and writes fixed-record uint8
+shards with a per-shard payload CRC. Training then reads the cache as
+one mmap'd strided gather per batch (dataset ``packed_images``, or
+``data.packed_cache_dir`` on the original dataset) and applies its
+random augmentation on top, host- or device-side.
+
+Every shard written is CRC-verified back before the tool declares
+success (``--no-verify`` skips, for very large packs where the writer
+is trusted). Exit nonzero on any verification failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pytorch_distributed_train_tpu.data import packed_cache  # noqa: E402
+from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
+
+_NORMS = {
+    "imagenet": ("IMAGENET_MEAN", "IMAGENET_STD"),
+    "cifar": ("CIFAR_MEAN", "CIFAR_STD"),
+}
+
+
+def _build_source(src: str, size: int):
+    """Source dataset in raw-u8 eval mode: get_item(i) -> deterministic
+    center-cropped HWC uint8 + label (datasets.py owns the transform)."""
+    from pytorch_distributed_train_tpu.data import datasets as ds_lib
+
+    if os.path.isdir(src):
+        return ds_lib.ImageFolderDataset(src, size, train=False,
+                                         raw_u8=True)
+    return ds_lib.TarShardImageDataset(src, size, train=False,
+                                       raw_u8=True)
+
+
+def pack_items(dataset, out_dir: str, *, split: str, shard_records: int,
+               meta: dict, threads: int = 0, verify: bool = True,
+               progress=None) -> list[str]:
+    """Pack any item-style u8 dataset into shards; returns shard paths.
+
+    Decode fans out over threads (PIL releases the GIL); records land in
+    INDEX ORDER regardless of thread scheduling — shard bytes must be
+    reproducible, they carry a CRC."""
+    os.makedirs(out_dir, exist_ok=True)
+    n = len(dataset)
+    threads = threads or min(16, os.cpu_count() or 4)
+    rng = np.random.default_rng(0)  # unused by eval transforms; API needs one
+    reg = get_registry()
+    c_rec = reg.counter("packed_cache_build_records_total",
+                        help="records decoded + written by the pack tool")
+    g_sec = reg.gauge("packed_cache_build_seconds",
+                      help="wall seconds of the last pack_dataset build")
+    t0 = time.monotonic()
+    paths: list[str] = []
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        for shard_i, start in enumerate(range(0, n, shard_records)):
+            idx = range(start, min(start + shard_records, n))
+            items = list(pool.map(
+                lambda i: dataset.get_item(i, rng), idx))
+            images = np.stack([it["image"] for it in items])
+            labels = np.asarray([it["label"] for it in items], np.int32)
+            path = os.path.join(
+                out_dir,
+                f"{split}-{shard_i:05d}{packed_cache.SHARD_SUFFIX}")
+            packed_cache.write_packed_shard(path, images, labels, meta)
+            c_rec.inc(len(items))
+            paths.append(path)
+            if progress is not None:
+                progress(path, len(items))
+    if verify:
+        for path in paths:
+            if not packed_cache.verify_shard(path):
+                raise SystemExit(f"pack_dataset: CRC verification FAILED "
+                                 f"for {path}")
+    g_sec.set(time.monotonic() - t0)
+    return paths
+
+
+def pack_arrays(images_u8: np.ndarray, labels: np.ndarray, out_dir: str,
+                *, split: str = "train", shard_records: int = 0,
+                meta: dict | None = None) -> list[str]:
+    """Pack in-RAM arrays (benches/tests) — same format, no decode."""
+    os.makedirs(out_dir, exist_ok=True)
+    n = len(images_u8)
+    shard_records = shard_records or n
+    paths = []
+    for shard_i, start in enumerate(range(0, n, shard_records)):
+        sl = slice(start, min(start + shard_records, n))
+        path = os.path.join(
+            out_dir, f"{split}-{shard_i:05d}{packed_cache.SHARD_SUFFIX}")
+        packed_cache.write_packed_shard(path, images_u8[sl], labels[sl],
+                                        meta or {})
+        paths.append(path)
+    return paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--src", required=True,
+                   help="ImageFolder root dir, or a .tar shard glob")
+    p.add_argument("--out", required=True, help="output cache directory")
+    p.add_argument("--split", default="train",
+                   help="shard name prefix (train|val)")
+    p.add_argument("--size", type=int, default=224,
+                   help="record edge: shorter-side resize + center crop")
+    p.add_argument("--shard-records", type=int, default=8192)
+    p.add_argument("--threads", type=int, default=0,
+                   help="decode threads (0 = auto)")
+    p.add_argument("--norm", choices=sorted(_NORMS), default="imagenet",
+                   help="mean/std stamped into shard meta (the training "
+                        "normalize constants)")
+    p.add_argument("--pad", type=int, default=4,
+                   help="reflect-pad crop margin stamped into meta "
+                        "(train-time augment of the packed records)")
+    p.add_argument("--no-verify", action="store_true",
+                   help="skip the CRC read-back pass")
+    args = p.parse_args(argv)
+
+    from pytorch_distributed_train_tpu.data import datasets as ds_lib
+
+    mean_name, std_name = _NORMS[args.norm]
+    meta = {
+        "mean": [float(v) for v in getattr(ds_lib, mean_name)],
+        "std": [float(v) for v in getattr(ds_lib, std_name)],
+        "pad": args.pad,
+        "src": args.src,
+        "size": args.size,
+    }
+    dataset = _build_source(args.src, args.size)
+    t0 = time.monotonic()
+
+    def progress(path, count):
+        print(f"pack_dataset: {path} ({count} records)", flush=True)
+
+    paths = pack_items(dataset, args.out, split=args.split,
+                       shard_records=args.shard_records, meta=meta,
+                       threads=args.threads, verify=not args.no_verify,
+                       progress=progress)
+    total = sum(packed_cache.read_header(p)[0]["n"] for p in paths)
+    print(json.dumps({
+        "shards": len(paths),
+        "records": total,
+        "size": args.size,
+        "out": args.out,
+        "verified": not args.no_verify,
+        "wall_s": round(time.monotonic() - t0, 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
