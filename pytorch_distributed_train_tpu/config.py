@@ -549,7 +549,12 @@ class ObsConfig:
     # (default — a port bind is a side effect), >0 = bind that port,
     # -1 = ephemeral OS-assigned port (tests / several trainers per
     # host; read it back from Trainer.metrics_server.port). Serves
-    # GET /metrics (text format v0.0.4) and /healthz.
+    # GET /metrics (text format v0.0.4) and /healthz. A fixed port
+    # already bound by another local worker falls back to an ephemeral
+    # one (logged once); under tpurun the ACTUAL bound port is
+    # published to the launcher store as an obs endpoint record, so
+    # the fleet collector (obs/collector.py) scrapes the right port
+    # either way.
     metrics_port: int = 0
     # Chrome trace.json of host spans (obs/spans.py), written by process
     # 0 when fit() ends ("" → <checkpoint.dir>/trace.json). Load in

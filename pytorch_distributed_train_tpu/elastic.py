@@ -227,6 +227,82 @@ def discover_replicas(store) -> list[str]:
     return out
 
 
+# Observability-endpoint registry on the same store (docs/observability
+# .md "Fleet health plane"): the symmetric twin of the serving-replica
+# registry above, but for SCRAPE surfaces — the trainer metrics sidecar
+# and serve_http self-register {role, addr, host, gen} so the fleet
+# collector (obs/collector.py) discovers every /metrics + /healthz
+# target without static config. Same liveness stance as replicas: dead
+# records are fine, the collector's staleness tracking (not this list)
+# decides who is alive; a restarted process claims a NEW index.
+OBS_ENDPOINT_COUNT_KEY = "obs/endpoints_n"
+OBS_ENDPOINT_KEY_PREFIX = "obs/endpoint/"
+
+
+def publish_obs_endpoint(store, role: str, addr: str,
+                         host: str | None = None,
+                         gen: str | None = None) -> int:
+    """Register a scrape endpoint (``role`` in {"trainer", "serving"},
+    ``addr`` a routable ``host:port`` whose /metrics answers) with the
+    launcher store; returns its registry index. ``host``/``gen``
+    default to the launcher env contract identity — the same writer id
+    the event journal uses, so fleet state and journals cross-link.
+    OUTSIDE the env contract (no PROCESS_ID: ad-hoc replicas) the addr
+    itself is the host identity — the collector keys targets by
+    (role, host), and N replicas all defaulting to "host0" would
+    silently collapse into one target with N-1 of them never
+    scraped."""
+    pid = os.environ.get("PROCESS_ID")
+    rec = {
+        "role": role, "addr": addr,
+        "host": host if host is not None else (
+            f"host{pid}" if pid is not None else addr),
+        "gen": gen if gen is not None else os.environ.get(
+            "RESTART_GENERATION", "0"),
+        "pid": os.getpid(),
+    }
+    idx = int(store.add(OBS_ENDPOINT_COUNT_KEY, 1)) - 1
+    store.set(f"{OBS_ENDPOINT_KEY_PREFIX}{idx}",
+              json.dumps(rec, sort_keys=True).encode())
+    return idx
+
+
+def discover_obs_endpoints(store) -> list[dict]:
+    """Every endpoint record ever published (registration order), each
+    carrying its registry ``idx``. Corrupt/unlanded records are skipped;
+    empty when nothing registered or the store is unreachable."""
+    if store is None:
+        return []
+    try:
+        n = int(store.add(OBS_ENDPOINT_COUNT_KEY, 0))
+    except Exception:
+        return []
+    out: list[dict] = []
+    for i in range(n):
+        try:
+            rec = json.loads(store.get(
+                f"{OBS_ENDPOINT_KEY_PREFIX}{i}", timeout_ms=200).decode())
+        except Exception:
+            continue  # claimed index whose set never landed
+        if not isinstance(rec, dict) or "addr" not in rec:
+            continue
+        rec["idx"] = i
+        out.append(rec)
+    return out
+
+
+def routable_host(bind_host: str) -> str:
+    """A peer-connectable address for a locally-bound server: wildcard
+    binds advertise the host's resolved name instead (the serve_http
+    --advertise rule, shared with the obs-endpoint publishers)."""
+    if bind_host not in ("", "0.0.0.0", "::"):
+        return bind_host
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return socket.gethostname()
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))

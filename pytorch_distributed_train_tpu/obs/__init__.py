@@ -42,6 +42,17 @@ checkpoint / data / serving layers:
                   input-pipeline stall timers (read/decode/augment/h2d),
                   and the append-only perf ledger with its median+MAD
                   regression gate (tools/perf_ledger.py).
+- ``memory``    — host/device memory-headroom gauges (RSS,
+                  MemAvailable, device bytes in use/limit), refreshed
+                  at log cadence and on every scrape.
+- ``collector`` — the fleet half: store-discovered scraping of every
+                  host's /metrics + /healthz into bounded rolling
+                  fleet state, staleness on the collector's clock.
+- ``alerts``    — the CLOSED declarative alert-rule catalog + engine
+                  over the collector's state (threshold / absence /
+                  rate / anomaly; firing→resolved lifecycle journaled
+                  under the ``alert`` category); rendered live by
+                  tools/fleet_console.py.
 
 Everything here is plain-Python host code: no jax import at module
 scope except in ``cluster`` (which is lazy), so data-loader worker

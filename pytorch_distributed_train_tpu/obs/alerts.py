@@ -1,0 +1,458 @@
+"""Declarative fleet alert rules: a CLOSED catalog + the engine.
+
+The fault-points/event-categories pattern applied to alerting: every
+rule the fleet plane can fire is declared HERE, in ``RULES``, with its
+kind and inputs — and the catalog is cross-checked against the table
+in docs/observability.md by the ``alert-catalog`` pass of
+``python -m tools.analyze`` (both directions). An alert nobody can
+look up is noise; an alert that exists only in a dashboard config is
+a silent gap.
+
+Rule kinds over the collector's rolling state (obs/collector.py):
+
+- ``threshold`` — latest value crosses a fixed bound (OOM headroom).
+- ``absence``   — something expected stopped happening: a target that
+  answered and then went silent (``fleet_stale``; never-scraped
+  targets are categorically exempt, the liveness-plane blame rule),
+  or a trainer that scrapes fine but whose step counter stopped
+  (``trainer_step_stalled``).
+- ``rate``      — too many discrete events per window: restart churn
+  counted from endpoint-registry generations.
+- ``anomaly``   — ``sentinel/numeric.SpikeDetector`` (median + MAD,
+  healthy-only window) pointed at a scraped series: step-time, TTFT
+  p95, goodput, shed rate, straggler ratio, loss. ``direction``
+  filters which side fires (a goodput SPIKE is good news);
+  ``min_abs`` floors the deviation so an all-zero baseline (shed
+  rate) doesn't make the first 10^-6 a 6-sigma event.
+
+Lifecycle per (rule, target): untriggered → FIRING → RESOLVED, each
+transition journaled under the closed ``alert`` event category (with
+the target's host/gen tags — a timeline_report landmark), counted in
+``alerts_fired_total{rule=}``, and mirrored in the
+``alerts_firing{rule=}`` gauge (the number of targets currently
+firing that rule). Per-rule ``cooldown_s`` bounds re-fire chatter.
+Transitions optionally POST to a webhook and/or append to a JSONL
+file sink, and a firing anomaly rule may invoke the managed profiler
+on the offending target (``profile_on_alert`` → ``POST /profile`` on
+its scrape endpoint — the PR-5 route exists on both the trainer
+sidecar and serve_http), wall-clock cooldown-limited.
+
+Stdlib + sentinel/numeric only; no jax (runs on a login host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+from pytorch_distributed_train_tpu.sentinel.numeric import SpikeDetector
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declared rule. ``series`` names the collector series (or
+    derived field) the rule reads; ``roles`` scopes it to trainer /
+    serving targets."""
+
+    name: str
+    kind: str                      # threshold | absence | rate | anomaly
+    roles: tuple                   # ("trainer",) / ("serving",) / both
+    series: str
+    description: str
+    # threshold bounds (exactly one set for kind=threshold)
+    below: float | None = None
+    above: float | None = None
+    # anomaly detector knobs (kind=anomaly)
+    sigma: float = 6.0
+    min_samples: int = 8
+    min_rel: float = 0.25
+    min_abs: float = 0.0
+    window: int = 64
+    direction: str = "both"        # above | below | both
+    resolve_after: int = 2         # consecutive healthy obs to resolve
+    # absence / rate windows (seconds)
+    for_s: float = 0.0
+    # lifecycle
+    cooldown_s: float = 60.0
+    profile: bool = False          # may invoke the managed profiler
+
+
+_BOTH = ("trainer", "serving")
+
+# The CLOSED catalog — docs/observability.md '## Alert catalog' mirrors
+# this table; tools/analyze's alert-catalog pass keeps the two in sync.
+RULES: dict[str, AlertRule] = {r.name: r for r in (
+    AlertRule(
+        name="fleet_stale", kind="absence", roles=_BOTH, series="scrape",
+        description="a target that answered at least once has not been "
+                    "scraped successfully past the staleness deadline "
+                    "(never-scraped targets are exempt)"),
+    AlertRule(
+        name="trainer_step_stalled", kind="absence", roles=("trainer",),
+        series="step", for_s=120.0,
+        description="scrapes succeed but the step counter has not "
+                    "advanced for the window — a wedged loop the host's "
+                    "own watchdog may be blind to"),
+    AlertRule(
+        name="loss_spike", kind="anomaly", roles=("trainer",),
+        series="loss", direction="above", min_rel=0.5, profile=True,
+        description="train loss deviates above the rolling median+MAD "
+                    "window (the sentinel spike detector, fleet-side)"),
+    AlertRule(
+        name="step_time_regression", kind="anomaly", roles=("trainer",),
+        series="step_time_ms", direction="above", profile=True,
+        description="step-time p50 regressed vs its healthy window"),
+    AlertRule(
+        name="ttft_regression", kind="anomaly", roles=("serving",),
+        series="ttft_p95_s", direction="above", min_abs=0.02,
+        profile=True,
+        description="windowed TTFT p95 (serve_ttft_seconds bucket "
+                    "deltas) spiked vs its healthy window"),
+    AlertRule(
+        name="goodput_drop", kind="anomaly", roles=("trainer",),
+        series="goodput_pct", direction="below", min_abs=5.0,
+        description="goodput %% fell hard vs its healthy window"),
+    AlertRule(
+        name="shed_storm", kind="anomaly", roles=("serving",),
+        series="shed_per_s", direction="above", min_abs=1.0,
+        description="admission-control shed rate spiked (requests/s "
+                    "refused with 429)"),
+    AlertRule(
+        name="straggler_ratio", kind="anomaly", roles=("trainer",),
+        series="straggler_ratio", direction="above", min_abs=0.5,
+        description="cluster max/median step-time ratio spiked — one "
+                    "host is pulling away from the gang"),
+    AlertRule(
+        name="host_oom_risk", kind="threshold", roles=_BOTH,
+        series="host_available_bytes", below=1 << 30,
+        description="host MemAvailable under the floor (default 1 GiB) "
+                    "— decode slowdown, then the OOM killer"),
+    AlertRule(
+        name="device_oom_risk", kind="threshold", roles=_BOTH,
+        series="device_mem_frac", above=0.92,
+        description="device bytes_in_use over 92%% of bytes_limit — "
+                    "HBM headroom nearly gone"),
+    AlertRule(
+        name="restart_churn", kind="rate", roles=_BOTH, series="gens",
+        above=3, for_s=600.0,
+        description="3+ restart generations registered within the "
+                    "window — a crash loop, fleet-visible"),
+)}
+
+
+class _RuleState:
+    """Lifecycle of one (rule, target) pair."""
+
+    def __init__(self, rule: AlertRule):
+        self.firing = False
+        self.since_mono: float | None = None
+        self.last_fire_mono: float | None = None
+        self.healthy = 0
+        self.value: float | None = None
+        self.baseline: float | None = None
+        self.detector: SpikeDetector | None = None
+        self.last_sample_mono: float | None = None
+        if rule.kind == "anomaly":
+            self.detector = SpikeDetector(
+                window=rule.window, sigma=rule.sigma,
+                min_samples=rule.min_samples, min_rel=rule.min_rel)
+
+
+class AlertEngine:
+    """Evaluates the rule catalog over a FleetCollector each tick.
+
+    ``sink_path`` appends one JSON record per transition;
+    ``webhook_url`` POSTs the same record (both best-effort — alerting
+    must never take the console down). ``overrides`` maps
+    ``rule.field`` → value (the console's ``--rule`` flag) so a drill
+    can tighten ``min_samples``/``cooldown_s`` without code edits.
+    """
+
+    def __init__(self, *, rules: dict | None = None,
+                 stale_after_s: float | None = None,
+                 sink_path: str = "", webhook_url: str = "",
+                 profile_on_alert: bool = False,
+                 profile_cooldown_s: float = 300.0,
+                 profile_capture_s: float = 2.0,
+                 overrides: dict | None = None, opener=None):
+        base = dict(rules if rules is not None else RULES)
+        for spec, value in (overrides or {}).items():
+            rule_name, _, field = spec.partition(".")
+            if rule_name not in base or not hasattr(base[rule_name],
+                                                    field):
+                raise KeyError(f"unknown rule override {spec!r}")
+            cur = getattr(base[rule_name], field)
+            if isinstance(cur, bool):
+                value = str(value).lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                value = int(float(value))
+            elif isinstance(cur, float) or cur is None:
+                try:
+                    value = float(value)
+                except (TypeError, ValueError):
+                    pass  # a string field (direction) stays a string
+            base[rule_name] = dataclasses.replace(
+                base[rule_name], **{field: value})
+        self.rules = base
+        self.stale_after_s = stale_after_s
+        self.sink_path = sink_path
+        self.webhook_url = webhook_url
+        self.profile_on_alert = profile_on_alert
+        self.profile_cooldown_s = profile_cooldown_s
+        self.profile_capture_s = profile_capture_s
+        self._opener = opener or urllib.request.urlopen
+        self._states: dict[tuple[str, str, str], _RuleState] = {}
+        self._gen_seen: dict[tuple[str, str], dict[str, float]] = {}
+        self._last_profile_mono: float | None = None
+
+    # ------------------------------------------------------------ helpers
+    def _state(self, rule: AlertRule, target) -> _RuleState:
+        key = (rule.name, target.role, target.host)
+        st = self._states.get(key)
+        if st is None:
+            st = self._states[key] = _RuleState(rule)
+        return st
+
+    def firing(self) -> list[dict]:
+        """Currently-firing alerts (console's active list), with ages."""
+        now = time.monotonic()
+        out = []
+        for (rule, role, host), st in sorted(self._states.items()):
+            if st.firing:
+                out.append({
+                    "rule": rule, "role": role, "host": host,
+                    "for_s": round(now - (st.since_mono or now), 1),
+                    "value": st.value, "baseline": st.baseline})
+        return out
+
+    # -------------------------------------------------------- transitions
+    def _transition(self, rule: AlertRule, target, st: _RuleState,
+                    fire: bool, now_mono: float,
+                    value: float | None, baseline: float | None) -> dict:
+        st.firing = fire
+        st.value = value
+        st.baseline = baseline
+        rec = {"rule": rule.name, "kind": rule.kind, "host": target.host,
+               "role": target.role, "gen": target.gen}
+        if value is not None:
+            rec["value"] = round(float(value), 6)
+        if baseline is not None:
+            rec["baseline"] = round(float(baseline), 6)
+        if fire:
+            st.since_mono = now_mono
+            st.last_fire_mono = now_mono
+            st.healthy = 0
+            rec["event"] = "fired"
+            get_registry().counter(
+                "alerts_fired_total", labels={"rule": rule.name},
+                help="alert-rule firing transitions").inc()
+        else:
+            rec["event"] = "resolved"
+            rec["after_s"] = round(now_mono - (st.since_mono or now_mono), 1)
+            st.since_mono = None
+        events_lib.emit("alert", rec["event"], rule=rule.name,
+                        host=target.host, role=target.role,
+                        gen=target.gen,
+                        **{k: v for k, v in rec.items()
+                           if k in ("value", "baseline", "after_s")})
+        self._sink(rec)
+        if fire and rule.profile and self.profile_on_alert:
+            self._request_profile(rule, target, now_mono)
+        return rec
+
+    def _sink(self, rec: dict) -> None:
+        payload = dict(rec, ts=time.time())
+        if self.sink_path:
+            try:
+                with open(self.sink_path, "a") as f:
+                    f.write(json.dumps(payload) + "\n")
+            except OSError:
+                pass
+        if self.webhook_url:
+            try:
+                req = urllib.request.Request(
+                    self.webhook_url, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                self._opener(req, timeout=2.0).read()
+            except Exception:
+                pass  # alert delivery is best-effort by design
+
+    def _request_profile(self, rule: AlertRule, target,
+                         now_mono: float) -> None:
+        """Fire the PR-5 managed profiler on the offending target via
+        its own ``POST /profile`` route — cooldown-limited so a bad
+        hour cannot fill the fleet's disks with captures. The POST runs
+        on its own thread: a slow target (exactly the kind that fires
+        alerts) must not stall the evaluation loop behind its timeout."""
+        if (self._last_profile_mono is not None
+                and now_mono - self._last_profile_mono
+                < self.profile_cooldown_s):
+            return
+        self._last_profile_mono = now_mono
+        addr, host, gen = target.addr, target.host, target.gen
+
+        def post():
+            status = None
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/profile",
+                    data=json.dumps(
+                        {"seconds": self.profile_capture_s}).encode(),
+                    headers={"Content-Type": "application/json"})
+                status = self._opener(req, timeout=5.0).status
+            except Exception as e:
+                status = getattr(e, "code", None) or repr(e)
+            events_lib.emit("alert", "profile_requested", rule=rule.name,
+                            host=host, gen=gen, status=status)
+
+        threading.Thread(target=post, daemon=True,
+                         name=f"alert-profile-{host}").start()
+
+    # ----------------------------------------------------------- evaluate
+    def evaluate(self, collector) -> list[dict]:
+        """One pass over every (rule, target) pair; returns the
+        transition records of this tick (fired/resolved)."""
+        now = time.monotonic()
+        stale_after = (self.stale_after_s
+                       if self.stale_after_s is not None
+                       else collector.stale_after_s)
+        transitions: list[dict] = []
+        for target in collector.targets:
+            for rule in self.rules.values():
+                if target.role not in rule.roles:
+                    continue
+                st = self._state(rule, target)
+                if rule.kind == "anomaly":
+                    transitions.extend(self._eval_anomaly(
+                        rule, target, st, now))
+                    continue
+                cond, value, baseline = self._condition(
+                    rule, target, now, stale_after)
+                if cond is None:
+                    continue
+                if cond and not st.firing:
+                    if (st.last_fire_mono is not None
+                            and now - st.last_fire_mono < rule.cooldown_s):
+                        continue  # re-fire inside the cooldown: suppress
+                    transitions.append(self._transition(
+                        rule, target, st, True, now, value, baseline))
+                elif not cond and st.firing:
+                    transitions.append(self._transition(
+                        rule, target, st, False, now, value, baseline))
+        # gauges reflect the post-evaluation truth for EVERY rule, 0s
+        # included — a resolved alert must visibly go back to 0
+        reg = get_registry()
+        per_rule: dict[str, int] = {name: 0 for name in self.rules}
+        for (rule_name, _r, _h), st in self._states.items():
+            if st.firing and rule_name in per_rule:
+                per_rule[rule_name] += 1
+        for name, n in per_rule.items():
+            reg.gauge("alerts_firing", labels={"rule": name},
+                      help="targets currently firing each alert rule"
+                      ).set(n)
+        return transitions
+
+    def _condition(self, rule: AlertRule, target, now: float,
+                   stale_after: float):
+        """(cond, value, baseline) for the non-anomaly kinds; cond None
+        = rule not applicable yet (missing input, never scraped)."""
+        if rule.kind == "absence" and rule.name == "fleet_stale":
+            if target.last_ok_mono is None:
+                return None, None, None  # never scraped: not blamable
+            age = now - target.last_ok_mono
+            return age > stale_after, age, stale_after
+        if rule.kind == "absence":  # trainer_step_stalled
+            if (target.state(now, stale_after) != "ok"
+                    or target.last_step_change_mono is None):
+                return None, None, None
+            idle = now - target.last_step_change_mono
+            return idle > rule.for_s, idle, rule.for_s
+        if rule.kind == "threshold":
+            if rule.series == "device_mem_frac":
+                value = target.device_mem_frac()
+            else:
+                value = target.memory.get(rule.series)
+            if value is None:
+                return None, None, None
+            if rule.below is not None:
+                return value < rule.below, value, rule.below
+            return value > rule.above, value, rule.above
+        if rule.kind == "rate":  # restart_churn over registry gens
+            key = (target.role, target.host)
+            seen = self._gen_seen.get(key)
+            if seen is None:
+                # First sight of this target: every generation already
+                # in the registry is HISTORY, not churn — stamping them
+                # "now" would false-fire every console (re)start against
+                # a store that ever accumulated 3+ restarts. Only gens
+                # appearing from here on count into the window.
+                self._gen_seen[key] = {g: None for g in target.gens}
+                return False, 0, rule.above
+            for g in target.gens:
+                seen.setdefault(g, now)
+            recent = sum(1 for ts in seen.values()
+                         if ts is not None and now - ts <= rule.for_s)
+            return recent >= (rule.above or 1), recent, rule.above
+        return None, None, None
+
+    def _eval_anomaly(self, rule: AlertRule, target, st: _RuleState,
+                      now: float) -> list[dict]:
+        """Feed the detector every series sample newer than the last
+        consumed one; spikes fire, ``resolve_after`` consecutive
+        healthy samples resolve. Healthy-only window: a firing storm
+        never drags its own baseline up."""
+        out: list[dict] = []
+        det = st.detector
+        samples = [(ts, v) for ts, v in target.series.get(rule.series, ())
+                   if st.last_sample_mono is None
+                   or ts > st.last_sample_mono]
+        for ts, value in samples:
+            st.last_sample_mono = ts
+            spike = det.is_spike(value) and self._directed(
+                rule, det, value)
+            if spike:
+                st.healthy = 0
+                st.value = value  # console shows the freshest reading
+                if not st.firing:
+                    if (st.last_fire_mono is not None
+                            and now - st.last_fire_mono
+                            < rule.cooldown_s):
+                        continue
+                    out.append(self._transition(
+                        rule, target, st, True, now, value,
+                        self._median(det)))
+                continue
+            det.add(value)
+            if st.firing:
+                st.healthy += 1
+                st.value = value
+                if st.healthy >= rule.resolve_after:
+                    out.append(self._transition(
+                        rule, target, st, False, now, value,
+                        self._median(det)))
+        return out
+
+    def _directed(self, rule: AlertRule, det: SpikeDetector,
+                  value: float) -> bool:
+        med = self._median(det)
+        if rule.min_abs and med is not None and (
+                abs(value - med) < rule.min_abs):
+            return False
+        if rule.direction == "both" or med is None:
+            return True
+        if rule.direction == "above":
+            return value > med
+        return value < med
+
+    @staticmethod
+    def _median(det: SpikeDetector) -> float | None:
+        xs = sorted(det.window)
+        if not xs:
+            return None
+        n = len(xs)
+        return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])

@@ -1,0 +1,528 @@
+"""Fleet collector: store-discovered scraping + rolling fleet state.
+
+Five per-host observability planes exist (metrics, events, profiler,
+perf attribution, request tracing) but each is a file or a port on ONE
+host, read after the fact. This module is the fleet-level half: one
+collector process (usually ``tools/fleet_console.py``) that
+
+- **discovers** every scrape target through the elastic launcher store
+  (``elastic.discover_obs_endpoints``): serving replicas and trainer
+  metrics sidecars self-register ``{role, addr, host, gen}`` records
+  (``elastic.publish_obs_endpoint``), so a fleet of N hosts needs zero
+  static scrape config — the same registry-as-hint stance as the
+  serving-replica registry (dead records are fine; staleness here, not
+  the registry, decides who is alive);
+- **scrapes** ``/metrics`` (Prometheus text v0.0.4, parsed back into
+  typed families) and ``/healthz`` (the serving reliability snapshot)
+  on a cadence, tracking staleness on the COLLECTOR's monotonic clock
+  — receiver-side like ``sentinel/liveness.py``, immune to target
+  clock skew, and with the same blame discipline: a target that has
+  NEVER been scraped successfully is "never" (still compiling, still
+  binding), categorically distinct from one that answered and then
+  went silent ("stale" — the alertable condition);
+- keeps **bounded rolling state** per host: step + steps/s (derived
+  from step deltas on the collector clock), loss, MFU, goodput,
+  step-time p50, straggler ratio, input-stage split, shed rate, a
+  windowed TTFT p95 estimated from ``serve_ttft_seconds`` bucket
+  deltas (responds immediately in BOTH directions, unlike the
+  replica's rolling-window p95), serving SLO/admission snapshots,
+  checkpoint tier hits, host/device memory headroom, and restart
+  generations seen.
+
+The alert engine (obs/alerts.py) evaluates its rule catalog over this
+state; ``tools/fleet_console.py`` renders it. No jax anywhere near
+this module (obs/ package contract) — it runs on a login host.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+# ----------------------------------------------------- exposition parser
+_SERIES_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)\s*$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def parse_exposition(text: str) -> dict:
+    """Prometheus text v0.0.4 → ``{family: {label_items_tuple: value}}``
+    — the inverse of ``registry.render``. Histogram series arrive as
+    their ``_bucket``/``_sum``/``_count`` families (with ``le`` labels
+    intact), which is exactly what the windowed-quantile estimator
+    needs. Unparseable lines are skipped: a scrape is a snapshot, not
+    a contract."""
+    out: dict[str, dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES_LINE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value_raw = m.groups()
+        try:
+            value = float(value_raw)
+        except ValueError:
+            continue
+        labels: tuple = ()
+        if labels_raw:
+            labels = tuple(sorted(
+                (k, _unescape(v)) for k, v in _LABEL.findall(labels_raw)))
+        out.setdefault(name, {})[labels] = value
+    return out
+
+
+def family_value(families: dict, name: str,
+                 labels: dict | None = None) -> float | None:
+    """One series' value, or None when absent (the collector's reader)."""
+    fam = families.get(name)
+    if not fam:
+        return None
+    key = tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+    return fam.get(key)
+
+
+def family_by_label(families: dict, name: str, label: str) -> dict:
+    """``{label_value: value}`` for a one-label family (e.g. the
+    per-stage input split, the per-tier restore counts)."""
+    out: dict[str, float] = {}
+    for key, v in (families.get(name) or {}).items():
+        for k, lv in key:
+            if k == label:
+                out[lv] = v
+    return out
+
+
+class HistogramWindow:
+    """Windowed quantile over a scraped cumulative histogram.
+
+    Each call diffs the new cumulative bucket counts against the last
+    scrape's and reports the requested quantile of ONLY the window's
+    observations (nearest bucket upper bound — coarse, but monotone and
+    instant to recover, which is what an anomaly detector needs; a
+    rolling-window p95 would hold a storm's tail for minutes after it
+    ended). Returns None when the window saw no new observations."""
+
+    def __init__(self) -> None:
+        self._prev: tuple[dict, float] | None = None
+
+    def observe(self, families: dict, name: str,
+                q: float = 0.95) -> float | None:
+        buckets: dict[float, float] = {}
+        for key, v in (families.get(f"{name}_bucket") or {}).items():
+            le = dict(key).get("le")
+            if le in (None, "+Inf"):
+                continue
+            try:
+                buckets[float(le)] = v
+            except ValueError:
+                continue
+        total = family_value(families, f"{name}_count") or 0.0
+        primed = self._prev is not None
+        prev_buckets, prev_total = self._prev or ({}, 0.0)
+        self._prev = (buckets, total)
+        if not primed:
+            # first scrape: the "window" would be the target's whole
+            # history — not a window at all; prime and wait for deltas
+            return None
+        delta_n = total - prev_total
+        if delta_n <= 0 or not buckets:
+            return None
+        target = q * delta_n
+        uppers = sorted(buckets)
+        for ub in uppers:
+            if buckets[ub] - prev_buckets.get(ub, 0.0) >= target:
+                return ub
+        # the quantile landed in the implicit +Inf bucket: report past
+        # the largest finite bound so the detector still sees "huge"
+        return 2.0 * uppers[-1]
+
+
+# ------------------------------------------------------------- fleet state
+# series the alert rules read; every one is a bounded (mono_ts, value)
+# deque per target
+SERIES = ("step", "steps_per_s", "loss", "step_time_ms", "mfu_pct",
+          "goodput_pct", "straggler_ratio", "shed_per_s", "ttft_p95_s")
+
+
+class Target:
+    """One scrape target's rolling state. ``state`` is the staleness
+    verdict on the collector's clock:
+
+    - ``never`` — no successful scrape yet (not blamable: first
+      compile, late bind — the liveness-plane rule);
+    - ``ok``    — answered within ``stale_after_s``;
+    - ``stale`` — answered at least once, silent past the deadline
+      (the alertable "gone" condition).
+    """
+
+    def __init__(self, endpoint: dict, window: int = 240):
+        self.role = str(endpoint.get("role", "?"))
+        self.host = str(endpoint.get("host", "?"))
+        self.addr = str(endpoint.get("addr", ""))
+        self.idx = int(endpoint.get("idx", -1))
+        self.gens: set[str] = set()
+        self.note_endpoint(endpoint)
+        self.window = window
+        self.last_ok_mono: float | None = None
+        self.last_attempt_mono: float | None = None
+        self.last_error: str | None = None
+        self.consecutive_errors = 0
+        self.families: dict = {}
+        self.healthz: dict | None = None
+        self.healthz_code: int | None = None
+        self.series: dict[str, deque] = {
+            s: deque(maxlen=window) for s in SERIES}
+        self.last_step_change_mono: float | None = None
+        self._prev_step: tuple[float, float] | None = None  # (mono, step)
+        self._prev_counters: dict[str, tuple[float, float]] = {}
+        self._ttft_hist = HistogramWindow()
+        # latest non-series rollups the console renders
+        self.memory: dict = {}
+        self.input_split: dict = {}
+        self.ckpt_tiers: dict = {}
+
+    def note_endpoint(self, endpoint: dict) -> None:
+        """A (re-)registration for this (role, host): newest index wins
+        the address; every gen ever seen accumulates (restart count)."""
+        if int(endpoint.get("idx", -1)) >= self.idx:
+            self.idx = int(endpoint.get("idx", -1))
+            self.addr = str(endpoint.get("addr", self.addr))
+        self.gens.add(str(endpoint.get("gen", "0")))
+
+    @property
+    def gen(self) -> str:
+        try:
+            return str(max(int(g) for g in self.gens))
+        except ValueError:
+            return max(self.gens) if self.gens else "0"
+
+    @property
+    def restarts(self) -> int:
+        return max(0, len(self.gens) - 1)
+
+    def state(self, now_mono: float, stale_after_s: float) -> str:
+        if self.last_ok_mono is None:
+            return "never"
+        if now_mono - self.last_ok_mono > stale_after_s:
+            return "stale"
+        return "ok"
+
+    def age_s(self, now_mono: float) -> float | None:
+        if self.last_ok_mono is None:
+            return None
+        return now_mono - self.last_ok_mono
+
+    def latest(self, series: str) -> float | None:
+        dq = self.series.get(series)
+        return dq[-1][1] if dq else None
+
+    # ------------------------------------------------------ derivations
+    def _push(self, name: str, now: float, value: float | None) -> None:
+        if value is not None:
+            self.series[name].append((now, float(value)))
+
+    def _rate(self, name: str, now: float,
+              value: float | None) -> float | None:
+        """Per-second delta of a scraped counter (None until the second
+        sample; counter resets — a restarted target — read as None, not
+        a negative rate)."""
+        if value is None:
+            return None
+        prev = self._prev_counters.get(name)
+        self._prev_counters[name] = (now, value)
+        if prev is None or now <= prev[0] or value < prev[1]:
+            return None
+        return (value - prev[1]) / (now - prev[0])
+
+    def ingest(self, families: dict, healthz: dict | None,
+               healthz_code: int | None, now_mono: float) -> None:
+        self.families = families
+        self.healthz = healthz
+        self.healthz_code = healthz_code
+        self.last_ok_mono = now_mono
+        self.consecutive_errors = 0
+        self.last_error = None
+
+        step = family_value(families, "train_step")
+        if step is not None:
+            if self._prev_step is None or step != self._prev_step[1]:
+                self.last_step_change_mono = now_mono
+            if self._prev_step is not None and now_mono > self._prev_step[0]:
+                if step >= self._prev_step[1]:
+                    self._push("steps_per_s", now_mono,
+                               (step - self._prev_step[1])
+                               / (now_mono - self._prev_step[0]))
+            self._prev_step = (now_mono, step)
+            self._push("step", now_mono, step)
+        self._push("loss", now_mono, family_value(families, "train_loss"))
+        self._push("step_time_ms", now_mono,
+                   family_value(families, "train_step_time_ms_p50"))
+        self._push("mfu_pct", now_mono,
+                   family_value(families, "perf_mfu_pct")
+                   if family_value(families, "perf_mfu_pct") is not None
+                   else family_value(families, "train_mfu_pct"))
+        self._push("goodput_pct", now_mono,
+                   family_value(families, "train_goodput_pct"))
+        p50_max = family_value(families, "train_step_time_p50_max")
+        p50_med = family_value(families, "train_step_time_p50_med")
+        if p50_max is not None and p50_med:
+            self._push("straggler_ratio", now_mono, p50_max / p50_med)
+        self._push("shed_per_s", now_mono,
+                   self._rate("serve_shed_total", now_mono,
+                              family_value(families, "serve_shed_total")))
+        self._push("ttft_p95_s", now_mono,
+                   self._ttft_hist.observe(families, "serve_ttft_seconds"))
+
+        self.memory = {
+            k: family_value(families, k)
+            for k in ("host_rss_bytes", "host_available_bytes",
+                      "device_bytes_in_use", "device_bytes_limit")
+            if family_value(families, k) is not None}
+        self.input_split = family_by_label(
+            families, "input_stage_seconds_total", "stage")
+        self.ckpt_tiers = family_by_label(
+            families, "ckpt_restore_tier_total", "tier")
+
+    def device_mem_frac(self) -> float | None:
+        used = self.memory.get("device_bytes_in_use")
+        limit = self.memory.get("device_bytes_limit")
+        if used is None or not limit:
+            return None
+        return used / limit
+
+    def slo(self) -> dict:
+        """The serving reliability snapshot out of /healthz, {} for
+        trainer targets / pre-plane replicas."""
+        if not isinstance(self.healthz, dict):
+            return {}
+        rel = self.healthz.get("reliability")
+        return rel if isinstance(rel, dict) else {}
+
+
+def _default_fetch(url: str, timeout_s: float) -> tuple[int, bytes]:
+    """(status, body); HTTP error statuses still return their body —
+    a 503 /healthz carries the draining/error JSON we want."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class FleetCollector:
+    """Scrapes every discovered target on a cadence into rolling state.
+
+    ``store_factory`` returns a launcher-store client per call (the
+    liveness-plane convention; default ``elastic.worker_store`` — None
+    outside a tpurun job). ``endpoints`` seeds static targets for
+    store-less runs. ``fetch`` is injectable for tests.
+    """
+
+    def __init__(self, *, store_factory=None, endpoints=(),
+                 poll_s: float = 2.0, stale_after_s: float = 10.0,
+                 window: int = 240, timeout_s: float = 2.0, fetch=None):
+        from pytorch_distributed_train_tpu.elastic import worker_store
+
+        self.poll_s = max(0.05, poll_s)
+        self.stale_after_s = stale_after_s
+        self.window = window
+        self.timeout_s = timeout_s
+        self._factory = store_factory if store_factory is not None \
+            else worker_store
+        self._fetch = fetch or _default_fetch
+        self._lock = threading.Lock()
+        self._targets: dict[tuple[str, str], Target] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        for i, ep in enumerate(endpoints):
+            ep = dict(ep)
+            ep.setdefault("idx", i)
+            self._note_endpoint(ep)
+
+    # ------------------------------------------------------------ targets
+    def _note_endpoint(self, ep: dict) -> None:
+        key = (str(ep.get("role", "?")), str(ep.get("host", "?")))
+        with self._lock:
+            t = self._targets.get(key)
+            if t is None:
+                self._targets[key] = Target(ep, window=self.window)
+            else:
+                t.note_endpoint(ep)
+
+    def discover(self) -> int:
+        """Merge the store's endpoint registry into the target set;
+        returns the number of known targets. Store unreachable = keep
+        what we have (the fleet does not vanish with a store hiccup)."""
+        store = None
+        try:
+            store = self._factory()
+            if store is not None:
+                from pytorch_distributed_train_tpu.elastic import (
+                    discover_obs_endpoints,
+                )
+
+                for ep in discover_obs_endpoints(store):
+                    self._note_endpoint(ep)
+        except Exception:
+            pass
+        finally:
+            if store is not None:
+                try:
+                    store.close()
+                except Exception:
+                    pass
+        with self._lock:
+            return len(self._targets)
+
+    @property
+    def targets(self) -> list[Target]:
+        with self._lock:
+            return list(self._targets.values())
+
+    # ------------------------------------------------------------- scrape
+    def _scrape_one(self, t: Target, now_mono: float) -> None:
+        if getattr(t, "_inflight", False):
+            return  # a previous (hung) scrape of this target still runs
+        t._inflight = True
+        try:
+            self._scrape_locked(t, now_mono)
+        finally:
+            t._inflight = False
+
+    def _scrape_locked(self, t: Target, now_mono: float) -> None:
+        try:
+            code, body = self._fetch(f"http://{t.addr}/metrics",
+                                     self.timeout_s)
+            if code != 200:
+                raise OSError(f"/metrics HTTP {code}")
+            families = parse_exposition(body.decode("utf-8", "replace"))
+            hz_code, hz = None, None
+            try:
+                hz_code, hz_body = self._fetch(f"http://{t.addr}/healthz",
+                                               self.timeout_s)
+                hz = json.loads(hz_body.decode("utf-8", "replace"))
+            except Exception:
+                pass  # metrics answered: the target is alive
+            t.ingest(families, hz, hz_code, now_mono)
+        except Exception as e:
+            t.last_error = f"{type(e).__name__}: {e}"
+            t.consecutive_errors += 1
+            get_registry().counter(
+                "fleet_scrape_errors_total",
+                help="failed fleet scrape attempts").inc()
+        finally:
+            t.last_attempt_mono = now_mono
+
+    def poll(self) -> None:
+        """One discovery + scrape pass over every target (the console's
+        tick; ``start()`` runs this on the cadence). Targets scrape in
+        PARALLEL: one slow or wedged host must not stall every other
+        host's staleness clock behind its timeout — that would turn one
+        sick target into a fleet-wide false-stale storm. A scrape still
+        in flight when the next pass starts is skipped, not doubled."""
+        self.discover()
+        now = time.monotonic()
+        threads = [threading.Thread(target=self._scrape_one,
+                                    args=(t, now), daemon=True,
+                                    name=f"fleet-scrape-{t.host}")
+                   for t in self.targets]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 2.0 * self.timeout_s + 1.0
+        for th in threads:
+            th.join(timeout=max(0.05, deadline - time.monotonic()))
+        reg = get_registry()
+        counts = {"never": 0, "ok": 0, "stale": 0}
+        for t in self.targets:
+            counts[t.state(time.monotonic(), self.stale_after_s)] += 1
+        for state, n in counts.items():
+            reg.gauge("fleet_targets", labels={"state": state},
+                      help="fleet scrape targets by staleness state").set(n)
+
+    # ------------------------------------------------------------ threading
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet-collector")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll()
+            except Exception:
+                pass  # the collector outlives any single bad pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> dict:
+        """The fleet rollup the console renders and --format json
+        emits. Pure read; safe from any thread."""
+        now = time.monotonic()
+        rows = []
+        for t in sorted(self.targets, key=lambda t: (t.role, t.host)):
+            slo = t.slo()
+            ttft = (slo.get("slo") or {}).get("ttft_s") or {}
+            age = t.age_s(now)
+            rows.append({
+                "host": t.host, "role": t.role, "addr": t.addr,
+                "gen": t.gen, "restarts": t.restarts,
+                "state": t.state(now, self.stale_after_s),
+                "age_s": None if age is None else round(age, 2),
+                "error": t.last_error,
+                "step": t.latest("step"),
+                "steps_per_s": t.latest("steps_per_s"),
+                "loss": t.latest("loss"),
+                "mfu_pct": t.latest("mfu_pct"),
+                "goodput_pct": t.latest("goodput_pct"),
+                "step_time_ms": t.latest("step_time_ms"),
+                "ttft_p95_s": t.latest("ttft_p95_s"),
+                "ttft_rolling": ttft,
+                "admission": slo.get("admission"),
+                "queue_depth": slo.get("queue_depth"),
+                "slots": slo.get("slots"),
+                "shed_per_s": t.latest("shed_per_s"),
+                "memory": dict(t.memory),
+                "input_split": dict(t.input_split),
+                "ckpt_tiers": dict(t.ckpt_tiers),
+            })
+        # slowest: the named-host rollups the ISSUE asks the console for
+        trainers = [r for r in rows if r["role"] == "trainer"
+                    and r["state"] == "ok"
+                    and r["steps_per_s"] is not None]
+        serving = [r for r in rows if r["role"] == "serving"
+                   and r["state"] == "ok"]
+
+        def _ttft_of(r):
+            if r["ttft_p95_s"] is not None:
+                return r["ttft_p95_s"]
+            return (r["ttft_rolling"] or {}).get("p95") or 0.0
+
+        slowest_trainer = (min(trainers, key=lambda r: r["steps_per_s"])
+                           ["host"] if trainers else None)
+        slow_serv = [r for r in serving if _ttft_of(r) > 0.0]
+        slowest_serving = (max(slow_serv, key=_ttft_of)["host"]
+                           if slow_serv else None)
+        return {"targets": rows,
+                "slowest_trainer": slowest_trainer,
+                "slowest_serving": slowest_serving}

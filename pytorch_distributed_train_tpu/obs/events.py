@@ -70,6 +70,8 @@ CATEGORIES: dict[str, str] = {
              "leaks, drains, router failovers and hedges",
     "perf": "performance attribution: per-capture MFU/op-class splits "
             "and perf-ledger rows (obs/perf.py)",
+    "alert": "fleet alert-rule transitions: fired, resolved, capture "
+             "requests (obs/alerts.py)",
 }
 
 

@@ -31,7 +31,16 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def render_metrics() -> str:
-    """The exposition body — shared by every scrape surface."""
+    """The exposition body — shared by every scrape surface. Memory
+    telemetry (obs/memory.py) refreshes first, so host/device headroom
+    gauges are scrape-fresh on every surface (trainer sidecar AND
+    serve_http) without any per-process sampling loop."""
+    try:
+        from pytorch_distributed_train_tpu.obs import memory as memory_lib
+
+        memory_lib.sample_memory_gauges()
+    except Exception:
+        pass  # telemetry must never break the scrape
     return get_registry().render()
 
 
@@ -120,11 +129,19 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class MetricsServer:
-    """Opt-in scrape sidecar for processes without an HTTP surface."""
+    """Opt-in scrape sidecar for processes without an HTTP surface.
+
+    ``port <= 0`` binds an OS-assigned ephemeral port (both -1, the
+    config sentinel, and a literal 0 land here — the "off" meaning of
+    ``cfg.obs.metrics_port == 0`` is the caller's gate, not this
+    class's). A fixed port that is already bound raises OSError
+    (EADDRINUSE) to the caller: the trainer's policy is to fall back to
+    ephemeral and publish the ACTUAL port through the store endpoint
+    record, so a second worker on the same host never crashes on the
+    shared config value (docs/observability.md).
+    """
 
     def __init__(self, port: int, host: str = "0.0.0.0"):
-        # -1 → ephemeral (the OS picks); 0 is the "off" config sentinel
-        # and never reaches here.
         self._httpd = ThreadingHTTPServer((host, max(port, 0)), _Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

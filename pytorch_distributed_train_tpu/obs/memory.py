@@ -1,0 +1,109 @@
+"""Host + device memory telemetry gauges — the OOM-headroom inputs.
+
+Every plane so far measures *time*; nothing scrapable measures *space*,
+and memory exhaustion is the classic silent killer on both sides of the
+fleet: a host whose page cache is gone decodes at disk speed long
+before the OOM killer fires, and a device a few hundred MB from its
+HBM limit fails on the next sharding change. These four gauges are the
+first alert-rule inputs (obs/alerts.py ``host_oom_risk`` /
+``device_oom_risk``):
+
+- ``host_rss_bytes``       — this process's resident set (VmRSS).
+- ``host_available_bytes`` — MemAvailable of the whole host: what the
+  kernel estimates can still be allocated without swapping, the number
+  the OOM killer effectively budgets against.
+- ``device_bytes_in_use``  — accelerator memory in use on local device
+  0 (jax ``memory_stats``; best-effort per backend).
+- ``device_bytes_limit``   — that device's allocatable limit.
+
+Sampling is best-effort and cheap (two /proc reads); it runs at the
+trainer's log cadence and at every ``/metrics`` scrape
+(obs/exposition.py ``render_metrics``), so serving replicas get the
+gauges without touching their request path. Device stats are only read
+when jax is ALREADY imported in this process — the scrape surface must
+never pay (or trigger) a backend init, and processes that never touch
+a device (the elastic agent, the fleet console) simply don't report
+the device pair. No jax at module scope (the obs/ package contract).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+
+def host_memory_bytes() -> dict:
+    """{"rss": ..., "available": ...} from /proc, missing keys where the
+    platform doesn't provide the file (macOS, exotic containers)."""
+    out: dict[str, int] = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss"] = int(line.split()[1]) * 1024
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    out["available"] = int(line.split()[1]) * 1024
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
+def device_memory_bytes() -> dict:
+    """{"in_use": ..., "limit": ...} of local device 0, or {} when jax
+    is not already loaded or the backend reports no memory stats (CPU).
+    Reading this NEVER imports jax — see module doc."""
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+    out: dict[str, int] = {}
+    if "bytes_in_use" in stats:
+        out["in_use"] = int(stats["bytes_in_use"])
+    # backends disagree on the limit key; take the first one present
+    for key in ("bytes_limit", "bytes_reservable_limit",
+                "pool_bytes"):
+        if stats.get(key):
+            out["limit"] = int(stats[key])
+            break
+    return out
+
+
+def sample_memory_gauges() -> dict:
+    """Refresh the four gauges in the process registry; returns the
+    sampled values (callers that also want them in a log record)."""
+    reg = get_registry()
+    host = host_memory_bytes()
+    dev = device_memory_bytes()
+    sampled: dict[str, int] = {}
+    if "rss" in host:
+        sampled["host_rss_bytes"] = host["rss"]
+        reg.gauge("host_rss_bytes",
+                  help="resident set size of this process").set(host["rss"])
+    if "available" in host:
+        sampled["host_available_bytes"] = host["available"]
+        reg.gauge("host_available_bytes",
+                  help="kernel MemAvailable estimate for the whole host "
+                       "(the OOM-headroom input)").set(host["available"])
+    if "in_use" in dev:
+        sampled["device_bytes_in_use"] = dev["in_use"]
+        reg.gauge("device_bytes_in_use",
+                  help="accelerator memory in use on local device 0 "
+                       "(best-effort per backend)").set(dev["in_use"])
+    if "limit" in dev:
+        sampled["device_bytes_limit"] = dev["limit"]
+        reg.gauge("device_bytes_limit",
+                  help="allocatable accelerator memory limit on local "
+                       "device 0").set(dev["limit"])
+    return sampled

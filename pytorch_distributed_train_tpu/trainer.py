@@ -31,6 +31,7 @@ from pytorch_distributed_train_tpu.data.pipeline import build_input_pipeline
 from pytorch_distributed_train_tpu.models.registry import build_model
 from pytorch_distributed_train_tpu.obs import cluster as cluster_lib
 from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs import memory as memory_lib
 from pytorch_distributed_train_tpu.obs import perf as perf_lib
 from pytorch_distributed_train_tpu.obs import profiler as profiler_lib
 from pytorch_distributed_train_tpu.obs import spans as spans_lib
@@ -442,7 +443,19 @@ class Trainer:
                 MetricsServer,
             )
 
-            self.metrics_server = MetricsServer(cfg.obs.metrics_port)
+            try:
+                self.metrics_server = MetricsServer(cfg.obs.metrics_port)
+            except OSError:
+                # Port collision: obs.metrics_port is one shared config
+                # value but several workers can share a host (tpurun
+                # --nprocs > 1). The sidecar is a diagnostic surface —
+                # crashing the trainer over it would be backwards; fall
+                # back to an ephemeral port and publish the ACTUAL port
+                # through the store endpoint record below.
+                self.metrics_server = MetricsServer(0)
+                print(f"[obs] metrics port {cfg.obs.metrics_port} in use "
+                      f"(another local worker?); bound ephemeral port "
+                      f"{self.metrics_server.port} instead", flush=True)
             # POST /profile on the sidecar opens a TIME-bounded capture
             # (capture_for_seconds, not a step window): the route's
             # whole point is poking a run that may be wedged, and a
@@ -457,6 +470,25 @@ class Trainer:
             if jax.process_index() == 0:
                 print(f"[obs] /metrics on port {self.metrics_server.port}",
                       flush=True)
+            # Self-register the scrape endpoint with the launcher store
+            # (elastic.publish_obs_endpoint) so the fleet collector
+            # discovers this host without static config — the ACTUAL
+            # bound port, which may differ from obs.metrics_port after
+            # the collision fallback above. Best-effort: no store (not
+            # under tpurun) just means no fleet discovery.
+            try:
+                from pytorch_distributed_train_tpu import elastic
+
+                store = elastic.worker_store()
+                if store is not None:
+                    addr = (f"{elastic.routable_host('')}"
+                            f":{self.metrics_server.port}")
+                    elastic.publish_obs_endpoint(store, "trainer", addr)
+                    store.close()
+                    print(f"[obs] registered fleet endpoint {addr}",
+                          flush=True)
+            except Exception:
+                pass
         self._stepped = False  # first train_step call = compile bucket
         # Eval's share of the process-global input-stage stats
         # (obs/perf.py), snapshot-deltas around evaluate(): the summary
@@ -759,6 +791,15 @@ class Trainer:
                             self.state, batch, self.step_rng
                         )
                     self._stepped = True
+                    if inflate_loss:
+                        # step.loss_spike drill: corrupt the OBSERVED
+                        # loss everywhere one observation is read —
+                        # the log record, the scrape mirror the fleet
+                        # collector reads, and the sentinel below all
+                        # see the same spike; params stay healthy.
+                        # (Lazy jnp multiply: no device sync here.)
+                        metrics = dict(metrics,
+                                       loss=metrics["loss"] * 1e6)
                     # Host-side step counter: int(state.step) every step
                     # would sync the device and serialize async dispatch
                     # (the jitted step increments state.step identically,
@@ -802,7 +843,7 @@ class Trainer:
                         "compile" if is_first else "step",
                         time.perf_counter() - t_body)
                     if self._sentinel_on and self._sentinel_observe(
-                            step, metrics, inflate_loss):
+                            step, metrics):
                         # Auto-rewind: BEFORE the cadence save below, so
                         # the diverged state is never checkpointed on
                         # the way out. The while loop re-enters with the
@@ -1083,6 +1124,11 @@ class Trainer:
             self._stall_prev = (stats.wait_s, loop_s)
         if self.cfg.obs.log_memory:
             host.update(device_memory_metrics())
+        # Host/device memory telemetry (obs/memory.py): refresh the
+        # OOM-headroom gauges at log cadence regardless of log_memory —
+        # two /proc reads plus an already-cached jax stats call, and
+        # they are the fleet plane's first alert-rule inputs.
+        memory_lib.sample_memory_gauges()
         if self._sentinel_on:
             scale = sentinel_numeric.cooldown_scale(self.state.opt_state)
             if scale is not None and scale != 1.0:
@@ -1246,9 +1292,10 @@ class Trainer:
         gate_skipped = ("update_skipped" in metrics
                         and float(np.asarray(metrics["update_skipped"])) > 0)
         if inflate_loss:
-            # step.loss_spike drill: corrupt only the OBSERVED value —
-            # the detection->rewind path exercises end to end while the
-            # actual params stay healthy.
+            # Legacy hook: the step.loss_spike drill now corrupts
+            # ``metrics["loss"]`` at the injection site in fit() (so
+            # the log/scrape mirror sees the spike too); this flag
+            # stays for callers staging their own observation.
             loss = loss * 1e6 if math.isfinite(loss) else loss
         reason = None
         if gate_skipped or not math.isfinite(loss):

@@ -80,3 +80,90 @@ def test_report_handles_missing_artifacts(tmp_path, capsys):
     assert "no trace file" in out
     # missing jsonl → exit 2, not a traceback
     assert obs_report.main(["--run-dir", str(tmp_path / "nope")]) == 2
+
+
+# ------------------------------------------ section presence contracts
+# Each section's present/absent behavior when its SOURCE is absent or
+# malformed, pinned one by one: a missing or corrupt source degrades
+# that one section and must never suppress the sections after it.
+
+def test_section_contract_no_events_dir(tmp_path, capsys):
+    """No events dir: the events section says so in one line; the
+    serving and traces sections (journal/trace-dir sourced) are ABSENT
+    entirely — quiet, not noisy."""
+    _write_fixture(tmp_path)
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "events: no journal directory" in out
+    assert "serving:" not in out
+    assert "traces:" not in out
+
+
+def test_section_contract_empty_and_populated_journal(tmp_path, capsys):
+    _write_fixture(tmp_path)
+    events = tmp_path / "events"
+    events.mkdir()
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    # dir exists but holds no journal files → empty, not absent
+    assert "is empty" in out
+    (events / "events_host0.jsonl").write_text(json.dumps(
+        {"ts": 1.0, "step": 1, "host": "host0", "gen": "0",
+         "category": "serve", "name": "tail_latency", "detail": {}})
+        + "\n")
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "events (1 journaled" in out
+    assert "serving (1 serve events)" in out  # journal present → section
+
+
+def test_section_contract_traces_dir(tmp_path, capsys):
+    _write_fixture(tmp_path)
+    traces = tmp_path / "traces"
+    traces.mkdir()
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "traces: directory present but no retained traces" in out
+
+
+def test_corrupt_trace_does_not_suppress_later_sections(tmp_path,
+                                                        capsys):
+    """A trace.json that parses as JSON but is not the Chrome shape
+    (the crashed-writer case) degrades the spans section to one line;
+    the events section AFTER it still renders."""
+    _write_fixture(tmp_path)
+    (tmp_path / "trace.json").write_text("[1, 2, 3]")
+    events = tmp_path / "events"
+    events.mkdir()
+    (events / "events_host0.jsonl").write_text(json.dumps(
+        {"ts": 1.0, "step": 1, "host": "host0", "gen": "0",
+         "category": "lifecycle", "name": "trainer_init", "detail": {}})
+        + "\n")
+    rc = obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "spans: unrenderable source" in out
+    assert "events (1 journaled" in out          # later section intact
+    assert "goodput: 81.0% productive" in out    # earlier one too
+
+
+def test_corrupt_journal_does_not_suppress_later_sections(tmp_path,
+                                                          capsys):
+    """A journal whose records defeat the loader (non-numeric ts mixed
+    with numeric — the sort dies) degrades the events/serving sections
+    only; the traces section after them still renders."""
+    _write_fixture(tmp_path)
+    events = tmp_path / "events"
+    events.mkdir()
+    (events / "events_host0.jsonl").write_text(
+        json.dumps({"ts": "late", "category": "serve", "name": "x"})
+        + "\n"
+        + json.dumps({"ts": 1.0, "category": "serve", "name": "y"})
+        + "\n")
+    traces = tmp_path / "traces"
+    traces.mkdir()
+    rc = obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "unrenderable source" in out
+    assert "traces: directory present but no retained traces" in out

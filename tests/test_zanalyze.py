@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 from tools.analyze import baseline as baseline_lib  # noqa: E402
 from tools.analyze import cli, core  # noqa: E402
 from tools.analyze.passes import (  # noqa: E402
+    alert_catalog,
     event_catalog,
     fault_catalog,
     jit_purity,
@@ -44,7 +45,7 @@ def test_registry_has_all_passes():
     assert set(core.all_passes()) == {
         "lock-scope", "monotonic-clock", "jit-purity", "fault-catalog",
         "event-catalog", "metric-catalog", "thread-shared-state",
-        "trace-hygiene"}
+        "trace-hygiene", "alert-catalog"}
 
 
 def test_pass_catalog_doc_is_the_registry_contract():
@@ -202,6 +203,30 @@ def test_event_catalog_catches_undeclared_emit(tmp_path):
         core.build_context(root))
     assert any(f.key == "undeclared:made_up_category" for f in findings)
     assert any(f.key.startswith("unemitted:") for f in findings)
+
+
+def test_alert_catalog_clean_on_repo():
+    assert alert_catalog.AlertCatalogPass().run(
+        core.build_context(REPO, [])) == []
+
+
+def test_alert_catalog_catches_doc_drift_both_ways(tmp_path):
+    def mutate(docs):
+        p = docs / "observability.md"
+        text = p.read_text()
+        anchor = "| `loss_spike`"
+        i = text.index(anchor)
+        # phantom row added + a real rule's row dropped
+        text = text[:i] + "| `ghost_rule` | anomaly | x | x | x |\n" \
+            + text[i:]
+        text = "\n".join(line for line in text.splitlines()
+                         if not line.startswith("| `ttft_regression`"))
+        p.write_text(text)
+
+    root = _repo_with_docs(tmp_path, mutate)
+    keys = {f.key for f in alert_catalog.AlertCatalogPass().run(
+        core.build_context(root, []))}
+    assert keys == {"phantom:ghost_rule", "undocumented:ttft_regression"}
 
 
 def test_metric_catalog_clean_on_repo():

@@ -8,6 +8,7 @@ documents the contract.
 """
 
 from tools.analyze.passes import (  # noqa: F401
+    alert_catalog,
     event_catalog,
     fault_catalog,
     jit_purity,

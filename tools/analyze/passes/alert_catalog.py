@@ -1,0 +1,83 @@
+"""alert-catalog: obs/alerts.py RULES ↔ docs/observability.md table.
+
+The fourth catalog the planes grew (after fault points, event
+categories and metrics): every declared fleet alert rule must appear
+in the doc's '## Alert catalog' table and vice versa — an alert an
+operator cannot look up is noise; a documented rule nothing evaluates
+is a silent gap. Also lints the declarations themselves: kinds come
+from the closed set the engine implements, and every rule names at
+least one role.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from tools.analyze.core import AnalysisPass, Context, Finding, register
+
+_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+DOC_REL = os.path.join("docs", "observability.md")
+SECTION = "## alert catalog"
+KINDS = {"threshold", "absence", "rate", "anomaly"}
+CODE_REL = "pytorch_distributed_train_tpu/obs/alerts.py"
+
+
+def documented_rules(doc_path: str) -> set[str]:
+    from tools.analyze.core import doc_table_names
+
+    return doc_table_names(doc_path, SECTION, _ROW)
+
+
+def declared_rules() -> dict:
+    from pytorch_distributed_train_tpu.obs.alerts import RULES
+
+    return dict(RULES)
+
+
+@register
+class AlertCatalogPass(AnalysisPass):
+    id = "alert-catalog"
+    description = ("fleet alert rules: obs/alerts.py RULES ↔ the doc's "
+                   "'## Alert catalog' table, both ways, plus "
+                   "closed-kind/role lint")
+    include = (CODE_REL,)
+
+    def run(self, ctx: Context) -> list[Finding]:
+        doc_path = ctx.doc_path(DOC_REL)
+        doc_rel = DOC_REL.replace(os.sep, "/")
+        code = declared_rules()
+        try:
+            doc = documented_rules(doc_path)
+        except OSError:
+            return [Finding(self.id, doc_rel, 1,
+                            "docs/observability.md is unreadable",
+                            key="doc-missing")]
+        if not doc:
+            return [Finding(self.id, doc_rel, 1,
+                            "no rows under '## Alert catalog' — was the "
+                            "table renamed?", key="catalog-empty")]
+        out: list[Finding] = []
+        for name, rule in sorted(code.items()):
+            if rule.kind not in KINDS:
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"rule `{name}` has kind {rule.kind!r} outside the "
+                    f"closed set {sorted(KINDS)}", key=f"kind:{name}"))
+            if not rule.roles:
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"rule `{name}` applies to no role — it can never "
+                    f"evaluate", key=f"roles:{name}"))
+        for name in sorted(set(code) - doc):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"alert rule `{name}` declared in obs/alerts.py but "
+                f"missing from the doc's alert catalog",
+                key=f"undocumented:{name}"))
+        for name in sorted(doc - set(code)):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"alert rule `{name}` documented but absent from "
+                f"obs/alerts.py RULES", key=f"phantom:{name}"))
+        return out

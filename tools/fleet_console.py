@@ -1,0 +1,406 @@
+#!/usr/bin/env python
+"""Live fleet health console over the store-discovered collector.
+
+    python tools/fleet_console.py --store 127.0.0.1:7777 --watch
+    python tools/fleet_console.py --store 127.0.0.1:7777 --snapshot
+    python tools/fleet_console.py --snapshot --format json \
+        --target serving=127.0.0.1:8000
+    python tools/fleet_console.py --offline --run-dir checkpoints/
+
+One screen answering "is the run healthy RIGHT NOW": every trainer
+host and serving replica the fleet registered (elastic
+``publish_obs_endpoint``; no static scrape config), scraped on a
+cadence (obs/collector.py), evaluated against the closed alert-rule
+catalog (obs/alerts.py), rendered as:
+
+- the per-target table — role, generation, staleness state (never /
+  ok / STALE on the collector's own clock), step + steps/s, MFU,
+  goodput, serving TTFT/admission/queue, memory headroom;
+- named rollups: the slowest trainer host and slowest serving replica;
+- active alerts with their ages, values and baselines;
+- the last rewind / restart / capture out of the event journal (when a
+  run dir is at hand).
+
+``--watch`` refreshes in place; ``--snapshot`` renders once (two
+scrape passes so rates exist) — ``--format json`` for CI. ``--offline``
+renders from journals + the perf ledger alone: the post-mortem view of
+the same screen, no live fleet needed.
+
+Alert transitions journal under the ``alert`` event category (a
+timeline_report landmark), and can additionally go to ``--alert-file``
+(JSONL) / ``--alert-webhook`` (POST). ``--profile-on-alert`` lets a
+firing anomaly rule open a managed-profiler capture on the offending
+target via its own ``POST /profile`` route, cooldown-limited.
+
+Pure stdlib + the repo's obs package; no jax import — safe on a login
+host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
+from pytorch_distributed_train_tpu.obs.alerts import (  # noqa: E402
+    RULES,
+    AlertEngine,
+)
+from pytorch_distributed_train_tpu.obs.collector import FleetCollector  # noqa: E402
+
+
+def _gb(n) -> str:
+    return f"{n / 2**30:.1f}G" if isinstance(n, (int, float)) else "-"
+
+
+def _num(v, fmt="{:.2f}") -> str:
+    return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+
+def _serving_cell(row: dict) -> str:
+    if row["role"] != "serving":
+        return "-"
+    ttft = row.get("ttft_p95_s")
+    if ttft is None:
+        ttft = (row.get("ttft_rolling") or {}).get("p95")
+    parts = []
+    if ttft is not None:
+        parts.append(f"ttft_p95 {1e3 * ttft:.0f}ms")
+    if row.get("admission"):
+        parts.append(str(row["admission"]))
+    if row.get("queue_depth") is not None:
+        parts.append(f"q={row['queue_depth']}")
+    return " ".join(parts) or "-"
+
+
+def render_snapshot(snap: dict, alerts: list[dict],
+                    last_events: dict | None = None) -> str:
+    rows = snap["targets"]
+    states = [r["state"] for r in rows]
+    head = (f"== fleet console: {len(rows)} target(s) "
+            f"({states.count('ok')} ok, {states.count('stale')} stale, "
+            f"{states.count('never')} never-scraped); "
+            f"{len(alerts)} alert(s) firing ==")
+    lines = [head,
+             f"  {'host':<10} {'role':<8} {'gen':>3} {'state':<6} "
+             f"{'age':>6} {'step':>7} {'steps/s':>8} {'mfu%':>6} "
+             f"{'goodput%':>8}  serving"]
+    for r in rows:
+        state = r["state"].upper() if r["state"] != "ok" else "ok"
+        age = f"{r['age_s']:.1f}s" if r["age_s"] is not None else "-"
+        lines.append(
+            f"  {r['host']:<10} {r['role']:<8} {r['gen']:>3} {state:<6} "
+            f"{age:>6} {_num(r['step'], '{:.0f}'):>7} "
+            f"{_num(r['steps_per_s']):>8} {_num(r['mfu_pct']):>6} "
+            f"{_num(r['goodput_pct'], '{:.1f}'):>8}  {_serving_cell(r)}")
+        mem = r.get("memory") or {}
+        extras = []
+        if "host_available_bytes" in mem:
+            extras.append(f"avail {_gb(mem['host_available_bytes'])}")
+        if "host_rss_bytes" in mem:
+            extras.append(f"rss {_gb(mem['host_rss_bytes'])}")
+        if mem.get("device_bytes_limit"):
+            frac = mem.get("device_bytes_in_use", 0) / mem[
+                "device_bytes_limit"]
+            extras.append(f"dev {100 * frac:.0f}%")
+        if r.get("restarts"):
+            extras.append(f"restarts {r['restarts']}")
+        split = r.get("input_split") or {}
+        if split and sum(split.values()):
+            top = max(split, key=split.get)
+            extras.append(
+                f"input {top} "
+                f"{100 * split[top] / sum(split.values()):.0f}%")
+        tiers = {k: v for k, v in (r.get("ckpt_tiers") or {}).items() if v}
+        if tiers:
+            extras.append("ckpt " + ",".join(
+                f"{t}={int(n)}" for t, n in sorted(tiers.items())))
+        if r.get("error") and r["state"] != "ok":
+            extras.append(f"err {r['error']}")
+        if extras:
+            lines.append(" " * 13 + "· " + "  ".join(extras))
+    if snap.get("slowest_serving"):
+        lines.append(f"  slowest serving replica: "
+                     f"{snap['slowest_serving']}")
+    if snap.get("slowest_trainer"):
+        lines.append(f"  slowest trainer: {snap['slowest_trainer']}")
+    if alerts:
+        lines.append(f"  alerts ({len(alerts)} firing):")
+        for a in alerts:
+            val = (f" value={a['value']:.4g}"
+                   if isinstance(a["value"], (int, float)) else "")
+            base = (f" baseline={a['baseline']:.4g}"
+                    if isinstance(a["baseline"], (int, float)) else "")
+            lines.append(f"    FIRING {a['rule']:<22} {a['host']:<10} "
+                         f"for {a['for_s']:.1f}s{val}{base}")
+    else:
+        lines.append("  alerts: none firing")
+    if last_events:
+        lines.append("  last: " + "  ".join(
+            f"{k}={v}" for k, v in last_events.items()))
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ journal bits
+def _last_events(events: list[dict]) -> dict:
+    """The operator's first three questions, from the journal."""
+    out = {}
+    for label, pred in (
+            ("rewind", lambda e: e.get("category") == "sentinel"
+             and e.get("name") == "rewind"),
+            ("restart", lambda e: e.get("category") == "elastic"
+             and e.get("name") in ("restart", "spawn")),
+            ("capture", lambda e: e.get("category") == "profile"
+             and e.get("name") == "capture_end"),
+    ):
+        hit = next((e for e in reversed(events) if pred(e)), None)
+        out[label] = ("-" if hit is None else
+                      f"{hit.get('name')}@step{hit.get('step')}"
+                      f"[{hit.get('host')}]")
+    return out
+
+
+def offline_report(run_dir: str, events_dir: str = "",
+                   ledger_path: str = "") -> str:
+    """The same screen, from artifacts alone (journals + perf ledger):
+    what was firing when the run died, which hosts wrote last."""
+    from pytorch_distributed_train_tpu.obs.events import load_events
+
+    events_dir = events_dir or os.path.join(run_dir, "events")
+    events = load_events(events_dir) if os.path.isdir(events_dir) else []
+    lines = [f"== fleet console (offline): {events_dir} "
+             f"({len(events)} journaled events) =="]
+    # per-writer last word
+    writers: dict[str, dict] = {}
+    for e in events:
+        writers[e.get("host", "?")] = e
+    for host, e in sorted(writers.items()):
+        lines.append(f"  {host:<10} last: {e.get('category')}."
+                     f"{e.get('name')} step={e.get('step')} "
+                     f"g{e.get('gen')}")
+    # alert replay: fired without a later resolved = was firing at EOJ
+    active: dict[tuple, dict] = {}
+    fired = 0
+    for e in events:
+        if e.get("category") != "alert":
+            continue
+        d = e.get("detail") or {}
+        key = (d.get("rule"), d.get("host"))
+        if e.get("name") == "fired":
+            fired += 1
+            active[key] = e
+        elif e.get("name") == "resolved":
+            active.pop(key, None)
+    lines.append(f"  alerts: {fired} fired over the journal; "
+                 f"{len(active)} still firing at end")
+    for (rule, host), e in sorted(active.items(),
+                                  key=lambda kv: kv[1].get("ts", 0.0)):
+        d = e.get("detail") or {}
+        lines.append(f"    UNRESOLVED {rule} on {host} "
+                     f"value={d.get('value')} (gen {d.get('gen')})")
+    lines.append("  " + "  ".join(
+        f"last {k}: {v}" for k, v in _last_events(events).items()))
+    ledger_path = ledger_path or os.path.join(run_dir, "perf_ledger.jsonl")
+    if os.path.exists(ledger_path):
+        last = None
+        try:
+            with open(ledger_path) as f:
+                for line in f:
+                    try:
+                        last = json.loads(line)
+                    except ValueError:
+                        continue
+        except OSError:
+            last = None
+        if last:
+            lines.append(
+                f"  perf ledger: last row {last.get('metric', '?')}="
+                f"{last.get('value')} mfu={last.get('mfu_pct')} "
+                f"({ledger_path})")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- wiring
+def _store_factory(addr: str):
+    host, _, port = addr.rpartition(":")
+
+    def factory():
+        from pytorch_distributed_train_tpu.native.store import StoreClient
+
+        return StoreClient(host or "127.0.0.1", int(port))
+
+    return factory
+
+
+def build(args) -> tuple[FleetCollector, AlertEngine]:
+    endpoints = []
+    for i, spec in enumerate(args.target or ()):
+        role, _, addr = spec.partition("=")
+        if not addr:
+            raise SystemExit(f"--target wants role=host:port, got {spec!r}")
+        endpoints.append({"role": role, "addr": addr,
+                          "host": f"static{i}", "gen": "0", "idx": i})
+    store_addr = args.store or os.environ.get("TPUSTORE_ADDR", "")
+    factory = (_store_factory(store_addr) if store_addr
+               else (lambda: None))
+    collector = FleetCollector(
+        store_factory=factory, endpoints=endpoints,
+        poll_s=args.interval, stale_after_s=args.stale_after,
+        timeout_s=args.timeout)
+    overrides = {}
+    for spec in args.rule or ():
+        key, _, value = spec.partition("=")
+        if not value:
+            raise SystemExit(f"--rule wants rule.field=value, got {spec!r}")
+        overrides[key] = value
+    engine = AlertEngine(
+        sink_path=args.alert_file, webhook_url=args.alert_webhook,
+        profile_on_alert=args.profile_on_alert,
+        profile_cooldown_s=args.profile_cooldown,
+        overrides=overrides)
+    return collector, engine
+
+
+_EVENTS_CACHE: dict = {"sig": None, "events": []}
+
+
+def _events_for_console(args) -> list[dict]:
+    """Journal for the last-events line, cached by (path, size)
+    signature: --watch calls this every refresh tick, and re-parsing a
+    long multi-host run's whole journal several times a second would
+    make each refresh slower than the interval."""
+    events_dir = args.events or (os.path.join(args.run_dir, "events")
+                                 if args.run_dir else
+                                 os.environ.get(events_lib.ENV_VAR, ""))
+    if not events_dir or not os.path.isdir(events_dir):
+        return []
+    import glob
+
+    sig = tuple(sorted(
+        (p, os.path.getsize(p))
+        for p in glob.glob(os.path.join(events_dir, "events_*.jsonl"))))
+    if sig != _EVENTS_CACHE["sig"]:
+        from pytorch_distributed_train_tpu.obs.events import load_events
+
+        _EVENTS_CACHE["sig"] = sig
+        _EVENTS_CACHE["events"] = load_events(events_dir)
+    return _EVENTS_CACHE["events"]
+
+
+def tick(collector: FleetCollector, engine: AlertEngine) -> dict:
+    """One console heartbeat: scrape, evaluate, snapshot."""
+    collector.poll()
+    engine.evaluate(collector)
+    return collector.snapshot()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--store", default="",
+                   help="launcher store host:port (default: "
+                        "$TPUSTORE_ADDR) for endpoint discovery")
+    p.add_argument("--target", action="append", metavar="ROLE=HOST:PORT",
+                   help="static scrape target (repeatable; supplements "
+                        "store discovery)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="scrape cadence seconds (--watch refresh)")
+    p.add_argument("--stale-after", type=float, default=10.0,
+                   help="seconds of scrape silence before a "
+                        "previously-seen target counts stale")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="per-scrape HTTP timeout")
+    p.add_argument("--watch", action="store_true",
+                   help="refresh the console in place until ^C")
+    p.add_argument("--snapshot", action="store_true",
+                   help="two scrape passes, render once, exit (CI)")
+    p.add_argument("--rounds", type=int, default=2,
+                   help="scrape passes for --snapshot (>=2 so "
+                        "steps/s and rate series exist)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--offline", action="store_true",
+                   help="render from journals + perf ledger alone "
+                        "(no scraping; needs --run-dir)")
+    p.add_argument("--run-dir", default="",
+                   help="run directory (events/ + perf_ledger.jsonl "
+                        "for --offline and the last-events line)")
+    p.add_argument("--events", default="",
+                   help="explicit events directory")
+    p.add_argument("--alert-file", default="",
+                   help="append alert transitions to this JSONL file")
+    p.add_argument("--alert-webhook", default="",
+                   help="POST alert transitions to this URL")
+    p.add_argument("--profile-on-alert", action="store_true",
+                   help="firing anomaly rules POST /profile on the "
+                        "offending target (cooldown-limited)")
+    p.add_argument("--profile-cooldown", type=float, default=300.0,
+                   help="min seconds between alert-triggered captures")
+    p.add_argument("--rule", action="append", metavar="RULE.FIELD=VALUE",
+                   help="override a rule knob, e.g. "
+                        "ttft_regression.min_samples=4 (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the closed alert-rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for name, r in sorted(RULES.items()):
+            print(f"{name:<22} {r.kind:<10} roles={','.join(r.roles)}  "
+                  f"{r.description}")
+        return 0
+    if args.offline:
+        if not args.run_dir and not args.events:
+            print("fleet_console: --offline needs --run-dir or --events",
+                  file=sys.stderr)
+            return 2
+        print(offline_report(args.run_dir, args.events))
+        return 0
+    if not (args.store or os.environ.get("TPUSTORE_ADDR")
+            or args.target):
+        print("fleet_console: no targets (--store, $TPUSTORE_ADDR or "
+              "--target)", file=sys.stderr)
+        return 2
+    collector, engine = build(args)
+    # alert events journal beside the run when a dir is at hand
+    events_dir = args.events or (os.path.join(args.run_dir, "events")
+                                 if args.run_dir else
+                                 os.environ.get(events_lib.ENV_VAR))
+    if events_dir:
+        events_lib.configure(events_dir, who="fleet")
+    try:
+        if args.watch:
+            while True:
+                snap = tick(collector, engine)
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear, home
+                print(render_snapshot(snap, engine.firing(),
+                                      _last_events(
+                                          _events_for_console(args))
+                                      if (args.run_dir or args.events)
+                                      else None))
+                sys.stdout.flush()
+                time.sleep(collector.poll_s)
+        else:
+            snap = None
+            for i in range(max(1, args.rounds)):
+                if i:
+                    time.sleep(min(collector.poll_s, 0.5))
+                snap = tick(collector, engine)
+            if args.format == "json":
+                out = json.dumps(dict(snap, alerts=engine.firing()),
+                                 indent=2, sort_keys=True)
+            else:
+                out = render_snapshot(
+                    snap, engine.firing(),
+                    _last_events(_events_for_console(args))
+                    if (args.run_dir or args.events) else None)
+            print(out)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -390,15 +390,31 @@ def report(jsonl_path: str, trace_path: str = "",
            events_dir: str = "", traces_dir: str = "") -> str:
     recs = load_jsonl(jsonl_path)
     lines = [f"== run report: {jsonl_path} ({len(recs)} records) =="]
-    events = _load_events(events_dir)
-    for section in (goodput_section(recs), trend_section(recs),
-                    perf_section(recs, events),
-                    input_section(recs),
-                    straggler_section(recs),
-                    spans_section(trace_path),
-                    events_section(events_dir, events),
-                    serving_section(events_dir, events),
-                    traces_section(traces_dir)):
+    try:
+        events = _load_events(events_dir)
+    except Exception:
+        events = None
+    # Sections are INDEPENDENT by contract (pinned in
+    # tests/test_obs_report.py): one malformed source — a trace.json
+    # that parses but isn't the expected shape, a journal record with
+    # a non-numeric field — degrades to a one-line note for ITS
+    # section instead of suppressing everything after it. A report
+    # tool that dies on a crashed run's artifacts defeats its purpose.
+    for name, build in (
+            ("goodput", lambda: goodput_section(recs)),
+            ("step-time", lambda: trend_section(recs)),
+            ("perf", lambda: perf_section(recs, events)),
+            ("input pipeline", lambda: input_section(recs)),
+            ("stragglers", lambda: straggler_section(recs)),
+            ("spans", lambda: spans_section(trace_path)),
+            ("events", lambda: events_section(events_dir, events)),
+            ("serving", lambda: serving_section(events_dir, events)),
+            ("traces", lambda: traces_section(traces_dir))):
+        try:
+            section = build()
+        except Exception as e:
+            section = [f"{name}: unrenderable source "
+                       f"({type(e).__name__}: {e})"]
         if not section:
             continue
         lines.append("")

@@ -1638,7 +1638,9 @@ def main(argv=None) -> int:
     drain.install()
     if args.advertise:
         from pytorch_distributed_train_tpu.elastic import (
+            publish_obs_endpoint,
             publish_replica,
+            routable_host,
             worker_store,
         )
 
@@ -1649,18 +1651,16 @@ def main(argv=None) -> int:
         else:
             # a wildcard bind is unconnectable from peers: advertise a
             # routable address instead
-            host = args.host
-            if host in ("", "0.0.0.0", "::"):
-                import socket as _socket
-
-                try:
-                    host = _socket.gethostbyname(_socket.gethostname())
-                except OSError:
-                    host = _socket.gethostname()
-            idx = publish_replica(
-                store, f"{host}:{server.server_address[1]}")
-            print(f"serve_http: advertised as replica {idx} "
-                  f"({host}:{server.server_address[1]})", flush=True)
+            addr = (f"{routable_host(args.host)}:"
+                    f"{server.server_address[1]}")
+            idx = publish_replica(store, addr)
+            # ... and the same address into the obs-endpoint registry,
+            # so the fleet collector scrapes this replica's /metrics +
+            # /healthz without static config (docs/observability.md
+            # "Fleet health plane").
+            publish_obs_endpoint(store, "serving", addr)
+            print(f"serve_http: advertised as replica {idx} ({addr})",
+                  flush=True)
     print(f"serving on http://{args.host}:{server.server_address[1]} "
           f"(slots={args.slots})", flush=True)
     try:

@@ -54,6 +54,7 @@ _MARKS = {
     "preempt": "PREEMPT",
     "serve": "SERVE",
     "perf": "PERF",
+    "alert": "ALERT",
     "lifecycle": "",
     "ckpt": "",
 }
@@ -87,6 +88,10 @@ _LANDMARKS = _RECOVERIES | {
     ("serve", "replica_down"),
     ("serve", "rolling_drain"),
     ("serve", "tail_latency"),
+    # fleet alert-rule transitions (obs/alerts.py): a rule firing or
+    # resolving is exactly the run-shape news the timeline exists for
+    ("alert", "fired"),
+    ("alert", "resolved"),
 }
 
 
@@ -186,6 +191,45 @@ def causal_chains(events: list[dict]) -> list[str]:
                      f"{_fmt_detail(recovery.get('detail') or {}, 32)}")
         else:
             line += " -> no recovery event"
+        out.append(line)
+    return out
+
+
+def alert_chains(events: list[dict]) -> list[str]:
+    """The fleet-plane analogue of ``causal_chains``: each journaled
+    alert FIRE paired with the capture it requested on the offending
+    target (``alert``/``profile_requested``, obs/alerts.py
+    profile_on_alert) and the RESOLVE that closed it — the
+    alert→capture→resolve story of an incident. Empty-journal quiet."""
+    fires = [e for e in events if e.get("category") == "alert"
+             and e.get("name") == "fired"]
+    if not fires:
+        return []
+    out = [f"alert chains ({len(fires)}):"]
+    for a in fires:
+        d = a.get("detail") or {}
+        rule, host = d.get("rule"), d.get("host")
+        ts = a.get("ts", 0.0)
+
+        def _next(name, a_d=d, ts=ts):
+            return next(
+                (e for e in events
+                 if e.get("category") == "alert" and e.get("name") == name
+                 and (e.get("detail") or {}).get("rule") == a_d.get("rule")
+                 and (e.get("detail") or {}).get("host") == a_d.get("host")
+                 and e.get("ts", 0.0) >= ts), None)
+
+        line = f"  {rule} FIRED on {host} (value={d.get('value')})"
+        capture = _next("profile_requested")
+        if capture is not None:
+            line += (" -> capture requested (status "
+                     f"{(capture.get('detail') or {}).get('status')})")
+        resolved = _next("resolved")
+        if resolved is not None:
+            rd = resolved.get("detail") or {}
+            line += f" -> resolved after {rd.get('after_s')}s"
+        else:
+            line += " -> still firing at journal end"
         out.append(line)
     return out
 
@@ -395,7 +439,10 @@ def report(events_dir: str, jsonl_path: str = "",
     events = load_events(events_dir)
     lines = [f"== run timeline: {events_dir} =="]
     for section in (counts_section(events), goodput_line(jsonl_path),
-                    timeline_lines(events), causal_chains(events)):
+                    timeline_lines(events), causal_chains(events),
+                    alert_chains(events)):
+        if not section:
+            continue
         lines.append("")
         lines.extend(section)
     return "\n".join(lines)
