@@ -492,6 +492,16 @@ def pipeline_decode_bench(args) -> None:
     }
     if mp_used:
         record["mp_workers"] = mp_used
+    per_worker = getattr(loader, "decode_threads_per_worker", 0)
+    if per_worker:
+        # Ledger note for the pil_grain_mp8 regression fix (ISSUE 14
+        # satellite): the per-worker PIL decode-thread clamp is part of
+        # this row's identity — rows before/after the clamp must be
+        # tellable apart in the trajectory.
+        record["decode_threads_per_worker"] = per_worker
+        record["note"] = ("mp+grain item decode: per-worker PIL pool "
+                          "clamped to the host core share "
+                          "(workers.python_thread_budget)")
     # Staged attribution (obs/perf.py): which stage of the decode
     # pipeline the wall went to — the per-stage view of the host wall.
     from pytorch_distributed_train_tpu.obs import perf as perf_lib
@@ -1085,7 +1095,47 @@ def main() -> None:
                         "on Mosaic kernels (ops/attention.py _pallas_usable). "
                         "'chunked' is the pure-XLA flash-style path: O(S* "
                         "chunk) memory, compiles everywhere.")
+    # ---- ISSUE 14 compute-graph arms (each encodes into the metric
+    # name -> fresh ledger trajectory; never seeds a canonical baseline)
+    p.add_argument("--grad-accum", type=int, default=0, metavar="N",
+                   help="microbatched train step: lax.scan over N "
+                        "microbatches with accumulated grads "
+                        "(train.grad_accum_steps; metric gains _gaN)")
+    p.add_argument("--overlap-collectives", action="store_true",
+                   help="shard_map DP step with per-bucket grad pmeans "
+                        "inside the accumulation scan + the latency-"
+                        "hiding XLA flag preset (metric gains _overlap)")
+    p.add_argument("--grad-bucket-mb", type=int, default=25,
+                   help="bucket cap for --overlap-collectives (DDP "
+                        "bucket_cap_mb analogue)")
+    p.add_argument("--fused-epilogue", action="store_true",
+                   help="one-pass fused clip+update+gate epilogue "
+                        "(ops/fused_update.py; metric gains _fusedep). "
+                        "Needs an adamw/adam/sgd/momentum optimizer — "
+                        "combine with --optimizer for lamb/adafactor "
+                        "presets")
     args = p.parse_args()
+
+    if args.overlap_collectives:
+        # Scheduler preset must be in XLA_FLAGS before the FIRST jax
+        # import in this process (config.py is jax-free). TPU backends
+        # only — XLA:CPU/GPU reject unknown --xla_tpu_* flags FATALLY —
+        # so gate on the platform actually resolving to TPU: an
+        # explicit JAX_PLATFORMS naming tpu, or no request at all on a
+        # host with libtpu installed (jax's default pick). A CPU smoke
+        # of this arm still runs; it measures collective PLACEMENT,
+        # not overlap.
+        import importlib.util
+
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        tpu_backend = "tpu" in plat or (
+            plat == "" and importlib.util.find_spec("libtpu") is not None)
+        if tpu_backend:
+            from pytorch_distributed_train_tpu.config import (
+                ensure_latency_hiding_flags,
+            )
+
+            ensure_latency_hiding_flags()
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # The env var alone does not stick on hosts whose sitecustomize
@@ -1203,9 +1253,26 @@ def main() -> None:
 
     _touch()  # backend import + arg setup done
     model = build_model(model_cfg, PrecisionConfig(compute_dtype="bfloat16"))
-    tx, _ = make_optimizer(opt, total_steps=1000)
+    tx, lr_sched = make_optimizer(opt, total_steps=1000)
     rules = rules_for_model(args.model)
     seq = model_cfg.max_seq_len
+
+    if args.overlap_collectives and args.offload_opt:
+        # Same refusal as the trainer's: the shard_map step cannot
+        # stage pinned-host opt state (an obscure sharding error — or a
+        # meaningless measurement — otherwise).
+        raise SystemExit("--overlap-collectives + --offload-opt is "
+                         "unsupported (shard_map cannot stage host-"
+                         "memory opt state)")
+
+    fused_update = None
+    if args.fused_epilogue:
+        from pytorch_distributed_train_tpu.optim import make_fused_update
+
+        # Raises with the reason for inexpressible optimizers (lamb/
+        # adafactor presets) — same loud-knob convention as
+        # --quant-training; pair with --optimizer to fuse those benches.
+        fused_update = make_fused_update(opt, lr_sched)
 
     tgt_seq = seq // 4 if args.model == "t5" else 0  # t5_small's 512/128
 
@@ -1234,13 +1301,35 @@ def main() -> None:
         sharding = steps_lib.offload_state_shardings(sharding)
     state = jax.jit(init_state, out_shardings=sharding)(rng)
     _touch()  # state materialized on device
-    train_step = steps_lib.make_train_step(model, get_loss_fn(loss_name), tx)
+    accum = max(args.grad_accum, 1)
+    reduce_grads = reduce_metrics = None
+    n_buckets = 0
+    if args.overlap_collectives:
+        reduce_grads, buckets = steps_lib.overlap_grad_reducer(
+            shape.params, max(args.grad_bucket_mb, 1), ("data", "fsdp"))
+        reduce_metrics = steps_lib.metrics_reducer(("data", "fsdp"))
+        n_buckets = len(buckets)
+    train_step = steps_lib.make_train_step(
+        model, get_loss_fn(loss_name), tx, grad_accum_steps=accum,
+        fused_update=fused_update, reduce_grads=reduce_grads,
+        reduce_metrics=reduce_metrics)
     if args.offload_opt:
         train_step = steps_lib.offload_opt_state(
             train_step, opt_dev_sharding, sharding.opt_state)
-    step = steps_lib.jit_train_step(train_step, mesh, sharding)
+    if args.overlap_collectives:
+        step = steps_lib.jit_overlap_train_step(train_step, mesh, sharding)
+    else:
+        step = steps_lib.jit_train_step(train_step, mesh, sharding)
 
     global_batch = bpc * n_chips
+    # Under --overlap-collectives the scan splits each SHARD's batch
+    # (batch axes data x fsdp = n_chips here), not the global one.
+    accum_unit = bpc if args.overlap_collectives else global_batch
+    if accum_unit % accum:
+        raise SystemExit(
+            f"--grad-accum {accum} does not divide the "
+            f"{'per-shard' if args.overlap_collectives else 'global'} "
+            f"batch {accum_unit}")
     rng_np = np.random.default_rng(0)
     if vision:
         batch = {
@@ -1304,11 +1393,21 @@ def main() -> None:
     # bert carries an explicit _mlm tag: the round-1 key measured plain
     # next-token xent and must never be compared against the MLM workload.
     bench_name = "bert_base_mlm" if args.model == "bert_base" else args.model
-    metric = f"{bench_name}_{unit_noun}_per_sec_per_chip"
+    # Compute-graph arms encode into the metric name (PR 12 convention:
+    # each arm owns its ledger trajectory; the gate never cross-judges).
+    arm_parts = []
+    if accum > 1:
+        arm_parts.append(f"ga{accum}")
+    if args.overlap_collectives:
+        arm_parts.append("overlap")
+    if args.fused_epilogue:
+        arm_parts.append("fusedep")
+    arm_sfx = ("_" + "_".join(arm_parts)) if arm_parts else ""
+    metric = f"{bench_name}{arm_sfx}_{unit_noun}_per_sec_per_chip"
     # Only canonical shapes may seed a baseline key — smoke runs with
     # non-default shapes must not (BASELINE.md policy).
     default_opt = (not args.optimizer and not args.moment_dtype
-                   and not args.offload_opt)
+                   and not args.offload_opt and not arm_parts)
     if vision:
         # resnet50 is the north-star; vit_b16 also tracks its own key so
         # regressions there are visible across rounds (resnet18 stays a
@@ -1358,6 +1457,13 @@ def main() -> None:
         "goodput_pct": round(
             100.0 * wall / max(time.monotonic() - _T_MAIN0[0], 1e-9), 2),
     }
+    if accum > 1:
+        record["grad_accum_steps"] = accum
+    if args.overlap_collectives:
+        record["grad_buckets"] = n_buckets
+        record["grad_bucket_mb"] = args.grad_bucket_mb
+    if args.fused_epilogue:
+        record["fused_epilogue"] = True
     from pytorch_distributed_train_tpu.obs import perf as perf_lib
 
     # Synthetic device batches: the stall split is usually empty — a

@@ -138,6 +138,16 @@ class ModelConfig:
     # "I understand the Zhou et al. caveat" opt-in.
     moe_router_allow_noncausal: bool = False
     moe_zloss_weight: float = 1e-3
+    # Fused elementwise block epilogues (ops/fused_update.py; vit/bert):
+    # the bias+GELU MLP epilogue and (post-LN bert) the residual-add+
+    # LayerNorm epilogue compute as single tagged expressions XLA keeps
+    # in one elementwise kernel, and the tag ("fused_epilogue",
+    # jax.ad_checkpoint.checkpoint_name) gives remat a handle — policy
+    # "no_fused_epilogue" (models/remat.py) recomputes exactly these
+    # cheap chains in backward instead of saving them. Param tree and
+    # numerics are unchanged (same names, same math, same fp32 norms);
+    # the knob exists so the A/B is one config flip.
+    fused_epilogues: bool = False
     # AQT-style int8 quantized TRAINING ("" | "int8"; llama/llama_pp/gpt2):
     # attention + MLP matmuls run int8×int8→int32 on the MXU (2× bf16
     # MACs/cycle on v5e) with dynamic symmetric absmax scales and a
@@ -402,6 +412,81 @@ class MeshConfig:
     # between TP matmuls (norms/residuals run seq-sharded; GSPMD inserts
     # the all-gather/reduce-scatter pair at the matmul boundaries).
     sequence_parallel: bool = False
+
+
+# XLA flag preset for the overlapped-collectives path (steps.py
+# re-exports; bench.py/train.py apply it to XLA_FLAGS before the first
+# jax import): the latency-hiding scheduler + async collective fusion
+# are what let the per-bucket in-scan reductions actually overlap the
+# next microbatch's compute instead of serializing after it. Defined
+# here (jax-free module) so host-side entrypoints can set the env
+# without importing a backend.
+LATENCY_HIDING_XLA_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true "
+    "--xla_tpu_enable_async_collective_fusion=true "
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true "
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true "
+    "--xla_tpu_overlap_compute_collective_tc=true"
+)
+
+
+def ensure_latency_hiding_flags(env=None) -> bool:
+    """Append the scheduler preset to XLA_FLAGS unless already present.
+    Returns True when the env was modified. Only effective if called
+    before the first jax backend initialization — which is why it lives
+    HERE (jax-free module) and not in steps.py: entrypoints import this
+    before any backend-registering import. TPU backends only — XLA:CPU
+    rejects unknown ``--xla_tpu_*`` flags fatally, so callers gate on
+    the resolved platform (see bench.py)."""
+    import os
+
+    env = env if env is not None else os.environ
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_tpu_enable_latency_hiding_scheduler" in flags:
+        return False
+    env["XLA_FLAGS"] = (flags + " " + LATENCY_HIDING_XLA_FLAGS).strip()
+    return True
+
+
+@dataclass
+class TrainStepConfig:
+    """Compute-graph optimization layer for the train step (steps.py +
+    ops/fused_update.py; docs/performance.md "Compute side"). All knobs
+    default off — the single-shot GSPMD step is the reference program
+    and every knob here is measured against it."""
+
+    # Microbatched train step: lax.scan over N microbatches inside ONE
+    # donated step executable, grads accumulated in the carry and the
+    # (clip → update → gate) epilogue applied once on the accumulated
+    # mean — the activation-memory/overlap twin of optim.accum_steps
+    # (optax.MultiSteps), which instead runs N separate host-driven
+    # micro-steps. 1 = off. Mutually exclusive with optim.accum_steps>1
+    # (both would compound). The global batch must divide by it; LR
+    # schedules count optimizer steps as before (one scan = one step).
+    grad_accum_steps: int = 1
+    # Overlapped gradient collectives (the DDP-reducer analogue, SURVEY
+    # C7/[TORCH] reducer.hpp:285): run the step under shard_map over the
+    # batch axes and issue per-BUCKET grad reductions inside the
+    # accumulation scan — microbatch i's collectives overlap microbatch
+    # i+1's compute under XLA's latency-hiding scheduler
+    # (steps.LATENCY_HIDING_XLA_FLAGS). Requires params/opt state
+    # replicated over the batch axes (pure DP or mesh.zero_stage=1
+    # layouts); refused loudly otherwise.
+    overlap_collectives: bool = False
+    # Bucket size for the per-bucket reductions, mirroring DDP's
+    # bucket_cap_mb=25 default; buckets fill in REVERSE parameter order
+    # (the order backward produces grads — reducer semantics).
+    grad_bucket_mb: int = 25
+    # Fused optimizer epilogue (ops/fused_update.py): clip-by-global-
+    # norm + optimizer update + non-finite gate computed in ONE pass
+    # over the grad tree (per-leaf select against the old state) instead
+    # of the chain's three passes plus the gate's whole-tree two-branch
+    # select. Numerically identical to the optax chain — which remains
+    # the reference oracle (tests pin fused == chain bit-for-bit,
+    # LR-cooldown leaf included); configs the fast path cannot express
+    # (plateau, layer_lr_decay, grad hooks, exotic optimizers) are
+    # refused loudly rather than silently falling back.
+    fused_epilogue: bool = False
 
 
 @dataclass
@@ -724,6 +809,10 @@ class TrainConfig:
     sentinel: SentinelConfig = field(default_factory=SentinelConfig)
     lora: LoraConfig = field(default_factory=LoraConfig)
     distill: DistillConfig = field(default_factory=DistillConfig)
+    # Compute-graph optimization layer (steps.py / ops/fused_update.py):
+    # microbatched scan step, overlapped bucketed collectives, fused
+    # optimizer epilogue. docs/performance.md "Compute side".
+    train: TrainStepConfig = field(default_factory=TrainStepConfig)
     # Train loop horizon: epochs if >0, else total_steps.
     epochs: int = 0
     total_steps: int = 1000
@@ -798,6 +887,7 @@ _SECTIONS = {
     "sentinel": SentinelConfig,
     "lora": LoraConfig,
     "distill": DistillConfig,
+    "train": TrainStepConfig,
 }
 
 

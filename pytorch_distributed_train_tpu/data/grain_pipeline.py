@@ -53,8 +53,14 @@ def _decode_pool():
 
         from pytorch_distributed_train_tpu.data import workers as workers_lib
 
+        # python_thread_budget, NOT process_thread_budget: this pool
+        # runs PIL item decode (GIL-holding Python framing), and the
+        # native budget's x2 I/O allowance composed pathologically with
+        # data.mp_workers — N forked workers x 2x-their-core-share PIL
+        # threads oversubscribed the host into the LKG pil_grain_mp8
+        # regression (424 vs 444 img/s; ISSUE 14 satellite).
         _DECODE_POOL = (os.getpid(), ThreadPoolExecutor(
-            max_workers=workers_lib.process_thread_budget(
+            max_workers=workers_lib.python_thread_budget(
                 min(8, os.cpu_count() or 1)),
             thread_name_prefix="grain-decode"))
     return _DECODE_POOL[1]
@@ -261,6 +267,38 @@ class GrainHostDataLoader:
             if workers_lib.available() else 0)
         self.num_workers = bounded_workers(
             data_cfg.num_workers, pool_budget=self._pool_budget)
+        self.decode_threads_per_worker = 0
+        if self._pool_budget > 0 and getattr(dataset, "is_item_style",
+                                             False):
+            # mp pool + grain ITEM-style decode: each forked worker also
+            # fans out a PIL decode thread pool. Uncapped that composed
+            # pathologically (LKG pil_grain_mp8: 424 img/s vs plain
+            # threads' 444) — workers.python_thread_budget now clamps
+            # each worker to its core share; surface the decision once
+            # (log + gauge) so the throughput math is inspectable.
+            avail = os.cpu_count() or 1
+            per = workers_lib.pool_decode_threads(self.num_workers)
+            self.decode_threads_per_worker = per
+            total = per * self.num_workers
+            from pytorch_distributed_train_tpu.obs.registry import (
+                get_registry,
+            )
+
+            get_registry().gauge(
+                "input_decode_threads", labels={"loader": "grain"},
+                help="PIL decode threads per forked mp pool worker "
+                     "after the core-share clamp").set(per)
+            key = ("decode-threads", self.num_workers, per)
+            if key not in _CLAMP_LOGGED:
+                _CLAMP_LOGGED.add(key)
+                import warnings
+
+                warnings.warn(
+                    f"grain + data.mp_workers item decode: "
+                    f"{self.num_workers} worker(s) x {per} PIL decode "
+                    f"thread(s) = {total} on {avail} host core(s) "
+                    "(per-worker pool clamped to the core share — the "
+                    "pil_grain_mp8 oversubscription fix)")
         self.mp_slots = getattr(data_cfg, "mp_slots", 0)
         self._mp_pool = None
         self.read_buffer = max(2, data_cfg.prefetch)

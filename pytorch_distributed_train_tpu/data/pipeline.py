@@ -78,8 +78,12 @@ def _item_pool(num_workers: int) -> ThreadPoolExecutor:
     if _ITEM_POOL is None or _ITEM_POOL[0] != os.getpid():
         from pytorch_distributed_train_tpu.data import workers as workers_lib
 
+        # python_thread_budget (no x2): PIL item decode holds the GIL
+        # through its Python framing — inside a forked mp worker the
+        # pool clamps to exactly the worker's core share (the LKG
+        # pil_grain_mp8 oversubscription fix, ISSUE 14 satellite).
         _ITEM_POOL = (os.getpid(), ThreadPoolExecutor(
-            max_workers=workers_lib.process_thread_budget(num_workers)))
+            max_workers=workers_lib.python_thread_budget(num_workers)))
     return _ITEM_POOL[1]
 
 

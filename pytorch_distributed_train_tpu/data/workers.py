@@ -84,6 +84,54 @@ def process_thread_budget(solo_threads: int) -> int:
     return max(1, solo_threads)
 
 
+def python_thread_budget(solo_threads: int) -> int:
+    """Per-process thread budget for PYTHON/PIL decode pools — the x2
+    I/O allowance of ``process_thread_budget`` removed.
+
+    The x2 was sized for C++ decode (libjpeg/imgops release the GIL for
+    the whole call); PIL item decode holds the GIL through its Python
+    framing, so N pool workers each running 2x their core share contend
+    instead of overlapping — the LKG ``pil_grain_mp8`` regression (424
+    img/s vs plain threads' 444, ISSUE 14 satellite): 8 forked workers
+    x (2 cores x2 = 4) PIL threads = 32 GIL-bound threads on a 24-core
+    host. Inside a pool worker this clamps to exactly the worker's
+    PDTT_NATIVE_THREADS core share."""
+    env = os.environ.get("PDTT_NATIVE_THREADS")
+    if env:
+        try:
+            return max(1, min(solo_threads, max(1, int(env))))
+        except ValueError:
+            pass
+    return max(1, solo_threads)
+
+
+def worker_core_share(num_workers: int, avail: int | None = None) -> int:
+    """Per-worker core share of the pool: (cpus - 1) split across the
+    workers, floor 1 — THE definition, used both at fork time (the
+    PDTT_NATIVE_THREADS budget each worker runs under) and by the
+    parent-side mirrors that report/warn about it
+    (``pool_decode_threads``, the grain clamp note). One formula so the
+    gauge/ledger identity can never drift from what the workers
+    actually use."""
+    if avail is None:
+        avail = os.cpu_count() or 2
+    return max(1, (avail - 1) // max(num_workers, 1))
+
+
+def pool_decode_threads(num_workers: int, solo_threads: int = 8,
+                        avail: int | None = None) -> int:
+    """The PIL-decode thread count ONE forked pool worker will use —
+    the parent-side mirror of ``python_thread_budget`` under the
+    per-worker core share the pool sets at fork (worker_core_share).
+    Lets loaders/benches report and warn about the total decode fan-out
+    before any worker forks."""
+    if avail is None:
+        avail = os.cpu_count() or 2
+    if num_workers <= 0:
+        return max(1, min(solo_threads, avail))
+    return max(1, min(solo_threads, worker_core_share(num_workers, avail)))
+
+
 def pool_budget(requested: int, avail: int | None = None) -> int:
     """Worker-process budget for the shared-memory pool.
 
@@ -304,8 +352,7 @@ class SharedMemoryWorkerPool:
             # start is done from the consumer side before batches flow.
             warnings.filterwarnings(
                 "ignore", message=".*os.fork.*", category=RuntimeWarning)
-            native_threads = max(
-                1, ((os.cpu_count() or 2) - 1) // self.num_workers)
+            native_threads = worker_core_share(self.num_workers)
             for _ in range(self.num_workers):
                 p = ctx.Process(
                     target=_worker_main,

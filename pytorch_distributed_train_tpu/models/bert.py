@@ -65,9 +65,39 @@ class BertLayer(nn.Module):
     param_dtype: jnp.dtype
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    fused_epilogues: bool = False
 
     @nn.compact
     def __call__(self, x, pad_mask):
+        # Audit-driven fused epilogues (ops/fused_update.py;
+        # model.fused_epilogues): the post-LN block's two residual-add+
+        # LayerNorm chains and the MLP's bias+GELU chain become single
+        # tagged expressions — param names/shapes and numerics identical
+        # to the plain formulation (pinned by tests), the tag feeds the
+        # "no_fused_epilogue" remat policy.
+        if self.fused_epilogues:
+            from pytorch_distributed_train_tpu.ops.fused_update import (
+                FusedDenseGelu,
+                FusedResidualLayerNorm,
+            )
+
+            res_ln = lambda name: FusedResidualLayerNorm(  # noqa: E731
+                epsilon=1e-12, param_dtype=jnp.float32, name=name)
+            attn = BertSelfAttention(
+                self.num_heads, self.dropout_rate, self.dtype,
+                self.param_dtype, cp=self.cp, attn_impl=self.attn_impl,
+                name="attn",
+            )(x, pad_mask, self.deterministic)
+            x = res_ln("ln_attn")(attn, x).astype(self.dtype)
+            h = FusedDenseGelu(self.mlp_dim, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name="mlp_in")(x)
+            h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="mlp_out")(h)
+            h = nn.Dropout(self.dropout_rate)(
+                h, deterministic=self.deterministic)
+            x = res_ln("ln_mlp")(h, x).astype(self.dtype)
+            return x
         ln = lambda name: nn.LayerNorm(  # noqa: E731
             epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32, name=name
         )
@@ -103,6 +133,7 @@ class BertForMLM(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     cp: ContextParallelConfig | None = None
     attn_impl: str = "auto"
+    fused_epilogues: bool = False
     # SP/CP activation anchoring (parallel/mesh.py ActivationSharding)
     act: "object | None" = None
 
@@ -143,15 +174,25 @@ class BertForMLM(nn.Module):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
                 self.dtype, self.param_dtype, cp=self.cp,
-                attn_impl=self.attn_impl, name=f"layer{i}",
+                attn_impl=self.attn_impl,
+                fused_epilogues=self.fused_epilogues, name=f"layer{i}",
             )(x, pad_mask)
             if self.act is not None:
                 x = self.act.constrain(x)
 
         # MLM head: dense + GELU + LN, then decode against tied word embeddings.
-        h = nn.Dense(self.hidden_size, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="mlm_dense")(x)
-        h = nn.gelu(h, approximate=False)  # exact erf GELU (BERT/HF convention)
+        if self.fused_epilogues:
+            from pytorch_distributed_train_tpu.ops.fused_update import (
+                FusedDenseGelu,
+            )
+
+            h = FusedDenseGelu(self.hidden_size, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name="mlm_dense")(x)
+        else:
+            h = nn.Dense(self.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="mlm_dense")(x)
+            h = nn.gelu(h, approximate=False)  # exact erf (BERT/HF convention)
         h = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32,
                          name="mlm_ln")(h)
         # Tied-embedding decode in the compute dtype with fp32 accumulation:
@@ -175,6 +216,7 @@ def bert_base(cfg, dtype, param_dtype, cp=None, act=None) -> BertForMLM:
         cp=cp,
         act=act,
         attn_impl=getattr(cfg, "attention_impl", "auto"),
+        fused_epilogues=getattr(cfg, "fused_epilogues", False),
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
         num_layers=cfg.num_layers,

@@ -14,10 +14,24 @@ from __future__ import annotations
 import jax
 import flax.linen as nn
 
+from pytorch_distributed_train_tpu.ops.fused_update import (
+    FUSED_EPILOGUE_NAME,
+)
+
 POLICIES = {
     "full": None,  # save nothing — recompute the whole block (default)
     "dots": jax.checkpoint_policies.dots_saveable,
     "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    # Audit-driven epilogue dial (ISSUE 14; ops/fused_update.py): save
+    # every intermediate EXCEPT the outputs tagged "fused_epilogue"
+    # (bias+GELU, residual+LayerNorm — model.fused_epilogues). The
+    # expensive MXU work stays resident; only the cheap elementwise
+    # epilogues recompute in backward — the inverse trade of "dots",
+    # aimed at the elementwise rows of `perf_ledger --audit`. Remat
+    # choices stay orthogonal to the fusion itself: any policy runs
+    # over fused or unfused blocks.
+    "no_fused_epilogue": jax.checkpoint_policies.
+    save_anything_except_these_names(FUSED_EPILOGUE_NAME),
 }
 
 

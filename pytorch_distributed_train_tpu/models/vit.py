@@ -23,13 +23,27 @@ class MlpBlock(nn.Module):
     dropout_rate: float
     dtype: jnp.dtype
     param_dtype: jnp.dtype
+    fused_epilogues: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
         d = x.shape[-1]
-        x = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
-                     name="mlp_in")(x)
-        x = nn.gelu(x, approximate=False)  # exact erf (torchvision/HF ViT)
+        if self.fused_epilogues:
+            # Audit-driven bias+GELU epilogue (ops/fused_update.py):
+            # param-compatible with the Dense+gelu pair below, same
+            # exact-erf math, single tagged elementwise chain — the
+            # "no_fused_epilogue" remat policy recomputes it backward.
+            from pytorch_distributed_train_tpu.ops.fused_update import (
+                FusedDenseGelu,
+            )
+
+            x = FusedDenseGelu(self.mlp_dim, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name="mlp_in")(x)
+        else:
+            x = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="mlp_in")(x)
+            x = nn.gelu(x, approximate=False)  # exact erf (torchvision/HF ViT)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         x = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
                      name="mlp_out")(x)
@@ -75,6 +89,7 @@ class EncoderBlock(nn.Module):
     dtype: jnp.dtype
     param_dtype: jnp.dtype
     attn_impl: str = "auto"
+    fused_epilogues: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -87,7 +102,7 @@ class EncoderBlock(nn.Module):
         )(norm("ln1")(x).astype(self.dtype), self.deterministic)
         x = x + MlpBlock(
             self.mlp_dim, self.dropout_rate, self.dtype, self.param_dtype,
-            name="mlp",
+            fused_epilogues=self.fused_epilogues, name="mlp",
         )(norm("ln2")(x).astype(self.dtype), self.deterministic)
         return x
 
@@ -103,10 +118,12 @@ class ViT(nn.Module):
     mlp_dim: int = 3072
     dropout_rate: float = 0.0
     remat: bool = False
-    remat_policy: str = "full"  # full | dots | dots_no_batch (models/remat.py)
+    remat_policy: str = "full"  # full | dots | dots_no_batch |
+    #                             no_fused_epilogue (models/remat.py)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     attn_impl: str = "auto"
+    fused_epilogues: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -138,6 +155,7 @@ class ViT(nn.Module):
             x = block_cls(
                 self.num_heads, self.mlp_dim, self.dropout_rate, deterministic,
                 self.dtype, self.param_dtype, attn_impl=self.attn_impl,
+                fused_epilogues=self.fused_epilogues,
                 name=f"block{i}",
             )(x)
 
@@ -155,6 +173,7 @@ def vit_b16(cfg, dtype, param_dtype, cp=None) -> ViT:
     del cp  # patch-seq CP not useful at ViT scale (197 tokens)
     return ViT(
         attn_impl=getattr(cfg, "attention_impl", "auto"),
+        fused_epilogues=getattr(cfg, "fused_epilogues", False),
         num_classes=cfg.num_classes,
         patch_size=cfg.patch_size,
         hidden_size=cfg.hidden_size,

@@ -47,6 +47,7 @@ import datetime
 import hashlib
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -538,6 +539,104 @@ def kernel_gap(mfu_pct: float, opclass_ms: dict[str, float] | None
     return out
 
 
+# Op-class → the concrete lever in THIS repo that closes it: the audit
+# names where the roofline gap lives; the worklist names what to flip.
+# Closed over the classifier's vocabulary (utils.xplane) + the audit's
+# synthetic 'unattributed' row.
+FUSION_SUGGESTIONS = {
+    "elementwise": ("fuse block epilogues: model.fused_epilogues "
+                    "(bias+GELU / residual+LayerNorm, ops/"
+                    "fused_update.py) + train.fused_epilogue "
+                    "(one-pass clip+update+gate optimizer epilogue)"),
+    "collective": ("overlap grad reductions: train.overlap_collectives "
+                   "+ train.grad_bucket_mb (bucketed in-scan pmeans) "
+                   "with the latency-hiding scheduler preset"),
+    "infeed": ("input pipeline: data.mp_workers / packed_cache_dir / "
+               "device_augment (docs/performance.md, input side)"),
+    "attention": ("Pallas flash attention: model.attention_impl=pallas "
+                  "(ops/flash_attention.py); chunked as the XLA "
+                  "fallback"),
+    "matmul": ("int8 quantized training (model.quant_training) or "
+               "remat_policy=dots to stop recomputing MXU work"),
+    "conv": ("space_to_depth stem (model.stem) and NHWC layout audit "
+             "(models/resnet.py)"),
+    "unattributed": ("no op-class capture for this row — run with "
+                     "obs.profile_every_steps so attribute_capture "
+                     "can split the gap"),
+}
+
+
+# bench.py's compute-graph arm tokens (_ga4/_overlap/_fusedep — ISSUE
+# 14): arm rows own their OWN ledger trajectories and must never be
+# cross-judged as the canonical preset's newest audited row.
+_ARM_METRIC = re.compile(r"_(ga\d+|overlap|fusedep)_")
+
+
+def _newest_audited_row(rows: list[dict], preset: str) -> dict | None:
+    row = None
+    for r in rows:  # newest wins: rows are append-ordered
+        metric = str(r.get("metric", ""))
+        if metric.startswith(preset) \
+                and not _ARM_METRIC.search(metric) \
+                and isinstance(r.get("mfu_pct"), (int, float)):
+            row = r
+    return row
+
+
+def fusion_worklist(rows: list[dict], presets=AUDIT_PRESETS,
+                    top_n: int = 3) -> list[dict]:
+    """Turn the kernel-gap ranking into an ACTIONABLE fusion worklist:
+    for each preset's newest audited ledger row, the top-N op-class
+    gaps with the row's config digest, the capture/source that measured
+    it, and the concrete repo lever that closes that class
+    (FUSION_SUGGESTIONS). Consumed by ``tools/perf_ledger --audit
+    --suggest`` and obs_report's perf section."""
+    out: list[dict] = []
+    for preset in presets:
+        row = _newest_audited_row(rows, preset)
+        if row is None:
+            continue
+        mfu = float(row["mfu_pct"])
+        for cls, share, gap in kernel_gap(mfu, row.get("opclass_ms"))[:top_n]:
+            if gap <= 0.0:
+                continue
+            out.append({
+                "preset": preset,
+                "metric": row.get("metric"),
+                "op_class": cls,
+                "gap_share": gap,
+                "time_share": share,
+                "mfu_pct": mfu,
+                "config_digest": row.get("config_digest"),
+                "source": row.get("source"),
+                "measured": row.get("measured") or row.get("ts"),
+                "capture": row.get("capture") or row.get("argv"),
+                "suggestion": FUSION_SUGGESTIONS.get(
+                    cls, "no catalogued lever — profile deeper"),
+            })
+    out.sort(key=lambda d: -d["gap_share"])
+    return out
+
+
+def fusion_worklist_report(rows: list[dict], presets=AUDIT_PRESETS,
+                           top_n: int = 3) -> str:
+    """Rendered worklist (one actionable line per gap entry)."""
+    items = fusion_worklist(rows, presets=presets, top_n=top_n)
+    if not items:
+        return ("fusion worklist: no audited ledger rows (need mfu_pct "
+                "rows — run bench.py per preset, or --import history)")
+    lines = ["fusion worklist (top kernel-gap classes -> repo lever):"]
+    for it in items:
+        digest = f" cfg={it['config_digest']}" if it["config_digest"] else ""
+        cap = f" [{it['capture']}]" if it.get("capture") else ""
+        lines.append(
+            f"  {it['preset']:<12} {it['op_class']:<12} "
+            f"gap {it['gap_share']:>6.1%} (share {it['time_share']:.1%}, "
+            f"{it['mfu_pct']:.1f}% MFU{digest}){cap}")
+        lines.append(f"    -> {it['suggestion']}")
+    return "\n".join(lines)
+
+
 def kernel_gap_report(rows: list[dict],
                       presets=AUDIT_PRESETS) -> str:
     """The audit: newest ledger row per preset (metric prefix match)
@@ -547,11 +646,7 @@ def kernel_gap_report(rows: list[dict],
     lines = ["kernel-gap audit (roofline gap by op class; gap shares "
              "sum to 1 - MFU, capped by the capture's compute share):"]
     for preset in presets:
-        row = None
-        for r in rows:  # newest wins: rows are append-ordered
-            if str(r.get("metric", "")).startswith(preset) \
-                    and isinstance(r.get("mfu_pct"), (int, float)):
-                row = r
+        row = _newest_audited_row(rows, preset)
         if row is None:
             lines.append(f"  {preset}: no ledger row with mfu_pct — run "
                          f"bench.py --model {preset}")

@@ -1,0 +1,329 @@
+"""Audit-driven fused epilogues: the compute-side answer to PR 9's
+``perf_ledger --audit`` kernel-gap report (ROADMAP item 2).
+
+Two families of fusion live here, both measured against an unfused
+reference that stays in the tree as the semantics oracle:
+
+1. **Fused optimizer epilogue** (:class:`FusedEpilogue`): clip-by-
+   global-norm + optimizer update + non-finite gate computed in ONE
+   pass over the gradient tree. The optax chain built by
+   ``optim.make_optimizer`` does the same work as three sequential tree
+   traversals (clip → per-transform update → apply_updates) plus — when
+   the sentinel gate is on — a whole-TrainState two-branch select that
+   materializes the stepped AND skipped trees. Here every leaf computes
+   ``new = where(finite, f(clip(g), mu, nu, p), old)`` inline, so XLA
+   emits one fused read-modify-write per parameter instead of bouncing
+   the grad tree through HBM between chain links. Numerics are
+   REPLICATED from the installed optax (same op order, same dtypes,
+   same ``safe_int32_increment`` counter semantics) and pinned by
+   tests against the chain — bit-for-bit, LR-cooldown leaf included.
+   The produced ``opt_state`` keeps the chain's exact pytree structure,
+   so checkpoints, the sentinel's cooldown rewind, and the partition
+   rules are oblivious to which path ran.
+
+2. **Fused model-block epilogues** (:class:`FusedDenseGelu`,
+   :class:`FusedResidualLayerNorm`): the top *elementwise* entries of
+   the kernel-gap audit for the transformer presets — the MLP's
+   bias+GELU chain and (post-LN BERT) the residual-add+LayerNorm
+   chain — expressed as single tagged expressions. Param names, init
+   and math match the ``nn.Dense``/``nn.LayerNorm`` formulation
+   exactly (checkpoints interchange); the new thing is the
+   ``checkpoint_name`` tag (:data:`FUSED_EPILOGUE_NAME`), which gives
+   the remat policy layer (models/remat.py ``no_fused_epilogue``) a
+   handle to recompute exactly these cheap chains in backward instead
+   of saving their activations — the flops↔HBM dial the audit's
+   elementwise gap asks for.
+
+The jitted-step purity contract applies (tools/analyze jit-purity pass
+covers this file): everything here is traced math — no host syncs, no
+prints, no wall clocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+# Tag on fused-epilogue outputs: remat policies key on it
+# (jax.checkpoint_policies.save_any_names_but_these — models/remat.py).
+FUSED_EPILOGUE_NAME = "fused_epilogue"
+
+
+# ---------------------------------------------------------------------------
+# Model-block epilogues
+# ---------------------------------------------------------------------------
+
+
+def bias_gelu(y: jax.Array, bias: jax.Array) -> jax.Array:
+    """bias-add + exact-erf GELU as one tagged elementwise chain.
+
+    Same math as ``Dense``'s ``y + bias`` followed by
+    ``nn.gelu(..., approximate=False)`` — the tag, not the arithmetic,
+    is the point: remat can now name-drop this output."""
+    y = y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+    y = nn.gelu(y, approximate=False)
+    return checkpoint_name(y, FUSED_EPILOGUE_NAME)
+
+
+class FusedDenseGelu(nn.Module):
+    """``nn.Dense`` + exact GELU with the epilogue fused and tagged.
+
+    Param-compatible with ``nn.Dense(features, name=...)`` — same
+    ``kernel``/``bias`` names, shapes, initializers and dtype promotion
+    (flax's own ``promote_dtype``), so checkpoints and partition rules
+    are unchanged and the fused/unfused arms share weights bit-for-bit.
+    """
+
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (jnp.shape(x)[-1], self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,), self.param_dtype)
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype)
+        y = jax.lax.dot_general(
+            x, kernel, (((x.ndim - 1,), (0,)), ((), ())))
+        return bias_gelu(y, bias)
+
+
+class FusedResidualLayerNorm(nn.Module):
+    """residual-add + LayerNorm as one tagged fp32 chain (post-LN BERT's
+    ``ln(x + h)`` epilogue).
+
+    Replicates flax ``nn.LayerNorm``'s numerics exactly — fast-variance
+    statistics promoted to fp32, ``x - mean`` then ``rsqrt(var + eps) *
+    scale`` then ``+ bias`` in that order, fp32 output — with the same
+    ``scale``/``bias`` param names under this module's own name, so
+    swapping ``ln(name)(x + h)`` for ``FusedResidualLayerNorm(name=
+    name)(h, x)`` preserves the param tree and the bits."""
+
+    epsilon: float = 1e-12
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, residual):
+        y = x + residual  # compute-dtype residual add (as `x + h` was)
+        stats_dtype = jnp.promote_types(jnp.result_type(y), jnp.float32)
+        yf = jnp.asarray(y, stats_dtype)
+        mean = yf.mean(-1)
+        mean2 = (yf * yf).mean(-1)
+        var = jnp.maximum(0.0, mean2 - mean * mean)
+        feat = y.shape[-1]
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (feat,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (feat,), self.param_dtype)
+        out = y - mean[..., None]
+        mul = jax.lax.rsqrt(var + self.epsilon)[..., None] * scale
+        out = out * mul + bias
+        return checkpoint_name(jnp.asarray(out, jnp.float32),
+                               FUSED_EPILOGUE_NAME)
+
+
+# ---------------------------------------------------------------------------
+# Fused optimizer epilogue
+# ---------------------------------------------------------------------------
+
+
+def _safe_int32_increment(count):
+    # optax.numerics.safe_int32_increment — replicated so the fused
+    # counter can never disagree with the chain's at int32 saturation.
+    max_i32 = jnp.iinfo(jnp.int32).max
+    return jnp.where(count < max_i32,
+                     count + jnp.array(1, jnp.int32), max_i32)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedEpilogue:
+    """One-pass clip + optimizer update + gate, oracle'd by the optax
+    chain ``optim.make_optimizer`` builds for the same config.
+
+    Built by ``optim.make_fused_update`` (the ``make_optimizer`` fast
+    path), which first proves the config expressible
+    (``optim.fused_update_unsupported_reason``). ``kind`` selects the
+    per-leaf math; the chain-state layout is derived from the same
+    booleans make_optimizer used to assemble its parts list, so the
+    returned ``opt_state`` is structurally identical to the chain's.
+
+    Call: ``new_params, new_opt_state, grad_norm = fe(grads, opt_state,
+    params, finite=...)``. ``finite=None`` means ungated; a traced bool
+    scalar folds the sentinel/GradScaler skip into the same pass —
+    every leaf (params, moments, counters) selects its OLD value when
+    the step is judged non-finite, matching the chain path's
+    whole-tree ``jnp.where`` select.
+    """
+
+    kind: str                      # adamw | adam | sgd
+    sched: Callable                # schedule: count -> lr
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    momentum: float | None = None  # sgd only
+    nesterov: bool = False
+    clip_norm: float = 0.0         # 0 = no clip link in the chain
+    cooldown: bool = False         # sentinel LR-cooldown link present
+    mu_dtype: Any = None           # adam mu / sgd trace storage dtype
+    mask: Callable | None = None   # decay mask fn (params -> bool tree)
+
+    # ---------------------------------------------------- state layout
+    def _indices(self) -> dict:
+        """Chain-state tuple indices, mirroring make_optimizer's parts
+        order: [clip?] [coupled wd?] [optimizer] [cooldown?]."""
+        idx = 0
+        out = {}
+        if self.clip_norm > 0:
+            out["clip"] = idx
+            idx += 1
+        if self.kind in ("sgd", "adam") and self.weight_decay > 0:
+            out["wd"] = idx  # add_decayed_weights link (coupled L2)
+            idx += 1
+        out["opt"] = idx
+        idx += 1
+        if self.cooldown:
+            out["cooldown"] = idx
+        return out
+
+    def _mask_tree(self, params):
+        if self.mask is None:
+            return jax.tree.map(lambda _: True, params)
+        return self.mask(params)
+
+    # --------------------------------------------------------- update
+    def __call__(self, grads, opt_state, params, finite=None):
+        import optax
+
+        ix = self._indices()
+        opt_inner = opt_state[ix["opt"]]
+        cooldown_scale = (opt_state[ix["cooldown"]].scale
+                          if self.cooldown else None)
+
+        gnorm = optax.global_norm(grads)
+        if self.clip_norm > 0:
+            trigger = gnorm < self.clip_norm
+
+            def clip_leaf(t):
+                # optax.clip_by_global_norm's exact formulation
+                return jax.lax.select(
+                    trigger, t, (t / gnorm.astype(t.dtype)) * self.clip_norm)
+        else:
+            clip_leaf = lambda t: t  # noqa: E731
+
+        def gate(new, old):
+            if finite is None:
+                return new
+            return jnp.where(finite, new, old)
+
+        def lr_mul(u, sched_count):
+            # scale_by_schedule: updates * jnp.array(-lr, u.dtype)
+            return u * jnp.array(-self.sched(sched_count), dtype=u.dtype)
+
+        def cool(u):
+            if cooldown_scale is None:
+                return u
+            return u * cooldown_scale.astype(u.dtype)
+
+        mask_tree = self._mask_tree(params)
+
+        if self.kind in ("adamw", "adam"):
+            if self.kind == "adamw":
+                adam_st, wd_st, sched_st = opt_inner
+            else:
+                adam_st, sched_st = opt_inner
+                wd_st = opt_state[ix["wd"]] if "wd" in ix else None
+            count_inc = _safe_int32_increment(adam_st.count)
+            b1c = 1 - self.b1 ** count_inc  # tree_bias_correction
+            b2c = 1 - self.b2 ** count_inc
+            sched_count = sched_st.count
+            wd = self.weight_decay
+
+            def leaf(g, p, mu, nu, decay):
+                g = clip_leaf(g)
+                if self.kind == "adam" and wd > 0 and decay:
+                    g = g + wd * p  # coupled L2 BEFORE the moments
+                mu_n = (1 - self.b1) * g + self.b1 * mu
+                nu_n = (1 - self.b2) * (g ** 2) + self.b2 * nu
+                mu_hat = mu_n / b1c.astype(mu_n.dtype)
+                nu_hat = nu_n / b2c.astype(nu_n.dtype)
+                u = mu_hat / (jnp.sqrt(nu_hat + 0.0) + self.eps)
+                mu_store = (mu_n.astype(self.mu_dtype)
+                            if self.mu_dtype is not None else mu_n)
+                if self.kind == "adamw" and wd > 0 and decay:
+                    u = u + wd * p  # decoupled decay AFTER the moments
+                u = cool(lr_mul(u, sched_count))
+                new_p = jnp.asarray(p + u).astype(jnp.asarray(p).dtype)
+                return (gate(new_p, p), gate(mu_store, mu),
+                        gate(nu_n, nu))
+
+            fused = jax.tree.map(leaf, grads, params,
+                                 adam_st.mu, adam_st.nu, mask_tree)
+            new_params = jax.tree.map(lambda t: t[0], fused,
+                                      is_leaf=lambda t: isinstance(t, tuple))
+            new_mu = jax.tree.map(lambda t: t[1], fused,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+            new_nu = jax.tree.map(lambda t: t[2], fused,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+            new_adam = optax.ScaleByAdamState(
+                count=gate(count_inc, adam_st.count), mu=new_mu, nu=new_nu)
+            new_sched = optax.ScaleByScheduleState(
+                count=gate(_safe_int32_increment(sched_st.count),
+                           sched_st.count))
+            if self.kind == "adamw":
+                new_inner = (new_adam, wd_st, new_sched)
+            else:
+                new_inner = (new_adam, new_sched)
+        else:  # sgd / momentum
+            trace_st, sched_st = opt_inner
+            sched_count = sched_st.count
+            wd = self.weight_decay
+            has_trace = self.momentum is not None
+            mu_tree = trace_st.trace if has_trace else params  # dummy
+
+            def leaf(g, p, tr, decay):
+                g = clip_leaf(g)
+                if wd > 0 and decay:
+                    g = g + wd * p  # torch-coupled L2 before momentum
+                if has_trace:
+                    tr_n = g + self.momentum * tr
+                    u = g + self.momentum * tr_n if self.nesterov else tr_n
+                    tr_store = (tr_n.astype(self.mu_dtype)
+                                if self.mu_dtype is not None else tr_n)
+                else:
+                    u, tr_store = g, tr
+                u = cool(lr_mul(u, sched_count))
+                new_p = jnp.asarray(p + u).astype(jnp.asarray(p).dtype)
+                return (gate(new_p, p), gate(tr_store, tr))
+
+            fused = jax.tree.map(leaf, grads, params, mu_tree, mask_tree)
+            new_params = jax.tree.map(lambda t: t[0], fused,
+                                      is_leaf=lambda t: isinstance(t, tuple))
+            if has_trace:
+                new_trace = jax.tree.map(
+                    lambda t: t[1], fused,
+                    is_leaf=lambda t: isinstance(t, tuple))
+                new_inner = (type(trace_st)(trace=new_trace),
+                             optax.ScaleByScheduleState(
+                                 count=gate(
+                                     _safe_int32_increment(sched_st.count),
+                                     sched_st.count)))
+            else:
+                new_inner = (trace_st,
+                             optax.ScaleByScheduleState(
+                                 count=gate(
+                                     _safe_int32_increment(sched_st.count),
+                                     sched_st.count)))
+
+        new_state = list(opt_state)
+        new_state[ix["opt"]] = new_inner
+        return new_params, tuple(new_state), gnorm

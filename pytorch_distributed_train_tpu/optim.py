@@ -435,6 +435,71 @@ def make_optimizer(opt_cfg, total_steps: int, steps_per_epoch: int = 0,
     return tx, sched
 
 
+def fused_update_unsupported_reason(opt_cfg, *, has_param_mask: bool = False
+                                    ) -> str | None:
+    """Why the fused one-pass epilogue (ops/fused_update.py) can NOT
+    express this optimizer config — or None when it can.
+
+    The fast path covers the chain shapes the presets actually run
+    (clip → {adamw | adam | sgd/momentum} → sentinel cooldown, with
+    decay masks and narrowed moment storage); everything else keeps the
+    optax chain, which remains the reference oracle either way. A loud
+    reason (not a silent fallback) is the repo convention: a knob that
+    quietly does nothing records wrong measurements."""
+    name = opt_cfg.name
+    if name not in ("adamw", "adam", "sgd", "momentum"):
+        return (f"optimizer {name!r} has no fused epilogue (supported: "
+                "adamw/adam/sgd/momentum)")
+    if getattr(opt_cfg, "grad_hook", "none") not in ("", "none"):
+        return "grad_hook transforms run on the raw grads (unfusable here)"
+    if getattr(opt_cfg, "layer_lr_decay", 1.0) != 1.0:
+        return "layer_lr_decay adds a stateful per-depth scale link"
+    if getattr(opt_cfg, "plateau_factor", 0.0) > 0.0:
+        return "reduce_on_plateau is a stateful loss-driven link"
+    if opt_cfg.accum_steps > 1:
+        return ("optim.accum_steps wraps the chain in MultiSteps — use "
+                "train.grad_accum_steps for in-graph accumulation instead")
+    if has_param_mask:
+        return "LoRA optimizer masking nests per-label inner states"
+    return None
+
+
+def make_fused_update(opt_cfg, sched, sentinel_cooldown: bool = False):
+    """The make_optimizer FAST PATH: a FusedEpilogue whose one-pass
+    update is numerically identical to the chain make_optimizer builds
+    for the same (supported) config. ``sched`` must be the SAME
+    schedule object make_optimizer returned — the two paths must read
+    identical LRs at every count. Raises ValueError (with the reason)
+    for configs the fast path cannot express."""
+    reason = fused_update_unsupported_reason(opt_cfg)
+    if reason is not None:
+        raise ValueError(f"train.fused_epilogue: {reason}")
+    from pytorch_distributed_train_tpu.ops.fused_update import FusedEpilogue
+
+    name = opt_cfg.name
+    momentum = None
+    nesterov = False
+    if name in ("sgd", "momentum"):
+        momentum = (opt_cfg.momentum
+                    if name == "momentum" or opt_cfg.momentum else None)
+        nesterov = opt_cfg.nesterov
+    mu_dtype = getattr(opt_cfg, "moment_dtype", "") or None
+    if name in ("sgd", "momentum") and not momentum:
+        # Mirror make_optimizer's TRUTHINESS check exactly
+        # (`accumulator_dtype=mu_dtype if momentum else None`):
+        # momentum=0.0 builds a TraceState but the chain keeps it fp32,
+        # so the fused path must not narrow it either.
+        mu_dtype = None
+    return FusedEpilogue(
+        kind="sgd" if name in ("sgd", "momentum") else name,
+        sched=sched, b1=opt_cfg.beta1, b2=opt_cfg.beta2, eps=opt_cfg.eps,
+        weight_decay=opt_cfg.weight_decay, momentum=momentum,
+        nesterov=nesterov, clip_norm=opt_cfg.grad_clip_norm,
+        cooldown=sentinel_cooldown, mu_dtype=mu_dtype,
+        mask=decay_mask_fn(getattr(opt_cfg, "decay_exclude", "")),
+    )
+
+
 def schedule_free_eval(opt_state, params):
     """Schedule-Free evaluation params: locate the ScheduleFreeState in
     the (possibly chained/wrapped) optimizer state — duck-typed on its

@@ -123,6 +123,39 @@ def match_partition_rules(rules: list[tuple[str, PartitionSpec]], params: Any) -
     return PartitionRules(rules).tree_specs(params)
 
 
+def grad_buckets(params: Any, bucket_bytes: int) -> list[list[int]]:
+    """Gradient buckets for the overlapped-collectives path
+    (steps.overlap_grad_reducer) — the layout half of DDP's reducer
+    (torch reducer.hpp:285 / ``bucket_cap_mb``).
+
+    Flattened-leaf indices grouped in REVERSE parameter order (backward
+    produces grads output-end first, so the last layers' buckets close —
+    and their collectives launch — while earlier layers still compute),
+    each bucket closing once its cumulative byte size reaches
+    ``bucket_bytes``. Works on arrays or ShapeDtypeStructs (AOT
+    bucketing from an eval_shape tree, no materialized params needed).
+    Invariants the tests pin: every leaf appears in exactly one bucket;
+    concatenating the buckets yields exactly ``reversed(range(n))``;
+    every bucket except possibly the last meets the byte floor."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be > 0, got {bucket_bytes}")
+    leaves = jax.tree_util.tree_leaves(params)
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    size = 0
+    for i in reversed(range(len(leaves))):
+        leaf = leaves[i]
+        cur.append(i)
+        size += int(np.prod(getattr(leaf, "shape", ()) or (1,))) * \
+            np.dtype(leaf.dtype).itemsize
+        if size >= bucket_bytes:
+            buckets.append(cur)
+            cur, size = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
 # ------------------------------------------------------------------ rule sets
 #
 # Sharding recipes per model family. Convention on axis use:

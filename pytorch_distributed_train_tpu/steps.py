@@ -22,6 +22,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+# Re-exports: the preset + env helper live in config.py (jax-free —
+# XLA_FLAGS must be set before any backend-registering import, which
+# importing THIS module may already have done).
+from pytorch_distributed_train_tpu.config import (  # noqa: F401
+    LATENCY_HIDING_XLA_FLAGS,
+    ensure_latency_hiding_flags,
+)
 from pytorch_distributed_train_tpu.train_state import TrainState
 
 
@@ -117,7 +124,12 @@ def make_train_step(model, loss_fn: Callable, tx,
                     module_grad_norms: bool = False,
                     param_transform: Callable | None = None,
                     teacher_fn: Callable | None = None,
-                    numeric_guard: bool = False) -> Callable:
+                    numeric_guard: bool = False,
+                    grad_accum_steps: int = 1,
+                    fused_update=None,
+                    reduce_grads: Callable | None = None,
+                    reduce_grads_accum: Callable | None = None,
+                    reduce_metrics: Callable | None = None) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
     closes over the optax transform (and the static EMA decay / mixup
     transform); jit-wrapped by the caller with explicit shardings.
@@ -131,7 +143,35 @@ def make_train_step(model, loss_fn: Callable, tx,
     and reports ``update_skipped`` in the metrics — one NaN batch costs
     one skipped step instead of permanently poisoned params. With
     dynamic loss scaling the scaler's own finite gate already does this;
-    the guard then only widens the check to include the loss value."""
+    the guard then only widens the check to include the loss value.
+
+    Compute-graph optimization layer (train.* knobs, docs/performance.md):
+
+    ``grad_accum_steps > 1`` microbatches the step IN-GRAPH: a
+    ``lax.scan`` over N equal microbatch slices of the (donated) global
+    batch accumulates grads in the carry; loss/metrics are the mean of
+    the per-microbatch means and the whole epilogue below — loss-scale
+    unscale, finite gate, clip, optimizer — runs ONCE on the
+    accumulated grads, so skip/rewind semantics and the LR schedule's
+    step count are those of the single-shot step at the same global
+    batch (optax.MultiSteps instead runs N host-driven micro-steps and
+    gates each one). Dropout/augment keys fold the microbatch index on
+    top of the per-step fold, so each microbatch draws independently
+    and deterministically under resume.
+
+    ``fused_update`` (ops/fused_update.py via optim.make_fused_update)
+    replaces the clip → optax-chain → apply_updates → gate-select
+    pipeline with the one-pass fused epilogue; semantics are pinned
+    bit-for-bit to the chain by tests. Mutually exclusive with EMA/SWA
+    (the fused path does not maintain the mirror).
+
+    ``reduce_grads`` / ``reduce_grads_accum`` / ``reduce_metrics`` are
+    the shard_map hooks of the overlapped-collectives path
+    (``jit_overlap_train_step``): per-microbatch bucketed grad
+    reduction inside the scan (DDP-reducer overlap), whole-tree
+    reduction of the accumulated grads (the monolithic baseline arm),
+    and cross-shard averaging of loss/metrics/batch-stats. All None
+    under plain GSPMD jit, where the partitioner places collectives."""
     if not 0.0 <= ema_decay < 1.0:
         raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
     if swa_start > 0 and ema_decay > 0.0:
@@ -140,12 +180,18 @@ def make_train_step(model, loss_fn: Callable, tx,
             "own the single averaged-params mirror")
     if swa_every < 1:
         raise ValueError(f"swa_every must be >= 1, got {swa_every}")
+    if grad_accum_steps < 1:
+        raise ValueError(
+            f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    if fused_update is not None and (ema_decay > 0.0 or swa_start > 0):
+        raise ValueError(
+            "train.fused_epilogue does not maintain the EMA/SWA params "
+            "mirror — disable optim.ema_decay/swa_start_step or the "
+            "fused epilogue")
 
-    def train_step(state: TrainState, batch: dict, rng: jax.Array):
-        # Per-step dropout key: fold the step counter into the base key —
-        # deterministic under resume (same step → same mask), no key chain
-        # to checkpoint (the reference relies on torch's stateful global RNG).
-        dropout_rng = jax.random.fold_in(rng, state.step)
+    def transform_batch(batch, dropout_rng):
+        """Per-(micro)batch input transforms, same fold-in discipline
+        in every path."""
         if device_augment is not None:
             # Device-side crop/flip/RandAugment/normalize on the raw u8
             # batch (ops/device_augment.py) — same fold-in discipline as
@@ -161,27 +207,118 @@ def make_train_step(model, loss_fn: Callable, tx,
             # (possibly mixup-transformed) batch in the same executable;
             # the KD loss reads batch['teacher_logits'].
             batch = {**batch, "teacher_logits": teacher_fn(batch)}
+        return batch
 
-        scale = state.dynamic_scale.scale if state.dynamic_scale is not None else None
+    def grad_one_batch(params, stats, batch, dropout_rng, scale):
+        """grads + aux for ONE (micro)batch — the single-shot math."""
 
-        def loss_for_grad(params):
+        def loss_for_grad(p):
             # LoRA et al: fold adapter leaves into base kernels in-graph
             # (lora.merge); grads flow only through the transform's
             # non-stop_gradient outputs.
             if param_transform is not None:
-                params = param_transform(params)
+                p = param_transform(p)
             logits, new_stats, model_aux = apply_model(
-                model, params, state.batch_stats, batch,
-                train=True, dropout_rng=dropout_rng,
+                model, p, stats, batch, train=True,
+                dropout_rng=dropout_rng,
             )
             loss, aux = loss_fn(logits, batch)
             total = loss + model_aux  # sown losses (MoE aux) join the objective
             scaled = total * scale if scale is not None else total
             return scaled, (loss, aux, model_aux, new_stats)
 
-        grads, (loss, aux, model_aux, new_stats) = jax.grad(
-            loss_for_grad, has_aux=True
-        )(state.params)
+        return jax.grad(loss_for_grad, has_aux=True)(params)
+
+    def accum_grads(state, batch, dropout_rng, scale):
+        """lax.scan over grad_accum_steps microbatches: grads (still
+        loss-scaled — the unscale happens once, after accumulation) sum
+        in the carry, BN stats thread sequentially (microbatch i sees
+        i-1's running stats — sequential-small-batch semantics, the
+        same caveat as optax.MultiSteps), per-microbatch metrics stack
+        in ys and average after."""
+        k = grad_accum_steps
+
+        def split(x):
+            if x.shape[0] % k:
+                # "step batch": the global batch under GSPMD jit, the
+                # per-shard batch inside shard_map (the trainer
+                # validates both cases at construction with the right
+                # denomination — this is the trace-time backstop).
+                raise ValueError(
+                    f"train.grad_accum_steps={k} does not divide the "
+                    f"step batch {x.shape[0]}")
+            return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, xs):
+            grad_acc, stats = carry
+            mb, idx = xs
+            # Per-microbatch key: the step fold already happened; the
+            # microbatch index folds on top, so draws are independent
+            # across microbatches and deterministic under resume.
+            mb_rng = jax.random.fold_in(dropout_rng, idx)
+            mb = transform_batch(mb, mb_rng)
+            grads, (loss, aux, model_aux, new_stats) = grad_one_batch(
+                state.params, stats, mb, mb_rng, scale)
+            if reduce_grads is not None:
+                # Overlap hook: per-BUCKET collectives issued HERE, so
+                # microbatch i's reductions overlap microbatch i+1's
+                # compute under the latency-hiding scheduler.
+                grads = reduce_grads(grads)
+            grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+            stats = new_stats if new_stats is not None else stats
+            return (grad_acc, stats), (loss, aux, model_aux)
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        (grad_acc, stats), (losses, auxes, model_auxes) = jax.lax.scan(
+            body, (zeros, state.batch_stats),
+            (micro, jnp.arange(k, dtype=jnp.int32)))
+        grads = jax.tree.map(lambda g: g / k, grad_acc)
+        loss = jnp.mean(losses)
+        aux = jax.tree.map(jnp.mean, auxes)
+        model_aux = jnp.mean(model_auxes)
+        new_stats = stats if state.batch_stats else None
+        return grads, (loss, aux, model_aux, new_stats)
+
+    def train_step(state: TrainState, batch: dict, rng: jax.Array):
+        # Per-step dropout key: fold the step counter into the base key —
+        # deterministic under resume (same step → same mask), no key chain
+        # to checkpoint (the reference relies on torch's stateful global RNG).
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        scale = state.dynamic_scale.scale if state.dynamic_scale is not None else None
+
+        if grad_accum_steps > 1:
+            grads, (loss, aux, model_aux, new_stats) = accum_grads(
+                state, batch, dropout_rng, scale)
+        else:
+            one = transform_batch(batch, dropout_rng)
+            grads, (loss, aux, model_aux, new_stats) = grad_one_batch(
+                state.params, state.batch_stats, one, dropout_rng, scale)
+            if reduce_grads is not None:
+                grads = reduce_grads(grads)
+        if reduce_grads_accum is not None:
+            # Monolithic post-backward reduction (the baseline arm the
+            # bucketed overlap is measured against): ONE whole-tree
+            # collective on the accumulated grads.
+            grads = reduce_grads_accum(grads)
+        if reduce_metrics is not None:
+            # shard_map: loss/metrics are per-shard means — average
+            # across the batch shards so every replica logs (and the
+            # sentinel judges) the same numbers the GSPMD step would.
+            loss, aux, model_aux = reduce_metrics((loss, aux, model_aux))
+            if new_stats is not None:
+                # BN running stats averaged across replicas each step
+                # (SyncBN-flavored): keeps the replicated state bitwise
+                # in sync, which the replicated-DP contract requires.
+                new_stats = reduce_metrics(new_stats)
+
+        if fused_update is not None:
+            return _fused_epilogue_step(
+                state, grads, loss, aux, model_aux, new_stats,
+                fused_update=fused_update, numeric_guard=numeric_guard,
+                module_grad_norms=module_grad_norms)
 
         if state.dynamic_scale is not None:
             # GradScaler semantics (torch:amp/grad_scaler.py:302,375,484):
@@ -246,6 +383,63 @@ def make_train_step(model, loss_fn: Callable, tx,
         return new_state, metrics
 
     return train_step
+
+
+def _fused_epilogue_step(state: TrainState, grads, loss, aux, model_aux,
+                         new_stats, *, fused_update, numeric_guard: bool,
+                         module_grad_norms: bool):
+    """Shared tail of train_step on the fused path: loss-scale unscale +
+    finite gate + clip + optimizer update in ONE pass over the grad tree
+    (ops/fused_update.py), instead of the chain's three passes plus the
+    whole-TrainState two-branch select. Skip/scale semantics match the
+    chain path exactly: the gate selects per-leaf against the old state,
+    the step counter advances either way, and the scaler adjusts on GRAD
+    overflow only."""
+    metrics_extra = {}
+    finite = None
+    new_dynamic_scale = None
+    if state.dynamic_scale is not None:
+        scale = state.dynamic_scale.scale
+        grads = jax.tree.map(lambda g: g / scale, grads)
+        grads_ok = _tree_finite(grads)
+        finite = grads_ok
+        if numeric_guard:
+            finite = finite & jnp.isfinite(loss)
+        new_dynamic_scale = state.dynamic_scale.update(grads_ok)
+        metrics_extra = {"loss_scale": scale, "grads_finite": grads_ok}
+        if numeric_guard:
+            metrics_extra["update_skipped"] = 1.0 - finite.astype(
+                jnp.float32)
+    elif numeric_guard:
+        finite = _tree_finite(grads) & jnp.isfinite(loss)
+        metrics_extra = {
+            "grads_finite": finite,
+            "update_skipped": 1.0 - finite.astype(jnp.float32),
+        }
+
+    new_params, new_opt_state, gnorm = fused_update(
+        grads, state.opt_state, state.params, finite=finite)
+    stats = state.batch_stats
+    if new_stats is not None:
+        # The chain path's skip branch keeps the OLD stats (the whole
+        # stepped-vs-skipped select); match it per-leaf here.
+        if finite is not None:
+            stats = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_stats, state.batch_stats)
+        else:
+            stats = new_stats
+    new_state = state.replace(
+        step=state.step + 1, params=new_params, opt_state=new_opt_state,
+        batch_stats=stats)
+    if new_dynamic_scale is not None:
+        new_state = new_state.replace(dynamic_scale=new_dynamic_scale)
+    metrics = {"loss": loss, "grad_norm": gnorm, "aux_loss": model_aux,
+               **aux, **metrics_extra}
+    if module_grad_norms:
+        for key, sub in grads.items():
+            metrics[f"grad_norm/{key}"] = optax_global_norm(sub)
+    return new_state, metrics
 
 
 def optax_global_norm(tree) -> jnp.ndarray:
@@ -400,4 +594,152 @@ def jit_eval_step(eval_step, mesh: Mesh, state_sharding, batch_axes=("data", "fs
         eval_step,
         in_shardings=(state_sharding, batch_sh),
         out_shardings=rep,
+    )
+
+
+# ------------------------------------------- overlapped grad collectives
+#
+# The DDP-reducer analogue (SURVEY [TORCH] reducer.hpp:285): under
+# shard_map data parallelism the gradient reduction moves out of the
+# monolithic post-backward psum into per-BUCKET pmeans issued inside the
+# accumulation scan — bucketed by REVERSE parameter order (the order
+# backward produces grads), sized by train.grad_bucket_mb — so the
+# collectives for microbatch i overlap microbatch i+1's remaining
+# compute once XLA's latency-hiding scheduler is on.
+
+# (LATENCY_HIDING_XLA_FLAGS — the scheduler preset the overlap path
+# wants in XLA_FLAGS before backend init — is re-exported from
+# config.py via the module imports above: the torch-world analogue is
+# NCCL's stream overlap, which DDP gets for free from autograd hooks;
+# XLA needs the scheduler told to hide collective latency behind
+# compute. bench.py applies it pre-import; trainer runs export it in
+# the launcher environment — docs/performance.md.)
+
+
+
+
+def overlap_grad_reducer(params_tree, bucket_mb: int, axis_names):
+    """Per-microbatch bucketed reducer (the ``reduce_grads`` hook):
+    returns (reduce_fn, buckets). Buckets come from
+    parallel.partition.grad_buckets over the params SHAPE tree —
+    reverse parameter order, ~bucket_mb each, mirroring DDP's
+    ``bucket_cap_mb``; each bucket reduces as ONE tupled pmean, i.e.
+    one collective the scheduler can hide behind the next microbatch's
+    compute."""
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        grad_buckets,
+    )
+
+    buckets = grad_buckets(params_tree, bucket_mb * 2**20)
+    axes = tuple(axis_names)
+
+    def reduce_fn(grads):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        out = list(leaves)
+        for bucket in buckets:
+            reduced = jax.lax.pmean(
+                tuple(leaves[i] for i in bucket), axes)
+            for j, i in enumerate(bucket):
+                out[i] = reduced[j]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return reduce_fn, buckets
+
+
+def monolithic_grad_reducer(axis_names):
+    """The baseline arm: ONE whole-tree pmean on the ACCUMULATED grads
+    (the ``reduce_grads_accum`` hook) — what a hand-written post-
+    backward all-reduce does, and what the bucketed in-scan reduction
+    is A/B'd against (tools/aot_ab.py ``overlap`` arm)."""
+    axes = tuple(axis_names)
+
+    def reduce_fn(grads):
+        return jax.lax.pmean(grads, axes)
+
+    return reduce_fn
+
+
+def metrics_reducer(axis_names):
+    """Cross-shard mean for per-shard loss/metrics/batch-stats (the
+    ``reduce_metrics`` hook)."""
+    axes = tuple(axis_names)
+
+    def reduce_fn(tree):
+        return jax.lax.pmean(tree, axes)
+
+    return reduce_fn
+
+
+def assert_replicated_for_overlap(state_sharding) -> None:
+    """The overlap path is the DDP analogue: pure data parallelism with
+    the whole TrainState REPLICATED (the batch axes act as data axes
+    only). A sharded param/opt leaf would silently compute garbage
+    inside the full-manual shard_map body — refuse loudly instead."""
+    bad = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(state_sharding)
+    for path, sh in flat:
+        if hasattr(sh, "is_fully_replicated") and not sh.is_fully_replicated:
+            from pytorch_distributed_train_tpu.parallel.partition import (
+                path_name,
+            )
+
+            bad.append(path_name(path))
+    if bad:
+        raise ValueError(
+            "train.overlap_collectives needs the whole TrainState "
+            "replicated (pure data parallelism — set mesh.fsdp=1 or a "
+            f"replicating rule set); sharded leaves: {bad[:5]}"
+            f"{'...' if len(bad) > 5 else ''}")
+
+
+def shard_rng_fold(rng: jax.Array, axis_names) -> jax.Array:
+    """Per-shard PRNG key inside a shard_map body: fold the linearized
+    shard index over ``axis_names`` into the (replicated) key. Without
+    this every data-parallel replica would draw IDENTICAL dropout/
+    augment/mixup randomness for its local batch — the DDP contract is
+    per-rank independent draws (torch ranks each own a global-RNG
+    stream). Axis sizes come from ``psum(1, ax)`` so no mesh handle is
+    needed in-graph."""
+    idx = jnp.int32(0)
+    for ax in axis_names:
+        idx = idx * jax.lax.psum(jnp.int32(1), ax) + jax.lax.axis_index(ax)
+    return jax.random.fold_in(rng, idx)
+
+
+def jit_overlap_train_step(train_step, mesh: Mesh, state_sharding,
+                           batch_axes=("data", "fsdp")):
+    """shard_map + jit wrap of a train step built with the reduce_*
+    hooks: state replicated, batch sharded over ``batch_axes``, grads
+    reduced explicitly inside the step body (per-bucket or monolithic —
+    whichever hooks the step closed over). Buffer donation is
+    preserved: the jit level aliases the replicated state exactly as
+    ``jit_train_step`` does. The replicated rng is re-keyed per shard
+    (``shard_rng_fold``) so dropout/augment draws are independent
+    across replicas — a different stream than the GSPMD step's global-
+    batch draws (both are valid samplings; parity tests compare
+    deterministic configs)."""
+    assert_replicated_for_overlap(state_sharding)
+    from pytorch_distributed_train_tpu.utils.compat import shard_map
+
+    axes = tuple(batch_axes)
+
+    def sharded_step(state, batch, rng):
+        return train_step(state, batch, shard_rng_fold(rng, axes))
+
+    batch_spec = PartitionSpec(axes)
+    smapped = shard_map(
+        sharded_step, mesh=mesh,
+        in_specs=(PartitionSpec(), batch_spec, PartitionSpec()),
+        out_specs=(PartitionSpec(), PartitionSpec()),
+        # Full-manual + no replication check: the body's pmeans make the
+        # outputs replicated by construction; legacy jax's check_rep
+        # cannot see through the scan-carried bucket reductions.
+        check_vma=False)
+    batch_sh = NamedSharding(mesh, batch_spec)
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(
+        smapped,
+        in_shardings=(state_sharding, batch_sh, rep),
+        out_shardings=(state_sharding, rep),
+        donate_argnums=(0,),
     )

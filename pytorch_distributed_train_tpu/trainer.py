@@ -264,6 +264,72 @@ class Trainer:
             sentinel_cooldown=cfg.sentinel.enabled,
         )
 
+        # ---- compute-graph optimization layer (train.* knobs; steps.py
+        # + ops/fused_update.py; docs/performance.md "Compute side").
+        # Every invalid combination is refused loudly at construction —
+        # a knob that silently does nothing records wrong measurements.
+        tcfg = cfg.train
+        if tcfg.grad_accum_steps > 1:
+            if cfg.optim.accum_steps > 1:
+                raise ValueError(
+                    "train.grad_accum_steps and optim.accum_steps both "
+                    "accumulate gradients — they would compound; use one "
+                    "(grad_accum_steps scans microbatches in-graph, "
+                    "accum_steps runs MultiSteps micro-steps)")
+            # The scan splits what the step SEES: the global batch under
+            # GSPMD jit, but the PER-SHARD batch under shard_map
+            # (overlap_collectives) — validate the right unit here, not
+            # at trace time with a misleading size in the message.
+            shards = 1
+            if tcfg.overlap_collectives:
+                for ax in self.batch_axes:
+                    shards *= max(self.mesh.shape.get(ax, 1), 1)
+            if cfg.data.batch_size % shards:
+                raise ValueError(
+                    f"global batch {cfg.data.batch_size} not divisible "
+                    f"by the {shards}-way batch sharding "
+                    f"({'x'.join(self.batch_axes)})")
+            unit = cfg.data.batch_size // shards
+            if unit % tcfg.grad_accum_steps:
+                raise ValueError(
+                    f"train.grad_accum_steps={tcfg.grad_accum_steps} must "
+                    f"divide the "
+                    f"{'per-shard' if shards > 1 else 'global'} batch "
+                    f"{unit}"
+                    + (f" (global {cfg.data.batch_size} over {shards} "
+                       f"shards)" if shards > 1 else ""))
+        self.fused_update = None
+        if tcfg.fused_epilogue:
+            from pytorch_distributed_train_tpu.optim import (
+                fused_update_unsupported_reason,
+                make_fused_update,
+            )
+
+            reason = fused_update_unsupported_reason(
+                cfg.optim, has_param_mask=cfg.lora.rank > 0)
+            if reason is not None:
+                raise ValueError(f"train.fused_epilogue: {reason}")
+            if cfg.optim.ema_decay > 0.0 or \
+                    getattr(cfg.optim, "swa_start_step", 0) > 0:
+                raise ValueError(
+                    "train.fused_epilogue does not maintain the EMA/SWA "
+                    "mirror — disable optim.ema_decay/swa_start_step")
+            self.fused_update = make_fused_update(
+                cfg.optim, self.lr_schedule,
+                sentinel_cooldown=cfg.sentinel.enabled)
+        if tcfg.overlap_collectives:
+            if cfg.optim.offload_state:
+                raise ValueError(
+                    "train.overlap_collectives + optim.offload_state: the "
+                    "shard_map step cannot stage host-memory opt state")
+            for ax in ("stage", "tensor", "context", "expert"):
+                if self.mesh.shape.get(ax, 1) != 1:
+                    raise ValueError(
+                        "train.overlap_collectives is the DDP analogue — "
+                        "pure data parallelism over the batch axes; mesh "
+                        f"axis {ax!r}={self.mesh.shape[ax]} shards the "
+                        "model (GSPMD already overlaps those collectives)")
+
         # ---- state (sharded init: params materialize directly into their
         # mesh layout — no host-RAM staging of 7B params; SURVEY C13)
         self.rng = jax.random.PRNGKey(cfg.seed)
@@ -302,6 +368,16 @@ class Trainer:
         param_transform = None
         if cfg.lora.rank > 0:
             param_transform = lambda p: lora_lib.merge(p, cfg.lora)  # noqa: E731
+        reduce_grads = reduce_metrics = None
+        self.grad_buckets = None
+        if cfg.train.overlap_collectives:
+            # Bucketed in-scan reduction (steps.overlap_grad_reducer):
+            # buckets derived AOT from the params shape tree, reverse
+            # parameter order, ~grad_bucket_mb each (DDP bucket_cap_mb).
+            reduce_grads, self.grad_buckets = steps_lib.overlap_grad_reducer(
+                state_shape.params, max(cfg.train.grad_bucket_mb, 1),
+                self.batch_axes)
+            reduce_metrics = steps_lib.metrics_reducer(self.batch_axes)
         train_step = steps_lib.make_train_step(
             self.model, self.loss_fn, self.tx,
             ema_decay=cfg.optim.ema_decay,
@@ -311,13 +387,28 @@ class Trainer:
             module_grad_norms=cfg.obs.log_module_grad_norms,
             param_transform=param_transform,
             teacher_fn=self.teacher_fn,
-            numeric_guard=cfg.sentinel.enabled)
+            numeric_guard=cfg.sentinel.enabled,
+            grad_accum_steps=cfg.train.grad_accum_steps,
+            fused_update=self.fused_update,
+            reduce_grads=reduce_grads,
+            reduce_metrics=reduce_metrics)
         if cfg.optim.offload_state:
             train_step = steps_lib.offload_opt_state(
                 train_step, opt_dev_sharding, self.state_sharding.opt_state)
-        self.train_step = steps_lib.jit_train_step(
-            train_step, self.mesh, self.state_sharding, self.batch_axes,
-        )
+        if cfg.train.overlap_collectives:
+            self.train_step = steps_lib.jit_overlap_train_step(
+                train_step, self.mesh, self.state_sharding,
+                self.batch_axes)
+            if jax.process_index() == 0:
+                print(f"[train] overlapped collectives: "
+                      f"{len(self.grad_buckets)} grad bucket(s) x "
+                      f"{cfg.train.grad_accum_steps} microbatch(es), "
+                      f"bucket cap {cfg.train.grad_bucket_mb} MiB",
+                      flush=True)
+        else:
+            self.train_step = steps_lib.jit_train_step(
+                train_step, self.mesh, self.state_sharding, self.batch_axes,
+            )
         self.eval_step = steps_lib.jit_eval_step(
             steps_lib.make_eval_step(
                 self.model, self.eval_loss_fn,

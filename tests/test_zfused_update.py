@@ -1,0 +1,304 @@
+"""Fused epilogues (ISSUE 14, ops/fused_update.py) vs their oracles:
+
+- the one-pass optimizer epilogue must be BIT-IDENTICAL to the optax
+  chain make_optimizer builds for the same config — params and the full
+  opt_state (counters, moments, the sentinel LR-cooldown leaf), gated
+  and ungated;
+- the fused model-block epilogues (bias+GELU, residual+LayerNorm) must
+  be bit-identical to the nn.Dense/nn.LayerNorm formulation with an
+  unchanged param tree;
+- the CPU AOT A/B (tools/aot_ab.py arms) must show the fused epilogue
+  touching no more bytes than the chain.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorch_distributed_train_tpu.config import (
+    ModelConfig,
+    OptimConfig,
+    PrecisionConfig,
+)
+from pytorch_distributed_train_tpu.models.registry import build_model
+from pytorch_distributed_train_tpu.optim import (
+    fused_update_unsupported_reason,
+    make_fused_update,
+    make_optimizer,
+)
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "layer1": {
+            "kernel": jnp.asarray(rng.standard_normal((4, 5)), jnp.float32),
+            "bias": jnp.asarray(rng.standard_normal(5), jnp.float32),
+        },
+        "scale": jnp.asarray(rng.standard_normal(5), jnp.float32),
+    }
+
+
+CASES = {
+    "adamw_full": OptimConfig(
+        name="adamw", learning_rate=1e-3, schedule="cosine",
+        warmup_steps=2, weight_decay=0.01, grad_clip_norm=1.0,
+        decay_exclude=r"bias$,scale$"),
+    "adamw_plain": OptimConfig(
+        name="adamw", learning_rate=1e-3, schedule="constant",
+        warmup_steps=0, weight_decay=0.0),
+    "adam_coupled_wd": OptimConfig(
+        name="adam", learning_rate=1e-3, schedule="constant",
+        warmup_steps=0, weight_decay=0.01),
+    "momentum_nesterov": OptimConfig(
+        name="momentum", learning_rate=0.1, momentum=0.9, nesterov=True,
+        schedule="cosine", warmup_steps=0, weight_decay=5e-4,
+        grad_clip_norm=1.0),
+    "sgd_plain": OptimConfig(
+        name="sgd", learning_rate=0.1, momentum=0.0, schedule="constant",
+        warmup_steps=0, weight_decay=0.0),
+    "adamw_bf16_moments": OptimConfig(
+        name="adamw", learning_rate=1e-3, schedule="constant",
+        warmup_steps=0, weight_decay=0.01, moment_dtype="bfloat16"),
+}
+
+
+def _assert_trees_identical(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _run_both(opt_cfg, sentinel=False, gate_pattern=None, steps=4):
+    """Drive the optax chain and the fused epilogue over the same grad
+    stream UNDER JIT (the deployment regime — both paths then lower
+    through the same XLA pipeline, which is the bit-identity contract)
+    and return both final (params, opt_state)."""
+    tx, sched = make_optimizer(opt_cfg, total_steps=100,
+                               sentinel_cooldown=sentinel)
+    fe = make_fused_update(opt_cfg, sched, sentinel_cooldown=sentinel)
+    params = _tree()
+    state = tx.init(params)
+    if sentinel:
+        # nontrivial LR-cooldown leaf: the rewind path scaled it down
+        from pytorch_distributed_train_tpu.sentinel.numeric import (
+            scale_cooldown,
+        )
+
+        state = scale_cooldown(state, 0.5)
+
+    @jax.jit
+    def chain_step(p, s, g, finite):
+        u, s2 = tx.update(g, s, p)
+        p2 = optax.apply_updates(p, u)
+        return jax.tree.map(lambda a, b: jnp.where(finite, a, b),
+                            (p2, s2), (p, s))
+
+    @jax.jit
+    def fused_step(p, s, g, finite):
+        p2, s2, _ = fe(g, s, p, finite=finite)
+        return p2, s2
+
+    rng = np.random.default_rng(7)
+    p1 = p2 = params
+    s1 = s2 = state
+    for i in range(steps):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(rng.standard_normal(p.shape),
+                                  jnp.float32), params)
+        finite = jnp.bool_(
+            True if gate_pattern is None else gate_pattern[i])
+        p1, s1 = chain_step(p1, s1, grads, finite)
+        p2, s2 = fused_step(p2, s2, grads, finite)
+    return (p1, s1), (p2, s2)
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_fused_epilogue_bit_identical_to_chain(case):
+    (p1, s1), (p2, s2) = _run_both(CASES[case])
+    _assert_trees_identical(p1, p2)
+    _assert_trees_identical(s1, s2)
+
+
+def test_fused_epilogue_gate_and_cooldown_leaf():
+    """Gated steps (the sentinel/GradScaler skip) and the LR-cooldown
+    chain link: fused == chain bit-for-bit including the skipped steps'
+    untouched counters and the cooldown scale's effect on updates."""
+    (p1, s1), (p2, s2) = _run_both(
+        CASES["adamw_full"], sentinel=True,
+        gate_pattern=[True, False, True, True])
+    _assert_trees_identical(p1, p2)
+    _assert_trees_identical(s1, s2)
+    # the gate really skipped: counts advanced 3 times, not 4
+    counts = [np.asarray(s) for s in jax.tree.leaves(s1)
+              if np.asarray(s).dtype == np.int32]
+    assert counts and all(int(c) == 3 for c in counts)
+
+
+def test_fused_epilogue_in_train_step_matches_chain(devices8):
+    """End-to-end: a jitted train step with the fused epilogue produces
+    the SAME params as the chain path (same batch, same rng)."""
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import MeshConfig
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    mesh = build_mesh(MeshConfig(data=8), devices8)
+    model_cfg = ModelConfig(name="vit_b16", num_classes=10, image_size=8,
+                            patch_size=4, hidden_size=32, num_layers=2,
+                            num_heads=4, mlp_dim=64, dropout_rate=0.0)
+    opt_cfg = CASES["adamw_full"]
+    model = build_model(model_cfg, PrecisionConfig())
+    tx, sched = make_optimizer(opt_cfg, total_steps=100)
+    rules = rules_for_model("vit_b16")
+
+    def init_state(rng):
+        variables = model.init({"params": rng}, jnp.zeros((2, 8, 8, 3)),
+                               train=False)
+        return TrainState.create(params=variables["params"], tx=tx)
+
+    shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    rng = np.random.default_rng(0)
+    batch = {"image": jnp.asarray(rng.standard_normal((16, 8, 8, 3)),
+                                  jnp.float32),
+             "label": jnp.asarray(rng.integers(0, 10, 16), jnp.int32)}
+    results = {}
+    for fused in (False, True):
+        fe = make_fused_update(opt_cfg, sched) if fused else None
+        step = steps_lib.jit_train_step(
+            steps_lib.make_train_step(model, get_loss_fn("softmax_xent"),
+                                      tx, fused_update=fe),
+            mesh, sharding)
+        state = jax.jit(init_state, out_shardings=sharding)(
+            jax.random.PRNGKey(0))
+        for _ in range(2):
+            state, metrics = step(state, batch, jax.random.PRNGKey(1))
+        results[fused] = (jax.device_get(state.params),
+                          jax.device_get(state.opt_state))
+    _assert_trees_identical(results[False][0], results[True][0])
+    _assert_trees_identical(results[False][1], results[True][1])
+
+
+def test_fused_unsupported_reasons():
+    assert fused_update_unsupported_reason(
+        OptimConfig(name="lamb")) is not None
+    assert fused_update_unsupported_reason(
+        OptimConfig(name="adafactor")) is not None
+    assert fused_update_unsupported_reason(
+        OptimConfig(name="adamw", plateau_factor=0.5)) is not None
+    assert fused_update_unsupported_reason(
+        OptimConfig(name="adamw", accum_steps=4)) is not None
+    assert fused_update_unsupported_reason(
+        OptimConfig(name="adamw", layer_lr_decay=0.9)) is not None
+    assert fused_update_unsupported_reason(
+        OptimConfig(name="adamw"), has_param_mask=True) is not None
+    assert fused_update_unsupported_reason(
+        OptimConfig(name="adamw", grad_clip_norm=1.0,
+                    decay_exclude=r"bias$")) is None
+    with pytest.raises(ValueError, match="fused_epilogue"):
+        make_fused_update(OptimConfig(name="lamb"), lambda c: 1e-3)
+
+
+# ---------------------------------------------------------------- models
+
+
+def _model_outputs(name, fused, dtype="float32", **kw):
+    cfg = ModelConfig(name=name, fused_epilogues=fused, **kw)
+    model = build_model(cfg, PrecisionConfig(compute_dtype=dtype))
+    rng = np.random.default_rng(3)
+    if name.startswith("vit"):
+        inputs = (jnp.asarray(rng.standard_normal((2, 16, 16, 3)),
+                              jnp.float32),)
+    else:
+        inputs = (jnp.asarray(rng.integers(0, 50, (2, 12)), jnp.int32),
+                  jnp.ones((2, 12), jnp.int32))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, *inputs,
+                           train=False)
+    return variables["params"], model.apply(variables, *inputs,
+                                            train=False)
+
+
+VIT_KW = dict(num_classes=10, image_size=16, patch_size=4, hidden_size=32,
+              num_layers=2, num_heads=4, mlp_dim=64)
+BERT_KW = dict(vocab_size=50, hidden_size=32, num_layers=2, num_heads=4,
+               mlp_dim=64, max_seq_len=16)
+
+
+@pytest.mark.parametrize("name,kw", [("vit_b16", VIT_KW),
+                                     ("bert_base", BERT_KW)])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_fused_block_epilogues_bit_identical(name, kw, dtype):
+    """model.fused_epilogues: same param tree (names, shapes, init
+    bits), same outputs (bias+GELU and residual+LayerNorm replicate the
+    nn.Dense/nn.LayerNorm math exactly)."""
+    p_ref, out_ref = _model_outputs(name, False, dtype=dtype, **kw)
+    p_fused, out_fused = _model_outputs(name, True, dtype=dtype, **kw)
+    assert jax.tree_util.tree_structure(p_ref) == \
+        jax.tree_util.tree_structure(p_fused)
+    _assert_trees_identical(p_ref, p_fused)
+    np.testing.assert_array_equal(np.asarray(out_ref),
+                                  np.asarray(out_fused))
+
+
+def test_no_fused_epilogue_remat_policy():
+    """remat_policy='no_fused_epilogue' composes with the fused blocks
+    (the tag is its handle) and leaves gradients equal to the unfused
+    formulation's."""
+    grads = {}
+    for fused in (False, True):
+        cfg = ModelConfig(name="bert_base", fused_epilogues=fused,
+                          remat=True,
+                          remat_policy="no_fused_epilogue" if fused
+                          else "full", **BERT_KW)
+        model = build_model(cfg, PrecisionConfig())
+        rng = np.random.default_rng(3)
+        ids = (jnp.asarray(rng.integers(0, 50, (2, 12)), jnp.int32),
+               jnp.ones((2, 12), jnp.int32))
+        variables = model.init({"params": jax.random.PRNGKey(0)}, *ids,
+                               train=False)
+
+        def loss(p):
+            return jnp.sum(
+                model.apply({"params": p}, *ids, train=False) ** 2)
+
+        grads[fused] = jax.jit(jax.grad(loss))(variables["params"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5,
+                                                atol=1e-5),
+        jax.device_get(grads[False]), jax.device_get(grads[True]))
+
+
+# ------------------------------------------------------------- CPU AOT A/B
+
+
+def test_aot_epilogue_bytes_accessed():
+    """Tier-1 CPU AOT smoke (ala AOT_AB.json): the fused-epilogue train
+    step's cost_analysis bytes-accessed must not exceed the chain
+    step's — the one-pass epilogue reads/writes the grad tree once."""
+    from tools.aot_ab import _compile_epilogue_arm
+
+    chain = _compile_epilogue_arm(True, False)
+    fused = _compile_epilogue_arm(True, True)
+    assert fused.get("ok", True) and chain.get("ok", True), (chain, fused)
+    assert fused["gbytes_accessed"] <= chain["gbytes_accessed"], \
+        (chain, fused)
+
+
+def test_fused_momentum_zero_keeps_fp32_trace():
+    """momentum=0.0 + moment_dtype: the chain's accumulator_dtype uses
+    a TRUTHINESS check (0.0 -> fp32 trace) — the fused path must mirror
+    it, not narrow the trace to bf16."""
+    cfg = OptimConfig(name="momentum", learning_rate=0.1, momentum=0.0,
+                      schedule="constant", warmup_steps=0,
+                      weight_decay=0.0, moment_dtype="bfloat16")
+    (p1, s1), (p2, s2) = _run_both(cfg)
+    _assert_trees_identical(p1, p2)
+    _assert_trees_identical(s1, s2)

@@ -528,3 +528,98 @@ def test_trainer_summary_stages_and_ledger_row(tmp_path):
     assert row["source"] == "trainer"
     assert 0 <= row["goodput_pct"] <= 100
     assert row["config_digest"]
+
+
+# ------------------------------------------------- fusion worklist (ISSUE 14)
+def test_fusion_worklist_actionable():
+    """--audit --suggest: top-N op-class gaps per preset with config
+    digest + measuring capture, each mapped to a concrete repo lever."""
+    rows = [
+        {"metric": "vit_b16_images_per_sec_per_chip", "value": 700.0,
+         "mfu_pct": 40.0, "config_digest": "abc123def456",
+         "source": "bench",
+         "opclass_ms": {"matmul": 50.0, "elementwise": 30.0,
+                        "collective": 15.0, "infeed": 5.0}},
+        {"metric": "bert_base_mlm_tokens_per_sec_per_chip",
+         "value": 9e4, "mfu_pct": 35.0},  # no capture -> unattributed
+    ]
+    wl = perf_lib.fusion_worklist(rows, presets=("vit_b16", "bert_base"),
+                                  top_n=2)
+    by_preset = {}
+    for it in wl:
+        by_preset.setdefault(it["preset"], []).append(it)
+    # vit: elementwise + collective are the top gap classes (matmul's
+    # share is mostly ideal time) and carry the digest
+    vit_classes = [it["op_class"] for it in by_preset["vit_b16"]]
+    assert "elementwise" in vit_classes and "collective" in vit_classes
+    for it in by_preset["vit_b16"]:
+        assert it["config_digest"] == "abc123def456"
+        assert it["gap_share"] > 0
+    ew = next(it for it in by_preset["vit_b16"]
+              if it["op_class"] == "elementwise")
+    assert "fused_epilogue" in ew["suggestion"]
+    co = next(it for it in by_preset["vit_b16"]
+              if it["op_class"] == "collective")
+    assert "overlap_collectives" in co["suggestion"]
+    # the capture-less preset still appears, pointing at the profiler
+    assert by_preset["bert_base"][0]["op_class"] == "unattributed"
+    # entries are sorted most-gap-first across presets
+    assert [it["gap_share"] for it in wl] == sorted(
+        (it["gap_share"] for it in wl), reverse=True)
+    text = perf_lib.fusion_worklist_report(rows,
+                                           presets=("vit_b16",), top_n=2)
+    assert "fusion worklist" in text and "elementwise" in text
+    # empty ledger: a quiet pointer, not a crash
+    assert "no audited ledger rows" in perf_lib.fusion_worklist_report([])
+
+
+def test_perf_ledger_cli_suggest(tmp_path, capsys):
+    import perf_ledger as plcli
+
+    path = tmp_path / "ledger.jsonl"
+    perf_lib.PerfLedger(str(path)).append(
+        "vit_b16_images_per_sec_per_chip", 700.0, mfu_pct=40.0,
+        opclass_ms={"matmul": 60.0, "elementwise": 40.0})
+    rc = plcli.main(["--path", str(path), "--audit", "--suggest",
+                     "--presets", "vit_b16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kernel-gap audit" in out
+    assert "fusion worklist" in out and "elementwise" in out
+    rc = plcli.main(["--path", str(path), "--suggest", "--json",
+                     "--presets", "vit_b16"])
+    out = capsys.readouterr().out
+    assert rc == 0 and '"worklist"' in out
+
+
+def test_obs_report_renders_worklist():
+    import obs_report
+
+    recs = [{"tag": "train", "step": 50, "mfu_pct": 40.0}]
+    rows = [{"metric": "vit_b16_images_per_sec_per_chip", "value": 700.0,
+             "mfu_pct": 40.0,
+             "opclass_ms": {"matmul": 60.0, "elementwise": 40.0}}]
+    text = "\n".join(obs_report.perf_section(recs, None, rows))
+    assert "worklist:" in text and "elementwise" in text
+    # no ledger rows -> no worklist lines, section otherwise intact
+    text2 = "\n".join(obs_report.perf_section(recs, None, None))
+    assert "worklist:" not in text2
+
+
+def test_audit_skips_compute_arm_rows():
+    """Arm rows (vit_b16_ga4_* / _overlap_ / _fusedep_) own their own
+    trajectories — the audit/worklist must pick the CANONICAL preset
+    row even when an arm row is newer."""
+    rows = [
+        {"metric": "vit_b16_images_per_sec_per_chip", "value": 700.0,
+         "mfu_pct": 40.0, "opclass_ms": {"matmul": 60.0,
+                                         "elementwise": 40.0}},
+        {"metric": "vit_b16_ga4_images_per_sec_per_chip", "value": 650.0,
+         "mfu_pct": 37.0},
+        {"metric": "vit_b16_overlap_images_per_sec_per_chip",
+         "value": 710.0, "mfu_pct": 41.0},
+    ]
+    report = perf_lib.kernel_gap_report(rows, presets=("vit_b16",))
+    assert "@ 40.00% MFU" in report  # the canonical row, not the arms
+    wl = perf_lib.fusion_worklist(rows, presets=("vit_b16",), top_n=1)
+    assert wl and wl[0]["metric"] == "vit_b16_images_per_sec_per_chip"

@@ -121,6 +121,9 @@ class JitPurityPass(AnalysisPass):
         # device-side augmentation runs inside the jitted step (ISSUE
         # 12c) — host syncs here would serialize the train pipeline
         "pytorch_distributed_train_tpu/ops/device_augment.py",
+        # fused optimizer/block epilogues execute inside the jitted
+        # step (ISSUE 14) — same purity contract as steps.py
+        "pytorch_distributed_train_tpu/ops/fused_update.py",
     )
 
     def run(self, ctx: Context) -> list[Finding]:

@@ -19,13 +19,19 @@ real 15.75G HBM budget enforced at buffer assignment; if the topology
 probe ever fails, a structured record lands in the output and the
 arms fall back to XLA:CPU (the memfit_7b.py-validated fallback).
 
-Arms (mirroring BASELINE.md's pending list):
-  stem   — ResNet-50 train step: conv 7x7/s2 stem vs space_to_depth
-  attn   — llama train step: attention_impl xla vs chunked
-  quant  — llama decode step: int8 vs int4 weight-only params (bytes)
+Arms (mirroring BASELINE.md's pending list + the ISSUE 14 compute arms):
+  stem     — ResNet-50 train step: conv 7x7/s2 stem vs space_to_depth
+  attn     — llama train step: attention_impl xla vs chunked
+  quant    — llama decode step: int8 vs int4 weight-only params (bytes)
+  epilogue — train-step optimizer epilogue: optax chain + gate select
+             vs the one-pass fused epilogue (ops/fused_update.py) —
+             bytes-accessed is the decision metric
+  overlap  — shard_map DP train step: monolithic post-backward pmean
+             vs per-bucket in-scan pmeans (collective count + bytes)
 
 Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-      python tools/aot_ab.py [--arms stem attn quant] [--small]
+      python tools/aot_ab.py [--arms stem attn quant epilogue overlap] \
+      [--small]
 """
 
 from __future__ import annotations
@@ -207,6 +213,182 @@ def _compile_decode(model_cfg, quantize: str, sh=None) -> dict:
     return out
 
 
+def _count_collectives(hlo_text: str) -> dict:
+    """All-reduce placement in a compiled HLO dump — the evidence of
+    the overlap A/B. Post-optimization XLA may COMBINE adjacent
+    all-reduces, so the raw count can coincide between arms; what
+    cannot coincide is WHERE they live: the bucketed arm issues its
+    reductions inside the accumulation scan (a while-body computation,
+    i.e. any non-ENTRY computation), the monolithic arm reduces the
+    accumulated tree in the entry computation after the loop."""
+    import re
+
+    entry = nested = 0
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if not line.startswith(" ") and "{" in line:
+            in_entry = line.lstrip().startswith("ENTRY")
+        if re.search(r" all-reduce(?:-start)?\(", line):
+            if in_entry:
+                entry += 1
+            else:
+                nested += 1
+    return {"all_reduce": entry + nested,
+            "all_reduce_entry": entry,
+            "all_reduce_in_loop": nested}
+
+
+def _compile_epilogue_arm(small: bool, fused: bool, sh=None) -> dict:
+    """ViT train step, adamw + clip + numeric guard: the optax-chain
+    epilogue (three tree passes + whole-state gate select) vs the
+    one-pass fused epilogue. Same model, same shapes — bytes-accessed
+    is the decision metric."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import (
+        ModelConfig,
+        OptimConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import (
+        make_fused_update,
+        make_optimizer,
+    )
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    if small:
+        mc = ModelConfig(name="vit_b16", num_classes=10, image_size=16,
+                         patch_size=4, hidden_size=64, num_layers=2,
+                         num_heads=4, mlp_dim=128)
+        bs = 8
+    else:
+        mc = ModelConfig(name="vit_b16", num_classes=1000, image_size=224,
+                         patch_size=16, hidden_size=768, num_layers=12,
+                         num_heads=12, mlp_dim=3072)
+        bs = 64
+    opt = OptimConfig(name="adamw", learning_rate=1e-3,
+                      schedule="constant", warmup_steps=0,
+                      weight_decay=0.01, grad_clip_norm=1.0)
+    model = build_model(mc, PrecisionConfig(compute_dtype="bfloat16"))
+    tx, sched = make_optimizer(opt, total_steps=100)
+    fe = make_fused_update(opt, sched) if fused else None
+
+    def init_state(rng):
+        variables = model.init(
+            {"params": rng},
+            jnp.zeros((1, mc.image_size, mc.image_size, 3)), train=False)
+        return TrainState.create(params=variables["params"], tx=tx)
+
+    state_shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    step = steps_lib.make_train_step(
+        model, get_loss_fn("softmax_xent"), tx, numeric_guard=True,
+        fused_update=fe)
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (bs, mc.image_size, mc.image_size, 3), jnp.float32),
+        "label": jax.ShapeDtypeStruct((bs,), jnp.int32),
+    }
+    rng_s = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    t0 = time.time()
+    compiled = jax.jit(step, donate_argnums=(0,)).lower(
+        _attach(state_shape, sh), _attach(batch, sh),
+        _attach(rng_s, sh)).compile()
+    out = _analyze(compiled)
+    out["compile_s"] = round(time.time() - t0, 1)
+    return out
+
+
+def _compile_overlap_arm(small: bool, bucketed: bool) -> dict:
+    """shard_map DP train step over the local device mesh: monolithic
+    post-backward pmean of the whole accumulated grad tree vs
+    per-bucket pmeans inside the accumulation scan. Collective counts
+    from the compiled HLO are the placement evidence; always compiles
+    on the LOCAL devices (the CPU fake-device mesh in tests/CI) — a
+    deviceless topology has no executable collective lowering to
+    count."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        OptimConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.losses import get_loss_fn
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    devs = jax.devices()
+    n = 8 if len(devs) >= 8 else len(devs)
+    mesh = build_mesh(MeshConfig(data=n, fsdp=1), devs[:n])
+    if small:
+        mc = ModelConfig(name="vit_b16", num_classes=10, image_size=16,
+                         patch_size=4, hidden_size=64, num_layers=2,
+                         num_heads=4, mlp_dim=128)
+        bs, accum, bucket_mb = 2 * n, 2, 1
+    else:
+        mc = ModelConfig(name="vit_b16", num_classes=1000, image_size=224,
+                         patch_size=16, hidden_size=768, num_layers=12,
+                         num_heads=12, mlp_dim=3072)
+        bs, accum, bucket_mb = 8 * n, 4, 25
+    opt = OptimConfig(name="momentum", learning_rate=0.1,
+                      schedule="constant", warmup_steps=0)
+    model = build_model(mc, PrecisionConfig(compute_dtype="bfloat16"))
+    tx, _ = make_optimizer(opt, total_steps=100)
+    rules = rules_for_model(mc.name)
+
+    def init_state(rng):
+        variables = model.init(
+            {"params": rng},
+            jnp.zeros((1, mc.image_size, mc.image_size, 3)), train=False)
+        return TrainState.create(params=variables["params"], tx=tx)
+
+    state_shape = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    sharding = steps_lib.state_shardings(mesh, rules, state_shape)
+    axes = ("data", "fsdp")
+    n_buckets = 0
+    if bucketed:
+        reduce_grads, buckets = steps_lib.overlap_grad_reducer(
+            state_shape.params, bucket_mb, axes)
+        reduce_accum = None
+        n_buckets = len(buckets)
+    else:
+        reduce_grads = None
+        reduce_accum = steps_lib.monolithic_grad_reducer(axes)
+    step = steps_lib.make_train_step(
+        model, get_loss_fn("softmax_xent"), tx, grad_accum_steps=accum,
+        reduce_grads=reduce_grads, reduce_grads_accum=reduce_accum,
+        reduce_metrics=steps_lib.metrics_reducer(axes))
+    jitted = steps_lib.jit_overlap_train_step(step, mesh, sharding)
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (bs, mc.image_size, mc.image_size, 3), jnp.float32),
+        "label": jax.ShapeDtypeStruct((bs,), jnp.int32),
+    }
+    rng_s = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    t0 = time.time()
+    compiled = jitted.lower(state_shape, batch, rng_s).compile()
+    out = _analyze(compiled)
+    out["compile_s"] = round(time.time() - t0, 1)
+    out.update(_count_collectives(compiled.as_text()))
+    out["devices"] = n
+    out["grad_accum_steps"] = accum
+    if bucketed:
+        out["grad_buckets"] = n_buckets
+    return out
+
+
 def main(argv=None) -> int:
     import jax
 
@@ -216,7 +398,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--arms", nargs="+",
                    default=["stem", "attn", "quant"],
-                   choices=["stem", "attn", "quant"])
+                   choices=["stem", "attn", "quant", "epilogue",
+                            "overlap"])
     p.add_argument("--small", action="store_true",
                    help="tiny shapes (smoke/test mode, minutes -> seconds)")
     args = p.parse_args(argv)
@@ -267,6 +450,31 @@ def main(argv=None) -> int:
         out["attn_ab"] = {"config": f"llama h{mc['hidden_size']} "
                                     f"L{mc['num_layers']} bs{bs} s{seq}",
                           **arms}
+
+    if "epilogue" in args.arms:
+        arms = {}
+        for fused in (False, True):
+            arms["fused" if fused else "chain"] = _guarded(
+                _compile_epilogue_arm, args.small, fused, sh=sh)
+        out["epilogue_ab"] = {
+            "config": ("vit train step, adamw+clip+numeric-guard, "
+                       + ("small" if args.small else "b16 bs64")),
+            "decision": "fused gbytes_accessed <= chain (one-pass "
+                        "epilogue reads/writes the grad tree once)",
+            **arms}
+
+    if "overlap" in args.arms:
+        arms = {}
+        for bucketed in (False, True):
+            arms["bucketed" if bucketed else "monolithic"] = _guarded(
+                _compile_overlap_arm, args.small, bucketed)
+        out["overlap_ab"] = {
+            "config": ("shard_map DP vit train step over local devices "
+                       + ("(small)" if args.small else "(b16)")),
+            "decision": "bucketed arm emits per-bucket all-reduces "
+                        "inside the accumulation scan (count changes "
+                        "vs the monolithic post-backward reduction)",
+            **arms}
 
     if "quant" in args.arms:
         mc = dict(vocab_size=32000, hidden_size=2048, num_layers=16,

@@ -256,7 +256,8 @@ def input_section(recs: list[dict]) -> list[str]:
 
 
 def perf_section(recs: list[dict],
-                 events: list[dict] | None = None) -> list[str]:
+                 events: list[dict] | None = None,
+                 ledger_rows: list[dict] | None = None) -> list[str]:
     """Perf-attribution summary (obs/perf.py): achieved MFU, the last
     capture's op-class split (from the ``perf`` journal category), and
     the staged input breakdown from the summary record — the one-screen
@@ -292,6 +293,27 @@ def perf_section(recs: list[dict],
             out.append(f"    {cls:<12} {ms:>10.2f}ms "
                        f"{_bar(ms / total if total else 0.0)} "
                        f"{100.0 * ms / total if total else 0.0:5.1f}%")
+    # Fusion worklist (obs/perf.py fusion_worklist): the audit's
+    # actionable rendering — top kernel-gap classes per preset mapped
+    # to the repo lever that closes them. Reads the RUN's own perf
+    # ledger rows (report() finds <run-dir>/perf_ledger.jsonl) — never
+    # the repo-global history, which would pollute every run's report
+    # with other machines' gaps.
+    if ledger_rows:
+        try:
+            from pytorch_distributed_train_tpu.obs.perf import (
+                fusion_worklist,
+            )
+
+            for it in fusion_worklist(ledger_rows)[:6]:
+                digest = (f" cfg={it['config_digest']}"
+                          if it.get("config_digest") else "")
+                out.append(
+                    f"  worklist: {it['preset']} {it['op_class']} gap "
+                    f"{it['gap_share']:.1%} ({it['mfu_pct']:.1f}% MFU"
+                    f"{digest}) -> {it['suggestion']}")
+        except Exception:
+            pass  # advisory; its absence must not fail the perf section
     if mfu_rec is None and not out:
         return ["perf: no attribution records (no mfu_pct metric, no "
                 "perf journal events — pre-perf-plane run?)"]
@@ -394,6 +416,18 @@ def report(jsonl_path: str, trace_path: str = "",
         events = _load_events(events_dir)
     except Exception:
         events = None
+    # Run-local perf ledger (trainer writes <run-dir>/perf_ledger.jsonl)
+    # feeds the perf section's fusion worklist.
+    ledger_rows = None
+    try:
+        run_ledger = os.path.join(os.path.dirname(jsonl_path),
+                                  "perf_ledger.jsonl")
+        if os.path.exists(run_ledger):
+            from pytorch_distributed_train_tpu.obs.perf import PerfLedger
+
+            ledger_rows = PerfLedger(run_ledger).load()
+    except Exception:
+        ledger_rows = None
     # Sections are INDEPENDENT by contract (pinned in
     # tests/test_obs_report.py): one malformed source — a trace.json
     # that parses but isn't the expected shape, a journal record with
@@ -403,7 +437,7 @@ def report(jsonl_path: str, trace_path: str = "",
     for name, build in (
             ("goodput", lambda: goodput_section(recs)),
             ("step-time", lambda: trend_section(recs)),
-            ("perf", lambda: perf_section(recs, events)),
+            ("perf", lambda: perf_section(recs, events, ledger_rows)),
             ("input pipeline", lambda: input_section(recs)),
             ("stragglers", lambda: straggler_section(recs)),
             ("spans", lambda: spans_section(trace_path)),

@@ -34,6 +34,8 @@ from pytorch_distributed_train_tpu.obs.perf import (  # noqa: E402
     AUDIT_PRESETS,
     PerfLedger,
     default_ledger_path,
+    fusion_worklist,
+    fusion_worklist_report,
     kernel_gap_report,
 )
 
@@ -105,10 +107,17 @@ def main(argv=None) -> int:
     p.add_argument("--audit", action="store_true",
                    help="kernel-gap report: op classes ranked by "
                         "roofline gap per preset")
+    p.add_argument("--suggest", action="store_true",
+                   help="with --audit: render the gap ranking as an "
+                        "actionable fusion worklist (top-N op-class "
+                        "gaps per preset -> the repo lever that closes "
+                        "them, with config digest + measuring capture)")
+    p.add_argument("--top", type=int, default=3,
+                   help="worklist entries per preset for --suggest")
     p.add_argument("--presets", default=",".join(AUDIT_PRESETS),
                    help="comma-separated preset prefixes for --audit")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable output for --check")
+                   help="machine-readable output for --check/--suggest")
     args = p.parse_args(argv)
 
     ledger = PerfLedger(args.path or default_ledger_path(_REPO))
@@ -131,10 +140,21 @@ def main(argv=None) -> int:
             rc = max(rc, 1 if regs else 0)
         else:
             rc = max(rc, check(ledger, args))
-    if args.audit:
+    if args.audit or args.suggest:
         did = True
         presets = tuple(s for s in args.presets.split(",") if s)
-        print(kernel_gap_report(ledger.load(), presets=presets))
+        rows = ledger.load()
+        if args.audit:
+            print(kernel_gap_report(rows, presets=presets))
+        if args.suggest:
+            if args.json:
+                json.dump({"worklist": fusion_worklist(
+                    rows, presets=presets, top_n=args.top)},
+                    sys.stdout, indent=1)
+                print()
+            else:
+                print(fusion_worklist_report(rows, presets=presets,
+                                             top_n=args.top))
     if args.show or not did:
         rc = max(rc, show(ledger, tail=args.tail))
     return rc

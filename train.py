@@ -106,6 +106,29 @@ def main(argv=None) -> int:
         print(cfg.to_json())
         return 0
 
+    if cfg.train.overlap_collectives:
+        # Latency-hiding scheduler preset (config.py — jax-free, so this
+        # runs BEFORE the jax-importing modules below initialize a
+        # backend): without it the bucketed in-scan reductions compile
+        # but serialize after compute, and the knob silently measures
+        # nothing. TPU backends only — XLA:CPU/GPU reject unknown
+        # --xla_tpu_* flags fatally (same gate as bench.py).
+        import importlib.util
+        import os
+
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        if "tpu" in plat or (
+                plat == "" and
+                importlib.util.find_spec("libtpu") is not None):
+            from pytorch_distributed_train_tpu.config import (
+                ensure_latency_hiding_flags,
+            )
+
+            if ensure_latency_hiding_flags():
+                print("[launch] overlap_collectives: appended the "
+                      "latency-hiding scheduler preset to XLA_FLAGS",
+                      flush=True)
+
     from pytorch_distributed_train_tpu.launch import initialize_distributed, runtime_info
     from pytorch_distributed_train_tpu.trainer import Trainer
 
