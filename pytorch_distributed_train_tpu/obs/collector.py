@@ -394,9 +394,15 @@ class FleetCollector:
 
     # ------------------------------------------------------------- scrape
     def _scrape_one(self, t: Target, now_mono: float) -> None:
-        if getattr(t, "_inflight", False):
-            return  # a previous (hung) scrape of this target still runs
-        t._inflight = True
+        # Claim under the collector lock: the bare check-then-set raced
+        # two concurrent poll() callers (the collector thread + a
+        # console tick) into DOUBLE-scraping the same target — exactly
+        # the in-flight pile-up the flag exists to prevent (concurrency
+        # plane true positive, collector scrape-thread state).
+        with self._lock:
+            if getattr(t, "_inflight", False):
+                return  # a previous (hung) scrape of this target runs
+            t._inflight = True
         try:
             self._scrape_locked(t, now_mono)
         finally:

@@ -72,6 +72,9 @@ CATEGORIES: dict[str, str] = {
             "and perf-ledger rows (obs/perf.py)",
     "alert": "fleet alert-rule transitions: fired, resolved, capture "
              "requests (obs/alerts.py)",
+    "sanitizer": "runtime concurrency-sanitizer findings: lock-order "
+                 "inversions, hold-while-blocking, unjoined threads, "
+                 "deadlock watchdog trips (utils/syncdbg.py)",
 }
 
 

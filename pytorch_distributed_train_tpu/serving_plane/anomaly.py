@@ -22,6 +22,7 @@ On a spike the monitor:
 
 from __future__ import annotations
 
+import threading
 import time
 
 from pytorch_distributed_train_tpu.obs import events as events_lib
@@ -81,11 +82,23 @@ class TailLatencyMonitor:
                 and now - self._last_capture_ts < self.cooldown_s):
             return
         self._last_capture_ts = now
-        try:
-            # reason == anomaly kind: timeline_report's causal-chain
-            # matcher pairs the capture with THIS anomaly by it
-            self.profiler.capture_for_seconds(self.capture_seconds,
-                                              reason=kind)
-        except Exception as e:  # noqa: BLE001 — detection must outlive it
-            print(f"[serve] tail-latency capture failed "
-                  f"({type(e).__name__}: {e})", flush=True)
+
+        # Concurrency-plane true positive (lock-order graph + syncdbg
+        # hold_while_blocking): observe_* runs on the serve scheduler
+        # UNDER the service lock, and a capture start is blocking work
+        # (profiler lock, capture-dir mkdir, jax profiler start) —
+        # every intake/shed/healthz handler would stall behind it. The
+        # capture is fired off-thread; the cooldown stamp above stays
+        # on the calling thread so a burst still fires exactly once.
+        def _capture():
+            try:
+                # reason == anomaly kind: timeline_report's causal-chain
+                # matcher pairs the capture with THIS anomaly by it
+                self.profiler.capture_for_seconds(self.capture_seconds,
+                                                  reason=kind)
+            except Exception as e:  # noqa: BLE001 — must outlive it
+                print(f"[serve] tail-latency capture failed "
+                      f"({type(e).__name__}: {e})", flush=True)
+
+        threading.Thread(target=_capture, daemon=True,
+                         name="tail-latency-capture").start()

@@ -1,0 +1,725 @@
+"""tsan-lite runtime concurrency sanitizer (``PDTT_SANITIZE=1``).
+
+The dynamic half of the concurrency correctness plane: the static
+``lock-order`` / ``thread-lifecycle`` passes (tools/analyze/) prove
+what they can see; this module watches the locks the program *actually
+takes*. Drop-in instrumented ``Lock``/``RLock``/``Condition``/
+``Thread`` replace the ``threading`` factories while active, and:
+
+- maintain the **runtime lock-order graph** keyed by lock *creation
+  site* (``path:line`` — the same identity the static pass uses, so
+  ``python -m tools.analyze --only lock-order --compare-runtime g.json``
+  can diff the two and name the static pass's blind spots);
+- flag a **lock-order inversion the moment the second edge direction
+  appears** — before any real deadlock needs the losing interleaving
+  (``lock_inversion``);
+- flag **blocking while holding a lock** longer than
+  ``PDTT_SANITIZE_BLOCK_S`` — a slow acquire, ``Condition.wait`` on
+  another lock, or ``Thread.join`` under a lock stalls every thread
+  behind the held lock (``hold_while_blocking``);
+- at teardown (``check_teardown()``, also an ``atexit`` hook) flag
+  **non-daemon threads that were started but never joined**
+  (``unjoined_thread``);
+- run a **deadlock watchdog**: any thread stuck in an instrumented
+  acquire for ``PDTT_SANITIZE_DEADLOCK_S`` gets every thread's stack
+  dumped plus the wait-for cycle (who holds what, who waits for whom)
+  named (``deadlock``).
+
+Findings are printed, counted (``sanitizer_findings_total{kind=}``)
+and journaled under the ``sanitizer`` event category; ``findings()``
+returns them for asserts; soak tools exit nonzero on any. No jax
+imports, obs imported lazily — the elastic agent and data workers can
+activate this without touching a device backend.
+
+Knobs (env): ``PDTT_SANITIZE=1`` activates (tests/conftest.py and the
+tool entry points call :func:`maybe_activate`); ``PDTT_SANITIZE_BLOCK_S``
+(default 1.0) is the blocking-while-holding threshold;
+``PDTT_SANITIZE_DEADLOCK_S`` (default 20.0) the watchdog trip;
+``PDTT_SANITIZE_GRAPH`` a path to auto-dump the runtime graph to at
+exit.
+
+Known limit: identity is the creation site, so two *instances* born on
+one line nesting in both orders read as a self-pair and are skipped —
+instance-level AB/BA needs distinct sites to be named.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+# Originals, saved at import: wrappers and the sanitizer's own state
+# must run on the REAL primitives whatever is patched later.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+_REAL_THREAD = threading.Thread
+
+FINDING_KINDS = ("lock_inversion", "hold_while_blocking",
+                 "unjoined_thread", "deadlock")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+class Finding:
+    __slots__ = ("kind", "message", "detail", "ts")
+
+    def __init__(self, kind: str, message: str, detail: dict):
+        self.kind = kind
+        self.message = message
+        self.detail = detail
+        self.ts = time.time()
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "message": self.message,
+                "detail": self.detail, "ts": self.ts}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Finding({self.kind}: {self.message})"
+
+
+class _State:
+    def __init__(self):
+        self.lock = _REAL_RLOCK()
+        self.edges: dict[tuple[str, str], dict] = {}
+        self.findings: list[Finding] = []
+        self.threads: list = []              # live SanThread bookkeeping
+        self.waiting: dict[int, tuple] = {}  # ident -> (lock, t0, held)
+        self.owners: dict[int, int] = {}     # id(lock) -> owner ident
+        self.reported_deadlocks: set[frozenset] = set()
+        self.block_s = _env_f("PDTT_SANITIZE_BLOCK_S", 1.0)
+        self.deadlock_s = _env_f("PDTT_SANITIZE_DEADLOCK_S", 20.0)
+        self.watchdog_poll_s = 0.5
+        self.watchdog = None
+        self.epoch = 0     # bumps on (de)activate: retires old watchdogs
+
+
+_state = _State()
+_tls = threading.local()
+_ACTIVE = False
+_HOOKS_INSTALLED = False
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _suppressed() -> bool:
+    return bool(getattr(_tls, "in_record", False))
+
+
+def _rel(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap.startswith(_REPO_ROOT + os.sep):
+        return ap[len(_REPO_ROOT) + 1:].replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def _creation_site() -> str:
+    f = sys._getframe(1)
+    while f is not None and f.f_globals.get("__name__", "") == __name__:
+        f = f.f_back
+    # threading internals (Event/Queue/Barrier building conditions and
+    # locks through the patched factories) are not useful identities —
+    # walk out to the first frame beyond the threading module too
+    while f is not None and f.f_globals.get("__name__", "") == "threading":
+        f = f.f_back
+    if f is None:  # pragma: no cover
+        return "<unknown>:0"
+    return f"{_rel(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _short_stack(limit: int = 10) -> list[str]:
+    out = []
+    for line in traceback.format_stack()[:-2][-limit:]:
+        out.append(line.strip().replace("\n", " | "))
+    return out
+
+
+def _record(kind: str, message: str, **detail) -> None:
+    f = Finding(kind, message, detail)
+    with _state.lock:
+        _state.findings.append(f)
+    if _suppressed():
+        return
+    _tls.in_record = True
+    try:
+        print(f"[syncdbg] {kind}: {message}", file=sys.stderr, flush=True)
+        try:
+            from pytorch_distributed_train_tpu.obs.registry import (
+                get_registry,
+            )
+
+            get_registry().counter(
+                "sanitizer_findings_total", labels={"kind": kind},
+                help="runtime concurrency-sanitizer findings by "
+                     "kind").inc()
+        except Exception:
+            pass
+        try:
+            from pytorch_distributed_train_tpu.obs import events as ev
+
+            ev.emit("sanitizer", kind, message=message, **detail)
+        except Exception:
+            pass
+    finally:
+        _tls.in_record = False
+
+
+# ------------------------------------------------------------- the graph
+def _note_acquired(lock) -> None:
+    """Edges held -> lock; inversion check the moment the second
+    direction appears."""
+    held = _held()
+    if held and not _suppressed():
+        me = threading.current_thread().name
+        for h in held:
+            a, b = h.site, lock.site
+            if a == b:
+                continue
+            with _state.lock:
+                fwd = _state.edges.get((a, b))
+                rev = _state.edges.get((b, a))
+                if fwd is None:
+                    _state.edges[(a, b)] = fwd = {
+                        "count": 0, "thread": me,
+                        "stack": _short_stack()}
+                fwd["count"] += 1
+                inverted = rev is not None and not fwd.get("reported") \
+                    and not rev.get("reported")
+                if inverted:
+                    fwd["reported"] = rev["reported"] = True
+            if inverted:
+                _record(
+                    "lock_inversion",
+                    f"lock order inverted: `{b}` was acquired while "
+                    f"holding `{a}` (thread {me}), but `{a}` has been "
+                    f"acquired while holding `{b}` (thread "
+                    f"{rev['thread']}) — these two paths deadlock under "
+                    f"the right interleaving",
+                    edge=[a, b], reverse_stack=rev["stack"],
+                    stack=_short_stack())
+    with _state.lock:
+        _state.owners[id(lock)] = threading.get_ident()
+    held.append(lock)
+
+
+def _note_released(lock) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            break
+    if not any(h is lock for h in held):
+        with _state.lock:
+            _state.owners.pop(id(lock), None)
+
+
+def _blocking_guard(what: str, lock, t0: float, waited: float) -> None:
+    """hold_while_blocking: we just blocked `waited` seconds on `what`
+    while other locks were held."""
+    if _suppressed() or waited < _state.block_s:
+        return
+    others = [h.site for h in _held() if h is not lock]
+    if not others:
+        return
+    _record(
+        "hold_while_blocking",
+        f"blocked {waited:.2f}s in {what} while holding "
+        f"{', '.join('`%s`' % s for s in others)} — every thread behind "
+        f"those locks stalled for the whole wait",
+        what=what, waited_s=round(waited, 3), held=others,
+        stack=_short_stack())
+
+
+class _Waiting:
+    """Context: this thread blocks on `lock` (watchdog visibility)."""
+
+    def __init__(self, lock, what: str):
+        self.lock = lock
+        self.what = what
+        self.t0 = time.monotonic()
+
+    def __enter__(self):
+        if not _suppressed():
+            with _state.lock:
+                _state.waiting[threading.get_ident()] = (
+                    self.lock, self.t0, tuple(h.site for h in _held()),
+                    self.what)
+        return self
+
+    def __exit__(self, *exc):
+        with _state.lock:
+            _state.waiting.pop(threading.get_ident(), None)
+        return False
+
+
+# ------------------------------------------------------------- wrappers
+class _SanBase:
+    _kind = "Lock"
+
+    def __init__(self, real):
+        self._real = real
+        self.site = _creation_site()
+
+    # threading.Condition support for wrapped locks
+    def _release_save(self):
+        _note_released(self)
+        return self._real._release_save() if hasattr(
+            self._real, "_release_save") else (self._real.release() or True)
+
+    def _acquire_restore(self, state):
+        if hasattr(self._real, "_acquire_restore"):
+            self._real._acquire_restore(state)
+        else:
+            self._real.acquire()
+        _held().append(self)
+        with _state.lock:
+            _state.owners[id(self)] = threading.get_ident()
+
+    def _is_owned(self):
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        if self._real.acquire(False):
+            self._real.release()
+            return False
+        return True
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = time.monotonic()
+        if blocking:
+            with _Waiting(self, f"{self._kind}.acquire"):
+                got = self._real.acquire(True, timeout)
+        else:
+            got = self._real.acquire(False)
+        if got:
+            waited = time.monotonic() - t0
+            first = not any(h is self for h in _held())
+            if first:
+                _blocking_guard(f"{self._kind}.acquire", self, t0, waited)
+                _note_acquired(self)
+            else:
+                _held().append(self)   # re-entrant: bookkeeping only
+        return got
+
+    def release(self):
+        _note_released(self)
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    def _at_fork_reinit(self):
+        # stdlib contract (concurrent.futures registers it as an
+        # at-fork hook): reinitialize the underlying primitive
+        self._real._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<San{self._kind} site={self.site}>"
+
+
+class SanLock(_SanBase):
+    _kind = "Lock"
+
+
+class SanRLock(_SanBase):
+    _kind = "RLock"
+
+
+def Lock():
+    return SanLock(_REAL_LOCK())
+
+
+def RLock():
+    return SanRLock(_REAL_RLOCK())
+
+
+class Condition:
+    """Sanitized Condition: a real Condition over the (unwrapped) real
+    lock, with held-stack bookkeeping on the wrapper. Entering the
+    Condition acquires its lock — same stance as the static passes."""
+
+    def __init__(self, lock=None):
+        if lock is None:
+            lock = SanRLock(_REAL_RLOCK())
+            lock.site = _creation_site()
+        elif not isinstance(lock, _SanBase):
+            lock = SanLock(lock) if not hasattr(lock, "_release_save") \
+                else SanRLock(lock)
+            lock.site = _creation_site()
+        self._san = lock
+        self.site = lock.site
+        self._cond = _REAL_CONDITION(lock._real)
+
+    def acquire(self, *a, **kw):
+        return self._san.acquire(*a, **kw)
+
+    def release(self):
+        self._san.release()
+
+    def __enter__(self):
+        self._san.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._san.release()
+        return False
+
+    def wait(self, timeout=None):
+        # the real wait releases the lock: mirror that in the held
+        # stack, and time the block — waiting on a condition while
+        # holding ANOTHER lock is the hold_while_blocking pattern.
+        # Ownership is pre-checked HERE so the un-acquired-lock
+        # RuntimeError fires before any bookkeeping: the finally below
+        # assumes the real wait released-then-reacquired (which CPython
+        # guarantees even on interruption mid-wait, via its own
+        # finally), and must not fabricate a held entry for a lock
+        # this thread never owned.
+        if not self._cond._is_owned():
+            raise RuntimeError("cannot wait on un-acquired lock")
+        t0 = time.monotonic()
+        _note_released(self._san)
+        try:
+            with _Waiting(self._san, "Condition.wait"):
+                return self._cond.wait(timeout)
+        finally:
+            _held().append(self._san)
+            with _state.lock:
+                _state.owners[id(self._san)] = threading.get_ident()
+            _blocking_guard("Condition.wait", self._san, t0,
+                            time.monotonic() - t0)
+
+    def wait_for(self, predicate, timeout=None):
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait(None)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    notifyAll = notify_all
+
+    def _at_fork_reinit(self):
+        self._san._at_fork_reinit()
+        self._cond._at_fork_reinit()
+
+
+class Thread(_REAL_THREAD):
+    """Instrumented thread: records its creation site and whether it
+    was ever joined, for the teardown unjoined-thread check; times
+    joins performed while locks are held. Registration happens at
+    ``start()`` — daemonness is final there — and only for non-daemon
+    threads (daemons are exempt from the teardown check anyway), with
+    deregistration on a completed join, so a long sanitized soak's
+    thread churn cannot grow the registry without bound."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.san_site = _creation_site()
+        self.san_joined = False
+
+    def start(self):
+        if not self.daemon:
+            with _state.lock:
+                _state.threads.append(self)
+        super().start()
+
+    def join(self, timeout=None):
+        t0 = time.monotonic()
+        with _Waiting(None, "Thread.join"):
+            super().join(timeout)
+        if not self.is_alive():
+            self.san_joined = True
+            with _state.lock:
+                try:
+                    _state.threads.remove(self)
+                except ValueError:
+                    pass
+        if _held():
+            _blocking_guard("Thread.join", None, t0,
+                            time.monotonic() - t0)
+
+
+# ------------------------------------------------------------- watchdog
+def _dump_all_stacks(out) -> None:
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        f = frames.get(t.ident)
+        if f is None:
+            continue
+        print(f"--- thread {t.name} (ident {t.ident}, "
+              f"daemon={t.daemon}) ---", file=out)
+        for line in traceback.format_stack(f):
+            print("  " + line.rstrip().replace("\n", "\n  "), file=out)
+
+
+def _find_wait_cycle(start_ident: int):
+    """Follow waiter -> held lock's owner -> their waited lock ... and
+    return the lock-site cycle if it loops, else None."""
+    with _state.lock:
+        waiting = dict(_state.waiting)
+        owners = dict(_state.owners)
+    path_sites: list[str] = []
+    seen: list[int] = []
+    ident = start_ident
+    while ident not in seen:
+        seen.append(ident)
+        entry = waiting.get(ident)
+        if entry is None or entry[0] is None:
+            return None
+        lock = entry[0]
+        path_sites.append(lock.site)
+        ident = owners.get(id(lock))
+        if ident is None:
+            return None
+        if ident == start_ident:
+            return path_sites
+    return None
+
+
+def _watchdog_loop(epoch: int) -> None:
+    # epoch-tagged: a deactivate→activate cycle within one poll must
+    # retire THIS loop even though _ACTIVE reads true again — only the
+    # newest epoch's watchdog survives
+    while _ACTIVE and _state.epoch == epoch:
+        time.sleep(_state.watchdog_poll_s)
+        if not _ACTIVE or _state.epoch != epoch:
+            return
+        now = time.monotonic()
+        stuck = []
+        with _state.lock:
+            for ident, entry in _state.waiting.items():
+                # an idle consumer parked on its own condition holding
+                # nothing is NORMAL (the persister between persists);
+                # only hold-and-wait past the deadline is a hazard
+                if now - entry[1] >= _state.deadlock_s and entry[2]:
+                    stuck.append((ident, entry))
+        if not stuck:
+            continue
+        idents = frozenset(i for i, _ in stuck)
+        with _state.lock:
+            if idents in _state.reported_deadlocks:
+                continue
+            _state.reported_deadlocks.add(idents)
+        cycle = None
+        for ident, _entry in stuck:
+            cycle = _find_wait_cycle(ident)
+            if cycle:
+                break
+        names = {t.ident: t.name for t in threading.enumerate()}
+        waits = "; ".join(
+            f"{names.get(i, i)} stuck {now - e[1]:.1f}s in {e[3]} on "
+            f"`{e[0].site if e[0] is not None else '<thread>'}` "
+            f"(holding {', '.join(e[2]) or 'nothing'})"
+            for i, e in stuck)
+        cyc = (" wait-for cycle: " + " -> ".join(cycle + [cycle[0]])
+               if cycle else " (no closed cycle found — a hold-and-wait "
+               "or a lost wakeup)")
+        _record("deadlock",
+                f"threads blocked past {_state.deadlock_s:.0f}s: {waits}."
+                + cyc,
+                cycle=cycle or [], waiters=sorted(names.get(i, str(i))
+                                                  for i in idents))
+        print("[syncdbg] all-thread stack dump follows", file=sys.stderr)
+        _dump_all_stacks(sys.stderr)
+
+
+# ------------------------------------------------------------- lifecycle
+def activate(*, block_s: float | None = None,
+             deadlock_s: float | None = None,
+             watchdog_poll_s: float | None = None) -> None:
+    """Patch threading's factories and start the watchdog. Idempotent."""
+    global _ACTIVE
+    if block_s is not None:
+        _state.block_s = block_s
+    if deadlock_s is not None:
+        _state.deadlock_s = deadlock_s
+    if watchdog_poll_s is not None:
+        _state.watchdog_poll_s = watchdog_poll_s
+    if _ACTIVE:
+        return
+    _ACTIVE = True
+    _state.epoch += 1
+    threading.Lock = Lock
+    threading.RLock = RLock
+    threading.Condition = Condition
+    threading.Thread = Thread
+    _state.watchdog = _REAL_THREAD(target=_watchdog_loop,
+                                   args=(_state.epoch,), daemon=True,
+                                   name="syncdbg-watchdog")
+    _state.watchdog.start()
+    global _HOOKS_INSTALLED
+    if not _HOOKS_INSTALLED:
+        _HOOKS_INSTALLED = True
+        atexit.register(_atexit_hook)
+        try:
+            # forked workers (data/workers.py) inherit the parent's
+            # state: its thread registry would read as "unjoined" at
+            # the child's teardown — start the child clean
+            os.register_at_fork(after_in_child=_after_fork_in_child)
+        except (AttributeError, ValueError):  # pragma: no cover
+            pass
+
+
+def _after_fork_in_child() -> None:
+    """Fork-child reset. The inherited ``_state.lock`` may be HELD by a
+    parent thread that does not exist here — acquiring it (as a plain
+    ``reset()`` would) could wedge the child inside ``os.fork``. The
+    child is single-threaded at this instant, so swap in a fresh lock
+    and clear lock-free."""
+    _state.lock = _REAL_RLOCK()
+    _state.edges.clear()
+    _state.findings.clear()
+    _state.threads.clear()
+    _state.waiting.clear()
+    _state.owners.clear()
+    _state.reported_deadlocks.clear()
+
+
+def deactivate() -> None:
+    """Restore the real factories (wrapped objects keep working: they
+    hold their real primitive). The watchdog thread exits on its next
+    poll."""
+    global _ACTIVE
+    if not _ACTIVE:
+        return
+    _ACTIVE = False
+    _state.epoch += 1   # retires the current watchdog immediately-ish
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    threading.Thread = _REAL_THREAD
+
+
+def maybe_activate() -> bool:
+    """Activate iff ``PDTT_SANITIZE=1`` — the one-liner for conftest
+    and tool entry points."""
+    if os.environ.get("PDTT_SANITIZE") == "1":
+        activate()
+        return True
+    return False
+
+
+def active() -> bool:
+    return _ACTIVE
+
+
+def _atexit_hook() -> None:
+    check_teardown()
+    path = os.environ.get("PDTT_SANITIZE_GRAPH")
+    if path:
+        try:
+            dump_graph(path)
+        except OSError:
+            pass
+
+
+def check_teardown() -> list[Finding]:
+    """Flag non-daemon sanitized threads that were started but never
+    joined. Returns the new findings."""
+    new: list[Finding] = []
+    with _state.lock:
+        threads = list(_state.threads)
+    for t in threads:
+        if t.daemon or t.san_joined or not t.ident:
+            continue  # never started / daemon / joined: fine
+        if t is threading.current_thread():
+            continue
+        state = "still alive" if t.is_alive() else "finished"
+        _record("unjoined_thread",
+                f"non-daemon thread {t.name!r} (created at "
+                f"{t.san_site}) was started but never joined — "
+                f"{state} at teardown",
+                thread=t.name, site=t.san_site, alive=t.is_alive())
+        t.san_joined = True   # one report per thread
+        with _state.lock:
+            try:                # reported: drop from the registry too
+                _state.threads.remove(t)
+            except ValueError:
+                pass
+        new.append(_state.findings[-1])
+    return new
+
+
+# --------------------------------------------------------------- readout
+def findings(kind: str | None = None) -> list[Finding]:
+    with _state.lock:
+        fs = list(_state.findings)
+    return fs if kind is None else [f for f in fs if f.kind == kind]
+
+
+def findings_summary() -> dict:
+    out: dict[str, int] = {}
+    for f in findings():
+        out[f.kind] = out.get(f.kind, 0) + 1
+    return out
+
+
+def edges() -> dict:
+    with _state.lock:
+        return {k: dict(v) for k, v in _state.edges.items()}
+
+
+def dump_graph(path: str) -> str:
+    """Write the observed runtime lock-order graph as JSON — the
+    ``--compare-runtime`` input."""
+    with _state.lock:
+        recs = [{"from": a, "to": b, "count": e["count"],
+                 "thread": e["thread"], "stack": e["stack"]}
+                for (a, b), e in sorted(_state.edges.items())]
+        fcount = len(_state.findings)
+    data = {"format": "pdtt-syncdbg-graph-v1", "edges": recs,
+            "findings": fcount}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def reset() -> None:
+    """Tests: drop edges/findings/thread registry (wrappers stay)."""
+    with _state.lock:
+        _state.edges.clear()
+        _state.findings.clear()
+        _state.threads.clear()
+        _state.waiting.clear()
+        _state.owners.clear()
+        _state.reported_deadlocks.clear()

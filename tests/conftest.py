@@ -45,6 +45,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# PDTT_SANITIZE=1: patch threading with the tsan-lite wrappers
+# (utils/syncdbg.py) for the whole test process — after the jax import
+# on purpose, so jax's own import-time locks stay real and findings
+# point at OUR code. The sanitized soak test runs this way end-to-end.
+from pytorch_distributed_train_tpu.utils import syncdbg as _syncdbg  # noqa: E402,I001
+
+_syncdbg.maybe_activate()
+
 import pytest  # noqa: E402
 
 

@@ -23,6 +23,145 @@ def _repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def _changed_paths(root: str) -> list[str] | None:
+    """Git-changed .py files (worktree vs HEAD + untracked) that are on
+    the analyzed surface; None when git itself fails (not a repo)."""
+    import subprocess
+
+    out: set[str] = set()
+    for args in (("git", "diff", "--name-only", "HEAD", "--"),
+                 ("git", "ls-files", "--others", "--exclude-standard")):
+        try:
+            r = subprocess.run(args, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        out.update(line.strip() for line in r.stdout.splitlines()
+                   if line.strip())
+    surface = set(core.discover(root))
+    return sorted(p for p in out if p in surface)
+
+
+def _json_payload(passes, unsuppressed, suppressed=(), stale=(),
+                  syntax_errors=()) -> dict:
+    """The one --format json document shape — shared by the normal run
+    and the empty clean-tree --changed path so the two can't drift."""
+    return {
+        "passes": sorted(passes),
+        "findings": [f.as_dict() for f in unsuppressed],
+        "suppressed": [f.as_dict() for f in suppressed],
+        "stale_baseline": list(stale),
+        "syntax_errors": list(syntax_errors),
+        "counts": {"findings": len(unsuppressed),
+                   "suppressed": len(suppressed),
+                   "stale_baseline": len(stale)},
+    }
+
+
+def _sarif(passes, findings: list[core.Finding]) -> dict:
+    """SARIF 2.1.0 — one run, one rule per pass, one result per
+    unsuppressed finding; CI annotates inline from this."""
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "pdtt-analyze",
+                "informationUri": "docs/static_analysis.md",
+                "rules": [{"id": pid,
+                           "shortDescription": {"text": p.description}}
+                          for pid, p in sorted(passes.items())],
+            }},
+            "results": [{
+                "ruleId": f.pass_id,
+                "level": "warning" if f.severity == "warning" else "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+                "partialFingerprints": {
+                    "pdttFingerprint/v1":
+                        f"{f.pass_id}|{f.path}|{f.key}"},
+            } for f in findings],
+        }],
+    }
+
+
+def _compare_runtime(graph_path: str, ctx, out) -> int:
+    """Diff the static lock-order graph against a syncdbg runtime
+    recording. Exit 1 when the runtime saw edges the static pass is
+    blind to — each one is a named pass gap, not a silent blind spot."""
+    from tools.analyze.passes import lock_order
+
+    try:
+        with open(graph_path, encoding="utf-8") as f:
+            data = json.load(f)
+        runtime_edges = data["edges"]
+    except (OSError, ValueError, KeyError) as e:
+        print(f"analyze: cannot read runtime graph {graph_path}: {e}",
+              file=sys.stderr)
+        return 2
+    static = lock_order.build_graph(ctx)
+    site_to_node: dict[str, str] = {}
+    for node, sites in static.nodes.items():
+        for path, line in sites:
+            site_to_node[f"{path}:{line}"] = node
+
+    covered = 0
+    foreign = 0
+    gaps: list[str] = []
+    for e in runtime_edges:
+        a, b = e.get("from", ""), e.get("to", "")
+        pa, pb = a.rsplit(":", 1)[0], b.rsplit(":", 1)[0]
+        # a lock born outside the pass's SCOPE (tests, soak drivers,
+        # native/) can never have a static node — skipping it is
+        # honest; only on-scope sites the pass misses are gaps
+        if not (core.path_matches(pa, lock_order.SCOPE)
+                and core.path_matches(pb, lock_order.SCOPE)):
+            foreign += 1
+            continue
+        na, nb = site_to_node.get(a), site_to_node.get(b)
+        if na is None or nb is None:
+            missing = a if na is None else b
+            gaps.append(
+                f"runtime lock at {missing} is UNKNOWN to lock-order "
+                f"(edge {a} -> {b}, thread {e.get('thread')}) — the "
+                f"creation pattern is outside the pass's lock model")
+            continue
+        if (na, nb) in static.edges:
+            covered += 1
+            continue
+        gaps.append(
+            f"runtime edge {lock_order._short(na)} -> "
+            f"{lock_order._short(nb)} (thread {e.get('thread')}) has no "
+            f"static counterpart — the acquisition path is invisible to "
+            f"lock-order (dynamic dispatch, callback, or an unresolved "
+            f"collaborator); cycles through it would go unreported")
+    unobserved = [f"{lock_order._short(a)} -> {lock_order._short(b)}"
+                  for (a, b) in sorted(static.edges)
+                  if not any(
+                      site_to_node.get(e.get("from", "")) == a
+                      and site_to_node.get(e.get("to", "")) == b
+                      for e in runtime_edges)]
+
+    print(f"compare-runtime: {len(runtime_edges)} runtime edge(s): "
+          f"{covered} covered statically, {len(gaps)} pass gap(s), "
+          f"{foreign} skipped (locks outside the analyzed surface)",
+          file=out)
+    for g in gaps:
+        print(f"  GAP: {g}", file=out)
+    if unobserved:
+        print(f"  note: {len(unobserved)} static edge(s) never observed "
+              f"at runtime (fine — the recording did not drive those "
+              f"paths): {', '.join(unobserved[:6])}"
+              + (" ..." if len(unobserved) > 6 else ""), file=out)
+    return 1 if gaps else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.analyze",
@@ -31,8 +170,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*",
                    help="repo-relative files to analyze (default: the "
                         "whole production surface)")
+    p.add_argument("--changed", action="store_true",
+                   help="analyze only git-changed files (working tree "
+                        "vs HEAD, plus untracked) — the pre-commit "
+                        "fast path; catalog passes skip their whole-"
+                        "surface directions as for any scoped run")
     p.add_argument("--only", default=None, metavar="PASS[,PASS...]",
                    help="run only these passes")
+    p.add_argument("--compare-runtime", default=None, metavar="GRAPH",
+                   help="diff the static lock-order graph against a "
+                        "runtime recording (utils/syncdbg.py "
+                        "dump_graph JSON); runtime edges the AST pass "
+                        "cannot see become a named pass-gap report "
+                        "(exit 1)")
     p.add_argument("--baseline", default=None, metavar="PATH",
                    help="baseline suppressions file (default: "
                         f"{baseline_lib.DEFAULT_BASELINE} when present)")
@@ -41,7 +191,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="rewrite the baseline to suppress every current "
                         "finding (stale entries expire)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--root", default=None, help=argparse.SUPPRESS)
     p.add_argument("--list-passes", action="store_true",
                    help="list registered passes and exit")
@@ -68,8 +219,45 @@ def main(argv: list[str] | None = None, out=None) -> int:
         passes = {pid: passes[pid] for pid in wanted}
 
     root = os.path.abspath(args.root) if args.root else _repo_root()
+
+    if args.compare_runtime is not None:
+        # a diagnostic mode, not a findings run: diff static vs runtime
+        # lock-order graphs; exit 1 = the pass has named blind spots.
+        # Dispatched BEFORE any --changed/path scoping, always over the
+        # FULL surface — a scoped context would misreport locks in
+        # un-analyzed files as blind spots (and a clean --changed tree
+        # must not skip the comparison entirely).
+        return _compare_runtime(args.compare_runtime,
+                                core.build_context(root), out)
+
     paths = list(args.paths) or None
-    if paths:
+    if args.changed:
+        if paths:
+            print("analyze: --changed and explicit paths are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        changed = _changed_paths(root)
+        if changed is None:
+            print("analyze: --changed needs a git worktree", file=sys.stderr)
+            return 2
+        if not changed:
+            # machine formats still get a parseable (empty) document —
+            # the CLEAN tree is the common case in a SARIF/JSON
+            # pipeline and must not feed it a prose line
+            if args.format == "sarif":
+                json.dump(_sarif(passes, []), out, indent=2,
+                          ensure_ascii=False)
+                out.write("\n")
+            elif args.format == "json":
+                json.dump(_json_payload(passes, []), out, indent=2,
+                          ensure_ascii=False)
+                out.write("\n")
+            else:
+                print("analyze: no changed files on the analyzed "
+                      "surface", file=out)
+            return 0
+        paths = changed
+    if paths and not args.changed:
         missing = [p for p in paths
                    if not os.path.isfile(os.path.join(root, p))]
         if missing:
@@ -114,7 +302,8 @@ def main(argv: list[str] | None = None, out=None) -> int:
     if args.write_baseline:
         target = bl_path or os.path.join(root, baseline_lib.DEFAULT_BASELINE)
         keep: list[dict] = []
-        if bl is not None and (args.only or args.paths):
+        if bl is not None and (args.only or paths):
+            # `paths`, not `args.paths`: a --changed run is scoped too
             # A scoped run only re-evaluated (selected passes ×
             # analyzed files): entries outside that product were not
             # looked at and must survive the rewrite.
@@ -135,17 +324,14 @@ def main(argv: list[str] | None = None, out=None) -> int:
 
     syntax_errors = [sf.path for sf in ctx.files if sf.tree is None]
 
-    if args.format == "json":
-        json.dump({
-            "passes": sorted(passes),
-            "findings": [f.as_dict() for f in unsuppressed],
-            "suppressed": [f.as_dict() for f in suppressed],
-            "stale_baseline": stale,
-            "syntax_errors": syntax_errors,
-            "counts": {"findings": len(unsuppressed),
-                       "suppressed": len(suppressed),
-                       "stale_baseline": len(stale)},
-        }, out, indent=2, ensure_ascii=False)
+    if args.format == "sarif":
+        json.dump(_sarif(passes, unsuppressed), out, indent=2,
+                  ensure_ascii=False)
+        out.write("\n")
+    elif args.format == "json":
+        json.dump(_json_payload(passes, unsuppressed, suppressed, stale,
+                                syntax_errors), out, indent=2,
+                  ensure_ascii=False)
         out.write("\n")
     else:
         for f in unsuppressed:

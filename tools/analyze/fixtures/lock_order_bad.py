@@ -1,0 +1,49 @@
+"""Seeded lock-order violations for the lock-order pass tests.
+
+``Pool`` takes A then B on the submit path, but B then (via a helper)
+A on the reclaim path — the classic AB/BA cycle, closed only
+inter-procedurally. ``Mixer`` closes a second cycle through a module
+lock and a cross-class call.
+"""
+
+import threading
+
+_MOD_LOCK = threading.Lock()
+
+
+class Pool:
+    def __init__(self):
+        self._slots = threading.Lock()
+        self._stats = threading.Lock()
+
+    def submit(self):
+        with self._slots:
+            with self._stats:
+                pass
+
+    def reclaim(self):
+        with self._stats:
+            self._count()
+
+    def _count(self):
+        with self._slots:
+            pass
+
+
+class Mixer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pool = Pool()
+
+    def tick(self):
+        with _MOD_LOCK:
+            with self._lock:
+                pass
+
+    def tock(self):
+        with self._lock:
+            self.grab()
+
+    def grab(self):
+        with _MOD_LOCK:
+            pass

@@ -1,0 +1,50 @@
+"""Near-miss patterns the lock-order pass must NOT flag: consistent
+ordering (edges, no cycle), re-entry of the same lock, closures
+defined under a lock, and an injected collaborator used one-way."""
+
+import threading
+
+_MOD_LOCK = threading.Lock()
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+
+class Outer:
+    """Always module -> outer -> inner: a chain, never a cycle."""
+
+    def __init__(self, inner=None):
+        self._lock = threading.Lock()
+        self.inner = inner if inner is not None else Inner()
+
+    def fwd(self):
+        with self._lock:
+            self.inner.poke()
+
+    def fwd_top(self):
+        with _MOD_LOCK:
+            self.fwd()
+
+    def reenter(self):
+        with self._lock:
+            self._again()
+
+    def _again(self):
+        # same lock through a call: re-entry/self-edge, not a cycle
+        with self._lock:
+            pass
+
+    def deferred(self):
+        with self._lock:
+            def later():
+                # closure body runs on another thread, later — its
+                # acquisitions are not edges from the enclosing hold
+                with _MOD_LOCK:
+                    self.inner.poke()
+            return later

@@ -1,0 +1,28 @@
+"""Seeded thread-lifecycle violations: a stored non-daemon thread
+nobody joins, a constructed-and-dropped non-daemon thread, and a
+``.join()`` executed while a lock is held."""
+
+import threading
+
+# module-scope spawn: same rule, no enclosing def to hide in
+_POLLER = threading.Thread(target=print)
+_POLLER.start()
+
+
+class Spawner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run)  # never joined
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def fire_and_forget(self):
+        threading.Thread(target=self._run).start()  # dropped: unjoinable
+
+    def stop_wrong(self):
+        other = threading.Thread(target=self._run)
+        other.start()
+        with self._lock:
+            other.join()  # joined, but while holding the class lock

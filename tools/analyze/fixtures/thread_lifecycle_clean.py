@@ -1,0 +1,48 @@
+"""Near-miss patterns the thread-lifecycle pass must NOT flag."""
+
+import threading
+
+# module-scope spawns are fine when daemon or joined somewhere
+_BG = threading.Thread(target=print, daemon=True)
+_SVC = threading.Thread(target=print)
+
+
+def _shutdown():
+    _SVC.join(timeout=1.0)
+
+
+class Clean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bg = threading.Thread(target=self._run, daemon=True)
+        self._svc = threading.Thread(target=self._run)  # joined in stop()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._svc.join(timeout=2.0)
+
+    def fan_out(self):
+        threads = [threading.Thread(target=self._run) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def dynamic_daemon(self, flag):
+        # daemon=<non-constant>: can't prove it false — accepted
+        t = threading.Thread(target=self._run, daemon=flag)
+        t.start()
+
+    def late_daemon(self):
+        t = threading.Thread(target=self._run)
+        t.daemon = True
+        t.start()
+
+    def join_outside(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        with self._lock:
+            pass
+        t.join()

@@ -12,9 +12,11 @@ from tools.analyze.passes import (  # noqa: F401
     event_catalog,
     fault_catalog,
     jit_purity,
+    lock_order,
     lock_scope,
     metric_catalog,
     monotonic_clock,
+    thread_lifecycle,
     thread_shared,
     trace_hygiene,
 )

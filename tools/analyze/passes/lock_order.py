@@ -1,0 +1,861 @@
+"""lock-order: inter-procedural lock-acquisition graph + cycle report.
+
+`lock-scope` polices what runs *inside* one lock; this pass polices the
+relationship *between* locks: which lock objects are acquired while
+which others are held, across method and module-function calls. Two
+code paths that take the same pair of locks in opposite orders are a
+deadlock waiting for the right interleaving — c10d keeps its reducer
+honest with exactly this discipline (plus TSAN); here the rule becomes
+a gate.
+
+How the graph is built:
+
+- lock identity is the *creation site*: ``self.X = threading.Lock()``
+  (or RLock/Condition — entering a Condition acquires its lock) keyed
+  per class, and module-global ``_LOCK = threading.Lock()`` keyed per
+  module. Instances of the same class share a node — two instances
+  locked in both orders is the classic AB/BA hazard this pass exists
+  to name, though a *self*-edge (two instances of one class nested) is
+  skipped: direction is meaningless on a single node.
+- within a function the walk is lexical: ``with self._lock:`` bodies
+  extend the held set (nested defs are skipped — closures run later,
+  not here); an explicit ``.acquire()`` on a known lock records an
+  acquisition at that point but does not extend the held set (its
+  matching release is not lexically findable).
+- calls are resolved inter-procedurally: ``self`` methods, same-module
+  and imported-module functions, constructor calls (``Cls()`` runs
+  ``Cls.__init__``), and method calls through typed expressions —
+  ``self.<attr>`` chains assigned a constructor or factory-function
+  result, module-global singletons (``_X = Cls()``, including
+  ``global``-statement assigns), and factory returns resolved from
+  ``return Cls(...)`` / ``return <global>`` / ``return self.<attr>``.
+  Everything a callee transitively acquires becomes an edge from every
+  lock held at the call site.
+- parameters and dynamically-injected collaborators are *not* resolved
+  — that blind spot is exactly what ``--compare-runtime`` (diffing this
+  static graph against a ``utils/syncdbg.py`` runtime recording) turns
+  into a named pass-gap report instead of silence.
+
+Findings: one per strongly-connected component of the edge graph with
+≥ 2 locks, naming the cycle and one concrete acquisition path for each
+direction.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.analyze.core import (AnalysisPass, Context, Finding,
+                                LOCK_FACTORIES, dotted, register)
+
+SCOPE = (
+    "pytorch_distributed_train_tpu/serving_plane/",
+    "pytorch_distributed_train_tpu/ckpt/",
+    "pytorch_distributed_train_tpu/obs/",
+    "pytorch_distributed_train_tpu/faults/",
+    "pytorch_distributed_train_tpu/elastic.py",
+    "pytorch_distributed_train_tpu/data/workers.py",
+    "tools/serve_http.py",
+    "tools/serve_router.py",
+)
+
+
+# ------------------------------------------------------------- symbol table
+@dataclasses.dataclass
+class ClassInfo:
+    key: str                     # "path::ClassName"
+    name: str
+    path: str
+    node: ast.ClassDef
+    locks: dict = dataclasses.field(default_factory=dict)   # attr -> [lines]
+    methods: dict = dataclasses.field(default_factory=dict)  # name -> node
+    attr_values: dict = dataclasses.field(default_factory=dict)  # attr->expr
+    attr_types: dict = dataclasses.field(default_factory=dict)  # attr -> key
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    key: str                     # "path::Class.m" / "path::f"
+    short: str                   # "Class.m" / "f"
+    path: str
+    node: ast.AST
+    cls: ClassInfo | None
+    # (held_lock, held_line, acquired_lock, line) — lexical nesting
+    nested: list = dataclasses.field(default_factory=list)
+    # (lock, line) — every acquisition, for reachability
+    acqs: list = dataclasses.field(default_factory=list)
+    # (callee_key, line, held_tuple) — held_tuple: ((lock, line), ...)
+    calls: list = dataclasses.field(default_factory=list)
+
+
+def _candidate_values(value: ast.AST):
+    """The sub-expressions a ``x = ...`` value may evaluate to:
+    unwraps ``a if c else b`` and ``a or b``."""
+    stack, out = [value], []
+    while stack:
+        v = stack.pop()
+        if isinstance(v, ast.IfExp):
+            stack.extend((v.body, v.orelse))
+        elif isinstance(v, ast.BoolOp):
+            stack.extend(v.values)
+        else:
+            out.append(v)
+    return out
+
+
+class _AnnMarker:
+    """A module global declared by annotation only: carries the
+    annotation expression (``Cls | None``) instead of a value."""
+
+    __slots__ = ("ann",)
+
+    def __init__(self, ann: ast.AST):
+        self.ann = ann
+
+
+def _self_assigns(cls: ast.ClassDef):
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                yield tgt.attr, node.value, node.lineno
+
+
+class _Table:
+    """Symbol + type tables over the analyzed surface."""
+
+    def __init__(self, files):
+        self.by_path = {sf.path: sf for sf in files}
+        self.classes: dict[str, ClassInfo] = {}
+        self.by_name: dict[str, list[str]] = {}
+        self.mod_funcs: dict[str, ast.AST] = {}
+        self.mod_locks: dict[str, dict[str, list[int]]] = {}
+        self.mod_globals: dict[str, dict[str, ast.AST]] = {}
+        self.mod_imports: dict[str, dict[str, str]] = {}      # alias -> path
+        self.from_funcs: dict[str, dict[str, str]] = {}       # name -> fkey
+        self.from_classes: dict[str, dict[str, str]] = {}     # name -> ckey
+        self._ret_memo: dict[str, str | None] = {}
+        for sf in files:
+            self._collect_module(sf)
+        self._collect_imports(files)
+        # attr types need every other table; a few rounds reach the
+        # fixpoint for chained attr -> factory -> class resolution
+        self._attr_fixpoint()
+        # injected collaborators: `self.X = <param>` in __init__, bound
+        # from the argument types at resolvable constructor call sites
+        # (the serve plane wires its monitor/profiler/replica-set this
+        # way — without this layer those subgraphs are invisible)
+        self._bind_ctor_params(files)
+        self._attr_fixpoint()
+
+    def _attr_fixpoint(self) -> None:
+        for _ in range(4):
+            changed = False
+            for ci in self.classes.values():
+                for attr, value in ci.attr_values.items():
+                    if attr in ci.attr_types:
+                        continue
+                    t = self.expr_type(value, ci.path, ci)
+                    if t is not None:
+                        ci.attr_types[attr] = t
+                        changed = True
+            if not changed:
+                break
+
+    # --------------------------------------------------------- collection
+    def _collect_module(self, sf) -> None:
+        locks: dict[str, list[int]] = {}
+        # every candidate value a module global is ever assigned —
+        # `_X = None` at module scope then `global _X; _X = Cls()` in a
+        # lazy builder means BOTH exprs are candidates; annotation-only
+        # declarations (`_X: Cls | None = None`) contribute their
+        # annotation's class names
+        mod_globals: dict[str, list[ast.AST]] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign):
+                is_lock = (isinstance(node.value, ast.Call)
+                           and dotted(node.value.func) in LOCK_FACTORIES)
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if is_lock:
+                        locks.setdefault(tgt.id, []).append(node.lineno)
+                    else:
+                        mod_globals.setdefault(tgt.id, []).append(
+                            node.value)
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                anns = mod_globals.setdefault(node.target.id, [])
+                anns.append(_AnnMarker(node.annotation))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.mod_funcs[f"{sf.path}::{node.name}"] = node
+        # `global X; X = Cls()` inside functions is how the repo's
+        # lazily-built singletons (tracer, recorder, registry) appear
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            gnames = {n for sub in ast.walk(node)
+                      if isinstance(sub, ast.Global) for n in sub.names}
+            if not gnames:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id in gnames:
+                        mod_globals.setdefault(tgt.id, []).append(
+                            sub.value)
+        self.mod_locks[sf.path] = locks
+        self.mod_globals[sf.path] = mod_globals
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = ClassInfo(f"{sf.path}::{node.name}", node.name,
+                           sf.path, node)
+            for attr, value, line in _self_assigns(node):
+                if isinstance(value, ast.Call) and \
+                        dotted(value.func) in LOCK_FACTORIES:
+                    ci.locks.setdefault(attr, []).append(line)
+                else:
+                    ci.attr_values.setdefault(attr, value)
+            for m in node.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[m.name] = m
+            self.classes[ci.key] = ci
+            self.by_name.setdefault(node.name, []).append(ci.key)
+
+    def _module_path(self, dotted_mod: str) -> str | None:
+        rel = dotted_mod.replace(".", "/")
+        for cand in (rel + ".py", rel + "/__init__.py"):
+            if cand in self.by_path:
+                return cand
+        return None
+
+    def _rel_module(self, sf_path: str, level: int,
+                    module: str | None) -> str | None:
+        """Resolve a relative ``from ...x import y`` base module.
+        Level 1 is the containing package — the file's directory, for
+        plain modules and ``__init__.py`` alike."""
+        parts = sf_path.split("/")[:-1]
+        for _ in range(level - 1):
+            if not parts:
+                return None
+            parts = parts[:-1]
+        dotted_mod = ".".join(parts + (module.split(".") if module else []))
+        return self._module_path(dotted_mod) if dotted_mod else None
+
+    def _collect_imports(self, files) -> None:
+        # phase 1: module aliases + pending from-imports (target may be
+        # a re-export through a package __init__, resolved in phase 2)
+        pending: dict[str, list[tuple[str, str, str]]] = {}
+        for sf in files:
+            aliases: dict[str, str] = {}
+            todo: list[tuple[str, str, str]] = []
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        p = self._module_path(a.name)
+                        if p is not None:
+                            aliases[a.asname or a.name.split(".")[0]] = p
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level == 0:
+                        base = self._module_path(node.module or "")
+                        subfmt = (node.module or "") + ".{}"
+                    else:
+                        base = self._rel_module(sf.path, node.level,
+                                                node.module)
+                        subfmt = None
+                    for a in node.names:
+                        local = a.asname or a.name
+                        sub = None
+                        if subfmt is not None:
+                            sub = self._module_path(subfmt.format(a.name))
+                        elif base is not None and \
+                                base.endswith("/__init__.py"):
+                            sub = self._module_path(
+                                base[:-len("/__init__.py")].replace("/", ".")
+                                + "." + a.name)
+                        if sub is not None:     # `from pkg import module`
+                            aliases[local] = sub
+                            continue
+                        if base is not None:
+                            todo.append((local, base, a.name))
+            self.mod_imports[sf.path] = aliases
+            self.from_funcs[sf.path] = {}
+            self.from_classes[sf.path] = {}
+            pending[sf.path] = todo
+        # phase 2: resolve names, following re-export chains (a few
+        # rounds cover __init__ -> module -> definition)
+        for _ in range(4):
+            changed = False
+            for path, todo in pending.items():
+                for local, base, name in todo:
+                    if local in self.from_funcs[path] or \
+                            local in self.from_classes[path]:
+                        continue
+                    if f"{base}::{name}" in self.mod_funcs:
+                        self.from_funcs[path][local] = f"{base}::{name}"
+                    elif f"{base}::{name}" in self.classes:
+                        self.from_classes[path][local] = f"{base}::{name}"
+                    elif name in self.from_funcs.get(base, {}):
+                        self.from_funcs[path][local] = \
+                            self.from_funcs[base][name]
+                    elif name in self.from_classes.get(base, {}):
+                        self.from_classes[path][local] = \
+                            self.from_classes[base][name]
+                    else:
+                        continue
+                    changed = True
+            if not changed:
+                break
+
+    def _param_attr_map(self, ci: ClassInfo) -> dict[str, str]:
+        """param name -> self attr for ``self.X = <param>`` assigns in
+        ``__init__`` (through ``a if c else b`` / ``a or b``)."""
+        init = ci.methods.get("__init__")
+        if init is None:
+            return {}
+        params = {a.arg for a in list(init.args.args)
+                  + list(init.args.kwonlyargs)} - {"self"}
+        out: dict[str, str] = {}
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                for v in _candidate_values(node.value):
+                    if isinstance(v, ast.Name) and v.id in params:
+                        out[v.id] = tgt.attr
+        return out
+
+    def _bind_ctor_params(self, files) -> None:
+        for sf in files:
+            # innermost class per node, for `self` at the call site
+            cls_of: dict[int, ClassInfo] = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    ci = self.classes.get(f"{sf.path}::{node.name}")
+                    if ci is None:
+                        continue
+                    for sub in ast.walk(node):
+                        cls_of[id(sub)] = ci
+            # one-level local-variable types per function (module main()
+            # builds monitor/plane/router in locals before wiring them)
+            funcs = [n for n in ast.walk(sf.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            inner: dict[int, ast.AST] = {}
+            for fn in funcs:
+                for sub in ast.walk(fn):
+                    inner[id(sub)] = fn
+            local_types: dict[int, dict[str, str]] = {}
+            for fn in funcs:
+                env: dict[str, str] = {}
+                ci = cls_of.get(id(fn))
+                for sub in ast.walk(fn):
+                    if inner[id(sub)] is not fn or \
+                            not isinstance(sub, ast.Assign):
+                        continue
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            t = self.expr_type(sub.value, sf.path, ci)
+                            if t is not None:
+                                env.setdefault(tgt.id, t)
+                local_types[id(fn)] = env
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                ck = None
+                if "." not in d:
+                    ck = self.resolve_class(d, sf.path)
+                else:
+                    head, tail = d.split(".", 1)
+                    mod = self.mod_imports.get(sf.path, {}).get(head)
+                    if mod is not None and "." not in tail \
+                            and f"{mod}::{tail}" in self.classes:
+                        ck = f"{mod}::{tail}"
+                if ck is None:
+                    continue
+                tci = self.classes[ck]
+                pmap = self._param_attr_map(tci)
+                if not pmap:
+                    continue
+                init = tci.methods["__init__"]
+                pos = [a.arg for a in init.args.args[1:]]
+                ci = cls_of.get(id(node))
+                env = local_types.get(id(inner.get(id(node))), {})
+
+                def _argtype(expr):
+                    if isinstance(expr, ast.Name) and expr.id in env:
+                        return env[expr.id]
+                    return self.expr_type(expr, sf.path, ci)
+
+                for i, arg in enumerate(node.args):
+                    if i < len(pos) and pos[i] in pmap:
+                        t = _argtype(arg)
+                        if t is not None:
+                            tci.attr_types.setdefault(pmap[pos[i]], t)
+                for kw in node.keywords:
+                    if kw.arg in pmap:
+                        t = _argtype(kw.value)
+                        if t is not None:
+                            tci.attr_types.setdefault(pmap[kw.arg], t)
+
+    # --------------------------------------------------------- resolution
+    def resolve_class(self, name: str, path: str) -> str | None:
+        """A bare class name at a use site → class key: same module,
+        explicit from-import, else unique across the surface."""
+        key = f"{path}::{name}"
+        if key in self.classes:
+            return key
+        k = self.from_classes.get(path, {}).get(name)
+        if k is not None:
+            return k
+        keys = self.by_name.get(name, ())
+        return keys[0] if len(keys) == 1 else None
+
+    def resolve_func(self, name: str, path: str) -> str | None:
+        key = f"{path}::{name}"
+        if key in self.mod_funcs:
+            return key
+        return self.from_funcs.get(path, {}).get(name)
+
+    def resolve_call_target(self, call: ast.Call, path: str,
+                            ci: ClassInfo | None) -> str | None:
+        """Call expression → function/method key (``Cls()`` resolves to
+        ``Cls.__init__`` when one is defined)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            fk = self.resolve_func(func.id, path)
+            if fk is not None:
+                return fk
+            ck = self.resolve_class(func.id, path)
+            if ck is not None and "__init__" in self.classes[ck].methods:
+                tci = self.classes[ck]
+                return f"{tci.path}::{tci.name}.__init__"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = func.value
+        # imported-module function: events_lib.emit(...)
+        if isinstance(recv, ast.Name):
+            mod = self.mod_imports.get(path, {}).get(recv.id)
+            if mod is not None:
+                fk = f"{mod}::{func.attr}"
+                if fk in self.mod_funcs:
+                    return fk
+                # module.Class(...) constructor
+                ck = f"{mod}::{func.attr}"
+                if ck in self.classes and \
+                        "__init__" in self.classes[ck].methods:
+                    return f"{ck}.__init__"
+                return None
+        # typed receiver: self.m(), self.a.b.m(), get_x().m(), _GLOBAL.m()
+        t = self.expr_type(recv, path, ci)
+        if t is not None:
+            tci = self.classes.get(t)
+            if tci is not None and func.attr in tci.methods:
+                return f"{tci.path}::{tci.name}.{func.attr}"
+        return None
+
+    def expr_type(self, expr: ast.AST, path: str,
+                  ci: ClassInfo | None, depth: int = 0) -> str | None:
+        """Best-effort class key an expression evaluates to."""
+        if depth > 6:
+            return None
+        for v in _candidate_values(expr):
+            t = self._expr_type_one(v, path, ci, depth)
+            if t is not None:
+                return t
+        return None
+
+    def _global_type(self, mod: str, name: str, depth: int) -> str | None:
+        """Type of a module global: first resolvable candidate value,
+        else a class named in its annotation."""
+        for g in self.mod_globals.get(mod, {}).get(name, ()):
+            if isinstance(g, _AnnMarker):
+                for sub in ast.walk(g.ann):
+                    d = dotted(sub)
+                    if d is None:
+                        continue
+                    ck = self.resolve_class(d.rsplit(".", 1)[-1], mod)
+                    if ck is not None:
+                        return ck
+                continue
+            t = self.expr_type(g, mod, None, depth + 1)
+            if t is not None:
+                return t
+        return None
+
+    def _expr_type_one(self, v, path, ci, depth) -> str | None:
+        if isinstance(v, ast.Name):
+            if v.id == "self" and ci is not None:
+                return ci.key
+            return self._global_type(path, v.id, depth)
+        if isinstance(v, ast.Attribute):
+            if isinstance(v.value, ast.Name):
+                mod = self.mod_imports.get(path, {}).get(v.value.id)
+                if mod is not None:     # module-global singleton use
+                    return self._global_type(mod, v.attr, depth)
+            base = self.expr_type(v.value, path, ci, depth + 1)
+            if base is not None:
+                bci = self.classes.get(base)
+                if bci is not None:
+                    return bci.attr_types.get(v.attr)
+            return None
+        if isinstance(v, ast.Call):
+            d = dotted(v.func)
+            if d is not None:
+                ck = self.resolve_class(d.rsplit(".", 1)[-1], path) \
+                    if "." not in d else None
+                if "." not in d:
+                    if ck is not None:
+                        return ck
+                    fk = self.resolve_func(d, path)
+                    if fk is not None:
+                        return self.return_type(fk, depth + 1)
+                else:
+                    head, tail = d.split(".", 1)
+                    mod = self.mod_imports.get(path, {}).get(head)
+                    if mod is not None and "." not in tail:
+                        if f"{mod}::{tail}" in self.classes:
+                            return f"{mod}::{tail}"
+                        if f"{mod}::{tail}" in self.mod_funcs:
+                            return self.return_type(f"{mod}::{tail}",
+                                                    depth + 1)
+            return None
+        return None
+
+    def return_type(self, func_key: str, depth: int = 0) -> str | None:
+        if func_key in self._ret_memo:
+            return self._ret_memo[func_key]
+        self._ret_memo[func_key] = None     # cycle guard
+        node = self.mod_funcs.get(func_key)
+        path = func_key.split("::", 1)[0]
+        ci = None
+        if node is None:
+            cls_part, mname = func_key.rsplit(".", 1)
+            ci = self.classes.get(cls_part)
+            if ci is None:
+                return None
+            node = ci.methods.get(mname)
+            if node is None:
+                return None
+        types = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                t = self.expr_type(sub.value, path, ci, depth + 1)
+                if t is not None:
+                    types.add(t)
+        out = types.pop() if len(types) == 1 else None
+        self._ret_memo[func_key] = out
+        return out
+
+
+# ----------------------------------------------------------- per-function
+def _lock_of_withitem(item, ci: ClassInfo | None, mod_locks, path):
+    expr = item.context_expr
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self" and ci is not None
+            and expr.attr in ci.locks):
+        return f"{path}::{ci.name}.{expr.attr}"
+    if isinstance(expr, ast.Name) and expr.id in mod_locks:
+        return f"{path}::{expr.id}"
+    return None
+
+
+def _lock_of_receiver(func: ast.Attribute, ci, mod_locks, path):
+    """`self._lock.acquire()` / `_LOCK.acquire()` receivers."""
+    recv = func.value
+    if (isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self" and ci is not None
+            and recv.attr in ci.locks):
+        return f"{path}::{ci.name}.{recv.attr}"
+    if isinstance(recv, ast.Name) and recv.id in mod_locks:
+        return f"{path}::{recv.id}"
+    return None
+
+
+def _scan_function(fi: FuncInfo, table: _Table) -> None:
+    ci = fi.cls
+    mod_locks = table.mod_locks.get(fi.path, {})
+    # DFS with lexical held set: (node, held) where held is a tuple of
+    # (lock_id, acquired_at_line).
+    stack: list[tuple[ast.AST, tuple]] = [
+        (n, ()) for n in reversed(fi.node.body)]
+    while stack:
+        node, held = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue  # separate execution context
+        if isinstance(node, ast.With):
+            inner = held
+            for item in node.items:
+                # a later withitem's context expr evaluates with the
+                # earlier locks already held
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        _note_call(fi, sub, inner, ci, mod_locks, table)
+                lock = _lock_of_withitem(item, ci, mod_locks, fi.path)
+                if lock is not None:
+                    fi.acqs.append((lock, node.lineno))
+                    for h, hline in inner:
+                        if h != lock:
+                            fi.nested.append((h, hline, lock, node.lineno))
+                    inner = inner + ((lock, node.lineno),)
+            for child in reversed(node.body):
+                stack.append((child, inner))
+            continue
+        if isinstance(node, ast.Call):
+            _note_call(fi, node, held, ci, mod_locks, table)
+        for child in reversed(list(ast.iter_child_nodes(node))):
+            stack.append((child, held))
+
+
+def _note_call(fi: FuncInfo, call: ast.Call, held, ci, mod_locks, table):
+    func = call.func
+    if isinstance(func, ast.Attribute) and \
+            func.attr in ("acquire", "__enter__"):
+        lock = _lock_of_receiver(func, ci, mod_locks, fi.path)
+        if lock is not None:
+            fi.acqs.append((lock, call.lineno))
+            for h, hline in held:
+                if h != lock:
+                    fi.nested.append((h, hline, lock, call.lineno))
+            return
+    callee = table.resolve_call_target(call, fi.path, ci)
+    if callee is not None and callee != fi.key:
+        fi.calls.append((callee, call.lineno, held))
+
+
+# ----------------------------------------------------------------- graph
+class LockGraph:
+    """Static result: ``nodes`` (lock id -> creation sites) and
+    ``edges`` ((a, b) -> one concrete acquisition path, as text steps);
+    a→b means "b acquired while a is held" somewhere."""
+
+    def __init__(self):
+        self.nodes: dict[str, list[tuple[str, int]]] = {}
+        self.edges: dict[tuple[str, str], list[str]] = {}
+
+    def add_edge(self, a: str, b: str, chain: list[str]) -> None:
+        if a == b:
+            return
+        cur = self.edges.get((a, b))
+        if cur is None or len(chain) < len(cur):
+            self.edges[(a, b)] = chain
+
+    def sccs(self) -> list[list[str]]:
+        """Tarjan strongly-connected components with ≥ 2 nodes."""
+        adj: dict[str, list[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on: set[str] = set()
+        order: list[str] = []
+        out: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str):
+            # iterative Tarjan (the call graph can be deep)
+            work = [(v, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    order.append(node)
+                    on.add(node)
+                recurse = False
+                for w in adj[node][pi:]:
+                    work[-1] = (node, work[-1][1] + 1)
+                    if w not in index:
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = order.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        out.append(sorted(comp))
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        return sorted(out)
+
+    def cycle_in(self, comp: list[str]) -> list[str]:
+        """One concrete cycle inside an SCC: BFS from its first node
+        back to itself, restricted to the component."""
+        comp_set = set(comp)
+        start = comp[0]
+        prev: dict[str, str] = {}
+        frontier = [start]
+        seen = {start}
+        while frontier:
+            nxt = []
+            for v in frontier:
+                for (a, b) in self.edges:
+                    if a != v or b not in comp_set:
+                        continue
+                    if b == start:
+                        path = [v]
+                        node = v
+                        while node != start:
+                            node = prev[node]
+                            path.append(node)
+                        path.reverse()
+                        return path + [start]
+                    if b not in seen:
+                        seen.add(b)
+                        prev[b] = v
+                        nxt.append(b)
+            frontier = nxt
+        return [start, start]  # unreachable for a true SCC
+
+
+def build_graph(ctx: Context, include=SCOPE) -> LockGraph:
+    from tools.analyze.core import path_matches
+
+    files = [sf for sf in ctx.files
+             if sf.tree is not None and path_matches(sf.path, include)]
+    table = _Table(files)
+    graph = LockGraph()
+    for ci in table.classes.values():
+        for attr, lines in ci.locks.items():
+            graph.nodes[f"{ci.path}::{ci.name}.{attr}"] = [
+                (ci.path, ln) for ln in lines]
+    for path, locks in table.mod_locks.items():
+        for name, lines in locks.items():
+            graph.nodes[f"{path}::{name}"] = [(path, ln) for ln in lines]
+
+    funcs: dict[str, FuncInfo] = {}
+    for ci in table.classes.values():
+        for name, node in ci.methods.items():
+            key = f"{ci.path}::{ci.name}.{name}"
+            funcs[key] = FuncInfo(key, f"{ci.name}.{name}", ci.path,
+                                  node, ci)
+    for key, node in table.mod_funcs.items():
+        path, name = key.split("::", 1)
+        funcs.setdefault(key, FuncInfo(key, name, path, node, None))
+    for fi in funcs.values():
+        _scan_function(fi, table)
+
+    # reachable acquisitions per function (fixpoint over the call graph)
+    reach: dict[str, dict[str, list[str]]] = {}
+    for key, fi in funcs.items():
+        reach[key] = {}
+        for lock, line in fi.acqs:
+            if lock not in reach[key]:
+                reach[key][lock] = [
+                    f"{fi.path}:{line} {fi.short} acquires "
+                    f"`{_short(lock)}`"]
+    changed = True
+    while changed:
+        changed = False
+        for key, fi in funcs.items():
+            mine = reach[key]
+            for callee, line, _held in fi.calls:
+                if callee == key:
+                    continue
+                for lock, chain in reach.get(callee, {}).items():
+                    if lock in mine:
+                        continue
+                    mine[lock] = [f"{fi.path}:{line} {fi.short} calls "
+                                  f"{funcs[callee].short}"] + chain
+                    changed = True
+
+    # edges: lexical nesting + (held at a call site) x (callee reach)
+    for key in sorted(funcs):
+        fi = funcs[key]
+        for held, hline, lock, line in fi.nested:
+            graph.add_edge(held, lock, [
+                f"{fi.path}:{line} {fi.short} acquires `{_short(lock)}` "
+                f"while holding `{_short(held)}` (since line {hline})"])
+        for callee, line, held_tuple in fi.calls:
+            if not held_tuple:
+                continue
+            callee_reach = reach.get(callee, {})
+            for held, hline in held_tuple:
+                for lock, chain in callee_reach.items():
+                    if lock == held:
+                        continue
+                    graph.add_edge(held, lock, [
+                        f"{fi.path}:{line} {fi.short} (holding "
+                        f"`{_short(held)}`, since line {hline}) calls "
+                        f"{funcs[callee].short}"] + chain)
+    return graph
+
+
+def _short(lock_id: str) -> str:
+    path, name = lock_id.split("::", 1)
+    return f"{path.rsplit('/', 1)[-1]}::{name}"
+
+
+def _fmt_chain(chain: list[str]) -> str:
+    return " -> ".join(chain)
+
+
+@register
+class LockOrderPass(AnalysisPass):
+    id = "lock-order"
+    description = ("inter-procedural lock-acquisition graph: a cycle "
+                   "(locks taken in both orders on different paths) is "
+                   "a deadlock hazard")
+    include = SCOPE
+
+    def run(self, ctx: Context) -> list[Finding]:
+        graph = build_graph(ctx, self.include)
+        out: list[Finding] = []
+        for comp in graph.sccs():
+            cycle = graph.cycle_in(comp)
+            legs = []
+            for a, b in zip(cycle, cycle[1:]):
+                chain = graph.edges.get((a, b), ["<edge>"])
+                legs.append(f"`{_short(a)}` -> `{_short(b)}` via: "
+                            f"{_fmt_chain(chain)}")
+            anchor_path, anchor_line = _anchor(graph, cycle)
+            names = " -> ".join(_short(n) for n in cycle)
+            out.append(Finding(
+                self.id, anchor_path, anchor_line,
+                f"lock-order cycle (deadlock hazard): {names}. "
+                + " ; ".join(legs)
+                + ". Pick one global order for these locks or drop one "
+                  "acquisition out of the overlap.",
+                key="cycle:" + "->".join(sorted(set(comp)))))
+        return out
+
+
+def _anchor(graph: LockGraph, cycle: list[str]):
+    """(path, line) to pin the finding on: the head lock's creation
+    site (stable, survives call-site drift)."""
+    sites = graph.nodes.get(cycle[0])
+    if sites:
+        return sites[0]
+    path = cycle[0].split("::", 1)[0]
+    return path, 1

@@ -1,0 +1,322 @@
+"""thread-lifecycle: every spawned thread must be daemon or joined.
+
+A non-daemon ``threading.Thread`` nobody joins outlives its owner: it
+blocks interpreter shutdown (the silent-hang twin of the tier-1 suite's
+wedges), and its writes race teardown. The rule: every
+``threading.Thread(target=...)`` is either ``daemon=True`` or provably
+joined — stored somewhere (``self.X`` / a local / a list of threads)
+that a reachable ``.join()`` call drains. The companion hazard is the
+inverse: a ``.join()`` (or any thread-wait) executed *while a lock is
+held* turns "slow worker" into "everyone blocked behind the lock" — the
+runtime sanitizer (utils/syncdbg.py) times the same pattern live.
+
+Conservative by design:
+
+- ``daemon=<non-constant>`` is accepted (can't prove it false), as is a
+  post-construction ``<name>.daemon = True`` on the same stored name;
+- a join anywhere in the owning class (for ``self.X``) or function (for
+  locals) counts — we don't prove the shutdown path runs, only that one
+  exists;
+- list-of-threads patterns count when the list's elements are joined in
+  a loop over the list.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import (AnalysisPass, Context, Finding,
+                                class_lock_attrs, dotted,
+                                module_lock_names, register,
+                                walk_no_nested_defs, withitem_lock_name)
+
+SCOPE = (
+    "pytorch_distributed_train_tpu/serving_plane/",
+    "pytorch_distributed_train_tpu/ckpt/",
+    "pytorch_distributed_train_tpu/obs/",
+    "pytorch_distributed_train_tpu/faults/",
+    "pytorch_distributed_train_tpu/elastic.py",
+    "pytorch_distributed_train_tpu/data/workers.py",
+    "tools/serve_http.py",
+    "tools/serve_router.py",
+)
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (dotted(node.func) or "").endswith("Thread"))
+
+
+def _daemon_status(call: ast.Call) -> str:
+    """'daemon' | 'non_daemon' | 'unknown' from the constructor kwargs."""
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant):
+                return "daemon" if kw.value.value else "non_daemon"
+            return "unknown"  # dynamic: can't prove it false
+    return "non_daemon"  # threading's default
+
+
+def _joined_names(tree: ast.AST) -> set[str]:
+    """Names X with an ``X.join(...)`` / ``self.X.join(...)`` call
+    anywhere under ``tree`` (nested defs included: shutdown paths are
+    often closures/handlers)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Name):
+            out.add(recv.id)
+        elif (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"):
+            out.add(f"self.{recv.attr}")
+    return out
+
+
+def _loop_joined_lists(tree: ast.AST) -> set[str]:
+    """Names L for ``for t in L: ... t.join(...)`` patterns (self.L
+    included) — the joined-thread-list idiom."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.For):
+            continue
+        if not isinstance(node.target, ast.Name):
+            continue
+        it = node.iter
+        name = None
+        if isinstance(it, ast.Name):
+            name = it.id
+        elif (isinstance(it, ast.Attribute)
+                and isinstance(it.value, ast.Name)
+                and it.value.id == "self"):
+            name = f"self.{it.attr}"
+        if name is None:
+            continue
+        tvar = node.target.id
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "join"
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == tvar):
+                out.add(name)
+                break
+    return out
+
+
+def _daemon_assigned_names(tree: ast.AST) -> set[str]:
+    """Names X with a ``X.daemon = True`` / ``self.X.daemon = True``
+    assignment after construction."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and node.value.value is True):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Attribute)
+                    and tgt.attr == "daemon"):
+                continue
+            recv = tgt.value
+            if isinstance(recv, ast.Name):
+                out.add(recv.id)
+            elif (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                out.add(f"self.{recv.attr}")
+    return out
+
+
+def _storage_name(ctor: ast.Call, parents: dict) -> str | None:
+    """Where the Thread object lands: 'x' / 'self.x' for a direct
+    assignment, the comprehension's / appended-to list's name, else
+    None (constructed and dropped, e.g. ``Thread(...).start()``)."""
+    node = ctor
+    while True:
+        parent = parents.get(id(node))
+        if parent is None:
+            return None
+        if isinstance(parent, ast.Assign):
+            for tgt in parent.targets:
+                if isinstance(tgt, ast.Name):
+                    return tgt.id
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    return f"self.{tgt.attr}"
+            return None
+        if isinstance(parent, (ast.ListComp, ast.List, ast.Tuple)):
+            node = parent
+            continue
+        if isinstance(parent, ast.Call):
+            # L.append(Thread(...)) — storage is L
+            f = parent.func
+            if (isinstance(f, ast.Attribute) and f.attr == "append"
+                    and node in parent.args):
+                if isinstance(f.value, ast.Name):
+                    return f.value.id
+                if (isinstance(f.value, ast.Attribute)
+                        and isinstance(f.value.value, ast.Name)
+                        and f.value.value.id == "self"):
+                    return f"self.{f.value.attr}"
+            return None
+        if isinstance(parent, (ast.Expr, ast.Attribute)):
+            # Thread(...).start() or a bare expression: keep climbing
+            # one level to see if anything captures it (it won't).
+            node = parent
+            continue
+        return None
+
+
+def _parent_map(root: ast.AST) -> dict:
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+@register
+class ThreadLifecyclePass(AnalysisPass):
+    id = "thread-lifecycle"
+    description = ("threads must be daemon or provably joined; no "
+                   "blocking .join() while a lock is held")
+    include = SCOPE
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in self.files(ctx):
+            out.extend(self._check_file(sf))
+        return out
+
+    def _check_file(self, sf) -> list[Finding]:
+        out: list[Finding] = []
+        global_locks = module_lock_names(sf.tree)
+        # scope attribution: every node belongs to its INNERMOST
+        # enclosing function (ast.walk is breadth-first, parents before
+        # children, so later overwrites win), and each function to its
+        # innermost class — a ctor in a closure is checked against the
+        # closure, once, not against every enclosing def too.
+        funcs = [n for n in ast.walk(sf.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        innermost: dict[int, ast.AST] = {}
+        for func in funcs:
+            for sub in ast.walk(func):
+                innermost[id(sub)] = func
+        class_of_func: dict[int, ast.ClassDef] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        class_of_func.setdefault(id(sub), node)
+
+        # module/class-body scope first: a thread spawned at import time
+        # (not inside any def) is bound by the same rule — its joins can
+        # live anywhere in the module (atexit hooks, shutdown helpers)
+        mod_ctors = [n for n in ast.walk(sf.tree)
+                     if _is_thread_ctor(n) and id(n) not in innermost]
+        if mod_ctors:
+            parents = _parent_map(sf.tree)
+            joined = _joined_names(sf.tree) | _loop_joined_lists(sf.tree)
+            daemoned = _daemon_assigned_names(sf.tree)
+            for ctor in mod_ctors:
+                if _daemon_status(ctor) != "non_daemon":
+                    continue
+                name = _storage_name(ctor, parents)
+                if name is None:
+                    out.append(self.finding(
+                        sf, ctor,
+                        "non-daemon thread is constructed and dropped "
+                        "at module scope — nothing can ever join it; "
+                        "pass daemon=True or store and join it on a "
+                        "shutdown path"))
+                elif name not in joined and name not in daemoned:
+                    out.append(self.finding(
+                        sf, ctor,
+                        f"non-daemon module-scope thread stored in "
+                        f"`{name}` is never joined (no `{name}.join(...)`"
+                        f" anywhere in the module) — pass daemon=True "
+                        f"or join it"))
+
+        for func in funcs:
+            cls = class_of_func.get(id(func))
+            parents = _parent_map(func)
+            ctors = [n for n in ast.walk(func)
+                     if _is_thread_ctor(n) and innermost[id(n)] is func]
+            if ctors:
+                local_joined = _joined_names(func) | _loop_joined_lists(func)
+                local_daemoned = _daemon_assigned_names(func)
+                if cls is not None:
+                    cls_joined = _joined_names(cls) | _loop_joined_lists(cls)
+                    cls_daemoned = _daemon_assigned_names(cls)
+                else:
+                    cls_joined = cls_daemoned = set()
+                for ctor in ctors:
+                    status = _daemon_status(ctor)
+                    if status != "non_daemon":
+                        continue
+                    name = _storage_name(ctor, parents)
+                    if name is None:
+                        out.append(self.finding(
+                            sf, ctor,
+                            "non-daemon thread is constructed and "
+                            "dropped — nothing can ever join it; pass "
+                            "daemon=True or store and join it on a "
+                            "shutdown path"))
+                        continue
+                    joined = local_joined | (
+                        cls_joined if name.startswith("self.") else set())
+                    daemoned = local_daemoned | (
+                        cls_daemoned if name.startswith("self.") else set())
+                    if name in joined or name in daemoned:
+                        continue
+                    out.append(self.finding(
+                        sf, ctor,
+                        f"non-daemon thread stored in `{name}` is never "
+                        f"joined (no `{name}.join(...)` on any shutdown "
+                        f"path) — pass daemon=True or join it"))
+
+            # .join() under a held lock: lexical, same stance as
+            # lock-scope but with thread-wait-specific wording, and
+            # over THIS pass's scope (which includes obs/).
+            self_locks = class_lock_attrs(cls) if cls is not None else set()
+            for node in ast.walk(func):
+                if not isinstance(node, ast.With) \
+                        or innermost[id(node)] is not func:
+                    continue
+                held = None
+                for item in node.items:
+                    held = withitem_lock_name(item, self_locks,
+                                              global_locks)
+                    if held:
+                        break
+                if not held:
+                    continue
+                for sub in walk_no_nested_defs(node.body):
+                    if not (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "join"):
+                        continue
+                    # thread-ish receivers only: a bare name or a
+                    # self attribute — `", ".join(...)` (Constant) and
+                    # `os.path.join(...)` (module attr chain) are
+                    # string/path joins, not thread waits
+                    recv = sub.func.value
+                    threadish = isinstance(recv, ast.Name) or (
+                        isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self")
+                    if not threadish:
+                        continue
+                    out.append(self.finding(
+                        sf, sub,
+                        f"blocking `.join()` while holding `{held}` "
+                        f"— a slow or wedged thread stalls every "
+                        f"thread behind this lock; join outside "
+                        f"the lock"))
+        return out

@@ -282,13 +282,34 @@ def main(argv=None) -> int:
     p.add_argument("--shrink", action="store_true",
                    help="run the elastic shrink drill instead of the "
                         "multi-fault soak (docs/elastic.md)")
+    p.add_argument("--sanitize", action="store_true",
+                   help="run under the tsan-lite concurrency sanitizer "
+                        "(utils/syncdbg.py): agent threads in-process, "
+                        "worker subprocesses via PDTT_SANITIZE=1; any "
+                        "sanitizer finding fails the soak")
     args = p.parse_args(argv)
+    if args.sanitize:
+        # env first: the elastic agent's worker subprocesses inherit it
+        # and train.py's maybe_activate() picks it up on their side
+        os.environ["PDTT_SANITIZE"] = "1"
+    from pytorch_distributed_train_tpu.utils import syncdbg
+
+    syncdbg.maybe_activate()
     if args.shrink:
         report = run_shrink_drill(seed=args.seed, steps=args.steps or 6,
                                   out_dir=args.out)
     else:
         report = run_soak(seed=args.seed, steps=args.steps or 8,
                           out_dir=args.out)
+    if syncdbg.active():
+        syncdbg.check_teardown()
+        summary = syncdbg.findings_summary()
+        report["sanitizer_findings"] = summary
+        if summary:
+            for f in syncdbg.findings():
+                print(f"FAIL: sanitizer {f.kind}: {f.message}",
+                      file=sys.stderr)
+            report["ok"] = False
     print(json.dumps(report))
     return 0 if report["ok"] else 1
 

@@ -106,6 +106,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# PDTT_SANITIZE=1: patch threading BEFORE the plane imports below run —
+# they create module-global locks (events._LOCK, this file's
+# _PROFILER_LOCK) at import time, and an activation from main() would
+# leave those singletons unsanitized/invisible to the runtime graph.
+from pytorch_distributed_train_tpu.utils import syncdbg  # noqa: E402
+
+syncdbg.maybe_activate()
+
 from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
 from pytorch_distributed_train_tpu.obs import spans as spans_lib  # noqa: E402
 from pytorch_distributed_train_tpu.obs import tracing  # noqa: E402

@@ -66,6 +66,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# PDTT_SANITIZE=1: patch threading BEFORE the imports below create
+# their module-global locks (events/tracing/registry singletons)
+from pytorch_distributed_train_tpu.utils import syncdbg  # noqa: E402
+
+syncdbg.maybe_activate()
+
 from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
 from pytorch_distributed_train_tpu.obs import tracing  # noqa: E402
 from pytorch_distributed_train_tpu.obs.exposition import (  # noqa: E402

@@ -43,6 +43,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# PDTT_SANITIZE=1: patch threading BEFORE the imports below create
+# their module-global locks — the "zero findings end-to-end" gate must
+# see the events/tracing/registry singletons, not miss them
+from pytorch_distributed_train_tpu.utils import syncdbg  # noqa: E402
+
+syncdbg.maybe_activate()
+
 import numpy as np  # noqa: E402
 
 from pytorch_distributed_train_tpu.faults import registry as fregistry  # noqa: E402
@@ -360,6 +367,16 @@ def main(argv=None) -> int:
             print(f"FAIL: {hp['hedges_fired']} hedges but "
                   f"{hp['hedged_traces_retained']} retained hedged "
                   "trace(s)", file=sys.stderr)
+            ok = False
+    if syncdbg.active():
+        syncdbg.check_teardown()
+        summary = syncdbg.findings_summary()
+        report["sanitizer_findings"] = summary
+        print(f"  sanitizer_findings: {summary or 0}")
+        if summary:
+            for f in syncdbg.findings():
+                print(f"FAIL: sanitizer {f.kind}: {f.message}",
+                      file=sys.stderr)
             ok = False
     if ok:
         print("slo_soak: all bounds held")

@@ -86,6 +86,12 @@ def build_config(args):
 
 
 def main(argv=None) -> int:
+    # PDTT_SANITIZE=1 (exported by `tools/chaos_soak.py --sanitize` to
+    # elastic worker subprocesses): tsan-lite lock/thread wrappers on
+    # from the first import — utils/syncdbg.py, docs/static_analysis.md
+    from pytorch_distributed_train_tpu.utils import syncdbg
+
+    syncdbg.maybe_activate()
     args = parse_args(argv)
     if args.list_configs:
         from pytorch_distributed_train_tpu.config import list_presets
@@ -185,6 +191,19 @@ def main(argv=None) -> int:
         return 0 if metrics else 1
     trainer.fit()
     trainer.close()
+    if syncdbg.active():
+        # Sanitized run (chaos_soak --sanitize exports PDTT_SANITIZE=1
+        # to worker subprocesses): a concurrency finding in THIS
+        # process must reach the supervising soak, and the exit code is
+        # the only channel — rc 57, distinct from every fault-drill rc.
+        # Checked BEFORE the preemption exit code: a preempted worker
+        # with findings must not report the clean resume contract.
+        syncdbg.check_teardown()
+        summary = syncdbg.findings_summary()
+        if summary:
+            print(f"[sanitizer] findings: {summary} — failing the run",
+                  file=sys.stderr, flush=True)
+            return 57
     if trainer.preempted:
         # Graceful SIGTERM preemption: the loop already checkpointed and
         # the summary carries the `preempted` marker; the exit code is
