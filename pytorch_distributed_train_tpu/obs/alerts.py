@@ -24,6 +24,19 @@ Rule kinds over the collector's rolling state (obs/collector.py):
   filters which side fires (a goodput SPIKE is good news);
   ``min_abs`` floors the deviation so an all-zero baseline (shed
   rate) doesn't make the first 10^-6 a 6-sigma event.
+- ``burn_rate`` — Google-SRE multi-window error-budget burn over the
+  durable history store (obs/tsdb.py + obs/slo_budget.py): one fast
+  (5m/1h, page) and one slow (30m/6h, warn) rule per declared SLO.
+  Fires when BOTH windows of the pair burn over ``factor`` (the short
+  window proves it is happening NOW, the long one that it is not a
+  blip); resolves when either recovers. Needs an engine with an
+  attached ``slo_tracker`` — without one (or without history for the
+  window) the rules are silent, not failing.
+
+Every FIRED transition mints an alert id (``rule@host@epoch_ms``)
+that threads through the journal records (fired / profile_requested /
+resolved) — the handle ``tools/postmortem.py --alert`` reconstructs
+an incident from.
 
 Lifecycle per (rule, target): untriggered → FIRING → RESOLVED, each
 transition journaled under the closed ``alert`` event category (with
@@ -60,7 +73,7 @@ class AlertRule:
     serving targets."""
 
     name: str
-    kind: str                      # threshold | absence | rate | anomaly
+    kind: str          # threshold | absence | rate | anomaly | burn_rate
     roles: tuple                   # ("trainer",) / ("serving",) / both
     series: str
     description: str
@@ -80,9 +93,43 @@ class AlertRule:
     # lifecycle
     cooldown_s: float = 60.0
     profile: bool = False          # may invoke the managed profiler
+    # burn-rate knobs (kind=burn_rate; windows override-shrinkable for
+    # drills via --rule, like every other field)
+    slo: str = ""                  # SLO_CATALOG name the rule burns
+    burn_window: str = ""          # "fast" | "slow"
+    short_s: float = 0.0
+    long_s: float = 0.0
+    factor: float = 1.0            # burn-rate threshold for BOTH windows
 
 
 _BOTH = ("trainer", "serving")
+
+
+def _burn_rules() -> list[AlertRule]:
+    """Two multi-window burn-rate rules per declared SLO — derived
+    from the SLO catalog so adding an SLO grows its alerting for free
+    (the doc table + slo-catalog pass keep the pair honest)."""
+    from pytorch_distributed_train_tpu.obs.slo_budget import (
+        BURN_FACTORS,
+        BURN_WINDOWS,
+        SLO_CATALOG,
+    )
+
+    out = []
+    for slo in SLO_CATALOG.values():
+        for win, (short_s, long_s) in sorted(BURN_WINDOWS.items()):
+            out.append(AlertRule(
+                name=f"slo_{slo.name}_burn_{win}", kind="burn_rate",
+                roles=slo.roles, series=slo.series, slo=slo.name,
+                burn_window=win, short_s=short_s, long_s=long_s,
+                factor=BURN_FACTORS[win],
+                profile=(win == "fast"),
+                description=f"{slo.name} error budget burning ≥"
+                            f"{BURN_FACTORS[win]}× the SLO rate over "
+                            f"both the {int(short_s)}s and "
+                            f"{int(long_s)}s windows "
+                            f"({'page' if win == 'fast' else 'warn'})"))
+    return out
 
 # The CLOSED catalog — docs/observability.md '## Alert catalog' mirrors
 # this table; tools/analyze's alert-catalog pass keeps the two in sync.
@@ -142,6 +189,7 @@ RULES: dict[str, AlertRule] = {r.name: r for r in (
         above=3, for_s=600.0,
         description="3+ restart generations registered within the "
                     "window — a crash loop, fleet-visible"),
+    *_burn_rules(),
 )}
 
 
@@ -157,6 +205,8 @@ class _RuleState:
         self.baseline: float | None = None
         self.detector: SpikeDetector | None = None
         self.last_sample_mono: float | None = None
+        self.alert_id: str | None = None  # minted at FIRE, threads
+        # through resolve/profile journal records (postmortem handle)
         if rule.kind == "anomaly":
             self.detector = SpikeDetector(
                 window=rule.window, sigma=rule.sigma,
@@ -179,7 +229,8 @@ class AlertEngine:
                  profile_on_alert: bool = False,
                  profile_cooldown_s: float = 300.0,
                  profile_capture_s: float = 2.0,
-                 overrides: dict | None = None, opener=None):
+                 overrides: dict | None = None, opener=None,
+                 slo_tracker=None):
         base = dict(rules if rules is not None else RULES)
         for spec, value in (overrides or {}).items():
             rule_name, _, field = spec.partition(".")
@@ -205,6 +256,9 @@ class AlertEngine:
         self.profile_on_alert = profile_on_alert
         self.profile_cooldown_s = profile_cooldown_s
         self.profile_capture_s = profile_capture_s
+        # obs/slo_budget.SLOBudgetTracker over the history store; the
+        # burn_rate rules are inert without one
+        self.slo_tracker = slo_tracker
         self._opener = opener or urllib.request.urlopen
         self._states: dict[tuple[str, str, str], _RuleState] = {}
         self._gen_seen: dict[tuple[str, str], dict[str, float]] = {}
@@ -227,7 +281,8 @@ class AlertEngine:
                 out.append({
                     "rule": rule, "role": role, "host": host,
                     "for_s": round(now - (st.since_mono or now), 1),
-                    "value": st.value, "baseline": st.baseline})
+                    "value": st.value, "baseline": st.baseline,
+                    "id": st.alert_id})
         return out
 
     # -------------------------------------------------------- transitions
@@ -247,6 +302,11 @@ class AlertEngine:
             st.since_mono = now_mono
             st.last_fire_mono = now_mono
             st.healthy = 0
+            # the incident handle: stable across this firing's whole
+            # lifecycle, unique enough per journal (same rule+host
+            # cannot fire twice in one millisecond)
+            st.alert_id = (f"{rule.name}@{target.host}"
+                           f"@{int(time.time() * 1000)}")
             rec["event"] = "fired"
             get_registry().counter(
                 "alerts_fired_total", labels={"rule": rule.name},
@@ -255,14 +315,17 @@ class AlertEngine:
             rec["event"] = "resolved"
             rec["after_s"] = round(now_mono - (st.since_mono or now_mono), 1)
             st.since_mono = None
+        if st.alert_id is not None:
+            rec["id"] = st.alert_id
         events_lib.emit("alert", rec["event"], rule=rule.name,
                         host=target.host, role=target.role,
                         gen=target.gen,
                         **{k: v for k, v in rec.items()
-                           if k in ("value", "baseline", "after_s")})
+                           if k in ("value", "baseline", "after_s",
+                                    "id")})
         self._sink(rec)
         if fire and rule.profile and self.profile_on_alert:
-            self._request_profile(rule, target, now_mono)
+            self._request_profile(rule, target, now_mono, st.alert_id)
         return rec
 
     def _sink(self, rec: dict) -> None:
@@ -283,7 +346,8 @@ class AlertEngine:
                 pass  # alert delivery is best-effort by design
 
     def _request_profile(self, rule: AlertRule, target,
-                         now_mono: float) -> None:
+                         now_mono: float,
+                         alert_id: str | None = None) -> None:
         """Fire the PR-5 managed profiler on the offending target via
         its own ``POST /profile`` route — cooldown-limited so a bad
         hour cannot fill the fleet's disks with captures. The POST runs
@@ -307,8 +371,11 @@ class AlertEngine:
                 status = self._opener(req, timeout=5.0).status
             except Exception as e:
                 status = getattr(e, "code", None) or repr(e)
-            events_lib.emit("alert", "profile_requested", rule=rule.name,
-                            host=host, gen=gen, status=status)
+            detail = {"rule": rule.name, "host": host, "gen": gen,
+                      "status": status}
+            if alert_id is not None:
+                detail["id"] = alert_id
+            events_lib.emit("alert", "profile_requested", **detail)
 
         threading.Thread(target=post, daemon=True,
                          name=f"alert-profile-{host}").start()
@@ -355,6 +422,14 @@ class AlertEngine:
             reg.gauge("alerts_firing", labels={"rule": name},
                       help="targets currently firing each alert rule"
                       ).set(n)
+        if self.slo_tracker is not None:
+            try:
+                # budget/burn gauges ride the evaluation cadence: the
+                # metric catalog's slo_error_budget_remaining{slo=} and
+                # slo_burn_rate{slo=,window=}
+                self.slo_tracker.export_gauges()
+            except Exception:
+                pass  # accounting must never take the engine down
         return transitions
 
     def _condition(self, rule: AlertRule, target, now: float,
@@ -382,6 +457,23 @@ class AlertEngine:
             if rule.below is not None:
                 return value < rule.below, value, rule.below
             return value > rule.above, value, rule.above
+        if rule.kind == "burn_rate":
+            tracker = self.slo_tracker
+            if tracker is None or not rule.slo:
+                return None, None, None
+            key = f"{target.role}@{target.host}"
+            try:
+                short = tracker.burn_rate(rule.slo, key, rule.short_s)
+                long_ = tracker.burn_rate(rule.slo, key, rule.long_s)
+            except Exception:
+                return None, None, None
+            if short is None or long_ is None:
+                return None, None, None  # no history yet: unknown
+            # both windows must agree to fire; min() is therefore the
+            # actionable burn, and its dropping below factor (the
+            # short window recovering) resolves
+            return (min(short, long_) >= rule.factor,
+                    min(short, long_), rule.factor)
         if rule.kind == "rate":  # restart_churn over registry gens
             key = (target.role, target.host)
             seen = self._gen_seen.get(key)

@@ -140,10 +140,21 @@ class HistogramWindow:
         delta_n = total - prev_total
         if delta_n <= 0 or not buckets:
             return None
-        target = q * delta_n
         uppers = sorted(buckets)
+        deltas = {ub: buckets[ub] - prev_buckets.get(ub, 0.0)
+                  for ub in uppers}
+        if any(d < 0 for d in deltas.values()):
+            # Counter reset the total-delta guard cannot see: the
+            # target restarted and the NEW process out-accumulated the
+            # old total between scrapes (delta_n > 0), but individual
+            # buckets went backwards — diffing across generations
+            # would fabricate a quantile from a mixed window. Re-prime
+            # (the new snapshot is already ``_prev``) and report
+            # nothing for this window.
+            return None
+        target = q * delta_n
         for ub in uppers:
-            if buckets[ub] - prev_buckets.get(ub, 0.0) >= target:
+            if deltas[ub] >= target:
                 return ub
         # the quantile landed in the implicit +Inf bucket: report past
         # the largest finite bound so the detector still sees "huge"
@@ -155,6 +166,23 @@ class HistogramWindow:
 # deque per target
 SERIES = ("step", "steps_per_s", "loss", "step_time_ms", "mfu_pct",
           "goodput_pct", "straggler_ratio", "shed_per_s", "ttft_p95_s")
+
+# raw scraped families additionally persisted through the history
+# store (obs/tsdb.py) when one is attached: the cumulative counters /
+# histogram totals the SLO-budget math and postmortems want verbatim,
+# not just the per-scrape derivations above
+PERSIST_FAMILIES = ("serve_shed_total", "serve_ttft_seconds_count",
+                    "serve_ttft_seconds_sum")
+PERSIST_FAMILY_SUMS = ("serve_requests_total",)
+
+
+def family_sum(families: dict, name: str) -> float | None:
+    """Sum over every label set of one family (e.g. the per-outcome
+    serve_requests_total) — None when the family is absent."""
+    fam = families.get(name)
+    if not fam:
+        return None
+    return sum(fam.values())
 
 
 class Target:
@@ -168,9 +196,14 @@ class Target:
       (the alertable "gone" condition).
     """
 
-    def __init__(self, endpoint: dict, window: int = 240):
+    def __init__(self, endpoint: dict, window: int = 240, history=None):
         self.role = str(endpoint.get("role", "?"))
         self.host = str(endpoint.get("host", "?"))
+        # durable write-through (obs/tsdb.TimeSeriesStore or None);
+        # the key is what slo_budget's role scoping parses back
+        self.history = history
+        self.history_key = f"{self.role}@{self.host}"
+        self._wall_now = 0.0
         self.addr = str(endpoint.get("addr", ""))
         self.idx = int(endpoint.get("idx", -1))
         self.gens: set[str] = set()
@@ -231,8 +264,19 @@ class Target:
 
     # ------------------------------------------------------ derivations
     def _push(self, name: str, now: float, value: float | None) -> None:
-        if value is not None:
-            self.series[name].append((now, float(value)))
+        if value is None:
+            return
+        self.series[name].append((now, float(value)))
+        if self.history is not None:
+            # wall-clock stamp (set once per ingest): history must be
+            # joinable across restarts and against the event journal,
+            # which the in-memory deques' monotonic stamps are not
+            try:
+                self.history.append(self.history_key, name,
+                                    self._wall_now or time.time(),
+                                    float(value))
+            except Exception:
+                pass  # history is best-effort; scraping never dies of it
 
     def _rate(self, name: str, now: float,
               value: float | None) -> float | None:
@@ -255,6 +299,26 @@ class Target:
         self.last_ok_mono = now_mono
         self.consecutive_errors = 0
         self.last_error = None
+        self._wall_now = time.time()
+        if self.history is not None:
+            for fname in PERSIST_FAMILIES:
+                v = family_value(families, fname)
+                if v is None:
+                    v = family_sum(families, fname)
+                if v is not None:
+                    try:
+                        self.history.append(self.history_key, fname,
+                                            self._wall_now, v)
+                    except Exception:
+                        pass
+            for fname in PERSIST_FAMILY_SUMS:
+                v = family_sum(families, fname)
+                if v is not None:
+                    try:
+                        self.history.append(self.history_key, fname,
+                                            self._wall_now, v)
+                    except Exception:
+                        pass
 
         step = family_value(families, "train_step")
         if step is not None:
@@ -333,13 +397,19 @@ class FleetCollector:
 
     def __init__(self, *, store_factory=None, endpoints=(),
                  poll_s: float = 2.0, stale_after_s: float = 10.0,
-                 window: int = 240, timeout_s: float = 2.0, fetch=None):
+                 window: int = 240, timeout_s: float = 2.0, fetch=None,
+                 history=None):
         from pytorch_distributed_train_tpu.elastic import worker_store
 
         self.poll_s = max(0.05, poll_s)
         self.stale_after_s = stale_after_s
         self.window = window
         self.timeout_s = timeout_s
+        # optional durable history (obs/tsdb.TimeSeriesStore): every
+        # series sample + selected raw counters write THROUGH it; a
+        # fresh collector pointed at the same root re-attaches to the
+        # on-disk trajectories (no amnesia gap across restarts)
+        self.history = history
         self._factory = store_factory if store_factory is not None \
             else worker_store
         self._fetch = fetch or _default_fetch
@@ -358,7 +428,8 @@ class FleetCollector:
         with self._lock:
             t = self._targets.get(key)
             if t is None:
-                self._targets[key] = Target(ep, window=self.window)
+                self._targets[key] = Target(ep, window=self.window,
+                                            history=self.history)
             else:
                 t.note_endpoint(ep)
 

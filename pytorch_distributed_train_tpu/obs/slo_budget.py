@@ -1,0 +1,225 @@
+"""Declarative SLO catalog + error-budget accounting over the tsdb.
+
+The alert catalog (obs/alerts.py) answers "is something anomalous
+RIGHT NOW". This module answers the operator's slower question — "are
+we keeping our promises" — with the three pieces Google-SRE burn-rate
+alerting needs, all computed from the durable per-target history in
+``obs/tsdb.TimeSeriesStore``:
+
+- **SLO_CATALOG** — the CLOSED set of service-level objectives
+  (serving availability, TTFT p95, trainer goodput, steps/s floor).
+  Each SLO names the collector series that is its SLI, the good-side
+  threshold, the objective (target good fraction) and the budget
+  window. Mirrored in docs/observability.md's '## SLO catalog' table
+  and cross-checked both ways by the ``slo-catalog`` pass of
+  ``python -m tools.analyze`` — the fault-points/event-categories/
+  alert-rules pattern, applied a fifth time.
+- **SLI semantics** — a scrape sample is GOOD when its value sits on
+  the SLO's good side of the threshold; the SLI over a window is the
+  good fraction of its samples. Sample-based (not request-based) on
+  purpose: it is computable for trainer series where "a request" does
+  not exist, and the collector's scrape cadence makes samples a fair
+  proxy for time.
+- **burn rates & budgets** — ``burn_rate(slo, target, window)`` =
+  bad_fraction(window) / (1 - objective): 1.0 means "spending the
+  budget exactly as fast as the SLO allows", N means N× too fast.
+  ``budget_remaining(slo, target)`` over the SLO's own window is the
+  fraction of error budget left (negative = overspent).
+
+The multi-window multi-burn-rate RULES themselves (fast 5m/1h page +
+slow 30m/6h warn per SLO) are declared in obs/alerts.py ``RULES``
+(kind ``burn_rate``) so they ride the existing engine lifecycle —
+firing→resolved transitions journaled under ``alert``, counted,
+cooldown-limited — and this module only does the math. A rule fires
+when BOTH its windows burn over the factor (the short window proves
+it is happening now, the long window proves it is not a blip) and
+resolves as soon as either recovers.
+
+``export_gauges`` mirrors the accounting into the metric catalog:
+``slo_error_budget_remaining{slo=}`` (worst target) and
+``slo_burn_rate{slo=,window=}`` (worst target's fast/slow actionable
+burn — the min of each pair, since both windows must agree to act).
+
+Stdlib + obs.tsdb/registry only; no jax (login-host safe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One declared objective. ``series`` is the collector series the
+    SLI reads; a sample is good when its value is ``good`` (below /
+    above) the ``threshold``; ``objective`` is the target good
+    fraction over ``window_s``."""
+
+    name: str
+    roles: tuple                   # ("serving",) / ("trainer",)
+    series: str
+    good: str                      # "below" | "above"
+    threshold: float
+    objective: float               # target good fraction, in (0, 1)
+    window_s: float                # error-budget accounting window
+    description: str
+
+
+GOOD_SIDES = ("below", "above")
+
+# The CLOSED catalog — docs/observability.md '## SLO catalog' mirrors
+# this table; tools/analyze's slo-catalog pass keeps the two in sync.
+SLO_CATALOG: dict[str, SLO] = {s.name: s for s in (
+    SLO(name="serve_availability", roles=("serving",),
+        series="shed_per_s", good="below", threshold=1.0,
+        objective=0.99, window_s=3600.0,
+        description="admission availability: a scrape sample is good "
+                    "when the replica sheds under 1 req/s (429s are "
+                    "the error budget, not an outage)"),
+    SLO(name="serve_ttft_p95", roles=("serving",),
+        series="ttft_p95_s", good="below", threshold=0.5,
+        objective=0.95, window_s=3600.0,
+        description="latency: windowed TTFT p95 under 500ms — the "
+                    "promise the whole serving plane defends"),
+    SLO(name="trainer_goodput", roles=("trainer",),
+        series="goodput_pct", good="above", threshold=50.0,
+        objective=0.95, window_s=3600.0,
+        description="trainer goodput above 50%% productive — restarts "
+                    "and stalls spend this budget"),
+    SLO(name="trainer_steps_floor", roles=("trainer",),
+        series="steps_per_s", good="above", threshold=0.1,
+        objective=0.90, window_s=3600.0,
+        description="throughput floor: steps/s above 0.1 — a slower "
+                    "fleet is a budget spend, a stopped one an alert"),
+)}
+
+# (short_s, long_s) per burn window; factor = burn-rate threshold.
+# The classic SRE pairs: the fast pair pages (a real, current fire),
+# the slow pair warns (a sustained slow leak).
+BURN_WINDOWS: dict[str, tuple[float, float]] = {
+    "fast": (300.0, 3600.0),
+    "slow": (1800.0, 21600.0),
+}
+BURN_FACTORS: dict[str, float] = {"fast": 14.4, "slow": 3.0}
+
+
+class SLOBudgetTracker:
+    """Error-budget accounting over a TimeSeriesStore.
+
+    Target keys are the collector's history keys (``role@host``), so
+    role scoping falls out of the key prefix. Every method returns
+    None when the store holds no samples for the window — an SLO with
+    no evidence is unknown, not violated (the never-scraped blame
+    rule, budget-flavored)."""
+
+    def __init__(self, store, catalog: dict | None = None,
+                 clock=time.time):
+        self.store = store
+        self.catalog = dict(catalog if catalog is not None
+                            else SLO_CATALOG)
+        self.clock = clock
+
+    # ------------------------------------------------------------- math
+    def _bad_fraction(self, slo: SLO, target_key: str,
+                      window_s: float, now: float) -> float | None:
+        pts = self.store.query(target_key, slo.series,
+                               now - window_s, now)
+        if not pts:
+            return None
+        if slo.good == "below":
+            bad = sum(1 for _ts, v in pts if v > slo.threshold)
+        else:
+            bad = sum(1 for _ts, v in pts if v < slo.threshold)
+        return bad / len(pts)
+
+    def burn_rate(self, slo_name: str, target_key: str,
+                  window_s: float, now: float | None = None
+                  ) -> float | None:
+        slo = self.catalog[slo_name]
+        now = self.clock() if now is None else now
+        bf = self._bad_fraction(slo, target_key, window_s, now)
+        if bf is None:
+            return None
+        return bf / max(1e-9, 1.0 - slo.objective)
+
+    def budget_remaining(self, slo_name: str, target_key: str,
+                         now: float | None = None) -> float | None:
+        """Fraction of the error budget left over the SLO's own
+        window; 1.0 = untouched, 0.0 = spent, negative = overspent."""
+        slo = self.catalog[slo_name]
+        now = self.clock() if now is None else now
+        bf = self._bad_fraction(slo, target_key, slo.window_s, now)
+        if bf is None:
+            return None
+        return 1.0 - bf / max(1e-9, 1.0 - slo.objective)
+
+    # ---------------------------------------------------------- rollups
+    def _targets_for(self, slo: SLO) -> list[str]:
+        return [t for t in self.store.targets()
+                if t.partition("@")[0] in slo.roles]
+
+    def status(self, now: float | None = None) -> dict:
+        """Per-SLO rollup the console panel and obs_report render:
+        worst-target budget remaining + per-window burn rates (the
+        actionable burn of each pair: min(short, long), worst across
+        targets)."""
+        now = self.clock() if now is None else now
+        out: dict[str, dict] = {}
+        for name, slo in self.catalog.items():
+            targets: dict[str, dict] = {}
+            for key in self._targets_for(slo):
+                rem = self.budget_remaining(name, key, now)
+                if rem is None:
+                    continue
+                burns = {}
+                for win, (short_s, long_s) in BURN_WINDOWS.items():
+                    sb = self.burn_rate(name, key, short_s, now)
+                    lb = self.burn_rate(name, key, long_s, now)
+                    if sb is not None and lb is not None:
+                        burns[win] = min(sb, lb)
+                targets[key] = {"budget_remaining": rem, "burn": burns}
+            if not targets:
+                continue
+            worst_key = min(targets,
+                            key=lambda k: targets[k]["budget_remaining"])
+            rollup_burn = {
+                win: max((t["burn"][win] for t in targets.values()
+                          if win in t["burn"]), default=None)
+                for win in BURN_WINDOWS}
+            worst_win = None
+            numeric = {w: b for w, b in rollup_burn.items()
+                       if b is not None}
+            if numeric:
+                worst_win = max(numeric, key=numeric.get)
+            out[name] = {
+                "budget_remaining":
+                    targets[worst_key]["budget_remaining"],
+                "worst_target": worst_key,
+                "burn": rollup_burn,
+                "worst_window": worst_win,
+                "objective": slo.objective,
+                "window_s": slo.window_s,
+                "targets": targets,
+            }
+        return out
+
+    def export_gauges(self, now: float | None = None) -> None:
+        reg = get_registry()
+        for name, st in self.status(now).items():
+            reg.gauge("slo_error_budget_remaining",
+                      labels={"slo": name},
+                      help="fraction of SLO error budget left over the "
+                           "budget window (worst target; negative = "
+                           "overspent)").set(st["budget_remaining"])
+            for win, burn in st["burn"].items():
+                if burn is None:
+                    continue
+                reg.gauge("slo_burn_rate",
+                          labels={"slo": name, "window": win},
+                          help="actionable SLO burn rate per window "
+                               "pair (min of short/long, worst target; "
+                               "1.0 = spending exactly at the SLO "
+                               "rate)").set(burn)

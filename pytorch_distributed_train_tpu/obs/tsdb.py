@@ -1,0 +1,523 @@
+"""Embedded per-target time-series store: the fleet's durable memory.
+
+PR 13's collector keeps every series in a bounded in-memory deque —
+observable in the moment, amnesiac past the window, gone with the
+process. This module is the history half: a dependency-free store the
+collector writes THROUGH on every scrape, so "shed storm for 10
+minutes" and "TTFT budget 80%% burned this hour" are answerable
+questions (obs/slo_budget.py asks them) and a console restart loses
+nothing.
+
+Layout (one directory tree, shared-storage friendly):
+
+    <root>/<role>@<host>/<series>/<tier>/open.jsonl
+    <root>/<role>@<host>/<series>/<tier>/chunk-<start_ms>.tsc
+
+- **open.jsonl** — the append-only ACTIVE chunk: one JSON row per
+  sample, flushed per append, so a SIGKILLed collector loses at most
+  the OS page cache (nothing, for a process kill). A torn tail line is
+  skipped on read — it is the routine shape of a kill, not corruption.
+- **chunk-*.tsc** — a SEALED chunk: written to ``.tmp`` and published
+  by ``os.replace`` (the packed_cache/manifest atomic-seal pattern),
+  magic + JSON header + packed little-endian float64 payload with the
+  payload's CRC32 in the header. A truncated or bit-flipped sealed
+  chunk fails its CRC on read, is IGNORED, and counts into
+  ``tsdb_chunk_corrupt_total`` — a reader never crashes on a torn
+  file and never silently serves garbage.
+- **tiers** — ``raw`` (every scrape sample, rows ``[ts, value]``) plus
+  downsampled aggregates maintained ONLINE as raw samples arrive
+  (default 10s and 1m buckets, rows ``[bucket_ts, min, max, mean,
+  last, count]``): long-range queries read a few aggregate rows
+  instead of re-scanning every scrape ever taken.
+
+Retention is a DISK budget, not an age: ``gc()`` (run after every
+seal) evicts the oldest sealed chunks until the store fits, but never
+a chunk a still-open query iterator holds pinned, and never the
+newest sealed chunk of any (target, series, tier) — history shrinks
+from the far end only, and an in-flight read never has its data
+deleted out from under it.
+
+Timestamps are WALL-CLOCK epoch seconds (the caller stamps them):
+history must survive process restarts and be joinable against the
+event journal, which monotonic time cannot do.
+
+Stdlib only; no jax anywhere near this module (obs/ package contract
+— it runs on a login host).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+import threading
+import zlib
+
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+MAGIC = b"PDTTTSC1"
+RAW = "raw"
+AGGS = ("min", "max", "mean", "last", "count", "sum")
+_SAN = re.compile(r"[^A-Za-z0-9_.@-]+")
+
+
+def _safe(name: str) -> str:
+    return _SAN.sub("_", str(name)) or "_"
+
+
+def _tier_name(width_s: float) -> str:
+    return f"{int(width_s)}s"
+
+
+def _chunk_name(start_ts: float) -> str:
+    return f"chunk-{int(start_ts * 1000):015d}.tsc"
+
+
+def write_chunk(path: str, series: str, tier: str,
+                rows: list[tuple]) -> None:
+    """Seal ``rows`` (each a tuple of floats, all the same width) into
+    one immutable chunk: tmp + fsync-less atomic rename, CRC of the
+    payload in the header. Readers see the old state or the new state,
+    never a half-written file."""
+    cols = len(rows[0])
+    payload = b"".join(struct.pack(f"<{cols}d", *r) for r in rows)
+    header = {
+        "series": series, "tier": tier, "n": len(rows), "cols": cols,
+        "start": rows[0][0], "end": rows[-1][0],
+        "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+    }
+    hbytes = json.dumps(header, sort_keys=True).encode("utf-8")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", len(hbytes)))
+        f.write(hbytes)
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def read_chunk(path: str) -> tuple[dict, list[tuple]] | None:
+    """(header, rows) of a sealed chunk — or None (counted into
+    ``tsdb_chunk_corrupt_total``) when the file is torn, truncated or
+    fails its CRC. A corrupt chunk is a hole in history, not a crash."""
+    try:
+        with open(path, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ValueError("bad magic")
+            (hlen,) = struct.unpack("<I", f.read(4))
+            header = json.loads(f.read(hlen).decode("utf-8"))
+            cols, n = int(header["cols"]), int(header["n"])
+            payload = f.read(cols * n * 8)
+            if len(payload) != cols * n * 8:
+                raise ValueError("truncated payload")
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != header["crc32"]:
+                raise ValueError("crc mismatch")
+        rows = [struct.unpack_from(f"<{cols}d", payload, i * cols * 8)
+                for i in range(n)]
+        return header, rows
+    except (OSError, ValueError, KeyError, struct.error):
+        get_registry().counter(
+            "tsdb_chunk_corrupt_total",
+            help="sealed tsdb chunks ignored for torn/truncated/CRC "
+                 "failure").inc()
+        return None
+
+
+class _Bucket:
+    """Online aggregate accumulator for one downsample interval."""
+
+    __slots__ = ("start", "mn", "mx", "total", "last", "count")
+
+    def __init__(self, start: float, value: float):
+        self.start = start
+        self.mn = self.mx = self.total = self.last = value
+        self.count = 1
+
+    def add(self, value: float) -> None:
+        self.mn = min(self.mn, value)
+        self.mx = max(self.mx, value)
+        self.total += value
+        self.last = value
+        self.count += 1
+
+    def row(self) -> tuple:
+        return (self.start, self.mn, self.mx, self.total / self.count,
+                self.last, float(self.count))
+
+
+class _SeriesTier:
+    """One (target, series, tier) directory: the open chunk's append
+    state plus seal bookkeeping. Re-attach recovers the open row count
+    and the last persisted timestamp by scanning open.jsonl once."""
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        self.open_path = os.path.join(dir_path, "open.jsonl")
+        self.fh = None
+        self.open_rows = 0
+        self.open_start: float | None = None
+        self.last_ts: float | None = None
+        os.makedirs(dir_path, exist_ok=True)
+        for row in _read_jsonl(self.open_path):
+            self.open_rows += 1
+            if self.open_start is None:
+                self.open_start = row[0]
+            self.last_ts = row[0]
+
+    def append(self, row: tuple) -> None:
+        if self.fh is None:
+            self.fh = open(self.open_path, "a")
+        self.fh.write(json.dumps(list(row)) + "\n")
+        self.fh.flush()
+        if self.open_start is None:
+            self.open_start = row[0]
+        self.last_ts = row[0]
+        self.open_rows += 1
+
+    def seal(self, series: str, tier: str) -> str | None:
+        rows = _read_jsonl(self.open_path)
+        if self.fh is not None:
+            self.fh.close()
+            self.fh = None
+        if not rows:
+            return None
+        path = os.path.join(self.dir, _chunk_name(rows[0][0]))
+        write_chunk(path, series, tier, rows)
+        os.remove(self.open_path)
+        self.open_rows = 0
+        self.open_start = None
+        get_registry().counter(
+            "tsdb_chunks_sealed_total",
+            help="tsdb open chunks sealed into immutable CRC'd "
+                 "files").inc()
+        return path
+
+    def close(self) -> None:
+        if self.fh is not None:
+            self.fh.close()
+            self.fh = None
+
+
+def _read_jsonl(path: str) -> list[tuple]:
+    rows: list[tuple] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue  # torn tail of a killed writer: routine
+                if isinstance(row, list) and row and all(
+                        isinstance(v, (int, float)) for v in row):
+                    rows.append(tuple(float(v) for v in row))
+    except OSError:
+        pass
+    return rows
+
+
+class TimeSeriesStore:
+    """The embedded store. One instance per collector (or per reading
+    tool); every method is thread-safe — the collector scrapes targets
+    on parallel threads and a console query may run concurrently."""
+
+    def __init__(self, root: str, *, chunk_samples: int = 360,
+                 chunk_span_s: float = 900.0,
+                 tiers: tuple = (10.0, 60.0),
+                 disk_budget_bytes: int = 64 << 20):
+        self.root = root
+        self.chunk_samples = max(2, int(chunk_samples))
+        self.chunk_span_s = float(chunk_span_s)
+        self.tier_widths = tuple(sorted(float(w) for w in tiers))
+        self.disk_budget_bytes = int(disk_budget_bytes)
+        self._lock = threading.RLock()
+        self._states: dict[tuple[str, str, str], _SeriesTier] = {}
+        self._buckets: dict[tuple[str, str, float], _Bucket] = {}
+        self._pins: dict[str, int] = {}
+        os.makedirs(root, exist_ok=True)
+
+    # ------------------------------------------------------------- layout
+    def _tier_dir(self, target: str, series: str, tier: str) -> str:
+        return os.path.join(self.root, _safe(target), _safe(series), tier)
+
+    def _state(self, target: str, series: str, tier: str) -> _SeriesTier:
+        key = (_safe(target), _safe(series), tier)
+        st = self._states.get(key)
+        if st is None:
+            st = self._states[key] = _SeriesTier(
+                self._tier_dir(target, series, tier))
+        return st
+
+    def targets(self) -> list[str]:
+        try:
+            return sorted(d for d in os.listdir(self.root)
+                          if os.path.isdir(os.path.join(self.root, d)))
+        except OSError:
+            return []
+
+    def series(self, target: str) -> list[str]:
+        tdir = os.path.join(self.root, _safe(target))
+        try:
+            return sorted(d for d in os.listdir(tdir)
+                          if os.path.isdir(os.path.join(tdir, d)))
+        except OSError:
+            return []
+
+    # ------------------------------------------------------------- writes
+    def append(self, target: str, series: str, ts: float,
+               value: float) -> None:
+        """One raw sample. Updates the online downsample buckets and
+        seals/GCs when the open chunk fills — all under one lock, all
+        bounded work."""
+        ts, value = float(ts), float(value)
+        with self._lock:
+            st = self._state(target, series, RAW)
+            st.append((ts, value))
+            for width in self.tier_widths:
+                self._downsample(target, series, width, ts, value)
+            if (st.open_rows >= self.chunk_samples
+                    or (st.open_start is not None
+                        and ts - st.open_start >= self.chunk_span_s)):
+                st.seal(_safe(series), RAW)
+                self.gc()
+
+    def _downsample(self, target: str, series: str, width: float,
+                    ts: float, value: float) -> None:
+        key = (_safe(target), _safe(series), width)
+        start = (ts // width) * width
+        b = self._buckets.get(key)
+        if b is not None and start > b.start:
+            # bucket complete: one aggregate row into the tier's chunk
+            tier = _tier_name(width)
+            st = self._state(target, series, tier)
+            if st.last_ts is None or b.start > st.last_ts:
+                # (re-attach guard: a bucket already emitted by the
+                # previous process must not appear twice)
+                st.append(b.row())
+                if st.open_rows >= self.chunk_samples:
+                    st.seal(_safe(series), tier)
+                    self.gc()
+            self._buckets[key] = _Bucket(start, value)
+        elif b is None or start < b.start:
+            self._buckets[key] = _Bucket(start, value)
+        else:
+            b.add(value)
+
+    def flush(self) -> None:
+        """Seal every open chunk (shutdown / test hook)."""
+        with self._lock:
+            for (tgt, ser, tier), st in list(self._states.items()):
+                if st.open_rows:
+                    st.seal(ser, tier)
+            self.gc()
+
+    def close(self) -> None:
+        with self._lock:
+            for st in self._states.values():
+                st.close()
+
+    # ------------------------------------------------------------- queries
+    def _chunks(self, target: str, series: str, tier: str) -> list[str]:
+        d = self._tier_dir(target, series, tier)
+        try:
+            return sorted(
+                os.path.join(d, f) for f in os.listdir(d)
+                if f.startswith("chunk-") and f.endswith(".tsc"))
+        except OSError:
+            return []
+
+    def query_iter(self, target: str, series: str, start: float,
+                   end: float, *, tier: str = RAW):
+        """Lazy row iterator over [start, end]: sealed chunks (each
+        PINNED against GC while it is being read) then the open chunk.
+        Rows are ``(ts, value)`` for raw, ``(bucket_ts, min, max, mean,
+        last, count)`` for aggregate tiers."""
+        for path in self._chunks(target, series, tier):
+            with self._lock:
+                self._pins[path] = self._pins.get(path, 0) + 1
+            try:
+                got = read_chunk(path)
+                if got is None:
+                    continue
+                header, rows = got
+                if header["end"] < start or header["start"] > end:
+                    continue
+                for row in rows:
+                    if start <= row[0] <= end:
+                        yield row
+            finally:
+                with self._lock:
+                    n = self._pins.get(path, 1) - 1
+                    if n <= 0:
+                        self._pins.pop(path, None)
+                    else:
+                        self._pins[path] = n
+        with self._lock:
+            st = self._states.get((_safe(target), _safe(series), tier))
+        open_path = (st.open_path if st is not None else os.path.join(
+            self._tier_dir(target, series, tier), "open.jsonl"))
+        for row in _read_jsonl(open_path):
+            if start <= row[0] <= end:
+                yield row
+
+    def query(self, target: str, series: str, start: float, end: float,
+              *, step: float = 0.0, agg: str = "mean",
+              tier: str | None = None) -> list[tuple[float, float]]:
+        """Range query: ``[(ts, value), ...]`` sorted by time.
+
+        ``step=0`` returns point samples (raw values, or the ``agg``
+        field of aggregate-tier rows). ``step>0`` buckets the range
+        into ``[start + k*step)`` windows and reduces each with
+        ``agg`` ∈ {min, max, mean, last, count, sum}. ``tier=None``
+        picks the coarsest downsample tier that still resolves
+        ``step`` (≥ 2 source buckets per output bucket), falling back
+        toward raw when a tier holds no data for the range."""
+        if agg not in AGGS:
+            raise ValueError(f"agg {agg!r} not in {AGGS}")
+        tiers_to_try = ([tier] if tier is not None
+                        else self._auto_tiers(step))
+        rows: list[tuple] = []
+        used = RAW
+        for t in tiers_to_try:
+            rows = sorted(self.query_iter(target, series, start, end,
+                                          tier=t), key=lambda r: r[0])
+            if rows:
+                used = t
+                break
+        if not rows:
+            return []
+        if step <= 0.0:
+            return [(r[0], _row_value(r, agg, used)) for r in rows]
+        out: list[tuple[float, float]] = []
+        acc: dict[float, _Agg] = {}
+        for r in rows:
+            b = start + ((r[0] - start) // step) * step
+            a = acc.get(b)
+            if a is None:
+                a = acc[b] = _Agg()
+            a.add(r, used)
+        for b in sorted(acc):
+            out.append((b, acc[b].value(agg)))
+        return out
+
+    def _auto_tiers(self, step: float) -> list[str]:
+        picks = [RAW]
+        for width in self.tier_widths:
+            if step > 0 and width * 2 <= step:
+                picks.insert(0, _tier_name(width))
+        return picks
+
+    def latest(self, target: str, series: str) -> tuple | None:
+        """Newest raw sample on disk (console sparkline anchor)."""
+        rows = _read_jsonl(os.path.join(
+            self._tier_dir(target, series, RAW), "open.jsonl"))
+        if rows:
+            return rows[-1]
+        chunks = self._chunks(target, series, RAW)
+        for path in reversed(chunks):
+            got = read_chunk(path)
+            if got is not None and got[1]:
+                return got[1][-1]
+        return None
+
+    # ----------------------------------------------------------- retention
+    def gc(self) -> int:
+        """Evict oldest sealed chunks until the store fits its disk
+        budget. Never the newest sealed chunk of a (target, series,
+        tier) — a restarting reader must always find SOME history —
+        and never a chunk a live ``query_iter`` holds pinned. Returns
+        the number of chunks evicted."""
+        with self._lock:
+            entries = []  # (start_key, path, size, is_newest)
+            total = 0
+            for tgt in self.targets():
+                for ser in self.series(tgt):
+                    base = os.path.join(self.root, tgt, ser)
+                    try:
+                        tiers = os.listdir(base)
+                    except OSError:
+                        continue
+                    for tier in tiers:
+                        chunks = self._chunks(tgt, ser, tier)
+                        for i, path in enumerate(chunks):
+                            try:
+                                size = os.path.getsize(path)
+                            except OSError:
+                                continue
+                            total += size
+                            entries.append(
+                                (os.path.basename(path), path, size,
+                                 i == len(chunks) - 1))
+            evicted = 0
+            if total > self.disk_budget_bytes:
+                for _key, path, size, newest in sorted(entries):
+                    if total <= self.disk_budget_bytes:
+                        break
+                    if newest or self._pins.get(path):
+                        continue
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        continue
+                    total -= size
+                    evicted += 1
+            if evicted:
+                get_registry().counter(
+                    "tsdb_gc_evicted_total",
+                    help="sealed tsdb chunks evicted by the disk-budget "
+                         "retention GC").inc(evicted)
+            get_registry().gauge(
+                "tsdb_disk_bytes",
+                help="bytes of sealed tsdb chunks on disk").set(total)
+            return evicted
+
+
+class _Agg:
+    """Reducer that merges raw samples or aggregate-tier rows into one
+    output bucket, keeping the math consistent either way: a mean of a
+    downsampled range is the sample-count-weighted mean, identical to
+    the mean over the raw samples it summarizes."""
+
+    __slots__ = ("mn", "mx", "total", "count", "last")
+
+    def __init__(self):
+        self.mn = self.mx = self.last = None
+        self.total = 0.0
+        self.count = 0.0
+
+    def add(self, row: tuple, tier: str) -> None:
+        if tier == RAW:
+            mn = mx = last = row[1]
+            total, count = row[1], 1.0
+        else:
+            _ts, mn, mx, mean, last, count = row[:6]
+            total = mean * count
+        self.mn = mn if self.mn is None else min(self.mn, mn)
+        self.mx = mx if self.mx is None else max(self.mx, mx)
+        self.total += total
+        self.count += count
+        self.last = last
+
+    def value(self, agg: str) -> float:
+        if agg == "min":
+            return self.mn
+        if agg == "max":
+            return self.mx
+        if agg == "last":
+            return self.last
+        if agg == "count":
+            return self.count
+        if agg == "sum":
+            return self.total
+        return self.total / self.count if self.count else 0.0
+
+
+def _row_value(row: tuple, agg: str, tier: str) -> float:
+    if tier == RAW:
+        return row[1]
+    _ts, mn, mx, mean, last, count = row[:6]
+    return {"min": mn, "max": mx, "mean": mean, "last": last,
+            "count": count, "sum": mean * count}[agg]

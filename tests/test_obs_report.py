@@ -147,6 +147,43 @@ def test_corrupt_trace_does_not_suppress_later_sections(tmp_path,
     assert "goodput: 81.0% productive" in out    # earlier one too
 
 
+def test_section_contract_slo_budgets(tmp_path, capsys):
+    """SLO budgets section (tsdb-sourced): ABSENT entirely when the run
+    kept no history store (pre-history runs stay quiet); present-but-
+    empty store and catalog-less store each degrade to one line; a
+    store holding a catalog SLI renders per-SLO budget lines."""
+    import time as _time
+
+    _write_fixture(tmp_path)
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "SLO budgets" not in out          # no <run>/tsdb → absent
+    (tmp_path / "tsdb").mkdir()
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "SLO budgets: store present but empty" in out
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    from pytorch_distributed_train_tpu.obs.tsdb import TimeSeriesStore
+
+    store = TimeSeriesStore(str(tmp_path / "tsdb"))
+    now = _time.time()
+    store.append("serving@h0", "uncatalogued_series", now, 1.0)
+    store.flush()
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "SLO budgets: store holds no SLI series" in out
+    for i in range(20):  # all good: ttft well under the 0.5s bound
+        store.append("serving@h0", "ttft_p95_s", now - 60 + 3 * i, 0.01)
+    store.flush()
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "SLO budgets (as of the store's newest sample):" in out
+    assert "serve_ttft_p95" in out and "budget +1.00 (ok)" in out
+    # the section is sourced from the store alone — sections after it
+    # (traces) must still follow their own contract
+    assert "traces:" not in out
+
+
 def test_corrupt_journal_does_not_suppress_later_sections(tmp_path,
                                                           capsys):
     """A journal whose records defeat the loader (non-numeric ts mixed

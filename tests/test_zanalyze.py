@@ -47,7 +47,7 @@ def test_registry_has_all_passes():
     assert set(core.all_passes()) == {
         "lock-scope", "monotonic-clock", "jit-purity", "fault-catalog",
         "event-catalog", "metric-catalog", "thread-shared-state",
-        "trace-hygiene", "alert-catalog", "lock-order",
+        "trace-hygiene", "alert-catalog", "slo-catalog", "lock-order",
         "thread-lifecycle"}
 
 

@@ -16,6 +16,7 @@ from tools.analyze.passes import (  # noqa: F401
     lock_scope,
     metric_catalog,
     monotonic_clock,
+    slo_catalog,
     thread_lifecycle,
     thread_shared,
     trace_hygiene,

@@ -19,7 +19,7 @@ from tools.analyze.core import AnalysisPass, Context, Finding, register
 _ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
 DOC_REL = os.path.join("docs", "observability.md")
 SECTION = "## alert catalog"
-KINDS = {"threshold", "absence", "rate", "anomaly"}
+KINDS = {"threshold", "absence", "rate", "anomaly", "burn_rate"}
 CODE_REL = "pytorch_distributed_train_tpu/obs/alerts.py"
 
 

@@ -1,0 +1,97 @@
+"""slo-catalog: obs/slo_budget.py SLO_CATALOG ↔ docs table.
+
+The fifth catalog: every declared service-level objective must appear
+in docs/observability.md's '## SLO catalog' table and vice versa — an
+SLO nobody can look up has no owner, and a documented objective the
+budget tracker never accounts is a promise nothing measures. Also
+lints the declarations themselves (the closed-field contract the burn
+-rate rules are generated from): ``good`` comes from GOOD_SIDES, every
+SLO names at least one role, the objective is a proper fraction, and
+the accounting window is positive.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from tools.analyze.core import AnalysisPass, Context, Finding, register
+
+_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+DOC_REL = os.path.join("docs", "observability.md")
+SECTION = "## slo catalog"
+CODE_REL = "pytorch_distributed_train_tpu/obs/slo_budget.py"
+
+
+def documented_slos(doc_path: str) -> set[str]:
+    from tools.analyze.core import doc_table_names
+
+    return doc_table_names(doc_path, SECTION, _ROW)
+
+
+def declared_slos() -> dict:
+    from pytorch_distributed_train_tpu.obs.slo_budget import SLO_CATALOG
+
+    return dict(SLO_CATALOG)
+
+
+@register
+class SloCatalogPass(AnalysisPass):
+    id = "slo-catalog"
+    description = ("service-level objectives: obs/slo_budget.py "
+                   "SLO_CATALOG ↔ the doc's '## SLO catalog' table, "
+                   "both ways, plus closed-field lint")
+    include = (CODE_REL,)
+
+    def run(self, ctx: Context) -> list[Finding]:
+        from pytorch_distributed_train_tpu.obs.slo_budget import GOOD_SIDES
+
+        doc_path = ctx.doc_path(DOC_REL)
+        doc_rel = DOC_REL.replace(os.sep, "/")
+        code = declared_slos()
+        try:
+            doc = documented_slos(doc_path)
+        except OSError:
+            return [Finding(self.id, doc_rel, 1,
+                            "docs/observability.md is unreadable",
+                            key="doc-missing")]
+        if not doc:
+            return [Finding(self.id, doc_rel, 1,
+                            "no rows under '## SLO catalog' — was the "
+                            "table renamed?", key="catalog-empty")]
+        out: list[Finding] = []
+        for name, slo in sorted(code.items()):
+            if slo.good not in GOOD_SIDES:
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"SLO `{name}` has good={slo.good!r} outside the "
+                    f"closed set {sorted(GOOD_SIDES)}",
+                    key=f"good:{name}"))
+            if not slo.roles:
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"SLO `{name}` applies to no role — its budget can "
+                    f"never be accounted", key=f"roles:{name}"))
+            if not 0.0 < slo.objective < 1.0:
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"SLO `{name}` objective {slo.objective} is not a "
+                    f"proper fraction (0 < objective < 1)",
+                    key=f"objective:{name}"))
+            if slo.window_s <= 0:
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"SLO `{name}` has non-positive accounting window "
+                    f"{slo.window_s}s", key=f"window:{name}"))
+        for name in sorted(set(code) - doc):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"SLO `{name}` declared in obs/slo_budget.py but "
+                f"missing from the doc's SLO catalog",
+                key=f"undocumented:{name}"))
+        for name in sorted(doc - set(code)):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"SLO `{name}` documented but absent from "
+                f"obs/slo_budget.py SLO_CATALOG", key=f"phantom:{name}"))
+        return out

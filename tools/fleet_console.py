@@ -26,6 +26,20 @@ scrape passes so rates exist) — ``--format json`` for CI. ``--offline``
 renders from journals + the perf ledger alone: the post-mortem view of
 the same screen, no live fleet needed.
 
+``--history-dir`` attaches the durable time-series store
+(obs/tsdb.py): every scrape writes through to disk, the per-target
+rows grow SPARKLINES over the recent trajectory, and an SLO-budget
+panel (obs/slo_budget.py) shows each objective's remaining error
+budget and fast/slow burn rates — the burn-rate alert rules
+(kind ``burn_rate``) evaluate alongside the instant rules. With
+``--since`` (+ optional ``--range``) the console instead renders a
+RETROSPECTIVE of that window from the store alone — no live fleet,
+no journal needed:
+
+    python tools/fleet_console.py --history-dir run/tsdb \
+        --since -30m --range 30m
+
+
 Alert transitions journal under the ``alert`` event category (a
 timeline_report landmark), and can additionally go to ``--alert-file``
 (JSONL) / ``--alert-webhook`` (POST). ``--profile-on-alert`` lets a
@@ -54,6 +68,66 @@ from pytorch_distributed_train_tpu.obs.alerts import (  # noqa: E402
 from pytorch_distributed_train_tpu.obs.collector import FleetCollector  # noqa: E402
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 24) -> str:
+    """Unicode block sparkline of a value sequence (newest right).
+    Empty input renders empty; a flat series renders flat-low."""
+    vals = [float(v) for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return ""
+    vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / span * len(_SPARK)))] for v in vals)
+
+
+# which stored series a row's sparkline follows, per role
+_SPARK_SERIES = {"trainer": "steps_per_s", "serving": "ttft_p95_s"}
+
+
+def _history_spark(history, row: dict, window_s: float = 300.0) -> str:
+    series = _SPARK_SERIES.get(row["role"])
+    if history is None or series is None:
+        return ""
+    now = time.time()
+    try:
+        pts = history.query(f"{row['role']}@{row['host']}", series,
+                            now - window_s, now)
+    except Exception:
+        return ""
+    if not pts:
+        return ""
+    return (f"{series} {sparkline([v for _ts, v in pts])} "
+            f"[{min(v for _, v in pts):.3g}..{max(v for _, v in pts):.3g}]")
+
+
+def slo_panel(slo_status: dict) -> list[str]:
+    """The SLO-budget panel: per objective, worst-target remaining
+    budget + the fast/slow actionable burn rates."""
+    if not slo_status:
+        return []
+    out = ["  SLO budgets (worst target per objective):"]
+    for name, st in sorted(slo_status.items()):
+        rem = st.get("budget_remaining")
+        burns = st.get("burn") or {}
+        btxt = " ".join(
+            f"{w}={burns[w]:.2f}x" for w in ("fast", "slow")
+            if isinstance(burns.get(w), (int, float)))
+        flag = ("OVERSPENT" if isinstance(rem, (int, float)) and rem < 0
+                else "")
+        out.append(
+            f"    {name:<22} budget {_num(rem, '{:+.2f}'):>7} "
+            f"burn {btxt or '-':<22} {st.get('worst_target') or ''} "
+            f"{flag}".rstrip())
+    return out
+
+
 def _gb(n) -> str:
     return f"{n / 2**30:.1f}G" if isinstance(n, (int, float)) else "-"
 
@@ -79,7 +153,9 @@ def _serving_cell(row: dict) -> str:
 
 
 def render_snapshot(snap: dict, alerts: list[dict],
-                    last_events: dict | None = None) -> str:
+                    last_events: dict | None = None,
+                    history=None,
+                    slo_status: dict | None = None) -> str:
     rows = snap["targets"]
     states = [r["state"] for r in rows]
     head = (f"== fleet console: {len(rows)} target(s) "
@@ -124,6 +200,9 @@ def render_snapshot(snap: dict, alerts: list[dict],
             extras.append(f"err {r['error']}")
         if extras:
             lines.append(" " * 13 + "· " + "  ".join(extras))
+        spark = _history_spark(history, r)
+        if spark:
+            lines.append(" " * 13 + "~ " + spark)
     if snap.get("slowest_serving"):
         lines.append(f"  slowest serving replica: "
                      f"{snap['slowest_serving']}")
@@ -140,6 +219,7 @@ def render_snapshot(snap: dict, alerts: list[dict],
                          f"for {a['for_s']:.1f}s{val}{base}")
     else:
         lines.append("  alerts: none firing")
+    lines.extend(slo_panel(slo_status or {}))
     if last_events:
         lines.append("  last: " + "  ".join(
             f"{k}={v}" for k, v in last_events.items()))
@@ -225,6 +305,81 @@ def offline_report(run_dir: str, events_dir: str = "",
     return "\n".join(lines)
 
 
+# ------------------------------------------------------- retrospective
+def parse_since(spec: str, now: float | None = None) -> float:
+    """``--since``: epoch seconds, ISO ``YYYY-mm-ddTHH:MM[:SS]``, or
+    relative ``-30m`` / ``-2h`` / ``-90s`` (ago)."""
+    now = time.time() if now is None else now
+    spec = spec.strip()
+    if spec.startswith("-"):
+        return now - parse_duration(spec[1:])
+    try:
+        return float(spec)
+    except ValueError:
+        pass
+    import datetime
+
+    for fmt in ("%Y-%m-%dT%H:%M:%S", "%Y-%m-%dT%H:%M", "%Y-%m-%d"):
+        try:
+            return datetime.datetime.strptime(spec, fmt).timestamp()
+        except ValueError:
+            continue
+    raise SystemExit(f"--since: cannot parse {spec!r}")
+
+
+def parse_duration(spec: str) -> float:
+    spec = spec.strip().lower()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(
+        spec[-1:], None)
+    if mult is not None:
+        return float(spec[:-1]) * mult
+    return float(spec)
+
+
+def history_report(history_dir: str, since: float,
+                   range_s: float) -> str:
+    """The retrospective console: the window [since, since+range]
+    rendered from the on-disk store ALONE — every target and series
+    with data gets its stats + sparkline, then the SLO-budget panel as
+    of the window's end. A dead fleet's last hour, readable after the
+    fact."""
+    from pytorch_distributed_train_tpu.obs.slo_budget import (
+        SLOBudgetTracker,
+    )
+    from pytorch_distributed_train_tpu.obs.tsdb import TimeSeriesStore
+
+    store = TimeSeriesStore(history_dir)
+    end = since + range_s
+    lines = [f"== fleet console (retrospective): {history_dir} "
+             f"[{time.strftime('%Y-%m-%dT%H:%M:%S', time.localtime(since))}"
+             f" +{range_s:.0f}s] =="]
+    targets = store.targets()
+    if not targets:
+        lines.append("  (store is empty — no targets ever wrote "
+                     "history here)")
+        return "\n".join(lines)
+    for target in targets:
+        shown = []
+        for series in store.series(target):
+            try:
+                pts = store.query(target, series, since, end)
+            except Exception:
+                continue
+            if not pts:
+                continue
+            vals = [v for _ts, v in pts]
+            shown.append(
+                f"    {series:<24} n={len(vals):<5} "
+                f"min={min(vals):.4g} mean={sum(vals) / len(vals):.4g} "
+                f"max={max(vals):.4g}  {sparkline(vals)}")
+        if shown:
+            lines.append(f"  {target}:")
+            lines.extend(shown)
+    tracker = SLOBudgetTracker(store, clock=lambda: end)
+    lines.extend(slo_panel(tracker.status()))
+    return "\n".join(lines)
+
+
 # ----------------------------------------------------------------- wiring
 def _store_factory(addr: str):
     host, _, port = addr.rpartition(":")
@@ -248,10 +403,26 @@ def build(args) -> tuple[FleetCollector, AlertEngine]:
     store_addr = args.store or os.environ.get("TPUSTORE_ADDR", "")
     factory = (_store_factory(store_addr) if store_addr
                else (lambda: None))
+    history = None
+    tracker = None
+    history_dir = getattr(args, "history_dir", "")
+    if history_dir:
+        from pytorch_distributed_train_tpu.obs.slo_budget import (
+            SLOBudgetTracker,
+        )
+        from pytorch_distributed_train_tpu.obs.tsdb import (
+            TimeSeriesStore,
+        )
+
+        history = TimeSeriesStore(
+            history_dir,
+            disk_budget_bytes=int(
+                getattr(args, "history_budget_mb", 64.0) * 2**20))
+        tracker = SLOBudgetTracker(history)
     collector = FleetCollector(
         store_factory=factory, endpoints=endpoints,
         poll_s=args.interval, stale_after_s=args.stale_after,
-        timeout_s=args.timeout)
+        timeout_s=args.timeout, history=history)
     overrides = {}
     for spec in args.rule or ():
         key, _, value = spec.partition("=")
@@ -262,7 +433,7 @@ def build(args) -> tuple[FleetCollector, AlertEngine]:
         sink_path=args.alert_file, webhook_url=args.alert_webhook,
         profile_on_alert=args.profile_on_alert,
         profile_cooldown_s=args.profile_cooldown,
-        overrides=overrides)
+        overrides=overrides, slo_tracker=tracker)
     return collector, engine
 
 
@@ -344,12 +515,37 @@ def main(argv=None) -> int:
                         "ttft_regression.min_samples=4 (repeatable)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the closed alert-rule catalog and exit")
+    p.add_argument("--history-dir", default="",
+                   help="attach the durable time-series store "
+                        "(obs/tsdb.py) at this directory: scrapes "
+                        "write through, sparklines + SLO budgets "
+                        "render, burn-rate rules evaluate")
+    p.add_argument("--history-budget-mb", type=float, default=64.0,
+                   help="retention disk budget for --history-dir")
+    p.add_argument("--since", default="",
+                   help="retrospective mode: render [SINCE, "
+                        "SINCE+RANGE] from the store alone (epoch, "
+                        "ISO, or -30m style; needs --history-dir or "
+                        "--run-dir with a tsdb/)")
+    p.add_argument("--range", default="15m", dest="range_",
+                   metavar="RANGE", help="retrospective window length")
     args = p.parse_args(argv)
 
     if args.list_rules:
         for name, r in sorted(RULES.items()):
             print(f"{name:<22} {r.kind:<10} roles={','.join(r.roles)}  "
                   f"{r.description}")
+        return 0
+    if args.since:
+        history_dir = args.history_dir or (
+            os.path.join(args.run_dir, "tsdb") if args.run_dir else "")
+        if not history_dir or not os.path.isdir(history_dir):
+            print("fleet_console: --since needs an existing store "
+                  "(--history-dir, or --run-dir with tsdb/)",
+                  file=sys.stderr)
+            return 2
+        print(history_report(history_dir, parse_since(args.since),
+                             parse_duration(args.range_)))
         return 0
     if args.offline:
         if not args.run_dir and not args.events:
@@ -371,6 +567,14 @@ def main(argv=None) -> int:
     if events_dir:
         events_lib.configure(events_dir, who="fleet")
     try:
+        def _slo_status():
+            if engine.slo_tracker is None:
+                return None
+            try:
+                return engine.slo_tracker.status()
+            except Exception:
+                return None
+
         if args.watch:
             while True:
                 snap = tick(collector, engine)
@@ -379,7 +583,9 @@ def main(argv=None) -> int:
                                       _last_events(
                                           _events_for_console(args))
                                       if (args.run_dir or args.events)
-                                      else None))
+                                      else None,
+                                      history=collector.history,
+                                      slo_status=_slo_status()))
                 sys.stdout.flush()
                 time.sleep(collector.poll_s)
         else:
@@ -389,13 +595,16 @@ def main(argv=None) -> int:
                     time.sleep(min(collector.poll_s, 0.5))
                 snap = tick(collector, engine)
             if args.format == "json":
-                out = json.dumps(dict(snap, alerts=engine.firing()),
+                out = json.dumps(dict(snap, alerts=engine.firing(),
+                                      slo=_slo_status()),
                                  indent=2, sort_keys=True)
             else:
                 out = render_snapshot(
                     snap, engine.firing(),
                     _last_events(_events_for_console(args))
-                    if (args.run_dir or args.events) else None)
+                    if (args.run_dir or args.events) else None,
+                    history=collector.history,
+                    slo_status=_slo_status())
             print(out)
     except KeyboardInterrupt:
         pass

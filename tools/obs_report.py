@@ -408,8 +408,53 @@ def traces_section(traces_dir: str, top: int = 5) -> list[str]:
     return out
 
 
+def slo_section(history_dir: str) -> list[str]:
+    """SLO error budgets from the run-local history store
+    (<run-dir>/tsdb, written by a collector with --history-dir):
+    remaining budget + the worst burn window per objective. Absent
+    (empty) when the run kept no store — pre-history runs stay
+    quiet, the input/serving-section convention."""
+    if not history_dir or not os.path.isdir(history_dir):
+        return []
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from pytorch_distributed_train_tpu.obs.slo_budget import (
+        SLOBudgetTracker,
+    )
+    from pytorch_distributed_train_tpu.obs.tsdb import TimeSeriesStore
+
+    store = TimeSeriesStore(history_dir)
+    # report as of the newest sample, not the wall clock: the run may
+    # have ended hours ago and "the last hour" of a dead store is empty
+    newest = 0.0
+    for target in store.targets():
+        for series in store.series(target):
+            last = store.latest(target, series)
+            if last is not None:
+                newest = max(newest, last[0])
+    if not newest:
+        return ["SLO budgets: store present but empty"]
+    status = SLOBudgetTracker(store, clock=lambda: newest).status()
+    if not status:
+        return ["SLO budgets: store holds no SLI series"]
+    out = ["SLO budgets (as of the store's newest sample):"]
+    for name, st in sorted(status.items()):
+        rem = st.get("budget_remaining")
+        burns = {w: b for w, b in (st.get("burn") or {}).items()
+                 if isinstance(b, (int, float))}
+        worst = st.get("worst_window")
+        wtxt = (f"worst burn {worst} {burns[worst]:.2f}x"
+                if worst in burns else "burn unknown")
+        out.append(
+            f"  {name:<22} budget {rem:+.2f} "
+            f"({'OVERSPENT' if rem < 0 else 'ok'}), {wtxt} "
+            f"[{st.get('worst_target')}]")
+    return out
+
+
 def report(jsonl_path: str, trace_path: str = "",
-           events_dir: str = "", traces_dir: str = "") -> str:
+           events_dir: str = "", traces_dir: str = "",
+           history_dir: str = "") -> str:
     recs = load_jsonl(jsonl_path)
     lines = [f"== run report: {jsonl_path} ({len(recs)} records) =="]
     try:
@@ -443,6 +488,9 @@ def report(jsonl_path: str, trace_path: str = "",
             ("spans", lambda: spans_section(trace_path)),
             ("events", lambda: events_section(events_dir, events)),
             ("serving", lambda: serving_section(events_dir, events)),
+            ("SLO budgets", lambda: slo_section(
+                history_dir or os.path.join(
+                    os.path.dirname(jsonl_path), "tsdb"))),
             ("traces", lambda: traces_section(traces_dir))):
         try:
             section = build()

@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Incident postmortem: one alert's whole story as one report.
+
+    python tools/postmortem.py --run-dir RUN --alert <id or prefix>
+    python tools/postmortem.py --run-dir RUN --from -30m --to -10m
+    python tools/postmortem.py --run-dir RUN --alert <id> --out pm.txt
+
+PR 13 built the alert→capture→resolve chain; this tool reconstructs
+it AFTER the fact into a single artifact, joining every plane the
+incident touched:
+
+- the **alert lifecycle** — the journal's fired / profile_requested /
+  resolved records threaded by the alert id the engine mints at FIRE
+  (``rule@host@epoch_ms``; any unique prefix selects it);
+- **before / during / after series** from the durable history store
+  (obs/tsdb.py): the rule's own series plus the core trajectories of
+  the offending target, each phase with stats and the whole padded
+  window as a sparkline — the shape of the incident, not just its
+  peak;
+- the **event journal slice** for the window (per-category counts +
+  the notable landmarks);
+- **retained traces** finished inside the window (obs/tracing.py) and
+  **profiler captures** it requested (capture dirs touched in the
+  window);
+- the **SLO budget impact**: remaining error budget at window start
+  vs end per applicable objective (obs/slo_budget.py).
+
+Sections are independent — a run missing a plane (no traces, no
+store) degrades that section to one line, never the report. Pure
+stdlib + the repo's obs package; no jax (login-host safe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import fleet_console  # noqa: E402  (parse_since/parse_duration/sparkline)
+
+from pytorch_distributed_train_tpu.obs.events import load_events  # noqa: E402
+
+# series rendered for the offending target beyond the rule's own
+CORE_SERIES = ("ttft_p95_s", "shed_per_s", "steps_per_s",
+               "goodput_pct")
+
+
+def find_alert(events: list[dict], alert_id: str) -> dict | None:
+    """The incident's fired/resolved/profile records by id (exact, or
+    a prefix/substring that selects exactly one fired record)."""
+    recs = [e for e in events if e.get("category") == "alert"
+            and (e.get("detail") or {}).get("id")]
+    fired = [e for e in recs if e.get("name") == "fired"]
+    exact = [e for e in fired
+             if (e.get("detail") or {}).get("id") == alert_id]
+    hits = exact or [e for e in fired
+                     if alert_id in (e.get("detail") or {}).get("id", "")]
+    if len(hits) != 1:
+        return None if not hits else {"ambiguous": [
+            (e.get("detail") or {}).get("id") for e in hits]}
+    aid = (hits[0].get("detail") or {}).get("id")
+    chain = [e for e in recs if (e.get("detail") or {}).get("id") == aid]
+    return {"id": aid, "fired": hits[0],
+            "resolved": next((e for e in chain
+                              if e.get("name") == "resolved"), None),
+            "chain": sorted(chain, key=lambda e: e.get("ts", 0.0))}
+
+
+def _phase_stats(pts: list[tuple]) -> str:
+    if not pts:
+        return "n=0"
+    vals = [v for _ts, v in pts]
+    return (f"n={len(vals)} mean={sum(vals) / len(vals):.4g} "
+            f"max={max(vals):.4g}")
+
+
+def series_section(store, target_key: str, series_names,
+                   start: float, end: float, pad: float) -> list[str]:
+    if store is None:
+        return ["series: no history store (run without --history-dir "
+                "collector?)"]
+    out = [f"series for {target_key} "
+           f"(before {pad:.0f}s | during {end - start:.0f}s | "
+           f"after {pad:.0f}s):"]
+    shown = 0
+    for name in series_names:
+        try:
+            before = store.query(target_key, name, start - pad, start)
+            during = store.query(target_key, name, start, end)
+            after = store.query(target_key, name, end, end + pad)
+        except Exception:
+            continue
+        if not (before or during or after):
+            continue
+        shown += 1
+        allpts = before + during + after
+        out.append(f"  {name}:")
+        out.append(f"    before  {_phase_stats(before)}")
+        out.append(f"    during  {_phase_stats(during)}")
+        out.append(f"    after   {_phase_stats(after)}")
+        out.append("    shape   "
+                   + fleet_console.sparkline(
+                       [v for _ts, v in allpts], width=48))
+    if not shown:
+        out.append("  (store holds no samples for this target/window)")
+    return out
+
+
+def lifecycle_section(incident: dict) -> list[str]:
+    out = ["alert lifecycle:"]
+    for e in incident["chain"]:
+        d = e.get("detail") or {}
+        ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0.0)))
+        extra = " ".join(f"{k}={d[k]}" for k in
+                         ("value", "baseline", "after_s", "status")
+                         if k in d)
+        out.append(f"  {ts} {e.get('name'):<18} rule={d.get('rule')} "
+                   f"host={d.get('host')} {extra}".rstrip())
+    if incident["resolved"] is None:
+        out.append("  (never resolved inside the journal)")
+    return out
+
+
+def journal_section(events: list[dict], start: float, end: float,
+                    pad: float, limit: int = 20) -> list[str]:
+    window = [e for e in events
+              if start - pad <= e.get("ts", 0.0) <= end + pad]
+    if not window:
+        return ["journal: no events inside the window"]
+    by_key: dict[str, int] = {}
+    for e in window:
+        k = f"{e.get('category')}.{e.get('name')}"
+        by_key[k] = by_key.get(k, 0) + 1
+    out = [f"journal slice ({len(window)} events): "
+           + "  ".join(f"{k}={n}" for k, n in sorted(
+               by_key.items(), key=lambda kv: -kv[1])[:8])]
+    notable = [e for e in window if e.get("category") in
+               ("alert", "elastic", "sentinel", "profile", "serve")]
+    for e in notable[:limit]:
+        ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0.0)))
+        out.append(f"  {ts} [{e.get('host')}] {e.get('category')}."
+                   f"{e.get('name')}")
+    if len(notable) > limit:
+        out.append(f"  ... {len(notable) - limit} more")
+    return out
+
+
+def traces_section(traces_dir: str, start: float, end: float,
+                   pad: float, top: int = 5) -> list[str]:
+    if not traces_dir or not os.path.isdir(traces_dir):
+        return ["traces: no retained-traces directory"]
+    from pytorch_distributed_train_tpu.obs.tracing import load_traces
+
+    trees = [t for t in load_traces(traces_dir)
+             if start - pad <= t.get("ts", 0.0) <= end + pad]
+    if not trees:
+        return ["traces: none retained inside the window"]
+    out = [f"retained traces in window ({len(trees)}):"]
+    for t in sorted(trees, key=lambda t: -(t.get("dur_ms") or 0.0))[:top]:
+        out.append(f"  {str(t.get('trace_id'))[:16]}.. "
+                   f"{t.get('dur_ms', 0.0):>9.1f}ms "
+                   f"[{t.get('reason')}; {t.get('host')}]")
+    return out
+
+
+def captures_section(profiles_dir: str, start: float, end: float,
+                     pad: float) -> list[str]:
+    if not profiles_dir or not os.path.isdir(profiles_dir):
+        return ["captures: no profiler directory"]
+    hits = []
+    for name in sorted(os.listdir(profiles_dir)):
+        path = os.path.join(profiles_dir, name)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        if start - pad <= mtime <= end + pad:
+            hits.append((mtime, name))
+    if not hits:
+        return ["captures: none taken inside the window"]
+    out = [f"profiler captures in window ({len(hits)}):"]
+    for mtime, name in hits:
+        ts = time.strftime("%H:%M:%S", time.localtime(mtime))
+        out.append(f"  {ts} {name}")
+    return out
+
+
+def budget_section(store, target_key: str, role: str, start: float,
+                   end: float) -> list[str]:
+    if store is None:
+        return []
+    from pytorch_distributed_train_tpu.obs.slo_budget import (
+        SLO_CATALOG,
+        SLOBudgetTracker,
+    )
+
+    tracker = SLOBudgetTracker(store)
+    out = ["SLO budget impact (remaining, window start -> end):"]
+    shown = 0
+    for name, slo in sorted(SLO_CATALOG.items()):
+        if role not in slo.roles:
+            continue
+        b0 = tracker.budget_remaining(name, target_key, now=start)
+        b1 = tracker.budget_remaining(name, target_key, now=end)
+        if b0 is None and b1 is None:
+            continue
+        shown += 1
+        fmt = lambda b: "-" if b is None else f"{b:+.2f}"  # noqa: E731
+        out.append(f"  {name:<22} {fmt(b0)} -> {fmt(b1)}"
+                   + ("  OVERSPENT" if (b1 or 0) < 0 else ""))
+    return out if shown else []
+
+
+def report(run_dir: str, *, alert_id: str = "", t_from: str = "",
+           t_to: str = "", events_dir: str = "", history_dir: str = "",
+           traces_dir: str = "", profiles_dir: str = "",
+           pad_s: float = 60.0) -> tuple[str, int]:
+    """(report text, exit code). Sections degrade independently."""
+    events_dir = events_dir or os.path.join(run_dir, "events")
+    history_dir = history_dir or os.path.join(run_dir, "tsdb")
+    traces_dir = traces_dir or os.path.join(run_dir, "traces")
+    profiles_dir = profiles_dir or os.path.join(run_dir, "profiles")
+    events = load_events(events_dir) if os.path.isdir(events_dir) else []
+
+    incident = None
+    if alert_id:
+        incident = find_alert(events, alert_id)
+        if incident is None:
+            return (f"postmortem: no alert matching {alert_id!r} in "
+                    f"{events_dir}", 2)
+        if "ambiguous" in incident:
+            return ("postmortem: ambiguous alert id, candidates:\n  "
+                    + "\n  ".join(incident["ambiguous"]), 2)
+        start = incident["fired"].get("ts", 0.0)
+        end = (incident["resolved"].get("ts", start)
+               if incident["resolved"] else
+               max((e.get("ts", start) for e in events), default=start))
+        d = incident["fired"].get("detail") or {}
+        rule, host = d.get("rule", "?"), d.get("host", "?")
+        role = d.get("role", "?")
+        target_key = f"{role}@{host}"
+        title = (f"incident {incident['id']} — {rule} on {host} "
+                 f"({end - start:.1f}s)")
+    else:
+        if not t_from:
+            return ("postmortem: need --alert or --from", 2)
+        start = fleet_console.parse_since(t_from)
+        end = (fleet_console.parse_since(t_to) if t_to
+               else start + 900.0)
+        rule, host, role, target_key = "?", "?", "?", ""
+        title = (f"window {time.strftime('%H:%M:%S', time.localtime(start))}"
+                 f" -> {time.strftime('%H:%M:%S', time.localtime(end))}")
+
+    store = None
+    if os.path.isdir(history_dir):
+        try:
+            from pytorch_distributed_train_tpu.obs.tsdb import (
+                TimeSeriesStore,
+            )
+
+            store = TimeSeriesStore(history_dir)
+        except Exception:
+            store = None
+
+    pad = max(pad_s, end - start)
+    lines = [f"== postmortem: {title} =="]
+    rule_series = ()
+    if incident is not None:
+        try:
+            from pytorch_distributed_train_tpu.obs.alerts import RULES
+
+            if rule in RULES:
+                rule_series = (RULES[rule].series,)
+        except Exception:
+            rule_series = ()
+    series_names = list(dict.fromkeys(
+        (*rule_series, *CORE_SERIES)))
+
+    def targets_to_show():
+        if target_key:
+            return [target_key]
+        return store.targets() if store is not None else []
+
+    sections = []
+    if incident is not None:
+        sections.append(lambda: lifecycle_section(incident))
+    if store is None:
+        sections.append(lambda: ["series: no history store at "
+                                 f"{history_dir}"])
+    else:
+        for tk in targets_to_show():
+            sections.append(
+                lambda tk=tk: series_section(
+                    store, tk, series_names, start, end, pad))
+    sections.append(lambda: journal_section(events, start, end, pad))
+    sections.append(lambda: traces_section(traces_dir, start, end, pad))
+    sections.append(lambda: captures_section(
+        profiles_dir, start, end, pad))
+    if target_key and role != "?":
+        sections.append(lambda: budget_section(
+            store, target_key, role, start, end))
+    for build in sections:
+        try:
+            section = build()
+        except Exception as e:
+            section = [f"(section unrenderable: "
+                       f"{type(e).__name__}: {e})"]
+        if not section:
+            continue
+        lines.append("")
+        lines.extend(section)
+    return "\n".join(lines), 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--run-dir", default="",
+                   help="run directory (events/, tsdb/, traces/, "
+                        "profiles/)")
+    p.add_argument("--alert", default="",
+                   help="alert id (or unique prefix) from the journal "
+                        "/ console firing list")
+    p.add_argument("--from", dest="t_from", default="",
+                   help="window start (epoch, ISO, or -30m style) "
+                        "when no --alert")
+    p.add_argument("--to", dest="t_to", default="",
+                   help="window end (default start+15m)")
+    p.add_argument("--events", default="", help="explicit events dir")
+    p.add_argument("--history-dir", default="",
+                   help="explicit tsdb store dir")
+    p.add_argument("--traces", default="", help="explicit traces dir")
+    p.add_argument("--profiles", default="",
+                   help="explicit profiler captures dir")
+    p.add_argument("--pad", type=float, default=60.0,
+                   help="seconds of before/after context")
+    p.add_argument("--out", default="",
+                   help="also write the report to this file")
+    args = p.parse_args(argv)
+    if not (args.run_dir or args.events):
+        print("postmortem: need --run-dir (or explicit --events/"
+              "--history-dir)", file=sys.stderr)
+        return 2
+    text, rc = report(
+        args.run_dir, alert_id=args.alert, t_from=args.t_from,
+        t_to=args.t_to, events_dir=args.events,
+        history_dir=args.history_dir, traces_dir=args.traces,
+        profiles_dir=args.profiles, pad_s=args.pad)
+    print(text)
+    if args.out and rc == 0:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

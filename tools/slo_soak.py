@@ -278,6 +278,170 @@ def run_hedge_phase(args) -> dict:
             "hedged_traces_retained": len(hedged_ids)}
 
 
+def run_budget_phase(args) -> dict:
+    """SLO error-budget phase (obs/tsdb.py + obs/slo_budget.py): a
+    seeded TTFT storm followed by calm traffic, with every request's
+    measured TTFT written through a history store as the SLI. Asserted
+    downstream in main():
+
+    - the error budget BURNS during the storm (the fast burn-rate pair
+      crosses its factor and the rule fires),
+    - the burn rate returns under threshold once the storm ends (the
+      rule resolves, final actionable burn < factor),
+    - the engine's fired/resolved totals match the journal's alert
+      lifecycle records exactly (nothing fired unjournaled, nothing
+      journaled that didn't fire).
+    """
+    import dataclasses
+    import tempfile as _tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_http
+
+    from pytorch_distributed_train_tpu.obs import events as events_lib
+    from pytorch_distributed_train_tpu.obs.alerts import (
+        RULES,
+        AlertEngine,
+    )
+    from pytorch_distributed_train_tpu.obs.slo_budget import (
+        SLO_CATALOG,
+        SLOBudgetTracker,
+    )
+    from pytorch_distributed_train_tpu.obs.tsdb import TimeSeriesStore
+
+    store_dir = args.budget_store_dir or _tempfile.mkdtemp(
+        prefix="slo_soak_tsdb_")
+    events_dir = _tempfile.mkdtemp(prefix="slo_soak_ev_")
+    store = TimeSeriesStore(store_dir)
+    # phase-scaled objective: 50% of requests may be slow; storm makes
+    # ~100% slow (burn ≈ 2×), calm ~0% (burn → 0) — factor 1.5 splits
+    slo = dataclasses.replace(
+        SLO_CATALOG["serve_ttft_p95"], threshold=args.budget_ttft,
+        objective=0.5, window_s=8.0)
+    tracker = SLOBudgetTracker(store, catalog={slo.name: slo})
+    rules = {}
+    for name in ("slo_serve_ttft_p95_burn_fast",
+                 "slo_serve_ttft_p95_burn_slow"):
+        short_s, long_s = ((0.8, 2.5) if name.endswith("fast")
+                          else (1.6, 5.0))
+        rules[name] = dataclasses.replace(
+            RULES[name], short_s=short_s, long_s=long_s, factor=1.5,
+            cooldown_s=0.05, profile=False)
+    # journal swap: the phase's lifecycle records go to a fresh dir so
+    # the totals comparison is exact, then the previous journal (a
+    # surrounding pytest process may own one) is restored untouched
+    j = events_lib.EventJournal(events_dir, who="soak")
+    with events_lib._LOCK:
+        prev_journal = events_lib._GLOBAL
+        events_lib._GLOBAL = j
+
+    class _Tgt:
+        role, host, addr, gen = "serving", "soak", "", "0"
+        gens = {"0"}
+        series: dict = {}
+        last_ok_mono = 0.0
+
+        def state(self, now, stale):
+            return "ok"
+
+    class _Coll:
+        targets = [_Tgt()]
+        stale_after_s = 10.0
+
+    engine = AlertEngine(rules=rules, slo_tracker=tracker)
+    batcher = FakeTokenBatcher(slots=4, step_delay_s=0.002)
+    service = serve_http.BatcherService(batcher, FakeByteTok(),
+                                        orphan_grace_s=0.5)
+    fired = {n: 0 for n in rules}
+    resolved = {n: 0 for n in rules}
+    burn_peak = 0.0
+
+    def measure_one(i: int) -> None:
+        # one streamed request; TTFT = start -> first decoded chunk
+        t0 = time.monotonic()
+        uid, _, chunks = service.stream(f"budget probe {i}", 6, 0.0,
+                                        timeout_s=30.0)
+        ttft = None
+        for _toks, c in chunks:
+            if c is not None:
+                ttft = time.monotonic() - t0
+                break
+        service.abandon_stream(uid)
+        store.append("serving@soak", "ttft_p95_s", time.time(),
+                     ttft if ttft is not None else 10.0 * slo.threshold)
+
+    def evaluate() -> None:
+        nonlocal burn_peak
+        for rec in engine.evaluate(_Coll()):
+            if rec["event"] == "fired":
+                fired[rec["rule"]] += 1
+            else:
+                resolved[rec["rule"]] += 1
+        fast = rules["slo_serve_ttft_p95_burn_fast"]
+        s = tracker.burn_rate(slo.name, "serving@soak", fast.short_s)
+        lo = tracker.burn_rate(slo.name, "serving@soak", fast.long_s)
+        if s is not None and lo is not None:
+            burn_peak = max(burn_peak, min(s, lo))
+
+    try:
+        # ---- storm: every decode step stutters past the TTFT bound
+        fregistry.configure(
+            specs=(f"serve.slow_decode@p=1:count=1000000:delay="
+                   f"{3.0 * args.budget_ttft}",), seed=args.seed)
+        i = 0
+        deadline = time.monotonic() + args.budget_storm_s
+        while time.monotonic() < deadline:
+            measure_one(i)
+            i += 1
+            evaluate()
+        budget_after_storm = tracker.budget_remaining(
+            slo.name, "serving@soak")
+        # ---- calm: faults off, the short window must drain
+        fregistry.configure(seed=args.seed)
+        deadline = time.monotonic() + args.budget_calm_s
+        while time.monotonic() < deadline:
+            measure_one(i)
+            i += 1
+            evaluate()
+        # final evaluations so resolves land even if the last loop
+        # iteration fired
+        for _ in range(3):
+            time.sleep(0.05)
+            evaluate()
+        fast = rules["slo_serve_ttft_p95_burn_fast"]
+        s = tracker.burn_rate(slo.name, "serving@soak", fast.short_s)
+        lo = tracker.burn_rate(slo.name, "serving@soak", fast.long_s)
+        burn_final = (min(s, lo) if s is not None and lo is not None
+                      else None)
+        budget_end = tracker.budget_remaining(slo.name, "serving@soak")
+    finally:
+        service.shutdown()
+        fregistry.configure(seed=args.seed)
+        with events_lib._LOCK:
+            events_lib._GLOBAL = prev_journal
+        j.close()
+    journal = events_lib.load_events(events_dir)
+    j_fired = sum(1 for e in journal if e.get("category") == "alert"
+                  and e.get("name") == "fired")
+    j_resolved = sum(1 for e in journal if e.get("category") == "alert"
+                     and e.get("name") == "resolved")
+    store.flush()
+    return {"requests": i, "store_dir": store_dir,
+            "burn_factor": 1.5,
+            "burn_peak": round(burn_peak, 3),
+            "burn_final": (None if burn_final is None
+                           else round(burn_final, 3)),
+            "budget_after_storm": (
+                None if budget_after_storm is None
+                else round(budget_after_storm, 3)),
+            "budget_end": (None if budget_end is None
+                           else round(budget_end, 3)),
+            "alerts_fired": sum(fired.values()),
+            "alerts_resolved": sum(resolved.values()),
+            "journal_fired": j_fired,
+            "journal_resolved": j_resolved}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--requests", type=int, default=120)
@@ -311,11 +475,24 @@ def main(argv=None) -> int:
     p.add_argument("--hedge-slow-delay", type=float, default=0.1,
                    help="slow replica's per-step decode delay in the "
                         "hedge phase")
+    p.add_argument("--budget-storm-s", type=float, default=1.2,
+                   help="SLO budget phase: seeded-storm duration "
+                        "(0 = skip the phase)")
+    p.add_argument("--budget-calm-s", type=float, default=3.0,
+                   help="SLO budget phase: calm recovery duration")
+    p.add_argument("--budget-ttft", type=float, default=0.05,
+                   help="SLO budget phase: per-request TTFT threshold "
+                        "a sample must beat to count good")
+    p.add_argument("--budget-store-dir", default="",
+                   help="SLO budget phase: tsdb root (default: fresh "
+                        "temp dir)")
     args = p.parse_args(argv)
 
     report = run_soak(args)
     if args.hedge_requests > 0:
         report["hedge_phase"] = run_hedge_phase(args)
+    if args.budget_storm_s > 0:
+        report["budget_phase"] = run_budget_phase(args)
     print("== slo_soak report ==")
     for k, v in report.items():
         print(f"  {k}: {v}")
@@ -367,6 +544,45 @@ def main(argv=None) -> int:
             print(f"FAIL: {hp['hedges_fired']} hedges but "
                   f"{hp['hedged_traces_retained']} retained hedged "
                   "trace(s)", file=sys.stderr)
+            ok = False
+    bp = report.get("budget_phase")
+    if bp is not None:
+        # the budget must BURN during the seeded storm...
+        if bp["burn_peak"] < bp["burn_factor"]:
+            print(f"FAIL: budget phase peak burn {bp['burn_peak']}x "
+                  f"never crossed the {bp['burn_factor']}x factor",
+                  file=sys.stderr)
+            ok = False
+        if bp["alerts_fired"] == 0:
+            print("FAIL: budget phase fired no burn-rate alerts",
+                  file=sys.stderr)
+            ok = False
+        if (bp["budget_after_storm"] is None
+                or bp["budget_after_storm"] >= 1.0):
+            print(f"FAIL: error budget did not burn during the storm "
+                  f"(remaining {bp['budget_after_storm']})",
+                  file=sys.stderr)
+            ok = False
+        # ...the burn rate must return under threshold after it...
+        if bp["burn_final"] is None \
+                or bp["burn_final"] >= bp["burn_factor"]:
+            print(f"FAIL: burn rate still {bp['burn_final']}x >= "
+                  f"{bp['burn_factor']}x after the calm phase",
+                  file=sys.stderr)
+            ok = False
+        if bp["alerts_resolved"] != bp["alerts_fired"]:
+            print(f"FAIL: {bp['alerts_fired']} burn alert(s) fired but "
+                  f"{bp['alerts_resolved']} resolved", file=sys.stderr)
+            ok = False
+        # ...and the engine's totals must match the journal's alert
+        # lifecycle exactly
+        if (bp["journal_fired"] != bp["alerts_fired"]
+                or bp["journal_resolved"] != bp["alerts_resolved"]):
+            print(f"FAIL: journal lifecycle "
+                  f"({bp['journal_fired']} fired/"
+                  f"{bp['journal_resolved']} resolved) != engine "
+                  f"({bp['alerts_fired']}/{bp['alerts_resolved']})",
+                  file=sys.stderr)
             ok = False
     if syncdbg.active():
         syncdbg.check_teardown()
