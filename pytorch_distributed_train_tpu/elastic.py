@@ -195,6 +195,13 @@ def store_world(store, gen: int) -> dict | None:
 # the truth.
 SERVE_REPLICA_COUNT_KEY = "serve/replicas_n"
 SERVE_REPLICA_KEY_PREFIX = "serve/replica/"
+# a cleanly-exited replica overwrites its record with this sentinel:
+# crashed replicas still leave their address behind (the prober handles
+# those), but a deliberate drain/exit must not leave a forever-probed
+# ghost — a controller counting fleet size from the registry would
+# over-count dead replicas and its scale-in math would drift after
+# every recycle
+SERVE_REPLICA_TOMBSTONE = b"__tombstone__"
 
 
 def publish_replica(store, addr: str) -> int:
@@ -205,10 +212,25 @@ def publish_replica(store, addr: str) -> int:
     return idx
 
 
+def tombstone_replica(store, idx: int) -> bool:
+    """Mark a registry slot dead on clean exit (serve_http's drain /
+    shutdown path). Best-effort: a crash simply leaves the address
+    behind, same as before tombstones existed."""
+    if store is None or idx < 0:
+        return False
+    try:
+        store.set(f"{SERVE_REPLICA_KEY_PREFIX}{int(idx)}",
+                  SERVE_REPLICA_TOMBSTONE)
+        return True
+    except Exception:
+        return False
+
+
 def discover_replicas(store) -> list[str]:
-    """Every address ever advertised (order = registration order; the
-    prober, not this list, decides liveness). Empty when nothing
-    registered or the store is unreachable."""
+    """Every address ever advertised and not tombstoned (order =
+    registration order; the prober, not this list, decides liveness of
+    what remains). Empty when nothing registered or the store is
+    unreachable."""
     if store is None:
         return []
     try:
@@ -220,10 +242,13 @@ def discover_replicas(store) -> list[str]:
     out: list[str] = []
     for i in range(n):
         try:
-            out.append(store.get(f"{SERVE_REPLICA_KEY_PREFIX}{i}",
-                                 timeout_ms=200).decode())
+            raw = store.get(f"{SERVE_REPLICA_KEY_PREFIX}{i}",
+                            timeout_ms=200)
         except Exception:
             continue  # claimed index whose set never landed
+        if raw == SERVE_REPLICA_TOMBSTONE:
+            continue  # cleanly exited: not a discovery candidate
+        out.append(raw.decode())
     return out
 
 

@@ -83,6 +83,11 @@ POINTS: dict[str, str] = {
     "step.loss_spike": "flag",   # trainer inflates the OBSERVED loss
     "host.hang": "hang",         # wedge this host forever (collective
                                  # deadlock seen from outside)
+    "controller.act": "raise",   # fleet-controller actuation start
+                                 # (fleet/controller.py): the act fails
+                                 # before touching the fleet, so the
+                                 # failed/rolled_back journaling and the
+                                 # action budget are drillable
 }
 
 

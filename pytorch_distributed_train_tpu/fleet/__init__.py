@@ -1,0 +1,16 @@
+"""Fleet control plane: the closed loop over alerts and actuators.
+
+The observability planes diagnose (collector, alert engine, SLO
+budgets); the serving planes actuate (advertise/discover, drain,
+rolling restart, admission shed). This package is the connective
+tissue: a reconciling controller that reads the former and drives the
+latter, under hard safety rails (docs/autoscaler.md).
+"""
+
+from pytorch_distributed_train_tpu.fleet.controller import (  # noqa: F401
+    ACTIONS,
+    OUTCOMES,
+    ActionSpec,
+    FleetController,
+    SubprocessReplicaLauncher,
+)

@@ -1,0 +1,684 @@
+"""Closed-loop fleet controller: alerts in, actuation out.
+
+The reconciling control loop ROADMAP item 3 names: each tick reads
+the fleet's diagnosis (AlertEngine firing state + FleetCollector load
+rows) and drives the actuators the serving planes already have —
+spawn a replica (pluggable :class:`ReplicaLauncher`), drain one
+through serve_http's ``/admin/drain``, push router dispatch weights —
+so a flash crowd or a sick host is *handled*, not just observed.
+
+The action catalog is CLOSED (``ACTIONS``, mirrored by the table in
+docs/autoscaler.md; the ``action-catalog`` analyze pass keeps the two
+in sync both ways):
+
+- ``scale_out`` — sustained ``shed_storm`` / ``ttft_regression`` /
+  fast serving burn alerts: launch a replica, verify it answers
+  /healthz, roll back (kill it) if it never does.
+- ``scale_in``  — a calm fleet above ``min_replicas``: drain the
+  least-loaded replica with zero failed requests (the router fails
+  over around a draining replica by construction).
+- ``recycle``   — ``host_oom_risk`` / ``restart_churn`` on a serving
+  host: drain the sick replica and launch a replacement.
+- ``rebalance`` — continuous policy: per-replica dispatch weights from
+  queue depth + admission state, pushed through the router weights
+  hook (``ReplicaSet.set_weights`` / ``POST /admin/weights``).
+
+Safety rails are the point, not an afterthought:
+
+- **bounds** — the fleet never leaves [min_replicas, max_replicas];
+- **hysteresis** — an action needs its trigger across N consecutive
+  evaluations, one spike is not a signal;
+- **cooldowns** — per-action monotonic cooldowns bound act churn;
+- **action budget** — at most ``budget_max_actions`` acts per rolling
+  ``budget_window_s``; overflow LATCHES the controller into a loudly
+  journaled ``degraded (budget_exhausted)`` observe-only mode (a
+  controller in a tight act loop is itself the incident) until an
+  operator calls :meth:`FleetController.reset_budget`;
+- **dry run** — journals every intended action, acts on nothing.
+
+Every decision is journaled under the closed ``action`` event
+category with a durable action id (``act-<action>-<epoch_ms>-<seq>``)
+cross-linked to the triggering alert's incident id, through the
+lifecycle ``requested → acting → effective | failed | rolled_back``
+(plus ``skipped`` for rail-suppressed acts and ``mode`` for latch
+transitions). ``faults.maybe_fire("controller.act")`` runs at every
+actuation start, so action failure handling is drillable.
+
+Deadlines/cooldowns ride ``time.monotonic()``; wall-clock appears
+only in ids and journal timestamps. Stdlib + the repo's obs/faults
+packages; no jax (runs on a login host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from pytorch_distributed_train_tpu.faults import registry as fregistry
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+# closed outcome vocabulary: every journaled ``action`` lifecycle name
+# except the mode latch; the action-catalog pass lints each action's
+# declared outcomes against this set
+OUTCOMES = ("requested", "acting", "effective", "failed",
+            "rolled_back", "skipped")
+
+# trigger sentinels that are policies, not alert rules
+POLICY_TRIGGERS = ("calm", "policy")
+
+
+@dataclasses.dataclass(frozen=True)
+class ActionSpec:
+    """One declared controller action. ``triggers`` name alert rules
+    (obs/alerts.py RULES) or a policy sentinel ("calm"/"policy");
+    ``outcomes`` are the terminal lifecycle names this action can
+    journal (always through requested → acting first)."""
+
+    name: str
+    triggers: tuple
+    actuator: str
+    outcomes: tuple
+    description: str
+
+
+# The CLOSED catalog — docs/autoscaler.md '## Action catalog' mirrors
+# this table; tools/analyze's action-catalog pass keeps the two in
+# sync both ways.
+ACTIONS: dict[str, ActionSpec] = {a.name: a for a in (
+    ActionSpec(
+        name="scale_out",
+        triggers=("shed_storm", "ttft_regression",
+                  "slo_serve_ttft_p95_burn_fast",
+                  "slo_serve_availability_burn_fast"),
+        actuator="ReplicaLauncher.launch (serve_http --advertise)",
+        outcomes=("requested", "acting", "effective", "failed",
+                  "rolled_back", "skipped"),
+        description="sustained overload on the serving fleet: launch "
+                    "one replica, verify /healthz answers, kill it if "
+                    "it never does (rolled_back)"),
+    ActionSpec(
+        name="scale_in",
+        triggers=("calm",),
+        actuator="POST /admin/drain on the least-loaded replica",
+        outcomes=("requested", "acting", "effective", "failed",
+                  "skipped"),
+        description="calm fleet above min_replicas: drain the least-"
+                    "loaded replica gracefully — zero failed requests "
+                    "by the drain + router-failover contract"),
+    ActionSpec(
+        name="recycle",
+        triggers=("host_oom_risk", "restart_churn"),
+        actuator="drain the sick replica, then ReplicaLauncher.launch",
+        outcomes=("requested", "acting", "effective", "failed",
+                  "skipped"),
+        description="a serving host diagnosed sick: drain its replica "
+                    "and launch a fresh one elsewhere"),
+    ActionSpec(
+        name="rebalance",
+        triggers=("policy",),
+        actuator="router weights hook (set_weights / POST "
+                 "/admin/weights)",
+        outcomes=("requested", "acting", "effective", "failed",
+                  "skipped"),
+        description="continuous load policy: dispatch weights from "
+                    "per-replica queue depth + admission state, "
+                    "pushed when they materially change"),
+)}
+
+# controller_mode gauge encoding
+_MODE_VALUES = {"active": 0.0, "dry_run": 1.0,
+                "degraded (budget_exhausted)": 2.0}
+
+
+class ReplicaLauncher:
+    """Scale-out actuator interface: ``launch()`` returns the new
+    replica's routable ``host:port`` (or None on failure); ``stop``
+    reverses an unverifiable launch (the rollback path)."""
+
+    def launch(self) -> str | None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def stop(self, addr: str) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class SubprocessReplicaLauncher(ReplicaLauncher):
+    """The drill/test launcher: spawn ``serve_http --fake-backend
+    --advertise`` as a subprocess and parse its bound address off
+    stdout. ``extra_args``/``env`` parameterize slots, delays and the
+    store/journal env contract."""
+
+    def __init__(self, *, python: str | None = None,
+                 serve_http_path: str = "tools/serve_http.py",
+                 extra_args: tuple = (), env: dict | None = None,
+                 start_timeout_s: float = 20.0):
+        self.python = python or sys.executable
+        self.serve_http_path = serve_http_path
+        self.extra_args = tuple(extra_args)
+        self.env = env
+        self.start_timeout_s = start_timeout_s
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def launch(self) -> str | None:
+        cmd = [self.python, self.serve_http_path, "--fake-backend",
+               "--port", "0", "--advertise", *self.extra_args]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=self.env)
+        addr = None
+        deadline = time.monotonic() + self.start_timeout_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline() if proc.stdout else ""
+            if not line:
+                if proc.poll() is not None:
+                    break
+                continue
+            if line.startswith("serving on http://"):
+                addr = line.split("http://", 1)[1].split()[0].strip("/")
+                break
+        if addr is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            return None
+        # drain the pipe so the child never blocks on a full stdout
+        threading.Thread(target=self._pump, args=(proc,),
+                         daemon=True,
+                         name=f"fleet-launch-pump-{addr}").start()
+        self.procs[addr] = proc
+        return addr
+
+    @staticmethod
+    def _pump(proc) -> None:
+        try:
+            for _line in proc.stdout:
+                pass
+        except (OSError, ValueError):
+            pass
+
+    def stop(self, addr: str) -> None:
+        proc = self.procs.pop(addr, None)
+        if proc is None:
+            return
+        try:
+            proc.terminate()
+            proc.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    def stop_all(self) -> None:
+        for addr in list(self.procs):
+            self.stop(addr)
+
+
+_DEFAULT_COOLDOWNS = {"scale_out": 30.0, "scale_in": 60.0,
+                      "recycle": 60.0, "rebalance": 10.0}
+
+
+class FleetController:
+    """The reconciling loop. Drive it with :meth:`tick` (tests, the
+    console) or :meth:`start` (the ``tools/fleet_controller.py``
+    daemon). One tick = read state, decide, act within the rails.
+
+    ``launcher`` actuates scale_out/recycle spawns, ``weights_sink``
+    (a ``dict[addr, weight]`` callable) actuates rebalance; either
+    left None disables the actions that need it (journaled-skip free:
+    an impossible action is simply never proposed).
+    """
+
+    def __init__(self, collector, engine, *,
+                 launcher: ReplicaLauncher | None = None,
+                 weights_sink=None,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 hysteresis: int = 2, calm_ticks: int = 5,
+                 cooldown_s: dict | None = None,
+                 budget_window_s: float = 300.0,
+                 budget_max_actions: int = 10,
+                 verify_s: float = 15.0,
+                 drain_timeout_s: float = 30.0,
+                 dry_run: bool = False,
+                 history_max: int = 64,
+                 http_timeout_s: float = 3.0):
+        self.collector = collector
+        self.engine = engine
+        self.launcher = launcher
+        self.weights_sink = weights_sink
+        self.min_replicas = max(0, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.hysteresis = max(1, int(hysteresis))
+        self.calm_ticks = max(1, int(calm_ticks))
+        self.cooldown_s = dict(_DEFAULT_COOLDOWNS,
+                               **(cooldown_s or {}))
+        self.budget_window_s = float(budget_window_s)
+        self.budget_max_actions = int(budget_max_actions)
+        self.verify_s = float(verify_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.http_timeout_s = float(http_timeout_s)
+        self._lock = threading.Lock()  # history/mode/budget vs status()
+        self.mode = "dry_run" if dry_run else "active"
+        self.history: list[dict] = []
+        self.history_max = int(history_max)
+        self._budget_monos: list[float] = []
+        self._streak: dict[str, int] = {}
+        self._recycle_key: str | None = None
+        self._calm_streak = 0
+        self._last_act_mono: dict[str, float] = {}
+        self._seq = 0
+        self._last_weights: dict[str, float] = {}
+        # launched-but-not-yet-discovered replicas: counted into fleet
+        # size so one overload doesn't double-launch inside the
+        # collector's discovery latency
+        self._expected: dict[str, float] = {}
+        # drained replicas the collector hasn't noticed dying yet:
+        # excluded from the live set so a victim is never re-drained
+        # inside the staleness window
+        self._drained: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        engine.subscribe(self._on_alert)
+        self._transitions: list[dict] = []
+        self._emit_gauges()
+
+    # ------------------------------------------------------------ plumbing
+    def _on_alert(self, rec: dict) -> None:
+        """AlertEngine subscriber: remember recent transitions so a
+        tick can cross-link actions to incident ids even when the
+        firing list has already moved on."""
+        with self._lock:
+            self._transitions.append(rec)
+            del self._transitions[:-64]
+
+    def _emit_gauges(self, target: int | None = None) -> None:
+        reg = get_registry()
+        reg.gauge("controller_mode",
+                  help="fleet-controller mode (0=active, 1=dry_run, "
+                       "2=degraded budget_exhausted)").set(
+            _MODE_VALUES.get(self.mode, 2.0))
+        if target is not None:
+            reg.gauge("fleet_target_replicas",
+                      help="serving fleet size the controller is "
+                           "reconciling toward").set(float(target))
+
+    def _next_action_id(self, action: str) -> str:
+        self._seq += 1
+        return f"act-{action}-{int(time.time() * 1000)}-{self._seq}"
+
+    def _record(self, rec: dict) -> None:
+        with self._lock:
+            self.history.append(rec)
+            del self.history[:-self.history_max]
+
+    def status(self) -> dict:
+        """The console panel's view: mode, budget headroom, last
+        actions (newest last)."""
+        now = time.monotonic()
+        with self._lock:
+            spent = sum(1 for m in self._budget_monos
+                        if now - m <= self.budget_window_s)
+            return {"mode": self.mode,
+                    "budget_spent": spent,
+                    "budget_max": self.budget_max_actions,
+                    "budget_window_s": self.budget_window_s,
+                    "actions": list(self.history)}
+
+    # --------------------------------------------------------------- rails
+    def _budget_ok(self, now: float) -> bool:
+        with self._lock:
+            self._budget_monos = [m for m in self._budget_monos
+                                  if now - m <= self.budget_window_s]
+            return len(self._budget_monos) < self.budget_max_actions
+
+    def _latch_degraded(self) -> None:
+        if self.mode == "degraded (budget_exhausted)":
+            return
+        self.mode = "degraded (budget_exhausted)"
+        self._emit_gauges()
+        # LOUD: the latch is itself an incident — journaled, gauged,
+        # printed
+        events_lib.emit("action", "mode", mode=self.mode,
+                        budget_max=self.budget_max_actions,
+                        window_s=self.budget_window_s)
+        print(f"[fleet-controller] action budget exhausted "
+              f"({self.budget_max_actions} per "
+              f"{self.budget_window_s:.0f}s): latched into "
+              f"OBSERVE-ONLY degraded mode — reset_budget() to "
+              f"re-arm", flush=True)
+
+    def reset_budget(self) -> None:
+        """Operator re-arm after a ``budget_exhausted`` latch."""
+        with self._lock:
+            self._budget_monos.clear()
+        if self.mode == "degraded (budget_exhausted)":
+            self.mode = "active"
+            self._emit_gauges()
+            events_lib.emit("action", "mode", mode=self.mode,
+                            reason="budget_reset")
+
+    def _skip(self, action: str, reason: str, trigger: str,
+              alert: dict | None, **detail) -> dict:
+        aid = self._next_action_id(action)
+        base = {"action": action, "id": aid, "trigger": trigger}
+        if alert is not None and alert.get("id"):
+            base["alert_id"] = alert["id"]
+        events_lib.emit("action", "requested", **base, **detail)
+        rec = {**base, "outcome": "skipped", "reason": reason, **detail}
+        events_lib.emit("action", "skipped", **rec)
+        get_registry().counter(
+            "controller_actions_total",
+            labels={"action": action, "outcome": "skipped"},
+            help="fleet-controller actions by terminal outcome").inc()
+        self._record(rec)
+        return rec
+
+    # ------------------------------------------------------------ execute
+    def _execute(self, action: str, trigger: str, alert: dict | None,
+                 fn, **detail) -> dict:
+        """Run one decided action through the journaled lifecycle.
+        ``fn()`` returns (outcome, detail_updates) with outcome in the
+        action's declared set; any exception → ``failed``."""
+        now = time.monotonic()
+        aid = self._next_action_id(action)
+        base = {"action": action, "id": aid, "trigger": trigger}
+        if alert is not None and alert.get("id"):
+            base["alert_id"] = alert["id"]
+            base["alert_host"] = alert.get("host")
+        events_lib.emit("action", "requested", **base, **detail)
+        if self.mode == "dry_run":
+            rec = {**base, "outcome": "skipped", "reason": "dry_run",
+                   **detail}
+            events_lib.emit("action", "skipped", **rec)
+            get_registry().counter(
+                "controller_actions_total",
+                labels={"action": action, "outcome": "skipped"},
+                help="fleet-controller actions by terminal "
+                     "outcome").inc()
+            with self._lock:
+                # dry-run still honors the cooldown: one journaled
+                # intent per window, not one per tick
+                self._last_act_mono[action] = now
+            self._record(rec)
+            return rec
+        events_lib.emit("action", "acting", **base)
+        outcome, extra = "failed", {}
+        try:
+            fregistry.maybe_fire("controller.act")
+            outcome, extra = fn()
+        except Exception as e:  # noqa: BLE001 — every act failure is data
+            outcome, extra = "failed", {
+                "error": f"{type(e).__name__}: {e}"}
+        # literal-unpack merge: an actuator's extra may repeat a key
+        # the decision detail already carries (addr on drains) — the
+        # actuator's value wins
+        rec = {**base, "outcome": outcome,
+               "after_s": round(time.monotonic() - now, 3),
+               **detail, **extra}
+        events_lib.emit("action", outcome, **rec)
+        get_registry().counter(
+            "controller_actions_total",
+            labels={"action": action, "outcome": outcome},
+            help="fleet-controller actions by terminal outcome").inc()
+        with self._lock:
+            self._budget_monos.append(now)
+            self._last_act_mono[action] = now
+        self._record(rec)
+        return rec
+
+    # ----------------------------------------------------------- actuators
+    def _http_post(self, addr: str, path: str) -> int:
+        req = urllib.request.Request(f"http://{addr}{path}", data=b"{}",
+                                     headers={"Content-Type":
+                                              "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=self.http_timeout_s) as r:
+            return r.status
+
+    def _healthz_status(self, addr: str) -> int | None:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}/healthz",
+                    timeout=self.http_timeout_s) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+        except OSError:
+            return None
+
+    def _do_scale_out(self):
+        addr = self.launcher.launch()
+        if addr is None:
+            return "failed", {"error": "launcher returned no address"}
+        deadline = time.monotonic() + self.verify_s
+        while time.monotonic() < deadline:
+            if self._healthz_status(addr) is not None:
+                with self._lock:
+                    self._expected[addr] = time.monotonic() + 60.0
+                return "effective", {"addr": addr}
+            time.sleep(0.1)
+        # launched but never answered: reverse it, loudly
+        self.launcher.stop(addr)
+        return "rolled_back", {"addr": addr,
+                               "error": "replica never answered "
+                                        "/healthz inside verify_s"}
+
+    def _do_drain(self, addr: str):
+        try:
+            self._http_post(addr, "/admin/drain")
+        except urllib.error.HTTPError:
+            pass  # drain answered non-2xx: poll below decides
+        except OSError:
+            return "failed", {"addr": addr,
+                              "error": "drain endpoint unreachable"}
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            if self._healthz_status(addr) is None:
+                with self._lock:
+                    self._drained[addr] = time.monotonic() + 60.0
+                return "effective", {"addr": addr}
+            time.sleep(0.1)
+        return "failed", {"addr": addr,
+                          "error": "replica still answering after "
+                                   "drain_timeout_s"}
+
+    def _do_recycle(self, addr: str):
+        outcome, extra = self._do_drain(addr)
+        if outcome != "effective":
+            return outcome, extra
+        if self.launcher is None:
+            return "effective", dict(extra, replacement=None)
+        out2, extra2 = self._do_scale_out()
+        if out2 != "effective":
+            return "failed", dict(extra, error="drained but "
+                                  "replacement launch "
+                                  f"{out2}: {extra2.get('error')}")
+        return "effective", dict(extra,
+                                 replacement=extra2.get("addr"))
+
+    def _do_rebalance(self, weights: dict):
+        self.weights_sink(dict(weights))
+        self._last_weights = dict(weights)
+        return "effective", {"weights": {a: round(w, 3)
+                                         for a, w in weights.items()}}
+
+    # ------------------------------------------------------------ policies
+    @staticmethod
+    def _weights_from(rows: list[dict]) -> dict[str, float]:
+        """Dispatch weights from load: inverse queue depth, shedding
+        replicas quartered, normalized so the best replica is 1.0."""
+        raw = {}
+        for r in rows:
+            q = r.get("queue_depth")
+            w = 1.0 / (1.0 + (float(q) if q is not None else 0.0))
+            if r.get("admission") == "shedding":
+                w *= 0.25
+            raw[r["addr"]] = w
+        top = max(raw.values(), default=0.0)
+        if top <= 0.0:
+            return {}
+        return {a: w / top for a, w in raw.items()}
+
+    def _weights_changed(self, weights: dict) -> bool:
+        if not weights:
+            return False
+        for addr, w in weights.items():
+            if abs(w - self._last_weights.get(addr, 1.0)) > 0.15:
+                return True
+        return False
+
+    def _cooled(self, action: str, now: float) -> bool:
+        last = self._last_act_mono.get(action)
+        return (last is None
+                or now - last >= self.cooldown_s.get(action, 0.0))
+
+    def _rail_checked(self, action: str, trigger: str,
+                      alert: dict | None, now: float,
+                      fn, **detail) -> dict | None:
+        """Common rails for one decided action: cooldown (silent
+        suppress), budget latch + degraded mode (journaled skip),
+        then execute. Returns the terminal record, or None when the
+        cooldown suppressed the act."""
+        if not self._cooled(action, now):
+            return None
+        if self.mode == "degraded (budget_exhausted)" \
+                or not self._budget_ok(now):
+            if self.mode != "dry_run":
+                self._latch_degraded()
+            rec = self._skip(action, "budget_exhausted", trigger,
+                             alert, **detail)
+            with self._lock:
+                self._last_act_mono[action] = now
+            return rec
+        return self._execute(action, trigger, alert, fn, **detail)
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> list[dict]:
+        """One reconcile pass. Returns the terminal action records it
+        produced (empty on a quiet tick)."""
+        now = time.monotonic()
+        rows = self.collector.serving_rows()
+        with self._lock:
+            self._drained = {a: d for a, d in self._drained.items()
+                             if d > now}
+            drained = set(self._drained)
+        live = [r for r in rows
+                if r["state"] == "ok" and r["addr"] not in drained]
+        live_addrs = {r["addr"] for r in live}
+        with self._lock:
+            self._expected = {
+                a: d for a, d in self._expected.items()
+                if a not in live_addrs and d > now}
+            pending = len(self._expected)
+        fleet = len(live) + pending
+        firing = self.engine.firing()
+        by_rule: dict[str, dict] = {}
+        for a in firing:
+            by_rule.setdefault(a["rule"], a)
+        out: list[dict] = []
+
+        # ---- scale OUT: sustained overload triggers
+        trig = next((t for t in ACTIONS["scale_out"].triggers
+                     if t in by_rule), None)
+        self._streak["scale_out"] = (
+            self._streak.get("scale_out", 0) + 1 if trig else 0)
+        if trig:
+            self._calm_streak = 0
+        else:
+            self._calm_streak += 1
+        if (trig and self.launcher is not None
+                and self._streak["scale_out"] >= self.hysteresis):
+            if fleet >= self.max_replicas:
+                pass  # bounded: nothing to propose
+            else:
+                rec = self._rail_checked(
+                    "scale_out", trig, by_rule[trig], now,
+                    self._do_scale_out, fleet=fleet,
+                    target=min(self.max_replicas, fleet + 1))
+                if rec is not None:
+                    out.append(rec)
+
+        # ---- recycle: a diagnosed-sick serving host (drain +
+        # replace, so the fleet floor holds; with no launcher the
+        # drain alone must not take the fleet under min_replicas)
+        sick = next(
+            (a for a in firing
+             if a["rule"] in ACTIONS["recycle"].triggers
+             and any(r["host"] == a["host"] for r in live)), None)
+        key = f"recycle:{sick['host']}" if sick else None
+        self._streak["recycle"] = (
+            self._streak.get("recycle", 0) + 1
+            if sick and key == self._recycle_key
+            else (1 if sick else 0))
+        self._recycle_key = key
+        if (sick and self._streak["recycle"] >= self.hysteresis
+                and (self.launcher is not None
+                     or fleet > self.min_replicas)):
+            row = next(r for r in live if r["host"] == sick["host"])
+            rec = self._rail_checked(
+                "recycle", sick["rule"], sick, now,
+                lambda: self._do_recycle(row["addr"]),
+                addr=row["addr"], host=sick["host"])
+            if rec is not None:
+                out.append(rec)
+
+        # ---- scale IN: calm fleet above the floor
+        if (self._calm_streak >= self.calm_ticks
+                and len(live) > self.min_replicas and pending == 0):
+            victim = min(
+                live, key=lambda r: (
+                    (r.get("queue_depth")
+                     if r.get("queue_depth") is not None else 0),
+                    r.get("shed_per_s") or 0.0, r["addr"]))
+            rec = self._rail_checked(
+                "scale_in", "calm", None, now,
+                lambda: self._do_drain(victim["addr"]),
+                addr=victim["addr"], host=victim["host"],
+                fleet=fleet, target=max(self.min_replicas, fleet - 1))
+            if rec is not None:
+                out.append(rec)
+
+        # ---- rebalance: continuous weights policy
+        if self.weights_sink is not None and len(live) >= 2:
+            weights = self._weights_from(live)
+            if self._weights_changed(weights):
+                rec = self._rail_checked(
+                    "rebalance", "policy", None, now,
+                    lambda: self._do_rebalance(weights))
+                if rec is not None:
+                    out.append(rec)
+
+        self._emit_gauges(target=max(
+            self.min_replicas, min(self.max_replicas, fleet)))
+        return out
+
+    # ------------------------------------------------------------ threading
+    def start(self, tick_s: float = 2.0) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(tick_s):
+                try:
+                    self.tick()
+                except Exception as e:  # noqa: BLE001 — loop must live
+                    print(f"[fleet-controller] tick error "
+                          f"{type(e).__name__}: {e}", flush=True)
+
+        self._thread = threading.Thread(
+            target=_loop, daemon=True, name="fleet-controller")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -88,6 +88,15 @@ class AlertRule:
     window: int = 64
     direction: str = "both"        # above | below | both
     resolve_after: int = 2         # consecutive healthy obs to resolve
+    # anomaly series can go QUIET (a sparse series like ttft_p95_s
+    # only produces samples while requests complete): with no fresh
+    # samples there is no evidence either way, and a firing alert
+    # would freeze FIRING forever — wedging every consumer that waits
+    # for resolution (the fleet controller's calm gate). After this
+    # many seconds without a sample, resolve the alert: "no traffic"
+    # is not "regressed" (wedged targets are the stale/absence rules'
+    # job). 0 disables (dense series like shed_per_s never go quiet).
+    quiet_resolve_s: float = 0.0
     # absence / rate windows (seconds)
     for_s: float = 0.0
     # lifecycle
@@ -157,7 +166,7 @@ RULES: dict[str, AlertRule] = {r.name: r for r in (
     AlertRule(
         name="ttft_regression", kind="anomaly", roles=("serving",),
         series="ttft_p95_s", direction="above", min_abs=0.02,
-        profile=True,
+        profile=True, quiet_resolve_s=30.0,
         description="windowed TTFT p95 (serve_ttft_seconds bucket "
                     "deltas) spiked vs its healthy window"),
     AlertRule(
@@ -263,6 +272,18 @@ class AlertEngine:
         self._states: dict[tuple[str, str, str], _RuleState] = {}
         self._gen_seen: dict[tuple[str, str], dict[str, float]] = {}
         self._last_profile_mono: float | None = None
+        # action-sink hook (fleet/controller.py): every transition
+        # record is pushed to subscribers as it happens, so a
+        # controller reacts on the evaluation tick instead of diffing
+        # firing() snapshots
+        self._subscribers: list = []
+
+    def subscribe(self, fn) -> None:
+        """Register ``fn(rec)`` to receive every transition record
+        (fired AND resolved, each carrying the incident ``id``).
+        Subscriber errors are swallowed — an actuator bug must never
+        take alert evaluation down."""
+        self._subscribers.append(fn)
 
     # ------------------------------------------------------------ helpers
     def _state(self, rule: AlertRule, target) -> _RuleState:
@@ -315,6 +336,9 @@ class AlertEngine:
             rec["event"] = "resolved"
             rec["after_s"] = round(now_mono - (st.since_mono or now_mono), 1)
             st.since_mono = None
+        # the incident id rides EVERY transition record — resolve
+        # included, so action→resolve chains close without the caller
+        # re-deriving rule@host@ms from parts
         if st.alert_id is not None:
             rec["id"] = st.alert_id
         events_lib.emit("alert", rec["event"], rule=rule.name,
@@ -323,9 +347,20 @@ class AlertEngine:
                         **{k: v for k, v in rec.items()
                            if k in ("value", "baseline", "after_s",
                                     "id")})
+        if not fire:
+            # the id's lifetime IS the incident's: once the resolve
+            # record carried it out, a later unrelated firing must mint
+            # a fresh one, never inherit this one
+            st.alert_id = None
         self._sink(rec)
+        for fn in self._subscribers:
+            try:
+                fn(rec)
+            except Exception:
+                pass  # subscriber bugs must not break evaluation
         if fire and rule.profile and self.profile_on_alert:
-            self._request_profile(rule, target, now_mono, st.alert_id)
+            self._request_profile(rule, target, now_mono,
+                                  rec.get("id"))
         return rec
 
     def _sink(self, rec: dict) -> None:
@@ -503,6 +538,16 @@ class AlertEngine:
         samples = [(ts, v) for ts, v in target.series.get(rule.series, ())
                    if st.last_sample_mono is None
                    or ts > st.last_sample_mono]
+        if (not samples and st.firing and rule.quiet_resolve_s > 0
+                and st.last_sample_mono is not None
+                and now - st.last_sample_mono >= rule.quiet_resolve_s):
+            # the series went quiet under a firing alert: no fresh
+            # evidence can ever arrive to resolve it, and "no traffic"
+            # is not the condition this rule alerts on — resolve so
+            # downstream consumers (calm gates, pages) unwedge
+            return [self._transition(
+                rule, target, st, False, now, st.value,
+                self._median(det))]
         for ts, value in samples:
             st.last_sample_mono = ts
             spike = det.is_spike(value) and self._directed(

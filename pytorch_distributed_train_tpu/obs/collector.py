@@ -603,3 +603,12 @@ class FleetCollector:
         return {"targets": rows,
                 "slowest_trainer": slowest_trainer,
                 "slowest_serving": slowest_serving}
+
+    def serving_rows(self) -> list[dict]:
+        """The serving-replica load rows (the fleet controller's
+        reconcile input): ``snapshot()`` filtered to ``role ==
+        "serving"``, each row carrying addr / state / queue_depth /
+        admission / shed_per_s / TTFT. Pure read; safe from any
+        thread."""
+        return [r for r in self.snapshot()["targets"]
+                if r["role"] == "serving"]

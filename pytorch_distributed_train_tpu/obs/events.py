@@ -72,6 +72,9 @@ CATEGORIES: dict[str, str] = {
             "and perf-ledger rows (obs/perf.py)",
     "alert": "fleet alert-rule transitions: fired, resolved, capture "
              "requests (obs/alerts.py)",
+    "action": "fleet-controller decisions and their lifecycle: "
+              "requested, acting, effective, failed, rolled_back, "
+              "skipped, mode latches (fleet/controller.py)",
     "sanitizer": "runtime concurrency-sanitizer findings: lock-order "
                  "inversions, hold-while-blocking, unjoined threads, "
                  "deadlock watchdog trips (utils/syncdbg.py)",

@@ -88,6 +88,14 @@ class Replica:
     outstanding: int = 0
     fails: int = 0               # consecutive probe failures
     healthz: dict = dataclasses.field(default_factory=dict)
+    # dispatch weight (fleet-controller rebalance hook): balancing
+    # divides effective load by it, so a 0.5-weight replica carries
+    # half the traffic of a 1.0 one at equal outstanding counts
+    weight: float = 1.0
+    # role-aware dispatch stub (ROADMAP item 2 — prefill/decode
+    # pools): "mixed" replicas serve everything; pick(role=) prefers a
+    # matching pool when one exists and falls back to mixed otherwise
+    role: str = "mixed"
 
 
 class ReplicaSet:
@@ -97,10 +105,27 @@ class ReplicaSet:
         for a in addrs:
             self.add(a)
 
-    def add(self, addr: str) -> None:
+    def add(self, addr: str, role: str = "mixed") -> None:
         with self._lock:
             if addr not in self._replicas:
-                self._replicas[addr] = Replica(addr)
+                self._replicas[addr] = Replica(addr, role=role)
+
+    def set_weights(self, weights: dict) -> None:
+        """Apply dispatch weights (addr → positive float; missing
+        addrs keep their current weight). The fleet controller's
+        rebalance actuator lands here — and through serve_router's
+        ``POST /admin/weights``."""
+        with self._lock:
+            for addr, w in weights.items():
+                r = self._replicas.get(addr)
+                if r is None:
+                    continue
+                try:
+                    w = float(w)
+                except (TypeError, ValueError):
+                    continue
+                if w > 0.0:
+                    r.weight = w
 
     def addrs(self) -> list[str]:
         with self._lock:
@@ -156,24 +181,35 @@ class ReplicaSet:
             if r is not None:
                 r.outstanding = max(0, r.outstanding - 1)
 
-    def pick(self, exclude: set[str] = frozenset()) -> str | None:
-        """Least-outstanding routable replica. A replica whose own
-        admission state says ``shedding`` ranks after every non-
-        shedding one — the router backs off before the 429s start."""
+    def pick(self, exclude: set[str] = frozenset(),
+             role: str | None = None) -> str | None:
+        """Least-loaded routable replica, where load is outstanding
+        requests divided by the dispatch weight (rebalance hook). A
+        replica whose own admission state says ``shedding`` ranks
+        after every non-shedding one — the router backs off before the
+        429s start. ``role`` prefers a matching pool when one exists
+        (prefill/decode split, ROADMAP item 2) and falls back to the
+        whole up set otherwise."""
         with self._lock:
             cands = [r for r in self._replicas.values()
                      if r.state == "up" and r.addr not in exclude]
+            if role is not None:
+                pool = [r for r in cands if r.role == role]
+                if pool:
+                    cands = pool
             if not cands:
                 return None
             return min(
                 cands,
                 key=lambda r: (r.healthz.get("admission") == "shedding",
-                               r.outstanding, r.addr)).addr
+                               (r.outstanding + 1) / max(r.weight, 1e-9),
+                               r.addr)).addr
 
     def snapshot(self) -> list[dict]:
         with self._lock:
             return [{"addr": r.addr, "state": r.state,
                      "outstanding": r.outstanding,
+                     "weight": r.weight, "role": r.role,
                      "admission": r.healthz.get("admission"),
                      "queue_depth": r.healthz.get("queue_depth")}
                     for r in self._replicas.values()]
@@ -406,6 +442,11 @@ class Router:
             if kind == "conn_fail":
                 return 502, json.dumps(
                     {"error": "session replica unreachable"}).encode()
+            if kind == "ok":
+                # a kept resume consumes the session and parks a NEW
+                # one: learn the fresh id here too, or the chain's next
+                # turn routes unpinned to an arbitrary replica
+                self.note_session(rbody, pinned)
             return status, rbody
         tried: set[str] = set()
         last: tuple[int, bytes] | None = None

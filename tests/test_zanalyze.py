@@ -48,7 +48,7 @@ def test_registry_has_all_passes():
         "lock-scope", "monotonic-clock", "jit-purity", "fault-catalog",
         "event-catalog", "metric-catalog", "thread-shared-state",
         "trace-hygiene", "alert-catalog", "slo-catalog", "lock-order",
-        "thread-lifecycle"}
+        "thread-lifecycle", "action-catalog"}
 
 
 def test_pass_catalog_doc_is_the_registry_contract():

@@ -1,0 +1,66 @@
+"""Autoscaler drill (tools/autoscale_drill.py — the ISSUE 17
+acceptance): the flash-crowd arc end to end with a REAL subprocess
+scale-out (alert fires → controller launches a 3rd serve_http replica
+→ shed recovers → calm scale-in drains with zero failed requests, the
+whole chain journaled and console-visible), and the budget-zero
+variant latching ``degraded (budget_exhausted)`` observe-only mode —
+run under the tsan-lite sanitizer per the acceptance bar. Slow-marked
+subprocess tests so tier-1 stays fast, like the chaos soak."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_drill(*args, timeout=480):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PDTT_FAULTS", None)
+    env.pop("PDTT_EVENTS_DIR", None)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "autoscale_drill.py"), *args],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_flash_crowd_drill_scales_out_recovers_scales_in():
+    report = _run_drill("--seed", "0")
+    assert report["ok"] is True, report.get("why")
+    # the closed loop actually closed: overload alert → subprocess
+    # scale-out → calm scale-in, zero hard-failed client requests
+    acts = {(a["action"], a["outcome"]) for a in report["actions"]}
+    assert ("scale_out", "effective") in acts
+    assert ("scale_in", "effective") in acts
+    assert report["failed_total"] == 0
+    assert report["shed_total"] > 0       # the spike really overloaded
+    assert report["ok_total"] > 0
+    # the journal carries the alert → action → resolved chain that
+    # timeline_report renders
+    chain = report["chain"]
+    assert chain["ok"] and chain["action_id"].startswith("act-scale_out-")
+    assert chain["alert_id"] and chain["alert_resolved"] is True
+    # and the arc is console-visible
+    assert "serving" in report["console_snapshot"]
+    assert report["controller"]["mode"] == "active"
+
+
+@pytest.mark.slow
+def test_budget_zero_drill_latches_degraded_under_sanitizer():
+    report = _run_drill("--budget-drill", "--time-scale", "0.6",
+                        "--sanitize")
+    assert report["ok"] is True, report.get("why")
+    assert report["controller"]["mode"] == "degraded (budget_exhausted)"
+    assert report["latched"] is True
+    assert report["skipped_actions"] > 0  # suppressed intents journaled
+    # observe-only: nothing actually actuated
+    assert not any(a["outcome"] in ("effective", "failed", "rolled_back")
+                   for a in report["actions"])
+    assert report["failed_total"] == 0
+    assert report.get("sanitizer_findings") in (None, {}, [])

@@ -278,6 +278,45 @@ def test_anomaly_rule_lifecycle_and_cooldown(tmp_path):
     assert ("resolved", "loss_spike", "0") in names
 
 
+def test_anomaly_quiet_series_resolves(tmp_path):
+    """A firing anomaly rule over a SPARSE series (ttft_p95_s only
+    samples while requests complete) must resolve once the series goes
+    quiet for quiet_resolve_s — no traffic is not a regression, and a
+    frozen-FIRING alert wedges every consumer that waits on resolution
+    (the fleet controller's calm gate)."""
+    events_lib.configure(str(tmp_path))
+    t = _target(role="serving", host="hostQ")
+    col = _StubCollector([t])
+    engine = AlertEngine(overrides={
+        "ttft_regression.min_samples": "4",
+        "ttft_regression.quiet_resolve_s": "0.3"})
+
+    def push(*vals):  # real-clock stamps: the quiet window is wall-time
+        for v in vals:
+            t.series["ttft_p95_s"].append((time.monotonic(), float(v)))
+            time.sleep(0.002)
+
+    push(0.05, 0.06, 0.05, 0.06, 0.05)
+    assert engine.evaluate(col) == []
+    push(0.9)
+    trans = engine.evaluate(col)
+    assert [r["event"] for r in trans] == ["fired"]
+    fired_id = trans[0]["id"]
+    # quiet window not yet elapsed: no evidence either way, no change
+    assert engine.evaluate(col) == []
+    assert engine.firing()[0]["rule"] == "ttft_regression"
+    time.sleep(0.35)
+    trans = engine.evaluate(col)
+    assert [r["event"] for r in trans] == ["resolved"]
+    assert trans[0]["id"] == fired_id  # the incident closes, same id
+    assert engine.firing() == []
+    alert_recs = [(e["name"], (e.get("detail") or {}).get("id"))
+                  for e in load_events(str(tmp_path))
+                  if e["category"] == "alert"]
+    assert ("fired", fired_id) in alert_recs
+    assert ("resolved", fired_id) in alert_recs
+
+
 def test_threshold_and_rate_rules(tmp_path):
     events_lib.configure(str(tmp_path))
     t = _target(role="serving", host="hostS")

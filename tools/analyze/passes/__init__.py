@@ -8,6 +8,7 @@ documents the contract.
 """
 
 from tools.analyze.passes import (  # noqa: F401
+    action_catalog,
     alert_catalog,
     event_catalog,
     fault_catalog,

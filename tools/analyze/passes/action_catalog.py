@@ -1,0 +1,105 @@
+"""action-catalog: fleet/controller.py ACTIONS ↔ docs/autoscaler.md.
+
+The controller's action vocabulary is closed, like the fault points,
+event categories, metrics and alert rules before it: every declared
+action must appear in docs/autoscaler.md's '## Action catalog' table
+and vice versa — an actuation an operator cannot look up in the
+runbook is exactly the kind of surprise a self-healing loop must
+never produce. Also lints the declarations themselves: outcomes come
+from the controller's closed OUTCOMES set (and always include the
+``requested``/journaled lifecycle root plus at least one terminal),
+and triggers name real alert rules (obs/alerts.py RULES) or one of
+the policy sentinels.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from tools.analyze.core import AnalysisPass, Context, Finding, register
+
+_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+DOC_REL = os.path.join("docs", "autoscaler.md")
+SECTION = "## action catalog"
+CODE_REL = "pytorch_distributed_train_tpu/fleet/controller.py"
+TERMINALS = {"effective", "failed", "rolled_back", "skipped"}
+
+
+def documented_actions(doc_path: str) -> set[str]:
+    from tools.analyze.core import doc_table_names
+
+    return doc_table_names(doc_path, SECTION, _ROW)
+
+
+def declared_actions() -> dict:
+    from pytorch_distributed_train_tpu.fleet.controller import ACTIONS
+
+    return dict(ACTIONS)
+
+
+@register
+class ActionCatalogPass(AnalysisPass):
+    id = "action-catalog"
+    description = ("fleet-controller actions: fleet/controller.py "
+                   "ACTIONS ↔ docs/autoscaler.md '## Action catalog', "
+                   "both ways, plus closed-outcome/trigger lint")
+    include = (CODE_REL,)
+
+    def run(self, ctx: Context) -> list[Finding]:
+        from pytorch_distributed_train_tpu.fleet.controller import (
+            OUTCOMES,
+            POLICY_TRIGGERS,
+        )
+        from pytorch_distributed_train_tpu.obs.alerts import RULES
+
+        doc_path = ctx.doc_path(DOC_REL)
+        doc_rel = DOC_REL.replace(os.sep, "/")
+        code = declared_actions()
+        try:
+            doc = documented_actions(doc_path)
+        except OSError:
+            return [Finding(self.id, doc_rel, 1,
+                            "docs/autoscaler.md is unreadable",
+                            key="doc-missing")]
+        if not doc:
+            return [Finding(self.id, doc_rel, 1,
+                            "no rows under '## Action catalog' — was "
+                            "the table renamed?", key="catalog-empty")]
+        out: list[Finding] = []
+        valid_triggers = set(RULES) | set(POLICY_TRIGGERS)
+        for name, spec in sorted(code.items()):
+            bad = sorted(set(spec.outcomes) - set(OUTCOMES))
+            if bad:
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"action `{name}` declares outcomes {bad} outside "
+                    f"the closed set {sorted(OUTCOMES)}",
+                    key=f"outcome:{name}"))
+            if "requested" not in spec.outcomes or not (
+                    set(spec.outcomes) & TERMINALS):
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"action `{name}` must declare the `requested` "
+                    f"lifecycle root and at least one terminal outcome "
+                    f"({sorted(TERMINALS)})", key=f"lifecycle:{name}"))
+            for t in sorted(set(spec.triggers) - valid_triggers):
+                out.append(Finding(
+                    self.id, CODE_REL, 1,
+                    f"action `{name}` trigger `{t}` names neither an "
+                    f"alert rule (obs/alerts.py RULES) nor a policy "
+                    f"sentinel {sorted(POLICY_TRIGGERS)}",
+                    key=f"trigger:{name}:{t}"))
+        for name in sorted(set(code) - doc):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"controller action `{name}` declared in "
+                f"fleet/controller.py but missing from the doc's "
+                f"action catalog", key=f"undocumented:{name}"))
+        for name in sorted(doc - set(code)):
+            out.append(Finding(
+                self.id, doc_rel, 1,
+                f"controller action `{name}` documented but absent "
+                f"from fleet/controller.py ACTIONS",
+                key=f"phantom:{name}"))
+        return out

@@ -56,8 +56,10 @@ SCOPE = (
     "pytorch_distributed_train_tpu/faults/",
     "pytorch_distributed_train_tpu/elastic.py",
     "pytorch_distributed_train_tpu/data/workers.py",
+    "pytorch_distributed_train_tpu/fleet/",
     "tools/serve_http.py",
     "tools/serve_router.py",
+    "tools/fleet_controller.py",
 )
 
 

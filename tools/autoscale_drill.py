@@ -1,0 +1,426 @@
+#!/usr/bin/env python
+"""autoscale_drill — prove the closed fleet-control loop end to end.
+
+The controller drill (ISSUE 17 acceptance): a seeded flash-crowd
+traffic shape (tools/slo_soak.py ``scenario_schedule``) hits a 2-replica
+fake-backend serving fleet through the failover router, the alert
+engine diagnoses the overload, and the REAL controller — subprocess
+launcher and all — must:
+
+1. scale OUT: launch a 3rd ``serve_http --fake-backend --advertise``
+   replica (action journaled ``requested → acting → effective``,
+   cross-linked to the triggering alert incident id);
+2. absorb the spike: the router discovers the new replica and shed
+   recovers;
+3. scale IN: once calm, drain one replica through ``/admin/drain``
+   with ZERO hard-failed client requests (429 shed during the spike is
+   honest degradation and does not count);
+4. leave the whole arc visible: ``fleet_console --snapshot`` shows the
+   fleet, and the event journal carries the
+   ``alert fired → action requested → effective → alert resolved``
+   chain tools/timeline_report.py renders.
+
+``--budget-drill`` runs the safety-rail variant instead: the same
+storm against a controller given an action budget of ZERO must latch
+``degraded (budget_exhausted)`` observe-only mode, journal the
+suppressed actions as ``skipped``, and act on nothing.
+
+Prints one JSON report line; exit 0 = pass. Registered as slow-marked
+tests (tests/test_zautoscale_drill.py) so tier-1 stays fast.
+
+Usage::
+
+    python tools/autoscale_drill.py [--seed 0] [--sanitize]
+    python tools/autoscale_drill.py --budget-drill
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mk_launcher(store_addr: str, events_dir: str, *,
+                 step_delay: float, slots: int, queue_depth: int):
+    from pytorch_distributed_train_tpu.fleet.controller import (
+        SubprocessReplicaLauncher,
+    )
+
+    env = dict(os.environ)
+    env["TPUSTORE_ADDR"] = store_addr
+    env["PDTT_EVENTS_DIR"] = events_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    here = os.path.dirname(os.path.abspath(__file__))
+    return SubprocessReplicaLauncher(
+        serve_http_path=os.path.join(here, "serve_http.py"),
+        extra_args=("--slots", str(slots),
+                    "--fake-step-delay", str(step_delay),
+                    "--max-queue-depth", str(queue_depth),
+                    "--drain-grace", "10"),
+        env=env, start_timeout_s=30.0)
+
+
+def _drive(router, phases: list, seed: int, counts: dict,
+           lock: threading.Lock, stop: threading.Event) -> None:
+    """Client load: the scenario schedule through the in-process
+    failover router. Counts per-phase outcomes; a hard failure is a
+    5xx or transport error — 429/504 are honest admission answers."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    sem = threading.Semaphore(64)
+    threads = []
+
+    def one(phase, i):
+        body = {"prompt": f"{phase.name} req {i} xxxx",
+                "max_tokens": phase.max_tokens}
+        raw = json.dumps(body).encode()
+        status = -1
+        with sem:
+            try:
+                status, _ = router.request("/v1/completions", raw, body)
+            except Exception:  # noqa: BLE001 — any escape is a failure
+                status = -1
+        with lock:
+            c = counts.setdefault(
+                phase.name, {"ok": 0, "shed": 0, "deadline": 0,
+                             "failed": 0})
+            if status == 200:
+                c["ok"] += 1
+            elif status == 429:
+                c["shed"] += 1
+            elif status == 504:
+                c["deadline"] += 1
+            else:
+                c["failed"] += 1
+
+    for pi, phase in enumerate(phases):
+        n = max(1, int(phase.rps * phase.duration_s))
+        gap = phase.duration_s / n
+        for i in range(n):
+            if stop.is_set():
+                break
+            th = threading.Thread(target=one, args=(phase, i),
+                                  daemon=True,
+                                  name=f"drill-load-{phase.name}-{i}")
+            th.start()
+            threads.append(th)
+            time.sleep(max(0.0, gap * float(rng.uniform(0.6, 1.4))))
+        if stop.is_set():
+            break
+    for th in threads:
+        th.join(timeout=45.0)
+
+
+def _snapshot_console(store_addr: str) -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    r = subprocess.run(
+        [sys.executable, os.path.join(here, "fleet_console.py"),
+         "--store", store_addr, "--snapshot", "--interval", "0.3"],
+        capture_output=True, text=True, timeout=60)
+    return r.stdout
+
+
+def _chain_ok(events: list[dict]) -> dict:
+    """The journal must carry the closed-loop arc: an overload alert
+    fired, a scale_out action requested cross-linked to its incident
+    id, the same action effective, the alert later resolved. Journal
+    records nest their payload under ``detail``."""
+    fired = {e["detail"].get("id") for e in events
+             if e.get("category") == "alert"
+             and e.get("name") == "fired"}
+    resolved = {e["detail"].get("id") for e in events
+                if e.get("category") == "alert"
+                and e.get("name") == "resolved"}
+    by_id: dict[str, dict] = {}
+    for e in events:
+        if e.get("category") != "action":
+            continue
+        d = e.get("detail", {})
+        aid = d.get("id")
+        if not aid or not str(aid).startswith("act-"):
+            continue
+        slot = by_id.setdefault(aid, {"names": [], "detail": d})
+        slot["names"].append(e.get("name"))
+    for aid, slot in by_id.items():
+        d = slot["detail"]
+        if (d.get("action") == "scale_out"
+                and "requested" in slot["names"]
+                and "effective" in slot["names"]
+                and d.get("alert_id") in fired):
+            return {"ok": True, "action_id": aid,
+                    "alert_id": d.get("alert_id"),
+                    "alert_resolved": d.get("alert_id") in resolved}
+    return {"ok": False, "action_ids": sorted(by_id)}
+
+
+def run_drill(seed: int = 0, budget_drill: bool = False,
+              time_scale: float = 1.0) -> dict:
+    from pytorch_distributed_train_tpu.elastic import discover_replicas
+    from pytorch_distributed_train_tpu.fleet.controller import (
+        FleetController,
+    )
+    from pytorch_distributed_train_tpu.native.store import (
+        StoreClient,
+        StoreServer,
+    )
+    from pytorch_distributed_train_tpu.obs import events as events_lib
+    from pytorch_distributed_train_tpu.obs.alerts import AlertEngine
+    from pytorch_distributed_train_tpu.obs.collector import (
+        FleetCollector,
+    )
+    from pytorch_distributed_train_tpu.serving_plane.router import (
+        HealthProber,
+        ReplicaSet,
+        Router,
+    )
+    from tools.slo_soak import Phase, scenario_schedule
+
+    report: dict = {"seed": seed,
+                    "variant": "budget_drill" if budget_drill
+                    else "flash_crowd"}
+    events_dir = tempfile.mkdtemp(prefix="autoscale-drill-events-")
+    report["events_dir"] = events_dir
+    os.environ["PDTT_EVENTS_DIR"] = events_dir
+    events_lib.configure(events_dir, who="drill")
+
+    server = StoreServer()
+    store_addr = f"127.0.0.1:{server.port}"
+    report["store"] = store_addr
+    launcher = _mk_launcher(store_addr, events_dir,
+                            step_delay=0.03, slots=2, queue_depth=4)
+    store = StoreClient("127.0.0.1", server.port)
+    replicas = ReplicaSet()
+    prober = HealthProber(replicas, interval_s=0.25, down_after=3,
+                          refresh=lambda: discover_replicas(store))
+    router = Router(replicas, timeout_s=30.0)
+    collector = FleetCollector(
+        store_factory=lambda: StoreClient("127.0.0.1", server.port),
+        poll_s=0.4, stale_after_s=4.0, timeout_s=2.0)
+    # drill-tight rules: the storm must diagnose in seconds, and the
+    # incident must resolve fast enough for the arc to complete. The
+    # fake backend quantizes TTFT into coarse histogram buckets and a
+    # scrape often covers a single request, so the windowed p95 is
+    # really max-sampling: one benign queue collision reads ~4x the
+    # idle median (0.256 bucket vs 0.064). min_abs must sit ABOVE that
+    # collision noise — otherwise the rule fires off calm-phase noise
+    # and every recovery-phase collision resets the healthy streak, so
+    # the alert never resolves and calm never accrues. Storm TTFT
+    # (queue full) lands at >= 0.512, comfortably over 0.3.
+    engine = AlertEngine(overrides={
+        "shed_storm.min_samples": 4,
+        "shed_storm.window": 16,
+        "shed_storm.resolve_after": 3,
+        "shed_storm.cooldown_s": 1.0,
+        "ttft_regression.min_abs": 0.3,
+        "ttft_regression.cooldown_s": 1.0,
+        # once the drill's traffic ends the ttft series goes quiet;
+        # resolve fast so calm can accrue inside the settle window
+        "ttft_regression.quiet_resolve_s": 5.0,
+    })
+    controller = FleetController(
+        collector, engine, launcher=launcher,
+        min_replicas=2, max_replicas=3,
+        hysteresis=2, calm_ticks=8,
+        cooldown_s={"scale_out": 3.0, "scale_in": 3.0,
+                    "recycle": 3.0, "rebalance": 2.0},
+        budget_window_s=120.0,
+        budget_max_actions=0 if budget_drill else 10,
+        verify_s=15.0, drain_timeout_s=20.0)
+    if budget_drill:
+        # a zero budget means the very first decided action latches
+        # the degraded observe-only mode — the rail under test
+        controller.mode = "active"
+
+    counts: dict = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+    action_log: list[dict] = []
+    ctl_stop = threading.Event()
+
+    def control_loop():
+        while not ctl_stop.wait(0.5):
+            try:
+                collector.poll()
+                engine.evaluate(collector)
+                for rec in controller.tick():
+                    action_log.append(rec)
+            except Exception as e:  # noqa: BLE001 — drill must report
+                action_log.append({"action": "loop_error",
+                                   "outcome": "failed",
+                                   "error": f"{type(e).__name__}: {e}"})
+
+    try:
+        for _ in range(2):
+            addr = launcher.launch()
+            if addr is None:
+                report["ok"] = False
+                report["error"] = "seed replica failed to start"
+                return report
+        prober.start()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and len(
+                replicas.snapshot()) < 2:
+            time.sleep(0.2)
+        ctl = threading.Thread(target=control_loop, daemon=True,
+                               name="drill-control-loop")
+        ctl.start()
+        # warm baseline so the shed_per_s spike detector has a healthy
+        # window before the storm
+        time.sleep(3.0)
+
+        phases = scenario_schedule("flash_crowd", seed=seed,
+                                   time_scale=2.2 * time_scale,
+                                   rps_scale=3.0)
+        # stretch recovery so calm_ticks can elapse and scale-in runs
+        # while clients are still live (the zero-failed contract)
+        phases = [*phases[:-1],
+                  Phase("recovery", phases[-1].duration_s + 20.0,
+                        phases[-1].rps, phases[-1].max_tokens,
+                        phases[-1].prompt_chars, phases[-1].tenants)]
+        _drive(router, phases, seed, counts, lock, stop)
+        # let drains / resolves settle: the overload alerts must
+        # RESOLVE (healthy samples / quiet_resolve_s) before calm
+        # ticks can even start accruing, so this window is generous —
+        # the loop exits the moment the arc completes
+        settle = time.monotonic() + 35.0
+        while time.monotonic() < settle:
+            if budget_drill and controller.mode.startswith("degraded"):
+                break
+            if not budget_drill and any(
+                    r["action"] == "scale_in"
+                    and r["outcome"] == "effective"
+                    for r in action_log):
+                break
+            time.sleep(0.5)
+        report["console_snapshot"] = _snapshot_console(store_addr)
+    finally:
+        stop.set()
+        ctl_stop.set()
+        prober.stop()
+        collector.stop()
+        launcher.stop_all()
+        try:
+            server.stop()
+        except OSError:
+            pass
+
+    report["traffic"] = counts
+    report["actions"] = [
+        {k: r.get(k) for k in ("action", "outcome", "id", "trigger",
+                               "alert_id", "addr", "reason", "error")}
+        for r in action_log]
+    report["controller"] = {"mode": controller.mode,
+                            "calm_streak": controller._calm_streak,
+                            "pending": len(controller._expected),
+                            **{k: v for k, v
+                               in controller.status().items()
+                               if k != "actions"}}
+    report["firing_at_end"] = engine.firing()
+    failed_total = sum(c.get("failed", 0) for c in counts.values())
+    shed_total = sum(c.get("shed", 0) for c in counts.values())
+    ok_total = sum(c.get("ok", 0) for c in counts.values())
+    report["failed_total"] = failed_total
+    report["shed_total"] = shed_total
+    report["ok_total"] = ok_total
+
+    events = events_lib.load_events(events_dir)
+    if budget_drill:
+        skipped = [r for r in action_log
+                   if r.get("outcome") == "skipped"
+                   and r.get("reason") == "budget_exhausted"]
+        latched = any(e.get("category") == "action"
+                      and e.get("name") == "mode"
+                      and str(e.get("detail", {}).get("mode", ""))
+                      .startswith("degraded")
+                      for e in events)
+        acted = [r for r in action_log
+                 if r.get("outcome") in ("effective", "failed",
+                                         "rolled_back")]
+        report["skipped_actions"] = len(skipped)
+        report["latched"] = latched
+        report["ok"] = bool(
+            controller.mode == "degraded (budget_exhausted)"
+            and latched and skipped and not acted
+            and failed_total == 0 and ok_total > 0)
+        if not report["ok"]:
+            report["why"] = {"mode": controller.mode,
+                             "latched": latched,
+                             "skipped": len(skipped),
+                             "acted": len(acted),
+                             "failed_total": failed_total}
+        return report
+
+    scale_out_ok = any(r["action"] == "scale_out"
+                       and r["outcome"] == "effective"
+                       for r in action_log)
+    scale_in_ok = any(r["action"] == "scale_in"
+                      and r["outcome"] == "effective"
+                      for r in action_log)
+    chain = _chain_ok(events)
+    report["chain"] = chain
+    shed_fired = any(e.get("category") == "alert"
+                     and e.get("name") == "fired"
+                     and e.get("detail", {}).get("rule") in
+                     ("shed_storm", "ttft_regression")
+                     for e in events)
+    report["ok"] = bool(
+        shed_fired and scale_out_ok and scale_in_ok
+        and chain["ok"] and failed_total == 0
+        and shed_total > 0 and ok_total > 0
+        and "serving" in report.get("console_snapshot", ""))
+    if not report["ok"]:
+        report["why"] = {"shed_fired": shed_fired,
+                         "scale_out": scale_out_ok,
+                         "scale_in": scale_in_ok,
+                         "chain": chain["ok"],
+                         "failed_total": failed_total,
+                         "shed_total": shed_total,
+                         "ok_total": ok_total}
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--budget-drill", action="store_true",
+                   help="run the budget-zero latch variant instead of "
+                        "the flash-crowd scale drill")
+    p.add_argument("--time-scale", type=float, default=1.0,
+                   help="stretch the traffic phases (slow machines)")
+    p.add_argument("--sanitize", action="store_true",
+                   help="run under the tsan-lite concurrency "
+                        "sanitizer (utils/syncdbg.py); replica "
+                        "subprocesses inherit PDTT_SANITIZE=1; any "
+                        "finding fails the drill")
+    args = p.parse_args(argv)
+    if args.sanitize:
+        os.environ["PDTT_SANITIZE"] = "1"
+    from pytorch_distributed_train_tpu.utils import syncdbg
+
+    syncdbg.maybe_activate()
+    report = run_drill(seed=args.seed, budget_drill=args.budget_drill,
+                       time_scale=args.time_scale)
+    if syncdbg.active():
+        syncdbg.check_teardown()
+        summary = syncdbg.findings_summary()
+        report["sanitizer_findings"] = summary
+        if summary:
+            for f in syncdbg.findings():
+                print(f"FAIL: sanitizer {f.kind}: {f.message}",
+                      file=sys.stderr)
+            report["ok"] = False
+    print(json.dumps(report))
+    return 0 if report.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,7 +155,8 @@ def _serving_cell(row: dict) -> str:
 def render_snapshot(snap: dict, alerts: list[dict],
                     last_events: dict | None = None,
                     history=None,
-                    slo_status: dict | None = None) -> str:
+                    slo_status: dict | None = None,
+                    controller_lines: list[str] | None = None) -> str:
     rows = snap["targets"]
     states = [r["state"] for r in rows]
     head = (f"== fleet console: {len(rows)} target(s) "
@@ -220,6 +221,8 @@ def render_snapshot(snap: dict, alerts: list[dict],
     else:
         lines.append("  alerts: none firing")
     lines.extend(slo_panel(slo_status or {}))
+    if controller_lines:
+        lines.extend(controller_lines)
     if last_events:
         lines.append("  last: " + "  ".join(
             f"{k}={v}" for k, v in last_events.items()))
@@ -227,6 +230,51 @@ def render_snapshot(snap: dict, alerts: list[dict],
 
 
 # ------------------------------------------------------------ journal bits
+def controller_panel(events: list[dict], last: int = 5) -> list[str]:
+    """Fleet-controller panel, replayed from the ``action`` journal
+    category (fleet/controller.py): current mode, budget latches, and
+    the last K actions with terminal outcomes. Empty when no
+    controller wrote to this journal — the panel only appears on
+    fleets that run the closed loop."""
+    acts = [e for e in events if e.get("category") == "action"]
+    if not acts:
+        return []
+    mode = "active"
+    terminal: dict[str, dict] = {}
+    order: list[str] = []
+    for e in acts:
+        d = e.get("detail") or {}
+        if e.get("name") == "mode":
+            mode = str(d.get("mode", mode))
+            continue
+        aid = d.get("id")
+        if not aid:
+            continue
+        if aid not in order:
+            order.append(aid)
+        if e.get("name") in ("effective", "failed", "rolled_back",
+                             "skipped"):
+            terminal[aid] = e
+    out = [f"  controller: mode={mode}  actions journaled="
+           f"{len(order)}"]
+    for aid in order[-last:]:
+        t = terminal.get(aid)
+        if t is None:
+            out.append(f"    {aid}: no terminal outcome journaled")
+            continue
+        d = t.get("detail") or {}
+        line = (f"    {d.get('action', '?'):<10} "
+                f"{t.get('name'):<12} trigger={d.get('trigger', '?')}")
+        if d.get("addr"):
+            line += f" addr={d.get('addr')}"
+        if d.get("alert_id"):
+            line += f" alert={d.get('alert_id')}"
+        if d.get("reason"):
+            line += f" reason={d.get('reason')}"
+        out.append(line)
+    return out
+
+
 def _last_events(events: list[dict]) -> dict:
     """The operator's first three questions, from the journal."""
     out = {}
@@ -283,6 +331,7 @@ def offline_report(run_dir: str, events_dir: str = "",
         d = e.get("detail") or {}
         lines.append(f"    UNRESOLVED {rule} on {host} "
                      f"value={d.get('value')} (gen {d.get('gen')})")
+    lines.extend(controller_panel(events))
     lines.append("  " + "  ".join(
         f"last {k}: {v}" for k, v in _last_events(events).items()))
     ledger_path = ledger_path or os.path.join(run_dir, "perf_ledger.jsonl")
@@ -579,13 +628,15 @@ def main(argv=None) -> int:
             while True:
                 snap = tick(collector, engine)
                 sys.stdout.write("\x1b[2J\x1b[H")  # clear, home
+                evs = (_events_for_console(args)
+                       if (args.run_dir or args.events) else [])
                 print(render_snapshot(snap, engine.firing(),
-                                      _last_events(
-                                          _events_for_console(args))
-                                      if (args.run_dir or args.events)
+                                      _last_events(evs) if evs
                                       else None,
                                       history=collector.history,
-                                      slo_status=_slo_status()))
+                                      slo_status=_slo_status(),
+                                      controller_lines=controller_panel(
+                                          evs)))
                 sys.stdout.flush()
                 time.sleep(collector.poll_s)
         else:
@@ -599,12 +650,14 @@ def main(argv=None) -> int:
                                       slo=_slo_status()),
                                  indent=2, sort_keys=True)
             else:
+                evs = (_events_for_console(args)
+                       if (args.run_dir or args.events) else [])
                 out = render_snapshot(
                     snap, engine.firing(),
-                    _last_events(_events_for_console(args))
-                    if (args.run_dir or args.events) else None,
+                    _last_events(evs) if evs else None,
                     history=collector.history,
-                    slo_status=_slo_status())
+                    slo_status=_slo_status(),
+                    controller_lines=controller_panel(evs))
             print(out)
     except KeyboardInterrupt:
         pass

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Closed-loop fleet controller daemon (fleet/controller.py).
+
+    # observe-only first: journal what WOULD happen
+    python tools/fleet_controller.py --store 127.0.0.1:7777 \
+        --events run/events --dry-run
+
+    # the real loop: scale serving replicas between 2 and 4, push
+    # router weights, cap actuation at 10 acts per 5 minutes
+    TPUSTORE_ADDR=127.0.0.1:7777 python tools/fleet_controller.py \
+        --min-replicas 2 --max-replicas 4 \
+        --router 127.0.0.1:8080 \
+        --launch-arg=--fake-backend --launch-arg=--slots=4
+
+Builds the same store-discovered collector + alert engine the fleet
+console runs, then closes the loop: sustained overload alerts scale
+decode replicas OUT (subprocess ``serve_http --advertise``), a calm
+fleet scales IN through ``/admin/drain`` with zero failed requests, a
+sick host is drain-and-recycled, and router dispatch weights track
+per-replica load (``POST /admin/weights`` on ``--router``). Safety
+rails — fleet bounds, hysteresis, per-action cooldowns, the windowed
+action budget with its ``degraded (budget_exhausted)`` latch, and
+``--dry-run`` — are documented in docs/autoscaler.md, along with the
+closed action catalog every decision is journaled against.
+
+Pure stdlib + the repo's obs/fleet packages; no jax — safe on a login
+host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# PDTT_SANITIZE=1: patch threading BEFORE the imports below create
+# their module-global locks (events/registry singletons)
+from pytorch_distributed_train_tpu.utils import syncdbg  # noqa: E402
+
+syncdbg.maybe_activate()
+
+from pytorch_distributed_train_tpu.fleet.controller import (  # noqa: E402
+    FleetController,
+    SubprocessReplicaLauncher,
+)
+from pytorch_distributed_train_tpu.obs import events as events_lib  # noqa: E402
+
+
+def make_weights_sink(router_addr: str, timeout_s: float = 3.0):
+    """The rebalance actuator: POST the weight map to serve_router's
+    ``/admin/weights``. Best-effort errors surface to the controller
+    as a failed action, which is exactly what they are."""
+
+    def sink(weights: dict) -> None:
+        req = urllib.request.Request(
+            f"http://{router_addr}/admin/weights",
+            data=json.dumps(weights).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=timeout_s).read()
+
+    return sink
+
+
+def build_controller(args, collector, engine) -> FleetController:
+    launcher = None
+    if not args.no_launch:
+        env = dict(os.environ)
+        if args.store:
+            env["TPUSTORE_ADDR"] = args.store
+        launcher = SubprocessReplicaLauncher(
+            serve_http_path=os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "serve_http.py"),
+            extra_args=tuple(args.launch_arg or ()), env=env)
+    sink = make_weights_sink(args.router) if args.router else None
+    cooldowns = {}
+    for spec in args.cooldown or ():
+        action, _, value = spec.partition("=")
+        cooldowns[action] = float(value)
+    return FleetController(
+        collector, engine, launcher=launcher, weights_sink=sink,
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        hysteresis=args.hysteresis, calm_ticks=args.calm_ticks,
+        cooldown_s=cooldowns,
+        budget_window_s=args.budget_window,
+        budget_max_actions=args.budget_actions,
+        verify_s=args.verify_timeout,
+        drain_timeout_s=args.drain_timeout,
+        dry_run=args.dry_run)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--store", default="",
+                   help="launcher store host:port (default: "
+                        "$TPUSTORE_ADDR) for endpoint discovery")
+    p.add_argument("--target", action="append", metavar="ROLE=HOST:PORT",
+                   help="static scrape target (repeatable)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="collector scrape + controller tick seconds")
+    p.add_argument("--stale-after", type=float, default=10.0)
+    p.add_argument("--timeout", type=float, default=2.0)
+    p.add_argument("--rule", action="append", metavar="RULE.FIELD=VALUE",
+                   help="alert-rule override (fleet_console syntax)")
+    p.add_argument("--history-dir", default="",
+                   help="durable tsdb dir (burn-rate rules evaluate "
+                        "when attached)")
+    p.add_argument("--history-budget-mb", type=float, default=64.0)
+    p.add_argument("--alert-file", default="")
+    p.add_argument("--alert-webhook", default="")
+    p.add_argument("--profile-on-alert", action="store_true",
+                   help="firing anomaly rules POST /profile on the "
+                        "offending target (fleet_console semantics)")
+    p.add_argument("--profile-cooldown", type=float, default=300.0)
+    p.add_argument("--events", default="",
+                   help="event-journal directory (default "
+                        "$PDTT_EVENTS_DIR) — the action journal")
+    p.add_argument("--router", default="",
+                   help="serve_router host:port for the rebalance "
+                        "weights hook (empty = rebalance off)")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--hysteresis", type=int, default=2,
+                   help="consecutive firing evaluations before acting")
+    p.add_argument("--calm-ticks", type=int, default=5,
+                   help="consecutive quiet evaluations before scale-in")
+    p.add_argument("--cooldown", action="append",
+                   metavar="ACTION=SECONDS",
+                   help="per-action cooldown override (repeatable)")
+    p.add_argument("--budget-window", type=float, default=300.0,
+                   help="action-budget rolling window seconds")
+    p.add_argument("--budget-actions", type=int, default=10,
+                   help="max actions per window; overflow latches "
+                        "degraded observe-only mode")
+    p.add_argument("--verify-timeout", type=float, default=15.0,
+                   help="seconds a launched replica has to answer "
+                        "/healthz before rollback")
+    p.add_argument("--drain-timeout", type=float, default=30.0)
+    p.add_argument("--launch-arg", action="append",
+                   help="extra serve_http arg for launched replicas "
+                        "(repeatable, e.g. --launch-arg=--fake-backend)")
+    p.add_argument("--no-launch", action="store_true",
+                   help="no launcher: scale_out/recycle-replace off")
+    p.add_argument("--dry-run", action="store_true",
+                   help="journal intended actions, act on nothing")
+    p.add_argument("--ticks", type=int, default=0,
+                   help="exit after N ticks (0 = run until ^C); the "
+                        "status JSON prints on exit")
+    p.add_argument("--list-actions", action="store_true",
+                   help="print the closed action catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_actions:
+        from pytorch_distributed_train_tpu.fleet.controller import (
+            ACTIONS,
+        )
+
+        for name, a in sorted(ACTIONS.items()):
+            print(f"{name:<10} triggers={','.join(a.triggers)}  "
+                  f"{a.description}")
+        return 0
+    if not (args.store or os.environ.get("TPUSTORE_ADDR")
+            or args.target):
+        print("fleet_controller: no targets (--store, $TPUSTORE_ADDR "
+              "or --target)", file=sys.stderr)
+        return 2
+    events_dir = args.events or os.environ.get(events_lib.ENV_VAR)
+    if events_dir:
+        events_lib.configure(events_dir, who="controller")
+    from tools.fleet_console import build
+
+    collector, engine = build(args)
+    controller = build_controller(args, collector, engine)
+    print(f"fleet_controller: mode={controller.mode} "
+          f"bounds=[{controller.min_replicas},"
+          f"{controller.max_replicas}] budget="
+          f"{controller.budget_max_actions}/"
+          f"{controller.budget_window_s:.0f}s", flush=True)
+    n = 0
+    try:
+        while True:
+            collector.poll()
+            engine.evaluate(collector)
+            for rec in controller.tick():
+                print(f"[fleet-controller] {rec['action']} -> "
+                      f"{rec['outcome']} ({rec.get('reason') or rec.get('addr') or ''})",
+                      flush=True)
+            n += 1
+            if args.ticks and n >= args.ticks:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if controller.launcher is not None:
+            controller.launcher.stop_all()
+    print(json.dumps(controller.status(), indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -362,6 +362,68 @@ def serving_section(events_dir: str,
     return out
 
 
+def controller_section(events_dir: str,
+                       events: list[dict] | None = None,
+                       last: int = 8) -> list[str]:
+    """Fleet-controller summary from the ``action`` journal category
+    (docs/autoscaler.md): per-(action, outcome) counts, any mode
+    latches, and the last K actions with their triggering alert and
+    latency from ``requested`` to the terminal outcome. Quiet when no
+    controller ran against this journal."""
+    if events is None:
+        events = _load_events(events_dir)
+    if events is None:
+        return []
+    acts = [e for e in events if e.get("category") == "action"]
+    if not acts:
+        return []
+    terminal_names = ("effective", "failed", "rolled_back", "skipped")
+    requested_ts: dict[str, float] = {}
+    terminal: dict[str, dict] = {}
+    order: list[str] = []
+    counts: dict[tuple, int] = {}
+    latches = []
+    for e in acts:
+        d = e.get("detail") or {}
+        aid = d.get("id")
+        if e.get("name") == "mode":
+            latches.append(d.get("mode"))
+            continue
+        if not aid:
+            continue
+        if e.get("name") == "requested":
+            requested_ts[aid] = e.get("ts", 0.0)
+            if aid not in order:
+                order.append(aid)
+        if e.get("name") in terminal_names:
+            terminal[aid] = e
+            key = (d.get("action", "?"), e.get("name"))
+            counts[key] = counts.get(key, 0) + 1
+    out = [f"controller actions ({len(order)}): "
+           + "  ".join(f"{a}/{o}={c}" for (a, o), c in sorted(
+               counts.items(), key=lambda kv: -kv[1]))]
+    if latches:
+        out.append(f"  mode transitions: {' -> '.join(str(m) for m in latches)}")
+    for aid in order[-last:]:
+        t = terminal.get(aid)
+        if t is None:
+            out.append(f"  {aid} requested, no terminal outcome "
+                       "journaled (in flight at journal end?)")
+            continue
+        d = t.get("detail") or {}
+        lat = t.get("ts", 0.0) - requested_ts.get(aid, t.get("ts", 0.0))
+        line = (f"  {d.get('action', '?'):<10} {t.get('name'):<12} "
+                f"+{lat:6.2f}s  trigger={d.get('trigger', '?')}")
+        if d.get("alert_id"):
+            line += f"  alert={d.get('alert_id')}"
+        if d.get("addr"):
+            line += f"  addr={d.get('addr')}"
+        if d.get("reason"):
+            line += f"  reason={d.get('reason')}"
+        out.append(line)
+    return out
+
+
 def traces_section(traces_dir: str, top: int = 5) -> list[str]:
     """Slowest retained distributed traces (obs/tracing.py): top-K by
     whole-request duration with the per-phase (queue / prefill / decode
@@ -488,6 +550,8 @@ def report(jsonl_path: str, trace_path: str = "",
             ("spans", lambda: spans_section(trace_path)),
             ("events", lambda: events_section(events_dir, events)),
             ("serving", lambda: serving_section(events_dir, events)),
+            ("controller actions",
+             lambda: controller_section(events_dir, events)),
             ("SLO budgets", lambda: slo_section(
                 history_dir or os.path.join(
                     os.path.dirname(jsonl_path), "tsdb"))),

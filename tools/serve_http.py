@@ -1644,6 +1644,7 @@ def main(argv=None) -> int:
     drain = GracefulDrain(server, service, grace_s=args.drain_grace)
     server.RequestHandlerClass = make_handler(service, drain)
     drain.install()
+    adv_store, adv_idx = None, -1
     if args.advertise:
         from pytorch_distributed_train_tpu.elastic import (
             publish_obs_endpoint,
@@ -1662,6 +1663,7 @@ def main(argv=None) -> int:
             addr = (f"{routable_host(args.host)}:"
                     f"{server.server_address[1]}")
             idx = publish_replica(store, addr)
+            adv_store, adv_idx = store, idx
             # ... and the same address into the obs-endpoint registry,
             # so the fleet collector scrapes this replica's /metrics +
             # /healthz without static config (docs/observability.md
@@ -1677,6 +1679,15 @@ def main(argv=None) -> int:
         pass
     finally:
         service.shutdown()  # idempotent: the drain path already did this
+        if adv_store is not None:
+            # clean exit (drain completed or ^C): tombstone the registry
+            # slot so discovery stops returning this address forever — a
+            # crash skips this, and the prober handles that stale entry
+            from pytorch_distributed_train_tpu.elastic import (
+                tombstone_replica,
+            )
+
+            tombstone_replica(adv_store, adv_idx)
     return 0
 
 

@@ -145,6 +145,23 @@ def make_handler(router: Router, prober: HealthProber):
             get_registry().counter(
                 "router_requests_total", labels={"path": path},
                 help="router requests by path").inc()
+            if path == "/admin/weights":
+                # fleet-controller rebalance hook: body is a flat
+                # {addr: weight} map applied to the routable set
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    weights = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._send(400, {"error": "bad json"})
+                    return
+                if not isinstance(weights, dict):
+                    self._send(400, {"error": "want {addr: weight}"})
+                    return
+                router.replicas.set_weights(weights)
+                self._send(200, {"status": "ok",
+                                 "replicas":
+                                     router.replicas.snapshot()})
+                return
             if path == "/admin/rolling_restart":
                 # walk replicas through their drain path off-thread; the
                 # report lands in the journal (serve/rolling_drain per

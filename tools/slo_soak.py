@@ -34,12 +34,15 @@ smoke runs this with small numbers; the slow-marked test soaks longer.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
 import tempfile
 import threading
 import time
+import urllib.error
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -65,6 +68,144 @@ from pytorch_distributed_train_tpu.serving_plane.testing import (  # noqa: E402
     FakeByteTok,
     FakeTokenBatcher,
 )
+
+
+# --------------------------------------------------- traffic scenarios
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One segment of a scenario schedule: a target request rate with
+    a request shape, held for a duration."""
+
+    name: str
+    duration_s: float
+    rps: float
+    max_tokens: int = 6
+    prompt_chars: int = 24
+    tenants: int = 1
+
+
+def scenario_schedule(name: str, seed: int = 0,
+                      time_scale: float = 1.0,
+                      rps_scale: float = 1.0) -> list[Phase]:
+    """The seeded phase schedule for a named traffic SHAPE — the
+    controller/admission planes are proven against shapes, not just
+    rates. Deterministic for a (name, seed) pair; ``time_scale`` /
+    ``rps_scale`` stretch it to the harness at hand (a drill runs the
+    same shape in seconds that production sees over hours)."""
+    rng = np.random.default_rng(seed)
+
+    def ph(pname, dur, rps, **kw):
+        return Phase(pname, dur * time_scale, rps * rps_scale, **kw)
+
+    if name == "diurnal":
+        base = 3.0 + float(rng.uniform(0.0, 1.0))
+        steps = (0.4, 0.8, 1.3, 1.7, 1.2, 0.5)
+        return [ph(f"hour{i}", 2.0,
+                   base * f * float(rng.uniform(0.9, 1.1)))
+                for i, f in enumerate(steps)]
+    if name == "flash_crowd":
+        calm = 2.0 + float(rng.uniform(0.0, 0.5))
+        return [ph("calm", 3.0, calm),
+                ph("spike", 4.0, calm * 10.0),
+                ph("recovery", 6.0, calm * 0.8)]
+    if name == "long_prompt_storm":
+        calm = 3.0 + float(rng.uniform(0.0, 0.5))
+        return [ph("normal", 2.5, calm),
+                ph("storm", 4.0, calm * 1.5,
+                   prompt_chars=int(rng.integers(2000, 4000)),
+                   max_tokens=12),
+                ph("normal2", 2.5, calm)]
+    if name == "mixed_tenant":
+        base = 4.0 + float(rng.uniform(0.0, 1.0))
+        return [ph("warm", 2.0, base * 0.6, tenants=2),
+                ph("contend", 4.0, base * 1.4, tenants=4),
+                ph("tail", 2.0, base * 0.8, tenants=4)]
+    raise SystemExit(f"unknown scenario {name!r} (want diurnal | "
+                     f"flash_crowd | long_prompt_storm | mixed_tenant)")
+
+
+def drive_phase(url: str, phase: Phase, seed: int,
+                timeout_s: float = 30.0, stop=None) -> dict:
+    """Run one phase's seeded request stream against ``url``
+    (a ``/v1/completions`` endpoint). Outcome accounting separates
+    honest degradation (429 shed, 504 deadline) from real failures
+    (transport errors, 5xx) — the zero-failed-requests assertions key
+    off ``failed`` alone."""
+    rng = np.random.default_rng(seed)
+    n = max(1, int(phase.rps * phase.duration_s))
+    gap = phase.duration_s / n
+    results = {"ok": 0, "shed": 0, "deadline": 0, "failed": 0}
+    lock = threading.Lock()
+    sem = threading.Semaphore(64)
+
+    def one(i: int) -> None:
+        body = json.dumps(
+            {"prompt": f"{phase.name} tenant{i % phase.tenants} "
+                       f"req {i} " + "x" * phase.prompt_chars,
+             "max_tokens": phase.max_tokens}).encode()
+        status = -1
+        with sem:
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req,
+                                            timeout=timeout_s) as r:
+                    status = r.status
+                    r.read()
+            except urllib.error.HTTPError as e:
+                status = e.code
+            except OSError:
+                status = -1
+        with lock:
+            if status == 200:
+                results["ok"] += 1
+            elif status == 429:
+                results["shed"] += 1
+            elif status == 504:
+                results["deadline"] += 1
+            else:
+                results["failed"] += 1
+
+    threads = []
+    t0 = time.monotonic()
+    for i in range(n):
+        th = threading.Thread(target=one, args=(i,), daemon=True,
+                              name=f"scenario-{phase.name}-{i}")
+        th.start()
+        threads.append(th)
+        if stop is not None and stop.is_set():
+            break
+        time.sleep(max(0.0, gap * float(rng.uniform(0.5, 1.5))))
+    for th in threads:
+        th.join(timeout=timeout_s + 5.0)
+    with lock:
+        out = dict(results)
+    out["phase"] = phase.name
+    out["requests"] = sum(results.values())
+    out["rps_target"] = round(phase.rps, 2)
+    out["wall_s"] = round(time.monotonic() - t0, 2)
+    return out
+
+
+def run_scenario(args) -> dict:
+    """Scenario mode: drive the named shape at ``--target`` (a router
+    or replica ``host:port``) and report per-phase outcomes."""
+    url = args.target
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    url = url.rstrip("/") + "/v1/completions"
+    phases = scenario_schedule(args.scenario, seed=args.seed,
+                               time_scale=args.scenario_time_scale,
+                               rps_scale=args.scenario_rps_scale)
+    out = []
+    for i, phase in enumerate(phases):
+        out.append(drive_phase(url, phase,
+                               seed=args.seed * 1000 + i))
+    return {"scenario": args.scenario, "seed": args.seed,
+            "target": args.target, "phases": out,
+            "failed_total": sum(p["failed"] for p in out),
+            "shed_total": sum(p["shed"] for p in out)}
 
 
 def run_soak(args) -> dict:
@@ -486,7 +627,33 @@ def main(argv=None) -> int:
     p.add_argument("--budget-store-dir", default="",
                    help="SLO budget phase: tsdb root (default: fresh "
                         "temp dir)")
+    p.add_argument("--scenario", default="",
+                   choices=["", "diurnal", "flash_crowd",
+                            "long_prompt_storm", "mixed_tenant"],
+                   help="scenario mode: drive this seeded traffic "
+                        "shape at --target instead of the in-process "
+                        "soak")
+    p.add_argument("--target", default="",
+                   help="scenario mode: router/replica host:port")
+    p.add_argument("--scenario-time-scale", type=float, default=1.0,
+                   help="scenario mode: phase-duration multiplier")
+    p.add_argument("--scenario-rps-scale", type=float, default=1.0,
+                   help="scenario mode: request-rate multiplier")
     args = p.parse_args(argv)
+
+    if args.scenario:
+        if not args.target:
+            print("slo_soak: --scenario needs --target",
+                  file=sys.stderr)
+            return 2
+        report = run_scenario(args)
+        print("== slo_soak scenario report ==")
+        print(json.dumps(report, indent=2, sort_keys=True))
+        if report["failed_total"] != 0:
+            print(f"FAIL: {report['failed_total']} hard-failed "
+                  f"request(s)", file=sys.stderr)
+            return 1
+        return 0
 
     report = run_soak(args)
     if args.hedge_requests > 0:

@@ -55,6 +55,7 @@ _MARKS = {
     "serve": "SERVE",
     "perf": "PERF",
     "alert": "ALERT",
+    "action": "ACTION",
     "lifecycle": "",
     "ckpt": "",
 }
@@ -92,6 +93,14 @@ _LANDMARKS = _RECOVERIES | {
     # resolving is exactly the run-shape news the timeline exists for
     ("alert", "fired"),
     ("alert", "resolved"),
+    # fleet-controller actuation (fleet/controller.py): what the
+    # closed loop DID about an incident — and its latch transitions —
+    # must survive eliding alongside the alerts that triggered it
+    ("action", "requested"),
+    ("action", "effective"),
+    ("action", "failed"),
+    ("action", "rolled_back"),
+    ("action", "mode"),
 }
 
 
@@ -230,6 +239,60 @@ def alert_chains(events: list[dict]) -> list[str]:
             line += f" -> resolved after {rd.get('after_s')}s"
         else:
             line += " -> still firing at journal end"
+        out.append(line)
+    return out
+
+
+def action_chains(events: list[dict]) -> list[str]:
+    """The closed-loop story: each journaled controller action grouped
+    by its durable action id (``act-<action>-...``), shown as
+    ``alert fired → action requested → terminal outcome → alert
+    resolved`` when the action carries a triggering incident id — the
+    what-the-controller-DID companion to ``alert_chains``. Quiet when
+    no ``action`` events are journaled."""
+    by_id: dict[str, dict] = {}
+    order: list[str] = []
+    for e in events:
+        if e.get("category") != "action":
+            continue
+        d = e.get("detail") or {}
+        aid = d.get("id")
+        if not aid:
+            continue  # mode latches render via the timeline landmarks
+        slot = by_id.setdefault(aid, {"events": [], "detail": d})
+        if aid not in order:
+            order.append(aid)
+        slot["events"].append(e)
+        slot["detail"] = {**slot["detail"], **d}
+    if not by_id:
+        return []
+    resolved_by_id = {
+        (e.get("detail") or {}).get("id"): e for e in events
+        if e.get("category") == "alert" and e.get("name") == "resolved"}
+    out = [f"action chains ({len(by_id)}):"]
+    for aid in order:
+        slot = by_id[aid]
+        d = slot["detail"]
+        names = [e.get("name") for e in slot["events"]]
+        terminal = next(
+            (n for n in reversed(names)
+             if n in ("effective", "failed", "rolled_back", "skipped")),
+            names[-1] if names else "?")
+        trigger = d.get("trigger", "?")
+        alert_id = d.get("alert_id")
+        line = f"  {d.get('action', '?')} [{aid}]"
+        if alert_id:
+            line += f" <- alert {alert_id}"
+        else:
+            line += f" <- {trigger}"
+        line += f" -> {' -> '.join(names)}"
+        if terminal == "failed" and d.get("error"):
+            line += f" ({str(d.get('error'))[:48]})"
+        if terminal == "skipped" and d.get("reason"):
+            line += f" ({d.get('reason')})"
+        if alert_id and alert_id in resolved_by_id:
+            rd = resolved_by_id[alert_id].get("detail") or {}
+            line += f" -> alert resolved after {rd.get('after_s')}s"
         out.append(line)
     return out
 
@@ -440,7 +503,7 @@ def report(events_dir: str, jsonl_path: str = "",
     lines = [f"== run timeline: {events_dir} =="]
     for section in (counts_section(events), goodput_line(jsonl_path),
                     timeline_lines(events), causal_chains(events),
-                    alert_chains(events)):
+                    alert_chains(events), action_chains(events)):
         if not section:
             continue
         lines.append("")
