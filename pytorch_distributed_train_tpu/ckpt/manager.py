@@ -117,12 +117,19 @@ class TieredCheckpointManager:
 
     # ---------------------------------------------------------------- store
     def _get_store(self):
+        """The launcher store the peer tier publishes/fetches through,
+        wrapped in store_plane.ResilientStore: every peer-tier store op
+        gets a bounded timeout + retry, and during an outage the tier
+        fails CLOSED in bounded time — restore falls through to the
+        next tier (Orbax) instead of wedging a rewind behind a dead
+        socket. None when the run has no launcher store."""
         if not self._store_resolved:
             self._store_resolved = True
             try:
-                from pytorch_distributed_train_tpu.elastic import worker_store
+                from pytorch_distributed_train_tpu import store_plane
 
-                self._store = worker_store()
+                self._store = store_plane.resilient_worker_store(
+                    name="ckpt-peer")
             except Exception:
                 self._store = None
         return self._store

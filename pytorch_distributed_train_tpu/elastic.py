@@ -226,11 +226,20 @@ def tombstone_replica(store, idx: int) -> bool:
         return False
 
 
-def discover_replicas(store) -> list[str]:
+def discover_replicas(store, strict: bool = False) -> list[str]:
     """Every address ever advertised and not tombstoned (order =
     registration order; the prober, not this list, decides liveness of
     what remains). Empty when nothing registered or the store is
-    unreachable."""
+    unreachable.
+
+    A MISSING index — a publisher that crashed between ``add(COUNT)``
+    and ``set(key_<idx>)`` left a counter-covered hole — is skippable
+    forever, exactly like a corrupt record: the key-absent TimeoutError
+    is an ANSWER from a healthy store. Transport failures are not:
+    under ``strict=True`` they re-raise (OSError) instead of degrading
+    into a silently-partial/empty list, so a resilient caller
+    (store_plane.ResilientStore.cached) can tell "registry is empty"
+    from "store is down" and serve its last-known-good answer."""
     if store is None:
         return []
     try:
@@ -238,14 +247,20 @@ def discover_replicas(store) -> list[str]:
         # zero-delta add reads it back — and creates 0 when absent
         n = int(store.add(SERVE_REPLICA_COUNT_KEY, 0))
     except Exception:
+        if strict:
+            raise
         return []
     out: list[str] = []
     for i in range(n):
         try:
             raw = store.get(f"{SERVE_REPLICA_KEY_PREFIX}{i}",
                             timeout_ms=200)
+        except TimeoutError:
+            continue  # partial-publish hole: skippable, forever
         except Exception:
-            continue  # claimed index whose set never landed
+            if strict:
+                raise
+            continue  # transport trouble: legacy best-effort skip
         if raw == SERVE_REPLICA_TOMBSTONE:
             continue  # cleanly exited: not a discovery candidate
         out.append(raw.decode())
@@ -292,23 +307,38 @@ def publish_obs_endpoint(store, role: str, addr: str,
     return idx
 
 
-def discover_obs_endpoints(store) -> list[dict]:
+def discover_obs_endpoints(store, strict: bool = False) -> list[dict]:
     """Every endpoint record ever published (registration order), each
     carrying its registry ``idx``. Corrupt/unlanded records are skipped;
-    empty when nothing registered or the store is unreachable."""
+    empty when nothing registered or the store is unreachable.
+
+    Same hole/strict contract as :func:`discover_replicas`: a missing
+    index (publisher crashed between the counter add and the record
+    set) is a skippable hole; under ``strict=True`` transport failures
+    re-raise instead of truncating the registry."""
     if store is None:
         return []
     try:
         n = int(store.add(OBS_ENDPOINT_COUNT_KEY, 0))
     except Exception:
+        if strict:
+            raise
         return []
     out: list[dict] = []
     for i in range(n):
         try:
-            rec = json.loads(store.get(
-                f"{OBS_ENDPOINT_KEY_PREFIX}{i}", timeout_ms=200).decode())
+            raw = store.get(f"{OBS_ENDPOINT_KEY_PREFIX}{i}",
+                            timeout_ms=200)
+        except TimeoutError:
+            continue  # partial-publish hole: skippable, forever
         except Exception:
-            continue  # claimed index whose set never landed
+            if strict:
+                raise
+            continue  # transport trouble: legacy best-effort skip
+        try:
+            rec = json.loads(raw.decode())
+        except ValueError:
+            continue  # corrupt record: skippable like a hole
         if not isinstance(rec, dict) or "addr" not in rec:
             continue
         rec["idx"] = i

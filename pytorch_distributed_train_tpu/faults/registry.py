@@ -25,6 +25,12 @@ Schedule grammar (one spec per entry)::
                   must recover; -1 = every generation)
           rc    — exit code for step.crash (default 41)
           delay — straggle sleep seconds for step.straggle (default 2.0)
+          for   — OUTAGE WINDOW seconds: once the spec's trigger first
+                  matches, the point fires on EVERY traversal for this
+                  many wall-seconds (monotonic), then exhausts; count=
+                  is ignored. ``store.get@call=1:for=6`` is a 6-second
+                  store-read blackout — the store-resilience drills'
+                  primitive (docs/fault_tolerance.md)
 
 What firing MEANS is a property of the point, not the spec: I/O-shaped
 points raise ``InjectedFault`` (an OSError, so the retry policies treat
@@ -88,6 +94,19 @@ POINTS: dict[str, str] = {
                                  # before touching the fleet, so the
                                  # failed/rolled_back journaling and the
                                  # action budget are drillable
+    # Store-resilience drill points (store_plane.py ResilientStore;
+    # docs/fault_tolerance.md degraded-mode matrix). Traversed INSIDE
+    # the bounded op path, so an injected outage exercises exactly the
+    # deadline/retry/LKG machinery a real one would. Combine with for=
+    # for blackout windows, and set PDTT_FAULTS on a single host for a
+    # per-host partition.
+    "store.get": "raise",        # launcher-store read (get/wait/numkeys)
+    "store.set": "raise",        # launcher-store write (set/delete)
+    "store.add": "raise",        # launcher-store counter add
+    "store.latency": "sleep",    # injected latency before every store
+                                 # op (latency storm: ops hit their
+                                 # ResilientStore deadline instead of
+                                 # stalling the caller)
 }
 
 
@@ -106,9 +125,12 @@ class FaultSpec:
     gen: int = 0
     rc: int = 41
     delay_s: float = 2.0
+    for_s: float = 0.0
     # mutable bookkeeping
     fired: int = 0
     calls: int = 0
+    window_start: float | None = None  # monotonic; for= window open mark
+    window_done: bool = False
 
     def spec_str(self) -> str:
         parts = []
@@ -118,12 +140,15 @@ class FaultSpec:
             parts.append(f"call={self.at_call}")
         if self.p:
             parts.append(f"p={self.p}")
-        parts.append(f"count={self.count}")
+        if self.for_s > 0.0:
+            parts.append(f"for={self.for_s}")
+        else:
+            parts.append(f"count={self.count}")
         return f"{self.point}@" + ":".join(parts)
 
 
 _INT_KEYS = {"step", "call", "count", "gen", "rc"}
-_FLOAT_KEYS = {"p", "delay"}
+_FLOAT_KEYS = {"p", "delay", "for"}
 
 
 def parse_spec(spec: str) -> FaultSpec:
@@ -177,6 +202,8 @@ def parse_spec(spec: str) -> FaultSpec:
             out.rc = val
         elif k == "delay":
             out.delay_s = val
+        elif k == "for":
+            out.for_s = val
     if out.step is None and out.at_call is None and out.p <= 0.0:
         raise ValueError(
             f"fault spec {spec!r}: needs at least one trigger "
@@ -228,7 +255,20 @@ class FaultSchedule:
                 if spec.gen >= 0 and gen != str(spec.gen):
                     continue
                 spec.calls += 1
-                if spec.fired >= spec.count:
+                if spec.for_s > 0.0:
+                    # Outage-window semantics: fire on EVERY traversal
+                    # from first trigger match until for_s monotonic
+                    # seconds elapse, then exhaust (count= is ignored).
+                    if spec.window_done:
+                        continue
+                    if spec.window_start is not None:
+                        if (time.monotonic() - spec.window_start
+                                < spec.for_s):
+                            spec.fired += 1
+                            return spec
+                        spec.window_done = True
+                        continue
+                elif spec.fired >= spec.count:
                     continue
                 if spec.step is not None and (
                         cur_step is None or cur_step < spec.step):
@@ -237,6 +277,8 @@ class FaultSchedule:
                     continue
                 if spec.p > 0.0 and not (self._rng.random() < spec.p):
                     continue
+                if spec.for_s > 0.0:
+                    spec.window_start = time.monotonic()
                 spec.fired += 1
                 return spec
         return None

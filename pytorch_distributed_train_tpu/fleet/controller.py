@@ -34,6 +34,13 @@ Safety rails are the point, not an afterthought:
   journaled ``degraded (budget_exhausted)`` observe-only mode (a
   controller in a tight act loop is itself the incident) until an
   operator calls :meth:`FleetController.reset_budget`;
+- **store hold** — while the launcher-store health machine
+  (store_plane, via ``collector.store_health()``) reports
+  degraded/down, the controller holds a ``degraded (store)``
+  observe-only mode: its fleet view rides registries the dead store
+  can't refresh, so acting on it risks draining healthy replicas it
+  merely can't see. Auto-clears on recovery (unlike the budget
+  latch); every suppressed decision journals requested → skipped;
 - **dry run** — journals every intended action, acts on nothing.
 
 Every decision is journaled under the closed ``action`` event
@@ -133,7 +140,8 @@ ACTIONS: dict[str, ActionSpec] = {a.name: a for a in (
 
 # controller_mode gauge encoding
 _MODE_VALUES = {"active": 0.0, "dry_run": 1.0,
-                "degraded (budget_exhausted)": 2.0}
+                "degraded (budget_exhausted)": 2.0,
+                "degraded (store)": 3.0}
 
 
 class ReplicaLauncher:
@@ -302,7 +310,8 @@ class FleetController:
         reg = get_registry()
         reg.gauge("controller_mode",
                   help="fleet-controller mode (0=active, 1=dry_run, "
-                       "2=degraded budget_exhausted)").set(
+                       "2=degraded budget_exhausted, "
+                       "3=degraded store)").set(
             _MODE_VALUES.get(self.mode, 2.0))
         if target is not None:
             reg.gauge("fleet_target_replicas",
@@ -353,6 +362,37 @@ class FleetController:
               f"{self.budget_window_s:.0f}s): latched into "
               f"OBSERVE-ONLY degraded mode — reset_budget() to "
               f"re-arm", flush=True)
+
+    def _update_store_hold(self) -> None:
+        """The store-resilience contract: while the launcher-store
+        health machine (store_plane, read through the collector) is
+        degraded/down, the controller holds OBSERVE-ONLY — its view of
+        the fleet rides discovery registries the dead store can no
+        longer refresh, so actuating on it risks draining healthy
+        replicas it merely can't see. Unlike the budget latch this
+        hold clears ITSELF on recovery: the store coming back is the
+        all-clear, no operator in the loop."""
+        try:
+            snap = self.collector.store_health()
+        except Exception:
+            return
+        degraded = (isinstance(snap, dict) and snap.get("ops_total")
+                    and snap.get("state") != "ok")
+        if degraded and self.mode == "active":
+            self.mode = "degraded (store)"
+            self._emit_gauges()
+            events_lib.emit("action", "mode", mode=self.mode,
+                            store_state=snap.get("state"))
+            print("[fleet-controller] launcher store "
+                  f"{snap.get('state')}: holding OBSERVE-ONLY until "
+                  "it recovers", flush=True)
+        elif not degraded and self.mode == "degraded (store)":
+            self.mode = "active"
+            self._emit_gauges()
+            events_lib.emit("action", "mode", mode=self.mode,
+                            reason="store_recovered")
+            print("[fleet-controller] launcher store recovered: "
+                  "re-armed", flush=True)
 
     def reset_budget(self) -> None:
         """Operator re-arm after a ``budget_exhausted`` latch."""
@@ -548,6 +588,15 @@ class FleetController:
         cooldown suppressed the act."""
         if not self._cooled(action, now):
             return None
+        if self.mode == "degraded (store)":
+            # observe-only while the control plane is blind: the
+            # decision is journaled (requested → skipped) so the
+            # timeline shows what the controller WOULD have done
+            rec = self._skip(action, "store_degraded", trigger,
+                             alert, **detail)
+            with self._lock:
+                self._last_act_mono[action] = now
+            return rec
         if self.mode == "degraded (budget_exhausted)" \
                 or not self._budget_ok(now):
             if self.mode != "dry_run":
@@ -564,6 +613,7 @@ class FleetController:
         """One reconcile pass. Returns the terminal action records it
         produced (empty on a quiet tick)."""
         now = time.monotonic()
+        self._update_store_hold()
         rows = self.collector.serving_rows()
         with self._lock:
             self._drained = {a: d for a, d in self._drained.items()

@@ -198,8 +198,30 @@ RULES: dict[str, AlertRule] = {r.name: r for r in (
         above=3, for_s=600.0,
         description="3+ restart generations registered within the "
                     "window — a crash loop, fleet-visible"),
+    AlertRule(
+        name="store_degraded", kind="threshold", roles=("store",),
+        series="store_health_state", above=0.5,
+        description="the launcher-store health machine left ok "
+                    "(degraded/down) — control-plane outage, not a "
+                    "fleet problem; fleet_stale is suppressed while "
+                    "this fires so a store blackout never masquerades "
+                    "as dead hosts"),
     *_burn_rules(),
 )}
+
+
+class _StoreTarget:
+    """The synthetic target the ``store_degraded`` rule fires against:
+    there is exactly one launcher store per fleet, and it is not a
+    scrape endpoint — its 'series' is the store_plane health machine
+    read through ``collector.store_health()``."""
+
+    host = "launcher"
+    role = "store"
+    gen = "-"
+
+
+_STORE_TARGET = _StoreTarget()
 
 
 class _RuleState:
@@ -271,6 +293,7 @@ class AlertEngine:
         self._opener = opener or urllib.request.urlopen
         self._states: dict[tuple[str, str, str], _RuleState] = {}
         self._gen_seen: dict[tuple[str, str], dict[str, float]] = {}
+        self._store_suppress = False  # set each tick by _eval_store
         self._last_profile_mono: float | None = None
         # action-sink hook (fleet/controller.py): every transition
         # record is pushed to subscribers as it happens, so a
@@ -423,7 +446,7 @@ class AlertEngine:
         stale_after = (self.stale_after_s
                        if self.stale_after_s is not None
                        else collector.stale_after_s)
-        transitions: list[dict] = []
+        transitions: list[dict] = list(self._eval_store(collector, now))
         for target in collector.targets:
             for rule in self.rules.values():
                 if target.role not in rule.roles:
@@ -467,6 +490,41 @@ class AlertEngine:
                 pass  # accounting must never take the engine down
         return transitions
 
+    def _eval_store(self, collector, now: float) -> list[dict]:
+        """Evaluate ``store_degraded`` against the store_plane health
+        machine (via ``collector.store_health()``) on the synthetic
+        launcher/store target. Inert until some consumer has actually
+        run store ops (``ops_total`` 0 = store-less deployment, not a
+        healthy store). Side effect: latches ``_store_suppress`` so
+        the same tick's ``fleet_stale`` evaluations are held — ALL
+        hosts going quiet at once because the CONTROL plane died is a
+        store outage, not a fleet of dead hosts."""
+        rule = self.rules.get("store_degraded")
+        self._store_suppress = False
+        if rule is None:
+            return []
+        try:
+            snap = collector.store_health()
+        except Exception:
+            return []
+        if not isinstance(snap, dict) or not snap.get("ops_total"):
+            return []
+        value = {"ok": 0.0, "degraded": 1.0,
+                 "down": 2.0}.get(snap.get("state"), 0.0)
+        cond = value > (rule.above or 0.5)
+        self._store_suppress = cond
+        st = self._state(rule, _STORE_TARGET)
+        if cond and not st.firing:
+            if (st.last_fire_mono is not None
+                    and now - st.last_fire_mono < rule.cooldown_s):
+                return []
+            return [self._transition(rule, _STORE_TARGET, st, True,
+                                     now, value, rule.above)]
+        if not cond and st.firing:
+            return [self._transition(rule, _STORE_TARGET, st, False,
+                                     now, value, rule.above)]
+        return []
+
     def _condition(self, rule: AlertRule, target, now: float,
                    stale_after: float):
         """(cond, value, baseline) for the non-anomaly kinds; cond None
@@ -474,6 +532,13 @@ class AlertEngine:
         if rule.kind == "absence" and rule.name == "fleet_stale":
             if target.last_ok_mono is None:
                 return None, None, None  # never scraped: not blamable
+            if getattr(self, "_store_suppress", False):
+                # store outage in progress: staleness evidence is
+                # untrustworthy (the store IS the discovery plane and
+                # the outage often stalls the whole control loop) —
+                # hold fleet_stale in place, neither firing nor
+                # resolving, until the store recovers
+                return None, None, None
             age = now - target.last_ok_mono
             return age > stale_after, age, stale_after
         if rule.kind == "absence":  # trainer_step_stalled

@@ -417,6 +417,13 @@ class FleetCollector:
         self._targets: dict[tuple[str, str], Target] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # persistent resilient store handle for discovery (store_plane):
+        # built on first use, kept across passes (it reconnects
+        # internally); None until then, or forever when no store is
+        # configured — a store-LESS console must not feed the health
+        # machine phantom failures
+        self._store = None
+        self._store_absent = False
         for i, ep in enumerate(endpoints):
             ep = dict(ep)
             ep.setdefault("idx", i)
@@ -433,30 +440,59 @@ class FleetCollector:
             else:
                 t.note_endpoint(ep)
 
+    def _store_handle(self):
+        """Lazily build the persistent ResilientStore used for
+        discovery. Returns None when no store is configured (factory
+        yields None) — that's a static-endpoints console, and it must
+        never feed the store-health machine phantom failures. A factory
+        that RAISES means a store exists but is unreachable: build the
+        wrapper anyway so the outage is seen, retried and scored."""
+        if self._store is not None or self._store_absent:
+            return self._store
+        try:
+            probe = self._factory()
+        except Exception:
+            probe = False  # configured-but-down: still wrap
+        if probe is None:
+            self._store_absent = True
+            return None
+        if probe is not False:
+            try:
+                probe.close()
+            except Exception:
+                pass
+        from pytorch_distributed_train_tpu import store_plane
+
+        self._store = store_plane.ResilientStore(
+            self._factory, op_timeout_s=self.timeout_s,
+            name="fleet-collector")
+        return self._store
+
     def discover(self) -> int:
         """Merge the store's endpoint registry into the target set;
         returns the number of known targets. Store unreachable = keep
-        what we have (the fleet does not vanish with a store hiccup)."""
-        store = None
-        try:
-            store = self._factory()
-            if store is not None:
-                from pytorch_distributed_train_tpu.elastic import (
-                    discover_obs_endpoints,
-                )
-
-                for ep in discover_obs_endpoints(store):
+        what we have (the fleet does not vanish with a store hiccup):
+        the ResilientStore's last-known-good cache keeps serving the
+        previous registry through an outage, and with no cache yet the
+        OSError is swallowed and the static target set stands."""
+        rs = self._store_handle()
+        if rs is not None:
+            try:
+                for ep in rs.discover_obs_endpoints():
                     self._note_endpoint(ep)
-        except Exception:
-            pass
-        finally:
-            if store is not None:
-                try:
-                    store.close()
-                except Exception:
-                    pass
+            except Exception:
+                pass
         with self._lock:
             return len(self._targets)
+
+    def store_health(self) -> dict:
+        """Snapshot of the launcher-store health machine (store_plane)
+        for the console/alert engine: state, op p95, LKG cache ages.
+        Meaningful only once some consumer has run store ops (ops_total
+        > 0); store-less deployments read an inert all-zero 'ok'."""
+        from pytorch_distributed_train_tpu import store_plane
+
+        return store_plane.health_snapshot()
 
     @property
     def targets(self) -> list[Target]:
@@ -550,6 +586,12 @@ class FleetCollector:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:
+                pass
+            self._store = None
 
     # ------------------------------------------------------------ snapshot
     def snapshot(self) -> dict:

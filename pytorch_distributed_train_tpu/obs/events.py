@@ -78,6 +78,10 @@ CATEGORIES: dict[str, str] = {
     "sanitizer": "runtime concurrency-sanitizer findings: lock-order "
                  "inversions, hold-while-blocking, unjoined threads, "
                  "deadlock watchdog trips (utils/syncdbg.py)",
+    "store": "launcher-store resilience plane: health transitions "
+             "(degraded/down/recovered), liveness blame suspensions "
+             "during store outages (store_plane.py, "
+             "sentinel/liveness.py)",
 }
 
 

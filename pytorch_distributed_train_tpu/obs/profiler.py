@@ -207,15 +207,33 @@ class ManagedProfiler:
 
     # -------------------------------------------------------------- store
     def _open_store(self):
+        """A ResilientStore over the configured factory (store_plane:
+        bounded ops + retry + health scoring), or None when no store
+        is configured — a store-less run must not feed the health
+        machine phantom failures from a 5 Hz watcher. The probe client
+        is handed to the wrapper as its first connection, not closed
+        and re-dialed."""
         factory = self._factory
         if factory is None:
             from pytorch_distributed_train_tpu.elastic import worker_store
 
             factory = worker_store
         try:
-            return factory()
+            probe = factory()
         except Exception:
             return None
+        if probe is None:
+            return None
+        first = [probe]
+
+        def _fac():
+            if first:
+                return first.pop()
+            return factory()
+
+        from pytorch_distributed_train_tpu import store_plane
+
+        return store_plane.ResilientStore(_fac, name="profiler")
 
     def _watch(self, store) -> None:
         """Poll the launcher store for coordinated capture requests —
@@ -225,7 +243,10 @@ class ManagedProfiler:
                 try:
                     raw = store.get(REQUEST_KEY, timeout_ms=1)
                 except TimeoutError:
-                    continue
+                    continue  # no request published yet
+                except OSError:
+                    continue  # store degraded: ResilientStore scored
+                    # it; keep watching — the outage ends, we resume
                 try:
                     req = CaptureRequest.from_json(raw.decode())
                 except (ValueError, TypeError, KeyError):

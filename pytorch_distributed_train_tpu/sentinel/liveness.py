@@ -27,6 +27,29 @@ to the local heartbeat / scheduler timeout). Identity comes from the
 launcher env contract (``PROCESS_ID`` / ``NUM_PROCESSES`` /
 ``RESTART_GENERATION``), not jax.distributed, so the plane works in any
 process tpurun spawns — including single-device workers in tests.
+
+Store-resilience contracts (store_plane.py; docs/fault_tolerance.md
+degraded-mode matrix):
+
+- **Heartbeat publishes are time-bounded.** ``beat()`` deposits into a
+  latest-wins slot drained by a background publisher thread and waits
+  at most ``beat_timeout_s`` — a slow store can never stall the step
+  loop. Beats that were superseded unsent, timed out, or failed are
+  COUNTED (``store_beats_dropped_total{reason=}``), never blocking.
+- **Blame is suspended during store outages.** A blackout makes every
+  heartbeat look stale at once; dumping and restarting a healthy gang
+  for that is the false-blame this plane exists to prevent. The
+  monitor suspends blame while (a) the process-global store health is
+  not ok, or (b) EVERY host it has ever seen heartbeat (two or more)
+  is stale simultaneously — one host can hang alone, the whole gang
+  going silent together is the store's signature. During suspension
+  staleness clocks are re-baselined, so recovery re-arms blame with a
+  full ``hang_timeout_s`` window (a genuinely hung host is re-detected
+  after the outage, bounded-late, instead of insta-blamed). A gang
+  TRULY deadlocked on every host falls to each host's local watchdog.
+- **The watcher and monitor survive outages.** Store errors skip the
+  iteration instead of killing the thread; the plane goes degraded,
+  not dark.
 """
 
 from __future__ import annotations
@@ -57,16 +80,21 @@ class LivenessPlane:
                  exit_code: int = 43, every_steps: int = 1,
                  recorder=None, spans=None, store_factory=None,
                  rank: int | None = None, world: int | None = None,
-                 gen: str | None = None, exit_fn=None):
+                 gen: str | None = None, exit_fn=None,
+                 beat_timeout_s: float = 0.25, store_health=None):
         from pytorch_distributed_train_tpu.elastic import worker_store
+        from pytorch_distributed_train_tpu.store_plane import get_health
 
         self.hang_timeout_s = hang_timeout_s
         self.poll_s = max(0.05, poll_s)
         self.exit_code = exit_code
         self.every_steps = max(1, every_steps)
+        self.beat_timeout_s = max(0.05, beat_timeout_s)
         self.recorder = recorder
         self.spans = spans
         self._factory = store_factory or worker_store
+        self._health = store_health if store_health is not None else (
+            get_health())
         self.rank = rank if rank is not None else _env_int("PROCESS_ID", 0)
         self.world = (world if world is not None
                       else _env_int("NUM_PROCESSES", 1))
@@ -79,22 +107,49 @@ class LivenessPlane:
         self._threads: list[threading.Thread] = []
         self.active = False
         self.blamed: dict | None = None  # monitor's diagnosis (rank 0)
+        # latest-wins pending beat: (step, done-event); drained by the
+        # lazily-started publisher thread (_publish_loop)
+        self._pending: tuple[int, threading.Event] | None = None
+        self._pending_lock = threading.Lock()
+        self._pending_ev = threading.Event()
+        self._publisher: threading.Thread | None = None
+        self.suspended = False  # monitor blame-suspension state (rank 0)
 
     # ------------------------------------------------------------- keys
     def _key(self, kind: str, rank: int | None = None) -> str:
         base = f"sentinel/{self.gen}/{kind}"
         return base if rank is None else f"{base}/{rank}"
 
+    def _mk_store(self, name: str, *, attempts: int = 2,
+                  op_timeout_s: float = 0.5):
+        from pytorch_distributed_train_tpu.faults.retry import RetryPolicy
+        from pytorch_distributed_train_tpu.store_plane import ResilientStore
+
+        return ResilientStore(
+            self._factory, op_timeout_s=op_timeout_s,
+            policy=RetryPolicy(max_attempts=attempts, base_delay_s=0.05,
+                               max_delay_s=0.25, jitter=0.5,
+                               retry_on=(OSError,)),
+            health=self._health, name=name)
+
     # ---------------------------------------------------------- lifecycle
     def start(self) -> bool:
         """Connect and spawn the watcher (+ monitor on rank 0). Returns
         False (plane inactive) when no launcher store is reachable."""
         try:
-            self._beat_store = self._factory()
+            probe = self._factory()
         except OSError:
-            self._beat_store = None
-        if self._beat_store is None:
+            probe = None
+        if probe is None:
             return False
+        try:
+            probe.close()
+        except Exception:
+            pass
+        # single attempt, small deadline: a failed beat is DROPPED and
+        # counted (the next beat supersedes it), never retried into a
+        # step-loop stall
+        self._beat_store = self._mk_store("sentinel-beat", attempts=1)
         self.active = True
         watcher = threading.Thread(target=self._watch, daemon=True,
                                    name="sentinel-liveness-watch")
@@ -109,8 +164,12 @@ class LivenessPlane:
 
     def stop(self) -> None:
         self._stop.set()
+        self._pending_ev.set()  # wake the publisher so it can exit
         for t in self._threads:
             t.join(timeout=2.0)
+        if self._publisher is not None:
+            self._publisher.join(timeout=2.0)
+            self._publisher = None
         if self._beat_store is not None:
             try:
                 self._beat_store.close()
@@ -120,13 +179,66 @@ class LivenessPlane:
         self.active = False
 
     # ------------------------------------------------------------ publish
-    def _publish_hb(self, step: int) -> None:
+    def _count_dropped(self, reason: str) -> None:
         try:
-            self._beat_store.set(
-                self._key("hb", self.rank),
-                json.dumps({"step": int(step), "ts": time.time()}).encode())
+            from pytorch_distributed_train_tpu.obs.registry import (
+                get_registry,
+            )
+
+            get_registry().counter(
+                "store_beats_dropped_total", labels={"reason": reason},
+                help="liveness heartbeats not confirmed published: "
+                     "superseded unsent, publish error, or slow store "
+                     "(sentinel/liveness.py)").inc()
         except Exception:
-            pass  # best-effort: a flaky store must never fail training
+            pass
+
+    def _ensure_publisher(self) -> None:
+        # caller holds _pending_lock
+        if self._publisher is None or not self._publisher.is_alive():
+            self._publisher = threading.Thread(
+                target=self._publish_loop, daemon=True,
+                name="sentinel-beat-publish")
+            self._publisher.start()
+
+    def _publish_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._pending_ev.wait(0.2):
+                continue
+            with self._pending_lock:
+                item = self._pending
+                self._pending = None
+                self._pending_ev.clear()
+            if item is None:
+                continue
+            step, done = item
+            try:
+                self._beat_store.set(
+                    self._key("hb", self.rank),
+                    json.dumps({"step": int(step),
+                                "ts": time.time()}).encode())
+            except Exception:
+                self._count_dropped("error")
+            finally:
+                done.set()
+
+    def _publish_hb(self, step: int) -> None:
+        """Time-bounded publish: deposit latest-wins, wait at most
+        ``beat_timeout_s`` for the publisher to confirm. A fast store
+        behaves synchronously; a slow one costs the caller the bounded
+        wait and the beat is counted dropped, not blocking."""
+        if self._beat_store is None:
+            return
+        done = threading.Event()
+        with self._pending_lock:
+            if self._pending is not None:
+                self._count_dropped("superseded")
+                self._pending[1].set()  # release any bounded waiter
+            self._pending = (int(step), done)
+            self._ensure_publisher()
+            self._pending_ev.set()
+        if not done.wait(self.beat_timeout_s):
+            self._count_dropped("slow_store")
 
     def beat(self, step: int) -> None:
         """Publish this host's heartbeat (call at step boundaries, main
@@ -157,28 +269,31 @@ class LivenessPlane:
     # ------------------------------------------------------------ watcher
     def _watch(self) -> None:
         """Every host: publish the phase record (open spans — readable
-        while the main thread is wedged) and obey cluster-dump orders."""
-        store = None
+        while the main thread is wedged) and obey cluster-dump orders.
+        Store errors skip the iteration — an outage degrades the plane,
+        it must not kill the thread that would dump the post-mortem."""
+        store = self._mk_store("liveness-watch")
         try:
-            store = self._factory()
             while not self._stop.wait(self.poll_s):
-                store.set(
-                    self._key("phase", self.rank),
-                    json.dumps({"spans": self._open_spans(),
-                                "ts": time.time()}).encode())
                 try:
+                    store.set(
+                        self._key("phase", self.rank),
+                        json.dumps({"spans": self._open_spans(),
+                                    "ts": time.time()}).encode())
                     raw = store.get(self._key("dump"), timeout_ms=1)
                 except TimeoutError:
-                    continue
-                self._dump_local(json.loads(raw.decode()))
-        except Exception:
-            pass  # store gone (teardown/agent death): the plane goes dark
-        finally:
-            if store is not None:
+                    continue  # no dump order pending
+                except OSError:
+                    continue  # store degraded: keep watching
                 try:
-                    store.close()
-                except Exception:
-                    pass
+                    self._dump_local(json.loads(raw.decode()))
+                except ValueError:
+                    continue  # corrupt order: ignore
+        finally:
+            try:
+                store.close()
+            except Exception:
+                pass
 
     def _dump_local(self, order: dict) -> None:
         if self._dumped or self.recorder is None:
@@ -200,45 +315,96 @@ class LivenessPlane:
             pass  # diagnostics must never crash the dump path
 
     # ------------------------------------------------------------ monitor
+    def _set_suspended(self, value: bool, *, reason: str = "",
+                       stale: int = 0) -> None:
+        if value == self.suspended:
+            return
+        self.suspended = value
+        name = "blame_suspended" if value else "blame_resumed"
+        try:
+            from pytorch_distributed_train_tpu.obs import events as evl
+
+            evl.emit("store", name, reason=reason, stale_hosts=stale)
+        except Exception:
+            pass
+        if value:
+            print(f"[sentinel] hang blame SUSPENDED ({reason}): store "
+                  "outage signature, not a host hang", flush=True)
+        else:
+            print("[sentinel] hang blame resumed (store recovered)",
+                  flush=True)
+
     def _monitor(self) -> None:
-        """Rank 0: receiver-side staleness over every host's heartbeat."""
+        """Rank 0: receiver-side staleness over every host's heartbeat,
+        with blame suspended while the outage signature holds (module
+        doc). Survives store errors: an unreadable pass counts as
+        outage evidence, never kills the thread."""
         from pytorch_distributed_train_tpu.obs.registry import get_registry
 
-        store = None
+        store = self._mk_store("hang-monitor")
         # rank -> (last raw payload, last-change monotonic ts); hosts
         # enter only once they have heartbeat at least once.
         seen: dict[int, tuple[bytes, float]] = {}
         try:
-            store = self._factory()
             while not self._stop.wait(self.poll_s):
                 now = time.monotonic()
+                outage = not self._health.ok()
+                changed = False
+                stale_ranks: list[int] = []
                 stale: tuple[int, float, bytes] | None = None
+                raws: dict[int, bytes] = {}
                 for r in range(self.world):
                     try:
                         raw = store.get(self._key("hb", r), timeout_ms=50)
                     except TimeoutError:
-                        continue  # never started: not blamable (see module doc)
+                        continue  # never started: not blamable (module doc)
+                    except OSError:
+                        outage = True  # unreadable ≠ unblamable host
+                        continue
+                    except Exception:
+                        continue  # defensive: monitor must not die
+                    raws[r] = raw
                     prev = seen.get(r)
                     if prev is None or prev[0] != raw:
                         seen[r] = (raw, now)
+                        changed = True
                         continue
                     age = now - prev[1]
-                    if age > self.hang_timeout_s and (
-                            stale is None or age > stale[1]):
-                        stale = (r, age, raw)
+                    if age > self.hang_timeout_s:
+                        stale_ranks.append(r)
+                        if stale is None or age > stale[1]:
+                            stale = (r, age, raw)
+                # The store-outage signature: the store itself reports
+                # trouble, or EVERY host ever seen (>=2) went stale at
+                # once. One host can hang alone; the whole gang going
+                # silent together means the control plane, and blaming
+                # a healthy gang restarts it for nothing.
+                all_stale = (len(seen) >= 2 and stale_ranks
+                             and len(stale_ranks) == len(seen))
+                if outage or (all_stale and not changed):
+                    self._set_suspended(
+                        True,
+                        reason="store_degraded" if outage else "all_stale",
+                        stale=len(stale_ranks))
+                    # re-baseline: every staleness clock restarts, so
+                    # recovery re-arms blame with a full window instead
+                    # of insta-blaming whoever the outage froze first
+                    for r, raw in raws.items():
+                        seen[r] = (raw, now)
+                    continue
+                if self.suspended:
+                    self._set_suspended(False)
+                    continue  # freshly re-armed clocks: nothing stale yet
                 if stale is None:
                     continue
                 rank, age, raw = stale
                 self._diagnose(store, rank, age, raw, get_registry())
                 return
-        except Exception:
-            pass  # store gone: the gang is already coming down
         finally:
-            if store is not None:
-                try:
-                    store.close()
-                except Exception:
-                    pass
+            try:
+                store.close()
+            except Exception:
+                pass
 
     def _diagnose(self, store, rank: int, age: float, raw: bytes,
                   registry) -> None:

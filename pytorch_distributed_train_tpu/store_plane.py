@@ -1,0 +1,511 @@
+"""Store resilience plane: bounded, retried, health-tracked launcher-KV ops.
+
+The entire control plane — rendezvous, world membership, liveness
+heartbeats, the peer checkpoint tier, replica/obs-endpoint discovery,
+profile coordination, the fleet controller — rides ONE launcher KV
+store (native/store.py, hosted by node 0). ``elastic.py`` documents it
+as a single point of failure; before this plane existed a store
+blackout false-blamed healthy hosts as hung, blinded the collector
+into ``fleet_stale``, and could stall the step loop inside a heartbeat
+publish. :class:`ResilientStore` is the one wrapper every consumer goes
+through instead of a raw ``StoreClient`` (enforced by the ``raw-store``
+pass of ``python -m tools.analyze``):
+
+- **every op is time-bounded**: the raw client is driven by a private
+  worker thread; an op that exceeds its deadline abandons the worker
+  (which closes its connection on its own time) and raises
+  :class:`StoreOpTimeout` — a wedged TCP send can never wedge a caller;
+- **bounded exponential-backoff retry** via ``faults/retry.retry_call``
+  (``retries_total{point=store.*}``), reconnecting between attempts;
+- **a last-known-good read cache** for the discovery registries
+  (replicas, obs endpoints, world): a registry read that fails after
+  retries serves the last successful answer instead of an empty list,
+  counted in ``store_lkg_reads_total{registry=}``;
+- **an ok→degraded→down health state machine** (:class:`StoreHealth`,
+  process-global by default — one process talks to one launcher store)
+  exported as metrics (``store_op_seconds``, ``store_degraded_total``,
+  ``store_health_state``) and journaled under the closed ``store``
+  event category, so consumers (liveness monitor, alert engine, fleet
+  controller) share one verdict about the control plane itself.
+
+Exception contract (mirrors the raw client): ``get``/``wait`` raise
+``TimeoutError`` when the key never appears — the store ANSWERED, so a
+key-absent timeout is neither retried nor a health failure. ``OSError``
+(including :class:`StoreOpTimeout` and injected ``store.*`` faults)
+means the store itself misbehaved: it is retried, and exhaustion both
+propagates to the caller and feeds the health machine.
+
+Fault points ``store.get``/``store.set``/``store.add`` (raise) and
+``store.latency`` (sleep) are traversed INSIDE the bounded op path, so
+outage windows and latency storms injected via ``PDTT_FAULTS`` exercise
+exactly the deadline/retry/LKG machinery production outages would.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+
+from pytorch_distributed_train_tpu.faults import registry as fregistry
+from pytorch_distributed_train_tpu.faults.retry import RetryPolicy, retry_call
+
+STATES = ("ok", "degraded", "down")
+_STATE_VALUES = {"ok": 0.0, "degraded": 1.0, "down": 2.0}
+
+# op kind -> the fault point it traverses (wait/num_keys are read-shaped,
+# delete is write-shaped; the catalog stays the three points the drills
+# drive)
+_POINT_BY_KIND = {"get": "store.get", "wait": "store.get",
+                  "num_keys": "store.get", "set": "store.set",
+                  "delete": "store.set", "add": "store.add"}
+
+_GET_DEFAULT_MAX_LEN = 1 << 20
+
+
+class StoreOpTimeout(OSError):
+    """An op exceeded its ResilientStore deadline. Deliberately NOT a
+    ``TimeoutError``: that type means "the store answered: no such key",
+    this one means "the store did not answer at all" — conflating them
+    would turn an outage into a phantom empty registry."""
+
+
+class _Absent:
+    """In-band marker for the raw client's key-absent TimeoutError, so
+    the retry loop (``retry_on=(OSError,)``, and TimeoutError IS an
+    OSError) never retries a legitimate answer."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: TimeoutError):
+        self.error = error
+
+
+def _registry():
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+    return get_registry()
+
+
+# --------------------------------------------------------------- health
+class StoreHealth:
+    """ok→degraded→down, driven by per-attempt outcomes on a monotonic
+    clock: ``degraded_after`` consecutive transport failures degrade,
+    failures persisting ``down_after_s`` past the first mark it down,
+    any success snaps back to ok. Process-global by default (module
+    singleton below); tests inject isolated instances."""
+
+    def __init__(self, *, degraded_after: int = 2, down_after_s: float = 15.0,
+                 clock=time.monotonic):
+        self.degraded_after = max(1, int(degraded_after))
+        self.down_after_s = float(down_after_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = "ok"
+        self._state_since = clock()
+        self._consecutive = 0
+        self._first_failure = None
+        self._last_error = ""
+        self._ops_total = 0
+        self._failures_total = 0
+        self._durs: collections.deque = collections.deque(maxlen=128)
+        self._lkg_refresh: dict[str, float] = {}
+        self._lkg_serves: dict[str, int] = {}
+
+    # ------------------------------------------------------- transitions
+    def record_success(self, op: str, duration_s: float) -> None:
+        with self._lock:
+            self._ops_total += 1
+            self._durs.append(float(duration_s))
+            self._consecutive = 0
+            self._first_failure = None
+            prev = self.state
+            if prev != "ok":
+                self.state = "ok"
+                self._state_since = self._clock()
+        if prev != "ok":
+            self._announce(prev, "ok", op, "")
+        self._export_gauge()
+
+    def record_failure(self, op: str, err: BaseException) -> None:
+        now = self._clock()
+        with self._lock:
+            self._ops_total += 1
+            self._failures_total += 1
+            self._consecutive += 1
+            self._last_error = f"{type(err).__name__}: {err}"
+            if self._first_failure is None:
+                self._first_failure = now
+            prev = self.state
+            new = prev
+            if prev == "ok" and self._consecutive >= self.degraded_after:
+                new = "degraded"
+            if (new == "degraded"
+                    and now - self._first_failure >= self.down_after_s):
+                new = "down"
+            if new != prev:
+                self.state = new
+                self._state_since = now
+            last = self._last_error
+        if new != prev:
+            self._announce(prev, new, op, last)
+        self._export_gauge()
+
+    def _announce(self, prev: str, new: str, op: str, err: str) -> None:
+        # outside self._lock: journaling is file I/O under its own lock
+        if prev == "ok" and new in ("degraded", "down"):
+            _registry().counter(
+                "store_degraded_total",
+                help="launcher-store health transitions out of ok "
+                     "(store_plane.py)").inc()
+        name = "recovered" if new == "ok" else new
+        try:
+            from pytorch_distributed_train_tpu.obs import events as evl
+
+            evl.emit("store", name, prev=prev, op=op, error=err,
+                     consecutive=self._consecutive)
+        except Exception:
+            pass  # diagnostics must never make an outage worse
+        print(f"[store] launcher-store health {prev} -> {new}"
+              + (f" ({err})" if err else ""), flush=True)
+
+    def _export_gauge(self) -> None:
+        try:
+            _registry().gauge(
+                "store_health_state",
+                help="launcher-store health (0=ok 1=degraded 2=down)"
+            ).set(_STATE_VALUES[self.state])
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- reads
+    def ok(self) -> bool:
+        return self.state == "ok"
+
+    def note_lkg_refresh(self, name: str) -> None:
+        with self._lock:
+            self._lkg_refresh[name] = self._clock()
+
+    def note_lkg_serve(self, name: str) -> None:
+        with self._lock:
+            self._lkg_serves[name] = self._lkg_serves.get(name, 0) + 1
+
+    def snapshot(self) -> dict:
+        """One dict every consumer renders from (fleet_console's store
+        line, obs_report, the alert engine's synthetic store target)."""
+        now = self._clock()
+        with self._lock:
+            durs = sorted(self._durs)
+            p95 = durs[int(0.95 * (len(durs) - 1))] if durs else 0.0
+            ages = {k: round(now - v, 1)
+                    for k, v in self._lkg_refresh.items()}
+            return {"state": self.state,
+                    "state_age_s": round(now - self._state_since, 1),
+                    "ops_total": self._ops_total,
+                    "failures_total": self._failures_total,
+                    "consecutive_failures": self._consecutive,
+                    "op_p95_ms": round(p95 * 1000.0, 2),
+                    "last_error": self._last_error,
+                    "lkg_age_s": ages,
+                    "lkg_serves": dict(self._lkg_serves)}
+
+
+_HEALTH = StoreHealth()
+
+
+def get_health() -> StoreHealth:
+    """The process-global health machine every default-constructed
+    ResilientStore feeds (one process, one launcher store)."""
+    return _HEALTH
+
+
+def health_snapshot() -> dict:
+    return _HEALTH.snapshot()
+
+
+def _reset_for_tests() -> None:
+    global _HEALTH
+    _HEALTH = StoreHealth()
+
+
+# --------------------------------------------------------- bounded runner
+class _Op:
+    __slots__ = ("fn", "done", "result", "error")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+
+class _Worker:
+    """Owns ONE raw client, executes ops serially. Abandoned (not
+    joined) on a deadline miss: it finishes the wedged C call on its own
+    time, sees the flag, closes its connection and exits — the caller
+    never blocks on a socket it cannot interrupt."""
+
+    def __init__(self, factory, name: str):
+        self._factory = factory
+        self._q: queue.Queue = queue.Queue()
+        self._abandoned = threading.Event()
+        self.dead = False
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name=f"{name}-op")
+        self._t.start()
+
+    def submit(self, op: _Op) -> None:
+        self._q.put(op)
+
+    def abandon(self) -> None:
+        self.dead = True
+        self._abandoned.set()
+        self._q.put(None)  # unblock an idle get()
+
+    def _loop(self) -> None:
+        client = None
+        try:
+            while not self._abandoned.is_set():
+                op = self._q.get()
+                if op is None:
+                    break
+                try:
+                    if client is None:
+                        client = self._factory()
+                    if client is None:
+                        raise ConnectionError(
+                            "no launcher store (factory returned None)")
+                    op.result = op.fn(client)
+                except BaseException as e:
+                    op.error = e
+                    if isinstance(e, OSError) and not isinstance(
+                            e, TimeoutError):
+                        # transport failure: this connection is suspect;
+                        # reconnect on the next op
+                        if client is not None:
+                            try:
+                                client.close()
+                            except Exception:
+                                pass
+                            client = None
+                finally:
+                    op.done.set()
+        finally:
+            self.dead = True
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:
+                    pass
+
+
+class _OpRunner:
+    def __init__(self, factory, name: str):
+        self._factory = factory
+        self._name = name
+        self._lock = threading.Lock()
+        self._worker: _Worker | None = None
+
+    def run(self, fn, timeout_s: float):
+        with self._lock:
+            w = self._worker
+            if w is None or w.dead:
+                w = _Worker(self._factory, self._name)
+                self._worker = w
+        op = _Op(fn)
+        w.submit(op)
+        if not op.done.wait(timeout_s):
+            w.abandon()
+            with self._lock:
+                if self._worker is w:
+                    self._worker = None
+            raise StoreOpTimeout(
+                f"{self._name}: store op exceeded its "
+                f"{timeout_s:.1f}s deadline")
+        if op.error is not None:
+            raise op.error
+        return op.result
+
+    def close(self) -> None:
+        with self._lock:
+            w, self._worker = self._worker, None
+        if w is not None:
+            w.abandon()
+
+
+# --------------------------------------------------------- the wrapper
+class ResilientStore:
+    """Drop-in StoreClient facade (set/get/add/wait/delete/num_keys/
+    barrier/close) with the resilience contract from the module doc.
+
+    ``factory`` returns a NEW raw client per call (the worker_store
+    convention) — reconnection between retry attempts needs a factory,
+    not a client. ``None`` defaults to ``elastic.worker_store``.
+    """
+
+    def __init__(self, factory=None, *, op_timeout_s: float = 2.0,
+                 policy: RetryPolicy | None = None,
+                 health: StoreHealth | None = None, name: str = "store"):
+        if factory is None:
+            from pytorch_distributed_train_tpu.elastic import worker_store
+
+            factory = worker_store
+        self._runner = _OpRunner(factory, name)
+        self.op_timeout_s = float(op_timeout_s)
+        self._policy = policy or RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            jitter=0.5, retry_on=(OSError,))
+        self.health = health if health is not None else get_health()
+        self._cache_lock = threading.Lock()
+        self._cache: dict[str, object] = {}
+
+    # ------------------------------------------------------------ op core
+    def _op(self, kind: str, fn, *, budget_s: float = 0.0):
+        """One logical op: fault traversal + deadline + retry + health.
+        ``budget_s`` extends the deadline by the op's own legitimate
+        blocking budget (a get/wait's timeout_ms is WAITING, not
+        latency)."""
+        point = _POINT_BY_KIND[kind]
+        deadline_s = self.op_timeout_s + float(budget_s)
+        hist = _registry().histogram(
+            "store_op_seconds", labels={"op": kind},
+            help="launcher-store op latency through ResilientStore, "
+                 "per attempt")
+
+        def raw(client):
+            fregistry.maybe_fire("store.latency")
+            fregistry.maybe_fire(point)
+            try:
+                return fn(client)
+            except TimeoutError as e:
+                return _Absent(e)  # the store ANSWERED: not a failure
+
+        def attempt():
+            t0 = time.perf_counter()
+            try:
+                out = self._runner.run(raw, deadline_s)
+            except OSError as e:
+                hist.observe(time.perf_counter() - t0)
+                self.health.record_failure(kind, e)
+                raise
+            dur = time.perf_counter() - t0
+            hist.observe(dur)
+            self.health.record_success(kind, dur)
+            return out
+
+        out = retry_call(attempt, policy=self._policy, point=point)
+        if isinstance(out, _Absent):
+            raise TimeoutError(str(out.error))
+        return out
+
+    # --------------------------------------------------- client surface
+    def set(self, key: str, value: bytes) -> None:
+        self._op("set", lambda c: c.set(key, value))
+
+    def get(self, key: str, timeout_ms: int = 60_000,
+            max_len: int = _GET_DEFAULT_MAX_LEN) -> bytes:
+        def fn(c):
+            if max_len != _GET_DEFAULT_MAX_LEN:
+                return c.get(key, timeout_ms=timeout_ms, max_len=max_len)
+            # default max_len stays implicit so duck-typed test fakes
+            # only need get(key, timeout_ms=)
+            return c.get(key, timeout_ms=timeout_ms)
+
+        return self._op("get", fn, budget_s=timeout_ms / 1000.0)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return self._op("add", lambda c: c.add(key, delta))
+
+    def wait(self, key: str, timeout_ms: int = 60_000) -> None:
+        self._op("wait", lambda c: c.wait(key, timeout_ms=timeout_ms),
+                 budget_s=timeout_ms / 1000.0)
+
+    def delete(self, key: str) -> None:
+        self._op("delete", lambda c: c.delete(key))
+
+    def num_keys(self) -> int:
+        return self._op("num_keys", lambda c: c.num_keys())
+
+    def barrier(self, name: str, world: int, rank: int,
+                timeout_ms: int = 60_000) -> None:
+        n = self.add(f"barrier/{name}/count", 1)
+        if n == world:
+            self.set(f"barrier/{name}/go", b"1")
+        self.wait(f"barrier/{name}/go", timeout_ms)
+
+    def close(self) -> None:
+        self._runner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ----------------------------------------------------- LKG registry
+    def cached(self, name: str, fetch):
+        """Run ``fetch()`` (a strict discovery read built on this
+        store); success refreshes the named last-known-good entry,
+        transport failure serves the cached answer (counted in
+        ``store_lkg_reads_total{registry=}``) or re-raises when there
+        has never been one."""
+        try:
+            val = fetch()
+        except OSError as e:
+            if isinstance(e, TimeoutError) and not isinstance(
+                    e, StoreOpTimeout):
+                raise  # key-absent is an answer, not an outage
+            with self._cache_lock:
+                if name not in self._cache:
+                    raise
+                val = self._cache[name]
+            _registry().counter(
+                "store_lkg_reads_total", labels={"registry": name},
+                help="discovery reads served from the last-known-good "
+                     "cache during store degradation").inc()
+            self.health.note_lkg_serve(name)
+            return val
+        with self._cache_lock:
+            self._cache[name] = val
+        self.health.note_lkg_refresh(name)
+        return val
+
+    def discover_replicas(self) -> list:
+        from pytorch_distributed_train_tpu import elastic
+
+        return self.cached(
+            "replicas", lambda: elastic.discover_replicas(self, strict=True))
+
+    def discover_obs_endpoints(self) -> list:
+        from pytorch_distributed_train_tpu import elastic
+
+        return self.cached(
+            "obs_endpoints",
+            lambda: elastic.discover_obs_endpoints(self, strict=True))
+
+    def world_max(self, default: int = 0) -> int:
+        from pytorch_distributed_train_tpu import elastic
+
+        def fetch():
+            try:
+                raw = self.get(elastic.WORLD_MAX_KEY, timeout_ms=50)
+            except TimeoutError:
+                return int(default)  # never published: an answer
+            return max(int(default), int(raw.decode()))
+
+        try:
+            return self.cached("world", fetch)
+        except (OSError, ValueError):
+            return int(default)
+
+
+def resilient_worker_store(**kw) -> ResilientStore | None:
+    """ResilientStore over ``elastic.worker_store``, or None outside a
+    tpurun job (no ``TPUSTORE_ADDR``) — the ``worker_store()`` calling
+    convention every consumer already follows."""
+    import os
+
+    if not os.environ.get("TPUSTORE_ADDR"):
+        return None
+    return ResilientStore(**kw)

@@ -568,9 +568,10 @@ class Trainer:
             # the collision fallback above. Best-effort: no store (not
             # under tpurun) just means no fleet discovery.
             try:
-                from pytorch_distributed_train_tpu import elastic
+                from pytorch_distributed_train_tpu import elastic, store_plane
 
-                store = elastic.worker_store()
+                store = store_plane.resilient_worker_store(
+                    name="trainer-advertise")
                 if store is not None:
                     addr = (f"{elastic.routable_host('')}"
                             f":{self.metrics_server.port}")

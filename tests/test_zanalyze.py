@@ -29,6 +29,7 @@ from tools.analyze.passes import (  # noqa: E402
     lock_scope,
     metric_catalog,
     monotonic_clock,
+    raw_store,
     thread_lifecycle,
     thread_shared,
 )
@@ -48,7 +49,7 @@ def test_registry_has_all_passes():
         "lock-scope", "monotonic-clock", "jit-purity", "fault-catalog",
         "event-catalog", "metric-catalog", "thread-shared-state",
         "trace-hygiene", "alert-catalog", "slo-catalog", "lock-order",
-        "thread-lifecycle", "action-catalog"}
+        "thread-lifecycle", "action-catalog", "raw-store"}
 
 
 def test_pass_catalog_doc_is_the_registry_contract():
@@ -191,6 +192,32 @@ def test_thread_lifecycle_catches_seeded_violations():
 def test_thread_lifecycle_passes_clean_patterns():
     assert run_pass(thread_lifecycle.ThreadLifecyclePass,
                     [f"{FIXTURES}/thread_lifecycle_clean.py"]) == []
+
+
+def test_raw_store_catches_seeded_violations():
+    findings = run_pass(raw_store.RawStorePass,
+                        [f"{FIXTURES}/raw_store_bad.py"])
+    assert len(findings) == 5
+    msgs = "\n".join(f.message for f in findings)
+    # local name, attr taint across methods, and the unbound inline call
+    assert "`store.get(...)`" in msgs
+    assert "`self._store.set(...)`" in msgs
+    assert "`StoreClient.get(...)`" in msgs
+    assert all("ResilientStore" in f.message for f in findings)
+
+
+def test_raw_store_passes_clean_patterns():
+    # resilient wrapper handles + parameter-taking helpers are sanctioned
+    assert run_pass(raw_store.RawStorePass,
+                    [f"{FIXTURES}/raw_store_clean.py"]) == []
+
+
+def test_raw_store_repo_surface_is_clean():
+    """The production surface routes every store op through the
+    resilience plane — the whole point of the wrapper PR; a new raw
+    call site must fail here, not land in the baseline."""
+    findings = raw_store.RawStorePass().run(core.build_context(REPO))
+    assert findings == []
 
 
 def _seed_live_copy(tmp_path, rel, extra):

@@ -1,0 +1,31 @@
+"""Seeded raw-store violations (tools/analyze/passes/raw_store.py)."""
+import time
+
+from pytorch_distributed_train_tpu.elastic import worker_store
+from pytorch_distributed_train_tpu.native.store import StoreClient
+
+
+def poll_once():
+    store = worker_store()
+    return store.get("fleet/epoch")  # finding: raw worker_store handle
+
+
+def publish(addr):
+    client = StoreClient("127.0.0.1", 29400)
+    idx = client.add("replicas/count", 1)  # finding: raw StoreClient
+    client.set(f"replicas/{idx}", addr.encode())  # finding
+    return idx
+
+
+def inline_chain():
+    return StoreClient("127.0.0.1", 29400).get("k")  # finding: no binding
+
+
+class BeatLoop:
+    def __init__(self):
+        self._store = StoreClient("127.0.0.1", 29400)
+
+    def tick(self, step):
+        # finding: attr tainted class-wide from __init__
+        self._store.set("beat", str(step).encode())
+        time.sleep(0.1)

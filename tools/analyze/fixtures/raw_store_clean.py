@@ -1,0 +1,25 @@
+"""Clean store usage the raw-store pass must NOT flag."""
+from pytorch_distributed_train_tpu import store_plane
+
+
+def poll_once():
+    # the resilient wrapper IS the sanctioned handle
+    store = store_plane.resilient_worker_store(name="clean")
+    if store is None:
+        return None
+    return store.get("fleet/epoch")
+
+
+def drain(store):
+    # parameter-taking helpers inherit the CALLER's handle (which is the
+    # wrapper at production call sites) — not tainted
+    store.set("drained", b"1")
+    return store.add("drain/count", 1)
+
+
+class CachedReader:
+    def __init__(self, factory):
+        self._store = store_plane.ResilientStore(factory, name="reader")
+
+    def read(self):
+        return self._store.get("k", timeout_ms=200)

@@ -17,6 +17,7 @@ from tools.analyze.passes import (  # noqa: F401
     lock_scope,
     metric_catalog,
     monotonic_clock,
+    raw_store,
     slo_catalog,
     thread_lifecycle,
     thread_shared,

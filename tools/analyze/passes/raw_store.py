@@ -1,0 +1,168 @@
+"""raw-store: launcher-store ops must go through the resilience plane.
+
+Every control-plane subsystem (liveness, discovery, peer ckpt, profiler
+triggers) rides the ONE launcher KV store. A raw ``StoreClient`` /
+``elastic.worker_store()`` handle gives each op the native client's
+defaults — a 60s blocking ``get``, no retry, no health scoring, no
+fault points — so one slow store stalls a step loop for a minute and
+the outage is invisible to the ``store_degraded`` alert.
+``store_plane.ResilientStore`` exists to close exactly that hole:
+bounded per-op deadline, bounded retry, last-known-good discovery
+cache, and the ok→degraded→down health machine the console, alerts and
+controller hold on (docs/fault_tolerance.md degraded-mode matrix).
+
+The pass taints names bound from a raw-handle constructor —
+``worker_store()`` (NOT ``resilient_worker_store``) or
+``StoreClient(...)`` — including ``self.x`` attribute bindings
+class-wide, and flags any store op (``get``/``set``/``add``/``wait``/
+``delete``/``num_keys``/``barrier``) invoked on a tainted handle.
+
+Deliberately NOT flagged:
+
+- a store received as a *parameter* (``def f(store): store.get(...)``)
+  — elastic helpers and ckpt/peer.py take the caller's handle, and the
+  resilient wrapper IS that handle at every production call site;
+- the plumbing that builds the plane itself: ``elastic.py`` (the
+  launcher/agent side pre-dates workers and owns rendezvous),
+  ``store_plane.py`` (the wrapper's own raw calls are the point),
+  ``native/`` (the client), and ``sentinel/liveness.py``'s factory
+  plumbing (it builds ResilientStore from a raw probe).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import AnalysisPass, Context, Finding, dotted, register
+
+# Final dotted segment of a call that yields a RAW handle. Matched
+# exactly: ``resilient_worker_store`` must not taint.
+RAW_FACTORIES = {"worker_store", "StoreClient"}
+STORE_OPS = {"get", "set", "add", "wait", "delete", "num_keys", "barrier"}
+EXEMPT = (
+    "pytorch_distributed_train_tpu/elastic.py",
+    "pytorch_distributed_train_tpu/store_plane.py",
+    "pytorch_distributed_train_tpu/native/",
+    "pytorch_distributed_train_tpu/sentinel/liveness.py",
+)
+
+
+def _is_raw_factory(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return bool(d) and d.split(".")[-1] in RAW_FACTORIES
+
+
+def _assign_names(tgt: ast.AST):
+    if isinstance(tgt, ast.Name):
+        yield ("name", tgt.id)
+    elif isinstance(tgt, ast.Attribute):
+        d = dotted(tgt)
+        if d and d.startswith("self."):
+            yield ("attr", d)
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _assign_names(elt)
+
+
+def _scope_nodes(body):
+    """Statements of this scope only — nested defs are their own world
+    (a parameter-taking closure must not inherit outer taint rules)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class RawStorePass(AnalysisPass):
+    id = "raw-store"
+    description = ("launcher-store get/set/add on a raw StoreClient/"
+                   "worker_store handle instead of "
+                   "store_plane.ResilientStore")
+    include = ("**",)
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in self.files(ctx):
+            if any(sf.path == e or sf.path.startswith(e) for e in EXEMPT):
+                continue
+            # class-wide attr taint: self._store = StoreClient(...) in
+            # any method taints self._store ops in every method
+            attr_taint: dict[int, set[str]] = {}
+            for cls in [n for n in ast.walk(sf.tree)
+                        if isinstance(n, ast.ClassDef)]:
+                attrs: set[str] = set()
+                for node in ast.walk(cls):
+                    if isinstance(node, ast.Assign) and \
+                            _is_raw_factory(node.value):
+                        for t in node.targets:
+                            for kind, name in _assign_names(t):
+                                if kind == "attr":
+                                    attrs.add(name)
+                for fn in ast.walk(cls):
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        attr_taint[id(fn)] = attrs
+            funcs = [n for n in ast.walk(sf.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for fn in funcs:
+                out.extend(self._check_scope(
+                    sf, fn.body, attr_taint.get(id(fn), set())))
+            top = [n for n in sf.tree.body
+                   if not isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))]
+            out.extend(self._check_scope(sf, top, set()))
+        return out
+
+    def _check_scope(self, sf, body, tainted_attrs) -> list[Finding]:
+        tainted: set[str] = set()
+        for node in _scope_nodes(body):
+            tgts = None
+            if isinstance(node, ast.Assign):
+                tgts, value = node.targets, node.value
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                tgts, value = [node.target], node.value
+            elif isinstance(node, ast.withitem):
+                tgts = [node.optional_vars] if node.optional_vars else []
+                value = node.context_expr
+            if tgts and value is not None and _is_raw_factory(value):
+                for t in tgts:
+                    for kind, name in _assign_names(t):
+                        if kind == "name":
+                            tainted.add(name)
+
+        out: list[Finding] = []
+        seen: set[int] = set()
+        for node in _scope_nodes(body):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in STORE_OPS):
+                continue
+            base = node.func.value
+            hit = None
+            if isinstance(base, ast.Name) and base.id in tainted:
+                hit = base.id
+            elif isinstance(base, ast.Attribute):
+                d = dotted(base)
+                if d in tainted_attrs:
+                    hit = d
+            elif _is_raw_factory(base):
+                hit = dotted(base.func) or "StoreClient(...)"
+            if hit is not None and node.lineno not in seen:
+                seen.add(node.lineno)
+                out.append(self.finding(
+                    sf, node,
+                    f"raw store op `{hit}.{node.func.attr}(...)` outside "
+                    "the resilience plane — build the handle with "
+                    "store_plane.resilient_worker_store()/ResilientStore "
+                    "for bounded timeout, retry, LKG cache and health "
+                    "scoring (docs/fault_tolerance.md)"))
+        return out

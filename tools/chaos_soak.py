@@ -282,6 +282,12 @@ def main(argv=None) -> int:
     p.add_argument("--shrink", action="store_true",
                    help="run the elastic shrink drill instead of the "
                         "multi-fault soak (docs/elastic.md)")
+    p.add_argument("--store-outage", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="run the launcher-store blackout drill instead "
+                        "(tools/store_outage_drill.py): a 2-node gang "
+                        "trains through a store outage of this many "
+                        "seconds with zero false hang blames")
     p.add_argument("--sanitize", action="store_true",
                    help="run under the tsan-lite concurrency sanitizer "
                         "(utils/syncdbg.py): agent threads in-process, "
@@ -295,7 +301,13 @@ def main(argv=None) -> int:
     from pytorch_distributed_train_tpu.utils import syncdbg
 
     syncdbg.maybe_activate()
-    if args.shrink:
+    if args.store_outage > 0:
+        import store_outage_drill
+
+        report = store_outage_drill.run_training_drill(
+            seed=args.seed, steps=args.steps or 18,
+            outage_s=args.store_outage, out_dir=args.out)
+    elif args.shrink:
         report = run_shrink_drill(seed=args.seed, steps=args.steps or 6,
                                   out_dir=args.out)
     else:

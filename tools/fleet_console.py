@@ -152,11 +152,36 @@ def _serving_cell(row: dict) -> str:
     return " ".join(parts) or "-"
 
 
+def store_panel(health: dict | None) -> list[str]:
+    """One store-health line from the store_plane health snapshot:
+    state, op p95, last-known-good cache ages. Empty for store-less
+    consoles (no ops ever ran — nothing to report on)."""
+    if not isinstance(health, dict) or not health.get("ops_total"):
+        return []
+    state = str(health.get("state", "ok"))
+    parts = [f"  store: {state.upper() if state != 'ok' else 'ok'}"]
+    p95 = health.get("op_p95_ms")
+    if p95 is not None:
+        parts.append(f"op p95 {p95:.1f}ms")
+    if health.get("consecutive_failures"):
+        parts.append(f"consec-fail {health['consecutive_failures']}")
+    lkg = health.get("lkg_age_s") or {}
+    if lkg:
+        parts.append("lkg " + ",".join(
+            f"{name}={age:.0f}s" for name, age in sorted(lkg.items())))
+    if health.get("lkg_serves"):
+        parts.append(f"served-from-cache {health['lkg_serves']}")
+    if state != "ok" and health.get("last_error"):
+        parts.append(f"err {health['last_error']}")
+    return ["  ".join(parts)]
+
+
 def render_snapshot(snap: dict, alerts: list[dict],
                     last_events: dict | None = None,
                     history=None,
                     slo_status: dict | None = None,
-                    controller_lines: list[str] | None = None) -> str:
+                    controller_lines: list[str] | None = None,
+                    store_health: dict | None = None) -> str:
     rows = snap["targets"]
     states = [r["state"] for r in rows]
     head = (f"== fleet console: {len(rows)} target(s) "
@@ -220,6 +245,7 @@ def render_snapshot(snap: dict, alerts: list[dict],
                          f"for {a['for_s']:.1f}s{val}{base}")
     else:
         lines.append("  alerts: none firing")
+    lines.extend(store_panel(store_health))
     lines.extend(slo_panel(slo_status or {}))
     if controller_lines:
         lines.extend(controller_lines)
@@ -332,6 +358,26 @@ def offline_report(run_dir: str, events_dir: str = "",
         lines.append(f"    UNRESOLVED {rule} on {host} "
                      f"value={d.get('value')} (gen {d.get('gen')})")
     lines.extend(controller_panel(events))
+    # store-plane replay (the ``store`` journal category): the
+    # degraded→ok arc and any liveness blame suspensions, so a store
+    # outage reads as a control-plane incident, not N dead hosts
+    srecs = [e for e in events if e.get("category") == "store"]
+    if srecs:
+        state = "ok"
+        transitions = 0
+        suspensions = 0
+        for e in srecs:
+            name = e.get("name")
+            if name in ("degraded", "down"):
+                state = name
+                transitions += 1
+            elif name == "recovered":
+                state = "ok"
+            elif name == "blame_suspended":
+                suspensions += 1
+        lines.append(f"  store: {state.upper() if state != 'ok' else 'ok'}"
+                     f" at end  degraded-transitions={transitions}  "
+                     f"blame-suspensions={suspensions}")
     lines.append("  " + "  ".join(
         f"last {k}: {v}" for k, v in _last_events(events).items()))
     ledger_path = ledger_path or os.path.join(run_dir, "perf_ledger.jsonl")
@@ -636,7 +682,9 @@ def main(argv=None) -> int:
                                       history=collector.history,
                                       slo_status=_slo_status(),
                                       controller_lines=controller_panel(
-                                          evs)))
+                                          evs),
+                                      store_health=collector
+                                      .store_health()))
                 sys.stdout.flush()
                 time.sleep(collector.poll_s)
         else:
@@ -647,7 +695,9 @@ def main(argv=None) -> int:
                 snap = tick(collector, engine)
             if args.format == "json":
                 out = json.dumps(dict(snap, alerts=engine.firing(),
-                                      slo=_slo_status()),
+                                      slo=_slo_status(),
+                                      store_health=collector
+                                      .store_health()),
                                  indent=2, sort_keys=True)
             else:
                 evs = (_events_for_console(args)
@@ -657,7 +707,8 @@ def main(argv=None) -> int:
                     _last_events(evs) if evs else None,
                     history=collector.history,
                     slo_status=_slo_status(),
-                    controller_lines=controller_panel(evs))
+                    controller_lines=controller_panel(evs),
+                    store_health=collector.store_health())
             print(out)
     except KeyboardInterrupt:
         pass

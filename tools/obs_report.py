@@ -424,6 +424,40 @@ def controller_section(events_dir: str,
     return out
 
 
+def store_section(events_dir: str,
+                  events: list[dict] | None = None) -> list[str]:
+    """Launcher-store health from the ``store`` journal category
+    (store_plane.py / sentinel/liveness.py): the degraded→recovered
+    arc, dropped-beat pressure and liveness blame suspensions. Quiet
+    when the run never journaled store trouble — a healthy store is
+    the default and needs no line."""
+    if events is None:
+        events = _load_events(events_dir)
+    if events is None:
+        return []
+    srecs = [e for e in events if e.get("category") == "store"]
+    if not srecs:
+        return []
+    state = "ok"
+    counts: dict[str, int] = {}
+    for e in srecs:
+        name = str(e.get("name", "?"))
+        counts[name] = counts.get(name, 0) + 1
+        if name in ("degraded", "down"):
+            state = name
+        elif name == "recovered":
+            state = "ok"
+    out = [f"store health ({len(srecs)} store events, "
+           f"{state.upper() if state != 'ok' else 'ok'} at journal end): "
+           + "  ".join(f"{n}={c}" for n, c in sorted(counts.items()))]
+    last = srecs[-1]
+    detail = " ".join(f"{k}={v}" for k, v in
+                      (last.get("detail") or {}).items())[:64]
+    out.append(f"  last: {last.get('name')} [{last.get('host')} "
+               f"g{last.get('gen')}] {detail}".rstrip())
+    return out
+
+
 def traces_section(traces_dir: str, top: int = 5) -> list[str]:
     """Slowest retained distributed traces (obs/tracing.py): top-K by
     whole-request duration with the per-phase (queue / prefill / decode
@@ -552,6 +586,7 @@ def report(jsonl_path: str, trace_path: str = "",
             ("serving", lambda: serving_section(events_dir, events)),
             ("controller actions",
              lambda: controller_section(events_dir, events)),
+            ("store health", lambda: store_section(events_dir, events)),
             ("SLO budgets", lambda: slo_section(
                 history_dir or os.path.join(
                     os.path.dirname(jsonl_path), "tsdb"))),

@@ -1646,14 +1646,17 @@ def main(argv=None) -> int:
     drain.install()
     adv_store, adv_idx = None, -1
     if args.advertise:
+        from pytorch_distributed_train_tpu import store_plane
         from pytorch_distributed_train_tpu.elastic import (
             publish_obs_endpoint,
             publish_replica,
             routable_host,
-            worker_store,
         )
 
-        store = worker_store()
+        # resilient wrapper (store_plane): the publish and the exit
+        # tombstone get bounded timeouts + retries instead of wedging
+        # startup/shutdown behind a slow launcher store
+        store = store_plane.resilient_worker_store(name="serve-advertise")
         if store is None:
             print("serve_http: --advertise ignored (no TPUSTORE_ADDR)",
                   flush=True)

@@ -328,17 +328,18 @@ def main(argv=None) -> int:
 
     refresh = None
     if args.store:
-        from pytorch_distributed_train_tpu.elastic import (
-            discover_replicas,
-            worker_store,
-        )
+        from pytorch_distributed_train_tpu import store_plane
 
-        store = worker_store()
+        store = store_plane.resilient_worker_store(name="router")
         if store is None:
             print("serve_router: --store needs TPUSTORE_ADDR",
                   file=sys.stderr)
             return 2
-        refresh = lambda: discover_replicas(store)  # noqa: E731
+        # last-known-good discovery (store_plane.ResilientStore): a
+        # registry blackout serves the cached replica set — the router
+        # keeps routing, it just can't pick up NEW replicas until the
+        # store heals (the prober swallows a never-cached failure)
+        refresh = store.discover_replicas
     replicas = ReplicaSet(tuple(args.replica))
     if not args.replica and refresh is None:
         print("serve_router: no replicas (--replica or --store)",

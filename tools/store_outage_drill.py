@@ -1,0 +1,476 @@
+#!/usr/bin/env python
+"""store_outage_drill — blackout the launcher KV store mid-run and
+prove the fleet rides it out (docs/fault_tolerance.md degraded-mode
+matrix; the store-resilience plane's acceptance drill).
+
+Two arms, each printing one JSON report line (exit 0 = pass):
+
+``--train`` (default): a 2-node elastic gang with the liveness plane
+armed (``hang_timeout_s`` SHORTER than the outage) trains through a
+seeded client-side store blackout — the ``store.get``/``store.set``/
+``store.add`` fault points open a ``for=``-window at a mid-run step on
+EVERY host at once, exactly the "all hosts stale simultaneously"
+signature that used to read as a cluster hang. Acceptance:
+
+- zero false hang blames: no ``sentinel``/``hang_blamed`` or
+  ``cluster_dump`` events, every worker exits 0, the run completes;
+- the journal carries the ``store`` arc: degraded (or down) →
+  recovered, plus the liveness monitor's blame_suspended /
+  blame_resumed bracket;
+- step cadence stays within noise of a no-fault CONTROL run of the
+  same shape (time-bounded heartbeats: dropped beats are counted,
+  never waited on).
+
+``--serve``: two advertised fake-backend replicas + the in-process
+router stack (HealthProber refresh = ResilientStore.discover_replicas)
+take a registry blackout: every ``store.get`` in the drill process
+raises for the window while live traffic flows through the router
+front. Acceptance: ZERO failed requests (the replica set serves from
+the last-known-good cache, ``store_lkg_reads_total`` > 0) and the
+health machine walks degraded → ok on recovery.
+
+Usage::
+
+    python tools/store_outage_drill.py [--train] [--seed 0]
+        [--steps 16] [--outage 3.0] [--out DIR]
+    python tools/store_outage_drill.py --serve [--outage 2.0]
+
+Registered as slow tests (tests/test_zstore_plane.py) under
+``PDTT_SANITIZE=1``; tier-1 stays fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))
+if _TOOLS not in sys.path:
+    sys.path.insert(1, _TOOLS)
+
+_TRAIN_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from pytorch_distributed_train_tpu.utils import syncdbg
+syncdbg.maybe_activate()
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorch_distributed_train_tpu.config import TrainConfig
+from pytorch_distributed_train_tpu.trainer import Trainer
+
+rank = int(os.environ["PROCESS_ID"])
+out = {out!r}
+cfg = TrainConfig()
+cfg.model.name = "resnet18"; cfg.model.num_classes = 10
+cfg.model.image_size = 8
+cfg.data.dataset = "synthetic_images"; cfg.data.synthetic_size = 48
+cfg.data.batch_size = 12; cfg.data.num_workers = 1
+cfg.optim.name = "momentum"; cfg.optim.learning_rate = 0.05
+cfg.optim.schedule = "constant"; cfg.optim.warmup_steps = 0
+cfg.total_steps = {steps}
+cfg.checkpoint.dir = os.path.join(out, f"ckpt-{{rank}}")
+cfg.checkpoint.save_every_steps = 0
+cfg.obs.log_every_steps = 1
+cfg.obs.jsonl_path = os.path.join(out, f"metrics-{{rank}}.jsonl")
+# liveness armed TIGHTER than the outage: without blame suspension
+# this gang would dump-and-die mid-blackout
+cfg.sentinel.hang_timeout_s = {hang_timeout}
+cfg.sentinel.hang_poll_s = 0.2
+cfg.sentinel.heartbeat_every_steps = 1
+# pace every step (control AND fault runs identically) so the run
+# outlasts the blackout and the recovery arc lands IN-run: the monitor
+# must re-arm blame and journal blame_resumed before fit ends
+inject = ["step.straggle@step=2:count=1000:delay={pace}:gen=-1"]
+if {outage_s} > 0:
+    inject += [
+        "store.get@step={outage_step}:for={outage_s}:gen=-1",
+        "store.set@step={outage_step}:for={outage_s}:gen=-1",
+        "store.add@step={outage_step}:for={outage_s}:gen=-1",
+    ]
+cfg.faults.inject = tuple(inject)
+t = Trainer(cfg)
+t.fit()
+t.close()
+"""
+
+
+def _step_intervals(metrics_path: str) -> list[float]:
+    """Wall-clock deltas between consecutive train rows (compile row
+    excluded): the per-step cadence a blocked heartbeat would smear."""
+    ts = []
+    try:
+        with open(metrics_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("tag") == "train" and rec.get("step", 0) >= 2:
+                    ts.append(float(rec["ts"]))
+    except OSError:
+        return []
+    return [b - a for a, b in zip(ts, ts[1:])]
+
+
+def _mean(xs: list[float]) -> float | None:
+    return sum(xs) / len(xs) if xs else None
+
+
+def _run_gang(out_dir: str, steps: int, hang_timeout: float,
+              outage_step: int, outage_s: float,
+              pace: float = 0.35) -> dict[int, int]:
+    """One 2-node elastic gang over the worker above; returns agent
+    return codes by node rank."""
+    import socket
+
+    from pytorch_distributed_train_tpu.elastic import (
+        ElasticAgent,
+        LaunchConfig,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.makedirs(out_dir, exist_ok=True)
+    script = os.path.join(out_dir, "worker.py")
+    with open(script, "w") as f:
+        f.write(_TRAIN_WORKER.format(
+            repo=repo, out=out_dir, steps=steps,
+            hang_timeout=hang_timeout, outage_step=outage_step,
+            outage_s=outage_s, pace=pace))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    if os.environ.get("PDTT_SANITIZE"):
+        env["PDTT_SANITIZE"] = os.environ["PDTT_SANITIZE"]
+    rcs: dict[int, int] = {}
+
+    def agent(node_rank: int) -> None:
+        cfg = LaunchConfig(
+            nprocs=1, max_restarts=0, monitor_interval_s=0.1,
+            nnodes=2, node_rank=node_rank, master_addr="127.0.0.1",
+            store_port=port, rendezvous_window_s=2.0,
+            backoff_base_s=0.05, backoff_max_s=0.1, env=env,
+            events_dir=os.path.join(out_dir, "events"))
+        rcs[node_rank] = ElasticAgent(
+            cfg, [sys.executable, script]).run()
+
+    threads = [threading.Thread(target=agent, args=(r,), daemon=True)
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    return rcs
+
+
+def run_training_drill(seed: int = 0, steps: int = 18,
+                       outage_s: float = 3.0, out_dir: str = "") -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pytorch_distributed_train_tpu.obs.events import load_events
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="store-outage-")
+    rng = random.Random(seed)
+    # early-ish outage: plenty of post-recovery steps for blame_resumed
+    outage_step = rng.randrange(3, 6)
+    hang_timeout = max(0.5, min(2.0, outage_s * 0.6))
+
+    fault_dir = os.path.join(out_dir, "fault")
+    control_dir = os.path.join(out_dir, "control")
+    rcs = _run_gang(fault_dir, steps, hang_timeout, outage_step, outage_s)
+    rcs_control = _run_gang(control_dir, steps, hang_timeout, 0, 0.0)
+
+    steps_seen: list[int] = []
+    try:
+        with open(os.path.join(fault_dir, "metrics-0.jsonl")) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("tag") == "train":
+                    steps_seen.append(int(rec["step"]))
+    except OSError:
+        pass
+    completed = bool(steps_seen) and max(steps_seen, default=0) == steps
+
+    events = load_events(os.path.join(fault_dir, "events"))
+    sentinel_names = [e.get("name") for e in events
+                      if e.get("category") == "sentinel"]
+    store_names = [e.get("name") for e in events
+                   if e.get("category") == "store"]
+    false_blames = sum(1 for n in sentinel_names
+                       if n in ("hang_blamed", "cluster_dump"))
+    degraded = any(n in ("degraded", "down") for n in store_names)
+    recovered = "recovered" in store_names
+    suspended = "blame_suspended" in store_names
+    resumed = "blame_resumed" in store_names
+
+    mean_fault = _mean(_step_intervals(
+        os.path.join(fault_dir, "metrics-0.jsonl")))
+    mean_control = _mean(_step_intervals(
+        os.path.join(control_dir, "metrics-0.jsonl")))
+    # "within noise": bounded beats cost at most beat_timeout_s per
+    # step; the bound guards the REAL regression (an unbounded publish
+    # blocking a step for the store client's multi-second default
+    # timeout), with generous headroom for loaded CI boxes
+    cadence_ok = (mean_fault is not None and mean_control is not None
+                  and mean_fault <= 3.0 * mean_control + 0.35)
+
+    report = {
+        "arm": "train", "seed": seed, "steps": steps,
+        "outage_step": outage_step, "outage_s": outage_s,
+        "hang_timeout_s": hang_timeout,
+        "rcs": {str(k): v for k, v in sorted(rcs.items())},
+        "rcs_control": {str(k): v for k, v in sorted(rcs_control.items())},
+        "completed": completed, "false_hang_blames": false_blames,
+        "store_degraded": degraded, "store_recovered": recovered,
+        "blame_suspended": suspended, "blame_resumed": resumed,
+        "mean_step_s_fault": mean_fault,
+        "mean_step_s_control": mean_control,
+        "cadence_ok": cadence_ok, "out_dir": out_dir,
+    }
+    report["ok"] = bool(
+        rcs.get(0) == 0 and rcs.get(1) == 0
+        and rcs_control.get(0) == 0 and rcs_control.get(1) == 0
+        and completed and false_blames == 0
+        and degraded and recovered and suspended and resumed
+        and cadence_ok)
+    return report
+
+
+# ------------------------------------------------------------- serving arm
+def _spawn_replica(out_dir: str, name: str, store_addr: str,
+                   proc_id: int) -> tuple[subprocess.Popen, str]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "TPUSTORE_ADDR": store_addr,
+           "PROCESS_ID": str(proc_id), "NUM_PROCESSES": "4",
+           "PDTT_EVENTS_DIR": os.path.join(out_dir, "events")}
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tools", "serve_http.py"),
+         "--fake-backend", "--port", "0", "--slots", "4",
+         "--advertise", "--drain-grace", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo)
+    addr = None
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline() if proc.stdout else ""
+        if not line:
+            if proc.poll() is not None:
+                break
+            continue
+        m = re.search(r"serving on http://127\.0\.0\.1:(\d+)", line)
+        if m:
+            addr = f"127.0.0.1:{m.group(1)}"
+            break
+    if addr is None:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        raise RuntimeError(f"replica {name} never came up")
+
+    def _pump():
+        try:
+            for _line in proc.stdout:
+                pass
+        except (OSError, ValueError):
+            pass
+
+    threading.Thread(target=_pump, daemon=True,
+                     name=f"drill-pump-{name}").start()
+    return proc, addr
+
+
+def run_serving_drill(outage_s: float = 2.0, requests: int = 20,
+                      out_dir: str = "") -> dict:
+    from http.server import ThreadingHTTPServer
+
+    import serve_router as serve_router_tool
+    from pytorch_distributed_train_tpu import store_plane
+    from pytorch_distributed_train_tpu.faults import registry as fregistry
+    from pytorch_distributed_train_tpu.native.store import (
+        StoreClient,
+        StoreServer,
+    )
+    from pytorch_distributed_train_tpu.obs import events as events_lib
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
+    from pytorch_distributed_train_tpu.serving_plane.router import (
+        HealthProber,
+        ReplicaSet,
+        Router,
+    )
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="store-outage-serve-")
+    events_lib.configure(os.path.join(out_dir, "events"), who="router")
+    store_plane._reset_for_tests()
+    procs = []
+    front = None
+    prober = None
+    rs = None
+    try:
+        with StoreServer() as srv:
+            store_addr = f"127.0.0.1:{srv.port}"
+            for i, name in enumerate(("a", "b")):
+                procs.append(_spawn_replica(out_dir, name, store_addr,
+                                            i + 1))
+            host, port_s = store_addr.split(":")
+            rs = store_plane.ResilientStore(
+                lambda: StoreClient(host, int(port_s)), name="router")
+            # prime the last-known-good cache: discovery must have seen
+            # both replicas BEFORE the blackout for the cache to serve
+            deadline = time.monotonic() + 30.0
+            found: list = []
+            while time.monotonic() < deadline and len(found) < 2:
+                try:
+                    found = rs.discover_replicas()
+                except OSError:
+                    pass
+                time.sleep(0.1)
+            if len(found) < 2:
+                raise RuntimeError("replicas never advertised")
+            replicas = ReplicaSet(())
+            prober = HealthProber(replicas, interval_s=0.2,
+                                  refresh=rs.discover_replicas)
+            prober.probe_once()
+            router = Router(replicas, timeout_s=30.0)
+            front = ThreadingHTTPServer(
+                ("127.0.0.1", 0),
+                serve_router_tool.make_handler(router, prober))
+            threading.Thread(target=front.serve_forever,
+                             daemon=True).start()
+            prober.start()
+            fport = front.server_address[1]
+
+            # ---- blackout: every store.get in THIS process raises for
+            # the window; the prober keeps refreshing from LKG cache
+            lkg_before = get_registry().get_value(
+                "store_lkg_reads_total", {"registry": "replicas"}) or 0.0
+            # blackout BOTH discovery ops: the registry read leads with
+            # add(COUNT, 0), so a get-only window would let the counter
+            # read through and never trip the health machine's
+            # consecutive-failure gate
+            fregistry.configure(
+                (f"store.add@call=1:for={outage_s}:gen=-1",
+                 f"store.get@call=1:for={outage_s}:gen=-1"))
+            t0 = time.monotonic()
+            ok_n, fail_n = 0, 0
+            while time.monotonic() - t0 < outage_s:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{fport}/v1/completions",
+                    data=json.dumps({"prompt": "through the blackout",
+                                     "max_tokens": 4}).encode(),
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        ok_n += 1 if r.status == 200 else 0
+                        fail_n += 0 if r.status == 200 else 1
+                        r.read()
+                except Exception:
+                    fail_n += 1
+                if ok_n + fail_n >= requests:
+                    break
+                time.sleep(max(0.0, outage_s / max(1, requests) / 2))
+            mid_state = store_plane.health_snapshot().get("state")
+            lkg_after = get_registry().get_value(
+                "store_lkg_reads_total", {"registry": "replicas"}) or 0.0
+
+            # ---- recovery: wait out the window, then a refresh must
+            # succeed and walk the health machine back to ok
+            deadline = time.monotonic() + max(10.0, outage_s + 10.0)
+            state = mid_state
+            while time.monotonic() < deadline and state != "ok":
+                try:
+                    rs.discover_replicas()
+                except OSError:
+                    pass
+                state = store_plane.health_snapshot().get("state")
+                time.sleep(0.1)
+            report = {
+                "arm": "serve", "outage_s": outage_s,
+                "requests_ok": ok_n, "requests_failed": fail_n,
+                "lkg_reads": lkg_after - lkg_before,
+                "state_during_outage": mid_state,
+                "state_after": state, "out_dir": out_dir,
+            }
+            report["ok"] = bool(
+                ok_n > 0 and fail_n == 0
+                and lkg_after > lkg_before
+                and mid_state in ("degraded", "down")
+                and state == "ok")
+            return report
+    finally:
+        fregistry.configure(())
+        if prober is not None:
+            prober.stop()
+        if front is not None:
+            front.shutdown()
+            front.server_close()
+        if rs is not None:
+            rs.close()
+        for proc, _addr in procs:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train", action="store_true",
+                   help="training blackout arm (the default)")
+    p.add_argument("--serve", action="store_true",
+                   help="serving registry-blackout arm instead")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=18)
+    p.add_argument("--outage", type=float, default=0.0,
+                   help="blackout seconds (default 3.0 train / "
+                        "2.0 serve)")
+    p.add_argument("--out", default="", help="run dir (default: tempdir)")
+    p.add_argument("--sanitize", action="store_true",
+                   help="run under the tsan-lite concurrency sanitizer "
+                        "(PDTT_SANITIZE=1 inherited by workers)")
+    args = p.parse_args(argv)
+    if args.sanitize:
+        os.environ["PDTT_SANITIZE"] = "1"
+    from pytorch_distributed_train_tpu.utils import syncdbg
+
+    syncdbg.maybe_activate()
+    if args.serve:
+        report = run_serving_drill(outage_s=args.outage or 2.0,
+                                   out_dir=args.out)
+    else:
+        report = run_training_drill(seed=args.seed, steps=args.steps,
+                                    outage_s=args.outage or 3.0,
+                                    out_dir=args.out)
+    if syncdbg.active():
+        syncdbg.check_teardown()
+        summary = syncdbg.findings_summary()
+        report["sanitizer_findings"] = summary
+        if summary:
+            for f in syncdbg.findings():
+                print(f"FAIL: sanitizer {f.kind}: {f.message}",
+                      file=sys.stderr)
+            report["ok"] = False
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

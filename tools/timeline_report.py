@@ -56,6 +56,7 @@ _MARKS = {
     "perf": "PERF",
     "alert": "ALERT",
     "action": "ACTION",
+    "store": "STORE",
     "lifecycle": "",
     "ckpt": "",
 }
@@ -101,6 +102,14 @@ _LANDMARKS = _RECOVERIES | {
     ("action", "failed"),
     ("action", "rolled_back"),
     ("action", "mode"),
+    # launcher-store health arc (store_plane.py): a control-plane
+    # outage and its recovery — plus the liveness blame suspension it
+    # forces — ARE the run's shape while they last
+    ("store", "degraded"),
+    ("store", "down"),
+    ("store", "recovered"),
+    ("store", "blame_suspended"),
+    ("store", "blame_resumed"),
 }
 
 
