@@ -107,6 +107,23 @@ POINTS: dict[str, str] = {
                                  # op (latency storm: ops hit their
                                  # ResilientStore deadline instead of
                                  # stalling the caller)
+    # Online post-training plane drill points (online/;
+    # docs/online_training.md). The loop's three failure surfaces:
+    # publishing trainer weights, swapping them onto a replica, and
+    # harvesting rollouts — each must degrade (keep the old version /
+    # retry the fetch), never corrupt state or fail live requests.
+    "weights.publish": "raise",  # trainer-side weight publish to the
+                                 # KV store (online/publisher.py): the
+                                 # step loop's cadence skips a beat,
+                                 # replicas keep serving and lag grows
+    "weights.swap": "raise",     # replica-side swap request (serve_http
+                                 # /admin/weights): 503 to the caller,
+                                 # the replica keeps its current version
+    "rollout.fetch": "raise",    # rollout harvest HTTP fetch
+                                 # (online/rollouts.py; retry_call at
+                                 # the driver wraps it — exhausted
+                                 # retries skip the batch, never feed a
+                                 # partial one to a train step)
 }
 
 

@@ -263,6 +263,56 @@ def make_dpo_loss(beta: float):
     return fn
 
 
+def make_grpo_loss(clip_eps: float = 0.2):
+    """Group-relative policy loss over harvested rollouts (online/ —
+    the GRPO surrogate of Shao et al. 2024, value-model-free).
+
+    Batch layout (online/rollouts.to_grpo_batch): ``input_ids`` (B, S)
+    prompt+completion, ``loss_mask`` (B, S) — 1.0 exactly on the
+    SAMPLED completion tokens — and ``advantage`` (B,), the per-prompt-
+    group normalized reward ((r - mean) / std over the group: "better
+    than the other samples of this prompt" is the whole baseline).
+
+    Per-token surrogate: -advantage * logpi(sampled token), masked and
+    token-mean'd. When the batch also carries ``behavior_logprobs``
+    (B, S) — the generating policy's per-token logprobs, aligned to the
+    same positions — the PPO-style clipped-ratio objective bounds the
+    update against off-policy drift (rollouts from version V training
+    version V+k); without them the ratio is 1 and this reduces to
+    REINFORCE with the group baseline.
+    """
+    if clip_eps < 0.0:
+        raise ValueError(f"grpo clip_eps must be >= 0, got {clip_eps}")
+
+    def fn(logits, batch, *_):
+        ids = batch["input_ids"]  # (B, S)
+        mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        logp = jnp.take_along_axis(
+            lp, ids[:, 1:, None], axis=-1)[..., 0]  # (B, S-1)
+        adv = jax.lax.stop_gradient(
+            batch["advantage"].astype(jnp.float32))[:, None]
+        if "behavior_logprobs" in batch:
+            behavior = jax.lax.stop_gradient(
+                batch["behavior_logprobs"][:, 1:].astype(jnp.float32))
+            ratio = jnp.exp(logp - behavior)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv)
+            per_tok = -surr
+        else:
+            per_tok = -adv * logp
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_tok * mask).sum() / denom
+        return loss, {
+            "sampled_tokens": mask.sum(),
+            "mean_advantage": batch["advantage"].mean(),
+            "mean_sample_logp": (logp * mask).sum() / denom,
+        }
+
+    return fn
+
+
 LOSSES = {
     "softmax_xent": softmax_xent,
     "mlm_xent": mlm_xent,

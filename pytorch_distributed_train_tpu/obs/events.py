@@ -82,6 +82,9 @@ CATEGORIES: dict[str, str] = {
              "(degraded/down/recovered), liveness blame suspensions "
              "during store outages (store_plane.py, "
              "sentinel/liveness.py)",
+    "weights": "online post-training plane: weight publishes, replica "
+               "swaps (applied/rejected), rollout batches "
+               "(online/, tools/serve_http.py)",
 }
 
 

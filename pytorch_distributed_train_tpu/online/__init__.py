@@ -1,0 +1,40 @@
+"""Online post-training plane: serving rollouts feed the trainer and
+updated weights stream back onto the serving mesh with no storage
+round-trip (docs/online_training.md).
+
+Three pieces close the loop:
+
+- ``rollouts``  — drives completion traffic through the serving plane
+  (router or direct replica), harvesting prompt/completion/logprob
+  records into versioned ``RolloutBatch``es tagged with the generating
+  ``weight_version``, plus the GRPO-style conversion into train batches.
+- ``publisher`` — seals the trainer's params at a step cadence via the
+  ckpt shard wire format (``take_shard_snapshot`` → per-host CRC'd
+  publish → ``assemble_shards``) onto the launcher KV store, and the
+  fetch/reshard half a serving replica runs on swap.
+- ``swap``      — the replica-side mutable weight-version state machine
+  behind ``POST /admin/weights`` (tools/serve_http.py): a fetched and
+  verified version is STAGED by the handler thread and APPLIED by the
+  scheduler thread between decode quanta, so an in-flight request never
+  observes a half-swapped model and never fails because of a swap.
+
+``tools/online_loop.py`` wires the three into one supervised loop.
+"""
+
+from pytorch_distributed_train_tpu.online.publisher import (  # noqa: F401
+    WeightPublisher,
+    fetch_version,
+    latest_meta,
+    place_leaves,
+    publish_version,
+)
+from pytorch_distributed_train_tpu.online.rollouts import (  # noqa: F401
+    RolloutBatch,
+    RolloutCollector,
+    RolloutRecord,
+    to_grpo_batch,
+)
+from pytorch_distributed_train_tpu.online.swap import (  # noqa: F401
+    PendingSwap,
+    WeightState,
+)

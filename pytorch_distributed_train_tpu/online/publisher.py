@@ -1,0 +1,273 @@
+"""Trainer → serving weight publication over the launcher KV store.
+
+The trainer seals its live params with the ckpt shard wire format
+(ckpt/snapshot.py): each host ships only the array shards it OWNS as a
+``part_<k>`` npz payload plus a CRC'd header, and any reader can
+reassemble the GLOBAL flatten-order leaves with ``assemble_shards`` —
+the format is mesh-agnostic, so a 1-proc serving replica restores a
+2-proc trainer's params (and vice versa) bit-exactly, then
+``place_leaves`` device_puts them into ITS mesh's shardings (the same
+placement glue as ckpt/manager._place_leaves).
+
+Wire layout (all keys under one namespace, chunking as ckpt/peer.py —
+chunks land BEFORE the meta key, and metas before the seal: the store
+has no transactions, write ordering is the atomicity)::
+
+    wts/latest                 JSON: {version, step, hosts, sealed_at}
+    wts/<ver>/sealed           same JSON, per version (fetch by version)
+    wts/<ver>/<host>/meta      JSON: shard header + chunking info
+    wts/<ver>/<host>/c<i>      payload chunks (<= CHUNK_BYTES each)
+
+Versions are a monotonically increasing int assigned by the publisher
+(NOT the trainer step — the step rides in the meta so replicas can
+report lag in steps). The last ``KEEP_VERSIONS`` versions stay on the
+store so a replica mid-fetch of version V survives V+1 landing; older
+chunks are deleted after each seal.
+
+Fault point ``weights.publish`` (faults/registry.py) traverses the
+publish path; a corrupt chunk on the store is caught by the payload
+CRC at fetch time and reads as "version unavailable" — the replica
+keeps serving its current version (docs/fault_tolerance.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import zlib
+
+import jax
+import numpy as np
+
+from pytorch_distributed_train_tpu.ckpt import snapshot as snapshot_lib
+from pytorch_distributed_train_tpu.faults import registry as faults_registry
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+CHUNK_BYTES = 512 * 1024  # store get() buffers default to 1 MiB
+KEEP_VERSIONS = 2  # newest + previous (in-flight fetch survives a seal)
+_NS = "wts"
+
+
+def _latest_key() -> str:
+    return f"{_NS}/latest"
+
+
+def _sealed_key(version: int) -> str:
+    return f"{_NS}/{int(version)}/sealed"
+
+
+def _meta_key(version: int, host: int) -> str:
+    return f"{_NS}/{int(version)}/{int(host)}/meta"
+
+
+def _chunk_key(version: int, host: int, i: int) -> str:
+    return f"{_NS}/{int(version)}/{int(host)}/c{int(i)}"
+
+
+def publish_shard(store, *, version: int, host: int, payload: bytes,
+                  header: dict, chunk_bytes: int = CHUNK_BYTES) -> None:
+    """One host's shard payload for ``version``: chunks first, then the
+    meta naming them (a reader that sees meta can read every chunk)."""
+    n_chunks = max(1, (len(payload) + chunk_bytes - 1) // chunk_bytes)
+    for i in range(n_chunks):
+        store.set(_chunk_key(version, host, i),
+                  payload[i * chunk_bytes:(i + 1) * chunk_bytes])
+    meta = dict(header)
+    meta.update(n_chunks=n_chunks, payload_bytes=len(payload),
+                payload_crc32=zlib.crc32(payload))
+    store.set(_meta_key(version, host),
+              json.dumps(meta, sort_keys=True).encode())
+
+
+def seal_version(store, *, version: int, step: int, hosts) -> dict:
+    """Flip ``wts/latest`` to ``version`` after every host's meta is in,
+    then GC versions older than ``KEEP_VERSIONS``. Returns the seal
+    record replicas read."""
+    info = {"version": int(version), "step": int(step),
+            "hosts": [int(h) for h in hosts], "sealed_at": time.time()}
+    blob = json.dumps(info, sort_keys=True).encode()
+    store.set(_sealed_key(version), blob)
+    store.set(_latest_key(), blob)
+    _gc_version(store, int(version) - KEEP_VERSIONS)
+    return info
+
+
+def _gc_version(store, version: int) -> None:
+    if version < 1:
+        return
+    try:
+        info = json.loads(store.get(_sealed_key(version),
+                                    timeout_ms=50).decode())
+    except Exception:
+        return  # never sealed / already collected
+    for host in info.get("hosts", []):
+        try:
+            meta = json.loads(store.get(_meta_key(version, host),
+                                        timeout_ms=50).decode())
+            for i in range(int(meta.get("n_chunks", 0))):
+                store.delete(_chunk_key(version, host, i))
+            store.delete(_meta_key(version, host))
+        except Exception:
+            continue  # best-effort housekeeping
+    try:
+        store.delete(_sealed_key(version))
+    except Exception:
+        pass
+
+
+def publish_version(store, savable: dict, *, version: int, step: int,
+                    owned_preds: dict | None = None,
+                    chunk_bytes: int = CHUNK_BYTES) -> dict:
+    """Seal + publish ``savable`` (checkpoint._savable layout, typically
+    ``{"params": ...}``) as ``version``.
+
+    Single-controller convenience covering every host in one call:
+    ``owned_preds`` maps host id → shard-ownership predicate (tests and
+    the online_loop driver simulate a multi-host trainer by partitioning
+    device ids; ``{0: None}`` — the default — is the single-host job,
+    owning every replica-0 shard). A real multi-host job calls
+    ``publish_shard`` per process and ``seal_version`` on host 0 after a
+    barrier, same split as ckpt/peer.py.
+    """
+    faults_registry.maybe_fire("weights.publish", step=step)
+    preds = owned_preds if owned_preds else {0: None}
+    for host, pred in preds.items():
+        payload, header = snapshot_lib.take_shard_snapshot(
+            savable, step=step, meta={"weight_version": int(version)},
+            origin="online", owned=pred)
+        publish_shard(store, version=version, host=host, payload=payload,
+                      header=header, chunk_bytes=chunk_bytes)
+    info = seal_version(store, version=version, step=step,
+                        hosts=list(preds))
+    get_registry().counter(
+        "weights_published_total",
+        help="weight versions sealed onto the online publish "
+             "plane").inc()
+    events_lib.emit("weights", "publish", step=step,
+                    version=int(version), hosts=len(preds))
+    return info
+
+
+def latest_meta(store) -> dict | None:
+    """The newest seal record {version, step, hosts, sealed_at}, or None
+    when nothing has been published."""
+    try:
+        return json.loads(store.get(_latest_key(), timeout_ms=50).decode())
+    except Exception:
+        return None
+
+
+def _fetch_host(store, version: int, host: int,
+                chunk_timeout_ms: int) -> tuple[bytes, dict] | None:
+    """One host's (payload, header) for ``version``, CRC-verified end to
+    end — a corrupt or torn transfer reads as "not found"."""
+    try:
+        meta = json.loads(store.get(_meta_key(version, host),
+                                    timeout_ms=50).decode())
+    except Exception:
+        return None
+    if not meta.get("sealed") or meta.get("shard_format") != 1:
+        return None
+    chunks = []
+    try:
+        for i in range(int(meta["n_chunks"])):
+            chunks.append(store.get(_chunk_key(version, host, i),
+                                    timeout_ms=chunk_timeout_ms))
+    except Exception:
+        return None
+    payload = b"".join(chunks)
+    if (len(payload) != int(meta["payload_bytes"])
+            or zlib.crc32(payload) != int(meta["payload_crc32"])):
+        return None
+    return payload, meta
+
+
+def fetch_version(store, version: int | None = None, *,
+                  chunk_timeout_ms: int = 10_000):
+    """Replica-side fetch: ``(info, leaves, header)`` — the seal record,
+    GLOBAL flatten-order numpy leaves (every host's shards reassembled
+    and per-part CRC-verified by ``assemble_shards``), and the shard
+    header — or None when the version is unsealed, incomplete, or any
+    byte fails its CRC. None NEVER means "partially applied": the
+    caller keeps serving its current weights."""
+    try:
+        key = _latest_key() if version is None else _sealed_key(version)
+        info = json.loads(store.get(key, timeout_ms=50).decode())
+    except Exception:
+        return None
+    fetched = []
+    for host in info.get("hosts", []):
+        got = _fetch_host(store, int(info["version"]), int(host),
+                          chunk_timeout_ms)
+        if got is None:
+            return None
+        fetched.append(got)
+    assembled = snapshot_lib.assemble_shards(fetched)
+    if assembled is None:
+        return None
+    leaves, header = assembled
+    return info, leaves, header
+
+
+def place_leaves(template, leaves: list[np.ndarray]):
+    """Host leaves → device arrays in ``template``'s shardings (the
+    serving mesh's layout), rebuilt into the template's structure — the
+    ckpt/manager._place_leaves placement glue without the TrainState
+    wrapper. None on any count/shape/dtype mismatch (e.g. a quantized
+    serving tree): the caller rejects the swap instead of serving a
+    half-cast model."""
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    if not snapshot_lib.leaves_match_template(leaves, t_leaves):
+        return None
+    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    try:
+        return jax.tree.map(
+            lambda t, h: jax.device_put(h, getattr(t, "sharding", None)),
+            template, tree)
+    except (ValueError, TypeError) as e:
+        print(f"[online] weight placement failed "
+              f"({type(e).__name__}: {e}); keeping current weights",
+              flush=True)
+        return None
+
+
+class WeightPublisher:
+    """Cadence wrapper the trainer step loop holds: every
+    ``cadence_steps`` steps, seal the live params as the next version.
+
+    ``store`` may be None (no TPUSTORE_ADDR — e.g. a unit test trainer):
+    ``maybe_publish`` is then a no-op returning None, same stance as
+    ckpt/peer publication outside a tpurun job.
+    """
+
+    def __init__(self, store, *, cadence_steps: int = 10,
+                 owned_preds: dict | None = None,
+                 chunk_bytes: int = CHUNK_BYTES):
+        if cadence_steps < 1:
+            raise ValueError("cadence_steps must be >= 1")
+        self.store = store
+        self.cadence_steps = int(cadence_steps)
+        self.owned_preds = owned_preds
+        self.chunk_bytes = int(chunk_bytes)
+        self.version = 0  # last published (0 = nothing yet)
+        self.published_step = -1
+
+    def due(self, step: int) -> bool:
+        return (self.store is not None
+                and int(step) >= self.published_step + self.cadence_steps)
+
+    def publish(self, savable: dict, *, step: int) -> int:
+        """Unconditionally publish as the next version; returns it."""
+        version = self.version + 1
+        publish_version(self.store, savable, version=version,
+                        step=int(step), owned_preds=self.owned_preds,
+                        chunk_bytes=self.chunk_bytes)
+        self.version = version
+        self.published_step = int(step)
+        return version
+
+    def maybe_publish(self, savable: dict, *, step: int) -> int | None:
+        if not self.due(step):
+            return None
+        return self.publish(savable, step=step)

@@ -1,0 +1,197 @@
+"""Rollout harvesting: serving completions → versioned train batches.
+
+The collector is a plain HTTP client of the serving plane — it speaks
+the same ``/v1/completions`` contract as any user, through the router
+or a direct replica, so rollout traffic exercises exactly the
+production request path (admission, deadlines, tracing). Each sampled
+completion comes back stamped with the ``weight_version`` that
+generated it (tools/serve_http.py attaches the version current at
+submit time), so a batch spanning a live swap is visibly mixed-version
+rather than silently stale: ``RolloutBatch.weight_version`` is the
+dominant generating version and ``versions()`` the full census.
+
+Group sampling (``group_size`` completions per prompt via the serving
+``n=`` fan-out, sharing one prefill) feeds the GRPO-style conversion
+``to_grpo_batch``: rewards are normalized WITHIN each prompt group
+(advantage = (r - mean) / std), so the train signal is "better than
+the other samples of this prompt", needing no learned value baseline.
+
+Fault point ``rollout.fetch`` (faults/registry.py) traverses every
+collection request; callers wrap ``collect`` in faults/retry.py's
+``retry_call`` — transport errors (urllib raises OSError subclasses)
+retry and then surface, they never poison a train step with a partial
+batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from pytorch_distributed_train_tpu.faults import registry as faults_registry
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+
+@dataclasses.dataclass
+class RolloutRecord:
+    """One sampled completion, tagged with what generated it."""
+
+    prompt: str
+    completion: str
+    finish_reason: str
+    weight_version: str  # serving-side version at submit time
+    group: int  # prompt-group id (group-relative advantage)
+    logprobs: list | None = None  # serving-side per-token logprobs
+
+
+@dataclasses.dataclass
+class RolloutBatch:
+    """An ordered harvest of rollout records, version-tagged."""
+
+    records: list
+    collected_at: float = dataclasses.field(default_factory=time.time)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def versions(self) -> dict[str, int]:
+        """Generating weight_version → record count."""
+        out: dict[str, int] = {}
+        for r in self.records:
+            out[r.weight_version] = out.get(r.weight_version, 0) + 1
+        return out
+
+    @property
+    def weight_version(self) -> str:
+        """The dominant generating version (ties break to the newest
+        insertion — irrelevant in practice: a batch spans at most one
+        swap boundary)."""
+        census = self.versions()
+        if not census:
+            return ""
+        return max(census, key=census.get)
+
+
+class RolloutCollector:
+    """Drives completion traffic through the serving plane and harvests
+    the responses. ``base_url`` is a router or replica root
+    (``http://host:port``); ``traceparent`` headers propagate the
+    driver's trace so rollout requests land in its causal chain."""
+
+    def __init__(self, base_url: str, *, group_size: int = 4,
+                 max_tokens: int = 16, temperature: float = 0.9,
+                 timeout_s: float = 30.0):
+        if group_size < 1:
+            raise ValueError("group_size must be >= 1")
+        self.base_url = base_url.rstrip("/")
+        self.group_size = int(group_size)
+        self.max_tokens = int(max_tokens)
+        self.temperature = float(temperature)
+        self.timeout_s = float(timeout_s)
+
+    def _post_json(self, path: str, obj: dict,
+                   traceparent: str | None = None) -> dict:
+        # `rollout.fetch` fault point: an injected transport fault is an
+        # OSError, exactly what a dead replica raises — the caller's
+        # retry_call wrapper sees both identically.
+        faults_registry.maybe_fire("rollout.fetch")
+        body = json.dumps(obj).encode()
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            headers["traceparent"] = traceparent
+        req = urllib.request.Request(self.base_url + path, data=body,
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def sample_group(self, prompt: str, group: int,
+                     traceparent: str | None = None) -> list[RolloutRecord]:
+        """``group_size`` sampled completions of one prompt (the serving
+        ``n=`` fan-out shares the prefill across the group)."""
+        obj = {"prompt": prompt, "max_tokens": self.max_tokens,
+               "temperature": self.temperature, "logprobs": True}
+        if self.group_size > 1:
+            obj["n"] = self.group_size
+        out = self._post_json("/v1/completions", obj, traceparent)
+        version = str(out.get("weight_version", ""))
+        choices = out.get("choices") or [out]
+        return [RolloutRecord(prompt=prompt,
+                              completion=str(c.get("text", "")),
+                              finish_reason=str(c.get("finish_reason", "")),
+                              weight_version=version, group=group,
+                              logprobs=c.get("logprobs"))
+                for c in choices]
+
+    def collect(self, prompts, *,
+                traceparent: str | None = None) -> RolloutBatch:
+        """One rollout batch: a group per prompt, in order."""
+        records: list[RolloutRecord] = []
+        for gid, prompt in enumerate(prompts):
+            records.extend(self.sample_group(prompt, gid, traceparent))
+        batch = RolloutBatch(records=records)
+        get_registry().counter(
+            "rollout_batches_total",
+            help="rollout batches harvested from the serving "
+                 "plane").inc()
+        events_lib.emit("weights", "rollout_batch",
+                        records=len(records),
+                        version=batch.weight_version or "?")
+        return batch
+
+
+def group_advantages(rewards: dict[int, list[float]],
+                     eps: float = 1e-6) -> dict[int, list[float]]:
+    """Per-group (reward - mean) / std — the GRPO baseline. A group
+    whose rewards are all equal gets zero advantage (no signal, no
+    noise) rather than a 0/0."""
+    out: dict[int, list[float]] = {}
+    for gid, rs in rewards.items():
+        arr = np.asarray(rs, np.float32)
+        std = float(arr.std())
+        mean = float(arr.mean())
+        if std < eps:
+            out[gid] = [0.0] * len(rs)
+        else:
+            out[gid] = [float((r - mean) / std) for r in arr]
+    return out
+
+
+def to_grpo_batch(batch: RolloutBatch, encode, reward_fn, *,
+                  seq_len: int, pad_id: int = 0) -> dict:
+    """RolloutBatch → numpy train batch for losses.make_grpo_loss.
+
+    ``encode`` is the TRAINER's tokenizer (ids may differ from the
+    serving tokenizer's only in implementation, not vocab); the prompt
+    is re-encoded alone to find where completion positions start, so
+    ``loss_mask`` covers exactly the sampled tokens. ``reward_fn:
+    (prompt, completion) -> float`` scores each record; advantages are
+    group-relative (``group_advantages``). Static shapes: every row
+    pads/truncates to ``seq_len``.
+
+    Returns {'input_ids': (N,S) int32, 'loss_mask': (N,S) float32,
+    'advantage': (N,) float32}.
+    """
+    rewards: dict[int, list[float]] = {}
+    for r in batch.records:
+        rewards.setdefault(r.group, []).append(
+            float(reward_fn(r.prompt, r.completion)))
+    advs = group_advantages(rewards)
+    cursor = {gid: 0 for gid in advs}
+    ids = np.full((len(batch.records), seq_len), pad_id, np.int32)
+    mask = np.zeros((len(batch.records), seq_len), np.float32)
+    adv = np.zeros((len(batch.records),), np.float32)
+    for row, r in enumerate(batch.records):
+        p_ids = list(encode(r.prompt))
+        full = p_ids + list(encode(r.completion))
+        full = full[:seq_len]
+        ids[row, : len(full)] = full
+        mask[row, min(len(p_ids), seq_len): len(full)] = 1.0
+        k = cursor[r.group]
+        cursor[r.group] += 1
+        adv[row] = advs[r.group][k]
+    return {"input_ids": ids, "loss_mask": mask, "advantage": adv}

@@ -1,0 +1,170 @@
+"""Replica-side weight-swap state machine (tools/serve_http.py).
+
+``WeightState`` is the ONE mutable home of a serving process's weight
+version — ``--weight-version`` seeds it at boot and every live swap
+advances it, so /healthz, span correlation tags and completion
+responses all read the same moving value (the frozen-at-boot version
+was the bug this plane fixes).
+
+Two-thread protocol, mirroring the service's submit/step split:
+
+- the ``POST /admin/weights`` HANDLER thread fetches + CRC-verifies the
+  published version and prepares the placed params OFF the scheduler
+  lock (the expensive half), then ``stage()``s a pending swap and waits;
+- the SCHEDULER thread calls ``apply_pending()`` between decode quanta
+  (under the service lock, where nothing is mid-forward): the apply is
+  a cheap attribute flip, so in-flight requests straddle the swap
+  without failing — they simply complete at the version they were
+  admitted under, observable via the ``weight_version`` stamped on
+  their responses and spans.
+
+A verify/fetch failure never reaches ``stage()``: the replica keeps
+serving its current version (docs/fault_tolerance.md, ``weights.swap``
+row). Only one swap stages at a time — a second concurrent POST gets
+"busy" and retries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs import spans as spans_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+
+
+@dataclasses.dataclass
+class PendingSwap:
+    version: str
+    step: int
+    apply_fn: object  # zero-arg callable flipping the params, or None
+    t0: float  # monotonic, at fetch start (the swap-latency clock)
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    error: str | None = None
+    duration_s: float = 0.0
+
+
+class WeightState:
+    """Mutable weight version + the staged-swap slot. Every critical
+    section is a field read/write — the lock is never held across the
+    apply, metrics, or journaling (the scheduler calls those unlocked:
+    it is the only applier)."""
+
+    def __init__(self, version: str = "0", step: int = 0):
+        self._lock = threading.Lock()
+        self._version = str(version)
+        self._step = int(step)
+        self._published_version = 0  # newest seen on the publish plane
+        self._published_step = -1
+        self._swaps = 0
+        self._rejects = 0
+        self._last_swap_wall = 0.0
+        self._pending: PendingSwap | None = None
+
+    # ------------------------------------------------------------ reads
+    @property
+    def version(self) -> str:
+        with self._lock:
+            return self._version
+
+    def snapshot(self) -> dict:
+        """The /healthz ``weights`` section."""
+        with self._lock:
+            out = {"version": self._version, "step": self._step,
+                   "published_version": self._published_version,
+                   "published_step": self._published_step,
+                   "lag_steps": self._lag_locked(),
+                   "swaps": self._swaps, "rejects": self._rejects,
+                   "last_swap_age_s": (
+                       round(time.time() - self._last_swap_wall, 3)
+                       if self._last_swap_wall else None),
+                   "pending": self._pending is not None}
+        return out
+
+    def _lag_locked(self) -> int | None:
+        if self._published_step < 0:
+            return None
+        return max(0, self._published_step - self._step)
+
+    # ---------------------------------------------------------- updates
+    def note_published(self, version: int, step: int) -> None:
+        """Record the publish plane's newest (version, step) — every
+        swap POST carries it, so the lag gauge stays fresh even when
+        the swap itself is a no-op."""
+        with self._lock:
+            self._published_version = max(self._published_version,
+                                          int(version))
+            self._published_step = max(self._published_step, int(step))
+            lag = self._lag_locked()
+        if lag is not None:
+            _lag_gauge().set(lag)
+
+    def reject(self, version, reason: str) -> None:
+        """A fetch/verify/placement failure: count + journal it; the
+        serving version is untouched."""
+        with self._lock:
+            self._rejects += 1
+            current = self._version
+        events_lib.emit("weights", "swap_rejected", version=str(version),
+                        reason=reason, serving=current)
+
+    def stage(self, pending: PendingSwap) -> bool:
+        """Park a verified swap for the scheduler. False when another
+        swap is already staged (caller answers "busy")."""
+        with self._lock:
+            if self._pending is not None:
+                return False
+            self._pending = pending
+        return True
+
+    def apply_pending(self) -> bool:
+        """Scheduler-thread entry, between decode quanta: flip the
+        params (if any), advance the version, re-stamp the span
+        correlation tag, record latency + lag, wake the handler."""
+        with self._lock:
+            p = self._pending
+            if p is None:
+                return False
+            self._pending = None
+            old = self._version
+        if p.apply_fn is not None:
+            try:
+                p.apply_fn()
+            except Exception as e:  # noqa: BLE001 — reject, keep serving
+                p.error = f"{type(e).__name__}: {e}"
+                self.reject(p.version, f"apply: {p.error}")
+                p.done.set()
+                return False
+        dur = time.monotonic() - p.t0
+        with self._lock:
+            self._version = str(p.version)
+            self._step = int(p.step)
+            self._swaps += 1
+            self._last_swap_wall = time.time()
+            lag = self._lag_locked()
+        # every span recorded from here on carries the NEW version —
+        # the old/new tag flip the timeline report keys on
+        spans_lib.set_correlation_tags(weight_version=str(p.version))
+        get_registry().histogram(
+            "weight_swap_seconds",
+            help="fetch→verify→place→apply latency of a live weight "
+                 "swap").observe(dur)
+        if lag is not None:
+            _lag_gauge().set(lag)
+        events_lib.emit("weights", "swap", version=str(p.version),
+                        step=int(p.step), old_version=old,
+                        dur_s=round(dur, 6))
+        p.duration_s = dur
+        p.done.set()
+        return True
+
+
+def _lag_gauge():
+    return get_registry().gauge(
+        "replica_weight_lag_steps",
+        help="trainer's newest published step minus this replica's "
+             "serving step (0 = fresh; each replica reports its own, "
+             "scraped per-target)")

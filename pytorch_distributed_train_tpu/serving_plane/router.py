@@ -589,3 +589,47 @@ class Router:
                     time.sleep(poll_s)
             report.append(entry)
         return report
+
+    def weight_sync(self, *, version: int | None = None,
+                    traceparent: str | None = None,
+                    timeout_s: float = 60.0) -> list[dict]:
+        """Broadcast a live weight swap to every routable replica
+        (serve_http's ``POST /admin/weights``) — the online loop's
+        one-call "swap the fleet" (docs/online_training.md).
+
+        Sequential on purpose: at most one replica pays its swap pause
+        at a time, so fleet capacity never dips by more than one
+        replica's worth — the weight-plane analogue of the rolling
+        restart above. Per-replica failures land in the report (the
+        caller retries laggards next cycle); they never abort the walk.
+        """
+        body = json.dumps(
+            {} if version is None else {"version": int(version)}).encode()
+        headers = ({"traceparent": traceparent} if traceparent else None)
+        report: list[dict] = []
+        for addr in list(self.replicas.addrs()):
+            rep = self.replicas.get(addr)
+            if rep is None or rep.state == "down":
+                report.append({"addr": addr, "skipped": "down"})
+                continue
+            try:
+                status, raw = http_json(addr, "/admin/weights", body,
+                                        timeout_s, headers=headers)
+            except OSError as e:
+                report.append({"addr": addr,
+                               "error": f"{type(e).__name__}: {e}"})
+                continue
+            entry = {"addr": addr, "http_status": status}
+            try:
+                out = json.loads(raw)
+                if isinstance(out, dict):
+                    entry.update(out)
+            except ValueError:
+                entry["error"] = "non-json swap response"
+            report.append(entry)
+        swapped = sum(1 for e in report if e.get("status") == "swapped")
+        events_lib.emit("weights", "fleet_sync",
+                        version=(int(version) if version is not None
+                                 else "latest"),
+                        replicas=len(report), swapped=swapped)
+        return report

@@ -85,6 +85,10 @@ class LockScopePass(AnalysisPass):
         # shared-memory decode plane (ISSUE 12): its queues sit on the
         # input hot path — no blocking work under any lock here
         "pytorch_distributed_train_tpu/data/workers.py",
+        # online weight plane (ISSUE 19): WeightState sits between the
+        # swap handler and the serving scheduler — a blocking call
+        # under its lock stalls every decode quantum
+        "pytorch_distributed_train_tpu/online/",
         "tools/serve_*.py",
     )
 

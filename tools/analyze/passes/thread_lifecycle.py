@@ -38,9 +38,11 @@ SCOPE = (
     "pytorch_distributed_train_tpu/elastic.py",
     "pytorch_distributed_train_tpu/data/workers.py",
     "pytorch_distributed_train_tpu/fleet/",
+    "pytorch_distributed_train_tpu/online/",
     "tools/serve_http.py",
     "tools/serve_router.py",
     "tools/fleet_controller.py",
+    "tools/online_loop.py",
 )
 
 

@@ -301,6 +301,51 @@ def controller_panel(events: list[dict], last: int = 5) -> list[str]:
     return out
 
 
+def weights_panel(events: list[dict], last: int = 4) -> list[str]:
+    """Online weight-sync panel, replayed from the ``weights`` journal
+    category (online/, tools/serve_http.py): the newest published
+    version, each replica's last applied swap (so a laggard is one
+    glance away), recent rejects, and the rollout harvest rate. Empty
+    when no online loop wrote to this journal."""
+    recs = [e for e in events if e.get("category") == "weights"]
+    if not recs:
+        return []
+    published = None
+    swaps: dict[str, dict] = {}  # replica host -> last applied swap
+    rejects: list[dict] = []
+    batches = 0
+    for e in recs:
+        name = e.get("name")
+        if name == "publish":
+            published = e
+        elif name == "swap":
+            swaps[e.get("host", "?")] = e
+        elif name == "swap_rejected":
+            rejects.append(e)
+        elif name == "rollout_batch":
+            batches += 1
+    out = ["  weight sync:"]
+    if published is not None:
+        d = published.get("detail") or {}
+        out.append(f"    published v{d.get('version')} @ "
+                   f"step {published.get('step')} "
+                   f"({d.get('hosts')} host shard(s))")
+    for host, e in sorted(swaps.items()):
+        d = e.get("detail") or {}
+        out.append(f"    {host:<10} serving v{d.get('version')} "
+                   f"(from v{d.get('old_version')}, "
+                   f"{d.get('dur_s', 0):.3f}s swap)")
+    if rejects:
+        d = (rejects[-1].get("detail") or {})
+        out.append(f"    rejects: {len(rejects)} "
+                   f"(last: v{d.get('version')} "
+                   f"{d.get('reason', '?')} on "
+                   f"{rejects[-1].get('host', '?')})")
+    if batches:
+        out.append(f"    rollout batches harvested: {batches}")
+    return out
+
+
 def _last_events(events: list[dict]) -> dict:
     """The operator's first three questions, from the journal."""
     out = {}
@@ -358,6 +403,7 @@ def offline_report(run_dir: str, events_dir: str = "",
         lines.append(f"    UNRESOLVED {rule} on {host} "
                      f"value={d.get('value')} (gen {d.get('gen')})")
     lines.extend(controller_panel(events))
+    lines.extend(weights_panel(events))
     # store-plane replay (the ``store`` journal category): the
     # degraded→ok arc and any liveness blame suspensions, so a store
     # outage reads as a control-plane incident, not N dead hosts
@@ -681,8 +727,9 @@ def main(argv=None) -> int:
                                       else None,
                                       history=collector.history,
                                       slo_status=_slo_status(),
-                                      controller_lines=controller_panel(
-                                          evs),
+                                      controller_lines=(
+                                          controller_panel(evs)
+                                          + weights_panel(evs)),
                                       store_health=collector
                                       .store_health()))
                 sys.stdout.flush()
@@ -707,7 +754,8 @@ def main(argv=None) -> int:
                     _last_events(evs) if evs else None,
                     history=collector.history,
                     slo_status=_slo_status(),
-                    controller_lines=controller_panel(evs),
+                    controller_lines=(controller_panel(evs)
+                                      + weights_panel(evs)),
                     store_health=collector.store_health())
             print(out)
     except KeyboardInterrupt:

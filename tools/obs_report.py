@@ -424,6 +424,51 @@ def controller_section(events_dir: str,
     return out
 
 
+def weights_section(events_dir: str,
+                    events: list[dict] | None = None) -> list[str]:
+    """Online weight-sync summary from the ``weights`` journal category
+    (docs/online_training.md): publish cadence, per-replica applied
+    swaps and their durations, rejects with reasons, and the rollout
+    harvest count. Quiet when no online loop ran against this
+    journal."""
+    if events is None:
+        events = _load_events(events_dir)
+    if events is None:
+        return []
+    recs = [e for e in events if e.get("category") == "weights"]
+    if not recs:
+        return []
+    publishes = [e for e in recs if e.get("name") == "publish"]
+    swaps = [e for e in recs if e.get("name") == "swap"]
+    rejects = [e for e in recs if e.get("name") == "swap_rejected"]
+    batches = [e for e in recs if e.get("name") == "rollout_batch"]
+    out = [f"weight sync ({len(recs)} weights events): "
+           f"publishes={len(publishes)}  swaps={len(swaps)}  "
+           f"rejects={len(rejects)}  rollout_batches={len(batches)}"]
+    if publishes:
+        d = publishes[-1].get("detail") or {}
+        out.append(f"  last publish: v{d.get('version')} @ "
+                   f"step {publishes[-1].get('step')} "
+                   f"({d.get('hosts')} host shard(s))")
+    last_by_host: dict[str, dict] = {}
+    for e in swaps:
+        last_by_host[e.get("host", "?")] = e
+    for host, e in sorted(last_by_host.items()):
+        d = e.get("detail") or {}
+        out.append(f"  {host:<10} serving v{d.get('version')} "
+                   f"(from v{d.get('old_version')}, "
+                   f"{d.get('dur_s', 0):.3f}s swap)")
+    if rejects:
+        reasons: dict[str, int] = {}
+        for e in rejects:
+            r = str((e.get("detail") or {}).get("reason", "?"))
+            reasons[r] = reasons.get(r, 0) + 1
+        out.append("  reject reasons: " + "  ".join(
+            f"{r}={c}" for r, c in sorted(reasons.items(),
+                                          key=lambda kv: -kv[1])))
+    return out
+
+
 def store_section(events_dir: str,
                   events: list[dict] | None = None) -> list[str]:
     """Launcher-store health from the ``store`` journal category
@@ -586,6 +631,8 @@ def report(jsonl_path: str, trace_path: str = "",
             ("serving", lambda: serving_section(events_dir, events)),
             ("controller actions",
              lambda: controller_section(events_dir, events)),
+            ("weight sync",
+             lambda: weights_section(events_dir, events)),
             ("store health", lambda: store_section(events_dir, events)),
             ("SLO budgets", lambda: slo_section(
                 history_dir or os.path.join(

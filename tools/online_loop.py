@@ -1,0 +1,449 @@
+#!/usr/bin/env python
+"""online_loop — the online post-training plane, end to end.
+
+One supervised loop closes the serving→training→serving cycle
+(docs/online_training.md): a fake-backend serving fleet (subprocess
+``serve_http --fake-backend --advertise`` replicas, the autoscale-drill
+launcher pattern) answers rollout traffic; the harvested completions —
+each stamped with the ``weight_version`` that generated it — convert to
+a GRPO batch (online/rollouts.py) and feed an in-process tiny-gpt2
+trainer; the updated params publish through the weight plane
+(online/publisher.py, ckpt shard wire format over the launcher store);
+and ``Router.weight_sync`` swaps every replica live, between scheduler
+quanta, with ZERO failed client requests.
+
+Each cycle runs under one forced trace: the collector's completion
+requests, the replica-side swap handlers and the driver's own
+rollout/train/publish spans all carry the same trace id, so
+``tools/timeline_report.py --trace <id>`` renders the causal chain
+
+    rollout batch (@ version V) → train steps → weight publish (V+1)
+        → per-replica swap (V → V+1)
+
+with the old/new ``weight_version`` correlation tags visible on both
+the trainer and replica sides.
+
+``--smoke`` is the tier-1 drill (tests/test_zonline_loop.py): 2
+replicas, 2 cycles (= 2 fleet swaps), background traffic asserting the
+zero-failed contract, a few seconds on CPU. The default run is the
+slow acceptance drill with more cycles and heavier traffic.
+
+Prints one JSON report line; exit 0 = pass.
+
+Usage::
+
+    python tools/online_loop.py --smoke
+    python tools/online_loop.py [--replicas 2] [--cycles 3]
+        [--steps-per-cycle 2] [--group-size 4] [--max-tokens 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_PROMPTS = (
+    "the quick brown fox jumps over",
+    "in a hole in the ground there",
+    "it was the best of times it",
+    "call me ishmael some years ago",
+)
+
+
+def _spawn_replica(idx: int, *, store_addr: str, events_dir: str,
+                   trace_dir: str, slots: int, step_delay: float,
+                   timeout_s: float = 30.0):
+    """One ``serve_http --fake-backend --advertise`` subprocess.
+    Distinct PROCESS_ID per replica: each gets its own trace/journal
+    writer identity AND its own process-wide weight_version correlation
+    tag (in-process replicas would fight over one tag set)."""
+    env = dict(os.environ)
+    env["TPUSTORE_ADDR"] = store_addr
+    env["PDTT_EVENTS_DIR"] = events_dir
+    env["PROCESS_ID"] = str(idx)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(here, "serve_http.py"),
+           "--fake-backend", "--port", "0", "--advertise",
+           "--slots", str(slots),
+           "--fake-step-delay", str(step_delay),
+           "--trace-dir", trace_dir,
+           "--drain-grace", "5"]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env)
+    addr = None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline() if proc.stdout else ""
+        if not line:
+            if proc.poll() is not None:
+                break
+            continue
+        if line.startswith("serving on http://"):
+            addr = line.split("http://", 1)[1].split()[0].strip("/")
+            break
+    if addr is None:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        return None, None
+
+    def pump():
+        try:
+            for _line in proc.stdout:
+                pass
+        except (OSError, ValueError):
+            pass
+
+    threading.Thread(target=pump, daemon=True,
+                     name=f"online-replica-pump-{idx}").start()
+    return addr, proc
+
+
+def _build_trainer(seq_len: int, steps_total: int):
+    """Tiny-gpt2 GRPO trainer, the test_train_step construction path:
+    real model registry, real partition rules, real jit train step —
+    just small enough to live beside the serving fleet on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_train_tpu import steps as steps_lib
+    from pytorch_distributed_train_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        OptimConfig,
+        PrecisionConfig,
+    )
+    from pytorch_distributed_train_tpu.losses import make_grpo_loss
+    from pytorch_distributed_train_tpu.models.registry import build_model
+    from pytorch_distributed_train_tpu.optim import make_optimizer
+    from pytorch_distributed_train_tpu.parallel.mesh import build_mesh
+    from pytorch_distributed_train_tpu.parallel.partition import (
+        rules_for_model,
+    )
+    from pytorch_distributed_train_tpu.train_state import TrainState
+
+    model_cfg = ModelConfig(name="gpt2", hidden_size=32, num_layers=1,
+                            num_heads=2, mlp_dim=64, vocab_size=512,
+                            max_seq_len=seq_len, dropout_rate=0.0)
+    opt_cfg = OptimConfig(name="momentum", learning_rate=0.01,
+                          schedule="constant", warmup_steps=0,
+                          weight_decay=0.0)
+    mesh = build_mesh(MeshConfig(data=1), jax.devices()[:1])
+    model = build_model(model_cfg, PrecisionConfig())
+    loss_fn = make_grpo_loss()
+    tx, _ = make_optimizer(opt_cfg, total_steps=max(1, steps_total))
+    rules = rules_for_model(model_cfg.name)
+
+    def init_state(rng):
+        variables = model.init({"params": rng},
+                               jnp.zeros((1, 4), jnp.int32), train=False)
+        return TrainState.create(params=variables["params"], tx=tx,
+                                 batch_stats=variables.get(
+                                     "batch_stats", {}))
+
+    rng = jax.random.PRNGKey(0)
+    shape = jax.eval_shape(init_state, rng)
+    sharding = steps_lib.state_shardings(mesh, rules, shape)
+    state = jax.jit(init_state, out_shardings=sharding)(rng)
+    step = steps_lib.jit_train_step(
+        steps_lib.make_train_step(model, loss_fn, tx), mesh, sharding,
+        batch_axes=("data", "fsdp"))
+    return state, step
+
+
+def _encode(text: str) -> list[int]:
+    # trainer-side byte tokenizer: ids 1..255 (0 stays the pad id);
+    # only has to be consistent with ITSELF — to_grpo_batch re-encodes
+    # prompt and completion with this same fn
+    return [1 + (b % 255) for b in text.encode("utf-8")]
+
+
+def _reward(prompt: str, completion: str) -> float:
+    # deterministic toy reward with in-group variance: mean byte value
+    # of the sampled completion. Fake-backend samples differ across the
+    # n= group (tokens are a function of prompt AND uid), so distinct
+    # completions score distinctly and group-relative advantages are
+    # non-degenerate.
+    data = completion.encode("utf-8")
+    if not data:
+        return 0.0
+    return sum(data) / (255.0 * len(data))
+
+
+def _traffic(router, stop: threading.Event, counts: dict,
+             lock: threading.Lock, *, max_tokens: int,
+             gap_s: float) -> None:
+    """Background client load through the failover router for the
+    zero-failed-requests contract: a 5xx or transport escape is a hard
+    failure; 429/504 are honest admission answers (and should not
+    appear at this load anyway)."""
+    i = 0
+    while not stop.is_set():
+        body = {"prompt": f"background req {i} xxxx",
+                "max_tokens": max_tokens}
+        raw = json.dumps(body).encode()
+        try:
+            status, _ = router.request("/v1/completions", raw, body)
+        except Exception:  # noqa: BLE001 — any escape is a failure
+            status = -1
+        with lock:
+            if status == 200:
+                counts["ok"] = counts.get("ok", 0) + 1
+            elif status in (429, 504):
+                counts["shed"] = counts.get("shed", 0) + 1
+            else:
+                counts["failed"] = counts.get("failed", 0) + 1
+        i += 1
+        time.sleep(gap_s)
+
+
+def run_loop(*, replicas: int = 2, cycles: int = 2,
+             steps_per_cycle: int = 2, group_size: int = 2,
+             max_tokens: int = 8, seq_len: int = 48,
+             n_prompts: int = 2, step_delay: float = 0.0,
+             traffic_gap_s: float = 0.08, slots: int = 8) -> dict:
+    import dataclasses as _dc
+
+    from pytorch_distributed_train_tpu.elastic import discover_replicas
+    from pytorch_distributed_train_tpu.faults.retry import retry_call
+    from pytorch_distributed_train_tpu.native.store import (
+        StoreClient,
+        StoreServer,
+    )
+    from pytorch_distributed_train_tpu.obs import events as events_lib
+    from pytorch_distributed_train_tpu.obs import spans as spans_lib
+    from pytorch_distributed_train_tpu.obs import tracing
+    from pytorch_distributed_train_tpu.online import (
+        RolloutCollector,
+        WeightPublisher,
+        to_grpo_batch,
+    )
+    from pytorch_distributed_train_tpu.serving_plane.router import (
+        HealthProber,
+        ReplicaSet,
+        Router,
+        http_json,
+    )
+
+    report: dict = {"replicas": replicas, "cycles": cycles,
+                    "steps_per_cycle": steps_per_cycle}
+    events_dir = tempfile.mkdtemp(prefix="online-loop-events-")
+    trace_dir = tempfile.mkdtemp(prefix="online-loop-traces-")
+    report["events_dir"] = events_dir
+    report["trace_dir"] = trace_dir
+    os.environ["PDTT_EVENTS_DIR"] = events_dir
+    events_lib.configure(events_dir, who="trainer")
+    tracing.configure(trace_dir, who="trainer")
+    spans_lib.set_correlation_tags(role="trainer", weight_version="0")
+
+    server = StoreServer()
+    store_addr = f"127.0.0.1:{server.port}"
+    os.environ["TPUSTORE_ADDR"] = store_addr
+    report["store"] = store_addr
+    store = StoreClient("127.0.0.1", server.port)
+
+    procs: list = []
+    rset = ReplicaSet()
+    prober = HealthProber(rset, interval_s=0.25, down_after=3,
+                          refresh=lambda: discover_replicas(store))
+    router = Router(rset, timeout_s=30.0)
+    stop = threading.Event()
+    counts: dict = {}
+    lock = threading.Lock()
+    cycle_log: list[dict] = []
+
+    try:
+        for i in range(replicas):
+            addr, proc = _spawn_replica(
+                i + 1, store_addr=store_addr, events_dir=events_dir,
+                trace_dir=trace_dir, slots=slots,
+                step_delay=step_delay)
+            if addr is None:
+                report["ok"] = False
+                report["error"] = f"replica {i + 1} failed to start"
+                return report
+            procs.append(proc)
+        prober.start()
+        deadline = time.monotonic() + 20.0
+        while (time.monotonic() < deadline
+               and len([r for r in rset.snapshot()
+                        if r["state"] == "up"]) < replicas):
+            time.sleep(0.2)
+        up = [r["addr"] for r in rset.snapshot() if r["state"] == "up"]
+        if len(up) < replicas:
+            report["ok"] = False
+            report["error"] = f"only {len(up)}/{replicas} replicas up"
+            return report
+
+        state, step = _build_trainer(seq_len,
+                                     cycles * steps_per_cycle)
+        publisher = WeightPublisher(store, cadence_steps=1)
+        collectors = [RolloutCollector(f"http://{a}",
+                                       group_size=group_size,
+                                       max_tokens=max_tokens)
+                      for a in up]
+        prompts = list(_PROMPTS[:max(1, n_prompts)])
+
+        bg = threading.Thread(
+            target=_traffic, args=(router, stop, counts, lock),
+            kwargs={"max_tokens": max_tokens, "gap_s": traffic_gap_s},
+            daemon=True, name="online-loop-traffic")
+        bg.start()
+
+        import jax.numpy as jnp
+
+        global_step = 0
+        for c in range(cycles):
+            # one forced trace per cycle: driver spans + the replicas'
+            # completion/swap handler spans all share this id (the
+            # sampled flag propagates via traceparent, so every side
+            # retains its subtree)
+            ctx = _dc.replace(tracing.start_trace(), sampled=True)
+            t0 = time.monotonic()
+            entry: dict = {"cycle": c, "trace": ctx.trace_id}
+            with tracing.activate(ctx):
+                with spans_lib.span("online.cycle", cycle=c):
+                    child = tracing.current_child_context(sampled=True)
+                    tp = tracing.format_traceparent(child)
+                    with spans_lib.span("online.rollout"):
+                        # rollouts rotate across replicas so every
+                        # replica's completions feed training
+                        coll = collectors[c % len(collectors)]
+                        batch = retry_call(
+                            lambda: coll.collect(prompts,
+                                                 traceparent=tp),
+                            point="rollout.fetch")
+                    entry["rollout_versions"] = batch.versions()
+                    grpo = to_grpo_batch(batch, _encode, _reward,
+                                         seq_len=seq_len)
+                    jbatch = {k: jnp.asarray(v)
+                              for k, v in grpo.items()}
+                    import jax as _jax
+
+                    rng = _jax.random.PRNGKey(100 + c)
+                    losses = []
+                    with spans_lib.span("online.train",
+                                        steps=steps_per_cycle,
+                                        rollout_version=(
+                                            batch.weight_version)):
+                        for _k in range(steps_per_cycle):
+                            state, metrics = step(state, jbatch, rng)
+                            losses.append(float(metrics["loss"]))
+                            global_step += 1
+                    entry["losses"] = losses
+                    with spans_lib.span("online.publish"):
+                        version = publisher.publish(
+                            {"params": state.params},
+                            step=global_step)
+                    spans_lib.set_correlation_tags(
+                        weight_version=str(version))
+                    entry["published_version"] = version
+                    child = tracing.current_child_context(sampled=True)
+                    sync = router.weight_sync(
+                        version=version,
+                        traceparent=tracing.format_traceparent(child))
+                    entry["sync"] = sync
+                    entry["swapped"] = sum(
+                        1 for e in sync
+                        if e.get("status") == "swapped")
+            tracing.get_tracer().finish(ctx.trace_id,
+                                        time.monotonic() - t0)
+            cycle_log.append(entry)
+
+        # the fleet must end on the last published version — read it
+        # back off every replica's /healthz weight state
+        final = str(publisher.version)
+        versions = {}
+        for a in up:
+            try:
+                _code, raw = http_json(a, "/healthz", None, 5.0)
+                versions[a] = json.loads(raw).get(
+                    "weights", {}).get("version")
+            except (OSError, ValueError) as e:
+                versions[a] = f"error: {e}"
+        report["final_versions"] = versions
+        report["converged"] = all(v == final
+                                  for v in versions.values())
+    finally:
+        stop.set()
+        prober.stop()
+        for proc in procs:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        try:
+            server.stop()
+        except OSError:
+            pass
+
+    report["cycle_log"] = cycle_log
+    report["traffic"] = counts
+    swaps_ok = all(e.get("swapped") == replicas for e in cycle_log)
+    trained = all(len(e.get("losses", [])) == steps_per_cycle
+                  for e in cycle_log)
+    versioned = all(e.get("rollout_versions") for e in cycle_log)
+    report["ok"] = bool(
+        len(cycle_log) == cycles and swaps_ok and trained
+        and versioned and report.get("converged")
+        and counts.get("failed", 0) == 0
+        and counts.get("ok", 0) > 0)
+    if not report["ok"]:
+        report["why"] = {"cycles_done": len(cycle_log),
+                         "swaps_ok": swaps_ok, "trained": trained,
+                         "versioned": versioned,
+                         "converged": report.get("converged"),
+                         "traffic": counts}
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--cycles", type=int, default=3)
+    p.add_argument("--steps-per-cycle", type=int, default=2)
+    p.add_argument("--group-size", type=int, default=4)
+    p.add_argument("--max-tokens", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=48)
+    p.add_argument("--prompts", type=int, default=4,
+                   help="prompts per rollout batch (each fans out to "
+                        "--group-size sampled completions)")
+    p.add_argument("--smoke", action="store_true",
+                   help="the tier-1 drill: 2 replicas, 2 cycles "
+                        "(2 fleet swaps), light traffic, seconds on "
+                        "CPU")
+    args = p.parse_args(argv)
+    if args.smoke:
+        report = run_loop(replicas=2, cycles=2, steps_per_cycle=2,
+                          group_size=2, max_tokens=4, seq_len=48,
+                          n_prompts=2, traffic_gap_s=0.1)
+    else:
+        report = run_loop(replicas=args.replicas, cycles=args.cycles,
+                          steps_per_cycle=args.steps_per_cycle,
+                          group_size=args.group_size,
+                          max_tokens=args.max_tokens,
+                          seq_len=args.seq_len,
+                          n_prompts=args.prompts,
+                          traffic_gap_s=0.04)
+    print(json.dumps(report))
+    return 0 if report.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,12 +57,22 @@ API (JSON over POST, one object per request):
   ``chat.completion.chunk`` deltas. Stateless by definition (full
   history per call) — keep/session/prefix are refused here; resident-KV
   conversations live on ``/v1/completions``.
-- ``GET /healthz``: {status, reliability, stats} — liveness + batcher
-  counters + the reliability section (queue depth, slot occupancy,
-  admission state ``ok|shedding|draining``, SLO snapshot) the router's
-  probe and balancing read.
+- ``GET /healthz``: {status, reliability, stats, weights} — liveness +
+  batcher counters + the reliability section (queue depth, slot
+  occupancy, admission state ``ok|shedding|draining``, SLO snapshot)
+  the router's probe and balancing read, plus the MUTABLE weight state
+  (current version/step, lag vs the trainer's newest published step,
+  swap count) the fleet console's weight-sync panel reads.
 - ``POST /admin/drain``: trigger the graceful drain over HTTP (same
   path as SIGTERM; what the router's rolling restart walks).
+- ``POST /admin/weights``: live weight swap (online/,
+  docs/online_training.md) — {version?} fetches that sealed version
+  (default newest) from the launcher store, CRC-verifies + places it,
+  and the scheduler flips params BETWEEN decode quanta: in-flight
+  requests finish at the version they were admitted under (responses
+  carry ``weight_version``, so stale completions are observable, never
+  errors). Any fetch/verify/placement failure rejects the swap and the
+  replica keeps serving its current version.
 
 Reliability plane (serving_plane/, docs/serving_reliability.md):
 per-request deadlines (``deadline_s`` field or ``--deadline-default``;
@@ -127,6 +137,10 @@ from pytorch_distributed_train_tpu.faults import (  # noqa: E402
 )
 from pytorch_distributed_train_tpu.obs.registry import get_registry  # noqa: E402
 from pytorch_distributed_train_tpu.obs.spans import span  # noqa: E402
+from pytorch_distributed_train_tpu.online.swap import (  # noqa: E402
+    PendingSwap,
+    WeightState,
+)
 from pytorch_distributed_train_tpu.serving import trim_at_eos  # noqa: E402
 from pytorch_distributed_train_tpu.serving_plane import (  # noqa: E402
     DeadlineExceeded,
@@ -277,6 +291,14 @@ class BatcherService:
         # under self._lock.
         self._trace: dict[int, dict] = {}
         self._spans = spans_lib.get_recorder()
+        # Online weight plane (online/swap.py): the mutable weight
+        # version + staged-swap slot. main() reseeds it from
+        # --weight-version; `weight_applier` (set for real backends) is
+        # `(leaves, header) -> zero-arg apply fn | None` — it prepares
+        # placed params in the HANDLER thread, the scheduler flips them
+        # between quanta via weights.apply_pending() in _loop.
+        self.weights = WeightState()
+        self.weight_applier = None
         self._orphan_grace_s = orphan_grace_s
         self.error: str | None = None  # scheduler-death reason (terminal)
         self._idle_sleep_s = idle_sleep_s
@@ -287,6 +309,12 @@ class BatcherService:
     def _loop(self):
         while not self._stop:
             try:
+                # Staged weight swap, applied BETWEEN decode quanta:
+                # this is the only thread that runs batcher.step(), so
+                # flipping params here can never land mid-forward, and
+                # doing it outside the service lock keeps intake live
+                # through the flip (handlers never read params).
+                self.weights.apply_pending()
                 with self._lock:
                     busy = bool(self.batcher.queue
                                 or self.batcher.active_slots)
@@ -1068,6 +1096,85 @@ class GracefulDrain:
         self.service.shutdown()
 
 
+def _swap_store(service):
+    """The replica's handle onto the weight-publish plane, built lazily
+    and cached on the service (same resilient wrapper --advertise uses;
+    None outside a store-backed job)."""
+    store = getattr(service, "_weight_store", None)
+    if store is None:
+        from pytorch_distributed_train_tpu import store_plane
+
+        store = store_plane.resilient_worker_store(name="weight-swap")
+        if store is not None:
+            service._weight_store = store
+    return store
+
+
+def _swap_weights(service, req: dict) -> tuple[int, dict]:
+    """POST /admin/weights body: {"version": N?} (default: the newest
+    sealed version). Fetch → CRC verify → place (handler thread) →
+    stage → scheduler applies between quanta. Every failure leaves the
+    replica serving its CURRENT version — a swap can reject, it cannot
+    half-land (docs/online_training.md swap protocol)."""
+    weights = getattr(service, "weights", None)
+    if weights is None:
+        return 503, {"error": "no weight plane on this service"}
+    t0 = time.monotonic()
+    want = req.get("version")
+    want = int(want) if want is not None else None
+    # `weights.swap` fault point: the injected failure is a 503 BEFORE
+    # any fetch — the replica keeps its version, the caller retries
+    try:
+        _maybe_fire_fault("weights.swap")
+    except InjectedFault as e:
+        weights.reject(want if want is not None else "latest",
+                       f"injected: {e}")
+        return 503, {"error": str(e), "serving": weights.version}
+    store = _swap_store(service)
+    if store is None:
+        return 503, {"error": "no TPUSTORE_ADDR: weight swaps ride the "
+                              "launcher store"}
+    from pytorch_distributed_train_tpu.online import publisher as pub_lib
+
+    fetched = pub_lib.fetch_version(store, want)
+    if fetched is None:
+        # unsealed / incomplete / corrupt (CRC) — indistinguishable on
+        # purpose: none of them may touch the serving params
+        weights.reject(want if want is not None else "latest",
+                       "verify_failed")
+        return 409, {"error": "published version unavailable or failed "
+                              "verification", "serving": weights.version}
+    info, leaves, header = fetched
+    weights.note_published(info["version"], info["step"])
+    old = weights.version
+    if str(info["version"]) == old:
+        return 200, {"status": "already_current", "version": old}
+    apply_fn = None
+    if service.weight_applier is not None:
+        # the expensive half (host→device placement into the serving
+        # mesh's shardings) runs HERE, off the scheduler's critical path
+        apply_fn = service.weight_applier(leaves, header)
+        if apply_fn is None:
+            weights.reject(info["version"], "placement_mismatch")
+            return 409, {"error": "published leaves do not match the "
+                                  "serving params template",
+                         "serving": old}
+    pending = PendingSwap(version=str(info["version"]),
+                          step=int(info["step"]), apply_fn=apply_fn,
+                          t0=t0)
+    if not weights.stage(pending):
+        return 409, {"error": "another swap is in flight",
+                     "serving": old}
+    if not pending.done.wait(timeout=30.0):
+        return 504, {"error": "swap staged but not applied within 30s "
+                              "(scheduler wedged?)", "serving": old}
+    if pending.error:
+        return 500, {"error": pending.error, "serving": weights.version}
+    return 200, {"status": "swapped", "version": weights.version,
+                 "old_version": old, "step": int(info["step"]),
+                 "swap_seconds": round(pending.duration_s, 6)}
+
+
 def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet by default
@@ -1092,6 +1199,12 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
             # second endpoint. Omitted for plane-less service fakes
             # (tests): their healthz keeps the pre-plane shape.
             out = {"status": status, "stats": service.stats()}
+            weights = getattr(service, "weights", None)
+            if weights is not None:
+                # mutable weight version (online/swap.py): the swap is
+                # visible here without a restart — current version/step,
+                # lag vs the trainer's newest published step, swap count
+                out["weights"] = weights.snapshot()
             batcher = getattr(service, "batcher", None)
             plane = getattr(service, "plane", None)
             if batcher is None or plane is None:
@@ -1149,6 +1262,42 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                 else:
                     drain.request_drain()
                     self._send(202, {"status": "draining"})
+                return
+            if self.path.split("?", 1)[0] == "/admin/weights":
+                # Live weight swap (online/; docs/online_training.md):
+                # fetch + verify the published version, stage it, wait
+                # for the scheduler to flip it between quanta. Subject
+                # to the drain gate: a draining replica is leaving the
+                # rotation — swapping it is wasted work.
+                if drain is not None and not drain.begin_request():
+                    self._send(503, {"error": "server draining"})
+                    return
+                try:
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n) or b"{}")
+                    except ValueError as e:
+                        self._send(400, {"error": f"bad body: {e}"})
+                        return
+                    # the swap rides the driver's trace: its spans carry
+                    # the OLD weight_version tag before apply_pending
+                    # re-stamps, the NEW one after — the flip the
+                    # timeline report shows
+                    ctx = tracing.continue_or_start(
+                        self.headers.get("traceparent"))
+                    t0 = time.monotonic()
+                    try:
+                        with tracing.activate(ctx):
+                            with span("http.admin.weights"):
+                                code, obj = _swap_weights(service, req)
+                    finally:
+                        tracing.get_tracer().finish(
+                            ctx.trace_id,
+                            dur_s=time.monotonic() - t0)
+                    self._send(code, obj)
+                finally:
+                    if drain is not None:
+                        drain.end_request()
                 return
             if self.path.split("?", 1)[0] == "/profile":
                 # On-demand capture of the SERVING process (managed
@@ -1244,6 +1393,13 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
 
         def _handle_post(self):
             chat = self.path == "/v1/chat/completions"
+            # weight version at ADMIT time: a request straddling a live
+            # swap completes at the version it was admitted under — the
+            # response says which (stale-version completions are
+            # observable, never errors; docs/online_training.md)
+            weights = getattr(service, "weights", None)
+            admit_version = (weights.version if weights is not None
+                             else None)
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
@@ -1307,7 +1463,10 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                         prompt, max_tokens, temperature, n,
                         logprobs=bool(req.get("logprobs", False)),
                         penalties=penalties, deadline_s=deadline_s)
-                    self._send(200, _chat_response(out) if chat else out)
+                    resp = _chat_response(out) if chat else out
+                    if admit_version is not None:
+                        resp["weight_version"] = admit_version
+                    self._send(200, resp)
                     return
                 if req.get("stream"):
                     if stop and keep:
@@ -1330,7 +1489,10 @@ def make_handler(service: BatcherService, drain: GracefulDrain | None = None):
                                            req.get("logprobs", False)),
                                        penalties=penalties,
                                        deadline_s=deadline_s)
-                self._send(200, _chat_response(out) if chat else out)
+                resp = _chat_response(out) if chat else out
+                if admit_version is not None:
+                    resp["weight_version"] = admit_version
+                self._send(200, resp)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": f"{e.args[0] if e.args else e}"})
             except OverloadShed as e:
@@ -1518,9 +1680,36 @@ def build_service(args) -> BatcherService:
     batcher = cls(cfg.model, cfg.precision, params, slots=args.slots,
                   top_k=args.top_k, top_p=args.top_p, min_p=args.min_p,
                   rng=jax.random.PRNGKey(args.seed), **extra)
-    return BatcherService(batcher, tok,
-                          max_new_default=args.max_new_default,
-                          plane=build_plane(args))
+    service = BatcherService(batcher, tok,
+                             max_new_default=args.max_new_default,
+                             plane=build_plane(args))
+    service.weight_applier = _make_weight_applier(batcher)
+    return service
+
+
+def _make_weight_applier(batcher):
+    """Weight-swap placement for a real model backend: published leaves
+    (the trainer's ``{"params": ...}`` savable, global flatten order) →
+    device arrays in THIS batcher's param shardings → a cheap apply fn
+    the scheduler flips between quanta. None on any shape/dtype
+    mismatch (e.g. a --quantize serving tree vs fp32 trainer params):
+    the swap rejects instead of serving a half-cast model."""
+
+    def prepare(leaves, header):
+        from pytorch_distributed_train_tpu.online import (
+            publisher as pub_lib,
+        )
+
+        placed = pub_lib.place_leaves({"params": batcher.params}, leaves)
+        if placed is None:
+            return None
+
+        def apply():
+            batcher.params = placed["params"]
+
+        return apply
+
+    return prepare
 
 
 def main(argv=None) -> int:
@@ -1629,10 +1818,11 @@ def main(argv=None) -> int:
     tracing.configure(args.trace_dir or tracing.default_dir(),
                       sample_pct=args.trace_sample_pct,
                       keep_slow_ms=args.trace_keep_slow_ms)
+    boot_version = args.weight_version or (
+        os.path.basename(args.safetensors) if args.safetensors
+        else "fake")
     spans_lib.set_correlation_tags(
-        weight_version=args.weight_version or (
-            os.path.basename(args.safetensors) if args.safetensors
-            else "fake"),
+        weight_version=boot_version,
         gen=os.environ.get("RESTART_GENERATION", "0"))
     try:
         service = build_service(args)
@@ -1640,6 +1830,9 @@ def main(argv=None) -> int:
         print(f"serve_http: error: {e.args[0] if e.args else e}",
               file=sys.stderr)
         return 2
+    # --weight-version only SEEDS the mutable weight state: a live swap
+    # (/admin/weights) advances it, and /healthz + span tags follow
+    service.weights = WeightState(version=boot_version)
     server = ThreadingHTTPServer((args.host, args.port), None)
     drain = GracefulDrain(server, service, grace_s=args.drain_grace)
     server.RequestHandlerClass = make_handler(service, drain)

@@ -39,6 +39,11 @@ on serving_plane/router.py:
   ``--rolling-restart`` one-shot) walks each replica through
   serve_http's drain path (``/admin/drain``) one at a time: zero
   failed requests for a fleet-wide restart;
+- **fleet weight sync** — ``POST /admin/weight_sync`` {version?} walks
+  each replica through serve_http's live weight swap
+  (``/admin/weights``) one at a time and returns the per-replica
+  report: the online post-training loop's zero-downtime "swap the
+  fleet" (docs/online_training.md);
 - **tracing** — every request gets (or continues, via an inbound
   ``traceparent`` header) a distributed trace context; attempts,
   failovers and hedges are child spans, hedge copies are sent
@@ -161,6 +166,28 @@ def make_handler(router: Router, prober: HealthProber):
                 self._send(200, {"status": "ok",
                                  "replicas":
                                      router.replicas.snapshot()})
+                return
+            if path == "/admin/weight_sync":
+                # online post-training plane: broadcast a live weight
+                # swap (serve_http /admin/weights) across the fleet,
+                # one replica at a time; body {version?} (default:
+                # newest sealed). Synchronous — the caller (the online
+                # loop) wants the per-replica report.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._send(400, {"error": "bad json"})
+                    return
+                version = body.get("version")
+                report = router.weight_sync(
+                    version=int(version) if version is not None else None,
+                    traceparent=self.headers.get("traceparent"))
+                ok = all("error" not in e and "skipped" not in e
+                         for e in report)
+                self._send(200 if ok else 502,
+                           {"status": "ok" if ok else "partial",
+                            "replicas": report})
                 return
             if path == "/admin/rolling_restart":
                 # walk replicas through their drain path off-thread; the
