@@ -654,6 +654,15 @@ class ObsConfig:
     # Per-top-level-module grad norms in the train metrics
     # (grad_norm/<module> keys) — which block explodes/vanishes.
     log_module_grad_norms: bool = False
+    # Model-health observability plane (obs/model_health.py;
+    # docs/observability.md "Model health"): the in-graph training-
+    # dynamics pass (per-module grad/param/update norms + update-to-
+    # param ratios, ops/model_health.py) in the step metrics, plus the
+    # host-side monitor that journals divergence early-warnings under
+    # the ``model`` event category and can arm the sentinel rewind /
+    # profiler hooks BEFORE the loss diverges. Bitwise no-op on the
+    # update path when off.
+    model_health: bool = False
     # Persistent XLA compilation cache dir ("" → leave jax's default): cuts
     # the minutes-scale recompiles of big GSPMD programs across job restarts
     # (SURVEY §7.4.5) — the torch.compile cache analogue. NOTE: the jax

@@ -87,6 +87,11 @@ POINTS: dict[str, str] = {
     # the next batch / inflate the observed loss), not of this registry.
     "step.nan": "flag",          # trainer poisons the next batch to NaN
     "step.loss_spike": "flag",   # trainer inflates the OBSERVED loss
+    "step.grad_spike": "flag",   # trainer inflates the OBSERVED grad/
+                                 # update telemetry (post-backward,
+                                 # pre-clip observation; params and loss
+                                 # untouched) — the model-health
+                                 # early-warning drill (obs/model_health)
     "host.hang": "hang",         # wedge this host forever (collective
                                  # deadlock seen from outside)
     "controller.act": "raise",   # fleet-controller actuation start

@@ -304,11 +304,25 @@ def make_grpo_loss(clip_eps: float = 0.2):
             per_tok = -adv * logp
         denom = jnp.maximum(mask.sum(), 1.0)
         loss = (per_tok * mask).sum() / denom
-        return loss, {
+        aux = {
             "sampled_tokens": mask.sum(),
             "mean_advantage": batch["advantage"].mean(),
             "mean_sample_logp": (logp * mask).sum() / denom,
+            # Model-health analytics (obs/model_health.py), free from
+            # tensors already in hand. Token entropy of the policy over
+            # sampled positions: entropy collapse (policy going
+            # deterministic) is the classic RL failure precursor.
+            "token_entropy":
+                (-(jnp.exp(lp) * lp).sum(-1) * mask).sum() / denom,
         }
+        if "behavior_logprobs" in batch:
+            # Sampled-token KL estimate to the BEHAVIOR policy
+            # (E_behavior[log behavior - log pi] over the sampled
+            # tokens): the off-policy drift the clipped ratio bounds —
+            # runaway here means rollouts no longer resemble the policy
+            # being trained (the kl_runaway alert input).
+            aux["kl_behavior"] = ((behavior - logp) * mask).sum() / denom
+        return loss, aux
 
     return fn
 

@@ -184,6 +184,28 @@ RULES: dict[str, AlertRule] = {r.name: r for r in (
         description="cluster max/median step-time ratio spiked — one "
                     "host is pulling away from the gang"),
     AlertRule(
+        name="grad_norm_spike", kind="anomaly", roles=("trainer",),
+        series="grad_norm", direction="above", min_rel=0.5, profile=True,
+        description="global gradient norm deviates above its healthy "
+                    "median+MAD window — the divergence PRECURSOR the "
+                    "model-health plane watches; fires steps before "
+                    "loss_spike can"),
+    AlertRule(
+        name="reward_collapse", kind="anomaly", roles=("trainer",),
+        series="reward_mean", direction="below", min_rel=0.5,
+        profile=True, quiet_resolve_s=60.0,
+        description="rollout reward mean fell hard vs its healthy "
+                    "window — the online policy is degrading (or the "
+                    "reward fn broke)"),
+    AlertRule(
+        name="kl_runaway", kind="anomaly", roles=("trainer",),
+        series="kl_behavior", direction="above", min_abs=0.05,
+        profile=True, quiet_resolve_s=60.0,
+        description="sampled-token KL to the behavior policy spiked — "
+                    "rollouts no longer resemble the policy being "
+                    "trained (swap cadence lagging, or the update "
+                    "blew past the clip)"),
+    AlertRule(
         name="host_oom_risk", kind="threshold", roles=_BOTH,
         series="host_available_bytes", below=1 << 30,
         description="host MemAvailable under the floor (default 1 GiB) "

@@ -165,7 +165,10 @@ class HistogramWindow:
 # series the alert rules read; every one is a bounded (mono_ts, value)
 # deque per target
 SERIES = ("step", "steps_per_s", "loss", "step_time_ms", "mfu_pct",
-          "goodput_pct", "straggler_ratio", "shed_per_s", "ttft_p95_s")
+          "goodput_pct", "straggler_ratio", "shed_per_s", "ttft_p95_s",
+          # model-health plane (obs/model_health.py): training-dynamics
+          # + rollout analytics the early-warning rules read
+          "grad_norm", "update_ratio", "reward_mean", "kl_behavior")
 
 # raw scraped families additionally persisted through the history
 # store (obs/tsdb.py) when one is attached: the cumulative counters /
@@ -349,6 +352,19 @@ class Target:
                               family_value(families, "serve_shed_total")))
         self._push("ttft_p95_s", now_mono,
                    self._ttft_hist.observe(families, "serve_ttft_seconds"))
+        # model-health series (absent families push nothing — an image
+        # run simply has no reward/KL series): the tree-wide grad norm
+        # and worst update-to-param ratio from the in-graph pass, the
+        # rollout reward level, and the KL-to-behavior drift. History
+        # write-through rides _push like every other series.
+        self._push("grad_norm", now_mono,
+                   family_value(families, "train_grad_norm"))
+        self._push("update_ratio", now_mono,
+                   family_value(families, "train_update_ratio_max"))
+        self._push("reward_mean", now_mono,
+                   family_value(families, "rollout_reward_mean"))
+        self._push("kl_behavior", now_mono,
+                   family_value(families, "train_kl_behavior"))
 
         self.memory = {
             k: family_value(families, k)
@@ -621,6 +637,15 @@ class FleetCollector:
                 "queue_depth": slo.get("queue_depth"),
                 "slots": slo.get("slots"),
                 "shed_per_s": t.latest("shed_per_s"),
+                # model-health panel input: recent in-window trajectory
+                # per series (console sparklines need no history store
+                # attached); absent series are omitted entirely so an
+                # image run renders no empty panel
+                "model_health": {
+                    name: [v for _ts, v in t.series[name]]
+                    for name in ("grad_norm", "update_ratio",
+                                 "reward_mean", "kl_behavior")
+                    if t.series[name]},
                 "memory": dict(t.memory),
                 "input_split": dict(t.input_split),
                 "ckpt_tiers": dict(t.ckpt_tiers),

@@ -85,6 +85,9 @@ CATEGORIES: dict[str, str] = {
     "weights": "online post-training plane: weight publishes, replica "
                "swaps (applied/rejected), rollout batches "
                "(online/, tools/serve_http.py)",
+    "model": "model-health early warnings: training-dynamics spikes "
+             "(grad/update norms, update ratios), reward/KL drift "
+             "verdicts, rewind arming (obs/model_health.py)",
 }
 
 

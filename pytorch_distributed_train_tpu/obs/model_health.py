@@ -1,0 +1,158 @@
+"""Host-side model-health monitor: divergence early warning BEFORE the
+loss moves.
+
+The in-graph half (ops/model_health.py, gated by ``obs.model_health``)
+lands training-dynamics scalars — grad/param/update norms, update-to-
+param ratios — in the step metrics; the GRPO/rollout path adds reward,
+advantage, entropy and KL-to-behavior series. This module is the host
+half: a ``ModelHealthMonitor`` holding one sentinel ``SpikeDetector``
+per watched series (the same healthy-only median+MAD windows the loss
+sentinel uses — sentinel/numeric.py), fed once per log cadence from the
+already-transferred host record, so it adds zero device syncs.
+
+Why a separate monitor when the sentinel already watches the loss: the
+loss is a LAGGING indicator. A per-block gradient explosion or an
+update that suddenly dwarfs its weights shows up steps before the loss
+diverges; reward collapse and KL runaway show up before an online
+policy degrades visibly. Catching the precursor means the rewind
+replays a couple of steps instead of a couple hundred, and the
+profiler can capture the step window where the dynamics actually
+broke.
+
+Verdicts are journaled under the CLOSED ``model`` event category
+(obs/events.py) with the optimizer-scale context that makes them
+actionable post-hoc (lr, loss_scale, lr_cooldown_scale at the moment
+of the warning), counted per series, and fed to the managed profiler's
+anomaly hook (obs/profiler.py: journal always, capture when
+``profile_on_anomaly``). A warning streak across consecutive
+observations ARMS the sentinel rewind — the trainer treats an armed
+monitor exactly like a sentinel bad-step streak.
+
+No jax at module scope (the obs/ package contract).
+"""
+
+from __future__ import annotations
+
+import statistics
+
+from pytorch_distributed_train_tpu.obs import events as events_lib
+from pytorch_distributed_train_tpu.obs.registry import get_registry
+from pytorch_distributed_train_tpu.sentinel.numeric import SpikeDetector
+
+# series -> unhealthy direction. "above": only an upward deviation is a
+# warning (a gradient norm FALLING is news, not danger); "below" the
+# mirror (reward/entropy collapse). Deviations in the healthy direction
+# still enter the window — they ARE the new baseline.
+WATCHED: dict[str, str] = {
+    "grad_norm": "above",
+    "update_norm": "above",
+    "update_ratio_max": "above",
+    "kl_behavior": "above",
+    "reward_mean": "below",
+    "token_entropy": "below",
+}
+
+# optimizer-scale context stamped onto every warning record: the
+# post-mortem question is always "what was the LR/scale doing there"
+_CONTEXT_KEYS = ("lr", "loss_scale", "lr_cooldown_scale")
+
+
+class ModelHealthMonitor:
+    """Per-series spike detection over the host-side metrics record.
+
+    ``observe(step, record)`` returns True when the warning streak has
+    crossed ``arm_streak`` — the caller's cue to trigger the sentinel
+    rewind path. Detector windows are healthy-only (a warning value
+    never contaminates its own baseline) and ``reset()`` after a rewind
+    forgets the pre-rewind regime, same stance as the loss sentinel.
+    """
+
+    def __init__(self, *, window: int = 64, sigma: float = 6.0,
+                 min_samples: int = 8, min_rel: float = 0.5,
+                 arm_streak: int = 3, profiler=None,
+                 watch: dict[str, str] | None = None):
+        self.watch = dict(WATCHED if watch is None else watch)
+        self.profiler = profiler
+        self.arm_streak = max(1, int(arm_streak))
+        self._streak = 0
+        self._detectors = {
+            name: SpikeDetector(window=window, sigma=sigma,
+                                min_samples=min_samples, min_rel=min_rel)
+            for name in self.watch}
+
+    # ------------------------------------------------------------ verdicts
+    def _directed(self, name: str, value: float, det: SpikeDetector) -> bool:
+        """Spike AND in the unhealthy direction for this series."""
+        if not det.is_spike(value):
+            return False
+        med = statistics.median(det.window)
+        direction = self.watch[name]
+        return value > med if direction == "above" else value < med
+
+    def observe(self, step: int, record: dict) -> bool:
+        """Feed one host metrics record (the ``_log_train`` dict).
+
+        Absent series are skipped (an image run has no ``kl_behavior``;
+        a run without ``model_health`` never feeds ``update_ratio_max``)
+        — the monitor watches whatever telemetry actually flows.
+        Returns True when the rewind should be armed.
+        """
+        context = {k: record[k] for k in _CONTEXT_KEYS if k in record}
+        warned = []
+        for name, det in self._detectors.items():
+            raw = record.get(name)
+            if raw is None or isinstance(raw, bool):
+                continue
+            try:
+                value = float(raw)
+            except (TypeError, ValueError):
+                continue
+            if value != value:  # NaN: the numeric guard's territory
+                continue
+            if self._directed(name, value, det):
+                warned.append(name)
+                baseline = statistics.median(det.window)
+                get_registry().counter(
+                    "model_health_warnings_total",
+                    labels={"series": name},
+                    help="model-health divergence early warnings by "
+                         "series").inc()
+                events_lib.emit(
+                    "model", "early_warning", step=step, series=name,
+                    value=round(value, 6), baseline=round(baseline, 6),
+                    direction=self.watch[name], streak=self._streak + 1,
+                    **context)
+            else:
+                det.add(value)
+        self._streak = self._streak + 1 if warned else 0
+        get_registry().gauge(
+            "model_health_warning_streak",
+            help="consecutive observations with >=1 model-health "
+                 "warning").set(self._streak)
+        if warned and self.profiler is not None:
+            # journal always; opens a capture window on the step where
+            # the dynamics broke when obs.profile_on_anomaly is set
+            self.profiler.anomaly("model_health", step,
+                                  series=",".join(warned),
+                                  streak=self._streak)
+        if self._streak >= self.arm_streak:
+            get_registry().counter(
+                "model_health_rewinds_armed_total",
+                help="rewind triggers armed by the model-health "
+                     "monitor").inc()
+            events_lib.emit("model", "rewind_armed", step=step,
+                            series=",".join(warned), streak=self._streak,
+                            **context)
+            return True
+        return False
+
+    def reset(self) -> None:
+        """Forget every window (post-rewind: the replayed region's
+        telemetry re-enters from scratch)."""
+        self._streak = 0
+        get_registry().gauge(
+            "model_health_warning_streak",
+            help="consecutive observations with >=1 model-health "
+                 "warning").set(0)
+        for det in self._detectors.values():
+            det.reset()

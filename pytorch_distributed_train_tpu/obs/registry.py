@@ -153,9 +153,24 @@ class MetricsRegistry:
     def set_from_mapping(self, metrics: dict, prefix: str = "") -> None:
         """Mirror a MetricLogger record: every numeric value becomes a
         gauge ``<prefix>_<key>`` (non-numerics skipped). Called on every
-        ``log``, so the scrape always shows the latest logged window."""
+        ``log``, so the scrape always shows the latest logged window.
+
+        Per-module keys (``grad_norm/<module>`` and the model-health
+        families — obs/model_health.py) route through a bounded
+        ``module=`` label: ``sanitize_name`` would otherwise fold the
+        module path into the family NAME (one unbounded family per
+        module, and a different spelling per model), dropping them off
+        every fixed-name scrape consumer. ``train_grad_norm{module=
+        "encoder"}`` is one family however many blocks the model has."""
         for k, v in metrics.items():
             if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            key = str(k)
+            if "/" in key:
+                family, _, module = key.partition("/")
+                name = sanitize_name(
+                    f"{prefix}_{family}" if prefix else family)
+                self.gauge(name, labels={"module": module}).set(v)
                 continue
             name = sanitize_name(f"{prefix}_{k}" if prefix else k)
             self.gauge(name).set(v)

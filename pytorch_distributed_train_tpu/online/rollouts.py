@@ -194,4 +194,39 @@ def to_grpo_batch(batch: RolloutBatch, encode, reward_fn, *,
         k = cursor[r.group]
         cursor[r.group] += 1
         adv[row] = advs[r.group][k]
+    _record_batch_analytics(batch, rewards, adv)
     return {"input_ids": ids, "loss_mask": mask, "advantage": adv}
+
+
+def _record_batch_analytics(batch: RolloutBatch, rewards: dict,
+                            adv: np.ndarray) -> None:
+    """Post-training health gauges per converted batch (the model-health
+    plane's rollout-side inputs — obs/model_health.py): raw reward
+    level/spread (``reward_collapse`` alert input), post-normalization
+    advantage spread (all-zero = every group degenerate: no train
+    signal), and the mixed-version census (sustained >1 = swap cadence
+    lagging the harvest cadence). Host-side numpy on values already in
+    hand — no extra work at scale."""
+    flat = np.asarray([r for rs in rewards.values() for r in rs],
+                      np.float32)
+    reg = get_registry()
+    if flat.size:
+        reg.gauge("rollout_reward_mean",
+                  help="mean raw reward over the last converted rollout "
+                       "batch").set(float(flat.mean()))
+        reg.gauge("rollout_reward_std",
+                  help="raw reward spread over the last converted "
+                       "rollout batch").set(float(flat.std()))
+    if adv.size:
+        reg.gauge("rollout_advantage_mean",
+                  help="mean group-relative advantage of the last "
+                       "converted rollout batch (~0 by "
+                       "construction)").set(float(adv.mean()))
+        reg.gauge("rollout_advantage_std",
+                  help="advantage spread of the last converted rollout "
+                       "batch (0 = no train signal)").set(
+                      float(adv.std()))
+    reg.gauge("rollout_mixed_versions",
+              help="distinct generating weight versions in the last "
+                   "converted rollout batch").set(
+                  float(len(batch.versions())))

@@ -1,0 +1,90 @@
+"""In-graph model-health statistics: the training-dynamics telemetry
+pass (ISSUE 20 tentpole, obs/model_health.py's device-side half).
+
+One traversal of the gradient/param/update trees per step computes, for
+every TOP-LEVEL module of the parameter tree, the gradient norm, the
+parameter norm, the update norm and the update-to-param ratio — the
+classic divergence precursors (per-block gradient explosion, an update
+that suddenly dwarfs the weights it lands on) — plus the tree-wide
+aggregates the fleet alert rules watch. Everything is reduced IN-GRAPH
+to scalars, so the host cost stays one transfer at log cadence no
+matter how many modules the model has.
+
+The update norm is measured on the ACTUAL applied update
+(``new_params - params``), not the optimizer's proposed update: the
+numeric-guard skip branch, loss-scale gating and the LR-cooldown leaf
+are all reflected for free (a skipped step reads as update_norm 0).
+
+The jitted-step purity contract applies (tools/analyze jit-purity pass
+covers this file): everything here is traced math — no host syncs, no
+prints, no wall clocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Floor under the param norm in the update-to-param ratio: a freshly
+# zero-initialized block (biases, layernorm offsets) must read as
+# "huge update" via the numerator, not divide by zero.
+_RATIO_EPS = 1e-12
+
+
+def _sumsq(tree) -> jnp.ndarray:
+    return sum(
+        (jnp.sum(jnp.square(x.astype(jnp.float32)))
+         for x in jax.tree_util.tree_leaves(tree)),
+        start=jnp.float32(0.0))
+
+
+def _diff_sumsq(new_tree, old_tree) -> jnp.ndarray:
+    return sum(
+        (jnp.sum(jnp.square(n.astype(jnp.float32)
+                            - o.astype(jnp.float32)))
+         for n, o in zip(jax.tree_util.tree_leaves(new_tree),
+                         jax.tree_util.tree_leaves(old_tree))),
+        start=jnp.float32(0.0))
+
+
+def health_stats(grads, params, new_params) -> dict:
+    """Per-top-level-module training-dynamics stats + aggregates.
+
+    Returns a flat metrics dict of f32 scalars:
+
+    - ``grad_norm/<module>``, ``param_norm/<module>``,
+      ``update_norm/<module>``, ``update_ratio/<module>`` for every
+      top-level key of the param tree (the ``module=`` label series on
+      the scrape surface — obs/registry.set_from_mapping);
+    - ``param_norm``, ``update_norm`` — tree-wide norms (``grad_norm``
+      is already in the step metrics);
+    - ``update_ratio_max`` — the worst module's update-to-param ratio,
+      the scalar the ``grad_norm_spike`` early-warning path pairs with.
+
+    Caller contract (steps.py): only ever ADDS metrics entries — the
+    update path itself is untouched, so ``obs.model_health`` off is
+    bitwise identical to the pre-telemetry step.
+    """
+    stats: dict[str, jnp.ndarray] = {}
+    param_sq = jnp.float32(0.0)
+    update_sq = jnp.float32(0.0)
+    ratios = []
+    for key in grads:
+        g_sq = _sumsq(grads[key])
+        p_sq = _sumsq(params[key])
+        u_sq = _diff_sumsq(new_params[key], params[key])
+        p_norm = jnp.sqrt(p_sq)
+        u_norm = jnp.sqrt(u_sq)
+        ratio = u_norm / (p_norm + _RATIO_EPS)
+        stats[f"grad_norm/{key}"] = jnp.sqrt(g_sq)
+        stats[f"param_norm/{key}"] = p_norm
+        stats[f"update_norm/{key}"] = u_norm
+        stats[f"update_ratio/{key}"] = ratio
+        param_sq = param_sq + p_sq
+        update_sq = update_sq + u_sq
+        ratios.append(ratio)
+    stats["param_norm"] = jnp.sqrt(param_sq)
+    stats["update_norm"] = jnp.sqrt(update_sq)
+    stats["update_ratio_max"] = (
+        jnp.max(jnp.stack(ratios)) if ratios else jnp.float32(0.0))
+    return stats

@@ -129,7 +129,8 @@ def make_train_step(model, loss_fn: Callable, tx,
                     fused_update=None,
                     reduce_grads: Callable | None = None,
                     reduce_grads_accum: Callable | None = None,
-                    reduce_metrics: Callable | None = None) -> Callable:
+                    reduce_metrics: Callable | None = None,
+                    model_health: bool = False) -> Callable:
     """Returns train_step(state, batch, rng) -> (state, metrics). Pure;
     closes over the optax transform (and the static EMA decay / mixup
     transform); jit-wrapped by the caller with explicit shardings.
@@ -137,6 +138,11 @@ def make_train_step(model, loss_fn: Callable, tx,
     metrics (grad_norm/<module> keys) — the torch-recipe debugging habit
     of watching which block's gradients explode/vanish; computed in-graph,
     so it costs a few reductions, not a host transfer per param.
+    ``model_health`` (obs/model_health.py) widens that to the full
+    training-dynamics pass (ops/model_health.health_stats): per-module
+    grad/param/update norms and update-to-param ratios plus tree-wide
+    aggregates, all reduced in-graph. It only ADDS metrics entries — the
+    update path is bitwise identical with the flag off.
     ``numeric_guard`` (sentinel/) generalizes the GradScaler skip-step to
     UNSCALED training: a non-finite grad or loss skips the optimizer
     update in-graph (params/opt-state unchanged, step still advances)
@@ -318,7 +324,8 @@ def make_train_step(model, loss_fn: Callable, tx,
             return _fused_epilogue_step(
                 state, grads, loss, aux, model_aux, new_stats,
                 fused_update=fused_update, numeric_guard=numeric_guard,
-                module_grad_norms=module_grad_norms)
+                module_grad_norms=module_grad_norms,
+                model_health=model_health)
 
         if state.dynamic_scale is not None:
             # GradScaler semantics (torch:amp/grad_scaler.py:302,375,484):
@@ -377,7 +384,18 @@ def make_train_step(model, loss_fn: Callable, tx,
         gnorm = optax_global_norm(grads)
         metrics = {"loss": loss, "grad_norm": gnorm, "aux_loss": model_aux,
                    **aux, **metrics_extra}
-        if module_grad_norms:
+        if model_health:
+            # Training-dynamics pass on the ACTUAL applied update (the
+            # skip-select is already folded into new_state.params);
+            # supersedes the module_grad_norms loop (same grad_norm/<k>
+            # keys, plus param/update norms and ratios).
+            from pytorch_distributed_train_tpu.ops.model_health import (
+                health_stats,
+            )
+
+            metrics.update(health_stats(grads, state.params,
+                                        new_state.params))
+        elif module_grad_norms:
             for key, sub in grads.items():
                 metrics[f"grad_norm/{key}"] = optax_global_norm(sub)
         return new_state, metrics
@@ -387,7 +405,8 @@ def make_train_step(model, loss_fn: Callable, tx,
 
 def _fused_epilogue_step(state: TrainState, grads, loss, aux, model_aux,
                          new_stats, *, fused_update, numeric_guard: bool,
-                         module_grad_norms: bool):
+                         module_grad_norms: bool,
+                         model_health: bool = False):
     """Shared tail of train_step on the fused path: loss-scale unscale +
     finite gate + clip + optimizer update in ONE pass over the grad tree
     (ops/fused_update.py), instead of the chain's three passes plus the
@@ -436,7 +455,13 @@ def _fused_epilogue_step(state: TrainState, grads, loss, aux, model_aux,
         new_state = new_state.replace(dynamic_scale=new_dynamic_scale)
     metrics = {"loss": loss, "grad_norm": gnorm, "aux_loss": model_aux,
                **aux, **metrics_extra}
-    if module_grad_norms:
+    if model_health:
+        from pytorch_distributed_train_tpu.ops.model_health import (
+            health_stats,
+        )
+
+        metrics.update(health_stats(grads, state.params, new_params))
+    elif module_grad_norms:
         for key, sub in grads.items():
             metrics[f"grad_norm/{key}"] = optax_global_norm(sub)
     return new_state, metrics

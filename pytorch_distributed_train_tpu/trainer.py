@@ -385,6 +385,7 @@ class Trainer:
             swa_every=getattr(cfg.optim, "swa_every", 1), mixup=mixup,
             device_augment=device_augment,
             module_grad_norms=cfg.obs.log_module_grad_norms,
+            model_health=cfg.obs.model_health,
             param_transform=param_transform,
             teacher_fn=self.teacher_fn,
             numeric_guard=cfg.sentinel.enabled,
@@ -606,6 +607,22 @@ class Trainer:
                 sigma=cfg.sentinel.spike_sigma,
                 min_samples=cfg.sentinel.spike_min_samples,
                 min_rel=cfg.sentinel.spike_min_rel)
+        # ---- model-health monitor (obs/model_health.py): per-series
+        # spike detection over the host metrics record at log cadence —
+        # divergence early warning on the training-dynamics telemetry
+        # the in-graph pass (ops/model_health.py) lands in the step
+        # metrics. Arms the SAME rewind path as the loss sentinel, but
+        # fires on the precursors (grad/update norms, reward/KL drift)
+        # steps before the loss moves. Independent of sentinel.enabled:
+        # the monitor reads metrics already on host, no extra sync.
+        self.health = None
+        if cfg.obs.model_health:
+            from pytorch_distributed_train_tpu.obs import (
+                model_health as model_health_lib,
+            )
+
+            self.health = model_health_lib.ModelHealthMonitor(
+                profiler=self.profiler)
         self.liveness = None
         if cfg.sentinel.hang_timeout_s > 0:
             from pytorch_distributed_train_tpu.sentinel.liveness import (
@@ -863,6 +880,13 @@ class Trainer:
                     # untouched).
                     inflate_loss = self.faults.maybe_fire(
                         "step.loss_spike", step=step)
+                    # step.grad_spike inflates only the OBSERVED grad/
+                    # update telemetry (post-backward, pre-anything the
+                    # monitor reads) — the early-warning drill: the
+                    # model-health plane must fire on it while the loss
+                    # stays healthy, so the sentinel never trips.
+                    inflate_grads = self.faults.maybe_fire(
+                        "step.grad_spike", step=step)
                     if self.faults.maybe_fire("step.nan", step=step):
                         batch = _poison_batch_nan(batch)
                     # First execution per process = jit trace + compile
@@ -892,6 +916,18 @@ class Trainer:
                         # (Lazy jnp multiply: no device sync here.)
                         metrics = dict(metrics,
                                        loss=metrics["loss"] * 1e6)
+                    if inflate_grads:
+                        # step.grad_spike drill: same observation-only
+                        # stance — every grad/update telemetry reader
+                        # (log record, scrape mirror, fleet collector,
+                        # model-health monitor) sees the spike; params
+                        # and the loss stay healthy. (Lazy jnp multiply:
+                        # no device sync here.)
+                        metrics = {
+                            k: (v * 1e3 if k.startswith(
+                                ("grad_norm", "update_norm",
+                                 "update_ratio")) else v)
+                            for k, v in metrics.items()}
                     # Host-side step counter: int(state.step) every step
                     # would sync the device and serialize async dispatch
                     # (the jitted step increments state.step identically,
@@ -926,7 +962,19 @@ class Trainer:
                         self.liveness.beat(step)
                     self.recorder.record("step", step)
                     if step % cfg.obs.log_every_steps == 0 or step == limit:
-                        self._log_train(step, metrics)
+                        host_rec = self._log_train(step, metrics)
+                        if (self.health is not None
+                                and self.health.observe(step, host_rec)):
+                            # Early-warning rewind: the model-health
+                            # monitor armed on divergence PRECURSORS
+                            # (grad/update norms, reward/KL) — same
+                            # restore+cooldown path as the loss
+                            # sentinel, steps earlier.
+                            step = self._sentinel_rewind(step)
+                            epoch = step // max(self.steps_per_epoch, 1)
+                            self.meter.reset_clock()
+                            rewound = True
+                            break
                     # The step bucket closes AFTER the (cadenced) log:
                     # _log_train's device sync is where async-dispatched
                     # compute gets waited on host-side, and that wait is
@@ -1171,7 +1219,10 @@ class Trainer:
         except Exception:
             pass  # incl. unserializable span args — never fail the run
 
-    def _log_train(self, step: int, metrics: dict) -> None:
+    def _log_train(self, step: int, metrics: dict) -> dict:
+        """Build + emit the host-side train record; returns it so the
+        fit loop can feed the model-health monitor without a second
+        device transfer."""
         host = {k: float(np.asarray(v)) for k, v in metrics.items()}
         # the schedule counts optimizer updates, not micro-steps
         host["lr"] = float(self.lr_schedule(step // max(self.cfg.optim.accum_steps, 1)))
@@ -1221,7 +1272,7 @@ class Trainer:
         # two /proc reads plus an already-cached jax stats call, and
         # they are the fleet plane's first alert-rule inputs.
         memory_lib.sample_memory_gauges()
-        if self._sentinel_on:
+        if self._sentinel_on or self.health is not None:
             scale = sentinel_numeric.cooldown_scale(self.state.opt_state)
             if scale is not None and scale != 1.0:
                 # post-rewind cooldown: fold into the reported lr like
@@ -1253,6 +1304,7 @@ class Trainer:
                     p50_max=round(agg["step_time_p50_max"], 3),
                     p50_med=round(agg["step_time_p50_med"], 3))
         self.logger.log(step, host, prefix="train")
+        return host
 
     def update_bn(self, num_batches: int = 50) -> None:
         """Re-estimate BN running statistics for the CURRENT eval params
@@ -1442,7 +1494,12 @@ class Trainer:
                 "diverging after repeated restore+cooldown — aborting "
                 "rather than looping restore/diverge forever")
         self._bad_streak = 0
-        self._spike.reset()
+        if self._spike is not None:  # health-armed rewind, sentinel off
+            self._spike.reset()
+        if self.health is not None:
+            # post-rewind: the pre-rewind telemetry regime may contain
+            # the very divergence being recovered from
+            self.health.reset()
         try:
             # a mid-flight async save must commit before we pick
             self.ckpt.wait()

@@ -184,6 +184,65 @@ def test_section_contract_slo_budgets(tmp_path, capsys):
     assert "traces:" not in out
 
 
+def test_section_contract_model_health(tmp_path, capsys):
+    """Model-health section (metrics- and model-journal-sourced):
+    ABSENT entirely for runs without the plane — ``grad_norm`` alone is
+    every run's baseline metric and must NOT light it up; present when
+    train records carry the plane's keys (``update_ratio_max`` etc.) or
+    the journal holds ``model`` events."""
+    jsonl, _ = _write_fixture(tmp_path)
+    recs = [json.loads(line) for line in jsonl.read_text().splitlines()
+            if line.startswith("{\"")]
+    for r in recs:
+        if r["tag"] == "train":
+            r["grad_norm"] = 1.5  # baseline metric, not the plane
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "model health" not in out
+    # the plane's in-graph keys → section renders, series table + the
+    # no-warnings line
+    for i, r in enumerate(r for r in recs if r["tag"] == "train"):
+        r["update_ratio_max"] = 0.01 + 0.001 * i
+        r["kl_behavior"] = 0.002
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "model health:" in out
+    assert "update_ratio_max" in out and "kl_behavior" in out
+    assert "grad_norm" in out  # rides the table once the plane is on
+    assert "model events: none journaled" in out
+    # model journal events alone (metrics keys absent) also light it,
+    # with the early-warning arc rendered
+    jsonl2 = tmp_path / "metrics.jsonl"
+    base = [json.loads(line) for line in jsonl2.read_text().splitlines()
+            if line.startswith("{\"")]
+    for r in base:
+        r.pop("update_ratio_max", None)
+        r.pop("kl_behavior", None)
+    jsonl2.write_text("".join(json.dumps(r) + "\n" for r in base))
+    events = tmp_path / "events"
+    events.mkdir()
+    (events / "events_host0.jsonl").write_text(
+        json.dumps({"ts": 1.0, "step": 120, "host": "host0", "gen": "0",
+                    "category": "model", "name": "early_warning",
+                    "detail": {"series": "grad_norm", "value": 99.0,
+                               "lr": 0.05}}) + "\n"
+        + json.dumps({"ts": 2.0, "step": 121, "host": "host0",
+                      "gen": "0", "category": "model",
+                      "name": "rewind_armed",
+                      "detail": {"series": "grad_norm", "streak": 3}})
+        + "\n")
+    obs_report.main(["--run-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "model health:" in out
+    assert "model events (2):" in out
+    assert "last warning" in out and "series=grad_norm" in out
+    assert "last rewind armed" in out and "@step 121" in out
+    # later sections still follow their own contracts
+    assert "events (2 journaled" in out
+
+
 def test_corrupt_journal_does_not_suppress_later_sections(tmp_path,
                                                           capsys):
     """A journal whose records defeat the loader (non-numeric ts mixed

@@ -92,6 +92,23 @@ def test_online_loop_smoke():
         # state reads the final published version
         assert report["converged"] is True
         assert set(report["final_versions"].values()) == {"2"}
+
+        # model-health plane (ISSUE 20): the rollout/KL analytics are
+        # LIVE on the loop's scrape registry — reward level/spread and
+        # the mixed-version census from every converted batch, token
+        # entropy and KL-to-behavior from the GRPO aux (the loop
+        # recomputes behavior logprobs against the harvest-version
+        # weights, so kl_behavior flows from the first update on)
+        hg = report["health_gauges"]
+        assert hg["rollout_reward_mean"] is not None
+        assert hg["rollout_reward_std"] is not None
+        assert hg["rollout_advantage_mean"] is not None
+        assert hg["rollout_advantage_std"] is not None
+        assert hg["rollout_mixed_versions"] >= 1.0
+        assert hg["train_token_entropy"] > 0.0
+        assert hg["train_kl_behavior"] is not None
+        for entry in log:
+            assert entry["kl_behavior"] is not None
     finally:
         _cleanup(report)
 

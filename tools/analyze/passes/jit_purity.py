@@ -124,6 +124,9 @@ class JitPurityPass(AnalysisPass):
         # fused optimizer/block epilogues execute inside the jitted
         # step (ISSUE 14) — same purity contract as steps.py
         "pytorch_distributed_train_tpu/ops/fused_update.py",
+        # in-graph model-health stats (ISSUE 20) run inside the jitted
+        # step at every step — same purity contract as steps.py
+        "pytorch_distributed_train_tpu/ops/model_health.py",
     )
 
     def run(self, ctx: Context) -> list[Finding]:

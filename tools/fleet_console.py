@@ -226,6 +226,22 @@ def render_snapshot(snap: dict, alerts: list[dict],
             extras.append(f"err {r['error']}")
         if extras:
             lines.append(" " * 13 + "· " + "  ".join(extras))
+        health = r.get("model_health") or {}
+        if health:
+            # model-health panel (obs/model_health.py): the divergence
+            # precursors per target — latest value + in-window
+            # sparkline from the collector's own deques (no history
+            # store needed)
+            cells = []
+            for name in ("grad_norm", "update_ratio", "reward_mean",
+                         "kl_behavior"):
+                vals = health.get(name)
+                if vals:
+                    cells.append(
+                        f"{name} {_num(vals[-1], '{:.3g}')} "
+                        f"{sparkline(vals)}")
+            if cells:
+                lines.append(" " * 13 + "♥ " + "  ".join(cells))
         spark = _history_spark(history, r)
         if spark:
             lines.append(" " * 13 + "~ " + spark)

@@ -503,6 +503,60 @@ def store_section(events_dir: str,
     return out
 
 
+def model_health_section(recs: list[dict],
+                         events: list[dict] | None = None) -> list[str]:
+    """Model-health plane (obs/model_health.py): the training-dynamics
+    trend over the logged windows — grad norm, worst update-to-param
+    ratio, reward/KL/entropy when the run is online — plus the
+    ``model`` journal's early-warning arc. Quiet (empty) for runs
+    without the plane: no ``update_ratio_max``-bearing train records
+    (``grad_norm`` alone is every run's baseline metric, not the
+    plane) and no ``model`` events."""
+    health_keys = ("grad_norm", "update_ratio_max", "update_norm",
+                   "reward_mean", "kl_behavior", "token_entropy")
+    rows = [r for r in recs if r.get("tag") == "train"
+            and ("update_ratio_max" in r or "kl_behavior" in r
+                 or "token_entropy" in r)]
+    mrecs = [e for e in (events or [])
+             if e.get("category") == "model"]
+    if not rows and not mrecs:
+        return []
+    out = ["model health:"]
+    if rows:
+        out.append(f"  {'series':<18} {'n':>5} {'first':>10} "
+                   f"{'last':>10} {'max':>10}")
+        for key in health_keys:
+            vals = [float(r[key]) for r in rows
+                    if isinstance(r.get(key), (int, float))]
+            if not vals:
+                continue
+            out.append(f"  {key:<18} {len(vals):>5} {vals[0]:>10.4g} "
+                       f"{vals[-1]:>10.4g} {max(vals):>10.4g}")
+    if mrecs:
+        by_name: dict[str, int] = {}
+        for e in mrecs:
+            by_name[e.get("name", "?")] = by_name.get(
+                e.get("name", "?"), 0) + 1
+        out.append(f"  model events ({len(mrecs)}): " + "  ".join(
+            f"{n}={c}" for n, c in sorted(by_name.items(),
+                                          key=lambda kv: -kv[1])))
+        for label, name in (("last warning", "early_warning"),
+                            ("last rewind armed", "rewind_armed")):
+            hit = next((e for e in reversed(mrecs)
+                        if e.get("name") == name), None)
+            if hit is None:
+                continue
+            detail = " ".join(
+                f"{k}={v}" for k, v in
+                (hit.get("detail") or {}).items())[:64]
+            out.append(f"  {label:<17} @step {hit.get('step')} "
+                       f"[{hit.get('host')} g{hit.get('gen')}] "
+                       f"{detail}".rstrip())
+    elif rows:
+        out.append("  model events: none journaled (no warnings fired)")
+    return out
+
+
 def traces_section(traces_dir: str, top: int = 5) -> list[str]:
     """Slowest retained distributed traces (obs/tracing.py): top-K by
     whole-request duration with the per-phase (queue / prefill / decode
@@ -626,6 +680,8 @@ def report(jsonl_path: str, trace_path: str = "",
             ("perf", lambda: perf_section(recs, events, ledger_rows)),
             ("input pipeline", lambda: input_section(recs)),
             ("stragglers", lambda: straggler_section(recs)),
+            ("model health",
+             lambda: model_health_section(recs, events)),
             ("spans", lambda: spans_section(trace_path)),
             ("events", lambda: events_section(events_dir, events)),
             ("serving", lambda: serving_section(events_dir, events)),

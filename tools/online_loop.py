@@ -158,9 +158,26 @@ def _build_trainer(seq_len: int, steps_total: int):
     sharding = steps_lib.state_shardings(mesh, rules, shape)
     state = jax.jit(init_state, out_shardings=sharding)(rng)
     step = steps_lib.jit_train_step(
-        steps_lib.make_train_step(model, loss_fn, tx), mesh, sharding,
-        batch_axes=("data", "fsdp"))
-    return state, step
+        steps_lib.make_train_step(model, loss_fn, tx,
+                                  model_health=True),
+        mesh, sharding, batch_axes=("data", "fsdp"))
+
+    @jax.jit
+    def behavior_logprobs(params, ids):
+        # Per-token logprobs of `ids` under `params`, in the TRAINER's
+        # tokenization. Serving returns logprobs in its own token
+        # space, which need not align with the trainer's re-encoding —
+        # so the behavior policy is recomputed here, against the
+        # harvest-version weights, before any update applies. Column 0
+        # is padding: the loss reads [:, 1:].
+        logits, _, _ = steps_lib.apply_model(
+            model, params, {}, {"input_ids": ids}, train=False,
+            dropout_rng=None)
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        logp = jnp.take_along_axis(lp, ids[:, 1:, None], axis=-1)[..., 0]
+        return jnp.pad(logp, ((0, 0), (1, 0)))
+
+    return state, step, behavior_logprobs
 
 
 def _encode(text: str) -> list[int]:
@@ -225,6 +242,7 @@ def run_loop(*, replicas: int = 2, cycles: int = 2,
     from pytorch_distributed_train_tpu.obs import events as events_lib
     from pytorch_distributed_train_tpu.obs import spans as spans_lib
     from pytorch_distributed_train_tpu.obs import tracing
+    from pytorch_distributed_train_tpu.obs.registry import get_registry
     from pytorch_distributed_train_tpu.online import (
         RolloutCollector,
         WeightPublisher,
@@ -287,8 +305,8 @@ def run_loop(*, replicas: int = 2, cycles: int = 2,
             report["error"] = f"only {len(up)}/{replicas} replicas up"
             return report
 
-        state, step = _build_trainer(seq_len,
-                                     cycles * steps_per_cycle)
+        state, step, behavior_fn = _build_trainer(
+            seq_len, cycles * steps_per_cycle)
         publisher = WeightPublisher(store, cadence_steps=1)
         collectors = [RolloutCollector(f"http://{a}",
                                        group_size=group_size,
@@ -330,6 +348,11 @@ def run_loop(*, replicas: int = 2, cycles: int = 2,
                                          seq_len=seq_len)
                     jbatch = {k: jnp.asarray(v)
                               for k, v in grpo.items()}
+                    # behavior policy = the harvest-version weights,
+                    # recomputed trainer-side (PPO clipped ratio +
+                    # kl_behavior drift live from the first update on)
+                    jbatch["behavior_logprobs"] = behavior_fn(
+                        state.params, jbatch["input_ids"])
                     import jax as _jax
 
                     rng = _jax.random.PRNGKey(100 + c)
@@ -342,7 +365,15 @@ def run_loop(*, replicas: int = 2, cycles: int = 2,
                             state, metrics = step(state, jbatch, rng)
                             losses.append(float(metrics["loss"]))
                             global_step += 1
+                            # mirror onto the scrape surface, the
+                            # trainer-process MetricLogger convention
+                            get_registry().set_from_mapping(
+                                {k: float(v)
+                                 for k, v in metrics.items()},
+                                prefix="train")
                     entry["losses"] = losses
+                    entry["kl_behavior"] = get_registry().get_value(
+                        "train_kl_behavior")
                     with spans_lib.span("online.publish"):
                         version = publisher.publish(
                             {"params": state.params},
@@ -376,6 +407,16 @@ def run_loop(*, replicas: int = 2, cycles: int = 2,
         report["final_versions"] = versions
         report["converged"] = all(v == final
                                   for v in versions.values())
+        # the model-health plane's rollout/KL gauges, read back off the
+        # same registry the /metrics scrape surface renders
+        reg = get_registry()
+        report["health_gauges"] = {
+            name: reg.get_value(name)
+            for name in ("rollout_reward_mean", "rollout_reward_std",
+                         "rollout_advantage_mean",
+                         "rollout_advantage_std",
+                         "rollout_mixed_versions",
+                         "train_kl_behavior", "train_token_entropy")}
     finally:
         stop.set()
         prober.stop()

@@ -48,6 +48,16 @@ from pytorch_distributed_train_tpu.obs.events import load_events  # noqa: E402
 CORE_SERIES = ("ttft_p95_s", "shed_per_s", "steps_per_s",
                "goodput_pct")
 
+# model-health incidents (obs/model_health.py) read as a PAIR of
+# training-dynamics series — a grad-norm spike without the update
+# ratio (or a KL runaway without the reward level) is half the story,
+# so each rule pulls its companion series into the window render
+MODEL_HEALTH_SERIES = {
+    "grad_norm_spike": ("grad_norm", "update_ratio", "loss"),
+    "reward_collapse": ("reward_mean", "kl_behavior"),
+    "kl_runaway": ("kl_behavior", "reward_mean"),
+}
+
 
 def find_alert(events: list[dict], alert_id: str) -> dict | None:
     """The incident's fired/resolved/profile records by id (exact, or
@@ -278,7 +288,8 @@ def report(run_dir: str, *, alert_id: str = "", t_from: str = "",
         except Exception:
             rule_series = ()
     series_names = list(dict.fromkeys(
-        (*rule_series, *CORE_SERIES)))
+        (*rule_series, *MODEL_HEALTH_SERIES.get(rule, ()),
+         *CORE_SERIES)))
 
     def targets_to_show():
         if target_key:
